@@ -1,0 +1,50 @@
+"""rabia-tpu: a TPU-native State Machine Replication framework.
+
+A brand-new implementation of the capability set of rabia-rs/rabia (the Rabia
+randomized consensus protocol, SOSP 2021): leaderless crash-fault-tolerant
+weak-MVC consensus with a common coin, behind a pluggable deterministic
+``StateMachine`` API, with TCP and in-memory transports, snapshot persistence,
+a sharded key-value store with change notifications, network simulation with
+fault injection, and a performance harness.
+
+Unlike the reference (actor-per-node Rust with scalar vote logic), the
+consensus hot loop here is an array program: phase management, quorum vote
+tallying and the common-coin flip for thousands of concurrent consensus
+instances (one per kvstore key-range shard) are evaluated as a single
+vectorized reduction over a ``[shards, replicas]`` vote matrix in JAX/XLA.
+
+Layer map (mirrors the reference's crate workspace; see SURVEY.md):
+
+- :mod:`rabia_tpu.core`        — types, messages, traits, config, validation
+  (reference: ``rabia-core``)
+- :mod:`rabia_tpu.kernel`      — the JAX batched phase driver (reference:
+  ``rabia-engine`` phase management, vectorized)
+- :mod:`rabia_tpu.engine`      — host event loop, engine state, leader info
+  (reference: ``rabia-engine``)
+- :mod:`rabia_tpu.persistence` — in-memory / atomic-file snapshot stores
+  (reference: ``rabia-persistence``)
+- :mod:`rabia_tpu.kvstore`     — sharded KV store + notification bus
+  (reference: ``rabia-kvstore``)
+- :mod:`rabia_tpu.net`         — in-memory transport, network simulator, TCP
+  (reference: ``rabia-engine/src/network`` + ``rabia-testing`` transports)
+- :mod:`rabia_tpu.testing`     — fault-injection + performance harnesses
+  (reference: ``rabia-testing``)
+- :mod:`rabia_tpu.apps`        — counter / banking / kvstore SMR applications
+  (reference: ``examples/*_smr``)
+"""
+
+__version__ = "0.1.0"
+
+from rabia_tpu.core.types import (  # noqa: F401
+    ABSENT,
+    V0,
+    V1,
+    VQUESTION,
+    BatchId,
+    Command,
+    CommandBatch,
+    NodeId,
+    PhaseId,
+    StateValue,
+)
+from rabia_tpu.core.errors import RabiaError  # noqa: F401

@@ -1,0 +1,21 @@
+"""Core layer: identifiers, protocol values, messages, traits and config.
+
+Reference parity: ``rabia-core`` (rabia-core/src/lib.rs:95-105 declares the
+module set mirrored here: batching, error, memory/buffers, messages, network,
+persistence, serialization, smr, state_machine, types, validation).
+"""
+
+from rabia_tpu.core import (  # noqa: F401
+    batching,
+    config,
+    errors,
+    messages,
+    network,
+    oracle,
+    persistence,
+    serialization,
+    smr,
+    state_machine,
+    types,
+    validation,
+)

@@ -1,0 +1,303 @@
+"""Protocol message schema and the per-phase vote ledger.
+
+Reference parity: rabia-core/src/messages.rs — the ``ProtocolMessage``
+envelope (:6-56), the 9-variant message enum (:58-69), payloads (:71-136),
+``PhaseData`` with its majority tally (:138-223) and ``PendingBatch``
+(:225-257).
+
+TPU-native twist: vote messages carry **vectors of votes over the shard
+axis** (``shards: array of shard indices``, ``votes: int8 per shard``), not
+one scalar vote — a replica exchanges its whole per-phase vote vector with a
+peer in a single message. The scalar case is a length-1 vector. ``PhaseData``
+remains the host-side ledger for shards handled off-device; the batched tally
+lives in :mod:`rabia_tpu.kernel.phase_driver`.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from rabia_tpu.core.types import (
+    BatchId,
+    Command,
+    CommandBatch,
+    NodeId,
+    PhaseId,
+    StateValue,
+    quorum_size,
+)
+
+
+class MessageType(enum.IntEnum):
+    """Wire discriminants (order stable — used by the binary codec)."""
+
+    Propose = 1
+    VoteRound1 = 2
+    VoteRound2 = 3
+    Decision = 4
+    SyncRequest = 5
+    SyncResponse = 6
+    NewBatch = 7
+    HeartBeat = 8
+    QuorumNotification = 9
+
+
+# ---------------------------------------------------------------------------
+# Payloads (one dataclass per MessageType)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class VoteEntry:
+    """One (shard, phase, vote) triple inside a vote vector."""
+
+    shard: int
+    phase: int
+    vote: StateValue
+
+
+@dataclass(frozen=True)
+class Propose:
+    """Proposer announces a batch for a shard's next phase.
+
+    Reference: messages.rs:71-82 ProposeMessage{phase_id, batch_id, value,
+    batch}; here additionally scoped to a shard.
+    """
+
+    shard: int
+    phase: int
+    batch_id: BatchId
+    value: StateValue
+    batch: Optional[CommandBatch] = None
+
+
+@dataclass(frozen=True)
+class VoteRound1:
+    """Round-1 vote vector. Unlike the reference (which unicasts R1 votes to
+    the proposer only — engine.rs:418-419, a documented protocol deviation,
+    SURVEY.md §3.1), round-1 votes are **broadcast** per the Ivy spec."""
+
+    votes: tuple[VoteEntry, ...]
+
+
+@dataclass(frozen=True)
+class VoteRound2:
+    """Round-2 vote vector (broadcast)."""
+
+    votes: tuple[VoteEntry, ...]
+
+
+@dataclass(frozen=True)
+class DecisionEntry:
+    shard: int
+    phase: int
+    decision: StateValue
+    batch_id: Optional[BatchId] = None
+
+
+@dataclass(frozen=True)
+class Decision:
+    """Decision notifications (messages.rs:100-106), vectorized per shard."""
+
+    decisions: tuple[DecisionEntry, ...]
+
+
+@dataclass(frozen=True)
+class SyncRequest:
+    """Lagging node asks peers for state (messages.rs:108-112)."""
+
+    current_phase: int
+    state_version: int
+
+
+@dataclass(frozen=True)
+class SyncResponse:
+    """Peer replies with snapshot if ahead (messages.rs:114-121)."""
+
+    responder_phase: int
+    state_version: int
+    snapshot: Optional[bytes] = None
+    per_shard_phase: tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class NewBatch:
+    """Batch payload dissemination ahead of/alongside a proposal."""
+
+    shard: int
+    batch: CommandBatch
+
+
+@dataclass(frozen=True)
+class HeartBeat:
+    """Liveness + progress beacon (messages.rs:125-130)."""
+
+    current_phase: int
+    committed_phase: int
+
+
+@dataclass(frozen=True)
+class QuorumNotification:
+    """Quorum lost/restored announcement (messages.rs:132-136)."""
+
+    has_quorum: bool
+    active_nodes: tuple[NodeId, ...]
+
+
+Payload = (
+    Propose
+    | VoteRound1
+    | VoteRound2
+    | Decision
+    | SyncRequest
+    | SyncResponse
+    | NewBatch
+    | HeartBeat
+    | QuorumNotification
+)
+
+_PAYLOAD_TYPE = {
+    Propose: MessageType.Propose,
+    VoteRound1: MessageType.VoteRound1,
+    VoteRound2: MessageType.VoteRound2,
+    Decision: MessageType.Decision,
+    SyncRequest: MessageType.SyncRequest,
+    SyncResponse: MessageType.SyncResponse,
+    NewBatch: MessageType.NewBatch,
+    HeartBeat: MessageType.HeartBeat,
+    QuorumNotification: MessageType.QuorumNotification,
+}
+
+
+@dataclass(frozen=True)
+class ProtocolMessage:
+    """Envelope: id, from, optional to (None = broadcast), timestamp, payload.
+
+    Reference: messages.rs:6-56.
+    """
+
+    id: uuid.UUID
+    sender: NodeId
+    recipient: Optional[NodeId]  # None = broadcast
+    timestamp: float
+    payload: Payload
+
+    @staticmethod
+    def new(
+        sender: NodeId, payload: Payload, recipient: Optional[NodeId] = None
+    ) -> "ProtocolMessage":
+        return ProtocolMessage(
+            id=uuid.uuid4(),
+            sender=sender,
+            recipient=recipient,
+            timestamp=time.time(),
+            payload=payload,
+        )
+
+    @property
+    def message_type(self) -> MessageType:
+        return _PAYLOAD_TYPE[type(self.payload)]
+
+    def is_broadcast(self) -> bool:
+        return self.recipient is None
+
+
+# ---------------------------------------------------------------------------
+# Host-side vote ledger (for the scalar/oracle path and engine bookkeeping)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PhaseData:
+    """Vote ledger for one (shard, phase) consensus step.
+
+    Reference: messages.rs:138-223 — holds per-node R1/R2 votes, the batch
+    binding, and the majority tally (``count_votes`` :185-211, ``set_decision``
+    :217-222). The kernel's batched tally is the vectorized form of this.
+    """
+
+    phase: PhaseId
+    batch_id: Optional[BatchId] = None
+    proposed_value: Optional[StateValue] = None
+    round1_votes: dict[NodeId, StateValue] = field(default_factory=dict)
+    round2_votes: dict[NodeId, StateValue] = field(default_factory=dict)
+    decision: Optional[StateValue] = None
+
+    def add_round1_vote(self, node: NodeId, vote: StateValue) -> None:
+        self.round1_votes.setdefault(node, vote)
+
+    def add_round2_vote(self, node: NodeId, vote: StateValue) -> None:
+        self.round2_votes.setdefault(node, vote)
+
+    @staticmethod
+    def count_votes(
+        votes: dict[NodeId, StateValue],
+    ) -> tuple[int, int, int]:
+        """(v0_count, v1_count, vq_count)."""
+        v0 = v1 = vq = 0
+        for v in votes.values():
+            if v == StateValue.V0:
+                v0 += 1
+            elif v == StateValue.V1:
+                v1 += 1
+            elif v == StateValue.VQuestion:
+                vq += 1
+        return v0, v1, vq
+
+    def _majority_of(
+        self, votes: dict[NodeId, StateValue], n_nodes: int
+    ) -> Optional[StateValue]:
+        q = quorum_size(n_nodes)
+        v0, v1, _ = self.count_votes(votes)
+        if v0 >= q:
+            return StateValue.V0
+        if v1 >= q:
+            return StateValue.V1
+        return None
+
+    def round1_majority(self, n_nodes: int) -> Optional[StateValue]:
+        return self._majority_of(self.round1_votes, n_nodes)
+
+    def round2_majority(self, n_nodes: int) -> Optional[StateValue]:
+        return self._majority_of(self.round2_votes, n_nodes)
+
+    def has_round1_quorum(self, n_nodes: int) -> bool:
+        return len(self.round1_votes) >= quorum_size(n_nodes)
+
+    def has_round2_quorum(self, n_nodes: int) -> bool:
+        return len(self.round2_votes) >= quorum_size(n_nodes)
+
+    def set_decision(self, value: StateValue) -> None:
+        """Record the decision; commit only concrete values (messages.rs:217-222)."""
+        if value == StateValue.VQuestion:
+            return
+        if self.decision is None:
+            self.decision = value
+
+    def is_decided(self) -> bool:
+        return self.decision is not None
+
+
+@dataclass
+class PendingBatch:
+    """A submitted batch awaiting consensus (messages.rs:225-257)."""
+
+    batch: CommandBatch
+    proposer: NodeId
+    submitted_at: float = field(default_factory=time.time)
+    phase: Optional[PhaseId] = None
+    attempts: int = 0
+
+    def age(self) -> float:
+        return time.time() - self.submitted_at
+
+
+def vote_vector(
+    entries: Sequence[tuple[int, int, StateValue]],
+) -> tuple[VoteEntry, ...]:
+    """Convenience: build a vote vector from (shard, phase, vote) triples."""
+    return tuple(VoteEntry(s, p, v) for s, p, v in entries)

@@ -1,0 +1,92 @@
+"""Persistence trait and the persisted engine-state blob.
+
+Reference parity: rabia-core/src/persistence.rs — the deliberately minimal
+``PersistenceLayer`` trait (:49-68: save a single opaque state blob, load it
+back) and the persisted ``EngineState`` record (:9-42: phase counters +
+snapshot, JSON to/from bytes). Rabia needs no WAL: the protocol re-derives
+in-flight phases from peers via sync, so durability is one atomic blob
+(:44-48 states this design choice).
+
+TPU twist: the persisted record additionally carries the **per-shard phase
+vector** (the device ``phase[S]`` array, host-serialized) so a restarted
+node resumes every consensus instance, not just a single global counter.
+"""
+
+from __future__ import annotations
+
+import abc
+import base64
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from rabia_tpu.core.errors import PersistenceError
+from rabia_tpu.core.state_machine import Snapshot
+
+
+@dataclass
+class PersistedEngineState:
+    """Durable engine record (persistence.rs:9-42)."""
+
+    current_phase: int = 0
+    last_committed_phase: int = 0
+    state_version: int = 0
+    snapshot: Optional[Snapshot] = None
+    per_shard_phase: list[int] = field(default_factory=list)
+    per_shard_committed: list[int] = field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        doc = {
+            "current_phase": self.current_phase,
+            "last_committed_phase": self.last_committed_phase,
+            "state_version": self.state_version,
+            "snapshot": (
+                base64.b64encode(self.snapshot.to_bytes()).decode("ascii")
+                if self.snapshot
+                else None
+            ),
+            "per_shard_phase": self.per_shard_phase,
+            "per_shard_committed": self.per_shard_committed,
+        }
+        return json.dumps(doc, separators=(",", ":")).encode("utf-8")
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "PersistedEngineState":
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+            snap = (
+                Snapshot.from_bytes(base64.b64decode(doc["snapshot"]))
+                if doc.get("snapshot")
+                else None
+            )
+            return PersistedEngineState(
+                current_phase=int(doc["current_phase"]),
+                last_committed_phase=int(doc["last_committed_phase"]),
+                state_version=int(doc.get("state_version", 0)),
+                snapshot=snap,
+                per_shard_phase=[int(x) for x in doc.get("per_shard_phase", [])],
+                per_shard_committed=[
+                    int(x) for x in doc.get("per_shard_committed", [])
+                ],
+            )
+        except (ValueError, KeyError) as e:
+            raise PersistenceError(f"corrupt engine state: {e}") from None
+
+
+class PersistenceLayer(abc.ABC):
+    """Single-blob durability trait (persistence.rs:49-68)."""
+
+    @abc.abstractmethod
+    async def save_state(self, data: bytes) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def load_state(self) -> Optional[bytes]:
+        ...
+
+    async def save_engine_state(self, state: PersistedEngineState) -> None:
+        await self.save_state(state.to_bytes())
+
+    async def load_engine_state(self) -> Optional[PersistedEngineState]:
+        raw = await self.load_state()
+        return PersistedEngineState.from_bytes(raw) if raw is not None else None

@@ -1,0 +1,142 @@
+"""Engine-facing state machine boundary (untyped bytes).
+
+Reference parity: rabia-core/src/state_machine.rs — the async trait
+(:29-52: apply_command, apply_commands, create_snapshot, restore_snapshot,
+get_state), ``Snapshot`` with crc verification (:6-27), and the built-in
+``InMemoryStateMachine`` understanding SET/GET/DEL text commands (:54-140),
+which is the universal test fixture.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import zlib
+from dataclasses import dataclass
+from typing import Sequence
+
+from rabia_tpu.core.errors import ChecksumMismatchError, StateMachineError
+from rabia_tpu.core.types import Command, CommandBatch
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    """Versioned state blob with integrity check (state_machine.rs:6-27)."""
+
+    version: int
+    data: bytes
+    checksum: int
+
+    @staticmethod
+    def create(version: int, data: bytes) -> "Snapshot":
+        return Snapshot(version=version, data=data, checksum=zlib.crc32(data) & 0xFFFFFFFF)
+
+    def verify(self) -> None:
+        actual = zlib.crc32(self.data) & 0xFFFFFFFF
+        if actual != self.checksum:
+            raise ChecksumMismatchError(self.checksum, actual)
+
+    def to_bytes(self) -> bytes:
+        head = self.version.to_bytes(8, "little") + self.checksum.to_bytes(4, "little")
+        return head + self.data
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "Snapshot":
+        if len(raw) < 12:
+            raise StateMachineError("snapshot blob too short")
+        version = int.from_bytes(raw[:8], "little")
+        checksum = int.from_bytes(raw[8:12], "little")
+        snap = Snapshot(version=version, data=raw[12:], checksum=checksum)
+        snap.verify()
+        return snap
+
+
+class StateMachine(abc.ABC):
+    """The deterministic replicated state machine the engine drives.
+
+    Contract (state_machine.rs:29-52): ``apply_command`` must be
+    deterministic — identical command sequences on every replica must produce
+    identical states and responses. All methods are synchronous here; the
+    engine offloads to an executor where needed (the reference uses
+    async-trait for the same reason).
+    """
+
+    @abc.abstractmethod
+    def apply_command(self, command: Command) -> bytes:
+        """Apply one command; return the (replicated-deterministic) response."""
+
+    def apply_commands(self, commands: Sequence[Command]) -> list[bytes]:
+        return [self.apply_command(c) for c in commands]
+
+    def apply_batch(self, batch: CommandBatch) -> list[bytes]:
+        return self.apply_commands(batch.commands)
+
+    @abc.abstractmethod
+    def create_snapshot(self) -> Snapshot:
+        """Serialize full state into a versioned snapshot."""
+
+    @abc.abstractmethod
+    def restore_snapshot(self, snapshot: Snapshot) -> None:
+        """Replace state from a snapshot (verify() is the caller's duty)."""
+
+    @abc.abstractmethod
+    def get_state_summary(self) -> str:
+        """Cheap human-readable state digest (for logs/tests)."""
+
+
+class InMemoryStateMachine(StateMachine):
+    """Reference dict state machine parsing SET/GET/DEL text commands.
+
+    Reference: state_machine.rs:54-140. Grammar:
+      ``SET <key> <value>`` -> "OK"
+      ``GET <key>``         -> value or "NOT_FOUND"
+      ``DEL <key>``         -> "DELETED" or "NOT_FOUND"
+    Unknown commands return "ERROR: ..." (still deterministic).
+    """
+
+    def __init__(self) -> None:
+        self._data: dict[str, str] = {}
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def apply_command(self, command: Command) -> bytes:
+        self._version += 1
+        text = command.data_str().strip()
+        parts = text.split(" ", 2)
+        op = parts[0].upper() if parts else ""
+        if op == "SET" and len(parts) == 3:
+            self._data[parts[1]] = parts[2]
+            return b"OK"
+        if op == "GET" and len(parts) >= 2:
+            val = self._data.get(parts[1])
+            return val.encode("utf-8") if val is not None else b"NOT_FOUND"
+        if op == "DEL" and len(parts) >= 2:
+            if parts[1] in self._data:
+                del self._data[parts[1]]
+                return b"DELETED"
+            return b"NOT_FOUND"
+        return f"ERROR: unknown command {text[:64]!r}".encode("utf-8")
+
+    def create_snapshot(self) -> Snapshot:
+        data = json.dumps(
+            {"version": self._version, "data": self._data}, sort_keys=True
+        ).encode("utf-8")
+        return Snapshot.create(self._version, data)
+
+    def restore_snapshot(self, snapshot: Snapshot) -> None:
+        snapshot.verify()
+        doc = json.loads(snapshot.data.decode("utf-8"))
+        self._data = dict(doc["data"])
+        self._version = int(doc["version"])
+
+    def get_state_summary(self) -> str:
+        return f"{len(self._data)} keys @ v{self._version}"
+
+    def get(self, key: str) -> str | None:
+        return self._data.get(key)
+
+    def __len__(self) -> int:
+        return len(self._data)

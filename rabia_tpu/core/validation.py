@@ -1,0 +1,127 @@
+"""Ingest-path validation.
+
+Reference parity: rabia-core/src/validation.rs — Validator trait (:5-7),
+per-message structural checks + clock-skew windows (:30-124), batch limits
+(:126-180), monotonic phase sequence checks (:182-226).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Protocol
+
+from rabia_tpu.core.config import ValidationConfig
+from rabia_tpu.core.errors import ValidationError
+from rabia_tpu.core.messages import (
+    Decision,
+    HeartBeat,
+    NewBatch,
+    ProtocolMessage,
+    Propose,
+    SyncRequest,
+    SyncResponse,
+    VoteRound1,
+    VoteRound2,
+)
+from rabia_tpu.core.types import CommandBatch, StateValue
+
+
+class Validator(Protocol):
+    """Validator trait (validation.rs:5-7)."""
+
+    def validate_message(self, msg: ProtocolMessage) -> None: ...
+
+    def validate_batch(self, batch: CommandBatch) -> None: ...
+
+
+class MessageValidator:
+    """Structural + temporal validation of inbound protocol traffic."""
+
+    def __init__(self, config: ValidationConfig | None = None):
+        self.config = config or ValidationConfig()
+        self._last_phase_seen: dict = {}
+
+    # -- messages ----------------------------------------------------------
+
+    def validate_message(self, msg: ProtocolMessage, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        self._validate_timestamp(msg.timestamp, now)
+        payload = msg.payload
+        if isinstance(payload, Propose):
+            self._validate_propose(payload)
+        elif isinstance(payload, (VoteRound1, VoteRound2)):
+            self._validate_votes(payload)
+        elif isinstance(payload, Decision):
+            for d in payload.decisions:
+                if d.decision == StateValue.VQuestion:
+                    raise ValidationError("decision cannot be V?")
+                self._validate_phase(d.phase)
+        elif isinstance(payload, (SyncRequest, HeartBeat)):
+            self._validate_phase(payload.current_phase)
+        elif isinstance(payload, SyncResponse):
+            self._validate_phase(payload.responder_phase)
+        elif isinstance(payload, NewBatch):
+            self.validate_batch(payload.batch)
+
+    def _validate_timestamp(self, ts: float, now: float) -> None:
+        if ts > now + self.config.max_future_skew:
+            raise ValidationError(
+                f"message timestamp {ts - now:.1f}s in the future "
+                f"(max {self.config.max_future_skew}s)"
+            )
+        if ts < now - self.config.max_age:
+            raise ValidationError(
+                f"message is {now - ts:.1f}s old (max {self.config.max_age}s)"
+            )
+
+    def _validate_propose(self, p: Propose) -> None:
+        self._validate_phase(p.phase)
+        if p.value == StateValue.Absent:
+            raise ValidationError("proposal value cannot be ABSENT")
+        if p.batch is not None:
+            self.validate_batch(p.batch)
+
+    def _validate_votes(self, v: VoteRound1 | VoteRound2) -> None:
+        if not v.votes:
+            raise ValidationError("vote vector must be non-empty")
+        for e in v.votes:
+            self._validate_phase(e.phase)
+            if e.shard < 0:
+                raise ValidationError(f"negative shard index {e.shard}")
+            if e.vote == StateValue.Absent:
+                raise ValidationError("cannot vote ABSENT")
+
+    def _validate_phase(self, phase: int) -> None:
+        if phase < 0:
+            raise ValidationError(f"negative phase {phase}")
+
+    # -- batches (validation.rs:126-180) -----------------------------------
+
+    def validate_batch(self, batch: CommandBatch) -> None:
+        if batch.is_empty():
+            raise ValidationError("batch must contain at least one command")
+        if len(batch) > self.config.max_commands_per_batch:
+            raise ValidationError(
+                f"batch has {len(batch)} commands "
+                f"(max {self.config.max_commands_per_batch})"
+            )
+        for c in batch.commands:
+            if c.size() > self.config.max_command_size:
+                raise ValidationError(
+                    f"command {c.id} is {c.size()} bytes "
+                    f"(max {self.config.max_command_size})"
+                )
+
+    # -- phase-sequence sanity (validation.rs:182-226) ----------------------
+
+    def check_phase_progression(self, key, new_phase: int) -> bool:
+        """True if the jump from the last-seen phase looks sane.
+
+        Large forward jumps (> max_phase_jump) are suspicious but allowed
+        (sync can legitimately fast-forward); callers may log/deprioritize.
+        """
+        last = self._last_phase_seen.get(key, -1)
+        self._last_phase_seen[key] = max(last, new_phase)
+        if new_phase < last:
+            return True  # old traffic — duplicate delivery, not suspicious
+        return (new_phase - last) <= self.config.max_phase_jump

@@ -1,0 +1,15 @@
+"""Device kernels: the batched weak-MVC phase driver and mesh execution.
+
+This package is the TPU-native replacement for the reference's scalar
+consensus hot loop (rabia-engine/src/engine.rs:381-746 — vote rules, tally,
+coin, decision): thousands of consensus instances evaluated as one array
+program over ``[shards, replicas]`` vote matrices.
+"""
+
+from rabia_tpu.kernel.phase_driver import (  # noqa: F401
+    ClusterKernel,
+    ClusterState,
+    NodeKernel,
+    NodeState,
+    device_coin,
+)

@@ -1,0 +1,618 @@
+"""The batched weak-MVC phase driver: consensus as an array program.
+
+This module vectorizes the weak-MVC transition relation (the reference's
+formal spec, docs/weak_mvc.ivy:82-186; scalar executable form in
+:mod:`rabia_tpu.core.oracle`) over ``S`` independent consensus instances
+("shards") × ``R`` replicas:
+
+- vote ledgers are ``int8[S, R, R]`` arrays (receiver-major) instead of the
+  reference's per-phase HashMaps (rabia-core/src/messages.rs:138-223);
+- the majority tally is a one-hot sum over the sender axis instead of
+  ``PhaseData::count_votes`` loops (messages.rs:185-211);
+- the round-2 tie-break is a **common coin** — ``fold_in(key, (shard, slot,
+  phase))`` — identical on every replica by construction, implementing the
+  spec's shared ``coin(P,V)`` relation (weak_mvc.ivy:169-182) rather than the
+  reference implementation's per-node RNG (engine.rs:454-481, a documented
+  deviation, SURVEY.md §3.1);
+- crashes and partitions are boolean masks (``alive[S,R]``,
+  ``deliver[S,R,R]``), not control flow.
+
+Two kernels share the transition spec:
+
+:class:`ClusterKernel`
+    Whole-cluster simulation: all R replicas' state lives in one set of
+    arrays. One ``round_step`` = one synchronous communication round with
+    lossy delivery + implicit retransmission — bit-identical in semantics to
+    ``WeakMVCOracle.step``. Used by the fault-injection harness and the
+    benchmark ``slot_pipeline`` (which runs whole decision slots under
+    ``lax.scan`` without host round-trips).
+
+:class:`NodeKernel`
+    One node's view (state ``[S]``, inboxes ``[S, R]`` ABSENT-coded): the
+    device half of the host engine, which feeds it votes arriving from real
+    transports and turns its outboxes into messages. Host-paced rounds
+    resolve the async-protocol-on-synchronous-device tension (SURVEY.md
+    §7.4.1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rabia_tpu.core.types import ABSENT, V0, V1, VQUESTION, f_plus_1, quorum_size
+
+I8 = jnp.int8
+I32 = jnp.int32
+
+R1_WAIT = 0
+R2_WAIT = 1
+
+
+# ---------------------------------------------------------------------------
+# Common coin
+# ---------------------------------------------------------------------------
+
+
+def _coin_bits(key, shard: jnp.ndarray, slot: jnp.ndarray, phase: jnp.ndarray, p1: float):
+    """Common-coin values for (shard, slot, phase) triples (same shape).
+
+    Depends only on the base key and the triple — never on the replica
+    flipping it — so every replica (and every host replay) sees the same
+    coin. Returns int8 V0/V1 of the broadcast shape.
+    """
+    shard, slot, phase = jnp.broadcast_arrays(
+        jnp.asarray(shard, I32), jnp.asarray(slot, I32), jnp.asarray(phase, I32)
+    )
+    shape = shard.shape
+
+    def one(sh, sl, ph):
+        k = jax.random.fold_in(key, sh)
+        k = jax.random.fold_in(k, sl)
+        k = jax.random.fold_in(k, ph)
+        return jax.random.bernoulli(k, p1)
+
+    flat = jax.vmap(one)(shard.ravel(), slot.ravel(), phase.ravel())
+    return jnp.where(flat.reshape(shape), I8(V1), I8(V0))
+
+
+def device_coin(seed: int, shard: int, slot: int, phase: int, p1: float = 0.5) -> int:
+    """Scalar host-side view of the device coin (for the oracle/tests)."""
+    key = jax.random.key(seed)
+    return int(_coin_bits(key, jnp.array([shard]), jnp.array([slot]), jnp.array([phase]), p1)[0])
+
+
+def _tally(ledger: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Count V0/V1/V? and total present votes over the last (sender) axis.
+
+    The batched form of PhaseData::count_votes (messages.rs:185-211).
+    """
+    c0 = jnp.sum(ledger == V0, axis=-1, dtype=I32)
+    c1 = jnp.sum(ledger == V1, axis=-1, dtype=I32)
+    cq = jnp.sum(ledger == VQUESTION, axis=-1, dtype=I32)
+    total = c0 + c1 + cq
+    return c0, c1, cq, total
+
+
+# ---------------------------------------------------------------------------
+# Cluster-simulation kernel
+# ---------------------------------------------------------------------------
+
+
+class ClusterState(NamedTuple):
+    """All-replica consensus state for S shards × R replicas (device)."""
+
+    slot: jnp.ndarray  # i32[S]   decision-slot counter (host-advanced)
+    phase: jnp.ndarray  # i32[S,R] weak-MVC phase within the slot
+    stage: jnp.ndarray  # i8[S,R]  R1_WAIT | R2_WAIT
+    my_r1: jnp.ndarray  # i8[S,R]  this replica's round-1 vote (current phase)
+    my_r2: jnp.ndarray  # i8[S,R]  round-2 vote (ABSENT until cast)
+    # previous phase's votes, re-offered to stragglers one phase behind:
+    # weak MVC assumes reliable broadcast, so under lossy delivery a sender
+    # keeps retransmitting the votes of the phase it just left — otherwise a
+    # quorum can splinter across adjacent phases and deadlock.
+    prev_r1: jnp.ndarray  # i8[S,R]
+    prev_r2: jnp.ndarray  # i8[S,R]
+    led1: jnp.ndarray  # i8[S,R,R] round-1 ledger [shard, receiver, sender]
+    led2: jnp.ndarray  # i8[S,R,R]
+    decided: jnp.ndarray  # i8[S]  slot decision (ABSENT until first decider)
+    decided_phase: jnp.ndarray  # i32[S] min MVC phase of any decision (or -1)
+    done: jnp.ndarray  # bool[S,R] replica knows the decision
+    active: jnp.ndarray  # bool[S] shard has a live instance this slot
+
+
+class ClusterKernel:
+    """Factory of jitted cluster-simulation step functions.
+
+    ``n_replicas``, quorum and f+1 are static (baked into the compiled
+    program); shard count is dynamic up to the padded shape.
+    """
+
+    def __init__(self, n_shards: int, n_replicas: int, *, coin_p1: float = 0.5, seed: int = 0):
+        self.S = int(n_shards)
+        self.R = int(n_replicas)
+        self.quorum = quorum_size(self.R)
+        self.f1 = f_plus_1(self.R)
+        self.coin_p1 = float(coin_p1)
+        self.seed = int(seed)
+        self.key = jax.random.key(self.seed)
+        self._shard_idx = jnp.arange(self.S, dtype=I32)
+
+    # -- state constructors -------------------------------------------------
+
+    def init_state(self) -> ClusterState:
+        S, R = self.S, self.R
+        return ClusterState(
+            slot=jnp.zeros((S,), I32),
+            phase=jnp.zeros((S, R), I32),
+            stage=jnp.full((S, R), R1_WAIT, I8),
+            my_r1=jnp.full((S, R), ABSENT, I8),
+            my_r2=jnp.full((S, R), ABSENT, I8),
+            prev_r1=jnp.full((S, R), ABSENT, I8),
+            prev_r2=jnp.full((S, R), ABSENT, I8),
+            led1=jnp.full((S, R, R), ABSENT, I8),
+            led2=jnp.full((S, R, R), ABSENT, I8),
+            decided=jnp.full((S,), ABSENT, I8),
+            decided_phase=jnp.full((S,), -1, I32),
+            done=jnp.zeros((S, R), bool),
+            active=jnp.zeros((S,), bool),
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def start_slot(
+        self, state: ClusterState, shard_mask: jnp.ndarray, initial_votes: jnp.ndarray
+    ) -> ClusterState:
+        """Begin a new decision slot on masked shards with the given initial
+        round-1 votes (V1 where the replica holds the proposal, V0 where it
+        gave up waiting — weak_mvc.ivy:113-131)."""
+        S, R = self.S, self.R
+        m = shard_mask  # bool[S]
+        mr = m[:, None]
+        eye = jnp.eye(R, dtype=bool)[None, :, :]
+        led1_fresh = jnp.where(
+            eye, initial_votes[:, :, None].astype(I8), I8(ABSENT)
+        )
+        return ClusterState(
+            slot=jnp.where(m, state.slot + jnp.where(state.active, 1, 0), state.slot),
+            phase=jnp.where(mr, 0, state.phase),
+            stage=jnp.where(mr, I8(R1_WAIT), state.stage),
+            my_r1=jnp.where(mr, initial_votes.astype(I8), state.my_r1),
+            my_r2=jnp.where(mr, I8(ABSENT), state.my_r2),
+            prev_r1=jnp.where(mr, I8(ABSENT), state.prev_r1),
+            prev_r2=jnp.where(mr, I8(ABSENT), state.prev_r2),
+            led1=jnp.where(mr[:, :, None], led1_fresh, state.led1),
+            led2=jnp.where(mr[:, :, None], I8(ABSENT), state.led2),
+            decided=jnp.where(m, I8(ABSENT), state.decided),
+            decided_phase=jnp.where(m, -1, state.decided_phase),
+            done=jnp.where(mr, False, state.done),
+            active=jnp.logical_or(state.active, m),
+        )
+
+    # -- the synchronous round step ----------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def round_step(
+        self,
+        state: ClusterState,
+        alive: jnp.ndarray,  # bool[S,R] (or broadcastable [R])
+        deliver: jnp.ndarray,  # bool[S,R,R]  [shard, sender, receiver]
+    ) -> ClusterState:
+        """One synchronous communication round for every shard at once.
+
+        Semantics are element-for-element those of ``WeakMVCOracle.step``:
+        (1) deliver outstanding votes under the mask (with retransmission —
+        a sender's *current* votes are re-offered every round), (2) run every
+        enabled R1→R2 and R2→advance transition, (3) propagate decisions.
+        """
+        S, R, Q, F1 = self.S, self.R, self.quorum, self.f1
+        alive = jnp.broadcast_to(alive, (S, R))
+        act = state.active[:, None]
+
+        # ---- 1. delivery ------------------------------------------------
+        # link[s,i,j]: sender i's traffic reaches receiver j this round
+        link = (
+            deliver
+            & alive[:, :, None]
+            & alive[:, None, :]
+            & ~jnp.eye(R, dtype=bool)[None]
+        )
+        same_phase = state.phase[:, :, None] == state.phase[:, None, :]  # [s,i,j]
+        ahead_one = state.phase[:, :, None] == state.phase[:, None, :] + 1
+        rcv_open = ~state.done[:, None, :]  # decided receivers stop listening
+        offer1 = link & rcv_open & (
+            (same_phase & (state.my_r1 != ABSENT)[:, :, None])
+            | (ahead_one & (state.prev_r1 != ABSENT)[:, :, None])
+        )
+        offer2 = link & rcv_open & (
+            (
+                same_phase
+                & (state.stage == R2_WAIT)[:, :, None]
+                & (state.my_r2 != ABSENT)[:, :, None]
+            )
+            | (ahead_one & (state.prev_r2 != ABSENT)[:, :, None])
+        )
+        val1 = jnp.where(same_phase, state.my_r1[:, :, None], state.prev_r1[:, :, None])
+        val2 = jnp.where(same_phase, state.my_r2[:, :, None], state.prev_r2[:, :, None])
+        # ledgers are [s, receiver, sender] — transpose the offer/value grids
+        o1 = jnp.swapaxes(offer1, 1, 2)
+        o2 = jnp.swapaxes(offer2, 1, 2)
+        v1 = jnp.swapaxes(jnp.broadcast_to(val1, (S, R, R)), 1, 2)
+        v2 = jnp.swapaxes(jnp.broadcast_to(val2, (S, R, R)), 1, 2)
+        led1 = jnp.where((state.led1 == ABSENT) & o1, v1, state.led1)
+        led2 = jnp.where((state.led2 == ABSENT) & o2, v2, state.led2)
+
+        # ---- 2. transitions (on pre-step stages, like the oracle) --------
+        enabled = act & alive & ~state.done
+        eye = jnp.eye(R, dtype=bool)[None]
+
+        # R1 -> R2: with a quorum of round-1 votes, vote v on an all-v
+        # majority, else V?  (weak_mvc.ivy:133-147)
+        c0, c1, _, tot1 = _tally(led1)
+        cast_r2 = enabled & (state.stage == R1_WAIT) & (tot1 >= Q)
+        r2_val = jnp.where(c1 >= Q, I8(V1), jnp.where(c0 >= Q, I8(V0), I8(VQUESTION)))
+        my_r2 = jnp.where(cast_r2, r2_val, state.my_r2)
+        stage = jnp.where(cast_r2, I8(R2_WAIT), state.stage)
+        led2 = jnp.where(cast_r2[:, :, None] & eye, my_r2[:, :, None], led2)
+
+        # R2 -> advance: decide on f+1 agreeing non-? votes; else adopt any
+        # non-? vote; else flip the common coin  (weak_mvc.ivy:149-186)
+        d0, d1, _, tot2 = _tally(led2)
+        advance = enabled & (state.stage == R2_WAIT) & (tot2 >= Q)
+        decide1 = d1 >= F1
+        decide0 = d0 >= F1
+        coin = _coin_bits(
+            self.key,
+            jnp.broadcast_to(self._shard_idx[:, None], (S, R)),
+            jnp.broadcast_to(state.slot[:, None], (S, R)),
+            state.phase,
+            self.coin_p1,
+        )
+        next_v = jnp.where(
+            decide1,
+            I8(V1),
+            jnp.where(
+                decide0,
+                I8(V0),
+                jnp.where(d1 > 0, I8(V1), jnp.where(d0 > 0, I8(V0), coin)),
+            ),
+        )
+        newly_decided = advance & (decide1 | decide0)
+        dec_vals = jnp.where(
+            newly_decided, jnp.where(decide1, I8(V1), I8(V0)), I8(-1)
+        )
+        shard_dec = jnp.max(dec_vals, axis=1)  # -1 if no decider this round
+        decided = jnp.where(
+            (state.decided == ABSENT) & (shard_dec >= 0),
+            shard_dec.astype(I8),
+            state.decided,
+        )
+        # decided_phase = minimum MVC phase at which any replica decided
+        intmax = jnp.iinfo(I32).max
+        round_min = jnp.min(
+            jnp.where(newly_decided, state.phase, intmax), axis=1
+        )
+        existing = jnp.where(state.decided_phase < 0, intmax, state.decided_phase)
+        merged = jnp.minimum(existing, round_min)
+        decided_phase = jnp.where(merged == intmax, -1, merged)
+        done = state.done | newly_decided
+
+        phase = jnp.where(advance, state.phase + 1, state.phase)
+        prev_r1 = jnp.where(advance, state.my_r1, state.prev_r1)
+        prev_r2 = jnp.where(advance, my_r2, state.prev_r2)
+        my_r1 = jnp.where(advance, next_v, state.my_r1)
+        stage = jnp.where(advance, I8(R1_WAIT), stage)
+        my_r2 = jnp.where(advance, I8(ABSENT), my_r2)
+        adv3 = advance[:, :, None]
+        led1 = jnp.where(
+            adv3, jnp.where(eye, next_v[:, :, None], I8(ABSENT)), led1
+        )
+        led2 = jnp.where(adv3, I8(ABSENT), led2)
+
+        # ---- 3. decision propagation ------------------------------------
+        # any done replica whose link reaches an undecided one informs it
+        informed = jnp.einsum("si,sij->sj", (done & alive).astype(I32), deliver.astype(I32)) > 0
+        adopt = state.active[:, None] & alive & ~done & informed & (decided != ABSENT)[:, None]
+        done = done | adopt
+
+        return ClusterState(
+            slot=state.slot,
+            phase=phase,
+            stage=stage,
+            my_r1=my_r1,
+            my_r2=my_r2,
+            prev_r1=prev_r1,
+            prev_r2=prev_r2,
+            led1=led1,
+            led2=led2,
+            decided=decided,
+            decided_phase=decided_phase,
+            done=done,
+            active=state.active,
+        )
+
+    # -- multi-round / multi-slot drivers ----------------------------------
+
+    @functools.partial(
+        jax.jit,
+        static_argnums=(0, 3, 5),
+        static_argnames=("n_rounds", "p_deliver"),
+    )
+    def run_rounds(
+        self,
+        state: ClusterState,
+        alive: jnp.ndarray,
+        n_rounds: int,
+        step_key: jnp.ndarray,
+        p_deliver: float = 1.0,
+        link_mask: Optional[jnp.ndarray] = None,
+    ) -> ClusterState:
+        """Run ``n_rounds`` round_steps in one dispatch (lax.scan), drawing a
+        fresh Bernoulli delivery mask per round ∧ an optional static link
+        mask (partitions). ``step_key`` seeds delivery randomness only —
+        protocol coins come from the kernel's own key."""
+        S, R = self.S, self.R
+        base_link = (
+            jnp.ones((S, R, R), bool) if link_mask is None else jnp.broadcast_to(link_mask, (S, R, R))
+        )
+
+        def body(st, k):
+            if p_deliver >= 1.0:
+                d = base_link
+            else:
+                d = base_link & jax.random.bernoulli(k, p_deliver, (S, R, R))
+            return self.round_step(st, alive, d), ()
+
+        keys = jax.random.split(step_key, n_rounds)
+        state, _ = lax.scan(body, state, keys)
+        return state
+
+    @functools.partial(
+        jax.jit,
+        static_argnums=(0, 3, 4, 5),
+        static_argnames=("n_slots", "rounds_per_slot", "start_slot_index"),
+    )
+    def slot_pipeline(
+        self,
+        initial_votes: jnp.ndarray,  # i8[T, S, R] per-slot initial R1 votes
+        alive: jnp.ndarray,  # bool[S,R]
+        n_slots: int,
+        rounds_per_slot: int = 2,
+        start_slot_index: int = 0,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Decide ``n_slots`` consecutive slots for all S shards entirely on
+        device: scan over slots, ``rounds_per_slot`` full-delivery rounds
+        each (2 suffices fault-free: R1 exchange+cast, R2 exchange+decide).
+
+        Returns ``(decided[T, S], decided_phase[T, S])``. This is the
+        benchmark hot path — no host round-trips between decisions, which is
+        what amortizes dispatch overhead across thousands of shards
+        (SURVEY.md §7.4.4).
+        """
+        S, R = self.S, self.R
+        full = jnp.ones((S, R, R), bool)
+        every = jnp.ones((S,), bool)
+
+        def per_slot(state, inp):
+            slot_votes, slot_idx = inp
+            st = self.start_slot(state, every, slot_votes)
+            st = st._replace(slot=jnp.full((S,), slot_idx, I32))
+
+            def rd(s, _):
+                return self.round_step(s, alive, full), ()
+
+            st, _ = lax.scan(rd, st, None, length=rounds_per_slot)
+            return st, (st.decided, st.decided_phase)
+
+        state0 = self.init_state()
+        slots = jnp.arange(start_slot_index, start_slot_index + n_slots, dtype=I32)
+        _, (decided, dphase) = lax.scan(
+            per_slot, state0, (initial_votes, slots)
+        )
+        return decided, dphase
+
+
+# ---------------------------------------------------------------------------
+# Per-node kernel (the host engine's device half)
+# ---------------------------------------------------------------------------
+
+
+class NodeState(NamedTuple):
+    """One node's consensus state over its S shards."""
+
+    slot: jnp.ndarray  # i32[S]
+    phase: jnp.ndarray  # i32[S]
+    stage: jnp.ndarray  # i8[S]
+    my_r1: jnp.ndarray  # i8[S]
+    my_r2: jnp.ndarray  # i8[S]
+    led1: jnp.ndarray  # i8[S,R]  votes seen for current (slot, phase)
+    led2: jnp.ndarray  # i8[S,R]
+    decided: jnp.ndarray  # i8[S]
+    done: jnp.ndarray  # bool[S]
+    active: jnp.ndarray  # bool[S]
+
+
+class NodeOutbox(NamedTuple):
+    """What the host must transmit after a node_step."""
+
+    cast_r2: jnp.ndarray  # bool[S] — broadcast VoteRound2(phase, my_r2)
+    r2_vals: jnp.ndarray  # i8[S]
+    advanced: jnp.ndarray  # bool[S] — broadcast VoteRound1(phase+1, my_r1)
+    new_r1: jnp.ndarray  # i8[S]
+    new_phase: jnp.ndarray  # i32[S]
+    newly_decided: jnp.ndarray  # bool[S] — broadcast Decision(slot, value)
+    decided_vals: jnp.ndarray  # i8[S]
+
+
+class NodeKernel:
+    """Jitted per-node step: ledgers in, transitions out (SURVEY.md §7.1).
+
+    The host engine owns message routing and slot lifecycle; this kernel owns
+    every piece of per-phase math the reference computes in
+    engine.rs:424-706, for all shards at once.
+    """
+
+    def __init__(self, n_shards: int, n_replicas: int, me: int, *, coin_p1: float = 0.5, seed: int = 0):
+        self.S = int(n_shards)
+        self.R = int(n_replicas)
+        self.me = int(me)
+        self.quorum = quorum_size(self.R)
+        self.f1 = f_plus_1(self.R)
+        self.coin_p1 = float(coin_p1)
+        self.key = jax.random.key(int(seed))
+        self._shard_idx = jnp.arange(self.S, dtype=I32)
+
+    def init_state(self) -> NodeState:
+        S, R = self.S, self.R
+        return NodeState(
+            slot=jnp.zeros((S,), I32),
+            phase=jnp.zeros((S,), I32),
+            stage=jnp.full((S,), R1_WAIT, I8),
+            my_r1=jnp.full((S,), ABSENT, I8),
+            my_r2=jnp.full((S,), ABSENT, I8),
+            led1=jnp.full((S, R), ABSENT, I8),
+            led2=jnp.full((S, R), ABSENT, I8),
+            decided=jnp.full((S,), ABSENT, I8),
+            done=jnp.zeros((S,), bool),
+            active=jnp.zeros((S,), bool),
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def start_slots(
+        self,
+        state: NodeState,
+        shard_mask: jnp.ndarray,  # bool[S]
+        slot_index: jnp.ndarray,  # i32[S]
+        initial_votes: jnp.ndarray,  # i8[S]
+    ) -> NodeState:
+        S, R = self.S, self.R
+        m = shard_mask
+        led1 = jnp.where(
+            m[:, None],
+            jnp.where(
+                jnp.arange(R)[None, :] == self.me,
+                initial_votes[:, None].astype(I8),
+                I8(ABSENT),
+            ),
+            state.led1,
+        )
+        return NodeState(
+            slot=jnp.where(m, slot_index, state.slot),
+            phase=jnp.where(m, 0, state.phase),
+            stage=jnp.where(m, I8(R1_WAIT), state.stage),
+            my_r1=jnp.where(m, initial_votes.astype(I8), state.my_r1),
+            my_r2=jnp.where(m, I8(ABSENT), state.my_r2),
+            led1=led1,
+            led2=jnp.where(m[:, None], I8(ABSENT), state.led2),
+            decided=jnp.where(m, I8(ABSENT), state.decided),
+            done=jnp.where(m, False, state.done),
+            active=state.active | m,
+        )
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def node_step(
+        self,
+        state: NodeState,
+        inbox_r1: jnp.ndarray,  # i8[S,R] votes for current (slot, phase); ABSENT elsewhere
+        inbox_r2: jnp.ndarray,  # i8[S,R]
+        decision_in: jnp.ndarray,  # i8[S] ABSENT or adopted decision value
+    ) -> tuple[NodeState, NodeOutbox]:
+        """Consume routed inboxes, run enabled transitions on every shard."""
+        S, R, Q, F1 = self.S, self.R, self.quorum, self.f1
+
+        led1 = jnp.where((state.led1 == ABSENT) & (inbox_r1 != ABSENT), inbox_r1, state.led1)
+        led2 = jnp.where((state.led2 == ABSENT) & (inbox_r2 != ABSENT), inbox_r2, state.led2)
+
+        enabled = state.active & ~state.done
+
+        c0, c1, _, tot1 = _tally(led1)
+        cast_r2 = enabled & (state.stage == R1_WAIT) & (tot1 >= Q)
+        r2_val = jnp.where(c1 >= Q, I8(V1), jnp.where(c0 >= Q, I8(V0), I8(VQUESTION)))
+        my_r2 = jnp.where(cast_r2, r2_val, state.my_r2)
+        stage = jnp.where(cast_r2, I8(R2_WAIT), state.stage)
+        own = jnp.arange(R)[None, :] == self.me
+        led2 = jnp.where(cast_r2[:, None] & own, my_r2[:, None], led2)
+
+        d0, d1, _, tot2 = _tally(led2)
+        advance = enabled & (state.stage == R2_WAIT) & (tot2 >= Q)
+        decide1 = d1 >= F1
+        decide0 = d0 >= F1
+        coin = _coin_bits(self.key, self._shard_idx, state.slot, state.phase, self.coin_p1)
+        next_v = jnp.where(
+            decide1,
+            I8(V1),
+            jnp.where(
+                decide0,
+                I8(V0),
+                jnp.where(d1 > 0, I8(V1), jnp.where(d0 > 0, I8(V0), coin)),
+            ),
+        )
+        newly_decided = advance & (decide1 | decide0)
+        dec_val = jnp.where(decide1, I8(V1), I8(V0))
+
+        # external decision adoption (Decision broadcast / sync)
+        adopt = enabled & ~newly_decided & (decision_in != ABSENT)
+        decided = jnp.where(
+            newly_decided, dec_val, jnp.where(adopt, decision_in, state.decided)
+        )
+        done = state.done | newly_decided | adopt
+
+        phase = jnp.where(advance, state.phase + 1, state.phase)
+        my_r1 = jnp.where(advance, next_v, state.my_r1)
+        stage = jnp.where(advance, I8(R1_WAIT), stage)
+        my_r2_out = my_r2
+        my_r2 = jnp.where(advance, I8(ABSENT), my_r2)
+        led1 = jnp.where(
+            advance[:, None],
+            jnp.where(own, next_v[:, None], I8(ABSENT)),
+            led1,
+        )
+        led2 = jnp.where(advance[:, None], I8(ABSENT), led2)
+
+        new_state = NodeState(
+            slot=state.slot,
+            phase=phase,
+            stage=stage,
+            my_r1=my_r1,
+            my_r2=my_r2,
+            led1=led1,
+            led2=led2,
+            decided=decided,
+            done=done,
+            active=state.active,
+        )
+        outbox = NodeOutbox(
+            cast_r2=cast_r2,
+            r2_vals=my_r2_out,
+            advanced=advance,
+            new_r1=my_r1,
+            new_phase=phase,
+            newly_decided=newly_decided,
+            decided_vals=decided,
+        )
+        return new_state, outbox
+
+
+# ---------------------------------------------------------------------------
+# Wire phase packing: (slot, mvc_phase) <-> u64 sequence number
+# ---------------------------------------------------------------------------
+
+_MVC_BITS = 16
+
+
+def pack_phase(slot: int, mvc_phase: int) -> int:
+    """Encode (decision slot, weak-MVC phase) into a wire sequence number.
+
+    The reference's monotone PhaseId (one per decision) maps to our slot;
+    the in-slot MVC phase is new (its engine folds retries into fresh
+    PhaseIds instead — SURVEY.md §3.1)."""
+    if mvc_phase >= (1 << _MVC_BITS):
+        raise ValueError("mvc phase overflow")
+    return (slot << _MVC_BITS) | mvc_phase
+
+
+def unpack_phase(seq: int) -> tuple[int, int]:
+    return seq >> _MVC_BITS, seq & ((1 << _MVC_BITS) - 1)

@@ -1,0 +1,26 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip hardware is not available in CI; sharding tests run over
+``--xla_force_host_platform_device_count=8`` CPU devices (the sanctioned way
+to validate Mesh/pjit programs without real chips). Must run before jax
+initializes, hence the env mutation at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def jax_devices():
+    import jax
+
+    return jax.devices()

@@ -1,0 +1,413 @@
+"""Core types, messages, serialization, validation, batching unit tests.
+
+Mirrors the reference's co-located unit tier (SURVEY.md §4.1):
+rabia-core/src/lib.rs:112-194 (types/messages), serialization.rs:211-320,
+batching.rs:328-454, validation.rs:228-257.
+"""
+
+import time
+
+import pytest
+
+from rabia_tpu.core.batching import CommandBatcher, ShardedBatcher
+from rabia_tpu.core.config import BatchConfig, RabiaConfig, SerializationConfig
+from rabia_tpu.core.errors import (
+    NetworkError,
+    QuorumNotAvailableError,
+    SerializationError,
+    StateMachineError,
+    TimeoutError_,
+    ValidationError,
+)
+from rabia_tpu.core.messages import (
+    Decision,
+    DecisionEntry,
+    HeartBeat,
+    PhaseData,
+    ProtocolMessage,
+    Propose,
+    QuorumNotification,
+    SyncRequest,
+    SyncResponse,
+    VoteEntry,
+    VoteRound1,
+    VoteRound2,
+)
+from rabia_tpu.core.serialization import (
+    BinarySerializer,
+    JsonSerializer,
+    Serializer,
+    estimate_serialized_size,
+)
+from rabia_tpu.core.state_machine import InMemoryStateMachine, Snapshot
+from rabia_tpu.core.types import (
+    BatchId,
+    Command,
+    CommandBatch,
+    NodeId,
+    PhaseId,
+    ShardId,
+    StateValue,
+    f_plus_1,
+    node_index_map,
+    quorum_size,
+)
+from rabia_tpu.core.validation import MessageValidator
+
+
+class TestIds:
+    def test_node_id_deterministic_from_int(self):
+        assert NodeId.from_int(7) == NodeId.from_int(7)
+        assert NodeId.from_int(7) != NodeId.from_int(8)
+
+    def test_node_id_ordering_stable(self):
+        ids = [NodeId.from_int(i) for i in (3, 1, 2)]
+        assert sorted(ids) == [NodeId.from_int(i) for i in (1, 2, 3)]
+
+    def test_node_id_random_unique(self):
+        assert NodeId.new() != NodeId.new()
+
+    def test_replica_index_map(self):
+        nodes = [NodeId.from_int(i) for i in (5, 1, 9)]
+        m = node_index_map(nodes)
+        assert m[NodeId.from_int(1)] == 0
+        assert m[NodeId.from_int(9)] == 2
+
+    def test_phase_id_monotonic(self):
+        p = PhaseId(0)
+        assert p.is_initial()
+        assert p.next().value == 1
+        assert PhaseId(3) > PhaseId(2)
+
+    def test_quorum_sizes(self):
+        assert quorum_size(3) == 2
+        assert quorum_size(5) == 3
+        assert quorum_size(7) == 4
+        assert quorum_size(4) == 3
+        assert f_plus_1(3) == 2
+        assert f_plus_1(5) == 3
+        assert f_plus_1(7) == 4
+
+    def test_quorum_fp1_intersection(self):
+        # any majority and any f+1 set must intersect (weak_mvc.ivy:24-31)
+        for n in range(1, 12):
+            assert quorum_size(n) + f_plus_1(n) > n
+
+
+class TestStateValue:
+    def test_codes_stable(self):
+        assert int(StateValue.V0) == 0
+        assert int(StateValue.V1) == 1
+        assert int(StateValue.VQuestion) == 2
+        assert int(StateValue.Absent) == 3
+
+    def test_is_decided_value(self):
+        assert StateValue.V1.is_decided_value()
+        assert StateValue.V0.is_decided_value()
+        assert not StateValue.VQuestion.is_decided_value()
+
+
+class TestBatches:
+    def test_batch_checksum_roundtrip(self):
+        b = CommandBatch.new(["SET a 1", "SET b 2"])
+        assert b.verify(b.checksum())
+        assert not b.verify(b.checksum() ^ 1)
+
+    def test_batch_basics(self):
+        b = CommandBatch.new([b"x"], shard=ShardId(3))
+        assert len(b) == 1
+        assert not b.is_empty()
+        assert int(b.shard) == 3
+        assert b.total_size() == 1
+
+
+class TestPhaseData:
+    def test_majority_tally(self):
+        pd = PhaseData(phase=PhaseId(1))
+        nodes = [NodeId.from_int(i) for i in range(5)]
+        for n in nodes[:3]:
+            pd.add_round1_vote(n, StateValue.V1)
+        pd.add_round1_vote(nodes[3], StateValue.V0)
+        assert pd.round1_majority(5) == StateValue.V1
+        assert pd.has_round1_quorum(5)
+        v0, v1, vq = PhaseData.count_votes(pd.round1_votes)
+        assert (v0, v1, vq) == (1, 3, 0)
+
+    def test_duplicate_votes_ignored(self):
+        pd = PhaseData(phase=PhaseId(1))
+        n = NodeId.from_int(1)
+        pd.add_round1_vote(n, StateValue.V1)
+        pd.add_round1_vote(n, StateValue.V0)  # second vote ignored
+        assert pd.round1_votes[n] == StateValue.V1
+
+    def test_decision_rejects_question(self):
+        pd = PhaseData(phase=PhaseId(1))
+        pd.set_decision(StateValue.VQuestion)
+        assert not pd.is_decided()
+        pd.set_decision(StateValue.V1)
+        assert pd.decision == StateValue.V1
+        pd.set_decision(StateValue.V0)  # first decision wins
+        assert pd.decision == StateValue.V1
+
+
+def _all_payloads():
+    batch = CommandBatch.new(["SET k v", "GET k"])
+    nodes = tuple(NodeId.from_int(i) for i in range(3))
+    votes = (
+        VoteEntry(0, 5, StateValue.V1),
+        VoteEntry(1, 5, StateValue.VQuestion),
+    )
+    return [
+        Propose(shard=0, phase=5, batch_id=batch.id, value=StateValue.V1, batch=batch),
+        Propose(shard=1, phase=6, batch_id=BatchId.new(), value=StateValue.V0, batch=None),
+        VoteRound1(votes=votes),
+        VoteRound2(votes=votes),
+        Decision(
+            decisions=(
+                DecisionEntry(0, 5, StateValue.V1, batch.id),
+                DecisionEntry(1, 5, StateValue.V0, None),
+            )
+        ),
+        SyncRequest(current_phase=9, state_version=4),
+        SyncResponse(responder_phase=12, state_version=7, snapshot=b"\x01\x02", per_shard_phase=(1, 2, 3)),
+        SyncResponse(responder_phase=1, state_version=0, snapshot=None),
+        HeartBeat(current_phase=3, committed_phase=2),
+        QuorumNotification(has_quorum=True, active_nodes=nodes),
+    ]
+
+
+class TestSerialization:
+    @pytest.mark.parametrize("payload", _all_payloads(), ids=lambda p: type(p).__name__)
+    def test_binary_roundtrip(self, payload):
+        ser = BinarySerializer()
+        msg = ProtocolMessage.new(NodeId.from_int(1), payload, NodeId.from_int(2))
+        out = ser.deserialize(ser.serialize(msg))
+        assert out == msg
+
+    @pytest.mark.parametrize("payload", _all_payloads(), ids=lambda p: type(p).__name__)
+    def test_json_roundtrip(self, payload):
+        ser = JsonSerializer()
+        msg = ProtocolMessage.new(NodeId.from_int(1), payload)
+        out = ser.deserialize(ser.serialize(msg))
+        assert out == msg
+
+    def test_broadcast_flag(self):
+        ser = BinarySerializer()
+        msg = ProtocolMessage.new(NodeId.from_int(1), HeartBeat(1, 0))
+        assert msg.is_broadcast()
+        assert ser.deserialize(ser.serialize(msg)).recipient is None
+
+    def test_binary_smaller_than_json(self):
+        # binary strictly smaller (serialization.rs:259-276 asserts this)
+        batch = CommandBatch.new([f"SET key{i} value{i}" for i in range(50)])
+        msg = ProtocolMessage.new(
+            NodeId.from_int(1),
+            Propose(0, 1, batch.id, StateValue.V1, batch),
+        )
+        b = BinarySerializer().serialize(msg)
+        j = JsonSerializer().serialize(msg)
+        assert len(b) < len(j)
+
+    def test_compression_kicks_in(self):
+        cfg = SerializationConfig(compression_threshold=128)
+        batch = CommandBatch.new(["SET k " + "a" * 4096])
+        msg = ProtocolMessage.new(
+            NodeId.from_int(1), Propose(0, 1, batch.id, StateValue.V1, batch)
+        )
+        small = BinarySerializer(cfg).serialize(msg)
+        big = BinarySerializer(SerializationConfig(compression_threshold=0)).serialize(msg)
+        assert len(small) < len(big)
+        assert BinarySerializer(cfg).deserialize(small) == msg
+
+    def test_corrupt_payload_rejected(self):
+        ser = BinarySerializer()
+        batch = CommandBatch.new(["SET a b"])
+        msg = ProtocolMessage.new(
+            NodeId.from_int(1), Propose(0, 1, batch.id, StateValue.V1, batch)
+        )
+        raw = bytearray(ser.serialize(msg))
+        raw[-3] ^= 0xFF  # flip a byte inside the batch payload
+        with pytest.raises(SerializationError):
+            ser.deserialize(bytes(raw))
+
+    def test_truncated_rejected(self):
+        ser = BinarySerializer()
+        msg = ProtocolMessage.new(NodeId.from_int(1), HeartBeat(1, 0))
+        raw = ser.serialize(msg)
+        with pytest.raises(SerializationError):
+            ser.deserialize(raw[: len(raw) // 2])
+
+    def test_dispatcher_autodetect(self):
+        s = Serializer()
+        msg = ProtocolMessage.new(NodeId.from_int(1), HeartBeat(2, 1))
+        assert s.deserialize(BinarySerializer().serialize(msg)) == msg
+        assert s.deserialize(JsonSerializer().serialize(msg)) == msg
+
+    def test_size_estimate_order_of_magnitude(self):
+        msg = ProtocolMessage.new(
+            NodeId.from_int(1), VoteRound1(votes=tuple(VoteEntry(i, 1, StateValue.V1) for i in range(100)))
+        )
+        actual = len(BinarySerializer().serialize(msg))
+        est = estimate_serialized_size(msg)
+        assert 0.5 * actual <= est <= 2 * actual
+
+
+class TestValidation:
+    def test_future_message_rejected(self):
+        v = MessageValidator()
+        msg = ProtocolMessage.new(NodeId.from_int(1), HeartBeat(1, 0))
+        msg = ProtocolMessage(
+            id=msg.id,
+            sender=msg.sender,
+            recipient=None,
+            timestamp=time.time() + 120,
+            payload=msg.payload,
+        )
+        with pytest.raises(ValidationError):
+            v.validate_message(msg)
+
+    def test_stale_message_rejected(self):
+        v = MessageValidator()
+        msg = ProtocolMessage(
+            id=ProtocolMessage.new(NodeId.from_int(1), HeartBeat(1, 0)).id,
+            sender=NodeId.from_int(1),
+            recipient=None,
+            timestamp=time.time() - 700,
+            payload=HeartBeat(1, 0),
+        )
+        with pytest.raises(ValidationError):
+            v.validate_message(msg)
+
+    def test_oversized_batch_rejected(self):
+        v = MessageValidator()
+        batch = CommandBatch.new([f"c{i}" for i in range(1001)])
+        with pytest.raises(ValidationError):
+            v.validate_batch(batch)
+
+    def test_empty_batch_rejected(self):
+        v = MessageValidator()
+        with pytest.raises(ValidationError):
+            v.validate_batch(CommandBatch.new([]))
+
+    def test_vq_decision_rejected(self):
+        v = MessageValidator()
+        msg = ProtocolMessage.new(
+            NodeId.from_int(1),
+            Decision(decisions=(DecisionEntry(0, 1, StateValue.VQuestion),)),
+        )
+        with pytest.raises(ValidationError):
+            v.validate_message(msg)
+
+    def test_phase_progression(self):
+        v = MessageValidator()
+        assert v.check_phase_progression("n1", 5)
+        assert v.check_phase_progression("n1", 6)
+        assert not v.check_phase_progression("n1", 6 + 1001)
+
+
+class TestErrors:
+    def test_retryable_taxonomy(self):
+        # Network | Timeout | QuorumNotAvailable are retryable (error.rs:249-255)
+        assert NetworkError("x").is_retryable()
+        assert TimeoutError_("x").is_retryable()
+        assert QuorumNotAvailableError("x").is_retryable()
+        assert not StateMachineError("x").is_retryable()
+
+
+class TestBatcher:
+    def test_size_flush(self):
+        b = CommandBatcher(BatchConfig(max_batch_size=3, adaptive=False))
+        assert b.add(Command.new("a")) is None
+        assert b.add(Command.new("b")) is None
+        batch = b.add(Command.new("c"))
+        assert batch is not None and len(batch) == 3
+        assert b.pending_count() == 0
+
+    def test_timeout_flush(self):
+        b = CommandBatcher(BatchConfig(max_batch_size=100, max_batch_delay=0.01, adaptive=False))
+        b.add(Command.new("a"), now=0.0)
+        assert b.poll(now=0.005) is None
+        batch = b.poll(now=0.02)
+        assert batch is not None and len(batch) == 1
+
+    def test_adaptive_grows_under_load(self):
+        cfg = BatchConfig(max_batch_size=10, adaptive=True)
+        b = CommandBatcher(cfg)
+        for _ in range(10):  # 10 size-triggered flushes
+            for i in range(10):
+                b.add(Command.new(f"c{i}"), now=0.0)
+        assert b.target_size > 10
+
+    def test_adaptive_shrinks_when_idle(self):
+        cfg = BatchConfig(max_batch_size=100, max_batch_delay=0.01, adaptive=True)
+        b = CommandBatcher(cfg)
+        for k in range(10):  # 10 timeout-triggered flushes
+            b.add(Command.new("x"), now=float(k))
+            assert b.poll(now=float(k) + 0.5) is not None
+        assert b.target_size < 100
+
+    def test_sharded_batcher_routes(self):
+        sb = ShardedBatcher(4, BatchConfig(max_batch_size=1, adaptive=False))
+        batch = sb.add(2, Command.new("x"))
+        assert batch is not None and int(batch.shard) == 2
+
+    def test_stats(self):
+        b = CommandBatcher(BatchConfig(max_batch_size=2, adaptive=False))
+        b.add(Command.new("a"))
+        b.add(Command.new("b"))
+        assert b.stats.batches_created == 1
+        assert b.stats.commands_batched == 2
+        assert b.stats.avg_batch_size == 2.0
+
+
+class TestStateMachine:
+    def test_set_get_del(self):
+        sm = InMemoryStateMachine()
+        assert sm.apply_command(Command.new("SET k hello")) == b"OK"
+        assert sm.apply_command(Command.new("GET k")) == b"hello"
+        assert sm.apply_command(Command.new("DEL k")) == b"DELETED"
+        assert sm.apply_command(Command.new("GET k")) == b"NOT_FOUND"
+
+    def test_unknown_command_deterministic_error(self):
+        sm = InMemoryStateMachine()
+        r1 = sm.apply_command(Command(id=NodeId.from_int(1).value, data=b"BLORP"))
+        sm2 = InMemoryStateMachine()
+        r2 = sm2.apply_command(Command(id=NodeId.from_int(1).value, data=b"BLORP"))
+        assert r1 == r2 and r1.startswith(b"ERROR")
+
+    def test_snapshot_roundtrip(self):
+        sm = InMemoryStateMachine()
+        sm.apply_command(Command.new("SET a 1"))
+        sm.apply_command(Command.new("SET b 2"))
+        snap = sm.create_snapshot()
+        snap.verify()
+        sm2 = InMemoryStateMachine()
+        sm2.restore_snapshot(snap)
+        assert sm2.get("a") == "1" and sm2.get("b") == "2"
+        assert sm2.version == sm.version
+
+    def test_snapshot_corruption_detected(self):
+        sm = InMemoryStateMachine()
+        sm.apply_command(Command.new("SET a 1"))
+        snap = sm.create_snapshot()
+        bad = Snapshot(version=snap.version, data=snap.data + b"x", checksum=snap.checksum)
+        with pytest.raises(Exception):
+            bad.verify()
+
+    def test_snapshot_bytes_roundtrip(self):
+        sm = InMemoryStateMachine()
+        sm.apply_command(Command.new("SET a 1"))
+        snap = sm.create_snapshot()
+        assert Snapshot.from_bytes(snap.to_bytes()) == snap
+
+
+class TestConfig:
+    def test_builders(self):
+        cfg = RabiaConfig().with_seed(42).with_shards(64)
+        assert cfg.randomization_seed == 42
+        assert cfg.kernel.num_shards == 64
+
+    def test_padded_shards(self):
+        cfg = RabiaConfig().with_shards(65)
+        assert cfg.kernel.padded_shards == 72
+        assert RabiaConfig().with_shards(64).kernel.padded_shards == 64

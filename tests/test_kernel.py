@@ -1,0 +1,327 @@
+"""Kernel correctness: the vectorized phase driver vs the scalar oracle.
+
+Three layers (SURVEY.md §4.4's strengthened strategy):
+1. step-for-step conformance of ``ClusterKernel.round_step`` against
+   ``WeakMVCOracle.step`` under identical delivery masks and the *same*
+   device coin — every field, every step;
+2. Ivy-invariant property tests (agreement/validity) on the kernel directly
+   under random loss/crash masks;
+3. ``NodeKernel`` (per-node, inbox/outbox) driven by a host-side router must
+   reach the same decisions as the cluster kernel.
+"""
+
+import functools
+import random
+
+import numpy as np
+import pytest
+
+from rabia_tpu.core.oracle import WeakMVCOracle
+from rabia_tpu.core.types import ABSENT, V0, V1, VQUESTION
+
+import jax
+import jax.numpy as jnp
+
+from rabia_tpu.kernel.phase_driver import (
+    ClusterKernel,
+    NodeKernel,
+    R1_WAIT,
+    R2_WAIT,
+    device_coin,
+    pack_phase,
+    unpack_phase,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _coin(seed, shard, slot, phase):
+    return device_coin(seed, shard, slot, phase)
+
+
+def oracle_coin(seed, shard, slot=0):
+    return lambda phase: _coin(seed, shard, slot, phase)
+
+
+def _start(kernel, initial):
+    state = kernel.init_state()
+    votes = jnp.asarray(initial, jnp.int8)
+    return kernel.start_slot(state, jnp.ones((kernel.S,), bool), votes)
+
+
+class TestFaultFreeKernel:
+    @pytest.mark.parametrize("R", [3, 5, 7])
+    def test_unanimous_v1_decides_in_two_rounds(self, R):
+        S = 16
+        k = ClusterKernel(S, R, seed=0)
+        state = _start(k, np.full((S, R), V1))
+        full = jnp.ones((S, R, R), bool)
+        alive = jnp.ones((S, R), bool)
+        state = k.round_step(state, alive, full)
+        assert not np.any(np.asarray(state.decided) != ABSENT)
+        state = k.round_step(state, alive, full)
+        assert np.all(np.asarray(state.decided) == V1)
+        assert np.all(np.asarray(state.decided_phase) == 0)
+        assert np.all(np.asarray(state.done))
+
+    def test_unanimous_v0_decides_v0(self):
+        S, R = 8, 5
+        k = ClusterKernel(S, R, seed=0)
+        state = _start(k, np.full((S, R), V0))
+        state = k.run_rounds(state, jnp.ones((S, R), bool), 2, jax.random.key(0))
+        assert np.all(np.asarray(state.decided) == V0)
+
+    def test_slot_pipeline_throughput_path(self):
+        S, R, T = 32, 5, 4
+        k = ClusterKernel(S, R, seed=3)
+        votes = jnp.full((T, S, R), V1, jnp.int8)
+        decided, dphase = k.slot_pipeline(votes, jnp.ones((S, R), bool), T)
+        assert decided.shape == (T, S)
+        assert np.all(np.asarray(decided) == V1)
+        assert np.all(np.asarray(dphase) == 0)
+
+    def test_minority_crash_still_decides(self):
+        S, R = 8, 5
+        k = ClusterKernel(S, R, seed=1)
+        alive = jnp.asarray(
+            np.broadcast_to(np.array([False, False, True, True, True]), (S, R))
+        )
+        state = _start(k, np.full((S, R), V1))
+        state = k.run_rounds(state, alive, 4, jax.random.key(0))
+        assert np.all(np.asarray(state.decided) == V1)
+        done = np.asarray(state.done)
+        assert np.all(done[:, 2:])
+
+    def test_majority_crash_no_progress(self):
+        S, R = 4, 3
+        k = ClusterKernel(S, R, seed=1)
+        alive = jnp.asarray(np.broadcast_to(np.array([True, False, False]), (S, R)))
+        state = _start(k, np.full((S, R), V1))
+        state = k.run_rounds(state, alive, 20, jax.random.key(0))
+        assert np.all(np.asarray(state.decided) == ABSENT)
+
+    def test_inactive_shards_untouched(self):
+        S, R = 8, 3
+        k = ClusterKernel(S, R, seed=0)
+        state = k.init_state()
+        mask = np.zeros((S,), bool)
+        mask[::2] = True
+        votes = jnp.full((S, R), V1, jnp.int8)
+        state = k.start_slot(state, jnp.asarray(mask), votes)
+        state = k.run_rounds(state, jnp.ones((S, R), bool), 2, jax.random.key(0))
+        decided = np.asarray(state.decided)
+        assert np.all(decided[::2] == V1)
+        assert np.all(decided[1::2] == ABSENT)
+
+
+class TestOracleConformance:
+    """round_step must be WeakMVCOracle.step, vectorized — field for field."""
+
+    @pytest.mark.parametrize(
+        "R,p,seed",
+        [(3, 1.0, 0), (3, 0.6, 1), (5, 0.6, 2), (5, 0.35, 3), (4, 0.5, 4), (7, 0.6, 5)],
+    )
+    def test_stepwise_conformance(self, R, p, seed):
+        S, T = 4, 30
+        rng = np.random.default_rng(seed)
+        initial = rng.integers(0, 2, size=(S, R))
+        alive_np = np.ones((S, R), bool)
+        if seed % 2:
+            alive_np[:, 0] = False  # one crashed replica
+
+        k = ClusterKernel(S, R, seed=seed)
+        state = _start(k, initial)
+        oracles = [
+            WeakMVCOracle(
+                R,
+                list(initial[s]),
+                oracle_coin(seed, s),
+                alive=list(alive_np[s]),
+            )
+            for s in range(S)
+        ]
+        alive = jnp.asarray(alive_np)
+
+        masks = rng.random((T, S, R, R)) < p
+        for t in range(T):
+            state = k.round_step(state, alive, jnp.asarray(masks[t]))
+            for s in range(S):
+                m = masks[t, s]
+                oracles[s].step(lambda i, j, m=m: bool(m[i, j]))
+            self._compare(state, oracles, alive_np, t)
+
+    @staticmethod
+    def _compare(state, oracles, alive_np, t):
+        phase = np.asarray(state.phase)
+        stage = np.asarray(state.stage)
+        my_r1 = np.asarray(state.my_r1)
+        my_r2 = np.asarray(state.my_r2)
+        done = np.asarray(state.done)
+        decided = np.asarray(state.decided)
+        dphase = np.asarray(state.decided_phase)
+        for s, o in enumerate(oracles):
+            kd = None if decided[s] == ABSENT else int(decided[s])
+            assert kd == o.decided_value, f"step {t} shard {s}: decided {kd} vs {o.decided_value}"
+            kp = None if dphase[s] < 0 else int(dphase[s])
+            assert kp == o.decided_phase, f"step {t} shard {s}: decided_phase {kp} vs {o.decided_phase}"
+            for r, node in enumerate(o.nodes):
+                if not alive_np[s, r]:
+                    continue
+                ctx = f"step {t} shard {s} replica {r}"
+                assert done[s, r] == (node.decided is not None), ctx
+                if node.decided is not None:
+                    continue  # frozen replicas may hold stale fields
+                assert phase[s, r] == node.phase, ctx
+                assert stage[s, r] == node.stage, ctx
+                assert my_r1[s, r] == node.my_r1, ctx
+                assert my_r2[s, r] == node.my_r2, ctx
+
+
+class TestKernelProperties:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_agreement_validity_under_loss(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        S, R, T = 8, 5, 120
+        initial = rng.integers(0, 2, size=(S, R))
+        k = ClusterKernel(S, R, seed=seed)
+        state = _start(k, initial)
+        alive = jnp.ones((S, R), bool)
+        key = jax.random.key(seed)
+        state = k.run_rounds(state, alive, T, key, p_deliver=0.55)
+        decided = np.asarray(state.decided)
+        done = np.asarray(state.done)
+        # liveness: with 120 lossy rounds everything should be decided
+        assert np.all(decided != ABSENT)
+        assert np.all(done)
+        assert np.all(decided != VQUESTION)
+        # validity per-shard
+        for s in range(S):
+            if np.all(initial[s] == V1):
+                assert decided[s] == V1
+            if np.all(initial[s] == V0):
+                assert decided[s] == V0
+
+    def test_static_partition_blocks_minority_then_heals(self):
+        S, R = 4, 5
+        k = ClusterKernel(S, R, seed=9)
+        initial = np.full((S, R), V1)
+        state = _start(k, initial)
+        alive = jnp.ones((S, R), bool)
+        # partition {0,1} | {2,3,4}
+        part = np.ones((R, R), bool)
+        for i in range(R):
+            for j in range(R):
+                if (i < 2) != (j < 2):
+                    part[i, j] = False
+        state = k.run_rounds(
+            state, alive, 6, jax.random.key(0), link_mask=jnp.asarray(part[None])
+        )
+        decided = np.asarray(state.decided)
+        done = np.asarray(state.done)
+        assert np.all(decided == V1)  # majority side decides
+        assert not np.any(done[:, :2])  # minority side still dark
+        # heal
+        state = k.run_rounds(state, alive, 2, jax.random.key(1))
+        assert np.all(np.asarray(state.done))
+
+
+class TestNodeKernelRouter:
+    """NodeKernel × R with a host router == ClusterKernel decisions."""
+
+    @pytest.mark.parametrize("R,seed", [(3, 0), (5, 1)])
+    def test_full_delivery_matches_cluster(self, R, seed):
+        S = 4
+        rng = np.random.default_rng(seed)
+        initial = rng.integers(0, 2, size=(S, R)).astype(np.int8)
+
+        nodes = [NodeKernel(S, R, me=i, seed=seed) for i in range(R)]
+        states = [n.init_state() for n in nodes]
+        # buffers[(shard, slot, phase)] = {"r1": {sender: v}, "r2": {...}}
+        buffers: dict = {}
+        decisions_wire: dict[int, int] = {}  # shard -> value
+
+        def buf(s, slot, ph):
+            return buffers.setdefault((s, slot, ph), {"r1": {}, "r2": {}})
+
+        mask = jnp.ones((S,), bool)
+        slot_idx = jnp.zeros((S,), jnp.int32)
+        for i, n in enumerate(nodes):
+            states[i] = n.start_slots(states[i], mask, slot_idx, jnp.asarray(initial[:, i]))
+            for s in range(S):
+                buf(s, 0, 0)["r1"][i] = int(initial[s, i])
+
+        for _ in range(30):
+            if all(bool(np.all(np.asarray(st.done))) for st in states):
+                break
+            new_states = []
+            outs = []
+            for i, n in enumerate(nodes):
+                st = states[i]
+                phase = np.asarray(st.phase)
+                slot = np.asarray(st.slot)
+                in1 = np.full((S, R), ABSENT, np.int8)
+                in2 = np.full((S, R), ABSENT, np.int8)
+                dec = np.full((S,), ABSENT, np.int8)
+                for s in range(S):
+                    b = buffers.get((s, int(slot[s]), int(phase[s])))
+                    if b:
+                        for snd, v in b["r1"].items():
+                            in1[s, snd] = v
+                        for snd, v in b["r2"].items():
+                            in2[s, snd] = v
+                    if s in decisions_wire:
+                        dec[s] = decisions_wire[s]
+                st2, out = n.node_step(
+                    st, jnp.asarray(in1), jnp.asarray(in2), jnp.asarray(dec)
+                )
+                new_states.append(st2)
+                outs.append(out)
+            # route outboxes (full delivery)
+            for i, (st2, out) in enumerate(zip(new_states, outs)):
+                slot = np.asarray(st2.slot)
+                cast = np.asarray(out.cast_r2)
+                r2v = np.asarray(out.r2_vals)
+                adv = np.asarray(out.advanced)
+                r1v = np.asarray(out.new_r1)
+                nph = np.asarray(out.new_phase)
+                nd = np.asarray(out.newly_decided)
+                dv = np.asarray(out.decided_vals)
+                oph = np.asarray(states[i].phase)  # phase before the step
+                for s in range(S):
+                    if cast[s]:
+                        buf(s, int(slot[s]), int(oph[s]))["r2"][i] = int(r2v[s])
+                    if adv[s]:
+                        buf(s, int(slot[s]), int(nph[s]))["r1"][i] = int(r1v[s])
+                    if nd[s]:
+                        decisions_wire[s] = int(dv[s])
+            states = new_states
+
+        for st in states:
+            assert np.all(np.asarray(st.done)), "liveness: all nodes decide"
+        vals = np.stack([np.asarray(st.decided) for st in states])
+        # agreement across nodes
+        assert np.all(vals == vals[0])
+        # conformance with the cluster kernel under the same full delivery
+        k = ClusterKernel(S, R, seed=seed)
+        cs = _start(k, initial)
+        cs = k.run_rounds(cs, jnp.ones((S, R), bool), 30, jax.random.key(0))
+        assert np.all(np.asarray(cs.decided) == vals[0])
+
+
+class TestCoin:
+    def test_device_coin_common_and_deterministic(self):
+        a = device_coin(5, 2, 1, 3)
+        b = device_coin(5, 2, 1, 3)
+        assert a == b and a in (V0, V1)
+
+    def test_device_coin_spreads(self):
+        vals = {device_coin(0, s, 0, p) for s in range(4) for p in range(8)}
+        assert vals == {V0, V1}
+
+    def test_phase_packing(self):
+        assert unpack_phase(pack_phase(123, 45)) == (123, 45)
+        assert pack_phase(1, 0) > pack_phase(0, 65535)
+
+
+class TestStages:
+    def test_stage_constants(self):
+        assert R1_WAIT == 0 and R2_WAIT == 1

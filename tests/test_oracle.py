@@ -1,0 +1,136 @@
+"""Weak-MVC oracle tests: the Ivy-spec safety/liveness properties
+(docs/weak_mvc.ivy:190+ invariants) under synchronous, lossy and faulty
+delivery. The oracle is itself the reference for the kernel conformance
+tests, so it gets hammered hard here.
+"""
+
+import random
+
+import pytest
+
+from rabia_tpu.core.oracle import (
+    WeakMVCOracle,
+    bernoulli_deliver,
+    seeded_coin,
+)
+from rabia_tpu.core.types import V0, V1
+
+
+def run_case(n, initial, *, alive=None, deliver=None, seed=0, max_steps=500):
+    o = WeakMVCOracle(n, initial, seeded_coin(seed), alive=alive)
+    val = o.run(max_steps=max_steps, deliver=deliver or (lambda i, j: True))
+    o.check_agreement()
+    o.check_validity(initial)
+    return o, val
+
+
+class TestFaultFree:
+    @pytest.mark.parametrize("n", [1, 3, 5, 7])
+    def test_unanimous_v1_decides_v1_phase0(self, n):
+        o, val = run_case(n, [V1] * n)
+        assert val == V1
+        assert o.decided_phase == 0
+
+    @pytest.mark.parametrize("n", [3, 5, 7])
+    def test_unanimous_v0_decides_v0(self, n):
+        _, val = run_case(n, [V0] * n)
+        assert val == V0
+
+    def test_two_rounds_to_decide(self):
+        # fault-free unanimous input decides after exactly 2 synchronous steps
+        o = WeakMVCOracle(3, [V1] * 3, seeded_coin(0))
+        o.step()
+        assert o.decided_value is None
+        o.step()
+        assert o.decided_value == V1
+
+    @pytest.mark.parametrize("n,seed", [(3, s) for s in range(5)] + [(5, s) for s in range(5)])
+    def test_mixed_inputs_decide(self, n, seed):
+        rng = random.Random(seed)
+        initial = [rng.choice([V0, V1]) for _ in range(n)]
+        o, val = run_case(n, initial, seed=seed)
+        assert val in (V0, V1)
+        # every alive node must eventually learn the decision
+        assert all(nd.decided == val for nd in o.nodes)
+
+
+class TestCrashFaults:
+    @pytest.mark.parametrize("n,crashed", [(3, 1), (5, 2), (7, 3)])
+    def test_minority_crash_still_decides(self, n, crashed):
+        alive = [True] * n
+        for i in range(crashed):
+            alive[i] = False
+        o, val = run_case(n, [V1] * n, alive=alive)
+        assert val == V1
+        assert all(nd.decided == V1 for nd in o.nodes if nd.alive)
+
+    def test_majority_crash_no_progress(self):
+        alive = [False, False, True]  # only 1 of 3 alive — below quorum
+        o = WeakMVCOracle(3, [V1] * 3, seeded_coin(0), alive=alive)
+        o.run(max_steps=50)
+        assert o.decided_value is None
+
+
+class TestLossyDelivery:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_heavy_loss_eventually_decides(self, seed):
+        rng = random.Random(seed)
+        n = 5
+        initial = [rng.choice([V0, V1]) for _ in range(n)]
+        o, val = run_case(
+            n, initial, deliver=bernoulli_deliver(rng, 0.5), seed=seed, max_steps=2000
+        )
+        assert val in (V0, V1)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_asymmetric_partition_heals(self, seed):
+        # one-sided partition for the first 20 steps, then full delivery
+        n = 5
+        rng = random.Random(seed)
+        initial = [rng.choice([V0, V1]) for _ in range(n)]
+        o = WeakMVCOracle(n, initial, seeded_coin(seed))
+        cut = {0, 1}  # isolated minority
+        for _ in range(20):
+            o.step(lambda i, j: not (i in cut) ^ (j in cut))
+        for _ in range(100):
+            if all(nd.decided is not None for nd in o.nodes):
+                break
+            o.step()
+        o.check_agreement()
+        assert o.decided_value in (V0, V1)
+
+
+class TestCommonCoin:
+    def test_coin_is_common(self):
+        c1 = seeded_coin(seed=7, shard=3, slot=2)
+        c2 = seeded_coin(seed=7, shard=3, slot=2)
+        assert [c1(p) for p in range(32)] == [c2(p) for p in range(32)]
+
+    def test_coin_varies_with_phase_and_seed(self):
+        c = seeded_coin(seed=7)
+        vals = {c(p) for p in range(64)}
+        assert vals == {V0, V1}
+        other = seeded_coin(seed=8)
+        assert [c(p) for p in range(64)] != [other(p) for p in range(64)]
+
+    def test_split_vote_terminates_via_coin(self):
+        # adversarial-ish: 2 vs 3 split with full delivery resolves quickly
+        for seed in range(6):
+            o, val = run_case(5, [V0, V0, V1, V1, V1], seed=seed)
+            assert val in (V0, V1)
+
+
+class TestAgreementStress:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_random_masks_never_break_agreement(self, seed):
+        rng = random.Random(1000 + seed)
+        n = rng.choice([3, 4, 5, 7])
+        initial = [rng.choice([V0, V1]) for _ in range(n)]
+        alive = [rng.random() > 0.2 for _ in range(n)]
+        # guarantee a quorum stays alive so the run can terminate
+        while sum(alive) < n // 2 + 1:
+            alive[rng.randrange(n)] = True
+        o = WeakMVCOracle(n, initial, seeded_coin(seed), alive=alive)
+        o.run(max_steps=1500, deliver=bernoulli_deliver(rng, 0.6))
+        o.check_agreement()
+        o.check_validity(initial)
