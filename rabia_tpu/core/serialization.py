@@ -461,10 +461,21 @@ class Serializer:
         return self._json.serialize(msg)
 
     def deserialize(self, data: bytes) -> ProtocolMessage:
-        """Auto-detect: JSON messages start with '{'."""
-        if data[:1] == b"{":
-            return self._json.deserialize(data)
-        return self._binary.deserialize(data)
+        """Auto-detect: JSON messages start with '{'.
+
+        Any parse failure — including corrupt enum codes or truncated
+        buffers raising ValueError/struct.error deep in a codec — surfaces
+        as SerializationError so ingest paths can drop the message instead
+        of crashing (the engine catches RabiaError only).
+        """
+        try:
+            if data[:1] == b"{":
+                return self._json.deserialize(data)
+            return self._binary.deserialize(data)
+        except SerializationError:
+            raise
+        except Exception as e:
+            raise SerializationError(f"malformed message: {e}") from e
 
 
 def estimate_serialized_size(msg: ProtocolMessage) -> int:

@@ -1,0 +1,27 @@
+"""Host consensus engine: event loop, leader info, runtime state.
+
+The host half of the reference's rabia-engine crate (SURVEY.md §1.2); the
+device half is :mod:`rabia_tpu.kernel.phase_driver`.
+"""
+
+from rabia_tpu.engine.engine import RabiaEngine
+from rabia_tpu.engine.leader import LeaderSelector, LeadershipInfo, slot_proposer
+from rabia_tpu.engine.state import (
+    EngineRuntime,
+    EngineStatistics,
+    PendingSubmission,
+    ShardRuntime,
+    SlotRecord,
+)
+
+__all__ = [
+    "RabiaEngine",
+    "LeaderSelector",
+    "LeadershipInfo",
+    "slot_proposer",
+    "EngineRuntime",
+    "EngineStatistics",
+    "PendingSubmission",
+    "ShardRuntime",
+    "SlotRecord",
+]

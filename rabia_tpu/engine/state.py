@@ -1,0 +1,145 @@
+"""Host-side engine runtime state: buffers, slot ledger, statistics.
+
+Reference parity: rabia-engine/src/state.rs — the shared `EngineState` with
+atomic phase counters (:14-29), CAS-monotonic `commit_phase` (:65-103),
+pending-batch map (:144-150), phase GC (:191-243) and `EngineStatistics`
+(:268-292). The reference guards this state with atomics/DashMaps because N
+tokio tasks mutate it; here the engine is a single asyncio task per node, so
+plain Python structures suffice — the *device* arrays hold the hot consensus
+state (SURVEY.md §7.1) and this module holds everything that stays on host:
+batch payloads, vote buffers for not-yet-current (slot, phase) pairs, the
+decided-slot ledger, and response futures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from rabia_tpu.core.types import BatchId, CommandBatch, NodeId, StateValue
+
+
+@dataclass
+class EngineStatistics:
+    """Pull-based stats snapshot (state.rs:268-292)."""
+
+    node_id: NodeId
+    current_slot_max: int = 0  # highest slot any shard has opened
+    committed_slots: int = 0  # total applied slots across shards
+    decided_v1: int = 0
+    decided_v0: int = 0
+    pending_batches: int = 0
+    active_nodes: int = 0
+    has_quorum: bool = False
+    state_version: int = 0
+    is_active: bool = True
+    decisions_total: int = 0
+
+    @property
+    def last_committed_phase(self) -> int:
+        return self.committed_slots
+
+
+@dataclass
+class SlotRecord:
+    """Decision ledger entry for one (shard, slot)."""
+
+    value: StateValue
+    batch_id: Optional[BatchId] = None
+    decided_at: float = field(default_factory=time.time)
+    applied: bool = False
+
+
+@dataclass
+class PendingSubmission:
+    """An accepted client batch waiting to be proposed/committed."""
+
+    batch: CommandBatch
+    future: Optional[asyncio.Future] = None
+    attempts: int = 0
+    submitted_at: float = field(default_factory=time.time)
+
+
+class ShardRuntime:
+    """Per-shard host bookkeeping around the device arrays.
+
+    Vote buffers hold votes for (slot, phase) pairs the kernel hasn't
+    reached yet; each round the engine re-offers the current pair's buffered
+    votes to the kernel inbox (the ledger ignores duplicates), which makes
+    local delivery idempotent and loss-tolerant.
+    """
+
+    def __init__(self, shard: int) -> None:
+        self.shard = shard
+        self.next_slot: int = 0  # next slot index to open locally
+        self.applied_upto: int = 0  # slots [0, applied_upto) applied
+        self.in_flight: bool = False  # kernel currently deciding a slot here
+        self.opened_at: float = 0.0  # when the in-flight slot started
+        self.last_progress: float = 0.0  # last observed phase/stage change
+        self.queue: deque[PendingSubmission] = deque()  # to propose here
+        # payloads keyed by batch id (immutable content per id), so a late
+        # re-Propose can never swap the bytes a decided slot will apply
+        self.payloads: dict[BatchId, CommandBatch] = {}
+        # batch ids already applied on this shard -> their responses; the
+        # apply path consults this so one batch can never execute twice even
+        # if it commits in two slots (duplicate forwarding race)
+        self.applied_results: dict[BatchId, list[bytes]] = {}
+        self.decisions: dict[int, SlotRecord] = {}
+        # vote buffers: (slot, phase) -> {sender_row: vote_code}
+        self.buf_r1: dict[tuple[int, int], dict[int, int]] = {}
+        self.buf_r2: dict[tuple[int, int], dict[int, int]] = {}
+        # decision notices not yet consumed: slot -> (value_code, batch_id)
+        self.buf_decision: dict[int, tuple[int, Optional[BatchId]]] = {}
+        # proposals seen for slots not yet opened: slot -> (batch_id, batch)
+        self.buf_propose: dict[int, tuple[BatchId, Optional[CommandBatch]]] = {}
+
+    def gc_upto(self, slot: int) -> None:
+        """Drop buffered state for every slot < `slot` (state.rs:191-243
+        phase-GC analog; payloads/decisions for applied slots are kept only
+        until applied)."""
+        for d in (self.buf_r1, self.buf_r2):
+            for k in [k for k in d if k[0] < slot]:
+                del d[k]
+        for d2 in (self.buf_decision, self.buf_propose):
+            for k in [k for k in d2 if k < slot]:
+                del d2[k]
+        # payloads for already-applied batches are no longer needed
+        for bid in [b for b in self.payloads if b in self.applied_results]:
+            del self.payloads[bid]
+
+    def pending_count(self) -> int:
+        return len(self.queue)
+
+
+class EngineRuntime:
+    """All shards' host state plus cluster-level counters."""
+
+    def __init__(self, n_shards: int) -> None:
+        self.shards = [ShardRuntime(s) for s in range(n_shards)]
+        self.active_nodes: set[NodeId] = set()
+        self.has_quorum: bool = False
+        self.is_active: bool = True
+        self.state_version: int = 0
+        self.decided_v1: int = 0
+        self.decided_v0: int = 0
+        # in-flight sync: responses collected by sender
+        self.sync_responses: dict[NodeId, tuple[int, int, Optional[bytes], tuple[int, ...]]] = {}
+        self.sync_started_at: Optional[float] = None
+
+    def stats(self, node_id: NodeId) -> EngineStatistics:
+        return EngineStatistics(
+            node_id=node_id,
+            current_slot_max=max((sh.next_slot for sh in self.shards), default=0),
+            committed_slots=sum(sh.applied_upto for sh in self.shards),
+            decided_v1=self.decided_v1,
+            decided_v0=self.decided_v0,
+            pending_batches=sum(sh.pending_count() for sh in self.shards),
+            active_nodes=len(self.active_nodes),
+            has_quorum=self.has_quorum,
+            state_version=self.state_version,
+            is_active=self.is_active,
+            decisions_total=self.decided_v0 + self.decided_v1,
+        )

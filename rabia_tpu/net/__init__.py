@@ -1,0 +1,23 @@
+"""Transports: in-memory hub, fault-injecting simulator, (C++) TCP.
+
+The communication planes of SURVEY.md §5.8 — all behind
+:class:`rabia_tpu.core.network.NetworkTransport`.
+"""
+
+from rabia_tpu.net.in_memory import HubStats, InMemoryHub, InMemoryNetwork
+from rabia_tpu.net.simulator import (
+    NetworkConditions,
+    NetworkSimulator,
+    NetworkStats,
+    SimulatedNetwork,
+)
+
+__all__ = [
+    "HubStats",
+    "InMemoryHub",
+    "InMemoryNetwork",
+    "NetworkConditions",
+    "NetworkSimulator",
+    "NetworkStats",
+    "SimulatedNetwork",
+]

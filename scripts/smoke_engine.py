@@ -1,0 +1,65 @@
+"""3-node in-memory cluster smoke test for the host engine (dev script)."""
+
+import asyncio
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from rabia_tpu.core.config import RabiaConfig
+from rabia_tpu.core.network import ClusterConfig
+from rabia_tpu.core.state_machine import InMemoryStateMachine
+from rabia_tpu.core.types import CommandBatch, NodeId
+from rabia_tpu.engine import RabiaEngine
+from rabia_tpu.net import InMemoryHub
+
+
+async def main() -> int:
+    nodes = [NodeId.from_int(i + 1) for i in range(3)]
+    hub = InMemoryHub()
+    config = RabiaConfig(
+        phase_timeout=0.5, heartbeat_interval=0.1, round_interval=0.002
+    ).with_kernel(num_shards=2, shard_pad_multiple=2)
+    engines = []
+    sms = []
+    for n in nodes:
+        sm = InMemoryStateMachine()
+        t = hub.register(n)
+        eng = RabiaEngine(
+            ClusterConfig.new(n, nodes), sm, t, persistence=None, config=config
+        )
+        engines.append(eng)
+        sms.append(sm)
+    tasks = [asyncio.ensure_future(e.run()) for e in engines]
+    await asyncio.sleep(0.5)  # let heartbeats establish quorum
+
+    t0 = time.time()
+    fut = await engines[0].submit_batch(
+        CommandBatch.new(["SET k1 hello", "SET k2 world"]), shard=0
+    )
+    responses = await asyncio.wait_for(fut, 10.0)
+    print(f"decision in {time.time()-t0:.3f}s; responses={responses}")
+
+    fut2 = await engines[1].submit_batch(CommandBatch.new(["SET k3 again"]), shard=1)
+    r2 = await asyncio.wait_for(fut2, 10.0)
+    print(f"second batch: {r2}")
+
+    await asyncio.sleep(1.0)  # let followers apply
+    ok = True
+    for i, sm in enumerate(sms):
+        st = await engines[i].get_statistics()
+        print(f"node{i}: {sm.get_state_summary()} k1={sm.get('k1')} k3={sm.get('k3')} "
+              f"applied={st.committed_slots} v1={st.decided_v1} v0={st.decided_v0}")
+        if sm.get("k1") != "hello" or sm.get("k3") != "again":
+            ok = False
+    for e in engines:
+        await e.shutdown()
+    for t in tasks:
+        t.cancel()
+    print("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

@@ -8,7 +8,12 @@ initializes, hence the env mutation at import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the ambient environment may preset JAX_PLATFORMS=axon (a
+# tunneled real-TPU backend, catastrophically slow for per-round dispatch in
+# engine tests); tests must run on the virtual 8-device CPU mesh. In this
+# image jax latches the platform from process-start env, so mutating
+# os.environ here is NOT enough — force it through jax.config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,7 +21,35 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "asyncio: run the (coroutine) test on a fresh event loop"
+    )
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal asyncio test support (pytest-asyncio isn't in this image):
+    coroutine tests run on a fresh event loop per test."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
 
 
 @pytest.fixture(scope="session")
