@@ -1,0 +1,97 @@
+"""Headline benchmark: consensus decisions/sec, device kernel vs CPU oracle.
+
+Workload (BASELINE north star): 4096 concurrent consensus instances
+(kvstore shards) × 5 replicas, deciding consecutive slots with the batched
+weak-MVC kernel — whole slots scanned on device with no host round-trips
+(`ClusterKernel.slot_pipeline`). Baseline: the scalar weak-MVC oracle (the
+reference architecture's one-instance-at-a-time execution model) measured
+on this host's CPU.
+
+Prints exactly ONE JSON line:
+  {"metric": "decisions_per_sec", "value": N, "unit": "decisions/s",
+   "vs_baseline": ratio, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _cpu_oracle_rate(n_replicas: int, sample_slots: int = 150) -> float:
+    """Decisions/sec of the scalar oracle (one instance at a time)."""
+    from rabia_tpu.core.oracle import WeakMVCOracle
+    from rabia_tpu.core.types import V1
+
+    t0 = time.perf_counter()
+    done = 0
+    for s in range(sample_slots):
+        oracle = WeakMVCOracle(
+            n_replicas, [V1] * n_replicas, coin=lambda p: V1
+        )
+        for _ in range(64):
+            oracle.step()
+            if oracle.decided_value is not None:
+                break
+        done += 1
+    dt = time.perf_counter() - t0
+    return done / dt
+
+
+def main() -> int:
+    shards = int(os.environ.get("BENCH_SHARDS", 4096))
+    replicas = int(os.environ.get("BENCH_REPLICAS", 5))
+    slots = int(os.environ.get("BENCH_SLOTS", 64))
+    reps = int(os.environ.get("BENCH_REPS", 3))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rabia_tpu.core.types import V1
+    from rabia_tpu.kernel import ClusterKernel
+
+    backend = jax.default_backend()
+    kernel = ClusterKernel(shards, replicas, seed=0)
+    votes = jnp.full((slots, shards, replicas), V1, jnp.int8)
+    alive = jnp.ones((shards, replicas), bool)
+
+    # warmup / compile
+    decided, _ = kernel.slot_pipeline(votes, alive, slots)
+    decided.block_until_ready()
+    assert np.all(np.asarray(decided) == V1)
+
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        decided, _ = kernel.slot_pipeline(votes, alive, slots)
+        decided.block_until_ready()
+        dt = time.perf_counter() - t0
+        best = max(best, shards * slots / dt)
+
+    cpu_rate = _cpu_oracle_rate(replicas)
+
+    print(
+        json.dumps(
+            {
+                "metric": "decisions_per_sec",
+                "value": round(best, 1),
+                "unit": "decisions/s",
+                "vs_baseline": round(best / cpu_rate, 2),
+                "baseline_cpu_oracle_per_sec": round(cpu_rate, 1),
+                "config": {
+                    "shards": shards,
+                    "replicas": replicas,
+                    "slots_per_dispatch": slots,
+                    "backend": backend,
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

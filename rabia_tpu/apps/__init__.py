@@ -1,0 +1,70 @@
+"""Typed SMR applications: counter, sharded KV store, banking.
+
+The reference's example app crates (SURVEY.md §1.6, C27-C29) rebuilt on the
+typed SMR API, with the KV store sharded by key range to expose the device
+kernel's batch axis.
+"""
+
+from rabia_tpu.apps.banking import (
+    Account,
+    BankCommand,
+    BankingSMR,
+    BankOp,
+    BankResponse,
+)
+from rabia_tpu.apps.counter import (
+    CounterCommand,
+    CounterOp,
+    CounterResponse,
+    CounterSMR,
+    CounterState,
+)
+from rabia_tpu.apps.kvstore import (
+    ChangeNotification,
+    ChangeType,
+    KVOperation,
+    KVOpType,
+    KVResult,
+    KVResultKind,
+    KVStore,
+    KVStoreSMR,
+    NotificationBus,
+    NotificationFilter,
+    StoreError,
+    StoreErrorKind,
+    shard_for_key,
+)
+from rabia_tpu.apps.sharded import (
+    ShardedKVService,
+    ShardedStateMachine,
+    make_sharded_kv,
+)
+
+__all__ = [
+    "Account",
+    "BankCommand",
+    "BankOp",
+    "BankResponse",
+    "BankingSMR",
+    "ChangeNotification",
+    "ChangeType",
+    "CounterCommand",
+    "CounterOp",
+    "CounterResponse",
+    "CounterSMR",
+    "CounterState",
+    "KVOpType",
+    "KVOperation",
+    "KVResult",
+    "KVResultKind",
+    "KVStore",
+    "KVStoreSMR",
+    "NotificationBus",
+    "NotificationFilter",
+    "ShardedKVService",
+    "ShardedStateMachine",
+    "StoreError",
+    "StoreErrorKind",
+    "make_sharded_kv",
+    "shard_for_key",
+]

@@ -1,0 +1,600 @@
+"""Production KV store with change notifications and key-range sharding.
+
+Reference parity:
+- `KVStore` — rabia-kvstore/src/store.rs: ValueEntry (:44-80), CRUD + batch
+  + snapshot (:101-486; `set` :144-188, `apply_batch` :313-348, `snapshot`
+  :350-412, checksum :464-475, key/value validation :436-451), stats
+  (:82-90), config (:18-42).
+- `KVOperation`/`KVResult`/`StoreError` — rabia-kvstore/src/operations.rs
+  (:9-51 ops + read/write classes, :54-93 results, :96-167 errors,
+  :169-262 OperationBatch/BatchResult).
+- `NotificationBus` — rabia-kvstore/src/notifications.rs: change events
+  (:14-42), filter algebra All/Key/KeyPrefix/ChangeType/And/Or (:60-89),
+  bus with per-subscriber queues + closed-subscriber GC (:106-271,
+  `publish` :198-235), stats (:99-104).
+- `KVStoreSMR` — examples/kvstore_smr/src/smr_impl.rs:22-100 (with the
+  state-transfer accessors of examples/kvstore_smr/src/store.rs:435-455).
+
+TPU-native twist: the store is **sharded by key range** (stable hash →
+shard index). Each shard is an independent consensus instance — the shard
+axis is exactly the ``S`` axis the device kernel batches over
+(SURVEY.md §5.7), so kvstore scale-out IS kernel batch width.
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+import json
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional, Sequence
+
+from rabia_tpu.core.config import KVStoreConfig
+from rabia_tpu.core.errors import StateMachineError, ValidationError
+from rabia_tpu.core.smr import TypedStateMachine
+
+
+# ---------------------------------------------------------------------------
+# Operations / results / errors (operations.rs)
+# ---------------------------------------------------------------------------
+
+
+class KVOpType(enum.Enum):
+    Set = "set"
+    Get = "get"
+    Delete = "delete"
+    Exists = "exists"
+    Clear = "clear"
+
+
+_WRITE_OPS = {KVOpType.Set, KVOpType.Delete, KVOpType.Clear}
+
+
+@dataclass(frozen=True)
+class KVOperation:
+    """One typed store operation (operations.rs:9-51)."""
+
+    op: KVOpType
+    key: str = ""
+    value: Optional[str] = None
+
+    @property
+    def is_write(self) -> bool:
+        return self.op in _WRITE_OPS
+
+    @property
+    def is_read(self) -> bool:
+        return not self.is_write
+
+    @staticmethod
+    def set(key: str, value: str) -> "KVOperation":
+        return KVOperation(KVOpType.Set, key, value)
+
+    @staticmethod
+    def get(key: str) -> "KVOperation":
+        return KVOperation(KVOpType.Get, key)
+
+    @staticmethod
+    def delete(key: str) -> "KVOperation":
+        return KVOperation(KVOpType.Delete, key)
+
+    @staticmethod
+    def exists(key: str) -> "KVOperation":
+        return KVOperation(KVOpType.Exists, key)
+
+
+class KVResultKind(enum.Enum):
+    Success = "success"
+    NotFound = "not_found"
+    Error = "error"
+
+
+@dataclass(frozen=True)
+class KVResult:
+    """Operation outcome (operations.rs:54-93)."""
+
+    kind: KVResultKind
+    value: Optional[str] = None
+    version: Optional[int] = None
+    error: Optional[str] = None
+
+    @staticmethod
+    def success(value: Optional[str] = None, version: Optional[int] = None) -> "KVResult":
+        return KVResult(KVResultKind.Success, value=value, version=version)
+
+    @staticmethod
+    def not_found() -> "KVResult":
+        return KVResult(KVResultKind.NotFound)
+
+    @staticmethod
+    def err(message: str) -> "KVResult":
+        return KVResult(KVResultKind.Error, error=message)
+
+    @property
+    def ok(self) -> bool:
+        return self.kind == KVResultKind.Success
+
+
+class StoreErrorKind(enum.Enum):
+    """Error taxonomy (operations.rs:96-167)."""
+
+    KeyTooLong = "key_too_long"
+    KeyEmpty = "key_empty"
+    ValueTooLarge = "value_too_large"
+    StoreFull = "store_full"
+    KeyNotFound = "key_not_found"
+    InvalidOperation = "invalid_operation"
+    SnapshotCorrupt = "snapshot_corrupt"
+    ChecksumMismatch = "checksum_mismatch"
+    VersionConflict = "version_conflict"
+    Internal = "internal"
+
+    @property
+    def recoverable(self) -> bool:
+        return self in (
+            StoreErrorKind.KeyNotFound,
+            StoreErrorKind.VersionConflict,
+            StoreErrorKind.StoreFull,
+        )
+
+    @property
+    def is_client_error(self) -> bool:
+        return self in (
+            StoreErrorKind.KeyTooLong,
+            StoreErrorKind.KeyEmpty,
+            StoreErrorKind.ValueTooLarge,
+            StoreErrorKind.InvalidOperation,
+            StoreErrorKind.KeyNotFound,
+        )
+
+
+class StoreError(ValidationError):
+    def __init__(self, kind: StoreErrorKind, message: str = "") -> None:
+        super().__init__(f"{kind.value}: {message}" if message else kind.value)
+        self.kind = kind
+
+
+# ---------------------------------------------------------------------------
+# Change notifications (notifications.rs)
+# ---------------------------------------------------------------------------
+
+
+class ChangeType(enum.Enum):
+    Created = "created"
+    Updated = "updated"
+    Deleted = "deleted"
+    Cleared = "cleared"
+
+
+@dataclass(frozen=True)
+class ChangeNotification:
+    """One change event (notifications.rs:14-42)."""
+
+    key: str
+    change: ChangeType
+    old_value: Optional[str]
+    new_value: Optional[str]
+    version: int
+    timestamp: float = field(default_factory=time.time)
+
+
+class NotificationFilter:
+    """Filter algebra (notifications.rs:60-89): All / Key / KeyPrefix /
+    ChangeType / And / Or, composed as predicate trees."""
+
+    def __init__(self, pred: Callable[[ChangeNotification], bool]) -> None:
+        self._pred = pred
+
+    def matches(self, n: ChangeNotification) -> bool:
+        return self._pred(n)
+
+    @staticmethod
+    def all() -> "NotificationFilter":
+        return NotificationFilter(lambda n: True)
+
+    @staticmethod
+    def key(key: str) -> "NotificationFilter":
+        return NotificationFilter(lambda n: n.key == key)
+
+    @staticmethod
+    def key_prefix(prefix: str) -> "NotificationFilter":
+        return NotificationFilter(lambda n: n.key.startswith(prefix))
+
+    @staticmethod
+    def change_type(ct: ChangeType) -> "NotificationFilter":
+        return NotificationFilter(lambda n: n.change == ct)
+
+    def and_(self, other: "NotificationFilter") -> "NotificationFilter":
+        return NotificationFilter(lambda n: self.matches(n) and other.matches(n))
+
+    def or_(self, other: "NotificationFilter") -> "NotificationFilter":
+        return NotificationFilter(lambda n: self.matches(n) or other.matches(n))
+
+
+@dataclass
+class NotificationStats:
+    """Bus counters (notifications.rs:99-104)."""
+
+    published: int = 0
+    delivered: int = 0
+    dropped: int = 0
+    active_subscribers: int = 0
+
+
+class _Subscription:
+    """One subscriber's unbounded queue + filter (notifications.rs:279-314
+    NotificationListener analog). Iterate with ``async for`` or ``get()``."""
+
+    def __init__(self, bus: "NotificationBus", flt: NotificationFilter, maxsize: int) -> None:
+        import asyncio
+
+        self.bus = bus
+        self.filter = flt
+        self.queue: "asyncio.Queue[ChangeNotification]" = asyncio.Queue(maxsize)
+        self.closed = False
+
+    async def get(self, timeout: Optional[float] = None):
+        import asyncio
+
+        if timeout is None:
+            return await self.queue.get()
+        return await asyncio.wait_for(self.queue.get(), timeout)
+
+    def get_nowait(self) -> Optional[ChangeNotification]:
+        import asyncio
+
+        try:
+            return self.queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+
+    def close(self) -> None:
+        self.closed = True
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> ChangeNotification:
+        if self.closed:
+            raise StopAsyncIteration
+        return await self.queue.get()
+
+
+class NotificationBus:
+    """Filtered pub/sub for store changes (notifications.rs:106-271).
+
+    Synchronous publish into per-subscriber bounded queues (cap mirrors the
+    reference's 1000-slot broadcast channel); full queues count drops, and
+    closed subscribers are GC'd on the next publish (:237-246 analog).
+    """
+
+    def __init__(self, queue_capacity: int = 1000) -> None:
+        self._subs: list[_Subscription] = []
+        self._capacity = queue_capacity
+        self.stats = NotificationStats()
+
+    def subscribe(
+        self, flt: Optional[NotificationFilter] = None
+    ) -> _Subscription:
+        sub = _Subscription(self, flt or NotificationFilter.all(), self._capacity)
+        self._subs.append(sub)
+        self.stats.active_subscribers = len(self._subs)
+        return sub
+
+    def publish(self, n: ChangeNotification) -> int:
+        """Deliver to matching subscribers; returns delivery count
+        (notifications.rs:198-235)."""
+        import asyncio
+
+        self.stats.published += 1
+        delivered = 0
+        live: list[_Subscription] = []
+        for sub in self._subs:
+            if sub.closed:
+                continue
+            live.append(sub)
+            if not sub.filter.matches(n):
+                continue
+            try:
+                sub.queue.put_nowait(n)
+                delivered += 1
+            except asyncio.QueueFull:
+                self.stats.dropped += 1
+        self._subs = live
+        self.stats.active_subscribers = len(live)
+        self.stats.delivered += delivered
+        return delivered
+
+
+# ---------------------------------------------------------------------------
+# The store (store.rs)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ValueEntry:
+    """Stored value + metadata (store.rs:44-80)."""
+
+    value: str
+    version: int
+    created_at: float
+    updated_at: float
+
+    @property
+    def size(self) -> int:
+        return len(self.value.encode())
+
+
+@dataclass
+class StoreStats:
+    """Store counters (store.rs:82-90)."""
+
+    total_operations: int = 0
+    reads: int = 0
+    writes: int = 0
+    keys: int = 0
+    total_size: int = 0
+
+
+def shard_for_key(key: str, num_shards: int) -> int:
+    """Stable key→shard map (blake2 for cross-process determinism)."""
+    if num_shards <= 1:
+        return 0
+    h = hashlib.blake2s(key.encode(), digest_size=8).digest()
+    return int.from_bytes(h, "little") % num_shards
+
+
+class KVStore:
+    """Versioned in-memory KV store with validation, notifications,
+    snapshots, and key-range sharding (store.rs:101-486).
+
+    In the SMR deployment every mutation arrives through consensus (one
+    consensus instance per shard); direct calls are for local/testing use.
+    """
+
+    def __init__(self, config: Optional[KVStoreConfig] = None) -> None:
+        self.config = config or KVStoreConfig()
+        self._data: dict[str, ValueEntry] = {}
+        self._version = 0
+        self.stats = StoreStats()
+        self.notifications = (
+            NotificationBus() if self.config.notifications_enabled else None
+        )
+
+    # -- validation (store.rs:436-451) --------------------------------------
+
+    def _validate_key(self, key: str) -> None:
+        if not key:
+            raise StoreError(StoreErrorKind.KeyEmpty)
+        if len(key) > self.config.max_key_length:
+            raise StoreError(
+                StoreErrorKind.KeyTooLong, f"{len(key)} > {self.config.max_key_length}"
+            )
+
+    def _validate_value(self, value: str) -> None:
+        if len(value.encode()) > self.config.max_value_size:
+            raise StoreError(StoreErrorKind.ValueTooLarge)
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def set(self, key: str, value: str) -> KVResult:
+        """Insert or update (store.rs:144-188)."""
+        self._validate_key(key)
+        self._validate_value(value)
+        now = time.time()
+        self.stats.total_operations += 1
+        self.stats.writes += 1
+        entry = self._data.get(key)
+        if entry is None:
+            if len(self._data) >= self.config.max_keys:
+                raise StoreError(StoreErrorKind.StoreFull)
+            self._version += 1
+            self._data[key] = ValueEntry(value, self._version, now, now)
+            self._notify(key, ChangeType.Created, None, value)
+        else:
+            old = entry.value
+            self._version += 1
+            entry.value = value
+            entry.version = self._version
+            entry.updated_at = now
+            self._notify(key, ChangeType.Updated, old, value)
+        return KVResult.success(version=self._version)
+
+    def get(self, key: str) -> KVResult:
+        self.stats.total_operations += 1
+        self.stats.reads += 1
+        entry = self._data.get(key)
+        if entry is None:
+            return KVResult.not_found()
+        return KVResult.success(value=entry.value, version=entry.version)
+
+    def get_with_metadata(self, key: str) -> Optional[ValueEntry]:
+        entry = self._data.get(key)
+        if entry is None:
+            return None
+        return ValueEntry(entry.value, entry.version, entry.created_at, entry.updated_at)
+
+    def delete(self, key: str) -> KVResult:
+        self.stats.total_operations += 1
+        self.stats.writes += 1
+        entry = self._data.pop(key, None)
+        if entry is None:
+            return KVResult.not_found()
+        self._version += 1
+        self._notify(key, ChangeType.Deleted, entry.value, None)
+        return KVResult.success(value=entry.value, version=self._version)
+
+    def exists(self, key: str) -> KVResult:
+        self.stats.total_operations += 1
+        self.stats.reads += 1
+        return KVResult.success(value="true" if key in self._data else "false")
+
+    def keys(self, prefix: str = "") -> list[str]:
+        """Sorted key listing, optionally prefix-filtered (store.rs keys())."""
+        if prefix:
+            return sorted(k for k in self._data if k.startswith(prefix))
+        return sorted(self._data)
+
+    def clear(self) -> int:
+        n = len(self._data)
+        self.stats.total_operations += 1
+        self.stats.writes += 1
+        self._data.clear()
+        self._version += 1
+        self._notify("", ChangeType.Cleared, None, None)
+        return n
+
+    def size(self) -> int:
+        return len(self._data)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def _notify(
+        self, key: str, change: ChangeType, old: Optional[str], new: Optional[str]
+    ) -> None:
+        if self.notifications is not None:
+            self.notifications.publish(
+                ChangeNotification(key, change, old, new, self._version)
+            )
+
+    # -- batches (store.rs:313-348) ------------------------------------------
+
+    def apply_operations(self, ops: Sequence[KVOperation]) -> list[KVResult]:
+        out: list[KVResult] = []
+        for op in ops:
+            try:
+                if op.op == KVOpType.Set:
+                    out.append(self.set(op.key, op.value or ""))
+                elif op.op == KVOpType.Get:
+                    out.append(self.get(op.key))
+                elif op.op == KVOpType.Delete:
+                    out.append(self.delete(op.key))
+                elif op.op == KVOpType.Exists:
+                    out.append(self.exists(op.key))
+                elif op.op == KVOpType.Clear:
+                    self.clear()
+                    out.append(KVResult.success())
+                else:
+                    out.append(KVResult.err("invalid operation"))
+            except StoreError as e:
+                out.append(KVResult.err(str(e)))
+        return out
+
+    # -- snapshots (store.rs:350-412) ----------------------------------------
+
+    def snapshot_bytes(self) -> bytes:
+        doc = {
+            "version": self._version,
+            "data": {
+                k: [e.value, e.version, e.created_at, e.updated_at]
+                for k, e in sorted(self._data.items())
+            },
+        }
+        payload = json.dumps(doc, separators=(",", ":"), sort_keys=True).encode()
+        checksum = zlib.crc32(payload) & 0xFFFFFFFF
+        return checksum.to_bytes(4, "little") + payload
+
+    def restore_bytes(self, raw: bytes) -> None:
+        if len(raw) < 4:
+            raise StoreError(StoreErrorKind.SnapshotCorrupt, "too short")
+        checksum = int.from_bytes(raw[:4], "little")
+        payload = raw[4:]
+        if zlib.crc32(payload) & 0xFFFFFFFF != checksum:
+            raise StoreError(StoreErrorKind.ChecksumMismatch)
+        try:
+            doc = json.loads(payload)
+            self._data = {
+                k: ValueEntry(v[0], int(v[1]), float(v[2]), float(v[3]))
+                for k, v in doc["data"].items()
+            }
+            self._version = int(doc["version"])
+        except (ValueError, KeyError, IndexError) as e:
+            raise StoreError(StoreErrorKind.SnapshotCorrupt, str(e)) from None
+
+    def checksum(self) -> int:
+        """Content hash over sorted (key, value, version) (store.rs:464-475)."""
+        h = hashlib.blake2s(digest_size=8)
+        for k in sorted(self._data):
+            e = self._data[k]
+            h.update(k.encode())
+            h.update(e.value.encode())
+            h.update(e.version.to_bytes(8, "little"))
+        return int.from_bytes(h.digest(), "little")
+
+
+# ---------------------------------------------------------------------------
+# SMR bridge (smr_impl.rs:22-100)
+# ---------------------------------------------------------------------------
+
+
+class KVStoreSMR(TypedStateMachine[KVOperation, KVResult, dict]):
+    """Adapts :class:`KVStore` to the typed SMR interface.
+
+    One instance serves ONE shard's consensus log; a sharded deployment runs
+    `num_shards` of these behind :class:`ShardedKVService`.
+    """
+
+    def __init__(self, config: Optional[KVStoreConfig] = None) -> None:
+        self.store = KVStore(config)
+
+    def apply_command(self, command: KVOperation) -> KVResult:
+        self._bump_version()
+        try:
+            res = self.store.apply_operations([command])[0]
+        except StoreError as e:
+            return KVResult.err(str(e))
+        return res
+
+    def get_state(self) -> dict:
+        return {k: e.value for k, e in self.store._data.items()}
+
+    def set_state(self, state: dict) -> None:
+        self.store._data = {
+            k: ValueEntry(v, 0, time.time(), time.time()) for k, v in state.items()
+        }
+
+    def encode_command(self, command: KVOperation) -> bytes:
+        return json.dumps(
+            {"op": command.op.value, "key": command.key, "value": command.value},
+            separators=(",", ":"),
+        ).encode()
+
+    def decode_command(self, data: bytes) -> KVOperation:
+        try:
+            doc = json.loads(data)
+            return KVOperation(KVOpType(doc["op"]), doc.get("key", ""), doc.get("value"))
+        except (ValueError, KeyError) as e:
+            raise StateMachineError(f"bad kv command: {e}") from None
+
+    def encode_response(self, response: KVResult) -> bytes:
+        return json.dumps(
+            {
+                "kind": response.kind.value,
+                "value": response.value,
+                "version": response.version,
+                "error": response.error,
+            },
+            separators=(",", ":"),
+        ).encode()
+
+    def decode_response(self, data: bytes) -> KVResult:
+        doc = json.loads(data)
+        return KVResult(
+            KVResultKind(doc["kind"]),
+            value=doc.get("value"),
+            version=doc.get("version"),
+            error=doc.get("error"),
+        )
+
+    def serialize_state(self) -> bytes:
+        return self.store.snapshot_bytes()
+
+    def deserialize_state(self, data: bytes) -> None:
+        self.store.restore_bytes(data)

@@ -1,0 +1,136 @@
+"""Shard-routing state machine + the client-facing sharded KV service.
+
+No direct reference analog: the reference runs ONE consensus instance per
+cluster (its kvstore_smr bridges a single store — smr_impl.rs:22-100). Here
+the store is partitioned by key range and every shard is an independent
+consensus instance — the batched ``S`` axis of the device kernel
+(SURVEY.md §5.7, §7.1). This module provides:
+
+- :class:`ShardedStateMachine` — engine-facing bytes SM that routes each
+  committed batch to its shard's sub-machine (`CommandBatch.shard` carries
+  the index through consensus);
+- :class:`ShardedKVService` — the client API: key → shard → engine submit,
+  with typed encode/decode via the shard's `KVStoreSMR`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Optional, Sequence
+
+from rabia_tpu.core.smr import SMRBridge, TypedStateMachine
+from rabia_tpu.core.state_machine import Snapshot, StateMachine
+from rabia_tpu.core.types import Command, CommandBatch, ShardId
+from rabia_tpu.apps.kvstore import (
+    KVOperation,
+    KVResult,
+    KVStoreConfig,
+    KVStoreSMR,
+    shard_for_key,
+)
+
+
+class ShardedStateMachine(StateMachine):
+    """Routes committed batches to per-shard typed machines by batch.shard.
+
+    The engine applies whole batches (engine.rs:684-706 analog); the shard
+    index rides on the batch, so routing is O(1) and the per-shard machines
+    stay single-writer (no cross-shard synchronization — matching how the
+    kernel treats shards as independent instances).
+    """
+
+    def __init__(self, machines: Sequence[TypedStateMachine]) -> None:
+        self.bridges = [SMRBridge(m) for m in machines]
+        self.machines = list(machines)
+        self._version = 0
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.bridges)
+
+    def _bridge_for(self, shard: int) -> SMRBridge:
+        return self.bridges[shard % len(self.bridges)]
+
+    def apply_command(self, command: Command) -> bytes:
+        # unrouted single commands go to shard 0 (engine tests / smoke)
+        return self.bridges[0].apply_command(command)
+
+    def apply_batch(self, batch: CommandBatch) -> list[bytes]:
+        bridge = self._bridge_for(int(batch.shard))
+        return [bridge.apply_command(c) for c in batch.commands]
+
+    def create_snapshot(self) -> Snapshot:
+        self._version += 1
+        doc = {
+            "shards": [
+                bridge.create_snapshot().to_bytes().hex() for bridge in self.bridges
+            ]
+        }
+        return Snapshot.create(
+            self._version, json.dumps(doc, separators=(",", ":")).encode()
+        )
+
+    def restore_snapshot(self, snapshot: Snapshot) -> None:
+        snapshot.verify()
+        doc = json.loads(snapshot.data)
+        for bridge, blob_hex in zip(self.bridges, doc["shards"]):
+            bridge.restore_snapshot(Snapshot.from_bytes(bytes.fromhex(blob_hex)))
+        self._version = snapshot.version
+
+    def get_state_summary(self) -> str:
+        return f"{len(self.bridges)} shards"
+
+
+def make_sharded_kv(
+    num_shards: int, config: Optional[KVStoreConfig] = None
+) -> tuple[ShardedStateMachine, list[KVStoreSMR]]:
+    """Build one `KVStoreSMR` per shard behind a routing SM."""
+    machines = [KVStoreSMR(config) for _ in range(num_shards)]
+    return ShardedStateMachine(machines), machines
+
+
+class ShardedKVService:
+    """Client facade: key-routed KV operations through consensus.
+
+    `submit` is the engine's `submit_batch`; injected so the service works
+    with any engine (or a local loopback in tests).
+    """
+
+    def __init__(
+        self,
+        num_shards: int,
+        submit: Callable,  # async (CommandBatch, shard) -> Future[list[bytes]]
+        machines: Sequence[KVStoreSMR],
+    ) -> None:
+        self.num_shards = num_shards
+        self._submit = submit
+        self._machines = list(machines)
+
+    def shard_of(self, key: str) -> int:
+        return shard_for_key(key, self.num_shards)
+
+    async def _roundtrip(self, op: KVOperation, shard: int) -> KVResult:
+        codec = self._machines[shard]
+        batch = CommandBatch.new(
+            [Command.new(codec.encode_command(op))], shard=ShardId(shard)
+        )
+        fut = await self._submit(batch, shard)
+        responses = await fut
+        return codec.decode_response(responses[0])
+
+    async def set(self, key: str, value: str) -> KVResult:
+        return await self._roundtrip(KVOperation.set(key, value), self.shard_of(key))
+
+    async def get(self, key: str) -> KVResult:
+        return await self._roundtrip(KVOperation.get(key), self.shard_of(key))
+
+    async def delete(self, key: str) -> KVResult:
+        return await self._roundtrip(KVOperation.delete(key), self.shard_of(key))
+
+    async def exists(self, key: str) -> bool:
+        res = await self._roundtrip(KVOperation.exists(key), self.shard_of(key))
+        return res.value == "true"
+
+    def local_store(self, shard: int):
+        """Direct access to a shard's local replica store (reads/tests)."""
+        return self._machines[shard].store

@@ -116,12 +116,20 @@ class SyncRequest:
 
 @dataclass(frozen=True)
 class SyncResponse:
-    """Peer replies with snapshot if ahead (messages.rs:114-121)."""
+    """Peer replies with snapshot if ahead (messages.rs:114-121).
+
+    ``applied_ids`` carries recently applied (shard, batch_id) pairs so a
+    syncing node inherits the duplicate-commit dedup ledger along with the
+    snapshot — without it, a batch that commits in two slots (duplicate
+    forwarding race) could be applied once pre-sync via the snapshot and
+    again post-sync by the restored node.
+    """
 
     responder_phase: int
     state_version: int
     snapshot: Optional[bytes] = None
     per_shard_phase: tuple[int, ...] = ()
+    applied_ids: tuple[tuple[int, BatchId], ...] = ()
 
 
 @dataclass(frozen=True)

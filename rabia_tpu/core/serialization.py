@@ -236,6 +236,10 @@ def _encode_payload(w: _Writer, payload) -> None:
         w.u32(len(payload.per_shard_phase))
         for p in payload.per_shard_phase:
             w.u64(p)
+        w.u32(len(payload.applied_ids))
+        for shard, bid in payload.applied_ids:
+            w.u32(shard)
+            w.uuid(bid.value)
     elif isinstance(payload, NewBatch):
         w.u32(payload.shard)
         _write_batch(w, payload.batch)
@@ -282,7 +286,9 @@ def _decode_payload(msg_type: MessageType, r: _Reader):
         snap = r.blob() if r.u8() else None
         n = r.u32()
         per_shard = tuple(r.u64() for _ in range(n))
-        return SyncResponse(phase, ver, snap, per_shard)
+        n_ids = r.u32()
+        applied = tuple((r.u32(), BatchId(r.uuid())) for _ in range(n_ids))
+        return SyncResponse(phase, ver, snap, per_shard, applied)
     if msg_type == MessageType.NewBatch:
         return NewBatch(shard=r.u32(), batch=_read_batch(r))
     if msg_type == MessageType.HeartBeat:

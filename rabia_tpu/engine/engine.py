@@ -289,6 +289,16 @@ class RabiaEngine:
 
     def _handle_message(self, sender: NodeId, msg: ProtocolMessage) -> None:
         """Route one validated message into host buffers (engine.rs:349-379)."""
+        if sender != msg.sender:
+            # envelope sender must match the transport-authenticated peer:
+            # otherwise one faulty peer could forge votes as every other
+            # replica row and fabricate a quorum single-handedly
+            logger.warning(
+                "dropping spoofed message: envelope %s via transport %s",
+                msg.sender,
+                sender,
+            )
+            return
         row = self._node_to_row.get(msg.sender)
         if row is None:
             logger.warning("message from unknown node %s", msg.sender)
@@ -320,8 +330,14 @@ class RabiaEngine:
         if slot < sh.applied_upto:
             return  # stale
         rec = sh.decisions.get(slot)
-        if rec is not None and rec.batch_id != p.batch_id:
-            return  # slot already decided about a different batch
+        if rec is not None:
+            if rec.batch_id is None:
+                # slot decided V1 off peers' votes before the Propose got
+                # here: repair the binding so apply doesn't need a snapshot
+                # sync for a payload that just arrived
+                rec.batch_id = p.batch_id
+            elif rec.batch_id != p.batch_id:
+                return  # slot already decided about a different batch
         # first proposal wins the slot binding; payloads are id-keyed so a
         # conflicting late proposal can't swap the bytes a decision applies
         sh.buf_propose.setdefault(slot, (p.batch_id, p.batch))
@@ -347,7 +363,12 @@ class RabiaEngine:
                 continue
             sh = self.rt.shards[d.shard]
             slot, _ = unpack_phase(d.phase)
-            if slot < sh.applied_upto or slot in sh.decisions:
+            if slot < sh.applied_upto:
+                continue
+            rec = sh.decisions.get(slot)
+            if rec is not None:
+                if rec.batch_id is None and d.batch_id is not None:
+                    rec.batch_id = d.batch_id  # late binding repair
                 continue
             # buffered only: recorded when the slot becomes current, either
             # via kernel adoption (in flight) or in _open_slots — keeps slot
@@ -383,9 +404,9 @@ class RabiaEngine:
             if target_row == self.me:
                 continue
             sub = sh.queue[0]
-            if getattr(sub, "_forwarded_at", 0) and now - sub._forwarded_at < self.config.phase_timeout:
+            if sub.forwarded_at and now - sub.forwarded_at < self.config.phase_timeout:
                 continue
-            sub._forwarded_at = now  # type: ignore[attr-defined]
+            sub.forwarded_at = now
             target = self._row_to_node[target_row]
             self._send(
                 NewBatch(shard=s, batch=sub.batch), recipient=target
@@ -419,6 +440,14 @@ class RabiaEngine:
                 self._record_decision(s, slot, bd[0], bd[1])
                 continue
             proposer_row = slot_proposer(s, slot, self.R)
+            # never propose a batch that already committed in another slot
+            # (duplicate-forwarding race): settle it from the dedup ledger
+            while sh.queue and sh.queue[0].batch.id in sh.applied_results:
+                done_sub = sh.queue.popleft()
+                if done_sub.future is not None and not done_sub.future.done():
+                    done_sub.future.set_result(
+                        sh.applied_results[done_sub.batch.id]
+                    )
             if proposer_row == self.me and sh.queue:
                 sub = sh.queue[0]
                 sh.payloads[sub.batch.id] = sub.batch
@@ -444,8 +473,8 @@ class RabiaEngine:
                         sh.opened_at = now  # start the grace clock
                     elif now - sh.opened_at > grace:
                         opened.append((s, slot, V0))
-                elif sh.queue and getattr(sh.queue[0], "_forwarded_at", 0) and (
-                    now - sh.queue[0]._forwarded_at > self.config.phase_timeout
+                elif sh.queue and sh.queue[0].forwarded_at and (
+                    now - sh.queue[0].forwarded_at > self.config.phase_timeout
                 ):
                     # forwarded proposer unresponsive: force a null slot to
                     # rotate the proposer (leaderless liveness)
@@ -672,7 +701,7 @@ class RabiaEngine:
                         )
                     del sh.queue[i]
                 else:
-                    sub._forwarded_at = 0  # type: ignore[attr-defined]
+                    sub.forwarded_at = 0.0
                 break
 
     # -- timeouts ------------------------------------------------------------
@@ -736,6 +765,11 @@ class RabiaEngine:
         if total_applied <= p.current_phase:
             return  # not ahead; stay silent (engine.rs:763-779)
         snap = self.sm.create_snapshot()
+        applied_ids = tuple(
+            (s, bid)
+            for s, sh in enumerate(self.rt.shards[: self.n_shards])
+            for bid in sh.applied_results
+        )
         self._send(
             SyncResponse(
                 responder_phase=total_applied,
@@ -744,6 +778,7 @@ class RabiaEngine:
                 per_shard_phase=tuple(
                     sh.applied_upto for sh in self.rt.shards
                 ),
+                applied_ids=applied_ids,
             ),
             recipient=sender,
         )
@@ -754,9 +789,15 @@ class RabiaEngine:
             p.state_version,
             p.snapshot,
             p.per_shard_phase,
+            p.applied_ids,
         )
-        # resolve once a quorum (incl. self) answered or anyone is ahead
-        if len(self.rt.sync_responses) + 1 >= self.cluster.quorum_size:
+        # only strictly-ahead peers respond at all, so any usable response
+        # resolves immediately — waiting for a quorum of responders can
+        # stall forever when just one peer is ahead
+        total_applied = sum(sh.applied_upto for sh in self.rt.shards)
+        if p.responder_phase > total_applied or (
+            len(self.rt.sync_responses) + 1 >= self.cluster.quorum_size
+        ):
             self._resolve_sync()
 
     def _resolve_sync(self) -> None:
@@ -787,6 +828,11 @@ class RabiaEngine:
                 sh.next_slot = max(sh.next_slot, applied)
                 sh.in_flight = False
                 sh.gc_upto(applied)
+        # inherit the responder's dedup ledger: batches already applied via
+        # the snapshot must never re-apply here if they commit again later
+        for s, bid in best[4]:
+            if 0 <= s < self.n_shards:
+                self.rt.shards[s].applied_results.setdefault(bid, [])
         self.rt.sync_responses.clear()
         logger.info("%s sync: jumped to %d applied", self.node_id.short(), best[0])
 
@@ -828,6 +874,18 @@ class RabiaEngine:
                 cut = sh.applied_upto - self.config.max_phase_history
                 for k in [k for k in sh.decisions if k < cut]:
                     del sh.decisions[k]
+            # drop payloads nothing references anymore (e.g. batches whose
+            # slots kept deciding V0 and were abandoned) — without this a
+            # long-running replica leaks every rejected batch's bytes
+            live = {sub.batch.id for sub in sh.queue}
+            live.update(bid for bid, _ in sh.buf_propose.values())
+            live.update(
+                rec.batch_id
+                for slot, rec in sh.decisions.items()
+                if rec.batch_id is not None and not rec.applied
+            )
+            for bid in [b for b in sh.payloads if b not in live]:
+                del sh.payloads[bid]
             if len(sh.applied_results) > 2 * self.config.max_pending_batches:
                 for bid in list(sh.applied_results)[
                     : len(sh.applied_results) - self.config.max_pending_batches

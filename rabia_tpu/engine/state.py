@@ -61,6 +61,7 @@ class PendingSubmission:
     future: Optional[asyncio.Future] = None
     attempts: int = 0
     submitted_at: float = field(default_factory=time.time)
+    forwarded_at: float = 0.0  # last NewBatch forward to a remote proposer
 
 
 class ShardRuntime:

@@ -1,0 +1,19 @@
+"""Multi-device execution: meshes, shard-axis pjit, replica collectives."""
+
+from rabia_tpu.parallel.mesh import (
+    REPLICA_AXIS,
+    SHARD_AXIS,
+    MeshPhaseKernel,
+    MeshPhaseState,
+    ShardedClusterKernel,
+    make_mesh,
+)
+
+__all__ = [
+    "REPLICA_AXIS",
+    "SHARD_AXIS",
+    "MeshPhaseKernel",
+    "MeshPhaseState",
+    "ShardedClusterKernel",
+    "make_mesh",
+]

@@ -1,0 +1,93 @@
+"""InMemory + atomic-file persistence backends.
+
+Reference parity: rabia-persistence/src/in_memory.rs:11-43 (single-slot
+RwLock store) and file_system.rs:26-94 (one `state.dat`, atomic write via
+`.tmp` + rename). Writes go through the event loop's default executor so
+fsync never blocks the consensus round loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from pathlib import Path
+from typing import Optional
+
+from rabia_tpu.core.errors import PersistenceError
+from rabia_tpu.core.persistence import PersistenceLayer
+
+STATE_FILE = "state.dat"
+
+
+class InMemoryPersistence(PersistenceLayer):
+    """Single-slot volatile store (in_memory.rs:11-43)."""
+
+    def __init__(self) -> None:
+        self._blob: Optional[bytes] = None
+        self.saves = 0
+        self.loads = 0
+
+    async def save_state(self, data: bytes) -> None:
+        self._blob = bytes(data)
+        self.saves += 1
+
+    async def load_state(self) -> Optional[bytes]:
+        self.loads += 1
+        return self._blob
+
+    def clear(self) -> None:
+        self._blob = None
+
+
+class FileSystemPersistence(PersistenceLayer):
+    """One `state.dat` per node dir; atomic tmp+rename (file_system.rs:62-78).
+
+    The rename is atomic on POSIX, so a crash mid-save leaves either the old
+    or the new state — never a torn file. fsync before rename makes the
+    write durable, fsync of the directory makes the rename durable.
+    """
+
+    def __init__(self, directory: str | os.PathLike) -> None:
+        self.dir = Path(directory)
+        try:
+            self.dir.mkdir(parents=True, exist_ok=True)
+        except OSError as e:
+            raise PersistenceError(f"cannot create state dir: {e}") from None
+        self.path = self.dir / STATE_FILE
+
+    def _save_sync(self, data: bytes) -> None:
+        tmp = self.path.with_suffix(".tmp")
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            dfd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError as e:
+            raise PersistenceError(f"save failed: {e}") from None
+
+    def _load_sync(self) -> Optional[bytes]:
+        try:
+            return self.path.read_bytes()
+        except FileNotFoundError:
+            return None
+        except OSError as e:
+            raise PersistenceError(f"load failed: {e}") from None
+
+    async def save_state(self, data: bytes) -> None:
+        await asyncio.get_event_loop().run_in_executor(None, self._save_sync, data)
+
+    async def load_state(self) -> Optional[bytes]:
+        return await asyncio.get_event_loop().run_in_executor(None, self._load_sync)
+
+    # sync wrappers (file_system.rs:80-94 "sync constructor" analog)
+    def save_state_sync(self, data: bytes) -> None:
+        self._save_sync(data)
+
+    def load_state_sync(self) -> Optional[bytes]:
+        return self._load_sync()

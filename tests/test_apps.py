@@ -1,0 +1,390 @@
+"""App-layer tests: counter, kvstore (+notifications), banking, sharding.
+
+Reference parity: examples/counter_smr/src/lib.rs:209-324 (counter logic),
+rabia-kvstore/src/store.rs:488-568 (CRUD/batch/snapshot),
+notifications.rs:316-454 (filtering), banking_smr invariants.
+"""
+
+import pytest
+
+from rabia_tpu.apps import (
+    BankCommand,
+    BankingSMR,
+    ChangeType,
+    CounterCommand,
+    CounterSMR,
+    KVOperation,
+    KVResultKind,
+    KVStore,
+    KVStoreSMR,
+    NotificationFilter,
+    ShardedStateMachine,
+    make_sharded_kv,
+    shard_for_key,
+)
+from rabia_tpu.core.config import KVStoreConfig
+from rabia_tpu.core.smr import SMRBridge
+from rabia_tpu.core.types import Command, CommandBatch, ShardId
+
+
+class TestCounter:
+    def test_increment_decrement_set_reset(self):
+        sm = CounterSMR()
+        assert sm.apply_command(CounterCommand.increment(5)).value == 5
+        assert sm.apply_command(CounterCommand.decrement(2)).value == 3
+        assert sm.apply_command(CounterCommand.set(100)).value == 100
+        assert sm.apply_command(CounterCommand.reset()).value == 0
+        assert sm.operations == 4
+
+    def test_overflow_rejected_deterministically(self):
+        sm = CounterSMR()
+        sm.apply_command(CounterCommand.set((1 << 63) - 1))
+        r = sm.apply_command(CounterCommand.increment(1))
+        assert not r.ok and r.error == "overflow"
+        assert sm.value == (1 << 63) - 1
+        assert sm.operations == 2  # failed ops still count (determinism)
+
+    def test_underflow_rejected(self):
+        sm = CounterSMR()
+        sm.apply_command(CounterCommand.set(-(1 << 63)))
+        r = sm.apply_command(CounterCommand.decrement(1))
+        assert not r.ok and r.error == "underflow"
+
+    def test_command_response_roundtrip(self):
+        sm = CounterSMR()
+        cmd = CounterCommand.increment(7)
+        assert sm.decode_command(sm.encode_command(cmd)) == cmd
+        resp = sm.apply_command(cmd)
+        assert sm.decode_response(sm.encode_response(resp)) == resp
+
+    def test_state_roundtrip_via_bridge(self):
+        sm = CounterSMR()
+        bridge = SMRBridge(sm)
+        bridge.apply_command(Command.new(sm.encode_command(CounterCommand.increment(41))))
+        snap = bridge.create_snapshot()
+        sm2 = CounterSMR()
+        SMRBridge(sm2).restore_snapshot(snap)
+        assert sm2.value == 41
+
+
+class TestKVStore:
+    def test_crud(self):
+        s = KVStore()
+        assert s.set("a", "1").ok
+        assert s.get("a").value == "1"
+        assert s.exists("a").value == "true"
+        assert s.delete("a").value == "1"
+        assert s.get("a").kind == KVResultKind.NotFound
+
+    def test_versions_monotone(self):
+        s = KVStore()
+        v1 = s.set("k", "x").version
+        v2 = s.set("k", "y").version
+        assert v2 > v1
+        meta = s.get_with_metadata("k")
+        assert meta.version == v2 and meta.value == "y"
+
+    def test_key_validation(self):
+        import pytest as _pytest
+
+        s = KVStore(KVStoreConfig(max_key_length=4))
+        with _pytest.raises(Exception):
+            s.set("toolongkey", "v")
+        with _pytest.raises(Exception):
+            s.set("", "v")
+
+    def test_value_size_limit(self):
+        s = KVStore(KVStoreConfig(max_value_size=8))
+        with pytest.raises(Exception):
+            s.set("k", "x" * 100)
+
+    def test_max_keys(self):
+        s = KVStore(KVStoreConfig(max_keys=2))
+        s.set("a", "1")
+        s.set("b", "2")
+        with pytest.raises(Exception):
+            s.set("c", "3")
+        s.set("a", "updated")  # updates never hit the cap
+
+    def test_keys_prefix_listing(self):
+        s = KVStore()
+        for k in ["user:1", "user:2", "order:1"]:
+            s.set(k, "x")
+        assert s.keys("user:") == ["user:1", "user:2"]
+        assert len(s.keys()) == 3
+
+    def test_snapshot_roundtrip_and_checksum(self):
+        s = KVStore()
+        s.set("a", "1")
+        s.set("b", "2")
+        blob = s.snapshot_bytes()
+        s2 = KVStore()
+        s2.restore_bytes(blob)
+        assert s2.get("a").value == "1"
+        assert s.checksum() == s2.checksum()
+
+    def test_snapshot_corruption_detected(self):
+        s = KVStore()
+        s.set("a", "1")
+        blob = bytearray(s.snapshot_bytes())
+        blob[10] ^= 0xFF
+        with pytest.raises(Exception):
+            KVStore().restore_bytes(bytes(blob))
+
+    def test_batch_apply(self):
+        s = KVStore()
+        results = s.apply_operations(
+            [
+                KVOperation.set("x", "1"),
+                KVOperation.get("x"),
+                KVOperation.delete("x"),
+                KVOperation.get("x"),
+            ]
+        )
+        assert [r.kind for r in results] == [
+            KVResultKind.Success,
+            KVResultKind.Success,
+            KVResultKind.Success,
+            KVResultKind.NotFound,
+        ]
+
+
+class TestNotifications:
+    def test_filters(self):
+        s = KVStore()
+        bus = s.notifications
+        all_sub = bus.subscribe()
+        key_sub = bus.subscribe(NotificationFilter.key("a"))
+        prefix_sub = bus.subscribe(NotificationFilter.key_prefix("user:"))
+        type_sub = bus.subscribe(NotificationFilter.change_type(ChangeType.Deleted))
+
+        s.set("a", "1")
+        s.set("user:7", "x")
+        s.delete("a")
+
+        assert all_sub.queue.qsize() == 3
+        assert key_sub.queue.qsize() == 2  # created + deleted for "a"
+        assert prefix_sub.queue.qsize() == 1
+        assert type_sub.queue.qsize() == 1
+        n = type_sub.get_nowait()
+        assert n.change == ChangeType.Deleted and n.old_value == "1"
+
+    def test_and_or_composition(self):
+        s = KVStore()
+        bus = s.notifications
+        sub = bus.subscribe(
+            NotificationFilter.key_prefix("u:").and_(
+                NotificationFilter.change_type(ChangeType.Created)
+            )
+        )
+        s.set("u:1", "a")  # match
+        s.set("u:1", "b")  # update: no
+        s.set("v:1", "c")  # prefix: no
+        assert sub.queue.qsize() == 1
+
+    def test_closed_subscriber_gc(self):
+        s = KVStore()
+        bus = s.notifications
+        sub = bus.subscribe()
+        sub.close()
+        s.set("k", "v")
+        assert bus.stats.active_subscribers == 0
+
+
+class TestBanking:
+    def test_deposit_withdraw_transfer(self):
+        b = BankingSMR()
+        assert b.apply_command(BankCommand.create("alice", 10_00)).ok
+        assert b.apply_command(BankCommand.create("bob")).ok
+        assert b.apply_command(BankCommand.deposit("bob", 5_00)).ok
+        r = b.apply_command(BankCommand.transfer("alice", "bob", 3_00))
+        assert r.ok and r.balance_cents == 7_00
+        assert b.apply_command(BankCommand.balance("bob")).balance_cents == 8_00
+
+    def test_conservation_invariant(self):
+        b = BankingSMR()
+        b.apply_command(BankCommand.create("a", 100_00))
+        b.apply_command(BankCommand.create("b", 50_00))
+        total = b.total_value()
+        for _ in range(10):
+            b.apply_command(BankCommand.transfer("a", "b", 1_00))
+            b.apply_command(BankCommand.transfer("b", "a", 1_00))
+        assert b.total_value() == total
+
+    def test_validation(self):
+        b = BankingSMR()
+        b.apply_command(BankCommand.create("a", 1_00))
+        assert not b.apply_command(BankCommand.deposit("a", -5)).ok
+        assert not b.apply_command(BankCommand.deposit("a", 10_000_000_01)).ok
+        assert not b.apply_command(BankCommand.withdraw("a", 2_00)).ok
+        assert not b.apply_command(BankCommand.transfer("a", "a", 1)).ok
+        assert not b.apply_command(BankCommand.transfer("a", "ghost", 1)).ok
+        assert b.total_value() == 1_00
+
+    def test_state_roundtrip(self):
+        b = BankingSMR()
+        b.apply_command(BankCommand.create("x", 42_00))
+        blob = b.serialize_state()
+        b2 = BankingSMR()
+        b2.deserialize_state(blob)
+        assert b2.apply_command(BankCommand.balance("x")).balance_cents == 42_00
+        assert b2.total_value() == b.total_value()
+
+
+class TestSharding:
+    def test_shard_for_key_stable_and_spread(self):
+        assert shard_for_key("k", 8) == shard_for_key("k", 8)
+        shards = {shard_for_key(f"key{i}", 8) for i in range(200)}
+        assert len(shards) == 8  # every shard reached
+
+    def test_sharded_sm_routes_by_batch_shard(self):
+        sm, machines = make_sharded_kv(4)
+        op = machines[2].encode_command(KVOperation.set("hello", "world"))
+        batch = CommandBatch.new([Command.new(op)], shard=ShardId(2))
+        sm.apply_batch(batch)
+        assert machines[2].store.get("hello").value == "world"
+        assert machines[0].store.size() == 0
+
+    def test_sharded_snapshot_roundtrip(self):
+        sm, machines = make_sharded_kv(3)
+        for i, m in enumerate(machines):
+            m.store.set(f"k{i}", str(i))
+        snap = sm.create_snapshot()
+        sm2, machines2 = make_sharded_kv(3)
+        sm2.restore_snapshot(snap)
+        for i, m in enumerate(machines2):
+            assert m.store.get(f"k{i}").value == str(i)
+
+
+class TestCounterClusterEndToEnd:
+    """BASELINE config #1: counter SMR, 3 replicas, in-memory transport —
+    the minimum end-to-end slice (SURVEY.md §7.3)."""
+
+    @pytest.mark.asyncio
+    async def test_counter_cluster(self):
+        import asyncio
+
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.types import NodeId
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.net import InMemoryHub
+
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        config = RabiaConfig(
+            phase_timeout=0.4, heartbeat_interval=0.05, round_interval=0.002
+        ).with_kernel(num_shards=1, shard_pad_multiple=1)
+        engines, counters, tasks = [], [], []
+        for n in nodes:
+            counter = CounterSMR()
+            engines.append(
+                RabiaEngine(
+                    ClusterConfig.new(n, nodes),
+                    SMRBridge(counter),
+                    hub.register(n),
+                    config=config,
+                )
+            )
+            counters.append(counter)
+            tasks.append(asyncio.ensure_future(engines[-1].run()))
+        try:
+            for _ in range(200):
+                await asyncio.sleep(0.01)
+                sts = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in sts):
+                    break
+            codec = counters[0]
+            batch = CommandBatch.new(
+                [Command.new(codec.encode_command(CounterCommand.increment(5)))]
+            )
+            fut = await engines[0].submit_batch(batch, shard=0)
+            responses = await asyncio.wait_for(fut, 15.0)
+            assert codec.decode_response(responses[0]).value == 5
+
+            async def converged():
+                while not all(c.value == 5 for c in counters):
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(converged(), 10.0)
+        finally:
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+
+class TestShardedKVCluster:
+    """BASELINE config #2 shape: sharded kvstore over a 3-replica cluster."""
+
+    @pytest.mark.asyncio
+    async def test_sharded_kv_cluster(self):
+        import asyncio
+
+        from rabia_tpu.apps import ShardedKVService
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.types import NodeId
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.net import InMemoryHub
+
+        n_shards = 4
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        config = RabiaConfig(
+            phase_timeout=0.4, heartbeat_interval=0.05, round_interval=0.002
+        ).with_kernel(num_shards=n_shards, shard_pad_multiple=4)
+        engines, all_machines, tasks = [], [], []
+        for n in nodes:
+            sm, machines = make_sharded_kv(n_shards)
+            engines.append(
+                RabiaEngine(
+                    ClusterConfig.new(n, nodes), sm, hub.register(n), config=config
+                )
+            )
+            all_machines.append(machines)
+            tasks.append(asyncio.ensure_future(engines[-1].run()))
+        try:
+            for _ in range(200):
+                await asyncio.sleep(0.01)
+                sts = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in sts):
+                    break
+            svc = ShardedKVService(
+                n_shards, engines[0].submit_batch, all_machines[0]
+            )
+            keys = [f"key{i}" for i in range(8)]
+            results = await asyncio.gather(
+                *[
+                    asyncio.wait_for(
+                        (lambda k: _set_via(svc, k))(k), 20.0
+                    )
+                    for k in keys
+                ]
+            )
+            assert all(r.ok for r in results)
+            # every replica's shard stores converge
+            async def converged():
+                while True:
+                    ok = True
+                    for machines in all_machines:
+                        for k in keys:
+                            s = shard_for_key(k, n_shards)
+                            if machines[s].store.get(k).value != f"v-{k}":
+                                ok = False
+                    if ok:
+                        return
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(converged(), 20.0)
+        finally:
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+
+async def _set_via(svc, key):
+    return await svc.set(key, f"v-{key}")

@@ -1,0 +1,144 @@
+"""Mesh execution tests on the virtual 8-device CPU mesh.
+
+Covers the two device-plane modes of SURVEY.md §5.8 and the §7.4.6
+conformance gate: vmap-simulated replicas (ClusterKernel) and mesh-axis
+replicas with collectives (MeshPhaseKernel) must be decision-identical.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rabia_tpu.core.types import ABSENT, V0, V1
+from rabia_tpu.kernel import ClusterKernel
+from rabia_tpu.parallel import (
+    MeshPhaseKernel,
+    ShardedClusterKernel,
+    make_mesh,
+)
+
+
+@pytest.fixture(scope="module")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest must provide 8 virtual CPU devices"
+    return devs
+
+
+class TestMakeMesh:
+    def test_default_all_on_shard_axis(self, devices):
+        m = make_mesh()
+        assert m.shape == {"shard": 8, "replica": 1}
+
+    def test_two_d(self, devices):
+        m = make_mesh(shard_axis_size=2, replica_axis_size=4)
+        assert m.shape == {"shard": 2, "replica": 4}
+
+    def test_bad_factorization_rejected(self, devices):
+        with pytest.raises(ValueError):
+            make_mesh(shard_axis_size=3, replica_axis_size=3)
+
+
+class TestShardedClusterKernel:
+    def test_pipeline_matches_single_device(self, devices):
+        S, R, T = 16, 3, 4
+        votes = np.random.RandomState(0).choice(
+            [V0, V1], size=(T, S, R)
+        ).astype(np.int8)
+        alive = jnp.ones((S, R), bool)
+
+        plain = ClusterKernel(S, R, seed=11)
+        d_plain, p_plain = plain.slot_pipeline(jnp.asarray(votes), alive, T)
+
+        mesh = make_mesh(shard_axis_size=8, replica_axis_size=1)
+        sharded = ShardedClusterKernel(S, R, mesh, seed=11)
+        d_shard, p_shard = sharded.slot_pipeline(
+            sharded.place_votes(jnp.asarray(votes)), alive, T
+        )
+        np.testing.assert_array_equal(np.asarray(d_plain), np.asarray(d_shard))
+        np.testing.assert_array_equal(np.asarray(p_plain), np.asarray(p_shard))
+
+    def test_state_is_actually_sharded(self, devices):
+        mesh = make_mesh(shard_axis_size=8, replica_axis_size=1)
+        k = ShardedClusterKernel(32, 3, mesh)
+        st = k.init_state()
+        assert len(st.phase.sharding.device_set) == 8
+
+    def test_indivisible_shards_rejected(self, devices):
+        mesh = make_mesh(shard_axis_size=8, replica_axis_size=1)
+        with pytest.raises(ValueError):
+            ShardedClusterKernel(12, 3, mesh)
+
+
+class TestMeshPhaseKernel:
+    def test_unanimous_v1_decides_first_phase(self, devices):
+        S, R = 16, 4
+        mesh = make_mesh(shard_axis_size=2, replica_axis_size=4)
+        k = MeshPhaseKernel(S, R, mesh, seed=5)
+        st = k.init_state(jnp.full((S, R), V1, jnp.int8))
+        alive = k.place(jnp.ones((S, R), bool))
+        st = k.phase_step(st, alive, k.shard_index_array())
+        assert np.all(np.asarray(st.decided) == V1)
+
+    def test_mixed_votes_terminate_and_agree(self, devices):
+        S, R = 8, 4
+        mesh = make_mesh(shard_axis_size=2, replica_axis_size=4)
+        k = MeshPhaseKernel(S, R, mesh, seed=7)
+        votes = np.random.RandomState(3).choice([V0, V1], size=(S, R)).astype(np.int8)
+        st = k.init_state(jnp.asarray(votes))
+        alive = k.place(jnp.ones((S, R), bool))
+        idx = k.shard_index_array()
+        for _ in range(12):
+            st = k.phase_step(st, alive, idx)
+        dec = np.asarray(st.decided)
+        assert np.all(dec != ABSENT)
+        # agreement: every replica of a shard decided the same value
+        assert np.all(dec == dec[:, :1])
+
+    def test_conformance_with_cluster_kernel(self, devices):
+        """§7.4.6: mesh-collective replicas and vmap-simulated replicas must
+        be decision-identical (same seed, fault-free, lockstep)."""
+        S, R, T = 8, 4, 3
+        seed = 23
+        votes = np.random.RandomState(9).choice(
+            [V0, V1], size=(T, S, R)
+        ).astype(np.int8)
+
+        plain = ClusterKernel(S, R, seed=seed)
+        d_plain, _ = plain.slot_pipeline(
+            jnp.asarray(votes), jnp.ones((S, R), bool), T, rounds_per_slot=16
+        )
+
+        mesh = make_mesh(shard_axis_size=2, replica_axis_size=4)
+        k = MeshPhaseKernel(S, R, mesh, seed=seed)
+        alive = k.place(jnp.ones((S, R), bool))
+        idx = k.shard_index_array()
+        mesh_decisions = []
+        for t in range(T):
+            st = k.init_state(jnp.asarray(votes[t]))
+            st = st._replace(
+                slot=k.place(jnp.full((S, R), t, jnp.int32))
+            )
+            for _ in range(16):
+                st = k.phase_step(st, alive, idx)
+            dec = np.asarray(st.decided)
+            assert np.all(dec == dec[:, :1])
+            mesh_decisions.append(dec[:, 0])
+        np.testing.assert_array_equal(
+            np.asarray(d_plain), np.stack(mesh_decisions)
+        )
+
+    def test_minority_crash_still_decides(self, devices):
+        S, R = 8, 4
+        mesh = make_mesh(shard_axis_size=2, replica_axis_size=4)
+        k = MeshPhaseKernel(S, R, mesh, seed=1)
+        st = k.init_state(jnp.full((S, R), V1, jnp.int8))
+        alive_np = np.ones((S, R), bool)
+        alive_np[:, 0] = False  # 1 of 4 crashed (f = 1)
+        alive = k.place(jnp.asarray(alive_np))
+        idx = k.shard_index_array()
+        for _ in range(8):
+            st = k.phase_step(st, alive, idx)
+        dec = np.asarray(st.decided)
+        assert np.all(dec[:, 1:] == V1)
