@@ -227,39 +227,64 @@ class _Subscription:
     """One subscriber's unbounded queue + filter (notifications.rs:279-314
     NotificationListener analog). Iterate with ``async for`` or ``get()``."""
 
+    _CLOSED = object()  # sentinel: wakes consumers blocked in queue.get()
+
     def __init__(self, bus: "NotificationBus", flt: NotificationFilter, maxsize: int) -> None:
         import asyncio
 
         self.bus = bus
         self.filter = flt
-        self.queue: "asyncio.Queue[ChangeNotification]" = asyncio.Queue(maxsize)
+        self.queue: "asyncio.Queue" = asyncio.Queue(maxsize)
         self.closed = False
 
     async def get(self, timeout: Optional[float] = None):
         import asyncio
 
-        if timeout is None:
-            return await self.queue.get()
-        return await asyncio.wait_for(self.queue.get(), timeout)
+        item = (
+            await self.queue.get()
+            if timeout is None
+            else await asyncio.wait_for(self.queue.get(), timeout)
+        )
+        if item is self._CLOSED:
+            self.queue.put_nowait(self._CLOSED)  # re-arm for other waiters
+            raise StopAsyncIteration
+        return item
 
     def get_nowait(self) -> Optional[ChangeNotification]:
         import asyncio
 
         try:
-            return self.queue.get_nowait()
+            item = self.queue.get_nowait()
         except asyncio.QueueEmpty:
             return None
+        if item is self._CLOSED:
+            self.queue.put_nowait(self._CLOSED)
+            return None
+        return item
 
     def close(self) -> None:
+        """Mark closed and wake any consumer parked in get()/async-for."""
+        import asyncio
+
         self.closed = True
+        try:
+            self.queue.put_nowait(self._CLOSED)
+        except asyncio.QueueFull:
+            # full queue: the consumer has items to drain and will see the
+            # sentinel after them; make room for it deterministically
+            try:
+                self.queue.get_nowait()
+            except asyncio.QueueEmpty:  # pragma: no cover - racy edge
+                pass
+            self.queue.put_nowait(self._CLOSED)
 
     def __aiter__(self):
         return self
 
     async def __anext__(self) -> ChangeNotification:
-        if self.closed:
+        if self.closed and self.queue.empty():
             raise StopAsyncIteration
-        return await self.queue.get()
+        return await self.get()
 
 
 class NotificationBus:
@@ -546,11 +571,8 @@ class KVStoreSMR(TypedStateMachine[KVOperation, KVResult, dict]):
 
     def apply_command(self, command: KVOperation) -> KVResult:
         self._bump_version()
-        try:
-            res = self.store.apply_operations([command])[0]
-        except StoreError as e:
-            return KVResult.err(str(e))
-        return res
+        # apply_operations already folds StoreError into KVResult.err
+        return self.store.apply_operations([command])[0]
 
     def get_state(self) -> dict:
         return {k: e.value for k, e in self.store._data.items()}

@@ -162,6 +162,9 @@ class RabiaConfig:
 
     phase_timeout: float = 5.0
     sync_timeout: float = 10.0
+    # committed-slot lag vs the most advanced peer that triggers a snapshot
+    # sync (a shard mid-decision naturally lags ~1; 3 = genuinely behind)
+    sync_lag_slots: int = 3
     max_batch_size: int = 1000
     max_pending_batches: int = 100
     cleanup_interval: float = 30.0

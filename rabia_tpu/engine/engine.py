@@ -407,6 +407,8 @@ class RabiaEngine:
             if sub.forwarded_at and now - sub.forwarded_at < self.config.phase_timeout:
                 continue
             sub.forwarded_at = now
+            if not sub.first_forwarded_at:
+                sub.first_forwarded_at = now
             target = self._row_to_node[target_row]
             self._send(
                 NewBatch(shard=s, batch=sub.batch), recipient=target
@@ -444,10 +446,7 @@ class RabiaEngine:
             # (duplicate-forwarding race): settle it from the dedup ledger
             while sh.queue and sh.queue[0].batch.id in sh.applied_results:
                 done_sub = sh.queue.popleft()
-                if done_sub.future is not None and not done_sub.future.done():
-                    done_sub.future.set_result(
-                        sh.applied_results[done_sub.batch.id]
-                    )
+                self._settle_from_ledger(sh, done_sub)
             if proposer_row == self.me and sh.queue:
                 sub = sh.queue[0]
                 sh.payloads[sub.batch.id] = sub.batch
@@ -473,11 +472,21 @@ class RabiaEngine:
                         sh.opened_at = now  # start the grace clock
                     elif now - sh.opened_at > grace:
                         opened.append((s, slot, V0))
-                elif sh.queue and sh.queue[0].forwarded_at and (
-                    now - sh.queue[0].forwarded_at > self.config.phase_timeout
+                elif sh.queue and (
+                    (
+                        sh.queue[0].first_forwarded_at
+                        and now - sh.queue[0].first_forwarded_at
+                        > self.config.phase_timeout
+                    )
+                    or self._row_to_node[proposer_row] not in (
+                        self.rt.active_nodes | {self.node_id}
+                    )
                 ):
-                    # forwarded proposer unresponsive: force a null slot to
-                    # rotate the proposer (leaderless liveness)
+                    # forwarded proposer unresponsive (or known-dead): force
+                    # a null slot to rotate the proposer (leaderless
+                    # liveness). first_forwarded_at, not forwarded_at — the
+                    # periodic re-forward refreshes the latter, which must
+                    # not reset the give-up clock.
                     opened.append((s, slot, V0))
         for s, slot, _v in opened:
             sh = self.rt.shards[s]
@@ -629,6 +638,11 @@ class RabiaEngine:
             sh.in_flight = False
         sh.next_slot = max(sh.next_slot, slot + 1)
         sh.opened_at = 0.0
+        # the next slot has a new proposer: restart the forward/give-up
+        # clocks for whatever is still queued here
+        for sub in sh.queue:
+            sub.forwarded_at = 0.0
+            sub.first_forwarded_at = 0.0
         sh.gc_upto(sh.applied_upto)
 
     # -- decision application ------------------------------------------------
@@ -655,10 +669,11 @@ class RabiaEngine:
                     if rec.batch_id is not None and rec.batch_id in sh.applied_results:
                         # duplicate commit (same batch decided in an earlier
                         # slot): never apply twice; just settle the future
-                        if batch is not None:
-                            self._resolve_local(
-                                sh, batch, sh.applied_results[rec.batch_id]
-                            )
+                        for i, sub in enumerate(list(sh.queue)):
+                            if sub.batch.id == rec.batch_id:
+                                del sh.queue[i]
+                                self._settle_from_ledger(sh, sub)
+                                break
                     elif batch is None:
                         # decided V1 but never saw the payload: snapshot sync
                         # is the recovery path (engine.rs:748-844, §3.3)
@@ -675,7 +690,30 @@ class RabiaEngine:
                 sh.applied_upto += 1
                 sh.gc_upto(sh.applied_upto)
                 applied += 1
+        if applied:
+            self.rt.last_apply_time = time.time()
         return applied
+
+    def _settle_from_ledger(self, sh, sub) -> None:
+        """Settle a submitter future for a batch the ledger says is applied.
+
+        Responses are None when the apply happened under a snapshot sync on
+        another node — the commit is real but the per-command responses
+        never existed here, so the future must FAIL with a distinct error
+        rather than resolve with an empty list (callers index responses
+        per command)."""
+        if sub.future is None or sub.future.done():
+            return
+        responses = sh.applied_results.get(sub.batch.id)
+        if responses is None:
+            sub.future.set_exception(
+                RabiaError(
+                    "batch committed (applied via snapshot sync); "
+                    "responses unavailable"
+                )
+            )
+        else:
+            sub.future.set_result(responses)
 
     def _resolve_local(self, sh, batch: CommandBatch, responses: list[bytes]) -> None:
         """Resolve the submitter future if this batch was queued locally."""
@@ -702,6 +740,7 @@ class RabiaEngine:
                     del sh.queue[i]
                 else:
                     sub.forwarded_at = 0.0
+                    sub.first_forwarded_at = 0.0
                 break
 
     # -- timeouts ------------------------------------------------------------
@@ -747,8 +786,12 @@ class RabiaEngine:
     # -- sync protocol (engine.rs:748-844) -----------------------------------
 
     async def _initiate_sync(self) -> None:
+        # retry window: a lost SyncRequest/Response must not gate recovery
+        # on the full sync_timeout — lossy networks are exactly when sync
+        # is needed most
+        retry_after = min(self.config.sync_timeout, 4 * self.config.phase_timeout)
         if self.rt.sync_started_at is not None and (
-            time.time() - self.rt.sync_started_at < self.config.sync_timeout
+            time.time() - self.rt.sync_started_at < retry_after
         ):
             return
         self.rt.sync_started_at = time.time()
@@ -829,10 +872,11 @@ class RabiaEngine:
                 sh.in_flight = False
                 sh.gc_upto(applied)
         # inherit the responder's dedup ledger: batches already applied via
-        # the snapshot must never re-apply here if they commit again later
+        # the snapshot must never re-apply here if they commit again later.
+        # None marks "responses unavailable" (see _settle_from_ledger).
         for s, bid in best[4]:
             if 0 <= s < self.n_shards:
-                self.rt.shards[s].applied_results.setdefault(bid, [])
+                self.rt.shards[s].applied_results.setdefault(bid, None)
         self.rt.sync_responses.clear()
         logger.info("%s sync: jumped to %d applied", self.node_id.short(), best[0])
 
@@ -849,10 +893,22 @@ class RabiaEngine:
                     committed_phase=total_applied,
                 )
             )
-            # lag detection: a peer quorum being far ahead triggers sync
+            # lag detection: a peer ahead while we make NO local progress
+            # triggers a snapshot sync — a straggler that missed Decisions
+            # (loss, healed partition) has no other path back
+            # (engine.rs:889-907 analog). The local-idle condition prevents
+            # snapshot storms under healthy multi-shard load, where
+            # aggregate committed counts skew by a few slots at any instant.
             if self._peer_progress:
                 best_peer = max(v[0] for v in self._peer_progress.values())
-                if best_peer > total_applied + self.config.max_phase_history:
+                locally_idle = (
+                    time.time() - self.rt.last_apply_time
+                    > 2 * self.config.phase_timeout
+                )
+                if (
+                    best_peer >= total_applied + self.config.sync_lag_slots
+                    or (best_peer > total_applied and locally_idle)
+                ) and locally_idle:
                     await self._initiate_sync()
         if now - self._last_monitor >= max(self.config.heartbeat_interval, 0.2):
             self._last_monitor = now

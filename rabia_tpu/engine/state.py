@@ -62,6 +62,7 @@ class PendingSubmission:
     attempts: int = 0
     submitted_at: float = field(default_factory=time.time)
     forwarded_at: float = 0.0  # last NewBatch forward to a remote proposer
+    first_forwarded_at: float = 0.0  # first forward for the CURRENT slot
 
 
 class ShardRuntime:
@@ -84,10 +85,11 @@ class ShardRuntime:
         # payloads keyed by batch id (immutable content per id), so a late
         # re-Propose can never swap the bytes a decided slot will apply
         self.payloads: dict[BatchId, CommandBatch] = {}
-        # batch ids already applied on this shard -> their responses; the
-        # apply path consults this so one batch can never execute twice even
-        # if it commits in two slots (duplicate forwarding race)
-        self.applied_results: dict[BatchId, list[bytes]] = {}
+        # batch ids already applied on this shard -> their responses (None =
+        # applied via snapshot sync, responses unavailable); the apply path
+        # consults this so one batch can never execute twice even if it
+        # commits in two slots (duplicate forwarding race)
+        self.applied_results: dict[BatchId, Optional[list[bytes]]] = {}
         self.decisions: dict[int, SlotRecord] = {}
         # vote buffers: (slot, phase) -> {sender_row: vote_code}
         self.buf_r1: dict[tuple[int, int], dict[int, int]] = {}
@@ -127,8 +129,9 @@ class EngineRuntime:
         self.decided_v1: int = 0
         self.decided_v0: int = 0
         # in-flight sync: responses collected by sender
-        self.sync_responses: dict[NodeId, tuple[int, int, Optional[bytes], tuple[int, ...]]] = {}
+        self.sync_responses: dict[NodeId, tuple] = {}
         self.sync_started_at: Optional[float] = None
+        self.last_apply_time: float = time.time()  # any shard's last apply
 
     def stats(self, node_id: NodeId) -> EngineStatistics:
         return EngineStatistics(

@@ -115,6 +115,7 @@ class NetworkSimulator:
         self._rng = random.Random(seed)
         self._queues: dict[NodeId, asyncio.Queue[tuple[NodeId, bytes]]] = {}
         self._crashed: set[NodeId] = set()
+        self._node_delay: dict[NodeId, float] = {}  # SlowNode fault support
         self._partition: set[NodeId] = set()
         self._partition_until: float = 0.0
         self._heap: list[_Pending] = []
@@ -147,6 +148,14 @@ class NetworkSimulator:
 
     def is_crashed(self, node: NodeId) -> bool:
         return node in self._crashed
+
+    def set_node_delay(self, node: NodeId, delay: float) -> None:
+        """SlowNode fault: extra delay on all of `node`'s traffic (the
+        reference stubs this — fault_injection.rs:267-288)."""
+        if delay <= 0:
+            self._node_delay.pop(node, None)
+        else:
+            self._node_delay[node] = delay
 
     def partition(self, group: set[NodeId], duration: Optional[float] = None) -> None:
         """Isolate `group` from the rest for `duration` seconds (None = until
@@ -197,6 +206,7 @@ class NetworkSimulator:
         delay = 0.0
         if c.latency_max > 0:
             delay = self._rng.uniform(c.latency_min, c.latency_max)
+        delay += self._node_delay.get(sender, 0.0) + self._node_delay.get(target, 0.0)
         if c.bandwidth_limit:
             delay += self._bandwidth_delay(len(data), c.bandwidth_limit)
 

@@ -1,0 +1,43 @@
+"""Test & benchmark harnesses: fault injection, scenarios, perf loads.
+
+The rebuild of the reference's rabia-testing crate (SURVEY.md §1.5).
+"""
+
+from rabia_tpu.testing.cluster import TestCluster, default_test_config
+from rabia_tpu.testing.fault_injection import (
+    ConsensusTestHarness,
+    ExpectedOutcome,
+    Fault,
+    FaultType,
+    ScenarioResult,
+    TestScenario,
+    canned_scenarios,
+    run_scenario,
+)
+from rabia_tpu.testing.scenarios import (
+    PerformanceBenchmark,
+    PerformanceReport,
+    PerformanceTest,
+    canned_performance_tests,
+    print_summary,
+    run_performance_test,
+)
+
+__all__ = [
+    "ConsensusTestHarness",
+    "TestCluster",
+    "default_test_config",
+    "ExpectedOutcome",
+    "Fault",
+    "FaultType",
+    "PerformanceBenchmark",
+    "PerformanceReport",
+    "PerformanceTest",
+    "ScenarioResult",
+    "TestScenario",
+    "canned_performance_tests",
+    "canned_scenarios",
+    "print_summary",
+    "run_performance_test",
+    "run_scenario",
+]

@@ -1,0 +1,156 @@
+"""Fault-injection scenario suite + perf harness smoke.
+
+Reference parity: rabia-testing/tests/integration_consensus.rs (scenario
+runs) and integration_simple.rs (fast smoke). The full canned scenario set
+runs here with real engines; AllCommitted must actually pass (SURVEY.md
+§4.4 strengthening).
+"""
+
+import pytest
+
+from rabia_tpu.testing import (
+    ExpectedOutcome,
+    Fault,
+    FaultType,
+    PerformanceTest,
+    TestScenario,
+    canned_scenarios,
+    run_performance_test,
+    run_scenario,
+)
+from rabia_tpu.net import NetworkConditions
+
+
+class TestScenarios:
+    @pytest.mark.asyncio
+    async def test_basic_consensus(self):
+        res = await run_scenario(
+            TestScenario(name="basic", node_count=3, initial_commands=5)
+        )
+        assert res.passed, res.detail
+
+    @pytest.mark.asyncio
+    async def test_single_crash_still_commits(self):
+        res = await run_scenario(
+            TestScenario(
+                name="crash1",
+                node_count=3,
+                initial_commands=5,
+                faults=(Fault(delay=0.2, fault=FaultType.NodeCrash, nodes=(2,)),),
+                timeout=30.0,
+            )
+        )
+        assert res.passed, res.detail
+
+    @pytest.mark.asyncio
+    async def test_packet_loss_30pct(self):
+        res = await run_scenario(
+            TestScenario(
+                name="loss30",
+                node_count=3,
+                initial_commands=5,
+                conditions=NetworkConditions.lossy(0.30),
+                timeout=40.0,
+            ),
+            seed=5,
+        )
+        assert res.passed, res.detail
+
+    @pytest.mark.asyncio
+    async def test_majority_crash_no_progress(self):
+        res = await run_scenario(
+            TestScenario(
+                name="majority_down",
+                node_count=3,
+                initial_commands=3,
+                faults=(
+                    Fault(delay=0.0, fault=FaultType.NodeCrash, nodes=(1, 2)),
+                ),
+                expected=ExpectedOutcome.NoProgress,
+                timeout=4.0,
+            )
+        )
+        assert res.passed, res.detail
+
+    @pytest.mark.asyncio
+    async def test_partition_minority_then_heal(self):
+        res = await run_scenario(
+            TestScenario(
+                name="partition_heal",
+                node_count=5,
+                initial_commands=5,
+                faults=(
+                    Fault(
+                        delay=0.2,
+                        fault=FaultType.NetworkPartition,
+                        nodes=(3, 4),
+                        duration=1.5,
+                    ),
+                ),
+                expected=ExpectedOutcome.EventualConsistency,
+                timeout=30.0,
+            )
+        )
+        assert res.passed, res.detail
+
+    @pytest.mark.asyncio
+    async def test_slow_node(self):
+        res = await run_scenario(
+            TestScenario(
+                name="slow",
+                node_count=3,
+                initial_commands=4,
+                faults=(
+                    Fault(delay=0.1, fault=FaultType.SlowNode, nodes=(2,), rate=0.03),
+                ),
+                timeout=30.0,
+            )
+        )
+        assert res.passed, res.detail
+
+    def test_canned_suite_shape(self):
+        scs = canned_scenarios()
+        assert len(scs) == 6
+        assert {s.name for s in scs} == {
+            "basic_consensus",
+            "single_node_crash",
+            "network_partition_5",
+            "packet_loss_30pct",
+            "high_latency",
+            "cascading_crashes_5",
+        }
+
+
+class TestPerformanceHarness:
+    @pytest.mark.asyncio
+    async def test_small_load_runs(self):
+        rep = await run_performance_test(
+            PerformanceTest(
+                name="ci_smoke",
+                node_count=3,
+                total_operations=30,
+                operations_per_second=200.0,
+                batch_size=5,
+                timeout=20.0,
+            )
+        )
+        assert rep.committed_batches == rep.submitted_batches == 6
+        assert rep.failed_batches == 0
+        assert rep.p50 > 0
+        assert rep.p99 >= rep.p50
+
+    @pytest.mark.asyncio
+    async def test_sharded_load(self):
+        rep = await run_performance_test(
+            PerformanceTest(
+                name="ci_sharded",
+                node_count=3,
+                total_operations=40,
+                operations_per_second=400.0,
+                batch_size=5,
+                num_shards=4,
+                timeout=20.0,
+            )
+        )
+        assert rep.committed_batches == rep.submitted_batches
+        assert rep.failed_batches == 0
