@@ -427,6 +427,7 @@ class RabiaEngine:
         grace = min(max(self.config.phase_timeout / 10.0, 0.02), 1.0)
         opened: list[tuple[int, int, int]] = []
         propose_entries: list[Propose] = []
+        alive_set = self.rt.active_nodes | {self.node_id}  # hoisted: hot loop
         for s in range(self.n_shards):
             sh = self.rt.shards[s]
             if sh.in_flight:
@@ -472,21 +473,22 @@ class RabiaEngine:
                         sh.opened_at = now  # start the grace clock
                     elif now - sh.opened_at > grace:
                         opened.append((s, slot, V0))
-                elif sh.queue and (
-                    (
-                        sh.queue[0].first_forwarded_at
-                        and now - sh.queue[0].first_forwarded_at
-                        > self.config.phase_timeout
-                    )
-                    or self._row_to_node[proposer_row] not in (
-                        self.rt.active_nodes | {self.node_id}
+                elif sh.queue and sh.queue[0].first_forwarded_at and (
+                    now - sh.queue[0].first_forwarded_at
+                    > (
+                        self.config.phase_timeout
+                        if self._row_to_node[proposer_row] in alive_set
+                        # known-dead proposer: short-circuit after one grace
+                        # period instead of a transient-heartbeat-gap
+                        # instant null slot
+                        else max(grace, self.config.phase_timeout / 4)
                     )
                 ):
-                    # forwarded proposer unresponsive (or known-dead): force
-                    # a null slot to rotate the proposer (leaderless
-                    # liveness). first_forwarded_at, not forwarded_at — the
-                    # periodic re-forward refreshes the latter, which must
-                    # not reset the give-up clock.
+                    # forwarded proposer unresponsive: force a null slot to
+                    # rotate the proposer (leaderless liveness).
+                    # first_forwarded_at, not forwarded_at — the periodic
+                    # re-forward refreshes the latter, which must not reset
+                    # the give-up clock.
                     opened.append((s, slot, V0))
         for s, slot, _v in opened:
             sh = self.rt.shards[s]
@@ -905,10 +907,15 @@ class RabiaEngine:
                     time.time() - self.rt.last_apply_time
                     > 2 * self.config.phase_timeout
                 )
-                if (
-                    best_peer >= total_applied + self.config.sync_lag_slots
-                    or (best_peer > total_applied and locally_idle)
-                ) and locally_idle:
+                # mild lag only matters when we're stuck (aggregate counts
+                # skew by a few slots under healthy multi-shard load);
+                # severe lag — sync_lag_slots scaled by the shard count —
+                # warrants a sync even while some shards still progress
+                mild = best_peer > total_applied and locally_idle
+                severe = best_peer >= total_applied + (
+                    self.config.sync_lag_slots * max(4, self.n_shards)
+                )
+                if mild or severe:
                     await self._initiate_sync()
         if now - self._last_monitor >= max(self.config.heartbeat_interval, 0.2):
             self._last_monitor = now

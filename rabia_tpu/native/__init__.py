@@ -1,0 +1,10 @@
+"""Native (C++) components: the TCP transport data plane.
+
+Built lazily with g++ into a shared library cached next to the source; no
+pip/pybind dependency — the Python side binds via ctypes
+(:mod:`rabia_tpu.net.tcp`).
+"""
+
+from rabia_tpu.native.build import lib_path, load_library
+
+__all__ = ["lib_path", "load_library"]

@@ -1,0 +1,112 @@
+"""Lazy g++ build + ctypes loader for the native transport library."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+from rabia_tpu.core.errors import InternalError
+
+_HERE = Path(__file__).parent
+_SRC = _HERE / "transport.cpp"
+_LOCK = threading.Lock()
+_CACHED: ctypes.CDLL | None = None
+
+
+def _src_digest() -> str:
+    return hashlib.blake2s(_SRC.read_bytes(), digest_size=8).hexdigest()
+
+
+def lib_path() -> Path:
+    """Target .so path, keyed by source digest so edits force rebuilds."""
+    return _HERE / f"_transport_{_src_digest()}.so"
+
+
+def _build(target: Path) -> None:
+    cmd = [
+        "g++",
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        str(_SRC),
+        "-o",
+        str(target),
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise InternalError(
+            f"native transport build failed:\n{proc.stderr[-2000:]}"
+        )
+    # clean up stale builds of older source versions
+    for old in _HERE.glob("_transport_*.so"):
+        if old != target:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (if needed) and dlopen the transport library; sets prototypes."""
+    global _CACHED
+    with _LOCK:
+        if _CACHED is not None:
+            return _CACHED
+        target = lib_path()
+        if not target.exists():
+            _build(target)
+        lib = ctypes.CDLL(os.fspath(target))
+
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.rt_create.restype = ctypes.c_void_p
+        lib.rt_create.argtypes = [
+            u8p,
+            ctypes.c_char_p,
+            ctypes.c_uint16,
+            ctypes.POINTER(ctypes.c_uint16),
+        ]
+        lib.rt_add_peer.restype = ctypes.c_int
+        lib.rt_add_peer.argtypes = [
+            ctypes.c_void_p,
+            u8p,
+            ctypes.c_char_p,
+            ctypes.c_uint16,
+        ]
+        lib.rt_remove_peer.restype = ctypes.c_int
+        lib.rt_remove_peer.argtypes = [ctypes.c_void_p, u8p]
+        lib.rt_send.restype = ctypes.c_int
+        lib.rt_send.argtypes = [
+            ctypes.c_void_p,
+            u8p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+        ]
+        lib.rt_broadcast.restype = ctypes.c_int
+        lib.rt_broadcast.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+        ]
+        lib.rt_recv.restype = ctypes.c_int
+        lib.rt_recv.argtypes = [
+            ctypes.c_void_p,
+            u8p,
+            u8p,
+            ctypes.c_uint32,
+            ctypes.c_int,
+        ]
+        lib.rt_connected.restype = ctypes.c_int
+        lib.rt_connected.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int]
+        lib.rt_port.restype = ctypes.c_uint16
+        lib.rt_port.argtypes = [ctypes.c_void_p]
+        lib.rt_close.restype = None
+        lib.rt_close.argtypes = [ctypes.c_void_p]
+
+        _CACHED = lib
+        return lib

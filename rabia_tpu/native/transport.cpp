@@ -1,0 +1,544 @@
+// TCP transport data plane: epoll loop, framing, handshake, reconnect.
+//
+// Native equivalent of the reference's TcpNetwork (rabia-engine/src/network/
+// tcp.rs, SURVEY.md C17), exposed to Python through a C API consumed via
+// ctypes (rabia_tpu/net/tcp.py). Wire compatibility points:
+//   - frames: u32 little-endian length prefix + payload, 16 MiB cap
+//     (tcp.rs:86,125);
+//   - handshake: each side sends its 16-byte node id immediately after
+//     connect; a connection is "established" once both ids crossed
+//     (tcp.rs:384-413,527-557);
+//   - dial with exponential backoff: 5 attempts, 100ms base, x2 growth,
+//     30s cap (tcp.rs:54-72), then periodic re-dial while the peer stays
+//     configured (keepalive scan, tcp.rs:661-684);
+//   - per-peer outbound queues; broadcast = enqueue to every established
+//     peer (tcp.rs:771-789).
+//
+// Threading model: ONE io thread owns all sockets and epoll; callers
+// enqueue sends under a mutex and kick an eventfd; inbound frames land in a
+// deque the Python side drains (blocking with timeout via condvar). No
+// Python/GIL involvement inside the io loop.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <array>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMaxFrame = 16u * 1024u * 1024u;  // 16 MiB (tcp.rs:86)
+constexpr int kMaxDialAttempts = 5;                  // tcp.rs:57
+constexpr double kDialBaseDelayS = 0.1;              // tcp.rs:58
+constexpr double kDialMaxDelayS = 30.0;              // tcp.rs:60
+constexpr double kRedialPeriodS = 10.0;              // keepalive scan period
+
+using Clock = std::chrono::steady_clock;
+
+double now_s() {
+  return std::chrono::duration<double>(Clock::now().time_since_epoch()).count();
+}
+
+using NodeIdBytes = std::array<uint8_t, 16>;
+
+struct InboundMsg {
+  NodeIdBytes sender;
+  std::vector<uint8_t> data;
+};
+
+struct Conn {
+  int fd = -1;
+  NodeIdBytes peer{};          // zero until handshake completes
+  bool handshaken_in = false;  // peer id received
+  bool handshake_sent = false;
+  bool outbound = false;       // we dialed (vs accepted)
+  NodeIdBytes dial_target{};   // peer we dialed (valid when outbound)
+  std::vector<uint8_t> rbuf;
+  std::deque<std::vector<uint8_t>> wqueue;  // framed bytes pending write
+  size_t woff = 0;  // offset into wqueue.front()
+};
+
+struct Peer {
+  std::string host;
+  uint16_t port = 0;
+  int attempts = 0;
+  double next_dial = 0.0;
+  bool connected = false;
+};
+
+struct Transport {
+  NodeIdBytes self_id{};
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1;
+  uint16_t port = 0;
+
+  std::thread io_thread;
+  std::atomic<bool> stopping{false};
+
+  std::mutex mu;  // guards everything below
+  std::map<int, Conn> conns;                 // fd -> connection
+  std::map<NodeIdBytes, int> established;    // peer id -> fd
+  std::map<NodeIdBytes, Peer> peers;         // configured dial targets
+  std::deque<InboundMsg> inbox;
+  std::condition_variable inbox_cv;
+  uint64_t dropped_frames = 0;
+
+  void io_loop();
+  void handle_readable(int fd);
+  void handle_writable(int fd);
+  void try_dials();
+  void dial(const NodeIdBytes& id, Peer& p);
+  void close_conn(int fd);
+  bool establish(int fd, Conn& c);  // false: conn was dropped (dup loser)
+  void enqueue_frame_locked(int fd, const uint8_t* data, uint32_t len);
+  void arm_write(int fd, bool on);
+};
+
+int set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  return fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void Transport::arm_write(int fd, bool on) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (on ? EPOLLOUT : 0);
+  ev.data.fd = fd;
+  epoll_ctl(epoll_fd, EPOLL_CTL_MOD, fd, &ev);
+}
+
+void Transport::close_conn(int fd) {
+  auto it = conns.find(fd);
+  if (it == conns.end()) return;
+  if (it->second.handshaken_in) {
+    auto est = established.find(it->second.peer);
+    if (est != established.end() && est->second == fd) {
+      established.erase(est);
+      auto p = peers.find(it->second.peer);
+      if (p != peers.end()) {
+        p->second.connected = false;
+        p->second.attempts = 0;
+        p->second.next_dial = now_s() + kDialBaseDelayS;
+      }
+    }
+  }
+  epoll_ctl(epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
+  ::close(fd);
+  conns.erase(it);
+}
+
+bool Transport::establish(int fd, Conn& c) {
+  auto old = established.find(c.peer);
+  if (old != established.end() && old->second != fd) {
+    // simultaneous-dial duplicate: BOTH sides must deterministically keep
+    // the same connection or they flap (each closing the one the other
+    // kept). Rule: the connection dialed by the smaller node id wins.
+    auto initiator = [&](const Conn& conn) -> const NodeIdBytes& {
+      return conn.outbound ? self_id : conn.peer;
+    };
+    int old_fd = old->second;
+    Conn& oldc = conns[old_fd];
+    bool new_wins = initiator(c) < initiator(oldc);
+    if (!new_wins) {
+      // keep the old one; quietly drop the newcomer
+      epoll_ctl(epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
+      ::close(fd);
+      conns.erase(fd);
+      return false;
+    }
+    established.erase(old);
+    epoll_ctl(epoll_fd, EPOLL_CTL_DEL, old_fd, nullptr);
+    ::close(old_fd);
+    conns.erase(old_fd);
+  }
+  established[c.peer] = fd;
+  auto p = peers.find(c.peer);
+  if (p != peers.end()) {
+    p->second.connected = true;
+    p->second.attempts = 0;
+  }
+  return true;
+}
+
+void Transport::handle_readable(int fd) {
+  auto it = conns.find(fd);
+  if (it == conns.end()) return;
+  Conn& c = it->second;
+  uint8_t buf[64 * 1024];
+  for (;;) {
+    ssize_t n = ::recv(fd, buf, sizeof(buf), 0);
+    if (n > 0) {
+      c.rbuf.insert(c.rbuf.end(), buf, buf + n);
+    } else if (n == 0) {
+      close_conn(fd);
+      return;
+    } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      break;
+    } else {
+      close_conn(fd);
+      return;
+    }
+  }
+  size_t off = 0;
+  // handshake: first 16 bytes are the peer's node id
+  if (!c.handshaken_in) {
+    if (c.rbuf.size() < 16) return;
+    memcpy(c.peer.data(), c.rbuf.data(), 16);
+    c.handshaken_in = true;
+    off = 16;
+    if (!establish(fd, c)) return;  // dup loser: conn object is gone
+  }
+  while (c.rbuf.size() - off >= 4) {
+    uint32_t len = static_cast<uint32_t>(c.rbuf[off]) |
+                   (static_cast<uint32_t>(c.rbuf[off + 1]) << 8) |
+                   (static_cast<uint32_t>(c.rbuf[off + 2]) << 16) |
+                   (static_cast<uint32_t>(c.rbuf[off + 3]) << 24);
+    if (len > kMaxFrame) {  // poisoned stream: drop the connection
+      close_conn(fd);
+      return;
+    }
+    if (c.rbuf.size() - off - 4 < len) break;
+    InboundMsg m;
+    m.sender = c.peer;
+    m.data.assign(c.rbuf.begin() + off + 4, c.rbuf.begin() + off + 4 + len);
+    inbox.push_back(std::move(m));
+    off += 4 + len;
+  }
+  if (off) c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + off);
+  if (!inbox.empty()) inbox_cv.notify_all();
+}
+
+void Transport::handle_writable(int fd) {
+  auto it = conns.find(fd);
+  if (it == conns.end()) return;
+  Conn& c = it->second;
+  while (!c.wqueue.empty()) {
+    auto& front = c.wqueue.front();
+    ssize_t n = ::send(fd, front.data() + c.woff, front.size() - c.woff,
+                       MSG_NOSIGNAL);
+    if (n > 0) {
+      c.woff += static_cast<size_t>(n);
+      if (c.woff == front.size()) {
+        c.wqueue.pop_front();
+        c.woff = 0;
+      }
+    } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      return;  // stay EPOLLOUT-armed
+    } else {
+      close_conn(fd);
+      return;
+    }
+  }
+  arm_write(fd, false);
+}
+
+void Transport::enqueue_frame_locked(int fd, const uint8_t* data,
+                                     uint32_t len) {
+  auto it = conns.find(fd);
+  if (it == conns.end()) return;
+  std::vector<uint8_t> frame(4 + len);
+  frame[0] = len & 0xFF;
+  frame[1] = (len >> 8) & 0xFF;
+  frame[2] = (len >> 16) & 0xFF;
+  frame[3] = (len >> 24) & 0xFF;
+  memcpy(frame.data() + 4, data, len);
+  it->second.wqueue.push_back(std::move(frame));
+  arm_write(fd, true);
+}
+
+void Transport::dial(const NodeIdBytes& id, Peer& p) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return;
+  set_nonblock(fd);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(p.port);
+  if (inet_pton(AF_INET, p.host.c_str(), &addr.sin_addr) != 1) {
+    ::close(fd);
+    return;
+  }
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (rc < 0 && errno != EINPROGRESS) {
+    ::close(fd);
+    p.attempts++;
+    double delay = kDialBaseDelayS;
+    for (int i = 0; i < p.attempts; i++) delay *= 2.0;
+    if (delay > kDialMaxDelayS) delay = kDialMaxDelayS;
+    p.next_dial = now_s() + delay;
+    return;
+  }
+  Conn c;
+  c.fd = fd;
+  c.outbound = true;
+  c.dial_target = id;
+  // send our id immediately (kernel buffers it through connect completion)
+  std::vector<uint8_t> hello(self_id.begin(), self_id.end());
+  c.wqueue.push_back(std::move(hello));
+  c.handshake_sent = true;
+  conns[fd] = std::move(c);
+  epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLOUT;
+  ev.data.fd = fd;
+  epoll_ctl(epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+}
+
+void Transport::try_dials() {
+  double t = now_s();
+  for (auto& [id, p] : peers) {
+    if (p.connected) continue;
+    bool mid_dial = false;  // an in-flight outbound conn to this peer?
+    for (auto& [fd, c] : conns) {
+      if (c.outbound && !c.handshaken_in && c.dial_target == id) {
+        mid_dial = true;
+        break;
+      }
+    }
+    if (mid_dial) continue;
+    // after the initial backoff budget, keep re-dialing slowly forever
+    if (p.attempts >= kMaxDialAttempts) {
+      if (t >= p.next_dial) {
+        p.attempts = 0;
+        p.next_dial = t + kRedialPeriodS;
+        dial(id, p);
+      }
+      continue;
+    }
+    if (t >= p.next_dial) {
+      p.attempts++;
+      double delay = kDialBaseDelayS;
+      for (int i = 1; i < p.attempts; i++) delay *= 2.0;
+      if (delay > kDialMaxDelayS) delay = kDialMaxDelayS;
+      p.next_dial = t + delay;
+      dial(id, p);
+    }
+  }
+}
+
+void Transport::io_loop() {
+  epoll_event evs[64];
+  while (!stopping.load()) {
+    int n = epoll_wait(epoll_fd, evs, 64, 50);
+    std::unique_lock<std::mutex> lk(mu);
+    for (int i = 0; i < n; i++) {
+      int fd = evs[i].data.fd;
+      uint32_t e = evs[i].events;
+      if (fd == wake_fd) {
+        uint64_t junk;
+        while (::read(wake_fd, &junk, 8) == 8) {
+        }
+        continue;
+      }
+      if (fd == listen_fd) {
+        for (;;) {
+          int cfd = ::accept(listen_fd, nullptr, nullptr);
+          if (cfd < 0) break;
+          set_nonblock(cfd);
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          Conn c;
+          c.fd = cfd;
+          std::vector<uint8_t> hello(self_id.begin(), self_id.end());
+          c.wqueue.push_back(std::move(hello));
+          c.handshake_sent = true;
+          conns[cfd] = std::move(c);
+          epoll_event ev{};
+          ev.events = EPOLLIN | EPOLLOUT;
+          ev.data.fd = cfd;
+          epoll_ctl(epoll_fd, EPOLL_CTL_ADD, cfd, &ev);
+        }
+        continue;
+      }
+      if (e & (EPOLLHUP | EPOLLERR)) {
+        close_conn(fd);
+        continue;
+      }
+      if (e & EPOLLIN) handle_readable(fd);
+      if (e & EPOLLOUT) handle_writable(fd);
+    }
+    try_dials();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Creates + starts a transport. Writes the actually-bound port into
+// *actual_port (useful with port=0). Returns an opaque handle or null.
+void* rt_create(const uint8_t node_id[16], const char* bind_host,
+                uint16_t port, uint16_t* actual_port) {
+  auto* t = new Transport();
+  memcpy(t->self_id.data(), node_id, 16);
+
+  t->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (t->listen_fd < 0) {
+    delete t;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(t->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, bind_host, &addr.sin_addr) != 1) {
+    ::close(t->listen_fd);
+    delete t;
+    return nullptr;
+  }
+  if (::bind(t->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      ::listen(t->listen_fd, 128) < 0) {
+    ::close(t->listen_fd);
+    delete t;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(t->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  t->port = ntohs(addr.sin_port);
+  if (actual_port) *actual_port = t->port;
+  set_nonblock(t->listen_fd);
+
+  t->epoll_fd = epoll_create1(0);
+  t->wake_fd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = t->listen_fd;
+  epoll_ctl(t->epoll_fd, EPOLL_CTL_ADD, t->listen_fd, &ev);
+  ev.data.fd = t->wake_fd;
+  epoll_ctl(t->epoll_fd, EPOLL_CTL_ADD, t->wake_fd, &ev);
+
+  t->io_thread = std::thread([t] { t->io_loop(); });
+  return t;
+}
+
+int rt_add_peer(void* h, const uint8_t peer_id[16], const char* host,
+                uint16_t port) {
+  auto* t = static_cast<Transport*>(h);
+  NodeIdBytes id;
+  memcpy(id.data(), peer_id, 16);
+  {
+    std::lock_guard<std::mutex> lk(t->mu);
+    Peer p;
+    p.host = host;
+    p.port = port;
+    p.next_dial = 0.0;
+    t->peers[id] = std::move(p);
+  }
+  uint64_t one = 1;
+  (void)!::write(t->wake_fd, &one, 8);
+  return 0;
+}
+
+int rt_remove_peer(void* h, const uint8_t peer_id[16]) {
+  auto* t = static_cast<Transport*>(h);
+  NodeIdBytes id;
+  memcpy(id.data(), peer_id, 16);
+  std::lock_guard<std::mutex> lk(t->mu);
+  t->peers.erase(id);
+  auto est = t->established.find(id);
+  if (est != t->established.end()) t->close_conn(est->second);
+  return 0;
+}
+
+// 0 = queued; -1 = peer not connected.
+int rt_send(void* h, const uint8_t peer_id[16], const uint8_t* data,
+            uint32_t len) {
+  auto* t = static_cast<Transport*>(h);
+  if (len > kMaxFrame) return -2;
+  NodeIdBytes id;
+  memcpy(id.data(), peer_id, 16);
+  std::lock_guard<std::mutex> lk(t->mu);
+  auto est = t->established.find(id);
+  if (est == t->established.end()) return -1;
+  t->enqueue_frame_locked(est->second, data, len);
+  return 0;
+}
+
+// Returns number of peers the frame was queued to.
+int rt_broadcast(void* h, const uint8_t* data, uint32_t len) {
+  auto* t = static_cast<Transport*>(h);
+  if (len > kMaxFrame) return -2;
+  std::lock_guard<std::mutex> lk(t->mu);
+  int sent = 0;
+  for (auto& [id, fd] : t->established) {
+    t->enqueue_frame_locked(fd, data, len);
+    sent++;
+  }
+  return sent;
+}
+
+// Blocks up to timeout_ms for one inbound frame. Returns the frame length
+// (copied into buf, truncated to buf_cap), 0 on timeout, -1 if closed.
+int rt_recv(void* h, uint8_t sender_out[16], uint8_t* buf, uint32_t buf_cap,
+            int timeout_ms) {
+  auto* t = static_cast<Transport*>(h);
+  std::unique_lock<std::mutex> lk(t->mu);
+  if (t->inbox.empty() && timeout_ms != 0) {
+    t->inbox_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                         [t] { return !t->inbox.empty() || t->stopping.load(); });
+  }
+  if (t->inbox.empty()) return t->stopping.load() ? -1 : 0;
+  InboundMsg m = std::move(t->inbox.front());
+  t->inbox.pop_front();
+  memcpy(sender_out, m.sender.data(), 16);
+  uint32_t n = static_cast<uint32_t>(m.data.size());
+  if (n > buf_cap) n = buf_cap;
+  memcpy(buf, m.data.data(), n);
+  return static_cast<int>(n);
+}
+
+// Writes up to cap peer ids (16 bytes each) of established peers; returns
+// the count.
+int rt_connected(void* h, uint8_t* ids_out, int cap) {
+  auto* t = static_cast<Transport*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  int i = 0;
+  for (auto& [id, fd] : t->established) {
+    if (i >= cap) break;
+    memcpy(ids_out + 16 * i, id.data(), 16);
+    i++;
+  }
+  return i;
+}
+
+uint16_t rt_port(void* h) { return static_cast<Transport*>(h)->port; }
+
+void rt_close(void* h) {
+  auto* t = static_cast<Transport*>(h);
+  t->stopping.store(true);
+  {
+    std::lock_guard<std::mutex> lk(t->mu);
+    t->inbox_cv.notify_all();
+  }
+  uint64_t one = 1;
+  (void)!::write(t->wake_fd, &one, 8);
+  if (t->io_thread.joinable()) t->io_thread.join();
+  std::lock_guard<std::mutex> lk(t->mu);
+  for (auto& [fd, c] : t->conns) ::close(fd);
+  t->conns.clear();
+  ::close(t->listen_fd);
+  ::close(t->epoll_fd);
+  ::close(t->wake_fd);
+  delete t;
+}
+
+}  // extern "C"

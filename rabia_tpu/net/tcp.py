@@ -1,0 +1,165 @@
+"""TcpNetwork: the production transport over the C++ epoll data plane.
+
+Reference parity: rabia-engine/src/network/tcp.rs (C17) — but the
+framing/handshake/reconnect machinery lives in native code
+(rabia_tpu/native/transport.cpp) with zero Python in the io path; this
+module is the asyncio bridge implementing
+:class:`~rabia_tpu.core.network.NetworkTransport`:
+
+- a reader thread blocks in the native `rt_recv` and pushes frames into an
+  asyncio queue via ``call_soon_threadsafe`` (no busy polling, no GIL
+  contention in the hot loop);
+- sends/broadcasts enqueue into native per-peer buffers — the returned
+  awaitables complete immediately (the reference's unbounded outbound
+  queues, tcp.rs:559-643, behave the same way).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import threading
+from typing import Optional
+
+from rabia_tpu.core.config import TcpNetworkConfig
+from rabia_tpu.core.errors import NetworkError, TimeoutError_
+from rabia_tpu.core.network import NetworkTransport
+from rabia_tpu.core.types import NodeId
+from rabia_tpu.native import load_library
+
+_RECV_BUF_CAP = 16 * 1024 * 1024  # matches the native 16MiB frame cap
+
+
+def _id_bytes(node: NodeId) -> bytes:
+    return node.value.bytes
+
+
+class TcpNetwork(NetworkTransport):
+    """Async transport facade over the native epoll loop."""
+
+    def __init__(
+        self,
+        node_id: NodeId,
+        config: Optional[TcpNetworkConfig] = None,
+    ) -> None:
+        self.node_id = node_id
+        self.config = config or TcpNetworkConfig()
+        self._lib = load_library()
+        actual = ctypes.c_uint16(0)
+        self_id = (ctypes.c_uint8 * 16).from_buffer_copy(_id_bytes(node_id))
+        self._handle = self._lib.rt_create(
+            self_id,
+            self.config.bind_host.encode(),
+            self.config.bind_port,
+            ctypes.byref(actual),
+        )
+        if not self._handle:
+            raise NetworkError(
+                f"cannot bind {self.config.bind_host}:{self.config.bind_port}"
+            )
+        self.port: int = actual.value
+        self._queue: asyncio.Queue[tuple[NodeId, bytes]] = asyncio.Queue()
+        # must be the RUNNING loop: the reader thread posts into it with
+        # call_soon_threadsafe; a get_event_loop()-created orphan loop would
+        # swallow frames forever. Constructing outside async context is an
+        # error (RuntimeError), not a silent hang.
+        self._loop = asyncio.get_running_loop()
+        self._closed = False
+        self._recv_buf = (ctypes.c_uint8 * _RECV_BUF_CAP)()
+        self._sender_buf = (ctypes.c_uint8 * 16)()
+        self._reader = threading.Thread(target=self._reader_loop, daemon=True)
+        self._reader.start()
+
+    # -- peers --------------------------------------------------------------
+
+    def add_peer(self, peer: NodeId, host: str, port: int) -> None:
+        pid = (ctypes.c_uint8 * 16).from_buffer_copy(_id_bytes(peer))
+        self._lib.rt_add_peer(self._handle, pid, host.encode(), port)
+
+    def remove_peer(self, peer: NodeId) -> None:
+        pid = (ctypes.c_uint8 * 16).from_buffer_copy(_id_bytes(peer))
+        self._lib.rt_remove_peer(self._handle, pid)
+
+    # -- reader bridge ------------------------------------------------------
+
+    def _reader_loop(self) -> None:
+        import uuid
+
+        while not self._closed:
+            n = self._lib.rt_recv(
+                self._handle, self._sender_buf, self._recv_buf, _RECV_BUF_CAP, 100
+            )
+            if n < 0:
+                return  # transport closing
+            if n == 0:
+                continue
+            sender = NodeId(uuid.UUID(bytes=bytes(self._sender_buf)))
+            data = bytes(self._recv_buf[:n])
+            try:
+                self._loop.call_soon_threadsafe(
+                    self._queue.put_nowait, (sender, data)
+                )
+            except RuntimeError:
+                return  # loop closed
+
+    # -- NetworkTransport ---------------------------------------------------
+
+    async def send_to(self, target: NodeId, data: bytes) -> None:
+        pid = (ctypes.c_uint8 * 16).from_buffer_copy(_id_bytes(target))
+        rc = self._lib.rt_send(self._handle, pid, data, len(data))
+        if rc == -2:
+            raise NetworkError("frame exceeds 16MiB cap")
+        # rc == -1 (not connected) is a silent drop, like the reference's
+        # best-effort sends to disconnected peers
+
+    async def broadcast(self, data: bytes) -> None:
+        rc = self._lib.rt_broadcast(self._handle, data, len(data))
+        if rc == -2:
+            raise NetworkError("frame exceeds 16MiB cap")
+
+    async def receive(self, timeout: Optional[float] = None) -> tuple[NodeId, bytes]:
+        if timeout is None:
+            return await self._queue.get()
+        try:
+            return await asyncio.wait_for(self._queue.get(), timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError_("receive", timeout) from None
+
+    def receive_nowait(self) -> Optional[tuple[NodeId, bytes]]:
+        try:
+            return self._queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+
+    async def get_connected_nodes(self) -> set[NodeId]:
+        import uuid
+
+        cap = 1024
+        buf = (ctypes.c_uint8 * (16 * cap))()
+        n = self._lib.rt_connected(self._handle, buf, cap)
+        out = set()
+        for i in range(n):
+            out.add(NodeId(uuid.UUID(bytes=bytes(buf[16 * i : 16 * (i + 1)]))))
+        return out
+
+    async def disconnect(self, node: NodeId) -> None:
+        self.remove_peer(node)
+
+    async def reconnect(self) -> None:
+        # dialing is continuous in the native loop; nothing to kick
+        return
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        # order matters: stop the reader FIRST (it polls _closed every
+        # <=100ms inside rt_recv), and only then destroy the native handle —
+        # rt_close deletes the Transport, so a reader still inside rt_recv
+        # would be a use-after-free
+        self._closed = True
+        loop = asyncio.get_running_loop()
+        if self._reader.is_alive():
+            await loop.run_in_executor(None, self._reader.join, 2.0)
+        handle, self._handle = self._handle, None
+        if handle:
+            await loop.run_in_executor(None, self._lib.rt_close, handle)

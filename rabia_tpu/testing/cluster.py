@@ -12,6 +12,7 @@ import time
 from typing import Callable, Optional
 
 from rabia_tpu.core.config import RabiaConfig
+from rabia_tpu.core.errors import QuorumNotAvailableError
 from rabia_tpu.core.network import ClusterConfig
 from rabia_tpu.core.state_machine import InMemoryStateMachine, StateMachine
 from rabia_tpu.core.types import NodeId
@@ -64,6 +65,13 @@ class TestCluster:
             if all(s.has_quorum for s in stats):
                 return
             await asyncio.sleep(0.01)
+        # a non-quorate cluster produces misleading downstream failures
+        # ("0 committed") — fail loudly at the source instead
+        dead = [t for t in self.tasks if t.done()]
+        detail = f"; {len(dead)} engine task(s) died" if dead else ""
+        raise QuorumNotAvailableError(
+            f"cluster failed to reach quorum within {quorum_wait}s{detail}"
+        )
 
     async def stop(self) -> None:
         for e in self.engines:

@@ -135,6 +135,7 @@ class ConsensusTestHarness(TestCluster):
     async def run_scenario(self, sc: TestScenario) -> ScenarioResult:
         t0 = time.time()
         futures = []
+        submit_errors: list[str] = []
         # submit round-robin across nodes (:149-164)
         for i in range(sc.initial_commands):
             eng = self.engines[i % self.n]
@@ -143,8 +144,10 @@ class ConsensusTestHarness(TestCluster):
                     CommandBatch.new([f"SET key{i} value{i}"])
                 )
                 futures.append(fut)
-            except Exception:
-                pass
+            except Exception as e:  # expected under injected faults, but
+                # never silent: a broken submit path must show up in the
+                # scenario detail, not vanish
+                submit_errors.append(f"cmd{i}: {type(e).__name__}: {e}")
         # scheduled faults (:167-170)
         fault_tasks = [
             asyncio.ensure_future(self._delayed_inject(f)) for f in sc.faults
@@ -169,6 +172,8 @@ class ConsensusTestHarness(TestCluster):
             await asyncio.sleep(0.2)
         for ft in fault_tasks:
             ft.cancel()
+        if submit_errors:
+            result.detail += f"; submit errors: {submit_errors[:3]}"
         result.submitted = sc.initial_commands
         result.elapsed = time.time() - t0
         return result
@@ -208,13 +213,14 @@ class ConsensusTestHarness(TestCluster):
         elif sc.expected == ExpectedOutcome.NoProgress:
             ok = all(c == 0 for c in committed)
             detail = f"committed={committed}"
-        else:  # EventualConsistency (max-min bound, :346-350)
-            ok = (
+        else:  # EventualConsistency (max-min bound, :346-350) — with a
+            # progress floor: a cluster that committed NOTHING is trivially
+            # "consistent" but has not achieved the scenario's goal
+            ok = bool(live_committed) and (
                 max(live_committed) - min(live_committed) <= 2
-                if live_committed
-                else False
+                and max(applied_cmds) > 0
             )
-            detail = f"spread={live_committed}"
+            detail = f"spread={live_committed}, applied_cmds={applied_cmds}"
         return ScenarioResult(
             name=sc.name, passed=ok, detail=detail, committed_per_node=committed
         )
