@@ -1,0 +1,239 @@
+"""BASELINE config sweep: the 5 target configurations, one JSON line each.
+
+The configs (BASELINE.md):
+  1. counter_smr,  3 replicas,     1 shard,  in-memory transport
+  2. kvstore_smr,  3 replicas,    64 shards, in-memory transport
+  3. kvstore_smr,  5 replicas,  4096 shards, adaptive batching
+  4. banking_smr,  7 replicas,  1024 shards, minority crash injected
+  5. kvstore_smr,  5 replicas, 16384 shards, TCP transport, Zipf key load
+
+Configs 1 and 5 exercise the full host engine + transport stack (TCP for
+#5); configs 2-4 measure the device decision pipeline at the target shard
+widths (#4 with a crashed-minority alive mask — crash = masked rows,
+SURVEY.md §5.3). Each config prints one JSON line; the CPU-oracle baseline
+rate is measured once and reused for vs_baseline ratios.
+
+Backend note: configs 1 and 5 pace the kernel per consensus round from the
+host; over a TUNNELED accelerator (dispatch RTT in the ms) that is
+pathological, so when an engine-path config is selected the whole process
+is pinned to RABIA_SWEEP_BACKEND (default cpu) — jax.config, not env vars,
+because this image latches the platform early. Run {2,3,4} in a separate
+invocation to measure the device pipeline on the accelerator.
+
+Run: python benchmarks/baseline_sweep.py            (all configs)
+     python benchmarks/baseline_sweep.py 2 3 4      (device-only, accelerator)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def _emit(config: str, decisions_per_sec: float, baseline: float, extra: dict) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": "decisions_per_sec",
+                "config": config,
+                "value": round(decisions_per_sec, 1),
+                "unit": "decisions/s",
+                "vs_baseline": round(decisions_per_sec / baseline, 2),
+                **extra,
+            }
+        )
+    )
+
+
+def cpu_oracle_baseline(replicas: int = 5, sample: int = 120) -> float:
+    from rabia_tpu.core.oracle import WeakMVCOracle
+    from rabia_tpu.core.types import V1
+
+    t0 = time.perf_counter()
+    for _ in range(sample):
+        o = WeakMVCOracle(replicas, [V1] * replicas, coin=lambda p: V1)
+        for _ in range(64):
+            o.step()
+            if o.decided_value is not None:
+                break
+    return sample / (time.perf_counter() - t0)
+
+
+def pipeline_rate(S: int, R: int, T: int = 32, alive_mask=None) -> float:
+    import jax.numpy as jnp
+
+    from rabia_tpu.core.types import ABSENT, V1
+    from rabia_tpu.kernel import ClusterKernel
+
+    k = ClusterKernel(S, R)
+    votes = jnp.full((T, S, R), V1, jnp.int8)
+    alive = (
+        jnp.ones((S, R), bool) if alive_mask is None else jnp.asarray(alive_mask)
+    )
+    rounds = 2 if alive_mask is None else 4
+    d, _ = k.slot_pipeline(votes, alive, T, rounds_per_slot=rounds)
+    d.block_until_ready()
+    t0 = time.perf_counter()
+    d, _ = k.slot_pipeline(votes, alive, T, rounds_per_slot=rounds)
+    d.block_until_ready()
+    dt = time.perf_counter() - t0
+    arr = np.asarray(d)
+    assert np.all(arr != ABSENT), "undecided shards in pipeline"
+    return S * T / dt
+
+
+async def config1_counter_cluster(baseline: float) -> None:
+    """Full engine stack: counter, 3 replicas, 1 shard, in-memory hub."""
+    from rabia_tpu.apps import CounterCommand, CounterSMR
+    from rabia_tpu.core.network import ClusterConfig
+    from rabia_tpu.core.config import RabiaConfig
+    from rabia_tpu.core.smr import SMRBridge
+    from rabia_tpu.core.types import Command, CommandBatch, NodeId
+    from rabia_tpu.engine import RabiaEngine
+    from rabia_tpu.net import InMemoryHub
+
+    nodes = [NodeId.from_int(i + 1) for i in range(3)]
+    hub = InMemoryHub()
+    cfg = RabiaConfig(
+        phase_timeout=0.4, heartbeat_interval=0.05, round_interval=0.0005
+    ).with_kernel(num_shards=1, shard_pad_multiple=1)
+    counters, engines, tasks = [], [], []
+    for n in nodes:
+        c = CounterSMR()
+        counters.append(c)
+        engines.append(
+            RabiaEngine(ClusterConfig.new(n, nodes), SMRBridge(c), hub.register(n), config=cfg)
+        )
+        tasks.append(asyncio.ensure_future(engines[-1].run()))
+    for _ in range(300):
+        await asyncio.sleep(0.01)
+        sts = [await e.get_statistics() for e in engines]
+        if all(s.has_quorum for s in sts):
+            break
+    codec = counters[0]
+    n_ops = 60
+    t0 = time.perf_counter()
+    for i in range(n_ops):
+        fut = await engines[0].submit_batch(
+            CommandBatch.new([Command.new(codec.encode_command(CounterCommand.increment(1)))])
+        )
+        await asyncio.wait_for(fut, 20.0)
+    dt = time.perf_counter() - t0
+    assert counters[0].value == n_ops
+    for e in engines:
+        await e.shutdown()
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    _emit(
+        "1:counter_3rep_1shard_inmem",
+        n_ops / dt,
+        baseline,
+        {"p50_latency_ms": round(dt / n_ops * 1000, 2), "mode": "engine"},
+    )
+
+
+async def config5_kvstore_tcp_zipf(baseline: float) -> None:
+    """Full engine + native TCP + Zipf-skewed keys (scaled-down cluster run
+    + full-width device pipeline rate)."""
+    from rabia_tpu.apps import ShardedKVService, make_sharded_kv
+    from rabia_tpu.core.config import RabiaConfig, TcpNetworkConfig
+    from rabia_tpu.core.network import ClusterConfig
+    from rabia_tpu.core.types import NodeId
+    from rabia_tpu.engine import RabiaEngine
+    from rabia_tpu.net.tcp import TcpNetwork
+
+    n_shards = 64  # engine-path sample; device rate measured at 16384 below
+    ids = [NodeId.from_int(i + 1) for i in range(5)]
+    nets = [TcpNetwork(i, TcpNetworkConfig(bind_port=0)) for i in ids]
+    for i in range(5):
+        for j in range(5):
+            if i != j:
+                nets[i].add_peer(ids[j], "127.0.0.1", nets[j].port)
+    cfg = RabiaConfig(
+        phase_timeout=0.5, heartbeat_interval=0.05, round_interval=0.0005
+    ).with_kernel(num_shards=n_shards, shard_pad_multiple=n_shards)
+    sets, engines, tasks = [], [], []
+    for i, n in enumerate(ids):
+        sm, machines = make_sharded_kv(n_shards)
+        sets.append(machines)
+        engines.append(RabiaEngine(ClusterConfig.new(n, ids), sm, nets[i], config=cfg))
+        tasks.append(asyncio.ensure_future(engines[-1].run()))
+    for _ in range(300):
+        await asyncio.sleep(0.01)
+        sts = [await e.get_statistics() for e in engines]
+        if all(s.has_quorum for s in sts):
+            break
+    svc = ShardedKVService(n_shards, engines[0].submit_batch, sets[0])
+    rng = np.random.default_rng(0)
+    zipf_keys = [f"key{min(int(z), 9999)}" for z in rng.zipf(1.2, size=120)]
+    t0 = time.perf_counter()
+    results = await asyncio.gather(
+        *[svc.set(k, "v") for k in zipf_keys], return_exceptions=True
+    )
+    dt = time.perf_counter() - t0
+    ok = sum(1 for r in results if not isinstance(r, Exception) and r.ok)
+    for e in engines:
+        await e.shutdown()
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    for n in nets:
+        await n.close()
+    device_rate = pipeline_rate(16384, 5)
+    _emit(
+        "5:kvstore_5rep_16384shards_tcp_zipf",
+        device_rate,
+        baseline,
+        {
+            "engine_tcp_zipf_ops_per_sec": round(ok / dt, 1),
+            "engine_sample_shards": n_shards,
+            "mode": "engine+device",
+        },
+    )
+
+
+def main() -> int:
+    which = {int(a) for a in sys.argv[1:]} or {1, 2, 3, 4, 5}
+    if which & {1, 5}:
+        import os
+
+        import jax
+
+        backend = os.environ.get("RABIA_SWEEP_BACKEND", "cpu")
+        jax.config.update("jax_platforms", backend)
+    baseline = cpu_oracle_baseline()
+    if 1 in which:
+        asyncio.run(config1_counter_cluster(baseline))
+    if 2 in which:
+        _emit("2:kvstore_3rep_64shards_inmem", pipeline_rate(64, 3), baseline, {"mode": "device"})
+    if 3 in which:
+        _emit(
+            "3:kvstore_5rep_4096shards_adaptive",
+            pipeline_rate(4096, 5),
+            baseline,
+            {"mode": "device"},
+        )
+    if 4 in which:
+        alive = np.ones((1024, 7), bool)
+        alive[:, :3] = False  # minority crash: 3 of 7 masked (f = 3)
+        _emit(
+            "4:banking_7rep_1024shards_minority_crash",
+            pipeline_rate(1024, 7, alive_mask=alive),
+            baseline,
+            {"crashed_replicas": 3, "mode": "device"},
+        )
+    if 5 in which:
+        asyncio.run(config5_kvstore_tcp_zipf(baseline))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

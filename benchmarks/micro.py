@@ -1,0 +1,202 @@
+"""Micro-benchmark suites: serialization, batching, pipeline, kernel.
+
+Reference parity: the five criterion suites (SURVEY.md C31,
+benchmarks/benches/*.rs) — baseline_performance (JSON ser, batch
+creation/validation, id alloc), serialization_comparison (JSON vs binary,
+small/large), comprehensive_optimization (individual-JSON vs batched-binary
+pipeline), peak_performance (1000-cmd batch cycle, streaming batcher) —
+plus the TPU-native kernel_scaling sweep the reference has no analog for.
+
+Run: python -m benchmarks.micro  (or `python benchmarks/micro.py`)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from rabia_tpu.core.batching import CommandBatcher
+from rabia_tpu.core.config import BatchConfig
+from rabia_tpu.core.messages import (
+    Propose,
+    ProtocolMessage,
+    VoteEntry,
+    VoteRound1,
+)
+from rabia_tpu.core.serialization import BinarySerializer, JsonSerializer
+from rabia_tpu.core.types import (
+    BatchId,
+    Command,
+    CommandBatch,
+    NodeId,
+    StateValue,
+)
+from rabia_tpu.core.validation import MessageValidator
+
+
+def _timeit(fn, n: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return n / (time.perf_counter() - t0)
+
+
+def bench_baseline_performance() -> dict:
+    """baseline_performance.rs:4-68: ids, batch creation, validation, JSON."""
+    node = NodeId.from_int(1)
+    validator = MessageValidator()
+    cmds = [Command.new(f"SET key{i} value{i}") for i in range(100)]
+    batch = CommandBatch.new([c.data for c in cmds])
+    msg = ProtocolMessage.new(
+        node,
+        Propose(shard=0, phase=7, batch_id=batch.id, value=StateValue.V1, batch=batch),
+    )
+    return {
+        "id_alloc_per_sec": _timeit(BatchId.new, 20000),
+        "batch_create_100_per_sec": _timeit(
+            lambda: CommandBatch.new([c.data for c in cmds]), 500
+        ),
+        "batch_checksum_per_sec": _timeit(batch.checksum, 2000),
+        "validate_propose_per_sec": _timeit(
+            lambda: validator.validate_message(msg), 5000
+        ),
+    }
+
+
+def bench_serialization_comparison() -> dict:
+    """serialization_comparison.rs: JSON vs binary, small and large."""
+    node = NodeId.from_int(1)
+    small = ProtocolMessage.new(
+        node, VoteRound1(votes=(VoteEntry(0, 1, StateValue.V1),))
+    )
+    large = ProtocolMessage.new(
+        node,
+        VoteRound1(
+            votes=tuple(
+                VoteEntry(s, s * 3 + 1, StateValue.V1) for s in range(4096)
+            )
+        ),
+    )
+    out: dict = {}
+    for name, codec in (("binary", BinarySerializer()), ("json", JsonSerializer())):
+        for sz, msg in (("small", small), ("large", large)):
+            blob = codec.serialize(msg)
+            out[f"{name}_{sz}_bytes"] = len(blob)
+            out[f"{name}_{sz}_roundtrips_per_sec"] = _timeit(
+                lambda c=codec, m=msg: c.deserialize(c.serialize(m)),
+                2000 if sz == "small" else 50,
+            )
+    # the reference asserts binary strictly smaller (serialization.rs:259-276)
+    assert out["binary_small_bytes"] < out["json_small_bytes"]
+    assert out["binary_large_bytes"] < out["json_large_bytes"]
+    return out
+
+
+def bench_batching_pipeline() -> dict:
+    """comprehensive_optimization.rs: per-command JSON vs batched binary."""
+    node = NodeId.from_int(1)
+    binary = BinarySerializer()
+    jsonc = JsonSerializer()
+    cmds = [Command.new(f"SET key{i} v{i}") for i in range(100)]
+
+    def individual_json() -> None:
+        for c in cmds:
+            b = CommandBatch.new([c.data])
+            jsonc.serialize(
+                ProtocolMessage.new(
+                    node,
+                    Propose(0, 1, b.id, StateValue.V1, b),
+                )
+            )
+
+    def batched_binary() -> None:
+        b = CommandBatch.new([c.data for c in cmds])
+        binary.serialize(
+            ProtocolMessage.new(node, Propose(0, 1, b.id, StateValue.V1, b))
+        )
+
+    return {
+        "individual_json_batches_per_sec": _timeit(individual_json, 50),
+        "batched_binary_batches_per_sec": _timeit(batched_binary, 500),
+    }
+
+
+def bench_peak_performance() -> dict:
+    """peak_performance.rs: 1000-cmd batch cycle + streaming batcher."""
+    binary = BinarySerializer()
+    node = NodeId.from_int(1)
+
+    def thousand_cycle() -> None:
+        batch = CommandBatch.new([f"SET k{i} v" for i in range(1000)])
+        blob = binary.serialize(
+            ProtocolMessage.new(node, Propose(0, 1, batch.id, StateValue.V1, batch))
+        )
+        binary.deserialize(blob)
+
+    batcher = CommandBatcher(BatchConfig(max_batch_size=100, adaptive=True))
+
+    def streaming() -> None:
+        for i in range(500):
+            batcher.add(Command.new(b"SET x 1"))
+        batcher.flush()
+
+    return {
+        "cmd1000_cycle_per_sec": _timeit(thousand_cycle, 20),
+        "streaming_cmds_per_sec": _timeit(streaming, 20) * 500,
+    }
+
+
+def bench_kernel_scaling() -> dict:
+    """TPU-native: decisions/sec vs shard count (no reference analog)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rabia_tpu.core.types import V1
+    from rabia_tpu.kernel import ClusterKernel
+
+    out: dict = {}
+    T, R = 16, 5
+    for S in (64, 1024, 4096):
+        k = ClusterKernel(S, R)
+        votes = jnp.full((T, S, R), V1, jnp.int8)
+        alive = jnp.ones((S, R), bool)
+        d, _ = k.slot_pipeline(votes, alive, T)
+        d.block_until_ready()
+        t0 = time.perf_counter()
+        d, _ = k.slot_pipeline(votes, alive, T)
+        d.block_until_ready()
+        dt = time.perf_counter() - t0
+        assert np.all(np.asarray(d) == V1)
+        out[f"shards_{S}_decisions_per_sec"] = S * T / dt
+    return out
+
+
+SUITES = {
+    "baseline_performance": bench_baseline_performance,
+    "serialization_comparison": bench_serialization_comparison,
+    "batching_pipeline": bench_batching_pipeline,
+    "peak_performance": bench_peak_performance,
+    "kernel_scaling": bench_kernel_scaling,
+}
+
+
+def main() -> int:
+    results = {}
+    for name, fn in SUITES.items():
+        results[name] = {
+            k: (round(v, 1) if isinstance(v, float) else v)
+            for k, v in fn().items()
+        }
+        print(f"[{name}]")
+        for k, v in results[name].items():
+            print(f"  {k:40s} {v:>14,.1f}" if isinstance(v, float) else f"  {k:40s} {v:>14,}")
+    Path("benchmarks/results.json").write_text(json.dumps(results, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

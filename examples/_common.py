@@ -1,0 +1,73 @@
+"""Shared helpers for the example drivers.
+
+Parity target: the reference's examples/ crate drivers (SURVEY.md C30).
+All examples force the CPU backend by default so they run anywhere; set
+RABIA_EXAMPLE_BACKEND=tpu to use an accelerator.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+_backend = os.environ.get("RABIA_EXAMPLE_BACKEND", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", _backend)
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", _backend)
+except Exception:  # pragma: no cover - backend may already be initialized
+    pass
+
+import asyncio  # noqa: E402
+from typing import Optional  # noqa: E402
+
+from rabia_tpu.core.config import RabiaConfig  # noqa: E402
+from rabia_tpu.core.network import ClusterConfig  # noqa: E402
+from rabia_tpu.core.state_machine import StateMachine  # noqa: E402
+from rabia_tpu.core.types import NodeId  # noqa: E402
+from rabia_tpu.engine import RabiaEngine  # noqa: E402
+from rabia_tpu.net import InMemoryHub  # noqa: E402
+
+
+def example_config(num_shards: int = 1) -> RabiaConfig:
+    return RabiaConfig(
+        phase_timeout=0.4, heartbeat_interval=0.05, round_interval=0.002
+    ).with_kernel(num_shards=num_shards, shard_pad_multiple=max(1, num_shards))
+
+
+async def start_cluster(
+    sm_factory,
+    n_nodes: int = 3,
+    num_shards: int = 1,
+    config: Optional[RabiaConfig] = None,
+):
+    """Build an n-node in-memory cluster; returns (engines, sms, tasks)."""
+    nodes = [NodeId.from_int(i + 1) for i in range(n_nodes)]
+    hub = InMemoryHub()
+    cfg = config or example_config(num_shards)
+    engines, sms, tasks = [], [], []
+    for n in nodes:
+        sm: StateMachine = sm_factory()
+        eng = RabiaEngine(ClusterConfig.new(n, nodes), sm, hub.register(n), config=cfg)
+        engines.append(eng)
+        sms.append(sm)
+        tasks.append(asyncio.ensure_future(eng.run()))
+    for _ in range(300):
+        await asyncio.sleep(0.01)
+        stats = [await e.get_statistics() for e in engines]
+        if all(s.has_quorum for s in stats):
+            break
+    return engines, sms, tasks
+
+
+async def stop_cluster(engines, tasks) -> None:
+    for e in engines:
+        await e.shutdown()
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)

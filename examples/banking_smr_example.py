@@ -1,0 +1,47 @@
+"""Banking SMR walkthrough: validated transfers with conservation checks.
+
+Reference parity: examples/src/banking_smr_example.rs.
+Run: python examples/banking_smr_example.py
+"""
+
+import asyncio
+
+from _common import start_cluster, stop_cluster
+
+from rabia_tpu.apps import BankCommand, BankingSMR
+from rabia_tpu.core.smr import SMRBridge
+from rabia_tpu.core.types import Command, CommandBatch
+
+
+async def main() -> None:
+    banks: list[BankingSMR] = []
+
+    def factory():
+        b = BankingSMR()
+        banks.append(b)
+        return SMRBridge(b)
+
+    engines, _, tasks = await start_cluster(factory, n_nodes=3)
+    codec = banks[0]
+    print("3-node banking cluster up")
+
+    async def run(cmd: BankCommand):
+        batch = CommandBatch.new([Command.new(codec.encode_command(cmd))])
+        fut = await engines[0].submit_batch(batch)
+        responses = await asyncio.wait_for(fut, 15.0)
+        return codec.decode_response(responses[0])
+
+    print("create alice($100) ->", await run(BankCommand.create("alice", 100_00)))
+    print("create bob         ->", await run(BankCommand.create("bob")))
+    print("deposit bob $25    ->", await run(BankCommand.deposit("bob", 25_00)))
+    print("alice->bob $40     ->", await run(BankCommand.transfer("alice", "bob", 40_00)))
+    print("overdraw alice $99 ->", await run(BankCommand.withdraw("alice", 99_00)))
+
+    await asyncio.sleep(0.5)
+    totals = [b.total_value() for b in banks]
+    print("total value per replica:", totals, "(conserved:", len(set(totals)) == 1, ")")
+    await stop_cluster(engines, tasks)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,46 @@
+"""Counter SMR walkthrough: typed commands through consensus.
+
+Reference parity: examples/src/counter_smr_example.rs + basic_usage.rs
+(3-node setup). Run: python examples/counter_smr_example.py
+"""
+
+import asyncio
+
+from _common import start_cluster, stop_cluster
+
+from rabia_tpu.apps import CounterCommand, CounterSMR
+from rabia_tpu.core.smr import SMRBridge
+from rabia_tpu.core.types import Command, CommandBatch
+
+
+async def main() -> None:
+    counters: list[CounterSMR] = []
+
+    def factory():
+        c = CounterSMR()
+        counters.append(c)
+        return SMRBridge(c)
+
+    engines, _, tasks = await start_cluster(factory, n_nodes=3)
+    codec = counters[0]
+    print("3-node counter cluster up")
+
+    async def run(cmd: CounterCommand):
+        batch = CommandBatch.new([Command.new(codec.encode_command(cmd))])
+        fut = await engines[0].submit_batch(batch)
+        responses = await asyncio.wait_for(fut, 15.0)
+        return codec.decode_response(responses[0])
+
+    print("increment(5)  ->", await run(CounterCommand.increment(5)))
+    print("increment(37) ->", await run(CounterCommand.increment(37)))
+    print("decrement(2)  ->", await run(CounterCommand.decrement(2)))
+    print("get()         ->", await run(CounterCommand.get()))
+
+    await asyncio.sleep(0.5)
+    values = [c.value for c in counters]
+    print("replica values:", values, "(all equal:", len(set(values)) == 1, ")")
+    await stop_cluster(engines, tasks)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

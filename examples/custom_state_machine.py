@@ -1,0 +1,98 @@
+"""Build your own replicated state machine: a minimal typed TODO list.
+
+Reference parity: examples/src/custom_state_machine.rs — the app-developer
+path (docs/SMR_GUIDE.md walks through this). Run:
+python examples/custom_state_machine.py
+"""
+
+import asyncio
+import json
+
+from _common import start_cluster, stop_cluster
+
+from rabia_tpu.core.smr import SMRBridge, TypedStateMachine
+from rabia_tpu.core.types import Command, CommandBatch
+
+
+class TodoSMR(TypedStateMachine[dict, dict, list]):
+    """Commands: {"op": "add"|"done"|"list", "text": ...}. Deterministic:
+    ids are assigned from a replicated counter, never from wall clock."""
+
+    def __init__(self) -> None:
+        self.items: dict[int, dict] = {}
+        self.next_id = 1
+
+    def apply_command(self, command: dict) -> dict:
+        self._bump_version()
+        op = command.get("op")
+        if op == "add":
+            item_id = self.next_id
+            self.next_id += 1
+            self.items[item_id] = {"text": command.get("text", ""), "done": False}
+            return {"ok": True, "id": item_id}
+        if op == "done":
+            item = self.items.get(int(command.get("id", 0)))
+            if item is None:
+                return {"ok": False, "error": "no such item"}
+            item["done"] = True
+            return {"ok": True}
+        if op == "list":
+            return {"ok": True, "items": sorted(self.items)}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def get_state(self) -> list:
+        return [self.items, self.next_id]
+
+    def set_state(self, state: list) -> None:
+        self.items, self.next_id = dict(state[0]), int(state[1])
+
+    def encode_command(self, c: dict) -> bytes:
+        return json.dumps(c, separators=(",", ":")).encode()
+
+    def decode_command(self, b: bytes) -> dict:
+        return json.loads(b)
+
+    encode_response = encode_command
+    decode_response = decode_command
+
+    def serialize_state(self) -> bytes:
+        return json.dumps(
+            {"items": self.items, "next": self.next_id}, sort_keys=True
+        ).encode()
+
+    def deserialize_state(self, b: bytes) -> None:
+        doc = json.loads(b)
+        self.items = {int(k): v for k, v in doc["items"].items()}
+        self.next_id = doc["next"]
+
+
+async def main() -> None:
+    smrs: list[TodoSMR] = []
+
+    def factory():
+        t = TodoSMR()
+        smrs.append(t)
+        return SMRBridge(t)
+
+    engines, _, tasks = await start_cluster(factory, n_nodes=3)
+    codec = smrs[0]
+
+    async def run(cmd: dict) -> dict:
+        fut = await engines[0].submit_batch(
+            CommandBatch.new([Command.new(codec.encode_command(cmd))])
+        )
+        return codec.decode_response((await asyncio.wait_for(fut, 15.0))[0])
+
+    print("add ->", await run({"op": "add", "text": "replicate everything"}))
+    print("add ->", await run({"op": "add", "text": "decide fast"}))
+    print("done ->", await run({"op": "done", "id": 1}))
+    print("list ->", await run({"op": "list"}))
+
+    await asyncio.sleep(0.5)
+    states = [smr.serialize_state() for smr in smrs]
+    print("replicas identical:", len(set(states)) == 1)
+    await stop_cluster(engines, tasks)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,48 @@
+"""Sharded KV store through consensus: the flagship deployment shape.
+
+Reference parity: examples/src/kvstore_smr_example.rs — but sharded: every
+key-range shard is an independent consensus instance batched on device
+(SURVEY.md §5.7). Run: python examples/kvstore_smr_example.py
+"""
+
+import asyncio
+
+from _common import start_cluster, stop_cluster
+
+from rabia_tpu.apps import ShardedKVService, make_sharded_kv, shard_for_key
+
+N_SHARDS = 8
+
+
+async def main() -> None:
+    machine_sets = []
+
+    def factory():
+        sm, machines = make_sharded_kv(N_SHARDS)
+        machine_sets.append(machines)
+        return sm
+
+    engines, _, tasks = await start_cluster(factory, n_nodes=3, num_shards=N_SHARDS)
+    svc = ShardedKVService(N_SHARDS, engines[0].submit_batch, machine_sets[0])
+    print(f"3-node cluster, {N_SHARDS} consensus shards")
+
+    writes = await asyncio.gather(
+        *[svc.set(f"user:{i}", f"profile-{i}") for i in range(16)]
+    )
+    print("16 writes committed:", all(r.ok for r in writes))
+    print("user:7 lives on shard", shard_for_key("user:7", N_SHARDS))
+    print("read back:", (await svc.get("user:7")).value)
+    print("exists user:99:", await svc.exists("user:99"))
+
+    await asyncio.sleep(0.8)
+    converged = all(
+        ms[shard_for_key("user:3", N_SHARDS)].store.get("user:3").value
+        == "profile-3"
+        for ms in machine_sets
+    )
+    print("all replicas converged:", converged)
+    await stop_cluster(engines, tasks)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,47 @@
+"""KV store + notifications walkthrough (no consensus: the service layer).
+
+Reference parity: examples/src/kvstore_usage.rs (notifications tour).
+Run: python examples/kvstore_usage.py
+"""
+
+import asyncio
+
+import _common  # noqa: F401  (path + backend setup)
+
+from rabia_tpu.apps import ChangeType, KVStore, NotificationFilter
+
+
+async def main() -> None:
+    store = KVStore()
+    bus = store.notifications
+
+    user_sub = bus.subscribe(NotificationFilter.key_prefix("user:"))
+    delete_sub = bus.subscribe(NotificationFilter.change_type(ChangeType.Deleted))
+
+    store.set("user:1", "alice")
+    store.set("user:2", "bob")
+    store.set("system:boot", "done")
+    store.set("user:1", "alice-renamed")
+    store.delete("user:2")
+
+    print("keys:", store.keys())
+    print("user:* events:")
+    while (n := user_sub.get_nowait()) is not None:
+        print(f"  {n.change.value:8s} {n.key} {n.old_value!r} -> {n.new_value!r}")
+    print("delete events:")
+    while (n := delete_sub.get_nowait()) is not None:
+        print(f"  {n.change.value:8s} {n.key} (was {n.old_value!r})")
+
+    snap = store.snapshot_bytes()
+    restored = KVStore()
+    restored.restore_bytes(snap)
+    print(
+        "snapshot round-trip:",
+        restored.get("user:1").value,
+        "| checksums match:",
+        store.checksum() == restored.checksum(),
+    )
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

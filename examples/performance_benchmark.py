@@ -1,0 +1,83 @@
+"""Performance tour: micro-benches + a cluster load test + kernel pipeline.
+
+Reference parity: examples/src/performance_benchmark.rs (kvstore batching /
+serialization micro-bench) + the macro harness. Run:
+python examples/performance_benchmark.py
+"""
+
+import asyncio
+import time
+
+import _common  # noqa: F401
+
+from rabia_tpu.core.messages import ProtocolMessage, VoteEntry, VoteRound1
+from rabia_tpu.core.serialization import BinarySerializer, JsonSerializer
+from rabia_tpu.core.types import NodeId, StateValue
+from rabia_tpu.testing import PerformanceTest, run_performance_test
+
+
+def serialization_bench() -> None:
+    node = NodeId.from_int(1)
+    votes = tuple(
+        VoteEntry(shard=s, phase=s * 7, vote=StateValue.V1) for s in range(256)
+    )
+    msg = ProtocolMessage.new(node, VoteRound1(votes=votes))
+    for name, codec in (("binary", BinarySerializer()), ("json", JsonSerializer())):
+        blob = codec.serialize(msg)
+        t0 = time.perf_counter()
+        n = 2000
+        for _ in range(n):
+            codec.deserialize(codec.serialize(msg))
+        dt = time.perf_counter() - t0
+        print(
+            f"  {name:6s}: {len(blob):6d} B/msg, "
+            f"{n / dt:8.0f} round-trips/s"
+        )
+
+
+def kernel_pipeline_bench() -> None:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rabia_tpu.core.types import V1
+    from rabia_tpu.kernel import ClusterKernel
+
+    S, R, T = 1024, 5, 32
+    k = ClusterKernel(S, R)
+    votes = jnp.full((T, S, R), V1, jnp.int8)
+    alive = jnp.ones((S, R), bool)
+    decided, _ = k.slot_pipeline(votes, alive, T)  # compile
+    decided.block_until_ready()
+    t0 = time.perf_counter()
+    decided, _ = k.slot_pipeline(votes, alive, T)
+    decided.block_until_ready()
+    dt = time.perf_counter() - t0
+    assert np.all(np.asarray(decided) == V1)
+    print(f"  device pipeline: {S * T / dt:12.0f} decisions/s ({S} shards x {T} slots)")
+
+
+async def cluster_bench() -> None:
+    rep = await run_performance_test(
+        PerformanceTest(
+            name="example_load",
+            node_count=3,
+            total_operations=100,
+            operations_per_second=400.0,
+            batch_size=10,
+            timeout=30.0,
+        )
+    )
+    print(" ", rep.summary())
+
+
+async def main() -> None:
+    print("serialization round-trips (256-entry vote vector):")
+    serialization_bench()
+    print("batched consensus kernel:")
+    kernel_pipeline_bench()
+    print("3-node cluster under load:")
+    await cluster_bench()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -27,6 +27,11 @@ def lib_path() -> Path:
 
 
 def _build(target: Path) -> None:
+    # compile to a private temp path, then atomically rename: an
+    # interrupted or concurrent build (the lock is per-process only) must
+    # never leave a truncated .so at the digest-keyed path, which would be
+    # trusted forever by the exists() fast path
+    tmp = target.with_suffix(f".tmp{os.getpid()}")
     cmd = [
         "g++",
         "-O2",
@@ -36,13 +41,15 @@ def _build(target: Path) -> None:
         "-pthread",
         str(_SRC),
         "-o",
-        str(target),
+        str(tmp),
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
+        tmp.unlink(missing_ok=True)
         raise InternalError(
             f"native transport build failed:\n{proc.stderr[-2000:]}"
         )
+    os.replace(tmp, target)
     # clean up stale builds of older source versions
     for old in _HERE.glob("_transport_*.so"):
         if old != target:

@@ -278,12 +278,9 @@ void Transport::dial(const NodeIdBytes& id, Peer& p) {
   }
   int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
   if (rc < 0 && errno != EINPROGRESS) {
+    // try_dials already advanced attempts/next_dial for this cycle; an
+    // immediate refusal must not double-charge the backoff budget
     ::close(fd);
-    p.attempts++;
-    double delay = kDialBaseDelayS;
-    for (int i = 0; i < p.attempts; i++) delay *= 2.0;
-    if (delay > kDialMaxDelayS) delay = kDialMaxDelayS;
-    p.next_dial = now_s() + delay;
     return;
   }
   Conn c;
@@ -487,7 +484,8 @@ int rt_broadcast(void* h, const uint8_t* data, uint32_t len) {
 }
 
 // Blocks up to timeout_ms for one inbound frame. Returns the frame length
-// (copied into buf, truncated to buf_cap), 0 on timeout, -1 if closed.
+// >= 0 (copied into buf, truncated to buf_cap; 0 is a valid empty frame),
+// -3 on timeout with no message, -1 if closed.
 int rt_recv(void* h, uint8_t sender_out[16], uint8_t* buf, uint32_t buf_cap,
             int timeout_ms) {
   auto* t = static_cast<Transport*>(h);
@@ -496,7 +494,7 @@ int rt_recv(void* h, uint8_t sender_out[16], uint8_t* buf, uint32_t buf_cap,
     t->inbox_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
                          [t] { return !t->inbox.empty() || t->stopping.load(); });
   }
-  if (t->inbox.empty()) return t->stopping.load() ? -1 : 0;
+  if (t->inbox.empty()) return t->stopping.load() ? -1 : -3;
   InboundMsg m = std::move(t->inbox.front());
   t->inbox.pop_front();
   memcpy(sender_out, m.sender.data(), 16);

@@ -89,10 +89,10 @@ class TcpNetwork(NetworkTransport):
             n = self._lib.rt_recv(
                 self._handle, self._sender_buf, self._recv_buf, _RECV_BUF_CAP, 100
             )
+            if n == -3:
+                continue  # timeout tick; 0 is a valid empty frame
             if n < 0:
                 return  # transport closing
-            if n == 0:
-                continue
             sender = NodeId(uuid.UUID(bytes=bytes(self._sender_buf)))
             data = bytes(self._recv_buf[:n])
             try:

@@ -66,9 +66,12 @@ class TestCluster:
                 return
             await asyncio.sleep(0.01)
         # a non-quorate cluster produces misleading downstream failures
-        # ("0 committed") — fail loudly at the source instead
+        # ("0 committed") — fail loudly at the source, but tear down the
+        # engines we already spawned first (callers invoke start() outside
+        # their try/finally, so nothing else will)
         dead = [t for t in self.tasks if t.done()]
         detail = f"; {len(dead)} engine task(s) died" if dead else ""
+        await self.stop()
         raise QuorumNotAvailableError(
             f"cluster failed to reach quorum within {quorum_wait}s{detail}"
         )
