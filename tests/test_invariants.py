@@ -1,0 +1,202 @@
+"""Property tests of the weak-MVC Ivy invariants against the kernel.
+
+Reference parity: docs/weak_mvc.ivy:190+ — the inductive invariants behind
+Rabia's safety argument, checked here as executable properties on kernel
+traces (SURVEY.md §4.4, C32):
+
+- **agreement**: no two replicas decide different values for one instance;
+- **validity**: a unanimous initial vote v is the only decidable value;
+- **decision uniqueness/stability**: a shard's decision, once set, never
+  changes in any later round;
+- **round-2 coherence**: two non-? round-2 votes cast in the same phase
+  carry the same value (weak_mvc.ivy's core lemma — their round-1
+  majorities intersect);
+- **no progress without quorum**: fewer than a majority of live replicas
+  can never decide.
+
+Each property is exercised under adversarial schedules: random initial
+votes, Bernoulli delivery masks, crashed replicas, and static partitions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rabia_tpu.core.types import ABSENT, V0, V1, VQUESTION, quorum_size
+from rabia_tpu.kernel import ClusterKernel
+from rabia_tpu.kernel.phase_driver import R2_WAIT
+
+S, R = 12, 5  # shards x replicas for the stress grid
+
+
+def _trace(kernel, state, alive, key, p_deliver, n_rounds):
+    """Run round-by-round, yielding the state after each round."""
+    states = []
+    for i in range(n_rounds):
+        k = jax.random.fold_in(key, i)
+        base = jnp.ones((kernel.S, kernel.R, kernel.R), bool)
+        if p_deliver < 1.0:
+            base = base & jax.random.bernoulli(
+                k, p_deliver, (kernel.S, kernel.R, kernel.R)
+            )
+        state = kernel.round_step(state, alive, base)
+        states.append(state)
+    return states
+
+
+def _start(kernel, votes, active=None):
+    active = (
+        jnp.ones((kernel.S,), bool) if active is None else jnp.asarray(active)
+    )
+    return kernel.start_slot(kernel.init_state(), active, jnp.asarray(votes))
+
+
+@pytest.mark.parametrize("seed", range(6))
+class TestAgreementAndStability:
+    def test_decision_stable_and_agreed(self, seed):
+        rng = np.random.RandomState(seed)
+        kernel = ClusterKernel(S, R, seed=seed)
+        votes = rng.choice([V0, V1], size=(S, R)).astype(np.int8)
+        alive_np = np.ones((S, R), bool)
+        # crash a random minority per shard
+        for s in range(S):
+            k = rng.randint(0, quorum_size(R) - 1 + 1)  # 0..f
+            alive_np[s, rng.choice(R, size=k, replace=False)] = False
+        alive = jnp.asarray(alive_np)
+        st = _start(kernel, votes)
+        key = jax.random.key(seed + 1000)
+        first_decided = np.full(S, ABSENT, np.int8)
+        for snap in _trace(kernel, st, alive, key, 0.7, 60):
+            dec = np.asarray(snap.decided)
+            for s in range(S):
+                if first_decided[s] == ABSENT and dec[s] != ABSENT:
+                    first_decided[s] = dec[s]
+                elif first_decided[s] != ABSENT:
+                    # decision uniqueness/stability (ivy: decision is a
+                    # function, never rewritten)
+                    assert dec[s] == first_decided[s], (
+                        f"shard {s} decision changed "
+                        f"{first_decided[s]} -> {dec[s]}"
+                    )
+        # liveness under minority crash + 30% loss
+        assert np.all(first_decided != ABSENT)
+        assert np.all(np.isin(first_decided, (V0, V1)))
+
+
+@pytest.mark.parametrize("value", [V0, V1])
+@pytest.mark.parametrize("seed", range(3))
+class TestValidity:
+    def test_unanimous_value_is_only_outcome(self, value, seed):
+        """weak_mvc.ivy validity: if every live replica starts with v, the
+        only reachable decision is v — under loss AND minority crash."""
+        rng = np.random.RandomState(seed)
+        kernel = ClusterKernel(S, R, seed=seed)
+        votes = np.full((S, R), value, np.int8)
+        alive_np = np.ones((S, R), bool)
+        alive_np[:, rng.choice(R, size=(R - 1) // 2, replace=False)] = False
+        st = _start(kernel, votes)
+        states = _trace(
+            kernel, st, jnp.asarray(alive_np), jax.random.key(seed), 0.6, 80
+        )
+        dec = np.asarray(states[-1].decided)
+        assert np.all(dec == value)
+
+
+@pytest.mark.parametrize("seed", range(4))
+class TestRound2Coherence:
+    def test_same_phase_r2_votes_agree(self, seed):
+        """Two non-? round-2 votes in one (shard, phase) must carry the same
+        value (weak_mvc.ivy's majority-intersection lemma)."""
+        rng = np.random.RandomState(seed)
+        kernel = ClusterKernel(S, R, seed=seed)
+        votes = rng.choice([V0, V1], size=(S, R)).astype(np.int8)
+        alive = jnp.ones((S, R), bool)
+        st = _start(kernel, votes)
+        for snap in _trace(kernel, st, alive, jax.random.key(seed), 0.5, 50):
+            phase = np.asarray(snap.phase)
+            stage = np.asarray(snap.stage)
+            r2 = np.asarray(snap.my_r2)
+            for s in range(S):
+                cast = (stage[s] == R2_WAIT) & np.isin(r2[s], (V0, V1))
+                if cast.sum() < 2:
+                    continue
+                for ph in np.unique(phase[s][cast]):
+                    vals = r2[s][cast & (phase[s] == ph)]
+                    assert len(set(vals.tolist())) <= 1, (
+                        f"shard {s} phase {ph}: conflicting R2 votes {vals}"
+                    )
+
+
+class TestNoQuorumNoProgress:
+    @pytest.mark.parametrize("n_alive", [1, 2])
+    def test_sub_quorum_never_decides(self, n_alive):
+        """quorum_size(5) = 3: with <=2 live replicas nothing may ever
+        decide, no matter how many rounds run."""
+        kernel = ClusterKernel(S, R, seed=0)
+        votes = np.full((S, R), V1, np.int8)
+        alive_np = np.zeros((S, R), bool)
+        alive_np[:, :n_alive] = True
+        st = _start(kernel, votes)
+        states = _trace(
+            kernel, st, jnp.asarray(alive_np), jax.random.key(0), 1.0, 40
+        )
+        assert np.all(np.asarray(states[-1].decided) == ABSENT)
+
+    def test_exact_quorum_decides(self):
+        kernel = ClusterKernel(S, R, seed=0)
+        votes = np.full((S, R), V1, np.int8)
+        alive_np = np.zeros((S, R), bool)
+        alive_np[:, : quorum_size(R)] = True
+        st = _start(kernel, votes)
+        states = _trace(
+            kernel, st, jnp.asarray(alive_np), jax.random.key(0), 1.0, 10
+        )
+        assert np.all(np.asarray(states[-1].decided) == V1)
+
+
+class TestPartitionSafety:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_split_brain_impossible(self, seed):
+        """A static partition into {0,1} | {2,3,4}: the minority side must
+        never decide anything, and the majority's decisions must satisfy
+        agreement when the partition heals."""
+        rng = np.random.RandomState(seed)
+        kernel = ClusterKernel(S, R, seed=seed)
+        votes = rng.choice([V0, V1], size=(S, R)).astype(np.int8)
+        groups = np.array([0, 0, 1, 1, 1])
+        link_np = (groups[:, None] == groups[None, :])
+        link = jnp.broadcast_to(jnp.asarray(link_np), (S, R, R))
+        alive = jnp.ones((S, R), bool)
+        st = _start(kernel, votes)
+        # partitioned phase
+        for i in range(30):
+            st = kernel.round_step(st, alive, link)
+        decided_mid = np.asarray(st.decided)
+        done_mid = np.asarray(st.done)
+        # minority replicas (rows 0,1) can never have decided
+        assert not done_mid[:, :2].any()
+        # heal; run to completion
+        full = jnp.ones((S, R, R), bool)
+        for i in range(40):
+            st = kernel.round_step(st, alive, full)
+        dec = np.asarray(st.decided)
+        assert np.all(dec != ABSENT)
+        # decisions reached during the partition must survive the heal
+        healed_changed = (decided_mid != ABSENT) & (decided_mid != dec)
+        assert not healed_changed.any()
+
+
+class TestVQuestionNeverDecided:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_question_is_not_a_decision_value(self, seed):
+        """V? may be voted but never decided (ivy: decision(v) => v != vq)."""
+        rng = np.random.RandomState(seed)
+        kernel = ClusterKernel(S, R, seed=seed)
+        votes = rng.choice([V0, V1], size=(S, R)).astype(np.int8)
+        st = _start(kernel, votes)
+        for snap in _trace(
+            kernel, st, jnp.ones((S, R), bool), jax.random.key(seed), 0.8, 40
+        ):
+            dec = np.asarray(snap.decided)
+            assert not np.any(dec == VQUESTION)
