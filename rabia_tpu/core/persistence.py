@@ -74,7 +74,19 @@ class PersistedEngineState:
 
 
 class PersistenceLayer(abc.ABC):
-    """Single-blob durability trait (persistence.rs:49-68)."""
+    """Single-blob durability trait (persistence.rs:49-68).
+
+    Beyond the reference's single blob, backends may support small named
+    *aux* blobs via :meth:`save_aux` / :meth:`load_aux`. The engine uses one
+    ("vote_barrier") as a write-ahead record of the highest slot each shard
+    may have voted in, so a restarted replica can avoid equivocating —
+    casting a different vote in a (slot, phase) it already voted in before
+    the crash. Aux blobs are tiny (bytes of an int64[S] array) and written
+    far more often than the full snapshot, hence the separate channel. The
+    defaults are no-ops (load returns None): a backend that ignores them
+    degrades to the reference's behavior (no restart-equivocation guard),
+    it does not break.
+    """
 
     @abc.abstractmethod
     async def save_state(self, data: bytes) -> None:
@@ -83,6 +95,12 @@ class PersistenceLayer(abc.ABC):
     @abc.abstractmethod
     async def load_state(self) -> Optional[bytes]:
         ...
+
+    async def save_aux(self, key: str, data: bytes) -> None:
+        return None
+
+    async def load_aux(self, key: str) -> Optional[bytes]:
+        return None
 
     async def save_engine_state(self, state: PersistedEngineState) -> None:
         await self.save_state(state.to_bytes())

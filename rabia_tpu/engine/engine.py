@@ -131,6 +131,13 @@ class RabiaEngine:
         self._decided = np.full(self.S, ABSENT, np.int8)
         self._active = np.zeros(self.S, bool)
 
+        # write-ahead vote barrier: _barrier[s] is persisted BEFORE this
+        # replica's first vote in any slot >= the previous barrier, so a
+        # restart knows exactly which slots may hold its pre-crash votes
+        self._barrier = np.zeros(self.S, np.int64)
+        self._restored_at = 0.0
+        self._pending_proposes: list[Propose] = []
+
         self._row_to_node = {i: n for i, n in enumerate(cluster.all_nodes)}
         self._node_to_row = {n: i for i, n in enumerate(cluster.all_nodes)}
         self._seen_batches: set = set()  # dedup of forwarded batch ids
@@ -215,8 +222,45 @@ class RabiaEngine:
                     self.node_id.short(),
                     sum(sh.applied_upto for sh in self.rt.shards),
                 )
+        # unconditionally: a replica that voted but crashed before its first
+        # checkpoint has no main blob yet the barrier aux blob exists — that
+        # early-life window is the most likely crash window
+        await self._restore_vote_barrier()
         connected = await self.transport.get_connected_nodes()
         await self.update_nodes(connected | {self.node_id})
+
+    async def _restore_vote_barrier(self) -> None:
+        """Taint slots this replica may have voted in before the crash.
+
+        Re-running consensus in such a slot could cast a DIFFERENT vote in
+        the same (slot, phase) — equivocation that can violate agreement
+        when f other replicas are simultaneously down. Tainted slots rejoin
+        only via adopted peer Decisions or snapshot sync; if no vote traffic
+        for them is observed within the release window, nobody holds our
+        pre-crash votes and the taint lifts (see _open_slots).
+        """
+        self._restored_at = time.time()
+        if self.persistence is None or self.R <= 1:
+            return  # single replica: no peer can hold a conflicting view
+        raw = await self.persistence.load_aux("vote_barrier")
+        if raw is None:
+            return
+        barrier = np.frombuffer(raw, np.int64)
+        for s in range(min(len(barrier), self.n_shards)):
+            self._barrier[s] = barrier[s]
+            sh = self.rt.shards[s]
+            if barrier[s] > sh.applied_upto:
+                sh.tainted_upto = int(barrier[s])
+
+    @property
+    def _taint_release(self) -> float:
+        return 4 * self.config.phase_timeout
+
+    def _tainted_blocked(self) -> bool:
+        return any(
+            max(sh.next_slot, sh.applied_upto) < sh.tainted_upto
+            for sh in self.rt.shards[: self.n_shards]
+        )
 
     async def run(self) -> None:
         """Main loop (engine.rs:184-236): drain inbound, advance the kernel
@@ -329,6 +373,18 @@ class RabiaEngine:
         slot, _ = unpack_phase(p.phase)
         if slot < sh.applied_upto:
             return  # stale
+        if slot_proposer(p.shard, slot, self.R) != row:
+            # only the slot's rotation proposer may bind a batch to it;
+            # otherwise any replica's (e.g. a confused restarted peer's)
+            # Propose could bind divergent batch_ids to the same V1-decided
+            # slot across the cluster
+            logger.warning(
+                "dropping Propose for shard %d slot %d from non-proposer row %d",
+                p.shard,
+                slot,
+                row,
+            )
+            return
         rec = sh.decisions.get(slot)
         if rec is not None:
             if rec.batch_id is None:
@@ -354,6 +410,8 @@ class RabiaEngine:
             slot, mvc = unpack_phase(v.phase)
             if slot < sh.applied_upto:
                 continue
+            if slot < sh.tainted_upto:
+                sh.taint_traffic = True  # peers are deciding: keep waiting
             buf = sh.buf_r1 if round_no == 1 else sh.buf_r2
             buf.setdefault((slot, mvc), {}).setdefault(row, int(v.vote))
 
@@ -442,13 +500,32 @@ class RabiaEngine:
                 # without running consensus locally
                 self._record_decision(s, slot, bd[0], bd[1])
                 continue
+            if slot < sh.tainted_upto:
+                # restart-equivocation guard: this replica may have voted in
+                # this slot before crashing — never cast fresh votes. The
+                # slot resolves via an adopted peer Decision (above), via
+                # snapshot sync, or — when no vote traffic for tainted slots
+                # has been seen for the whole release window — the taint
+                # lifts (nobody out there holds our pre-crash votes).
+                if (
+                    not sh.taint_traffic
+                    and now - self._restored_at > self._taint_release
+                ):
+                    sh.tainted_upto = 0
+                continue
             proposer_row = slot_proposer(s, slot, self.R)
             # never propose a batch that already committed in another slot
             # (duplicate-forwarding race): settle it from the dedup ledger
-            while sh.queue and sh.queue[0].batch.id in sh.applied_results:
+            while sh.queue and sh.queue[0].batch.id in sh.applied_ids:
                 done_sub = sh.queue.popleft()
                 self._settle_from_ledger(sh, done_sub)
-            if proposer_row == self.me and sh.queue:
+            if slot in sh.buf_propose:
+                # an existing binding wins the slot — never rebind, even as
+                # the proposer: re-proposing a different batch for a slot
+                # that already carries one could bind divergent batch_ids
+                # across replicas (retransmits go through _check_timeouts)
+                opened.append((s, slot, V1))
+            elif proposer_row == self.me and sh.queue:
                 sub = sh.queue[0]
                 sh.payloads[sub.batch.id] = sub.batch
                 sh.buf_propose[slot] = (sub.batch.id, sub.batch)
@@ -461,8 +538,6 @@ class RabiaEngine:
                         batch=sub.batch,
                     )
                 )
-                opened.append((s, slot, V1))
-            elif slot in sh.buf_propose:
                 opened.append((s, slot, V1))
             else:
                 votes_seen = any(
@@ -496,8 +571,12 @@ class RabiaEngine:
             sh.next_slot = max(sh.next_slot, slot) + 0  # opened, +1 on decide
             sh.opened_at = now
             sh.last_progress = now
-        for pe in propose_entries:
-            self._send(pe)
+        # Proposes are NOT sent here: the vote barrier must be durable
+        # before any proposal for a newly opened slot reaches the wire —
+        # otherwise a crash-restart could rebind a different batch to a slot
+        # some peer already bound. _kernel_round flushes these right after
+        # the barrier save.
+        self._pending_proposes.extend(propose_entries)
         return opened
 
     # -- the kernel round ----------------------------------------------------
@@ -505,6 +584,12 @@ class RabiaEngine:
     async def _kernel_round(self, opened: list[tuple[int, int, int]]) -> None:
         import jax.numpy as jnp
 
+        if opened:
+            await self._advance_vote_barrier(opened)
+        if self._pending_proposes:
+            for pe in self._pending_proposes:
+                self._send(pe)
+            self._pending_proposes.clear()
         if opened:
             mask = np.zeros(self.S, bool)
             slots = np.zeros(self.S, np.int32)
@@ -534,6 +619,25 @@ class RabiaEngine:
         prev_stage = self._stage.copy()
         self._refresh_mirrors()
         self._process_outbox(outbox, prev_phase, prev_stage)
+
+    async def _advance_vote_barrier(
+        self, opened: list[tuple[int, int, int]]
+    ) -> None:
+        """Persist the vote barrier BEFORE the first vote of any newly
+        opened slot leaves this replica (write-ahead), so a post-crash
+        restore can taint exactly the slots that may hold our votes. One
+        tiny aux write covers every shard opened this tick."""
+        if self.persistence is None:
+            return
+        changed = False
+        for s, slot, _v in opened:
+            if slot >= self._barrier[s]:
+                self._barrier[s] = slot + 1
+                changed = True
+        if changed:
+            await self.persistence.save_aux(
+                "vote_barrier", self._barrier[: self.n_shards].tobytes()
+            )
 
     def _refresh_mirrors(self) -> None:
         st = self.kstate
@@ -668,7 +772,7 @@ class RabiaEngine:
                         if rec.batch_id is not None
                         else None
                     )
-                    if rec.batch_id is not None and rec.batch_id in sh.applied_results:
+                    if rec.batch_id is not None and rec.batch_id in sh.applied_ids:
                         # duplicate commit (same batch decided in an earlier
                         # slot): never apply twice; just settle the future
                         for i, sub in enumerate(list(sh.queue)):
@@ -683,6 +787,7 @@ class RabiaEngine:
                         break
                     else:
                         responses = self.sm.apply_batch(batch)
+                        sh.applied_ids[rec.batch_id] = None
                         sh.applied_results[rec.batch_id] = responses
                         self.rt.state_version += 1
                         self._resolve_local(sh, batch, responses)
@@ -813,7 +918,7 @@ class RabiaEngine:
         applied_ids = tuple(
             (s, bid)
             for s, sh in enumerate(self.rt.shards[: self.n_shards])
-            for bid in sh.applied_results
+            for bid in sh.applied_ids
         )
         self._send(
             SyncResponse(
@@ -875,10 +980,11 @@ class RabiaEngine:
                 sh.gc_upto(applied)
         # inherit the responder's dedup ledger: batches already applied via
         # the snapshot must never re-apply here if they commit again later.
-        # None marks "responses unavailable" (see _settle_from_ledger).
+        # (applied_results stays empty for them: "responses unavailable" in
+        # _settle_from_ledger.)
         for s, bid in best[4]:
             if 0 <= s < self.n_shards:
-                self.rt.shards[s].applied_results.setdefault(bid, None)
+                self.rt.shards[s].applied_ids.setdefault(bid, None)
         self.rt.sync_responses.clear()
         logger.info("%s sync: jumped to %d applied", self.node_id.short(), best[0])
 
@@ -917,6 +1023,10 @@ class RabiaEngine:
                 )
                 if mild or severe:
                     await self._initiate_sync()
+        if self._tainted_blocked():
+            # tainted slots can only resolve via peer Decisions or snapshot
+            # sync — keep asking (self-rate-limited by the retry window)
+            await self._initiate_sync()
         if now - self._last_monitor >= max(self.config.heartbeat_interval, 0.2):
             self._last_monitor = now
             connected = await self.transport.get_connected_nodes()
@@ -950,10 +1060,18 @@ class RabiaEngine:
             for bid in [b for b in sh.payloads if b not in live]:
                 del sh.payloads[bid]
             if len(sh.applied_results) > 2 * self.config.max_pending_batches:
+                # response CACHE only — evicting here can no longer
+                # re-enable a duplicate apply (dedup lives in applied_ids)
                 for bid in list(sh.applied_results)[
                     : len(sh.applied_results) - self.config.max_pending_batches
                 ]:
                     del sh.applied_results[bid]
+            # the dedup ledger is id-only (16B entries): keep a far deeper
+            # horizon, evicted FIFO only to bound truly long runs
+            id_cap = 64 * self.config.max_pending_batches
+            if len(sh.applied_ids) > id_cap:
+                for bid in list(sh.applied_ids)[: len(sh.applied_ids) - id_cap]:
+                    del sh.applied_ids[bid]
         # evict oldest seen-batch ids, never the whole dedup set at once
         cap = 10 * self.config.max_pending_batches
         while len(self._seen_order) > cap:

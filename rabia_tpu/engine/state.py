@@ -85,11 +85,24 @@ class ShardRuntime:
         # payloads keyed by batch id (immutable content per id), so a late
         # re-Propose can never swap the bytes a decided slot will apply
         self.payloads: dict[BatchId, CommandBatch] = {}
-        # batch ids already applied on this shard -> their responses (None =
-        # applied via snapshot sync, responses unavailable); the apply path
-        # consults this so one batch can never execute twice even if it
+        # dedup ledger: EVERY batch id ever applied on this shard (ordered
+        # set; evicted only beyond a deep horizon in engine._gc) — consulted
+        # by the apply path so one batch can never execute twice even if it
         # commits in two slots (duplicate forwarding race)
+        self.applied_ids: dict[BatchId, None] = {}
+        # bounded response cache for applied batches (None = applied via
+        # snapshot sync, responses unavailable); separate from the dedup
+        # ledger so evicting a cached response can never re-enable a
+        # duplicate apply
         self.applied_results: dict[BatchId, Optional[list[bytes]]] = {}
+        # restart-equivocation guard: slots < tainted_upto may have received
+        # votes from this replica before a crash; they must not be re-voted,
+        # only adopted via peer Decisions or snapshot sync (see engine
+        # _open_slots)
+        self.tainted_upto: int = 0
+        # any vote traffic observed for a tainted slot since restore —
+        # peers are actively deciding, so the taint must not time out
+        self.taint_traffic: bool = False
         self.decisions: dict[int, SlotRecord] = {}
         # vote buffers: (slot, phase) -> {sender_row: vote_code}
         self.buf_r1: dict[tuple[int, int], dict[int, int]] = {}
@@ -110,7 +123,7 @@ class ShardRuntime:
             for k in [k for k in d2 if k < slot]:
                 del d2[k]
         # payloads for already-applied batches are no longer needed
-        for bid in [b for b in self.payloads if b in self.applied_results]:
+        for bid in [b for b in self.payloads if b in self.applied_ids]:
             del self.payloads[bid]
 
     def pending_count(self) -> int:

@@ -112,6 +112,10 @@ def load_library() -> ctypes.CDLL:
         lib.rt_connected.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int]
         lib.rt_port.restype = ctypes.c_uint16
         lib.rt_port.argtypes = [ctypes.c_void_p]
+        lib.rt_dropped.restype = ctypes.c_uint64
+        lib.rt_dropped.argtypes = [ctypes.c_void_p]
+        lib.rt_stop.restype = None
+        lib.rt_stop.argtypes = [ctypes.c_void_p]
         lib.rt_close.restype = None
         lib.rt_close.argtypes = [ctypes.c_void_p]
 

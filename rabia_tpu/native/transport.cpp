@@ -45,6 +45,10 @@
 namespace {
 
 constexpr uint32_t kMaxFrame = 16u * 1024u * 1024u;  // 16 MiB (tcp.rs:86)
+// Inbox bound: a fast peer with a slow Python drain must not grow memory
+// without limit. Beyond the cap the OLDEST frame is dropped (consensus
+// retransmits supersede stale votes) and dropped_frames counts it.
+constexpr size_t kMaxInbox = 65536;
 constexpr int kMaxDialAttempts = 5;                  // tcp.rs:57
 constexpr double kDialBaseDelayS = 0.1;              // tcp.rs:58
 constexpr double kDialMaxDelayS = 30.0;              // tcp.rs:60
@@ -218,6 +222,10 @@ void Transport::handle_readable(int fd) {
     InboundMsg m;
     m.sender = c.peer;
     m.data.assign(c.rbuf.begin() + off + 4, c.rbuf.begin() + off + 4 + len);
+    if (inbox.size() >= kMaxInbox) {
+      inbox.pop_front();
+      dropped_frames++;
+    }
     inbox.push_back(std::move(m));
     off += 4 + len;
   }
@@ -519,6 +527,28 @@ int rt_connected(void* h, uint8_t* ids_out, int cap) {
 }
 
 uint16_t rt_port(void* h) { return static_cast<Transport*>(h)->port; }
+
+// Stop the io loop and unblock any rt_recv caller WITHOUT deleting the
+// transport. Used when the Python reader thread might still be inside
+// rt_recv: stop first, join the reader, then rt_close. Safe to call more
+// than once; rt_close after rt_stop is the normal teardown.
+void rt_stop(void* h) {
+  auto* t = static_cast<Transport*>(h);
+  t->stopping.store(true);
+  {
+    std::lock_guard<std::mutex> lk(t->mu);
+    t->inbox_cv.notify_all();
+  }
+  uint64_t one = 1;
+  (void)!::write(t->wake_fd, &one, 8);
+}
+
+// Total inbound frames dropped due to the bounded inbox (oldest-first).
+uint64_t rt_dropped(void* h) {
+  auto* t = static_cast<Transport*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  return t->dropped_frames;
+}
 
 void rt_close(void* h) {
   auto* t = static_cast<Transport*>(h);

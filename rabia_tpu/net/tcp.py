@@ -142,6 +142,14 @@ class TcpNetwork(NetworkTransport):
             out.add(NodeId(uuid.UUID(bytes=bytes(buf[16 * i : 16 * (i + 1)]))))
         return out
 
+    @property
+    def dropped_frames(self) -> int:
+        """Inbound frames dropped by the native bounded inbox (oldest-first
+        beyond 64Ki queued frames)."""
+        if not self._handle:
+            return 0
+        return int(self._lib.rt_dropped(self._handle))
+
     async def disconnect(self, node: NodeId) -> None:
         self.remove_peer(node)
 
@@ -158,8 +166,26 @@ class TcpNetwork(NetworkTransport):
         # would be a use-after-free
         self._closed = True
         loop = asyncio.get_running_loop()
+        # stop the native io loop first: this makes any in-flight rt_recv
+        # return immediately (-1), so the reader exits promptly
+        if self._handle:
+            self._lib.rt_stop(self._handle)
         if self._reader.is_alive():
             await loop.run_in_executor(None, self._reader.join, 2.0)
+        if self._reader.is_alive():
+            # the join timed out: the reader may still be inside rt_recv, so
+            # rt_close (which deletes the Transport) would be a use-after-
+            # free. The io loop is already stopped (no accepts/redials), so
+            # leak the inert handle — process teardown reclaims it — and
+            # say so.
+            import logging
+
+            logging.getLogger("rabia_tpu.net").warning(
+                "tcp close: reader thread still alive after join timeout; "
+                "leaking stopped native transport handle"
+            )
+            self._handle = None
+            return
         handle, self._handle = self._handle, None
         if handle:
             await loop.run_in_executor(None, self._lib.rt_close, handle)

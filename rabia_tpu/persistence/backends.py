@@ -24,8 +24,10 @@ class InMemoryPersistence(PersistenceLayer):
 
     def __init__(self) -> None:
         self._blob: Optional[bytes] = None
+        self._aux: dict[str, bytes] = {}
         self.saves = 0
         self.loads = 0
+        self.aux_saves = 0
 
     async def save_state(self, data: bytes) -> None:
         self._blob = bytes(data)
@@ -35,8 +37,16 @@ class InMemoryPersistence(PersistenceLayer):
         self.loads += 1
         return self._blob
 
+    async def save_aux(self, key: str, data: bytes) -> None:
+        self._aux[key] = bytes(data)
+        self.aux_saves += 1
+
+    async def load_aux(self, key: str) -> Optional[bytes]:
+        return self._aux.get(key)
+
     def clear(self) -> None:
         self._blob = None
+        self._aux.clear()
 
 
 class FileSystemPersistence(PersistenceLayer):
@@ -55,14 +65,16 @@ class FileSystemPersistence(PersistenceLayer):
             raise PersistenceError(f"cannot create state dir: {e}") from None
         self.path = self.dir / STATE_FILE
 
-    def _save_sync(self, data: bytes) -> None:
-        tmp = self.path.with_suffix(".tmp")
+    def _atomic_write(self, path: Path, data: bytes) -> None:
+        """tmp + fsync + rename + directory fsync: crash leaves either the
+        old or the new file, and the rename itself is durable."""
+        tmp = path.with_suffix(".tmp")
         try:
             with open(tmp, "wb") as f:
                 f.write(data)
                 f.flush()
                 os.fsync(f.fileno())
-            os.replace(tmp, self.path)
+            os.replace(tmp, path)
             dfd = os.open(self.dir, os.O_RDONLY)
             try:
                 os.fsync(dfd)
@@ -70,6 +82,9 @@ class FileSystemPersistence(PersistenceLayer):
                 os.close(dfd)
         except OSError as e:
             raise PersistenceError(f"save failed: {e}") from None
+
+    def _save_sync(self, data: bytes) -> None:
+        self._atomic_write(self.path, data)
 
     def _load_sync(self) -> Optional[bytes]:
         try:
@@ -84,6 +99,28 @@ class FileSystemPersistence(PersistenceLayer):
 
     async def load_state(self) -> Optional[bytes]:
         return await asyncio.get_event_loop().run_in_executor(None, self._load_sync)
+
+    # -- aux blobs (one file per key; same atomic discipline) ---------------
+
+    def _aux_path(self, key: str) -> Path:
+        safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in key)
+        return self.dir / f"aux_{safe}.dat"
+
+    async def save_aux(self, key: str, data: bytes) -> None:
+        await asyncio.get_event_loop().run_in_executor(
+            None, self._atomic_write, self._aux_path(key), data
+        )
+
+    async def load_aux(self, key: str) -> Optional[bytes]:
+        def _load() -> Optional[bytes]:
+            try:
+                return self._aux_path(key).read_bytes()
+            except FileNotFoundError:
+                return None
+            except OSError as e:
+                raise PersistenceError(f"aux load failed: {e}") from None
+
+        return await asyncio.get_event_loop().run_in_executor(None, _load)
 
     # sync wrappers (file_system.rs:80-94 "sync constructor" analog)
     def save_state_sync(self, data: bytes) -> None:

@@ -150,7 +150,15 @@ class TestSimulatedConditions:
             fut = await engines[1].submit_batch(
                 CommandBatch.new(["SET lossy yes"]), shard=0
             )
-            await asyncio.wait_for(fut, 20.0)
+            # under loss the submitter itself can fall behind and receive
+            # its own batch's effects via snapshot sync — then the future
+            # fails with the documented "responses unavailable" error while
+            # the COMMIT is still real; convergence below is the actual
+            # assertion either way
+            try:
+                await asyncio.wait_for(fut, 20.0)
+            except Exception as e:  # noqa: BLE001
+                assert "responses unavailable" in str(e)
             await _converged(sms, "lossy", "yes", timeout=20.0)
         finally:
             await _teardown(engines, tasks)
@@ -201,3 +209,90 @@ class TestSlotProposer:
 
     def test_deterministic(self):
         assert slot_proposer(3, 7, 5) == slot_proposer(3, 7, 5)
+
+
+def _single_engine(n=3, n_shards=1):
+    nodes = [NodeId.from_int(i + 1) for i in range(n)]
+    hub = InMemoryHub()
+    eng = RabiaEngine(
+        ClusterConfig.new(nodes[0], nodes),
+        InMemoryStateMachine(),
+        hub.register(nodes[0]),
+        config=_mk_config(n_shards),
+    )
+    return eng
+
+
+class TestProposerValidation:
+    """Only the rotation proposer of (shard, slot) may bind a batch to it —
+    a non-proposer's Propose must be dropped (ADVICE: divergent batch_id
+    bindings on a V1-decided slot cause state divergence)."""
+
+    @pytest.mark.asyncio
+    async def test_non_proposer_propose_dropped(self):
+        from rabia_tpu.core.messages import Propose
+        from rabia_tpu.core.types import StateValue
+        from rabia_tpu.kernel.phase_driver import pack_phase
+
+        eng = _single_engine()
+        batch = CommandBatch.new(["SET a 1"])
+        # slot 0 of shard 0 belongs to row 0; rows 1/2 must be rejected
+        for bad_row in (1, 2):
+            eng._on_propose(
+                bad_row,
+                Propose(
+                    shard=0,
+                    phase=pack_phase(0, 0),
+                    batch_id=batch.id,
+                    value=StateValue.V1,
+                    batch=batch,
+                ),
+            )
+        assert eng.rt.shards[0].buf_propose == {}
+        # slot 1 belongs to row 1: accepted
+        eng._on_propose(
+            1,
+            Propose(
+                shard=0,
+                phase=pack_phase(1, 0),
+                batch_id=batch.id,
+                value=StateValue.V1,
+                batch=batch,
+            ),
+        )
+        assert 1 in eng.rt.shards[0].buf_propose
+
+    @pytest.mark.asyncio
+    async def test_open_slots_never_rebinds(self):
+        """Once a slot carries a binding, the proposer must not swap in a
+        different queued batch."""
+        eng = _single_engine()
+        eng.rt.has_quorum = True
+        sh = eng.rt.shards[0]
+        bound = CommandBatch.new(["SET first 1"])
+        sh.buf_propose[0] = (bound.id, bound)
+        await eng.submit_batch(CommandBatch.new(["SET second 2"]), shard=0)
+        opened = eng._open_slots()
+        assert [(s, slot) for s, slot, _v in opened] == [(0, 0)]
+        assert sh.buf_propose[0][0] == bound.id  # binding unchanged
+
+
+class TestDedupLedger:
+    """applied_ids is the duplicate-commit guard; evicting the bounded
+    response cache must not re-enable a duplicate apply (ADVICE low)."""
+
+    @pytest.mark.asyncio
+    async def test_dedup_survives_response_cache_eviction(self):
+        from rabia_tpu.core.types import BatchId
+
+        eng = _single_engine()
+        sh = eng.rt.shards[0]
+        ids = [BatchId.new() for _ in range(3 * eng.config.max_pending_batches)]
+        for bid in ids:
+            sh.applied_ids[bid] = None
+            sh.applied_results[bid] = [b"ok"]
+        eng._gc()
+        # response cache bounded...
+        assert len(sh.applied_results) <= 2 * eng.config.max_pending_batches
+        # ...but every id still known to the dedup ledger
+        assert all(bid in sh.applied_ids for bid in ids)
