@@ -172,6 +172,10 @@ class RabiaConfig:
     heartbeat_interval: float = 1.0
     randomization_seed: Optional[int] = None
     round_interval: float = 0.001  # host pacing of kernel rounds (engine.rs:233 analog)
+    # the write-ahead vote barrier is persisted this many slots AHEAD of the
+    # opened slot so one fsync amortizes over K opens per shard (a restart
+    # taints at most K-1 extra slots, resolved by the taint-release window)
+    barrier_stride: int = 64
     tcp: TcpNetworkConfig = TcpNetworkConfig()
     batching: BatchConfig = BatchConfig()
     validation: ValidationConfig = ValidationConfig()

@@ -257,8 +257,11 @@ class RabiaEngine:
         return 4 * self.config.phase_timeout
 
     def _tainted_blocked(self) -> bool:
+        # applied_upto, not next_slot: a slot decided-but-unapplied before
+        # the crash leaves applied_upto under the barrier while next_slot
+        # is already past it — recovery still needs the sync
         return any(
-            max(sh.next_slot, sh.applied_upto) < sh.tainted_upto
+            sh.applied_upto < sh.tainted_upto
             for sh in self.rt.shards[: self.n_shards]
         )
 
@@ -427,6 +430,15 @@ class RabiaEngine:
             if rec is not None:
                 if rec.batch_id is None and d.batch_id is not None:
                     rec.batch_id = d.batch_id  # late binding repair
+                continue
+            if slot < max(sh.next_slot, sh.applied_upto):
+                # gap slot (below the head, e.g. decided-but-lost across a
+                # crash): it will never "become current" again, so adopt the
+                # peer decision immediately — buffering it would wedge apply
+                # at the gap forever
+                self._record_decision(s, slot, int(d.decision), d.batch_id)
+                if d.batch_id is not None and slot not in sh.buf_propose:
+                    sh.buf_propose[slot] = (d.batch_id, None)
                 continue
             # buffered only: recorded when the slot becomes current, either
             # via kernel adoption (in flight) or in _open_slots — keeps slot
@@ -625,14 +637,21 @@ class RabiaEngine:
     ) -> None:
         """Persist the vote barrier BEFORE the first vote of any newly
         opened slot leaves this replica (write-ahead), so a post-crash
-        restore can taint exactly the slots that may hold our votes. One
-        tiny aux write covers every shard opened this tick."""
+        restore can taint every slot that may hold our votes.
+
+        The barrier is advanced ``barrier_stride`` slots AHEAD of the
+        opened slot, so one atomic-write+fsync amortizes over the next K
+        opens per shard instead of landing on every consensus round's
+        critical path. Cost: a restart may taint up to K-1 never-voted
+        slots, which the taint-release window already resolves (restore
+        path is deliberately conservative)."""
         if self.persistence is None:
             return
+        stride = max(1, self.config.barrier_stride)
         changed = False
         for s, slot, _v in opened:
             if slot >= self._barrier[s]:
-                self._barrier[s] = slot + 1
+                self._barrier[s] = slot + stride
                 changed = True
         if changed:
             await self.persistence.save_aux(
@@ -915,10 +934,15 @@ class RabiaEngine:
         if total_applied <= p.current_phase:
             return  # not ahead; stay silent (engine.rs:763-779)
         snap = self.sm.create_snapshot()
+        # recent ids only: the in-memory dedup horizon (64x max_pending per
+        # shard) would overflow the 16 MiB transport frame cap at scale —
+        # a duplicate commit of a batch older than the retransmit horizon
+        # is not reachable through live traffic anyway
+        id_cap = 2 * self.config.max_pending_batches
         applied_ids = tuple(
             (s, bid)
             for s, sh in enumerate(self.rt.shards[: self.n_shards])
-            for bid in sh.applied_ids
+            for bid in list(sh.applied_ids)[-id_cap:]
         )
         self._send(
             SyncResponse(

@@ -56,34 +56,60 @@ R2_WAIT = 1
 # ---------------------------------------------------------------------------
 # Common coin
 # ---------------------------------------------------------------------------
+#
+# The coin is a *portable* integer hash — the same uint32 avalanche
+# sequence evaluates bit-identically under numpy (the engine's host
+# kernel, rabia_tpu/kernel/host_driver.py) and under XLA on any backend.
+# This replaces the round-1 design's threefry fold_in chain, which (a) was
+# the dominant cost of a node_step dispatch on CPU and (b) could not be
+# replayed outside JAX. The spec only requires a *shared* coin(P, V)
+# relation (docs/weak_mvc.ivy:169-182): any deterministic function of
+# (seed, shard, slot, phase) that every replica evaluates identically
+# qualifies; the reference instead flips per-node RNGs
+# (engine.rs:454-481), a documented deviation we fix.
+
+_GOLD = 0x9E3779B9  # 2^32 / golden ratio, the hash_combine offset
 
 
-def _coin_bits(key, shard: jnp.ndarray, slot: jnp.ndarray, phase: jnp.ndarray, p1: float):
+def _mix32(h):
+    """lowbias32 avalanche (a well-mixed uint32 permutation)."""
+    h = h ^ (h >> 16)
+    h = h * 0x21F0AAAD
+    h = h ^ (h >> 15)
+    h = h * 0x735A2D97
+    h = h ^ (h >> 15)
+    return h
+
+
+def _coin_bits(seed, shard, slot, phase, p1: float, xp=jnp):
     """Common-coin values for (shard, slot, phase) triples (same shape).
 
-    Depends only on the base key and the triple — never on the replica
-    flipping it — so every replica (and every host replay) sees the same
-    coin. Returns int8 V0/V1 of the broadcast shape.
+    Depends only on the seed and the triple — never on the replica flipping
+    it — so every replica (and every host/device replay) sees the same coin.
+    ``xp`` is the array namespace (``jax.numpy`` or ``numpy``); both produce
+    identical bits. Returns int8 V0/V1 of the broadcast shape.
     """
-    shard, slot, phase = jnp.broadcast_arrays(
-        jnp.asarray(shard, I32), jnp.asarray(slot, I32), jnp.asarray(phase, I32)
+    u32 = xp.uint32
+    shard, slot, phase = xp.broadcast_arrays(
+        xp.asarray(shard), xp.asarray(slot), xp.asarray(phase)
     )
-    shape = shard.shape
-
-    def one(sh, sl, ph):
-        k = jax.random.fold_in(key, sh)
-        k = jax.random.fold_in(k, sl)
-        k = jax.random.fold_in(k, ph)
-        return jax.random.bernoulli(k, p1)
-
-    flat = jax.vmap(one)(shard.ravel(), slot.ravel(), phase.ravel())
-    return jnp.where(flat.reshape(shape), I8(V1), I8(V0))
+    h = _mix32(xp.full(shard.shape, u32(seed)) ^ u32(_GOLD))
+    h = _mix32(h ^ (shard.astype(u32) + u32(_GOLD)))
+    h = _mix32(h ^ (slot.astype(u32) + u32(_GOLD)))
+    h = _mix32(h ^ (phase.astype(u32) + u32(_GOLD)))
+    threshold = u32(min(int(p1 * 4294967296.0), 4294967295))
+    return xp.where(h < threshold, xp.int8(V1), xp.int8(V0))
 
 
 def device_coin(seed: int, shard: int, slot: int, phase: int, p1: float = 0.5) -> int:
-    """Scalar host-side view of the device coin (for the oracle/tests)."""
-    key = jax.random.key(seed)
-    return int(_coin_bits(key, jnp.array([shard]), jnp.array([slot]), jnp.array([phase]), p1)[0])
+    """Scalar host-side view of the common coin (for the oracle/tests)."""
+    import numpy as np
+
+    return int(
+        _coin_bits(
+            seed, np.array([shard]), np.array([slot]), np.array([phase]), p1, xp=np
+        )[0]
+    )
 
 
 def _tally(ledger: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -265,7 +291,7 @@ class ClusterKernel:
         decide1 = d1 >= F1
         decide0 = d0 >= F1
         coin = _coin_bits(
-            self.key,
+            self.seed,
             jnp.broadcast_to(self._shard_idx[:, None], (S, R)),
             jnp.broadcast_to(state.slot[:, None], (S, R)),
             state.phase,
@@ -462,7 +488,7 @@ class NodeKernel:
         self.quorum = quorum_size(self.R)
         self.f1 = f_plus_1(self.R)
         self.coin_p1 = float(coin_p1)
-        self.key = jax.random.key(int(seed))
+        self.seed = int(seed)
         self._shard_idx = jnp.arange(self.S, dtype=I32)
 
     def init_state(self) -> NodeState:
@@ -540,7 +566,7 @@ class NodeKernel:
         advance = enabled & (state.stage == R2_WAIT) & (tot2 >= Q)
         decide1 = d1 >= F1
         decide0 = d0 >= F1
-        coin = _coin_bits(self.key, self._shard_idx, state.slot, state.phase, self.coin_p1)
+        coin = _coin_bits(self.seed, self._shard_idx, state.slot, state.phase, self.coin_p1)
         next_v = jnp.where(
             decide1,
             I8(V1),

@@ -178,7 +178,7 @@ class MeshPhaseKernel:
         self.quorum = quorum_size(self.R)
         self.f1 = f_plus_1(self.R)
         self.coin_p1 = float(coin_p1)
-        self.key = jax.random.key(int(seed))
+        self.seed = int(seed)
         if self.S % mesh.shape[SHARD_AXIS] != 0:
             raise ValueError("n_shards not divisible by shard axis")
         if self.R % mesh.shape[REPLICA_AXIS] != 0:
@@ -209,7 +209,7 @@ class MeshPhaseKernel:
         """
         mesh = self.mesh
         Q, F1 = self.quorum, self.f1
-        key, p1 = self.key, self.coin_p1
+        seed, p1 = self.seed, self.coin_p1
 
         def step_block(slot, phase, my_r1, decided, alive_b, shard_idx):
             # blocks: [S_blk, R_blk]
@@ -239,7 +239,7 @@ class MeshPhaseKernel:
             d1 = jnp.sum(r2_all == V1, axis=-1, dtype=I32)[:, None]
             decide1 = d1 >= F1
             decide0 = d0 >= F1
-            coin = _coin_bits(key, shard_idx, slot, phase, p1)
+            coin = _coin_bits(seed, shard_idx, slot, phase, p1)
             next_v = jnp.where(
                 decide1,
                 I8(V1),

@@ -1,0 +1,112 @@
+"""HostNodeKernel (numpy) ⟷ NodeKernel (JAX) bit-identity conformance.
+
+The engine may run either implementation (host arrays for CPU round
+pacing, device arrays for TPU); decisions must be identical — same
+contract as the vmap/mesh conformance gate (SURVEY.md §7.4.6).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rabia_tpu.core.types import ABSENT, V0, V1
+from rabia_tpu.kernel.host_driver import HostNodeKernel
+from rabia_tpu.kernel.phase_driver import NodeKernel, device_coin, _coin_bits
+
+
+def _assert_state_equal(a, b, where=""):
+    """a: JAX NodeState ([S,R] ledgers); b: HostNodeState ([R,S] ledgers)."""
+    for f in a._fields:
+        av, bv = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        if f in ("led1", "led2"):
+            bv = bv.T
+        assert np.array_equal(av, bv), f"{where}: field {f} diverged"
+
+
+def _assert_outbox_equal(a, b, where=""):
+    for f in a._fields:
+        av, bv = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        assert np.array_equal(av, bv), f"{where}: outbox {f} diverged"
+
+
+def _random_round(rng, S, R, p_absent=0.5):
+    """Random (possibly garbage-laden) inboxes: votes or ABSENT."""
+    choices = np.array([ABSENT, V0, V1], np.int8)
+    probs = [p_absent, (1 - p_absent) / 2, (1 - p_absent) / 2]
+    in1 = rng.choice(choices, size=(S, R), p=probs)
+    in2 = rng.choice(choices, size=(S, R), p=probs)
+    dec = rng.choice(
+        np.array([ABSENT, ABSENT, ABSENT, V1], np.int8), size=(S,)
+    )
+    return in1, in2, dec
+
+
+class TestCoinPortability:
+    def test_numpy_and_jax_coins_identical(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        shard = rng.integers(0, 10000, 256).astype(np.int32)
+        slot = rng.integers(0, 100000, 256).astype(np.int32)
+        phase = rng.integers(0, 64, 256).astype(np.int32)
+        for seed in (0, 7, 123456):
+            a = _coin_bits(seed, shard, slot, phase, 0.5, xp=np)
+            b = np.asarray(_coin_bits(seed, jnp.asarray(shard), jnp.asarray(slot), jnp.asarray(phase), 0.5))
+            assert np.array_equal(a, b)
+
+    def test_coin_is_fair_ish(self):
+        vals = [device_coin(3, s, sl, p) for s in range(8) for sl in range(8) for p in range(8)]
+        frac = sum(1 for v in vals if v == V1) / len(vals)
+        assert 0.4 < frac < 0.6
+
+    def test_coin_bias_parameter(self):
+        ones = [device_coin(1, s, 0, p, p1=0.99) for s in range(64) for p in range(4)]
+        assert sum(1 for v in ones if v == V1) > 0.9 * len(ones)
+
+
+class TestHostKernelConformance:
+    @pytest.mark.parametrize("R", [3, 5, 7])
+    def test_randomized_rounds_bit_identical(self, R):
+        S = 32
+        seed = 11
+        jk = NodeKernel(S, R, me=1, seed=seed)
+        hk = HostNodeKernel(S, R, me=1, seed=seed)
+        js, hs = jk.init_state(), hk.init_state()
+        _assert_state_equal(js, hs, "init")
+
+        rng = np.random.default_rng(42)
+        slot_counter = np.zeros(S, np.int64)
+        for step in range(30):
+            # periodically (re)start slots on a random subset
+            if step % 5 == 0:
+                mask = rng.random(S) < 0.7
+                init = rng.choice(np.array([V0, V1], np.int8), size=S)
+                slot_counter[mask] += 1
+                slots = slot_counter.astype(np.int32)
+                js = jk.start_slots(js, mask, slots, init)
+                hs = hk.start_slots(hs, mask, slots, init)
+                _assert_state_equal(js, hs, f"start@{step}")
+            in1, in2, dec = _random_round(rng, S, R)
+            js, job = jk.node_step(js, in1, in2, dec)
+            hs, hob = hk.node_step(hs, in1, in2, dec)
+            _assert_state_equal(js, hs, f"step@{step}")
+            _assert_outbox_equal(job, hob, f"step@{step}")
+
+    def test_clean_two_round_decision(self):
+        """All-V1 unanimous inboxes decide V1 in two rounds, both kernels."""
+        S, R = 8, 5
+        hk = HostNodeKernel(S, R, me=0, seed=0)
+        st = hk.init_state()
+        st = hk.start_slots(
+            st, np.ones(S, bool), np.zeros(S, np.int32), np.full(S, V1, np.int8)
+        )
+        full1 = np.full((S, R), V1, np.int8)
+        absent = np.full((S, R), ABSENT, np.int8)
+        no_dec = np.full(S, ABSENT, np.int8)
+        st, ob = hk.node_step(st, full1, absent, no_dec)
+        assert bool(np.all(ob.cast_r2)) and bool(np.all(ob.r2_vals == V1))
+        full2 = np.full((S, R), V1, np.int8)
+        st, ob = hk.node_step(st, absent, full2, no_dec)
+        assert bool(np.all(ob.newly_decided))
+        assert bool(np.all(st.decided == V1))
