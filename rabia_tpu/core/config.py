@@ -134,6 +134,12 @@ class KernelConfig:
     seed: int = 0
     max_phases_per_step: int = 1  # full weak-MVC phases evaluated per kernel call
     dtype_votes: str = "int8"
+    # engine kernel implementation: "host" = numpy HostNodeKernel (host
+    # round pacing — no per-round XLA dispatch or device mirrors; the
+    # default), "jax" = the JAX NodeKernel (device-array state; the TPU
+    # path, where thousands of shards amortize one dispatch). Both are
+    # bit-identical (tests/test_host_kernel.py).
+    backend: str = "host"
 
     @property
     def padded_shards(self) -> int:

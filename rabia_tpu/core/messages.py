@@ -21,6 +21,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
+import numpy as np
+
 from rabia_tpu.core.types import (
     BatchId,
     Command,
@@ -75,20 +77,71 @@ class Propose:
     batch: Optional[CommandBatch] = None
 
 
-@dataclass(frozen=True)
-class VoteRound1:
+class _VoteVector:
+    """Array-backed vote vector over the shard axis.
+
+    The TPU-native hot-path representation: three parallel numpy arrays
+    (``shards`` i64, ``phases`` i64 packed (slot<<16)|mvc, ``vals`` i8),
+    ingested and emitted by the engine with bulk array ops — no per-entry
+    Python objects on the wire path. ``votes`` (tuple of
+    :class:`VoteEntry`) remains as the convenience/compat view and
+    constructor; the wire format is unchanged either way.
+    """
+
+    __slots__ = ("shards", "phases", "vals")
+
+    def __init__(
+        self,
+        votes: Optional[Sequence[VoteEntry]] = None,
+        *,
+        shards=None,
+        phases=None,
+        vals=None,
+    ) -> None:
+        if votes is not None:
+            n = len(votes)
+            self.shards = np.fromiter((e.shard for e in votes), np.int64, count=n)
+            self.phases = np.fromiter(
+                (int(e.phase) for e in votes), np.int64, count=n
+            )
+            self.vals = np.fromiter((int(e.vote) for e in votes), np.int8, count=n)
+        else:
+            self.shards = np.asarray(shards, np.int64)
+            self.phases = np.asarray(phases, np.int64)
+            self.vals = np.asarray(vals, np.int8)
+        if not (len(self.shards) == len(self.phases) == len(self.vals)):
+            raise ValueError("vote vector arrays must have equal length")
+
+    def __len__(self) -> int:
+        return len(self.shards)
+
+    @property
+    def votes(self) -> tuple[VoteEntry, ...]:
+        return tuple(
+            VoteEntry(int(s), int(p), StateValue(int(v)))
+            for s, p, v in zip(self.shards, self.phases, self.vals)
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            type(other) is type(self)
+            and np.array_equal(self.shards, other.shards)
+            and np.array_equal(self.phases, other.phases)
+            and np.array_equal(self.vals, other.vals)
+        )
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(n={len(self)})"
+
+
+class VoteRound1(_VoteVector):
     """Round-1 vote vector. Unlike the reference (which unicasts R1 votes to
     the proposer only — engine.rs:418-419, a documented protocol deviation,
     SURVEY.md §3.1), round-1 votes are **broadcast** per the Ivy spec."""
 
-    votes: tuple[VoteEntry, ...]
 
-
-@dataclass(frozen=True)
-class VoteRound2:
+class VoteRound2(_VoteVector):
     """Round-2 vote vector (broadcast)."""
-
-    votes: tuple[VoteEntry, ...]
 
 
 @dataclass(frozen=True)
@@ -99,11 +152,75 @@ class DecisionEntry:
     batch_id: Optional[BatchId] = None
 
 
-@dataclass(frozen=True)
 class Decision:
-    """Decision notifications (messages.rs:100-106), vectorized per shard."""
+    """Decision notifications (messages.rs:100-106), vectorized per shard.
 
-    decisions: tuple[DecisionEntry, ...]
+    Array-backed like :class:`_VoteVector`; ``bids`` is a parallel list of
+    ``Optional[BatchId]`` (or None for "no entry carries a batch id" — the
+    common follower case).
+    """
+
+    __slots__ = ("shards", "phases", "vals", "bids")
+
+    def __init__(
+        self,
+        decisions: Optional[Sequence[DecisionEntry]] = None,
+        *,
+        shards=None,
+        phases=None,
+        vals=None,
+        bids: Optional[list] = None,
+    ) -> None:
+        if decisions is not None:
+            n = len(decisions)
+            self.shards = np.fromiter((e.shard for e in decisions), np.int64, count=n)
+            self.phases = np.fromiter(
+                (int(e.phase) for e in decisions), np.int64, count=n
+            )
+            self.vals = np.fromiter(
+                (int(e.decision) for e in decisions), np.int8, count=n
+            )
+            bid_list = [e.batch_id for e in decisions]
+            self.bids = bid_list if any(b is not None for b in bid_list) else None
+        else:
+            self.shards = np.asarray(shards, np.int64)
+            self.phases = np.asarray(phases, np.int64)
+            self.vals = np.asarray(vals, np.int8)
+            self.bids = bids
+        if self.bids is not None:
+            if len(self.bids) != len(self.shards):
+                raise ValueError("bids must parallel the decision arrays")
+            if not any(b is not None for b in self.bids):
+                self.bids = None
+
+    def __len__(self) -> int:
+        return len(self.shards)
+
+    def bid_at(self, i: int) -> Optional[BatchId]:
+        return self.bids[i] if self.bids is not None else None
+
+    @property
+    def decisions(self) -> tuple[DecisionEntry, ...]:
+        return tuple(
+            DecisionEntry(
+                int(s), int(p), StateValue(int(v)), self.bid_at(i)
+            )
+            for i, (s, p, v) in enumerate(
+                zip(self.shards, self.phases, self.vals)
+            )
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            type(other) is type(self)
+            and np.array_equal(self.shards, other.shards)
+            and np.array_equal(self.phases, other.phases)
+            and np.array_equal(self.vals, other.vals)
+            and self.bids == other.bids
+        )
+
+    def __repr__(self) -> str:
+        return f"Decision(n={len(self)})"
 
 
 @dataclass(frozen=True)

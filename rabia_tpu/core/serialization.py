@@ -27,6 +27,8 @@ import uuid
 import zlib
 from typing import Optional, Protocol
 
+import numpy as np
+
 from rabia_tpu.core.config import SerializationConfig
 from rabia_tpu.core.errors import SerializationError
 from rabia_tpu.core.messages import (
@@ -53,7 +55,10 @@ from rabia_tpu.core.types import (
     StateValue,
 )
 
-_VERSION = 1
+# version 2: Decision body moved its optional batch-id UUIDs from
+# inline-per-entry to a trailing section (fixed entries decode as one
+# frombuffer); v1 peers cleanly reject rather than mis-parse
+_VERSION = 2
 _FLAG_COMPRESSED = 0x01
 _FLAG_HAS_RECIPIENT = 0x02
 
@@ -148,19 +153,36 @@ class _Reader:
         return self.pos >= len(self.data)
 
 
-def _write_votes(w: _Writer, votes: tuple[VoteEntry, ...]) -> None:
-    w.u32(len(votes))
-    for e in votes:
-        w.u32(e.shard)
-        w.u64(e.phase)
-        w.u8(int(e.vote))
+# packed little-endian entry layouts (numpy structured dtypes are unpadded
+# by default, so tobytes()/frombuffer() match the per-field wire layout)
+_VOTE_DT = np.dtype([("shard", "<u4"), ("phase", "<u8"), ("vote", "u1")])
+_DEC_DT = np.dtype(
+    [("shard", "<u4"), ("phase", "<u8"), ("decision", "u1"), ("has_bid", "u1")]
+)
 
 
-def _read_votes(r: _Reader) -> tuple[VoteEntry, ...]:
+def _write_votes(w: _Writer, vv) -> None:
+    """Vectorized vote-vector body: u32 count + packed (u32,u64,u8) entries
+    — byte-identical to writing each entry field-by-field."""
+    n = len(vv)
+    w.u32(n)
+    arr = np.empty(n, _VOTE_DT)
+    arr["shard"] = vv.shards
+    arr["phase"] = vv.phases.astype(np.uint64)
+    arr["vote"] = vv.vals.astype(np.uint8)
+    w.raw(arr.tobytes())
+
+
+def _read_vote_arrays(r: _Reader):
     n = r.u32()
-    return tuple(
-        VoteEntry(shard=r.u32(), phase=r.u64(), vote=StateValue(r.u8()))
-        for _ in range(n)
+    raw = r._take(_VOTE_DT.itemsize * n)
+    arr = np.frombuffer(raw, _VOTE_DT, count=n)
+    if n and (int(arr["vote"].max()) > 3):
+        raise SerializationError("vote code out of range")
+    return (
+        arr["shard"].astype(np.int64),
+        arr["phase"].astype(np.int64),
+        arr["vote"].astype(np.int8),
     )
 
 
@@ -210,18 +232,29 @@ def _encode_payload(w: _Writer, payload) -> None:
         w.u8(int(payload.value))
         _write_optional_batch(w, payload.batch)
     elif isinstance(payload, (VoteRound1, VoteRound2)):
-        _write_votes(w, payload.votes)
+        _write_votes(w, payload)
     elif isinstance(payload, Decision):
-        w.u32(len(payload.decisions))
-        for d in payload.decisions:
-            w.u32(d.shard)
-            w.u64(d.phase)
-            w.u8(int(d.decision))
-            if d.batch_id is None:
-                w.u8(0)
-            else:
-                w.u8(1)
-                w.uuid(d.batch_id.value)
+        # fixed packed entries first, then the bound batch ids (16B each)
+        # for entries with has_bid=1 in order — keeps the hot decode a
+        # single frombuffer over the fixed section
+        n = len(payload)
+        w.u32(n)
+        arr = np.empty(n, _DEC_DT)
+        arr["shard"] = payload.shards
+        arr["phase"] = payload.phases.astype(np.uint64)
+        arr["decision"] = payload.vals.astype(np.uint8)
+        if payload.bids is None:
+            arr["has_bid"] = 0
+            w.raw(arr.tobytes())
+        else:
+            has = np.fromiter(
+                (b is not None for b in payload.bids), bool, count=n
+            )
+            arr["has_bid"] = has.view(np.uint8)
+            w.raw(arr.tobytes())
+            for b in payload.bids:
+                if b is not None:
+                    w.uuid(b.value)
     elif isinstance(payload, SyncRequest):
         w.u64(payload.current_phase)
         w.u64(payload.state_version)
@@ -265,19 +298,28 @@ def _decode_payload(msg_type: MessageType, r: _Reader):
             batch=_read_optional_batch(r),
         )
     if msg_type == MessageType.VoteRound1:
-        return VoteRound1(votes=_read_votes(r))
+        sh, ph, vv = _read_vote_arrays(r)
+        return VoteRound1(shards=sh, phases=ph, vals=vv)
     if msg_type == MessageType.VoteRound2:
-        return VoteRound2(votes=_read_votes(r))
+        sh, ph, vv = _read_vote_arrays(r)
+        return VoteRound2(shards=sh, phases=ph, vals=vv)
     if msg_type == MessageType.Decision:
         n = r.u32()
-        entries = []
-        for _ in range(n):
-            shard = r.u32()
-            phase = r.u64()
-            val = StateValue(r.u8())
-            bid = BatchId(r.uuid()) if r.u8() else None
-            entries.append(DecisionEntry(shard, phase, val, bid))
-        return Decision(decisions=tuple(entries))
+        raw = r._take(_DEC_DT.itemsize * n)
+        arr = np.frombuffer(raw, _DEC_DT, count=n)
+        if n and int(arr["decision"].max()) > 3:
+            raise SerializationError("decision code out of range")
+        bids = None
+        if n and arr["has_bid"].any():
+            bids = [
+                BatchId(r.uuid()) if h else None for h in arr["has_bid"]
+            ]
+        return Decision(
+            shards=arr["shard"].astype(np.int64),
+            phases=arr["phase"].astype(np.int64),
+            vals=arr["decision"].astype(np.int8),
+            bids=bids,
+        )
     if msg_type == MessageType.SyncRequest:
         return SyncRequest(current_phase=r.u64(), state_version=r.u64())
     if msg_type == MessageType.SyncResponse:
@@ -374,6 +416,12 @@ class BinarySerializer:
 
 
 def _jsonify(obj):
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (VoteRound1, VoteRound2)):
+        return {"votes": _jsonify(obj.votes)}
+    if isinstance(obj, Decision):
+        return {"decisions": _jsonify(obj.decisions)}
     if isinstance(obj, bytes):
         return {"__bytes__": base64.b64encode(obj).decode("ascii")}
     if isinstance(obj, uuid.UUID):
@@ -489,9 +537,9 @@ def estimate_serialized_size(msg: ProtocolMessage) -> int:
     base = 3 + 16 + 16 + 16 + 8 + 4
     p = msg.payload
     if isinstance(p, (VoteRound1, VoteRound2)):
-        return base + 4 + 13 * len(p.votes)
+        return base + 4 + 13 * len(p)
     if isinstance(p, Decision):
-        return base + 4 + 30 * len(p.decisions)
+        return base + 4 + 30 * len(p)
     if isinstance(p, Propose):
         b = p.batch.total_size() + 40 * len(p.batch) if p.batch else 0
         return base + 29 + b

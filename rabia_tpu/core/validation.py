@@ -52,10 +52,14 @@ class MessageValidator:
         elif isinstance(payload, (VoteRound1, VoteRound2)):
             self._validate_votes(payload)
         elif isinstance(payload, Decision):
-            for d in payload.decisions:
-                if d.decision == StateValue.VQuestion:
-                    raise ValidationError("decision cannot be V?")
-                self._validate_phase(d.phase)
+            if len(payload) and (
+                (payload.vals == int(StateValue.VQuestion)).any()
+            ):
+                raise ValidationError("decision cannot be V?")
+            if len(payload) and (
+                int(payload.phases.min()) < 0 or int(payload.shards.min()) < 0
+            ):
+                raise ValidationError("negative phase/shard in decision")
         elif isinstance(payload, (SyncRequest, HeartBeat)):
             self._validate_phase(payload.current_phase)
         elif isinstance(payload, SyncResponse):
@@ -82,14 +86,14 @@ class MessageValidator:
             self.validate_batch(p.batch)
 
     def _validate_votes(self, v: VoteRound1 | VoteRound2) -> None:
-        if not v.votes:
+        if len(v) == 0:
             raise ValidationError("vote vector must be non-empty")
-        for e in v.votes:
-            self._validate_phase(e.phase)
-            if e.shard < 0:
-                raise ValidationError(f"negative shard index {e.shard}")
-            if e.vote == StateValue.Absent:
-                raise ValidationError("cannot vote ABSENT")
+        if int(v.phases.min()) < 0:
+            raise ValidationError("negative phase in vote vector")
+        if int(v.shards.min()) < 0:
+            raise ValidationError("negative shard index in vote vector")
+        if (v.vals == int(StateValue.Absent)).any():
+            raise ValidationError("cannot vote ABSENT")
 
     def _validate_phase(self, phase: int) -> None:
         if phase < 0:

@@ -4,11 +4,20 @@ Reference parity: rabia-engine/src/engine.rs — the engine drives
 propose → vote-R1 → vote-R2 → decide → apply (:184-236 run loop, :288-347
 propose path, :381-746 message handlers, :684-706 apply, :748-844 sync,
 :846-907 heartbeat/sync initiation, :923-947 receive loop). The consensus
-*math* of those handlers (vote rules, tallies, coin, decision) lives in
-:class:`rabia_tpu.kernel.phase_driver.NodeKernel` and runs for all S shards
-in one jitted call per round; this module is everything around it: message
-routing, slot lifecycle, batch payloads, state-machine application,
-persistence, heartbeats, sync and stats.
+*math* of those handlers (vote rules, tallies, coin, decision) lives in the
+node kernel — :class:`rabia_tpu.kernel.host_driver.HostNodeKernel` (numpy,
+the host hot loop) or :class:`rabia_tpu.kernel.phase_driver.NodeKernel`
+(JAX, the device path) — and runs for all S shards in one call per round;
+this module is everything around it: message routing, slot lifecycle, batch
+payloads, state-machine application, persistence, heartbeats, sync and
+stats.
+
+Hot-path design (SURVEY.md §7.4.4): everything per-round is **columnar** —
+vote vectors arrive as numpy arrays (:class:`~rabia_tpu.core.messages.
+_VoteVector`), are routed to the kernel ledger with bulk scatters, and the
+kernel outbox is turned back into broadcast vote vectors with bulk gathers.
+Per-shard Python runs only on *events* (slot open, decision record, batch
+apply), never in per-round scans.
 
 Protocol notes (deliberate divergences from the reference implementation,
 both fixing documented deviations — SURVEY.md §3.1):
@@ -65,18 +74,20 @@ from rabia_tpu.core.types import (
     StateValue,
 )
 from rabia_tpu.core.validation import MessageValidator
-from rabia_tpu.engine.leader import LeaderSelector, slot_proposer
+from rabia_tpu.engine.leader import LeaderSelector, slot_proposer, slot_proposer_vec
 from rabia_tpu.engine.state import (
     EngineRuntime,
     EngineStatistics,
     PendingSubmission,
     SlotRecord,
 )
+from rabia_tpu.kernel.host_driver import HostNodeKernel
 from rabia_tpu.kernel.phase_driver import NodeKernel, R2_WAIT, pack_phase, unpack_phase
 
 logger = logging.getLogger("rabia_tpu.engine")
 
 _MAX_SUBMIT_ATTEMPTS = 3
+_MVC_MASK = (1 << 16) - 1
 
 
 class RabiaEngine:
@@ -111,7 +122,9 @@ class RabiaEngine:
         # The coin seed must be identical cluster-wide (it IS the common
         # coin); randomization_seed defaults to 0 for all nodes.
         seed = self.config.randomization_seed or 0
-        self.kernel = NodeKernel(
+        self._host_kernel = kc.backend != "jax"
+        kernel_cls = HostNodeKernel if self._host_kernel else NodeKernel
+        self.kernel = kernel_cls(
             self.S, self.R, self.me, coin_p1=kc.coin_p1, seed=seed
         )
         self.kstate = self.kernel.init_state()
@@ -121,15 +134,25 @@ class RabiaEngine:
         self.leader = LeaderSelector(cluster.all_nodes)
         self.monitor = NetworkMonitor(cluster)
 
-        # host mirrors of kernel arrays (refreshed after each node_step)
-        self._cur_slot = np.zeros(self.S, np.int64)
-        self._cur_phase = np.zeros(self.S, np.int64)
-        self._stage = np.zeros(self.S, np.int8)
-        self._my_r1 = np.full(self.S, ABSENT, np.int8)
-        self._my_r2 = np.full(self.S, ABSENT, np.int8)
-        self._done = np.zeros(self.S, bool)
-        self._decided = np.full(self.S, ABSENT, np.int8)
-        self._active = np.zeros(self.S, bool)
+        # host mirrors of kernel arrays (aliases in host-kernel mode,
+        # refreshed copies in jax mode)
+        self._refresh_mirrors()
+
+        # vote stash: arrays appended at ingest, routed to the kernel in
+        # bulk once per tick ([(row, shards, slots, mvcs, vals)] per round)
+        self._stash1: list[tuple] = []
+        self._stash2: list[tuple] = []
+        # carry: future-(slot, phase) votes kept across ticks (same tuple
+        # shape); bounded in _route_votes
+        self._carry1: list[tuple] = []
+        self._carry2: list[tuple] = []
+        # adopted-decision plane consumed by the next node_step
+        self._dec_plane = np.full(self.S, ABSENT, np.int8)
+        if not self._host_kernel:
+            self._inbox1 = np.full((self.S, self.R), ABSENT, np.int8)
+            self._inbox2 = np.full((self.S, self.R), ABSENT, np.int8)
+        self._shard_ids = np.arange(self.S, dtype=np.int64)
+        self._apply_dirty: set[int] = set()
 
         # write-ahead vote barrier: _barrier[s] is persisted BEFORE this
         # replica's first vote in any slot >= the previous barrier, so a
@@ -150,6 +173,7 @@ class RabiaEngine:
         self._last_heartbeat = 0.0
         self._last_cleanup = 0.0
         self._last_monitor = 0.0
+        self._last_repair: dict[int, float] = {}  # sender row -> last repair
         self._peer_progress: dict[NodeId, tuple[int, float]] = {}
 
         if self.n_shards > self.S:
@@ -210,17 +234,17 @@ class RabiaEngine:
             if persisted is not None:
                 if persisted.snapshot is not None:
                     self.sm.restore_snapshot(persisted.snapshot)
-                for s, (opened, applied) in enumerate(
-                    zip(persisted.per_shard_phase, persisted.per_shard_committed)
-                ):
-                    if s < self.S:
-                        self.rt.shards[s].next_slot = opened
-                        self.rt.shards[s].applied_upto = applied
+                opened = np.asarray(persisted.per_shard_phase[: self.S], np.int64)
+                applied = np.asarray(
+                    persisted.per_shard_committed[: self.S], np.int64
+                )
+                self.rt.next_slot[: len(opened)] = opened
+                self.rt.applied_upto[: len(applied)] = applied
                 self.rt.state_version = persisted.state_version
                 logger.info(
                     "%s restored: %d slots applied",
                     self.node_id.short(),
-                    sum(sh.applied_upto for sh in self.rt.shards),
+                    int(self.rt.applied_upto.sum()),
                 )
         # unconditionally: a replica that voted but crashed before its first
         # checkpoint has no main blob yet the barrier aux blob exists — that
@@ -245,12 +269,11 @@ class RabiaEngine:
         raw = await self.persistence.load_aux("vote_barrier")
         if raw is None:
             return
-        barrier = np.frombuffer(raw, np.int64)
-        for s in range(min(len(barrier), self.n_shards)):
-            self._barrier[s] = barrier[s]
-            sh = self.rt.shards[s]
-            if barrier[s] > sh.applied_upto:
-                sh.tainted_upto = int(barrier[s])
+        barrier = np.frombuffer(raw, np.int64)[: self.n_shards]
+        self._barrier[: len(barrier)] = barrier
+        n = len(barrier)
+        taint = barrier > self.rt.applied_upto[:n]
+        self.rt.tainted_upto[:n][taint] = barrier[taint]
 
     @property
     def _taint_release(self) -> float:
@@ -260,9 +283,9 @@ class RabiaEngine:
         # applied_upto, not next_slot: a slot decided-but-unapplied before
         # the crash leaves applied_upto under the barrier while next_slot
         # is already past it — recovery still needs the sync
-        return any(
-            sh.applied_upto < sh.tainted_upto
-            for sh in self.rt.shards[: self.n_shards]
+        n = self.n_shards
+        return bool(
+            (self.rt.applied_upto[:n] < self.rt.tainted_upto[:n]).any()
         )
 
     async def run(self) -> None:
@@ -304,9 +327,7 @@ class RabiaEngine:
         return bool(got_msgs or opened or applied) and stepped
 
     def _anything_in_flight(self) -> bool:
-        return any(
-            sh.in_flight for sh in self.rt.shards[: self.n_shards]
-        )
+        return bool(self.rt.in_flight[: self.n_shards].any())
 
     # -- inbound ------------------------------------------------------------
 
@@ -352,14 +373,14 @@ class RabiaEngine:
             return
         self.rt.active_nodes.add(msg.sender)
         p = msg.payload
-        if isinstance(p, Propose):
-            self._on_propose(row, p)
-        elif isinstance(p, VoteRound1):
-            self._buffer_votes(row, p.votes, round_no=1)
+        if isinstance(p, VoteRound1):
+            self._ingest_vote_arrays(row, p.shards, p.phases, p.vals, 1)
         elif isinstance(p, VoteRound2):
-            self._buffer_votes(row, p.votes, round_no=2)
+            self._ingest_vote_arrays(row, p.shards, p.phases, p.vals, 2)
         elif isinstance(p, Decision):
             self._on_decision(p)
+        elif isinstance(p, Propose):
+            self._on_propose(row, p)
         elif isinstance(p, NewBatch):
             self._on_new_batch(p)
         elif isinstance(p, SyncRequest):
@@ -402,50 +423,230 @@ class RabiaEngine:
         sh.buf_propose.setdefault(slot, (p.batch_id, p.batch))
         if p.batch is not None:
             sh.payloads[p.batch_id] = p.batch
+        if rec is not None and not rec.applied:
+            # a late payload/binding may have just unwedged apply — the
+            # apply scan is dirty-set driven, so re-mark the shard
+            self._apply_dirty.add(p.shard)
+
+    # -- vote ingest (columnar) ---------------------------------------------
+
+    def _ingest_vote_arrays(
+        self,
+        row: int,
+        shards: np.ndarray,
+        phases: np.ndarray,
+        vals: np.ndarray,
+        round_no: int,
+    ) -> None:
+        """Stash one sender's vote vector for this tick's bulk route.
+
+        Cheap per-message side effects happen eagerly (vectorized): stale
+        drop, taint-traffic marking, votes-seen tracking for slot opening.
+        """
+        n = self.n_shards
+        ok = shards < n
+        if not ok.all():
+            shards, phases, vals = shards[ok], phases[ok], vals[ok]
+        if len(shards) == 0:
+            return
+        slots = phases >> 16
+        live = slots >= self.rt.applied_upto[shards]
+        if not live.all():
+            # the sender is voting in slots we already decided: it missed
+            # the Decision (loss / heal) — answer with a targeted repair
+            # instead of letting it stall into the sync path
+            self._repair_stale_sender(row, shards[~live], slots[~live])
+            shards, phases, vals, slots = (
+                shards[live],
+                phases[live],
+                vals[live],
+                slots[live],
+            )
+        if len(shards) == 0:
+            return
+        tainted = slots < self.rt.tainted_upto[shards]
+        if tainted.any():
+            # peers are deciding tainted slots: keep waiting for adoption
+            self.rt.taint_traffic[shards[tainted]] = True
+        np.maximum.at(self.rt.votes_seen_slot, shards, slots)
+        mvcs = phases & _MVC_MASK
+        stash = self._stash1 if round_no == 1 else self._stash2
+        stash.append((row, shards, slots, mvcs, vals))
 
     def _buffer_votes(
         self, row: int, votes: tuple[VoteEntry, ...], round_no: int
     ) -> None:
-        for v in votes:
-            if not (0 <= v.shard < self.n_shards):
+        """Compat shim: ingest a tuple-of-VoteEntry vote vector."""
+        vv = VoteRound1(votes=votes)
+        self._ingest_vote_arrays(row, vv.shards, vv.phases, vv.vals, round_no)
+
+    def _repair_stale_sender(
+        self, row: int, shards: np.ndarray, slots: np.ndarray
+    ) -> None:
+        """Unicast Decisions (with bindings) for decided slots a lagging
+        sender is still voting in. Rate-limited per sender; slots already
+        GC'd from the ledger fall back to the sync path on the sender."""
+        now = time.time()
+        last = self._last_repair.get(row, 0.0)
+        if now - last < max(0.05, self.config.phase_timeout / 4):
+            return
+        entries: list[DecisionEntry] = []
+        for s, slot in zip(shards[:256], slots[:256]):
+            s, slot = int(s), int(slot)
+            rec = self.rt.shards[s].decisions.get(slot)
+            if rec is not None:
+                entries.append(
+                    DecisionEntry(
+                        shard=s,
+                        phase=pack_phase(slot, 0),
+                        decision=rec.value,
+                        batch_id=rec.batch_id,
+                    )
+                )
+        if entries:
+            self._last_repair[row] = now
+            self._send(
+                Decision(decisions=tuple(entries)),
+                recipient=self._row_to_node[row],
+            )
+
+    def _route_votes(self) -> None:
+        """Offer every stashed/carried vote matching a shard's current
+        (slot, phase) to the kernel ledger; keep future votes for later
+        ticks; drop stale ones. One vectorized pass per sender batch."""
+        for round_no, stash, carry in (
+            (1, self._stash1, self._carry1),
+            (2, self._stash2, self._carry2),
+        ):
+            if not stash and not carry:
                 continue
-            sh = self.rt.shards[v.shard]
-            slot, mvc = unpack_phase(v.phase)
-            if slot < sh.applied_upto:
-                continue
-            if slot < sh.tainted_upto:
-                sh.taint_traffic = True  # peers are deciding: keep waiting
-            buf = sh.buf_r1 if round_no == 1 else sh.buf_r2
-            buf.setdefault((slot, mvc), {}).setdefault(row, int(v.vote))
+            items = carry + stash
+            stash.clear()
+            carry.clear()
+            for row, shards, slots, mvcs, vals in items:
+                live = slots >= self.rt.applied_upto[shards]
+                if not live.all():
+                    shards, slots, mvcs, vals = (
+                        shards[live],
+                        slots[live],
+                        mvcs[live],
+                        vals[live],
+                    )
+                if len(shards) == 0:
+                    continue
+                cur = (
+                    self.rt.in_flight[shards]
+                    & (slots == self._cur_slot[shards])
+                    & (mvcs == self._cur_phase[shards])
+                )
+                if cur.any():
+                    sh_c = shards[cur]
+                    v_c = vals[cur]
+                    if self._host_kernel:
+                        self.kernel.offer_votes(
+                            self.kstate, round_no, row, sh_c, v_c
+                        )
+                    else:
+                        plane = self._inbox1 if round_no == 1 else self._inbox2
+                        cell = plane[sh_c, row]
+                        w = cell == ABSENT
+                        plane[sh_c[w], row] = v_c[w]
+                    if cur.all():
+                        continue
+                    keep = ~cur
+                    shards, slots, mvcs, vals = (
+                        shards[keep],
+                        slots[keep],
+                        mvcs[keep],
+                        vals[keep],
+                    )
+                carry.append((row, shards, slots, mvcs, vals))
+        # bound the carry: genuinely unreachable future votes must not
+        # accumulate without limit (validation bounds phase jumps, but a
+        # malicious/buggy peer could still flood)
+        for carry in (self._carry1, self._carry2):
+            total = sum(len(t[1]) for t in carry)
+            cap = 8 * self.S * self.R
+            while carry and total > cap:
+                total -= len(carry.pop(0)[1])
 
     def _on_decision(self, p: Decision) -> None:
-        for d in p.decisions:
-            if not (0 <= d.shard < self.n_shards):
+        """Vectorized decision ingest: current-slot decisions go straight to
+        the adoption plane; gap/future/bid-bearing entries fall back to the
+        per-entry path (rare outside crash recovery)."""
+        n = self.n_shards
+        shards, phases, vals = p.shards, p.phases, p.vals
+        ok = shards < n
+        if not ok.all():
+            if p.bids is not None:
+                self._on_decision_entries(p)
+                return
+            shards, phases, vals = shards[ok], phases[ok], vals[ok]
+        if len(shards) == 0:
+            return
+        slots = phases >> 16
+        stale = slots < self.rt.applied_upto[shards]
+        cur = (
+            ~stale
+            & self.rt.in_flight[shards]
+            & (slots == self._cur_slot[shards])
+        )
+        if p.bids is None and bool(cur.all()):
+            self._dec_plane[shards] = vals
+            return
+        if p.bids is None:
+            sh_c = shards[cur]
+            self._dec_plane[sh_c] = vals[cur]
+            rest = ~cur & ~stale
+            if not rest.any():
+                return
+            idxs = np.nonzero(rest)[0]
+            for i in idxs:
+                self._on_decision_one(
+                    int(shards[i]), int(slots[i]), int(vals[i]), None
+                )
+        else:
+            self._on_decision_entries(p)
+
+    def _on_decision_entries(self, p: Decision) -> None:
+        for i, (s, ph, v) in enumerate(zip(p.shards, p.phases, p.vals)):
+            s = int(s)
+            if not (0 <= s < self.n_shards):
                 continue
-            sh = self.rt.shards[d.shard]
-            slot, _ = unpack_phase(d.phase)
-            if slot < sh.applied_upto:
+            slot = int(ph) >> 16
+            if slot < self.rt.applied_upto[s]:
                 continue
-            rec = sh.decisions.get(slot)
-            if rec is not None:
-                if rec.batch_id is None and d.batch_id is not None:
-                    rec.batch_id = d.batch_id  # late binding repair
-                continue
-            if slot < max(sh.next_slot, sh.applied_upto):
-                # gap slot (below the head, e.g. decided-but-lost across a
-                # crash): it will never "become current" again, so adopt the
-                # peer decision immediately — buffering it would wedge apply
-                # at the gap forever
-                self._record_decision(s, slot, int(d.decision), d.batch_id)
-                if d.batch_id is not None and slot not in sh.buf_propose:
-                    sh.buf_propose[slot] = (d.batch_id, None)
-                continue
-            # buffered only: recorded when the slot becomes current, either
-            # via kernel adoption (in flight) or in _open_slots — keeps slot
-            # recording contiguous so apply order never skips a slot
-            sh.buf_decision[slot] = (int(d.decision), d.batch_id)
-            if d.batch_id is not None and slot not in sh.buf_propose:
-                sh.buf_propose[slot] = (d.batch_id, None)
+            self._on_decision_one(s, slot, int(v), p.bid_at(i))
+
+    def _on_decision_one(self, s: int, slot: int, value: int, bid) -> None:
+        sh = self.rt.shards[s]
+        rec = sh.decisions.get(slot)
+        if rec is not None:
+            if rec.batch_id is None and bid is not None:
+                rec.batch_id = bid  # late binding repair
+                if not rec.applied:
+                    self._apply_dirty.add(s)
+            return
+        if sh.in_flight and slot == int(self._cur_slot[s]):
+            self._dec_plane[s] = value
+            if bid is not None and slot not in sh.buf_propose:
+                sh.buf_propose[slot] = (bid, None)
+            return
+        if slot < max(sh.next_slot, sh.applied_upto):
+            # gap slot (below the head, e.g. decided-but-lost across a
+            # crash): it will never "become current" again, so adopt the
+            # peer decision immediately — buffering it would wedge apply
+            # at the gap forever
+            self._record_decision(s, slot, value, bid)
+            if bid is not None and slot not in sh.buf_propose:
+                sh.buf_propose[slot] = (bid, None)
+            return
+        # buffered only: recorded when the slot becomes current, either
+        # via kernel adoption (in flight) or in _open_slots — keeps slot
+        # recording contiguous so apply order never skips a slot
+        sh.buf_decision[slot] = (value, bid)
+        if bid is not None and slot not in sh.buf_propose:
+            sh.buf_propose[slot] = (bid, None)
 
     def _on_new_batch(self, p: NewBatch) -> None:
         """A peer forwards a submission for us to propose (see module doc)."""
@@ -464,22 +665,37 @@ class RabiaEngine:
         not us. The submission stays queued locally (with its future) so the
         submitter can still answer its client; the proposer's copy drives
         consensus. Re-forwarded on timeout by `_check_timeouts`."""
+        n = self.n_shards
+        rt = self.rt
+        queued = rt.queue_len[:n] > 0
+        if not queued.any():
+            return
         now = time.time()
-        for s in range(self.n_shards):
-            sh = self.rt.shards[s]
-            if not sh.queue or sh.in_flight:
-                continue
-            slot = max(sh.next_slot, sh.applied_upto)
-            target_row = slot_proposer(s, slot, self.R)
-            if target_row == self.me:
-                continue
+        head = np.maximum(rt.next_slot[:n], rt.applied_upto[:n])
+        proposer = slot_proposer_vec(self._shard_ids[:n], head, self.R)
+        need = (
+            queued
+            & ~rt.in_flight[:n]
+            & (proposer != self.me)
+            & (
+                (rt.head_fwd_at[:n] == 0.0)
+                | (now - rt.head_fwd_at[:n] >= self.config.phase_timeout)
+            )
+        )
+        if not need.any():
+            return
+        for s in np.nonzero(need)[0]:
+            s = int(s)
+            sh = rt.shards[s]
             sub = sh.queue[0]
             if sub.forwarded_at and now - sub.forwarded_at < self.config.phase_timeout:
+                rt.head_fwd_at[s] = sub.forwarded_at
                 continue
             sub.forwarded_at = now
+            rt.head_fwd_at[s] = now
             if not sub.first_forwarded_at:
                 sub.first_forwarded_at = now
-            target = self._row_to_node[target_row]
+            target = self._row_to_node[int(proposer[s])]
             self._send(
                 NewBatch(shard=s, batch=sub.batch), recipient=target
             )
@@ -492,17 +708,31 @@ class RabiaEngine:
           - a Propose arrived for the slot → open V1;
           - peers are already voting on the slot (or a timeout expired on a
             forwarded submission) → open V0 after a grace period.
+
+        Candidate shards are selected with one columnar scan; the per-shard
+        decision logic below runs only for shards that can actually act.
         """
+        n = self.n_shards
+        rt = self.rt
+        head = np.maximum(rt.next_slot[:n], rt.applied_upto[:n])
+        cand = ~rt.in_flight[:n] & (
+            (rt.queue_len[:n] > 0)
+            | rt.prop_flag[:n]
+            | rt.dec_flag[:n]
+            | (rt.votes_seen_slot[:n] >= head)
+            | (rt.tainted_upto[:n] > 0)
+        )
+        if not cand.any():
+            return []
         now = time.time()
         grace = min(max(self.config.phase_timeout / 10.0, 0.02), 1.0)
         opened: list[tuple[int, int, int]] = []
         propose_entries: list[Propose] = []
         alive_set = self.rt.active_nodes | {self.node_id}  # hoisted: hot loop
-        for s in range(self.n_shards):
-            sh = self.rt.shards[s]
-            if sh.in_flight:
-                continue
-            slot = max(sh.next_slot, sh.applied_upto)
+        for s in np.nonzero(cand)[0]:
+            s = int(s)
+            sh = rt.shards[s]
+            slot = int(head[s])
             if slot in sh.decisions:  # decided while we weren't looking
                 sh.next_slot = slot + 1
                 continue
@@ -552,9 +782,7 @@ class RabiaEngine:
                 )
                 opened.append((s, slot, V1))
             else:
-                votes_seen = any(
-                    k[0] == slot for k in sh.buf_r1
-                ) or any(k[0] == slot for k in sh.buf_r2)
+                votes_seen = rt.votes_seen_slot[s] >= slot
                 if votes_seen:
                     if sh.opened_at == 0.0:
                         sh.opened_at = now  # start the grace clock
@@ -577,12 +805,13 @@ class RabiaEngine:
                     # re-forward refreshes the latter, which must not reset
                     # the give-up clock.
                     opened.append((s, slot, V0))
-        for s, slot, _v in opened:
-            sh = self.rt.shards[s]
-            sh.in_flight = True
-            sh.next_slot = max(sh.next_slot, slot) + 0  # opened, +1 on decide
-            sh.opened_at = now
-            sh.last_progress = now
+        if opened:
+            idx = np.fromiter((o[0] for o in opened), np.int64, len(opened))
+            slots_arr = np.fromiter((o[1] for o in opened), np.int64, len(opened))
+            rt.in_flight[idx] = True
+            np.maximum.at(rt.next_slot, idx, slots_arr)
+            rt.opened_at[idx] = now
+            rt.last_progress[idx] = now
         # Proposes are NOT sent here: the vote barrier must be durable
         # before any proposal for a newly opened slot reaches the wire —
         # otherwise a crash-restart could rebind a different batch to a slot
@@ -594,8 +823,6 @@ class RabiaEngine:
     # -- the kernel round ----------------------------------------------------
 
     async def _kernel_round(self, opened: list[tuple[int, int, int]]) -> None:
-        import jax.numpy as jnp
-
         if opened:
             await self._advance_vote_barrier(opened)
         if self._pending_proposes:
@@ -603,34 +830,60 @@ class RabiaEngine:
                 self._send(pe)
             self._pending_proposes.clear()
         if opened:
+            k = len(opened)
+            idx = np.fromiter((o[0] for o in opened), np.int64, k)
+            slots_arr = np.fromiter((o[1] for o in opened), np.int64, k)
+            init_arr = np.fromiter((o[2] for o in opened), np.int8, k)
             mask = np.zeros(self.S, bool)
-            slots = np.zeros(self.S, np.int32)
-            init = np.full(self.S, V0, np.int8)
-            r1_entries: list[VoteEntry] = []
-            for s, slot, v in opened:
-                mask[s] = True
-                slots[s] = slot
-                init[s] = v
-                r1_entries.append(
-                    VoteEntry(shard=s, phase=pack_phase(slot, 0), vote=StateValue(v))
+            mask[idx] = True
+            slots_full = np.zeros(self.S, np.int64)
+            slots_full[idx] = slots_arr
+            init_full = np.full(self.S, V0, np.int8)
+            init_full[idx] = init_arr
+            if self._host_kernel:
+                self.kstate = self.kernel.start_slots(
+                    self.kstate, mask, slots_full.astype(np.int32), init_full
                 )
-            self.kstate = self.kernel.start_slots(
-                self.kstate, jnp.asarray(mask), jnp.asarray(slots), jnp.asarray(init)
-            )
-            self._refresh_mirrors()
-            self._send(VoteRound1(votes=tuple(r1_entries)))
+            else:
+                import jax.numpy as jnp
 
-        inbox1, inbox2, dec_in = self._fill_inboxes()
-        self.kstate, outbox = self.kernel.node_step(
-            self.kstate,
-            jnp.asarray(inbox1),
-            jnp.asarray(inbox2),
-            jnp.asarray(dec_in),
+                self.kstate = self.kernel.start_slots(
+                    self.kstate,
+                    jnp.asarray(mask),
+                    jnp.asarray(slots_full.astype(np.int32)),
+                    jnp.asarray(init_full),
+                )
+            self._refresh_mirrors()
+            self._send(
+                VoteRound1(
+                    shards=idx,
+                    phases=(slots_arr << 16),
+                    vals=init_arr,
+                )
+            )
+
+        self._route_votes()
+        prev_phase = (
+            self._cur_phase if self._host_kernel else self._cur_phase.copy()
         )
-        prev_phase = self._cur_phase.copy()
-        prev_stage = self._stage.copy()
+        if self._host_kernel:
+            self.kstate, outbox = self.kernel.node_step(
+                self.kstate, None, None, self._dec_plane
+            )
+        else:
+            import jax.numpy as jnp
+
+            self.kstate, outbox = self.kernel.node_step(
+                self.kstate,
+                jnp.asarray(self._inbox1),
+                jnp.asarray(self._inbox2),
+                jnp.asarray(self._dec_plane),
+            )
+            self._inbox1.fill(ABSENT)
+            self._inbox2.fill(ABSENT)
+        self._dec_plane.fill(ABSENT)
         self._refresh_mirrors()
-        self._process_outbox(outbox, prev_phase, prev_stage)
+        self._process_outbox(outbox, prev_phase)
 
     async def _advance_vote_barrier(
         self, opened: list[tuple[int, int, int]]
@@ -660,93 +913,95 @@ class RabiaEngine:
 
     def _refresh_mirrors(self) -> None:
         st = self.kstate
-        self._cur_slot = np.asarray(st.slot, np.int64)
-        self._cur_phase = np.asarray(st.phase, np.int64)
-        self._stage = np.asarray(st.stage, np.int8)
-        self._my_r1 = np.asarray(st.my_r1, np.int8)
-        self._my_r2 = np.asarray(st.my_r2, np.int8)
-        self._done = np.asarray(st.done, bool)
-        self._decided = np.asarray(st.decided, np.int8)
-        self._active = np.asarray(st.active, bool)
+        if self._host_kernel:
+            # host arrays: mirrors alias the kernel state (no copies)
+            self._cur_slot = st.slot
+            self._cur_phase = st.phase
+            self._stage = st.stage
+            self._my_r1 = st.my_r1
+            self._my_r2 = st.my_r2
+            self._done = st.done
+            self._decided = st.decided
+            self._active = st.active
+        else:
+            self._cur_slot = np.asarray(st.slot, np.int64)
+            self._cur_phase = np.asarray(st.phase, np.int64)
+            self._stage = np.asarray(st.stage, np.int8)
+            self._my_r1 = np.asarray(st.my_r1, np.int8)
+            self._my_r2 = np.asarray(st.my_r2, np.int8)
+            self._done = np.asarray(st.done, bool)
+            self._decided = np.asarray(st.decided, np.int8)
+            self._active = np.asarray(st.active, bool)
 
-    def _fill_inboxes(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Re-offer buffered votes matching each shard's current (slot,
-        phase) to the kernel; the device ledger ignores what it already has."""
-        inbox1 = np.full((self.S, self.R), ABSENT, np.int8)
-        inbox2 = np.full((self.S, self.R), ABSENT, np.int8)
-        dec_in = np.full(self.S, ABSENT, np.int8)
-        for s in range(self.n_shards):
-            sh = self.rt.shards[s]
-            if not sh.in_flight:
-                continue
-            key = (int(self._cur_slot[s]), int(self._cur_phase[s]))
-            for row, vote in sh.buf_r1.get(key, {}).items():
-                inbox1[s, row] = vote
-            for row, vote in sh.buf_r2.get(key, {}).items():
-                inbox2[s, row] = vote
-            d = sh.buf_decision.get(key[0])
-            if d is not None and d[0] in (V0, V1):
-                dec_in[s] = d[0]
-        return inbox1, inbox2, dec_in
-
-    def _process_outbox(self, outbox, prev_phase: np.ndarray, prev_stage: np.ndarray) -> None:
-        """Turn kernel outbox flags into broadcast messages + decisions."""
-        cast_r2 = np.asarray(outbox.cast_r2, bool)
-        r2_vals = np.asarray(outbox.r2_vals, np.int8)
-        advanced = np.asarray(outbox.advanced, bool)
-        new_r1 = np.asarray(outbox.new_r1, np.int8)
-        new_phase = np.asarray(outbox.new_phase, np.int64)
-        newly_dec = np.asarray(outbox.newly_decided, bool)
-
-        r1_entries: list[VoteEntry] = []
-        r2_entries: list[VoteEntry] = []
-        dec_entries: list[DecisionEntry] = []
+    def _process_outbox(self, outbox, prev_phase: np.ndarray) -> None:
+        """Turn kernel outbox flags into broadcast messages + decisions —
+        columnar gathers; per-shard Python only for newly decided slots."""
+        n = self.n_shards
+        rt = self.rt
+        act = rt.in_flight[:n]
+        if not act.any():
+            return
         now = time.time()
-        for s in range(self.n_shards):
-            sh = self.rt.shards[s]
-            if not sh.in_flight:
-                continue
-            slot = int(self._cur_slot[s])
-            if cast_r2[s]:
-                r2_entries.append(
-                    VoteEntry(
-                        shard=s,
-                        phase=pack_phase(slot, int(prev_phase[s])),
-                        vote=StateValue(int(r2_vals[s])),
-                    )
+        cast_r2 = np.asarray(outbox.cast_r2)[:n] & act
+        advanced = np.asarray(outbox.advanced)[:n] & act
+        done = np.asarray(self._done)[:n] & act
+
+        if cast_r2.any():
+            idx = np.nonzero(cast_r2)[0]
+            slots = np.asarray(self._cur_slot)[idx].astype(np.int64)
+            phases = (slots << 16) | np.asarray(prev_phase)[idx].astype(np.int64)
+            self._send(
+                VoteRound2(
+                    shards=idx,
+                    phases=phases,
+                    vals=np.asarray(outbox.r2_vals)[idx],
                 )
-                sh.last_progress = now
-            if advanced[s] and not newly_dec[s] and not self._done[s]:
-                r1_entries.append(
-                    VoteEntry(
-                        shard=s,
-                        phase=pack_phase(slot, int(new_phase[s])),
-                        vote=StateValue(int(new_r1[s])),
-                    )
+            )
+            rt.last_progress[idx] = now
+
+        adv = advanced & ~done
+        if adv.any():
+            idx = np.nonzero(adv)[0]
+            slots = np.asarray(self._cur_slot)[idx].astype(np.int64)
+            phases = (slots << 16) | np.asarray(outbox.new_phase)[idx].astype(
+                np.int64
+            )
+            self._send(
+                VoteRound1(
+                    shards=idx,
+                    phases=phases,
+                    vals=np.asarray(outbox.new_r1)[idx],
                 )
-                sh.last_progress = now
-            if self._done[s]:
-                value = int(self._decided[s])
+            )
+            rt.last_progress[idx] = now
+
+        if done.any():
+            newly = np.asarray(outbox.newly_decided)[:n] & act
+            dec_idx = np.nonzero(done)[0]
+            decided_vals = np.asarray(self._decided)
+            cur_slot = np.asarray(self._cur_slot)
+            for s in dec_idx:
+                s = int(s)
+                sh = rt.shards[s]
+                slot = int(cur_slot[s])
                 bid = None
                 bp = sh.buf_propose.get(slot)
                 if bp is not None:
                     bid = bp[0]
-                if newly_dec[s]:
-                    dec_entries.append(
-                        DecisionEntry(
-                            shard=s,
-                            phase=pack_phase(slot, 0),
-                            decision=StateValue(value),
-                            batch_id=bid,
-                        )
+                self._record_decision(s, slot, int(decided_vals[s]), bid)
+            if newly.any():
+                # steady-state Decisions are bid-free (fully columnar both
+                # ways); a peer that never saw the Propose recovers the
+                # binding from the late/retransmitted Propose or via sync
+                idx = np.nonzero(newly)[0]
+                slots = cur_slot[idx].astype(np.int64)
+                self._send(
+                    Decision(
+                        shards=idx,
+                        phases=(slots << 16),
+                        vals=decided_vals[idx],
                     )
-                self._record_decision(s, slot, value, bid)
-        if r2_entries:
-            self._send(VoteRound2(votes=tuple(r2_entries)))
-        if r1_entries:
-            self._send(VoteRound1(votes=tuple(r1_entries)))
-        if dec_entries:
-            self._send(Decision(decisions=tuple(dec_entries)))
+                )
 
     def _record_decision(self, s: int, slot: int, value: int, batch_id) -> None:
         sh = self.rt.shards[s]
@@ -765,17 +1020,23 @@ class RabiaEngine:
         sh.opened_at = 0.0
         # the next slot has a new proposer: restart the forward/give-up
         # clocks for whatever is still queued here
+        self.rt.head_fwd_at[s] = 0.0
         for sub in sh.queue:
             sub.forwarded_at = 0.0
             sub.first_forwarded_at = 0.0
+        self._apply_dirty.add(s)
         sh.gc_upto(sh.applied_upto)
 
     # -- decision application ------------------------------------------------
 
     def _apply_ready(self) -> int:
         """Apply decided slots in order per shard (engine.rs:684-746)."""
+        if not self._apply_dirty:
+            return 0
         applied = 0
-        for s in range(self.n_shards):
+        dirty = self._apply_dirty
+        self._apply_dirty = set()
+        for s in dirty:
             sh = self.rt.shards[s]
             while True:
                 slot = sh.applied_upto
@@ -874,24 +1135,40 @@ class RabiaEngine:
     def _check_timeouts(self) -> None:
         """Retransmit current votes (and proposal) for stalled shards —
         liveness under message loss (host policy per SURVEY.md §7.4.1)."""
+        n = self.n_shards
+        rt = self.rt
         now = time.time()
         timeout = self.config.phase_timeout
-        r1_entries: list[VoteEntry] = []
-        r2_entries: list[VoteEntry] = []
-        for s in range(self.n_shards):
-            sh = self.rt.shards[s]
-            if not sh.in_flight or now - sh.last_progress < timeout:
-                continue
-            slot = int(self._cur_slot[s])
-            mvc = int(self._cur_phase[s])
-            if self._my_r1[s] != ABSENT:
-                r1_entries.append(
-                    VoteEntry(s, pack_phase(slot, mvc), StateValue(int(self._my_r1[s])))
+        stalled = rt.in_flight[:n] & (now - rt.last_progress[:n] >= timeout)
+        if not stalled.any():
+            return
+        idxs = np.nonzero(stalled)[0]
+        r1_mask = np.asarray(self._my_r1)[idxs] != ABSENT
+        r2_mask = (np.asarray(self._stage)[idxs] == R2_WAIT) & (
+            np.asarray(self._my_r2)[idxs] != ABSENT
+        )
+        slots = np.asarray(self._cur_slot)[idxs].astype(np.int64)
+        phases = (slots << 16) | np.asarray(self._cur_phase)[idxs].astype(np.int64)
+        if r1_mask.any():
+            self._send(
+                VoteRound1(
+                    shards=idxs[r1_mask],
+                    phases=phases[r1_mask],
+                    vals=np.asarray(self._my_r1)[idxs[r1_mask]],
                 )
-            if self._stage[s] == R2_WAIT and self._my_r2[s] != ABSENT:
-                r2_entries.append(
-                    VoteEntry(s, pack_phase(slot, mvc), StateValue(int(self._my_r2[s])))
+            )
+        if r2_mask.any():
+            self._send(
+                VoteRound2(
+                    shards=idxs[r2_mask],
+                    phases=phases[r2_mask],
+                    vals=np.asarray(self._my_r2)[idxs[r2_mask]],
                 )
+            )
+        for i, s in enumerate(idxs):
+            s = int(s)
+            sh = rt.shards[s]
+            slot = int(slots[i])
             bp = sh.buf_propose.get(slot)
             if bp is not None and slot_proposer(s, slot, self.R) == self.me:
                 self._send(
@@ -903,11 +1180,7 @@ class RabiaEngine:
                         batch=bp[1],
                     )
                 )
-            sh.last_progress = now
-        if r1_entries:
-            self._send(VoteRound1(votes=tuple(r1_entries)))
-        if r2_entries:
-            self._send(VoteRound2(votes=tuple(r2_entries)))
+        rt.last_progress[idxs] = now
 
     # -- sync protocol (engine.rs:748-844) -----------------------------------
 
@@ -922,7 +1195,7 @@ class RabiaEngine:
             return
         self.rt.sync_started_at = time.time()
         self.rt.sync_responses.clear()
-        total_applied = sum(sh.applied_upto for sh in self.rt.shards)
+        total_applied = int(self.rt.applied_upto.sum())
         self._send(
             SyncRequest(
                 current_phase=total_applied, state_version=self.rt.state_version
@@ -930,7 +1203,7 @@ class RabiaEngine:
         )
 
     def _on_sync_request(self, sender: NodeId, p: SyncRequest) -> None:
-        total_applied = sum(sh.applied_upto for sh in self.rt.shards)
+        total_applied = int(self.rt.applied_upto.sum())
         if total_applied <= p.current_phase:
             return  # not ahead; stay silent (engine.rs:763-779)
         snap = self.sm.create_snapshot()
@@ -949,9 +1222,7 @@ class RabiaEngine:
                 responder_phase=total_applied,
                 state_version=self.rt.state_version,
                 snapshot=snap.to_bytes(),
-                per_shard_phase=tuple(
-                    sh.applied_upto for sh in self.rt.shards
-                ),
+                per_shard_phase=tuple(self.rt.applied_upto.tolist()),
                 applied_ids=applied_ids,
             ),
             recipient=sender,
@@ -968,7 +1239,7 @@ class RabiaEngine:
         # only strictly-ahead peers respond at all, so any usable response
         # resolves immediately — waiting for a quorum of responders can
         # stall forever when just one peer is ahead
-        total_applied = sum(sh.applied_upto for sh in self.rt.shards)
+        total_applied = int(self.rt.applied_upto.sum())
         if p.responder_phase > total_applied or (
             len(self.rt.sync_responses) + 1 >= self.cluster.quorum_size
         ):
@@ -979,7 +1250,7 @@ class RabiaEngine:
         if not self.rt.sync_responses:
             return
         best = max(self.rt.sync_responses.values(), key=lambda r: r[0])
-        total_applied = sum(sh.applied_upto for sh in self.rt.shards)
+        total_applied = int(self.rt.applied_upto.sum())
         self.rt.sync_started_at = None
         if best[0] <= total_applied or best[2] is None:
             return
@@ -1001,6 +1272,7 @@ class RabiaEngine:
                 sh.applied_upto = applied
                 sh.next_slot = max(sh.next_slot, applied)
                 sh.in_flight = False
+                self._apply_dirty.add(s)
                 sh.gc_upto(applied)
         # inherit the responder's dedup ledger: batches already applied via
         # the snapshot must never re-apply here if they commit again later.
@@ -1018,10 +1290,10 @@ class RabiaEngine:
         now = time.time()
         if now - self._last_heartbeat >= self.config.heartbeat_interval:
             self._last_heartbeat = now
-            total_applied = sum(sh.applied_upto for sh in self.rt.shards)
+            total_applied = int(self.rt.applied_upto.sum())
             self._send(
                 HeartBeat(
-                    current_phase=max(sh.next_slot for sh in self.rt.shards),
+                    current_phase=int(self.rt.next_slot.max(initial=0)),
                     committed_phase=total_applied,
                 )
             )
@@ -1106,12 +1378,12 @@ class RabiaEngine:
             return
         snap = self.sm.create_snapshot()
         state = PersistedEngineState(
-            current_phase=max(sh.next_slot for sh in self.rt.shards),
-            last_committed_phase=sum(sh.applied_upto for sh in self.rt.shards),
+            current_phase=int(self.rt.next_slot.max(initial=0)),
+            last_committed_phase=int(self.rt.applied_upto.sum()),
             state_version=self.rt.state_version,
             snapshot=snap,
-            per_shard_phase=[sh.next_slot for sh in self.rt.shards],
-            per_shard_committed=[sh.applied_upto for sh in self.rt.shards],
+            per_shard_phase=self.rt.next_slot.tolist(),
+            per_shard_committed=self.rt.applied_upto.tolist(),
         )
         await self.persistence.save_engine_state(state)
 

@@ -75,5 +75,13 @@ def slot_proposer(shard: int, slot: int, n_replicas: int) -> int:
     coordination, and consecutive slots of one shard rotate through all
     replicas so a crashed proposer only costs its own slots (which decide V0
     by timeout and move on).
+
+    Keep :func:`slot_proposer_vec` in lockstep with any change here — the
+    engine's columnar scans use the vectorized form.
     """
     return (shard + slot) % n_replicas
+
+
+def slot_proposer_vec(shards, slots, n_replicas: int):
+    """Vectorized :func:`slot_proposer` over numpy shard/slot arrays."""
+    return (shards + slots) % n_replicas

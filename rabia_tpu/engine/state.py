@@ -5,10 +5,18 @@ atomic phase counters (:14-29), CAS-monotonic `commit_phase` (:65-103),
 pending-batch map (:144-150), phase GC (:191-243) and `EngineStatistics`
 (:268-292). The reference guards this state with atomics/DashMaps because N
 tokio tasks mutate it; here the engine is a single asyncio task per node, so
-plain Python structures suffice — the *device* arrays hold the hot consensus
-state (SURVEY.md §7.1) and this module holds everything that stays on host:
-batch payloads, vote buffers for not-yet-current (slot, phase) pairs, the
-decided-slot ledger, and response futures.
+plain Python structures suffice.
+
+Layout: the per-shard *scalar* fields (slot counters, in-flight flags,
+progress clocks, queue lengths, taint horizons) live in **columnar numpy
+arrays** on :class:`EngineRuntime` — the engine's round loop scans them
+with bulk array ops instead of per-shard Python iteration, which is what
+lets one host process drive thousands of concurrent consensus shards
+(SURVEY.md §7.4.4). :class:`ShardRuntime` exposes the same fields as
+attribute views into the arrays, so event-path code (and tests) read/write
+them per shard exactly as before. Irregular per-slot state (batch payloads,
+decision records, response futures) stays in per-shard dicts — touched only
+on events, never in round scans.
 """
 
 from __future__ import annotations
@@ -18,6 +26,8 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
+
+import numpy as np
 
 from rabia_tpu.core.types import BatchId, CommandBatch, NodeId, StateValue
 
@@ -65,23 +75,130 @@ class PendingSubmission:
     first_forwarded_at: float = 0.0  # first forward for the CURRENT slot
 
 
+class _TrackedQueue(deque):
+    """Per-shard submission queue that mirrors its length into the
+    runtime's columnar ``queue_len`` array (and resets the head-forward
+    clock cache when the head changes), so round scans never touch the
+    deques."""
+
+    __slots__ = ("_rt", "_s")
+
+    def __init__(self, rt: "EngineRuntime", shard: int):
+        super().__init__()
+        self._rt = rt
+        self._s = shard
+
+    def _sync(self) -> None:
+        self._rt.queue_len[self._s] = len(self)
+        self._rt.head_fwd_at[self._s] = 0.0
+
+    def append(self, item) -> None:
+        super().append(item)
+        self._sync()
+
+    def appendleft(self, item) -> None:
+        super().appendleft(item)
+        self._sync()
+
+    def popleft(self):
+        item = super().popleft()
+        self._sync()
+        return item
+
+    def pop(self):
+        item = super().pop()
+        self._sync()
+        return item
+
+    def __delitem__(self, i) -> None:
+        super().__delitem__(i)
+        self._sync()
+
+    def remove(self, item) -> None:
+        super().remove(item)
+        self._sync()
+
+    def clear(self) -> None:
+        super().clear()
+        self._sync()
+
+
+class _FlagDict(dict):
+    """Dict that mirrors its non-emptiness into a columnar bool array, so
+    round scans can ask "any shard with a buffered X?" in one array op."""
+
+    __slots__ = ("_flags", "_s")
+
+    def __init__(self, flags: np.ndarray, shard: int):
+        super().__init__()
+        self._flags = flags
+        self._s = shard
+
+    def _sync(self) -> None:
+        self._flags[self._s] = bool(self)
+
+    def __setitem__(self, k, v) -> None:
+        super().__setitem__(k, v)
+        self._flags[self._s] = True
+
+    def setdefault(self, k, default=None):
+        r = super().setdefault(k, default)
+        self._flags[self._s] = True
+        return r
+
+    def __delitem__(self, k) -> None:
+        super().__delitem__(k)
+        self._sync()
+
+    def pop(self, *a):
+        r = super().pop(*a)
+        self._sync()
+        return r
+
+    def clear(self) -> None:
+        super().clear()
+        self._sync()
+
+    def update(self, *a, **kw) -> None:
+        super().update(*a, **kw)
+        self._sync()
+
+
+def _col_property(name: str):
+    """An attribute view into EngineRuntime's columnar array ``name``."""
+
+    def fget(self):
+        return self._rt_arrays[name][self.shard].item()
+
+    def fset(self, value):
+        self._rt_arrays[name][self.shard] = value
+
+    return property(fget, fset)
+
+
 class ShardRuntime:
     """Per-shard host bookkeeping around the device arrays.
 
-    Vote buffers hold votes for (slot, phase) pairs the kernel hasn't
-    reached yet; each round the engine re-offers the current pair's buffered
-    votes to the kernel inbox (the ledger ignores duplicates), which makes
-    local delivery idempotent and loss-tolerant.
+    Scalar fields are views into :class:`EngineRuntime`'s columnar arrays
+    (see module doc); dict fields hold irregular per-slot state.
     """
 
-    def __init__(self, shard: int) -> None:
+    __slots__ = (
+        "shard",
+        "_rt_arrays",
+        "queue",
+        "payloads",
+        "applied_ids",
+        "applied_results",
+        "decisions",
+        "buf_decision",
+        "buf_propose",
+    )
+
+    def __init__(self, shard: int, rt: "EngineRuntime") -> None:
         self.shard = shard
-        self.next_slot: int = 0  # next slot index to open locally
-        self.applied_upto: int = 0  # slots [0, applied_upto) applied
-        self.in_flight: bool = False  # kernel currently deciding a slot here
-        self.opened_at: float = 0.0  # when the in-flight slot started
-        self.last_progress: float = 0.0  # last observed phase/stage change
-        self.queue: deque[PendingSubmission] = deque()  # to propose here
+        self._rt_arrays = rt.columns
+        self.queue: _TrackedQueue = _TrackedQueue(rt, shard)
         # payloads keyed by batch id (immutable content per id), so a late
         # re-Propose can never swap the bytes a decided slot will apply
         self.payloads: dict[BatchId, CommandBatch] = {}
@@ -95,32 +212,28 @@ class ShardRuntime:
         # ledger so evicting a cached response can never re-enable a
         # duplicate apply
         self.applied_results: dict[BatchId, Optional[list[bytes]]] = {}
-        # restart-equivocation guard: slots < tainted_upto may have received
-        # votes from this replica before a crash; they must not be re-voted,
-        # only adopted via peer Decisions or snapshot sync (see engine
-        # _open_slots)
-        self.tainted_upto: int = 0
-        # any vote traffic observed for a tainted slot since restore —
-        # peers are actively deciding, so the taint must not time out
-        self.taint_traffic: bool = False
         self.decisions: dict[int, SlotRecord] = {}
-        # vote buffers: (slot, phase) -> {sender_row: vote_code}
-        self.buf_r1: dict[tuple[int, int], dict[int, int]] = {}
-        self.buf_r2: dict[tuple[int, int], dict[int, int]] = {}
         # decision notices not yet consumed: slot -> (value_code, batch_id)
-        self.buf_decision: dict[int, tuple[int, Optional[BatchId]]] = {}
+        self.buf_decision: _FlagDict = _FlagDict(rt.dec_flag, shard)
         # proposals seen for slots not yet opened: slot -> (batch_id, batch)
-        self.buf_propose: dict[int, tuple[BatchId, Optional[CommandBatch]]] = {}
+        self.buf_propose: _FlagDict = _FlagDict(rt.prop_flag, shard)
+
+    # columnar scalar views (same names/semantics as the round-1 fields)
+    next_slot = _col_property("next_slot")
+    applied_upto = _col_property("applied_upto")
+    in_flight = _col_property("in_flight")
+    opened_at = _col_property("opened_at")
+    last_progress = _col_property("last_progress")
+    tainted_upto = _col_property("tainted_upto")
+    taint_traffic = _col_property("taint_traffic")
 
     def gc_upto(self, slot: int) -> None:
         """Drop buffered state for every slot < `slot` (state.rs:191-243
         phase-GC analog; payloads/decisions for applied slots are kept only
         until applied)."""
-        for d in (self.buf_r1, self.buf_r2):
-            for k in [k for k in d if k[0] < slot]:
-                del d[k]
         for d2 in (self.buf_decision, self.buf_propose):
-            for k in [k for k in d2 if k < slot]:
+            stale = [k for k in d2 if k < slot]
+            for k in stale:
                 del d2[k]
         # payloads for already-applied batches are no longer needed
         for bid in [b for b in self.payloads if b in self.applied_ids]:
@@ -131,10 +244,40 @@ class ShardRuntime:
 
 
 class EngineRuntime:
-    """All shards' host state plus cluster-level counters."""
+    """All shards' host state plus cluster-level counters.
+
+    The columnar arrays are the authoritative store for per-shard scalars;
+    ``shards[s]`` exposes them as attributes.
+    """
 
     def __init__(self, n_shards: int) -> None:
-        self.shards = [ShardRuntime(s) for s in range(n_shards)]
+        S = n_shards
+        self.n = S
+        self.next_slot = np.zeros(S, np.int64)
+        self.applied_upto = np.zeros(S, np.int64)
+        self.in_flight = np.zeros(S, bool)
+        self.opened_at = np.zeros(S, np.float64)
+        self.last_progress = np.zeros(S, np.float64)
+        self.tainted_upto = np.zeros(S, np.int64)
+        self.taint_traffic = np.zeros(S, bool)
+        self.queue_len = np.zeros(S, np.int64)
+        # scan caches (not authoritative): highest slot with foreign vote
+        # traffic per shard; head-of-queue last-forward clock
+        self.votes_seen_slot = np.full(S, -1, np.int64)
+        self.head_fwd_at = np.zeros(S, np.float64)
+        # buffered propose/decision non-emptiness flags (_FlagDict mirrors)
+        self.prop_flag = np.zeros(S, bool)
+        self.dec_flag = np.zeros(S, bool)
+        self.columns = {
+            "next_slot": self.next_slot,
+            "applied_upto": self.applied_upto,
+            "in_flight": self.in_flight,
+            "opened_at": self.opened_at,
+            "last_progress": self.last_progress,
+            "tainted_upto": self.tainted_upto,
+            "taint_traffic": self.taint_traffic,
+        }
+        self.shards = [ShardRuntime(s, self) for s in range(S)]
         self.active_nodes: set[NodeId] = set()
         self.has_quorum: bool = False
         self.is_active: bool = True
@@ -149,11 +292,11 @@ class EngineRuntime:
     def stats(self, node_id: NodeId) -> EngineStatistics:
         return EngineStatistics(
             node_id=node_id,
-            current_slot_max=max((sh.next_slot for sh in self.shards), default=0),
-            committed_slots=sum(sh.applied_upto for sh in self.shards),
+            current_slot_max=int(self.next_slot.max(initial=0)),
+            committed_slots=int(self.applied_upto.sum()),
             decided_v1=self.decided_v1,
             decided_v0=self.decided_v0,
-            pending_batches=sum(sh.pending_count() for sh in self.shards),
+            pending_batches=int(self.queue_len.sum()),
             active_nodes=len(self.active_nodes),
             has_quorum=self.has_quorum,
             state_version=self.state_version,

@@ -6,6 +6,10 @@ coin, decision): thousands of consensus instances evaluated as one array
 program over ``[shards, replicas]`` vote matrices.
 """
 
+from rabia_tpu.kernel.host_driver import (  # noqa: F401
+    HostNodeKernel,
+    HostNodeState,
+)
 from rabia_tpu.kernel.phase_driver import (  # noqa: F401
     ClusterKernel,
     ClusterState,
