@@ -404,6 +404,43 @@ class KVStore:
 
     # -- CRUD ---------------------------------------------------------------
 
+    def apply_set_bin_fast(self, b: bytes, now: float) -> Optional[bytes]:
+        """Fused fast path for one binary SET (the block lane's per-slot
+        common case): same semantics as :meth:`set` minus intermediate
+        objects. Returns None when the slow path must run (subscribers
+        present, limit checks fail, store full)."""
+        bus = self.notifications
+        if bus is not None and bus._subs:
+            return None
+        if len(b) < 3:
+            return None
+        klen = b[1] | (b[2] << 8)
+        vlen = len(b) - 3 - klen
+        cfg = self.config
+        if not (0 < klen <= cfg.max_key_length) or vlen < 0 or vlen > cfg.max_value_size:
+            return None
+        try:
+            key = b[3 : 3 + klen].decode()
+            value = b[3 + klen :].decode()
+        except UnicodeDecodeError:
+            return None  # slow path reports the malformed op
+        data = self._data
+        e = data.get(key)
+        v = self._version + 1
+        if e is None:
+            if len(data) >= cfg.max_keys:
+                return None
+            data[key] = ValueEntry(value, v, now, now)
+        else:
+            e.value = value
+            e.version = v
+            e.updated_at = now
+        self._version = v
+        st = self.stats
+        st.total_operations += 1
+        st.writes += 1
+        return b"\x00" + v.to_bytes(4, "little") + b"\x00"
+
     def set(self, key: str, value: str) -> KVResult:
         """Insert or update (store.rs:144-188)."""
         self._validate_key(key)
@@ -555,6 +592,178 @@ class KVStore:
 
 
 # ---------------------------------------------------------------------------
+# Compact binary op codec (the block lane's command format)
+# ---------------------------------------------------------------------------
+#
+# op:     u8 opcode (1=SET 2=GET 3=DEL 4=EXISTS) | u16 LE keylen | key utf8
+#         | value utf8 (SET only, rest of buffer)
+# result: u8 kind (0=success 1=not_found 2=error) | u32 LE version
+#         | value utf8 (rest; error text for kind=2)
+
+_OP_SET, _OP_GET, _OP_DEL, _OP_EXISTS, _OP_CLEAR = 1, 2, 3, 4, 5
+
+
+def encode_op_bin(op: KVOperation) -> bytes:
+    kb = op.key.encode()
+    head = bytes((_OP_CODE[op.op],)) + len(kb).to_bytes(2, "little") + kb
+    if op.op == KVOpType.Set:
+        return head + (op.value or "").encode()
+    return head
+
+
+_OP_CODE = {
+    KVOpType.Set: _OP_SET,
+    KVOpType.Get: _OP_GET,
+    KVOpType.Delete: _OP_DEL,
+    KVOpType.Exists: _OP_EXISTS,
+    KVOpType.Clear: _OP_CLEAR,
+}
+
+
+def encode_set_bin(key: str, value: str) -> bytes:
+    kb = key.encode()
+    return b"\x01" + len(kb).to_bytes(2, "little") + kb + value.encode()
+
+
+def _result_bin(kind: int, version: int, value: Optional[str] = None) -> bytes:
+    # kind u8 | version u32 LE | has_value u8 | value utf8 — the presence
+    # byte keeps "empty string value" distinct from "no value" (JSON parity)
+    head = bytes((kind,)) + (version & 0xFFFFFFFF).to_bytes(4, "little")
+    if value is None:
+        return head + b"\x00"
+    return head + b"\x01" + value.encode()
+
+
+_CODE_OP = {v: k for k, v in (
+    (KVOpType.Set, _OP_SET),
+    (KVOpType.Get, _OP_GET),
+    (KVOpType.Delete, _OP_DEL),
+    (KVOpType.Exists, _OP_EXISTS),
+    (KVOpType.Clear, _OP_CLEAR),
+)}
+
+
+def decode_op_bin(data: bytes) -> KVOperation:
+    try:
+        op = _CODE_OP[data[0]]
+        klen = int.from_bytes(data[1:3], "little")
+        key = data[3 : 3 + klen].decode()
+        value = data[3 + klen :].decode() if op == KVOpType.Set else None
+        return KVOperation(op, key, value)
+    except (KeyError, IndexError, UnicodeDecodeError) as e:
+        from rabia_tpu.core.errors import StateMachineError
+
+        raise StateMachineError(f"bad binary kv command: {e}") from None
+
+
+def decode_result_bin(data: bytes) -> KVResult:
+    kind = data[0]
+    version = int.from_bytes(data[1:5], "little")
+    value = data[6:].decode() if len(data) > 5 and data[5] else None
+    if kind == 0:
+        return KVResult.success(value=value, version=version or None)
+    if kind == 1:
+        return KVResult.not_found()
+    return KVResult.err(value or "error")
+
+
+def apply_ops_bin(store: "KVStore", ops, now: Optional[float] = None) -> list[bytes]:
+    """Bulk binary apply: semantics identical to the per-op CRUD calls
+    (validation, versioning, stats, notifications when subscribed) with the
+    per-op overhead amortized — one clock read per wave, notification
+    publish skipped when nobody subscribes, no intermediate KVResult
+    objects on the SET fast path. Non-SET / limit-violating ops fall back
+    to :func:`apply_op_bin` per op."""
+    if now is None:
+        now = time.time()
+    data = store._data
+    out: list[bytes] = []
+    v = store._version
+    bus = store.notifications
+    notify = bus is not None and bool(bus._subs)
+    cfg = store.config
+    max_klen = cfg.max_key_length
+    max_val = cfg.max_value_size
+    max_keys = cfg.max_keys
+    fast_writes = 0
+    for b in ops:
+        if b[:1] == b"\x01" and len(b) >= 3:
+            klen = b[1] | (b[2] << 8)
+            vlen = len(b) - 3 - klen
+            if 0 < klen <= max_klen and 0 <= vlen <= max_val:
+                try:
+                    key = b[3 : 3 + klen].decode()
+                    value = b[3 + klen :].decode()
+                except UnicodeDecodeError:
+                    store._version = v
+                    out.append(apply_op_bin(store, b))
+                    v = store._version
+                    continue
+                e = data.get(key)
+                if e is None:
+                    if len(data) >= max_keys:
+                        store._version = v
+                        out.append(apply_op_bin(store, b))
+                        v = store._version
+                        continue
+                    v += 1
+                    data[key] = ValueEntry(value, v, now, now)
+                    if notify:
+                        store._version = v
+                        store._notify(key, ChangeType.Created, None, value)
+                else:
+                    old = e.value
+                    v += 1
+                    e.value = value
+                    e.version = v
+                    e.updated_at = now
+                    if notify:
+                        store._version = v
+                        store._notify(key, ChangeType.Updated, old, value)
+                fast_writes += 1
+                out.append(b"\x00" + v.to_bytes(4, "little") + b"\x00")
+                continue
+        store._version = v
+        out.append(apply_op_bin(store, b))
+        v = store._version
+    store._version = v
+    store.stats.total_operations += fast_writes
+    store.stats.writes += fast_writes
+    return out
+
+
+def apply_op_bin(store: "KVStore", data: bytes) -> bytes:
+    """Apply one binary-encoded op against a store; binary result."""
+    try:
+        opcode = data[0]
+        klen = int.from_bytes(data[1:3], "little")
+        key = data[3 : 3 + klen].decode()
+        if opcode == _OP_SET:
+            res = store.set(key, data[3 + klen :].decode())
+            return _result_bin(0, res.version or 0)
+        if opcode == _OP_GET:
+            res = store.get(key)
+            if res.kind == KVResultKind.NotFound:
+                return _result_bin(1, 0)
+            return _result_bin(0, res.version or 0, res.value)
+        if opcode == _OP_DEL:
+            res = store.delete(key)
+            if res.kind == KVResultKind.NotFound:
+                return _result_bin(1, 0)
+            return _result_bin(0, res.version or 0, res.value)
+        if opcode == _OP_EXISTS:
+            res = store.exists(key)
+            return _result_bin(0, 0, res.value or "false")
+        if opcode == _OP_CLEAR:
+            return _result_bin(0, 0, str(store.clear()))
+        return _result_bin(2, 0, f"unknown opcode {opcode}")
+    except StoreError as e:
+        return _result_bin(2, 0, str(e))
+    except (IndexError, UnicodeDecodeError) as e:
+        return _result_bin(2, 0, f"malformed op: {e}")
+
+
+# ---------------------------------------------------------------------------
 # SMR bridge (smr_impl.rs:22-100)
 # ---------------------------------------------------------------------------
 
@@ -589,6 +798,8 @@ class KVStoreSMR(TypedStateMachine[KVOperation, KVResult, dict]):
         ).encode()
 
     def decode_command(self, data: bytes) -> KVOperation:
+        if data[:1] != b"{":
+            return decode_op_bin(data)
         try:
             doc = json.loads(data)
             return KVOperation(KVOpType(doc["op"]), doc.get("key", ""), doc.get("value"))
@@ -607,6 +818,8 @@ class KVStoreSMR(TypedStateMachine[KVOperation, KVResult, dict]):
         ).encode()
 
     def decode_response(self, data: bytes) -> KVResult:
+        if data[:1] != b"{":
+            return decode_result_bin(data)
         doc = json.loads(data)
         return KVResult(
             KVResultKind(doc["kind"]),
@@ -614,6 +827,27 @@ class KVStoreSMR(TypedStateMachine[KVOperation, KVResult, dict]):
             version=doc.get("version"),
             error=doc.get("error"),
         )
+
+    def apply_raw(self, data: bytes) -> bytes:
+        """Apply one encoded command without the JSON round-trip when it is
+        in the compact binary form (the block lane's format); JSON commands
+        take the typed path. Response is binary iff the command was."""
+        if data[:1] == b"{":
+            op = self.decode_command(data)
+            return self.encode_response(self.apply_command(op))
+        self._bump_version()
+        return apply_op_bin(self.store, data)
+
+    def apply_raw_many(self, ops, now: Optional[float] = None) -> list[bytes]:
+        """Bulk :meth:`apply_raw` (the block lane's per-shard wave)."""
+        if any(b[:1] == b"{" for b in ops):
+            return [self.apply_raw(b) for b in ops]
+        setattr(
+            self,
+            "_smr_version",
+            getattr(self, "_smr_version", 0) + len(ops),
+        )
+        return apply_ops_bin(self.store, ops, now)
 
     def serialize_state(self) -> bytes:
         return self.store.snapshot_bytes()

@@ -1,0 +1,183 @@
+"""Payload blocks: one proposer's whole cycle of batches, columnar.
+
+The scalar lane carries one ``Propose(CommandBatch)`` per (shard, slot) —
+fine for sparse traffic, hopeless for the dense lockstep case where a
+replica proposes for ~S/R shards *every cycle* (each scalar Propose costs a
+Python decode on every receiver). A :class:`PayloadBlock` packs all of a
+proposer's current-cycle batches into ONE broadcast message with columnar
+layout (shard/slot/count arrays + one concatenated command-bytes buffer),
+so binding, validation and routing on the receiver are bulk array ops and
+the per-command cost is two offsets and a byte-slice at apply time.
+
+No direct reference analog (the reference proposes one batch per phase —
+rabia-engine/src/engine.rs:312-347); this is the S-axis design of
+SURVEY.md §7.1 applied to the payload plane.
+
+Identity: a command inside a block has no UUID — its replicated identity
+is ``(block.id, shard)`` for the batch and the position ``j`` within the
+shard's region for the command. ``block_batch_id(block_id, shard)`` builds
+the hashable dedup key used wherever the scalar lane uses ``BatchId``.
+"""
+
+from __future__ import annotations
+
+import uuid
+import zlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+from rabia_tpu.core.errors import ValidationError
+from rabia_tpu.core.types import Command, CommandBatch, ShardId
+
+
+def block_batch_id(block_id: uuid.UUID, shard: int) -> tuple:
+    """Hashable replicated identity of one shard's batch inside a block."""
+    return ("blk", block_id.int, int(shard))
+
+
+class PayloadBlock:
+    """Columnar batch-of-batches covering a set of shards.
+
+    Arrays (parallel over the k covered shards):
+      - ``shards`` i64[k] — covered shard indices (unique);
+      - ``slots`` i64[k] — the decision slot each batch is bound to
+        (-1 until the proposer assigns slots at open time);
+      - ``counts`` i32[k] — commands per shard;
+    plus the command plane:
+      - ``cmd_sizes`` i64[total] — per-command byte length, shard-major;
+      - ``data`` bytes — concatenated command payloads.
+    """
+
+    __slots__ = (
+        "id",
+        "shards",
+        "slots",
+        "counts",
+        "cmd_sizes",
+        "data",
+        "_cmd_offsets",
+        "_shard_starts",
+    )
+
+    def __init__(
+        self,
+        block_id: uuid.UUID,
+        shards: np.ndarray,
+        slots: np.ndarray,
+        counts: np.ndarray,
+        cmd_sizes: np.ndarray,
+        data: bytes,
+    ) -> None:
+        self.id = block_id
+        self.shards = np.asarray(shards, np.int64)
+        self.slots = np.asarray(slots, np.int64)
+        self.counts = np.asarray(counts, np.int64)
+        self.cmd_sizes = np.asarray(cmd_sizes, np.int64)
+        self.data = data
+        if not (len(self.shards) == len(self.slots) == len(self.counts)):
+            raise ValidationError("block arrays must be parallel")
+        if int(self.counts.sum()) != len(self.cmd_sizes):
+            raise ValidationError("block counts disagree with cmd_sizes")
+        if int(self.cmd_sizes.sum()) != len(data):
+            raise ValidationError("block cmd_sizes disagree with data length")
+        self._cmd_offsets: Optional[np.ndarray] = None
+        self._shard_starts: Optional[np.ndarray] = None
+
+    # -- derived indices ------------------------------------------------------
+
+    @property
+    def cmd_offsets(self) -> np.ndarray:
+        """i64[total+1] byte offset of each command in ``data``."""
+        if self._cmd_offsets is None:
+            self._cmd_offsets = np.concatenate(
+                ([0], np.cumsum(self.cmd_sizes))
+            )
+        return self._cmd_offsets
+
+    @property
+    def shard_starts(self) -> np.ndarray:
+        """i64[k+1] first command index of each covered shard."""
+        if self._shard_starts is None:
+            self._shard_starts = np.concatenate(([0], np.cumsum(self.counts)))
+        return self._shard_starts
+
+    def __len__(self) -> int:
+        return len(self.shards)
+
+    @property
+    def total_commands(self) -> int:
+        return len(self.cmd_sizes)
+
+    def checksum(self) -> int:
+        return zlib.crc32(self.data) & 0xFFFFFFFF
+
+    # -- per-shard access -----------------------------------------------------
+
+    def commands_for(self, i: int) -> list[bytes]:
+        """Command payload bytes of covered-shard index ``i`` (slices)."""
+        starts = self.shard_starts
+        offs = self.cmd_offsets
+        lo, hi = int(starts[i]), int(starts[i + 1])
+        return [
+            self.data[int(offs[j]) : int(offs[j + 1])] for j in range(lo, hi)
+        ]
+
+    def batch_id_for(self, i: int) -> tuple:
+        return block_batch_id(self.id, int(self.shards[i]))
+
+    def materialize_batch(self, i: int) -> CommandBatch:
+        """Build a scalar-lane CommandBatch for covered-shard index ``i``
+        (demotion/fallback path). Command UUIDs are freshly generated and
+        therefore NOT replicated — consumers must not let responses depend
+        on command ids (none of the built-in SMs do)."""
+        cmds = tuple(Command.new(b) for b in self.commands_for(i))
+        return CommandBatch.new(list(cmds), shard=ShardId(int(self.shards[i])))
+
+    def subset(self, idxs: np.ndarray) -> "PayloadBlock":
+        """A new block covering only the given covered-shard indices (used
+        when an open wave covers part of the block). Shares the id — batch
+        identities are per (id, shard), so a subset stays consistent."""
+        idxs = np.asarray(idxs, np.int64)
+        starts = self.shard_starts
+        offs = self.cmd_offsets
+        pieces = []
+        sizes = []
+        for i in idxs:
+            lo, hi = int(starts[i]), int(starts[i + 1])
+            pieces.append(self.data[int(offs[lo]) : int(offs[hi])])
+            sizes.append(self.cmd_sizes[lo:hi])
+        return PayloadBlock(
+            self.id,
+            self.shards[idxs],
+            self.slots[idxs],
+            self.counts[idxs],
+            np.concatenate(sizes) if sizes else np.zeros(0, np.int64),
+            b"".join(pieces),
+        )
+
+
+def build_block(
+    shards: Sequence[int] | np.ndarray,
+    commands: Sequence[Sequence[bytes]],
+    block_id: Optional[uuid.UUID] = None,
+) -> PayloadBlock:
+    """Assemble a block from per-shard command lists (client side)."""
+    shards = np.asarray(shards, np.int64)
+    if len(shards) != len(commands):
+        raise ValidationError("one command list per shard required")
+    if len(np.unique(shards)) != len(shards):
+        raise ValidationError("block shards must be unique")
+    counts = np.fromiter((len(c) for c in commands), np.int64, len(commands))
+    if len(counts) and int(counts.min()) < 1:
+        raise ValidationError("every covered shard needs >= 1 command")
+    flat: list[bytes] = [b for cs in commands for b in cs]
+    sizes = np.fromiter((len(b) for b in flat), np.int64, len(flat))
+    return PayloadBlock(
+        block_id or uuid.uuid4(),
+        shards,
+        np.full(len(shards), -1, np.int64),
+        counts,
+        sizes,
+        b"".join(flat),
+    )

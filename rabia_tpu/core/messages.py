@@ -23,6 +23,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from rabia_tpu.core.blocks import PayloadBlock
 from rabia_tpu.core.types import (
     BatchId,
     Command,
@@ -46,6 +47,7 @@ class MessageType(enum.IntEnum):
     NewBatch = 7
     HeartBeat = 8
     QuorumNotification = 9
+    ProposeBlock = 10
 
 
 # ---------------------------------------------------------------------------
@@ -223,6 +225,31 @@ class Decision:
         return f"Decision(n={len(self)})"
 
 
+@dataclass(frozen=True, eq=False)
+class ProposeBlock:
+    """One proposer's whole cycle of proposals, columnar (bulk lane).
+
+    ``block`` covers k shards with assigned slots; the proposer of every
+    (shard, slot) in it must be the sender (receivers verify with
+    ``slot_proposer_vec``). See :mod:`rabia_tpu.core.blocks`.
+    """
+
+    block: PayloadBlock
+
+    def __eq__(self, other) -> bool:
+        if type(other) is not ProposeBlock:
+            return False
+        a, b = self.block, other.block
+        return (
+            a.id == b.id
+            and np.array_equal(a.shards, b.shards)
+            and np.array_equal(a.slots, b.slots)
+            and np.array_equal(a.counts, b.counts)
+            and np.array_equal(a.cmd_sizes, b.cmd_sizes)
+            and a.data == b.data
+        )
+
+
 @dataclass(frozen=True)
 class SyncRequest:
     """Lagging node asks peers for state (messages.rs:108-112)."""
@@ -283,6 +310,7 @@ Payload = (
     | NewBatch
     | HeartBeat
     | QuorumNotification
+    | ProposeBlock
 )
 
 _PAYLOAD_TYPE = {
@@ -295,6 +323,7 @@ _PAYLOAD_TYPE = {
     NewBatch: MessageType.NewBatch,
     HeartBeat: MessageType.HeartBeat,
     QuorumNotification: MessageType.QuorumNotification,
+    ProposeBlock: MessageType.ProposeBlock,
 }
 
 

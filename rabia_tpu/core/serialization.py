@@ -29,6 +29,7 @@ from typing import Optional, Protocol
 
 import numpy as np
 
+from rabia_tpu.core.blocks import PayloadBlock
 from rabia_tpu.core.config import SerializationConfig
 from rabia_tpu.core.errors import SerializationError
 from rabia_tpu.core.messages import (
@@ -37,6 +38,7 @@ from rabia_tpu.core.messages import (
     HeartBeat,
     MessageType,
     NewBatch,
+    ProposeBlock,
     ProtocolMessage,
     Propose,
     QuorumNotification,
@@ -273,6 +275,18 @@ def _encode_payload(w: _Writer, payload) -> None:
         for shard, bid in payload.applied_ids:
             w.u32(shard)
             w.uuid(bid.value)
+    elif isinstance(payload, ProposeBlock):
+        b = payload.block
+        k = len(b)
+        w.uuid(b.id)
+        w.u32(k)
+        w.raw(b.shards.astype("<u4").tobytes())
+        w.raw(b.slots.astype("<u8").tobytes())
+        w.raw(b.counts.astype("<u4").tobytes())
+        w.u32(b.total_commands)
+        w.raw(b.cmd_sizes.astype("<u4").tobytes())
+        w.blob(b.data)
+        w.u32(b.checksum())
     elif isinstance(payload, NewBatch):
         w.u32(payload.shard)
         _write_batch(w, payload.batch)
@@ -331,6 +345,23 @@ def _decode_payload(msg_type: MessageType, r: _Reader):
         n_ids = r.u32()
         applied = tuple((r.u32(), BatchId(r.uuid())) for _ in range(n_ids))
         return SyncResponse(phase, ver, snap, per_shard, applied)
+    if msg_type == MessageType.ProposeBlock:
+        bid = r.uuid()
+        k = r.u32()
+        shards = np.frombuffer(r._take(4 * k), "<u4").astype(np.int64)
+        slots = np.frombuffer(r._take(8 * k), "<u8").astype(np.int64)
+        counts = np.frombuffer(r._take(4 * k), "<u4").astype(np.int64)
+        total = r.u32()
+        sizes = np.frombuffer(r._take(4 * total), "<u4").astype(np.int64)
+        data = r.blob()
+        checksum = r.u32()
+        if (zlib.crc32(data) & 0xFFFFFFFF) != checksum:
+            raise SerializationError("block data checksum mismatch")
+        try:
+            block = PayloadBlock(bid, shards, slots, counts, sizes, data)
+        except Exception as e:
+            raise SerializationError(f"malformed block: {e}") from None
+        return ProposeBlock(block=block)
     if msg_type == MessageType.NewBatch:
         return NewBatch(shard=r.u32(), batch=_read_batch(r))
     if msg_type == MessageType.HeartBeat:
@@ -357,8 +388,16 @@ class BinarySerializer:
         body = body_w.getvalue()
 
         flags = 0
+        # compress only scalar payload-bearing bodies: snapshots and batch
+        # carriers. Consensus-round traffic (vote/decision vectors, blocks)
+        # is latency-critical and decodes via frombuffer — zlib on every
+        # round would dominate the hot path
+        compressible = isinstance(
+            msg.payload, (Propose, NewBatch, SyncResponse)
+        )
         if (
-            self.config.compression_threshold
+            compressible
+            and self.config.compression_threshold
             and len(body) > self.config.compression_threshold
         ):
             compressed = zlib.compress(body, level=1)
@@ -418,6 +457,13 @@ class BinarySerializer:
 def _jsonify(obj):
     if isinstance(obj, np.ndarray):
         return obj.tolist()
+    if isinstance(obj, PayloadBlock):
+        return {
+            "block_id": str(obj.id),
+            "covered_shards": len(obj),
+            "total_commands": obj.total_commands,
+            "data_bytes": len(obj.data),
+        }
     if isinstance(obj, (VoteRound1, VoteRound2)):
         return {"votes": _jsonify(obj.votes)}
     if isinstance(obj, Decision):
@@ -543,6 +589,10 @@ def estimate_serialized_size(msg: ProtocolMessage) -> int:
     if isinstance(p, Propose):
         b = p.batch.total_size() + 40 * len(p.batch) if p.batch else 0
         return base + 29 + b
+    if isinstance(p, ProposeBlock):
+        return base + 28 + 16 * len(p.block) + 4 * p.block.total_commands + len(
+            p.block.data
+        )
     if isinstance(p, NewBatch):
         return base + 4 + p.batch.total_size() + 40 * len(p.batch)
     if isinstance(p, SyncResponse):

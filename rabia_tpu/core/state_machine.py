@@ -84,6 +84,25 @@ class StateMachine(abc.ABC):
         """Cheap human-readable state digest (for logs/tests)."""
 
 
+class VectorStateMachine(abc.ABC):
+    """Optional bulk-apply capability for the engine's block lane.
+
+    A :class:`StateMachine` additionally implementing this interface
+    receives a whole decided :class:`~rabia_tpu.core.blocks.PayloadBlock`
+    wave in ONE call — the apply-side analog of the columnar consensus
+    path. Engines fall back to per-shard ``apply_batch`` (with materialized
+    batches) when the state machine doesn't implement it.
+
+    Determinism contract is unchanged: responses must be a pure function of
+    the applied command sequence (never of transport/timing/ids).
+    """
+
+    @abc.abstractmethod
+    def apply_block(self, block, idxs) -> list[list[bytes]]:
+        """Apply covered-shard indices ``idxs`` (numpy int array) of
+        ``block`` in order; return one response list per index."""
+
+
 class InMemoryStateMachine(StateMachine):
     """Reference dict state machine parsing SET/GET/DEL text commands.
 

@@ -16,6 +16,7 @@ from rabia_tpu.core.messages import (
     Decision,
     HeartBeat,
     NewBatch,
+    ProposeBlock,
     ProtocolMessage,
     Propose,
     SyncRequest,
@@ -49,6 +50,8 @@ class MessageValidator:
         payload = msg.payload
         if isinstance(payload, Propose):
             self._validate_propose(payload)
+        elif isinstance(payload, ProposeBlock):
+            self._validate_block(payload)
         elif isinstance(payload, (VoteRound1, VoteRound2)):
             self._validate_votes(payload)
         elif isinstance(payload, Decision):
@@ -98,6 +101,23 @@ class MessageValidator:
     def _validate_phase(self, phase: int) -> None:
         if phase < 0:
             raise ValidationError(f"negative phase {phase}")
+
+    def _validate_block(self, p: ProposeBlock) -> None:
+        b = p.block
+        if len(b) == 0:
+            raise ValidationError("block must cover at least one shard")
+        if int(b.shards.min()) < 0:
+            raise ValidationError("negative shard index in block")
+        if int(b.slots.min()) < 0:
+            raise ValidationError("block slots must be assigned (>= 0)")
+        if int(b.counts.max()) > self.config.max_commands_per_batch:
+            raise ValidationError(
+                f"block shard batch exceeds {self.config.max_commands_per_batch} commands"
+            )
+        if b.total_commands and int(b.cmd_sizes.max()) > self.config.max_command_size:
+            raise ValidationError(
+                f"block command exceeds {self.config.max_command_size} bytes"
+            )
 
     # -- batches (validation.rs:126-180) -----------------------------------
 

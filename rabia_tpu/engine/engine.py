@@ -46,6 +46,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from rabia_tpu.core.blocks import PayloadBlock, block_batch_id
 from rabia_tpu.core.config import RabiaConfig
 from rabia_tpu.core.errors import QuorumNotAvailableError, RabiaError, ValidationError
 from rabia_tpu.core.messages import (
@@ -53,6 +54,7 @@ from rabia_tpu.core.messages import (
     DecisionEntry,
     HeartBeat,
     NewBatch,
+    ProposeBlock,
     ProtocolMessage,
     Propose,
     SyncRequest,
@@ -64,7 +66,7 @@ from rabia_tpu.core.messages import (
 from rabia_tpu.core.network import ClusterConfig, NetworkMonitor, NetworkTransport
 from rabia_tpu.core.persistence import PersistedEngineState, PersistenceLayer
 from rabia_tpu.core.serialization import Serializer
-from rabia_tpu.core.state_machine import StateMachine
+from rabia_tpu.core.state_machine import StateMachine, VectorStateMachine
 from rabia_tpu.core.types import (
     ABSENT,
     V0,
@@ -88,6 +90,40 @@ logger = logging.getLogger("rabia_tpu.engine")
 
 _MAX_SUBMIT_ATTEMPTS = 3
 _MVC_MASK = (1 << 16) - 1
+
+
+class _OutBlock:
+    """Proposer-side pending block: aggregates per-shard outcomes into one
+    client future (one response list — or Exception — per covered shard)."""
+
+    __slots__ = ("block", "future", "responses", "remaining", "created_at")
+
+    def __init__(self, block: PayloadBlock, future: asyncio.Future):
+        self.block = block
+        self.future = future
+        self.responses: list = [None] * len(block)
+        self.remaining = len(block)
+        self.created_at = time.time()
+
+    def settle(self, i: int, outcome) -> None:
+        if self.responses[i] is None:
+            self.responses[i] = outcome
+            self.remaining -= 1
+            if self.remaining == 0 and self.future is not None and not self.future.done():
+                self.future.set_result(self.responses)
+
+
+class _BlockRef:
+    """Registry record for a live block (incoming or our own)."""
+
+    __slots__ = ("block", "out", "src_row", "remaining", "registered_at")
+
+    def __init__(self, block: PayloadBlock, out, src_row: int):
+        self.block = block
+        self.out = out
+        self.src_row = src_row
+        self.remaining = len(block)
+        self.registered_at = time.time()
 
 
 class RabiaEngine:
@@ -154,6 +190,19 @@ class RabiaEngine:
         self._shard_ids = np.arange(self.S, dtype=np.int64)
         self._apply_dirty: set[int] = set()
 
+        # block lane (bulk proposals — rabia_tpu.core.blocks):
+        # registry of live blocks by small int handle; columnar bindings
+        self._blk_registry: dict[int, _BlockRef] = {}
+        self._blk_next_ref = 1
+        self._blk_pending_ref = np.full(self.S, -1, np.int64)
+        self._blk_pending_idx = np.zeros(self.S, np.int64)
+        self._blk_pending_slot = np.full(self.S, -1, np.int64)
+        self._cur_blk_ref = np.full(self.S, -1, np.int64)
+        self._cur_blk_idx = np.zeros(self.S, np.int64)
+        self._pending_block_announces: list[ProposeBlock] = []
+        self._last_blk_retransmit: dict[int, float] = {}
+        self._is_vector_sm = isinstance(state_machine, VectorStateMachine)
+
         # write-ahead vote barrier: _barrier[s] is persisted BEFORE this
         # replica's first vote in any slot >= the previous barrier, so a
         # restart knows exactly which slots may hold its pre-crash votes
@@ -204,6 +253,89 @@ class RabiaEngine:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self.rt.shards[s].queue.append(PendingSubmission(batch=batch, future=fut))
         return fut
+
+    async def submit_block(self, block: PayloadBlock) -> asyncio.Future:
+        """Accept a columnar block of batches (one per covered shard) for
+        consensus — the bulk lane. Returns ONE future resolving to a list
+        with one entry per covered shard: the response list, or an
+        Exception instance for shards whose batch failed.
+
+        Shards where this replica is the upcoming proposer ride the block
+        fast path (one ProposeBlock broadcast, vectorized open/decide/
+        apply); the rest demote to the scalar queue and are forwarded to
+        their proposers as usual."""
+        if not self.rt.has_quorum:
+            raise QuorumNotAvailableError(
+                f"no quorum ({len(self.rt.active_nodes)}/{self.cluster.quorum_size})"
+            )
+        if len(block) == 0:
+            raise ValidationError("empty block")
+        if int(block.shards.max()) >= self.n_shards:
+            raise ValidationError("block shard out of range")
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        out = _OutBlock(block, fut)
+        ref = self._register_block(block, out, self.me)
+        shards = block.shards
+        head = np.maximum(
+            self.rt.next_slot[shards], self.rt.applied_upto[shards]
+        )
+        elig = (
+            (slot_proposer_vec(shards, head, self.R) == self.me)
+            & ~self.rt.in_flight[shards]
+            & (self.rt.queue_len[shards] == 0)
+            & ~self.rt.prop_flag[shards]
+            & (self._blk_pending_ref[shards] == -1)
+            & (self._cur_blk_ref[shards] == -1)
+            & (head >= self.rt.tainted_upto[shards])
+        )
+        idxe = np.nonzero(elig)[0]
+        if len(idxe):
+            sh_e = shards[idxe]
+            block.slots[idxe] = head[idxe]
+            self._blk_pending_ref[sh_e] = ref
+            self._blk_pending_idx[sh_e] = idxe
+            self._blk_pending_slot[sh_e] = head[idxe]
+        for i in np.nonzero(~elig)[0]:
+            self._demote_block_entry(ref, int(i))
+        return fut
+
+    def _register_block(self, block: PayloadBlock, out, src_row: int) -> int:
+        ref = self._blk_next_ref
+        self._blk_next_ref += 1
+        self._blk_registry[ref] = _BlockRef(block, out, src_row)
+        return ref
+
+    def _unref_block(self, ref: int, count: int) -> None:
+        rec = self._blk_registry.get(ref)
+        if rec is None:
+            return
+        rec.remaining -= count
+        if rec.remaining <= 0:
+            del self._blk_registry[ref]
+            self._last_blk_retransmit.pop(ref, None)
+
+    def _demote_block_entry(self, ref: int, i: int) -> None:
+        """Route one covered shard of a block through the scalar lane
+        (ineligible at submit, V0 retry, or out-of-order decide)."""
+        rec = self._blk_registry.get(ref)
+        if rec is None:
+            return
+        block = rec.block
+        s = int(block.shards[i])
+        batch = block.materialize_batch(i)
+        subfut: asyncio.Future = asyncio.get_event_loop().create_future()
+        out = rec.out
+
+        if out is not None:
+
+            def _settle(f: asyncio.Future, i=i, out=out):
+                out.settle(i, f.exception() if f.exception() else f.result())
+
+            subfut.add_done_callback(_settle)
+        self.rt.shards[s].queue.append(
+            PendingSubmission(batch=batch, future=subfut)
+        )
+        self._unref_block(ref, 1)
 
     async def get_statistics(self) -> EngineStatistics:
         return self.rt.stats(self.node_id)
@@ -315,16 +447,17 @@ class RabiaEngine:
     async def _tick(self) -> bool:
         got_msgs = await self._drain_messages()
         self._forward_submissions()
+        bulk = self._open_block_slots()
         opened = self._open_slots()
         stepped = False
-        if opened or got_msgs or self._anything_in_flight():
-            await self._kernel_round(opened)
+        if opened or bulk is not None or got_msgs or self._anything_in_flight():
+            await self._kernel_round(opened, bulk)
             stepped = True
         applied = self._apply_ready()
         self._check_timeouts()
         if applied and self.persistence is not None:
             self._dirty = True
-        return bool(got_msgs or opened or applied) and stepped
+        return bool(got_msgs or opened or bulk is not None or applied) and stepped
 
     def _anything_in_flight(self) -> bool:
         return bool(self.rt.in_flight[: self.n_shards].any())
@@ -379,6 +512,8 @@ class RabiaEngine:
             self._ingest_vote_arrays(row, p.shards, p.phases, p.vals, 2)
         elif isinstance(p, Decision):
             self._on_decision(p)
+        elif isinstance(p, ProposeBlock):
+            self._on_propose_block(row, p)
         elif isinstance(p, Propose):
             self._on_propose(row, p)
         elif isinstance(p, NewBatch):
@@ -427,6 +562,179 @@ class RabiaEngine:
             # a late payload/binding may have just unwedged apply — the
             # apply scan is dirty-set driven, so re-mark the shard
             self._apply_dirty.add(p.shard)
+
+    def _on_propose_block(self, row: int, p: ProposeBlock) -> None:
+        """Receiver side of the bulk lane: bind the block's (shard, slot)
+        proposals columnar; shards whose slot is current open V1 on the
+        next tick's bulk open pass."""
+        b = p.block
+        n = self.n_shards
+        # bounds-filter BEFORE any fancy indexing: wire shard indices are
+        # attacker-controlled and an out-of-range index would raise out of
+        # the drain loop
+        inb = (b.shards >= 0) & (b.shards < n)
+        if not inb.all():
+            if not inb.any():
+                return
+            b = b.subset(np.nonzero(inb)[0])
+        shards, slots = b.shards, b.slots
+        ok = (slot_proposer_vec(shards, slots, self.R) == row) & (
+            slots >= self.rt.applied_upto[shards]
+        )
+        # first binding wins: never displace an existing block or scalar
+        # binding for the shard's window. Duplicate/partial-wave announces
+        # of the same block id each register their own handle — bindings
+        # index into the exact announced subset, and already-bound shards
+        # are skipped here
+        free = (
+            (self._blk_pending_ref[shards] == -1)
+            & (self._cur_blk_ref[shards] == -1)
+            & ~self.rt.prop_flag[shards]
+        )
+        # only bind at-or-ahead of our head; behind-head slots are decided
+        # or being decided without the payload (repair rides Propose/sync)
+        head = np.maximum(
+            self.rt.next_slot[shards], self.rt.applied_upto[shards]
+        )
+        accept = ok & free & (slots >= head)
+        idxs = np.nonzero(accept)[0]
+        if len(idxs) == 0:
+            return
+        ref = self._register_block(b, None, row)
+        sh_a = shards[idxs]
+        self._blk_pending_ref[sh_a] = ref
+        self._blk_pending_idx[sh_a] = idxs
+        self._blk_pending_slot[sh_a] = slots[idxs]
+
+    def _open_block_slots(self):
+        """Vectorized bulk open: every shard whose pending block binding
+        matches its head slot starts consensus with vote V1 now.
+
+        Returns (idx, slots) arrays or None."""
+        n = self.n_shards
+        rt = self.rt
+        pend = self._blk_pending_slot[:n]
+        if not (pend >= 0).any():
+            return None
+        head = np.maximum(rt.next_slot[:n], rt.applied_upto[:n])
+        ready = (
+            (pend == head)
+            & ~rt.in_flight[:n]
+            & (rt.tainted_upto[:n] <= head)
+        )
+        if not ready.any():
+            return None
+        idx = np.nonzero(ready)[0]
+        self._cur_blk_ref[idx] = self._blk_pending_ref[idx]
+        self._cur_blk_idx[idx] = self._blk_pending_idx[idx]
+        self._blk_pending_ref[idx] = -1
+        self._blk_pending_slot[idx] = -1
+        now = time.time()
+        rt.in_flight[idx] = True
+        np.maximum.at(rt.next_slot, idx, head[idx])
+        rt.opened_at[idx] = now
+        rt.last_progress[idx] = now
+        # proposer side: announce blocks whose shards just opened (after
+        # the vote barrier — _kernel_round flushes the announces)
+        own = self._cur_blk_ref[idx]
+        own_refs = np.unique(own)
+        for ref in own_refs:
+            rec = self._blk_registry.get(int(ref))
+            if rec is None or rec.out is None:
+                continue
+            sel = idx[own == ref]
+            bidx = self._cur_blk_idx[sel]
+            if len(bidx) == len(rec.block):
+                announce = rec.block
+            else:
+                announce = rec.block.subset(bidx)
+            self._pending_block_announces.append(ProposeBlock(block=announce))
+        return idx, head[idx]
+
+    def _finish_block_slots(self, idx: np.ndarray) -> None:
+        """Vectorized decide+apply for block-bound shards: record
+        bookkeeping with array ops, group by block, bulk-apply V1 waves."""
+        rt = self.rt
+        slots = np.asarray(self._cur_slot)[idx].astype(np.int64)
+        vals = np.asarray(self._decided)[idx]
+        refs = self._cur_blk_ref[idx]
+        bidxs = self._cur_blk_idx[idx]
+
+        in_order = rt.applied_upto[idx] == slots
+        if not in_order.all():
+            # a sync overtook these shards mid-flight: route per shard
+            # through the scalar ledger (rare)
+            for j in np.nonzero(~in_order)[0]:
+                s = int(idx[j])
+                ref, bi = int(refs[j]), int(bidxs[j])
+                rec = self._blk_registry.get(ref)
+                if rec is not None:
+                    sh = rt.shards[s]
+                    bid = rec.block.batch_id_for(bi)
+                    sh.payloads[bid] = rec.block.materialize_batch(bi)
+                    sh.buf_propose.setdefault(int(slots[j]), (bid, None))
+                    if rec.out is not None:
+                        rec.out.settle(
+                            bi,
+                            RabiaError("block shard overtaken by sync"),
+                        )
+                    self._unref_block(ref, 1)
+                self._cur_blk_ref[s] = -1
+                self._record_decision(s, int(slots[j]), int(vals[j]), None)
+            keep = in_order
+            idx, slots, vals, refs, bidxs = (
+                idx[keep],
+                slots[keep],
+                vals[keep],
+                refs[keep],
+                bidxs[keep],
+            )
+            if len(idx) == 0:
+                return
+
+        v1 = vals == V1
+        # V0 (null) slots: nothing applies; the batch retries via the
+        # scalar lane (rotation moved to the next proposer)
+        if (~v1).any():
+            for j in np.nonzero(~v1)[0]:
+                self._demote_block_entry(int(refs[j]), int(bidxs[j]))
+        # V1 waves: group by block, apply in bulk
+        if v1.any():
+            v1_idx = np.nonzero(v1)[0]
+            wave_refs = refs[v1_idx]
+            for ref in np.unique(wave_refs):
+                rec = self._blk_registry.get(int(ref))
+                sel = v1_idx[wave_refs == ref]
+                bsel = bidxs[sel].astype(np.int64)
+                if rec is None:
+                    # block already GC'd (late duplicate decide) — skip
+                    continue
+                if self._is_vector_sm:
+                    responses = self.sm.apply_block(rec.block, bsel)
+                else:
+                    responses = [
+                        self.sm.apply_batch(rec.block.materialize_batch(int(bi)))
+                        for bi in bsel
+                    ]
+                if rec.out is not None:
+                    for bi, resp in zip(bsel, responses):
+                        rec.out.settle(int(bi), resp)
+                self._unref_block(int(ref), len(bsel))
+            rt.state_version += int(v1.sum())
+            self.rt.last_apply_time = time.time()
+
+        # columnar bookkeeping for the whole wave
+        rt.applied_upto[idx] = slots + 1
+        rt.next_slot[idx] = slots + 1
+        rt.in_flight[idx] = False
+        rt.opened_at[idx] = 0.0
+        rt.head_fwd_at[idx] = 0.0
+        self._cur_blk_ref[idx] = -1
+        n_v1 = int(v1.sum())
+        rt.decided_v1 += n_v1
+        rt.decided_v0 += len(idx) - n_v1
+        if self.persistence is not None and len(idx):
+            self._dirty = True
 
     # -- vote ingest (columnar) ---------------------------------------------
 
@@ -822,18 +1130,38 @@ class RabiaEngine:
 
     # -- the kernel round ----------------------------------------------------
 
-    async def _kernel_round(self, opened: list[tuple[int, int, int]]) -> None:
-        if opened:
-            await self._advance_vote_barrier(opened)
+    async def _kernel_round(
+        self,
+        opened: list[tuple[int, int, int]],
+        bulk: Optional[tuple[np.ndarray, np.ndarray]] = None,
+    ) -> None:
+        if opened or bulk is not None:
+            await self._advance_vote_barrier(opened, bulk)
         if self._pending_proposes:
             for pe in self._pending_proposes:
                 self._send(pe)
             self._pending_proposes.clear()
-        if opened:
-            k = len(opened)
-            idx = np.fromiter((o[0] for o in opened), np.int64, k)
-            slots_arr = np.fromiter((o[1] for o in opened), np.int64, k)
-            init_arr = np.fromiter((o[2] for o in opened), np.int8, k)
+        if self._pending_block_announces:
+            for pb in self._pending_block_announces:
+                self._send(pb)
+            self._pending_block_announces.clear()
+        if opened or bulk is not None:
+            if opened:
+                k = len(opened)
+                idx = np.fromiter((o[0] for o in opened), np.int64, k)
+                slots_arr = np.fromiter((o[1] for o in opened), np.int64, k)
+                init_arr = np.fromiter((o[2] for o in opened), np.int8, k)
+            else:
+                idx = np.zeros(0, np.int64)
+                slots_arr = np.zeros(0, np.int64)
+                init_arr = np.zeros(0, np.int8)
+            if bulk is not None:
+                b_idx, b_slots = bulk
+                idx = np.concatenate([idx, b_idx])
+                slots_arr = np.concatenate([slots_arr, b_slots])
+                init_arr = np.concatenate(
+                    [init_arr, np.full(len(b_idx), V1, np.int8)]
+                )
             mask = np.zeros(self.S, bool)
             mask[idx] = True
             slots_full = np.zeros(self.S, np.int64)
@@ -886,7 +1214,9 @@ class RabiaEngine:
         self._process_outbox(outbox, prev_phase)
 
     async def _advance_vote_barrier(
-        self, opened: list[tuple[int, int, int]]
+        self,
+        opened: list[tuple[int, int, int]],
+        bulk: Optional[tuple[np.ndarray, np.ndarray]] = None,
     ) -> None:
         """Persist the vote barrier BEFORE the first vote of any newly
         opened slot leaves this replica (write-ahead), so a post-crash
@@ -905,6 +1235,14 @@ class RabiaEngine:
         for s, slot, _v in opened:
             if slot >= self._barrier[s]:
                 self._barrier[s] = slot + stride
+                changed = True
+        if bulk is not None:
+            b_idx, b_slots = bulk
+            due = b_slots >= self._barrier[b_idx]
+            if due.any():
+                np.maximum.at(
+                    self._barrier, b_idx[due], b_slots[due] + stride
+                )
                 changed = True
         if changed:
             await self.persistence.save_aux(
@@ -980,7 +1318,10 @@ class RabiaEngine:
             dec_idx = np.nonzero(done)[0]
             decided_vals = np.asarray(self._decided)
             cur_slot = np.asarray(self._cur_slot)
-            for s in dec_idx:
+            blk = self._cur_blk_ref[dec_idx] != -1
+            if blk.any():
+                self._finish_block_slots(dec_idx[blk])
+            for s in dec_idx[~blk]:
                 s = int(s)
                 sh = rt.shards[s]
                 slot = int(cur_slot[s])
@@ -988,6 +1329,22 @@ class RabiaEngine:
                 bp = sh.buf_propose.get(slot)
                 if bp is not None:
                     bid = bp[0]
+                elif self._blk_pending_slot[s] == slot:
+                    ref = int(self._blk_pending_ref[s])
+                    rec_blk = self._blk_registry.get(ref)
+                    if rec_blk is not None and rec_blk.out is None:
+                        # a received block binding we never opened (e.g. we
+                        # voted V0 after grace before its ProposeBlock
+                        # arrived): use it as the payload source for the
+                        # decided slot
+                        bi = int(self._blk_pending_idx[s])
+                        bid = rec_blk.block.batch_id_for(bi)
+                        sh.payloads[bid] = rec_blk.block.materialize_batch(bi)
+                        self._unref_block(ref, 1)
+                        self._blk_pending_ref[s] = -1
+                        self._blk_pending_slot[s] = -1
+                    # our own never-announced pending entries stay put:
+                    # _record_decision voids them into the scalar retry lane
                 self._record_decision(s, slot, int(decided_vals[s]), bid)
             if newly.any():
                 # steady-state Decisions are bid-free (fully columnar both
@@ -1003,8 +1360,27 @@ class RabiaEngine:
                     )
                 )
 
+    def _void_pending_block(self, s: int) -> None:
+        """A slot a pending block binding targeted resolved without it:
+        release the binding. Our own never-announced entries retry through
+        the scalar lane (no peer ever saw them, so no duplicate risk);
+        received-block bindings are just dropped."""
+        ref = int(self._blk_pending_ref[s])
+        bi = int(self._blk_pending_idx[s])
+        self._blk_pending_ref[s] = -1
+        self._blk_pending_slot[s] = -1
+        rec = self._blk_registry.get(ref)
+        if rec is None:
+            return
+        if rec.out is not None:
+            self._demote_block_entry(ref, bi)
+        else:
+            self._unref_block(ref, 1)
+
     def _record_decision(self, s: int, slot: int, value: int, batch_id) -> None:
         sh = self.rt.shards[s]
+        if self._blk_pending_slot[s] != -1 and self._blk_pending_slot[s] <= slot:
+            self._void_pending_block(s)
         if slot in sh.decisions:
             rec = sh.decisions[slot]
         else:
@@ -1180,6 +1556,29 @@ class RabiaEngine:
                         batch=bp[1],
                     )
                 )
+        # stalled block-bound shards we proposed: rebroadcast the block
+        # (rate-limited per block) so peers that lost the ProposeBlock can
+        # bind and vote V1
+        stalled_refs = np.unique(self._cur_blk_ref[idxs])
+        for ref in stalled_refs:
+            ref = int(ref)
+            if ref == -1:
+                continue
+            rec = self._blk_registry.get(ref)
+            if rec is None or rec.out is None:
+                continue
+            if now - self._last_blk_retransmit.get(ref, 0.0) < timeout:
+                continue
+            self._last_blk_retransmit[ref] = now
+            # retransmit only the slot-assigned entries: demoted shards
+            # keep slot -1, which receivers' validators rightly reject
+            assigned = rec.block.slots >= 0
+            if assigned.all():
+                self._send(ProposeBlock(block=rec.block))
+            elif assigned.any():
+                self._send(
+                    ProposeBlock(block=rec.block.subset(np.nonzero(assigned)[0]))
+                )
         rt.last_progress[idxs] = now
 
     # -- sync protocol (engine.rs:748-844) -----------------------------------
@@ -1272,6 +1671,17 @@ class RabiaEngine:
                 sh.applied_upto = applied
                 sh.next_slot = max(sh.next_slot, applied)
                 sh.in_flight = False
+                # overtaken block bindings are void (the registry ages out)
+                if self._cur_blk_ref[s] != -1:
+                    rec = self._blk_registry.get(int(self._cur_blk_ref[s]))
+                    if rec is not None and rec.out is not None:
+                        rec.out.settle(
+                            int(self._cur_blk_idx[s]),
+                            RabiaError("block shard overtaken by sync"),
+                        )
+                    self._cur_blk_ref[s] = -1
+                if self._blk_pending_slot[s] != -1 and self._blk_pending_slot[s] < applied:
+                    self._void_pending_block(s)
                 self._apply_dirty.add(s)
                 sh.gc_upto(applied)
         # inherit the responder's dedup ledger: batches already applied via
@@ -1331,6 +1741,17 @@ class RabiaEngine:
         if now - self._last_cleanup >= self.config.cleanup_interval:
             self._last_cleanup = now
             self._gc()
+            # block registry GC: entries whose shards all resolved through
+            # other paths (sync overtake, V0 without binding) never hit
+            # remaining==0 — age them out
+            horizon = max(60.0, 4 * self.config.sync_timeout)
+            for ref in [
+                r
+                for r, rec in self._blk_registry.items()
+                if now - rec.registered_at > horizon
+            ]:
+                self._blk_registry.pop(ref)
+                self._last_blk_retransmit.pop(ref, None)
         if self._dirty:
             self._dirty = False
             await self._save_state()

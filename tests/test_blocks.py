@@ -1,0 +1,240 @@
+"""Block lane: PayloadBlock, ProposeBlock wire, engine bulk path, bulk
+service API, adaptive batching in the client path, binary kv op codec."""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+
+import numpy as np
+import pytest
+
+from rabia_tpu.apps import ShardedKVService, make_sharded_kv
+from rabia_tpu.apps.kvstore import (
+    KVOperation,
+    KVStore,
+    apply_op_bin,
+    apply_ops_bin,
+    decode_op_bin,
+    decode_result_bin,
+    encode_op_bin,
+    encode_set_bin,
+)
+from rabia_tpu.core.blocks import PayloadBlock, block_batch_id, build_block
+from rabia_tpu.core.config import BatchConfig, RabiaConfig
+from rabia_tpu.core.errors import ValidationError
+from rabia_tpu.core.messages import ProposeBlock, ProtocolMessage
+from rabia_tpu.core.network import ClusterConfig
+from rabia_tpu.core.serialization import Serializer
+from rabia_tpu.core.types import NodeId
+from rabia_tpu.engine import RabiaEngine
+from rabia_tpu.net import InMemoryHub
+
+
+class TestPayloadBlock:
+    def test_build_and_slicing(self):
+        blk = build_block(
+            [3, 7, 11],
+            [[b"a"], [b"bb", b"ccc"], [b"dddd"]],
+        )
+        assert len(blk) == 3
+        assert blk.total_commands == 4
+        assert blk.commands_for(0) == [b"a"]
+        assert blk.commands_for(1) == [b"bb", b"ccc"]
+        assert blk.commands_for(2) == [b"dddd"]
+        assert blk.batch_id_for(1) == block_batch_id(blk.id, 7)
+
+    def test_subset_shares_identity(self):
+        blk = build_block([1, 2, 3], [[b"x"], [b"yy"], [b"zzz"]])
+        sub = blk.subset(np.array([0, 2]))
+        assert sub.id == blk.id
+        assert sub.commands_for(1) == [b"zzz"]
+        assert list(sub.shards) == [1, 3]
+
+    def test_materialize_batch(self):
+        blk = build_block([5], [[b"cmd1", b"cmd2"]])
+        batch = blk.materialize_batch(0)
+        assert int(batch.shard) == 5
+        assert [c.data for c in batch.commands] == [b"cmd1", b"cmd2"]
+
+    def test_build_rejects_bad_shapes(self):
+        with pytest.raises(ValidationError):
+            build_block([1, 1], [[b"a"], [b"b"]])  # duplicate shard
+        with pytest.raises(ValidationError):
+            build_block([1], [[]])  # empty command list
+
+    def test_wire_roundtrip(self):
+        blk = build_block([0, 9], [[b"hello"], [b"wo", b"rld"]])
+        blk.slots[:] = [4, 5]
+        ser = Serializer()
+        msg = ProtocolMessage.new(NodeId.from_int(1), ProposeBlock(block=blk))
+        back = ser.deserialize(ser.serialize(msg))
+        assert back.payload == ProposeBlock(block=blk)
+        assert back.payload.block.commands_for(1) == [b"wo", b"rld"]
+
+    def test_wire_rejects_corrupt_data(self):
+        from rabia_tpu.core.errors import SerializationError
+
+        blk = build_block([0], [[b"hello"]])
+        blk.slots[:] = [0]
+        ser = Serializer()
+        raw = bytearray(
+            ser.serialize(
+                ProtocolMessage.new(NodeId.from_int(1), ProposeBlock(block=blk))
+            )
+        )
+        raw[-8] ^= 0xFF  # flip a data byte under the checksum
+        with pytest.raises(SerializationError):
+            ser.deserialize(bytes(raw))
+
+
+class TestBinaryOpCodec:
+    def test_roundtrip_all_ops(self):
+        for op in (
+            KVOperation.set("k", "v"),
+            KVOperation.get("k"),
+            KVOperation.delete("k"),
+            KVOperation.exists("k"),
+        ):
+            assert decode_op_bin(encode_op_bin(op)) == op
+
+    def test_apply_matches_typed_store(self):
+        a, b = KVStore(), KVStore()
+        r1 = apply_op_bin(a, encode_set_bin("x", "1"))
+        r2 = b.set("x", "1")
+        assert decode_result_bin(r1).version == r2.version
+        ra = decode_result_bin(apply_op_bin(a, encode_op_bin(KVOperation.get("x"))))
+        assert ra.value == "1"
+
+    def test_bulk_apply_equivalent_to_sequential(self):
+        bulk, seq = KVStore(), KVStore()
+        ops = [encode_set_bin(f"k{i % 5}", f"v{i}") for i in range(40)]
+        bulk_out = apply_ops_bin(bulk, ops)
+        seq_out = [apply_op_bin(seq, b) for b in ops]
+        assert [decode_result_bin(r).version for r in bulk_out] == [
+            decode_result_bin(r).version for r in seq_out
+        ]
+        assert {k: e.value for k, e in bulk._data.items()} == {
+            k: e.value for k, e in seq._data.items()
+        }
+
+    def test_fast_path_respects_notifications(self):
+        st = KVStore()
+        sub = st.notifications.subscribe()
+        # fast path must decline when subscribers exist (notify semantics)
+        import time as _t
+
+        assert st.apply_set_bin_fast(encode_set_bin("k", "v"), _t.time()) is None
+        st.set("k", "v")
+        assert sub.queue.qsize() == 1
+
+
+def _mk_cluster(S, R=3, persistence=False):
+    nodes = [NodeId.from_int(i + 1) for i in range(R)]
+    hub = InMemoryHub()
+    cfg = RabiaConfig(
+        phase_timeout=1.0, heartbeat_interval=0.2, round_interval=0.0005
+    ).with_kernel(num_shards=S, shard_pad_multiple=S)
+    engines, tasks, stores = [], [], []
+    for n in nodes:
+        sm, machines = make_sharded_kv(S)
+        stores.append(machines)
+        engines.append(
+            RabiaEngine(ClusterConfig.new(n, nodes), sm, hub.register(n), config=cfg)
+        )
+    return engines, stores, hub
+
+
+async def _start(engines):
+    tasks = [asyncio.ensure_future(e.run()) for e in engines]
+    for _ in range(300):
+        await asyncio.sleep(0.01)
+        sts = [await e.get_statistics() for e in engines]
+        if all(s.has_quorum for s in sts):
+            break
+    return tasks
+
+
+async def _stop(engines, tasks):
+    for e in engines:
+        await e.shutdown()
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+
+
+class TestBlockLaneEndToEnd:
+    @pytest.mark.asyncio
+    async def test_submit_block_commits_and_converges(self):
+        S = 16
+        engines, stores, _ = _mk_cluster(S)
+        tasks = await _start(engines)
+        try:
+            svc = ShardedKVService(
+                S,
+                engines[0].submit_batch,
+                stores[0],
+                submit_block=engines[0].submit_block,
+            )
+            res = await asyncio.wait_for(
+                svc.set_many([(f"key{i}", f"val{i}") for i in range(64)]), 30.0
+            )
+            assert all(r.ok for r in res)
+            # every replica applied every write
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                done = all(
+                    stores[r][svc.shard_of("key3")].store.get("key3").value == "val3"
+                    for r in range(3)
+                )
+                if done:
+                    break
+            assert done
+        finally:
+            await _stop(engines, tasks)
+
+    @pytest.mark.asyncio
+    async def test_block_demotion_on_wrong_proposer(self):
+        """A block covering shards this replica does NOT propose demotes
+        them to the scalar lane (forwarded), and still commits."""
+        S = 6
+        engines, stores, _ = _mk_cluster(S)
+        tasks = await _start(engines)
+        try:
+            # engine 2 proposes only shards where (s+0)%3==2 at slot 0;
+            # cover ALL shards so 2/3 demote+forward
+            svc = ShardedKVService(
+                S,
+                engines[2].submit_batch,
+                stores[2],
+                submit_block=engines[2].submit_block,
+            )
+            pairs = [(f"kk{i}", "z") for i in range(24)]
+            res = await asyncio.wait_for(svc.set_many(pairs), 30.0)
+            assert all(r.ok for r in res), [str(r) for r in res if not r.ok][:3]
+        finally:
+            await _stop(engines, tasks)
+
+    @pytest.mark.asyncio
+    async def test_adaptive_batching_amortizes_slots(self):
+        S = 4
+        engines, stores, _ = _mk_cluster(S)
+        tasks = await _start(engines)
+        try:
+            svc = ShardedKVService(
+                S,
+                engines[0].submit_batch,
+                stores[0],
+                batching=BatchConfig(max_batch_size=8, max_batch_delay=0.01),
+            )
+            results = await asyncio.wait_for(
+                asyncio.gather(*[svc.set(f"b{i}", "x") for i in range(48)]), 30.0
+            )
+            assert all(r.ok for r in results)
+            batches = sum(s.batches_created for s in svc.batch_stats)
+            cmds = sum(s.commands_batched for s in svc.batch_stats)
+            assert cmds == 48
+            assert batches < 48  # multiple commands rode one consensus slot
+            await svc.close()
+        finally:
+            await _stop(engines, tasks)
