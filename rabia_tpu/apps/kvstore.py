@@ -634,19 +634,15 @@ def _result_bin(kind: int, version: int, value: Optional[str] = None) -> bytes:
     return head + b"\x01" + value.encode()
 
 
-_CODE_OP = {v: k for k, v in (
-    (KVOpType.Set, _OP_SET),
-    (KVOpType.Get, _OP_GET),
-    (KVOpType.Delete, _OP_DEL),
-    (KVOpType.Exists, _OP_EXISTS),
-    (KVOpType.Clear, _OP_CLEAR),
-)}
+_CODE_OP = {v: k for k, v in _OP_CODE.items()}
 
 
 def decode_op_bin(data: bytes) -> KVOperation:
     try:
         op = _CODE_OP[data[0]]
         klen = int.from_bytes(data[1:3], "little")
+        if 3 + klen > len(data):
+            raise KeyError(f"key length {klen} exceeds payload")
         key = data[3 : 3 + klen].decode()
         value = data[3 + klen :].decode() if op == KVOpType.Set else None
         return KVOperation(op, key, value)
@@ -737,6 +733,8 @@ def apply_op_bin(store: "KVStore", data: bytes) -> bytes:
     try:
         opcode = data[0]
         klen = int.from_bytes(data[1:3], "little")
+        if 3 + klen > len(data):
+            return _result_bin(2, 0, f"malformed op: key length {klen} exceeds payload")
         key = data[3 : 3 + klen].decode()
         if opcode == _OP_SET:
             res = store.set(key, data[3 + klen :].decode())

@@ -73,7 +73,7 @@ class ShardedStateMachine(StateMachine, VectorStateMachine):
         bridge = self._bridge_for(int(batch.shard))
         return [bridge.apply_command(c) for c in batch.commands]
 
-    def apply_block(self, block, idxs) -> list[list[bytes]]:
+    def apply_block(self, block, idxs, want_responses: bool = True):
         """Bulk apply for the engine's block lane (VectorStateMachine).
 
         One wave-level clock read; array indices are materialized to Python
@@ -89,28 +89,31 @@ class ShardedStateMachine(StateMachine, VectorStateMachine):
         offs = block.cmd_offsets.tolist()
         data = block.data
         responses: list[list[bytes]] = []
+        applied = 0
         for i in np.asarray(idxs).tolist():
             m = machines[shards[i] % n]
             lo, hi = starts[i], starts[i + 1]
+            applied += 1
             if hi - lo == 1:
                 b = data[offs[lo] : offs[lo + 1]]
                 store = getattr(m, "store", None)
                 if store is not None and b[:1] == b"\x01":
                     r = store.apply_set_bin_fast(b, now)
                     if r is not None:
-                        responses.append([r])
+                        if want_responses:
+                            responses.append([r])
                         continue
             ops = [data[offs[j] : offs[j + 1]] for j in range(lo, hi)]
             raw_many = getattr(m, "apply_raw_many", None)
             if raw_many is not None:
-                responses.append(raw_many(ops, now))
+                rs = raw_many(ops, now)
             else:
                 bridge = self._bridge_for(shards[i])
-                responses.append(
-                    [bridge.apply_command(Command.new(b)) for b in ops]
-                )
-        self._version += len(responses)
-        return responses
+                rs = [bridge.apply_command(Command.new(b)) for b in ops]
+            if want_responses:
+                responses.append(rs)
+        self._version += applied
+        return responses if want_responses else None
 
     def create_snapshot(self) -> Snapshot:
         self._version += 1

@@ -98,9 +98,11 @@ class VectorStateMachine(abc.ABC):
     """
 
     @abc.abstractmethod
-    def apply_block(self, block, idxs) -> list[list[bytes]]:
+    def apply_block(self, block, idxs, want_responses: bool = True):
         """Apply covered-shard indices ``idxs`` (numpy int array) of
-        ``block`` in order; return one response list per index."""
+        ``block`` in order; return one response list per index, or None
+        when ``want_responses`` is False (follower replicas discard
+        responses — implementations may skip building them)."""
 
 
 class InMemoryStateMachine(StateMachine):

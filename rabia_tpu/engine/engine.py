@@ -272,6 +272,17 @@ class RabiaEngine:
             raise ValidationError("empty block")
         if int(block.shards.max()) >= self.n_shards:
             raise ValidationError("block shard out of range")
+        # fail fast with the same limits receivers enforce on the announce
+        # (and the scalar lane enforces on demoted batches) — otherwise an
+        # oversized batch livelocks retrying instead of erroring here
+        if int(block.counts.max()) > min(
+            self.config.max_batch_size, self.config.validation.max_commands_per_batch
+        ):
+            raise ValidationError("block shard batch exceeds max batch size")
+        if block.total_commands and (
+            int(block.cmd_sizes.max()) > self.config.validation.max_command_size
+        ):
+            raise ValidationError("block command exceeds max command size")
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         out = _OutBlock(block, fut)
         ref = self._register_block(block, out, self.me)
@@ -450,7 +461,11 @@ class RabiaEngine:
         bulk = self._open_block_slots()
         opened = self._open_slots()
         stepped = False
-        if opened or bulk is not None or got_msgs or self._anything_in_flight():
+        # step the kernel only on NEW input (opens or arrivals): consensus
+        # math is deterministic, so an in-flight shard with no new votes
+        # cannot progress — idle steps are pure dispatch waste. Loss
+        # recovery is timeout-driven (_check_timeouts), not step-driven.
+        if opened or bulk is not None or got_msgs:
             await self._kernel_round(opened, bulk)
             stepped = True
         applied = self._apply_ready()
@@ -693,35 +708,63 @@ class RabiaEngine:
                 return
 
         v1 = vals == V1
-        # V0 (null) slots: nothing applies; the batch retries via the
-        # scalar lane (rotation moved to the next proposer)
+        # V0 (null) slots: nothing applies. Only the PROPOSER requeues the
+        # batch (scalar lane, next rotation); receivers just drop their
+        # binding — every binder requeueing would commit the batch once per
+        # replica under fresh ids, defeating dedup
         if (~v1).any():
             for j in np.nonzero(~v1)[0]:
-                self._demote_block_entry(int(refs[j]), int(bidxs[j]))
+                ref = int(refs[j])
+                rec = self._blk_registry.get(ref)
+                if rec is None:
+                    continue
+                if rec.out is not None:
+                    self._demote_block_entry(ref, int(bidxs[j]))
+                else:
+                    self._unref_block(ref, 1)
         # V1 waves: group by block, apply in bulk
+        lost: list[int] = []  # positions whose block is gone — scalar path
         if v1.any():
             v1_idx = np.nonzero(v1)[0]
             wave_refs = refs[v1_idx]
             for ref in np.unique(wave_refs):
                 rec = self._blk_registry.get(int(ref))
                 sel = v1_idx[wave_refs == ref]
-                bsel = bidxs[sel].astype(np.int64)
                 if rec is None:
-                    # block already GC'd (late duplicate decide) — skip
+                    # registry entry gone (GC raced a very old stall):
+                    # NEVER silently skip the apply — route through the
+                    # scalar ledger so the payload-missing slot stalls
+                    # apply and sync repairs it
+                    lost.extend(sel.tolist())
                     continue
+                bsel = bidxs[sel].astype(np.int64)
+                want = rec.out is not None
                 if self._is_vector_sm:
-                    responses = self.sm.apply_block(rec.block, bsel)
+                    responses = self.sm.apply_block(
+                        rec.block, bsel, want_responses=want
+                    )
                 else:
                     responses = [
                         self.sm.apply_batch(rec.block.materialize_batch(int(bi)))
                         for bi in bsel
                     ]
-                if rec.out is not None:
+                if want and responses is not None:
                     for bi, resp in zip(bsel, responses):
                         rec.out.settle(int(bi), resp)
                 self._unref_block(int(ref), len(bsel))
-            rt.state_version += int(v1.sum())
+            rt.state_version += int(v1.sum()) - len(lost)
             self.rt.last_apply_time = time.time()
+        if lost:
+            keep = np.ones(len(idx), bool)
+            for j in lost:
+                s = int(idx[j])
+                self._cur_blk_ref[s] = -1
+                self._record_decision(s, int(slots[j]), int(vals[j]), None)
+                keep[j] = False
+            idx, slots, vals = idx[keep], slots[keep], vals[keep]
+            v1 = vals == V1
+            if len(idx) == 0:
+                return
 
         # columnar bookkeeping for the whole wave
         rt.applied_upto[idx] = slots + 1
@@ -752,7 +795,10 @@ class RabiaEngine:
         drop, taint-traffic marking, votes-seen tracking for slot opening.
         """
         n = self.n_shards
-        ok = shards < n
+        # full bounds check here (the wire validator no longer scans vote
+        # vectors element-wise): negative or oversized indices would
+        # wrap/raise in every fancy-indexing step below
+        ok = (shards >= 0) & (shards < n)
         if not ok.all():
             shards, phases, vals = shards[ok], phases[ok], vals[ok]
         if len(shards) == 0:
@@ -1745,10 +1791,19 @@ class RabiaEngine:
             # other paths (sync overtake, V0 without binding) never hit
             # remaining==0 — age them out
             horizon = max(60.0, 4 * self.config.sync_timeout)
+            # never evict a block an in-flight or pending binding still
+            # references — dropping one would skip its apply on decide
+            live_refs = set(
+                np.unique(
+                    np.concatenate(
+                        [self._cur_blk_ref, self._blk_pending_ref]
+                    )
+                ).tolist()
+            )
             for ref in [
                 r
                 for r, rec in self._blk_registry.items()
-                if now - rec.registered_at > horizon
+                if now - rec.registered_at > horizon and r not in live_refs
             ]:
                 self._blk_registry.pop(ref)
                 self._last_blk_retransmit.pop(ref, None)

@@ -1,0 +1,192 @@
+"""VectorKVStore: columnar correctness vs the classic store, collisions,
+growth, deletion chains, snapshots, and the VectorShardedKV block path."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rabia_tpu.apps.kvstore import decode_result_bin, encode_set_bin
+from rabia_tpu.apps.vector_kv import VectorKVStore, VectorShardedKV
+from rabia_tpu.core.blocks import build_block
+
+
+def _bulk_args(store, shards, keys):
+    lanes, klens = store._lanes_from_keys(keys)
+    return np.asarray(shards, np.int64), lanes, klens
+
+
+class TestVectorKVStore:
+    def test_set_get_roundtrip(self):
+        st = VectorKVStore(4, capacity=64)
+        v1 = st.set(0, b"alpha", b"1")
+        v2 = st.set(1, b"alpha", b"2")  # same key, different shard
+        assert v1 == 1 and v2 == 1  # per-shard version counters
+        assert st.get(0, b"alpha") == (b"1", 1)
+        assert st.get(1, b"alpha") == (b"2", 1)
+        assert st.get(2, b"alpha") is None
+        assert len(st) == 2
+
+    def test_update_bumps_version(self):
+        st = VectorKVStore(2, capacity=64)
+        st.set(0, b"k", b"a")
+        v = st.set(0, b"k", b"b")
+        assert v == 2
+        assert st.get(0, b"k") == (b"b", 2)
+        assert len(st) == 1
+
+    def test_bulk_wave_order_for_duplicate_keys(self):
+        st = VectorKVStore(1, capacity=64)
+        shards, lanes, klens = _bulk_args(st, [0, 0, 0], [b"k", b"x", b"k"])
+        vers = st.bulk_set(shards, lanes, klens, [b"v1", b"vx", b"v2"])
+        assert list(vers) == [1, 2, 3]
+        assert st.get(0, b"k") == (b"v2", 3)  # later op won
+        assert st.get(0, b"x") == (b"vx", 2)
+
+    def test_growth_preserves_contents(self):
+        st = VectorKVStore(8, capacity=16)  # tiny: forces several grows
+        for i in range(500):
+            st.set(i % 8, f"key{i}".encode(), f"v{i}".encode())
+        assert st.C >= 1024
+        for i in range(500):
+            got = st.get(i % 8, f"key{i}".encode())
+            assert got is not None and got[0] == f"v{i}".encode()
+
+    def test_collisions_resolve(self):
+        # tiny table with many keys ⇒ heavy probing
+        st = VectorKVStore(1, capacity=16)
+        keys = [f"c{i}".encode() for i in range(200)]
+        shards, lanes, klens = _bulk_args(st, [0] * 200, keys)
+        st.bulk_set(shards, lanes, klens, [b"x%d" % i for i in range(200)])
+        for i, k in enumerate(keys):
+            assert st.get(0, k) == (b"x%d" % i, i + 1)
+
+    def test_delete_backward_shift_keeps_chains(self):
+        st = VectorKVStore(1, capacity=16)
+        keys = [f"d{i}".encode() for i in range(10)]
+        for k in keys:
+            st.set(0, k, b"v")
+        assert st.delete(0, keys[3])
+        assert st.get(0, keys[3]) is None
+        for i, k in enumerate(keys):
+            if i != 3:
+                assert st.get(0, k) is not None, f"lost {k} after delete"
+        assert not st.delete(0, b"absent")
+
+    def test_long_keys_overflow(self):
+        st = VectorKVStore(2, capacity=16)
+        long_key = b"L" * 100
+        v = st.set(1, long_key, b"big")
+        assert v == 1
+        assert st.get(1, long_key) == (b"big", 1)
+        assert st.delete(1, long_key)
+        assert st.get(1, long_key) is None
+
+    def test_snapshot_roundtrip(self):
+        st = VectorKVStore(4, capacity=64)
+        for i in range(50):
+            st.set(i % 4, f"s{i}".encode(), f"v{i}".encode())
+        st.set(0, b"X" * 64, b"overflowed")
+        raw = st.snapshot_bytes()
+        st2 = VectorKVStore(4, capacity=64)
+        st2.restore_bytes(raw)
+        for i in range(50):
+            assert st2.get(i % 4, f"s{i}".encode()) == st.get(
+                i % 4, f"s{i}".encode()
+            )
+        assert st2.get(0, b"X" * 64) == (b"overflowed", st.get(0, b"X" * 64)[1])
+        assert list(st2.shard_version) == list(st.shard_version)
+
+    def test_matches_classic_store_semantics(self):
+        """Random op sequence: versions per shard match the classic
+        KVStore's per-store counters."""
+        from rabia_tpu.apps.kvstore import KVStore
+
+        rng = np.random.default_rng(3)
+        vec = VectorKVStore(4, capacity=64)
+        classic = [KVStore() for _ in range(4)]
+        for _ in range(300):
+            s = int(rng.integers(0, 4))
+            k = f"k{int(rng.integers(0, 20))}"
+            v = f"v{int(rng.integers(0, 100))}"
+            ver_v = vec.set(s, k.encode(), v.encode())
+            ver_c = classic[s].set(k, v).version
+            assert ver_v == ver_c
+        for s in range(4):
+            for k in classic[s].keys():
+                got = vec.get(s, k.encode())
+                assert got is not None
+                assert got[0].decode() == classic[s].get(k).value
+
+
+class TestVectorShardedKV:
+    def test_apply_block_sets(self):
+        sm = VectorShardedKV(8, capacity=64)
+        shards = [1, 3, 5]
+        blk = build_block(
+            shards, [[encode_set_bin(f"key{s}", f"val{s}")] for s in shards]
+        )
+        resp = sm.apply_block(blk, np.arange(3))
+        assert [len(r) for r in resp] == [1, 1, 1]
+        for r in resp:
+            assert decode_result_bin(r[0]).ok
+        assert sm.store.get(3, b"key3") == (b"val3", 1)
+
+    def test_apply_block_multi_command_shards(self):
+        sm = VectorShardedKV(4, capacity=64)
+        blk = build_block(
+            [0, 2],
+            [
+                [encode_set_bin("a", "1"), encode_set_bin("b", "2")],
+                [encode_set_bin("c", "3")],
+            ],
+        )
+        resp = sm.apply_block(blk, np.arange(2))
+        assert [len(r) for r in resp] == [2, 1]
+        assert sm.store.get(0, b"a") == (b"1", 1)
+        assert sm.store.get(0, b"b") == (b"2", 2)
+        assert sm.store.get(2, b"c") == (b"3", 1)
+
+    def test_apply_block_mixed_ops(self):
+        from rabia_tpu.apps.kvstore import KVOperation, encode_op_bin
+
+        sm = VectorShardedKV(4, capacity=64)
+        sm.store.set(1, b"x", b"old")
+        blk = build_block(
+            [0, 1],
+            [
+                [encode_set_bin("fresh", "v")],
+                [encode_op_bin(KVOperation.get("x"))],
+            ],
+        )
+        resp = sm.apply_block(blk, np.arange(2))
+        assert decode_result_bin(resp[0][0]).ok
+        got = decode_result_bin(resp[1][0])
+        assert got.ok and got.value == "old"
+
+    def test_scalar_batch_path(self):
+        from rabia_tpu.core.types import Command, CommandBatch, ShardId
+
+        sm = VectorShardedKV(4, capacity=64)
+        batch = CommandBatch.new(
+            [Command.new(encode_set_bin("sk", "sv"))], shard=ShardId(2)
+        )
+        resp = sm.apply_batch(batch)
+        assert decode_result_bin(resp[0]).ok
+        assert sm.store.get(2, b"sk") == (b"sv", 1)
+
+    def test_snapshot_roundtrip(self):
+        sm = VectorShardedKV(4, capacity=64)
+        blk = build_block([0, 1], [[encode_set_bin("a", "1")], [encode_set_bin("b", "2")]])
+        sm.apply_block(blk, np.arange(2))
+        snap = sm.create_snapshot()
+        sm2 = VectorShardedKV(4, capacity=64)
+        sm2.restore_snapshot(snap)
+        assert sm2.store.get(0, b"a") == (b"1", 1)
+        assert sm2.store.get(1, b"b") == (b"2", 1)
+
+    def test_malformed_op_reports_error(self):
+        sm = VectorShardedKV(2, capacity=64)
+        blk = build_block([0], [[b"\xff\x00\x00garbage"]])
+        resp = sm.apply_block(blk, np.arange(1))
+        assert not decode_result_bin(resp[0][0]).ok
