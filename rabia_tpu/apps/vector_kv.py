@@ -153,8 +153,20 @@ class VectorKVStore:
         n = len(klens)
         if n == 0:
             return np.zeros(0, np.int64)
+        if isinstance(values, tuple) and len(values[2]):
+            if int(np.max(values[2])) > self.max_value_size:
+                raise StateMachineError("value exceeds max_value_size")
+        elif not isinstance(values, tuple):
+            if any(len(v) > self.max_value_size for v in values):
+                raise StateMachineError("value exceeds max_value_size")
         if (self.count + n) * 10 > self.C * 7:
-            self._grow(max(self.C * 2, 1 << 10))
+            # size the growth to DEMAND: a single wave can exceed one
+            # doubling, and an exhausted probe loop would leave half-
+            # inserted ghost slots behind
+            needed = self.count + n
+            self._grow(
+                max(self.C * 2, 1 << max(10, (needed * 2 - 1).bit_length()))
+            )
         h = self._hash(lanes, klens, shards)
         slot = self._probe_or_insert(h, shards, lanes, klens, now)
         # versions: per-shard counters advance one per op, wave order
@@ -383,7 +395,12 @@ class VectorKVStore:
         """Tombstone-free delete: relocate the trailing cluster (classic
         open-addressing backward shift) — scalar path, deletes are rare."""
         if len(key) > self.K:
-            return self._overflow.pop((shard, key), None) is not None
+            if self._overflow.pop((shard, key), None) is None:
+                return False
+            self.shard_version[shard] += 1
+            self.total_operations += 1
+            self.writes += 1
+            return True
         lanes, klens = self._lanes_from_keys([key])
         slot = self._lookup(np.array([shard], np.int64), lanes, klens)
         s = int(slot[0])
@@ -427,6 +444,8 @@ class VectorKVStore:
     def _overflow_set(self, shard: int, key: bytes, value: bytes) -> int:
         if len(key) > self.max_key_length:
             raise StateMachineError("key too long")
+        if len(value) > self.max_value_size:
+            raise StateMachineError("value exceeds max_value_size")
         self.shard_version[shard] += 1
         v = int(self.shard_version[shard])
         now = time.time()
@@ -580,6 +599,7 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
             & (klen > 0)
             & (klen <= self.store.K)
             & (3 + klen <= op_len)
+            & (op_len - 3 - klen <= self.store.max_value_size)
         )
         self._version += len(idxs)
         if bool(is_set.all()):
@@ -618,10 +638,12 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
         )
         if not want_responses:
             return None
-        # responses: one structured array -> n small bytes objects
+        # responses: one structured array -> n fixed 6-byte frames
+        # (tobytes + slicing: an S6 view would strip trailing NULs)
         arr = np.zeros(n, _RESP_DT)
         arr["version"] = vers.astype(np.uint32)
-        return arr.view("S6").ravel().tolist()
+        raw6 = arr.tobytes()
+        return [raw6[i * 6 : i * 6 + 6] for i in range(n)]
 
     def _apply_mixed(
         self, op_shards, is_set, dbuf, op_off, op_len, klen, raw: bytes
@@ -652,6 +674,10 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
         try:
             code = op[0]
             klen = int.from_bytes(op[1:3], "little")
+            if 3 + klen > len(op):
+                return _result_bin(
+                    2, 0, f"malformed op: key length {klen} exceeds payload"
+                )
             key = op[3 : 3 + klen]
             if code == 1:  # SET
                 if len(key) > self.store.K:
@@ -664,7 +690,13 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
                 if got is None:
                     return _result_bin(1, 0)
                 val, ver = got
-                return _result_bin(0, ver, val.decode("utf-8", "replace"))
+                try:
+                    text = val.decode("utf-8")
+                except UnicodeDecodeError:
+                    # the store holds raw bytes; the text-result wire form
+                    # must not silently mangle them
+                    return _result_bin(2, ver, "value is not utf-8 text")
+                return _result_bin(0, ver, text)
             if code == 3:  # DEL
                 ok = self.store.delete(shard, key)
                 return _result_bin(0 if ok else 1, 0)

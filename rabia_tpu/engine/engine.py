@@ -739,15 +739,25 @@ class RabiaEngine:
                     continue
                 bsel = bidxs[sel].astype(np.int64)
                 want = rec.out is not None
-                if self._is_vector_sm:
-                    responses = self.sm.apply_block(
-                        rec.block, bsel, want_responses=want
-                    )
-                else:
-                    responses = [
-                        self.sm.apply_batch(rec.block.materialize_batch(int(bi)))
-                        for bi in bsel
-                    ]
+                try:
+                    if self._is_vector_sm:
+                        responses = self.sm.apply_block(
+                            rec.block, bsel, want_responses=want
+                        )
+                    else:
+                        responses = [
+                            self.sm.apply_batch(rec.block.materialize_batch(int(bi)))
+                            for bi in bsel
+                        ]
+                except Exception as e:
+                    # deterministic apply failure (same on every replica):
+                    # consume the slots, fail the submitter's entries
+                    logger.warning("block apply failed (ref %s): %s", ref, e)
+                    responses = None
+                    if want:
+                        err = RabiaError(f"apply failed: {e}")
+                        for bi in bsel:
+                            rec.out.settle(int(bi), err)
                 if want and responses is not None:
                     for bi, resp in zip(bsel, responses):
                         rec.out.settle(int(bi), resp)
@@ -1488,11 +1498,28 @@ class RabiaEngine:
                         self._spawn(self._initiate_sync())
                         break
                     else:
-                        responses = self.sm.apply_batch(batch)
+                        try:
+                            responses = self.sm.apply_batch(batch)
+                        except Exception as e:
+                            # a committed batch the state machine rejects
+                            # (undecodable command, app-level panic) fails
+                            # DETERMINISTICALLY on every replica: consume
+                            # the slot, fail the submitter — never let one
+                            # bad command kill the consensus loop
+                            logger.warning(
+                                "apply failed for batch %s on shard %d: %s",
+                                rec.batch_id,
+                                s,
+                                e,
+                            )
+                            responses = None
                         sh.applied_ids[rec.batch_id] = None
                         sh.applied_results[rec.batch_id] = responses
                         self.rt.state_version += 1
-                        self._resolve_local(sh, batch, responses)
+                        if responses is not None:
+                            self._resolve_local(sh, batch, responses)
+                        else:
+                            self._fail_local(sh, batch.id, RabiaError("apply failed"))
                 else:
                     self._requeue_null_slot(sh, slot, rec)
                 rec.applied = True
@@ -1517,8 +1544,8 @@ class RabiaEngine:
         if responses is None:
             sub.future.set_exception(
                 RabiaError(
-                    "batch committed (applied via snapshot sync); "
-                    "responses unavailable"
+                    "batch committed but responses unavailable (applied "
+                    "via snapshot sync, or the state machine rejected it)"
                 )
             )
         else:
@@ -1530,6 +1557,14 @@ class RabiaEngine:
             if sub.batch.id == batch.id:
                 if sub.future is not None and not sub.future.done():
                     sub.future.set_result(responses)
+                del sh.queue[i]
+                break
+
+    def _fail_local(self, sh, batch_id, err: Exception) -> None:
+        for i, sub in enumerate(list(sh.queue)):
+            if sub.batch.id == batch_id:
+                if sub.future is not None and not sub.future.done():
+                    sub.future.set_exception(err)
                 del sh.queue[i]
                 break
 

@@ -296,3 +296,53 @@ class TestDedupLedger:
         assert len(sh.applied_results) <= 2 * eng.config.max_pending_batches
         # ...but every id still known to the dedup ledger
         assert all(bid in sh.applied_ids for bid in ids)
+
+
+class TestApplyFailureContainment:
+    """A committed batch the state machine rejects must fail the submitter
+    deterministically — never kill the consensus loop (a poisoned command
+    would otherwise crash every replica identically: cluster outage)."""
+
+    @pytest.mark.asyncio
+    async def test_undecodable_command_fails_future_not_engine(self):
+        from rabia_tpu.apps import make_sharded_kv
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.core.errors import RabiaError
+        from rabia_tpu.core.types import Command, CommandBatch
+
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        engines, tasks = [], []
+        for n in nodes:
+            sm, _ = make_sharded_kv(2)
+            engines.append(
+                RabiaEngine(
+                    ClusterConfig.new(n, nodes),
+                    sm,
+                    hub.register(n),
+                    config=_mk_config(2),
+                )
+            )
+            tasks.append(asyncio.ensure_future(engines[-1].run()))
+        try:
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                sts = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in sts):
+                    break
+            # poisoned: neither JSON nor valid binary op
+            bad = CommandBatch.new([Command.new(b"NOT A VALID COMMAND")], shard=0)
+            fut = await engines[0].submit_batch(bad, shard=0)
+            with pytest.raises(RabiaError):
+                await asyncio.wait_for(fut, 20.0)
+            # the cluster is still alive: a good batch commits after it
+            good = CommandBatch.new([Command.new(encode_set_bin("k", "v"))], shard=0)
+            fut2 = await engines[0].submit_batch(good, shard=0)
+            responses = await asyncio.wait_for(fut2, 20.0)
+            assert len(responses) == 1
+        finally:
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)

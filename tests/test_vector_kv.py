@@ -190,3 +190,62 @@ class TestVectorShardedKV:
         blk = build_block([0], [[b"\xff\x00\x00garbage"]])
         resp = sm.apply_block(blk, np.arange(1))
         assert not decode_result_bin(resp[0][0]).ok
+
+
+class TestReviewRegressions:
+    def test_single_wave_larger_than_capacity(self):
+        """One wave with more new keys than 2x capacity must grow to
+        demand, not exhaust the probe loop mid-insert."""
+        st = VectorKVStore(1, capacity=16)
+        n = 500
+        keys = [f"w{i}".encode() for i in range(n)]
+        shards, lanes, klens = _bulk_args(st, [0] * n, keys)
+        st.bulk_set(shards, lanes, klens, [b"v"] * n)
+        for i in (0, 123, n - 1):
+            assert st.get(0, keys[i]) == (b"v", i + 1)
+
+    def test_malformed_set_rejected_not_truncated(self):
+        sm = VectorShardedKV(2, capacity=64)
+        bad = b"\x01" + (100).to_bytes(2, "little") + b"abc"
+        blk = build_block([0], [[bad]])
+        resp = sm.apply_block(blk, np.arange(1))
+        assert not decode_result_bin(resp[0][0]).ok
+        assert sm.store.get(0, b"abc") is None  # nothing stored
+
+    def test_overflow_delete_bumps_version(self):
+        st = VectorKVStore(2, capacity=16)
+        st.set(0, b"L" * 100, b"x")  # version 1
+        assert st.delete(0, b"L" * 100)
+        assert st.set(0, b"s", b"y") == 3  # delete consumed version 2
+
+    def test_value_size_limit_enforced(self):
+        import pytest as _pytest
+
+        from rabia_tpu.core.errors import StateMachineError
+
+        st = VectorKVStore(1, capacity=64, max_value_size=8)
+        with _pytest.raises(StateMachineError):
+            st.set(0, b"k", b"x" * 100)
+        sm = VectorShardedKV(1, capacity=64)
+        sm.store.max_value_size = 8
+        blk = build_block([0], [[encode_set_bin("k", "y" * 100)]])
+        resp = sm.apply_block(blk, np.arange(1))
+        assert not decode_result_bin(resp[0][0]).ok
+
+    def test_response_frames_are_fixed_width(self):
+        sm = VectorShardedKV(2, capacity=64)
+        blk = build_block([0], [[encode_set_bin("k", "v")]])
+        resp = sm.apply_block(blk, np.arange(1))
+        assert len(resp[0][0]) == 6  # kind u8 | version u32 | has_value u8
+
+    def test_non_utf8_value_get_errors_not_mangles(self):
+        sm = VectorShardedKV(1, capacity=64)
+        from rabia_tpu.apps.kvstore import KVOperation, encode_op_bin
+
+        raw_set = b"\x01" + (1).to_bytes(2, "little") + b"k" + b"\xff\xfe"
+        blk = build_block([0], [[raw_set]])
+        assert decode_result_bin(sm.apply_block(blk, np.arange(1))[0][0]).ok
+        blk2 = build_block([0], [[encode_op_bin(KVOperation.get("k"))]])
+        res = decode_result_bin(sm.apply_block(blk2, np.arange(1))[0][0])
+        assert not res.ok  # explicit error, not replacement characters
+        assert sm.store.get(0, b"k") == (b"\xff\xfe", 1)  # bytes API intact
