@@ -43,8 +43,11 @@ def _cpu_oracle_rate(n_replicas: int, sample_slots: int = 150) -> float:
 def main() -> int:
     shards = int(os.environ.get("BENCH_SHARDS", 4096))
     replicas = int(os.environ.get("BENCH_REPLICAS", 5))
-    slots = int(os.environ.get("BENCH_SLOTS", 64))
-    reps = int(os.environ.get("BENCH_REPS", 3))
+    # slots per dispatch = the device pipeline depth; deep windows amortize
+    # dispatch/tunnel overhead across thousands of decisions (SURVEY.md
+    # §7.4.4): 64→~3M dec/s, 256→~13M, 1024→~47M on the tunneled v5p chip
+    slots = int(os.environ.get("BENCH_SLOTS", 1024))
+    reps = int(os.environ.get("BENCH_REPS", 4))
 
     import jax
     import jax.numpy as jnp
