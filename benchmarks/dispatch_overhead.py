@@ -1,0 +1,95 @@
+"""Dispatch-overhead measurement (SURVEY.md §5.1 / §7.4.4).
+
+Quantifies the per-step cost the engine design amortizes: the same
+node-step math evaluated (a) as the numpy host kernel, (b) as a jitted
+XLA call on the current backend, at several shard widths. The difference
+between (b) at S=1 and (b) at large S is the dispatch overhead one engine
+round pays regardless of work; the host-kernel line is why the engine's
+CPU round loop runs numpy.
+
+Prints one JSON line per (impl, S).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def host_step_cost(S: int, R: int = 5, reps: int = 200) -> float:
+    from rabia_tpu.core.types import ABSENT, V1
+    from rabia_tpu.kernel.host_driver import HostNodeKernel
+
+    k = HostNodeKernel(S, R, 0, seed=0)
+    st = k.init_state()
+    st = k.start_slots(
+        st, np.ones(S, bool), np.zeros(S, np.int32), np.full(S, V1, np.int8)
+    )
+    in1 = np.full((S, R), V1, np.int8)
+    in2 = np.full((S, R), ABSENT, np.int8)
+    k.node_step(st, in1, in2, None)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        k.node_step(st, in1, in2, None)
+    return (time.perf_counter() - t0) / reps
+
+
+def jax_step_cost(S: int, R: int = 5, reps: int = 100) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from rabia_tpu.core.types import ABSENT, V1
+    from rabia_tpu.kernel.phase_driver import NodeKernel
+
+    k = NodeKernel(S, R, 0, seed=0)
+    st = k.init_state()
+    in1 = jnp.full((S, R), V1, jnp.int8)
+    in2 = jnp.full((S, R), ABSENT, jnp.int8)
+    dec = jnp.full((S,), ABSENT, jnp.int8)
+    out, _ = k.node_step(st, in1, in2, dec)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out, _ = k.node_step(st, in1, in2, dec)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> int:
+    import os
+
+    import jax
+
+    # env alone does not beat an already-registered accelerator plugin —
+    # force the platform before first device use (tests/conftest.py recipe)
+    want = os.environ.get("RABIA_BENCH_BACKEND")
+    if want:
+        jax.config.update("jax_platforms", want)
+    backend = jax.default_backend()
+    for S in (1, 256, 4096, 16384):
+        host = host_step_cost(S)
+        dev = jax_step_cost(S)
+        print(
+            json.dumps(
+                {
+                    "metric": "node_step_cost_us",
+                    "shards": S,
+                    "host_numpy_us": round(host * 1e6, 1),
+                    "jax_us": round(dev * 1e6, 1),
+                    "jax_backend": backend,
+                    "host_per_shard_ns": round(host / S * 1e9, 1),
+                    "jax_per_shard_ns": round(dev / S * 1e9, 1),
+                }
+            )
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

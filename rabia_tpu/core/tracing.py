@@ -1,0 +1,164 @@
+"""Span tracing + device profiling hooks.
+
+Reference parity: the reference instruments everything with the `tracing`
+crate's spans (SURVEY.md §5.1 — imports at engine.rs:6, tcp.rs:24,
+store.rs:15) and leaves profiling to external tools. Here:
+
+- :class:`Tracer` — a process-local span aggregator with the same
+  pull-based-stats shape as the rest of the framework (§5.5): per-span
+  count / total / max wall time, read via :meth:`Tracer.report`. Disabled
+  by default; when disabled a span costs one attribute check.
+- :func:`span` — ``with span("engine.tick.drain"): ...`` context manager
+  against the module singleton.
+- :func:`device_annotation` — wraps ``jax.profiler.TraceAnnotation`` so
+  kernel steps show up named in TensorBoard/XLA traces; no-op when
+  profiling is off or jax is absent.
+- :func:`device_trace` — ``with device_trace(logdir):`` wraps
+  ``jax.profiler.trace`` for capturing a device profile around a workload.
+
+Span naming taxonomy (dotted, coarse→fine):
+  engine.tick.{drain,open,kernel,apply,timeouts}
+  engine.kernel.{start,route,step,outbox}
+  wire.{serialize,deserialize}
+  sm.apply
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+logger = logging.getLogger("rabia_tpu.tracing")
+
+
+@dataclass
+class SpanStats:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        if dt > self.max_s:
+            self.max_s = dt
+
+
+@dataclass
+class Tracer:
+    """Process-local span aggregator (enable with ``tracer.enabled = True``)."""
+
+    enabled: bool = False
+    spans: dict = field(default_factory=dict)
+
+    def record(self, name: str, dt: float) -> None:
+        st = self.spans.get(name)
+        if st is None:
+            st = self.spans[name] = SpanStats()
+        st.add(dt)
+
+    def report(self) -> dict:
+        """{span: {count, total_s, avg_us, max_us}} sorted by total time."""
+        out = {}
+        for name, st in sorted(
+            self.spans.items(), key=lambda kv: -kv[1].total_s
+        ):
+            out[name] = {
+                "count": st.count,
+                "total_s": round(st.total_s, 4),
+                "avg_us": round(st.total_s / st.count * 1e6, 1) if st.count else 0,
+                "max_us": round(st.max_s * 1e6, 1),
+            }
+        return out
+
+    def reset(self) -> None:
+        self.spans.clear()
+
+    def log_report(self, level: int = logging.INFO) -> None:
+        for name, row in self.report().items():
+            logger.log(
+                level,
+                "span %-28s n=%-8d total=%8.3fs avg=%8.1fus max=%8.1fus",
+                name,
+                row["count"],
+                row["total_s"],
+                row["avg_us"],
+                row["max_us"],
+            )
+
+
+tracer = Tracer()
+
+
+class _NoopSpan:
+    """Shared no-op context: a disabled span costs one attribute check,
+    one call and no allocation."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "t0")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return None
+
+    def __exit__(self, *exc):
+        tracer.record(self.name, time.perf_counter() - self.t0)
+        return False
+
+
+def span(name: str):
+    """``with span("engine.tick.drain"): ...`` — aggregated when the
+    tracer is enabled, near-free otherwise."""
+    if not tracer.enabled:
+        return _NOOP
+    return _Span(name)
+
+
+@contextlib.contextmanager
+def device_annotation(name: str) -> Iterator[None]:
+    """Name a region in XLA device traces (no-op when jax is absent).
+
+    The annotation object is created OUTSIDE the yield so a body exception
+    propagates unharmed (a bare ``except: yield`` around a yield would
+    destroy it with 'generator didn't stop after throw()')."""
+    try:
+        import jax.profiler
+
+        ann = jax.profiler.TraceAnnotation(name)
+    except Exception:
+        ann = None
+    if ann is None:
+        yield
+    else:
+        with ann:
+            yield
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str) -> Iterator[None]:
+    """Capture a jax device profile (TensorBoard format) around a block."""
+    import jax.profiler
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

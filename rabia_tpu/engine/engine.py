@@ -57,16 +57,23 @@ from rabia_tpu.core.messages import (
     ProposeBlock,
     ProtocolMessage,
     Propose,
+    QuorumNotification,
     SyncRequest,
     SyncResponse,
     VoteEntry,
     VoteRound1,
     VoteRound2,
 )
-from rabia_tpu.core.network import ClusterConfig, NetworkMonitor, NetworkTransport
+from rabia_tpu.core.network import (
+    ClusterConfig,
+    NetworkEventHandler,
+    NetworkMonitor,
+    NetworkTransport,
+)
 from rabia_tpu.core.persistence import PersistedEngineState, PersistenceLayer
 from rabia_tpu.core.serialization import Serializer
 from rabia_tpu.core.state_machine import StateMachine, VectorStateMachine
+from rabia_tpu.core.tracing import span
 from rabia_tpu.core.types import (
     ABSENT,
     V0,
@@ -74,6 +81,7 @@ from rabia_tpu.core.types import (
     CommandBatch,
     NodeId,
     StateValue,
+    sorted_nodes,
 )
 from rabia_tpu.core.validation import MessageValidator
 from rabia_tpu.engine.leader import LeaderSelector, slot_proposer, slot_proposer_vec
@@ -126,6 +134,62 @@ class _BlockRef:
         self.registered_at = time.time()
 
 
+class _EngineNetHandler(NetworkEventHandler):
+    """Connectivity events → engine pause/resume (engine.rs:983-997).
+
+    Losing quorum pauses consensus (no new slots, no kernel rounds, no
+    retransmission — inbound traffic still drains so Decisions/sync adopt
+    passively); restoration resumes it. Both transitions are announced with
+    a QuorumNotification broadcast (messages.rs:132-136 parity)."""
+
+    def __init__(self, engine: "RabiaEngine") -> None:
+        self.engine = engine
+
+    async def on_node_connected(self, node: NodeId) -> None:
+        logger.info(
+            "%s: peer %s connected", self.engine.node_id.short(), node.short()
+        )
+
+    async def on_node_disconnected(self, node: NodeId) -> None:
+        logger.warning(
+            "%s: peer %s disconnected", self.engine.node_id.short(), node.short()
+        )
+
+    async def on_partition_detected(self, reachable) -> None:
+        logger.warning(
+            "%s: partition detected — reachable %d/%d",
+            self.engine.node_id.short(),
+            len(reachable),
+            self.engine.cluster.total_nodes,
+        )
+
+    async def on_quorum_lost(self) -> None:
+        e = self.engine
+        e._paused = True
+        e.rt.is_active = False
+        logger.warning("%s: quorum LOST — consensus paused", e.node_id.short())
+        e._send(
+            QuorumNotification(
+                has_quorum=False,
+                active_nodes=tuple(sorted_nodes(e.rt.active_nodes)),
+            )
+        )
+
+    async def on_quorum_restored(self) -> None:
+        e = self.engine
+        e._paused = False
+        e.rt.is_active = True
+        logger.info(
+            "%s: quorum RESTORED — consensus resumed", e.node_id.short()
+        )
+        e._send(
+            QuorumNotification(
+                has_quorum=True,
+                active_nodes=tuple(sorted_nodes(e.rt.active_nodes)),
+            )
+        )
+
+
 class RabiaEngine:
     """One replica's consensus engine (engine.rs:25-42 analog).
 
@@ -168,7 +232,8 @@ class RabiaEngine:
         self.serializer = Serializer(self.config.serialization)
         self.validator = MessageValidator(self.config.validation)
         self.leader = LeaderSelector(cluster.all_nodes)
-        self.monitor = NetworkMonitor(cluster)
+        self._paused = False
+        self.monitor = NetworkMonitor(cluster, handler=_EngineNetHandler(self))
 
         # host mirrors of kernel arrays (aliases in host-kernel mode,
         # refreshed copies in jax mode)
@@ -224,6 +289,7 @@ class RabiaEngine:
         self._last_monitor = 0.0
         self._last_repair: dict[int, float] = {}  # sender row -> last repair
         self._peer_progress: dict[NodeId, tuple[int, float]] = {}
+        self._peer_quorum_views: dict[NodeId, tuple[bool, float]] = {}
 
         if self.n_shards > self.S:
             raise ValidationError("num_shards exceeds padded kernel width")
@@ -456,20 +522,30 @@ class RabiaEngine:
     # ------------------------------------------------------------------
 
     async def _tick(self) -> bool:
-        got_msgs = await self._drain_messages()
-        self._forward_submissions()
-        bulk = self._open_block_slots()
-        opened = self._open_slots()
+        with span("engine.tick.drain"):
+            got_msgs = await self._drain_messages()
+        if self._paused:
+            # quorum lost: consensus paused (engine.rs:983-997). Inbound
+            # traffic above still adopts Decisions / answers sync, so a
+            # healed minority catches up passively before resuming.
+            return False
+        with span("engine.tick.open"):
+            self._forward_submissions()
+            bulk = self._open_block_slots()
+            opened = self._open_slots()
         stepped = False
         # step the kernel only on NEW input (opens or arrivals): consensus
         # math is deterministic, so an in-flight shard with no new votes
         # cannot progress — idle steps are pure dispatch waste. Loss
         # recovery is timeout-driven (_check_timeouts), not step-driven.
         if opened or bulk is not None or got_msgs:
-            await self._kernel_round(opened, bulk)
+            with span("engine.tick.kernel"):
+                await self._kernel_round(opened, bulk)
             stepped = True
-        applied = self._apply_ready()
-        self._check_timeouts()
+        with span("engine.tick.apply"):
+            applied = self._apply_ready()
+        with span("engine.tick.timeouts"):
+            self._check_timeouts()
         if applied and self.persistence is not None:
             self._dirty = True
         return bool(got_msgs or opened or bulk is not None or applied) and stepped
@@ -539,6 +615,20 @@ class RabiaEngine:
             self._on_sync_response(msg.sender, p)
         elif isinstance(p, HeartBeat):
             self._peer_progress[msg.sender] = (p.committed_phase, time.time())
+        elif isinstance(p, QuorumNotification):
+            # informational: a peer's view of cluster health — logged and
+            # kept for operators/stats (messages.rs:132-136)
+            self._peer_quorum_views[msg.sender] = (
+                p.has_quorum,
+                time.time(),
+            )
+            if not p.has_quorum:
+                logger.warning(
+                    "%s: peer %s reports quorum lost (sees %d nodes)",
+                    self.node_id.short(),
+                    msg.sender.short(),
+                    len(p.active_nodes),
+                )
 
     def _on_propose(self, row: int, p: Propose) -> None:
         if not (0 <= p.shard < self.n_shards):
@@ -740,15 +830,18 @@ class RabiaEngine:
                 bsel = bidxs[sel].astype(np.int64)
                 want = rec.out is not None
                 try:
-                    if self._is_vector_sm:
-                        responses = self.sm.apply_block(
-                            rec.block, bsel, want_responses=want
-                        )
-                    else:
-                        responses = [
-                            self.sm.apply_batch(rec.block.materialize_batch(int(bi)))
-                            for bi in bsel
-                        ]
+                    with span("sm.apply"):
+                        if self._is_vector_sm:
+                            responses = self.sm.apply_block(
+                                rec.block, bsel, want_responses=want
+                            )
+                        else:
+                            responses = [
+                                self.sm.apply_batch(
+                                    rec.block.materialize_batch(int(bi))
+                                )
+                                for bi in bsel
+                            ]
                 except Exception as e:
                     # deterministic apply failure (same on every replica):
                     # consume the slots, fail the submitter's entries
@@ -1224,19 +1317,20 @@ class RabiaEngine:
             slots_full[idx] = slots_arr
             init_full = np.full(self.S, V0, np.int8)
             init_full[idx] = init_arr
-            if self._host_kernel:
-                self.kstate = self.kernel.start_slots(
-                    self.kstate, mask, slots_full.astype(np.int32), init_full
-                )
-            else:
-                import jax.numpy as jnp
+            with span("engine.kernel.start"):
+                if self._host_kernel:
+                    self.kstate = self.kernel.start_slots(
+                        self.kstate, mask, slots_full.astype(np.int32), init_full
+                    )
+                else:
+                    import jax.numpy as jnp
 
-                self.kstate = self.kernel.start_slots(
-                    self.kstate,
-                    jnp.asarray(mask),
-                    jnp.asarray(slots_full.astype(np.int32)),
-                    jnp.asarray(init_full),
-                )
+                    self.kstate = self.kernel.start_slots(
+                        self.kstate,
+                        jnp.asarray(mask),
+                        jnp.asarray(slots_full.astype(np.int32)),
+                        jnp.asarray(init_full),
+                    )
             self._refresh_mirrors()
             self._send(
                 VoteRound1(
@@ -1246,28 +1340,31 @@ class RabiaEngine:
                 )
             )
 
-        self._route_votes()
+        with span("engine.kernel.route"):
+            self._route_votes()
         prev_phase = (
             self._cur_phase if self._host_kernel else self._cur_phase.copy()
         )
-        if self._host_kernel:
-            self.kstate, outbox = self.kernel.node_step(
-                self.kstate, None, None, self._dec_plane
-            )
-        else:
-            import jax.numpy as jnp
+        with span("engine.kernel.step"):
+            if self._host_kernel:
+                self.kstate, outbox = self.kernel.node_step(
+                    self.kstate, None, None, self._dec_plane
+                )
+            else:
+                import jax.numpy as jnp
 
-            self.kstate, outbox = self.kernel.node_step(
-                self.kstate,
-                jnp.asarray(self._inbox1),
-                jnp.asarray(self._inbox2),
-                jnp.asarray(self._dec_plane),
-            )
-            self._inbox1.fill(ABSENT)
-            self._inbox2.fill(ABSENT)
+                self.kstate, outbox = self.kernel.node_step(
+                    self.kstate,
+                    jnp.asarray(self._inbox1),
+                    jnp.asarray(self._inbox2),
+                    jnp.asarray(self._dec_plane),
+                )
+                self._inbox1.fill(ABSENT)
+                self._inbox2.fill(ABSENT)
         self._dec_plane.fill(ABSENT)
         self._refresh_mirrors()
-        self._process_outbox(outbox, prev_phase)
+        with span("engine.kernel.outbox"):
+            self._process_outbox(outbox, prev_phase)
 
     async def _advance_vote_barrier(
         self,
@@ -1817,8 +1914,11 @@ class RabiaEngine:
         if now - self._last_monitor >= max(self.config.heartbeat_interval, 0.2):
             self._last_monitor = now
             connected = await self.transport.get_connected_nodes()
-            await self.monitor.observe(connected)
+            # refresh membership BEFORE the monitor fires its handlers:
+            # QuorumNotification broadcasts read rt.active_nodes and must
+            # describe the NEW view, not the stale one
             await self.update_nodes(connected | {self.node_id})
+            await self.monitor.observe(connected)
         if now - self._last_cleanup >= self.config.cleanup_interval:
             self._last_cleanup = now
             self._gc()

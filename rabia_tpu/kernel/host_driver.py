@@ -77,14 +77,10 @@ def _rowsum_eq(led: np.ndarray, value: int) -> np.ndarray:
     return acc
 
 
-def _rowsum_ne(led: np.ndarray, value: int) -> np.ndarray:
-    ne = (led != value).view(np.uint8)
-    if led.shape[0] == 1:
-        return ne[0]
-    acc = ne[0] + ne[1]
-    for i in range(2, led.shape[0]):
-        acc += ne[i]
-    return acc
+# NOTE: quorum presence (`tot`) must count only the valid vote codes
+# (V0+V1+V?), exactly like phase_driver._tally — counting "anything
+# non-ABSENT" would let garbage codes from a faulty peer fabricate quorum
+# presence (and diverge bit-wise from the JAX kernel).
 
 
 class HostNodeKernel:
@@ -204,7 +200,7 @@ class HostNodeKernel:
 
         c0 = _rowsum_eq(led1, V0)
         c1 = _rowsum_eq(led1, V1)
-        tot1 = _rowsum_ne(led1, ABSENT)
+        tot1 = c0 + c1 + _rowsum_eq(led1, VQUESTION)
         cast_r2 = enabled & (state.stage == R1_WAIT) & (tot1 >= Q)
         r2_val = np.where(
             c1 >= Q, I8(V1), np.where(c0 >= Q, I8(V0), I8(VQUESTION))
@@ -217,7 +213,7 @@ class HostNodeKernel:
 
         d0 = _rowsum_eq(led2, V0)
         d1 = _rowsum_eq(led2, V1)
-        tot2 = _rowsum_ne(led2, ABSENT)
+        tot2 = d0 + d1 + _rowsum_eq(led2, VQUESTION)
         advance = enabled & (state.stage == R2_WAIT) & (tot2 >= Q)
         decide1 = d1 >= F1
         decide0 = d0 >= F1

@@ -1,0 +1,233 @@
+"""Adversarial safety tests: hostile inputs injected straight into kernel
+inboxes and engine ingest paths.
+
+The clean-router tests (test_kernel.py) exercise well-formed traffic; these
+inject duplicated, conflicting, stale and garbage votes plus spoofed
+decisions and assert the Ivy-derived safety invariants hold
+(docs/weak_mvc.ivy:190+ in the reference):
+
+  - agreement: no two replicas decide different values for one slot;
+  - stability: a decided slot's value never changes afterwards;
+  - first-vote-wins: a sender cannot replace a vote already ledgered
+    (equivocation containment under the crash-fault model).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from rabia_tpu.core.types import ABSENT, V0, V1, VQUESTION
+from rabia_tpu.kernel.host_driver import HostNodeKernel
+from rabia_tpu.kernel.phase_driver import ClusterKernel, NodeKernel
+
+
+def _full(S, R, v):
+    return np.full((S, R), v, np.int8)
+
+
+class TestNodeKernelAdversarial:
+    def test_equivocating_votes_first_write_wins(self):
+        """A sender re-offering a DIFFERENT vote for the same (slot, phase)
+        must not displace the ledgered one."""
+        S, R = 4, 3
+        k = HostNodeKernel(S, R, me=0, seed=0)
+        st = k.init_state()
+        st = k.start_slots(
+            st, np.ones(S, bool), np.zeros(S, np.int32), np.full(S, V1, np.int8)
+        )
+        sh = np.arange(S)
+        k.offer_votes(st, 1, 1, sh, np.full(S, V1, np.int8))
+        # equivocation: same row now claims V0
+        k.offer_votes(st, 1, 1, sh, np.full(S, V0, np.int8))
+        assert (st.led1[1] == V1).all()
+
+    def test_post_decision_spoofed_decision_ignored(self):
+        """decision_in with a conflicting value after the slot decided must
+        not change the recorded decision (stability)."""
+        S, R = 4, 3
+        k = HostNodeKernel(S, R, me=0, seed=0)
+        st = k.init_state()
+        st = k.start_slots(
+            st, np.ones(S, bool), np.zeros(S, np.int32), np.full(S, V1, np.int8)
+        )
+        st, _ = k.node_step(st, _full(S, R, V1), _full(S, R, ABSENT), None)
+        st, ob = k.node_step(st, _full(S, R, ABSENT), _full(S, R, V1), None)
+        assert (st.decided == V1).all() and st.done.all()
+        # adversary says V0 now
+        st2, _ = k.node_step(
+            st, _full(S, R, ABSENT), _full(S, R, ABSENT), np.full(S, V0, np.int8)
+        )
+        assert (st2.decided == V1).all()
+
+    def test_garbage_vote_codes_do_not_count(self):
+        """Out-of-range vote codes must not contribute to any tally."""
+        S, R = 4, 5
+        k = HostNodeKernel(S, R, me=0, seed=0)
+        st = k.init_state()
+        st = k.start_slots(
+            st, np.ones(S, bool), np.zeros(S, np.int32), np.full(S, V1, np.int8)
+        )
+        garbage = np.full((S, R), 7, np.int8)  # not a StateValue code
+        st, ob = k.node_step(st, garbage, garbage, None)
+        # garbage filled the ledger cells but tallies count only V0/V1/V?:
+        # one real vote (our own) is not a quorum, so nothing advances
+        assert not ob.cast_r2.any()
+        assert not st.done.any()
+
+    def test_question_flood_cannot_force_decision(self):
+        """An adversary flooding V? votes can stall but never decide:
+        decisions need f+1 concrete votes (weak_mvc.ivy:149-186)."""
+        S, R = 4, 5
+        k = HostNodeKernel(S, R, me=0, seed=0)
+        st = k.init_state()
+        st = k.start_slots(
+            st, np.ones(S, bool), np.zeros(S, np.int32), np.full(S, V1, np.int8)
+        )
+        for _ in range(8):
+            st, ob = k.node_step(
+                st, _full(S, R, VQUESTION), _full(S, R, VQUESTION), None
+            )
+            assert not ob.newly_decided.any()
+        assert (st.decided == ABSENT).all()
+
+    def test_conflicting_inboxes_across_nodes_agree(self):
+        """Two nodes fed DIFFERENT (but per-sender-consistent) vote subsets
+        must never decide differently — agreement under partial delivery."""
+        S, R = 16, 5
+        rng = np.random.default_rng(7)
+        kernels = [HostNodeKernel(S, R, me=i, seed=3) for i in range(R)]
+        states = [k.init_state() for k in kernels]
+        init = rng.choice(np.array([V0, V1], np.int8), size=(R, S))
+        for i, k in enumerate(kernels):
+            states[i] = k.start_slots(
+                states[i], np.ones(S, bool), np.zeros(S, np.int32), init[i]
+            )
+        # ground truth votes per (round, sender); receivers see random
+        # subsets (loss), never altered values
+        for step in range(30):
+            r1 = np.stack([np.asarray(states[i].my_r1) for i in range(R)])
+            r2 = np.stack([np.asarray(states[i].my_r2) for i in range(R)])
+            stages = [np.asarray(states[i].stage) for i in range(R)]
+            phases = [np.asarray(states[i].phase) for i in range(R)]
+            for i, k in enumerate(kernels):
+                in1 = np.full((S, R), ABSENT, np.int8)
+                in2 = np.full((S, R), ABSENT, np.int8)
+                for j in range(R):
+                    if i == j:
+                        continue
+                    same = phases[j] == phases[i]
+                    deliver = rng.random(S) < 0.7
+                    m1 = same & deliver & (r1[j] != ABSENT)
+                    in1[m1, j] = r1[j][m1]
+                    m2 = same & deliver & (stages[j] == 1) & (r2[j] != ABSENT)
+                    in2[m2, j] = r2[j][m2]
+                states[i], _ = k.node_step(states[i], in1, in2, None)
+        decided = np.stack([np.asarray(st.decided) for st in states])
+        done = np.stack([np.asarray(st.done) for st in states])
+        for s in range(S):
+            vals = {int(decided[i, s]) for i in range(R) if done[i, s]}
+            assert len(vals) <= 1, f"agreement violated on shard {s}: {vals}"
+
+    def test_validity_all_v1_cannot_decide_v0(self):
+        """If every replica proposes V1, V0 can never be decided no matter
+        what delivery does (validity)."""
+        S, R = 32, 5
+        k = ClusterKernel(S, R, seed=9)
+        st = k.start_slot(
+            k.init_state(),
+            np.ones(S, bool),
+            np.full((S, R), V1, np.int8),
+        )
+        import jax
+
+        st = k.run_rounds(
+            st, np.ones((S, R), bool), 60, jax.random.key(4), p_deliver=0.5
+        )
+        dec = np.asarray(st.decided)
+        assert not (dec == V0).any()
+
+
+class TestEngineIngestAdversarial:
+    @pytest.mark.asyncio
+    async def test_spoofed_envelope_sender_dropped(self):
+        """Envelope sender != transport-authenticated peer is dropped: one
+        faulty peer must not forge other rows' votes."""
+        from rabia_tpu.core.messages import ProtocolMessage, VoteRound1
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.serialization import Serializer
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.core.types import NodeId
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.net import InMemoryHub
+        from tests.test_engine import _mk_config
+
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        eng = RabiaEngine(
+            ClusterConfig.new(nodes[0], nodes),
+            InMemoryStateMachine(),
+            hub.register(nodes[0]),
+            config=_mk_config(1),
+        )
+        ser = Serializer()
+        vv = VoteRound1(
+            shards=np.array([0]), phases=np.array([0]), vals=np.array([V1], np.int8)
+        )
+        forged = ser.serialize(ProtocolMessage.new(nodes[2], vv))
+        eng._handle_message(nodes[1], ser.deserialize(forged))  # via node 1!
+        assert not eng._stash1  # dropped, nothing ingested
+
+    @pytest.mark.asyncio
+    async def test_out_of_range_and_negative_shards_ignored(self):
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.core.types import NodeId
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.net import InMemoryHub
+        from tests.test_engine import _mk_config
+
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        eng = RabiaEngine(
+            ClusterConfig.new(nodes[0], nodes),
+            InMemoryStateMachine(),
+            hub.register(nodes[0]),
+            config=_mk_config(2),
+        )
+        eng._ingest_vote_arrays(
+            1,
+            np.array([-1, 999999, 0]),
+            np.array([0, 0, 0]),
+            np.array([V1, V1, V1], np.int8),
+            1,
+        )
+        # only the in-range entry survives
+        assert len(eng._stash1) == 1
+        row, shards, slots, mvcs, vals = eng._stash1[0]
+        assert list(shards) == [0]
+
+    @pytest.mark.asyncio
+    async def test_conflicting_decisions_keep_first(self):
+        """Stability at the engine ledger: a second Decision with a
+        different value for a recorded slot must not alter it."""
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.core.types import NodeId, StateValue
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.net import InMemoryHub
+        from tests.test_engine import _mk_config
+
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        eng = RabiaEngine(
+            ClusterConfig.new(nodes[0], nodes),
+            InMemoryStateMachine(),
+            hub.register(nodes[0]),
+            config=_mk_config(1),
+        )
+        eng._record_decision(0, 0, V0, None)
+        eng._on_decision_one(0, 0, V1, None)  # conflicting spoof
+        assert eng.rt.shards[0].decisions[0].value == StateValue.V0

@@ -346,3 +346,131 @@ class TestApplyFailureContainment:
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
+
+
+class TestQuorumEventPlumbing:
+    """NetworkMonitor events drive engine pause/resume (engine.rs:983-997)
+    and QuorumNotification broadcasts (messages.rs:132-136)."""
+
+    @pytest.mark.asyncio
+    async def test_partition_pauses_and_heal_resumes(self):
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.core.types import Command, CommandBatch
+
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        cfg = _mk_config(1)
+        engines, tasks = [], []
+        for n in nodes:
+            engines.append(
+                RabiaEngine(
+                    ClusterConfig.new(n, nodes),
+                    InMemoryStateMachine(),
+                    hub.register(n),
+                    config=cfg,
+                )
+            )
+            tasks.append(asyncio.ensure_future(engines[-1].run()))
+        try:
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                sts = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in sts):
+                    break
+            # commit one batch while healthy
+            fut = await engines[0].submit_batch(
+                CommandBatch.new([Command.new(b"SET a 1")], shard=0), shard=0
+            )
+            await asyncio.wait_for(fut, 20.0)
+
+            # partition node 0 away from both peers
+            hub.set_connected(nodes[1], False)
+            hub.set_connected(nodes[2], False)
+            for _ in range(400):
+                await asyncio.sleep(0.01)
+                if engines[0]._paused:
+                    break
+            assert engines[0]._paused, "quorum loss must pause consensus"
+            st = await engines[0].get_statistics()
+            assert not st.is_active and not st.has_quorum
+            from rabia_tpu.core.errors import QuorumNotAvailableError
+
+            with pytest.raises(QuorumNotAvailableError):
+                await engines[0].submit_batch(
+                    CommandBatch.new([Command.new(b"SET b 2")], shard=0), shard=0
+                )
+
+            # heal: quorum restored resumes consensus and commits again
+            hub.set_connected(nodes[1], True)
+            hub.set_connected(nodes[2], True)
+            for _ in range(400):
+                await asyncio.sleep(0.01)
+                sts = [await e.get_statistics() for e in engines]
+                if not engines[0]._paused and all(s.has_quorum for s in sts):
+                    break
+            assert not engines[0]._paused
+            fut = await engines[0].submit_batch(
+                CommandBatch.new([Command.new(b"SET c 3")], shard=0), shard=0
+            )
+            await asyncio.wait_for(fut, 20.0)
+            # peers observed the lost/restored notifications
+            seen = any(
+                nodes[0] in e._peer_quorum_views for e in engines[1:]
+            )
+            assert seen, "QuorumNotification broadcasts were not received"
+        finally:
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+
+class TestTracing:
+    @pytest.mark.asyncio
+    async def test_spans_record_engine_phases(self):
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.core.tracing import tracer
+        from rabia_tpu.core.types import Command, CommandBatch
+
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        engines, tasks = [], []
+        for n in nodes:
+            engines.append(
+                RabiaEngine(
+                    ClusterConfig.new(n, nodes),
+                    InMemoryStateMachine(),
+                    hub.register(n),
+                    config=_mk_config(1),
+                )
+            )
+            tasks.append(asyncio.ensure_future(engines[-1].run()))
+        tracer.reset()
+        tracer.enabled = True
+        try:
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                sts = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in sts):
+                    break
+            fut = await engines[0].submit_batch(
+                CommandBatch.new([Command.new(b"SET t 1")], shard=0), shard=0
+            )
+            await asyncio.wait_for(fut, 20.0)
+        finally:
+            tracer.enabled = False
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+        report = tracer.report()
+        for name in (
+            "engine.tick.drain",
+            "engine.tick.kernel",
+            "engine.kernel.step",
+            "engine.tick.apply",
+        ):
+            assert name in report and report[name]["count"] > 0, report.keys()
+        tracer.reset()

@@ -30,10 +30,12 @@ def _assert_outbox_equal(a, b, where=""):
         assert np.array_equal(av, bv), f"{where}: outbox {f} diverged"
 
 
-def _random_round(rng, S, R, p_absent=0.5):
-    """Random (possibly garbage-laden) inboxes: votes or ABSENT."""
-    choices = np.array([ABSENT, V0, V1], np.int8)
-    probs = [p_absent, (1 - p_absent) / 2, (1 - p_absent) / 2]
+def _random_round(rng, S, R, p_absent=0.45):
+    """Random (garbage-laden) inboxes: valid votes, ABSENT, and an
+    out-of-range code (7) that must be ignored identically by both
+    kernels."""
+    choices = np.array([ABSENT, V0, V1, 7], np.int8)
+    probs = [p_absent, (1 - p_absent) / 2.5, (1 - p_absent) / 2.5, (1 - p_absent) / 5]
     in1 = rng.choice(choices, size=(S, R), p=probs)
     in2 = rng.choice(choices, size=(S, R), p=probs)
     dec = rng.choice(
