@@ -20,11 +20,11 @@ Binary layout (version 1):
 from __future__ import annotations
 
 import base64
-import io
 import json
 import struct
 import uuid
 import zlib
+from dataclasses import dataclass
 from typing import Optional, Protocol
 
 import numpy as np
@@ -79,28 +79,53 @@ class MessageSerializer(Protocol):
 
 
 class _Writer:
-    __slots__ = ("buf",)
+    """Cursor-based byte builder over a persistent preallocated arena.
 
-    def __init__(self) -> None:
-        self.buf = io.BytesIO()
+    Borrow via :func:`_borrow_writer` / return via :func:`_return_writer`
+    — the pooled-buffer path of rabia-core/src/serialization.rs:152-169 /
+    memory_pool.rs (C10). ``reset`` only rewinds the cursor (CPython
+    ``del buf[:]`` would FREE the allocation), so a pooled writer's grown
+    arena genuinely persists across messages.
+    """
+
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self.buf = bytearray(capacity)
+        self.pos = 0
+
+    def reset(self) -> None:
+        self.pos = 0
+
+    def _ensure(self, n: int) -> None:
+        need = self.pos + n
+        if need > len(self.buf):
+            self.buf.extend(bytes(max(n, len(self.buf))))
+
+    def raw(self, b) -> None:
+        n = len(b)
+        self._ensure(n)
+        self.buf[self.pos : self.pos + n] = b
+        self.pos += n
 
     def u8(self, v: int) -> None:
-        self.buf.write(struct.pack("<B", v))
+        if not 0 <= v <= 255:
+            raise SerializationError(f"u8 out of range: {v}")
+        self._ensure(1)
+        self.buf[self.pos] = v
+        self.pos += 1
 
     def u32(self, v: int) -> None:
-        self.buf.write(struct.pack("<I", v))
+        self.raw(struct.pack("<I", v))
 
     def u64(self, v: int) -> None:
-        self.buf.write(struct.pack("<Q", v))
+        self.raw(struct.pack("<Q", v))
 
     def f64(self, v: float) -> None:
-        self.buf.write(struct.pack("<d", v))
-
-    def raw(self, b: bytes) -> None:
-        self.buf.write(b)
+        self.raw(struct.pack("<d", v))
 
     def uuid(self, u: uuid.UUID) -> None:
-        self.buf.write(u.bytes)
+        self.raw(u.bytes)
 
     def blob(self, b: bytes) -> None:
         self.u32(len(b))
@@ -110,7 +135,38 @@ class _Writer:
         self.blob(s.encode("utf-8"))
 
     def getvalue(self) -> bytes:
-        return self.buf.getvalue()
+        return bytes(self.buf[:self.pos])
+
+
+@dataclass
+class PoolStats:
+    """Writer-arena reuse counters (memory_pool.rs:172-177 analog)."""
+
+    hits: int = 0
+    misses: int = 0
+    returned: int = 0
+
+
+_WRITER_POOL: list[_Writer] = []
+_WRITER_POOL_CAP = 32
+writer_pool_stats = PoolStats()
+
+
+def _borrow_writer() -> _Writer:
+    if _WRITER_POOL:
+        writer_pool_stats.hits += 1
+        w = _WRITER_POOL.pop()
+        w.reset()
+        return w
+    writer_pool_stats.misses += 1
+    return _Writer()
+
+
+def _return_writer(w: _Writer) -> None:
+    # don't park snapshot-sized arenas (a SyncResponse can be many MB)
+    if len(_WRITER_POOL) < _WRITER_POOL_CAP and len(w.buf) <= (1 << 20):
+        writer_pool_stats.returned += 1
+        _WRITER_POOL.append(w)
 
 
 class _Reader:
@@ -383,9 +439,10 @@ class BinarySerializer:
         self.config = config or SerializationConfig()
 
     def serialize(self, msg: ProtocolMessage) -> bytes:
-        body_w = _Writer()
+        body_w = _borrow_writer()
         _encode_payload(body_w, msg.payload)
         body = body_w.getvalue()
+        _return_writer(body_w)
 
         flags = 0
         # compress only scalar payload-bearing bodies: snapshots and batch
@@ -407,7 +464,7 @@ class BinarySerializer:
         if msg.recipient is not None:
             flags |= _FLAG_HAS_RECIPIENT
 
-        w = _Writer()
+        w = _borrow_writer()
         w.u8(_VERSION)
         w.u8(int(msg.message_type))
         w.u8(flags)
@@ -417,7 +474,9 @@ class BinarySerializer:
             w.uuid(msg.recipient.value)
         w.f64(msg.timestamp)
         w.blob(body)
-        return w.getvalue()
+        out = w.getvalue()
+        _return_writer(w)
+        return out
 
     def deserialize(self, data: bytes) -> ProtocolMessage:
         r = _Reader(data)

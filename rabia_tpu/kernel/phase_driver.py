@@ -229,6 +229,12 @@ class ClusterKernel:
     ) -> ClusterState:
         """One synchronous communication round for every shard at once.
 
+        No buffer donation here: the simulation kernel's callers (fault
+        harness, tests) legitimately hold old states for inspection; the
+        hot multi-round drivers (`run_rounds`, `slot_pipeline`) scan on
+        device, where XLA reuses the carry buffers anyway. The engine's
+        NodeKernel path IS donated — its state is threaded linearly.
+
         Semantics are element-for-element those of ``WeakMVCOracle.step``:
         (1) deliver outstanding votes under the mask (with retransmission —
         a sender's *current* votes are re-offered every round), (2) run every
@@ -506,7 +512,7 @@ class NodeKernel:
             active=jnp.zeros((S,), bool),
         )
 
-    @functools.partial(jax.jit, static_argnums=0)
+    @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
     def start_slots(
         self,
         state: NodeState,
@@ -538,7 +544,7 @@ class NodeKernel:
             active=state.active | m,
         )
 
-    @functools.partial(jax.jit, static_argnums=0)
+    @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
     def node_step(
         self,
         state: NodeState,
@@ -546,7 +552,10 @@ class NodeKernel:
         inbox_r2: jnp.ndarray,  # i8[S,R]
         decision_in: jnp.ndarray,  # i8[S] ABSENT or adopted decision value
     ) -> tuple[NodeState, NodeOutbox]:
-        """Consume routed inboxes, run enabled transitions on every shard."""
+        """Consume routed inboxes, run enabled transitions on every shard.
+
+        ``state`` is DONATED (device buffers reused in place); do not reuse
+        the passed-in state afterwards."""
         S, R, Q, F1 = self.S, self.R, self.quorum, self.f1
 
         led1 = jnp.where((state.led1 == ABSENT) & (inbox_r1 != ABSENT), inbox_r1, state.led1)

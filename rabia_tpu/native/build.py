@@ -114,6 +114,12 @@ def load_library() -> ctypes.CDLL:
         lib.rt_port.argtypes = [ctypes.c_void_p]
         lib.rt_dropped.restype = ctypes.c_uint64
         lib.rt_dropped.argtypes = [ctypes.c_void_p]
+        lib.rt_pool_stats.restype = None
+        lib.rt_pool_stats.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
         lib.rt_stop.restype = None
         lib.rt_stop.argtypes = [ctypes.c_void_p]
         lib.rt_close.restype = None

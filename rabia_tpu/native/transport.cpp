@@ -105,6 +105,40 @@ struct Transport {
   std::condition_variable inbox_cv;
   uint64_t dropped_frames = 0;
 
+  // buffer arena (rabia-core/src/memory_pool.rs analog): frame/message
+  // byte vectors are recycled instead of allocated per frame. Guarded by
+  // mu like everything else.
+  std::vector<std::vector<uint8_t>> buf_pool;
+  uint64_t pool_hits = 0;
+  uint64_t pool_misses = 0;
+  static constexpr size_t kMaxPooled = 256;
+
+  std::vector<uint8_t> pool_get_locked(size_t need) {
+    if (!buf_pool.empty()) {
+      std::vector<uint8_t> v = std::move(buf_pool.back());
+      buf_pool.pop_back();
+      v.clear();
+      v.reserve(need);
+      pool_hits++;
+      return v;
+    }
+    pool_misses++;
+    std::vector<uint8_t> v;
+    v.reserve(need);
+    return v;
+  }
+
+  // retain only small buffers: consensus traffic is KB-scale; parking
+  // snapshot-sized (up to 16 MiB) buffers would pin gigabytes for the
+  // process lifetime
+  static constexpr size_t kMaxPooledBuf = 256 * 1024;
+
+  void pool_put_locked(std::vector<uint8_t>&& v) {
+    if (buf_pool.size() < kMaxPooled && v.capacity() <= kMaxPooledBuf) {
+      buf_pool.push_back(std::move(v));
+    }
+  }
+
   void io_loop();
   void handle_readable(int fd);
   void handle_writable(int fd);
@@ -221,8 +255,10 @@ void Transport::handle_readable(int fd) {
     if (c.rbuf.size() - off - 4 < len) break;
     InboundMsg m;
     m.sender = c.peer;
+    m.data = pool_get_locked(len);
     m.data.assign(c.rbuf.begin() + off + 4, c.rbuf.begin() + off + 4 + len);
     if (inbox.size() >= kMaxInbox) {
+      pool_put_locked(std::move(inbox.front().data));
       inbox.pop_front();
       dropped_frames++;
     }
@@ -244,6 +280,7 @@ void Transport::handle_writable(int fd) {
     if (n > 0) {
       c.woff += static_cast<size_t>(n);
       if (c.woff == front.size()) {
+        pool_put_locked(std::move(front));
         c.wqueue.pop_front();
         c.woff = 0;
       }
@@ -261,7 +298,8 @@ void Transport::enqueue_frame_locked(int fd, const uint8_t* data,
                                      uint32_t len) {
   auto it = conns.find(fd);
   if (it == conns.end()) return;
-  std::vector<uint8_t> frame(4 + len);
+  std::vector<uint8_t> frame = pool_get_locked(4 + len);
+  frame.resize(4 + len);
   frame[0] = len & 0xFF;
   frame[1] = (len >> 8) & 0xFF;
   frame[2] = (len >> 16) & 0xFF;
@@ -509,7 +547,16 @@ int rt_recv(void* h, uint8_t sender_out[16], uint8_t* buf, uint32_t buf_cap,
   uint32_t n = static_cast<uint32_t>(m.data.size());
   if (n > buf_cap) n = buf_cap;
   memcpy(buf, m.data.data(), n);
+  t->pool_put_locked(std::move(m.data));
   return static_cast<int>(n);
+}
+
+// Buffer-arena counters (memory_pool.rs PoolStats analog).
+void rt_pool_stats(void* h, uint64_t* hits, uint64_t* misses) {
+  auto* t = static_cast<Transport*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  *hits = t->pool_hits;
+  *misses = t->pool_misses;
 }
 
 // Writes up to cap peer ids (16 bytes each) of established peers; returns

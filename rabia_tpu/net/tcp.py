@@ -150,6 +150,18 @@ class TcpNetwork(NetworkTransport):
             return 0
         return int(self._lib.rt_dropped(self._handle))
 
+    @property
+    def pool_stats(self) -> tuple[int, int]:
+        """(hits, misses) of the native buffer arena (C10 PoolStats)."""
+        if not self._handle:
+            return (0, 0)
+        hits = ctypes.c_uint64()
+        misses = ctypes.c_uint64()
+        self._lib.rt_pool_stats(
+            self._handle, ctypes.byref(hits), ctypes.byref(misses)
+        )
+        return int(hits.value), int(misses.value)
+
     async def disconnect(self, node: NodeId) -> None:
         self.remove_peer(node)
 

@@ -254,10 +254,15 @@ class TestNodeKernelRouter:
                 break
             new_states = []
             outs = []
+            old_phases = []
             for i, n in enumerate(nodes):
                 st = states[i]
+                # snapshot BEFORE the step: node_step donates its input
+                # state, so its buffers are dead afterwards on device
+                # backends
                 phase = np.asarray(st.phase)
                 slot = np.asarray(st.slot)
+                old_phases.append(phase)
                 in1 = np.full((S, R), ABSENT, np.int8)
                 in2 = np.full((S, R), ABSENT, np.int8)
                 dec = np.full((S,), ABSENT, np.int8)
@@ -285,7 +290,7 @@ class TestNodeKernelRouter:
                 nph = np.asarray(out.new_phase)
                 nd = np.asarray(out.newly_decided)
                 dv = np.asarray(out.decided_vals)
-                oph = np.asarray(states[i].phase)  # phase before the step
+                oph = old_phases[i]  # phase before the step
                 for s in range(S):
                     if cast[s]:
                         buf(s, int(slot[s]), int(oph[s]))["r2"][i] = int(r2v[s])
