@@ -1,0 +1,70 @@
+# Multi-stage Dockerfile for rabia-tpu example drivers.
+#
+# Reference parity: /root/reference Dockerfile:1-76 (multi-stage build
+# shipping the example binaries, non-root runtime user, RABIA_EXAMPLE
+# selector, healthcheck). The builder stage compiles the native C++ TCP
+# data plane once so the runtime image never needs a toolchain; the JAX
+# CPU backend runs everywhere, and a TPU runtime can layer libtpu on top.
+
+FROM python:3.12-slim AS builder
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /usr/src/rabia-tpu
+
+COPY pyproject.toml README.md ./
+COPY rabia_tpu/ ./rabia_tpu/
+
+# Build a wheel and precompile the native transport (librabia_transport.so
+# is cached next to the source keyed by its digest)
+RUN pip install --no-cache-dir build && python -m build --wheel
+RUN pip install --no-cache-dir dist/*.whl \
+    && python -c "from rabia_tpu.native.build import load_library; load_library()" \
+    && python - <<'EOF'
+# copy the compiled transport into a stable path for the runtime stage
+import glob, shutil
+so = glob.glob("/usr/local/lib/python3.12/site-packages/rabia_tpu/native/_transport_*.so")
+assert so, "native transport did not build"
+shutil.copy(so[0], "/usr/src/rabia-tpu/librabia_transport.so")
+EOF
+
+# Runtime stage
+FROM python:3.12-slim AS runtime
+
+# procps: pgrep for the healthcheck (not in slim by default)
+RUN apt-get update && apt-get install -y --no-install-recommends procps \
+    && rm -rf /var/lib/apt/lists/* \
+    && useradd -r -s /bin/false rabia
+
+COPY --from=builder /usr/src/rabia-tpu/dist/*.whl /tmp/
+# the wheel's dependencies pull in jax (CPU backend); TPU images add libtpu
+RUN pip install --no-cache-dir /tmp/*.whl && rm /tmp/*.whl
+
+COPY --from=builder /usr/src/rabia-tpu/librabia_transport.so \
+     /usr/local/lib/rabia_tpu/librabia_transport.so
+ENV RABIA_NATIVE_LIB=/usr/local/lib/rabia_tpu/librabia_transport.so
+
+# Example drivers are the user surface (reference ships 4 binaries)
+COPY examples/ /usr/local/share/rabia-tpu/examples/
+COPY README.md API_DOCUMENTATION.md PROTOCOL_GUIDE.md /usr/share/doc/rabia-tpu/
+
+RUN mkdir -p /var/lib/rabia /var/log/rabia && \
+    chown rabia:rabia /var/lib/rabia /var/log/rabia
+
+USER rabia
+WORKDIR /var/lib/rabia
+
+# Select the example with RABIA_EXAMPLE (reference Dockerfile:60-62)
+ENV RABIA_EXAMPLE=kvstore_usage
+ENV JAX_PLATFORMS=cpu
+CMD ["sh", "-c", "python /usr/local/share/rabia-tpu/examples/${RABIA_EXAMPLE}.py"]
+
+HEALTHCHECK --interval=30s --timeout=10s --start-period=5s --retries=3 \
+    CMD pgrep -f "${RABIA_EXAMPLE}" > /dev/null || exit 1
+
+LABEL description="TPU-native Rabia consensus SMR framework - example drivers"
+LABEL version="0.1.0"
+LABEL org.opencontainers.image.description="State Machine Replication on Rabia randomized consensus with the weak-MVC hot loop as a batched JAX array program"
+LABEL org.opencontainers.image.licenses="Apache-2.0"

@@ -60,15 +60,40 @@ def _build(target: Path) -> None:
 
 
 def load_library() -> ctypes.CDLL:
-    """Build (if needed) and dlopen the transport library; sets prototypes."""
+    """Build (if needed) and dlopen the transport library; sets prototypes.
+
+    ``RABIA_NATIVE_LIB`` points at a prebuilt .so (container runtime
+    images ship one so they need no toolchain)."""
     global _CACHED
     with _LOCK:
         if _CACHED is not None:
             return _CACHED
-        target = lib_path()
-        if not target.exists():
-            _build(target)
+        prebuilt = os.environ.get("RABIA_NATIVE_LIB")
+        if prebuilt:
+            target = Path(prebuilt)
+            if not target.exists():
+                # an explicitly configured path that is missing must fail
+                # loudly — falling back to a source build would mask the
+                # misconfiguration (and runtime images ship no compiler)
+                raise InternalError(
+                    f"RABIA_NATIVE_LIB points at a missing file: {prebuilt}"
+                )
+        else:
+            target = lib_path()
+            if not target.exists():
+                _build(target)
         lib = ctypes.CDLL(os.fspath(target))
+        if prebuilt:
+            # a prebuilt library bypasses the source-digest keying: probe
+            # the newest exported symbol so a stale .so fails fast with a
+            # clear message instead of a cryptic AttributeError later
+            try:
+                lib.rt_pool_stats
+            except AttributeError:
+                raise InternalError(
+                    f"RABIA_NATIVE_LIB library {prebuilt} is stale "
+                    "(missing rt_pool_stats); rebuild it from transport.cpp"
+                ) from None
 
         u8p = ctypes.POINTER(ctypes.c_uint8)
         lib.rt_create.restype = ctypes.c_void_p
