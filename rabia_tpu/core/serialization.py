@@ -6,12 +6,15 @@ trait (:9-19), ``JsonSerializer`` (:22-63), ``BinarySerializer`` (bincode,
 path and size estimator (:152-209).
 
 The binary codec here is hand-rolled little-endian (not bincode — no Rust):
-fixed-width header + per-payload-type body, optional zlib compression above
-``SerializationConfig.compression_threshold``. The same layout is implemented
-by the C++ data plane (rabia_tpu/native) so host transports can frame/parse
-without touching Python on the hot path.
+fixed-width header + per-payload-type body, with zlib compression above
+``SerializationConfig.compression_threshold`` for the scalar payload-
+bearing types only (Propose/NewBatch/SyncResponse — consensus-round
+vectors decode via ``numpy.frombuffer`` and stay uncompressed). The C++
+data plane (rabia_tpu/native) frames and transports these bytes opaquely
+(u32-LE length prefix); it does not parse message bodies — the
+vectorized numpy codecs below ARE the hot decode path.
 
-Binary layout (version 1):
+Binary layout (version 2):
   u8  version | u8 msg_type | u8 flags (bit0 compressed, bit1 has_recipient)
   16B msg id | 16B sender | [16B recipient] | f64 timestamp
   u32 body_len | body (possibly zlib-compressed payload body)
