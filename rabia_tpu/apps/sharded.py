@@ -33,7 +33,6 @@ from rabia_tpu.apps.kvstore import (
     KVResult,
     KVStoreConfig,
     KVStoreSMR,
-    decode_result_bin,
     encode_set_bin,
     shard_for_key,
 )

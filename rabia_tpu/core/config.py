@@ -182,6 +182,13 @@ class RabiaConfig:
     # opened slot so one fsync amortizes over K opens per shard (a restart
     # taints at most K-1 extra slots, resolved by the taint-release window)
     barrier_stride: int = 64
+    # broadcast Decision messages for newly decided slots (engine.rs:667-679
+    # parity). In the dense lockstep regime every replica decides each slot
+    # itself from round-2 votes, making the broadcast redundant; with False,
+    # stragglers recover via the targeted stale-vote repair (decided-value
+    # ring) and snapshot sync. Keep True for sparse/lossy deployments where
+    # proactive decision propagation shortens catch-up.
+    decision_broadcast: bool = True
     tcp: TcpNetworkConfig = TcpNetworkConfig()
     batching: BatchConfig = BatchConfig()
     validation: ValidationConfig = ValidationConfig()

@@ -216,6 +216,21 @@ class _Reader:
 
 # packed little-endian entry layouts (numpy structured dtypes are unpadded
 # by default, so tobytes()/frombuffer() match the per-field wire layout)
+# NodeId intern table: a cluster has a handful of peers but every decoded
+# message names one — skip re-hatching UUID/NodeId objects per message
+_NODE_INTERN: dict[bytes, NodeId] = {}
+
+
+def _intern_node(raw: bytes) -> NodeId:
+    n = _NODE_INTERN.get(raw)
+    if n is None:
+        if len(_NODE_INTERN) > 4096:  # bound against id-spraying peers
+            _NODE_INTERN.clear()
+        n = NodeId(uuid.UUID(bytes=raw))
+        _NODE_INTERN[bytes(raw)] = n
+    return n
+
+
 _VOTE_DT = np.dtype([("shard", "<u4"), ("phase", "<u8"), ("vote", "u1")])
 _DEC_DT = np.dtype(
     [("shard", "<u4"), ("phase", "<u8"), ("decision", "u1"), ("has_bid", "u1")]
@@ -492,8 +507,10 @@ class BinarySerializer:
             raise SerializationError(str(e)) from None
         flags = r.u8()
         msg_id = r.uuid()
-        sender = NodeId(r.uuid())
-        recipient = NodeId(r.uuid()) if flags & _FLAG_HAS_RECIPIENT else None
+        sender = _intern_node(r._take(16))
+        recipient = (
+            _intern_node(r._take(16)) if flags & _FLAG_HAS_RECIPIENT else None
+        )
         ts = r.f64()
         body = r.blob()
         if flags & _FLAG_COMPRESSED:

@@ -46,7 +46,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from rabia_tpu.core.blocks import PayloadBlock, block_batch_id
+from rabia_tpu.core.blocks import PayloadBlock
 from rabia_tpu.core.config import RabiaConfig
 from rabia_tpu.core.errors import QuorumNotAvailableError, RabiaError, ValidationError
 from rabia_tpu.core.messages import (
@@ -876,6 +876,11 @@ class RabiaEngine:
         rt.opened_at[idx] = 0.0
         rt.head_fwd_at[idx] = 0.0
         self._cur_blk_ref[idx] = -1
+        # decided-value ring: the stale-vote repair's answer source for
+        # bulk slots (which never materialize SlotRecords)
+        ring = slots & (rt.DEC_RING - 1)
+        rt.dec_ring_val[idx, ring] = vals
+        rt.dec_ring_slot[idx, ring] = slots
         n_v1 = int(v1.sum())
         rt.decided_v1 += n_v1
         rt.decided_v0 += len(idx) - n_v1
@@ -948,9 +953,10 @@ class RabiaEngine:
         if now - last < max(0.05, self.config.phase_timeout / 4):
             return
         entries: list[DecisionEntry] = []
+        rt = self.rt
         for s, slot in zip(shards[:256], slots[:256]):
             s, slot = int(s), int(slot)
-            rec = self.rt.shards[s].decisions.get(slot)
+            rec = rt.shards[s].decisions.get(slot)
             if rec is not None:
                 entries.append(
                     DecisionEntry(
@@ -958,6 +964,19 @@ class RabiaEngine:
                         phase=pack_phase(slot, 0),
                         decision=rec.value,
                         batch_id=rec.batch_id,
+                    )
+                )
+                continue
+            # bulk-lane slots have no SlotRecord: the decided-value ring
+            # still answers for the last DEC_RING slots per shard
+            ring = slot & (rt.DEC_RING - 1)
+            if rt.dec_ring_slot[s, ring] == slot:
+                entries.append(
+                    DecisionEntry(
+                        shard=s,
+                        phase=pack_phase(slot, 0),
+                        decision=StateValue(int(rt.dec_ring_val[s, ring])),
+                        batch_id=None,
                     )
                 )
         if entries:
@@ -1499,7 +1518,7 @@ class RabiaEngine:
                     # our own never-announced pending entries stay put:
                     # _record_decision voids them into the scalar retry lane
                 self._record_decision(s, slot, int(decided_vals[s]), bid)
-            if newly.any():
+            if newly.any() and self.config.decision_broadcast:
                 # steady-state Decisions are bid-free (fully columnar both
                 # ways); a peer that never saw the Propose recovers the
                 # binding from the late/retransmitted Propose or via sync
@@ -1547,6 +1566,9 @@ class RabiaEngine:
             sh.in_flight = False
         sh.next_slot = max(sh.next_slot, slot + 1)
         sh.opened_at = 0.0
+        ring = slot & (self.rt.DEC_RING - 1)
+        self.rt.dec_ring_val[s, ring] = value
+        self.rt.dec_ring_slot[s, ring] = slot
         # the next slot has a new proposer: restart the forward/give-up
         # clocks for whatever is still queued here
         self.rt.head_fwd_at[s] = 0.0

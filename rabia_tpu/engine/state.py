@@ -250,6 +250,8 @@ class EngineRuntime:
     ``shards[s]`` exposes them as attributes.
     """
 
+    DEC_RING = 64  # decided-value ring depth (power of two)
+
     def __init__(self, n_shards: int) -> None:
         S = n_shards
         self.n = S
@@ -268,6 +270,11 @@ class EngineRuntime:
         # buffered propose/decision non-emptiness flags (_FlagDict mirrors)
         self.prop_flag = np.zeros(S, bool)
         self.dec_flag = np.zeros(S, bool)
+        # compact decided-value ring (last DEC_RING slots per shard): the
+        # targeted stale-vote repair answers from here even for bulk-lane
+        # slots that never materialize SlotRecords
+        self.dec_ring_val = np.zeros((S, self.DEC_RING), np.int8)
+        self.dec_ring_slot = np.full((S, self.DEC_RING), -1, np.int64)
         self.columns = {
             "next_slot": self.next_slot,
             "applied_upto": self.applied_upto,

@@ -238,3 +238,275 @@ class TestBlockLaneEndToEnd:
             await svc.close()
         finally:
             await _stop(engines, tasks)
+
+
+class TestBlockLaneFaults:
+    @pytest.mark.asyncio
+    async def test_replica_crash_mid_bulk_load(self):
+        """Crash a replica while the block lane is pumping: survivors keep
+        committing (dead-proposer shards rotate via null slots) and stay
+        convergent."""
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.types import NodeId
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.engine.leader import slot_proposer_vec
+
+        S, R = 12, 3
+        nodes = [NodeId.from_int(i + 1) for i in range(R)]
+        hub = InMemoryHub()
+        cfg = RabiaConfig(
+            phase_timeout=0.3, heartbeat_interval=0.1, round_interval=0.0005
+        ).with_kernel(num_shards=S, shard_pad_multiple=S)
+        engines, stores, tasks = [], [], []
+        for n in nodes:
+            sm, machines = make_sharded_kv(S)
+            stores.append(machines)
+            engines.append(
+                RabiaEngine(ClusterConfig.new(n, nodes), sm, hub.register(n), config=cfg)
+            )
+            tasks.append(asyncio.ensure_future(engines[-1].run()))
+        try:
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                sts = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in sts):
+                    break
+            import numpy as _np
+
+            from rabia_tpu.apps.kvstore import encode_set_bin
+            from rabia_tpu.core.blocks import build_block
+            from rabia_tpu.core.types import Command, CommandBatch
+
+            shard_ids = _np.arange(S)
+
+            async def wave(live):
+                futs = []
+                for e in live:
+                    head = _np.maximum(e.rt.next_slot[:S], e.rt.applied_upto[:S])
+                    mine = shard_ids[
+                        (slot_proposer_vec(shard_ids, head, R) == e.me)
+                        & ~e.rt.in_flight[:S]
+                        & (e.rt.queue_len[:S] == 0)
+                    ]
+                    if len(mine):
+                        futs.append(
+                            await e.submit_block(
+                                build_block(
+                                    mine,
+                                    [[encode_set_bin(f"w{int(s)}", "x")] for s in mine],
+                                )
+                            )
+                        )
+                if futs:
+                    await asyncio.wait_for(
+                        asyncio.gather(*futs, return_exceptions=True), 20.0
+                    )
+
+            await wave(engines)  # healthy wave
+            # crash replica 0 (tolerated: quorum 2 of 3)
+            tasks[0].cancel()
+            hub.set_connected(nodes[0], False)
+            pre = (await engines[1].get_statistics()).committed_slots
+            # post-crash: live proposers pump blocks; shards whose rotation
+            # hits the dead row are fed through the scalar lane so the
+            # forward-timeout null slot rotates them
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while asyncio.get_event_loop().time() < deadline:
+                await wave(engines[1:])
+                e = engines[1]
+                head = _np.maximum(e.rt.next_slot[:S], e.rt.applied_upto[:S])
+                stuck = shard_ids[
+                    (slot_proposer_vec(shard_ids, head, R) == 0)
+                    & (e.rt.queue_len[:S] < 1)
+                ]
+                for s in stuck:
+                    try:
+                        await e.submit_batch(
+                            CommandBatch.new(
+                                [Command.new(encode_set_bin(f"w{int(s)}", "x"))],
+                                shard=int(s),
+                            ),
+                            shard=int(s),
+                        )
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.05)
+                post = (await engines[1].get_statistics()).committed_slots
+                if post - pre >= 2 * S:
+                    break
+            post = (await engines[1].get_statistics()).committed_slots
+            assert post - pre >= S, f"survivors stalled: {post - pre} commits"
+            # survivors convergent on a sample key
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                a = stores[1][3].store.get("w3")
+                b = stores[2][3].store.get("w3")
+                if a is not None and b is not None and a.value == b.value:
+                    break
+            assert a is not None and b is not None and a.value == b.value
+        finally:
+            for e in engines[1:]:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+
+class TestJaxBackendEngine:
+    @pytest.mark.asyncio
+    async def test_jax_kernel_backend_commits(self):
+        """KernelConfig.backend='jax' (device-array state + inbox planes)
+        commits the same as the host kernel — the device-engine deployment
+        path stays exercised."""
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.types import Command, CommandBatch, NodeId
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        S, R = 4, 3
+        nodes = [NodeId.from_int(i + 1) for i in range(R)]
+        hub = InMemoryHub()
+        cfg = RabiaConfig(
+            phase_timeout=0.5, heartbeat_interval=0.1, round_interval=0.001
+        ).with_kernel(num_shards=S, shard_pad_multiple=S, backend="jax")
+        engines, stores, tasks = [], [], []
+        for n in nodes:
+            sm, machines = make_sharded_kv(S)
+            stores.append(machines)
+            engines.append(
+                RabiaEngine(ClusterConfig.new(n, nodes), sm, hub.register(n), config=cfg)
+            )
+            tasks.append(asyncio.ensure_future(engines[-1].run()))
+        try:
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                sts = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in sts):
+                    break
+            fut = await engines[0].submit_batch(
+                CommandBatch.new([Command.new(encode_set_bin("jk", "jv"))], shard=1),
+                shard=1,
+            )
+            responses = await asyncio.wait_for(fut, 30.0)
+            assert len(responses) == 1
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                vals = [ms[1].store.get("jk") for ms in stores]
+                if all(v is not None and v.value == "jv" for v in vals):
+                    break
+            assert all(v is not None and v.value == "jv" for v in vals)
+        finally:
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+
+class TestNoDecisionBroadcast:
+    @pytest.mark.asyncio
+    async def test_straggler_recovers_without_decision_broadcasts(self):
+        """decision_broadcast=False: a partitioned replica that missed a
+        stretch of commits catches back up through the targeted stale-vote
+        repair (decided-value ring) and/or snapshot sync."""
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.types import NodeId
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.engine.leader import slot_proposer_vec
+        import numpy as _np
+
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.core.blocks import build_block
+
+        S, R = 8, 3
+        nodes = [NodeId.from_int(i + 1) for i in range(R)]
+        hub = InMemoryHub()
+        cfg = RabiaConfig(
+            phase_timeout=0.2,
+            heartbeat_interval=0.05,
+            round_interval=0.0005,
+            sync_timeout=1.0,
+            decision_broadcast=False,
+        ).with_kernel(num_shards=S, shard_pad_multiple=S)
+        engines, stores, tasks = [], [], []
+        for n in nodes:
+            sm, machines = make_sharded_kv(S)
+            stores.append(machines)
+            engines.append(
+                RabiaEngine(ClusterConfig.new(n, nodes), sm, hub.register(n), config=cfg)
+            )
+            tasks.append(asyncio.ensure_future(engines[-1].run()))
+        try:
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                sts = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in sts):
+                    break
+            shard_ids = _np.arange(S)
+
+            async def wave(live, tag):
+                futs = []
+                for e in live:
+                    head = _np.maximum(e.rt.next_slot[:S], e.rt.applied_upto[:S])
+                    mine = shard_ids[
+                        (slot_proposer_vec(shard_ids, head, R) == e.me)
+                        & ~e.rt.in_flight[:S]
+                        & (e.rt.queue_len[:S] == 0)
+                    ]
+                    if len(mine):
+                        try:
+                            futs.append(
+                                await e.submit_block(
+                                    build_block(
+                                        mine,
+                                        [
+                                            [encode_set_bin(f"s{int(s)}", tag)]
+                                            for s in mine
+                                        ],
+                                    )
+                                )
+                            )
+                        except Exception:
+                            # a just-healed replica may not have refreshed
+                            # its quorum view yet — skip it this wave
+                            pass
+                if futs:
+                    await asyncio.wait_for(
+                        asyncio.gather(*futs, return_exceptions=True), 20.0
+                    )
+
+            await wave(engines, "pre")
+            # partition node 2; the remaining quorum keeps committing for
+            # the slots it proposes (rotation parks at row-2 slots since
+            # nothing feeds the scalar give-up path — that's the crash
+            # test's job; here we only need the straggler to MISS commits)
+            hub.set_connected(nodes[2], False)
+            await asyncio.sleep(0.3)
+            for i in range(4):
+                await wave(engines[:2], f"gap{i}")
+            mid = (await engines[2].get_statistics()).committed_slots
+            lead = (await engines[0].get_statistics()).committed_slots
+            assert lead > mid, "quorum pair did not outrun the straggler"
+            # heal: traffic resumes cluster-wide; the straggler's fresh
+            # votes in already-decided slots must be answered by the
+            # targeted repair / sync — NO Decision broadcasts exist
+            hub.set_connected(nodes[2], True)
+            a = c = 0
+            for _ in range(600):
+                await asyncio.sleep(0.01)
+                await wave(engines, "post")
+                a = (await engines[0].get_statistics()).committed_slots
+                c = (await engines[2].get_statistics()).committed_slots
+                if c >= a - S and c > mid:
+                    break
+            assert c > mid, "straggler made no progress after heal"
+            assert c >= a - S, f"straggler stuck at {c} vs leader {a}"
+        finally:
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
