@@ -33,6 +33,7 @@ from rabia_tpu.apps.kvstore import (
     KVResult,
     KVStoreConfig,
     KVStoreSMR,
+    encode_op_bin,
     encode_set_bin,
     shard_for_key,
 )
@@ -249,25 +250,24 @@ class ShardedKVService:
 
     # -- block lane -----------------------------------------------------------
 
-    async def set_many(self, pairs: Sequence[tuple[str, str]]) -> list[KVResult]:
-        """Write many keys in one columnar block submission (one consensus
-        slot per covered shard). Falls back to per-op submission when the
-        engine exposes no block lane."""
-        if self._submit_block is None:
-            return list(
-                await asyncio.gather(*[self.set(k, v) for k, v in pairs])
-            )
+    async def _block_roundtrip(
+        self, keyed_ops: Sequence[tuple[str, bytes]]
+    ) -> list[KVResult]:
+        """Route (key, encoded-op) pairs shard-wise through one columnar
+        block submission; results in input order."""
+        if not keyed_ops:
+            return []
         by_shard: dict[int, list[bytes]] = {}
         positions: dict[int, list[int]] = {}
-        for pos, (k, v) in enumerate(pairs):
+        for pos, (k, op) in enumerate(keyed_ops):
             s = self.shard_of(k)
-            by_shard.setdefault(s, []).append(encode_set_bin(k, v))
+            by_shard.setdefault(s, []).append(op)
             positions.setdefault(s, []).append(pos)
         shards = sorted(by_shard)
         block = build_block(shards, [by_shard[s] for s in shards])
         fut = await self._submit_block(block)
         per_shard = await fut
-        out: list[KVResult] = [KVResult.err("missing response")] * len(pairs)
+        out: list[KVResult] = [KVResult.err("missing response")] * len(keyed_ops)
         for i, s in enumerate(shards):
             resp = per_shard[i]
             if isinstance(resp, Exception):
@@ -280,6 +280,30 @@ class ShardedKVService:
                     # shards come back through the scalar (JSON) path
                     out[pos] = codec.decode_response(raw)
         return out
+
+    async def set_many(self, pairs: Sequence[tuple[str, str]]) -> list[KVResult]:
+        """Write many keys in one columnar block submission (one consensus
+        slot per covered shard). Falls back to per-op submission when the
+        engine exposes no block lane."""
+        if self._submit_block is None:
+            return list(
+                await asyncio.gather(*[self.set(k, v) for k, v in pairs])
+            )
+        return await self._block_roundtrip(
+            [(k, encode_set_bin(k, v)) for k, v in pairs]
+        )
+
+    async def get_many(self, keys: Sequence[str]) -> list[KVResult]:
+        """Linearizable bulk reads through consensus (one slot per covered
+        shard), mirroring :meth:`set_many`. Falls back to per-op submission
+        without a block lane."""
+        if self._submit_block is None:
+            return list(
+                await asyncio.gather(*[self.get(k) for k in keys])
+            )
+        return await self._block_roundtrip(
+            [(k, encode_op_bin(KVOperation.get(k))) for k in keys]
+        )
 
     async def _roundtrip(self, op: KVOperation, shard: int) -> KVResult:
         if self._batcher is not None:

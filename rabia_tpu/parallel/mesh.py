@@ -264,12 +264,77 @@ class MeshPhaseKernel:
         )(state.slot, state.phase, state.my_r1, state.decided, alive, shard_index)
         return MeshPhaseState(*stepped)
 
-    def shard_index_array(self) -> jnp.ndarray:
-        """i32[S, R] global shard ids, placed like the state."""
-        idx = jnp.broadcast_to(
+    def _shard_index_grid(self) -> jnp.ndarray:
+        """i32[S, R] global shard ids (the coin's shard coordinate)."""
+        return jnp.broadcast_to(
             jnp.arange(self.S, dtype=I32)[:, None], (self.S, self.R)
         )
-        return jax.device_put(idx, NamedSharding(self.mesh, self._sr))
+
+    def shard_index_array(self) -> jnp.ndarray:
+        """i32[S, R] global shard ids, placed like the state."""
+        return jax.device_put(
+            self._shard_index_grid(), NamedSharding(self.mesh, self._sr)
+        )
 
     def place(self, arr: jnp.ndarray) -> jnp.ndarray:
         return jax.device_put(arr, NamedSharding(self.mesh, self._sr))
+
+    @functools.partial(
+        jax.jit,
+        static_argnums=(0, 3, 4, 5),
+        static_argnames=("n_slots", "max_phases", "start_slot_index"),
+    )
+    def slot_pipeline(
+        self,
+        initial_votes: jnp.ndarray,  # i8[T, S, R] per-slot initial R1 votes
+        alive: jnp.ndarray,  # bool[S, R]
+        n_slots: int,
+        max_phases: int = 4,
+        start_slot_index: int = 0,
+    ) -> jnp.ndarray:
+        """Decide ``n_slots`` consecutive slots for all shards ON THE MESH:
+        scan over slots, ``max_phases`` collective phases each (one phase
+        suffices fault-free; extra phases absorb split initial votes via
+        the common coin). The device-plane twin of
+        ``ClusterKernel.slot_pipeline`` — every phase's vote exchange is
+        two ``all_gather``s over the replica axis instead of N×(N−1)
+        transport messages (SURVEY.md §5.8).
+
+        Returns ``decided i8[T, S]`` (the agreed value per slot per shard;
+        ABSENT only if a shard failed to decide within ``max_phases`` —
+        callers re-run such shards with a deeper window).
+
+        ``start_slot_index`` offsets the slot numbering (and therefore the
+        common-coin stream) exactly like ``ClusterKernel.slot_pipeline`` —
+        successive windows MUST pass their log position or cross-window
+        coins would repeat.
+        """
+        shard_idx = self._shard_index_grid()
+
+        def per_slot(slot_no, slot_votes):
+            st = MeshPhaseState(
+                slot=jnp.full((self.S, self.R), slot_no, I32),
+                phase=jnp.zeros((self.S, self.R), I32),
+                my_r1=slot_votes.astype(I8),
+                decided=jnp.full((self.S, self.R), ABSENT, I8),
+            )
+
+            def ph(st, _):
+                return self.phase_step(st, alive, shard_idx), ()
+
+            st, _ = lax.scan(ph, st, None, length=max_phases)
+            # a decided replica's view; max over the replica axis collapses
+            # ABSENT (=3) only when nobody decided — mask it out explicitly
+            dec = st.decided
+            concrete = jnp.where(dec == ABSENT, I8(-1), dec)
+            best = jnp.max(concrete, axis=1)
+            return jnp.where(best < 0, I8(ABSENT), best.astype(I8))
+
+        slots = jnp.arange(
+            start_slot_index, start_slot_index + n_slots, dtype=I32
+        )
+        decided = lax.map(
+            lambda args: per_slot(args[0], args[1]),
+            (slots, initial_votes),
+        )
+        return decided

@@ -510,3 +510,29 @@ class TestNoDecisionBroadcast:
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
+
+
+class TestGetMany:
+    @pytest.mark.asyncio
+    async def test_bulk_reads_through_consensus(self):
+        S = 8
+        engines, stores, _ = _mk_cluster(S)
+        tasks = await _start(engines)
+        try:
+            svc = ShardedKVService(
+                S,
+                engines[0].submit_batch,
+                stores[0],
+                submit_block=engines[0].submit_block,
+            )
+            pairs = [(f"gk{i}", f"gv{i}") for i in range(20)]
+            res = await asyncio.wait_for(svc.set_many(pairs), 30.0)
+            assert all(r.ok for r in res)
+            got = await asyncio.wait_for(
+                svc.get_many([k for k, _ in pairs] + ["absent-key"]), 30.0
+            )
+            for (k, v), r in zip(pairs, got):
+                assert r.ok and r.value == v, (k, r)
+            assert not got[-1].ok or got[-1].value is None  # NotFound
+        finally:
+            await _stop(engines, tasks)
