@@ -59,6 +59,12 @@ def make_mesh(
     Defaults: all available devices on the shard axis (replica axis 1 —
     replicas vmapped within each device, the simulation mode). Axis sizes
     must multiply to the device count.
+
+    Multi-host: after ``jax.distributed.initialize()``, ``jax.devices()``
+    spans every host's chips and the same call builds a cross-host mesh —
+    replica-axis all_gathers then ride ICI within a slice and DCN across
+    slices, with no code changes here (standard JAX multi-host SPMD; lay
+    the replica axis within a slice so vote exchange stays on ICI).
     """
     devs = list(devices) if devices is not None else jax.devices()
     n = len(devs)
