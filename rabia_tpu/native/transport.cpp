@@ -607,12 +607,16 @@ void rt_close(void* h) {
   uint64_t one = 1;
   (void)!::write(t->wake_fd, &one, 8);
   if (t->io_thread.joinable()) t->io_thread.join();
-  std::lock_guard<std::mutex> lk(t->mu);
-  for (auto& [fd, c] : t->conns) ::close(fd);
-  t->conns.clear();
-  ::close(t->listen_fd);
-  ::close(t->epoll_fd);
-  ::close(t->wake_fd);
+  {
+    // the lock_guard must release BEFORE delete: unlocking a destroyed
+    // mutex is use-after-free (found by the TSan stress harness)
+    std::lock_guard<std::mutex> lk(t->mu);
+    for (auto& [fd, c] : t->conns) ::close(fd);
+    t->conns.clear();
+    ::close(t->listen_fd);
+    ::close(t->epoll_fd);
+    ::close(t->wake_fd);
+  }
   delete t;
 }
 

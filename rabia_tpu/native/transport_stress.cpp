@@ -1,0 +1,117 @@
+// ThreadSanitizer stress harness for the native transport (SURVEY §5.2:
+// the reference configures no race detection; we gate the C++ data plane
+// with TSan here). Build and run via tests/test_tcp.py::TestTsanStress:
+//
+//   g++ -O1 -g -std=c++17 -fsanitize=thread -pthread \
+//       transport_stress.cpp transport_tsan_glue.cpp -o stress && ./stress
+//
+// The harness links transport.cpp directly (no dlopen) so TSan sees every
+// thread: two transports handshake over loopback, then four threads hammer
+// send/broadcast/recv/stats/add-remove-peer concurrently while a fifth
+// tears one side down mid-traffic.
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* rt_create(const unsigned char* self_id, const char* host,
+                unsigned short port, unsigned short* actual_port);
+int rt_add_peer(void* h, const unsigned char* id, const char* host,
+                unsigned short port);
+int rt_remove_peer(void* h, const unsigned char* id);
+int rt_send(void* h, const unsigned char* id, const char* data,
+            unsigned int len);
+int rt_broadcast(void* h, const char* data, unsigned int len);
+int rt_recv(void* h, unsigned char sender_out[16], unsigned char* buf,
+            unsigned int buf_cap, int timeout_ms);
+int rt_connected(void* h, unsigned char* ids_out, int cap);
+unsigned short rt_port(void* h);
+unsigned long long rt_dropped(void* h);
+void rt_pool_stats(void* h, unsigned long long* hits,
+                   unsigned long long* misses);
+void rt_stop(void* h);
+void rt_close(void* h);
+}
+
+int main() {
+  unsigned char id_a[16] = {1};
+  unsigned char id_b[16] = {2};
+  unsigned short pa = 0, pb = 0;
+  void* a = rt_create(id_a, "127.0.0.1", 0, &pa);
+  void* b = rt_create(id_b, "127.0.0.1", 0, &pb);
+  if (!a || !b) {
+    std::fprintf(stderr, "create failed\n");
+    return 1;
+  }
+  rt_add_peer(a, id_b, "127.0.0.1", pb);
+  rt_add_peer(b, id_a, "127.0.0.1", pa);
+
+  // wait for the handshake
+  for (int i = 0; i < 200; i++) {
+    unsigned char ids[16 * 4];
+    if (rt_connected(a, ids, 4) >= 1 && rt_connected(b, ids, 4) >= 1) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+
+  std::atomic<bool> stop{false};
+  std::atomic<long> received{0};
+
+  std::thread sender_a([&] {
+    char msg[512];
+    std::memset(msg, 0x5A, sizeof(msg));
+    while (!stop.load()) {
+      rt_send(a, id_b, msg, sizeof(msg));
+      rt_broadcast(a, msg, 64);
+    }
+  });
+  std::thread sender_b([&] {
+    char msg[2048];
+    std::memset(msg, 0xA5, sizeof(msg));
+    while (!stop.load()) rt_broadcast(b, msg, sizeof(msg));
+  });
+  std::thread receiver_a([&] {
+    unsigned char sender[16];
+    std::vector<unsigned char> buf(1 << 16);
+    while (!stop.load()) {
+      int n = rt_recv(a, sender, buf.data(), buf.size(), 20);
+      if (n >= 0) received.fetch_add(1);
+    }
+  });
+  std::thread receiver_b([&] {
+    unsigned char sender[16];
+    std::vector<unsigned char> buf(1 << 16);
+    while (!stop.load()) {
+      int n = rt_recv(b, sender, buf.data(), buf.size(), 20);
+      if (n >= 0) received.fetch_add(1);
+    }
+  });
+  std::thread meddler([&] {
+    unsigned char ids[16 * 8];
+    while (!stop.load()) {
+      rt_connected(a, ids, 8);
+      unsigned long long h = 0, m = 0;
+      rt_pool_stats(b, &h, &m);
+      rt_dropped(a);
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
+    }
+  });
+
+  std::this_thread::sleep_for(std::chrono::seconds(2));
+  // tear one side down mid-traffic (close-under-load path)
+  rt_stop(b);
+  std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  stop.store(true);
+  sender_a.join();
+  sender_b.join();
+  receiver_a.join();
+  receiver_b.join();
+  meddler.join();
+  rt_close(b);
+  rt_stop(a);
+  rt_close(a);
+  std::printf("stress ok: %ld frames received\n", received.load());
+  return received.load() > 0 ? 0 : 2;
+}

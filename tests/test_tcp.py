@@ -141,3 +141,44 @@ class TestConsensusOverTcp:
             await asyncio.gather(*tasks, return_exceptions=True)
             for n in nets:
                 await n.close()
+
+
+class TestTsanStress:
+    def test_transport_under_thread_sanitizer(self, tmp_path):
+        """Compile the C++ data plane with -fsanitize=thread and hammer it
+        from five threads (send/broadcast/recv/stats/teardown-under-load).
+        Any data race fails the run (TSAN_OPTIONS halt_on_error)."""
+        import shutil
+        import subprocess
+        from pathlib import Path
+
+        if shutil.which("g++") is None:
+            pytest.skip("no g++")
+        src_dir = Path(__file__).parent.parent / "rabia_tpu" / "native"
+        out = tmp_path / "stress"
+        build = subprocess.run(
+            [
+                "g++", "-O1", "-g", "-std=c++17", "-fsanitize=thread",
+                "-pthread",
+                str(src_dir / "transport.cpp"),
+                str(src_dir / "transport_stress.cpp"),
+                "-o", str(out),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        if build.returncode != 0:
+            pytest.skip(f"tsan build unavailable: {build.stderr[-300:]}")
+        run = subprocess.run(
+            [str(out)],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env={"TSAN_OPTIONS": "halt_on_error=1", "PATH": "/usr/bin:/bin"},
+        )
+        assert run.returncode == 0, (
+            f"tsan stress failed rc={run.returncode}\n"
+            f"stdout: {run.stdout[-500:]}\nstderr: {run.stderr[-2000:]}"
+        )
+        assert "stress ok" in run.stdout
