@@ -182,6 +182,17 @@ class RabiaConfig:
     # opened slot so one fsync amortizes over K opens per shard (a restart
     # taints at most K-1 extra slots, resolved by the taint-release window)
     barrier_stride: int = 64
+    # taint-release window factor: a restored replica re-votes in a tainted
+    # slot only after taint_release_factor * phase_timeout passes with NO
+    # tainted-slot vote traffic. SAFETY ASSUMPTION (partial synchrony): an
+    # in-flight peer retransmits every phase_timeout, so a quiet window
+    # several times that implies nobody live still holds this replica's
+    # pre-crash votes. A peer stalled LONGER than the window (GC pause,
+    # partition) that later resurrects an old vote can violate the guard —
+    # set math.inf for fully-asynchronous safety (tainted slots then
+    # resolve only via adopted Decisions or snapshot sync, and a shard
+    # whose rotation parks on the restored replica waits for peers).
+    taint_release_factor: float = 4.0
     # broadcast Decision messages for newly decided slots (engine.rs:667-679
     # parity). In the dense lockstep regime every replica decides each slot
     # itself from round-2 votes, making the broadcast redundant; with False,

@@ -486,7 +486,8 @@ class RabiaEngine:
 
     @property
     def _taint_release(self) -> float:
-        return 4 * self.config.phase_timeout
+        # may be inf (asynchronous-safe mode): see config.taint_release_factor
+        return self.config.taint_release_factor * self.config.phase_timeout
 
     def _tainted_blocked(self) -> bool:
         # applied_upto, not next_slot: a slot decided-but-unapplied before
@@ -928,8 +929,9 @@ class RabiaEngine:
             return
         tainted = slots < self.rt.tainted_upto[shards]
         if tainted.any():
-            # peers are deciding tainted slots: keep waiting for adoption
-            self.rt.taint_traffic[shards[tainted]] = True
+            # peers are deciding tainted slots: hold the taint (sliding
+            # quiet-window — the column stores the last-seen time)
+            self.rt.taint_traffic[shards[tainted]] = time.time()
         np.maximum.at(self.rt.votes_seen_slot, shards, slots)
         mvcs = phases & _MVC_MASK
         stash = self._stash1 if round_no == 1 else self._stash2
@@ -1222,13 +1224,17 @@ class RabiaEngine:
                 # restart-equivocation guard: this replica may have voted in
                 # this slot before crashing — never cast fresh votes. The
                 # slot resolves via an adopted peer Decision (above), via
-                # snapshot sync, or — when no vote traffic for tainted slots
-                # has been seen for the whole release window — the taint
-                # lifts (nobody out there holds our pre-crash votes).
-                if (
-                    not sh.taint_traffic
-                    and now - self._restored_at > self._taint_release
-                ):
+                # snapshot sync, or — when a full release window passes
+                # with NO tainted-slot vote traffic — the taint lifts:
+                # in-flight peers retransmit every phase_timeout, so a
+                # quiet window several times that proves nobody live holds
+                # our pre-crash votes. (A sliding window, not a latch —
+                # traffic that stopped long ago must not wedge a shard
+                # whose rotation parks on this replica.)
+                quiet_since = max(
+                    self._restored_at, float(rt.taint_traffic[s])
+                )
+                if now - quiet_since > self._taint_release:
                     sh.tainted_upto = 0
                 continue
             proposer_row = slot_proposer(s, slot, self.R)

@@ -225,7 +225,18 @@ class ShardRuntime:
     opened_at = _col_property("opened_at")
     last_progress = _col_property("last_progress")
     tainted_upto = _col_property("tainted_upto")
-    taint_traffic = _col_property("taint_traffic")
+
+    @property
+    def taint_traffic(self) -> bool:
+        """Whether tainted-slot vote traffic has ever been observed (the
+        column stores the LAST-seen timestamp; release logic windows it)."""
+        return bool(self._rt_arrays["taint_traffic"][self.shard] > 0)
+
+    @taint_traffic.setter
+    def taint_traffic(self, value) -> None:
+        self._rt_arrays["taint_traffic"][self.shard] = (
+            time.time() if value else 0.0
+        )
 
     def gc_upto(self, slot: int) -> None:
         """Drop buffered state for every slot < `slot` (state.rs:191-243
@@ -261,7 +272,13 @@ class EngineRuntime:
         self.opened_at = np.zeros(S, np.float64)
         self.last_progress = np.zeros(S, np.float64)
         self.tainted_upto = np.zeros(S, np.int64)
-        self.taint_traffic = np.zeros(S, bool)
+        # LAST time vote traffic for a tainted slot was observed (0 =
+        # never). The taint-release check uses a sliding quiet WINDOW, not
+        # a latch: in-flight peers retransmit every phase_timeout, so a
+        # full release window with no traffic proves nobody live holds our
+        # pre-crash votes — a sticky flag would deadlock a shard whose
+        # rotation parks on the restored (taint-blocked) proposer
+        self.taint_traffic = np.zeros(S, np.float64)
         self.queue_len = np.zeros(S, np.int64)
         # scan caches (not authoritative): highest slot with foreign vote
         # traffic per shard; head-of-queue last-forward clock

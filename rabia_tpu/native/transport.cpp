@@ -42,6 +42,8 @@
 #include <thread>
 #include <vector>
 
+#include "transport.h"  // the C ABI — definitions below are checked against it
+
 namespace {
 
 constexpr uint32_t kMaxFrame = 16u * 1024u * 1024u;  // 16 MiB (tcp.rs:86)

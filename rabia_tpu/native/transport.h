@@ -1,0 +1,37 @@
+// C ABI of the native transport data plane — the single source of truth
+// for every consumer: transport.cpp includes it so definitions are
+// compiler-checked against these declarations, the TSan stress harness
+// links against it, and rabia_tpu/native/build.py mirrors it in ctypes.
+#pragma once
+
+#include <stdint.h>
+
+extern "C" {
+
+// Returns an opaque Transport handle (nullptr on failure); writes the
+// actually-bound port (for port 0 requests).
+void* rt_create(const uint8_t self_id[16], const char* host, uint16_t port,
+                uint16_t* actual_port);
+int rt_add_peer(void* h, const uint8_t id[16], const char* host,
+                uint16_t port);
+int rt_remove_peer(void* h, const uint8_t id[16]);
+// 0 ok, -1 unknown/unconnected peer, -2 frame too large.
+int rt_send(void* h, const uint8_t id[16], const uint8_t* data, uint32_t len);
+// Returns the number of peers reached.
+int rt_broadcast(void* h, const uint8_t* data, uint32_t len);
+// Blocks up to timeout_ms; >=0 frame length (truncated to buf_cap),
+// -3 timeout, -1 closed.
+int rt_recv(void* h, uint8_t sender_out[16], uint8_t* buf, uint32_t buf_cap,
+            int timeout_ms);
+// Writes up to cap established peer ids (16B each); returns the count.
+int rt_connected(void* h, uint8_t* ids_out, int cap);
+uint16_t rt_port(void* h);
+uint64_t rt_dropped(void* h);
+void rt_pool_stats(void* h, uint64_t* hits, uint64_t* misses);
+// Stop the io loop and unblock rt_recv callers WITHOUT freeing the
+// handle; call before rt_close when a reader thread may be inside
+// rt_recv.
+void rt_stop(void* h);
+void rt_close(void* h);
+
+}  // extern "C"

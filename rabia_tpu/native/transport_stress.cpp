@@ -3,38 +3,21 @@
 // with TSan here). Build and run via tests/test_tcp.py::TestTsanStress:
 //
 //   g++ -O1 -g -std=c++17 -fsanitize=thread -pthread \
-//       transport_stress.cpp transport_tsan_glue.cpp -o stress && ./stress
+//       transport.cpp transport_stress.cpp -o stress && ./stress
 //
 // The harness links transport.cpp directly (no dlopen) so TSan sees every
 // thread: two transports handshake over loopback, then four threads hammer
-// send/broadcast/recv/stats/add-remove-peer concurrently while a fifth
-// tears one side down mid-traffic.
+// send/broadcast/recv/stats concurrently while the main thread tears one
+// side down mid-traffic.
 
 #include <atomic>
+#include <chrono>
 #include <cstdio>
 #include <cstring>
 #include <thread>
 #include <vector>
 
-extern "C" {
-void* rt_create(const unsigned char* self_id, const char* host,
-                unsigned short port, unsigned short* actual_port);
-int rt_add_peer(void* h, const unsigned char* id, const char* host,
-                unsigned short port);
-int rt_remove_peer(void* h, const unsigned char* id);
-int rt_send(void* h, const unsigned char* id, const char* data,
-            unsigned int len);
-int rt_broadcast(void* h, const char* data, unsigned int len);
-int rt_recv(void* h, unsigned char sender_out[16], unsigned char* buf,
-            unsigned int buf_cap, int timeout_ms);
-int rt_connected(void* h, unsigned char* ids_out, int cap);
-unsigned short rt_port(void* h);
-unsigned long long rt_dropped(void* h);
-void rt_pool_stats(void* h, unsigned long long* hits,
-                   unsigned long long* misses);
-void rt_stop(void* h);
-void rt_close(void* h);
-}
+#include "transport.h"
 
 int main() {
   unsigned char id_a[16] = {1};
@@ -60,7 +43,7 @@ int main() {
   std::atomic<long> received{0};
 
   std::thread sender_a([&] {
-    char msg[512];
+    uint8_t msg[512];
     std::memset(msg, 0x5A, sizeof(msg));
     while (!stop.load()) {
       rt_send(a, id_b, msg, sizeof(msg));
@@ -68,31 +51,31 @@ int main() {
     }
   });
   std::thread sender_b([&] {
-    char msg[2048];
+    uint8_t msg[2048];
     std::memset(msg, 0xA5, sizeof(msg));
     while (!stop.load()) rt_broadcast(b, msg, sizeof(msg));
   });
   std::thread receiver_a([&] {
-    unsigned char sender[16];
-    std::vector<unsigned char> buf(1 << 16);
+    uint8_t sender[16];
+    std::vector<uint8_t> buf(1 << 16);
     while (!stop.load()) {
       int n = rt_recv(a, sender, buf.data(), buf.size(), 20);
       if (n >= 0) received.fetch_add(1);
     }
   });
   std::thread receiver_b([&] {
-    unsigned char sender[16];
-    std::vector<unsigned char> buf(1 << 16);
+    uint8_t sender[16];
+    std::vector<uint8_t> buf(1 << 16);
     while (!stop.load()) {
       int n = rt_recv(b, sender, buf.data(), buf.size(), 20);
       if (n >= 0) received.fetch_add(1);
     }
   });
   std::thread meddler([&] {
-    unsigned char ids[16 * 8];
+    uint8_t ids[16 * 8];
     while (!stop.load()) {
       rt_connected(a, ids, 8);
-      unsigned long long h = 0, m = 0;
+      uint64_t h = 0, m = 0;
       rt_pool_stats(b, &h, &m);
       rt_dropped(a);
       std::this_thread::sleep_for(std::chrono::milliseconds(5));

@@ -9,12 +9,16 @@ fsync never blocks the consensus round loop.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import os
 from pathlib import Path
 from typing import Optional
 
 from rabia_tpu.core.errors import PersistenceError
 from rabia_tpu.core.persistence import PersistenceLayer
+
+# unique per-write tmp-file sequence (see _atomic_write)
+_TMP_SEQ = itertools.count()
 
 STATE_FILE = "state.dat"
 
@@ -64,11 +68,24 @@ class FileSystemPersistence(PersistenceLayer):
         except OSError as e:
             raise PersistenceError(f"cannot create state dir: {e}") from None
         self.path = self.dir / STATE_FILE
+        # sweep tmp orphans from crashed saves (tmp names are unique per
+        # write, so a crash-looping process would otherwise accumulate
+        # them forever; no live writer of THIS process can exist yet)
+        for orphan in self.dir.glob("*.tmp*"):
+            try:
+                orphan.unlink()
+            except OSError:
+                pass
 
     def _atomic_write(self, path: Path, data: bytes) -> None:
         """tmp + fsync + rename + directory fsync: crash leaves either the
-        old or the new file, and the rename itself is durable."""
-        tmp = path.with_suffix(".tmp")
+        old or the new file, and the rename itself is durable.
+
+        The tmp name is unique per write: concurrent saves of the same
+        file (an explicit checkpoint racing the engine's periodic one, in
+        separate executor threads) must not share a tmp path — the loser's
+        rename would fail with ENOENT after the winner consumed it."""
+        tmp = path.with_suffix(f".tmp{os.getpid()}.{next(_TMP_SEQ)}")
         try:
             with open(tmp, "wb") as f:
                 f.write(data)
@@ -81,6 +98,10 @@ class FileSystemPersistence(PersistenceLayer):
             finally:
                 os.close(dfd)
         except OSError as e:
+            try:
+                tmp.unlink(missing_ok=True)
+            except OSError:
+                pass
             raise PersistenceError(f"save failed: {e}") from None
 
     def _save_sync(self, data: bytes) -> None:

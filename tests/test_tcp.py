@@ -154,6 +154,22 @@ class TestTsanStress:
 
         if shutil.which("g++") is None:
             pytest.skip("no g++")
+        # probe TOOLCHAIN support with a trivial TSan program: only a
+        # missing libtsan may skip — a compile failure of OUR sources must
+        # FAIL (otherwise a regression silently disables the race gate)
+        probe_src = tmp_path / "probe.cpp"
+        probe_src.write_text("int main() { return 0; }\n")
+        probe = subprocess.run(
+            [
+                "g++", "-fsanitize=thread", "-pthread",
+                str(probe_src), "-o", str(tmp_path / "probe"),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        if probe.returncode != 0:
+            pytest.skip(f"toolchain lacks TSan: {probe.stderr[-200:]}")
         src_dir = Path(__file__).parent.parent / "rabia_tpu" / "native"
         out = tmp_path / "stress"
         build = subprocess.run(
@@ -168,8 +184,9 @@ class TestTsanStress:
             text=True,
             timeout=180,
         )
-        if build.returncode != 0:
-            pytest.skip(f"tsan build unavailable: {build.stderr[-300:]}")
+        assert build.returncode == 0, (
+            f"TSan build of transport sources failed:\n{build.stderr[-2000:]}"
+        )
         run = subprocess.run(
             [str(out)],
             capture_output=True,
