@@ -160,8 +160,8 @@ class ConsensusTestHarness(TestCluster):
         except asyncio.TimeoutError:
             pass
         # poll until followers converge (stragglers may need a sync round
-        # trip) or the grace window closes
-        grace_deadline = time.time() + min(6.0, sc.timeout / 3)
+        # trip — under heavy loss, occasionally two) or the window closes
+        grace_deadline = time.time() + min(10.0, sc.timeout / 2)
         while True:
             committed = [
                 (await e.get_statistics()).committed_slots for e in self.engines
