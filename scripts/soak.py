@@ -1,0 +1,192 @@
+"""Chaos soak: bulk-lane load under random crash/heal cycles.
+
+Runs a 5-replica in-memory cluster at --shards shards for --seconds
+seconds while a chaos task randomly disconnects/reconnects up to f
+replicas; the pump drives block waves on live proposers and feeds
+dead-proposer shards through the scalar give-up lane. Exits nonzero if
+replicas fail to reconverge after the final heal.
+
+Usage: python scripts/soak.py [--seconds 60] [--shards 32] [--seed 42]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import random
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+async def soak(seconds: float, shards: int, seed: int) -> int:
+    import numpy as np
+
+    from rabia_tpu.apps import make_sharded_kv
+    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.core.blocks import build_block
+    from rabia_tpu.core.config import RabiaConfig
+    from rabia_tpu.core.network import ClusterConfig
+    from rabia_tpu.core.types import Command, CommandBatch, NodeId
+    from rabia_tpu.engine import RabiaEngine
+    from rabia_tpu.engine.leader import slot_proposer_vec
+    from rabia_tpu.net import InMemoryHub
+
+    S, R = shards, 5
+    rng = random.Random(seed)
+    nodes = [NodeId.from_int(i + 1) for i in range(R)]
+    hub = InMemoryHub()
+    cfg = RabiaConfig(
+        phase_timeout=0.3, heartbeat_interval=0.1, round_interval=0.0005
+    ).with_kernel(num_shards=S, shard_pad_multiple=S)
+    engines, stores, tasks = [], [], []
+    for n in nodes:
+        sm, machines = make_sharded_kv(S)
+        stores.append(machines)
+        engines.append(
+            RabiaEngine(ClusterConfig.new(n, nodes), sm, hub.register(n), config=cfg)
+        )
+        tasks.append(asyncio.ensure_future(engines[-1].run()))
+    for _ in range(300):
+        await asyncio.sleep(0.01)
+        sts = [await e.get_statistics() for e in engines]
+        if all(s.has_quorum for s in sts):
+            break
+    shard_ids = np.arange(S)
+    down: set = set()
+    stop_at = time.perf_counter() + seconds
+    waves = 0
+
+    async def chaos():
+        while time.perf_counter() < stop_at:
+            await asyncio.sleep(rng.uniform(2.0, 5.0))
+            if down and rng.random() < 0.6:
+                i = down.pop()
+                hub.set_connected(nodes[i], True)
+                print(f"[chaos] heal replica {i}")
+            elif len(down) < (R - 1) // 2:
+                cand = rng.choice([i for i in range(R) if i not in down])
+                down.add(cand)
+                hub.set_connected(nodes[cand], False)
+                print(f"[chaos] crash replica {cand}")
+
+    async def pump():
+        nonlocal waves
+        ctr = 0
+        while time.perf_counter() < stop_at:
+            futs = []
+            for i, e in enumerate(engines):
+                if i in down:
+                    continue
+                head = np.maximum(e.rt.next_slot[:S], e.rt.applied_upto[:S])
+                mine = shard_ids[
+                    (slot_proposer_vec(shard_ids, head, R) == e.me)
+                    & ~e.rt.in_flight[:S]
+                    & (e.rt.queue_len[:S] == 0)
+                ]
+                if len(mine):
+                    try:
+                        futs.append(
+                            await e.submit_block(
+                                build_block(
+                                    mine,
+                                    [
+                                        [encode_set_bin(f"s{int(s)}", f"v{ctr}")]
+                                        for s in mine
+                                    ],
+                                )
+                            )
+                        )
+                    except Exception:
+                        pass
+            live = [e for i, e in enumerate(engines) if i not in down]
+            if live and down:
+                e = live[0]
+                head = np.maximum(e.rt.next_slot[:S], e.rt.applied_upto[:S])
+                prop = slot_proposer_vec(shard_ids, head, R)
+                stuck = shard_ids[
+                    np.isin(prop, list(down)) & (e.rt.queue_len[:S] < 1)
+                ]
+                for s in stuck[:64]:
+                    try:
+                        await e.submit_batch(
+                            CommandBatch.new(
+                                [Command.new(encode_set_bin(f"s{int(s)}", f"v{ctr}"))],
+                                shard=int(s),
+                            ),
+                            shard=int(s),
+                        )
+                    except Exception:
+                        pass
+            if futs:
+                try:
+                    await asyncio.wait_for(
+                        asyncio.gather(*futs, return_exceptions=True), 20.0
+                    )
+                    waves += 1
+                except asyncio.TimeoutError:
+                    pass
+            ctr += 1
+            await asyncio.sleep(0.02)
+
+    ct = asyncio.ensure_future(chaos())
+    await pump()
+    ct.cancel()
+    for i in list(down):
+        hub.set_connected(nodes[i], True)
+    await asyncio.sleep(5.0)
+    sts = [await e.get_statistics() for e in engines]
+    committed = [s.committed_slots for s in sts]
+    print(f"waves={waves}, committed per replica: {committed}")
+    rc = 0
+    if max(committed) - min(committed) > 2 * S:
+        print("FAIL: replicas too far apart after heal")
+        rc = 1
+    else:
+        ok = False
+        for _ in range(600):
+            await asyncio.sleep(0.01)
+            vals = [
+                tuple(
+                    (stores[r][s].store.get(f"s{s}") or type("x", (), {"value": None})).value
+                    for s in (0, min(7, S - 1), min(19, S - 1))
+                )
+                for r in range(R)
+            ]
+            if len(set(vals)) == 1 and vals[0][0] is not None:
+                ok = True
+                break
+        if ok:
+            print("soak OK: all replicas convergent")
+        else:
+            print(f"FAIL: divergent values {vals}")
+            rc = 1
+    for e in engines:
+        try:
+            await asyncio.wait_for(e.shutdown(), 5)
+        except Exception:
+            pass
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    return rc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=60.0)
+    ap.add_argument("--shards", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    logging.disable(logging.WARNING)
+    return asyncio.run(soak(args.seconds, args.shards, args.seed))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
