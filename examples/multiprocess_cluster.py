@@ -1,0 +1,150 @@
+"""True multi-process cluster: one OS process per replica, native TCP.
+
+Every other driver runs its replicas in one process; this one launches
+three CHILD PYTHON PROCESSES, each owning a full RabiaEngine over the C++
+TCP data plane on localhost — the production deployment shape (the
+reference's tcp_networking example keeps all nodes in-process). The parent
+acts as the client of replica 0, commits writes, then asks every replica
+for its state digest and verifies convergence.
+
+Run: python examples/multiprocess_cluster.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+REPLICA_CODE = r"""
+import asyncio, json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import logging
+logging.disable(logging.WARNING)
+
+from rabia_tpu.apps import ShardedKVService, make_sharded_kv
+from rabia_tpu.core.config import RabiaConfig, TcpNetworkConfig
+from rabia_tpu.core.network import ClusterConfig
+from rabia_tpu.core.types import NodeId
+from rabia_tpu.engine import RabiaEngine
+from rabia_tpu.net.tcp import TcpNetwork
+
+ME = int(sys.argv[1])
+PORTS = json.loads(sys.argv[2])   # my listen port + peers', index-aligned
+N_OPS = int(sys.argv[3])
+S = 8
+
+async def main():
+    ids = [NodeId.from_int(i + 1) for i in range(3)]
+    net = TcpNetwork(ids[ME], TcpNetworkConfig(bind_port=PORTS[ME]))
+    for j in range(3):
+        if j != ME:
+            net.add_peer(ids[j], "127.0.0.1", PORTS[j])
+    cfg = RabiaConfig(
+        phase_timeout=0.5, heartbeat_interval=0.1, round_interval=0.001
+    ).with_kernel(num_shards=S, shard_pad_multiple=S)
+    sm, machines = make_sharded_kv(S)
+    eng = RabiaEngine(ClusterConfig.new(ids[ME], ids), sm, net, config=cfg)
+    task = asyncio.ensure_future(eng.run())
+    for _ in range(600):
+        await asyncio.sleep(0.05)
+        if (await eng.get_statistics()).has_quorum:
+            break
+    print(f"replica {ME}: quorum up", flush=True)
+
+    if ME == 0:
+        # this replica doubles as the client: commit N_OPS via set_many
+        svc = ShardedKVService(
+            S, eng.submit_batch, machines, submit_block=eng.submit_block
+        )
+        pairs = [(f"mp{i}", f"val{i}") for i in range(N_OPS)]
+        res = await asyncio.wait_for(svc.set_many(pairs), 60.0)
+        ok = sum(1 for r in res if r.ok)
+        print(f"replica 0: committed {ok}/{N_OPS}", flush=True)
+
+    # wait until every write is visible locally, then print the digest
+    want = N_OPS
+    for _ in range(1200):
+        await asyncio.sleep(0.05)
+        have = sum(
+            1
+            for i in range(N_OPS)
+            if machines[hash_shard(f"mp{i}")].store.get(f"mp{i}") is not None
+        )
+        if have >= want:
+            break
+    digest = sorted(
+        (f"mp{i}", machines[hash_shard(f"mp{i}")].store.get(f"mp{i}").value)
+        for i in range(N_OPS)
+        if machines[hash_shard(f"mp{i}")].store.get(f"mp{i}") is not None
+    )
+    print("DIGEST " + json.dumps(digest), flush=True)
+    await eng.shutdown()
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+    await net.close()
+
+def hash_shard(key):
+    from rabia_tpu.apps.kvstore import shard_for_key
+    return shard_for_key(key, S)
+
+asyncio.run(main())
+"""
+
+
+def _free_ports(n: int) -> list[int]:
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def main() -> int:
+    n_ops = 40
+    ports = _free_ports(3)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{REPO}{os.pathsep}" + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", REPLICA_CODE, str(i), json.dumps(ports), str(n_ops)],
+            stdout=subprocess.PIPE,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+        for i in range(3)
+    ]
+    digests = []
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=180)
+        print(f"--- replica {i} ---")
+        for line in out.splitlines():
+            if line.startswith("DIGEST "):
+                digests.append(line[len("DIGEST "):])
+            else:
+                print(" ", line)
+        if p.returncode != 0:
+            print(f"replica {i} exited rc={p.returncode}")
+            return 1
+    if len(digests) != 3 or len(set(digests)) != 1:
+        print("FAIL: replica digests diverge or are missing")
+        return 1
+    n = len(json.loads(digests[0]))
+    print(f"OK: 3 OS processes converged on {n}/{n_ops} keys over native TCP")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

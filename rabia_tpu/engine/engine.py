@@ -320,6 +320,27 @@ class RabiaEngine:
         self.rt.shards[s].queue.append(PendingSubmission(batch=batch, future=fut))
         return fut
 
+    def proposer_eligible_shards(self) -> np.ndarray:
+        """Shard indices this replica could open a block entry for RIGHT
+        NOW (rotation proposer at the head slot, idle, nothing queued or
+        bound). The block lane's eligibility mask, exposed for load
+        drivers/ops tooling so they don't re-derive it from runtime
+        internals."""
+        n = self.n_shards
+        rt = self.rt
+        shards = self._shard_ids[:n]
+        head = np.maximum(rt.next_slot[:n], rt.applied_upto[:n])
+        elig = (
+            (slot_proposer_vec(shards, head, self.R) == self.me)
+            & ~rt.in_flight[:n]
+            & (rt.queue_len[:n] == 0)
+            & ~rt.prop_flag[:n]
+            & (self._blk_pending_ref[:n] == -1)
+            & (self._cur_blk_ref[:n] == -1)
+            & (head >= rt.tainted_upto[:n])
+        )
+        return shards[elig]
+
     async def submit_block(self, block: PayloadBlock) -> asyncio.Future:
         """Accept a columnar block of batches (one per covered shard) for
         consensus — the bulk lane. Returns ONE future resolving to a list

@@ -55,6 +55,9 @@ async def soak(seconds: float, shards: int, seed: int) -> int:
         sts = [await e.get_statistics() for e in engines]
         if all(s.has_quorum for s in sts):
             break
+    else:
+        print("FAIL: quorum never formed")
+        return 1
     shard_ids = np.arange(S)
     down: set = set()
     stop_at = time.perf_counter() + seconds
@@ -81,12 +84,7 @@ async def soak(seconds: float, shards: int, seed: int) -> int:
             for i, e in enumerate(engines):
                 if i in down:
                     continue
-                head = np.maximum(e.rt.next_slot[:S], e.rt.applied_upto[:S])
-                mine = shard_ids[
-                    (slot_proposer_vec(shard_ids, head, R) == e.me)
-                    & ~e.rt.in_flight[:S]
-                    & (e.rt.queue_len[:S] == 0)
-                ]
+                mine = e.proposer_eligible_shards()
                 if len(mine):
                     try:
                         futs.append(
@@ -112,12 +110,18 @@ async def soak(seconds: float, shards: int, seed: int) -> int:
                 ]
                 for s in stuck[:64]:
                     try:
-                        await e.submit_batch(
+                        f = await e.submit_batch(
                             CommandBatch.new(
                                 [Command.new(encode_set_bin(f"s{int(s)}", f"v{ctr}"))],
                                 shard=int(s),
                             ),
                             shard=int(s),
+                        )
+                        # give-up-lane rejections are EXPECTED under chaos;
+                        # retrieve the exception so asyncio doesn't log
+                        # 'Future exception was never retrieved'
+                        f.add_done_callback(
+                            lambda fu: fu.exception() if not fu.cancelled() else None
                         )
                     except Exception:
                         pass
@@ -151,7 +155,7 @@ async def soak(seconds: float, shards: int, seed: int) -> int:
             await asyncio.sleep(0.01)
             vals = [
                 tuple(
-                    (stores[r][s].store.get(f"s{s}") or type("x", (), {"value": None})).value
+                    stores[r][s].store.get(f"s{s}").value
                     for s in (0, min(7, S - 1), min(19, S - 1))
                 )
                 for r in range(R)
