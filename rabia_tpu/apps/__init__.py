@@ -39,6 +39,10 @@ from rabia_tpu.apps.sharded import (
     ShardedStateMachine,
     make_sharded_kv,
 )
+from rabia_tpu.apps.vector_kv import (
+    VectorKVStore,
+    VectorShardedKV,
+)
 
 __all__ = [
     "Account",
@@ -65,6 +69,8 @@ __all__ = [
     "ShardedStateMachine",
     "StoreError",
     "StoreErrorKind",
+    "VectorKVStore",
+    "VectorShardedKV",
     "make_sharded_kv",
     "shard_for_key",
 ]
