@@ -249,3 +249,45 @@ class TestReviewRegressions:
         res = decode_result_bin(sm.apply_block(blk2, np.arange(1))[0][0])
         assert not res.ok  # explicit error, not replacement characters
         assert sm.store.get(0, b"k") == (b"\xff\xfe", 1)  # bytes API intact
+
+
+class TestMixedOpEquivalence:
+    def test_random_mixed_ops_match_classic(self):
+        """Interleaved set/get/delete/exists sequences: the vector store's
+        visible behavior (values, versions, found-ness) must match the
+        classic store op for op."""
+        from rabia_tpu.apps.kvstore import KVStore
+
+        rng = np.random.default_rng(17)
+        vec = VectorKVStore(4, capacity=32)  # tiny: forces growth + probes
+        classic = [KVStore() for _ in range(4)]
+        for step in range(800):
+            s = int(rng.integers(0, 4))
+            k = f"k{int(rng.integers(0, 12))}"
+            op = rng.random()
+            if op < 0.55:
+                v = f"v{step}"
+                assert vec.set(s, k.encode(), v.encode()) == classic[s].set(k, v).version
+            elif op < 0.75:
+                got = vec.get(s, k.encode())
+                cres = classic[s].get(k)
+                if cres.value is None:
+                    assert got is None
+                else:
+                    assert got is not None
+                    assert got[0].decode() == cres.value
+                    assert got[1] == cres.version
+            elif op < 0.9:
+                deleted = vec.delete(s, k.encode())
+                cres = classic[s].delete(k)
+                assert deleted == cres.ok
+            else:
+                found = vec.get(s, k.encode()) is not None
+                assert found == (classic[s].exists(k).value == "true")
+        # final state equality per shard — BOTH directions: every classic
+        # key readable in vec, and no ghost entries beyond the total count
+        assert len(vec) == sum(len(c.keys()) for c in classic)
+        for s in range(4):
+            for k in classic[s].keys():
+                got = vec.get(s, k.encode())
+                assert got is not None and got[0].decode() == classic[s].get(k).value
