@@ -133,6 +133,24 @@ class ShardedStateMachine(StateMachine, VectorStateMachine):
             bridge.restore_snapshot(Snapshot.from_bytes(bytes.fromhex(blob_hex)))
         self._version = snapshot.version
 
+    def restore_shards(self, snapshot: Snapshot, shard_ids) -> None:
+        """Restore ONLY the given shards from the snapshot (sync adoption
+        under mixed per-shard progress: the engine adopts a responder's
+        state solely for shards where the responder is ahead — wholesale
+        restore would regress shards where WE are ahead)."""
+        snapshot.verify()
+        doc = json.loads(snapshot.data)
+        blobs = doc["shards"]
+        for s in shard_ids:
+            s = int(s)
+            # tolerate a responder configured with fewer shards (reconfig
+            # skew): indices beyond its snapshot are simply not adopted
+            if 0 <= s < len(self.bridges) and s < len(blobs):
+                self.bridges[s].restore_snapshot(
+                    Snapshot.from_bytes(bytes.fromhex(blobs[s]))
+                )
+        self._version = max(self._version, snapshot.version)
+
     def get_state_summary(self) -> str:
         return f"{len(self.bridges)} shards"
 

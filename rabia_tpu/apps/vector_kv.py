@@ -498,21 +498,19 @@ class VectorKVStore:
         parts.append(json.dumps(over).encode())
         return b"".join(parts)
 
-    def restore_bytes(self, raw: bytes) -> None:
+    @staticmethod
+    def _parse_snapshot(raw: bytes):
+        """(shard_versions i64[N], rows, overflow_docs) where rows =
+        (shards, keys, vals, vers, created, updated) parallel lists."""
         n, num_shards = struct.unpack_from("<QI", raw, 0)
         off = 12
-        self.num_shards = num_shards
-        self.shard_version = np.frombuffer(
-            raw, np.int64, num_shards, offset=off
-        ).copy()
+        shard_versions = np.frombuffer(raw, np.int64, num_shards, offset=off).copy()
         off += 8 * num_shards
-        self._alloc(max(self.C, 1 << 10))
-        self.count = 0
-        self._overflow = {}
         shards, keys, vals, vers, created, updated = [], [], [], [], [], []
+        head = struct.calcsize("<iHIqdd")
         for _ in range(n):
             sh, klen, vlen, ver, cr, up = struct.unpack_from("<iHIqdd", raw, off)
-            off += struct.calcsize("<iHIqdd")
+            off += head
             keys.append(raw[off : off + klen])
             off += klen
             vals.append(raw[off : off + vlen])
@@ -522,28 +520,107 @@ class VectorKVStore:
             created.append(cr)
             updated.append(up)
         over = json.loads(raw[off:].decode()) if off < len(raw) else []
-        if n:
-            lanes, klens = self._lanes_from_keys(keys)
-            sh_arr = np.asarray(shards, np.int64)
-            if (self.count + n) * 10 > self.C * 7:
-                self._grow(1 << max(10, (int(n) * 2 - 1).bit_length()))
-            h = self._hash(lanes, klens, sh_arr)
-            slot = self._probe_or_insert(h, sh_arr, lanes, klens, 0.0)
-            vo = np.empty(n, object)
-            vo[:] = vals
-            self.val_buf[slot] = vo
-            self.val_off[slot] = 0
-            self.val_len[slot] = np.fromiter((len(v) for v in vals), np.int64, n)
-            self.version[slot] = np.asarray(vers, np.int64)
-            self.created[slot] = np.asarray(created)
-            self.updated[slot] = np.asarray(updated)
+        return shard_versions, (shards, keys, vals, vers, created, updated), over
+
+    def _bulk_load(self, rows) -> None:
+        """Insert parsed rows into the (fresh) table."""
+        shards, keys, vals, vers, created, updated = rows
+        n = len(keys)
+        if not n:
+            return
+        lanes, klens = self._lanes_from_keys(keys)
+        sh_arr = np.asarray(shards, np.int64)
+        if (self.count + n) * 10 > self.C * 7:
+            self._grow(1 << max(10, ((self.count + n) * 2 - 1).bit_length()))
+        h = self._hash(lanes, klens, sh_arr)
+        slot = self._probe_or_insert(h, sh_arr, lanes, klens, 0.0)
+        vo = np.empty(n, object)
+        vo[:] = vals
+        self.val_buf[slot] = vo
+        self.val_off[slot] = 0
+        self.val_len[slot] = np.fromiter((len(v) for v in vals), np.int64, n)
+        self.version[slot] = np.asarray(vers, np.int64)
+        self.created[slot] = np.asarray(created)
+        self.updated[slot] = np.asarray(updated)
+
+    def _absorb_overflow_docs(self, over, adopt=None) -> None:
+        """Decode overflow entries into the side-store (optionally only
+        the shards in ``adopt``) — one place owns the doc format."""
         for doc in over:
+            if adopt is not None and doc["shard"] not in adopt:
+                continue
             self._overflow[(doc["shard"], bytes.fromhex(doc["key"]))] = [
                 bytes.fromhex(doc["value"]),
                 doc["version"],
                 doc["created"],
                 doc["updated"],
             ]
+
+    def restore_bytes(self, raw: bytes) -> None:
+        shard_versions, rows, over = self._parse_snapshot(raw)
+        self.num_shards = len(shard_versions)
+        self.shard_version = shard_versions
+        self._alloc(max(self.C, 1 << 10))
+        self.count = 0
+        self._overflow = {}
+        self._bulk_load(rows)
+        self._absorb_overflow_docs(over)
+
+    def restore_shards_bytes(self, raw: bytes, shard_ids) -> None:
+        """Replace ONLY the given shards' entries/counters from the
+        snapshot, keeping every other shard's current state (sync adoption
+        under mixed per-shard progress).
+
+        Kept rows re-insert VECTORIZED from their stored hashes/lanes (the
+        ``_grow`` pattern) — a per-row Python loop over a large store
+        would stall the engine's event loop mid-sync."""
+        adopt = set(int(s) for s in shard_ids)
+        shard_versions, rows, over = self._parse_snapshot(raw)
+        used = np.nonzero(self.state == _USED)[0]
+        keep = used[
+            ~np.isin(self.shard_col[used], np.asarray(sorted(adopt), np.int64))
+        ]
+        kept = (
+            self.key_hash[keep].copy(),
+            self.key_len[keep].copy(),
+            self.key_lanes[keep].copy(),
+            self.shard_col[keep].copy(),
+            self.val_buf[keep].copy(),
+            self.val_off[keep].copy(),
+            self.val_len[keep].copy(),
+            self.version[keep].copy(),
+            self.created[keep].copy(),
+            self.updated[keep].copy(),
+        )
+        kept_overflow = {
+            k: v for k, v in self._overflow.items() if k[0] not in adopt
+        }
+        self._alloc(max(self.C, 1 << 10))
+        self.count = 0
+        self._overflow = kept_overflow
+        if len(keep):
+            needed = len(keep)
+            if needed * 10 > self.C * 7:
+                self._grow(1 << max(10, (needed * 2 - 1).bit_length()))
+            slot = self._probe_or_insert(
+                kept[0], kept[3], kept[2], kept[1].astype(np.int64), 0.0
+            )
+            self.val_buf[slot] = kept[4]
+            self.val_off[slot] = kept[5]
+            self.val_len[slot] = kept[6]
+            self.version[slot] = kept[7]
+            self.created[slot] = kept[8]
+            self.updated[slot] = kept[9]
+        # adopted shards come from the snapshot rows
+        adopted_rows = tuple(
+            [rows[j][i] for i in range(len(rows[0])) if int(rows[0][i]) in adopt]
+            for j in range(6)
+        )
+        self._bulk_load(adopted_rows)
+        self._absorb_overflow_docs(over, adopt)
+        for s in adopt:
+            if s < len(shard_versions) and s < len(self.shard_version):
+                self.shard_version[s] = shard_versions[s]
 
 
 # ---------------------------------------------------------------------------
@@ -728,6 +805,12 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
         snapshot.verify()
         self.store.restore_bytes(snapshot.data)
         self._version = snapshot.version
+
+    def restore_shards(self, snapshot: Snapshot, shard_ids) -> None:
+        """Per-shard sync adoption (see ShardedStateMachine.restore_shards)."""
+        snapshot.verify()
+        self.store.restore_shards_bytes(snapshot.data, shard_ids)
+        self._version = max(self._version, snapshot.version)
 
     def get_state_summary(self) -> str:
         return f"{len(self.store)} keys / {self.num_shards} shards (vector)"

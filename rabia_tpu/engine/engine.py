@@ -1872,7 +1872,19 @@ class RabiaEngine:
             self._resolve_sync()
 
     def _resolve_sync(self) -> None:
-        """Adopt the most advanced responder's snapshot (engine.rs:806-844)."""
+        """Adopt the most advanced responder's snapshot (engine.rs:806-844).
+
+        Adoption is PER SHARD: state and counters are taken only for
+        shards where the responder is ahead. Restoring the whole snapshot
+        while we are ahead on some shards would regress those shards'
+        state beneath our unchanged counters — a state/counter divergence
+        that then poisons every snapshot we later serve. State machines
+        expose ``restore_shards`` for this; a monolithic SM (no per-shard
+        restore) only adopts from a responder that is ahead-or-equal on
+        EVERY shard (a superset view — always true for single-shard
+        configs), otherwise it waits for per-shard repair/decisions or a
+        superset responder.
+        """
         if not self.rt.sync_responses:
             return
         best = max(self.rt.sync_responses.values(), key=lambda r: r[0])
@@ -1883,11 +1895,27 @@ class RabiaEngine:
         from rabia_tpu.core.state_machine import Snapshot
 
         snap = Snapshot.from_bytes(best[2])
-        self.sm.restore_snapshot(snap)
+        resp_applied = np.asarray(best[3][: self.S], np.int64)
+        ours = self.rt.applied_upto[: len(resp_applied)]
+        ahead = np.nonzero(resp_applied > ours)[0]
+        if len(ahead) == 0:
+            return
+        restore_shards = getattr(self.sm, "restore_shards", None)
+        if restore_shards is not None:
+            restore_shards(snap, ahead.tolist())
+        else:
+            if bool((resp_applied < ours).any()):
+                logger.warning(
+                    "%s sync: responder not a superset and state machine "
+                    "has no per-shard restore — waiting for repair/decisions",
+                    self.node_id.short(),
+                )
+                return
+            self.sm.restore_snapshot(snap)
         self.rt.state_version = best[1]
-        for s, applied in enumerate(best[3]):
-            if s >= self.S:
-                break
+        for s in ahead.tolist():
+            s = int(s)
+            applied = int(resp_applied[s])
             sh = self.rt.shards[s]
             if applied > sh.applied_upto:
                 # mark skipped slots as applied-elsewhere

@@ -474,3 +474,112 @@ class TestTracing:
         ):
             assert name in report and report[name]["count"] > 0, report.keys()
         tracer.reset()
+
+
+class TestMixedProgressSync:
+    """Sync adoption must be PER SHARD: a responder ahead on some shards
+    must not regress shards where the syncing replica is ahead (wholesale
+    snapshot restore under mixed progress poisons state/counter
+    consistency)."""
+
+    def _mk(self, S, sm):
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        return RabiaEngine(
+            ClusterConfig.new(nodes[0], nodes),
+            sm,
+            hub.register(nodes[0]),
+            config=_mk_config(S),
+        ), nodes
+
+    @pytest.mark.asyncio
+    async def test_sharded_sm_adopts_only_ahead_shards(self):
+        from rabia_tpu.apps import make_sharded_kv
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.core.messages import SyncResponse
+        from rabia_tpu.core.types import Command, CommandBatch, ShardId
+
+        S = 2
+        sm_a, stores_a = make_sharded_kv(S)  # the responder's state
+        sm_b, stores_b = make_sharded_kv(S)  # the syncing replica's
+
+        def put(sm, shard, key, val):
+            sm.apply_batch(
+                CommandBatch.new(
+                    [Command.new(encode_set_bin(key, val))], shard=ShardId(shard)
+                )
+            )
+
+        # responder A: ahead on shard 0 (3 slots), empty shard 1
+        for i in range(3):
+            put(sm_a, 0, f"a{i}", f"A{i}")
+        # syncer B: ahead on shard 1 (2 slots), empty shard 0
+        put(sm_b, 1, "b0", "B0")
+        put(sm_b, 1, "b1", "B1")
+
+        eng, nodes = self._mk(S, sm_b)
+        eng.rt.shards[1].applied_upto = 2
+        eng.rt.shards[1].next_slot = 2
+
+        snap = sm_a.create_snapshot()
+        resp = SyncResponse(
+            responder_phase=3,
+            state_version=3,
+            snapshot=snap.to_bytes(),
+            per_shard_phase=(3, 0),
+            applied_ids=(),
+        )
+        eng.rt.sync_started_at = 0.0
+        eng._on_sync_response(nodes[1], resp)
+        # shard 0 adopted from A...
+        assert eng.rt.shards[0].applied_upto == 3
+        assert stores_b[0].store.get("a2").value == "A2"
+        # ...while shard 1's OWN state and counters survive
+        assert eng.rt.shards[1].applied_upto == 2
+        assert stores_b[1].store.get("b1").value == "B1"
+
+    @pytest.mark.asyncio
+    async def test_monolithic_sm_requires_superset_responder(self):
+        from rabia_tpu.core.messages import SyncResponse
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+
+        S = 2
+        sm = InMemoryStateMachine()
+        eng, nodes = self._mk(S, sm)
+        # we are ahead on shard 1
+        eng.rt.shards[1].applied_upto = 2
+        responder_sm = InMemoryStateMachine()
+        snap = responder_sm.create_snapshot()
+        resp = SyncResponse(
+            responder_phase=3,
+            state_version=3,
+            snapshot=snap.to_bytes(),
+            per_shard_phase=(3, 0),  # ahead on 0, BEHIND on 1
+            applied_ids=(),
+        )
+        eng.rt.sync_started_at = 0.0
+        eng._on_sync_response(nodes[1], resp)
+        # not a superset + no per-shard restore => nothing adopted
+        assert eng.rt.shards[0].applied_upto == 0
+        assert eng.rt.shards[1].applied_upto == 2
+
+    def test_vector_store_restore_shards(self):
+        from rabia_tpu.apps.vector_kv import VectorShardedKV
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.core.blocks import build_block
+        import numpy as np
+
+        a = VectorShardedKV(3, capacity=64)
+        b = VectorShardedKV(3, capacity=64)
+        a.apply_block(
+            build_block([0, 2], [[encode_set_bin("x", "Ax")], [encode_set_bin("z", "Az")]]),
+            np.arange(2),
+        )
+        b.apply_block(
+            build_block([1], [[encode_set_bin("y", "By")]]), np.arange(1)
+        )
+        snap = a.create_snapshot()
+        b.restore_shards(snap, [0])  # adopt only shard 0 from A
+        assert b.store.get(0, b"x") == (b"Ax", 1)
+        assert b.store.get(1, b"y") == (b"By", 1)  # kept
+        assert b.store.get(2, b"z") is None  # NOT adopted
