@@ -14,9 +14,12 @@ rabia-engine/src/engine.rs:312-347); this is the S-axis design of
 SURVEY.md §7.1 applied to the payload plane.
 
 Identity: a command inside a block has no UUID — its replicated identity
-is ``(block.id, shard)`` for the batch and the position ``j`` within the
-shard's region for the command. ``block_batch_id(block_id, shard)`` builds
-the hashable dedup key used wherever the scalar lane uses ``BatchId``.
+is derived from ``(block.id, shard)`` for the batch and the position ``j``
+within the shard's region for the command. ``block_batch_id(block_id,
+shard)`` derives a real, wire-representable :class:`BatchId` (every replica
+derives the same id for the same block region), so block-lane ids flow
+through the binary codec (SyncResponse.applied_ids, Decision.batch_id)
+exactly like scalar-lane ids.
 """
 
 from __future__ import annotations
@@ -28,12 +31,23 @@ from typing import Optional, Sequence
 import numpy as np
 
 from rabia_tpu.core.errors import ValidationError
-from rabia_tpu.core.types import Command, CommandBatch, ShardId
+from rabia_tpu.core.types import BatchId, Command, CommandBatch, ShardId
+
+# 128-bit odd mixing constant (golden-ratio extension) — spreads the shard
+# index across the whole id so distinct shards of one block never collide.
+_SHARD_MIX = 0x9E3779B97F4A7C15F39CC0605CEDC835
+_U128 = (1 << 128) - 1
 
 
-def block_batch_id(block_id: uuid.UUID, shard: int) -> tuple:
-    """Hashable replicated identity of one shard's batch inside a block."""
-    return ("blk", block_id.int, int(shard))
+def block_batch_id(block_id: uuid.UUID, shard: int) -> BatchId:
+    """Deterministic :class:`BatchId` for one shard's batch inside a block.
+
+    Pure function of ``(block_id, shard)`` so every replica derives the
+    identical id without coordination; XOR-multiply mixing keeps it cheap
+    enough for the bulk lane (no hashing).
+    """
+    mixed = (block_id.int ^ (((int(shard) + 1) * _SHARD_MIX) & _U128)) & _U128
+    return BatchId(uuid.UUID(int=mixed))
 
 
 class PayloadBlock:
@@ -58,6 +72,7 @@ class PayloadBlock:
         "data",
         "_cmd_offsets",
         "_shard_starts",
+        "_id_cache",
     )
 
     def __init__(
@@ -83,6 +98,7 @@ class PayloadBlock:
             raise ValidationError("block cmd_sizes disagree with data length")
         self._cmd_offsets: Optional[np.ndarray] = None
         self._shard_starts: Optional[np.ndarray] = None
+        self._id_cache: dict[int, BatchId] = {}
 
     # -- derived indices ------------------------------------------------------
 
@@ -123,8 +139,12 @@ class PayloadBlock:
             self.data[int(offs[j]) : int(offs[j + 1])] for j in range(lo, hi)
         ]
 
-    def batch_id_for(self, i: int) -> tuple:
-        return block_batch_id(self.id, int(self.shards[i]))
+    def batch_id_for(self, i: int) -> BatchId:
+        bid = self._id_cache.get(i)
+        if bid is None:
+            bid = block_batch_id(self.id, int(self.shards[i]))
+            self._id_cache[i] = bid
+        return bid
 
     def materialize_batch(self, i: int) -> CommandBatch:
         """Build a scalar-lane CommandBatch for covered-shard index ``i``

@@ -184,15 +184,17 @@ class RabiaConfig:
     barrier_stride: int = 64
     # taint-release window factor: a restored replica re-votes in a tainted
     # slot only after taint_release_factor * phase_timeout passes with NO
-    # tainted-slot vote traffic. SAFETY ASSUMPTION (partial synchrony): an
-    # in-flight peer retransmits every phase_timeout, so a quiet window
-    # several times that implies nobody live still holds this replica's
-    # pre-crash votes. A peer stalled LONGER than the window (GC pause,
-    # partition) that later resurrects an old vote can violate the guard —
-    # set math.inf for fully-asynchronous safety (tainted slots then
-    # resolve only via adopted Decisions or snapshot sync, and a shard
-    # whose rotation parks on the restored replica waits for peers).
-    taint_release_factor: float = 4.0
+    # tainted-slot vote traffic (4x longer still when any member is out of
+    # view — an absent peer is exactly the one that could hold pre-crash
+    # votes). SAFETY ASSUMPTION (partial synchrony): an in-flight peer
+    # retransmits every phase_timeout, so a quiet window many times that
+    # implies nobody live still holds this replica's pre-crash votes. A
+    # CONNECTED peer stalled longer than the window (GC pause) that later
+    # resurrects an old vote can still violate the guard — set math.inf
+    # for fully-asynchronous safety (tainted slots then resolve only via
+    # adopted Decisions or snapshot sync, and a shard whose rotation parks
+    # on the restored replica waits for peers).
+    taint_release_factor: float = 16.0
     # broadcast Decision messages for newly decided slots (engine.rs:667-679
     # parity). In the dense lockstep regime every replica decides each slot
     # itself from round-2 votes, making the broadcast redundant; with False,

@@ -274,6 +274,11 @@ class SyncResponse:
     snapshot: Optional[bytes] = None
     per_shard_phase: tuple[int, ...] = ()
     applied_ids: tuple[tuple[int, BatchId], ...] = ()
+    # per-shard count of V1-APPLIED batches (the unit of state_version):
+    # partial per-shard adoption advances the adopter's version by exactly
+    # the responder's surplus on the adopted shards — adopting the global
+    # version (or counting null slots) would make versions incomparable
+    per_shard_version: tuple[int, ...] = ()
 
 
 @dataclass(frozen=True)

@@ -34,6 +34,8 @@ class PersistedEngineState:
     snapshot: Optional[Snapshot] = None
     per_shard_phase: list[int] = field(default_factory=list)
     per_shard_committed: list[int] = field(default_factory=list)
+    # per-shard V1-applied batch counts (the unit of state_version)
+    per_shard_version: list[int] = field(default_factory=list)
 
     def to_bytes(self) -> bytes:
         doc = {
@@ -47,6 +49,7 @@ class PersistedEngineState:
             ),
             "per_shard_phase": self.per_shard_phase,
             "per_shard_committed": self.per_shard_committed,
+            "per_shard_version": self.per_shard_version,
         }
         return json.dumps(doc, separators=(",", ":")).encode("utf-8")
 
@@ -67,6 +70,9 @@ class PersistedEngineState:
                 per_shard_phase=[int(x) for x in doc.get("per_shard_phase", [])],
                 per_shard_committed=[
                     int(x) for x in doc.get("per_shard_committed", [])
+                ],
+                per_shard_version=[
+                    int(x) for x in doc.get("per_shard_version", [])
                 ],
             )
         except (ValueError, KeyError) as e:

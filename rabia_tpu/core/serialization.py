@@ -14,7 +14,7 @@ data plane (rabia_tpu/native) frames and transports these bytes opaquely
 (u32-LE length prefix); it does not parse message bodies — the
 vectorized numpy codecs below ARE the hot decode path.
 
-Binary layout (version 2):
+Binary layout (version 3):
   u8  version | u8 msg_type | u8 flags (bit0 compressed, bit1 has_recipient)
   16B msg id | 16B sender | [16B recipient] | f64 timestamp
   u32 body_len | body (possibly zlib-compressed payload body)
@@ -62,8 +62,9 @@ from rabia_tpu.core.types import (
 
 # version 2: Decision body moved its optional batch-id UUIDs from
 # inline-per-entry to a trailing section (fixed entries decode as one
-# frombuffer); v1 peers cleanly reject rather than mis-parse
-_VERSION = 2
+# frombuffer); v1 peers cleanly reject rather than mis-parse.
+# version 3: SyncResponse gained the trailing per_shard_version section.
+_VERSION = 3
 _FLAG_COMPRESSED = 0x01
 _FLAG_HAS_RECIPIENT = 0x02
 
@@ -349,6 +350,9 @@ def _encode_payload(w: _Writer, payload) -> None:
         for shard, bid in payload.applied_ids:
             w.u32(shard)
             w.uuid(bid.value)
+        w.u32(len(payload.per_shard_version))
+        for v in payload.per_shard_version:
+            w.u64(v)
     elif isinstance(payload, ProposeBlock):
         b = payload.block
         k = len(b)
@@ -418,7 +422,9 @@ def _decode_payload(msg_type: MessageType, r: _Reader):
         per_shard = tuple(r.u64() for _ in range(n))
         n_ids = r.u32()
         applied = tuple((r.u32(), BatchId(r.uuid())) for _ in range(n_ids))
-        return SyncResponse(phase, ver, snap, per_shard, applied)
+        n_v = r.u32()
+        per_ver = tuple(r.u64() for _ in range(n_v))
+        return SyncResponse(phase, ver, snap, per_shard, applied, per_ver)
     if msg_type == MessageType.ProposeBlock:
         bid = r.uuid()
         k = r.u32()

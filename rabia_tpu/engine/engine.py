@@ -241,6 +241,7 @@ class RabiaEngine:
 
         # vote stash: arrays appended at ingest, routed to the kernel in
         # bulk once per tick ([(row, shards, slots, mvcs, vals)] per round)
+        self._restep = False
         self._stash1: list[tuple] = []
         self._stash2: list[tuple] = []
         # carry: future-(slot, phase) votes kept across ticks (same tuple
@@ -471,6 +472,8 @@ class RabiaEngine:
                 self.rt.next_slot[: len(opened)] = opened
                 self.rt.applied_upto[: len(applied)] = applied
                 self.rt.state_version = persisted.state_version
+                vers = np.asarray(persisted.per_shard_version[: self.S], np.int64)
+                self.rt.v1_applied[: len(vers)] = vers
                 logger.info(
                     "%s restored: %d slots applied",
                     self.node_id.short(),
@@ -556,11 +559,16 @@ class RabiaEngine:
             bulk = self._open_block_slots()
             opened = self._open_slots()
         stepped = False
-        # step the kernel only on NEW input (opens or arrivals): consensus
-        # math is deterministic, so an in-flight shard with no new votes
-        # cannot progress — idle steps are pure dispatch waste. Loss
-        # recovery is timeout-driven (_check_timeouts), not step-driven.
-        if opened or bulk is not None or got_msgs:
+        # step the kernel on NEW input (opens or arrivals) or when the last
+        # step left ledger-resident progress pending (_restep): the kernel
+        # advances one stage per step, so a stage transition (R1→R2 cast,
+        # phase advance) can make votes ALREADY in the ledger/carry
+        # decisive without any further peer traffic — most acutely for
+        # R==1, where no peer traffic ever arrives. Otherwise idle steps
+        # are pure dispatch waste; loss recovery is timeout-driven
+        # (_check_timeouts), not step-driven.
+        if opened or bulk is not None or got_msgs or self._restep:
+            self._restep = False
             with span("engine.tick.kernel"):
                 await self._kernel_round(opened, bulk)
             stepped = True
@@ -878,6 +886,12 @@ class RabiaEngine:
                         rec.out.settle(int(bi), resp)
                 self._unref_block(int(ref), len(bsel))
             rt.state_version += int(v1.sum()) - len(lost)
+            good = (
+                np.setdiff1d(v1_idx, np.asarray(lost, np.int64))
+                if lost
+                else v1_idx
+            )
+            np.add.at(rt.v1_applied, idx[good], 1)
             self.rt.last_apply_time = time.time()
         if lost:
             keep = np.ones(len(idx), bool)
@@ -1255,7 +1269,17 @@ class RabiaEngine:
                 quiet_since = max(
                     self._restored_at, float(rt.taint_traffic[s])
                 )
-                if now - quiet_since > self._taint_release:
+                # the quiet window only proves anything about CONNECTED
+                # peers: an absent (partitioned/paused) peer is exactly
+                # the one that could still hold our pre-crash votes. With
+                # the full membership in view, release after one window;
+                # with peers missing, hold out 4x longer — a dead peer
+                # must not wedge the shard forever, but a partitioned one
+                # gets ample time to heal and retransmit (which refreshes
+                # taint_traffic, restarting the window).
+                full_view = len(alive_set) >= len(self.cluster.all_nodes)
+                release = self._taint_release * (1.0 if full_view else 4.0)
+                if now - quiet_since > release:
                     sh.tainted_upto = 0
                 continue
             proposer_row = slot_proposer(s, slot, self.R)
@@ -1482,6 +1506,10 @@ class RabiaEngine:
         cast_r2 = np.asarray(outbox.cast_r2)[:n] & act
         advanced = np.asarray(outbox.advanced)[:n] & act
         done = np.asarray(self._done)[:n] & act
+        # a stage transition may have made ledger-resident (or carried)
+        # votes decisive — schedule one follow-up step (see _tick)
+        if cast_r2.any() or advanced.any():
+            self._restep = True
 
         if cast_r2.any():
             idx = np.nonzero(cast_r2)[0]
@@ -1662,6 +1690,7 @@ class RabiaEngine:
                         sh.applied_ids[rec.batch_id] = None
                         sh.applied_results[rec.batch_id] = responses
                         self.rt.state_version += 1
+                        self.rt.v1_applied[s] += 1
                         if responses is not None:
                             self._resolve_local(sh, batch, responses)
                         else:
@@ -1833,23 +1862,37 @@ class RabiaEngine:
         if total_applied <= p.current_phase:
             return  # not ahead; stay silent (engine.rs:763-779)
         snap = self.sm.create_snapshot()
-        # recent ids only: the in-memory dedup horizon (64x max_pending per
-        # shard) would overflow the 16 MiB transport frame cap at scale —
-        # a duplicate commit of a batch older than the retransmit horizon
-        # is not reachable through live traffic anyway
-        id_cap = 2 * self.config.max_pending_batches
-        applied_ids = tuple(
-            (s, bid)
-            for s, sh in enumerate(self.rt.shards[: self.n_shards])
-            for bid in list(sh.applied_ids)[-id_cap:]
+        snap_bytes = snap.to_bytes()
+        # ship the FULL in-memory dedup horizon (64x max_pending per shard)
+        # whenever it fits the transport frame: a synced replica with a
+        # truncated ledger double-applies any batch whose late duplicate
+        # commit lands beyond the shipped horizon. The id budget is what
+        # remains of the frame after the snapshot and the per-shard u64
+        # sections (plus header slack) — a response that overflows the
+        # frame cap is dropped by the transport and sync never completes.
+        budget = self.config.tcp.buffers.max_frame_size - len(snap_bytes)
+        budget -= 2 * 8 * self.S + 65536  # per-shard u64 sections + slack
+        id_cap = min(
+            64 * self.config.max_pending_batches,
+            max(0, budget) // (24 * max(1, self.n_shards)),
+        )
+        applied_ids = (
+            tuple(
+                (s, bid)
+                for s, sh in enumerate(self.rt.shards[: self.n_shards])
+                for bid in list(sh.applied_ids)[-id_cap:]
+            )
+            if id_cap > 0  # [-0:] would ship the ENTIRE horizon
+            else ()
         )
         self._send(
             SyncResponse(
                 responder_phase=total_applied,
                 state_version=self.rt.state_version,
-                snapshot=snap.to_bytes(),
+                snapshot=snap_bytes,
                 per_shard_phase=tuple(self.rt.applied_upto.tolist()),
                 applied_ids=applied_ids,
+                per_shard_version=tuple(self.rt.v1_applied.tolist()),
             ),
             recipient=sender,
         )
@@ -1861,6 +1904,7 @@ class RabiaEngine:
             p.snapshot,
             p.per_shard_phase,
             p.applied_ids,
+            p.per_shard_version,
         )
         # only strictly-ahead peers respond at all, so any usable response
         # resolves immediately — waiting for a quorum of responders can
@@ -1912,7 +1956,20 @@ class RabiaEngine:
                 )
                 return
             self.sm.restore_snapshot(snap)
-        self.rt.state_version = best[1]
+        # advance the version by the responder's V1-APPLY surplus on the
+        # adopted shards only — adopting the responder's GLOBAL version
+        # under mixed per-shard progress would over-advertise local state,
+        # and counting adopted SLOTS would count null (V0) slots that no
+        # other increment site counts, drifting versions apart
+        resp_v1 = np.asarray(best[5][: self.S], np.int64)
+        if len(resp_v1) == len(self.rt.v1_applied):
+            surplus = resp_v1[ahead] - self.rt.v1_applied[ahead]
+            self.rt.state_version += int(np.maximum(surplus, 0).sum())
+            self.rt.v1_applied[ahead] = np.maximum(
+                resp_v1[ahead], self.rt.v1_applied[ahead]
+            )
+        else:  # responder on an incompatible shard layout: slot-count bound
+            self.rt.state_version += int((resp_applied[ahead] - ours[ahead]).sum())
         for s in ahead.tolist():
             s = int(s)
             applied = int(resp_applied[s])
@@ -2072,6 +2129,7 @@ class RabiaEngine:
             snapshot=snap,
             per_shard_phase=self.rt.next_slot.tolist(),
             per_shard_committed=self.rt.applied_upto.tolist(),
+            per_shard_version=self.rt.v1_applied.tolist(),
         )
         await self.persistence.save_engine_state(state)
 
@@ -2086,7 +2144,18 @@ class RabiaEngine:
 
     def _send(self, payload, recipient: Optional[NodeId] = None) -> None:
         msg = ProtocolMessage.new(self.node_id, payload, recipient)
-        data = self.serializer.serialize(msg)
+        try:
+            data = self.serializer.serialize(msg)
+        except Exception:
+            # a codec failure on one outbound message must never kill the
+            # run loop — peers recover the dropped message via the normal
+            # retransmit/repair/sync paths
+            logger.exception(
+                "dropping unserializable %s to %s",
+                type(payload).__name__,
+                recipient or "broadcast",
+            )
+            return
         if recipient is None:
             self._spawn(self.transport.broadcast(data))
         else:

@@ -279,6 +279,10 @@ class EngineRuntime:
         # pre-crash votes — a sticky flag would deadlock a shard whose
         # rotation parks on the restored (taint-blocked) proposer
         self.taint_traffic = np.zeros(S, np.float64)
+        # V1 batches APPLIED per shard (null/V0 slots excluded): the unit
+        # of state_version, kept per shard so partial sync adoption can
+        # advance the version by exactly the responder's surplus
+        self.v1_applied = np.zeros(S, np.int64)
         self.queue_len = np.zeros(S, np.int64)
         # scan caches (not authoritative): highest slot with foreign vote
         # traffic per shard; head-of-queue last-forward clock
@@ -300,6 +304,7 @@ class EngineRuntime:
             "last_progress": self.last_progress,
             "tainted_upto": self.tainted_upto,
             "taint_traffic": self.taint_traffic,
+            "v1_applied": self.v1_applied,
         }
         self.shards = [ShardRuntime(s, self) for s in range(S)]
         self.active_nodes: set[NodeId] = set()

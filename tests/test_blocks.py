@@ -72,6 +72,51 @@ class TestPayloadBlock:
         assert back.payload == ProposeBlock(block=blk)
         assert back.payload.block.commands_for(1) == [b"wo", b"rld"]
 
+    def test_block_batch_ids_are_wire_representable(self):
+        # regression: block-lane ids flow into SyncResponse.applied_ids and
+        # Decision.batch_id; as tuples they crashed the codec (and with it
+        # the engine run loop on the first SyncRequest from a lagging peer)
+        from rabia_tpu.core.messages import Decision, DecisionEntry, SyncResponse
+        from rabia_tpu.core.types import BatchId, StateValue
+
+        blk = build_block([3, 7], [[b"a"], [b"b"]])
+        bid = blk.batch_id_for(1)
+        assert isinstance(bid, BatchId)
+        # deterministic across independent derivations, distinct per shard
+        assert bid == block_batch_id(blk.id, 7)
+        assert bid != block_batch_id(blk.id, 3)
+        assert block_batch_id(blk.id, 3) == blk.batch_id_for(0)
+
+        ser = Serializer()
+        sync = ProtocolMessage.new(
+            NodeId.from_int(1),
+            SyncResponse(
+                responder_phase=5,
+                state_version=5,
+                snapshot=b"snap",
+                per_shard_phase=(2, 3),
+                applied_ids=((0, bid), (1, BatchId.new())),
+            ),
+        )
+        back = ser.deserialize(ser.serialize(sync))
+        assert back.payload.applied_ids[0] == (0, bid)
+
+        dec = ProtocolMessage.new(
+            NodeId.from_int(1),
+            Decision(
+                decisions=(
+                    DecisionEntry(
+                        shard=7,
+                        phase=4 << 16,
+                        decision=StateValue.V1,
+                        batch_id=bid,
+                    ),
+                )
+            ),
+        )
+        back = ser.deserialize(ser.serialize(dec))
+        assert back.payload.bids[0] == bid
+
     def test_wire_rejects_corrupt_data(self):
         from rabia_tpu.core.errors import SerializationError
 

@@ -112,6 +112,26 @@ class TestThreeNodeInMemory:
             await _teardown(engines, tasks)
 
     @pytest.mark.asyncio
+    async def test_single_replica_cluster_keeps_committing(self):
+        # regression: R==1 gets no peer traffic, so the input-gated kernel
+        # step wedged after the R1 cast — the follow-up step (_restep) must
+        # carry each slot through R2 and decision on its own
+        hub = InMemoryHub()
+        _, engines, sms, tasks = await _spin_cluster(
+            1, _mk_config(), hub.register
+        )
+        try:
+            for i in range(3):
+                fut = await engines[0].submit_batch(
+                    CommandBatch.new([f"SET solo{i} v{i}"]), shard=i % 2
+                )
+                assert await asyncio.wait_for(fut, 10.0) == [b"OK"]
+            for i in range(3):
+                await _converged(sms, f"solo{i}", f"v{i}")
+        finally:
+            await _teardown(engines, tasks)
+
+    @pytest.mark.asyncio
     async def test_no_quorum_rejects_submission(self):
         hub = InMemoryHub()
         nodes = [NodeId.from_int(i + 1) for i in range(3)]

@@ -226,13 +226,48 @@ class TestRestoreTaint:
         assert eng.rt.shards[0].tainted_upto == 1
         eng.rt.has_quorum = True
         await eng.submit_batch(CommandBatch.new(["SET a 1"]), shard=0)
-        # nothing observed for the tainted slot: after the release window
-        # the shard resumes (first call clears the taint, next call opens)
+        # nothing observed for the tainted slot AND the full membership in
+        # view: after one release window the shard resumes (first call
+        # clears the taint, next call opens)
+        eng.rt.active_nodes = set(nodes)
         eng._restored_at = _time.time() - (eng._taint_release + 1.0)
         eng._open_slots()
         assert eng.rt.shards[0].tainted_upto == 0
         opened = eng._open_slots()
         assert [(s, slot) for s, slot, _v in opened] == [(0, 0)]
+
+    @pytest.mark.asyncio
+    async def test_taint_held_longer_with_absent_peers(self):
+        # an absent peer is the one that could still hold pre-crash votes:
+        # with a partial view the release window stretches 4x
+        import time as _time
+
+        import numpy as np
+
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.persistence import PersistedEngineState
+        from rabia_tpu.core.types import CommandBatch, NodeId
+
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        config = RabiaConfig(
+            phase_timeout=0.05, heartbeat_interval=0.05, round_interval=0.002
+        ).with_kernel(num_shards=1, shard_pad_multiple=1)
+        p = InMemoryPersistence()
+        await p.save_engine_state(
+            PersistedEngineState(per_shard_phase=[0], per_shard_committed=[0])
+        )
+        await p.save_aux("vote_barrier", np.asarray([1], np.int64).tobytes())
+        eng = _mk_restart_engine(nodes, p, config)
+        await eng.initialize()
+        eng.rt.has_quorum = True
+        await eng.submit_batch(CommandBatch.new(["SET a 1"]), shard=0)
+        eng.rt.active_nodes = set(nodes[:2])  # one member out of view
+        eng._restored_at = _time.time() - (eng._taint_release + 1.0)
+        eng._open_slots()
+        assert eng.rt.shards[0].tainted_upto == 1  # still held
+        eng._restored_at = _time.time() - (4 * eng._taint_release + 1.0)
+        eng._open_slots()
+        assert eng.rt.shards[0].tainted_upto == 0
 
     @pytest.mark.asyncio
     async def test_taint_held_while_traffic_observed(self):
