@@ -8,10 +8,13 @@ from rabia_tpu.parallel.mesh import (
     ShardedClusterKernel,
     make_mesh,
 )
+from rabia_tpu.parallel.mesh_engine import MeshEngine, MeshFuture
 
 __all__ = [
     "REPLICA_AXIS",
     "SHARD_AXIS",
+    "MeshEngine",
+    "MeshFuture",
     "MeshPhaseKernel",
     "MeshPhaseState",
     "ShardedClusterKernel",

@@ -344,3 +344,54 @@ class MeshPhaseKernel:
             (slots, initial_votes),
         )
         return decided
+
+    @functools.partial(
+        jax.jit,
+        static_argnums=(0,),
+        static_argnames=("n_slots", "max_phases"),
+    )
+    def slot_window(
+        self,
+        initial_votes: jnp.ndarray,  # i8[T, S, R] per-slot initial R1 votes
+        alive: jnp.ndarray,  # bool[S, R]
+        base_slots: jnp.ndarray,  # i32[S] PER-SHARD first slot number
+        *,
+        n_slots: int,
+        max_phases: int = 4,
+    ) -> jnp.ndarray:
+        """:meth:`slot_pipeline` with PER-SHARD slot numbering: window
+        entry ``t`` of shard ``s`` runs as slot ``base_slots[s] + t``.
+
+        The engine plane needs this because shards advance independently —
+        a uniform ``start_slot_index`` would make the common-coin stream of
+        a shard depend on every OTHER shard's progress, breaking replay
+        and conformance with the per-shard transport engine. Returns
+        ``decided i8[T, S]`` like :meth:`slot_pipeline`.
+        """
+        shard_idx = self._shard_index_grid()
+
+        def per_slot(t, slot_votes):
+            slot = jnp.broadcast_to(
+                (base_slots.astype(I32) + t)[:, None], (self.S, self.R)
+            )
+            st = MeshPhaseState(
+                slot=slot,
+                phase=jnp.zeros((self.S, self.R), I32),
+                my_r1=slot_votes.astype(I8),
+                decided=jnp.full((self.S, self.R), ABSENT, I8),
+            )
+
+            def ph(st, _):
+                return self.phase_step(st, alive, shard_idx), ()
+
+            st, _ = lax.scan(ph, st, None, length=max_phases)
+            dec = st.decided
+            concrete = jnp.where(dec == ABSENT, I8(-1), dec)
+            best = jnp.max(concrete, axis=1)
+            return jnp.where(best < 0, I8(ABSENT), best.astype(I8))
+
+        offsets = jnp.arange(n_slots, dtype=I32)
+        return lax.map(
+            lambda args: per_slot(args[0], args[1]),
+            (offsets, initial_votes),
+        )

@@ -1,0 +1,304 @@
+"""MeshEngine: the full SMR stack on the device plane.
+
+The deployment shape for a TPU pod slice (SURVEY.md §5.8 device plane):
+consensus replicas live on a mesh axis and a round's vote exchange is a
+collective, so deciding a window of slots is ONE device dispatch
+(:meth:`MeshPhaseKernel.slot_window`) instead of the transport engine's
+per-round message exchange (contrast the reference's broadcast-as-loop,
+rabia-engine/src/network/tcp.rs:771-789). Around that core this module
+adds everything the transport engine has and the bare kernel lacks:
+payload binding, ordered state-machine apply on every replica, client
+futures, per-shard decision logs, and crash-fault injection.
+
+Colocated lockstep model
+------------------------
+All R replicas of the cluster run in ONE process over one mesh: payload
+"dissemination" is shared host memory (on a real pod slice the block
+payloads ride an all_gather over the same axis the votes use), and every
+live replica votes V1 for a slot whose payload exists — disagreement
+comes only from injected faults (crash masks). Consensus math is
+bit-identical to the transport plane: same ``_coin_bits`` stream keyed by
+(seed, shard, slot, phase), same quorum/f+1 thresholds, which is what the
+engine-level conformance gate in ``tests/test_mesh_engine.py`` checks
+against :class:`~rabia_tpu.engine.RabiaEngine`.
+
+Slot semantics match the transport engine's: a slot decides V1 (batch
+applies, future settles) or V0 (null slot — the batch retries in the next
+window). An undecided slot (quorum of replicas crashed) parks the shard;
+the whole window re-runs deterministically after heal.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence, Union
+
+import numpy as np
+
+from rabia_tpu.core.errors import RabiaError, ValidationError
+from rabia_tpu.core.state_machine import StateMachine
+from rabia_tpu.core.types import ABSENT, V0, V1, CommandBatch, quorum_size
+from rabia_tpu.parallel.mesh import MeshPhaseKernel, make_mesh
+
+__all__ = ["MeshEngine", "MeshFuture"]
+
+logger = logging.getLogger(__name__)
+
+
+class MeshFuture:
+    """Synchronously settled result holder for one submitted batch.
+
+    ``run_cycle`` settles futures inline (no event loop in the device
+    plane's host driver); ``result()`` raises if called before the batch's
+    slot decided.
+    """
+
+    __slots__ = ("_value", "_done")
+
+    def __init__(self) -> None:
+        self._value = None
+        self._done = False
+
+    def _settle(self, value) -> None:
+        self._value = value
+        self._done = True
+
+    def done(self) -> bool:
+        return self._done
+
+    def result(self):
+        if not self._done:
+            raise RabiaError("batch not yet decided (run flush()/run_cycle())")
+        if isinstance(self._value, Exception):
+            raise self._value
+        return self._value
+
+
+class _Pending:
+    __slots__ = ("batch", "future")
+
+    def __init__(self, batch: CommandBatch, future: MeshFuture) -> None:
+        self.batch = batch
+        self.future = future
+
+
+class MeshEngine:
+    """R-replica SMR over a device mesh: consensus by collective.
+
+    Parameters
+    ----------
+    sm_factory:
+        Zero-arg callable producing one replica's state machine; called R
+        times (each replica applies the committed log independently —
+        replica-state equality IS the replication test).
+    n_shards, n_replicas:
+        Consensus geometry. Shards are padded up to the mesh's shard-axis
+        size internally.
+    mesh:
+        A 2D (shard × replica) mesh from :func:`make_mesh`; default puts
+        every local device on the shard axis (replicas vmapped — the
+        single-host simulation mode; pass a replica-axis mesh on a pod).
+    window:
+        Slots decided per shard per device dispatch (the amortization
+        lever — SURVEY.md §7.4.4).
+    """
+
+    def __init__(
+        self,
+        sm_factory: Callable[[], StateMachine],
+        n_shards: int,
+        n_replicas: int,
+        mesh=None,
+        *,
+        window: int = 16,
+        max_phases: int = 4,
+        coin_p1: float = 0.5,
+        seed: int = 0,
+    ) -> None:
+        if n_shards < 1 or n_replicas < 1:
+            raise ValidationError("need at least 1 shard and 1 replica")
+        self.mesh = mesh if mesh is not None else make_mesh()
+        axis = self.mesh.shape["shard"]
+        self.n_shards = int(n_shards)
+        self.S = ((self.n_shards + axis - 1) // axis) * axis  # padded
+        self.R = int(n_replicas)
+        self.window = int(window)
+        self.max_phases = int(max_phases)
+        self.kernel = MeshPhaseKernel(
+            self.S, self.R, self.mesh, coin_p1=coin_p1, seed=seed
+        )
+        self.sms: list[StateMachine] = [sm_factory() for _ in range(self.R)]
+        self.queues: list[deque[_Pending]] = [
+            deque() for _ in range(self.n_shards)
+        ]
+        self.next_slot = np.zeros(self.n_shards, np.int64)
+        self.alive = np.ones((self.S, self.R), bool)
+        # per-shard decision log: slot -> (value, batch or None)
+        self.decisions: list[dict[int, tuple[int, Optional[CommandBatch]]]] = [
+            {} for _ in range(self.n_shards)
+        ]
+        self.decided_v1 = 0
+        self.decided_v0 = 0
+        self.divergences = 0  # replicas disagreeing on an apply outcome
+        self.cycles = 0
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(
+        self,
+        commands: Union[CommandBatch, Sequence[Union[str, bytes]]],
+        shard: int = 0,
+    ) -> MeshFuture:
+        """Queue a batch for consensus on ``shard``; settled by run_cycle."""
+        if not (0 <= shard < self.n_shards):
+            raise ValidationError(f"shard {shard} out of range")
+        batch = (
+            commands
+            if isinstance(commands, CommandBatch)
+            else CommandBatch.new(list(commands))
+        )
+        fut = MeshFuture()
+        self.queues[shard].append(_Pending(batch, fut))
+        return fut
+
+    def submit_many(
+        self, per_shard: dict[int, Sequence[Union[str, bytes]]]
+    ) -> dict[int, MeshFuture]:
+        """Bulk submission: one batch per shard in a single call."""
+        return {s: self.submit(cmds, s) for s, cmds in per_shard.items()}
+
+    # -- fault injection -----------------------------------------------------
+
+    def crash_replica(self, r: int) -> None:
+        """Mask replica ``r`` out of every shard's tally (fail-stop)."""
+        self.alive[:, r] = False
+
+    def heal_replica(self, r: int) -> None:
+        self.alive[:, r] = True
+
+    @property
+    def has_quorum(self) -> bool:
+        return int(self.alive[0].sum()) >= quorum_size(self.R)
+
+    # -- the cycle -----------------------------------------------------------
+
+    def run_cycle(self) -> int:
+        """Decide up to ``window`` queued slots per shard in ONE device
+        dispatch, then apply + settle on the host. Returns batches applied.
+        """
+        import jax.numpy as jnp
+
+        W = self.window
+        depth = np.zeros(self.S, np.int64)
+        for s in range(self.n_shards):
+            depth[s] = min(len(self.queues[s]), W)
+        if not depth.any():
+            return 0
+        # initial votes: every live replica proposes/accepts V1 for a slot
+        # whose payload exists (colocated dissemination); filler entries
+        # beyond a shard's queue depth vote V0 unanimously — they decide V0
+        # in phase 0, are never recorded, and their slot numbers are reused
+        # by the next cycle (deterministic => harmless re-decide)
+        votes = np.zeros((W, self.S, self.R), np.int8)
+        for s in np.nonzero(depth)[0]:
+            votes[: depth[s], s, :] = V1
+        base = np.zeros(self.S, np.int32)
+        base[: self.n_shards] = self.next_slot
+        decided = np.asarray(
+            self.kernel.slot_window(
+                jnp.asarray(votes),
+                self.kernel.place(jnp.asarray(self.alive)),
+                jnp.asarray(base),
+                n_slots=W,
+                max_phases=self.max_phases,
+            )
+        )  # i8[W, S]
+        self.cycles += 1
+        applied = 0
+        for s in np.nonzero(depth)[0]:
+            s = int(s)
+            q = self.queues[s]
+            for t in range(int(depth[s])):
+                v = int(decided[t, s])
+                if v == ABSENT:
+                    # quorum lost mid-window: park the shard; the window
+                    # re-runs (deterministically) after heal
+                    break
+                slot = int(self.next_slot[s])
+                if v == V1:
+                    pend = q.popleft()
+                    responses = None
+                    err: Optional[Exception] = None
+                    for i, sm in enumerate(self.sms):
+                        try:
+                            r = sm.apply_batch(pend.batch)
+                        except Exception as e:  # deterministic app failure
+                            if i == 0:
+                                err = RabiaError(f"apply failed: {e}")
+                            r = None
+                        if i == 0:
+                            responses = r
+                        elif r != responses:
+                            # a committed batch MUST apply identically on
+                            # every replica — a differing outcome means the
+                            # state machines have diverged (non-determinism
+                            # or an earlier partial failure)
+                            self.divergences += 1
+                            logger.error(
+                                "replica %d diverged applying batch %s on "
+                                "shard %d slot %d: %r != %r",
+                                i, pend.batch.id.short(), s, slot, r, responses,
+                            )
+                    self.decisions[s][slot] = (V1, pend.batch)
+                    self.decided_v1 += 1
+                    pend.future._settle(err if err is not None else responses)
+                    applied += 1
+                else:
+                    # null slot: batch not committed here; retries next
+                    # window at a fresh slot number
+                    self.decisions[s][slot] = (V0, None)
+                    self.decided_v0 += 1
+                self.next_slot[s] = slot + 1
+        return applied
+
+    def flush(self, max_cycles: int = 1000) -> int:
+        """Run cycles until every queue drains (or quorum stalls progress).
+
+        Returns total batches applied. Raises if ``max_cycles`` elapse with
+        work still queued (quorum loss — heal a replica and call again).
+        """
+        total = 0
+        for _ in range(max_cycles):
+            if not any(self.queues):
+                return total
+            got = self.run_cycle()
+            total += got
+            if got == 0 and not self.has_quorum:
+                raise RabiaError("quorum lost: flush stalled")
+        if any(self.queues):
+            raise RabiaError(f"flush incomplete after {max_cycles} cycles")
+        return total
+
+    # -- introspection -------------------------------------------------------
+
+    def decisions_for(self, shard: int) -> dict[int, tuple[int, Optional[CommandBatch]]]:
+        return dict(self.decisions[shard])
+
+    def throughput(
+        self, batches_per_shard: int = 4, commands_per_batch: int = 1
+    ) -> dict:
+        """Measure end-to-end decisions/s (consensus + apply + futures)."""
+        payload = [b"x" * 16] * commands_per_batch
+        for _ in range(batches_per_shard):
+            for s in range(self.n_shards):
+                self.submit(payload, s)
+        t0 = time.perf_counter()
+        applied = self.flush()
+        dt = time.perf_counter() - t0
+        return {
+            "applied": applied,
+            "elapsed_s": dt,
+            "decisions_per_sec": applied / dt if dt > 0 else float("inf"),
+        }

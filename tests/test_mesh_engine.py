@@ -1,0 +1,228 @@
+"""MeshEngine: the full SMR stack on the device plane (SURVEY.md §5.8),
+engine-level conformance-gated against the transport engine (§7.4.6).
+
+The gate: the same submission schedule through (a) a 3-replica
+RabiaEngine cluster over in-memory transports and (b) a MeshEngine with
+MeshPhaseKernel as its consensus core must produce bit-identical decided
+values per (shard, slot), the same per-shard applied command sequence,
+and byte-identical replica state snapshots.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from rabia_tpu.core.errors import RabiaError
+from rabia_tpu.core.state_machine import InMemoryStateMachine
+from rabia_tpu.core.types import V1, CommandBatch, NodeId
+from rabia_tpu.parallel import MeshEngine, make_mesh
+
+
+def _mesh():
+    return make_mesh(shard_axis_size=2, replica_axis_size=4)
+
+
+class TestMeshEngineBasics:
+    def test_commit_settle_replicate(self):
+        eng = MeshEngine(
+            InMemoryStateMachine, n_shards=4, n_replicas=4, mesh=_mesh(),
+            window=4,
+        )
+        futs = [
+            eng.submit([f"SET k{i} v{i}"], shard=i % 4) for i in range(10)
+        ]
+        assert eng.flush() == 10
+        assert all(f.result() == [b"OK"] for f in futs)
+        # replica-state equality IS the replication test
+        snaps = [sm.create_snapshot().data for sm in eng.sms]
+        assert all(s == snaps[0] for s in snaps)
+        assert eng.sms[0].get("k7") == "v7"
+        assert eng.decided_v1 == 10
+
+    def test_decision_log_values(self):
+        eng = MeshEngine(
+            InMemoryStateMachine, n_shards=2, n_replicas=4, mesh=_mesh(),
+            window=2,
+        )
+        eng.submit(["SET a 1"], 0)
+        eng.submit(["SET b 2"], 0)
+        eng.submit(["SET c 3"], 1)
+        eng.flush()
+        d0 = eng.decisions_for(0)
+        assert sorted(d0) == [0, 1]
+        assert all(v == V1 for v, _ in d0.values())
+        assert [c.data for c in d0[0][1].commands] == [b"SET a 1"]
+
+    def test_minority_crash_commits_majority_crash_stalls(self):
+        eng = MeshEngine(
+            InMemoryStateMachine, n_shards=2, n_replicas=4, mesh=_mesh(),
+            window=2,
+        )
+        eng.crash_replica(3)
+        f = eng.submit(["SET x 1"], 0)
+        eng.flush()
+        assert f.result() == [b"OK"]
+        # crash a second replica: 2/4 live < quorum(3) -> stall, then heal
+        eng.crash_replica(2)
+        assert not eng.has_quorum
+        g = eng.submit(["SET y 2"], 1)
+        with pytest.raises(RabiaError):
+            eng.flush(max_cycles=3)
+        assert not g.done()
+        eng.heal_replica(2)
+        eng.flush()
+        assert g.result() == [b"OK"]
+        # crashed replica 3's SM missed nothing: colocated apply covers all
+        # replicas (state divergence modeling is the transport plane's job)
+
+    def test_apply_failure_fails_future_not_engine(self):
+        class Exploding(InMemoryStateMachine):
+            def apply_command(self, command):
+                if b"BOOM" in command.data:
+                    raise RuntimeError("boom")
+                return super().apply_command(command)
+
+        eng = MeshEngine(
+            Exploding, n_shards=1, n_replicas=4, mesh=_mesh(), window=2
+        )
+        bad = eng.submit(["BOOM"], 0)
+        good = eng.submit(["SET a 1"], 0)
+        eng.flush()
+        with pytest.raises(RabiaError):
+            bad.result()
+        assert good.result() == [b"OK"]
+
+
+    def test_replica_divergence_detected(self):
+        # a non-deterministic SM (outcome differs per replica) must be
+        # surfaced, not silently absorbed by replica 0's response
+        made = []
+
+        def factory():
+            class Tagged(InMemoryStateMachine):
+                def apply_command(self, command):
+                    if len(made) > 1 and self is made[1]:
+                        return b"DIVERGED"
+                    return super().apply_command(command)
+
+            sm = Tagged()
+            made.append(sm)
+            return sm
+
+        eng = MeshEngine(factory, n_shards=1, n_replicas=4, mesh=_mesh(),
+                         window=2)
+        f = eng.submit(["SET a 1"], 0)
+        eng.flush()
+        assert f.result() == [b"OK"]  # replica 0's outcome
+        assert eng.divergences == 1
+
+
+class TestMeshEngineConformance:
+    @pytest.mark.asyncio
+    async def test_decisions_match_transport_engine(self):
+        """Engine-level §7.4.6 gate: same schedule, same decisions, same
+        applied sequence, byte-identical state — device plane vs transport
+        plane."""
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.net import InMemoryHub
+
+        n_shards, n_replicas, waves = 2, 3, 4
+        schedule = [
+            {s: [f"SET w{w}s{s} val{w}"] for s in range(n_shards)}
+            for w in range(waves)
+        ]
+
+        # -- transport plane ------------------------------------------------
+        config = RabiaConfig(
+            phase_timeout=0.4,
+            heartbeat_interval=0.05,
+            round_interval=0.002,
+        ).with_kernel(num_shards=n_shards, shard_pad_multiple=2)
+        hub = InMemoryHub()
+        nodes = [NodeId.from_int(i + 1) for i in range(n_replicas)]
+        engines, sms, tasks = [], [], []
+        for node in nodes:
+            sm = InMemoryStateMachine()
+            eng = RabiaEngine(
+                ClusterConfig.new(node, nodes),
+                sm,
+                hub.register(node),
+                config=config,
+            )
+            engines.append(eng)
+            sms.append(sm)
+            tasks.append(asyncio.ensure_future(eng.run()))
+        try:
+            for _ in range(200):
+                await asyncio.sleep(0.01)
+                stats = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in stats):
+                    break
+            for wave in schedule:
+                futs = [
+                    await engines[0].submit_batch(
+                        CommandBatch.new(list(cmds)), shard=s
+                    )
+                    for s, cmds in wave.items()
+                ]
+                for f in futs:
+                    await asyncio.wait_for(f, 10.0)
+            transport_decisions = {
+                s: {
+                    slot: int(rec.value)
+                    for slot, rec in engines[0].rt.shards[s].decisions.items()
+                }
+                for s in range(n_shards)
+            }
+            # peers apply asynchronously after the submitter settles —
+            # poll for replica convergence before snapshotting
+            transport_snap = sms[0].create_snapshot().data
+            for _ in range(500):
+                if all(
+                    sm.create_snapshot().data == transport_snap for sm in sms
+                ):
+                    break
+                await asyncio.sleep(0.01)
+            assert all(
+                sm.create_snapshot().data == transport_snap for sm in sms
+            )
+        finally:
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        # -- device plane ---------------------------------------------------
+        # R=3 doesn't divide the 4-wide replica axis: use a shard-axis
+        # mesh (replicas vmapped within each device)
+        mesh_eng = MeshEngine(
+            InMemoryStateMachine,
+            n_shards=n_shards,
+            n_replicas=n_replicas,
+            mesh=make_mesh(),  # 8x1: all devices on the shard axis
+            window=2,
+        )
+        for wave in schedule:
+            futs = {
+                s: mesh_eng.submit(list(cmds), s) for s, cmds in wave.items()
+            }
+            mesh_eng.flush()
+            assert all(f.result() == [b"OK"] for f in futs.values())
+
+        for s in range(n_shards):
+            mesh_d = {
+                slot: v for slot, (v, _b) in mesh_eng.decisions_for(s).items()
+            }
+            assert mesh_d == transport_decisions[s], (
+                f"shard {s}: device-plane decisions diverge from transport"
+            )
+        mesh_snaps = [sm.create_snapshot().data for sm in mesh_eng.sms]
+        assert all(s == transport_snap for s in mesh_snaps), (
+            "replica state diverges across planes"
+        )
