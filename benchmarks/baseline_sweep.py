@@ -82,14 +82,20 @@ def cpu_oracle_baseline(replicas: int = 5, sample: int = 120) -> float:
 # ---------------------------------------------------------------------------
 
 
-def _cfg(S, phase_timeout=2.0, round_interval=0.0002):
+def _cfg(S, phase_timeout=2.0, round_interval=0.0002, backend="host",
+         device_substeps=3):
     from rabia_tpu.core.config import RabiaConfig
 
     return RabiaConfig(
         phase_timeout=phase_timeout,
         heartbeat_interval=0.5,
         round_interval=round_interval,
-    ).with_kernel(num_shards=S, shard_pad_multiple=max(1, S))
+    ).with_kernel(
+        num_shards=S,
+        shard_pad_multiple=max(1, S),
+        backend=backend,
+        device_substeps=device_substeps,
+    )
 
 
 async def _mk_mem_cluster(S, R, sm_factory, **cfg_kw):

@@ -40,7 +40,18 @@ def host_step_cost(S: int, R: int = 5, reps: int = 200) -> float:
     return (time.perf_counter() - t0) / reps
 
 
-def jax_step_cost(S: int, R: int = 5, reps: int = 100) -> float:
+def jax_step_cost(S: int, R: int = 5, reps: int = 50) -> dict:
+    """Three numbers per width (``node_step`` donates its state, so the
+    chain threads the returned state):
+
+    - ``enqueue_us``: back-to-back async dispatch (block once at the end)
+      — the pipelined throughput ceiling;
+    - ``roundtrip_us``: dispatch + device_get per step — what a host loop
+      that needs each step's result before the next pays;
+    - ``lag1_fetch_us``: dispatch step N, fetch step N-1 — whether a
+      one-tick-deep pipeline hides the readback latency (over a tunneled
+      TPU it does NOT: the readback round trip itself is the floor).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -48,17 +59,38 @@ def jax_step_cost(S: int, R: int = 5, reps: int = 100) -> float:
     from rabia_tpu.kernel.phase_driver import NodeKernel
 
     k = NodeKernel(S, R, 0, seed=0)
-    st = k.init_state()
     in1 = jnp.full((S, R), V1, jnp.int8)
     in2 = jnp.full((S, R), ABSENT, jnp.int8)
     dec = jnp.full((S,), ABSENT, jnp.int8)
-    out, _ = k.node_step(st, in1, in2, dec)
-    jax.block_until_ready(out)
+
+    st, ob = k.node_step(k.init_state(), in1, in2, dec)
+    jax.block_until_ready(ob.cast_r2)
     t0 = time.perf_counter()
     for _ in range(reps):
-        out, _ = k.node_step(st, in1, in2, dec)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
+        st, ob = k.node_step(st, in1, in2, dec)
+    jax.block_until_ready(ob.cast_r2)
+    enqueue = (time.perf_counter() - t0) / reps
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        st, ob = k.node_step(st, in1, in2, dec)
+        _ = jax.device_get(ob.cast_r2)
+    roundtrip = (time.perf_counter() - t0) / reps
+
+    prev = None
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        st, ob = k.node_step(st, in1, in2, dec)
+        if prev is not None:
+            _ = jax.device_get(prev)
+        prev = ob.cast_r2
+    lag1 = (time.perf_counter() - t0) / reps
+
+    return {
+        "enqueue_us": round(enqueue * 1e6, 1),
+        "roundtrip_us": round(roundtrip * 1e6, 1),
+        "lag1_fetch_us": round(lag1 * 1e6, 1),
+    }
 
 
 def main() -> int:
@@ -81,10 +113,9 @@ def main() -> int:
                     "metric": "node_step_cost_us",
                     "shards": S,
                     "host_numpy_us": round(host * 1e6, 1),
-                    "jax_us": round(dev * 1e6, 1),
                     "jax_backend": backend,
                     "host_per_shard_ns": round(host / S * 1e9, 1),
-                    "jax_per_shard_ns": round(dev / S * 1e9, 1),
+                    **dev,
                 }
             )
         )

@@ -140,6 +140,12 @@ class KernelConfig:
     # path, where thousands of shards amortize one dispatch). Both are
     # bit-identical (tests/test_host_kernel.py).
     backend: str = "host"
+    # kernel substeps chained inside ONE device dispatch ("jax" backend):
+    # a drain that fills both vote rounds decides in a single dispatch
+    # (merge->cast R2 at substep 0, tally->decide at substep 1) instead of
+    # paying the host->device round trip per stage transition. 3 covers
+    # the open->cast->decide cascade; 1 restores per-round stepping.
+    device_substeps: int = 3
 
     @property
     def padded_shards(self) -> int:

@@ -92,7 +92,13 @@ from rabia_tpu.engine.state import (
     SlotRecord,
 )
 from rabia_tpu.kernel.host_driver import HostNodeKernel
-from rabia_tpu.kernel.phase_driver import NodeKernel, R2_WAIT, pack_phase, unpack_phase
+from rabia_tpu.kernel.phase_driver import (
+    NodeKernel,
+    R1_WAIT,
+    R2_WAIT,
+    pack_phase,
+    unpack_phase,
+)
 
 logger = logging.getLogger("rabia_tpu.engine")
 
@@ -223,6 +229,7 @@ class RabiaEngine:
         # coin); randomization_seed defaults to 0 for all nodes.
         seed = self.config.randomization_seed or 0
         self._host_kernel = kc.backend != "jax"
+        self._substeps = max(1, int(kc.device_substeps))
         kernel_cls = HostNodeKernel if self._host_kernel else NodeKernel
         self.kernel = kernel_cls(
             self.S, self.R, self.me, coin_p1=kc.coin_p1, seed=seed
@@ -1364,7 +1371,10 @@ class RabiaEngine:
             for pb in self._pending_block_announces:
                 self._send(pb)
             self._pending_block_announces.clear()
-        if opened or bulk is not None:
+        have_opens = bool(opened) or bulk is not None
+        idx = slots_arr = init_arr = None
+        mask = slots_full = init_full = None
+        if have_opens:
             if opened:
                 k = len(opened)
                 idx = np.fromiter((o[0] for o in opened), np.int64, k)
@@ -1387,20 +1397,16 @@ class RabiaEngine:
             slots_full[idx] = slots_arr
             init_full = np.full(self.S, V0, np.int8)
             init_full[idx] = init_arr
-            with span("engine.kernel.start"):
-                if self._host_kernel:
-                    self.kstate = self.kernel.start_slots(
-                        self.kstate, mask, slots_full.astype(np.int32), init_full
-                    )
-                else:
-                    import jax.numpy as jnp
 
-                    self.kstate = self.kernel.start_slots(
-                        self.kstate,
-                        jnp.asarray(mask),
-                        jnp.asarray(slots_full.astype(np.int32)),
-                        jnp.asarray(init_full),
-                    )
+        if not self._host_kernel:
+            return self._device_round(idx, slots_arr, init_arr, mask,
+                                      slots_full, init_full)
+
+        if have_opens:
+            with span("engine.kernel.start"):
+                self.kstate = self.kernel.start_slots(
+                    self.kstate, mask, slots_full.astype(np.int32), init_full
+                )
             self._refresh_mirrors()
             self._send(
                 VoteRound1(
@@ -1412,29 +1418,78 @@ class RabiaEngine:
 
         with span("engine.kernel.route"):
             self._route_votes()
-        prev_phase = (
-            self._cur_phase if self._host_kernel else self._cur_phase.copy()
-        )
+        prev_phase = self._cur_phase
         with span("engine.kernel.step"):
-            if self._host_kernel:
-                self.kstate, outbox = self.kernel.node_step(
-                    self.kstate, None, None, self._dec_plane
-                )
-            else:
-                import jax.numpy as jnp
-
-                self.kstate, outbox = self.kernel.node_step(
-                    self.kstate,
-                    jnp.asarray(self._inbox1),
-                    jnp.asarray(self._inbox2),
-                    jnp.asarray(self._dec_plane),
-                )
-                self._inbox1.fill(ABSENT)
-                self._inbox2.fill(ABSENT)
+            self.kstate, outbox = self.kernel.node_step(
+                self.kstate, None, None, self._dec_plane
+            )
         self._dec_plane.fill(ABSENT)
         self._refresh_mirrors()
         with span("engine.kernel.outbox"):
             self._process_outbox(outbox, prev_phase)
+
+    def _device_round(
+        self,
+        idx: Optional[np.ndarray],
+        slots_arr: Optional[np.ndarray],
+        init_arr: Optional[np.ndarray],
+        mask: Optional[np.ndarray],
+        slots_full: Optional[np.ndarray],
+        init_full: Optional[np.ndarray],
+    ) -> None:
+        """One engine tick on the jax backend: ONE fused device dispatch
+        (start + ``device_substeps`` chained node_steps via node_cycle)
+        and ONE batched device→host fetch — instead of per-stage
+        dispatch/refresh pairs, which over a tunneled TPU link cost ~ms
+        each (SURVEY.md §7.4.4 amortization lever)."""
+        import jax
+        import jax.numpy as jnp
+
+        if idx is not None:
+            # host-side mirror update (the device applies the same open
+            # inside node_cycle): routing below must see the new slots
+            self._cur_slot[idx] = slots_arr
+            self._cur_phase[idx] = 0
+            self._stage[idx] = R1_WAIT
+            self._my_r1[idx] = init_arr
+            self._my_r2[idx] = ABSENT
+            self._decided[idx] = ABSENT
+            self._done[idx] = False
+            self._active[idx] = True
+            self._send(
+                VoteRound1(
+                    shards=idx,
+                    phases=(slots_arr << 16),
+                    vals=init_arr,
+                )
+            )
+        with span("engine.kernel.route"):
+            self._route_votes()
+        prev_phase = self._cur_phase.copy()
+        if mask is None:
+            mask = np.zeros(self.S, bool)
+            slots_full = np.zeros(self.S, np.int64)
+            init_full = np.full(self.S, V0, np.int8)
+        with span("engine.kernel.step"):
+            self.kstate, outboxes = self.kernel.node_cycle(
+                self.kstate,
+                jnp.asarray(mask),
+                jnp.asarray(slots_full.astype(np.int32)),
+                jnp.asarray(init_full),
+                jnp.asarray(self._inbox1),
+                jnp.asarray(self._inbox2),
+                jnp.asarray(self._dec_plane),
+                self._substeps,
+            )
+            self._inbox1.fill(ABSENT)
+            self._inbox2.fill(ABSENT)
+        adopted = self._dec_plane != ABSENT
+        self._dec_plane.fill(ABSENT)
+        with span("engine.kernel.fetch"):
+            st_np, ob_np = jax.device_get((self.kstate, outboxes))
+        self._set_mirrors(st_np)
+        with span("engine.kernel.outbox"):
+            self._process_outbox_window(ob_np, prev_phase, adopted)
 
     async def _advance_vote_barrier(
         self,
@@ -1485,14 +1540,20 @@ class RabiaEngine:
             self._decided = st.decided
             self._active = st.active
         else:
-            self._cur_slot = np.asarray(st.slot, np.int64)
-            self._cur_phase = np.asarray(st.phase, np.int64)
-            self._stage = np.asarray(st.stage, np.int8)
-            self._my_r1 = np.asarray(st.my_r1, np.int8)
-            self._my_r2 = np.asarray(st.my_r2, np.int8)
-            self._done = np.asarray(st.done, bool)
-            self._decided = np.asarray(st.decided, np.int8)
-            self._active = np.asarray(st.active, bool)
+            self._set_mirrors(st)
+
+    def _set_mirrors(self, st) -> None:
+        """Adopt host mirrors from a (fetched) kernel state. Mirrors must
+        be WRITABLE: the device round updates them in place for opened
+        slots before the fused dispatch."""
+        self._cur_slot = np.array(st.slot, np.int64)
+        self._cur_phase = np.array(st.phase, np.int64)
+        self._stage = np.array(st.stage, np.int8)
+        self._my_r1 = np.array(st.my_r1, np.int8)
+        self._my_r2 = np.array(st.my_r2, np.int8)
+        self._done = np.array(st.done, bool)
+        self._decided = np.array(st.decided, np.int8)
+        self._active = np.array(st.active, bool)
 
     def _process_outbox(self, outbox, prev_phase: np.ndarray) -> None:
         """Turn kernel outbox flags into broadcast messages + decisions —
@@ -1542,50 +1603,134 @@ class RabiaEngine:
 
         if done.any():
             newly = np.asarray(outbox.newly_decided)[:n] & act
-            dec_idx = np.nonzero(done)[0]
-            decided_vals = np.asarray(self._decided)
-            cur_slot = np.asarray(self._cur_slot)
-            blk = self._cur_blk_ref[dec_idx] != -1
-            if blk.any():
-                self._finish_block_slots(dec_idx[blk])
-            for s in dec_idx[~blk]:
-                s = int(s)
-                sh = rt.shards[s]
-                slot = int(cur_slot[s])
-                bid = None
-                bp = sh.buf_propose.get(slot)
-                if bp is not None:
-                    bid = bp[0]
-                elif self._blk_pending_slot[s] == slot:
-                    ref = int(self._blk_pending_ref[s])
-                    rec_blk = self._blk_registry.get(ref)
-                    if rec_blk is not None and rec_blk.out is None:
-                        # a received block binding we never opened (e.g. we
-                        # voted V0 after grace before its ProposeBlock
-                        # arrived): use it as the payload source for the
-                        # decided slot
-                        bi = int(self._blk_pending_idx[s])
-                        bid = rec_blk.block.batch_id_for(bi)
-                        sh.payloads[bid] = rec_blk.block.materialize_batch(bi)
-                        self._unref_block(ref, 1)
-                        self._blk_pending_ref[s] = -1
-                        self._blk_pending_slot[s] = -1
-                    # our own never-announced pending entries stay put:
-                    # _record_decision voids them into the scalar retry lane
-                self._record_decision(s, slot, int(decided_vals[s]), bid)
-            if newly.any() and self.config.decision_broadcast:
-                # steady-state Decisions are bid-free (fully columnar both
-                # ways); a peer that never saw the Propose recovers the
-                # binding from the late/retransmitted Propose or via sync
-                idx = np.nonzero(newly)[0]
-                slots = cur_slot[idx].astype(np.int64)
+            self._process_decided(done, newly)
+
+    def _process_outbox_window(
+        self, ob, prev_phase: np.ndarray, adopted: Optional[np.ndarray] = None
+    ) -> None:
+        """Windowed twin of :meth:`_process_outbox`: one stacked outbox
+        per chained substep (jax backend's node_cycle). Vote transitions
+        are emitted per substep — a shard can legitimately cast R2 in one
+        substep and advance (or decide) in a later one within the same
+        dispatch, each with its own phase tag. ``adopted`` marks shards
+        whose decision_in plane carried a value (they go done at substep
+        0, like the host path's adopt)."""
+        n = self.n_shards
+        rt = self.rt
+        act = rt.in_flight[:n]
+        if not act.any():
+            return
+        now = time.time()
+        K = len(ob.cast_r2)
+        done_final = np.asarray(self._done)[:n] & act
+        cur_slot = np.asarray(self._cur_slot)
+        prev = np.asarray(prev_phase).astype(np.int64)
+        newly_any = np.zeros(n, bool)
+        # running done view, matching the host path's per-step `advanced &
+        # ~done`: a phase-advance R1 is suppressed only if the shard is
+        # done BY THAT SUBSTEP — using the final state would drop votes a
+        # pivotal peer still needs (it decides later in the window)
+        cum_done = (
+            (adopted[:n] & act) if adopted is not None else np.zeros(n, bool)
+        )
+        for k in range(K):
+            cast = ob.cast_r2[k][:n] & act
+            if cast.any():
+                i = np.nonzero(cast)[0]
+                slots = cur_slot[i].astype(np.int64)
                 self._send(
-                    Decision(
-                        shards=idx,
-                        phases=(slots << 16),
-                        vals=decided_vals[idx],
+                    VoteRound2(
+                        shards=i,
+                        phases=(slots << 16) | prev[i],
+                        vals=ob.r2_vals[k][i],
                     )
                 )
+                rt.last_progress[i] = now
+            newly_k = ob.newly_decided[k][:n] & act
+            newly_any |= newly_k
+            cum_done |= newly_k
+            adv = ob.advanced[k][:n] & act & ~cum_done
+            if adv.any():
+                i = np.nonzero(adv)[0]
+                slots = cur_slot[i].astype(np.int64)
+                self._send(
+                    VoteRound1(
+                        shards=i,
+                        phases=(slots << 16)
+                        | ob.new_phase[k][i].astype(np.int64),
+                        vals=ob.new_r1[k][i],
+                    )
+                )
+                rt.last_progress[i] = now
+            prev = np.where(
+                np.asarray(ob.advanced[k], bool),
+                np.asarray(ob.new_phase[k], np.int64),
+                prev,
+            )
+        # ANY substep's transition schedules a follow-up tick: a phase
+        # advance can make host-side CARRIED votes routable, which later
+        # substeps cannot see (they only cascade on the device ledger) —
+        # the next tick's _route_votes must get a chance to offer them
+        any_trans = False
+        for k in range(K):
+            if (ob.cast_r2[k][:n] & act).any() or (
+                ob.advanced[k][:n] & act
+            ).any():
+                any_trans = True
+                break
+        if any_trans:
+            self._restep = True
+        if done_final.any():
+            self._process_decided(done_final, newly_any)
+
+    def _process_decided(self, done: np.ndarray, newly: np.ndarray) -> None:
+        """Record decisions for every done in-flight shard; broadcast the
+        newly decided ones (shared by both outbox processors)."""
+        rt = self.rt
+        dec_idx = np.nonzero(done)[0]
+        decided_vals = np.asarray(self._decided)
+        cur_slot = np.asarray(self._cur_slot)
+        blk = self._cur_blk_ref[dec_idx] != -1
+        if blk.any():
+            self._finish_block_slots(dec_idx[blk])
+        for s in dec_idx[~blk]:
+            s = int(s)
+            sh = rt.shards[s]
+            slot = int(cur_slot[s])
+            bid = None
+            bp = sh.buf_propose.get(slot)
+            if bp is not None:
+                bid = bp[0]
+            elif self._blk_pending_slot[s] == slot:
+                ref = int(self._blk_pending_ref[s])
+                rec_blk = self._blk_registry.get(ref)
+                if rec_blk is not None and rec_blk.out is None:
+                    # a received block binding we never opened (e.g. we
+                    # voted V0 after grace before its ProposeBlock
+                    # arrived): use it as the payload source for the
+                    # decided slot
+                    bi = int(self._blk_pending_idx[s])
+                    bid = rec_blk.block.batch_id_for(bi)
+                    sh.payloads[bid] = rec_blk.block.materialize_batch(bi)
+                    self._unref_block(ref, 1)
+                    self._blk_pending_ref[s] = -1
+                    self._blk_pending_slot[s] = -1
+                # our own never-announced pending entries stay put:
+                # _record_decision voids them into the scalar retry lane
+            self._record_decision(s, slot, int(decided_vals[s]), bid)
+        if newly.any() and self.config.decision_broadcast:
+            # steady-state Decisions are bid-free (fully columnar both
+            # ways); a peer that never saw the Propose recovers the
+            # binding from the late/retransmitted Propose or via sync
+            idx = np.nonzero(newly)[0]
+            slots = cur_slot[idx].astype(np.int64)
+            self._send(
+                Decision(
+                    shards=idx,
+                    phases=(slots << 16),
+                    vals=decided_vals[idx],
+                )
+            )
 
     def _void_pending_block(self, s: int) -> None:
         """A slot a pending block binding targeted resolved without it:

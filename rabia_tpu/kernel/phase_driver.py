@@ -520,7 +520,12 @@ class NodeKernel:
         slot_index: jnp.ndarray,  # i32[S]
         initial_votes: jnp.ndarray,  # i8[S]
     ) -> NodeState:
-        S, R = self.S, self.R
+        return self._start_slots_math(state, shard_mask, slot_index, initial_votes)
+
+    def _start_slots_math(
+        self, state, shard_mask, slot_index, initial_votes
+    ) -> NodeState:
+        R = self.R
         m = shard_mask
         led1 = jnp.where(
             m[:, None],
@@ -556,6 +561,61 @@ class NodeKernel:
 
         ``state`` is DONATED (device buffers reused in place); do not reuse
         the passed-in state afterwards."""
+        return self._node_step_math(state, inbox_r1, inbox_r2, decision_in)
+
+    @functools.partial(
+        jax.jit, static_argnums=(0, 8), donate_argnums=1
+    )
+    def node_cycle(
+        self,
+        state: NodeState,
+        shard_mask: jnp.ndarray,  # bool[S] slots to (re)start this tick
+        slot_index: jnp.ndarray,  # i32[S]
+        initial_votes: jnp.ndarray,  # i8[S]
+        inbox_r1: jnp.ndarray,  # i8[S,R]
+        inbox_r2: jnp.ndarray,  # i8[S,R]
+        decision_in: jnp.ndarray,  # i8[S]
+        n_steps: int,
+    ) -> tuple[NodeState, NodeOutbox]:
+        """One device dispatch for a whole engine tick: start newly opened
+        slots, then chain ``n_steps`` node_steps (inboxes consumed by the
+        first; later substeps cascade stage transitions — cast R2, then
+        decide — on ledger-resident votes). Returns the final state and a
+        NodeOutbox of [n_steps, ...]-stacked transition flags.
+
+        This is the SURVEY.md §7.4.4 dispatch-amortization lever for the
+        transport engine: per-round host<->device stepping pays the
+        dispatch latency once per STAGE; chaining substeps pays it once
+        per tick.
+        """
+        state = self._start_slots_math(
+            state, shard_mask, slot_index, initial_votes
+        )
+        K = int(n_steps)
+        pad1 = jnp.full((K - 1,) + inbox_r1.shape, ABSENT, I8)
+        ib1 = jnp.concatenate([inbox_r1[None].astype(I8), pad1])
+        ib2 = jnp.concatenate([inbox_r2[None].astype(I8), pad1])
+        dec = jnp.concatenate(
+            [
+                decision_in[None].astype(I8),
+                jnp.full((K - 1,) + decision_in.shape, ABSENT, I8),
+            ]
+        )
+
+        def body(st, xs):
+            st, outbox = self._node_step_math(st, xs[0], xs[1], xs[2])
+            return st, outbox
+
+        state, outboxes = lax.scan(body, state, (ib1, ib2, dec))
+        return state, outboxes
+
+    def _node_step_math(
+        self,
+        state: NodeState,
+        inbox_r1: jnp.ndarray,
+        inbox_r2: jnp.ndarray,
+        decision_in: jnp.ndarray,
+    ) -> tuple[NodeState, NodeOutbox]:
         S, R, Q, F1 = self.S, self.R, self.quorum, self.f1
 
         led1 = jnp.where((state.led1 == ABSENT) & (inbox_r1 != ABSENT), inbox_r1, state.led1)
