@@ -37,6 +37,7 @@
 #include <cstdint>
 #include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -77,8 +78,11 @@ struct Conn {
   bool outbound = false;       // we dialed (vs accepted)
   NodeIdBytes dial_target{};   // peer we dialed (valid when outbound)
   std::vector<uint8_t> rbuf;
-  std::deque<std::vector<uint8_t>> wqueue;  // framed bytes pending write
-  size_t woff = 0;  // offset into wqueue.front()
+  // framed bytes pending write. Shared: one broadcast frame is queued on
+  // every peer's connection without copies (recycled to the outbound
+  // arena when the last reference completes).
+  std::deque<std::shared_ptr<std::vector<uint8_t>>> wqueue;
+  size_t woff = 0;  // offset into *wqueue.front()
 };
 
 struct Peer {
@@ -106,6 +110,60 @@ struct Transport {
   std::deque<InboundMsg> inbox;
   std::condition_variable inbox_cv;
   uint64_t dropped_frames = 0;
+
+  // Outbound staging: rt_send/rt_broadcast never touch `mu` (the io loop
+  // holds it across whole epoll batches, syscalls included — a sending
+  // engine thread must not stall behind them). Frames are framed once,
+  // staged here under the cheap `mu_out`, and drained into per-conn
+  // queues by the io thread. Best-effort semantics: a frame staged for a
+  // peer that is gone at drain time is dropped, exactly like the
+  // reference's sends to disconnected peers (tcp.rs:559-643).
+  struct OutMsg {
+    std::shared_ptr<std::vector<uint8_t>> frame;
+    bool broadcast = false;
+    NodeIdBytes target{};
+  };
+  std::mutex mu_out;
+  std::deque<OutMsg> outq;
+  std::vector<std::vector<uint8_t>> out_pool;  // outbound frame arena
+  uint64_t out_hits = 0, out_misses = 0;
+
+  std::shared_ptr<std::vector<uint8_t>> make_frame(const uint8_t* data,
+                                                   uint32_t len) {
+    std::vector<uint8_t> v;
+    {
+      std::lock_guard<std::mutex> lo(mu_out);
+      if (!out_pool.empty()) {
+        v = std::move(out_pool.back());
+        out_pool.pop_back();
+        v.clear();
+        out_hits++;
+      } else {
+        out_misses++;
+      }
+    }
+    v.reserve(4 + len);
+    v.resize(4 + len);
+    v[0] = len & 0xFF;
+    v[1] = (len >> 8) & 0xFF;
+    v[2] = (len >> 16) & 0xFF;
+    v[3] = (len >> 24) & 0xFF;
+    memcpy(v.data() + 4, data, len);
+    return std::make_shared<std::vector<uint8_t>>(std::move(v));
+  }
+
+  void recycle_frame(std::shared_ptr<std::vector<uint8_t>>&& sp) {
+    if (sp.use_count() != 1) return;  // other conns still sending it
+    std::lock_guard<std::mutex> lo(mu_out);
+    if (out_pool.size() < kMaxPooled && sp->capacity() <= kMaxPooledBuf) {
+      out_pool.push_back(std::move(*sp));
+    }
+  }
+
+  void kick() {
+    uint64_t one = 1;
+    (void)!::write(wake_fd, &one, 8);
+  }
 
   // buffer arena (rabia-core/src/memory_pool.rs analog): frame/message
   // byte vectors are recycled instead of allocated per frame. Guarded by
@@ -148,7 +206,9 @@ struct Transport {
   void dial(const NodeIdBytes& id, Peer& p);
   void close_conn(int fd);
   bool establish(int fd, Conn& c);  // false: conn was dropped (dup loser)
-  void enqueue_frame_locked(int fd, const uint8_t* data, uint32_t len);
+  void enqueue_shared_locked(int fd,
+                             const std::shared_ptr<std::vector<uint8_t>>& f);
+  void drain_out_locked();
   void arm_write(int fd, bool on);
 };
 
@@ -276,15 +336,16 @@ void Transport::handle_writable(int fd) {
   if (it == conns.end()) return;
   Conn& c = it->second;
   while (!c.wqueue.empty()) {
-    auto& front = c.wqueue.front();
+    auto& front = *c.wqueue.front();
     ssize_t n = ::send(fd, front.data() + c.woff, front.size() - c.woff,
                        MSG_NOSIGNAL);
     if (n > 0) {
       c.woff += static_cast<size_t>(n);
       if (c.woff == front.size()) {
-        pool_put_locked(std::move(front));
+        auto sp = std::move(c.wqueue.front());
         c.wqueue.pop_front();
         c.woff = 0;
+        recycle_frame(std::move(sp));
       }
     } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
       return;  // stay EPOLLOUT-armed
@@ -296,19 +357,28 @@ void Transport::handle_writable(int fd) {
   arm_write(fd, false);
 }
 
-void Transport::enqueue_frame_locked(int fd, const uint8_t* data,
-                                     uint32_t len) {
+void Transport::enqueue_shared_locked(
+    int fd, const std::shared_ptr<std::vector<uint8_t>>& f) {
   auto it = conns.find(fd);
   if (it == conns.end()) return;
-  std::vector<uint8_t> frame = pool_get_locked(4 + len);
-  frame.resize(4 + len);
-  frame[0] = len & 0xFF;
-  frame[1] = (len >> 8) & 0xFF;
-  frame[2] = (len >> 16) & 0xFF;
-  frame[3] = (len >> 24) & 0xFF;
-  memcpy(frame.data() + 4, data, len);
-  it->second.wqueue.push_back(std::move(frame));
+  it->second.wqueue.push_back(f);
   arm_write(fd, true);
+}
+
+void Transport::drain_out_locked() {
+  std::deque<OutMsg> local;
+  {
+    std::lock_guard<std::mutex> lo(mu_out);
+    local.swap(outq);
+  }
+  for (auto& m : local) {
+    if (m.broadcast) {
+      for (auto& [id, fd] : established) enqueue_shared_locked(fd, m.frame);
+    } else {
+      auto est = established.find(m.target);
+      if (est != established.end()) enqueue_shared_locked(est->second, m.frame);
+    }
+  }
 }
 
 void Transport::dial(const NodeIdBytes& id, Peer& p) {
@@ -336,8 +406,8 @@ void Transport::dial(const NodeIdBytes& id, Peer& p) {
   c.outbound = true;
   c.dial_target = id;
   // send our id immediately (kernel buffers it through connect completion)
-  std::vector<uint8_t> hello(self_id.begin(), self_id.end());
-  c.wqueue.push_back(std::move(hello));
+  c.wqueue.push_back(
+      std::make_shared<std::vector<uint8_t>>(self_id.begin(), self_id.end()));
   c.handshake_sent = true;
   conns[fd] = std::move(c);
   epoll_event ev{};
@@ -383,6 +453,7 @@ void Transport::io_loop() {
   while (!stopping.load()) {
     int n = epoll_wait(epoll_fd, evs, 64, 50);
     std::unique_lock<std::mutex> lk(mu);
+    drain_out_locked();
     for (int i = 0; i < n; i++) {
       int fd = evs[i].data.fd;
       uint32_t e = evs[i].events;
@@ -401,8 +472,8 @@ void Transport::io_loop() {
           setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
           Conn c;
           c.fd = cfd;
-          std::vector<uint8_t> hello(self_id.begin(), self_id.end());
-          c.wqueue.push_back(std::move(hello));
+          c.wqueue.push_back(std::make_shared<std::vector<uint8_t>>(
+              self_id.begin(), self_id.end()));
           c.handshake_sent = true;
           conns[cfd] = std::move(c);
           epoll_event ev{};
@@ -511,10 +582,12 @@ int rt_send(void* h, const uint8_t peer_id[16], const uint8_t* data,
   if (len > kMaxFrame) return -2;
   NodeIdBytes id;
   memcpy(id.data(), peer_id, 16);
-  std::lock_guard<std::mutex> lk(t->mu);
-  auto est = t->established.find(id);
-  if (est == t->established.end()) return -1;
-  t->enqueue_frame_locked(est->second, data, len);
+  auto frame = t->make_frame(data, len);
+  {
+    std::lock_guard<std::mutex> lo(t->mu_out);
+    t->outq.push_back({std::move(frame), false, id});
+  }
+  t->kick();
   return 0;
 }
 
@@ -522,13 +595,13 @@ int rt_send(void* h, const uint8_t peer_id[16], const uint8_t* data,
 int rt_broadcast(void* h, const uint8_t* data, uint32_t len) {
   auto* t = static_cast<Transport*>(h);
   if (len > kMaxFrame) return -2;
-  std::lock_guard<std::mutex> lk(t->mu);
-  int sent = 0;
-  for (auto& [id, fd] : t->established) {
-    t->enqueue_frame_locked(fd, data, len);
-    sent++;
+  auto frame = t->make_frame(data, len);
+  {
+    std::lock_guard<std::mutex> lo(t->mu_out);
+    t->outq.push_back({std::move(frame), true, NodeIdBytes{}});
   }
-  return sent;
+  t->kick();
+  return 0;
 }
 
 // Blocks up to timeout_ms for one inbound frame. Returns the frame length
@@ -557,8 +630,9 @@ int rt_recv(void* h, uint8_t sender_out[16], uint8_t* buf, uint32_t buf_cap,
 void rt_pool_stats(void* h, uint64_t* hits, uint64_t* misses) {
   auto* t = static_cast<Transport*>(h);
   std::lock_guard<std::mutex> lk(t->mu);
-  *hits = t->pool_hits;
-  *misses = t->pool_misses;
+  std::lock_guard<std::mutex> lo(t->mu_out);
+  *hits = t->pool_hits + t->out_hits;
+  *misses = t->pool_misses + t->out_misses;
 }
 
 // Writes up to cap peer ids (16 bytes each) of established peers; returns

@@ -6,17 +6,21 @@ framing/handshake/reconnect machinery lives in native code
 module is the asyncio bridge implementing
 :class:`~rabia_tpu.core.network.NetworkTransport`:
 
-- a reader thread blocks in the native `rt_recv` and pushes frames into an
-  asyncio queue via ``call_soon_threadsafe`` (no busy polling, no GIL
-  contention in the hot loop);
-- sends/broadcasts enqueue into native per-peer buffers — the returned
-  awaitables complete immediately (the reference's unbounded outbound
-  queues, tcp.rs:559-643, behave the same way).
+- a reader thread blocks in the native `rt_recv` and pushes frames into a
+  plain deque (the engine's hot drain is ``receive_nowait``); the asyncio
+  loop is woken via ``call_soon_threadsafe`` at most ONCE per pending
+  batch, not once per frame — per-frame wakeups write the loop's self-pipe
+  and measurably dominate a 16384-shard profile;
+- sends/broadcasts frame once into the native outbound staging queue —
+  the returned awaitables complete immediately (the reference's unbounded
+  outbound queues, tcp.rs:559-643, behave the same way) and never contend
+  with the io thread's syscalls.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import ctypes
 import threading
 from typing import Optional
@@ -58,7 +62,14 @@ class TcpNetwork(NetworkTransport):
                 f"cannot bind {self.config.bind_host}:{self.config.bind_port}"
             )
         self.port: int = actual.value
-        self._queue: asyncio.Queue[tuple[NodeId, bytes]] = asyncio.Queue()
+        # frame handoff: deque appends are GIL-atomic, so the engine's
+        # receive_nowait drain never crosses the asyncio machinery at all;
+        # _data_ready only serves the blocking receive() path
+        self._pending: collections.deque[tuple[NodeId, bytes]] = (
+            collections.deque()
+        )
+        self._data_ready = asyncio.Event()
+        self._wake_scheduled = False
         # must be the RUNNING loop: the reader thread posts into it with
         # call_soon_threadsafe; a get_event_loop()-created orphan loop would
         # swallow frames forever. Constructing outside async context is an
@@ -94,13 +105,20 @@ class TcpNetwork(NetworkTransport):
             if n < 0:
                 return  # transport closing
             sender = NodeId(uuid.UUID(bytes=bytes(self._sender_buf)))
-            data = bytes(self._recv_buf[:n])
-            try:
-                self._loop.call_soon_threadsafe(
-                    self._queue.put_nowait, (sender, data)
-                )
-            except RuntimeError:
-                return  # loop closed
+            # one C-level memcpy; slicing the ctypes array instead would
+            # build n Python ints and burn the GIL the sender needs
+            data = ctypes.string_at(self._recv_buf, n)
+            self._pending.append((sender, data))
+            if not self._wake_scheduled:
+                # one loop wakeup per pending BATCH: further appends ride
+                # the already-scheduled callback. (A spurious extra wake
+                # after a drain is harmless; a missed one is impossible —
+                # the flag only resets inside the loop-thread callback.)
+                self._wake_scheduled = True
+                try:
+                    self._loop.call_soon_threadsafe(self._on_frames)
+                except RuntimeError:
+                    return  # loop closed
 
     # -- NetworkTransport ---------------------------------------------------
 
@@ -117,18 +135,39 @@ class TcpNetwork(NetworkTransport):
         if rc == -2:
             raise NetworkError("frame exceeds 16MiB cap")
 
+    def _on_frames(self) -> None:
+        self._wake_scheduled = False
+        self._data_ready.set()
+
     async def receive(self, timeout: Optional[float] = None) -> tuple[NodeId, bytes]:
-        if timeout is None:
-            return await self._queue.get()
-        try:
-            return await asyncio.wait_for(self._queue.get(), timeout)
-        except asyncio.TimeoutError:
-            raise TimeoutError_("receive", timeout) from None
+        deadline = (
+            None
+            if timeout is None
+            else asyncio.get_running_loop().time() + timeout
+        )
+        while True:
+            try:
+                return self._pending.popleft()
+            except IndexError:
+                pass
+            self._data_ready.clear()
+            if self._pending:  # appended between popleft and clear
+                continue
+            if deadline is None:
+                await self._data_ready.wait()
+                continue
+            left = deadline - asyncio.get_running_loop().time()
+            if left <= 0:
+                raise TimeoutError_("receive", timeout) from None
+            try:
+                await asyncio.wait_for(self._data_ready.wait(), left)
+            except asyncio.TimeoutError:
+                raise TimeoutError_("receive", timeout) from None
 
     def receive_nowait(self) -> Optional[tuple[NodeId, bytes]]:
         try:
-            return self._queue.get_nowait()
-        except asyncio.QueueEmpty:
+            return self._pending.popleft()
+        except IndexError:
             return None
 
     async def get_connected_nodes(self) -> set[NodeId]:
