@@ -22,7 +22,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-async def soak(seconds: float, shards: int, seed: int) -> int:
+async def soak(seconds: float, shards: int, seed: int, backend: str = "host") -> int:
     import numpy as np
 
     from rabia_tpu.apps import make_sharded_kv
@@ -41,7 +41,7 @@ async def soak(seconds: float, shards: int, seed: int) -> int:
     hub = InMemoryHub()
     cfg = RabiaConfig(
         phase_timeout=0.3, heartbeat_interval=0.1, round_interval=0.0005
-    ).with_kernel(num_shards=S, shard_pad_multiple=S)
+    ).with_kernel(num_shards=S, shard_pad_multiple=S, backend=backend)
     engines, stores, tasks = [], [], []
     for n in nodes:
         sm, machines = make_sharded_kv(S)
@@ -191,12 +191,16 @@ def main() -> int:
     ap.add_argument("--seconds", type=float, default=60.0)
     ap.add_argument("--shards", type=int, default=32)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument(
+        "--backend", choices=("host", "jax"), default="host",
+        help="engine kernel implementation under chaos",
+    )
     args = ap.parse_args()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     logging.disable(logging.WARNING)
-    return asyncio.run(soak(args.seconds, args.shards, args.seed))
+    return asyncio.run(soak(args.seconds, args.shards, args.seed, args.backend))
 
 
 if __name__ == "__main__":

@@ -132,6 +132,82 @@ class TestThreeNodeInMemory:
             await _teardown(engines, tasks)
 
     @pytest.mark.asyncio
+    async def test_live_membership_join_and_leave(self):
+        """A configured replica joins MID-RUN (quorum + leader recompute,
+        joiner catches up via sync) and another leaves (leader recomputes
+        again, survivors keep committing). Reference parity:
+        rabia-engine/src/engine.rs:142-153 (update_nodes),
+        leader.rs:61-87 (recompute), and the dynamic-topology arm of
+        examples/tcp_networking.rs:20-43."""
+        hub = InMemoryHub()
+        config = _mk_config()
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        engines, sms, tasks = [], [], []
+
+        def start(node):
+            sm = InMemoryStateMachine()
+            eng = RabiaEngine(
+                ClusterConfig.new(node, nodes), sm, hub.register(node),
+                config=config,
+            )
+            engines.append(eng)
+            sms.append(sm)
+            tasks.append(asyncio.ensure_future(eng.run()))
+            return eng
+
+        # phase 1: only 2 of the 3 configured replicas run (quorum = 2)
+        for node in nodes[:2]:
+            start(node)
+        try:
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                stats = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in stats):
+                    break
+            for i in range(4):
+                fut = await engines[0].submit_batch(
+                    CommandBatch.new([f"SET pre{i} v{i}"]), shard=i % 2
+                )
+                await asyncio.wait_for(fut, 10.0)
+            assert engines[0].leader.current_leader == nodes[0]
+
+            # phase 2: node 3 JOINS mid-run
+            joiner = start(nodes[2])
+            for _ in range(500):
+                await asyncio.sleep(0.01)
+                st = await joiner.get_statistics()
+                if st.has_quorum and st.active_nodes == 3:
+                    break
+            # membership view refreshed on every running engine
+            assert (await engines[0].get_statistics()).active_nodes == 3
+            # commits continue with the larger membership...
+            fut = await engines[1].submit_batch(
+                CommandBatch.new(["SET mid x"]), shard=0
+            )
+            await asyncio.wait_for(fut, 10.0)
+            # ...and the joiner catches up on everything it missed (sync)
+            await _converged(sms, "pre3", "v3", timeout=15.0)
+            await _converged(sms, "mid", "x", timeout=15.0)
+
+            # phase 3: the leader LEAVES mid-run
+            await engines[0].shutdown()
+            hub.set_connected(nodes[0], False)
+            for _ in range(500):
+                await asyncio.sleep(0.01)
+                if engines[1].leader.current_leader == nodes[1]:
+                    break
+            assert engines[1].leader.current_leader == nodes[1]
+            st = await engines[1].get_statistics()
+            assert st.has_quorum  # 2 of 3 configured still up
+            fut = await engines[1].submit_batch(
+                CommandBatch.new(["SET post y"]), shard=1
+            )
+            await asyncio.wait_for(fut, 10.0)
+            await _converged(sms[1:], "post", "y", timeout=15.0)
+        finally:
+            await _teardown(engines, tasks)
+
+    @pytest.mark.asyncio
     async def test_no_quorum_rejects_submission(self):
         hub = InMemoryHub()
         nodes = [NodeId.from_int(i + 1) for i in range(3)]

@@ -189,6 +189,107 @@ class TestMeshSlotPipeline:
         )
         assert (decided == 1).all()
 
+    def test_slot_window_matches_slot_pipeline_uniform_base(self, devices):
+        """slot_window with a uniform base is exactly slot_pipeline."""
+        S, R, T = 8, 4, 5
+        mesh = make_mesh(shard_axis_size=2, replica_axis_size=4)
+        mk = MeshPhaseKernel(S, R, mesh, seed=9)
+        votes = np.random.RandomState(4).choice(
+            [V0, V1], size=(T, S, R)
+        ).astype(np.int8)
+        alive = mk.place(jnp.ones((S, R), bool))
+        d_pipe = np.asarray(
+            mk.slot_pipeline(
+                jnp.asarray(votes), alive, T, max_phases=8, start_slot_index=7
+            )
+        )
+        base = jnp.full((S,), 7, jnp.int32)
+        d_win = np.asarray(
+            mk.slot_window(
+                jnp.asarray(votes), alive, base, n_slots=T, max_phases=8
+            )
+        )
+        np.testing.assert_array_equal(d_pipe, d_win)
+
+    def test_crash_mask_conformance_with_cluster_kernel(self, devices):
+        """§7.4.6 under faults: per-shard crash masks (≤ f crashed) must
+        leave the mesh plane decision-identical to the vmap plane on the
+        same vote trace — crashed replicas' votes vanish from both
+        tallies the same way."""
+        S, R, T = 8, 4, 4
+        seed = 31
+        rng = np.random.RandomState(8)
+        votes = rng.choice([V0, V1], size=(T, S, R)).astype(np.int8)
+        # one crashed replica (f=1 for R=4), varying BY SHARD
+        alive_np = np.ones((S, R), bool)
+        for s in range(S):
+            alive_np[s, rng.randint(R)] = False
+
+        plain = ClusterKernel(S, R, seed=seed)
+        d_plain, _ = plain.slot_pipeline(
+            jnp.asarray(votes), jnp.asarray(alive_np), T, rounds_per_slot=16
+        )
+
+        mesh = make_mesh(shard_axis_size=2, replica_axis_size=4)
+        mk = MeshPhaseKernel(S, R, mesh, seed=seed)
+        d_mesh = np.asarray(
+            mk.slot_pipeline(
+                jnp.asarray(votes),
+                mk.place(jnp.asarray(alive_np)),
+                T,
+                max_phases=16,
+            )
+        )
+        d_plain = np.asarray(d_plain)
+        assert (d_mesh != ABSENT).all()
+        np.testing.assert_array_equal(d_plain, d_mesh)
+
+    def test_lossy_cluster_agrees_with_mesh_on_unanimous_slots(self, devices):
+        """Validity across planes: a slot with unanimous initial votes
+        must decide that value on BOTH the lossy transport plane (30%
+        loss) and the reliable collective plane. (Split-vote slots may
+        legitimately differ between planes — different delivery orders
+        are both valid weak-MVC runs — but must stay concrete and
+        internally agreed.)"""
+        S, R, T = 8, 4, 4
+        seed = 13
+        rng = np.random.RandomState(5)
+        votes = rng.choice([V0, V1], size=(T, S, R)).astype(np.int8)
+        votes[:, ::2, :] = V1  # even shards unanimous
+        unanimous = np.zeros((T, S), bool)
+        unanimous[:, ::2] = True
+
+        mesh = make_mesh(shard_axis_size=2, replica_axis_size=4)
+        mk = MeshPhaseKernel(S, R, mesh, seed=seed)
+        d_mesh = np.asarray(
+            mk.slot_pipeline(
+                jnp.asarray(votes),
+                mk.place(jnp.ones((S, R), bool)),
+                T,
+                max_phases=12,
+            )
+        )
+
+        ck = ClusterKernel(S, R, seed=seed)
+        alive = jnp.ones((S, R), bool)
+        every = jnp.ones((S,), bool)
+        decided = []
+        st = ck.init_state()
+        for t in range(T):
+            st = ck.start_slot(st, every, jnp.asarray(votes[t]))
+            st = st._replace(slot=jnp.full((S,), t, jnp.int32))
+            st = ck.run_rounds(
+                st, alive, 60, jax.random.key(100 + t), p_deliver=0.7
+            )
+            dec = np.asarray(st.decided)
+            assert (dec != ABSENT).all(), "lossy run failed to terminate"
+            decided.append(dec)
+        d_lossy = np.stack(decided)
+        np.testing.assert_array_equal(
+            d_lossy[unanimous], d_mesh[unanimous]
+        )
+        assert (d_lossy[unanimous] == V1).all()
+
     def test_window_offsets_change_coin_stream(self, devices):
         """Successive windows must not reuse coin sequences: split votes
         decided at start_slot_index=0 vs =16 draw different coins (the
