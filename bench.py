@@ -111,20 +111,27 @@ def main() -> int:
 
 
 def _mesh_engine_rate(S: int, replicas: int) -> float:
-    """End-to-end decisions/s of the full device-plane SMR stack."""
-    from rabia_tpu.core.state_machine import InMemoryStateMachine
+    """End-to-end decisions/s of the full device-plane SMR stack (the
+    production columnar store: consensus windows on device, bulk
+    apply_block waves on host, client futures settled)."""
+    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.apps.vector_kv import VectorShardedKV
     from rabia_tpu.parallel import MeshEngine
 
     eng = MeshEngine(
-        InMemoryStateMachine, n_shards=S, n_replicas=replicas, window=16
+        lambda: VectorShardedKV(S, capacity=1 << 18),
+        n_shards=S,
+        n_replicas=replicas,
+        window=16,
     )
+    op = [encode_set_bin("k", "v")]
     for s in range(S):  # warmup wave (compiles slot_window)
-        eng.submit([b"SET w 1"], s)
+        eng.submit(op, s)
     eng.flush()
     waves = 4
     for _ in range(waves * eng.window):
         for s in range(S):
-            eng.submit([b"SET k v"], s)
+            eng.submit(op, s)
     t0 = time.perf_counter()
     applied = eng.flush(max_cycles=waves * 4)
     return applied / (time.perf_counter() - t0)

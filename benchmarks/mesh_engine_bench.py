@@ -24,9 +24,24 @@ from rabia_tpu.core.state_machine import InMemoryStateMachine
 from rabia_tpu.parallel import MeshEngine, make_mesh
 
 
-def bench_config(n_shards: int, n_replicas: int, window: int, waves: int) -> dict:
+def bench_config(
+    n_shards: int,
+    n_replicas: int,
+    window: int,
+    waves: int,
+    store: str = "inmem",
+) -> dict:
+    if store == "vector":
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.apps.vector_kv import VectorShardedKV
+
+        factory = lambda: VectorShardedKV(n_shards, capacity=1 << 18)
+        op = [encode_set_bin("k", "v")]
+    else:
+        factory = InMemoryStateMachine
+        op = ["SET k v"]
     eng = MeshEngine(
-        InMemoryStateMachine,
+        factory,
         n_shards=n_shards,
         n_replicas=n_replicas,
         mesh=make_mesh(),
@@ -34,12 +49,12 @@ def bench_config(n_shards: int, n_replicas: int, window: int, waves: int) -> dic
     )
     # warm the jit cache (first compile is tens of seconds on TPU)
     for s in range(n_shards):
-        eng.submit(["SET warm 1"], s)
+        eng.submit(op, s)
     eng.flush()
     t_compile = time.perf_counter()
     for _ in range(waves * window):
         for s in range(n_shards):
-            eng.submit([f"SET k{s} v"], s)
+            eng.submit(op, s)
     t0 = time.perf_counter()
     applied = eng.flush(max_cycles=waves * 4)
     dt = time.perf_counter() - t0
@@ -47,6 +62,7 @@ def bench_config(n_shards: int, n_replicas: int, window: int, waves: int) -> dic
         "shards": n_shards,
         "replicas": n_replicas,
         "window": window,
+        "store": store,
         "applied": applied,
         "elapsed_s": round(dt, 4),
         "decisions_per_sec": round(applied / dt, 1),
@@ -66,12 +82,13 @@ def main() -> None:
         "backend": backend,
         "devices": len(jax.devices()),
     }
-    for name, (S, R, W, waves) in {
-        "s256_r3_w16": (256, 3, 16, 8),
-        "s1024_r3_w16": (1024, 3, 16, 8),
-        "s4096_r3_w16": (4096, 3, 16, 4),
+    for name, (S, R, W, waves, store) in {
+        "s256_r3_w16": (256, 3, 16, 8, "inmem"),
+        "s1024_r3_w16": (1024, 3, 16, 8, "inmem"),
+        "s4096_r3_w16": (4096, 3, 16, 4, "inmem"),
+        "s4096_r5_w16_vector": (4096, 5, 16, 4, "vector"),
     }.items():
-        out[name] = bench_config(S, R, W, waves)
+        out[name] = bench_config(S, R, W, waves, store)
         print(name, "->", out[name]["decisions_per_sec"], "decisions/s")
 
     if "--record" in sys.argv:

@@ -33,13 +33,21 @@ from __future__ import annotations
 import logging
 import time
 from collections import deque
+from dataclasses import replace
 from typing import Callable, Optional, Sequence, Union
 
 import numpy as np
 
 from rabia_tpu.core.errors import RabiaError, ValidationError
 from rabia_tpu.core.state_machine import StateMachine
-from rabia_tpu.core.types import ABSENT, V0, V1, CommandBatch, quorum_size
+from rabia_tpu.core.types import (
+    ABSENT,
+    V0,
+    V1,
+    CommandBatch,
+    ShardId,
+    quorum_size,
+)
 from rabia_tpu.parallel.mesh import MeshPhaseKernel, make_mesh
 
 __all__ = ["MeshEngine", "MeshFuture"]
@@ -103,6 +111,14 @@ class MeshEngine:
     window:
         Slots decided per shard per device dispatch (the amortization
         lever — SURVEY.md §7.4.4).
+
+    State machines implementing
+    :class:`~rabia_tpu.core.state_machine.VectorStateMachine` get the
+    bulk-apply path: each window position's decided batches are packed
+    into ONE :class:`PayloadBlock` and applied per replica in one
+    `apply_block` call (follower replicas skip response materialization).
+    The per-batch replica-divergence check only runs on the scalar path —
+    bulk followers return no responses to compare.
     """
 
     def __init__(
@@ -116,6 +132,7 @@ class MeshEngine:
         max_phases: int = 4,
         coin_p1: float = 0.5,
         seed: int = 0,
+        max_decision_history: int = 4096,
     ) -> None:
         if n_shards < 1 or n_replicas < 1:
             raise ValidationError("need at least 1 shard and 1 replica")
@@ -130,12 +147,17 @@ class MeshEngine:
             self.S, self.R, self.mesh, coin_p1=coin_p1, seed=seed
         )
         self.sms: list[StateMachine] = [sm_factory() for _ in range(self.R)]
+        self._vector = all(
+            callable(getattr(sm, "apply_block", None)) for sm in self.sms
+        )
         self.queues: list[deque[_Pending]] = [
             deque() for _ in range(self.n_shards)
         ]
         self.next_slot = np.zeros(self.n_shards, np.int64)
         self.alive = np.ones((self.S, self.R), bool)
-        # per-shard decision log: slot -> (value, batch or None)
+        # per-shard decision log: slot -> (value, batch or None); bounded
+        # (insertion order is slot order, so trimming drops the oldest)
+        self.max_decision_history = int(max_decision_history)
         self.decisions: list[dict[int, tuple[int, Optional[CommandBatch]]]] = [
             {} for _ in range(self.n_shards)
         ]
@@ -154,11 +176,14 @@ class MeshEngine:
         """Queue a batch for consensus on ``shard``; settled by run_cycle."""
         if not (0 <= shard < self.n_shards):
             raise ValidationError(f"shard {shard} out of range")
-        batch = (
-            commands
-            if isinstance(commands, CommandBatch)
-            else CommandBatch.new(list(commands))
-        )
+        if isinstance(commands, CommandBatch):
+            batch = commands
+            if int(batch.shard) != shard:
+                # the shard argument wins (transport-engine submit_batch
+                # semantics); rebind WITHOUT changing the batch identity
+                batch = replace(batch, shard=ShardId(shard))
+        else:
+            batch = CommandBatch.new(list(commands), shard=ShardId(shard))
         fut = MeshFuture()
         self.queues[shard].append(_Pending(batch, fut))
         return fut
@@ -217,6 +242,12 @@ class MeshEngine:
         )  # i8[W, S]
         self.cycles += 1
         applied = 0
+        # collect (pop + record) first, apply after in window-position
+        # order. Per-shard apply order is slot order (the SMR guarantee);
+        # ACROSS shards the order is wave-major — deterministic and
+        # replica-consistent, and it lets the vector path pack each window
+        # position's commits into ONE PayloadBlock
+        waves: list[list[tuple[int, int, _Pending]]] = [[] for _ in range(W)]
         for s in np.nonzero(depth)[0]:
             s = int(s)
             q = self.queues[s]
@@ -229,39 +260,119 @@ class MeshEngine:
                 slot = int(self.next_slot[s])
                 if v == V1:
                     pend = q.popleft()
-                    responses = None
-                    err: Optional[Exception] = None
-                    for i, sm in enumerate(self.sms):
-                        try:
-                            r = sm.apply_batch(pend.batch)
-                        except Exception as e:  # deterministic app failure
-                            if i == 0:
-                                err = RabiaError(f"apply failed: {e}")
-                            r = None
-                        if i == 0:
-                            responses = r
-                        elif r != responses:
-                            # a committed batch MUST apply identically on
-                            # every replica — a differing outcome means the
-                            # state machines have diverged (non-determinism
-                            # or an earlier partial failure)
-                            self.divergences += 1
-                            logger.error(
-                                "replica %d diverged applying batch %s on "
-                                "shard %d slot %d: %r != %r",
-                                i, pend.batch.id.short(), s, slot, r, responses,
-                            )
-                    self.decisions[s][slot] = (V1, pend.batch)
-                    self.decided_v1 += 1
-                    pend.future._settle(err if err is not None else responses)
+                    waves[t].append((s, slot, pend))
+                    self._record(s, slot, V1, pend.batch)
                     applied += 1
                 else:
                     # null slot: batch not committed here; retries next
                     # window at a fresh slot number
-                    self.decisions[s][slot] = (V0, None)
-                    self.decided_v0 += 1
+                    self._record(s, slot, V0, None)
                 self.next_slot[s] = slot + 1
+        if self._vector:
+            self._apply_waves_bulk(waves)
+        else:
+            self._apply_waves_scalar(waves)
         return applied
+
+    def _record(
+        self, s: int, slot: int, value: int, batch: Optional[CommandBatch]
+    ) -> None:
+        d = self.decisions[s]
+        d[slot] = (value, batch)
+        if value == V1:
+            self.decided_v1 += 1
+        else:
+            self.decided_v0 += 1
+        while len(d) > self.max_decision_history:
+            del d[next(iter(d))]  # insertion order is slot order: O(1) trim
+
+    def _apply_waves_scalar(
+        self, waves: list[list[tuple[int, int, _Pending]]]
+    ) -> None:
+        for wave in waves:
+            for s, slot, pend in wave:
+                responses = None
+                err: Optional[Exception] = None
+                for i, sm in enumerate(self.sms):
+                    try:
+                        r = sm.apply_batch(pend.batch)
+                    except Exception as e:  # deterministic app failure
+                        if i == 0:
+                            err = RabiaError(f"apply failed: {e}")
+                        r = None
+                    if i == 0:
+                        responses = r
+                    elif r != responses:
+                        # a committed batch MUST apply identically on
+                        # every replica — a differing outcome means the
+                        # state machines have diverged (non-determinism
+                        # or an earlier partial failure)
+                        self.divergences += 1
+                        logger.error(
+                            "replica %d diverged applying batch %s on "
+                            "shard %d slot %d: %r != %r",
+                            i, pend.batch.id.short(), s, slot, r, responses,
+                        )
+                pend.future._settle(err if err is not None else responses)
+
+    def _apply_waves_bulk(
+        self, waves: list[list[tuple[int, int, _Pending]]]
+    ) -> None:
+        """One PayloadBlock per window position, one apply_block call per
+        replica (followers skip response materialization)."""
+        from rabia_tpu.core.blocks import build_block
+
+        for wave in waves:
+            if not wave:
+                continue
+            # blocks carry >=1 command per covered shard; empty batches
+            # (legal no-op commits) go through the scalar path
+            bulk = [e for e in wave if len(e[2].batch.commands)]
+            if len(bulk) != len(wave):
+                self._apply_waves_scalar(
+                    [[e for e in wave if not len(e[2].batch.commands)]]
+                )
+            if not bulk:
+                continue
+            shards = [s for s, _slot, _p in bulk]
+            cmds = [
+                [c.data for c in p.batch.commands] for _s, _slot, p in bulk
+            ]
+            try:
+                block = build_block(shards, cmds)
+            except Exception:
+                # a batch the block codec rejects must not poison the
+                # whole wave: apply it (and the rest) per batch instead
+                logger.exception("bulk wave fell back to scalar apply")
+                self._apply_waves_scalar([bulk])
+                continue
+            idxs = np.arange(len(bulk))
+            responses = None
+            err: Optional[Exception] = None
+            for i, sm in enumerate(self.sms):
+                try:
+                    r = sm.apply_block(block, idxs, want_responses=(i == 0))
+                except Exception as e:  # deterministic app failure
+                    if i == 0:
+                        err = RabiaError(f"apply failed: {e}")
+                    else:
+                        # a committed wave MUST apply on every replica —
+                        # a follower-only failure is a divergence
+                        self.divergences += 1
+                        logger.error(
+                            "replica %d failed bulk apply of block %s: %s",
+                            i, block.id, e,
+                        )
+                    r = None
+                if i == 0:
+                    responses = r
+            for j, (_s, _slot, pend) in enumerate(bulk):
+                if err is not None or responses is None:
+                    pend.future._settle(
+                        err if err is not None else RabiaError("apply failed")
+                    )
+                else:
+                    pend.future._settle(responses[j])
 
     def flush(self, max_cycles: int = 1000) -> int:
         """Run cycles until every queue drains (or quorum stalls progress).
@@ -280,6 +391,48 @@ class MeshEngine:
         if any(self.queues):
             raise RabiaError(f"flush incomplete after {max_cycles} cycles")
         return total
+
+    # -- checkpoint / restore ------------------------------------------------
+
+    def checkpoint(self):
+        """Durable snapshot of the committed log position + state
+        (the transport engine's PersistedEngineState, same shape)."""
+        from rabia_tpu.core.persistence import PersistedEngineState
+
+        return PersistedEngineState(
+            current_phase=int(self.next_slot.max(initial=0)),
+            last_committed_phase=int(self.next_slot.sum()),
+            state_version=self.decided_v1,
+            snapshot=self.sms[0].create_snapshot(),
+            per_shard_phase=self.next_slot.tolist(),
+            per_shard_committed=self.next_slot.tolist(),
+            per_shard_version=[],
+        )
+
+    def restore(self, state) -> None:
+        """Adopt a checkpoint into a FRESH engine (empty queues): every
+        replica state machine restores the snapshot; slot counters resume
+        where the checkpoint left off."""
+        if any(self.queues):
+            raise RabiaError("restore requires an idle engine")
+        committed = np.asarray(
+            state.per_shard_committed[: self.n_shards], np.int64
+        )
+        self.next_slot[: len(committed)] = committed
+        if state.snapshot is not None:
+            for sm in self.sms:
+                sm.restore_snapshot(state.snapshot)
+        self.decided_v1 = int(state.state_version)
+
+    async def save_to(self, persistence) -> None:
+        await persistence.save_engine_state(self.checkpoint())
+
+    async def load_from(self, persistence) -> bool:
+        state = await persistence.load_engine_state()
+        if state is None:
+            return False
+        self.restore(state)
+        return True
 
     # -- introspection -------------------------------------------------------
 

@@ -186,6 +186,70 @@ async def soak(seconds: float, shards: int, seed: int, backend: str = "host") ->
     return rc
 
 
+def soak_mesh(seconds: float, shards: int, seed: int) -> int:
+    """Device-plane chaos: MeshEngine under random crash/heal cycles.
+
+    Crashes up to f replicas between flushes (sometimes past quorum — the
+    engine must park, not corrupt), heals, and requires every submitted
+    batch to commit and all replicas to agree at the end."""
+    import numpy as np
+
+    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.apps.vector_kv import VectorShardedKV
+    from rabia_tpu.core.errors import RabiaError
+    from rabia_tpu.parallel import MeshEngine
+
+    S, R = shards, 5
+    rng = random.Random(seed)
+    eng = MeshEngine(
+        lambda: VectorShardedKV(S, capacity=1 << 14),
+        n_shards=S,
+        n_replicas=R,
+        window=4,
+    )
+    stop_at = time.perf_counter() + seconds
+    futs = []
+    ctr = 0
+    down: set[int] = set()
+    while time.perf_counter() < stop_at:
+        # chaos step: crash/heal with occasional quorum loss
+        roll = rng.random()
+        if down and roll < 0.5:
+            eng.heal_replica(down.pop())
+        elif len(down) < R - 1 and roll > 0.7:
+            cand = rng.choice([i for i in range(R) if i not in down])
+            down.add(cand)
+            eng.crash_replica(cand)
+        for s in range(S):
+            futs.append(
+                eng.submit([encode_set_bin(f"s{s}", f"v{ctr}")], s)
+            )
+        ctr += 1
+        try:
+            eng.flush(max_cycles=8)
+        except RabiaError:
+            pass  # quorum lost or slow convergence: heal next iteration
+    for i in list(down):
+        eng.heal_replica(i)
+    eng.flush()
+    if not all(f.done() for f in futs):
+        print("FAIL: undecided batches after final heal")
+        return 1
+    for s in (0, S // 2, S - 1):
+        vals = {sm.store.get(s, f"s{s}".encode()) for sm in eng.sms}
+        if len(vals) != 1 or None in vals:
+            print(f"FAIL: replicas diverge on shard {s}: {vals}")
+            return 1
+    if eng.divergences:
+        print(f"FAIL: {eng.divergences} apply divergences")
+        return 1
+    print(
+        f"mesh soak OK: {eng.decided_v1} commits over {eng.cycles} "
+        f"dispatches, {ctr} chaos waves, replicas convergent"
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=60.0)
@@ -195,11 +259,17 @@ def main() -> int:
         "--backend", choices=("host", "jax"), default="host",
         help="engine kernel implementation under chaos",
     )
+    ap.add_argument(
+        "--plane", choices=("transport", "mesh"), default="transport",
+        help="transport cluster (RabiaEngine) or device plane (MeshEngine)",
+    )
     args = ap.parse_args()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     logging.disable(logging.WARNING)
+    if args.plane == "mesh":
+        return soak_mesh(args.seconds, args.shards, args.seed)
     return asyncio.run(soak(args.seconds, args.shards, args.seed, args.backend))
 
 
