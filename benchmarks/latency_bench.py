@@ -1,0 +1,151 @@
+"""Decision-latency distributions (the BASELINE metric's second half).
+
+Measures submit→settle latency:
+- transport engine, 3 replicas, in-memory transport, serial closed-loop
+  (the reference deployment shape: one request at a time, p50 ~ 2 RTT);
+- transport engine under open-loop pipelined load (16 in flight);
+- MeshEngine: per-window decision latency (one device dispatch decides a
+  whole window; latency is the dispatch+readback+apply cost, amortized
+  over every slot in the window).
+
+Usage: python benchmarks/latency_bench.py [--record]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def _pct(samples: list[float]) -> dict:
+    a = np.asarray(samples) * 1e3
+    return {
+        "n": len(samples),
+        "p50_ms": round(float(np.percentile(a, 50)), 3),
+        "p95_ms": round(float(np.percentile(a, 95)), 3),
+        "p99_ms": round(float(np.percentile(a, 99)), 3),
+        "mean_ms": round(float(a.mean()), 3),
+    }
+
+
+async def transport_latency(serial: int = 200, pipelined: int = 400) -> dict:
+    from rabia_tpu.core.config import RabiaConfig
+    from rabia_tpu.core.network import ClusterConfig
+    from rabia_tpu.core.state_machine import InMemoryStateMachine
+    from rabia_tpu.core.types import CommandBatch, NodeId
+    from rabia_tpu.engine import RabiaEngine
+    from rabia_tpu.net import InMemoryHub
+
+    config = RabiaConfig(
+        phase_timeout=1.0, heartbeat_interval=0.2, round_interval=0.0005
+    ).with_kernel(num_shards=16, shard_pad_multiple=16)
+    hub = InMemoryHub()
+    nodes = [NodeId.from_int(i + 1) for i in range(3)]
+    engines, tasks = [], []
+    for node in nodes:
+        eng = RabiaEngine(
+            ClusterConfig.new(node, nodes),
+            InMemoryStateMachine(),
+            hub.register(node),
+            config=config,
+        )
+        engines.append(eng)
+        tasks.append(asyncio.ensure_future(eng.run()))
+    for _ in range(500):
+        await asyncio.sleep(0.01)
+        sts = [await e.get_statistics() for e in engines]
+        if all(s.has_quorum for s in sts):
+            break
+
+    serial_samples = []
+    for i in range(serial):
+        t0 = time.perf_counter()
+        fut = await engines[0].submit_batch(
+            CommandBatch.new([f"SET s{i} v"]), shard=i % 16
+        )
+        await asyncio.wait_for(fut, 10.0)
+        serial_samples.append(time.perf_counter() - t0)
+
+    piped_samples = []
+    sem = asyncio.Semaphore(16)
+
+    async def one(i):
+        async with sem:
+            t0 = time.perf_counter()
+            fut = await engines[0].submit_batch(
+                CommandBatch.new([f"SET p{i} v"]), shard=i % 16
+            )
+            await asyncio.wait_for(fut, 20.0)
+            piped_samples.append(time.perf_counter() - t0)
+
+    await asyncio.gather(*[one(i) for i in range(pipelined)])
+
+    for e in engines:
+        await e.shutdown()
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    return {
+        "serial_closed_loop": _pct(serial_samples),
+        "pipelined_16_in_flight": _pct(piped_samples),
+    }
+
+
+def mesh_latency(S: int = 1024, R: int = 3, W: int = 16, rounds: int = 30) -> dict:
+    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.apps.vector_kv import VectorShardedKV
+    from rabia_tpu.parallel import MeshEngine
+
+    eng = MeshEngine(
+        lambda: VectorShardedKV(S, capacity=1 << 16),
+        n_shards=S,
+        n_replicas=R,
+        window=W,
+    )
+    op = [encode_set_bin("k", "v")]
+    for s in range(S):  # compile
+        eng.submit(op, s)
+    eng.flush()
+    window_samples = []
+    for _ in range(rounds):
+        for _ in range(W):
+            for s in range(S):
+                eng.submit(op, s)
+        t0 = time.perf_counter()
+        eng.flush()
+        window_samples.append(time.perf_counter() - t0)
+    out = _pct(window_samples)
+    out["slots_per_window"] = S * W
+    out["note"] = (
+        "latency of ONE device dispatch deciding window*shards slots "
+        "(+ bulk apply); per-slot amortized cost = p50/slots"
+    )
+    return out
+
+
+def main() -> None:
+    import jax
+
+    out = {"platform": jax.devices()[0].platform}
+    out["transport_3rep_inmem"] = asyncio.run(transport_latency())
+    print("transport:", out["transport_3rep_inmem"])
+    out["mesh_1024shards_w16"] = mesh_latency()
+    print("mesh:", out["mesh_1024shards_w16"])
+
+    if "--record" in sys.argv:
+        path = Path(__file__).parent / "results.json"
+        doc = json.loads(path.read_text()) if path.exists() else {}
+        doc["latency_r03"] = out
+        path.write_text(json.dumps(doc, indent=1))
+        print("recorded -> results.json latency_r03")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,11 +8,16 @@ from rabia_tpu.parallel.mesh import (
     ShardedClusterKernel,
     make_mesh,
 )
-from rabia_tpu.parallel.mesh_engine import MeshEngine, MeshFuture
+from rabia_tpu.parallel.mesh_engine import (
+    MeshBlockFuture,
+    MeshEngine,
+    MeshFuture,
+)
 
 __all__ = [
     "REPLICA_AXIS",
     "SHARD_AXIS",
+    "MeshBlockFuture",
     "MeshEngine",
     "MeshFuture",
     "MeshPhaseKernel",

@@ -26,6 +26,19 @@ Slot semantics match the transport engine's: a slot decides V1 (batch
 applies, future settles) or V0 (null slot — the batch retries in the next
 window). An undecided slot (quorum of replicas crashed) parks the shard;
 the whole window re-runs deterministically after heal.
+
+Multi-host (DCN)
+----------------
+Pass a mesh spanning every process's devices (built after
+``jax.distributed.initialize()``) and the SAME engine code runs as a
+multi-controller SPMD program: consensus windows execute across hosts
+(collectives ride ICI within a slice, DCN across), vote/alive inputs are
+assembled per-process (`make_array_from_callback`), and the decided plane
+is re-replicated to every host (`process_allgather`). The host side
+follows the standard JAX multi-controller discipline: every process must
+run the same submissions in the same order (each holds the full replica
+SM set and applies identically). ``scripts/dcn_dryrun.py`` runs this
+end-to-end across two OS processes.
 """
 
 from __future__ import annotations
@@ -50,7 +63,7 @@ from rabia_tpu.core.types import (
 )
 from rabia_tpu.parallel.mesh import MeshPhaseKernel, make_mesh
 
-__all__ = ["MeshEngine", "MeshFuture"]
+__all__ = ["MeshBlockFuture", "MeshEngine", "MeshFuture"]
 
 logger = logging.getLogger(__name__)
 
@@ -84,12 +97,64 @@ class MeshFuture:
         return self._value
 
 
-class _Pending:
-    __slots__ = ("batch", "future")
+class MeshBlockFuture:
+    """Result holder for one submitted :class:`PayloadBlock`: one entry
+    per covered shard (response list, or an Exception), like the
+    transport engine's submit_block future."""
 
-    def __init__(self, batch: CommandBatch, future: MeshFuture) -> None:
+    __slots__ = ("_results", "_pending")
+
+    def __init__(self, k: int) -> None:
+        self._results: list = [None] * k
+        self._pending = k
+
+    def _settle(self, i: int, value) -> None:
+        if self._results[i] is None:
+            self._pending -= 1
+        self._results[i] = value
+
+    def done(self) -> bool:
+        return self._pending == 0
+
+    def result(self) -> list:
+        if self._pending:
+            raise RabiaError(
+                f"{self._pending} block entries not yet decided "
+                "(run flush()/run_cycle())"
+            )
+        return list(self._results)
+
+
+class _Pending:
+    """One queued consensus unit: a scalar batch OR one covered-shard
+    slice of a submitted block (``block``/``bidx``/``bfut`` set)."""
+
+    __slots__ = ("batch", "future", "block", "bidx", "bfut")
+
+    def __init__(
+        self,
+        batch: Optional[CommandBatch],
+        future: Optional[MeshFuture],
+        block=None,
+        bidx: int = -1,
+        bfut: Optional[MeshBlockFuture] = None,
+    ) -> None:
         self.batch = batch
         self.future = future
+        self.block = block
+        self.bidx = bidx
+        self.bfut = bfut
+
+    def materialize(self) -> CommandBatch:
+        if self.batch is None:
+            self.batch = self.block.materialize_batch(self.bidx)
+        return self.batch
+
+    def settle(self, value) -> None:
+        if self.future is not None:
+            self.future._settle(value)
+        else:
+            self.bfut._settle(self.bidx, value)
 
 
 class MeshEngine:
@@ -146,6 +211,9 @@ class MeshEngine:
         self.kernel = MeshPhaseKernel(
             self.S, self.R, self.mesh, coin_p1=coin_p1, seed=seed
         )
+        import jax
+
+        self._multi = jax.process_count() > 1
         self.sms: list[StateMachine] = [sm_factory() for _ in range(self.R)]
         self._vector = all(
             callable(getattr(sm, "apply_block", None)) for sm in self.sms
@@ -194,6 +262,24 @@ class MeshEngine:
         """Bulk submission: one batch per shard in a single call."""
         return {s: self.submit(cmds, s) for s, cmds in per_shard.items()}
 
+    def submit_block(self, block) -> MeshBlockFuture:
+        """Bulk lane: one consensus slot per covered shard of a columnar
+        :class:`~rabia_tpu.core.blocks.PayloadBlock` (the transport
+        engine's submit_block analog). Decided entries apply with ZERO
+        repacking — the submitted block IS the apply input — so per-slot
+        Python overhead drops to a queue pop and a future index."""
+        shards = np.asarray(block.shards, np.int64)
+        if len(shards) == 0:
+            raise ValidationError("empty block")
+        if int(shards.min()) < 0 or int(shards.max()) >= self.n_shards:
+            raise ValidationError("block shard out of range")
+        bfut = MeshBlockFuture(len(shards))
+        for i, s in enumerate(shards.tolist()):
+            self.queues[s].append(
+                _Pending(None, None, block=block, bidx=i, bfut=bfut)
+            )
+        return bfut
+
     # -- fault injection -----------------------------------------------------
 
     def crash_replica(self, r: int) -> None:
@@ -231,15 +317,18 @@ class MeshEngine:
             votes[: depth[s], s, :] = V1
         base = np.zeros(self.S, np.int32)
         base[: self.n_shards] = self.next_slot
-        decided = np.asarray(
-            self.kernel.slot_window(
-                jnp.asarray(votes),
-                self.kernel.place(jnp.asarray(self.alive)),
-                jnp.asarray(base),
-                n_slots=W,
-                max_phases=self.max_phases,
-            )
-        )  # i8[W, S]
+        if self._multi:
+            decided = self._run_window_multihost(votes, base, W)
+        else:
+            decided = np.asarray(
+                self.kernel.slot_window(
+                    jnp.asarray(votes),
+                    self.kernel.place(jnp.asarray(self.alive)),
+                    jnp.asarray(base),
+                    n_slots=W,
+                    max_phases=self.max_phases,
+                )
+            )  # i8[W, S]
         self.cycles += 1
         applied = 0
         # collect (pop + record) first, apply after in window-position
@@ -261,7 +350,17 @@ class MeshEngine:
                 if v == V1:
                     pend = q.popleft()
                     waves[t].append((s, slot, pend))
-                    self._record(s, slot, V1, pend.batch)
+                    # block-lane entries log a lazy (block, bidx) ref —
+                    # decisions_for materializes on access, so the bulk
+                    # hot path never builds per-slot CommandBatch objects
+                    self._record(
+                        s,
+                        slot,
+                        V1,
+                        pend.batch
+                        if pend.batch is not None
+                        else (pend.block, pend.bidx),
+                    )
                     applied += 1
                 else:
                     # null slot: batch not committed here; retries next
@@ -273,6 +372,33 @@ class MeshEngine:
         else:
             self._apply_waves_scalar(waves)
         return applied
+
+    def _run_window_multihost(
+        self, votes: np.ndarray, base: np.ndarray, W: int
+    ) -> np.ndarray:
+        """One consensus window as a multi-controller SPMD step: inputs
+        assembled from each process's addressable shards, the decided
+        plane re-replicated to every host."""
+        import jax
+        from jax.experimental import multihost_utils
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def put(arr, spec):
+            sharding = NamedSharding(self.mesh, spec)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx]
+            )
+
+        decided = self.kernel.slot_window(
+            put(votes.astype(np.int8), P(None, "shard", "replica")),
+            put(self.alive, P("shard", "replica")),
+            put(base.astype(np.int32), P("shard")),
+            n_slots=W,
+            max_phases=self.max_phases,
+        )
+        return np.asarray(
+            multihost_utils.process_allgather(decided, tiled=True)
+        )
 
     def _record(
         self, s: int, slot: int, value: int, batch: Optional[CommandBatch]
@@ -291,11 +417,12 @@ class MeshEngine:
     ) -> None:
         for wave in waves:
             for s, slot, pend in wave:
+                batch = pend.materialize()
                 responses = None
                 err: Optional[Exception] = None
                 for i, sm in enumerate(self.sms):
                     try:
-                        r = sm.apply_batch(pend.batch)
+                        r = sm.apply_batch(batch)
                     except Exception as e:  # deterministic app failure
                         if i == 0:
                             err = RabiaError(f"apply failed: {e}")
@@ -311,26 +438,48 @@ class MeshEngine:
                         logger.error(
                             "replica %d diverged applying batch %s on "
                             "shard %d slot %d: %r != %r",
-                            i, pend.batch.id.short(), s, slot, r, responses,
+                            i, batch.id.short(), s, slot, r, responses,
                         )
-                pend.future._settle(err if err is not None else responses)
+                pend.settle(err if err is not None else responses)
 
     def _apply_waves_bulk(
         self, waves: list[list[tuple[int, int, _Pending]]]
     ) -> None:
-        """One PayloadBlock per window position, one apply_block call per
-        replica (followers skip response materialization)."""
+        """One apply_block call per (source block, window position) per
+        replica — submitted blocks apply with zero repacking; scalar
+        batches are packed into a synthesized block per wave."""
         from rabia_tpu.core.blocks import build_block
 
         for wave in waves:
             if not wave:
                 continue
+            # group block-sourced entries by their source block (the
+            # common case is ONE submitted block covering the whole wave)
+            by_block: dict[int, list[_Pending]] = {}
+            loose: list[tuple[int, int, _Pending]] = []
+            for e in wave:
+                p = e[2]
+                if p.block is not None:
+                    by_block.setdefault(id(p.block), []).append(p)
+                else:
+                    loose.append(e)
+            for group in by_block.values():
+                block = group[0].block
+                idxs = np.fromiter(
+                    (p.bidx for p in group), np.int64, len(group)
+                )
+                self._apply_block_group(
+                    block, idxs, [p.settle for p in group]
+                )
+
+            if not loose:
+                continue
             # blocks carry >=1 command per covered shard; empty batches
             # (legal no-op commits) go through the scalar path
-            bulk = [e for e in wave if len(e[2].batch.commands)]
-            if len(bulk) != len(wave):
+            bulk = [e for e in loose if len(e[2].batch.commands)]
+            if len(bulk) != len(loose):
                 self._apply_waves_scalar(
-                    [[e for e in wave if not len(e[2].batch.commands)]]
+                    [[e for e in loose if not len(e[2].batch.commands)]]
                 )
             if not bulk:
                 continue
@@ -346,33 +495,41 @@ class MeshEngine:
                 logger.exception("bulk wave fell back to scalar apply")
                 self._apply_waves_scalar([bulk])
                 continue
-            idxs = np.arange(len(bulk))
-            responses = None
-            err: Optional[Exception] = None
-            for i, sm in enumerate(self.sms):
-                try:
-                    r = sm.apply_block(block, idxs, want_responses=(i == 0))
-                except Exception as e:  # deterministic app failure
-                    if i == 0:
-                        err = RabiaError(f"apply failed: {e}")
-                    else:
-                        # a committed wave MUST apply on every replica —
-                        # a follower-only failure is a divergence
-                        self.divergences += 1
-                        logger.error(
-                            "replica %d failed bulk apply of block %s: %s",
-                            i, block.id, e,
-                        )
-                    r = None
+            self._apply_block_group(
+                block,
+                np.arange(len(bulk)),
+                [p.settle for _s, _slot, p in bulk],
+            )
+
+    def _apply_block_group(self, block, idxs, settles) -> None:
+        responses = None
+        err: Optional[Exception] = None
+        for i, sm in enumerate(self.sms):
+            try:
+                r = sm.apply_block(block, idxs, want_responses=(i == 0))
+            except Exception as e:  # deterministic app failure
                 if i == 0:
-                    responses = r
-            for j, (_s, _slot, pend) in enumerate(bulk):
-                if err is not None or responses is None:
-                    pend.future._settle(
-                        err if err is not None else RabiaError("apply failed")
+                    err = RabiaError(f"apply failed: {e}")
+                elif err is None:
+                    # replica 0 succeeded but a follower failed: that IS
+                    # divergence. (All replicas failing identically is a
+                    # deterministic app error, not divergence — matching
+                    # the scalar path's accounting.)
+                    self.divergences += 1
+                    logger.error(
+                        "replica %d failed bulk apply of block %s: %s",
+                        i, block.id, e,
                     )
-                else:
-                    pend.future._settle(responses[j])
+                r = None
+            if i == 0:
+                responses = r
+        if err is not None or responses is None:
+            fail = err if err is not None else RabiaError("apply failed")
+            for settle in settles:
+                settle(fail)
+        else:
+            for j, settle in enumerate(settles):
+                settle(responses[j])
 
     def flush(self, max_cycles: int = 1000) -> int:
         """Run cycles until every queue drains (or quorum stalls progress).
@@ -437,7 +594,15 @@ class MeshEngine:
     # -- introspection -------------------------------------------------------
 
     def decisions_for(self, shard: int) -> dict[int, tuple[int, Optional[CommandBatch]]]:
-        return dict(self.decisions[shard])
+        """Committed decision log: slot -> (value, batch). ``batch`` is
+        None only for V0 null slots; block-lane commits materialize their
+        batch from the (log-retained) source block on access."""
+        out: dict[int, tuple[int, Optional[CommandBatch]]] = {}
+        for slot, (v, b) in self.decisions[shard].items():
+            if isinstance(b, tuple):
+                b = b[0].materialize_batch(b[1])
+            out[slot] = (v, b)
+        return out
 
     def throughput(
         self, batches_per_shard: int = 4, commands_per_batch: int = 1

@@ -85,6 +85,44 @@ def worker(process_id: int, coordinator: str) -> None:
         f"through the cross-process collective",
         flush=True,
     )
+
+    # ---- phase 2: the FULL SMR stack across both processes --------------
+    # Multi-controller discipline: every process runs the same submissions
+    # in the same order; consensus windows execute as one SPMD program
+    # over the cross-process mesh; each process applies the full replica
+    # set and must land in identical state.
+    from rabia_tpu.core.state_machine import InMemoryStateMachine
+    from rabia_tpu.parallel import MeshEngine
+
+    eng = MeshEngine(
+        InMemoryStateMachine, n_shards=S, n_replicas=R, mesh=mesh, window=2
+    )
+    assert eng._multi, "engine must detect the multi-process mesh"
+    futs = [
+        eng.submit([f"SET k{i} v{i}"], shard=i % S) for i in range(2 * S)
+    ]
+    applied = eng.flush()
+    assert applied == 2 * S, applied
+    assert all(f.result() == [b"OK"] for f in futs)
+    snap = eng.sms[0].create_snapshot().data
+    assert all(sm.create_snapshot().data == snap for sm in eng.sms)
+    # cross-process agreement: both processes must hold the same state
+    import hashlib
+
+    digest = np.frombuffer(
+        hashlib.sha256(snap).digest()[:8], np.uint8
+    ).astype(np.float32)
+    from jax.experimental import multihost_utils
+
+    all_digests = multihost_utils.process_allgather(digest)
+    assert np.all(all_digests == all_digests[0]), (
+        "replica state diverged across processes"
+    )
+    print(
+        f"proc {process_id}: MeshEngine committed {applied} batches "
+        f"across the 2-process mesh; state digests agree",
+        flush=True,
+    )
     jax.distributed.shutdown()
 
 
@@ -111,7 +149,10 @@ def main() -> int:
     if any(rcs):
         print(f"dcn dryrun FAILED: worker rcs {rcs}", file=sys.stderr)
         return 1
-    print("dcn dryrun ok: 2 processes, one global mesh, one collective phase")
+    print(
+        "dcn dryrun ok: 2 processes, one global mesh — collective phase "
+        "step + full MeshEngine SMR with cross-process state agreement"
+    )
     return 0
 
 

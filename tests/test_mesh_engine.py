@@ -139,6 +139,99 @@ class TestMeshEngineBasics:
             }
             assert len(vals) == 1
 
+    def test_block_lane_commits_with_zero_repacking(self):
+        # submitted PayloadBlocks apply directly (no rebuild); results
+        # match the scalar path on the same columnar store
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.apps.vector_kv import VectorShardedKV
+        from rabia_tpu.core.blocks import build_block
+
+        S = 4
+        eng = MeshEngine(
+            lambda: VectorShardedKV(S, capacity=1 << 10),
+            n_shards=S, n_replicas=4, mesh=_mesh(), window=2,
+        )
+        blk1 = build_block(
+            list(range(S)),
+            [[encode_set_bin(f"a{s}", f"x{s}")] for s in range(S)],
+        )
+        blk2 = build_block(
+            [0, 2],
+            [[encode_set_bin("b0", "y0"), encode_set_bin("b0b", "y0b")],
+             [encode_set_bin("b2", "y2")]],
+        )
+        f1 = eng.submit_block(blk1)
+        f2 = eng.submit_block(blk2)
+        assert eng.flush() == S + 2
+        r1, r2 = f1.result(), f2.result()
+        assert len(r1) == S and all(len(e) == 1 for e in r1)
+        assert len(r2[0]) == 2 and len(r2[1]) == 1
+        for s in range(S):
+            assert eng.sms[0].store.get(s, f"a{s}".encode())[0] == f"x{s}".encode()
+        assert eng.sms[2].store.get(0, b"b0b")[0] == b"y0b"
+        # mixed lanes in one window: scalar + block entries coexist
+        g = eng.submit([encode_set_bin("c", "z")], shard=1)
+        f3 = eng.submit_block(build_block([0], [[encode_set_bin("d", "w")]]))
+        eng.flush()
+        assert len(g.result()) == 1 and len(f3.result()) == 1
+
+    def test_block_lane_decision_log_materializes(self):
+        # block-lane commits must be recoverable from the decision log
+        # (V1 with None is reserved for null slots)
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.apps.vector_kv import VectorShardedKV
+        from rabia_tpu.core.blocks import build_block
+
+        eng = MeshEngine(
+            lambda: VectorShardedKV(2, capacity=1 << 10),
+            n_shards=2, n_replicas=4, mesh=_mesh(), window=2,
+        )
+        op = encode_set_bin("k", "v")
+        eng.submit_block(build_block([0, 1], [[op], [op]]))
+        eng.flush()
+        v, batch = eng.decisions_for(0)[0]
+        assert v == V1 and batch is not None
+        assert [c.data for c in batch.commands] == [op]
+
+    def test_deterministic_apply_failure_is_not_divergence(self):
+        # all replicas rejecting a batch identically is an app error, not
+        # replica divergence — on BOTH apply paths
+        class Rejecting(InMemoryStateMachine):
+            def apply_command(self, command):
+                raise RuntimeError("nope")
+
+            def apply_block(self, block, idxs, want_responses=True):
+                raise RuntimeError("nope")
+
+        from rabia_tpu.core.errors import RabiaError
+
+        for vector in (False, True):
+            eng = MeshEngine(
+                Rejecting, n_shards=1, n_replicas=4, mesh=_mesh(), window=2
+            )
+            eng._vector = vector
+            f = eng.submit(["X"], 0)
+            eng.flush()
+            with pytest.raises(RabiaError):
+                f.result()
+            assert eng.divergences == 0, f"vector={vector}"
+
+    def test_block_lane_scalar_sm_materializes(self):
+        # a non-vector SM still commits block submissions (per-batch
+        # materialization fallback)
+        from rabia_tpu.core.blocks import build_block
+
+        eng = MeshEngine(
+            InMemoryStateMachine, n_shards=2, n_replicas=4, mesh=_mesh(),
+            window=2,
+        )
+        f = eng.submit_block(
+            build_block([0, 1], [[b"SET m 1"], [b"SET n 2"]])
+        )
+        eng.flush()
+        assert f.result() == [[b"OK"], [b"OK"]]
+        assert all(sm.get("m") == "1" and sm.get("n") == "2" for sm in eng.sms)
+
     def test_empty_batch_on_vector_path_does_not_poison_wave(self):
         # regression: an empty batch (legal no-op commit) cannot ride a
         # PayloadBlock; it must fall back to scalar apply without
