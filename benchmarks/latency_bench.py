@@ -36,33 +36,13 @@ def _pct(samples: list[float]) -> dict:
 
 
 async def transport_latency(serial: int = 200, pipelined: int = 400) -> dict:
-    from rabia_tpu.core.config import RabiaConfig
-    from rabia_tpu.core.network import ClusterConfig
+    from benchmarks.baseline_sweep import _mk_mem_cluster, _stop
     from rabia_tpu.core.state_machine import InMemoryStateMachine
-    from rabia_tpu.core.types import CommandBatch, NodeId
-    from rabia_tpu.engine import RabiaEngine
-    from rabia_tpu.net import InMemoryHub
+    from rabia_tpu.core.types import CommandBatch
 
-    config = RabiaConfig(
-        phase_timeout=1.0, heartbeat_interval=0.2, round_interval=0.0005
-    ).with_kernel(num_shards=16, shard_pad_multiple=16)
-    hub = InMemoryHub()
-    nodes = [NodeId.from_int(i + 1) for i in range(3)]
-    engines, tasks = [], []
-    for node in nodes:
-        eng = RabiaEngine(
-            ClusterConfig.new(node, nodes),
-            InMemoryStateMachine(),
-            hub.register(node),
-            config=config,
-        )
-        engines.append(eng)
-        tasks.append(asyncio.ensure_future(eng.run()))
-    for _ in range(500):
-        await asyncio.sleep(0.01)
-        sts = [await e.get_statistics() for e in engines]
-        if all(s.has_quorum for s in sts):
-            break
+    _, hub, engines, _, tasks = await _mk_mem_cluster(
+        16, 3, InMemoryStateMachine, phase_timeout=1.0, round_interval=0.0005
+    )
 
     serial_samples = []
     for i in range(serial):
@@ -87,11 +67,7 @@ async def transport_latency(serial: int = 200, pipelined: int = 400) -> dict:
 
     await asyncio.gather(*[one(i) for i in range(pipelined)])
 
-    for e in engines:
-        await e.shutdown()
-    for t in tasks:
-        t.cancel()
-    await asyncio.gather(*tasks, return_exceptions=True)
+    await _stop(engines, tasks)
     return {
         "serial_closed_loop": _pct(serial_samples),
         "pipelined_16_in_flight": _pct(piped_samples),

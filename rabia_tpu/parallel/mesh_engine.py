@@ -113,6 +113,11 @@ class MeshBlockFuture:
             self._pending -= 1
         self._results[i] = value
 
+    def _settle_bulk(self, results: list) -> None:
+        """Settle every entry at once (full-width fast lane)."""
+        self._results = list(results)
+        self._pending = 0
+
     def done(self) -> bool:
         return self._pending == 0
 
@@ -221,6 +226,13 @@ class MeshEngine:
         self.queues: list[deque[_Pending]] = [
             deque() for _ in range(self.n_shards)
         ]
+        self._queued_entries = 0  # total entries across self.queues
+        # staged full-width blocks (the vectorized fast lane): only used
+        # while NO per-shard entries are pending, else demoted in order
+        self._full_blocks: deque = deque()
+        # range-compressed decision log for full-width waves:
+        # (start_slots i64[n], wave_offset, block, shard->bidx inv)
+        self._bulk_log: deque = deque()
         self.next_slot = np.zeros(self.n_shards, np.int64)
         self.alive = np.ones((self.S, self.R), bool)
         # per-shard decision log: slot -> (value, batch or None); bounded
@@ -252,8 +264,11 @@ class MeshEngine:
                 batch = replace(batch, shard=ShardId(shard))
         else:
             batch = CommandBatch.new(list(commands), shard=ShardId(shard))
+        if self._full_blocks:
+            self._demote_full_blocks()  # preserve submission order
         fut = MeshFuture()
         self.queues[shard].append(_Pending(batch, fut))
+        self._queued_entries += 1
         return fut
 
     def submit_many(
@@ -273,11 +288,25 @@ class MeshEngine:
             raise ValidationError("empty block")
         if int(shards.min()) < 0 or int(shards.max()) >= self.n_shards:
             raise ValidationError("block shard out of range")
+        if len(np.unique(shards)) != len(shards):
+            # build_block enforces this, but a hand-constructed or
+            # codec-decoded PayloadBlock may not have been through it —
+            # a duplicate shard would corrupt slot accounting
+            raise ValidationError("block shards must be unique")
         bfut = MeshBlockFuture(len(shards))
+        if len(shards) == self.n_shards and self._queued_entries == 0:
+            # full-width block with nothing queued: the vectorized lane
+            inv = np.empty(self.n_shards, np.int64)
+            inv[shards] = np.arange(len(shards))
+            self._full_blocks.append((block, bfut, inv))
+            return bfut
+        if self._full_blocks:
+            self._demote_full_blocks()
         for i, s in enumerate(shards.tolist()):
             self.queues[s].append(
                 _Pending(None, None, block=block, bidx=i, bfut=bfut)
             )
+            self._queued_entries += 1
         return bfut
 
     # -- fault injection -----------------------------------------------------
@@ -299,8 +328,10 @@ class MeshEngine:
         """Decide up to ``window`` queued slots per shard in ONE device
         dispatch, then apply + settle on the host. Returns batches applied.
         """
-        import jax.numpy as jnp
-
+        if self._full_blocks:
+            if self._vector and self._queued_entries == 0:
+                return self._run_cycle_fullwidth()
+            self._demote_full_blocks()  # non-vector SMs materialize per batch
         W = self.window
         depth = np.zeros(self.S, np.int64)
         for s in range(self.n_shards):
@@ -315,21 +346,7 @@ class MeshEngine:
         votes = np.zeros((W, self.S, self.R), np.int8)
         for s in np.nonzero(depth)[0]:
             votes[: depth[s], s, :] = V1
-        base = np.zeros(self.S, np.int32)
-        base[: self.n_shards] = self.next_slot
-        if self._multi:
-            decided = self._run_window_multihost(votes, base, W)
-        else:
-            decided = np.asarray(
-                self.kernel.slot_window(
-                    jnp.asarray(votes),
-                    self.kernel.place(jnp.asarray(self.alive)),
-                    jnp.asarray(base),
-                    n_slots=W,
-                    max_phases=self.max_phases,
-                )
-            )  # i8[W, S]
-        self.cycles += 1
+        decided = self._decide_window(votes, W)
         applied = 0
         # collect (pop + record) first, apply after in window-position
         # order. Per-shard apply order is slot order (the SMR guarantee);
@@ -349,6 +366,7 @@ class MeshEngine:
                 slot = int(self.next_slot[s])
                 if v == V1:
                     pend = q.popleft()
+                    self._queued_entries -= 1
                     waves[t].append((s, slot, pend))
                     # block-lane entries log a lazy (block, bidx) ref —
                     # decisions_for materializes on access, so the bulk
@@ -372,6 +390,75 @@ class MeshEngine:
         else:
             self._apply_waves_scalar(waves)
         return applied
+
+    def _run_cycle_fullwidth(self) -> int:
+        """Vectorized happy path: the pending work is a FIFO of
+        full-width blocks (every shard covered once per block) and no
+        per-shard entries. One dispatch decides ``depth`` uniform waves;
+        fault-free (all V1) the bookkeeping is pure numpy — no per-slot
+        Python objects at all: slot counters advance by array add, the
+        decision log records one RANGE entry per wave, and each block's
+        future settles in one call. Any non-V1 outcome demotes the blocks
+        to the per-shard queues and defers to the general path."""
+        W = self.window
+        n = self.n_shards
+        depth = min(len(self._full_blocks), W)
+        votes = np.zeros((W, self.S, self.R), np.int8)
+        votes[:depth, :n, :] = V1
+        decided = self._decide_window(votes, W)
+        if not bool((decided[:depth, :n] == V1).all()):
+            # faults interrupted the uniform wave: re-run through the
+            # general path with the SAME (deterministically re-decided)
+            # votes — demotion preserves per-shard FIFO order
+            self._demote_full_blocks()
+            self.cycles -= 1  # the demoted re-run is the same logical cycle
+            return self.run_cycle()
+        entries = [self._full_blocks.popleft() for _ in range(depth)]
+        start = self.next_slot.copy()
+        self.next_slot[:n] += depth
+        self.decided_v1 += depth * n
+        for t, (block, bfut, inv) in enumerate(entries):
+            self._bulk_log.append((start, t, block, inv))
+        while len(self._bulk_log) > max(
+            1, self.max_decision_history // max(1, self.window)
+        ):
+            self._bulk_log.popleft()
+        for block, bfut, inv in entries:
+            idxs = np.arange(len(block))
+            self._apply_block_group(block, idxs, None, bulk_future=bfut)
+        return depth * n
+
+    def _demote_full_blocks(self) -> None:
+        """Move staged full-width blocks onto the per-shard queues (the
+        general path's representation), preserving submission order."""
+        while self._full_blocks:
+            block, bfut, _inv = self._full_blocks.popleft()
+            for i, s in enumerate(block.shards.tolist()):
+                self.queues[s].append(
+                    _Pending(None, None, block=block, bidx=i, bfut=bfut)
+                )
+                self._queued_entries += 1
+
+    def _decide_window(self, votes: np.ndarray, W: int) -> np.ndarray:
+        """One device dispatch deciding a W-slot window; returns i8[W, S]."""
+        import jax.numpy as jnp
+
+        base = np.zeros(self.S, np.int32)
+        base[: self.n_shards] = self.next_slot
+        if self._multi:
+            decided = self._run_window_multihost(votes, base, W)
+        else:
+            decided = np.asarray(
+                self.kernel.slot_window(
+                    jnp.asarray(votes),
+                    self.kernel.place(jnp.asarray(self.alive)),
+                    jnp.asarray(base),
+                    n_slots=W,
+                    max_phases=self.max_phases,
+                )
+            )
+        self.cycles += 1
+        return decided
 
     def _run_window_multihost(
         self, votes: np.ndarray, base: np.ndarray, W: int
@@ -501,13 +588,17 @@ class MeshEngine:
                 [p.settle for _s, _slot, p in bulk],
             )
 
-    def _apply_block_group(self, block, idxs, settles) -> None:
+    def _apply_block_group(
+        self, block, idxs, settles, bulk_future: Optional[MeshBlockFuture] = None
+    ) -> None:
         responses = None
         err: Optional[Exception] = None
         for i, sm in enumerate(self.sms):
+            failed = False
             try:
                 r = sm.apply_block(block, idxs, want_responses=(i == 0))
             except Exception as e:  # deterministic app failure
+                failed = True
                 if i == 0:
                     err = RabiaError(f"apply failed: {e}")
                 elif err is None:
@@ -523,10 +614,23 @@ class MeshEngine:
                 r = None
             if i == 0:
                 responses = r
+            elif not failed and err is not None:
+                # the mirror-image divergence: a follower applied a wave
+                # replica 0 rejected — its state mutated alone
+                self.divergences += 1
+                logger.error(
+                    "replica %d applied block %s that replica 0 rejected",
+                    i, block.id,
+                )
         if err is not None or responses is None:
             fail = err if err is not None else RabiaError("apply failed")
-            for settle in settles:
-                settle(fail)
+            if bulk_future is not None:
+                bulk_future._settle_bulk([fail] * len(idxs))
+            else:
+                for settle in settles:
+                    settle(fail)
+        elif bulk_future is not None:
+            bulk_future._settle_bulk(responses)
         else:
             for j, settle in enumerate(settles):
                 settle(responses[j])
@@ -539,15 +643,18 @@ class MeshEngine:
         """
         total = 0
         for _ in range(max_cycles):
-            if not any(self.queues):
+            if not self._has_pending():
                 return total
             got = self.run_cycle()
             total += got
             if got == 0 and not self.has_quorum:
                 raise RabiaError("quorum lost: flush stalled")
-        if any(self.queues):
+        if self._has_pending():
             raise RabiaError(f"flush incomplete after {max_cycles} cycles")
         return total
+
+    def _has_pending(self) -> bool:
+        return bool(self._queued_entries or self._full_blocks)
 
     # -- checkpoint / restore ------------------------------------------------
 
@@ -570,7 +677,7 @@ class MeshEngine:
         """Adopt a checkpoint into a FRESH engine (empty queues): every
         replica state machine restores the snapshot; slot counters resume
         where the checkpoint left off."""
-        if any(self.queues):
+        if self._has_pending():
             raise RabiaError("restore requires an idle engine")
         committed = np.asarray(
             state.per_shard_committed[: self.n_shards], np.int64
@@ -580,6 +687,11 @@ class MeshEngine:
             for sm in self.sms:
                 sm.restore_snapshot(state.snapshot)
         self.decided_v1 = int(state.state_version)
+        # drop any pre-restore decision history: rewound slot numbers will
+        # be re-decided, and stale entries would contradict the new log
+        self._bulk_log.clear()
+        for d in self.decisions:
+            d.clear()
 
     async def save_to(self, persistence) -> None:
         await persistence.save_engine_state(self.checkpoint())
@@ -596,8 +708,14 @@ class MeshEngine:
     def decisions_for(self, shard: int) -> dict[int, tuple[int, Optional[CommandBatch]]]:
         """Committed decision log: slot -> (value, batch). ``batch`` is
         None only for V0 null slots; block-lane commits materialize their
-        batch from the (log-retained) source block on access."""
+        batch from the (log-retained) source block on access. Full-width
+        waves live range-compressed in ``_bulk_log`` and expand here."""
         out: dict[int, tuple[int, Optional[CommandBatch]]] = {}
+        for start, t, block, inv in self._bulk_log:
+            out[int(start[shard]) + t] = (
+                V1,
+                block.materialize_batch(int(inv[shard])),
+            )
         for slot, (v, b) in self.decisions[shard].items():
             if isinstance(b, tuple):
                 b = b[0].materialize_batch(b[1])

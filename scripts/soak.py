@@ -196,6 +196,7 @@ def soak_mesh(seconds: float, shards: int, seed: int) -> int:
 
     from rabia_tpu.apps.kvstore import encode_set_bin
     from rabia_tpu.apps.vector_kv import VectorShardedKV
+    from rabia_tpu.core.blocks import build_block
     from rabia_tpu.core.errors import RabiaError
     from rabia_tpu.parallel import MeshEngine
 
@@ -220,10 +221,22 @@ def soak_mesh(seconds: float, shards: int, seed: int) -> int:
             cand = rng.choice([i for i in range(R) if i not in down])
             down.add(cand)
             eng.crash_replica(cand)
-        for s in range(S):
+        if ctr % 2 == 0:
+            # full-width block lane (the vectorized fast path + its
+            # fault-demotion edge under the chaos above)
             futs.append(
-                eng.submit([encode_set_bin(f"s{s}", f"v{ctr}")], s)
+                eng.submit_block(
+                    build_block(
+                        list(range(S)),
+                        [[encode_set_bin(f"s{s}", f"v{ctr}")] for s in range(S)],
+                    )
+                )
             )
+        else:
+            for s in range(S):
+                futs.append(
+                    eng.submit([encode_set_bin(f"s{s}", f"v{ctr}")], s)
+                )
         ctr += 1
         try:
             eng.flush(max_cycles=8)

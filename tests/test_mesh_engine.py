@@ -216,6 +216,92 @@ class TestMeshEngineBasics:
                 f.result()
             assert eng.divergences == 0, f"vector={vector}"
 
+    def test_fullwidth_fast_lane_survives_quorum_loss(self):
+        # the vectorized full-width lane must demote cleanly when a wave
+        # can't decide (quorum lost), park, and commit after heal
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.apps.vector_kv import VectorShardedKV
+        from rabia_tpu.core.blocks import build_block
+
+        S = 2
+        eng = MeshEngine(
+            lambda: VectorShardedKV(S, capacity=1 << 10),
+            n_shards=S, n_replicas=4, mesh=_mesh(), window=4,
+        )
+        mk = lambda i: build_block(
+            [0, 1],
+            [[encode_set_bin(f"a{i}", f"x{i}")],
+             [encode_set_bin(f"b{i}", f"y{i}")]],
+        )
+        # minority crash: fast lane still decides V1 everywhere
+        eng.crash_replica(3)
+        f0 = eng.submit_block(mk(0))
+        assert eng.flush() == S
+        assert f0.done()
+        # majority crash: waves go ABSENT -> demote -> park
+        eng.crash_replica(2)
+        futs = [eng.submit_block(mk(i)) for i in range(1, 4)]
+        with pytest.raises(RabiaError):
+            eng.flush(max_cycles=3)
+        assert not any(f.done() for f in futs)
+        eng.heal_replica(2)
+        eng.flush()
+        assert all(f.done() for f in futs)
+        for i in range(4):
+            got = eng.sms[0].store.get(0, f"a{i}".encode())
+            assert got is not None and got[0] == f"x{i}".encode()
+        # slot ordering preserved across the demotion
+        log = eng.decisions_for(0)
+        assert sorted(log) == [0, 1, 2, 3]
+
+    def test_replica0_only_failure_counts_divergence_on_bulk_path(self):
+        # replica 0 rejects, followers apply: their state mutated alone —
+        # genuine divergence, must be counted on the bulk path too
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.apps.vector_kv import VectorShardedKV
+        from rabia_tpu.core.blocks import build_block
+        from rabia_tpu.core.errors import RabiaError
+
+        made = []
+
+        def factory():
+            class MaybeReject(VectorShardedKV):
+                def apply_block(self, block, idxs, want_responses=True):
+                    if made and self is made[0]:
+                        raise RuntimeError("replica 0 only")
+                    return super().apply_block(block, idxs, want_responses)
+
+            sm = MaybeReject(2, capacity=1 << 10)
+            made.append(sm)
+            return sm
+
+        eng = MeshEngine(factory, n_shards=2, n_replicas=4, mesh=_mesh(),
+                         window=2)
+        op = encode_set_bin("k", "v")
+        f = eng.submit_block(build_block([0, 1], [[op], [op]]))
+        eng.flush()
+        assert eng.divergences == 3  # every follower diverged from replica 0
+        assert all(isinstance(r, RabiaError) for r in f.result())
+
+    def test_duplicate_shard_block_rejected(self):
+        from rabia_tpu.core.blocks import PayloadBlock
+        import uuid
+
+        eng = MeshEngine(
+            InMemoryStateMachine, n_shards=2, n_replicas=4, mesh=_mesh(),
+            window=2,
+        )
+        blk = PayloadBlock(
+            uuid.uuid4(),
+            np.array([0, 0]),
+            np.array([-1, -1]),
+            np.array([1, 1]),
+            np.array([1, 1]),
+            b"XY",
+        )
+        with pytest.raises(Exception):
+            eng.submit_block(blk)
+
     def test_block_lane_scalar_sm_materializes(self):
         # a non-vector SM still commits block submissions (per-batch
         # materialization fallback)
