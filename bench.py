@@ -111,11 +111,13 @@ def main() -> int:
 
 
 def _mesh_engine_rate(S: int, replicas: int) -> float:
-    """End-to-end decisions/s of the full device-plane SMR stack (the
-    production columnar store: consensus windows on device, bulk
-    apply_block waves on host, client futures settled)."""
+    """End-to-end decisions/s of the full device-plane SMR stack in its
+    production bulk shape: full-width PayloadBlocks through the block
+    lane (consensus windows on device, one bulk apply per replica per
+    wave, block futures settled)."""
     from rabia_tpu.apps.kvstore import encode_set_bin
     from rabia_tpu.apps.vector_kv import VectorShardedKV
+    from rabia_tpu.core.blocks import build_block
     from rabia_tpu.parallel import MeshEngine
 
     eng = MeshEngine(
@@ -124,14 +126,14 @@ def _mesh_engine_rate(S: int, replicas: int) -> float:
         n_replicas=replicas,
         window=16,
     )
-    op = [encode_set_bin("k", "v")]
-    for s in range(S):  # warmup wave (compiles slot_window)
-        eng.submit(op, s)
-    eng.flush()
+    shards = list(range(S))
+    cmds = [[encode_set_bin(f"k{s}", "v")] for s in shards]
+    eng.submit_block(build_block(shards, cmds))
+    eng.flush()  # warmup (compiles slot_window)
     waves = 4
-    for _ in range(waves * eng.window):
-        for s in range(S):
-            eng.submit(op, s)
+    blocks = [build_block(shards, cmds) for _ in range(waves * eng.window)]
+    for b in blocks:
+        eng.submit_block(b)
     t0 = time.perf_counter()
     applied = eng.flush(max_cycles=waves * 4)
     return applied / (time.perf_counter() - t0)

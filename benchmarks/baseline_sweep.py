@@ -83,12 +83,12 @@ def cpu_oracle_baseline(replicas: int = 5, sample: int = 120) -> float:
 
 
 def _cfg(S, phase_timeout=2.0, round_interval=0.0002, backend="host",
-         device_substeps=3):
+         device_substeps=3, heartbeat_interval=0.5):
     from rabia_tpu.core.config import RabiaConfig
 
     return RabiaConfig(
         phase_timeout=phase_timeout,
-        heartbeat_interval=0.5,
+        heartbeat_interval=heartbeat_interval,
         round_interval=round_interval,
     ).with_kernel(
         num_shards=S,

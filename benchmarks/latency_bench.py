@@ -41,7 +41,8 @@ async def transport_latency(serial: int = 200, pipelined: int = 400) -> dict:
     from rabia_tpu.core.types import CommandBatch
 
     _, hub, engines, _, tasks = await _mk_mem_cluster(
-        16, 3, InMemoryStateMachine, phase_timeout=1.0, round_interval=0.0005
+        16, 3, InMemoryStateMachine, phase_timeout=1.0,
+        round_interval=0.0005, heartbeat_interval=0.2,
     )
 
     serial_samples = []

@@ -411,8 +411,7 @@ class MeshEngine:
             # general path with the SAME (deterministically re-decided)
             # votes — demotion preserves per-shard FIFO order
             self._demote_full_blocks()
-            self.cycles -= 1  # the demoted re-run is the same logical cycle
-            return self.run_cycle()
+            return self.run_cycle()  # second dispatch; cycles counts both
         entries = [self._full_blocks.popleft() for _ in range(depth)]
         start = self.next_slot.copy()
         self.next_slot[:n] += depth
@@ -720,7 +719,7 @@ class MeshEngine:
             if isinstance(b, tuple):
                 b = b[0].materialize_batch(b[1])
             out[slot] = (v, b)
-        return out
+        return dict(sorted(out.items()))  # iteration order = slot order
 
     def throughput(
         self, batches_per_shard: int = 4, commands_per_batch: int = 1

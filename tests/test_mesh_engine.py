@@ -299,7 +299,9 @@ class TestMeshEngineBasics:
             np.array([1, 1]),
             b"XY",
         )
-        with pytest.raises(Exception):
+        from rabia_tpu.core.errors import ValidationError
+
+        with pytest.raises(ValidationError, match="unique"):
             eng.submit_block(blk)
 
     def test_block_lane_scalar_sm_materializes(self):
