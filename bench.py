@@ -85,8 +85,10 @@ def main() -> int:
     try:
         engine_rate = _mesh_engine_rate(eng_S, eng_R)
         cpu_engine_rate = _cpu_engine_rate_quick(eng_S, eng_R)
-    except Exception:
-        pass  # headline must never fail on the aux measurements
+    except Exception as e:
+        # headline must never fail on the aux measurements — but say why
+        # they are missing (stdout stays the single JSON line)
+        print(f"bench: aux engine measurement failed: {e!r}", file=sys.stderr)
 
     out = {
         "metric": "decisions_per_sec",
@@ -114,29 +116,17 @@ def _mesh_engine_rate(S: int, replicas: int) -> float:
     """End-to-end decisions/s of the full device-plane SMR stack in its
     production bulk shape: full-width PayloadBlocks through the block
     lane (consensus windows on device, one bulk apply per replica per
-    wave, block futures settled)."""
-    from rabia_tpu.apps.kvstore import encode_set_bin
-    from rabia_tpu.apps.vector_kv import VectorShardedKV
-    from rabia_tpu.core.blocks import build_block
-    from rabia_tpu.parallel import MeshEngine
+    wave, block futures settled). Delegates to the canonical measurement
+    in benchmarks/mesh_engine_bench.py so the methodology lives in one
+    place."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.mesh_engine_bench import bench_block_lane
 
-    eng = MeshEngine(
-        lambda: VectorShardedKV(S, capacity=1 << 18),
-        n_shards=S,
-        n_replicas=replicas,
-        window=16,
+    return float(
+        bench_block_lane(S, replicas, window=16, waves=4, strict=False)[
+            "decisions_per_sec"
+        ]
     )
-    shards = list(range(S))
-    cmds = [[encode_set_bin(f"k{s}", "v")] for s in shards]
-    eng.submit_block(build_block(shards, cmds))
-    eng.flush()  # warmup (compiles slot_window)
-    waves = 4
-    blocks = [build_block(shards, cmds) for _ in range(waves * eng.window)]
-    for b in blocks:
-        eng.submit_block(b)
-    t0 = time.perf_counter()
-    applied = eng.flush(max_cycles=waves * 4)
-    return applied / (time.perf_counter() - t0)
 
 
 def _cpu_engine_rate_quick(S: int, R: int) -> float:

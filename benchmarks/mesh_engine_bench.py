@@ -72,7 +72,8 @@ def bench_config(
 
 
 def bench_block_lane(
-    n_shards: int, n_replicas: int, window: int, waves: int
+    n_shards: int, n_replicas: int, window: int, waves: int,
+    strict: bool = True,
 ) -> dict:
     """The bulk lane: full-width PayloadBlocks through submit_block —
     per-slot host overhead is a queue pop and a future index."""
@@ -99,7 +100,11 @@ def bench_block_lane(
     t0 = time.perf_counter()
     applied = eng.flush(max_cycles=waves * 4)
     dt = time.perf_counter() - t0
-    assert all(f.done() for f in futs)
+    # strict: the recorded benchmark requires every block settled;
+    # non-strict callers (bench.py headline aux) accept a partial flush
+    # on an overloaded host and report the measured rate anyway
+    if strict:
+        assert all(f.done() for f in futs)
     return {
         "shards": n_shards,
         "replicas": n_replicas,
