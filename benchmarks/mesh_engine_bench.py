@@ -20,6 +20,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax
 
+from rabia_tpu.core.errors import RabiaError
 from rabia_tpu.core.state_machine import InMemoryStateMachine
 from rabia_tpu.parallel import MeshEngine, make_mesh
 
@@ -98,11 +99,17 @@ def bench_block_lane(
     t_built = time.perf_counter()
     futs = [eng.submit_block(b) for b in blocks]
     t0 = time.perf_counter()
-    applied = eng.flush(max_cycles=waves * 4)
+    before = eng.decided_v1
+    try:
+        applied = eng.flush(max_cycles=waves * 4)
+    except RabiaError:
+        # flush raises on an incomplete drain; strict (the recorded
+        # benchmark) propagates, non-strict (bench.py headline aux)
+        # reports the rate of what DID commit on the overloaded host
+        if strict:
+            raise
+        applied = eng.decided_v1 - before
     dt = time.perf_counter() - t0
-    # strict: the recorded benchmark requires every block settled;
-    # non-strict callers (bench.py headline aux) accept a partial flush
-    # on an overloaded host and report the measured rate anyway
     if strict:
         assert all(f.done() for f in futs)
     return {

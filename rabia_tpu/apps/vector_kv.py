@@ -58,6 +58,75 @@ def _mix64(x: np.ndarray) -> np.ndarray:
     return x
 
 
+class FrameSeq(Sequence):
+    """Flat per-op responses as a lazy view over one fixed-width frame
+    buffer — a decided wave's response bytes materialize only when a
+    client actually reads them (the settle path stores the view)."""
+
+    __slots__ = ("raw", "width", "n")
+
+    def __init__(self, raw: bytes, width: int, n: int) -> None:
+        self.raw = raw
+        self.width = width
+        self.n = n
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self.n))]
+        if i < 0:
+            i += self.n
+        if not (0 <= i < self.n):
+            raise IndexError(i)
+        return self.raw[i * self.width : (i + 1) * self.width]
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, (list, tuple, Sequence)):
+            return NotImplemented
+        return len(self) == len(other) and all(
+            a == b for a, b in zip(self, other)
+        )
+
+    def __repr__(self) -> str:
+        return f"FrameSeq(n={self.n}, width={self.width})"
+
+
+class FrameGroups(Sequence):
+    """Per-shard response lists over a :class:`FrameSeq`, grouped by
+    cumulative op counts — the lazy form of ``_regroup``."""
+
+    __slots__ = ("frames", "bounds")
+
+    def __init__(self, frames: FrameSeq, bounds: np.ndarray) -> None:
+        self.frames = frames
+        self.bounds = bounds  # i64[k+1] cumulative
+
+    def __len__(self) -> int:
+        return len(self.bounds) - 1
+
+    def __getitem__(self, j):
+        if isinstance(j, slice):
+            return [self[i] for i in range(*j.indices(len(self)))]
+        if j < 0:
+            j += len(self)
+        if not (0 <= j < len(self)):
+            raise IndexError(j)
+        a, b = int(self.bounds[j]), int(self.bounds[j + 1])
+        return [self.frames[i] for i in range(a, b)]
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, (list, tuple, Sequence)):
+            return NotImplemented
+        return len(self) == len(other) and all(
+            a == b for a, b in zip(self, other)
+        )
+
+    def __repr__(self) -> str:
+        return f"FrameGroups(k={len(self)})"
+
+
 class VectorKVStore:
     """Partitioned columnar KV store (see module doc).
 
@@ -139,6 +208,7 @@ class VectorKVStore:
         klens: np.ndarray,  # i64[n]
         values,  # list[bytes] in wave order, OR (buffer, voffs, vlens)
         now: Optional[float] = None,
+        ranks: Optional[np.ndarray] = None,
     ) -> np.ndarray:
         """Insert/update n entries in wave order; returns versions i64[n].
 
@@ -146,7 +216,12 @@ class VectorKVStore:
         state and wave content. Duplicate keys within one wave land in wave
         order (the later op updates the earlier one's slot). ``values`` as
         a ``(buffer, voffs, vlens)`` triple stores by reference with no
-        per-value slicing (the block lane's path).
+        per-value slicing (the block lane's path). ``ranks`` overrides the
+        per-op occurrence index used for version assignment (count of
+        PRIOR ops on the same shard within this call) — required when
+        equal shards are NOT contiguous runs, e.g. several concatenated
+        waves (``apply_block_multi``); the default derivation assumes
+        shard-major wave order.
         """
         if now is None:
             now = time.time()
@@ -172,7 +247,7 @@ class VectorKVStore:
         # versions: per-shard counters advance one per op, wave order
         # (shard-major waves make ranks the run offsets)
         base = self.shard_version[shards]
-        rank = self._run_ranks(shards)
+        rank = ranks if ranks is not None else self._run_ranks(shards)
         vers = base + rank + 1
         np.add.at(self.shard_version, shards, 1)
         # scatter payload columns (duplicate slots: numpy fancy assignment
@@ -647,25 +722,34 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
 
     # -- block lane -----------------------------------------------------------
 
-    def apply_block(
-        self, block, idxs, want_responses: bool = True
-    ) -> Optional[list[list[bytes]]]:
-        idxs = np.asarray(idxs, np.int64)
+    def _decode_cols(
+        self, block, idxs: np.ndarray, off_shift: int = 0
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Flat (counts, op_shards, op_off, op_len) for the selected
+        shard entries, wave order; ``off_shift`` relocates offsets into a
+        concatenation of several blocks' data buffers."""
         counts = block.counts[idxs]
-        total = int(counts.sum())
-        starts = block.shard_starts
-        # flat command indices of the selected shards, wave order
         cmd_idx = (
-            np.repeat(starts[idxs], counts)
+            np.repeat(block.shard_starts[idxs], counts)
             + _concat_ranges(counts)
         )
         op_shards = np.repeat(block.shards[idxs], counts)
-        offs = block.cmd_offsets
-        op_off = offs[cmd_idx]
+        op_off = block.cmd_offsets[cmd_idx] + off_shift
         op_len = block.cmd_sizes[cmd_idx]
-        data = np.frombuffer(block.data, np.uint8)
+        return counts, op_shards, op_off, op_len
+
+    def _pad_buf(self, raw: bytes) -> np.ndarray:
+        data = np.frombuffer(raw, np.uint8)
         pad = np.zeros(self.store.K + 3, np.uint8)
-        dbuf = np.concatenate([data, pad])
+        return np.concatenate([data, pad])
+
+    def _set_mask(
+        self,
+        dbuf: np.ndarray,
+        op_off: np.ndarray,
+        op_len: np.ndarray,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """(klen i64[n], well-formed-SET mask bool[n])."""
         opcode = dbuf[op_off]
         klen = dbuf[op_off + 1].astype(np.int64) | (
             dbuf[op_off + 2].astype(np.int64) << 8
@@ -678,6 +762,32 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
             & (3 + klen <= op_len)
             & (op_len - 3 - klen <= self.store.max_value_size)
         )
+        return klen, is_set
+
+    @staticmethod
+    def _regroup(resp, counts: np.ndarray):
+        """Regroup flat per-op responses per covered shard (lazily when
+        the flat responses are a frame view)."""
+        if isinstance(resp, FrameSeq):
+            bounds = np.zeros(len(counts) + 1, np.int64)
+            np.cumsum(counts, out=bounds[1:])
+            return FrameGroups(resp, bounds)
+        if bool((counts == 1).all()):
+            return [[r] for r in resp]
+        out: list[list[bytes]] = []
+        pos = 0
+        for c in counts.tolist():
+            out.append(resp[pos : pos + c])
+            pos += c
+        return out
+
+    def apply_block(
+        self, block, idxs, want_responses: bool = True
+    ) -> Optional[list[list[bytes]]]:
+        idxs = np.asarray(idxs, np.int64)
+        counts, op_shards, op_off, op_len = self._decode_cols(block, idxs)
+        dbuf = self._pad_buf(block.data)
+        klen, is_set = self._set_mask(dbuf, op_off, op_len)
         self._version += len(idxs)
         if bool(is_set.all()):
             resp = self._apply_sets(
@@ -690,37 +800,123 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
             )
         if resp is None:
             return None
-        # regroup flat responses per covered shard
-        if bool((counts == 1).all()):
-            return [[r] for r in resp]
-        out: list[list[bytes]] = []
+        return self._regroup(resp, counts)
+
+    def apply_block_multi(
+        self, blocks, idxs_list, want_responses: bool = True
+    ) -> Optional[list[Optional[list[list[bytes]]]]]:
+        """Apply several decided waves (wave order = list order) in ONE
+        vectorized pass when every op is a well-formed SET — the
+        full-width block lane's bulk-write shape. Anything else falls
+        back to sequential :meth:`apply_block` calls, preserving each
+        wave's op-ordering semantics; on that path a wave that fails
+        deterministically yields an ``Exception`` as ITS list entry
+        (waves already applied keep their responses — per-wave failure
+        granularity, matching sequential apply).
+
+        Precondition (the block-lane invariant ``submit_block`` enforces):
+        a block's covered shards are unique within that block — the
+        cross-wave version ranks are derived from it.
+        """
+        if len(blocks) == 1:
+            return [self.apply_block(blocks[0], idxs_list[0], want_responses)]
+        per: list[tuple] = []
+        ranks_parts: list[np.ndarray] = []
+        prior = np.zeros(self.num_shards, np.int64)
+        shifts: list[int] = []
+        off = 0
+        set_only = True
+        for block, idxs in zip(blocks, idxs_list):
+            idxs = np.asarray(idxs, np.int64)
+            counts, op_shards, op_off, op_len = self._decode_cols(block, idxs)
+            # per-block SET check on the block's own buffer — the big
+            # concatenation below only happens once the fast path is sure
+            klen_j, is_set_j = self._set_mask(
+                self._pad_buf(block.data), op_off, op_len
+            )
+            if not bool(is_set_j.all()):
+                set_only = False
+                break  # fallback path re-decodes per block anyway
+            # occurrence rank = ops on the same shard in PRIOR waves +
+            # the within-wave run offset (runs are contiguous per block)
+            ranks_parts.append(
+                prior[op_shards] + VectorKVStore._run_ranks(op_shards)
+            )
+            prior[block.shards[idxs]] += counts
+            shifts.append(off)
+            off += len(block.data)
+            per.append((idxs, counts, op_shards, op_off, op_len, klen_j))
+        if not set_only:
+            # mixed waves: sequential applies keep cross-wave read/write
+            # ordering exact (no mutation has happened yet). A wave that
+            # fails deterministically becomes ITS entry's exception —
+            # earlier waves' commits stay settled with real responses.
+            out_seq: list = []
+            for b, i in zip(blocks, idxs_list):
+                try:
+                    out_seq.append(self.apply_block(b, i, want_responses))
+                except Exception as e:  # deterministic app failure
+                    out_seq.append(e)
+            return out_seq
+        raw = b"".join(b.data for b in blocks)
+        op_shards = np.concatenate([p[2] for p in per])
+        op_off = np.concatenate(
+            [p[3] + s for p, s in zip(per, shifts)]
+        )
+        op_len = np.concatenate([p[4] for p in per])
+        klen = np.concatenate([p[5] for p in per])
+        dbuf = self._pad_buf(raw)
+        self._version += sum(len(p[0]) for p in per)
+        resp = self._apply_sets(
+            op_shards, dbuf, op_off, op_len, klen, raw, want_responses,
+            ranks=np.concatenate(ranks_parts),
+        )
+        if resp is None:
+            return None
+        # per-block groups index the ONE flat frame view with absolute
+        # bounds — no per-block slicing or copying
+        out: list = []
         pos = 0
-        for c in counts.tolist():
-            out.append(resp[pos : pos + c])
-            pos += c
+        for _idxs, counts, *_rest in per:
+            tot = int(counts.sum())
+            bounds = np.full(len(counts) + 1, pos, np.int64)
+            bounds[1:] += np.cumsum(counts)
+            out.append(FrameGroups(resp, bounds))
+            pos += tot
         return out
 
     def _apply_sets(
         self, op_shards, dbuf, op_off, op_len, klen, raw: bytes,
         want_responses: bool = True,
+        ranks: Optional[np.ndarray] = None,
     ) -> Optional[list[bytes]]:
         n = len(op_off)
         K = self.store.K
-        # gather zero-padded key windows [n, K]
-        win = dbuf[(op_off + 3)[:, None] + np.arange(K)[None, :]]
-        win = np.where(np.arange(K)[None, :] < klen[:, None], win, 0)
+        # gather zero-padded key windows [n, K]; the gather itself only
+        # spans the widest ACTUAL key (Ku), zero-filling the rest — keys
+        # are usually far shorter than the table's max width
+        Ku = int(klen.max()) if n else 0
+        if Ku < K:
+            small = dbuf[(op_off + 3)[:, None] + np.arange(Ku)[None, :]]
+            small = np.where(np.arange(Ku)[None, :] < klen[:, None], small, 0)
+            win = np.zeros((n, K), np.uint8)
+            win[:, :Ku] = small
+        else:
+            win = dbuf[(op_off + 3)[:, None] + np.arange(K)[None, :]]
+            win = np.where(np.arange(K)[None, :] < klen[:, None], win, 0)
         lanes = np.ascontiguousarray(win).view(U64).reshape(n, self.store.L)
         vers = self.store.bulk_set(
-            op_shards, lanes, klen, (raw, op_off + 3 + klen, op_len - 3 - klen)
+            op_shards, lanes, klen,
+            (raw, op_off + 3 + klen, op_len - 3 - klen),
+            ranks=ranks,
         )
         if not want_responses:
             return None
-        # responses: one structured array -> n fixed 6-byte frames
-        # (tobytes + slicing: an S6 view would strip trailing NULs)
+        # responses: one structured array -> n fixed 6-byte frames behind
+        # a lazy view (tobytes once; per-frame bytes slice on client read)
         arr = np.zeros(n, _RESP_DT)
         arr["version"] = vers.astype(np.uint32)
-        raw6 = arr.tobytes()
-        return [raw6[i * 6 : i * 6 + 6] for i in range(n)]
+        return FrameSeq(arr.tobytes(), 6, n)
 
     def _apply_mixed(
         self, op_shards, is_set, dbuf, op_off, op_len, klen, raw: bytes

@@ -109,13 +109,18 @@ class MeshBlockFuture:
         self._pending = k
 
     def _settle(self, i: int, value) -> None:
+        if self._pending == 0:
+            return  # already bulk-settled (results may be a lazy view)
         if self._results[i] is None:
             self._pending -= 1
         self._results[i] = value
 
-    def _settle_bulk(self, results: list) -> None:
-        """Settle every entry at once (full-width fast lane)."""
-        self._results = list(results)
+    def _settle_bulk(self, results) -> None:
+        """Settle every entry at once (full-width fast lane). A lazy
+        response view (e.g. vector_kv.FrameGroups) is stored AS the
+        result — per-shard response lists materialize when the client
+        reads them, not on the commit path."""
+        self._results = list(results) if isinstance(results, list) else results
         self._pending = 0
 
     def done(self) -> bool:
@@ -245,6 +250,11 @@ class MeshEngine:
         self.decided_v0 = 0
         self.divergences = 0  # replicas disagreeing on an apply outcome
         self.cycles = 0
+        # speculative next-window dispatch (full-width lane): (key, device
+        # plane) issued before the current window's readback so device
+        # compute overlaps the host apply; used only when the engine state
+        # it assumed (depth, base slots, alive mask) still holds
+        self._spec: Optional[tuple[tuple, object]] = None
 
     # -- client surface ------------------------------------------------------
 
@@ -314,9 +324,11 @@ class MeshEngine:
     def crash_replica(self, r: int) -> None:
         """Mask replica ``r`` out of every shard's tally (fail-stop)."""
         self.alive[:, r] = False
+        self._spec = None  # speculated under the old mask
 
     def heal_replica(self, r: int) -> None:
         self.alive[:, r] = True
+        self._spec = None
 
     @property
     def has_quorum(self) -> bool:
@@ -403,9 +415,50 @@ class MeshEngine:
         W = self.window
         n = self.n_shards
         depth = min(len(self._full_blocks), W)
-        votes = np.zeros((W, self.S, self.R), np.int8)
-        votes[:depth, :n, :] = V1
-        decided = self._decide_window(votes, W)
+        base = np.zeros(self.S, np.int32)
+        base[:n] = self.next_slot
+        if self._multi:
+            # multi-controller SPMD: inputs must assemble through
+            # make_array_from_callback + allgather (no speculation — the
+            # blocking collective IS the step)
+            decided = self._run_window_multihost(
+                self._fullwidth_votes(depth), base, W
+            )
+            self.cycles += 1
+            return self._finish_cycle_fullwidth(decided, depth)
+        key = (depth, base.tobytes(), self.alive.tobytes())
+        if self._spec is not None and self._spec[0] == key:
+            dev = self._spec[1]  # the previous cycle already dispatched us
+        else:
+            dev = self._dispatch_window(self._fullwidth_votes(depth), base, W)
+        self._spec = None
+        # dispatch the NEXT window before this one's readback: its inputs
+        # assume this window decides all-V1 (exactly the full-width happy
+        # path), so device compute overlaps the readback + host apply
+        # below; a fault outcome just discards it (deterministic kernel —
+        # re-deciding later with the true base slots is harmless)
+        if len(self._full_blocks) > depth:
+            sdepth = min(len(self._full_blocks) - depth, W)
+            sbase = base.copy()
+            sbase[:n] += depth
+            skey = (sdepth, sbase.tobytes(), self.alive.tobytes())
+            sdev = self._dispatch_window(
+                self._fullwidth_votes(sdepth), sbase, W
+            )
+            try:
+                # queue the device->host transfer behind the compute so the
+                # decided plane is already on host when the next cycle
+                # reads it (the transfer latency hides under this cycle's
+                # apply — on a tunneled chip that's the whole round-trip)
+                sdev.copy_to_host_async()
+            except AttributeError:
+                pass
+            self._spec = (skey, sdev)
+        return self._finish_cycle_fullwidth(np.asarray(dev), depth)
+
+    def _finish_cycle_fullwidth(self, decided: np.ndarray, depth: int) -> int:
+        """Bookkeeping + apply for a decided full-width window."""
+        n = self.n_shards
         if not bool((decided[:depth, :n] == V1).all()):
             # faults interrupted the uniform wave: re-run through the
             # general path with the SAME (deterministically re-decided)
@@ -422,14 +475,22 @@ class MeshEngine:
             1, self.max_decision_history // max(1, self.window)
         ):
             self._bulk_log.popleft()
-        for block, bfut, inv in entries:
-            idxs = np.arange(len(block))
-            self._apply_block_group(block, idxs, None, bulk_future=bfut)
+        if len(entries) == 1 or not self._apply_entries_multi(entries):
+            for block, bfut, inv in entries:
+                idxs = np.arange(len(block))
+                self._apply_block_group(block, idxs, None, bulk_future=bfut)
         return depth * n
+
+    def _fullwidth_votes(self, depth: int) -> np.ndarray:
+        """Initial votes for ``depth`` uniform full-width waves."""
+        votes = np.zeros((self.window, self.S, self.R), np.int8)
+        votes[:depth, : self.n_shards, :] = V1
+        return votes
 
     def _demote_full_blocks(self) -> None:
         """Move staged full-width blocks onto the per-shard queues (the
         general path's representation), preserving submission order."""
+        self._spec = None  # speculated on the full-width lane's slots
         while self._full_blocks:
             block, bfut, _inv = self._full_blocks.popleft()
             for i, s in enumerate(block.shards.tolist()):
@@ -440,24 +501,29 @@ class MeshEngine:
 
     def _decide_window(self, votes: np.ndarray, W: int) -> np.ndarray:
         """One device dispatch deciding a W-slot window; returns i8[W, S]."""
-        import jax.numpy as jnp
-
         base = np.zeros(self.S, np.int32)
         base[: self.n_shards] = self.next_slot
         if self._multi:
             decided = self._run_window_multihost(votes, base, W)
-        else:
-            decided = np.asarray(
-                self.kernel.slot_window(
-                    jnp.asarray(votes),
-                    self.kernel.place(jnp.asarray(self.alive)),
-                    jnp.asarray(base),
-                    n_slots=W,
-                    max_phases=self.max_phases,
-                )
-            )
+            self.cycles += 1
+            return decided
+        return np.asarray(self._dispatch_window(votes, base, W))
+
+    def _dispatch_window(self, votes: np.ndarray, base: np.ndarray, W: int):
+        """Enqueue one slot_window dispatch; returns the UNmaterialized
+        device plane (JAX dispatch is async — the caller blocks only at
+        ``np.asarray``, which is what the full-width lane exploits to
+        overlap the next window's compute with this one's apply)."""
+        import jax.numpy as jnp
+
         self.cycles += 1
-        return decided
+        return self.kernel.slot_window(
+            jnp.asarray(votes),
+            self.kernel.place(jnp.asarray(self.alive)),
+            jnp.asarray(base),
+            n_slots=W,
+            max_phases=self.max_phases,
+        )
 
     def _run_window_multihost(
         self, votes: np.ndarray, base: np.ndarray, W: int
@@ -587,6 +653,65 @@ class MeshEngine:
                 [p.settle for _s, _slot, p in bulk],
             )
 
+    def _apply_entries_multi(self, entries: list) -> bool:
+        """Apply a whole full-width cycle's decided blocks with ONE
+        state-machine call per replica (``apply_block_multi`` — the
+        vector store concatenates the waves into a single vectorized
+        pass). Returns False when the SMs lack the interface; the caller
+        then falls back to per-block applies."""
+        if not all(
+            callable(getattr(sm, "apply_block_multi", None))
+            for sm in self.sms
+        ):
+            return False
+        blocks = [e[0] for e in entries]
+        idxs_list = [np.arange(len(b)) for b in blocks]
+        results: list = []  # per replica: result list, or the raised error
+        for i, sm in enumerate(self.sms):
+            try:
+                results.append(
+                    sm.apply_block_multi(
+                        blocks, idxs_list, want_responses=(i == 0)
+                    )
+                )
+            except Exception as e:  # deterministic app failure
+                results.append(e)
+        lead = results[0]
+        # divergence accounting: a follower disagreeing with replica 0 on
+        # group failure, or (where per-wave outcomes exist) on any wave's
+        # failure-ness, has diverged
+        for i, r in enumerate(results[1:], 1):
+            if isinstance(r, Exception) != isinstance(lead, Exception):
+                self.divergences += 1
+                logger.error(
+                    "replica %d %s a wave group replica 0 %s",
+                    i,
+                    "rejected" if isinstance(r, Exception) else "applied",
+                    "applied" if isinstance(r, Exception) else "rejected",
+                )
+            elif isinstance(r, list) and isinstance(lead, list):
+                for j in range(len(entries)):
+                    if isinstance(r[j], Exception) != isinstance(
+                        lead[j], Exception
+                    ):
+                        self.divergences += 1
+                        logger.error(
+                            "replica %d diverged on wave %d of a group", i, j
+                        )
+        # settlement follows replica 0's outcomes (per wave when they
+        # exist — waves that committed keep their real responses even if a
+        # later wave in the group failed)
+        for j, (block, bfut, _inv) in enumerate(entries):
+            if isinstance(lead, Exception) or lead is None:
+                out = RabiaError(f"apply failed: {lead}")
+                bfut._settle_bulk([out] * len(block))
+            elif isinstance(lead[j], Exception):
+                out = RabiaError(f"apply failed: {lead[j]}")
+                bfut._settle_bulk([out] * len(block))
+            else:
+                bfut._settle_bulk(lead[j])
+        return True
+
     def _apply_block_group(
         self, block, idxs, settles, bulk_future: Optional[MeshBlockFuture] = None
     ) -> None:
@@ -678,6 +803,7 @@ class MeshEngine:
         where the checkpoint left off."""
         if self._has_pending():
             raise RabiaError("restore requires an idle engine")
+        self._spec = None  # speculated on pre-restore slot counters
         committed = np.asarray(
             state.per_shard_committed[: self.n_shards], np.int64
         )

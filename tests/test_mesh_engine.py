@@ -509,3 +509,88 @@ class TestMeshEngineConformance:
         assert all(s == transport_snap for s in mesh_snaps), (
             "replica state diverges across planes"
         )
+
+
+class TestMultiApplyFailureGranularity:
+    """A deterministic app failure in one wave of a multi-block apply
+    group must fail ONLY that wave's future — earlier and later waves
+    keep their real responses (per-wave granularity, like the
+    sequential per-block path)."""
+
+    class _StubVectorSM:
+        """Vector-SM shape whose apply_block raises on 'poison' blocks."""
+
+        def apply_batch(self, batch):
+            return [b"OK"] * len(batch.commands)
+
+        def apply_block(self, block, idxs, want_responses=True):
+            if block.commands_for(0)[0].startswith(b"POISON"):
+                raise RuntimeError("boom")
+            if not want_responses:
+                return None
+            return [[b"OK"] for _ in np.asarray(idxs)]
+
+        def apply_block_multi(self, blocks, idxs_list, want_responses=True):
+            out = []
+            for b, i in zip(blocks, idxs_list):
+                try:
+                    out.append(self.apply_block(b, i, want_responses))
+                except Exception as e:
+                    out.append(e)
+            return out
+
+        def create_snapshot(self):
+            from rabia_tpu.core.state_machine import Snapshot
+
+            return Snapshot.create(0, b"")
+
+        def restore_snapshot(self, snapshot):
+            pass
+
+    def test_poison_wave_fails_alone(self):
+        from rabia_tpu.core.blocks import build_block
+
+        S = 4
+        eng = MeshEngine(
+            self._StubVectorSM, n_shards=S, n_replicas=4, mesh=_mesh(),
+            window=8,
+        )
+        shards = list(range(S))
+        ok1 = eng.submit_block(build_block(shards, [[b"SET a 1"]] * S))
+        bad = eng.submit_block(build_block(shards, [[b"POISON"]] * S))
+        ok2 = eng.submit_block(build_block(shards, [[b"SET b 2"]] * S))
+        eng.flush()
+        assert ok1.result() == [[b"OK"]] * S
+        assert ok2.result() == [[b"OK"]] * S
+        assert all(
+            isinstance(e, RabiaError) and "apply failed" in str(e)
+            for e in bad.result()
+        )
+
+    def test_poison_wave_fails_alone_fullwidth_lane(self):
+        """Same through the full-width fast lane (blocks cover every
+        shard, nothing queued per-shard) — the _apply_entries_multi path."""
+        from rabia_tpu.core.blocks import build_block
+
+        S = 8
+        eng = MeshEngine(
+            self._StubVectorSM, n_shards=S, n_replicas=4, mesh=_mesh(),
+            window=4,
+        )
+        shards = list(range(S))
+        futs = [
+            eng.submit_block(
+                build_block(
+                    shards,
+                    [[b"POISON" if w == 1 else b"SET x 1"]] * S,
+                )
+            )
+            for w in range(3)
+        ]
+        eng.flush()
+        assert futs[0].result() == [[b"OK"]] * S
+        assert futs[2].result() == [[b"OK"]] * S
+        assert all(
+            isinstance(e, RabiaError) and "apply failed" in str(e)
+            for e in futs[1].result()
+        )

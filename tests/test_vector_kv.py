@@ -291,3 +291,85 @@ class TestMixedOpEquivalence:
             for k in classic[s].keys():
                 got = vec.get(s, k.encode())
                 assert got is not None and got[0].decode() == classic[s].get(k).value
+
+
+class TestApplyBlockMulti:
+    """apply_block_multi (the full-width lane's one-call-per-replica
+    apply) must be observationally identical to sequential apply_block."""
+
+    @staticmethod
+    def _rand_blocks(rng, n_shards, n_waves, mixed=False):
+        from rabia_tpu.apps.kvstore import KVOperation, encode_op_bin
+
+        blocks = []
+        for _w in range(n_waves):
+            shards = sorted(
+                rng.choice(n_shards, rng.integers(1, n_shards + 1),
+                           replace=False).tolist()
+            )
+            cmds = []
+            for s in shards:
+                ops = []
+                for _ in range(int(rng.integers(1, 4))):
+                    # duplicate keys across waves AND within a wave
+                    key = f"k{int(rng.integers(0, 6))}"
+                    if mixed and rng.random() < 0.3:
+                        op = (
+                            KVOperation.get(key)
+                            if rng.random() < 0.5
+                            else KVOperation.delete(key)
+                        )
+                        ops.append(encode_op_bin(op))
+                    else:
+                        ops.append(
+                            encode_set_bin(key, f"v{int(rng.integers(0, 100))}")
+                        )
+                cmds.append(ops)
+            blocks.append(build_block(shards, cmds))
+        return blocks
+
+    def _assert_equal(self, a: VectorShardedKV, b: VectorShardedKV):
+        # timestamps (created/updated) are wall-clock metadata — exclude
+        # them, as two equivalent applies never share a clock
+        pa = VectorKVStore._parse_snapshot(a.store.snapshot_bytes())
+        pb = VectorKVStore._parse_snapshot(b.store.snapshot_bytes())
+        assert pa[0].tolist() == pb[0].tolist()  # per-shard versions
+        assert pa[1][:4] == pb[1][:4]  # shards, keys, vals, versions
+        ov_a = [{k: v for k, v in d.items() if k not in ("created", "updated")}
+                for d in pa[2]]
+        ov_b = [{k: v for k, v in d.items() if k not in ("created", "updated")}
+                for d in pb[2]]
+        assert ov_a == ov_b
+
+    @pytest.mark.parametrize("mixed", [False, True])
+    def test_matches_sequential_apply(self, mixed):
+        rng = np.random.default_rng(7 if mixed else 5)
+        n_shards = 6
+        for trial in range(8):
+            blocks = self._rand_blocks(rng, n_shards, int(rng.integers(2, 6)),
+                                       mixed=mixed)
+            idxs = [np.arange(len(blk)) for blk in blocks]
+            one = VectorShardedKV(n_shards, capacity=256)
+            two = VectorShardedKV(n_shards, capacity=256)
+            seq = [one.apply_block(blk, i) for blk, i in zip(blocks, idxs)]
+            multi = two.apply_block_multi(blocks, idxs)
+            assert multi == seq, f"trial {trial}: responses diverge"
+            self._assert_equal(one, two)
+
+    def test_want_responses_false_still_applies(self):
+        rng = np.random.default_rng(11)
+        blocks = self._rand_blocks(rng, 4, 3)
+        idxs = [np.arange(len(blk)) for blk in blocks]
+        leader = VectorShardedKV(4, capacity=128)
+        follower = VectorShardedKV(4, capacity=128)
+        assert leader.apply_block_multi(blocks, idxs) is not None
+        assert follower.apply_block_multi(blocks, idxs,
+                                          want_responses=False) is None
+        self._assert_equal(leader, follower)
+
+    def test_single_block_delegates(self):
+        blk = build_block([0, 1], [[encode_set_bin("a", "1")],
+                                   [encode_set_bin("b", "2")]])
+        sm = VectorShardedKV(2, capacity=64)
+        out = sm.apply_block_multi([blk], [np.arange(2)])
+        assert len(out) == 1 and [len(r) for r in out[0]] == [1, 1]
