@@ -44,9 +44,10 @@ def main() -> int:
     shards = int(os.environ.get("BENCH_SHARDS", 4096))
     replicas = int(os.environ.get("BENCH_REPLICAS", 5))
     # slots per dispatch = the device pipeline depth; deep windows amortize
-    # dispatch/tunnel overhead across thousands of decisions (SURVEY.md
-    # §7.4.4): 64→~3M dec/s, 256→~13M, 1024→~47M on the tunneled v5p chip
-    slots = int(os.environ.get("BENCH_SLOTS", 1024))
+    # the kernel's per-scan-step cost across thousands of decisions
+    # (SURVEY.md §7.4.4): 1024→~40M dec/s, 4096→~100M, 8192→~160M,
+    # 16384→~200M on the tunneled v5p chip
+    slots = int(os.environ.get("BENCH_SLOTS", 8192))
     reps = int(os.environ.get("BENCH_REPS", 4))
 
     import jax

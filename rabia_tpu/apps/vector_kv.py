@@ -216,7 +216,9 @@ class VectorKVStore:
         state and wave content. Duplicate keys within one wave land in wave
         order (the later op updates the earlier one's slot). ``values`` as
         a ``(buffer, voffs, vlens)`` triple stores by reference with no
-        per-value slicing (the block lane's path). ``ranks`` overrides the
+        per-value slicing (the block lane's path); ``buffer`` is one
+        shared bytes object or an object array of per-op buffers.
+        ``ranks`` overrides the
         per-op occurrence index used for version assignment (count of
         PRIOR ops on the same shard within this call) — required when
         equal shards are NOT contiguous runs, e.g. several concatenated
@@ -723,18 +725,17 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
     # -- block lane -----------------------------------------------------------
 
     def _decode_cols(
-        self, block, idxs: np.ndarray, off_shift: int = 0
+        self, block, idxs: np.ndarray
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Flat (counts, op_shards, op_off, op_len) for the selected
-        shard entries, wave order; ``off_shift`` relocates offsets into a
-        concatenation of several blocks' data buffers."""
+        shard entries, wave order; offsets index the block's own data."""
         counts = block.counts[idxs]
         cmd_idx = (
             np.repeat(block.shard_starts[idxs], counts)
             + _concat_ranges(counts)
         )
         op_shards = np.repeat(block.shards[idxs], counts)
-        op_off = block.cmd_offsets[cmd_idx] + off_shift
+        op_off = block.cmd_offsets[cmd_idx]
         op_len = block.cmd_sizes[cmd_idx]
         return counts, op_shards, op_off, op_len
 
@@ -823,17 +824,12 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
         per: list[tuple] = []
         ranks_parts: list[np.ndarray] = []
         prior = np.zeros(self.num_shards, np.int64)
-        shifts: list[int] = []
-        off = 0
         set_only = True
         for block, idxs in zip(blocks, idxs_list):
             idxs = np.asarray(idxs, np.int64)
             counts, op_shards, op_off, op_len = self._decode_cols(block, idxs)
-            # per-block SET check on the block's own buffer — the big
-            # concatenation below only happens once the fast path is sure
-            klen_j, is_set_j = self._set_mask(
-                self._pad_buf(block.data), op_off, op_len
-            )
+            dbuf_j = self._pad_buf(block.data)
+            klen_j, is_set_j = self._set_mask(dbuf_j, op_off, op_len)
             if not bool(is_set_j.all()):
                 set_only = False
                 break  # fallback path re-decodes per block anyway
@@ -843,9 +839,10 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
                 prior[op_shards] + VectorKVStore._run_ranks(op_shards)
             )
             prior[block.shards[idxs]] += counts
-            shifts.append(off)
-            off += len(block.data)
-            per.append((idxs, counts, op_shards, op_off, op_len, klen_j))
+            per.append(
+                (idxs, counts, op_shards, op_off, op_len, klen_j, dbuf_j,
+                 block.data)
+            )
         if not set_only:
             # mixed waves: sequential applies keep cross-wave read/write
             # ordering exact (no mutation has happened yet). A wave that
@@ -858,21 +855,33 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
                 except Exception as e:  # deterministic app failure
                     out_seq.append(e)
             return out_seq
-        raw = b"".join(b.data for b in blocks)
+        # ONE bulk_set over the concatenated columns. Keys gather from
+        # each block's own padded buffer (already built for the SET
+        # check); values reference each op's OWN block buffer through an
+        # object column — retention per block, exactly like sequential
+        # apply (no multi-wave buffer pinning, no payload concatenation).
         op_shards = np.concatenate([p[2] for p in per])
-        op_off = np.concatenate(
-            [p[3] + s for p, s in zip(per, shifts)]
-        )
-        op_len = np.concatenate([p[4] for p in per])
         klen = np.concatenate([p[5] for p in per])
-        dbuf = self._pad_buf(raw)
+        lanes = np.concatenate(
+            [self._lanes_of(p[6], p[3], p[5]) for p in per]
+        )
+        voffs = np.concatenate([p[3] + 3 + p[5] for p in per])
+        vlens = np.concatenate([p[4] - 3 - p[5] for p in per])
+        n_total = len(op_shards)
+        vbufs = np.empty(n_total, object)
+        pos = 0
+        for p in per:
+            k = len(p[2])
+            vbufs[pos : pos + k] = p[7]  # object scalar: one ref per op
+            pos += k
         self._version += sum(len(p[0]) for p in per)
-        resp = self._apply_sets(
-            op_shards, dbuf, op_off, op_len, klen, raw, want_responses,
+        vers = self.store.bulk_set(
+            op_shards, lanes, klen, (vbufs, voffs, vlens),
             ranks=np.concatenate(ranks_parts),
         )
-        if resp is None:
+        if not want_responses:
             return None
+        resp = self._vers_frames(vers)
         # per-block groups index the ONE flat frame view with absolute
         # bounds — no per-block slicing or copying
         out: list = []
@@ -885,16 +894,14 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
             pos += tot
         return out
 
-    def _apply_sets(
-        self, op_shards, dbuf, op_off, op_len, klen, raw: bytes,
-        want_responses: bool = True,
-        ranks: Optional[np.ndarray] = None,
-    ) -> Optional[list[bytes]]:
+    def _lanes_of(
+        self, dbuf: np.ndarray, op_off: np.ndarray, klen: np.ndarray
+    ) -> np.ndarray:
+        """Zero-padded u64 key lanes [n, L] gathered from ``dbuf``; the
+        gather only spans the widest ACTUAL key (Ku), zero-filling the
+        rest — keys are usually far shorter than the table's max width."""
         n = len(op_off)
         K = self.store.K
-        # gather zero-padded key windows [n, K]; the gather itself only
-        # spans the widest ACTUAL key (Ku), zero-filling the rest — keys
-        # are usually far shorter than the table's max width
         Ku = int(klen.max()) if n else 0
         if Ku < K:
             small = dbuf[(op_off + 3)[:, None] + np.arange(Ku)[None, :]]
@@ -904,19 +911,28 @@ class VectorShardedKV(StateMachine, VectorStateMachine):
         else:
             win = dbuf[(op_off + 3)[:, None] + np.arange(K)[None, :]]
             win = np.where(np.arange(K)[None, :] < klen[:, None], win, 0)
-        lanes = np.ascontiguousarray(win).view(U64).reshape(n, self.store.L)
+        return np.ascontiguousarray(win).view(U64).reshape(n, self.store.L)
+
+    @staticmethod
+    def _vers_frames(vers: np.ndarray) -> FrameSeq:
+        """Version responses as n fixed 6-byte frames behind a lazy view
+        (tobytes once; per-frame bytes slice on client read)."""
+        arr = np.zeros(len(vers), _RESP_DT)
+        arr["version"] = vers.astype(np.uint32)
+        return FrameSeq(arr.tobytes(), 6, len(vers))
+
+    def _apply_sets(
+        self, op_shards, dbuf, op_off, op_len, klen, raw: bytes,
+        want_responses: bool = True,
+    ) -> Optional[list[bytes]]:
+        lanes = self._lanes_of(dbuf, op_off, klen)
         vers = self.store.bulk_set(
             op_shards, lanes, klen,
             (raw, op_off + 3 + klen, op_len - 3 - klen),
-            ranks=ranks,
         )
         if not want_responses:
             return None
-        # responses: one structured array -> n fixed 6-byte frames behind
-        # a lazy view (tobytes once; per-frame bytes slice on client read)
-        arr = np.zeros(n, _RESP_DT)
-        arr["version"] = vers.astype(np.uint32)
-        return FrameSeq(arr.tobytes(), 6, n)
+        return self._vers_frames(vers)
 
     def _apply_mixed(
         self, op_shards, is_set, dbuf, op_off, op_len, klen, raw: bytes

@@ -421,10 +421,7 @@ class MeshEngine:
             # multi-controller SPMD: inputs must assemble through
             # make_array_from_callback + allgather (no speculation — the
             # blocking collective IS the step)
-            decided = self._run_window_multihost(
-                self._fullwidth_votes(depth), base, W
-            )
-            self.cycles += 1
+            decided = self._decide_window(self._fullwidth_votes(depth), W)
             return self._finish_cycle_fullwidth(decided, depth)
         key = (depth, base.tobytes(), self.alive.tobytes())
         if self._spec is not None and self._spec[0] == key:
@@ -432,6 +429,7 @@ class MeshEngine:
         else:
             dev = self._dispatch_window(self._fullwidth_votes(depth), base, W)
         self._spec = None
+        self.cycles += 1  # one CONSUMED window (discarded specs don't count)
         # dispatch the NEXT window before this one's readback: its inputs
         # assume this window decides all-V1 (exactly the full-width happy
         # path), so device compute overlaps the readback + host apply
@@ -500,23 +498,25 @@ class MeshEngine:
                 self._queued_entries += 1
 
     def _decide_window(self, votes: np.ndarray, W: int) -> np.ndarray:
-        """One device dispatch deciding a W-slot window; returns i8[W, S]."""
+        """One consumed consensus window; returns decided i8[W, S]."""
         base = np.zeros(self.S, np.int32)
         base[: self.n_shards] = self.next_slot
         if self._multi:
             decided = self._run_window_multihost(votes, base, W)
-            self.cycles += 1
-            return decided
-        return np.asarray(self._dispatch_window(votes, base, W))
+        else:
+            decided = np.asarray(self._dispatch_window(votes, base, W))
+        self.cycles += 1
+        return decided
 
     def _dispatch_window(self, votes: np.ndarray, base: np.ndarray, W: int):
         """Enqueue one slot_window dispatch; returns the UNmaterialized
         device plane (JAX dispatch is async — the caller blocks only at
         ``np.asarray``, which is what the full-width lane exploits to
-        overlap the next window's compute with this one's apply)."""
+        overlap the next window's compute with this one's apply). The
+        caller accounts ``cycles`` when a window is CONSUMED — a
+        discarded speculative dispatch is not a cycle."""
         import jax.numpy as jnp
 
-        self.cycles += 1
         return self.kernel.slot_window(
             jnp.asarray(votes),
             self.kernel.place(jnp.asarray(self.alive)),

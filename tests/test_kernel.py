@@ -79,6 +79,25 @@ class TestFaultFreeKernel:
         assert np.all(np.asarray(decided) == V1)
         assert np.all(np.asarray(dphase) == 0)
 
+    def test_slot_pipeline_wide_bit_identical(self):
+        # the batched (vmap-over-slots) pipeline must reproduce the
+        # sequential scan exactly — random votes, crash masks, odd sizes
+        rng = np.random.default_rng(3)
+        S, R, T = 17, 5, 8
+        k = ClusterKernel(S, R, seed=9)
+        votes = jnp.asarray(rng.choice([0, 1], size=(T, S, R)).astype(np.int8))
+        alive = jnp.asarray(rng.random((S, R)) > 0.25)
+        d1, p1 = k.slot_pipeline(
+            votes, alive, T, rounds_per_slot=6, start_slot_index=3
+        )
+        d2, p2 = k.slot_pipeline_wide(
+            votes, alive, T, rounds_per_slot=6, start_slot_index=3, block=4
+        )
+        assert np.array_equal(np.asarray(d1), np.asarray(d2))
+        assert np.array_equal(np.asarray(p1), np.asarray(p2))
+        with pytest.raises(ValueError, match="multiple"):
+            k.slot_pipeline_wide(votes, alive, T, block=3)
+
     def test_minority_crash_still_decides(self):
         S, R = 8, 5
         k = ClusterKernel(S, R, seed=1)

@@ -548,6 +548,9 @@ class TestMultiApplyFailureGranularity:
             pass
 
     def test_poison_wave_fails_alone(self):
+        """Through the GENERAL per-shard lane: subset blocks (3 of 4
+        shards) queue per shard, so failures settle via
+        _apply_block_group, not _apply_entries_multi."""
         from rabia_tpu.core.blocks import build_block
 
         S = 4
@@ -555,13 +558,14 @@ class TestMultiApplyFailureGranularity:
             self._StubVectorSM, n_shards=S, n_replicas=4, mesh=_mesh(),
             window=8,
         )
-        shards = list(range(S))
-        ok1 = eng.submit_block(build_block(shards, [[b"SET a 1"]] * S))
-        bad = eng.submit_block(build_block(shards, [[b"POISON"]] * S))
-        ok2 = eng.submit_block(build_block(shards, [[b"SET b 2"]] * S))
+        sub = [0, 1, 2]  # NOT full width -> per-shard queue lane
+        ok1 = eng.submit_block(build_block(sub, [[b"SET a 1"]] * len(sub)))
+        bad = eng.submit_block(build_block(sub, [[b"POISON"]] * len(sub)))
+        ok2 = eng.submit_block(build_block(sub, [[b"SET b 2"]] * len(sub)))
+        assert not eng._full_blocks  # really on the general lane
         eng.flush()
-        assert ok1.result() == [[b"OK"]] * S
-        assert ok2.result() == [[b"OK"]] * S
+        assert ok1.result() == [[b"OK"]] * len(sub)
+        assert ok2.result() == [[b"OK"]] * len(sub)
         assert all(
             isinstance(e, RabiaError) and "apply failed" in str(e)
             for e in bad.result()
