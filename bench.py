@@ -74,6 +74,34 @@ def main() -> int:
         decided.block_until_ready()
         dt = time.perf_counter() - t0
         best = max(best, shards * slots / dt)
+    scan_rate = best
+
+    # the fused (Pallas) fault-free window — bit-identical to the scanned
+    # machinery (conformance-gated in tests/test_kernel.py), bandwidth-
+    # bound instead of scan-latency-bound; this is the framework's actual
+    # fastest protocol-equivalent path, so it is the headline when it runs
+    kernel_name = "slot_pipeline_scan"
+    try:
+        d, _ = kernel.slot_pipeline_fused(votes, alive, slots)
+        d.block_until_ready()
+        if not bool(np.all(np.asarray(d) == V1)):
+            # a correctness failure must NOT be reported as mere
+            # unavailability (and an assert would vanish under -O)
+            raise RuntimeError("fused kernel decisions diverge (expected V1)")
+        fused_rate = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            d, _ = kernel.slot_pipeline_fused(votes, alive, slots)
+            d.block_until_ready()
+            dt = time.perf_counter() - t0
+            fused_rate = max(fused_rate, shards * slots / dt)
+        # adopt only a COMPLETE fused run, so a mid-loop failure can't
+        # leave a fused sample in `best` labeled as the scan kernel
+        if fused_rate > best:
+            best = fused_rate
+            kernel_name = "pallas_fused_window"
+    except Exception as e:
+        print(f"bench: fused kernel skipped: {e!r}", file=sys.stderr)
 
     cpu_rate = _cpu_oracle_rate(replicas)
 
@@ -98,10 +126,12 @@ def main() -> int:
         "vs_baseline": round(best / cpu_rate, 2),
         "vs_oracle": round(best / cpu_rate, 2),
         "baseline_cpu_oracle_per_sec": round(cpu_rate, 1),
+        "scan_decisions_per_sec": round(scan_rate, 1),
         "config": {
             "shards": shards,
             "replicas": replicas,
             "slots_per_dispatch": slots,
+            "kernel": kernel_name,
             "backend": backend,
         },
     }

@@ -1,0 +1,147 @@
+"""Pallas TPU kernel for the fault-free slot window: one fused pass.
+
+The general :meth:`ClusterKernel.slot_pipeline` runs every slot through the
+full weak-MVC machinery — two scanned ``round_step`` dispatches with
+``[S, R, R]`` delivery grids — because it must also model loss, partitions
+and per-replica divergence. Under the conditions ``slot_pipeline``
+actually runs with (FULL delivery, fresh per-slot state, the default
+``rounds_per_slot=2``), that machinery provably collapses to a closed
+form, which this module evaluates as a single Pallas kernel over the
+``[T, S, R]`` vote tensor — bandwidth-bound instead of scan-latency-bound.
+
+Derivation (each step mirrors ``round_step``, phase_driver.py:224-367):
+
+1. With full delivery, every alive receiver's round-1 ledger contains
+   exactly the *present* sender set ``{i : alive[i] and vote[i] != ABSENT}``
+   (a sender's own diagonal entry from ``start_slot`` coincides with its
+   delivered vote), so every alive replica computes the SAME tally
+   ``(c0, c1, tot)``.
+2. Round 1's transition: if ``tot >= Q`` every alive replica casts the
+   same round-2 vote ``r2 = V1 if c1>=Q else V0 if c0>=Q else V?``;
+   if ``tot < Q`` nothing ever happens (the ledger cannot grow).
+3. Round 2's delivery gives every alive receiver ``n_alive`` copies of
+   that same ``r2``; the advance condition ``tot2 >= Q`` holds because
+   ``n_alive >= tot >= Q``, and the decide condition ``count >= f+1``
+   holds because ``quorum >= f+1`` for every R. So the slot decides
+   ``r2`` iff ``r2 != V?`` — at MVC phase 0 — and stays undecided
+   otherwise (the coin is never reached within two rounds, so the
+   decision is independent of the slot index).
+
+Therefore::
+
+    decided[t, s] = V1      if c1 >= Q
+                    V0      elif c0 >= Q
+                    ABSENT  else (incl. tot < Q: c0,c1 <= tot)
+
+``tests/test_kernel.py`` pins this bit-identical to ``slot_pipeline``
+over random votes (all four codes), random crash masks and odd sizes —
+the general kernel remains the semantics owner; this is its proven
+fast path. No reference analog: the reference decides one instance at a
+time (rabia-core/src/messages.rs:185-211 tallies per phase).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from rabia_tpu.core.types import ABSENT, V0, V1
+
+I8 = jnp.int8
+I32 = jnp.int32
+
+
+@functools.partial(jax.jit, static_argnames=("quorum",))
+def closed_form_window(
+    votes: jnp.ndarray,  # i8[T, S, R]
+    alive: jnp.ndarray,  # bool[S, R]
+    quorum: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The closed form as one jitted XLA program (any backend)."""
+    present = (votes != ABSENT) & alive[None, :, :]
+    c1 = jnp.sum(present & (votes == V1), axis=-1, dtype=I32)
+    c0 = jnp.sum(present & (votes == V0), axis=-1, dtype=I32)
+    dec = jnp.where(
+        c1 >= quorum, I8(V1), jnp.where(c0 >= quorum, I8(V0), I8(ABSENT))
+    )
+    ph = jnp.where(dec != ABSENT, I32(0), I32(-1))
+    return dec, ph
+
+
+def _make_kernel(R: int, quorum: int):
+    """Kernel body closure (R and the quorum are compile-time static)."""
+
+    def kernel(votes_ref, alive_ref, dec_ref, ph_ref):
+        # votes_ref: i8[R, Tb, S] — replica-major so each plane is a
+        # contiguous (Tb, S) tile; alive_ref: i8[R, 1, S]. Integer
+        # arithmetic with explicit broadcasts throughout — Mosaic rejects
+        # mixed-rank i1 broadcasts ("non-singleton dimension replicated").
+        shape = dec_ref.shape
+        c1 = jnp.zeros(shape, I32)
+        c0 = jnp.zeros(shape, I32)
+        for r in range(R):  # static unroll over the replica axis
+            v = votes_ref[r].astype(I32)
+            a = jnp.broadcast_to(alive_ref[r], shape).astype(I32)
+            c1 = c1 + (v == V1).astype(I32) * a
+            c0 = c0 + (v == V0).astype(I32) * a
+        # stay in i32 until the final store: an i1 mask from an i32
+        # compare cannot drive an i8-tiled select (another relayout trap)
+        dec = jnp.where(
+            c1 >= quorum, I32(V1), jnp.where(c0 >= quorum, I32(V0), I32(ABSENT))
+        )
+        dec_ref[:] = dec.astype(I8)
+        ph_ref[:] = jnp.where(dec != ABSENT, I32(0), I32(-1))
+
+    return kernel
+
+
+def _pick_block(T: int) -> int:
+    # 64 slots x 4096 shards of i8 votes (xR) + i32 intermediates stays
+    # under the 16MB VMEM budget with double buffering; 128 does not
+    for b in (64, 32, 16, 8, 4, 2, 1):
+        if T % b == 0:
+            return b
+    return 1
+
+
+@functools.partial(
+    jax.jit, static_argnames=("quorum", "interpret")
+)
+def pallas_window(
+    votes: jnp.ndarray,  # i8[T, S, R]
+    alive: jnp.ndarray,  # bool[S, R]
+    quorum: int,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The closed form as one Pallas TPU kernel (grid over slot tiles)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, S, R = votes.shape
+    block = _pick_block(T)
+    votes_t = jnp.transpose(votes, (2, 0, 1))  # [R, T, S]
+    alive_t = jnp.transpose(alive.astype(I8), (1, 0))[:, None, :]  # [R,1,S]
+    dec, ph = pl.pallas_call(
+        _make_kernel(R, quorum),
+        grid=(T // block,),
+        in_specs=[
+            pl.BlockSpec(
+                (R, block, S), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (R, 1, S), lambda i: (0, 0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, S), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, S), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, S), I8),
+            jax.ShapeDtypeStruct((T, S), I32),
+        ],
+        interpret=interpret,
+    )(votes_t, alive_t)
+    return dec, ph

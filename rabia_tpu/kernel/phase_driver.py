@@ -513,6 +513,44 @@ class ClusterKernel:
             dphase.reshape(n_slots, S),
         )
 
+    def slot_pipeline_fused(
+        self,
+        initial_votes: jnp.ndarray,  # i8[T, S, R]
+        alive: jnp.ndarray,  # bool[S,R] (or broadcastable [R])
+        n_slots: int,
+        use_pallas: Optional[bool] = None,
+        interpret: bool = False,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Fault-free fast path: bit-identical to
+        ``slot_pipeline(votes, alive, T)`` at the default
+        ``rounds_per_slot=2`` (full delivery provably collapses to a
+        closed-form quorum tally — derivation in
+        :mod:`rabia_tpu.kernel.fused_window`), evaluated as ONE fused
+        Pallas kernel on TPU, or the same closed form as a plain XLA
+        program elsewhere. The scanned :meth:`slot_pipeline` remains the
+        semantics owner (and the path for lossy/crash simulation via
+        :meth:`run_rounds`)."""
+        from rabia_tpu.kernel import fused_window
+
+        if initial_votes.shape[0] != n_slots:
+            # slot_pipeline fails loudly on this mismatch (scan length);
+            # silent truncation would break the drop-in equivalence
+            raise ValueError(
+                f"votes carry {initial_votes.shape[0]} slots, "
+                f"n_slots={n_slots}"
+            )
+        alive = jnp.broadcast_to(alive, (self.S, self.R))
+        votes = initial_votes
+        if use_pallas is None:
+            use_pallas = (
+                jax.default_backend() == "tpu" and self.S % 128 == 0
+            )
+        if use_pallas or interpret:
+            return fused_window.pallas_window(
+                votes, alive, self.quorum, interpret=interpret
+            )
+        return fused_window.closed_form_window(votes, alive, self.quorum)
+
 
 # ---------------------------------------------------------------------------
 # Per-node kernel (the host engine's device half)

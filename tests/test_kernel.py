@@ -98,6 +98,29 @@ class TestFaultFreeKernel:
         with pytest.raises(ValueError, match="multiple"):
             k.slot_pipeline_wide(votes, alive, T, block=3)
 
+    def test_slot_pipeline_fused_bit_identical(self):
+        # the fused (closed-form / Pallas) fault-free window must match the
+        # scanned general machinery exactly: random votes over ALL four
+        # codes, random crash masks, varied R incl. even clusters and R=1
+        rng = np.random.default_rng(11)
+        for S, R in [(8, 1), (16, 3), (24, 4), (128, 5), (32, 7)]:
+            k = ClusterKernel(S, R, seed=S + R)
+            T = 8
+            votes = jnp.asarray(
+                rng.choice([0, 1, 2, 3], size=(T, S, R),
+                           p=[0.3, 0.4, 0.15, 0.15]).astype(np.int8)
+            )
+            alive = jnp.asarray(rng.random((S, R)) > 0.3)
+            d1, p1 = k.slot_pipeline(votes, alive, T)
+            # closed-form XLA path
+            d2, p2 = k.slot_pipeline_fused(votes, alive, T, use_pallas=False)
+            assert np.array_equal(np.asarray(d1), np.asarray(d2)), (S, R)
+            assert np.array_equal(np.asarray(p1), np.asarray(p2)), (S, R)
+            # Pallas kernel (interpreter mode on CPU)
+            d3, p3 = k.slot_pipeline_fused(votes, alive, T, interpret=True)
+            assert np.array_equal(np.asarray(d1), np.asarray(d3)), (S, R)
+            assert np.array_equal(np.asarray(p1), np.asarray(p3)), (S, R)
+
     def test_minority_crash_still_decides(self):
         S, R = 8, 5
         k = ClusterKernel(S, R, seed=1)
