@@ -31,7 +31,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from rabia_tpu.core.errors import ValidationError
-from rabia_tpu.core.types import BatchId, Command, CommandBatch, ShardId
+from rabia_tpu.core.types import BatchId, Command, CommandBatch, ShardId, fast_uuid4
 
 # 128-bit odd mixing constant (golden-ratio extension) — spreads the shard
 # index across the whole id so distinct shards of one block never collide.
@@ -194,7 +194,7 @@ def build_block(
     flat: list[bytes] = [b for cs in commands for b in cs]
     sizes = np.fromiter((len(b) for b in flat), np.int64, len(flat))
     return PayloadBlock(
-        block_id or uuid.uuid4(),
+        block_id or fast_uuid4(),
         shards,
         np.full(len(shards), -1, np.int64),
         counts,

@@ -31,6 +31,7 @@ from rabia_tpu.core.types import (
     NodeId,
     PhaseId,
     StateValue,
+    fast_uuid4,
     quorum_size,
 )
 
@@ -350,7 +351,7 @@ class ProtocolMessage:
         sender: NodeId, payload: Payload, recipient: Optional[NodeId] = None
     ) -> "ProtocolMessage":
         return ProtocolMessage(
-            id=uuid.uuid4(),
+            id=fast_uuid4(),
             sender=sender,
             recipient=recipient,
             timestamp=time.time(),

@@ -626,7 +626,7 @@ class TestBlockLanePersistence:
         engines = [mk_engine(i, stores) for i in range(R)]
         tasks = [asyncio.ensure_future(e.run()) for e in engines]
         try:
-            for _ in range(300):
+            for _ in range(1000):
                 await asyncio.sleep(0.01)
                 sts = [await e.get_statistics() for e in engines]
                 if all(s.has_quorum for s in sts):
@@ -676,7 +676,7 @@ class TestBlockLanePersistence:
             e0 = mk_engine(0, restored_stores)
             tasks[0] = asyncio.ensure_future(e0.run())
             engines[0] = e0
-            for _ in range(400):
+            for _ in range(1000):
                 await asyncio.sleep(0.01)
                 st = await e0.get_statistics()
                 if st.has_quorum and st.committed_slots > 0:
@@ -690,7 +690,7 @@ class TestBlockLanePersistence:
             after = (await engines[1].get_statistics()).committed_slots
             assert after > committed_before
             # restored replica converges on post-restart writes
-            for _ in range(400):
+            for _ in range(1000):
                 await asyncio.sleep(0.01)
                 got = restored_stores[0][2].store.get("p2")
                 if got is not None and got.value == "r2":

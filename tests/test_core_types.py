@@ -6,6 +6,7 @@ batching.rs:328-454, validation.rs:228-257.
 """
 
 import time
+import uuid
 
 import pytest
 
@@ -411,3 +412,33 @@ class TestConfig:
         cfg = RabiaConfig().with_shards(65)
         assert cfg.kernel.padded_shards == 72
         assert RabiaConfig().with_shards(64).kernel.padded_shards == 64
+
+
+class TestFastIds:
+    """Random ids come from a process-local PRNG (os.urandom once, not per
+    id); they must stay uuid4-shaped, unique, and fork-safe."""
+
+    def test_uuid4_shape_and_uniqueness(self):
+        ids = {BatchId.new().value for _ in range(5000)}
+        ids |= {NodeId.new().value for _ in range(5000)}
+        assert len(ids) == 10000
+        sample = next(iter(ids))
+        assert sample.version == 4
+        assert sample.variant == uuid.RFC_4122
+
+    def test_processes_draw_distinct_streams(self):
+        # forking the JAX-laden pytest process risks deadlock (JAX is
+        # multithreaded) — a fresh interpreter demonstrates the same
+        # property: two processes never share the id stream
+        import subprocess
+        import sys as _sys
+
+        out = subprocess.run(
+            [_sys.executable, "-c",
+             "from rabia_tpu.core.types import BatchId;"
+             "print(BatchId.new())"],
+            capture_output=True, text=True, timeout=60,
+            cwd=str(__import__("pathlib").Path(__file__).parent.parent),
+        )
+        assert out.returncode == 0, out.stderr
+        assert str(BatchId.new()) != out.stdout.strip()
