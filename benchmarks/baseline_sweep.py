@@ -371,6 +371,27 @@ async def config3_kvstore_4096_batched(baselines) -> None:
     top8, _ = await _committed(engines)
     dt8 = time.perf_counter() - t1
     await _stop(engines, tasks)
+
+    # (c) same geometry on the columnar store (VectorShardedKV) — the
+    # S-axis-native apply plane; the classic per-op store above is the
+    # reference-parity path, this is the TPU-first one (config5's store).
+    # Optional: a failure here must not discard the (a)/(b) measurements.
+    vector_rate = None
+    try:
+        from rabia_tpu.apps.vector_kv import VectorShardedKV
+
+        _, _, engines_v, _, tasks_v = await _mk_mem_cluster(
+            S, R, lambda: VectorShardedKV(S, capacity=1 << 18)
+        )
+        tv = time.perf_counter()
+        base_v, _ = await _committed(engines_v)
+        await _block_pump(engines_v, S, R, 8.0, lambda s: one_op[s])
+        top_v, _ = await _committed(engines_v)
+        dt_v = time.perf_counter() - tv
+        vector_rate = (top_v - base_v) / dt_v
+        await _stop(engines_v, tasks_v)
+    except Exception as e:
+        print(f"config3 vector phase failed: {e!r}", file=sys.stderr)
     _emit(
         "3:kvstore_5rep_4096shards_adaptive",
         rate,
@@ -391,6 +412,12 @@ async def config3_kvstore_4096_batched(baselines) -> None:
                 "consensus_batches": batches,
                 "avg_batch_size": round(cmds / max(1, batches), 1),
                 "ops_per_sec": round(adaptive_ok / adaptive_dt, 1),
+            },
+            "vector_store_phase": {
+                "store": "vector_kv",
+                "decisions_per_sec": (
+                    round(vector_rate, 1) if vector_rate else None
+                ),
             },
         },
     )

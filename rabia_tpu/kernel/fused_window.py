@@ -97,11 +97,12 @@ def _make_kernel(R: int, quorum: int):
     return kernel
 
 
-def _pick_block(T: int, S: int) -> int:
-    # 64 slots x 4096 shards of i8 votes (xR) + i32 intermediates stays
-    # under the 16MB VMEM budget with double buffering; scale the slot
-    # tile down as the shard axis grows so block*S stays bounded
-    cap = max(1, (64 * 4096) // max(S, 1))
+def _pick_block(T: int, S: int, R: int) -> int:
+    # the validated budget point: 64 slots x 4096 shards x 5 replicas of
+    # i8 votes + i32 intermediates fits the 16MB VMEM with double
+    # buffering — scale the slot tile down as EITHER axis grows so
+    # block*S*R stays bounded
+    cap = max(1, (64 * 4096 * 5) // max(S * max(R, 1), 1))
     for b in (64, 32, 16, 8, 4, 2, 1):
         if b <= cap and T % b == 0:
             return b
@@ -122,7 +123,7 @@ def pallas_window(
     from jax.experimental.pallas import tpu as pltpu
 
     T, S, R = votes.shape
-    block = _pick_block(T, S)
+    block = _pick_block(T, S, R)
     votes_t = jnp.transpose(votes, (2, 0, 1))  # [R, T, S]
     alive_t = jnp.transpose(alive.astype(I8), (1, 0))[:, None, :]  # [R,1,S]
     dec, ph = pl.pallas_call(

@@ -426,19 +426,59 @@ class TestFastIds:
         assert sample.version == 4
         assert sample.variant == uuid.RFC_4122
 
-    def test_processes_draw_distinct_streams(self):
-        # forking the JAX-laden pytest process risks deadlock (JAX is
-        # multithreaded) — a fresh interpreter demonstrates the same
-        # property: two processes never share the id stream
+    @staticmethod
+    def _first_draw(extra: str = "") -> str:
+        """First id drawn by a FRESH interpreter (stream position 1 —
+        comparing equal positions catches deterministic seeding, which a
+        positional offset would mask)."""
+        import pathlib
         import subprocess
         import sys as _sys
 
         out = subprocess.run(
             [_sys.executable, "-c",
-             "from rabia_tpu.core.types import BatchId;"
-             "print(BatchId.new())"],
+             "from rabia_tpu.core.types import BatchId\n" + extra
+             + "print(BatchId.new())"],
             capture_output=True, text=True, timeout=60,
-            cwd=str(__import__("pathlib").Path(__file__).parent.parent),
+            cwd=str(pathlib.Path(__file__).parent.parent),
         )
         assert out.returncode == 0, out.stderr
-        assert str(BatchId.new()) != out.stdout.strip()
+        return out.stdout.strip().splitlines()[-1]
+
+    def test_processes_draw_distinct_streams(self):
+        assert self._first_draw() != self._first_draw()
+
+    def test_fork_reseeds_child_stream(self):
+        # the register_at_fork reseed, exercised in a JAX-free child
+        # interpreter (forking the JAX-laden pytest process risks
+        # deadlock): parent and forked child at the SAME stream position
+        # must draw different ids
+        import pathlib
+        import subprocess
+        import sys as _sys
+
+        script = (
+            "import os, sys\n"
+            "from rabia_tpu.core.types import BatchId\n"
+            "if not hasattr(os, 'fork'):\n"
+            "    print('SKIP'); sys.exit(0)\n"
+            "r, w = os.pipe()\n"
+            "pid = os.fork()\n"
+            "if pid == 0:\n"
+            "    os.close(r); os.write(w, str(BatchId.new()).encode())\n"
+            "    os._exit(0)\n"
+            "os.close(w)\n"
+            "child = os.read(r, 64).decode(); os.close(r)\n"
+            "os.waitpid(pid, 0)\n"
+            "print('DIFFER' if str(BatchId.new()) != child else 'SAME')\n"
+        )
+        out = subprocess.run(
+            [_sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=60,
+            cwd=str(pathlib.Path(__file__).parent.parent),
+        )
+        assert out.returncode == 0, out.stderr
+        verdict = out.stdout.strip().splitlines()[-1]
+        if verdict == "SKIP":
+            pytest.skip("no fork on this platform")
+        assert verdict == "DIFFER"
