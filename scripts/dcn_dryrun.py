@@ -104,6 +104,16 @@ def worker(process_id: int, coordinator: str) -> None:
     applied = eng.flush()
     assert applied == 2 * S, applied
     assert all(f.result() == [b"OK"] for f in futs)
+    # the full-width block lane over the multi-process mesh too — its
+    # multihost decide routes through _run_window_multihost
+    from rabia_tpu.core.blocks import build_block
+
+    bfut = eng.submit_block(
+        build_block(list(range(S)), [[f"SET blk{s} w".encode()] for s in range(S)])
+    )
+    assert eng.flush() == S
+    assert bfut.result() == [[b"OK"]] * S
+    applied += S  # the printed total covers both lanes
     snap = eng.sms[0].create_snapshot().data
     assert all(sm.create_snapshot().data == snap for sm in eng.sms)
     # cross-process agreement: both processes must hold the same state
@@ -120,7 +130,8 @@ def worker(process_id: int, coordinator: str) -> None:
     )
     print(
         f"proc {process_id}: MeshEngine committed {applied} batches "
-        f"across the 2-process mesh; state digests agree",
+        f"(scalar + block lanes) across the 2-process mesh; "
+        f"state digests agree",
         flush=True,
     )
     jax.distributed.shutdown()
