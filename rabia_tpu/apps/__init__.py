@@ -24,8 +24,10 @@ from rabia_tpu.apps.kvstore import (
     ChangeType,
     KVOperation,
     KVOpType,
+    BatchResult,
     KVResult,
     KVResultKind,
+    OperationBatch,
     KVStore,
     KVStoreSMR,
     NotificationBus,
@@ -59,8 +61,10 @@ __all__ = [
     "CounterState",
     "KVOpType",
     "KVOperation",
+    "BatchResult",
     "KVResult",
     "KVResultKind",
+    "OperationBatch",
     "KVStore",
     "KVStoreSMR",
     "NotificationBus",

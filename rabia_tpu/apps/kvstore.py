@@ -117,6 +117,67 @@ class KVResult:
         return self.kind == KVResultKind.Success
 
 
+@dataclass(frozen=True)
+class OperationBatch:
+    """A group of typed operations executed as one unit
+    (operations.rs:169-212)."""
+
+    operations: tuple[KVOperation, ...]
+    batch_id: str = ""
+    created_at: float = field(default_factory=time.time)
+
+    @staticmethod
+    def new(operations: Iterable[KVOperation]) -> "OperationBatch":
+        from rabia_tpu.core.types import fast_uuid4
+
+        return OperationBatch(tuple(operations), batch_id=str(fast_uuid4()))
+
+    def size(self) -> int:
+        return len(self.operations)
+
+    def has_write_operations(self) -> bool:
+        return any(op.is_write for op in self.operations)
+
+    def is_read_only(self) -> bool:
+        return not self.has_write_operations()
+
+    def affected_keys(self) -> list[str]:
+        return [op.key for op in self.operations]
+
+
+@dataclass(frozen=True)
+class BatchResult:
+    """Outcome of one :class:`OperationBatch` (operations.rs:214-262)."""
+
+    batch_id: str
+    results: tuple[KVResult, ...]
+    success_count: int
+    failure_count: int
+    execution_time_ms: float
+
+    @staticmethod
+    def new(
+        batch_id: str,
+        results: Iterable[KVResult],
+        execution_time_ms: float,
+    ) -> "BatchResult":
+        rs = tuple(results)
+        ok = sum(1 for r in rs if r.ok)
+        return BatchResult(batch_id, rs, ok, len(rs) - ok, execution_time_ms)
+
+    def all_succeeded(self) -> bool:
+        return self.failure_count == 0
+
+    def has_failures(self) -> bool:
+        return self.failure_count > 0
+
+    def success_rate(self) -> float:
+        """Percentage of successful operations (0.0 for an empty batch)."""
+        if not self.results:
+            return 0.0
+        return 100.0 * self.success_count / len(self.results)
+
+
 class StoreErrorKind(enum.Enum):
     """Error taxonomy (operations.rs:96-167)."""
 
@@ -548,6 +609,15 @@ class KVStore:
             except StoreError as e:
                 out.append(KVResult.err(str(e)))
         return out
+
+    def execute_batch(self, batch: OperationBatch) -> BatchResult:
+        """Apply a typed :class:`OperationBatch` and report per-op results
+        with success counts and execution time (operations.rs:214-262)."""
+        t0 = time.perf_counter()
+        results = self.apply_operations(batch.operations)
+        return BatchResult.new(
+            batch.batch_id, results, (time.perf_counter() - t0) * 1000.0
+        )
 
     # -- snapshots (store.rs:350-412) ----------------------------------------
 

@@ -45,6 +45,9 @@ class PerformanceReport:
     failed_batches: int = 0
     elapsed: float = 0.0
     latencies: list[float] = field(default_factory=list)
+    # MEASURED process peak RSS at collection time — the reference reports
+    # a hard-coded per-node constant here (scenarios.rs:276-283)
+    memory_usage_mb: float = 0.0
 
     @property
     def throughput_ops(self) -> float:
@@ -75,7 +78,7 @@ class PerformanceReport:
             f"batches in {self.elapsed:.2f}s "
             f"({self.throughput_ops:.1f} batches/s), "
             f"latency p50={self.p50*1000:.1f}ms p95={self.p95*1000:.1f}ms "
-            f"p99={self.p99*1000:.1f}ms"
+            f"p99={self.p99*1000:.1f}ms, rss={self.memory_usage_mb:.0f}MB"
         )
 
 
@@ -126,6 +129,17 @@ class PerformanceBenchmark(TestCluster):
             await asyncio.sleep(interval)
         await asyncio.gather(*pending, return_exceptions=True)
         rep.elapsed = time.time() - t0
+        try:
+            import resource
+            import sys as _sys
+
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            # ru_maxrss is KILOBYTES on Linux but BYTES on macOS
+            rep.memory_usage_mb = rss / (
+                1024.0 * 1024.0 if _sys.platform == "darwin" else 1024.0
+            )
+        except Exception:
+            pass  # non-POSIX: leave 0.0
         return rep
 
 

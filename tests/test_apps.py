@@ -388,3 +388,43 @@ class TestShardedKVCluster:
 
 async def _set_via(svc, key):
     return await svc.set(key, f"v-{key}")
+
+
+class TestOperationBatch:
+    """OperationBatch/BatchResult (operations.rs:169-262 parity)."""
+
+    def test_batch_introspection(self):
+        from rabia_tpu.apps import KVOperation, OperationBatch
+
+        b = OperationBatch.new(
+            [KVOperation.set("a", "1"), KVOperation.get("b"),
+             KVOperation.delete("c")]
+        )
+        assert b.size() == 3
+        assert b.has_write_operations() and not b.is_read_only()
+        assert b.affected_keys() == ["a", "b", "c"]
+        assert b.batch_id  # unique id assigned
+        ro = OperationBatch.new([KVOperation.get("a"), KVOperation.exists("b")])
+        assert ro.is_read_only()
+
+    def test_execute_batch_reports_outcomes(self):
+        from rabia_tpu.apps import KVOperation, KVStore, OperationBatch
+
+        store = KVStore()
+        batch = OperationBatch.new(
+            [KVOperation.set("k", "v"), KVOperation.get("k"),
+             KVOperation.get("missing")]
+        )
+        res = store.execute_batch(batch)
+        assert res.batch_id == batch.batch_id
+        assert (res.success_count, res.failure_count) == (2, 1)
+        assert res.has_failures() and not res.all_succeeded()
+        assert abs(res.success_rate() - 200 / 3) < 1e-9
+        assert res.execution_time_ms >= 0
+        assert res.results[1].value == "v"
+
+    def test_empty_batch_success_rate_zero(self):
+        from rabia_tpu.apps import KVStore, OperationBatch
+
+        res = KVStore().execute_batch(OperationBatch.new([]))
+        assert res.success_rate() == 0.0 and res.all_succeeded()
