@@ -3,9 +3,10 @@
 The fixed-seed suites (tests/test_kernel.py, tests/test_invariants.py)
 pin the vectorized kernel to the scalar weak-MVC oracle on a handful of
 schedules; this script keeps drawing NEW random schedules until a time
-budget expires — random cluster sizes, loss rates, crash masks, and
-initial votes (including V?) — and fails loudly with the repro seed on
-the first divergence. Two gates per trial:
+budget expires — random loss rates, crash masks, and V0/V1 initial
+votes (V? is never a valid round-1 input; it arises only from tallies)
+— and fails loudly with the repro seed on the first divergence. Two
+gates per trial:
 
 1. step-for-step decision identity between ``ClusterKernel.round_step``
    and one ``WeakMVCOracle`` per shard under the SAME delivery masks and

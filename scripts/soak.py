@@ -22,6 +22,20 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def _probe_values(stores, R: int, S: int):
+    """Replica-by-replica values at three probe shards: (converged, vals).
+    Convergence = every replica holds the same non-None probe values."""
+    probe = (0, min(7, S - 1), min(19, S - 1))
+    vals = []
+    for r in range(R):
+        row = []
+        for s in probe:
+            res = stores[r][s].store.get(f"s{s}")
+            row.append(res.value if res else None)
+        vals.append(tuple(row))
+    return (len(set(vals)) == 1 and vals[0][0] is not None), vals
+
+
 async def soak(seconds: float, shards: int, seed: int, backend: str = "host") -> int:
     import numpy as np
 
@@ -160,15 +174,8 @@ async def soak(seconds: float, shards: int, seed: int, backend: str = "host") ->
         ok = False
         for _ in range(600):
             await asyncio.sleep(0.01)
-            vals = [
-                tuple(
-                    stores[r][s].store.get(f"s{s}").value
-                    for s in (0, min(7, S - 1), min(19, S - 1))
-                )
-                for r in range(R)
-            ]
-            if len(set(vals)) == 1 and vals[0][0] is not None:
-                ok = True
+            ok, vals = _probe_values(stores, R, S)
+            if ok:
                 break
         if ok:
             print("soak OK: all replicas convergent")
@@ -263,6 +270,190 @@ def soak_mesh(seconds: float, shards: int, seed: int) -> int:
     return 0
 
 
+async def soak_tcp(seconds: float, shards: int, seed: int) -> int:
+    """Chaos soak over REAL sockets with FULL replica restarts.
+
+    The harshest path in the framework: a killed replica's engine task is
+    cancelled and its native C++ transport closed outright; after a
+    pause it comes back as a NEW engine + transport on a FRESH port,
+    resumes from its persistence directory, and the survivors re-peer to
+    the new address live (native add_peer/remove_peer — the reference's
+    dynamic-topology arm, tcp_networking.rs:20-43, under repetition).
+    Exits nonzero if the cluster fails to reconverge after the final
+    restart."""
+    import tempfile
+
+    from rabia_tpu.apps import make_sharded_kv
+    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.core.blocks import build_block
+    from rabia_tpu.core.config import RabiaConfig, TcpNetworkConfig
+    from rabia_tpu.core.network import ClusterConfig
+    from rabia_tpu.core.types import NodeId
+    from rabia_tpu.engine import RabiaEngine
+    from rabia_tpu.net.tcp import TcpNetwork
+    from rabia_tpu.persistence import FileSystemPersistence
+
+    S, R = shards, 3
+    rng = random.Random(seed)
+    ids = [NodeId.from_int(i + 1) for i in range(R)]
+    # barrier_stride=1: restart taint covers only truly-opened slots, so
+    # a restarted replica rejoins without waiting out wide taint windows
+    cfg = RabiaConfig(
+        phase_timeout=0.3,
+        heartbeat_interval=0.1,
+        round_interval=0.0005,
+        barrier_stride=1,
+    ).with_kernel(num_shards=S, shard_pad_multiple=S)
+    tmp = tempfile.TemporaryDirectory()
+    persist = [FileSystemPersistence(f"{tmp.name}/n{i}") for i in range(R)]
+    stores: list = [None] * R
+    nets: list = [None] * R
+    engines: list = [None] * R
+    tasks: list = [None] * R
+
+    def spawn(i: int) -> None:
+        sm, machines = make_sharded_kv(S)
+        stores[i] = machines
+        nets[i] = TcpNetwork(ids[i], TcpNetworkConfig(bind_port=0))
+        for j in range(R):
+            if j != i and nets[j] is not None:
+                nets[i].add_peer(ids[j], "127.0.0.1", nets[j].port)
+                # survivors re-peer to THIS node's fresh port
+                try:
+                    nets[j].remove_peer(ids[i])
+                except Exception:
+                    pass
+                nets[j].add_peer(ids[i], "127.0.0.1", nets[i].port)
+        engines[i] = RabiaEngine(
+            ClusterConfig.new(ids[i], ids),
+            sm,
+            nets[i],
+            persistence=persist[i],
+            config=cfg,
+        )
+        tasks[i] = asyncio.ensure_future(engines[i].run())
+
+    for i in range(R):
+        spawn(i)
+    for _ in range(500):
+        await asyncio.sleep(0.01)
+        sts = [await e.get_statistics() for e in engines]
+        if all(s.has_quorum for s in sts):
+            break
+    else:
+        print("FAIL: quorum never formed over TCP")
+        return 1
+
+    down: list = []  # at most one (f=1 of 3)
+    stop_at = time.perf_counter() + seconds
+    waves = 0
+    restarts = 0
+
+    async def chaos() -> None:
+        nonlocal restarts
+        while time.perf_counter() < stop_at:
+            await asyncio.sleep(rng.uniform(2.5, 5.0))
+            if down:
+                i = down.pop()
+                spawn(i)
+                restarts += 1
+                print(f"[chaos] restart replica {i} on port {nets[i].port}")
+            else:
+                i = rng.randrange(R)
+                down.append(i)
+                tasks[i].cancel()
+                await asyncio.gather(tasks[i], return_exceptions=True)
+                await nets[i].close()
+                print(f"[chaos] kill replica {i} (task cancelled, socket closed)")
+
+    async def pump() -> None:
+        nonlocal waves
+        ctr = 0
+        while time.perf_counter() < stop_at:
+            futs = []
+            for i, e in enumerate(engines):
+                if i in down:
+                    continue
+                try:
+                    mine = e.proposer_eligible_shards()
+                    if len(mine):
+                        futs.append(
+                            await e.submit_block(
+                                build_block(
+                                    mine,
+                                    [
+                                        [encode_set_bin(f"s{int(s)}", f"v{ctr}")]
+                                        for s in mine
+                                    ],
+                                )
+                            )
+                        )
+                except Exception:
+                    pass  # racing a mid-kill engine is expected chaos
+            if futs:
+                # SHORT per-wave wait: a future submitted to an engine
+                # chaos kills mid-wave can never resolve (the restart is
+                # a NEW engine object) — blocking on it would freeze the
+                # pump and silently gut the load the soak claims to apply
+                done, _pending = await asyncio.wait(futs, timeout=1.5)
+                if done:
+                    for f in done:
+                        f.exception()  # retrieve, chaos rejections expected
+                    waves += 1
+            ctr += 1
+            await asyncio.sleep(0.03)
+
+    ct = asyncio.ensure_future(chaos())
+    await pump()
+    ct.cancel()
+    await asyncio.gather(ct, return_exceptions=True)
+    if down:
+        spawn(down.pop())
+        restarts += 1
+    # convergence: all replicas settle on equal committed counts + values
+    committed = []
+    for _ in range(45):
+        await asyncio.sleep(1.0)
+        sts = [await e.get_statistics() for e in engines]
+        committed = [s.committed_slots for s in sts]
+        if max(committed) - min(committed) == 0:
+            break
+    print(
+        f"waves={waves}, restarts={restarts}, committed per replica: {committed}"
+    )
+    rc = 0
+    ok = False
+    for _ in range(600):
+        await asyncio.sleep(0.01)
+        ok, vals = _probe_values(stores, R, S)
+        if ok:
+            break
+    if ok:
+        print("tcp soak OK: replicas convergent across restarts")
+    else:
+        print(f"FAIL: divergent values {vals}")
+        rc = 1
+    for e in engines:
+        try:
+            await asyncio.wait_for(e.shutdown(), 5)
+        except Exception:
+            pass
+    for t in tasks:
+        if t is not None:
+            t.cancel()
+    await asyncio.gather(
+        *[t for t in tasks if t is not None], return_exceptions=True
+    )
+    for n in nets:
+        if n is not None:
+            try:
+                await n.close()
+            except Exception:
+                pass
+    tmp.cleanup()
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=60.0)
@@ -276,13 +467,22 @@ def main() -> int:
         "--plane", choices=("transport", "mesh"), default="transport",
         help="transport cluster (RabiaEngine) or device plane (MeshEngine)",
     )
+    ap.add_argument(
+        "--transport", choices=("mem", "tcp"), default="mem",
+        help="transport plane's wire: in-memory hub, or native TCP with "
+        "full replica restarts (kill + fresh port + live re-peering)",
+    )
     args = ap.parse_args()
+    if args.plane == "mesh" and args.transport == "tcp":
+        ap.error("--transport tcp applies to the transport plane only")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     logging.disable(logging.WARNING)
     if args.plane == "mesh":
         return soak_mesh(args.seconds, args.shards, args.seed)
+    if args.transport == "tcp":
+        return asyncio.run(soak_tcp(args.seconds, args.shards, args.seed))
     return asyncio.run(soak(args.seconds, args.shards, args.seed, args.backend))
 
 
