@@ -194,7 +194,19 @@ def main() -> int:
         print(f"[{name}]")
         for k, v in results[name].items():
             print(f"  {k:40s} {v:>14,.1f}" if isinstance(v, float) else f"  {k:40s} {v:>14,}")
-    Path("benchmarks/results.json").write_text(json.dumps(results, indent=2))
+    # MERGE into the recorded file — results.json carries every round's
+    # engine/kernel/mesh entries; overwriting it would destroy them.
+    # Per-suite deep merge: refresh measured keys, keep annotations other
+    # writers (or hands) added under the same suite name.
+    path = Path(__file__).parent / "results.json"
+    merged = json.loads(path.read_text()) if path.exists() else {}
+    for name, vals in results.items():
+        prior = merged.get(name)
+        if isinstance(prior, dict):
+            prior.update(vals)
+        else:
+            merged[name] = vals
+    path.write_text(json.dumps(merged, indent=1))
     return 0
 
 
