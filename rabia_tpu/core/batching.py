@@ -16,8 +16,8 @@ from __future__ import annotations
 
 import asyncio
 import time
-from dataclasses import dataclass, field
-from typing import Awaitable, Callable, Optional, Sequence
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional
 
 from rabia_tpu.core.config import BatchConfig
 from rabia_tpu.core.types import Command, CommandBatch, ShardId

@@ -26,7 +26,6 @@ import numpy as np
 from rabia_tpu.core.blocks import PayloadBlock
 from rabia_tpu.core.types import (
     BatchId,
-    Command,
     CommandBatch,
     NodeId,
     PhaseId,

@@ -17,7 +17,7 @@ from __future__ import annotations
 import abc
 import enum
 from dataclasses import dataclass, field
-from typing import Awaitable, Callable, Optional
+from typing import Optional
 
 from rabia_tpu.core.types import NodeId, quorum_size, sorted_nodes
 

@@ -37,7 +37,6 @@ from rabia_tpu.core.config import SerializationConfig
 from rabia_tpu.core.errors import SerializationError
 from rabia_tpu.core.messages import (
     Decision,
-    DecisionEntry,
     HeartBeat,
     MessageType,
     NewBatch,
@@ -47,7 +46,6 @@ from rabia_tpu.core.messages import (
     QuorumNotification,
     SyncRequest,
     SyncResponse,
-    VoteEntry,
     VoteRound1,
     VoteRound2,
 )

@@ -29,7 +29,7 @@ import contextlib
 import logging
 import time
 from dataclasses import dataclass, field
-from typing import Iterator, Optional
+from typing import Iterator
 
 logger = logging.getLogger("rabia_tpu.tracing")
 

@@ -16,7 +16,7 @@ rotates to a live proposer — no election protocol, no terms.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Iterable, Optional
 
 from rabia_tpu.core.types import NodeId, sorted_nodes

@@ -13,7 +13,7 @@ errors with "No messages available" after a hard-coded 10ms
 from __future__ import annotations
 
 import asyncio
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 from rabia_tpu.core.errors import NetworkError, TimeoutError_

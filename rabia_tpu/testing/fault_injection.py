@@ -19,7 +19,7 @@ import asyncio
 import enum
 import time
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Optional
 
 from rabia_tpu.core.config import RabiaConfig
 from rabia_tpu.core.types import CommandBatch

@@ -13,7 +13,6 @@ from __future__ import annotations
 import asyncio
 import time
 from dataclasses import dataclass, field
-from typing import Optional
 
 from rabia_tpu.core.types import CommandBatch
 from rabia_tpu.net import NetworkConditions
