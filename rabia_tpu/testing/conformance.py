@@ -1,0 +1,131 @@
+"""Engine-level plane-conformance gate (SURVEY.md §7.4.6).
+
+One submission schedule driven through BOTH deployment planes — a
+transport-engine cluster over the in-memory hub, then a MeshEngine with
+MeshPhaseKernel as its consensus core — must produce bit-identical
+per-shard decisions, successful client futures, and byte-identical
+replica state. Shared by the fixed gate
+(tests/test_mesh_engine.py::TestMeshEngineConformance) and the
+randomized fuzz (scripts/fuzz_conformance.py --planes), so the two
+checks can never drift apart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Sequence
+
+
+async def run_schedule_on_both_planes(
+    schedule: Sequence[dict[int, list[str]]],
+    n_shards: int,
+    n_replicas: int = 3,
+    *,
+    tag: str = "",
+) -> None:
+    """Raise AssertionError (prefixed with ``tag``) on any divergence.
+
+    ``schedule``: per wave, {shard: [command strings]} — submitted in
+    wave order on both planes. Fault-free only (faults are masked
+    differently per plane; they have their own gates).
+    """
+    from rabia_tpu.core.config import RabiaConfig
+    from rabia_tpu.core.network import ClusterConfig
+    from rabia_tpu.core.state_machine import InMemoryStateMachine
+    from rabia_tpu.core.types import CommandBatch, NodeId
+    from rabia_tpu.engine import RabiaEngine
+    from rabia_tpu.net import InMemoryHub
+    from rabia_tpu.parallel import MeshEngine, make_mesh
+
+    # -- transport plane ----------------------------------------------------
+    # phase_timeout is a retransmit/lag timer only — the lossless hub never
+    # needs it for fault-free progress, and a generous value keeps a loaded
+    # host from tripping the mild-lag snapshot sync (which fails the
+    # submitter future by design: engine._settle_from_ledger)
+    config = RabiaConfig(
+        phase_timeout=3.0,
+        heartbeat_interval=0.05,
+        round_interval=0.002,
+    ).with_kernel(num_shards=n_shards, shard_pad_multiple=2)
+    hub = InMemoryHub()
+    nodes = [NodeId.from_int(i + 1) for i in range(n_replicas)]
+    engines, sms, tasks = [], [], []
+    for node in nodes:
+        sm = InMemoryStateMachine()
+        eng = RabiaEngine(
+            ClusterConfig.new(node, nodes), sm, hub.register(node),
+            config=config,
+        )
+        engines.append(eng)
+        sms.append(sm)
+        tasks.append(asyncio.ensure_future(eng.run()))
+    try:
+        quorum = False
+        for _ in range(300):
+            await asyncio.sleep(0.01)
+            if all(
+                [(await e.get_statistics()).has_quorum for e in engines]
+            ):
+                quorum = True
+                break
+        assert quorum, f"{tag}: transport cluster never formed quorum"
+        for w, wave in enumerate(schedule):
+            futs = {
+                s: await engines[0].submit_batch(
+                    CommandBatch.new(list(cmds)), shard=s
+                )
+                for s, cmds in wave.items()
+            }
+            for s, f in futs.items():
+                got = await asyncio.wait_for(f, 15.0)
+                want = [b"OK"] * len(wave[s])
+                assert got == want, (
+                    f"{tag}: transport wave {w} shard {s}: {got!r}"
+                )
+        transport_decisions = {
+            s: {
+                slot: int(rec.value)
+                for slot, rec in engines[0].rt.shards[s].decisions.items()
+            }
+            for s in range(n_shards)
+        }
+        # peers apply asynchronously after the submitter settles — poll
+        # for replica convergence before snapshotting
+        snap = sms[0].create_snapshot().data
+        for _ in range(500):
+            if all(sm.create_snapshot().data == snap for sm in sms):
+                break
+            await asyncio.sleep(0.01)
+        assert all(sm.create_snapshot().data == snap for sm in sms), (
+            f"{tag}: transport replicas diverged"
+        )
+    finally:
+        for e in engines:
+            await e.shutdown()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    # -- device plane -------------------------------------------------------
+    mesh_eng = MeshEngine(
+        InMemoryStateMachine, n_shards=n_shards, n_replicas=n_replicas,
+        mesh=make_mesh(), window=2,
+    )
+    for w, wave in enumerate(schedule):
+        futs = {s: mesh_eng.submit(list(cmds), s) for s, cmds in wave.items()}
+        mesh_eng.flush()
+        for s, f in futs.items():
+            got = f.result()
+            want = [b"OK"] * len(wave[s])
+            assert got == want, f"{tag}: mesh wave {w} shard {s}: {got!r}"
+    for s in range(n_shards):
+        mesh_d = {
+            slot: v for slot, (v, _b) in mesh_eng.decisions_for(s).items()
+        }
+        assert mesh_d == transport_decisions[s], (
+            f"{tag}: shard {s} decisions diverge across planes "
+            f"(mesh={mesh_d}, transport={transport_decisions[s]})"
+        )
+    assert all(
+        sm.create_snapshot().data == snap for sm in mesh_eng.sms
+    ), f"{tag}: replica state diverges across planes"

@@ -126,10 +126,47 @@ def _trial_fused(get_kernel, seed: int) -> None:
         )
 
 
+async def _trial_planes(seed: int) -> None:
+    """Engine-level differential: one RANDOM fault-free submission
+    schedule through BOTH deployment planes, via the shared gate
+    (rabia_tpu.testing.conformance — the same code path as the fixed
+    test, so the two checks cannot drift)."""
+    from rabia_tpu.testing.conformance import run_schedule_on_both_planes
+
+    rng = np.random.default_rng(seed + 77)
+    S = int(rng.choice([2, 3]))
+    waves = int(rng.integers(2, 5))
+    # random schedule: each wave covers a random non-empty shard subset
+    # with 1-2 commands per covered shard
+    schedule = []
+    for w in range(waves):
+        covered = sorted(
+            rng.choice(S, size=int(rng.integers(1, S + 1)), replace=False)
+        )
+        schedule.append(
+            {
+                int(s): [
+                    f"SET w{w}s{s}k{j} v{int(rng.integers(0, 9))}"
+                    for j in range(int(rng.integers(1, 3)))
+                ]
+                for s in covered
+            }
+        )
+    await run_schedule_on_both_planes(
+        schedule, n_shards=S, n_replicas=3, tag=f"planes seed={seed}"
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=30.0)
     ap.add_argument("--base-seed", type=int, default=0)
+    ap.add_argument(
+        "--planes", type=int, default=0,
+        help="additionally run N engine-level plane-differential trials "
+        "(random schedules through the transport engine AND MeshEngine; "
+        "~4s each)",
+    )
     args = ap.parse_args()
 
     get_kernel = _kernels()
@@ -147,10 +184,22 @@ def main() -> int:
         _trial_stepwise(get_kernel, seed)
         _trial_fused(get_kernel, seed)
         trial += 1
+    plane_trials = 0
+    if args.planes > 0:
+        import asyncio
+
+        for i in range(args.planes):
+            asyncio.run(_trial_planes(args.base_seed + i))
+            plane_trials += 1
+    extra = (
+        f"; {plane_trials} plane-differential schedules identical"
+        if plane_trials
+        else ""
+    )
     print(
         f"fuzz OK: {trial} random schedules conformant "
         f"(kernel==oracle stepwise; fused==scan), no divergence "
-        f"(warmup {warm_s:.0f}s excluded from budget)"
+        f"(warmup {warm_s:.0f}s excluded from budget){extra}"
     )
     return 0
 

@@ -10,14 +10,12 @@ and byte-identical replica state snapshots.
 
 from __future__ import annotations
 
-import asyncio
-
 import numpy as np
 import pytest
 
 from rabia_tpu.core.errors import RabiaError
 from rabia_tpu.core.state_machine import InMemoryStateMachine
-from rabia_tpu.core.types import V1, CommandBatch, NodeId
+from rabia_tpu.core.types import V1
 from rabia_tpu.parallel import MeshEngine, make_mesh
 
 
@@ -403,111 +401,19 @@ class TestMeshEngineConformance:
     async def test_decisions_match_transport_engine(self):
         """Engine-level §7.4.6 gate: same schedule, same decisions, same
         applied sequence, byte-identical state — device plane vs transport
-        plane."""
-        from rabia_tpu.core.config import RabiaConfig
-        from rabia_tpu.core.network import ClusterConfig
-        from rabia_tpu.engine import RabiaEngine
-        from rabia_tpu.net import InMemoryHub
+        plane. The gate itself lives in rabia_tpu.testing.conformance and
+        is ALSO driven with random schedules by
+        scripts/fuzz_conformance.py --planes (shared code: the fixed and
+        randomized checks cannot drift)."""
+        from rabia_tpu.testing.conformance import run_schedule_on_both_planes
 
-        n_shards, n_replicas, waves = 2, 3, 4
+        n_shards, waves = 2, 4
         schedule = [
             {s: [f"SET w{w}s{s} val{w}"] for s in range(n_shards)}
             for w in range(waves)
         ]
-
-        # -- transport plane ------------------------------------------------
-        # phase_timeout is a retransmit/lag timer only — the lossless hub
-        # never needs it for fault-free progress, and a generous value keeps
-        # a slow full-suite run from tripping the mild-lag snapshot sync
-        # (which fails the submitter future by design: engine.py
-        # _settle_from_ledger)
-        config = RabiaConfig(
-            phase_timeout=3.0,
-            heartbeat_interval=0.05,
-            round_interval=0.002,
-        ).with_kernel(num_shards=n_shards, shard_pad_multiple=2)
-        hub = InMemoryHub()
-        nodes = [NodeId.from_int(i + 1) for i in range(n_replicas)]
-        engines, sms, tasks = [], [], []
-        for node in nodes:
-            sm = InMemoryStateMachine()
-            eng = RabiaEngine(
-                ClusterConfig.new(node, nodes),
-                sm,
-                hub.register(node),
-                config=config,
-            )
-            engines.append(eng)
-            sms.append(sm)
-            tasks.append(asyncio.ensure_future(eng.run()))
-        try:
-            for _ in range(200):
-                await asyncio.sleep(0.01)
-                stats = [await e.get_statistics() for e in engines]
-                if all(s.has_quorum for s in stats):
-                    break
-            for wave in schedule:
-                futs = [
-                    await engines[0].submit_batch(
-                        CommandBatch.new(list(cmds)), shard=s
-                    )
-                    for s, cmds in wave.items()
-                ]
-                for f in futs:
-                    await asyncio.wait_for(f, 10.0)
-            transport_decisions = {
-                s: {
-                    slot: int(rec.value)
-                    for slot, rec in engines[0].rt.shards[s].decisions.items()
-                }
-                for s in range(n_shards)
-            }
-            # peers apply asynchronously after the submitter settles —
-            # poll for replica convergence before snapshotting
-            transport_snap = sms[0].create_snapshot().data
-            for _ in range(500):
-                if all(
-                    sm.create_snapshot().data == transport_snap for sm in sms
-                ):
-                    break
-                await asyncio.sleep(0.01)
-            assert all(
-                sm.create_snapshot().data == transport_snap for sm in sms
-            )
-        finally:
-            for e in engines:
-                await e.shutdown()
-            for t in tasks:
-                t.cancel()
-            await asyncio.gather(*tasks, return_exceptions=True)
-
-        # -- device plane ---------------------------------------------------
-        # R=3 doesn't divide the 4-wide replica axis: use a shard-axis
-        # mesh (replicas vmapped within each device)
-        mesh_eng = MeshEngine(
-            InMemoryStateMachine,
-            n_shards=n_shards,
-            n_replicas=n_replicas,
-            mesh=make_mesh(),  # 8x1: all devices on the shard axis
-            window=2,
-        )
-        for wave in schedule:
-            futs = {
-                s: mesh_eng.submit(list(cmds), s) for s, cmds in wave.items()
-            }
-            mesh_eng.flush()
-            assert all(f.result() == [b"OK"] for f in futs.values())
-
-        for s in range(n_shards):
-            mesh_d = {
-                slot: v for slot, (v, _b) in mesh_eng.decisions_for(s).items()
-            }
-            assert mesh_d == transport_decisions[s], (
-                f"shard {s}: device-plane decisions diverge from transport"
-            )
-        mesh_snaps = [sm.create_snapshot().data for sm in mesh_eng.sms]
-        assert all(s == transport_snap for s in mesh_snaps), (
-            "replica state diverges across planes"
+        await run_schedule_on_both_planes(
+            schedule, n_shards=n_shards, n_replicas=3, tag="fixed-gate"
         )
 
 
