@@ -1,0 +1,200 @@
+"""Roofline profiling for the fused fault-free window kernel.
+
+Measures achieved HBM bytes/s for the fused-window variants so
+"bandwidth-bound" is a measurement, not a docstring.
+
+Methodology (this matters on the tunneled chip): a single dispatch +
+sync pays the ~100ms host<->device tunnel round-trip, which buries any
+sub-10ms kernel — round 3's 0.98B dec/s "kernel" number was actually
+the tunnel. Here each variant is timed as a deep chain of N dispatches
+over alternating input buffers with ONE tiny readback at the end (the
+device queue executes in order, so forcing the last output forces all
+N), matching how the production engine pipelines windows
+(speculative next-window dispatch before readback,
+parallel/mesh_engine.py). Per-dispatch time = chain time / N, best of
+3 chains. A per-T sweep separates the fixed dispatch overhead
+(~0.4-0.5ms/dispatch through the tunnel) from the marginal byte rate.
+
+Bytes accounting per decision (T*S decisions): votes R bytes in,
+decision 1 byte out, phase 4 bytes out when emitted. Peak HBM for
+TPU v5e is ~819 GB/s.
+
+Writes the table into benchmarks/results.json under "roofline_r04"
+and prints it. Run on the TPU host: python benchmarks/roofline.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rabia_tpu.core.types import V1
+from rabia_tpu.kernel import fused_window
+
+PEAK_HBM_GBPS = 819.0  # TPU v5e spec sheet number
+
+
+def _chain_time(fn, inputs, chain: int = 128, reps: int = 3) -> float:
+    """Best per-dispatch seconds over `reps` chains of `chain` dispatches.
+
+    `inputs` is a list of distinct input tuples cycled through so no
+    caching layer can collapse the chain; the single trailing readback
+    forces completion of the whole in-order device queue.
+    """
+    out = fn(*inputs[0])
+    first = out[0] if isinstance(out, tuple) else out
+    np.asarray(first[0, :8])  # compile + settle
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for i in range(chain):
+            out = fn(*inputs[i % len(inputs)])
+        first = out[0] if isinstance(out, tuple) else out
+        np.asarray(first[0, :8])
+        best = min(best, (time.perf_counter() - t0) / chain)
+    return best
+
+
+def run(T: int = 8192, S: int = 4096, R: int = 5, chain: int = 128) -> dict:
+    quorum = R // 2 + 1
+    votes = jnp.full((T, S, R), V1, jnp.int8)
+    alive = jnp.ones((S, R), bool)
+    votes_rm = [
+        jnp.full((R, T, S), V1, jnp.int8),
+        (jnp.ones((R, T, S), jnp.int8) * jnp.int8(V1)),
+    ]
+    for v in votes_rm:
+        v.block_until_ready()
+    alive_rm = jnp.ones((R, S), bool)
+    dec_b, ph_b, votes_b = T * S, 4 * T * S, T * S * R
+
+    rows = {}
+
+    def row(name, secs, bytes_moved):
+        rows[name] = {
+            "ms_per_dispatch": round(secs * 1e3, 3),
+            "decisions_per_sec": round(T * S / secs, 1),
+            "GBps": round(bytes_moved / secs / 1e9, 1),
+            "pct_peak_hbm": round(
+                100 * bytes_moved / secs / 1e9 / PEAK_HBM_GBPS, 1
+            ),
+            "bytes_moved": bytes_moved,
+        }
+
+    t = _chain_time(
+        lambda v: fused_window.pallas_window_rmajor(v, alive_rm, quorum),
+        [(v,) for v in votes_rm],
+        chain,
+    )
+    row("pallas_rmajor", t, votes_b + dec_b + ph_b)
+
+    t = _chain_time(
+        lambda v: fused_window.pallas_window_rmajor(
+            v, alive_rm, quorum, want_phase=False
+        ),
+        [(v,) for v in votes_rm],
+        chain,
+    )
+    row("pallas_rmajor_nophase", t, votes_b + dec_b)
+
+    t = _chain_time(
+        lambda v: fused_window.closed_form_window_rmajor(v, alive_rm, quorum),
+        [(v,) for v in votes_rm],
+        chain,
+    )
+    row("xla_rmajor", t, votes_b + dec_b + ph_b)
+
+    t = _chain_time(
+        lambda: fused_window.pallas_window(votes, alive, quorum), [()], chain
+    )
+    row("pallas_tsr_api", t, votes_b + dec_b + ph_b)
+
+    t = _chain_time(
+        lambda: fused_window.closed_form_window(votes, alive, quorum),
+        [()],
+        chain,
+    )
+    row("xla_tsr_api", t, votes_b + dec_b + ph_b)
+
+    return {
+        "config": {
+            "T": T,
+            "S": S,
+            "R": R,
+            "chain": chain,
+            "backend": jax.default_backend(),
+        },
+        "methodology": "chained dispatch (pipelined windows), one readback",
+        "peak_hbm_GBps": PEAK_HBM_GBPS,
+        "rows": rows,
+    }
+
+
+def t_sweep(S: int = 4096, R: int = 5) -> dict:
+    """Per-dispatch time vs window depth T: the intercept is the tunnel
+    dispatch overhead, the slope is the marginal byte rate."""
+    quorum = R // 2 + 1
+    alive_rm = jnp.ones((R, S), bool)
+    out = {}
+    prev = None
+    for T in (1024, 4096, 16384, 65536):
+        votes_rm = [
+            jnp.full((R, T, S), V1, jnp.int8),
+            (jnp.ones((R, T, S), jnp.int8) * jnp.int8(V1)),
+        ]
+        for v in votes_rm:
+            v.block_until_ready()
+        t = _chain_time(
+            lambda v: fused_window.pallas_window_rmajor(v, alive_rm, quorum),
+            [(v,) for v in votes_rm],
+            chain=96,
+        )
+        entry = {
+            "ms_per_dispatch": round(t * 1e3, 3),
+            "decisions_per_sec": round(T * S / t, 1),
+            "GBps": round((R + 5) * T * S / t / 1e9, 1),
+        }
+        if prev is not None:
+            dT = T - prev[0]
+            dt = t - prev[1]
+            if dt > 0:
+                entry["marginal_GBps"] = round(
+                    (R + 5) * dT * S / dt / 1e9, 1
+                )
+        prev = (T, t)
+        out[f"T{T}"] = entry
+    return out
+
+
+def main() -> None:
+    out = run(
+        T=int(os.environ.get("ROOFLINE_T", 8192)),
+        S=int(os.environ.get("ROOFLINE_S", 4096)),
+        R=int(os.environ.get("ROOFLINE_R", 5)),
+    )
+    out["t_sweep"] = t_sweep(
+        S=int(os.environ.get("ROOFLINE_S", 4096)),
+        R=int(os.environ.get("ROOFLINE_R", 5)),
+    )
+    print(json.dumps(out, indent=1))
+    path = os.path.join(os.path.dirname(__file__), "results.json")
+    try:
+        with open(path) as f:
+            results = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        results = {}
+    results["roofline_r04"] = out
+    with open(path, "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,7 +7,10 @@ and per-replica divergence. Under the conditions ``slot_pipeline``
 actually runs with (FULL delivery, fresh per-slot state, the default
 ``rounds_per_slot=2``), that machinery provably collapses to a closed
 form, which this module evaluates as a single Pallas kernel over the
-``[T, S, R]`` vote tensor — bandwidth-bound instead of scan-latency-bound.
+vote tensor. Measured (not assumed) roofline: the replica-major entry
+streams votes at ~60-75% of peak HBM marginal rate once the per-dispatch
+tunnel overhead is amortized — see docs/PERFORMANCE.md and
+benchmarks/roofline.py for the table and methodology.
 
 Derivation (each step mirrors ``round_step``, phase_driver.py:224-367):
 
@@ -70,10 +73,41 @@ def closed_form_window(
     return dec, ph
 
 
-def _make_kernel(R: int, quorum: int):
+@functools.partial(jax.jit, static_argnames=("quorum", "want_phase"))
+def closed_form_window_rmajor(
+    votes_rm: jnp.ndarray,  # i8[R, T, S] — replica-major planes
+    alive_rm: jnp.ndarray,  # bool[R, S]
+    quorum: int,
+    want_phase: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray] | jnp.ndarray:
+    """The closed form on replica-major votes: every operand is a
+    well-tiled [T, S] plane, so no i8 minor-axis relayout is needed.
+    Bit-identical to ``closed_form_window(transpose(votes_rm,(1,2,0)))``.
+    ``want_phase=False`` returns only the decision plane and the i32
+    phase plane is never materialized.
+    """
+    R = votes_rm.shape[0]
+    T, S = votes_rm.shape[1], votes_rm.shape[2]
+    c1 = jnp.zeros((T, S), I32)
+    c0 = jnp.zeros((T, S), I32)
+    for r in range(R):  # static unroll: R is tiny
+        v = votes_rm[r]
+        a = alive_rm[r][None, :]
+        c1 = c1 + ((v == V1) & a).astype(I32)
+        c0 = c0 + ((v == V0) & a).astype(I32)
+    dec = jnp.where(
+        c1 >= quorum, I8(V1), jnp.where(c0 >= quorum, I8(V0), I8(ABSENT))
+    )
+    if not want_phase:
+        return dec
+    ph = jnp.where(dec != ABSENT, I32(0), I32(-1))
+    return dec, ph
+
+
+def _make_kernel(R: int, quorum: int, want_phase: bool = True):
     """Kernel body closure (R and the quorum are compile-time static)."""
 
-    def kernel(votes_ref, alive_ref, dec_ref, ph_ref):
+    def kernel(votes_ref, alive_ref, dec_ref, ph_ref=None):
         # votes_ref: i8[R, Tb, S] — replica-major so each plane is a
         # contiguous (Tb, S) tile; alive_ref: i8[R, 1, S]. Integer
         # arithmetic with explicit broadcasts throughout — Mosaic rejects
@@ -92,9 +126,10 @@ def _make_kernel(R: int, quorum: int):
             c1 >= quorum, I32(V1), jnp.where(c0 >= quorum, I32(V0), I32(ABSENT))
         )
         dec_ref[:] = dec.astype(I8)
-        ph_ref[:] = jnp.where(dec != ABSENT, I32(0), I32(-1))
+        if want_phase:
+            ph_ref[:] = jnp.where(dec != ABSENT, I32(0), I32(-1))
 
-    return kernel
+    return kernel  # ph_ref defaults to None on the no-phase arity
 
 
 def _pick_block(T: int, S: int, R: int) -> int:
@@ -110,24 +145,44 @@ def _pick_block(T: int, S: int, R: int) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("quorum", "interpret")
+    jax.jit, static_argnames=("quorum", "interpret", "want_phase")
 )
-def pallas_window(
-    votes: jnp.ndarray,  # i8[T, S, R]
-    alive: jnp.ndarray,  # bool[S, R]
+def pallas_window_rmajor(
+    votes_rm: jnp.ndarray,  # i8[R, T, S] — replica-major planes
+    alive_rm: jnp.ndarray,  # bool[R, S]
     quorum: int,
     interpret: bool = False,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """The closed form as one Pallas TPU kernel (grid over slot tiles)."""
+    want_phase: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray] | jnp.ndarray:
+    """The closed form as one Pallas TPU kernel on replica-major votes.
+
+    This is the bandwidth-shaped entry: each replica's votes are a
+    contiguous, well-tiled ``[T, S]`` i8 plane, so the kernel streams
+    them with no minor-axis relayout (the ``[T, S, R]`` layout puts
+    R=5 on the lane axis, and the i8 relayout to fix that dominated
+    the round-3 kernel — see docs/PERFORMANCE.md roofline table).
+
+    ``want_phase=False`` skips the i32 phase plane (4 redundant
+    bytes/decision: in the fault-free closed form the phase is
+    derivable — 0 iff decided) and returns only the decision plane.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    T, S, R = votes.shape
+    R, T, S = votes_rm.shape
     block = _pick_block(T, S, R)
-    votes_t = jnp.transpose(votes, (2, 0, 1))  # [R, T, S]
-    alive_t = jnp.transpose(alive.astype(I8), (1, 0))[:, None, :]  # [R,1,S]
-    dec, ph = pl.pallas_call(
-        _make_kernel(R, quorum),
+    alive_t = alive_rm.astype(I8)[:, None, :]  # [R, 1, S]
+    out_specs = [
+        pl.BlockSpec((block, S), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    ]
+    out_shape = [jax.ShapeDtypeStruct((T, S), I8)]
+    if want_phase:
+        out_specs.append(
+            pl.BlockSpec((block, S), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        )
+        out_shape.append(jax.ShapeDtypeStruct((T, S), I32))
+    out = pl.pallas_call(
+        _make_kernel(R, quorum, want_phase=want_phase),
         grid=(T // block,),
         in_specs=[
             pl.BlockSpec(
@@ -137,14 +192,30 @@ def pallas_window(
                 (R, 1, S), lambda i: (0, 0, 0), memory_space=pltpu.VMEM
             ),
         ],
-        out_specs=[
-            pl.BlockSpec((block, S), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((block, S), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T, S), I8),
-            jax.ShapeDtypeStruct((T, S), I32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
-    )(votes_t, alive_t)
-    return dec, ph
+    )(votes_rm, alive_t)
+    if want_phase:
+        return out[0], out[1]
+    return out[0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("quorum", "interpret")
+)
+def pallas_window(
+    votes: jnp.ndarray,  # i8[T, S, R]
+    alive: jnp.ndarray,  # bool[S, R]
+    quorum: int,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The closed form on the API ``[T, S, R]`` layout: relayouts to
+    replica-major, then runs :func:`pallas_window_rmajor`. Producers
+    that can build votes replica-major should call the rmajor entry
+    directly and skip the relayout."""
+    votes_t = jnp.transpose(votes, (2, 0, 1))  # [R, T, S]
+    alive_t = jnp.transpose(alive, (1, 0))  # [R, S]
+    return pallas_window_rmajor(
+        votes_t, alive_t, quorum, interpret=interpret
+    )

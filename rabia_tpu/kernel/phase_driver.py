@@ -551,6 +551,53 @@ class ClusterKernel:
             )
         return fused_window.closed_form_window(votes, alive, self.quorum)
 
+    def slot_pipeline_fused_rmajor(
+        self,
+        votes_rm: jnp.ndarray,  # i8[R, T, S] — replica-major planes
+        alive_rm: jnp.ndarray,  # bool[R, S] (or broadcastable [R, 1])
+        n_slots: int,
+        use_pallas: Optional[bool] = None,
+        interpret: bool = False,
+        want_phase: bool = True,
+    ):
+        """:meth:`slot_pipeline_fused` on replica-major votes — the
+        bandwidth-shaped entry for producers that build the vote tensor
+        themselves (the mesh engine does). Skipping the ``[T,S,R]`` API
+        layout avoids an i8 minor-axis relayout; ``want_phase=False``
+        additionally skips the redundant i32 phase plane (derivable:
+        0 iff decided). Bit-identical to
+        ``slot_pipeline(transpose(votes_rm, (1,2,0)), ...)`` — pinned in
+        tests/test_kernel.py and scripts/fuzz_conformance.py."""
+        from rabia_tpu.kernel import fused_window
+
+        if votes_rm.shape[1] != n_slots:
+            raise ValueError(
+                f"votes carry {votes_rm.shape[1]} slots, n_slots={n_slots}"
+            )
+        if votes_rm.shape[0] != self.R or votes_rm.shape[2] != self.S:
+            # loud failure on an accidental [T,S,R]-layout pass-through:
+            # R binding to T would statically unroll a T-iteration loop
+            raise ValueError(
+                f"votes_rm is {votes_rm.shape}, expected replica-major "
+                f"[R={self.R}, T={n_slots}, S={self.S}]"
+            )
+        alive_rm = jnp.broadcast_to(alive_rm, (self.R, self.S))
+        if use_pallas is None:
+            use_pallas = (
+                jax.default_backend() == "tpu" and self.S % 128 == 0
+            )
+        if use_pallas or interpret:
+            return fused_window.pallas_window_rmajor(
+                votes_rm,
+                alive_rm,
+                self.quorum,
+                interpret=interpret,
+                want_phase=want_phase,
+            )
+        return fused_window.closed_form_window_rmajor(
+            votes_rm, alive_rm, self.quorum, want_phase=want_phase
+        )
+
 
 # ---------------------------------------------------------------------------
 # Per-node kernel (the host engine's device half)

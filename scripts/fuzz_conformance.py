@@ -124,6 +124,24 @@ def _trial_fused(get_kernel, seed: int) -> None:
         raise AssertionError(
             f"fused divergence: seed={seed} S={S} R={R} T={T}"
         )
+    # replica-major entry (the production path): same schedule through
+    # [R,T,S] votes, with and without the derivable phase plane
+    votes_rm = jnp.transpose(votes, (2, 0, 1))
+    alive_rm = jnp.transpose(alive, (1, 0))
+    d3, p3 = k.slot_pipeline_fused_rmajor(
+        votes_rm, alive_rm, T, use_pallas=False
+    )
+    d4 = k.slot_pipeline_fused_rmajor(
+        votes_rm, alive_rm, T, use_pallas=False, want_phase=False
+    )
+    if not (
+        np.array_equal(np.asarray(d1), np.asarray(d3))
+        and np.array_equal(np.asarray(p1), np.asarray(p3))
+        and np.array_equal(np.asarray(d1), np.asarray(d4))
+    ):
+        raise AssertionError(
+            f"rmajor divergence: seed={seed} S={S} R={R} T={T}"
+        )
 
 
 async def _trial_planes(seed: int) -> None:

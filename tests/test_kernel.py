@@ -120,6 +120,21 @@ class TestFaultFreeKernel:
             d3, p3 = k.slot_pipeline_fused(votes, alive, T, interpret=True)
             assert np.array_equal(np.asarray(d1), np.asarray(d3)), (S, R)
             assert np.array_equal(np.asarray(p1), np.asarray(p3)), (S, R)
+            # replica-major entry (the bandwidth-shaped production path):
+            # same decisions from [R,T,S] votes, with and without the
+            # derivable phase plane, on both the XLA and Pallas paths
+            votes_rm = jnp.transpose(votes, (2, 0, 1))
+            alive_rm = jnp.transpose(alive, (1, 0))
+            for kw in ({"use_pallas": False}, {"interpret": True}):
+                d4, p4 = k.slot_pipeline_fused_rmajor(
+                    votes_rm, alive_rm, T, **kw
+                )
+                assert np.array_equal(np.asarray(d1), np.asarray(d4)), (S, R)
+                assert np.array_equal(np.asarray(p1), np.asarray(p4)), (S, R)
+                d5 = k.slot_pipeline_fused_rmajor(
+                    votes_rm, alive_rm, T, want_phase=False, **kw
+                )
+                assert np.array_equal(np.asarray(d1), np.asarray(d5)), (S, R)
 
     def test_minority_crash_still_decides(self):
         S, R = 8, 5
