@@ -119,9 +119,9 @@ def main() -> None:
     if "--record" in sys.argv:
         path = Path(__file__).parent / "results.json"
         doc = json.loads(path.read_text()) if path.exists() else {}
-        doc["latency_r03"] = out
+        doc["latency_r04"] = out
         path.write_text(json.dumps(doc, indent=1))
-        print("recorded -> results.json latency_r03")
+        print("recorded -> results.json latency_r04")
 
 
 if __name__ == "__main__":

@@ -86,6 +86,15 @@ class NetworkTransport(abc.ABC):
     async def close(self) -> None:
         """Tear down the transport (default no-op)."""
 
+    def set_receive_notify(self, callback) -> bool:
+        """Register a zero-arg callback invoked on the event-loop thread
+        whenever inbound data becomes available, enabling wake-on-inbox
+        engine loops instead of fixed-tick polling (the reference's
+        select!-style loop, engine.rs:193-235). Returns True if the
+        transport supports push notification; False (the default) means
+        the caller must poll ``receive_nowait``/``receive``."""
+        return False
+
 
 class NetworkEvent(enum.Enum):
     """Connectivity transitions (network.rs:131-138)."""

@@ -454,13 +454,63 @@ def _decode_payload(msg_type: MessageType, r: _Reader):
     raise SerializationError(f"unknown message type {msg_type}")
 
 
+_NATIVE_CODEC = None
+_NATIVE_TRIED = False
+
+
+def _native_codec():
+    """The C-extension codec for hot frames (rabia_tpu/native/codec.cpp),
+    bound to this module's classes on first use; None when unavailable.
+    Byte-for-byte compatible with the Python codec below (pinned by
+    tests/test_native_codec.py); the Python codec remains the semantics
+    owner and handles the remaining message types."""
+    global _NATIVE_CODEC, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE_TRIED = True
+        from rabia_tpu.native.build import load_codec
+
+        mod = load_codec()
+        if mod is not None:
+            mod.bind(
+                ProtocolMessage=ProtocolMessage,
+                VoteRound1=VoteRound1,
+                VoteRound2=VoteRound2,
+                Decision=Decision,
+                HeartBeat=HeartBeat,
+                SyncRequest=SyncRequest,
+                ProposeBlock=ProposeBlock,
+                PayloadBlock=PayloadBlock,
+                NodeId=NodeId,
+                BatchId=BatchId,
+                UUID=uuid.UUID,
+                safe_unknown=uuid.SafeUUID.unknown,
+                SerializationError=SerializationError,
+                crc32=zlib.crc32,
+            )
+            _NATIVE_CODEC = mod
+    return _NATIVE_CODEC
+
+
 class BinarySerializer:
-    """Compact binary codec (serialization.rs:66-98 analog; custom layout)."""
+    """Compact binary codec (serialization.rs:66-98 analog; custom layout).
+
+    Hot frame types (vote vectors, Decision, ProposeBlock, HeartBeat,
+    SyncRequest) encode/decode through the native C extension when it is
+    available; everything else — and every byte of wire format — stays
+    owned by the Python paths below."""
 
     def __init__(self, config: SerializationConfig | None = None):
         self.config = config or SerializationConfig()
+        self._native = _native_codec()
 
     def serialize(self, msg: ProtocolMessage) -> bytes:
+        if self._native is not None:
+            out = self._native.encode(msg)
+            if out is not None:
+                return out
+        return self._serialize_py(msg)
+
+    def _serialize_py(self, msg: ProtocolMessage) -> bytes:
         body_w = _borrow_writer()
         _encode_payload(body_w, msg.payload)
         body = body_w.getvalue()
@@ -501,6 +551,13 @@ class BinarySerializer:
         return out
 
     def deserialize(self, data: bytes) -> ProtocolMessage:
+        if self._native is not None:
+            msg = self._native.decode(data)
+            if msg is not None:
+                return msg
+        return self._deserialize_py(data)
+
+    def _deserialize_py(self, data: bytes) -> ProtocolMessage:
         r = _Reader(data)
         version = r.u8()
         if version != _VERSION:

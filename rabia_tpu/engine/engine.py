@@ -291,6 +291,8 @@ class RabiaEngine:
         self._running = False
         self._stopped = asyncio.Event()
         self._stopped.set()  # not running yet: shutdown() must not hang
+        self._wake = asyncio.Event()  # wake-on-inbox / wake-on-submit
+        self._notify_wired = False
         self._dirty = False  # committed something since last save
         self._last_heartbeat = 0.0
         self._last_cleanup = 0.0
@@ -326,6 +328,7 @@ class RabiaEngine:
             raise ValidationError(f"shard {s} out of range")
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self.rt.shards[s].queue.append(PendingSubmission(batch=batch, future=fut))
+        self._wake.set()  # wake the run loop: new work to propose
         return fut
 
     def proposer_eligible_shards(self) -> np.ndarray:
@@ -403,6 +406,7 @@ class RabiaEngine:
             self._blk_pending_slot[sh_e] = head[idxe]
         for i in np.nonzero(~elig)[0]:
             self._demote_block_entry(ref, int(i))
+        self._wake.set()  # wake the run loop: new work to propose
         return fut
 
     def _register_block(self, block: PayloadBlock, out, src_row: int) -> int:
@@ -530,24 +534,63 @@ class RabiaEngine:
         )
 
     async def run(self) -> None:
-        """Main loop (engine.rs:184-236): drain inbound, advance the kernel
-        one round, transmit the outbox, apply decisions, periodic chores."""
+        """Main loop: drain inbound, advance the kernel one round,
+        transmit the outbox, apply decisions, periodic chores.
+
+        Event-driven (the reference's select!-style loop,
+        engine.rs:193-235): when the transport supports push
+        notification the loop sleeps on a wake event — set by inbound
+        delivery and by local submissions — and wakes only for work or
+        for the next timer check, instead of pacing every round with a
+        fixed sleep (round 3's p50 was dominated by exactly that tick
+        alignment). Transports without notification fall back to
+        polling at ``round_interval``."""
         self._running = True
         self._stopped.clear()
         await self.initialize()
+        self._notify_wired = bool(
+            self.transport.set_receive_notify(self._wake.set)
+        )
         try:
             while self._running:
+                # clear BEFORE draining: anything that lands after this
+                # point either gets drained by this tick (a harmless
+                # spurious wake later) or sets the event and cuts the
+                # idle wait short — a wake can never be lost
+                self._wake.clear()
                 progressed = await self._tick()
                 await self._periodic()
-                # pace rounds; yield even when busy (engine.rs:233 analog)
-                await asyncio.sleep(
-                    0 if progressed else self.config.round_interval
-                )
+                if progressed or self._restep:
+                    # busy: yield to peers/transport, then loop again
+                    await asyncio.sleep(0)
+                    continue
+                try:
+                    await asyncio.wait_for(
+                        self._wake.wait(), self._idle_wait()
+                    )
+                except asyncio.TimeoutError:
+                    pass  # timer check (heartbeats, phase timeouts)
         finally:
             if self._dirty:
                 await self._save_state()
             self.rt.is_active = False
             self._stopped.set()
+
+    def _idle_wait(self) -> float:
+        """How long an idle loop may sleep before re-checking timers.
+
+        With wake-on-inbox wired, the sleep only bounds timer
+        granularity (heartbeats, phase-timeout retransmits, the
+        monitor) — capped well under the smallest configured interval.
+        Without it, the sleep IS the inbound poll period, so the old
+        ``round_interval`` pacing is kept."""
+        c = self.config
+        if not self._notify_wired:
+            return c.round_interval
+        return max(
+            4 * c.round_interval,
+            min(0.05, c.heartbeat_interval / 4, c.phase_timeout / 8),
+        )
 
     # ------------------------------------------------------------------
     # The round tick
@@ -1416,17 +1459,31 @@ class RabiaEngine:
                 )
             )
 
-        with span("engine.kernel.route"):
-            self._route_votes()
-        prev_phase = self._cur_phase
-        with span("engine.kernel.step"):
-            self.kstate, outbox = self.kernel.node_step(
-                self.kstate, None, None, self._dec_plane
-            )
-        self._dec_plane.fill(ABSENT)
-        self._refresh_mirrors()
-        with span("engine.kernel.outbox"):
-            self._process_outbox(outbox, prev_phase)
+        # Step to quiescence WITHIN the tick: the kernel advances one
+        # stage per step, and a transition (R1→R2 cast, phase advance)
+        # can make votes already ledger-resident decisive with no
+        # further peer traffic. Looping route→step→outbox here collapses
+        # those into one engine activation — e.g. a replica whose drain
+        # delivered a full R1+R2 quorum proposes, advances and decides
+        # in a single tick instead of three wake-ups. Bounded: a slot
+        # crosses at most a few stages per delivery, so 4 covers the
+        # deepest chain; anything left re-arms ``_restep`` for the next
+        # tick exactly as before.
+        for _ in range(4):
+            with span("engine.kernel.route"):
+                self._route_votes()
+            prev_phase = self._cur_phase
+            with span("engine.kernel.step"):
+                self.kstate, outbox = self.kernel.node_step(
+                    self.kstate, None, None, self._dec_plane
+                )
+            self._dec_plane.fill(ABSENT)
+            self._refresh_mirrors()
+            with span("engine.kernel.outbox"):
+                self._process_outbox(outbox, prev_phase)
+            if not self._restep:
+                break
+            self._restep = False
 
     def _device_round(
         self,

@@ -1,11 +1,19 @@
-"""Lazy g++ build + ctypes loader for the native transport library."""
+"""Lazy g++ build + loaders for the native libraries.
+
+Two artifacts, both digest-keyed and built on first use:
+- ``transport.cpp`` -> ctypes CDLL (the TCP data plane)
+- ``codec.cpp``     -> CPython extension module (the binary message
+  codec, SURVEY §2 C9's native component)
+"""
 
 from __future__ import annotations
 
 import ctypes
 import hashlib
+import importlib.util
 import os
 import subprocess
+import sysconfig
 import threading
 from pathlib import Path
 
@@ -13,8 +21,11 @@ from rabia_tpu.core.errors import InternalError
 
 _HERE = Path(__file__).parent
 _SRC = _HERE / "transport.cpp"
+_CODEC_SRC = _HERE / "codec.cpp"
 _LOCK = threading.Lock()
 _CACHED: ctypes.CDLL | None = None
+_CODEC_CACHED = None
+_CODEC_FAILED: str | None = None
 
 
 def _src_digest() -> str:
@@ -57,6 +68,76 @@ def _build(target: Path) -> None:
                 old.unlink()
             except OSError:
                 pass
+
+
+def _codec_path() -> Path:
+    digest = hashlib.blake2s(
+        _CODEC_SRC.read_bytes(), digest_size=8
+    ).hexdigest()
+    return _HERE / f"_codec_{digest}.so"
+
+
+def _build_codec(target: Path) -> None:
+    import numpy as np
+
+    tmp = target.with_suffix(f".tmp{os.getpid()}")
+    cmd = [
+        "g++",
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        f"-I{sysconfig.get_paths()['include']}",
+        f"-I{np.get_include()}",
+        str(_CODEC_SRC),
+        "-o",
+        str(tmp),
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        tmp.unlink(missing_ok=True)
+        raise InternalError(
+            f"native codec build failed:\n{proc.stderr[-2000:]}"
+        )
+    os.replace(tmp, target)
+    for old in _HERE.glob("_codec_*.so"):
+        if old != target:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+
+
+def load_codec():
+    """Build (if needed) and import the codec extension module.
+
+    Returns the module, or None when unavailable (no compiler, build
+    failure) — callers fall back to the Python codec. The failure is
+    remembered so a broken toolchain costs one build attempt, not one
+    per serializer construction. ``RABIA_PY_CODEC=1`` forces the Python
+    codec (debug/differential testing)."""
+    global _CODEC_CACHED, _CODEC_FAILED
+    if os.environ.get("RABIA_PY_CODEC"):
+        return None
+    with _LOCK:
+        if _CODEC_CACHED is not None:
+            return _CODEC_CACHED
+        if _CODEC_FAILED is not None:
+            return None
+        try:
+            target = _codec_path()
+            if not target.exists():
+                _build_codec(target)
+            spec = importlib.util.spec_from_file_location(
+                "rabia_native_codec", target
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception as e:  # noqa: BLE001 - any failure means fallback
+            _CODEC_FAILED = str(e)
+            return None
+        _CODEC_CACHED = mod
+        return mod
 
 
 def load_library() -> ctypes.CDLL:

@@ -1,0 +1,811 @@
+// Native binary codec for ProtocolMessage hot frames.
+//
+// SURVEY §2 C9 / §7.2 step 5 assign the binary serializer to the C++
+// host library (reference: rabia-core/src/serialization.rs:22-63 bincode
+// codec, :152-169 pooled zero-alloc path). This extension implements the
+// SAME wire format as rabia_tpu/core/serialization.py (version 3,
+// hand-rolled little-endian) for the latency-critical frame types —
+// VoteRound1/VoteRound2 (packed vote vectors), Decision, ProposeBlock,
+// HeartBeat, SyncRequest — and returns None for everything else so the
+// Python codec remains the semantics owner and fallback. Byte-for-byte
+// compatibility is pinned by tests/test_native_codec.py.
+//
+// Built as a CPython extension (not ctypes): the cost of the Python
+// codec is object construction and bytecode, not byte shuffling, so the
+// win comes from building ProtocolMessage/vote-vector objects directly
+// against the C API (tp_new + slot writes instead of Python __init__).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#define PY_ARRAY_UNIQUE_SYMBOL rabia_codec_ARRAY_API
+#include <numpy/arrayobject.h>
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+// CPython private-but-exported 128-bit int helpers (uuid.py's own
+// int.from_bytes path, minus the bytecode). Present throughout 3.x.
+PyObject* _PyLong_FromByteArray(const unsigned char* bytes, size_t n,
+                                int little_endian, int is_signed);
+int _PyLong_AsByteArray(PyLongObject* v, unsigned char* bytes, size_t n,
+                        int little_endian, int is_signed);
+}
+
+namespace {
+
+constexpr uint8_t WIRE_VERSION = 3;
+constexpr uint8_t FLAG_COMPRESSED = 0x01;
+constexpr uint8_t FLAG_HAS_RECIPIENT = 0x02;
+
+// MessageType codes (core/messages.py MessageType — order stable)
+constexpr uint8_t MT_VOTE1 = 2;
+constexpr uint8_t MT_VOTE2 = 3;
+constexpr uint8_t MT_DECISION = 4;
+constexpr uint8_t MT_SYNCREQ = 5;
+constexpr uint8_t MT_HEARTBEAT = 8;
+constexpr uint8_t MT_PROPOSE_BLOCK = 10;
+
+// Python classes / helpers bound once via bind()
+PyObject* g_ProtocolMessage = nullptr;
+PyObject* g_VoteRound1 = nullptr;
+PyObject* g_VoteRound2 = nullptr;
+PyObject* g_Decision = nullptr;
+PyObject* g_HeartBeat = nullptr;
+PyObject* g_SyncRequest = nullptr;
+PyObject* g_ProposeBlock = nullptr;
+PyObject* g_PayloadBlock = nullptr;
+PyObject* g_NodeId = nullptr;
+PyObject* g_BatchId = nullptr;
+PyObject* g_UUID = nullptr;
+PyObject* g_safe_unknown = nullptr;  // uuid.SafeUUID.unknown
+PyObject* g_SerializationError = nullptr;
+PyObject* g_crc32 = nullptr;  // zlib.crc32
+PyObject* g_node_intern = nullptr;  // dict: 16-raw-bytes -> NodeId
+PyObject* g_empty_tuple = nullptr;
+
+// interned attribute names
+PyObject* s_payload; PyObject* s_id; PyObject* s_sender; PyObject* s_recipient;
+PyObject* s_timestamp; PyObject* s_value; PyObject* s_int; PyObject* s_is_safe;
+PyObject* s_shards; PyObject* s_phases; PyObject* s_vals; PyObject* s_bids;
+PyObject* s_current_phase; PyObject* s_committed_phase; PyObject* s_state_version;
+PyObject* s_block; PyObject* s_slots; PyObject* s_counts; PyObject* s_cmd_sizes;
+PyObject* s_data; PyObject* s_total_commands;
+
+inline void wr_u32(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
+inline void wr_u64(uint8_t* p, uint64_t v) { memcpy(p, &v, 8); }
+inline uint32_t rd_u32(const uint8_t* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+inline uint64_t rd_u64(const uint8_t* p) { uint64_t v; memcpy(&v, p, 8); return v; }
+
+struct Buf {
+  uint8_t stack[8192];
+  uint8_t* p = stack;
+  size_t cap = sizeof(stack);
+  size_t len = 0;
+  ~Buf() { if (p != stack) PyMem_Free(p); }
+  uint8_t* reserve(size_t n) {
+    if (len + n > cap) {
+      size_t ncap = cap * 2;
+      while (ncap < len + n) ncap *= 2;
+      uint8_t* np = (uint8_t*)PyMem_Malloc(ncap);
+      if (!np) return nullptr;
+      memcpy(np, p, len);
+      if (p != stack) PyMem_Free(p);
+      p = np; cap = ncap;
+    }
+    uint8_t* out = p + len;
+    len += n;
+    return out;
+  }
+  bool put_u8(uint8_t v) { uint8_t* q = reserve(1); if (!q) return false; *q = v; return true; }
+  bool put_u32(uint32_t v) { uint8_t* q = reserve(4); if (!q) return false; wr_u32(q, v); return true; }
+  bool put_u64(uint64_t v) { uint8_t* q = reserve(8); if (!q) return false; wr_u64(q, v); return true; }
+  bool put_raw(const void* src, size_t n) {
+    uint8_t* q = reserve(n); if (!q) return false; memcpy(q, src, n); return true;
+  }
+};
+
+struct Rd {
+  const uint8_t* p;
+  size_t len;
+  size_t pos = 0;
+  const uint8_t* take(size_t n) {
+    if (pos + n > len) {
+      PyErr_Format(g_SerializationError,
+                   "truncated message: need %zu bytes at offset %zu, have %zu",
+                   n, pos, len - pos);
+      return nullptr;
+    }
+    const uint8_t* out = p + pos;
+    pos += n;
+    return out;
+  }
+};
+
+// --- object construction helpers -----------------------------------------
+
+// allocate an instance without running __init__ (object.__new__ path)
+PyObject* raw_new(PyObject* cls) {
+  PyTypeObject* t = (PyTypeObject*)cls;
+  return t->tp_new(t, g_empty_tuple, nullptr);
+}
+
+// set an attribute bypassing the class's __setattr__ (works for both
+// __slots__ descriptors and instance dicts; same mechanism as
+// object.__setattr__, which frozen dataclasses / uuid.UUID themselves use)
+int raw_set(PyObject* obj, PyObject* name, PyObject* val) {
+  return PyObject_GenericSetAttr(obj, name, val);
+}
+
+// uuid.UUID from 16 big-endian bytes, skipping UUID.__init__ validation
+PyObject* make_uuid(const uint8_t* raw) {
+  PyObject* big = _PyLong_FromByteArray(raw, 16, /*little=*/0, /*signed=*/0);
+  if (!big) return nullptr;
+  PyObject* u = raw_new(g_UUID);
+  if (!u) { Py_DECREF(big); return nullptr; }
+  if (raw_set(u, s_int, big) < 0 ||
+      raw_set(u, s_is_safe, g_safe_unknown) < 0) {
+    Py_DECREF(big); Py_DECREF(u); return nullptr;
+  }
+  Py_DECREF(big);
+  return u;
+}
+
+// 16 wire bytes of a uuid.UUID (big-endian of its .int)
+bool uuid_bytes(PyObject* u, uint8_t* out) {
+  PyObject* big = PyObject_GetAttr(u, s_int);
+  if (!big) return false;
+  // UUID(int=...) stores whatever integer-like it was given (e.g. a
+  // numpy int64); coerce to an exact PyLong before the byte export
+  PyObject* exact = PyNumber_Index(big);
+  Py_DECREF(big);
+  if (!exact) return false;
+  int rc = _PyLong_AsByteArray((PyLongObject*)exact, out, 16, /*little=*/0,
+                               /*signed=*/0);
+  Py_DECREF(exact);
+  return rc == 0;
+}
+
+// interned NodeId from 16 raw bytes
+PyObject* intern_node(const uint8_t* raw) {
+  PyObject* key = PyBytes_FromStringAndSize((const char*)raw, 16);
+  if (!key) return nullptr;
+  PyObject* hit = PyDict_GetItemWithError(g_node_intern, key);
+  if (hit) {
+    Py_INCREF(hit);
+    Py_DECREF(key);
+    return hit;
+  }
+  if (PyErr_Occurred()) { Py_DECREF(key); return nullptr; }
+  if (PyDict_Size(g_node_intern) > 4096) PyDict_Clear(g_node_intern);
+  PyObject* u = make_uuid(raw);
+  if (!u) { Py_DECREF(key); return nullptr; }
+  PyObject* node = raw_new(g_NodeId);
+  if (!node || raw_set(node, s_value, u) < 0) {
+    Py_XDECREF(node); Py_DECREF(u); Py_DECREF(key); return nullptr;
+  }
+  Py_DECREF(u);
+  if (PyDict_SetItem(g_node_intern, key, node) < 0) {
+    Py_DECREF(node); Py_DECREF(key); return nullptr;
+  }
+  Py_DECREF(key);
+  return node;
+}
+
+// contiguous int64 view of a numpy attr (no copy when already i64)
+PyArrayObject* as_i64(PyObject* owner, PyObject* name) {
+  PyObject* a = PyObject_GetAttr(owner, name);
+  if (!a) return nullptr;
+  PyArrayObject* arr = (PyArrayObject*)PyArray_FROM_OTF(
+      a, NPY_INT64, NPY_ARRAY_C_CONTIGUOUS | NPY_ARRAY_ALIGNED);
+  Py_DECREF(a);
+  return arr;
+}
+
+PyArrayObject* as_i8(PyObject* owner, PyObject* name) {
+  PyObject* a = PyObject_GetAttr(owner, name);
+  if (!a) return nullptr;
+  PyArrayObject* arr = (PyArrayObject*)PyArray_FROM_OTF(
+      a, NPY_INT8, NPY_ARRAY_C_CONTIGUOUS | NPY_ARRAY_ALIGNED);
+  Py_DECREF(a);
+  return arr;
+}
+
+// --- payload encoders -----------------------------------------------------
+
+// vote vector body: u32 n + n * (u32 shard, u64 phase, u8 vote)
+bool encode_votes(Buf& b, PyObject* payload) {
+  PyArrayObject* sh = as_i64(payload, s_shards);
+  PyArrayObject* ph = as_i64(payload, s_phases);
+  PyArrayObject* vv = as_i8(payload, s_vals);
+  if (!sh || !ph || !vv) {
+    Py_XDECREF(sh); Py_XDECREF(ph); Py_XDECREF(vv);
+    return false;
+  }
+  npy_intp n = PyArray_DIM(sh, 0);
+  const int64_t* ps = (const int64_t*)PyArray_DATA(sh);
+  const int64_t* pp = (const int64_t*)PyArray_DATA(ph);
+  const int8_t* pv = (const int8_t*)PyArray_DATA(vv);
+  bool ok = b.put_u32((uint32_t)n);
+  if (ok) {
+    uint8_t* q = b.reserve((size_t)n * 13);
+    ok = q != nullptr;
+    if (ok) {
+      for (npy_intp i = 0; i < n; i++) {
+        wr_u32(q, (uint32_t)ps[i]);
+        wr_u64(q + 4, (uint64_t)pp[i]);
+        q[12] = (uint8_t)pv[i];
+        q += 13;
+      }
+    }
+  }
+  Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv);
+  return ok;
+}
+
+// Decision body: u32 n + n * (u32, u64, u8 decision, u8 has_bid) +
+// trailing 16B batch ids for has_bid entries in order
+bool encode_decision(Buf& b, PyObject* payload) {
+  PyArrayObject* sh = as_i64(payload, s_shards);
+  PyArrayObject* ph = as_i64(payload, s_phases);
+  PyArrayObject* vv = as_i8(payload, s_vals);
+  PyObject* bids = PyObject_GetAttr(payload, s_bids);
+  if (!sh || !ph || !vv || !bids) {
+    Py_XDECREF(sh); Py_XDECREF(ph); Py_XDECREF(vv); Py_XDECREF(bids);
+    return false;
+  }
+  npy_intp n = PyArray_DIM(sh, 0);
+  const int64_t* ps = (const int64_t*)PyArray_DATA(sh);
+  const int64_t* pp = (const int64_t*)PyArray_DATA(ph);
+  const int8_t* pv = (const int8_t*)PyArray_DATA(vv);
+  bool has_bids = bids != Py_None;
+  bool ok = b.put_u32((uint32_t)n);
+  uint8_t* q = ok ? b.reserve((size_t)n * 14) : nullptr;
+  ok = q != nullptr;
+  if (ok) {
+    for (npy_intp i = 0; i < n; i++) {
+      wr_u32(q, (uint32_t)ps[i]);
+      wr_u64(q + 4, (uint64_t)pp[i]);
+      q[12] = (uint8_t)pv[i];
+      uint8_t hb = 0;
+      if (has_bids) {
+        PyObject* bid = PyList_GET_ITEM(bids, i);  // borrowed
+        hb = (bid != Py_None) ? 1 : 0;
+      }
+      q[13] = hb;
+      q += 14;
+    }
+    if (has_bids) {
+      for (npy_intp i = 0; ok && i < n; i++) {
+        PyObject* bid = PyList_GET_ITEM(bids, i);
+        if (bid == Py_None) continue;
+        PyObject* val = PyObject_GetAttr(bid, s_value);
+        uint8_t raw[16];
+        ok = val && uuid_bytes(val, raw) && b.put_raw(raw, 16);
+        Py_XDECREF(val);
+      }
+    }
+  }
+  Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv); Py_DECREF(bids);
+  return ok;
+}
+
+bool put_u64_attr(Buf& b, PyObject* payload, PyObject* name) {
+  PyObject* v = PyObject_GetAttr(payload, name);
+  if (!v) return false;
+  uint64_t x = PyLong_AsUnsignedLongLong(v);
+  Py_DECREF(v);
+  if (x == (uint64_t)-1 && PyErr_Occurred()) return false;
+  return b.put_u64(x);
+}
+
+uint32_t crc32_of(PyObject* data_bytes, bool* ok) {
+  PyObject* r = PyObject_CallFunctionObjArgs(g_crc32, data_bytes, nullptr);
+  if (!r) { *ok = false; return 0; }
+  uint32_t v = (uint32_t)(PyLong_AsUnsignedLong(r) & 0xFFFFFFFFu);
+  Py_DECREF(r);
+  *ok = !PyErr_Occurred();
+  return v;
+}
+
+// ProposeBlock body (serialization.py _encode_payload ProposeBlock branch)
+bool encode_block(Buf& b, PyObject* payload) {
+  PyObject* blk = PyObject_GetAttr(payload, s_block);
+  if (!blk) return false;
+  PyObject* bid = PyObject_GetAttr(blk, s_id);
+  PyArrayObject* sh = as_i64(blk, s_shards);
+  PyArrayObject* sl = as_i64(blk, s_slots);
+  PyArrayObject* ct = as_i64(blk, s_counts);
+  PyArrayObject* cs = as_i64(blk, s_cmd_sizes);
+  PyObject* data = PyObject_GetAttr(blk, s_data);
+  PyObject* tot = PyObject_GetAttr(blk, s_total_commands);
+  bool ok = bid && sh && sl && ct && cs && data && tot &&
+            PyBytes_Check(data);
+  if (ok) {
+    uint8_t raw[16];
+    ok = uuid_bytes(bid, raw) && b.put_raw(raw, 16);
+    npy_intp k = PyArray_DIM(sh, 0);
+    ok = ok && b.put_u32((uint32_t)k);
+    if (ok) {
+      const int64_t* p = (const int64_t*)PyArray_DATA(sh);
+      uint8_t* q = b.reserve((size_t)k * 4);
+      ok = q != nullptr;
+      for (npy_intp i = 0; ok && i < k; i++) wr_u32(q + 4 * i, (uint32_t)p[i]);
+    }
+    if (ok) {
+      const int64_t* p = (const int64_t*)PyArray_DATA(sl);
+      uint8_t* q = b.reserve((size_t)k * 8);
+      ok = q != nullptr;
+      for (npy_intp i = 0; ok && i < k; i++) wr_u64(q + 8 * i, (uint64_t)p[i]);
+    }
+    if (ok) {
+      const int64_t* p = (const int64_t*)PyArray_DATA(ct);
+      uint8_t* q = b.reserve((size_t)k * 4);
+      ok = q != nullptr;
+      for (npy_intp i = 0; ok && i < k; i++) wr_u32(q + 4 * i, (uint32_t)p[i]);
+    }
+    long total = ok ? PyLong_AsLong(tot) : 0;
+    ok = ok && !PyErr_Occurred() && b.put_u32((uint32_t)total);
+    if (ok) {
+      npy_intp nsz = PyArray_DIM(cs, 0);
+      const int64_t* p = (const int64_t*)PyArray_DATA(cs);
+      uint8_t* q = b.reserve((size_t)nsz * 4);
+      ok = q != nullptr;
+      for (npy_intp i = 0; ok && i < nsz; i++) wr_u32(q + 4 * i, (uint32_t)p[i]);
+    }
+    if (ok) {
+      Py_ssize_t dn = PyBytes_GET_SIZE(data);
+      ok = b.put_u32((uint32_t)dn) &&
+           b.put_raw(PyBytes_AS_STRING(data), (size_t)dn);
+    }
+    if (ok) {
+      uint32_t crc = crc32_of(data, &ok);
+      ok = ok && b.put_u32(crc);
+    }
+  }
+  Py_XDECREF(bid); Py_XDECREF(sh); Py_XDECREF(sl); Py_XDECREF(ct);
+  Py_XDECREF(cs); Py_XDECREF(data); Py_XDECREF(tot); Py_DECREF(blk);
+  return ok;
+}
+
+// --- payload decoders -----------------------------------------------------
+
+PyObject* make_i64_array(npy_intp n) {
+  npy_intp dims[1] = {n};
+  return PyArray_SimpleNew(1, dims, NPY_INT64);
+}
+
+PyObject* decode_votes(Rd& r, PyObject* cls) {
+  const uint8_t* q = r.take(4);
+  if (!q) return nullptr;
+  uint32_t n = rd_u32(q);
+  const uint8_t* body = r.take((size_t)n * 13);
+  if (!body) return nullptr;
+  PyObject* sh = make_i64_array(n);
+  PyObject* ph = make_i64_array(n);
+  npy_intp dims[1] = {(npy_intp)n};
+  PyObject* vv = PyArray_SimpleNew(1, dims, NPY_INT8);
+  if (!sh || !ph || !vv) { Py_XDECREF(sh); Py_XDECREF(ph); Py_XDECREF(vv); return nullptr; }
+  int64_t* ps = (int64_t*)PyArray_DATA((PyArrayObject*)sh);
+  int64_t* pp = (int64_t*)PyArray_DATA((PyArrayObject*)ph);
+  int8_t* pv = (int8_t*)PyArray_DATA((PyArrayObject*)vv);
+  bool bad = false;
+  for (uint32_t i = 0; i < n; i++) {
+    const uint8_t* e = body + (size_t)i * 13;
+    ps[i] = rd_u32(e);
+    pp[i] = (int64_t)rd_u64(e + 4);
+    uint8_t code = e[12];
+    if (code > 3) bad = true;
+    pv[i] = (int8_t)code;
+  }
+  if (bad) {
+    Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv);
+    PyErr_SetString(g_SerializationError, "vote code out of range");
+    return nullptr;
+  }
+  PyObject* obj = raw_new(cls);
+  if (!obj || raw_set(obj, s_shards, sh) < 0 || raw_set(obj, s_phases, ph) < 0 ||
+      raw_set(obj, s_vals, vv) < 0) {
+    Py_XDECREF(obj); Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv);
+    return nullptr;
+  }
+  Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv);
+  return obj;
+}
+
+PyObject* decode_decision(Rd& r) {
+  const uint8_t* q = r.take(4);
+  if (!q) return nullptr;
+  uint32_t n = rd_u32(q);
+  const uint8_t* body = r.take((size_t)n * 14);
+  if (!body) return nullptr;
+  PyObject* sh = make_i64_array(n);
+  PyObject* ph = make_i64_array(n);
+  npy_intp dims[1] = {(npy_intp)n};
+  PyObject* vv = PyArray_SimpleNew(1, dims, NPY_INT8);
+  if (!sh || !ph || !vv) { Py_XDECREF(sh); Py_XDECREF(ph); Py_XDECREF(vv); return nullptr; }
+  int64_t* ps = (int64_t*)PyArray_DATA((PyArrayObject*)sh);
+  int64_t* pp = (int64_t*)PyArray_DATA((PyArrayObject*)ph);
+  int8_t* pv = (int8_t*)PyArray_DATA((PyArrayObject*)vv);
+  bool bad = false;
+  uint32_t n_bids = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    const uint8_t* e = body + (size_t)i * 14;
+    ps[i] = rd_u32(e);
+    pp[i] = (int64_t)rd_u64(e + 4);
+    uint8_t code = e[12];
+    if (code > 3) bad = true;
+    pv[i] = (int8_t)code;
+    if (e[13]) n_bids++;
+  }
+  if (bad) {
+    Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv);
+    PyErr_SetString(g_SerializationError, "decision code out of range");
+    return nullptr;
+  }
+  PyObject* bids = Py_None;
+  Py_INCREF(Py_None);
+  if (n_bids) {
+    Py_DECREF(Py_None);
+    bids = PyList_New(n);
+    if (!bids) { Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv); return nullptr; }
+    for (uint32_t i = 0; i < n; i++) {
+      const uint8_t* e = body + (size_t)i * 14;
+      PyObject* item;
+      if (e[13]) {
+        const uint8_t* raw = r.take(16);
+        if (!raw) { Py_DECREF(bids); Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv); return nullptr; }
+        PyObject* u = make_uuid(raw);
+        if (!u) { Py_DECREF(bids); Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv); return nullptr; }
+        item = raw_new(g_BatchId);
+        if (!item || raw_set(item, s_value, u) < 0) {
+          Py_XDECREF(item); Py_DECREF(u); Py_DECREF(bids);
+          Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv);
+          return nullptr;
+        }
+        Py_DECREF(u);
+      } else {
+        item = Py_None;
+        Py_INCREF(Py_None);
+      }
+      PyList_SET_ITEM(bids, i, item);  // steals
+    }
+  }
+  PyObject* obj = raw_new(g_Decision);
+  if (!obj || raw_set(obj, s_shards, sh) < 0 || raw_set(obj, s_phases, ph) < 0 ||
+      raw_set(obj, s_vals, vv) < 0 || raw_set(obj, s_bids, bids) < 0) {
+    Py_XDECREF(obj); Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv); Py_DECREF(bids);
+    return nullptr;
+  }
+  Py_DECREF(sh); Py_DECREF(ph); Py_DECREF(vv); Py_DECREF(bids);
+  return obj;
+}
+
+// frozen-dataclass carrier with two u64 fields (HeartBeat / SyncRequest)
+PyObject* decode_two_u64(Rd& r, PyObject* cls, PyObject* f1, PyObject* f2) {
+  const uint8_t* q = r.take(16);
+  if (!q) return nullptr;
+  PyObject* a = PyLong_FromUnsignedLongLong(rd_u64(q));
+  PyObject* b = PyLong_FromUnsignedLongLong(rd_u64(q + 8));
+  PyObject* obj = (a && b) ? raw_new(cls) : nullptr;
+  if (!obj || raw_set(obj, f1, a) < 0 || raw_set(obj, f2, b) < 0) {
+    Py_XDECREF(obj); Py_XDECREF(a); Py_XDECREF(b);
+    return nullptr;
+  }
+  Py_DECREF(a); Py_DECREF(b);
+  return obj;
+}
+
+PyObject* decode_block(Rd& r) {
+  const uint8_t* braw = r.take(16);
+  if (!braw) return nullptr;
+  PyObject* bid = make_uuid(braw);
+  if (!bid) return nullptr;
+  const uint8_t* q = r.take(4);
+  if (!q) { Py_DECREF(bid); return nullptr; }
+  uint32_t k = rd_u32(q);
+  const uint8_t* shr = r.take((size_t)k * 4);
+  const uint8_t* slr = shr ? r.take((size_t)k * 8) : nullptr;
+  const uint8_t* ctr = slr ? r.take((size_t)k * 4) : nullptr;
+  const uint8_t* totr = ctr ? r.take(4) : nullptr;
+  if (!totr) { Py_DECREF(bid); return nullptr; }
+  uint32_t total = rd_u32(totr);
+  const uint8_t* szr = r.take((size_t)total * 4);
+  const uint8_t* dlenr = szr ? r.take(4) : nullptr;
+  if (!dlenr) { Py_DECREF(bid); return nullptr; }
+  uint32_t dlen = rd_u32(dlenr);
+  const uint8_t* draw = r.take(dlen);
+  const uint8_t* crcr = draw ? r.take(4) : nullptr;
+  if (!crcr) { Py_DECREF(bid); return nullptr; }
+
+  PyObject* data = PyBytes_FromStringAndSize((const char*)draw, dlen);
+  if (!data) { Py_DECREF(bid); return nullptr; }
+  bool ok = true;
+  uint32_t crc = crc32_of(data, &ok);
+  if (!ok) { Py_DECREF(bid); Py_DECREF(data); return nullptr; }
+  if (crc != rd_u32(crcr)) {
+    Py_DECREF(bid); Py_DECREF(data);
+    PyErr_SetString(g_SerializationError, "block data checksum mismatch");
+    return nullptr;
+  }
+  PyObject* sh = make_i64_array(k);
+  PyObject* sl = make_i64_array(k);
+  PyObject* ct = make_i64_array(k);
+  PyObject* cs = make_i64_array(total);
+  if (!sh || !sl || !ct || !cs) {
+    Py_XDECREF(sh); Py_XDECREF(sl); Py_XDECREF(ct); Py_XDECREF(cs);
+    Py_DECREF(bid); Py_DECREF(data);
+    return nullptr;
+  }
+  int64_t* p;
+  p = (int64_t*)PyArray_DATA((PyArrayObject*)sh);
+  for (uint32_t i = 0; i < k; i++) p[i] = rd_u32(shr + 4 * i);
+  p = (int64_t*)PyArray_DATA((PyArrayObject*)sl);
+  for (uint32_t i = 0; i < k; i++) p[i] = (int64_t)rd_u64(slr + 8 * i);
+  p = (int64_t*)PyArray_DATA((PyArrayObject*)ct);
+  for (uint32_t i = 0; i < k; i++) p[i] = rd_u32(ctr + 4 * i);
+  p = (int64_t*)PyArray_DATA((PyArrayObject*)cs);
+  for (uint32_t i = 0; i < total; i++) p[i] = rd_u32(szr + 4 * i);
+
+  // PayloadBlock validates shape/ordering invariants in __init__ — call
+  // it normally; malformed content must raise SerializationError
+  PyObject* blk = PyObject_CallFunctionObjArgs(
+      g_PayloadBlock, bid, sh, sl, ct, cs, data, nullptr);
+  Py_DECREF(bid); Py_DECREF(sh); Py_DECREF(sl); Py_DECREF(ct);
+  Py_DECREF(cs); Py_DECREF(data);
+  if (!blk) {
+    PyObject *et, *ev, *tb;
+    PyErr_Fetch(&et, &ev, &tb);
+    PyErr_Format(g_SerializationError, "malformed block: %S",
+                 ev ? ev : Py_None);
+    Py_XDECREF(et); Py_XDECREF(ev); Py_XDECREF(tb);
+    return nullptr;
+  }
+  PyObject* obj = raw_new(g_ProposeBlock);
+  if (!obj || raw_set(obj, s_block, blk) < 0) {
+    Py_XDECREF(obj); Py_DECREF(blk);
+    return nullptr;
+  }
+  Py_DECREF(blk);
+  return obj;
+}
+
+// --- entry points ---------------------------------------------------------
+
+PyObject* codec_encode(PyObject*, PyObject* msg) {
+  if (!g_ProtocolMessage) {
+    PyErr_SetString(PyExc_RuntimeError, "codec not bound");
+    return nullptr;
+  }
+  PyObject* payload = PyObject_GetAttr(msg, s_payload);
+  if (!payload) return nullptr;
+  PyTypeObject* pt = Py_TYPE(payload);
+  uint8_t mt;
+  if (pt == (PyTypeObject*)g_VoteRound1) mt = MT_VOTE1;
+  else if (pt == (PyTypeObject*)g_VoteRound2) mt = MT_VOTE2;
+  else if (pt == (PyTypeObject*)g_Decision) mt = MT_DECISION;
+  else if (pt == (PyTypeObject*)g_HeartBeat) mt = MT_HEARTBEAT;
+  else if (pt == (PyTypeObject*)g_SyncRequest) mt = MT_SYNCREQ;
+  else if (pt == (PyTypeObject*)g_ProposeBlock) mt = MT_PROPOSE_BLOCK;
+  else {
+    Py_DECREF(payload);
+    Py_RETURN_NONE;  // unsupported: Python codec handles it
+  }
+
+  PyObject* mid = PyObject_GetAttr(msg, s_id);
+  PyObject* sender = mid ? PyObject_GetAttr(msg, s_sender) : nullptr;
+  PyObject* recipient = sender ? PyObject_GetAttr(msg, s_recipient) : nullptr;
+  PyObject* ts = recipient ? PyObject_GetAttr(msg, s_timestamp) : nullptr;
+  PyObject* out = nullptr;
+  if (ts) {
+    double tsv = PyFloat_AsDouble(ts);
+    if (!(tsv == -1.0 && PyErr_Occurred())) {
+      Buf env;
+      uint8_t flags = (recipient != Py_None) ? FLAG_HAS_RECIPIENT : 0;
+      bool ok = env.put_u8(WIRE_VERSION) && env.put_u8(mt) && env.put_u8(flags);
+      uint8_t raw[16];
+      ok = ok && uuid_bytes(mid, raw) && env.put_raw(raw, 16);
+      if (ok) {
+        PyObject* sval = PyObject_GetAttr(sender, s_value);
+        ok = sval && uuid_bytes(sval, raw) && env.put_raw(raw, 16);
+        Py_XDECREF(sval);
+      }
+      if (ok && recipient != Py_None) {
+        PyObject* rval = PyObject_GetAttr(recipient, s_value);
+        ok = rval && uuid_bytes(rval, raw) && env.put_raw(raw, 16);
+        Py_XDECREF(rval);
+      }
+      if (ok) {
+        uint64_t bits;
+        memcpy(&bits, &tsv, 8);
+        ok = env.put_u64(bits);
+      }
+      if (ok) {
+        Buf body;
+        switch (mt) {
+          case MT_VOTE1:
+          case MT_VOTE2: ok = encode_votes(body, payload); break;
+          case MT_DECISION: ok = encode_decision(body, payload); break;
+          case MT_HEARTBEAT:
+            ok = put_u64_attr(body, payload, s_current_phase) &&
+                 put_u64_attr(body, payload, s_committed_phase);
+            break;
+          case MT_SYNCREQ:
+            ok = put_u64_attr(body, payload, s_current_phase) &&
+                 put_u64_attr(body, payload, s_state_version);
+            break;
+          case MT_PROPOSE_BLOCK: ok = encode_block(body, payload); break;
+        }
+        ok = ok && env.put_u32((uint32_t)body.len) &&
+             env.put_raw(body.p, body.len);
+        if (ok)
+          out = PyBytes_FromStringAndSize((const char*)env.p,
+                                          (Py_ssize_t)env.len);
+      }
+      if (!ok && !PyErr_Occurred())
+        PyErr_SetString(g_SerializationError, "native encode failed");
+    }
+  }
+  Py_XDECREF(ts); Py_XDECREF(recipient); Py_XDECREF(sender);
+  Py_XDECREF(mid); Py_DECREF(payload);
+  return out;
+}
+
+PyObject* codec_decode(PyObject*, PyObject* arg) {
+  if (!g_ProtocolMessage) {
+    PyErr_SetString(PyExc_RuntimeError, "codec not bound");
+    return nullptr;
+  }
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  Rd r{(const uint8_t*)view.buf, (size_t)view.len};
+  PyObject* result = nullptr;
+  PyObject* payload = nullptr;
+  PyObject *mid = nullptr, *sender = nullptr, *recipient = nullptr,
+           *tsobj = nullptr;
+  do {
+    const uint8_t* h = r.take(3);
+    if (!h) break;
+    uint8_t version = h[0], mt = h[1], flags = h[2];
+    if (version != WIRE_VERSION) {
+      PyErr_Format(g_SerializationError, "unsupported wire version %d",
+                   (int)version);
+      break;
+    }
+    bool supported =
+        (mt == MT_VOTE1 || mt == MT_VOTE2 || mt == MT_DECISION ||
+         mt == MT_HEARTBEAT || mt == MT_SYNCREQ || mt == MT_PROPOSE_BLOCK) &&
+        !(flags & FLAG_COMPRESSED);
+    if (!supported) {
+      // Python codec owns the remaining types / compressed bodies
+      result = Py_None;
+      Py_INCREF(Py_None);
+      break;
+    }
+    const uint8_t* idr = r.take(16);
+    if (!idr) break;
+    mid = make_uuid(idr);
+    if (!mid) break;
+    const uint8_t* sndr = r.take(16);
+    if (!sndr) break;
+    sender = intern_node(sndr);
+    if (!sender) break;
+    if (flags & FLAG_HAS_RECIPIENT) {
+      const uint8_t* rcp = r.take(16);
+      if (!rcp) break;
+      recipient = intern_node(rcp);
+      if (!recipient) break;
+    } else {
+      recipient = Py_None;
+      Py_INCREF(Py_None);
+    }
+    const uint8_t* tsr = r.take(8);
+    if (!tsr) break;
+    double tsv;
+    uint64_t bits = rd_u64(tsr);
+    memcpy(&tsv, &bits, 8);
+    tsobj = PyFloat_FromDouble(tsv);
+    if (!tsobj) break;
+    const uint8_t* blr = r.take(4);
+    if (!blr) break;
+    uint32_t body_len = rd_u32(blr);
+    const uint8_t* body = r.take(body_len);
+    if (!body) break;
+    Rd br{body, body_len};
+    switch (mt) {
+      case MT_VOTE1: payload = decode_votes(br, g_VoteRound1); break;
+      case MT_VOTE2: payload = decode_votes(br, g_VoteRound2); break;
+      case MT_DECISION: payload = decode_decision(br); break;
+      case MT_HEARTBEAT:
+        payload = decode_two_u64(br, g_HeartBeat, s_current_phase,
+                                 s_committed_phase);
+        break;
+      case MT_SYNCREQ:
+        payload = decode_two_u64(br, g_SyncRequest, s_current_phase,
+                                 s_state_version);
+        break;
+      case MT_PROPOSE_BLOCK: payload = decode_block(br); break;
+    }
+    if (!payload) break;
+    PyObject* msg = raw_new(g_ProtocolMessage);
+    if (!msg || raw_set(msg, s_id, mid) < 0 ||
+        raw_set(msg, s_sender, sender) < 0 ||
+        raw_set(msg, s_recipient, recipient) < 0 ||
+        raw_set(msg, s_timestamp, tsobj) < 0 ||
+        raw_set(msg, s_payload, payload) < 0) {
+      Py_XDECREF(msg);
+      break;
+    }
+    result = msg;
+  } while (false);
+  Py_XDECREF(payload); Py_XDECREF(mid); Py_XDECREF(sender);
+  Py_XDECREF(recipient); Py_XDECREF(tsobj);
+  PyBuffer_Release(&view);
+  return result;
+}
+
+PyObject* codec_bind(PyObject*, PyObject* args, PyObject* kwargs) {
+  static const char* kwlist[] = {
+      "ProtocolMessage", "VoteRound1", "VoteRound2", "Decision",
+      "HeartBeat", "SyncRequest", "ProposeBlock", "PayloadBlock",
+      "NodeId", "BatchId", "UUID", "safe_unknown", "SerializationError",
+      "crc32", nullptr};
+  PyObject *pm, *v1, *v2, *dc, *hb, *sr, *pb, *plb, *nid, *bid, *uu, *su,
+      *se, *crc;
+  if (!PyArg_ParseTupleAndKeywords(
+          args, kwargs, "OOOOOOOOOOOOOO", (char**)kwlist, &pm, &v1, &v2, &dc,
+          &hb, &sr, &pb, &plb, &nid, &bid, &uu, &su, &se, &crc))
+    return nullptr;
+#define BIND(slot, val) Py_XDECREF(slot); Py_INCREF(val); slot = val
+  BIND(g_ProtocolMessage, pm); BIND(g_VoteRound1, v1); BIND(g_VoteRound2, v2);
+  BIND(g_Decision, dc); BIND(g_HeartBeat, hb); BIND(g_SyncRequest, sr);
+  BIND(g_ProposeBlock, pb); BIND(g_PayloadBlock, plb); BIND(g_NodeId, nid);
+  BIND(g_BatchId, bid); BIND(g_UUID, uu); BIND(g_safe_unknown, su);
+  BIND(g_SerializationError, se); BIND(g_crc32, crc);
+#undef BIND
+  Py_RETURN_NONE;
+}
+
+PyMethodDef methods[] = {
+    {"bind", (PyCFunction)codec_bind, METH_VARARGS | METH_KEYWORDS,
+     "Bind the Python message classes the codec builds/reads."},
+    {"encode", codec_encode, METH_O,
+     "Serialize a ProtocolMessage; None if the type is not fast-pathed."},
+    {"decode", codec_decode, METH_O,
+     "Deserialize wire bytes; None if the type is not fast-pathed."},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,
+                         "rabia_native_codec",
+                         "Native binary codec for protocol hot frames",
+                         -1,
+                         methods,
+                         nullptr,
+                         nullptr,
+                         nullptr,
+                         nullptr};
+
+}  // namespace
+
+extern "C" PyMODINIT_FUNC PyInit_rabia_native_codec(void) {
+  import_array();
+  PyObject* m = PyModule_Create(&moduledef);
+  if (!m) return nullptr;
+  g_node_intern = PyDict_New();
+  g_empty_tuple = PyTuple_New(0);
+#define INTERN(var, name) var = PyUnicode_InternFromString(name)
+  INTERN(s_payload, "payload"); INTERN(s_id, "id"); INTERN(s_sender, "sender");
+  INTERN(s_recipient, "recipient"); INTERN(s_timestamp, "timestamp");
+  INTERN(s_value, "value"); INTERN(s_int, "int"); INTERN(s_is_safe, "is_safe");
+  INTERN(s_shards, "shards"); INTERN(s_phases, "phases");
+  INTERN(s_vals, "vals"); INTERN(s_bids, "bids");
+  INTERN(s_current_phase, "current_phase");
+  INTERN(s_committed_phase, "committed_phase");
+  INTERN(s_state_version, "state_version"); INTERN(s_block, "block");
+  INTERN(s_slots, "slots"); INTERN(s_counts, "counts");
+  INTERN(s_cmd_sizes, "cmd_sizes"); INTERN(s_data, "data");
+  INTERN(s_total_commands, "total_commands");
+#undef INTERN
+  return m;
+}

@@ -42,6 +42,7 @@ class InMemoryHub:
     def __init__(self) -> None:
         self._queues: dict[NodeId, asyncio.Queue[tuple[NodeId, bytes]]] = {}
         self._disconnected: set[NodeId] = set()
+        self._notify: dict[NodeId, object] = {}  # node -> zero-arg callable
         self.stats = HubStats()
 
     def register(self, node: NodeId) -> "InMemoryNetwork":
@@ -74,6 +75,14 @@ class InMemoryHub:
         q.put_nowait((sender, data))
         self.stats.delivered += 1
         self.stats.total_bytes += len(data)
+        cb = self._notify.get(target)
+        if cb is not None:
+            cb()
+
+    def set_notify(self, node: NodeId, callback) -> None:
+        """Wake-on-inbox hook: `callback` runs (on the loop thread, from
+        route()) whenever a message lands in `node`'s queue."""
+        self._notify[node] = callback
 
     def queue_of(self, node: NodeId) -> asyncio.Queue:
         return self._queues[node]
@@ -110,6 +119,10 @@ class InMemoryNetwork(NetworkTransport):
             return q.get_nowait()
         except asyncio.QueueEmpty:
             return None
+
+    def set_receive_notify(self, callback) -> bool:
+        self.hub.set_notify(self.node_id, callback)
+        return True
 
     async def get_connected_nodes(self) -> set[NodeId]:
         if not self.hub.is_connected(self.node_id):

@@ -114,6 +114,7 @@ class NetworkSimulator:
         self.stats = NetworkStats()
         self._rng = random.Random(seed)
         self._queues: dict[NodeId, asyncio.Queue[tuple[NodeId, bytes]]] = {}
+        self._notify: dict[NodeId, object] = {}  # node -> zero-arg callable
         self._crashed: set[NodeId] = set()
         self._node_delay: dict[NodeId, float] = {}  # SlowNode fault support
         self._partition: set[NodeId] = set()
@@ -245,6 +246,9 @@ class NetworkSimulator:
         self.stats.messages_delivered += 1
         self.stats.total_latency += latency
         self.stats.total_bytes += len(data)
+        cb = self._notify.get(target)
+        if cb is not None:
+            cb()
 
     # -- delayed-delivery driver (replaces the 1ms tick loop) ---------------
 
@@ -290,6 +294,11 @@ class NetworkSimulator:
             except asyncio.CancelledError:
                 pass
 
+    def set_notify(self, node: NodeId, callback) -> None:
+        """Wake-on-inbox hook: `callback` runs at actual delivery time
+        (after simulated latency), on the loop thread."""
+        self._notify[node] = callback
+
     def queue_of(self, node: NodeId) -> asyncio.Queue:
         return self._queues[node]
 
@@ -324,6 +333,10 @@ class SimulatedNetwork(NetworkTransport):
             return self.sim.queue_of(self.node_id).get_nowait()
         except asyncio.QueueEmpty:
             return None
+
+    def set_receive_notify(self, callback) -> bool:
+        self.sim.set_notify(self.node_id, callback)
+        return True
 
     async def get_connected_nodes(self) -> set[NodeId]:
         if self.sim.is_crashed(self.node_id):

@@ -70,6 +70,7 @@ class TcpNetwork(NetworkTransport):
         )
         self._data_ready = asyncio.Event()
         self._wake_scheduled = False
+        self._recv_notify = None  # wake-on-inbox hook (set_receive_notify)
         # must be the RUNNING loop: the reader thread posts into it with
         # call_soon_threadsafe; a get_event_loop()-created orphan loop would
         # swallow frames forever. Constructing outside async context is an
@@ -138,6 +139,8 @@ class TcpNetwork(NetworkTransport):
     def _on_frames(self) -> None:
         self._wake_scheduled = False
         self._data_ready.set()
+        if self._recv_notify is not None:
+            self._recv_notify()
 
     async def receive(self, timeout: Optional[float] = None) -> tuple[NodeId, bytes]:
         deadline = (
@@ -169,6 +172,12 @@ class TcpNetwork(NetworkTransport):
             return self._pending.popleft()
         except IndexError:
             return None
+
+    def set_receive_notify(self, callback) -> bool:
+        # invoked from _on_frames, which already runs on the loop thread
+        # (the reader thread posts it via call_soon_threadsafe)
+        self._recv_notify = callback
+        return True
 
     async def get_connected_nodes(self) -> set[NodeId]:
         import uuid
