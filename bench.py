@@ -197,17 +197,19 @@ def main() -> int:
 def _mesh_engine_rate(S: int, replicas: int) -> float:
     """End-to-end decisions/s of the full device-plane SMR stack in its
     production bulk shape: full-width PayloadBlocks through the block
-    lane (consensus windows on device, one bulk apply per replica per
-    wave, block futures settled). Delegates to the canonical measurement
-    in benchmarks/mesh_engine_bench.py so the methodology lives in one
+    lane with the device-resident KV table (consensus + apply fused on
+    device, responses derived host-side, block futures settled).
+    Delegates to the canonical measurement in
+    benchmarks/mesh_engine_bench.py so the methodology lives in one
     place."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from benchmarks.mesh_engine_bench import bench_block_lane
 
     return float(
-        bench_block_lane(S, replicas, window=16, waves=4, strict=False)[
-            "decisions_per_sec"
-        ]
+        bench_block_lane(
+            S, replicas, window=64, waves=4, strict=False,
+            device_store=True,
+        )["decisions_per_sec"]
     )
 
 

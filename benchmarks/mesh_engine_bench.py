@@ -75,9 +75,12 @@ def bench_config(
 def bench_block_lane(
     n_shards: int, n_replicas: int, window: int, waves: int,
     strict: bool = True,
+    device_store: bool = False,
 ) -> dict:
     """The bulk lane: full-width PayloadBlocks through submit_block —
-    per-slot host overhead is a queue pop and a future index."""
+    per-slot host overhead is a queue pop and a future index.
+    ``device_store=True`` runs the device-resident KV lane (decide +
+    apply fused on device, 12-byte readback per window)."""
     from rabia_tpu.apps.kvstore import encode_set_bin
     from rabia_tpu.apps.vector_kv import VectorShardedKV
     from rabia_tpu.core.blocks import build_block
@@ -88,6 +91,7 @@ def bench_block_lane(
         n_replicas=n_replicas,
         mesh=make_mesh(),
         window=window,
+        device_store=device_store,
     )
     shards = list(range(n_shards))
     cmds = [[encode_set_bin(f"k{s}", "v")] for s in range(n_shards)]
@@ -112,11 +116,13 @@ def bench_block_lane(
     dt = time.perf_counter() - t0
     if strict:
         assert all(f.done() for f in futs)
+    if device_store and strict:
+        assert eng._dev_active, "device lane demoted during the benchmark"
     return {
         "shards": n_shards,
         "replicas": n_replicas,
         "window": window,
-        "lane": "block",
+        "lane": "block_device" if device_store else "block",
         "applied": applied,
         "elapsed_s": round(dt, 4),
         "decisions_per_sec": round(applied / dt, 1),
@@ -150,13 +156,19 @@ def main() -> None:
         out["s4096_r5_w16_block_lane"]["decisions_per_sec"],
         "decisions/s",
     )
+    for name, (W, waves) in {
+        "s4096_r5_w64_device_store": (64, 4),
+        "s4096_r5_w128_device_store": (128, 4),
+    }.items():
+        out[name] = bench_block_lane(4096, 5, W, waves, device_store=True)
+        print(name, "->", out[name]["decisions_per_sec"], "decisions/s")
 
     if "--record" in sys.argv:
         path = Path(__file__).parent / "results.json"
         doc = json.loads(path.read_text()) if path.exists() else {}
-        doc["mesh_engine_r03"] = out
+        doc["mesh_engine_r04"] = out
         path.write_text(json.dumps(doc, indent=1))
-        print("recorded -> results.json mesh_engine_r03")
+        print("recorded -> results.json mesh_engine_r04")
 
 
 if __name__ == "__main__":

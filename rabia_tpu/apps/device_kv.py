@@ -1,0 +1,374 @@
+"""Device-resident KV table: the SET-dominant block lane's apply plane.
+
+The round-3 MeshEngine applied every decided wave on the HOST (numpy
+hash/probe in :class:`~rabia_tpu.apps.vector_kv.VectorKVStore`), so each
+window cycle paid a device->host readback of the decided plane PLUS a
+host apply pass. This module moves the table itself onto the device and
+fuses "decide the window + apply every decided SET" into ONE jitted
+program per window (VERDICT r03 item 2; reference behavior being
+accelerated: rabia-kvstore/src/store.rs:313-348 apply_batch). Per
+window, only a 3-word status vector crosses the tunnel back: version
+responses are DERIVED on the host (a clean all-V1 full-width window
+advances every shard's version counter by exactly its wave count), so
+the readback is pure latency, not bandwidth.
+
+Tunnel-shaped design (measured on the axon-tunneled v5e: ~100ms
+round-trip latency, ~30MB/s host->device):
+- the host pre-gathers each op's key/value bytes into fixed-width
+  windows bucketed to the LARGEST ACTUAL width in the window (Ku/VWu,
+  power-of-two, not the table max) and packs them as u32 words — the
+  upload carries ~(key+value) bytes per op, no raw-buffer slack;
+- the device table stores keys/values as u32 words too, so matching is
+  word compares and updates are one-hot word selects — byte-wise
+  dynamic-index gathers/scatters measured ~25ms/wave on TPU, the
+  word-select formulation streams at vector speed;
+- per-op versions never travel: ``vers[t, s] = shard_ver[s] + t + 1``
+  on a clean window, computed by the engine from its host-side mirror.
+
+Scope (the fast lane, not a general store): full-width blocks of
+well-formed binary SET ops, one op per covered shard per wave, keys up
+to ``key_lanes*8`` bytes, values up to ``value_width`` bytes, at most
+``per_shard_capacity`` distinct keys per shard. Anything outside that
+envelope — mixed ops, GETs, scalar batches, table overflow, a fault
+outcome — makes the engine DEMOTE: the device state syncs down into the
+host replica stores once and the cycle re-runs on the host path, which
+remains the semantics owner. Behavioral conformance (versions returned,
+final key->value/version content) is pinned against the host store in
+tests/test_device_kv.py.
+
+Table layout (all arrays sharded over the mesh shard axis; K4 = K/4,
+VW4 = VW/4 u32 words):
+  used     bool[S, P]      key_words u32[S, P, K4]  key_len  i32[S, P]
+  version  i32[S, P]       val_words u32[S, P, VW4] val_len  i32[S, P]
+  shard_ver i32[S]
+
+Matching is a FULL-key compare against all P slots of the op's shard
+(P is small; no hashing, no probe loop), so slot layout differs from
+the host store but the observable key->(value, version) mapping cannot.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from rabia_tpu.core.types import V0, V1
+
+__all__ = ["DeviceKVTable", "DeviceWindowOps"]
+
+_SET_HDR = 3  # binary SET op: u8 opcode(1) + u16 klen + key + value
+
+
+def _bucket(n: int, lo: int = 4) -> int:
+    """Round up to a power of two (>= lo, multiple of 4 for u32 views)."""
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
+
+
+class DeviceWindowOps(NamedTuple):
+    """One window's ops packed for device apply (host numpy arrays).
+
+    ``kwin``/``vwin`` are the ops' key/value bytes, zero-padded to the
+    window's bucketed widths and viewed as u32 words — the fused
+    program compares/stores words, never bytes.
+    """
+
+    klen: np.ndarray  # i16[W, S] (0 = no op on this (wave, shard))
+    vlen: np.ndarray  # i16[W, S]
+    kwin: np.ndarray  # u32[W, S, Ku/4]
+    vwin: np.ndarray  # u32[W, S, VWu/4]
+
+
+class DeviceKVTable:
+    """Device twin of the vector store's SET lane (see module doc)."""
+
+    def __init__(
+        self,
+        n_shards: int,
+        kernel,  # MeshPhaseKernel — decide plane + sharding owner
+        *,
+        per_shard_capacity: int = 64,
+        key_lanes: int = 4,
+        value_width: int = 64,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from rabia_tpu.parallel.mesh import SHARD_AXIS
+
+        self.n_shards = int(n_shards)
+        self.kernel = kernel
+        self.S = kernel.S  # padded shard width (mesh-divisible)
+        self.P = int(per_shard_capacity)
+        self.K = int(key_lanes) * 8
+        self.VW = _bucket(int(value_width))
+        self.K4 = self.K // 4
+        self.VW4 = self.VW // 4
+        S, Pc = self.S, self.P
+        shard_sharding = NamedSharding(kernel.mesh, P(SHARD_AXIS))
+        put = lambda a: jax.device_put(a, shard_sharding)
+        self.state = (
+            put(jnp.zeros((S, Pc), bool)),  # used
+            put(jnp.zeros((S, Pc, self.K4), jnp.uint32)),  # key words
+            put(jnp.zeros((S, Pc), jnp.int32)),  # key len
+            put(jnp.zeros((S, Pc), jnp.int32)),  # version
+            put(jnp.zeros((S, Pc, self.VW4), jnp.uint32)),  # value words
+            put(jnp.zeros((S, Pc), jnp.int32)),  # value len
+            put(jnp.zeros((S,), jnp.int32)),  # shard_ver
+        )
+        self._fused = None  # built per (W, Ku4, VWu4) — see decide_apply
+        self._fused_cache: dict = {}
+
+    # -- host-side packing -------------------------------------------------
+
+    def pack_window(self, blocks) -> Optional[DeviceWindowOps]:
+        """Pack ``blocks`` (one per wave, FIFO order) into device inputs.
+
+        Returns None when any wave is outside the fast-lane envelope
+        (non-SET op, >1 op per shard, key/value over the table widths) —
+        the caller demotes to the host path. All numpy, no per-op
+        Python loop."""
+        W = len(blocks)
+        S = self.S
+        parsed = []
+        ku = vu = 4
+        for b in blocks:
+            if not bool((b.counts == 1).all()):
+                return None
+            raw = np.frombuffer(b.data, np.uint8)
+            if len(raw) < _SET_HDR * len(b):
+                return None
+            # cmd_offsets is a prefix-sum (length total+1); with one op
+            # per covered shard, op i starts at cmd_offsets[i]
+            off = b.cmd_offsets[:-1]
+            ln = b.cmd_sizes
+            pad = np.zeros(self.K + _SET_HDR, np.uint8)
+            dbuf = np.concatenate([raw, pad])
+            opcode = dbuf[off]
+            klen = dbuf[off + 1].astype(np.int64) | (
+                dbuf[off + 2].astype(np.int64) << 8
+            )
+            vlen = ln - _SET_HDR - klen
+            ok = (
+                (opcode == 1)
+                & (ln >= _SET_HDR)
+                & (klen > 0)
+                & (klen <= self.K)
+                & (vlen >= 0)
+                & (vlen <= self.VW)
+            )
+            if not bool(ok.all()):
+                return None
+            ku = max(ku, _bucket(int(klen.max())))
+            vu = max(vu, _bucket(int(vlen.max(initial=0))))
+            parsed.append((b, dbuf, off, klen, vlen))
+        klen_w = np.zeros((W, S), np.int16)
+        vlen_w = np.zeros((W, S), np.int16)
+        kwin_w = np.zeros((W, S, ku), np.uint8)
+        vwin_w = np.zeros((W, S, vu), np.uint8)
+        kcols = np.arange(ku)[None, :]
+        vcols = np.arange(vu)[None, :]
+        for t, (b, dbuf, off, klen, vlen) in enumerate(parsed):
+            sh = b.shards
+            klen_w[t, sh] = klen
+            vlen_w[t, sh] = vlen
+            kw = dbuf[(off + _SET_HDR)[:, None] + kcols]
+            kwin_w[t, sh] = np.where(kcols < klen[:, None], kw, 0)
+            # value window may reach past the buffer end for the last op
+            # (vlen masks it); dbuf's pad already covers K+3 of slack,
+            # extend the gather clamp instead of growing the pad
+            vidx = np.minimum(
+                (off + _SET_HDR + klen)[:, None] + vcols, len(dbuf) - 1
+            )
+            vw = dbuf[vidx]
+            vwin_w[t, sh] = np.where(vcols < vlen[:, None], vw, 0)
+        return DeviceWindowOps(
+            klen_w,
+            vlen_w,
+            np.ascontiguousarray(kwin_w).view(np.uint32),
+            np.ascontiguousarray(vwin_w).view(np.uint32),
+        )
+
+    # -- the fused program ---------------------------------------------------
+
+    def _build_fused(self, Ku4: int, VWu4: int):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        kernel = self.kernel
+        S, Pc = self.S, self.P
+        K4, VW4 = self.K4, self.VW4
+        n = self.n_shards
+        I8, I32 = jnp.int8, jnp.int32
+        col = jnp.arange(S) < n  # real (non-padding) shards
+
+        def fused(state, alive, base, depth, ops, *, W, max_phases):
+            # initial votes generated on device: every live replica
+            # proposes V1 for the depth in-window waves of real shards
+            wave = jnp.arange(W, dtype=I32)[:, None] < depth  # [W, 1]
+            present = wave & col[None, :]  # [W, S]
+            votes = jnp.where(
+                present[:, :, None], I8(V1), I8(V0)
+            ) * jnp.ones((1, 1, kernel.R), I8)
+            decided = kernel.slot_window(
+                votes, alive, base, n_slots=W, max_phases=max_phases
+            )  # i8[W, S]
+            all_v1 = jnp.all(jnp.where(present, decided == V1, True))
+
+            # pad the op windows to the table widths once, outside the
+            # scan (zero tails keep prefix-compare == full-key compare)
+            kwin_full = jnp.pad(ops.kwin, ((0, 0), (0, 0), (0, K4 - Ku4)))
+            vwin_full = jnp.pad(ops.vwin, ((0, 0), (0, 0), (0, VW4 - VWu4)))
+
+            def wave_step(carry, inp):
+                used, keyw, klen, ver, valw, vlen, sver = carry
+                ok_w, klen_t, vlen_t, kwin_t, vwin_t = inp
+                # op columns travel as i16 (upload bytes are the tunnel
+                # wall); table arithmetic stays i32
+                klen_t = klen_t.astype(jnp.int32)
+                vlen_t = vlen_t.astype(jnp.int32)
+                # match: word compare against all P slots of the shard;
+                # stored tails beyond the op key are zero, as are the
+                # padded op words, so prefix equality + length equality
+                # IS full-key equality
+                eq = (
+                    used
+                    & (klen == klen_t[:, None])
+                    & (keyw == kwin_t[:, None, :]).all(-1)
+                )  # [S, P]
+                found = eq.any(1)
+                slot = jnp.where(
+                    found, jnp.argmax(eq, 1), jnp.argmax(~used, 1)
+                )
+                full = used.all(1)
+                apply = ok_w & (found | ~full)
+                overflow = jnp.any(ok_w & ~found & full)
+                # updates as one-hot word SELECTS, not dynamic-index
+                # scatters (which lower poorly on TPU)
+                onehot = (
+                    jnp.arange(Pc)[None, :] == slot[:, None]
+                ) & apply[:, None]  # [S, P]
+                oh3 = onehot[:, :, None]
+                used = used | onehot
+                keyw = jnp.where(oh3, kwin_t[:, None, :], keyw)
+                klen = jnp.where(onehot, klen_t[:, None], klen)
+                new_ver = sver + 1
+                ver = jnp.where(onehot, new_ver[:, None], ver)
+                valw = jnp.where(oh3, vwin_t[:, None, :], valw)
+                vlen = jnp.where(onehot, vlen_t[:, None], vlen)
+                sver = jnp.where(apply, new_ver, sver)
+                return (used, keyw, klen, ver, valw, vlen, sver), overflow
+
+            new_state, over_w = lax.scan(
+                wave_step,
+                state,
+                (present, ops.klen, ops.vlen, kwin_full, vwin_full),
+            )
+            flags = jnp.stack(
+                [
+                    all_v1.astype(I32),
+                    jnp.any(over_w).astype(I32),
+                    jnp.any(
+                        new_state[6] >= jnp.int32(2**31 - 2)
+                    ).astype(I32),
+                ]
+            )
+            return new_state, flags
+
+        return jax.jit(fused, static_argnames=("W", "max_phases"))
+
+    def decide_apply(self, alive, base, depth: int, ops: DeviceWindowOps,
+                     W: int, max_phases: int = 4, state=None):
+        """Dispatch one fused decide+apply window. Returns device handles
+        ``(new_state, flags)`` where ``flags`` is i32[3]:
+        ``[all_v1, overflow, ver_overflow]`` — 12 bytes of readback.
+        The caller ADOPTS ``new_state`` only when the flags are clean
+        (and then derives version responses from its host-side counter
+        mirror); otherwise it keeps the old state object (purely
+        functional program — nothing was donated) and demotes."""
+        import jax.numpy as jnp
+
+        if ops.klen.shape[0] < W:
+            # pack_window covers only the depth in-flight waves; pad to
+            # the static window size (filler waves are masked out by the
+            # in-program depth gate)
+            pad = W - ops.klen.shape[0]
+            ops = DeviceWindowOps(
+                *(
+                    np.concatenate(
+                        [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
+                    )
+                    for a in ops
+                )
+            )
+        key = (W, ops.kwin.shape[2], ops.vwin.shape[2])
+        fused = self._fused_cache.get(key)
+        if fused is None:
+            fused = self._build_fused(key[1], key[2])
+            self._fused_cache[key] = fused
+        dev_ops = DeviceWindowOps(*(jnp.asarray(a) for a in ops))
+        return fused(
+            self.state if state is None else state,
+            self.kernel.place(jnp.asarray(alive)),
+            jnp.asarray(base),
+            jnp.int32(depth),
+            dev_ops,
+            W=W,
+            max_phases=max_phases,
+        )
+
+    def adopt(self, new_state) -> None:
+        self.state = new_state
+
+    # -- sync down (demotion / checkpoint) -----------------------------------
+
+    def dump(self) -> dict:
+        """Materialize the table on host: per-entry rows + counters."""
+        used, keyw, klen, ver, valw, vlen, sver = (
+            np.asarray(a) for a in self.state
+        )
+        key_bytes = keyw.view(np.uint8).reshape(self.S, self.P, self.K)
+        val_bytes = valw.view(np.uint8).reshape(self.S, self.P, self.VW)
+        rows = []
+        s_idx, p_idx = np.nonzero(used[: self.n_shards])
+        for s, p in zip(s_idx.tolist(), p_idx.tolist()):
+            rows.append(
+                (
+                    s,
+                    key_bytes[s, p, : klen[s, p]].tobytes(),
+                    val_bytes[s, p, : vlen[s, p]].tobytes(),
+                    int(ver[s, p]),
+                )
+            )
+        return {
+            "rows": rows,
+            "shard_version": sver[: self.n_shards].astype(np.int64),
+        }
+
+    def sync_into(self, sm, dump: Optional[dict] = None) -> None:
+        """Rebuild one host replica store (VectorShardedKV) from the
+        device table. The host store is reset first — in device mode the
+        host replicas saw none of the device lane's applies. Pass a
+        precomputed ``dump()`` when syncing several replicas: the table
+        materialization (a device->host transfer) then happens once."""
+        from rabia_tpu.apps.vector_kv import VectorKVStore
+
+        d = dump if dump is not None else self.dump()
+        store = VectorKVStore(
+            self.n_shards, capacity=max(1 << 10, 2 * len(d["rows"]))
+        )
+        for s, key, val, ver in d["rows"]:
+            lanes, klens = store._lanes_from_keys([key])
+            shards = np.array([s], np.int64)
+            store.bulk_set(shards, lanes, klens, [val])
+            # bulk_set assigned a provisional version; pin the real ones
+            slot = store._lookup(shards, lanes, klens)[0]
+            store.version[slot] = ver
+        store.shard_version[:] = 0
+        store.shard_version[: self.n_shards] = d["shard_version"]
+        sm.store = store

@@ -587,8 +587,12 @@ class RabiaEngine:
         c = self.config
         if not self._notify_wired:
             return c.round_interval
+        # capped by the smallest configured timer interval (a max()
+        # floor above these would delay heartbeats/retransmits past
+        # their configured periods); 0.5ms floor avoids busy-waking
+        # when a test configures a microscopic phase_timeout
         return max(
-            4 * c.round_interval,
+            0.0005,
             min(0.05, c.heartbeat_interval / 4, c.phase_timeout / 8),
         )
 

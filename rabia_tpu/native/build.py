@@ -37,7 +37,10 @@ def lib_path() -> Path:
     return _HERE / f"_transport_{_src_digest()}.so"
 
 
-def _build(target: Path) -> None:
+def _compile(
+    src: Path, target: Path, extra_args: list[str], stale_glob: str,
+    what: str,
+) -> None:
     # compile to a private temp path, then atomically rename: an
     # interrupted or concurrent build (the lock is per-process only) must
     # never leave a truncated .so at the digest-keyed path, which would be
@@ -49,8 +52,8 @@ def _build(target: Path) -> None:
         "-std=c++17",
         "-shared",
         "-fPIC",
-        "-pthread",
-        str(_SRC),
+        *extra_args,
+        str(src),
         "-o",
         str(tmp),
     ]
@@ -58,16 +61,20 @@ def _build(target: Path) -> None:
     if proc.returncode != 0:
         tmp.unlink(missing_ok=True)
         raise InternalError(
-            f"native transport build failed:\n{proc.stderr[-2000:]}"
+            f"native {what} build failed:\n{proc.stderr[-2000:]}"
         )
     os.replace(tmp, target)
     # clean up stale builds of older source versions
-    for old in _HERE.glob("_transport_*.so"):
+    for old in _HERE.glob(stale_glob):
         if old != target:
             try:
                 old.unlink()
             except OSError:
                 pass
+
+
+def _build(target: Path) -> None:
+    _compile(_SRC, target, ["-pthread"], "_transport_*.so", "transport")
 
 
 def _codec_path() -> Path:
@@ -80,32 +87,16 @@ def _codec_path() -> Path:
 def _build_codec(target: Path) -> None:
     import numpy as np
 
-    tmp = target.with_suffix(f".tmp{os.getpid()}")
-    cmd = [
-        "g++",
-        "-O2",
-        "-std=c++17",
-        "-shared",
-        "-fPIC",
-        f"-I{sysconfig.get_paths()['include']}",
-        f"-I{np.get_include()}",
-        str(_CODEC_SRC),
-        "-o",
-        str(tmp),
-    ]
-    proc = subprocess.run(cmd, capture_output=True, text=True)
-    if proc.returncode != 0:
-        tmp.unlink(missing_ok=True)
-        raise InternalError(
-            f"native codec build failed:\n{proc.stderr[-2000:]}"
-        )
-    os.replace(tmp, target)
-    for old in _HERE.glob("_codec_*.so"):
-        if old != target:
-            try:
-                old.unlink()
-            except OSError:
-                pass
+    _compile(
+        _CODEC_SRC,
+        target,
+        [
+            f"-I{sysconfig.get_paths()['include']}",
+            f"-I{np.get_include()}",
+        ],
+        "_codec_*.so",
+        "codec",
+    )
 
 
 def load_codec():

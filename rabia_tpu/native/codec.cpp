@@ -592,6 +592,19 @@ PyObject* codec_encode(PyObject*, PyObject* msg) {
     Py_DECREF(payload);
     Py_RETURN_NONE;  // unsupported: Python codec handles it
   }
+  if (mt == MT_DECISION) {
+    // encode_decision indexes bids with PyList_GET_ITEM; a non-list
+    // sequence (Decision.__init__ accepts any sized iterable) must
+    // fall back to the Python codec, not be reinterpreted as a list
+    PyObject* bids = PyObject_GetAttr(payload, s_bids);
+    if (!bids) { Py_DECREF(payload); return nullptr; }
+    bool ok_bids = bids == Py_None || PyList_Check(bids);
+    Py_DECREF(bids);
+    if (!ok_bids) {
+      Py_DECREF(payload);
+      Py_RETURN_NONE;
+    }
+  }
 
   PyObject* mid = PyObject_GetAttr(msg, s_id);
   PyObject* sender = mid ? PyObject_GetAttr(msg, s_sender) : nullptr;

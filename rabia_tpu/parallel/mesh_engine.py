@@ -208,6 +208,8 @@ class MeshEngine:
         coin_p1: float = 0.5,
         seed: int = 0,
         max_decision_history: int = 4096,
+        device_store: bool = False,
+        device_store_kw: Optional[dict] = None,
     ) -> None:
         if n_shards < 1 or n_replicas < 1:
             raise ValidationError("need at least 1 shard and 1 replica")
@@ -255,6 +257,41 @@ class MeshEngine:
         # compute overlaps the host apply; used only when the engine state
         # it assumed (depth, base slots, alive mask) still holds
         self._spec: Optional[tuple[tuple, object]] = None
+        # device-resident KV lane (apps/device_kv.py): decide + apply
+        # fused in one program per window, only responses cross the
+        # tunnel. Active until any work outside its envelope arrives —
+        # then the device table syncs down into the host replica stores
+        # ONCE and the engine continues on the host path permanently.
+        self._dev = None
+        self._dev_active = False
+        self._dev_spec = None  # speculative chained device window
+        if device_store:
+            from rabia_tpu.apps.device_kv import DeviceKVTable
+
+            if self._multi:
+                # the device lane dispatches host-local inputs against
+                # the global sharding; multi-controller runs need the
+                # make_array_from_callback/allgather discipline of the
+                # host lane (_run_window_multihost)
+                raise ValidationError(
+                    "device_store is single-controller only; multi-host "
+                    "runs use the host-apply lane"
+                )
+            if not all(
+                hasattr(sm, "store") and callable(getattr(sm, "apply_block", None))
+                for sm in self.sms
+            ):
+                raise ValidationError(
+                    "device_store requires VectorShardedKV replica SMs "
+                    "(the demotion target)"
+                )
+            self._dev = DeviceKVTable(
+                self.n_shards, self.kernel, **(device_store_kw or {})
+            )
+            self._dev_active = True
+            # host mirror of the device per-shard version counters:
+            # response versions derive from it (no per-op readback)
+            self._dev_sver = np.zeros(self.S, np.int64)
 
     # -- client surface ------------------------------------------------------
 
@@ -325,10 +362,12 @@ class MeshEngine:
         """Mask replica ``r`` out of every shard's tally (fail-stop)."""
         self.alive[:, r] = False
         self._spec = None  # speculated under the old mask
+        self._dev_spec = None
 
     def heal_replica(self, r: int) -> None:
         self.alive[:, r] = True
         self._spec = None
+        self._dev_spec = None
 
     @property
     def has_quorum(self) -> bool:
@@ -342,8 +381,16 @@ class MeshEngine:
         """
         if self._full_blocks:
             if self._vector and self._queued_entries == 0:
+                if self._dev_active:
+                    return self._run_cycle_fullwidth_device()
                 return self._run_cycle_fullwidth()
             self._demote_full_blocks()  # non-vector SMs materialize per batch
+        if self._dev_active and self._queued_entries:
+            # per-shard / scalar work is outside the device lane's
+            # envelope: hand the authoritative state back to the host
+            # replicas before applying anything there. (An IDLE cycle —
+            # nothing queued at all — must NOT demote.)
+            self._demote_device_store()
         W = self.window
         depth = np.zeros(self.S, np.int64)
         for s in range(self.n_shards):
@@ -402,6 +449,128 @@ class MeshEngine:
         else:
             self._apply_waves_scalar(waves)
         return applied
+
+    def _run_cycle_fullwidth_device(self) -> int:
+        """Full-width lane with the device-resident KV table: consensus
+        window + every decided SET + response versions in ONE fused
+        program; the host does bookkeeping only. Any outcome outside the
+        fast-lane envelope (non-SET ops, key/value over width, table
+        overflow, a fault) demotes to the host path — state is adopted
+        only on a clean all-V1 window, so demotion always re-runs from a
+        consistent table."""
+        from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
+
+        W = self.window
+        n = self.n_shards
+        depth = min(len(self._full_blocks), W)
+        entries = [self._full_blocks[i] for i in range(depth)]  # peek
+        base = np.zeros(self.S, np.int32)
+        base[:n] = self.next_slot
+        key = self._dev_window_key(entries, base)
+        if self._dev_spec is not None and self._dev_spec[0] == key:
+            # the previous cycle already packed, uploaded and dispatched
+            # this window against its (not-yet-adopted) output state
+            new_state, flags_dev = self._dev_spec[1], self._dev_spec[2]
+        else:
+            ops = self._dev.pack_window([e[0] for e in entries])
+            if ops is None:
+                self._dev_spec = None
+                self._demote_device_store()
+                return self.run_cycle()
+            new_state, flags_dev = self._dev.decide_apply(
+                self.alive, base, depth, ops, W=W,
+                max_phases=self.max_phases,
+            )
+        self._dev_spec = None
+        self.cycles += 1
+        # speculate the NEXT window before this one's readback: pack +
+        # upload + dispatch against the chained (unadopted) state, so
+        # device compute and the host->device transfer overlap this
+        # cycle's flag round-trip. The program is functional — a fault
+        # outcome simply discards the whole chain.
+        if len(self._full_blocks) > depth:
+            depth2 = min(len(self._full_blocks) - depth, W)
+            entries2 = [
+                self._full_blocks[depth + i] for i in range(depth2)
+            ]
+            base2 = base.copy()
+            base2[:n] += depth
+            ops2 = self._dev.pack_window([e[0] for e in entries2])
+            if ops2 is not None:
+                spec = self._dev.decide_apply(
+                    self.alive, base2, depth2, ops2, W=W,
+                    max_phases=self.max_phases, state=new_state,
+                )
+                self._dev_spec = (
+                    self._dev_window_key(entries2, base2),
+                    spec[0],
+                    spec[1],
+                )
+        flags = np.asarray(flags_dev)  # 12 bytes: the ONLY readback
+        if not flags[0] or flags[1] or flags[2]:
+            # the program is functional: nothing was adopted, the table
+            # still holds the pre-window state — sync it down and let
+            # the host path re-decide (deterministic kernel) and apply.
+            # Any speculative chain built on this window dies with it.
+            self._dev_spec = None
+            self._demote_device_store()
+            return self.run_cycle()
+        self._dev.adopt(new_state)
+        # version responses are DERIVED, not transferred: a clean
+        # all-V1 full-width window advances every covered shard's
+        # version by exactly one per wave, so the host mirror + wave
+        # index reproduces the device counters bit-for-bit (pinned by
+        # tests/test_device_kv.py against the host store)
+        vers = (
+            self._dev_sver[None, : self.S]
+            + np.arange(1, W + 1, dtype=np.int64)[:, None]
+        )
+        self._dev_sver[:n] += depth
+        for _ in range(depth):
+            self._full_blocks.popleft()
+        start = self.next_slot.copy()
+        self.next_slot[:n] += depth
+        self.decided_v1 += depth * n
+        for t, (block, bfut, inv) in enumerate(entries):
+            self._bulk_log.append((start, t, block, inv))
+        while len(self._bulk_log) > max(
+            1, self.max_decision_history // max(1, self.window)
+        ):
+            self._bulk_log.popleft()
+        # settle futures from the device's version responses; counts==1
+        # per covered shard (pack_window enforced it), so group bounds
+        # are the identity
+        for t, (block, bfut, _inv) in enumerate(entries):
+            row = vers[t, np.asarray(block.shards, np.int64)]
+            frames = VectorShardedKV._vers_frames(row)
+            bounds = np.arange(len(block) + 1, dtype=np.int64)
+            bfut._settle_bulk(FrameGroups(frames, bounds))
+        return depth * n
+
+    def _dev_window_key(self, entries, base) -> tuple:
+        """Identity of a device window dispatch: the exact blocks (by
+        object id — the FIFO holds them alive), slot base and alive
+        mask the speculation assumed."""
+        return (
+            tuple(id(e[0]) for e in entries),
+            base.tobytes(),
+            self.alive.tobytes(),
+        )
+
+    def _demote_device_store(self) -> None:
+        """Leave device-store mode: the device table becomes the host
+        replica stores' content (rebuilt from scratch — in device mode
+        the host replicas saw none of the applies)."""
+        if not self._dev_active:
+            return
+        self._dev_active = False
+        d = self._dev.dump()  # ONE table materialization for all replicas
+        for sm in self.sms:
+            self._dev.sync_into(sm, dump=d)
+        logger.info(
+            "device KV lane demoted to host stores (%d entries)",
+            len(d["rows"]),
+        )
 
     def _run_cycle_fullwidth(self) -> int:
         """Vectorized happy path: the pending work is a FIFO of
@@ -787,6 +956,14 @@ class MeshEngine:
         (the transport engine's PersistedEngineState, same shape)."""
         from rabia_tpu.core.persistence import PersistedEngineState
 
+        if self._dev_active:
+            # the device table is authoritative in device mode: reflect
+            # it into the host replicas so the snapshot below sees it
+            # (device mode stays active; the host copies are snapshots)
+            d = self._dev.dump()
+            for sm in self.sms:
+                self._dev.sync_into(sm, dump=d)
+
         return PersistedEngineState(
             current_phase=int(self.next_slot.max(initial=0)),
             last_committed_phase=int(self.next_slot.sum()),
@@ -804,6 +981,9 @@ class MeshEngine:
         if self._has_pending():
             raise RabiaError("restore requires an idle engine")
         self._spec = None  # speculated on pre-restore slot counters
+        # a restored snapshot supersedes any device-lane state: continue
+        # on the host path (no sync — the checkpoint IS the state)
+        self._dev_active = False
         committed = np.asarray(
             state.per_shard_committed[: self.n_shards], np.int64
         )

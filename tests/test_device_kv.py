@@ -1,0 +1,212 @@
+"""Device-resident KV lane conformance vs the host vector store.
+
+The device table (apps/device_kv.py) is a bounded fast lane; the host
+VectorShardedKV is the semantics owner. Every test drives the SAME
+full-width SET workload through a device-store MeshEngine and a host
+MeshEngine and compares the observables: per-op version responses, and
+the final key -> (value, version) content after demotion/sync-down.
+Runs on the virtual CPU mesh (conftest pins JAX to CPU).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rabia_tpu.apps.kvstore import encode_set_bin
+from rabia_tpu.apps.vector_kv import VectorShardedKV
+from rabia_tpu.core.blocks import build_block
+from rabia_tpu.parallel import MeshEngine, make_mesh
+
+
+def _mk(n_shards, device: bool, **kw):
+    return MeshEngine(
+        lambda: VectorShardedKV(n_shards, capacity=1 << 12),
+        n_shards=n_shards,
+        n_replicas=3,
+        mesh=make_mesh(),
+        window=4,
+        device_store=device,
+        **kw,
+    )
+
+
+def _frames(fut):
+    """Flatten a block future's responses to a list of frame bytes."""
+    return [bytes(g[0]) for g in fut.result_groups()] if hasattr(
+        fut, "result_groups"
+    ) else [bytes(r[0]) for r in fut._results]
+
+
+def _set_blocks(n_shards, waves, rng, keyspace=3):
+    """Random full-width SET blocks: repeated keys across waves, varied
+    value lengths (collision + update coverage)."""
+    out = []
+    for w in range(waves):
+        cmds = []
+        for s in range(n_shards):
+            k = f"k{s}_{int(rng.integers(0, keyspace))}"
+            v = "v" * int(rng.integers(0, 24)) + f"{w}"
+            cmds.append([encode_set_bin(k, v)])
+        out.append(build_block(list(range(n_shards)), cmds))
+    return out
+
+
+def _store_content(sm: VectorShardedKV, n_shards):
+    st = sm.store
+    out = {}
+    used = np.nonzero(st.state == 1)[0]
+    for slot in used.tolist():
+        s = int(st.shard_col[slot])
+        key = (
+            st.key_lanes[slot]
+            .view(np.uint8)[: int(st.key_len[slot])]
+            .tobytes()
+        )
+        out[(s, key)] = (sm.store._value_at(slot), int(st.version[slot]))
+    return out
+
+
+class TestDeviceKVConformance:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_versions_and_state_match_host(self, seed):
+        n = 8
+        rng = np.random.default_rng(seed)
+        blocks = _set_blocks(n, waves=6, rng=rng)
+        dev = _mk(n, device=True)
+        host = _mk(n, device=False)
+        dev_futs = [dev.submit_block(b) for b in blocks]
+        # identical blocks, fresh identity, through the host engine
+        host_blocks = _set_blocks(n, waves=6, rng=np.random.default_rng(seed))
+        host_futs = [host.submit_block(b) for b in host_blocks]
+        assert dev.flush() == host.flush() == 6 * n
+        assert dev._dev_active  # clean SET windows: no demotion
+        for df, hf in zip(dev_futs, host_futs):
+            d = [list(map(bytes, g)) for g in df._results] if isinstance(
+                df._results, list
+            ) else None
+            h = [list(map(bytes, g)) for g in hf._results] if isinstance(
+                hf._results, list
+            ) else None
+            assert d == h
+        # demote and compare the final store content on every replica
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+        # slot accounting marched identically
+        assert np.array_equal(dev.next_slot, host.next_slot)
+        assert dev.decided_v1 == host.decided_v1
+
+    def test_mixed_block_demotes_and_stays_correct(self):
+        import struct
+        encode_get_bin = lambda k: bytes([2]) + struct.pack("<H", len(k)) + k.encode()
+
+        n = 4
+        rng = np.random.default_rng(7)
+        dev = _mk(n, device=True)
+        host = _mk(n, device=False)
+        sets = _set_blocks(n, waves=2, rng=rng)
+        for b in sets:
+            dev.submit_block(b)
+        for b in _set_blocks(n, waves=2, rng=np.random.default_rng(7)):
+            host.submit_block(b)
+        dev.flush()
+        host.flush()
+        assert dev._dev_active
+        # a GET block is outside the lane's envelope -> demotion, and the
+        # GET must read the device-written values through the host store
+        getb = build_block(
+            list(range(n)), [[encode_get_bin(f"k{s}_0")] for s in range(n)]
+        )
+        getb_h = build_block(
+            list(range(n)), [[encode_get_bin(f"k{s}_0")] for s in range(n)]
+        )
+        df, hf = dev.submit_block(getb), host.submit_block(getb_h)
+        dev.flush()
+        host.flush()
+        assert not dev._dev_active  # demoted
+        d = [list(map(bytes, g)) for g in df._results]
+        h = [list(map(bytes, g)) for g in hf._results]
+        assert d == h
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+
+    def test_fault_demotes_without_corruption(self):
+        n = 4
+        rng = np.random.default_rng(3)
+        dev = _mk(n, device=True)
+        host = _mk(n, device=False)
+        for b in _set_blocks(n, waves=2, rng=rng):
+            dev.submit_block(b)
+        for b in _set_blocks(n, waves=2, rng=np.random.default_rng(3)):
+            host.submit_block(b)
+        dev.flush()
+        host.flush()
+        # crash a MINORITY replica: quorum holds, every slot still
+        # decides V1, and the device lane keeps going — fault tolerance
+        # without demotion (only a quorum-losing window demotes)
+        dev.crash_replica(2)
+        host.crash_replica(2)
+        for b in _set_blocks(n, waves=2, rng=np.random.default_rng(4)):
+            dev.submit_block(b)
+        for b in _set_blocks(n, waves=2, rng=np.random.default_rng(4)):
+            host.submit_block(b)
+        assert dev.flush() == host.flush()
+        assert dev._dev_active  # minority crash rides the device lane
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+        assert np.array_equal(dev.next_slot, host.next_slot)
+
+    def test_overflow_demotes(self):
+        n = 2
+        dev = _mk(n, device=True, device_store_kw={"per_shard_capacity": 4})
+        # 6 distinct keys per shard exceeds the 4-slot device table
+        for w in range(6):
+            dev.submit_block(
+                build_block(
+                    list(range(n)),
+                    [[encode_set_bin(f"key{w}", "x")] for _ in range(n)],
+                )
+            )
+        assert dev.flush() == 6 * n
+        assert not dev._dev_active  # overflowed -> demoted mid-stream
+        # every key present with version == its wave's shard version
+        ref = _mk(n, device=False)
+        for w in range(6):
+            ref.submit_block(
+                build_block(
+                    list(range(n)),
+                    [[encode_set_bin(f"key{w}", "x")] for _ in range(n)],
+                )
+            )
+        ref.flush()
+        assert _store_content(dev.sms[0], n) == _store_content(ref.sms[0], n)
+
+    def test_idle_run_cycle_does_not_demote(self):
+        n = 4
+        dev = _mk(n, device=True)
+        assert dev.run_cycle() == 0  # nothing queued: a no-op, not work
+        assert dev._dev_active
+        for b in _set_blocks(n, waves=2, rng=np.random.default_rng(5)):
+            dev.submit_block(b)
+        assert dev.flush() == 2 * n
+        assert dev._dev_active
+
+    def test_checkpoint_reflects_device_state(self):
+        n = 4
+        dev = _mk(n, device=True)
+        for b in _set_blocks(n, waves=3, rng=np.random.default_rng(9)):
+            dev.submit_block(b)
+        dev.flush()
+        assert dev._dev_active
+        cp = dev.checkpoint()
+        assert dev._dev_active  # checkpoint does not leave device mode
+        fresh = _mk(n, device=False)
+        fresh.restore(cp)
+        want = _store_content(fresh.sms[0], n)
+        dev._demote_device_store()
+        assert _store_content(dev.sms[0], n) == want

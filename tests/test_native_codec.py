@@ -117,6 +117,24 @@ class TestNativeCodecParity:
         )
         _roundtrip_both(ProtocolMessage.new(NodeId.from_int(5), d))
 
+    def test_decision_tuple_bids_falls_back(self):
+        # Decision.__init__ accepts any sized iterable for bids; the
+        # native encoder only fast-paths lists and must DECLINE a tuple
+        # (not reinterpret it as a PyListObject)
+        n = 2
+        d = Decision(
+            shards=np.arange(n, dtype=np.int64),
+            phases=np.arange(n, dtype=np.int64),
+            vals=np.ones(n, np.int8),
+            bids=[BatchId(uuid.UUID(int=7)), None],
+        )
+        d.bids = tuple(d.bids)  # __slots__ class: plain attribute write
+        msg = ProtocolMessage.new(NodeId.from_int(3), d)
+        assert native.encode(msg) is None
+        ser = BinarySerializer()
+        out = ser._deserialize_py(ser._serialize_py(msg))
+        assert out.payload.bids == list(d.bids) or tuple(out.payload.bids) == d.bids
+
     def test_heartbeat_syncrequest(self):
         nid = NodeId.from_int(6)
         _roundtrip_both(
