@@ -64,6 +64,19 @@ class NetworkTransport(abc.ABC):
     async def broadcast(self, data: bytes) -> None:
         """Deliver to every connected peer (excluding self)."""
 
+    def send_to_nowait(self, target: NodeId, data: bytes) -> bool:
+        """Optional synchronous non-blocking send. Returns True when the
+        transport completed (or best-effort dropped) the send inline;
+        False when it has no sync path — the caller awaits ``send_to``.
+        Transports whose sends complete without suspending (the in-memory
+        hub, the native TCP library's lock-free enqueue) override this so
+        the engine's hot loop avoids one task spawn per outbound frame."""
+        return False
+
+    def broadcast_nowait(self, data: bytes) -> bool:
+        """Synchronous twin of ``broadcast`` (see ``send_to_nowait``)."""
+        return False
+
     @abc.abstractmethod
     async def receive(self, timeout: Optional[float] = None) -> tuple[NodeId, bytes]:
         """Next inbound (sender, payload); raises TimeoutError_ on timeout."""

@@ -486,6 +486,12 @@ def _native_codec():
                 safe_unknown=uuid.SafeUUID.unknown,
                 SerializationError=SerializationError,
                 crc32=zlib.crc32,
+                Propose=Propose,
+                NewBatch=NewBatch,
+                CommandBatch=CommandBatch,
+                Command=Command,
+                ShardId=ShardId,
+                StateValue=StateValue,
             )
             _NATIVE_CODEC = mod
     return _NATIVE_CODEC
@@ -494,10 +500,10 @@ def _native_codec():
 class BinarySerializer:
     """Compact binary codec (serialization.rs:66-98 analog; custom layout).
 
-    Hot frame types (vote vectors, Decision, ProposeBlock, HeartBeat,
-    SyncRequest) encode/decode through the native C extension when it is
-    available; everything else — and every byte of wire format — stays
-    owned by the Python paths below."""
+    Hot frame types (vote vectors, Decision, Propose/NewBatch command
+    batches, ProposeBlock, HeartBeat, SyncRequest) encode/decode through
+    the native C extension when it is available; everything else — and
+    every byte of wire format — stays owned by the Python paths below."""
 
     def __init__(self, config: SerializationConfig | None = None):
         self.config = config or SerializationConfig()
@@ -505,7 +511,11 @@ class BinarySerializer:
 
     def serialize(self, msg: ProtocolMessage) -> bytes:
         if self._native is not None:
-            out = self._native.encode(msg)
+            # the threshold makes the native codec decline batch bodies
+            # the Python path might compress (parity stays byte-for-byte)
+            out = self._native.encode(
+                msg, self.config.compression_threshold or 0
+            )
             if out is not None:
                 return out
         return self._serialize_py(msg)

@@ -262,6 +262,14 @@ class RabiaEngine:
             self._inbox2 = np.full((self.S, self.R), ABSENT, np.int8)
         self._shard_ids = np.arange(self.S, dtype=np.int64)
         self._apply_dirty: set[int] = set()
+        # native columnar helpers (hostkernel.cpp); None -> numpy paths
+        from rabia_tpu.native.build import load_hostkernel
+
+        self._hk_lib = load_hostkernel()
+        self._open_bufs = (
+            np.zeros(self.n_shards, np.int64),
+            np.zeros(self.n_shards, np.uint8),
+        )
 
         # block lane (bulk proposals — rabia_tpu.core.blocks):
         # registry of live blocks by small int handle; columnar bindings
@@ -993,6 +1001,31 @@ class RabiaEngine:
         drop, taint-traffic marking, votes-seen tracking for slot opening.
         """
         n = self.n_shards
+        if shards.shape[0] == 1:
+            # scalar fast path: the serial/low-shard deployment shape
+            # sends one-entry vote vectors, where every fancy-indexing
+            # step below costs more than the whole scalar transcription
+            rt = self.rt
+            s = shards[0].item()
+            if s < 0 or s >= n:
+                return
+            ph = phases[0].item()
+            slot = ph >> 16
+            if slot < rt.applied_upto[s]:
+                self._repair_stale_sender(
+                    row, shards, np.asarray([slot], np.int64)
+                )
+                return
+            if slot < rt.tainted_upto[s]:
+                rt.taint_traffic[s] = time.time()
+            if slot > rt.votes_seen_slot[s]:
+                rt.votes_seen_slot[s] = slot
+            stash = self._stash1 if round_no == 1 else self._stash2
+            # fully scalar entry — _route_votes dispatches on type(shards)
+            stash.append(
+                (row, s, slot, ph & _MVC_MASK, vals[0].item())
+            )
+            return
         # full bounds check here (the wire validator no longer scans vote
         # vectors element-wise): negative or oversized indices would
         # wrap/raise in every fancy-indexing step below
@@ -1091,6 +1124,35 @@ class RabiaEngine:
             stash.clear()
             carry.clear()
             for row, shards, slots, mvcs, vals in items:
+                if type(shards) is int:
+                    # scalar entry (one-vote vector, see ingest fast path)
+                    s = shards
+                    if slots < self.rt.applied_upto[s]:
+                        continue  # stale: decided+applied while stashed
+                    if (
+                        self.rt.in_flight[s]
+                        and slots == self._cur_slot[s]
+                        and mvcs == self._cur_phase[s]
+                    ):
+                        if self._host_kernel:
+                            led = (
+                                self.kstate.led1
+                                if round_no == 1
+                                else self.kstate.led2
+                            )
+                            if led[row, s] == ABSENT:
+                                led[row, s] = vals
+                        else:
+                            plane = (
+                                self._inbox1
+                                if round_no == 1
+                                else self._inbox2
+                            )
+                            if plane[s, row] == ABSENT:
+                                plane[s, row] = vals
+                    else:
+                        carry.append((row, s, slots, mvcs, vals))
+                    continue
                 live = slots >= self.rt.applied_upto[shards]
                 if not live.all():
                     shards, slots, mvcs, vals = (
@@ -1132,10 +1194,13 @@ class RabiaEngine:
         # accumulate without limit (validation bounds phase jumps, but a
         # malicious/buggy peer could still flood)
         for carry in (self._carry1, self._carry2):
-            total = sum(len(t[1]) for t in carry)
+            total = sum(
+                1 if type(t[1]) is int else len(t[1]) for t in carry
+            )
             cap = 8 * self.S * self.R
             while carry and total > cap:
-                total -= len(carry.pop(0)[1])
+                t = carry.pop(0)[1]
+                total -= 1 if type(t) is int else len(t)
 
     def _on_decision(self, p: Decision) -> None:
         """Vectorized decision ingest: current-slot decisions go straight to
@@ -1281,16 +1346,31 @@ class RabiaEngine:
         """
         n = self.n_shards
         rt = self.rt
-        head = np.maximum(rt.next_slot[:n], rt.applied_upto[:n])
-        cand = ~rt.in_flight[:n] & (
-            (rt.queue_len[:n] > 0)
-            | rt.prop_flag[:n]
-            | rt.dec_flag[:n]
-            | (rt.votes_seen_slot[:n] >= head)
-            | (rt.tainted_upto[:n] > 0)
-        )
-        if not cand.any():
-            return []
+        lib = self._hk_lib
+        if lib is not None:
+            # one C pass over the columns; an idle tick costs one int
+            head, cand = self._open_bufs
+            if not lib.rk_open_scan(
+                n,
+                rt.next_slot.ctypes.data, rt.applied_upto.ctypes.data,
+                rt.in_flight.ctypes.data, rt.queue_len.ctypes.data,
+                rt.prop_flag.ctypes.data, rt.dec_flag.ctypes.data,
+                rt.votes_seen_slot.ctypes.data,
+                rt.tainted_upto.ctypes.data,
+                head.ctypes.data, cand.ctypes.data,
+            ):
+                return []
+        else:
+            head = np.maximum(rt.next_slot[:n], rt.applied_upto[:n])
+            cand = ~rt.in_flight[:n] & (
+                (rt.queue_len[:n] > 0)
+                | rt.prop_flag[:n]
+                | rt.dec_flag[:n]
+                | (rt.votes_seen_slot[:n] >= head)
+                | (rt.tainted_upto[:n] > 0)
+            )
+            if not cand.any():
+                return []
         now = time.time()
         grace = min(max(self.config.phase_timeout / 10.0, 0.02), 1.0)
         opened: list[tuple[int, int, int]] = []
@@ -1622,19 +1702,23 @@ class RabiaEngine:
         n = self.n_shards
         rt = self.rt
         act = rt.in_flight[:n]
-        if not act.any():
+        # nonzero-once (then branch on idx.size): at small S the repeated
+        # tiny-array .any() dispatches dominate the outbox cost
+        cast_idx = np.nonzero(np.asarray(outbox.cast_r2)[:n] & act)[0]
+        done = np.asarray(self._done)[:n] & act
+        adv_all_idx = np.nonzero(np.asarray(outbox.advanced)[:n] & act)[0]
+        adv_idx = adv_all_idx[~done[adv_all_idx]]
+        done_idx = np.nonzero(done)[0]
+        if not (cast_idx.size or adv_all_idx.size or done_idx.size):
             return
         now = time.time()
-        cast_r2 = np.asarray(outbox.cast_r2)[:n] & act
-        advanced = np.asarray(outbox.advanced)[:n] & act
-        done = np.asarray(self._done)[:n] & act
         # a stage transition may have made ledger-resident (or carried)
         # votes decisive — schedule one follow-up step (see _tick)
-        if cast_r2.any() or advanced.any():
+        if cast_idx.size or adv_all_idx.size:
             self._restep = True
 
-        if cast_r2.any():
-            idx = np.nonzero(cast_r2)[0]
+        if cast_idx.size:
+            idx = cast_idx
             slots = np.asarray(self._cur_slot)[idx].astype(np.int64)
             phases = (slots << 16) | np.asarray(prev_phase)[idx].astype(np.int64)
             self._send(
@@ -1646,9 +1730,8 @@ class RabiaEngine:
             )
             rt.last_progress[idx] = now
 
-        adv = advanced & ~done
-        if adv.any():
-            idx = np.nonzero(adv)[0]
+        if adv_idx.size:
+            idx = adv_idx
             slots = np.asarray(self._cur_slot)[idx].astype(np.int64)
             phases = (slots << 16) | np.asarray(outbox.new_phase)[idx].astype(
                 np.int64
@@ -1662,7 +1745,7 @@ class RabiaEngine:
             )
             rt.last_progress[idx] = now
 
-        if done.any():
+        if done_idx.size:
             newly = np.asarray(outbox.newly_decided)[:n] & act
             self._process_decided(done, newly)
 
@@ -2362,7 +2445,20 @@ class RabiaEngine:
                 recipient or "broadcast",
             )
             return
-        if recipient is None:
-            self._spawn(self.transport.broadcast(data))
-        else:
-            self._spawn(self.transport.send_to(recipient, data))
+        try:
+            if recipient is None:
+                if self.transport.broadcast_nowait(data):
+                    return
+                self._spawn(self.transport.broadcast(data))
+            else:
+                if self.transport.send_to_nowait(recipient, data):
+                    return
+                self._spawn(self.transport.send_to(recipient, data))
+        except Exception:
+            # same containment as the codec guard above: one bad send
+            # must not kill the run loop (peers recover via retransmit)
+            logger.exception(
+                "dropping failed send of %s to %s",
+                type(payload).__name__,
+                recipient or "broadcast",
+            )

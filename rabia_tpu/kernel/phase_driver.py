@@ -81,6 +81,16 @@ def _mix32(h):
     return h
 
 
+def coin_threshold(p1: float) -> int:
+    """uint32 acceptance threshold for coin probability ``p1``.
+
+    Bit-identity-critical: every coin implementation (this XLA/numpy
+    kernel, the numpy host kernel, and native/hostkernel.cpp) must derive
+    the threshold from ``p1`` with EXACTLY this rounding/clamping, or
+    replicas on different backends flip different coins."""
+    return min(int(p1 * 4294967296.0), 4294967295)
+
+
 def _coin_bits(seed, shard, slot, phase, p1: float, xp=jnp):
     """Common-coin values for (shard, slot, phase) triples (same shape).
 
@@ -97,7 +107,7 @@ def _coin_bits(seed, shard, slot, phase, p1: float, xp=jnp):
     h = _mix32(h ^ (shard.astype(u32) + u32(_GOLD)))
     h = _mix32(h ^ (slot.astype(u32) + u32(_GOLD)))
     h = _mix32(h ^ (phase.astype(u32) + u32(_GOLD)))
-    threshold = u32(min(int(p1 * 4294967296.0), 4294967295))
+    threshold = u32(coin_threshold(p1))
     return xp.where(h < threshold, xp.int8(V1), xp.int8(V0))
 
 

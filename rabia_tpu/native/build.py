@@ -1,9 +1,12 @@
 """Lazy g++ build + loaders for the native libraries.
 
-Two artifacts, both digest-keyed and built on first use:
-- ``transport.cpp`` -> ctypes CDLL (the TCP data plane)
-- ``codec.cpp``     -> CPython extension module (the binary message
+Three artifacts, all digest-keyed and built on first use:
+- ``transport.cpp``  -> ctypes CDLL (the TCP data plane)
+- ``codec.cpp``      -> CPython extension module (the binary message
   codec, SURVEY §2 C9's native component)
+- ``hostkernel.cpp`` -> ctypes CDLL (the engine's per-activation
+  consensus step; numpy twin in kernel/host_driver.py stays the
+  semantics owner)
 """
 
 from __future__ import annotations
@@ -22,10 +25,13 @@ from rabia_tpu.core.errors import InternalError
 _HERE = Path(__file__).parent
 _SRC = _HERE / "transport.cpp"
 _CODEC_SRC = _HERE / "codec.cpp"
+_HK_SRC = _HERE / "hostkernel.cpp"
 _LOCK = threading.Lock()
 _CACHED: ctypes.CDLL | None = None
 _CODEC_CACHED = None
 _CODEC_FAILED: str | None = None
+_HK_CACHED: ctypes.CDLL | None = None
+_HK_FAILED: str | None = None
 
 
 def _src_digest() -> str:
@@ -129,6 +135,62 @@ def load_codec():
             return None
         _CODEC_CACHED = mod
         return mod
+
+
+def _hk_path() -> Path:
+    digest = hashlib.blake2s(_HK_SRC.read_bytes(), digest_size=8).hexdigest()
+    return _HERE / f"_hostkernel_{digest}.so"
+
+
+def load_hostkernel() -> ctypes.CDLL | None:
+    """Build (if needed) and dlopen the host-kernel step library.
+
+    Returns the CDLL with prototypes set, or None when unavailable —
+    callers fall back to the numpy step, which stays the semantics
+    owner. ``RABIA_PY_HOSTKERNEL=1`` forces the numpy step
+    (debug/differential testing)."""
+    global _HK_CACHED, _HK_FAILED
+    if os.environ.get("RABIA_PY_HOSTKERNEL"):
+        return None
+    with _LOCK:
+        if _HK_CACHED is not None:
+            return _HK_CACHED
+        if _HK_FAILED is not None:
+            return None
+        try:
+            target = _hk_path()
+            if not target.exists():
+                _compile(
+                    _HK_SRC, target, ["-O3"], "_hostkernel_*.so",
+                    "hostkernel",
+                )
+            lib = ctypes.CDLL(os.fspath(target))
+        except Exception as e:  # noqa: BLE001 - any failure means fallback
+            _HK_FAILED = str(e)
+            return None
+        # pointer args are c_void_p: callers pass raw ndarray.ctypes.data
+        # ints (cheapest ctypes marshalling on the per-activation path)
+        p = ctypes.c_void_p
+        lib.rk_node_step.restype = None
+        lib.rk_node_step.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_uint32, ctypes.c_uint32,
+            p, p, p, p, p, p, p, p, p, p, p,
+            p, p, p, p,
+        ]
+        lib.rk_start_slots.restype = None
+        lib.rk_start_slots.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            p, p, p,
+            p, p, p, p, p, p, p, p, p, p,
+        ]
+        lib.rk_open_scan.restype = ctypes.c_int32
+        lib.rk_open_scan.argtypes = [
+            ctypes.c_int32, p, p, p, p, p, p, p, p, p, p,
+        ]
+        _HK_CACHED = lib
+        return lib
 
 
 def load_library() -> ctypes.CDLL:

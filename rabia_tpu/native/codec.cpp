@@ -5,10 +5,11 @@
 // codec, :152-169 pooled zero-alloc path). This extension implements the
 // SAME wire format as rabia_tpu/core/serialization.py (version 3,
 // hand-rolled little-endian) for the latency-critical frame types —
-// VoteRound1/VoteRound2 (packed vote vectors), Decision, ProposeBlock,
-// HeartBeat, SyncRequest — and returns None for everything else so the
-// Python codec remains the semantics owner and fallback. Byte-for-byte
-// compatibility is pinned by tests/test_native_codec.py.
+// VoteRound1/VoteRound2 (packed vote vectors), Decision, Propose and
+// NewBatch (command batches), ProposeBlock, HeartBeat, SyncRequest — and
+// returns None for everything else so the Python codec remains the
+// semantics owner and fallback. Byte-for-byte compatibility is pinned by
+// tests/test_native_codec.py.
 //
 // Built as a CPython extension (not ctypes): the cost of the Python
 // codec is object construction and bytecode, not byte shuffling, so the
@@ -40,10 +41,12 @@ constexpr uint8_t FLAG_COMPRESSED = 0x01;
 constexpr uint8_t FLAG_HAS_RECIPIENT = 0x02;
 
 // MessageType codes (core/messages.py MessageType — order stable)
+constexpr uint8_t MT_PROPOSE = 1;
 constexpr uint8_t MT_VOTE1 = 2;
 constexpr uint8_t MT_VOTE2 = 3;
 constexpr uint8_t MT_DECISION = 4;
 constexpr uint8_t MT_SYNCREQ = 5;
+constexpr uint8_t MT_NEWBATCH = 7;
 constexpr uint8_t MT_HEARTBEAT = 8;
 constexpr uint8_t MT_PROPOSE_BLOCK = 10;
 
@@ -58,6 +61,12 @@ PyObject* g_ProposeBlock = nullptr;
 PyObject* g_PayloadBlock = nullptr;
 PyObject* g_NodeId = nullptr;
 PyObject* g_BatchId = nullptr;
+PyObject* g_Propose = nullptr;
+PyObject* g_NewBatch = nullptr;
+PyObject* g_CommandBatch = nullptr;
+PyObject* g_Command = nullptr;
+PyObject* g_ShardId = nullptr;
+PyObject* g_StateValue = nullptr;
 PyObject* g_UUID = nullptr;
 PyObject* g_safe_unknown = nullptr;  // uuid.SafeUUID.unknown
 PyObject* g_SerializationError = nullptr;
@@ -72,6 +81,8 @@ PyObject* s_shards; PyObject* s_phases; PyObject* s_vals; PyObject* s_bids;
 PyObject* s_current_phase; PyObject* s_committed_phase; PyObject* s_state_version;
 PyObject* s_block; PyObject* s_slots; PyObject* s_counts; PyObject* s_cmd_sizes;
 PyObject* s_data; PyObject* s_total_commands;
+PyObject* s_shard; PyObject* s_phase; PyObject* s_batch_id; PyObject* s_batch;
+PyObject* s_commands;
 
 inline void wr_u32(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
 inline void wr_u64(uint8_t* p, uint64_t v) { memcpy(p, &v, 8); }
@@ -300,6 +311,27 @@ bool put_u64_attr(Buf& b, PyObject* payload, PyObject* name) {
   return b.put_u64(x);
 }
 
+// zlib-compatible CRC-32 (IEEE 0xEDB88320), table built on first use —
+// CommandBatch.checksum() chains crc32 over (id bytes, data) per command,
+// which would cost one Python call per piece via g_crc32
+uint32_t crc32_table[256];
+bool crc32_ready = false;
+uint32_t crc32_run(uint32_t crc, const uint8_t* buf, size_t n) {
+  if (!crc32_ready) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc32_table[i] = c;
+    }
+    crc32_ready = true;
+  }
+  crc = ~crc;
+  for (size_t i = 0; i < n; i++)
+    crc = crc32_table[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
 uint32_t crc32_of(PyObject* data_bytes, bool* ok) {
   PyObject* r = PyObject_CallFunctionObjArgs(g_crc32, data_bytes, nullptr);
   if (!r) { *ok = false; return 0; }
@@ -367,6 +399,205 @@ bool encode_block(Buf& b, PyObject* payload) {
   Py_XDECREF(bid); Py_XDECREF(sh); Py_XDECREF(sl); Py_XDECREF(ct);
   Py_XDECREF(cs); Py_XDECREF(data); Py_XDECREF(tot); Py_DECREF(blk);
   return ok;
+}
+
+// u32/u64 from an int-like attribute (plain int, numpy integer, IntEnum)
+bool u64_attr_val(PyObject* obj, PyObject* name, uint64_t* out) {
+  PyObject* v = PyObject_GetAttr(obj, name);
+  if (!v) return false;
+  PyObject* ix = PyNumber_Index(v);
+  Py_DECREF(v);
+  if (!ix) return false;
+  *out = PyLong_AsUnsignedLongLong(ix);
+  Py_DECREF(ix);
+  return !(*out == (uint64_t)-1 && PyErr_Occurred());
+}
+
+// CommandBatch body (serialization.py _write_batch): uuid id, f64 ts,
+// u32 shard, u32 checksum, u32 n, then per command uuid id + blob data.
+// Caller has pre-validated every Command.data is bytes (see the prescan
+// in codec_encode) so checksum and emission are single-pass C.
+bool encode_batch(Buf& b, PyObject* batch) {
+  PyObject* bid = PyObject_GetAttr(batch, s_id);
+  PyObject* bval = bid ? PyObject_GetAttr(bid, s_value) : nullptr;
+  Py_XDECREF(bid);
+  if (!bval) return false;
+  uint8_t raw[16];
+  bool ok = uuid_bytes(bval, raw) && b.put_raw(raw, 16);
+  Py_DECREF(bval);
+  if (!ok) return false;
+  PyObject* ts = PyObject_GetAttr(batch, s_timestamp);
+  if (!ts) return false;
+  double tsv = PyFloat_AsDouble(ts);
+  Py_DECREF(ts);
+  if (tsv == -1.0 && PyErr_Occurred()) return false;
+  uint64_t bits;
+  memcpy(&bits, &tsv, 8);
+  if (!b.put_u64(bits)) return false;
+  // CommandBatch.shard: a ShardId or a plain int — Python writes
+  // int(batch.shard), which accepts both
+  PyObject* sh = PyObject_GetAttr(batch, s_shard);
+  if (!sh) return false;
+  PyObject* ix = PyNumber_Index(sh);
+  if (!ix) {
+    PyErr_Clear();
+    PyObject* shv = PyObject_GetAttr(sh, s_value);
+    if (shv) {
+      ix = PyNumber_Index(shv);
+      Py_DECREF(shv);
+    }
+  }
+  Py_DECREF(sh);
+  if (!ix) return false;
+  uint32_t shard = (uint32_t)PyLong_AsUnsignedLong(ix);
+  Py_DECREF(ix);
+  if (PyErr_Occurred() || !b.put_u32(shard)) return false;
+
+  PyObject* cmds = PyObject_GetAttr(batch, s_commands);
+  if (!cmds) return false;
+  Py_ssize_t n = PyTuple_Check(cmds) ? PyTuple_GET_SIZE(cmds) : -1;
+  if (n < 0) { Py_DECREF(cmds); return false; }
+  // checksum pass (ids big-endian + data, chained)
+  uint32_t crc = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* c = PyTuple_GET_ITEM(cmds, i);  // borrowed
+    PyObject* cid = PyObject_GetAttr(c, s_id);
+    uint8_t craw[16];
+    ok = cid && uuid_bytes(cid, craw);
+    Py_XDECREF(cid);
+    if (!ok) { Py_DECREF(cmds); return false; }
+    crc = crc32_run(crc, craw, 16);
+    PyObject* data = PyObject_GetAttr(c, s_data);
+    if (!data || !PyBytes_Check(data)) {
+      Py_XDECREF(data); Py_DECREF(cmds);
+      if (!PyErr_Occurred())
+        PyErr_SetString(g_SerializationError, "command data is not bytes");
+      return false;
+    }
+    crc = crc32_run(crc, (const uint8_t*)PyBytes_AS_STRING(data),
+                    (size_t)PyBytes_GET_SIZE(data));
+    Py_DECREF(data);
+  }
+  ok = b.put_u32(crc) && b.put_u32((uint32_t)n);
+  for (Py_ssize_t i = 0; ok && i < n; i++) {
+    PyObject* c = PyTuple_GET_ITEM(cmds, i);
+    PyObject* cid = PyObject_GetAttr(c, s_id);
+    uint8_t craw[16];
+    ok = cid && uuid_bytes(cid, craw) && b.put_raw(craw, 16);
+    Py_XDECREF(cid);
+    if (!ok) break;
+    PyObject* data = PyObject_GetAttr(c, s_data);
+    ok = data && PyBytes_Check(data) &&
+         b.put_u32((uint32_t)PyBytes_GET_SIZE(data)) &&
+         b.put_raw(PyBytes_AS_STRING(data),
+                   (size_t)PyBytes_GET_SIZE(data));
+    Py_XDECREF(data);
+  }
+  Py_DECREF(cmds);
+  return ok;
+}
+
+// Propose body: u32 shard, u64 phase, uuid batch_id, u8 value,
+// u8 has_batch [+ batch]
+bool encode_propose(Buf& b, PyObject* payload) {
+  uint64_t shard, phase;
+  if (!u64_attr_val(payload, s_shard, &shard) ||
+      !u64_attr_val(payload, s_phase, &phase))
+    return false;
+  if (!b.put_u32((uint32_t)shard) || !b.put_u64(phase)) return false;
+  PyObject* bid = PyObject_GetAttr(payload, s_batch_id);
+  PyObject* bval = bid ? PyObject_GetAttr(bid, s_value) : nullptr;
+  Py_XDECREF(bid);
+  if (!bval) return false;
+  uint8_t raw[16];
+  bool ok = uuid_bytes(bval, raw) && b.put_raw(raw, 16);
+  Py_DECREF(bval);
+  if (!ok) return false;
+  PyObject* val = PyObject_GetAttr(payload, s_value);
+  if (!val) return false;
+  long code = PyLong_AsLong(val);
+  Py_DECREF(val);
+  if (code == -1 && PyErr_Occurred()) return false;
+  if (!b.put_u8((uint8_t)code)) return false;
+  PyObject* batch = PyObject_GetAttr(payload, s_batch);
+  if (!batch) return false;
+  if (batch == Py_None) {
+    ok = b.put_u8(0);
+  } else {
+    ok = b.put_u8(1) && encode_batch(b, batch);
+  }
+  Py_DECREF(batch);
+  return ok;
+}
+
+// NewBatch body: u32 shard + batch
+bool encode_newbatch(Buf& b, PyObject* payload) {
+  uint64_t shard;
+  if (!u64_attr_val(payload, s_shard, &shard)) return false;
+  if (!b.put_u32((uint32_t)shard)) return false;
+  PyObject* batch = PyObject_GetAttr(payload, s_batch);
+  if (!batch) return false;
+  bool ok = encode_batch(b, batch);
+  Py_DECREF(batch);
+  return ok;
+}
+
+// A Propose/NewBatch payload is fast-pathable only when every command's
+// data is exactly bytes (the Python writer accepts any buffer; rather
+// than replicate that, odd inputs take the Python path). Returns the
+// exact encoded batch body size, 0 for None, or -1 when not
+// fast-pathable — the caller compares against the serializer's
+// compression threshold, above which the Python codec owns the frame
+// (it may compress; this codec never does, and byte parity is pinned).
+// an int-like attr (or its .value) that must fit the given wire width;
+// returns false (with the error cleared) when it does not — the Python
+// codec then owns the frame and raises exactly as it always has
+bool attr_fits(PyObject* obj, PyObject* name, uint64_t max) {
+  PyObject* v = PyObject_GetAttr(obj, name);
+  if (!v) { PyErr_Clear(); return false; }
+  PyObject* ix = PyNumber_Index(v);
+  if (!ix) {
+    PyErr_Clear();
+    PyObject* val = PyObject_GetAttr(v, s_value);
+    Py_DECREF(v);
+    if (!val) { PyErr_Clear(); return false; }
+    ix = PyNumber_Index(val);
+    Py_DECREF(val);
+  } else {
+    Py_DECREF(v);
+  }
+  if (!ix) { PyErr_Clear(); return false; }
+  uint64_t x = PyLong_AsUnsignedLongLong(ix);
+  Py_DECREF(ix);
+  if (x == (uint64_t)-1 && PyErr_Occurred()) {
+    PyErr_Clear();  // negative or > 2^64
+    return false;
+  }
+  return x <= max;
+}
+
+Py_ssize_t batch_body_size(PyObject* batch) {
+  if (batch == Py_None) return 0;
+  if (Py_TYPE(batch) != (PyTypeObject*)g_CommandBatch) return -1;
+  if (!attr_fits(batch, s_shard, 0xFFFFFFFFull)) return -1;
+  PyObject* cmds = PyObject_GetAttr(batch, s_commands);
+  if (!cmds) { PyErr_Clear(); return -1; }
+  Py_ssize_t size = 16 + 8 + 4 + 4 + 4;  // id, ts, shard, crc, count
+  bool ok = PyTuple_Check(cmds);
+  if (ok) {
+    for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(cmds); i++) {
+      PyObject* c = PyTuple_GET_ITEM(cmds, i);
+      if (Py_TYPE(c) != (PyTypeObject*)g_Command) { ok = false; break; }
+      PyObject* data = PyObject_GetAttr(c, s_data);
+      if (!data) { PyErr_Clear(); ok = false; break; }
+      bool is_bytes = PyBytes_Check(data);
+      if (is_bytes) size += 16 + 4 + PyBytes_GET_SIZE(data);
+      Py_DECREF(data);
+      if (!is_bytes) { ok = false; break; }
+    }
+  }
+  Py_DECREF(cmds);
+  return ok ? size : -1;
 }
 
 // --- payload decoders -----------------------------------------------------
@@ -571,9 +802,153 @@ PyObject* decode_block(Rd& r) {
   return obj;
 }
 
+// CommandBatch from the wire (serialization.py _read_batch), checksum
+// verified with the C crc32 while commands are built
+PyObject* decode_batch(Rd& r) {
+  const uint8_t* braw = r.take(16);
+  if (!braw) return nullptr;
+  PyObject* bid_u = make_uuid(braw);
+  PyObject* bid = bid_u ? raw_new(g_BatchId) : nullptr;
+  if (!bid || raw_set(bid, s_value, bid_u) < 0) {
+    Py_XDECREF(bid); Py_XDECREF(bid_u);
+    return nullptr;
+  }
+  Py_DECREF(bid_u);
+  const uint8_t* fixed = r.take(8 + 4 + 4 + 4);
+  if (!fixed) { Py_DECREF(bid); return nullptr; }
+  double tsv;
+  uint64_t bits = rd_u64(fixed);
+  memcpy(&tsv, &bits, 8);
+  uint32_t shard = rd_u32(fixed + 8);
+  uint32_t checksum = rd_u32(fixed + 12);
+  uint32_t n = rd_u32(fixed + 16);
+  // bound the wire-controlled count by the remaining bytes BEFORE
+  // allocating (every command needs >= 20 bytes: 16B id + u32 len) —
+  // otherwise a short hostile frame forces a multi-GB tuple allocation
+  if ((uint64_t)n * 20 > (uint64_t)(r.len - r.pos)) {
+    Py_DECREF(bid);
+    PyErr_Format(g_SerializationError,
+                 "truncated batch: %u commands in %zu bytes", n,
+                 r.len - r.pos);
+    return nullptr;
+  }
+  PyObject* cmds = PyTuple_New((Py_ssize_t)n);
+  if (!cmds) { Py_DECREF(bid); return nullptr; }
+  uint32_t crc = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    const uint8_t* idr = r.take(16);
+    const uint8_t* lenr = idr ? r.take(4) : nullptr;
+    if (!lenr) { Py_DECREF(bid); Py_DECREF(cmds); return nullptr; }
+    uint32_t dlen = rd_u32(lenr);
+    const uint8_t* draw = r.take(dlen);
+    if (!draw) { Py_DECREF(bid); Py_DECREF(cmds); return nullptr; }
+    crc = crc32_run(crc, idr, 16);
+    crc = crc32_run(crc, draw, dlen);
+    PyObject* cid = make_uuid(idr);
+    PyObject* data =
+        cid ? PyBytes_FromStringAndSize((const char*)draw, dlen) : nullptr;
+    PyObject* cmd = data ? raw_new(g_Command) : nullptr;
+    if (!cmd || raw_set(cmd, s_id, cid) < 0 ||
+        raw_set(cmd, s_data, data) < 0) {
+      Py_XDECREF(cmd); Py_XDECREF(data); Py_XDECREF(cid);
+      Py_DECREF(bid); Py_DECREF(cmds);
+      return nullptr;
+    }
+    Py_DECREF(cid); Py_DECREF(data);
+    PyTuple_SET_ITEM(cmds, i, cmd);  // steals
+  }
+  if (crc != checksum) {
+    Py_DECREF(bid); Py_DECREF(cmds);
+    PyErr_SetString(g_SerializationError,
+                    "batch checksum mismatch on decode");
+    return nullptr;
+  }
+  PyObject* shard_obj = raw_new(g_ShardId);
+  PyObject* shard_val = PyLong_FromUnsignedLong(shard);
+  PyObject* ts = PyFloat_FromDouble(tsv);
+  PyObject* batch =
+      (shard_obj && shard_val && ts) ? raw_new(g_CommandBatch) : nullptr;
+  if (!batch || raw_set(shard_obj, s_value, shard_val) < 0 ||
+      raw_set(batch, s_id, bid) < 0 ||
+      raw_set(batch, s_commands, cmds) < 0 ||
+      raw_set(batch, s_timestamp, ts) < 0 ||
+      raw_set(batch, s_shard, shard_obj) < 0) {
+    Py_XDECREF(batch); Py_XDECREF(shard_obj); Py_XDECREF(shard_val);
+    Py_XDECREF(ts); Py_DECREF(bid); Py_DECREF(cmds);
+    return nullptr;
+  }
+  Py_DECREF(shard_obj); Py_DECREF(shard_val); Py_DECREF(ts);
+  Py_DECREF(bid); Py_DECREF(cmds);
+  return batch;
+}
+
+PyObject* decode_propose(Rd& r) {
+  const uint8_t* fixed = r.take(4 + 8 + 16 + 1 + 1);
+  if (!fixed) return nullptr;
+  uint32_t shard = rd_u32(fixed);
+  uint64_t phase = rd_u64(fixed + 4);
+  const uint8_t* bidr = fixed + 12;
+  uint8_t code = fixed[28];
+  uint8_t has_batch = fixed[29];
+  PyObject* batch;
+  if (has_batch) {
+    batch = decode_batch(r);
+    if (!batch) return nullptr;
+  } else {
+    batch = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject* bid_u = make_uuid(bidr);
+  PyObject* bid = bid_u ? raw_new(g_BatchId) : nullptr;
+  if (!bid || raw_set(bid, s_value, bid_u) < 0) {
+    Py_XDECREF(bid); Py_XDECREF(bid_u); Py_DECREF(batch);
+    return nullptr;
+  }
+  Py_DECREF(bid_u);
+  // StateValue(code) through the enum class: invalid codes raise exactly
+  // what the Python decoder would (ValueError), preserving error parity
+  PyObject* sval = PyObject_CallFunction(g_StateValue, "i", (int)code);
+  PyObject* shard_obj = sval ? PyLong_FromUnsignedLong(shard) : nullptr;
+  PyObject* phase_obj = shard_obj ? PyLong_FromUnsignedLongLong(phase) : nullptr;
+  PyObject* obj = phase_obj ? raw_new(g_Propose) : nullptr;
+  if (!obj || raw_set(obj, s_shard, shard_obj) < 0 ||
+      raw_set(obj, s_phase, phase_obj) < 0 ||
+      raw_set(obj, s_batch_id, bid) < 0 ||
+      raw_set(obj, s_value, sval) < 0 ||
+      raw_set(obj, s_batch, batch) < 0) {
+    Py_XDECREF(obj); Py_XDECREF(phase_obj); Py_XDECREF(shard_obj);
+    Py_XDECREF(sval); Py_DECREF(bid); Py_DECREF(batch);
+    return nullptr;
+  }
+  Py_DECREF(phase_obj); Py_DECREF(shard_obj); Py_DECREF(sval);
+  Py_DECREF(bid); Py_DECREF(batch);
+  return obj;
+}
+
+PyObject* decode_newbatch(Rd& r) {
+  const uint8_t* q = r.take(4);
+  if (!q) return nullptr;
+  uint32_t shard = rd_u32(q);
+  PyObject* batch = decode_batch(r);
+  if (!batch) return nullptr;
+  PyObject* shard_obj = PyLong_FromUnsignedLong(shard);
+  PyObject* obj = shard_obj ? raw_new(g_NewBatch) : nullptr;
+  if (!obj || raw_set(obj, s_shard, shard_obj) < 0 ||
+      raw_set(obj, s_batch, batch) < 0) {
+    Py_XDECREF(obj); Py_XDECREF(shard_obj); Py_DECREF(batch);
+    return nullptr;
+  }
+  Py_DECREF(shard_obj); Py_DECREF(batch);
+  return obj;
+}
+
 // --- entry points ---------------------------------------------------------
 
-PyObject* codec_encode(PyObject*, PyObject* msg) {
+PyObject* codec_encode(PyObject*, PyObject* args) {
+  PyObject* msg;
+  Py_ssize_t compress_threshold = 0;
+  if (!PyArg_ParseTuple(args, "O|n", &msg, &compress_threshold))
+    return nullptr;
   if (!g_ProtocolMessage) {
     PyErr_SetString(PyExc_RuntimeError, "codec not bound");
     return nullptr;
@@ -588,9 +963,30 @@ PyObject* codec_encode(PyObject*, PyObject* msg) {
   else if (pt == (PyTypeObject*)g_HeartBeat) mt = MT_HEARTBEAT;
   else if (pt == (PyTypeObject*)g_SyncRequest) mt = MT_SYNCREQ;
   else if (pt == (PyTypeObject*)g_ProposeBlock) mt = MT_PROPOSE_BLOCK;
+  else if (pt == (PyTypeObject*)g_Propose) mt = MT_PROPOSE;
+  else if (pt == (PyTypeObject*)g_NewBatch) mt = MT_NEWBATCH;
   else {
     Py_DECREF(payload);
     Py_RETURN_NONE;  // unsupported: Python codec handles it
+  }
+  if (mt == MT_PROPOSE || mt == MT_NEWBATCH) {
+    PyObject* batch = PyObject_GetAttr(payload, s_batch);
+    if (!batch) { Py_DECREF(payload); return nullptr; }
+    Py_ssize_t bsize = batch_body_size(batch);
+    bool ok_batch = bsize >= 0 && (batch != Py_None || mt == MT_PROPOSE) &&
+                    attr_fits(payload, s_shard, 0xFFFFFFFFull) &&
+                    (mt != MT_PROPOSE ||
+                     attr_fits(payload, s_phase, ~0ull));
+    Py_DECREF(batch);
+    Py_ssize_t body_size =
+        (mt == MT_PROPOSE ? 4 + 8 + 16 + 1 + 1 : 4) + bsize;
+    if (!ok_batch ||
+        (compress_threshold > 0 && body_size > compress_threshold)) {
+      // odd batch content, or large enough that the Python codec may
+      // compress it: the Python path owns the frame
+      Py_DECREF(payload);
+      Py_RETURN_NONE;
+    }
   }
   if (mt == MT_DECISION) {
     // encode_decision indexes bids with PyList_GET_ITEM; a non-list
@@ -640,6 +1036,8 @@ PyObject* codec_encode(PyObject*, PyObject* msg) {
           case MT_VOTE1:
           case MT_VOTE2: ok = encode_votes(body, payload); break;
           case MT_DECISION: ok = encode_decision(body, payload); break;
+          case MT_PROPOSE: ok = encode_propose(body, payload); break;
+          case MT_NEWBATCH: ok = encode_newbatch(body, payload); break;
           case MT_HEARTBEAT:
             ok = put_u64_attr(body, payload, s_current_phase) &&
                  put_u64_attr(body, payload, s_committed_phase);
@@ -688,7 +1086,8 @@ PyObject* codec_decode(PyObject*, PyObject* arg) {
     }
     bool supported =
         (mt == MT_VOTE1 || mt == MT_VOTE2 || mt == MT_DECISION ||
-         mt == MT_HEARTBEAT || mt == MT_SYNCREQ || mt == MT_PROPOSE_BLOCK) &&
+         mt == MT_HEARTBEAT || mt == MT_SYNCREQ || mt == MT_PROPOSE_BLOCK ||
+         mt == MT_PROPOSE || mt == MT_NEWBATCH) &&
         !(flags & FLAG_COMPRESSED);
     if (!supported) {
       // Python codec owns the remaining types / compressed bodies
@@ -739,6 +1138,8 @@ PyObject* codec_decode(PyObject*, PyObject* arg) {
                                  s_state_version);
         break;
       case MT_PROPOSE_BLOCK: payload = decode_block(br); break;
+      case MT_PROPOSE: payload = decode_propose(br); break;
+      case MT_NEWBATCH: payload = decode_newbatch(br); break;
     }
     if (!payload) break;
     PyObject* msg = raw_new(g_ProtocolMessage);
@@ -763,12 +1164,14 @@ PyObject* codec_bind(PyObject*, PyObject* args, PyObject* kwargs) {
       "ProtocolMessage", "VoteRound1", "VoteRound2", "Decision",
       "HeartBeat", "SyncRequest", "ProposeBlock", "PayloadBlock",
       "NodeId", "BatchId", "UUID", "safe_unknown", "SerializationError",
-      "crc32", nullptr};
+      "crc32", "Propose", "NewBatch", "CommandBatch", "Command",
+      "ShardId", "StateValue", nullptr};
   PyObject *pm, *v1, *v2, *dc, *hb, *sr, *pb, *plb, *nid, *bid, *uu, *su,
-      *se, *crc;
+      *se, *crc, *pr, *nb, *cb, *cm, *si, *sv;
   if (!PyArg_ParseTupleAndKeywords(
-          args, kwargs, "OOOOOOOOOOOOOO", (char**)kwlist, &pm, &v1, &v2, &dc,
-          &hb, &sr, &pb, &plb, &nid, &bid, &uu, &su, &se, &crc))
+          args, kwargs, "OOOOOOOOOOOOOOOOOOOO", (char**)kwlist, &pm, &v1,
+          &v2, &dc, &hb, &sr, &pb, &plb, &nid, &bid, &uu, &su, &se, &crc,
+          &pr, &nb, &cb, &cm, &si, &sv))
     return nullptr;
 #define BIND(slot, val) Py_XDECREF(slot); Py_INCREF(val); slot = val
   BIND(g_ProtocolMessage, pm); BIND(g_VoteRound1, v1); BIND(g_VoteRound2, v2);
@@ -776,6 +1179,8 @@ PyObject* codec_bind(PyObject*, PyObject* args, PyObject* kwargs) {
   BIND(g_ProposeBlock, pb); BIND(g_PayloadBlock, plb); BIND(g_NodeId, nid);
   BIND(g_BatchId, bid); BIND(g_UUID, uu); BIND(g_safe_unknown, su);
   BIND(g_SerializationError, se); BIND(g_crc32, crc);
+  BIND(g_Propose, pr); BIND(g_NewBatch, nb); BIND(g_CommandBatch, cb);
+  BIND(g_Command, cm); BIND(g_ShardId, si); BIND(g_StateValue, sv);
 #undef BIND
   Py_RETURN_NONE;
 }
@@ -783,8 +1188,9 @@ PyObject* codec_bind(PyObject*, PyObject* args, PyObject* kwargs) {
 PyMethodDef methods[] = {
     {"bind", (PyCFunction)codec_bind, METH_VARARGS | METH_KEYWORDS,
      "Bind the Python message classes the codec builds/reads."},
-    {"encode", codec_encode, METH_O,
-     "Serialize a ProtocolMessage; None if the type is not fast-pathed."},
+    {"encode", codec_encode, METH_VARARGS,
+     "encode(msg, compress_threshold=0): serialize a ProtocolMessage; "
+     "None if the type is not fast-pathed (or would compress)."},
     {"decode", codec_decode, METH_O,
      "Deserialize wire bytes; None if the type is not fast-pathed."},
     {nullptr, nullptr, 0, nullptr}};
@@ -819,6 +1225,9 @@ extern "C" PyMODINIT_FUNC PyInit_rabia_native_codec(void) {
   INTERN(s_slots, "slots"); INTERN(s_counts, "counts");
   INTERN(s_cmd_sizes, "cmd_sizes"); INTERN(s_data, "data");
   INTERN(s_total_commands, "total_commands");
+  INTERN(s_shard, "shard"); INTERN(s_phase, "phase");
+  INTERN(s_batch_id, "batch_id"); INTERN(s_batch, "batch");
+  INTERN(s_commands, "commands");
 #undef INTERN
   return m;
 }

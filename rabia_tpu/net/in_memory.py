@@ -96,12 +96,20 @@ class InMemoryNetwork(NetworkTransport):
         self.hub = hub
 
     async def send_to(self, target: NodeId, data: bytes) -> None:
-        self.hub.route(self.node_id, target, data)
+        self.send_to_nowait(target, data)
 
     async def broadcast(self, data: bytes) -> None:
+        self.broadcast_nowait(data)
+
+    def send_to_nowait(self, target: NodeId, data: bytes) -> bool:
+        self.hub.route(self.node_id, target, data)
+        return True
+
+    def broadcast_nowait(self, data: bytes) -> bool:
         for n in self.hub.nodes():
             if n != self.node_id:
                 self.hub.route(self.node_id, n, data)
+        return True
 
     async def receive(self, timeout: Optional[float] = None) -> tuple[NodeId, bytes]:
         q = self.hub.queue_of(self.node_id)

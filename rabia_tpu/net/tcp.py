@@ -124,17 +124,25 @@ class TcpNetwork(NetworkTransport):
     # -- NetworkTransport ---------------------------------------------------
 
     async def send_to(self, target: NodeId, data: bytes) -> None:
+        self.send_to_nowait(target, data)
+
+    async def broadcast(self, data: bytes) -> None:
+        self.broadcast_nowait(data)
+
+    def send_to_nowait(self, target: NodeId, data: bytes) -> bool:
         pid = (ctypes.c_uint8 * 16).from_buffer_copy(_id_bytes(target))
         rc = self._lib.rt_send(self._handle, pid, data, len(data))
         if rc == -2:
             raise NetworkError("frame exceeds 16MiB cap")
         # rc == -1 (not connected) is a silent drop, like the reference's
         # best-effort sends to disconnected peers
+        return True
 
-    async def broadcast(self, data: bytes) -> None:
+    def broadcast_nowait(self, data: bytes) -> bool:
         rc = self._lib.rt_broadcast(self._handle, data, len(data))
         if rc == -2:
             raise NetworkError("frame exceeds 16MiB cap")
+        return True
 
     def _on_frames(self) -> None:
         self._wake_scheduled = False

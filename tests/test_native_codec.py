@@ -39,7 +39,10 @@ def _roundtrip_both(msg: ProtocolMessage) -> None:
     n_bytes = native.encode(msg)
     p_bytes = ser._serialize_py(msg)
     assert n_bytes == p_bytes, type(msg.payload).__name__
-    # cross-decode: each codec reads the other's output
+    # cross-decode: each codec reads the other's output; both must equal
+    # what the Python codec (the semantics owner) decodes — which may be
+    # a canonicalized form of the input (e.g. int shard -> ShardId)
+    canonical = ser._deserialize_py(p_bytes)
     from_py = native.decode(p_bytes)
     from_native = ser._deserialize_py(n_bytes)
     for out in (from_py, from_native):
@@ -48,7 +51,7 @@ def _roundtrip_both(msg: ProtocolMessage) -> None:
         assert out.recipient == msg.recipient
         assert out.timestamp == msg.timestamp
         assert type(out.payload) is type(msg.payload)
-        assert _payload_eq(out.payload, msg.payload)
+        assert _payload_eq(out.payload, canonical.payload)
 
 
 def _payload_eq(a, b) -> bool:
@@ -156,21 +159,171 @@ class TestNativeCodecParity:
         _roundtrip_both(ProtocolMessage.new(NodeId.from_int(7), ProposeBlock(block=block)))
 
     def test_unsupported_types_fall_through(self):
-        # Propose (compressible scalar path) is not fast-pathed: the
-        # native codec must decline, not mis-encode
-        from rabia_tpu.core.messages import Propose
-        from rabia_tpu.core.types import StateValue
+        # QuorumNotification is not fast-pathed: the native codec must
+        # decline, not mis-encode
+        from rabia_tpu.core.messages import QuorumNotification
 
         msg = ProtocolMessage.new(
             NodeId.from_int(8),
-            Propose(shard=0, phase=1, batch_id=BatchId(uuid.UUID(int=5)),
-                    value=StateValue.V1),
+            QuorumNotification(
+                has_quorum=True, active_nodes=(NodeId.from_int(1),)
+            ),
         )
         assert native.encode(msg) is None
         ser = BinarySerializer()
         data = ser.serialize(msg)  # python path
         assert native.decode(data) is None
         assert ser.deserialize(data).payload == msg.payload
+
+    def test_propose_and_newbatch(self):
+        from rabia_tpu.core.messages import NewBatch, Propose
+        from rabia_tpu.core.types import (
+            Command,
+            CommandBatch,
+            ShardId,
+            StateValue,
+        )
+
+        rng = np.random.default_rng(17)
+        for trial in range(20):
+            cmds = tuple(
+                Command(
+                    id=uuid.UUID(int=int(rng.integers(1, 2**63))),
+                    data=bytes(
+                        rng.integers(0, 256, int(rng.integers(0, 48))).astype(
+                            np.uint8
+                        )
+                    ),
+                )
+                for _ in range(int(rng.integers(0, 5)))
+            )
+            batch = CommandBatch(
+                id=BatchId(uuid.UUID(int=trial + 1)),
+                commands=cmds,
+                timestamp=float(rng.random() * 1e9),
+                # the engine passes both ShardId and plain-int shards;
+                # int(batch.shard) accepts either and so must the codec
+                shard=(
+                    ShardId(int(rng.integers(0, 2**31)))
+                    if trial % 2
+                    else int(rng.integers(0, 2**31))
+                ),
+            )
+            _roundtrip_both(
+                ProtocolMessage.new(
+                    NodeId.from_int(3),
+                    Propose(
+                        shard=int(rng.integers(0, 2**31)),
+                        phase=int(rng.integers(0, 2**62)),
+                        batch_id=BatchId.new(),
+                        value=StateValue(int(rng.choice([0, 1, 2]))),
+                        batch=batch if trial % 3 else None,
+                    ),
+                )
+            )
+            _roundtrip_both(
+                ProtocolMessage.new(
+                    NodeId.from_int(4),
+                    NewBatch(shard=int(rng.integers(0, 2**31)), batch=batch),
+                )
+            )
+
+    def test_large_batch_declined_above_compression_threshold(self):
+        # bodies the Python codec might compress must NOT be fast-pathed:
+        # the serializer passes its threshold and the codec declines, so
+        # the two paths stay byte-for-byte identical on every frame
+        from rabia_tpu.core.messages import Propose
+        from rabia_tpu.core.types import (
+            Command,
+            CommandBatch,
+            ShardId,
+            StateValue,
+        )
+
+        batch = CommandBatch(
+            id=BatchId(uuid.UUID(int=9)),
+            commands=(Command(id=uuid.UUID(int=1), data=b"x" * 8192),),
+            timestamp=1.0,
+            shard=ShardId(0),
+        )
+        msg = ProtocolMessage.new(
+            NodeId.from_int(2),
+            Propose(
+                shard=0,
+                phase=1,
+                batch_id=BatchId(uuid.UUID(int=5)),
+                value=StateValue.V1,
+                batch=batch,
+            ),
+        )
+        assert native.encode(msg, 4096) is None  # declines: may compress
+        assert native.encode(msg) is not None  # no threshold: encodes
+        ser = BinarySerializer()
+        data = ser.serialize(msg)  # python path (compressed)
+        assert ser.deserialize(data).payload.batch == batch
+
+    def test_oversized_shard_declined(self):
+        # a shard that does not fit u32 must NOT be silently truncated:
+        # the native codec declines and the Python path raises, exactly
+        # as it did before the fast path existed
+        from rabia_tpu.core.messages import Propose
+        from rabia_tpu.core.types import StateValue
+
+        msg = ProtocolMessage.new(
+            NodeId.from_int(1),
+            Propose(
+                shard=2**32,
+                phase=1,
+                batch_id=BatchId(uuid.UUID(int=5)),
+                value=StateValue.V1,
+            ),
+        )
+        assert native.encode(msg) is None
+        ser = BinarySerializer()
+        with pytest.raises(Exception):
+            ser.serialize(msg)  # python path: struct.pack('<I') rejects
+
+    def test_hostile_command_count(self):
+        # a short frame claiming 2^32-1 commands must raise, not attempt
+        # a multi-GB tuple allocation in the receive path
+        from rabia_tpu.core.messages import NewBatch
+        from rabia_tpu.core.types import CommandBatch, ShardId
+
+        ser = BinarySerializer()
+        msg = ProtocolMessage.new(
+            NodeId.from_int(1),
+            NewBatch(
+                shard=1, batch=CommandBatch.new(["SET a b"], shard=ShardId(0))
+            ),
+        )
+        data = bytearray(ser._serialize_py(msg))
+        # command count u32 sits at envelope(47) + shard(4) + id(16)
+        # + ts(8) + shard(4) + crc(4) = offset 83 (no recipient)
+        assert int.from_bytes(data[83:87], "little") == 1
+        data[83:87] = b"\xff\xff\xff\xff"
+        with pytest.raises(SerializationError):
+            native.decode(bytes(data))
+        with pytest.raises(SerializationError):
+            ser._deserialize_py(bytes(data))
+
+    def test_batch_checksum_mismatch(self):
+        from rabia_tpu.core.messages import NewBatch
+        from rabia_tpu.core.types import CommandBatch, ShardId
+
+        ser = BinarySerializer()
+        msg = ProtocolMessage.new(
+            NodeId.from_int(1),
+            NewBatch(
+                shard=1, batch=CommandBatch.new(["SET a b"], shard=ShardId(0))
+            ),
+        )
+        good = ser._serialize_py(msg)
+        bad = bytearray(good)
+        bad[-3] ^= 0xFF  # flip a payload byte inside the last command
+        with pytest.raises(SerializationError):
+            native.decode(bytes(bad))
+        with pytest.raises(SerializationError):
+            ser._deserialize_py(bytes(bad))
 
     def test_full_serializer_uses_native_transparently(self):
         rng = np.random.default_rng(5)
