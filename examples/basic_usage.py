@@ -1,0 +1,77 @@
+"""First contact: assemble one engine's components and commit a batch.
+
+The smallest possible tour of the pieces a deployment wires together —
+cluster config, state machine, transport, persistence, engine — and one
+committed command batch to prove the loop turns. The other examples go
+deeper (consensus_cluster.py runs faults, tcp_networking.py goes over
+real sockets, mesh_engine_demo.py uses the device plane).
+
+Reference analog: examples/basic_usage.rs (component assembly for the
+primary node of a 3-node cluster).
+
+Run: python examples/basic_usage.py
+"""
+
+import asyncio
+
+import _common  # noqa: F401 - repo path + backend setup
+
+from rabia_tpu.core.config import RabiaConfig
+from rabia_tpu.core.network import ClusterConfig
+from rabia_tpu.core.state_machine import InMemoryStateMachine
+from rabia_tpu.core.types import CommandBatch, NodeId
+from rabia_tpu.engine import RabiaEngine
+from rabia_tpu.net import InMemoryHub
+from rabia_tpu.persistence import InMemoryPersistence
+
+
+async def main() -> None:
+    # 3 nodes: the minimum for consensus (quorum 2, tolerates 1 fault)
+    nodes = [NodeId.from_int(i) for i in (1, 2, 3)]
+    hub = InMemoryHub()  # in-process message plane (swap for TcpNetwork)
+
+    engines = []
+    machines = []
+    for node in nodes:
+        sm = InMemoryStateMachine()  # SET/GET/DEL over an in-memory dict
+        machines.append(sm)
+        engines.append(
+            RabiaEngine(
+                ClusterConfig.new(node, nodes),
+                sm,
+                hub.register(node),
+                persistence=InMemoryPersistence(),
+                config=RabiaConfig(),
+            )
+        )
+    print(f"3-node cluster: {[str(n) for n in nodes]}")
+
+    tasks = [asyncio.ensure_future(e.run()) for e in engines]
+    while True:  # wait for quorum
+        stats = [await e.get_statistics() for e in engines]
+        if all(s.has_quorum for s in stats):
+            break
+        await asyncio.sleep(0.01)
+    print("quorum established")
+
+    # submit one batch through node 1; consensus replicates it everywhere
+    batch = CommandBatch.new(["SET greeting hello", "GET greeting"])
+    future = await engines[0].submit_batch(batch, shard=0)
+    responses = await asyncio.wait_for(future, timeout=10.0)
+    print(f"committed: {[r.decode() for r in responses]}")
+
+    # every replica applied the same state
+    await asyncio.sleep(0.2)
+    snapshots = {m.create_snapshot().data for m in machines}
+    assert len(snapshots) == 1, "replicas diverged"
+    print("all 3 replicas converged")
+
+    for e in engines:
+        await e.shutdown()
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

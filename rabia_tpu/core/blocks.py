@@ -89,7 +89,11 @@ class PayloadBlock:
         self.slots = np.asarray(slots, np.int64)
         self.counts = np.asarray(counts, np.int64)
         self.cmd_sizes = np.asarray(cmd_sizes, np.int64)
-        self.data = data
+        # exact bytes, enforced: downstream numpy object-array stores
+        # (`vbufs[a:b] = block.data`, apps/vector_kv.py) treat bytes as a
+        # scalar ref — a bytearray/memoryview would broadcast
+        # element-wise there. bytes(b) is a no-op for bytes input.
+        self.data = bytes(data)
         if not (len(self.shards) == len(self.slots) == len(self.counts)):
             raise ValidationError("block arrays must be parallel")
         if int(self.counts.sum()) != len(self.cmd_sizes):

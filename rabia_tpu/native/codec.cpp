@@ -401,7 +401,11 @@ bool encode_block(Buf& b, PyObject* payload) {
   return ok;
 }
 
-// u32/u64 from an int-like attribute (plain int, numpy integer, IntEnum)
+// u32/u64 from an int-like attribute (plain int, numpy integer, IntEnum).
+// Deliberately NO ShardId-style .value unwrapping: the Python writer
+// (struct.pack) rejects wrappers for payload-level fields, and the
+// prescan (attr_fits with allow_wrapper=false) routes those frames to it
+// so the historical error surfaces unchanged.
 bool u64_attr_val(PyObject* obj, PyObject* name, uint64_t* out) {
   PyObject* v = PyObject_GetAttr(obj, name);
   if (!v) return false;
@@ -549,14 +553,19 @@ bool encode_newbatch(Buf& b, PyObject* payload) {
 // fast-pathable — the caller compares against the serializer's
 // compression threshold, above which the Python codec owns the frame
 // (it may compress; this codec never does, and byte parity is pinned).
-// an int-like attr (or its .value) that must fit the given wire width;
-// returns false (with the error cleared) when it does not — the Python
-// codec then owns the frame and raises exactly as it always has
-bool attr_fits(PyObject* obj, PyObject* name, uint64_t max) {
+// an int-like attr that must fit the given wire width; returns false
+// (with the error cleared) when it does not — the Python codec then
+// owns the frame and raises exactly as it always has. allow_wrapper
+// additionally unwraps a .value carrier (ShardId): valid ONLY where the
+// Python writer itself coerces via int() (CommandBatch.shard) — the
+// struct.pack payload fields must stay strict or the native path would
+// succeed where Python raises.
+bool attr_fits(PyObject* obj, PyObject* name, uint64_t max,
+               bool allow_wrapper) {
   PyObject* v = PyObject_GetAttr(obj, name);
   if (!v) { PyErr_Clear(); return false; }
   PyObject* ix = PyNumber_Index(v);
-  if (!ix) {
+  if (!ix && allow_wrapper) {
     PyErr_Clear();
     PyObject* val = PyObject_GetAttr(v, s_value);
     Py_DECREF(v);
@@ -579,7 +588,8 @@ bool attr_fits(PyObject* obj, PyObject* name, uint64_t max) {
 Py_ssize_t batch_body_size(PyObject* batch) {
   if (batch == Py_None) return 0;
   if (Py_TYPE(batch) != (PyTypeObject*)g_CommandBatch) return -1;
-  if (!attr_fits(batch, s_shard, 0xFFFFFFFFull)) return -1;
+  if (!attr_fits(batch, s_shard, 0xFFFFFFFFull, /*allow_wrapper=*/true))
+    return -1;
   PyObject* cmds = PyObject_GetAttr(batch, s_commands);
   if (!cmds) { PyErr_Clear(); return -1; }
   Py_ssize_t size = 16 + 8 + 4 + 4 + 4;  // id, ts, shard, crc, count
@@ -973,10 +983,12 @@ PyObject* codec_encode(PyObject*, PyObject* args) {
     PyObject* batch = PyObject_GetAttr(payload, s_batch);
     if (!batch) { Py_DECREF(payload); return nullptr; }
     Py_ssize_t bsize = batch_body_size(batch);
-    bool ok_batch = bsize >= 0 && (batch != Py_None || mt == MT_PROPOSE) &&
-                    attr_fits(payload, s_shard, 0xFFFFFFFFull) &&
-                    (mt != MT_PROPOSE ||
-                     attr_fits(payload, s_phase, ~0ull));
+    bool ok_batch =
+        bsize >= 0 && (batch != Py_None || mt == MT_PROPOSE) &&
+        attr_fits(payload, s_shard, 0xFFFFFFFFull,
+                  /*allow_wrapper=*/false) &&
+        (mt != MT_PROPOSE ||
+         attr_fits(payload, s_phase, ~0ull, /*allow_wrapper=*/false));
     Py_DECREF(batch);
     Py_ssize_t body_size =
         (mt == MT_PROPOSE ? 4 + 8 + 16 + 1 + 1 : 4) + bsize;

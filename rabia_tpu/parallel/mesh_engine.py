@@ -110,7 +110,14 @@ class MeshBlockFuture:
 
     def _settle(self, i: int, value) -> None:
         if self._pending == 0:
-            return  # already bulk-settled (results may be a lazy view)
+            # already bulk-settled (results may be a lazy view); a settle
+            # landing here is dropped — log it so a misrouted late settle
+            # (e.g. a future error path re-settling an entry) is
+            # observable rather than silently swallowed
+            logger.debug(
+                "ignoring post-bulk settle of entry %d (%r)", i, value
+            )
+            return
         if self._results[i] is None:
             self._pending -= 1
         self._results[i] = value

@@ -1,24 +1,34 @@
-"""Randomized protocol-conformance fuzz: kernel vs oracle, fused vs scan.
+"""Randomized protocol-conformance fuzz: kernel vs oracle, fused vs scan,
+mesh plane vs host plane under faults.
 
-The fixed-seed suites (tests/test_kernel.py, tests/test_invariants.py)
-pin the vectorized kernel to the scalar weak-MVC oracle on a handful of
+The fixed-seed suites (tests/test_kernel.py, tests/test_invariants.py,
+tests/test_parallel.py) pin the vectorized kernel to the scalar weak-MVC
+oracle (and the mesh collectives to the vmap plane) on a handful of
 schedules; this script keeps drawing NEW random schedules until a time
 budget expires — random loss rates, crash masks, and V0/V1 initial
 votes (V? is never a valid round-1 input; it arises only from tallies)
-— and fails loudly with the repro seed on the first divergence. Two
-gates per trial:
+— and fails loudly with the repro seed on the first divergence. Gates:
 
 1. step-for-step decision identity between ``ClusterKernel.round_step``
    and one ``WeakMVCOracle`` per shard under the SAME delivery masks and
    the same common coin;
 2. bit-identity of ``slot_pipeline_fused`` (closed form) with the
-   scanned ``slot_pipeline`` on random fault-free windows.
+   scanned ``slot_pipeline`` on random fault-free windows;
+3. (``--mesh N``) the SPMD mesh plane under faults, on a virtual
+   8-device CPU mesh: random monotonic crash schedules through
+   ``MeshPhaseKernel``'s shard_map collectives diffed per phase against
+   ``ClusterKernel`` with full delivery, and random loss+crash schedules
+   through ``ShardedClusterKernel``'s pjit path diffed bit-for-bit
+   against the unsharded kernel each round.
 
 Usage::
 
     python scripts/fuzz_conformance.py [--seconds 30] [--base-seed 0]
+        [--planes N] [--mesh N]
 
-CI runs a short budget on every push; longer local runs deepen coverage.
+CI runs a fixed seed on every push (failures reproduce exactly) and a
+nightly job with a fresh per-run seed for exploration; either prints the
+repro seed on the first divergence.
 """
 
 from __future__ import annotations
@@ -144,6 +154,132 @@ def _trial_fused(get_kernel, seed: int) -> None:
         )
 
 
+# (S, R, shard_axis, replica_axis) on the virtual 8-device mesh: covers
+# replica-axis collectives (4-way, 2-way) and the pure shard-data-parallel
+# layout (replica axis 1, replicas vmapped in-device)
+MESH_GEOMETRY_POOL = [(8, 4, 2, 4), (16, 2, 4, 2), (8, 5, 8, 1)]
+
+
+def _mesh_kernels():
+    """Geometry -> (plain ClusterKernel, MeshPhaseKernel, shard-idx,
+    ShardedClusterKernel) cache; jit compiles once per geometry."""
+    from rabia_tpu.kernel import ClusterKernel
+    from rabia_tpu.parallel.mesh import (
+        MeshPhaseKernel,
+        ShardedClusterKernel,
+        make_mesh,
+    )
+
+    cache: dict[tuple, tuple] = {}
+
+    def get(geo: tuple):
+        if geo not in cache:
+            S, R, sa, ra = geo
+            mesh = make_mesh(shard_axis_size=sa, replica_axis_size=ra)
+            plain = ClusterKernel(S, R, seed=101)
+            mk = MeshPhaseKernel(S, R, mesh, seed=101)
+            sk = ShardedClusterKernel(S, R, mesh, seed=101)
+            cache[geo] = (plain, mk, mk.shard_index_array(), sk)
+        return cache[geo]
+
+    return get
+
+
+def _trial_mesh_crash(get_mesh, seed: int) -> None:
+    """Random monotonic crash schedule through the shard_map collectives.
+
+    The mesh plane is lockstep (delivery is the all_gather; a crash is an
+    ``alive`` row that stops contributing — monotonic, since a revived
+    replica would rejoin out of phase, which the model excludes). The
+    same schedule runs on ``ClusterKernel`` with full delivery, two
+    rounds per phase; at EVERY phase boundary each shard's unique
+    non-ABSENT mesh decision (agreement is asserted across replica
+    views) must equal the host plane's decided value, including the
+    never-decides case (majority crash -> ABSENT on both)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed ^ 0x3E5B)
+    geo = MESH_GEOMETRY_POOL[seed % len(MESH_GEOMETRY_POOL)]
+    S, R, _, _ = geo
+    plain, mk, idx, _ = get_mesh(geo)
+
+    K = 10  # phases
+    votes = rng.integers(0, 2, (S, R)).astype(np.int8)
+    alive = rng.random((S, R)) > float(rng.uniform(0.0, 0.4))
+    crash_phase = int(rng.integers(0, K))  # a second crash wave mid-run
+    survivors = rng.random((S, R)) > float(rng.uniform(0.0, 0.3))
+
+    st = mk.init_state(jnp.asarray(votes))
+    ps = plain.start_slot(
+        plain.init_state(), jnp.ones((S,), bool), jnp.asarray(votes)
+    )
+    full = jnp.ones((S, R, R), bool)
+    for ph in range(K):
+        if ph == crash_phase:
+            alive = alive & survivors
+        a = jnp.asarray(alive)
+        st = mk.phase_step(st, mk.place(a), idx)
+        ps = plain.round_step(ps, a, full)  # R1 exchange -> R2 cast
+        ps = plain.round_step(ps, a, full)  # R2 exchange -> decide/advance
+        mdec = np.asarray(st.decided)
+        pdec = np.asarray(ps.decided)
+        for s in range(S):
+            vals = {int(v) for v in mdec[s] if v != ABSENT}
+            if len(vals) > 1:
+                raise AssertionError(
+                    f"mesh-crash seed={seed} phase={ph} shard={s}: replica "
+                    f"views disagree: {sorted(vals)}"
+                )
+            got = vals.pop() if vals else None
+            want = None if pdec[s] == ABSENT else int(pdec[s])
+            if got != want:
+                raise AssertionError(
+                    f"mesh-crash seed={seed} phase={ph} shard={s} "
+                    f"geo={geo}: mesh decided {got}, host plane {want}"
+                )
+
+
+def _trial_sharded_lossy(get_mesh, seed: int) -> None:
+    """Random loss + crash schedule through the pjit-sharded kernel.
+
+    ``ShardedClusterKernel`` is the same array program as
+    ``ClusterKernel`` with state partitioned over the mesh's shard axis —
+    every step must stay BIT-identical under arbitrary per-round delivery
+    masks and crash masks (an SPMD partitioning/layout bug shows up
+    exactly here)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed ^ 0x51A2)
+    geo = MESH_GEOMETRY_POOL[(seed + 1) % len(MESH_GEOMETRY_POOL)]
+    S, R, _, _ = geo
+    plain, _, _, sk = get_mesh(geo)
+
+    T = 24
+    p = float(rng.uniform(0.3, 1.0))
+    votes = rng.integers(0, 2, (S, R)).astype(np.int8)
+    alive = jnp.asarray(rng.random((S, R)) > float(rng.uniform(0.0, 0.4)))
+
+    ps = plain.start_slot(
+        plain.init_state(), jnp.ones((S,), bool), jnp.asarray(votes)
+    )
+    ms = sk.start_slot(
+        sk.init_state(), jnp.ones((S,), bool), sk.place_votes(jnp.asarray(votes))
+    )
+    for t in range(T):
+        mask = jnp.asarray(rng.random((S, R, R)) < p)
+        ps = plain.round_step(ps, alive, mask)
+        ms = sk.round_step(ms, alive, mask)
+        if t % 6 == 5 or t == T - 1:
+            for f in ("decided", "phase", "my_r1", "my_r2", "done"):
+                a = np.asarray(getattr(ps, f))
+                b = np.asarray(getattr(ms, f))
+                if not np.array_equal(a, b):
+                    raise AssertionError(
+                        f"sharded-lossy seed={seed} t={t} geo={geo} "
+                        f"p={p:.2f}: field {f} diverged"
+                    )
+
+
 async def _trial_planes(seed: int) -> None:
     """Engine-level differential: one RANDOM fault-free submission
     schedule through BOTH deployment planes, via the shared gate
@@ -185,7 +321,45 @@ def main() -> int:
         "(random schedules through the transport engine AND MeshEngine; "
         "~4s each)",
     )
+    ap.add_argument(
+        "--mesh", type=int, default=0,
+        help="additionally run N mesh-plane fault trials (crash schedules "
+        "through MeshPhaseKernel's shard_map collectives + loss/crash "
+        "through ShardedClusterKernel's pjit path) on a virtual 8-device "
+        "CPU mesh, each diffed against the host-plane ClusterKernel",
+    )
     args = ap.parse_args()
+
+    if args.mesh > 0:
+        # the virtual 8-device mesh requires the CPU platform and must be
+        # configured before jax initializes — all jax imports in this
+        # module are function-local, so forcing the env here (first thing
+        # in main) is early enough. This overrides an inherited
+        # JAX_PLATFORMS (e.g. a TPU session): mesh fault fuzzing is a
+        # conformance gate, not a perf run, and needs 8 devices.
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        # this image pre-imports jax, so env alone is too late — the
+        # config route works as long as no backend has initialized yet
+        # (same mechanism as tests/conftest.py)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        if len(jax.devices()) < 8:
+            print(
+                "mesh trials need 8 virtual devices; got "
+                f"{len(jax.devices())} ({jax.devices()[0].platform}) — "
+                "run in a fresh process with JAX_PLATFORMS=cpu "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8",
+                file=sys.stderr,
+            )
+            return 2
 
     get_kernel = _kernels()
     # warmup: compile every pool geometry BEFORE the budget clock starts,
@@ -202,6 +376,15 @@ def main() -> int:
         _trial_stepwise(get_kernel, seed)
         _trial_fused(get_kernel, seed)
         trial += 1
+    mesh_trials = 0
+    if args.mesh > 0:
+        get_mesh = _mesh_kernels()
+        for geo in MESH_GEOMETRY_POOL:  # compile warmup
+            get_mesh(geo)
+        for i in range(args.mesh):
+            _trial_mesh_crash(get_mesh, args.base_seed + i)
+            _trial_sharded_lossy(get_mesh, args.base_seed + i)
+            mesh_trials += 1
     plane_trials = 0
     if args.planes > 0:
         import asyncio
@@ -214,6 +397,11 @@ def main() -> int:
         if plane_trials
         else ""
     )
+    if mesh_trials:
+        extra += (
+            f"; {mesh_trials} mesh-plane fault schedules conformant "
+            "(crash x shard_map, loss+crash x pjit)"
+        )
     print(
         f"fuzz OK: {trial} random schedules conformant "
         f"(kernel==oracle stepwise; fused==scan), no divergence "
