@@ -19,6 +19,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax
+import numpy as np
 
 from rabia_tpu.core.errors import RabiaError
 from rabia_tpu.core.state_machine import InMemoryStateMachine
@@ -131,6 +132,85 @@ def bench_block_lane(
     }
 
 
+def bench_latency_governor(
+    n_shards: int,
+    n_replicas: int,
+    targets_ms: list,
+    seconds_per: float = 6.0,
+) -> dict:
+    """Throughput-vs-p99 under the window governor.
+
+    For each latency target, a governed engine
+    (``MeshEngine(latency_target_ms=...)``) runs the block lane under
+    saturating demand (the feed keeps ~2 windows of blocks queued, so
+    the governor is free to grow as well as shrink); after the run the
+    achieved per-window p50/p99 and throughput are recorded along with
+    where the governor parked W. This replaces the manual
+    window_sweep_block_lane knob: pick a latency target, get the window.
+    """
+    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.apps.vector_kv import VectorShardedKV
+    from rabia_tpu.core.blocks import build_block
+
+    shards = list(range(n_shards))
+    cmds = [[encode_set_bin(f"k{s}", "v")] for s in range(n_shards)]
+    out = {}
+    for t_ms in targets_ms:
+        eng = MeshEngine(
+            lambda: VectorShardedKV(n_shards, capacity=1 << 18),
+            n_shards=n_shards,
+            n_replicas=n_replicas,
+            mesh=make_mesh(),
+            window=16,
+            latency_target_ms=t_ms,
+            max_window=256,
+        )
+        eng.submit_block(build_block(shards, cmds))
+        eng.flush()  # compile the initial window size
+        samples = []
+        applied = 0
+        settled_at = 0  # sample index of the last governor resize
+        t0 = time.perf_counter()
+        deadline = t0 + seconds_per
+        while time.perf_counter() < deadline or len(samples) - settled_at < 8:
+            if time.perf_counter() > t0 + 4 * seconds_per:
+                break  # hard cap: never-settling targets still report
+            while len(eng._full_blocks) < 2 * eng.window:
+                eng.submit_block(build_block(shards, cmds))
+            resizes = eng.window_resizes
+            c0 = time.perf_counter()
+            applied += eng.run_cycle()
+            samples.append((time.perf_counter() - c0) * 1e3)
+            if eng.window_resizes != resizes:
+                # +1: the next cycle pays the new size's jit compile —
+                # the engine leaves it untimed (_lat_skip) and so must
+                # the recorded tail, or p99 reports a compile
+                settled_at = len(samples) + 1
+        dt = time.perf_counter() - t0
+        # stats over the settled tail: windows run at the final W only
+        tail = samples[settled_at:]
+        a = np.asarray(tail if tail else samples)
+        out[f"target_{t_ms:g}ms"] = {
+            "window": eng.window,
+            "resizes": eng.window_resizes,
+            "windows_timed": len(samples),
+            "settled_windows": len(tail),
+            # empty tail = the hard cap fired mid-resize; stats then
+            # cover mixed window sizes and say so
+            "mixed_sizes": not tail,
+            "p50_ms": round(float(np.percentile(a, 50)), 2),
+            "p99_ms": round(float(np.percentile(a, 99)), 2),
+            "decisions_per_sec": round(applied / dt, 1),
+        }
+        print(
+            f"  governor target {t_ms}ms -> W={eng.window} "
+            f"p50={out[f'target_{t_ms:g}ms']['p50_ms']}ms "
+            f"p99={out[f'target_{t_ms:g}ms']['p99_ms']}ms "
+            f"{out[f'target_{t_ms:g}ms']['decisions_per_sec']} dec/s"
+        )
+    return out
+
+
 def main() -> None:
     backend = jax.devices()[0].platform
     out = {
@@ -162,6 +242,11 @@ def main() -> None:
     }.items():
         out[name] = bench_block_lane(4096, 5, W, waves, device_store=True)
         print(name, "->", out[name]["decisions_per_sec"], "decisions/s")
+
+    print("latency governor sweep (block lane, 1024 shards x 3):")
+    out["latency_governor_sweep"] = bench_latency_governor(
+        1024, 3, [20.0, 60.0, 250.0, 1000.0]
+    )
 
     if "--record" in sys.argv:
         path = Path(__file__).parent / "results.json"

@@ -330,7 +330,9 @@ class DeviceKVTable:
     def dump(self) -> dict:
         """Materialize the table on host: per-entry rows + counters."""
         used, keyw, klen, ver, valw, vlen, sver = (
-            np.asarray(a) for a in self.state
+            # contiguous: a fetched sharded array can come back with a
+            # non-contiguous layout, which .view(uint8) rejects
+            np.ascontiguousarray(np.asarray(a)) for a in self.state
         )
         key_bytes = keyw.view(np.uint8).reshape(self.S, self.P, self.K)
         val_bytes = valw.view(np.uint8).reshape(self.S, self.P, self.VW)

@@ -193,6 +193,11 @@ class MeshEngine:
     window:
         Slots decided per shard per device dispatch (the amortization
         lever — SURVEY.md §7.4.4).
+    latency_target_ms:
+        When set, a governor replaces the manual window knob: measured
+        per-window wall time walks ``window`` along a power-of-two
+        ladder within [min_window, max_window] to keep the p99 window
+        latency under the target (see :meth:`run_cycle`).
 
     State machines implementing
     :class:`~rabia_tpu.core.state_machine.VectorStateMachine` get the
@@ -217,6 +222,9 @@ class MeshEngine:
         max_decision_history: int = 4096,
         device_store: bool = False,
         device_store_kw: Optional[dict] = None,
+        latency_target_ms: Optional[float] = None,
+        min_window: int = 1,
+        max_window: int = 256,
     ) -> None:
         if n_shards < 1 or n_replicas < 1:
             raise ValidationError("need at least 1 shard and 1 replica")
@@ -259,6 +267,22 @@ class MeshEngine:
         self.decided_v0 = 0
         self.divergences = 0  # replicas disagreeing on an apply outcome
         self.cycles = 0
+        # latency governor (see run_cycle/_govern): auto-tunes `window`
+        # against a p99 wall-time target instead of the manual knob
+        if latency_target_ms is not None and latency_target_ms <= 0:
+            raise ValidationError("latency_target_ms must be positive")
+        self.latency_target_ms = (
+            float(latency_target_ms) if latency_target_ms is not None else None
+        )
+        self.min_window = max(1, int(min_window))
+        self.max_window = max(self.min_window, int(max_window))
+        self.window_resizes = 0
+        self._lat_samples: deque[float] = deque(maxlen=32)
+        self._lat_saturated = False
+        # windows to leave untimed: the first cycle at any window size
+        # pays that size's jit compile (seconds), which must not read as
+        # latency or the governor ratchets W down one compile at a time
+        self._lat_skip = 1
         # speculative next-window dispatch (full-width lane): (key, device
         # plane) issued before the current window's readback so device
         # compute overlaps the host apply; used only when the engine state
@@ -385,7 +409,64 @@ class MeshEngine:
     def run_cycle(self) -> int:
         """Decide up to ``window`` queued slots per shard in ONE device
         dispatch, then apply + settle on the host. Returns batches applied.
-        """
+
+        With ``latency_target_ms`` set, each working cycle's wall time
+        feeds the window governor (see :meth:`_govern`), which walks
+        ``window`` up and down a power-of-two ladder to keep the p99
+        window latency under the target — the same measure-and-step
+        pattern as the adaptive batcher (core/batching.py), on the
+        latency axis instead of the flush-cause axis."""
+        if self.latency_target_ms is None:
+            return self._run_cycle_inner()
+        self._lat_saturated = False
+        cycles_before = self.cycles
+        t0 = time.perf_counter()
+        applied = self._run_cycle_inner()
+        if self.cycles > cycles_before:
+            # time only cycles that consumed a window (an idle probe
+            # costs ~µs and would drown the window samples)
+            if self._lat_skip:
+                self._lat_skip -= 1  # compile warmup, not latency
+            else:
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                self._lat_samples.append(dt_ms)
+                self._govern(dt_ms)
+        return applied
+
+    def _govern(self, dt_ms: float) -> None:
+        """Latency-target window control (multiplicative ladder).
+
+        Downsize: the conservative p99 proxy (max of the last ≤32 window
+        times) exceeding the target halves W — immediately on a single
+        2× overshoot, else after 4 samples of evidence. Upsize: with the
+        proxy comfortably under target (≤40%) AND demand saturating the
+        current window (a deeper window would actually amortize more), W
+        doubles after 8 samples. Samples clear on every resize so each
+        decision is measured at the current W; each ladder size jit-
+        compiles once per process."""
+        s = self._lat_samples
+        t = self.latency_target_ms
+        est = max(s)
+        if (
+            (len(s) >= 2 and dt_ms > 2.0 * t)
+            or (len(s) >= 4 and est > t)
+        ) and self.window > self.min_window:
+            self.window = max(self.min_window, self.window // 2)
+            s.clear()
+            self._lat_skip = 1
+            self.window_resizes += 1
+        elif (
+            len(s) >= 8
+            and est < 0.4 * t
+            and self._lat_saturated
+            and self.window < self.max_window
+        ):
+            self.window = min(self.max_window, self.window * 2)
+            s.clear()
+            self._lat_skip = 1
+            self.window_resizes += 1
+
+    def _run_cycle_inner(self) -> int:
         if self._full_blocks:
             if self._vector and self._queued_entries == 0:
                 if self._dev_active:
@@ -400,8 +481,12 @@ class MeshEngine:
             self._demote_device_store()
         W = self.window
         depth = np.zeros(self.S, np.int64)
+        saturated = False
         for s in range(self.n_shards):
-            depth[s] = min(len(self.queues[s]), W)
+            q = len(self.queues[s])
+            depth[s] = min(q, W)
+            saturated |= q >= W
+        self._lat_saturated |= saturated  # a deeper window had demand
         if not depth.any():
             return 0
         # initial votes: every live replica proposes/accepts V1 for a slot
@@ -470,6 +555,7 @@ class MeshEngine:
         W = self.window
         n = self.n_shards
         depth = min(len(self._full_blocks), W)
+        self._lat_saturated |= len(self._full_blocks) >= W
         entries = [self._full_blocks[i] for i in range(depth)]  # peek
         base = np.zeros(self.S, np.int32)
         base[:n] = self.next_slot
@@ -483,7 +569,7 @@ class MeshEngine:
             if ops is None:
                 self._dev_spec = None
                 self._demote_device_store()
-                return self.run_cycle()
+                return self._run_cycle_inner()
             new_state, flags_dev = self._dev.decide_apply(
                 self.alive, base, depth, ops, W=W,
                 max_phases=self.max_phases,
@@ -521,7 +607,7 @@ class MeshEngine:
             # Any speculative chain built on this window dies with it.
             self._dev_spec = None
             self._demote_device_store()
-            return self.run_cycle()
+            return self._run_cycle_inner()
         self._dev.adopt(new_state)
         # version responses are DERIVED, not transferred: a clean
         # all-V1 full-width window advances every covered shard's
@@ -591,6 +677,7 @@ class MeshEngine:
         W = self.window
         n = self.n_shards
         depth = min(len(self._full_blocks), W)
+        self._lat_saturated |= len(self._full_blocks) >= W
         base = np.zeros(self.S, np.int32)
         base[:n] = self.next_slot
         if self._multi:
@@ -638,7 +725,7 @@ class MeshEngine:
             # general path with the SAME (deterministically re-decided)
             # votes — demotion preserves per-shard FIFO order
             self._demote_full_blocks()
-            return self.run_cycle()  # second dispatch; cycles counts both
+            return self._run_cycle_inner()  # second dispatch; cycles counts both
         entries = [self._full_blocks.popleft() for _ in range(depth)]
         start = self.next_slot.copy()
         self.next_slot[:n] += depth

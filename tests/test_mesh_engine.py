@@ -504,3 +504,83 @@ class TestMultiApplyFailureGranularity:
             isinstance(e, RabiaError) and "apply failed" in str(e)
             for e in futs[1].result()
         )
+
+
+class TestLatencyGovernor:
+    """MeshEngine(latency_target_ms=...) auto-tunes `window` on a
+    power-of-two ladder against measured per-window wall time, replacing
+    the manual knob (the adaptive pattern of core/batching.py on the
+    latency axis)."""
+
+    def _mk(self, **kw):
+        from rabia_tpu.apps.vector_kv import VectorShardedKV
+
+        S = kw.pop("S", 16)
+        return MeshEngine(
+            lambda: VectorShardedKV(S, capacity=1 << 12),
+            n_shards=S,
+            n_replicas=3,
+            **kw,
+        )
+
+    def test_unreachable_target_shrinks_to_min(self):
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        eng = self._mk(window=16, latency_target_ms=1e-4, min_window=2)
+        op = [encode_set_bin("k", "v")]
+        for _ in range(30):
+            for _ in range(4):
+                for s in range(eng.n_shards):
+                    eng.submit(op, s)
+            eng.flush()
+        assert eng.window == 2
+        assert eng.window_resizes >= 3  # 16 -> 8 -> 4 -> 2
+
+    def test_loose_target_grows_under_saturating_demand(self):
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        eng = self._mk(window=2, latency_target_ms=60_000.0, max_window=16)
+        op = [encode_set_bin("k", "v")]
+        for _ in range(30):
+            for _ in range(16):  # queues deeper than the window
+                for s in range(eng.n_shards):
+                    eng.submit(op, s)
+            eng.flush()
+        assert eng.window > 2
+
+    def test_no_growth_without_demand(self):
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        eng = self._mk(window=4, latency_target_ms=60_000.0, max_window=64)
+        op = [encode_set_bin("k", "v")]
+        for _ in range(30):  # 1-deep queues: a wider window buys nothing
+            for s in range(eng.n_shards):
+                eng.submit(op, s)
+            eng.flush()
+        assert eng.window == 4
+
+    def test_governed_state_matches_ungoverned(self):
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        def run(lat):
+            eng = self._mk(S=8, window=8, latency_target_ms=lat)
+            rng = np.random.default_rng(5)
+            keys = set()
+            for i in range(150):
+                s = int(rng.integers(0, 8))
+                keys.add((s, f"k{i % 17}".encode()))
+                eng.submit([encode_set_bin(f"k{i % 17}", f"v{i}")], s)
+                if i % 13 == 0:
+                    eng.flush()
+            eng.flush()
+            return eng, keys
+
+        gov, keys = run(0.5)  # tight target: window walks down mid-run
+        plain, _ = run(None)
+        assert gov.window_resizes > 0
+        assert np.array_equal(gov.next_slot, plain.next_slot)
+        for s, k in sorted(keys):
+            for r in range(3):
+                assert gov.sms[r].store.get(s, k) == plain.sms[r].store.get(
+                    s, k
+                ), (s, k, r)
