@@ -276,6 +276,10 @@ class MeshEngine:
         )
         self.min_window = max(1, int(min_window))
         self.max_window = max(self.min_window, int(max_window))
+        if self.latency_target_ms is not None:
+            # the governor walks W within [min_window, max_window]; the
+            # starting size must already be on that ladder
+            self.window = min(self.max_window, max(self.min_window, self.window))
         self.window_resizes = 0
         self._lat_samples: deque[float] = deque(maxlen=32)
         self._lat_saturated = False
@@ -283,6 +287,8 @@ class MeshEngine:
         # pays that size's jit compile (seconds), which must not read as
         # latency or the governor ratchets W down one compile at a time
         self._lat_skip = 1
+        # set by lane demotions: the in-flight cycle's sample is void
+        self._lat_invalidate = False
         # speculative next-window dispatch (full-width lane): (key, device
         # plane) issued before the current window's readback so device
         # compute overlaps the host apply; used only when the engine state
@@ -424,9 +430,14 @@ class MeshEngine:
         applied = self._run_cycle_inner()
         if self.cycles > cycles_before:
             # time only cycles that consumed a window (an idle probe
-            # costs ~µs and would drown the window samples)
+            # costs ~µs and would drown the window samples). A lane
+            # demotion mid-cycle (device -> host, block -> scalar) runs
+            # a second dispatch plus that path's jit compile inside this
+            # one sample — one-off machinery, not steady-state latency
             if self._lat_skip:
                 self._lat_skip -= 1  # compile warmup, not latency
+            elif self._lat_invalidate:
+                self._lat_invalidate = False
             else:
                 dt_ms = (time.perf_counter() - t0) * 1e3
                 self._lat_samples.append(dt_ms)
@@ -656,6 +667,7 @@ class MeshEngine:
         the host replicas saw none of the applies)."""
         if not self._dev_active:
             return
+        self._lat_invalidate = True  # one-off lane switch, not latency
         self._dev_active = False
         d = self._dev.dump()  # ONE table materialization for all replicas
         for sm in self.sms:
@@ -751,6 +763,7 @@ class MeshEngine:
     def _demote_full_blocks(self) -> None:
         """Move staged full-width blocks onto the per-shard queues (the
         general path's representation), preserving submission order."""
+        self._lat_invalidate = True  # one-off lane switch, not latency
         self._spec = None  # speculated on the full-width lane's slots
         while self._full_blocks:
             block, bfut, _inv = self._full_blocks.popleft()
