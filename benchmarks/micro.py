@@ -359,7 +359,10 @@ def main() -> int:
         print(f"[{name}]")
         for k, v in results[name].items():
             if isinstance(v, float):
-                print(f"  {k:40s} {v:>14,.1f}")
+                # small floats are ratios/rates: .1f would print the
+                # 0.9503 hit rate as a false-perfect 1.0
+                fmt = ",.1f" if abs(v) >= 10 else ",.4f"
+                print(f"  {k:40s} {v:>14{fmt}}")
             elif isinstance(v, int):
                 print(f"  {k:40s} {v:>14,}")
             else:

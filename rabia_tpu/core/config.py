@@ -134,11 +134,16 @@ class KernelConfig:
     seed: int = 0
     max_phases_per_step: int = 1  # full weak-MVC phases evaluated per kernel call
     dtype_votes: str = "int8"
-    # engine kernel implementation: "host" = numpy HostNodeKernel (host
-    # round pacing — no per-round XLA dispatch or device mirrors; the
-    # default), "jax" = the JAX NodeKernel (device-array state; the TPU
-    # path, where thousands of shards amortize one dispatch). Both are
-    # bit-identical (tests/test_host_kernel.py).
+    # engine kernel implementation: "host" = native/numpy HostNodeKernel
+    # (host round pacing — no per-round XLA dispatch or device mirrors;
+    # the default and the ONLY engine backend exercised on tunneled
+    # hardware), "jax" = the JAX NodeKernel (device-array state) — for
+    # DIRECTLY-ATTACHED accelerators only: a tunneled chip's ~120ms
+    # readback floors every per-tick round trip (jax_engine_r03 records
+    # the measurement; docs/PERFORMANCE.md has the fencing decision).
+    # Both are bit-identical (tests/test_host_kernel.py); the engine
+    # logs a warning when "jax" is selected so accidental use on the
+    # wrong deployment shape is visible.
     backend: str = "host"
     # kernel substeps chained inside ONE device dispatch ("jax" backend):
     # a drain that fills both vote rounds decides in a single dispatch

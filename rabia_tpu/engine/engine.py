@@ -230,6 +230,17 @@ class RabiaEngine:
         seed = self.config.randomization_seed or 0
         self._host_kernel = kc.backend != "jax"
         self._substeps = max(1, int(kc.device_substeps))
+        if not self._host_kernel:
+            # fenced: the device-array engine backend is for DIRECTLY-
+            # ATTACHED accelerators; on tunneled hardware the per-tick
+            # readback floor caps it ~75x below the host kernel
+            # (jax_engine_r03). The mesh plane (parallel/) is the
+            # supported device story for windowed consensus.
+            logger.warning(
+                "KernelConfig.backend='jax' selected: intended for "
+                "directly-attached accelerators only (see "
+                "docs/PERFORMANCE.md, 'Engine kernel backends')"
+            )
         kernel_cls = HostNodeKernel if self._host_kernel else NodeKernel
         self.kernel = kernel_cls(
             self.S, self.R, self.me, coin_p1=kc.coin_p1, seed=seed

@@ -287,8 +287,9 @@ class MeshEngine:
         # pays that size's jit compile (seconds), which must not read as
         # latency or the governor ratchets W down one compile at a time
         self._lat_skip = 1
-        # set by lane demotions: the in-flight cycle's sample is void
+        # set by lane demotions DURING a timed cycle: that sample is void
         self._lat_invalidate = False
+        self._lat_timing = False  # a governed cycle is being timed now
         # speculative next-window dispatch (full-width lane): (key, device
         # plane) issued before the current window's readback so device
         # compute overlaps the host apply; used only when the engine state
@@ -425,20 +426,25 @@ class MeshEngine:
         if self.latency_target_ms is None:
             return self._run_cycle_inner()
         self._lat_saturated = False
+        self._lat_invalidate = False
+        self._lat_timing = True
         cycles_before = self.cycles
         t0 = time.perf_counter()
-        applied = self._run_cycle_inner()
+        try:
+            applied = self._run_cycle_inner()
+        finally:
+            self._lat_timing = False
         if self.cycles > cycles_before:
             # time only cycles that consumed a window (an idle probe
             # costs ~µs and would drown the window samples). A lane
             # demotion mid-cycle (device -> host, block -> scalar) runs
             # a second dispatch plus that path's jit compile inside this
             # one sample — one-off machinery, not steady-state latency
+            invalid = self._lat_invalidate
+            self._lat_invalidate = False
             if self._lat_skip:
                 self._lat_skip -= 1  # compile warmup, not latency
-            elif self._lat_invalidate:
-                self._lat_invalidate = False
-            else:
+            elif not invalid:
                 dt_ms = (time.perf_counter() - t0) * 1e3
                 self._lat_samples.append(dt_ms)
                 self._govern(dt_ms)
@@ -667,7 +673,10 @@ class MeshEngine:
         the host replicas saw none of the applies)."""
         if not self._dev_active:
             return
-        self._lat_invalidate = True  # one-off lane switch, not latency
+        # a lane switch DURING a timed cycle voids that cycle's latency
+        # sample; from outside a cycle (submit-path demotions) there is
+        # no sample in flight to void
+        self._lat_invalidate |= self._lat_timing
         self._dev_active = False
         d = self._dev.dump()  # ONE table materialization for all replicas
         for sm in self.sms:
@@ -763,7 +772,7 @@ class MeshEngine:
     def _demote_full_blocks(self) -> None:
         """Move staged full-width blocks onto the per-shard queues (the
         general path's representation), preserving submission order."""
-        self._lat_invalidate = True  # one-off lane switch, not latency
+        self._lat_invalidate |= self._lat_timing  # void only mid-cycle
         self._spec = None  # speculated on the full-width lane's slots
         while self._full_blocks:
             block, bfut, _inv = self._full_blocks.popleft()

@@ -35,6 +35,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "asyncio: run the (coroutine) test on a fresh event loop"
     )
+    config.addinivalue_line(
+        "markers",
+        "jax_backend: exercises the fenced device-array engine backend "
+        "(KernelConfig.backend='jax' — directly-attached accelerators "
+        "only; deselect with -m 'not jax_backend')",
+    )
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -399,6 +399,13 @@ class TestBlockLaneFaults:
 
 
 class TestJaxBackendEngine:
+    """The FENCED device-array engine backend (KernelConfig.backend=
+    "jax"): kept for directly-attached accelerators; on tunneled
+    hardware the per-tick readback floor makes it ~75x slower than the
+    host kernel (docs/PERFORMANCE.md, 'Engine kernel backends'). These
+    tests keep the path correct, not fast."""
+
+    @pytest.mark.jax_backend
     @pytest.mark.asyncio
     async def test_jax_kernel_backend_commits(self):
         """KernelConfig.backend='jax' (device-array state + inbox planes)

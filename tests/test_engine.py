@@ -679,3 +679,50 @@ class TestMixedProgressSync:
         assert b.store.get(0, b"x") == (b"Ax", 1)
         assert b.store.get(1, b"y") == (b"By", 1)  # kept
         assert b.store.get(2, b"z") is None  # NOT adopted
+
+
+class TestBackendFencing:
+    def test_default_engine_is_host_kernel_only(self):
+        """The engine hot path is single-backend by default: the native/
+        numpy HostNodeKernel. backend='jax' is the fenced directly-
+        attached-accelerator path and must be an explicit opt-in."""
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.core.types import NodeId
+        from rabia_tpu.kernel.host_driver import HostNodeKernel
+        from rabia_tpu.net import InMemoryHub
+
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        eng = RabiaEngine(
+            ClusterConfig.new(nodes[0], nodes),
+            InMemoryStateMachine(),
+            hub.register(nodes[0]),
+            config=RabiaConfig(),
+        )
+        assert eng._host_kernel
+        assert type(eng.kernel) is HostNodeKernel
+
+    @pytest.mark.jax_backend
+    def test_jax_backend_warns_on_selection(self, caplog):
+        import logging
+
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.core.types import NodeId
+        from rabia_tpu.net import InMemoryHub
+
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        with caplog.at_level(logging.WARNING, logger="rabia_tpu.engine"):
+            RabiaEngine(
+                ClusterConfig.new(nodes[0], nodes),
+                InMemoryStateMachine(),
+                hub.register(nodes[0]),
+                config=RabiaConfig().with_kernel(
+                    num_shards=2, shard_pad_multiple=2, backend="jax"
+                ),
+            )
+        assert any("directly-attached" in r.message for r in caplog.records)
