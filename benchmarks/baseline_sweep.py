@@ -177,9 +177,16 @@ async def _block_pump(engines, S, R, dur, shard_cmds, live=None):
                     asyncio.gather(*futs), max(10.0, dur)
                 )
                 for res in results:
-                    acked += sum(
-                        len(r) for r in res if not isinstance(r, Exception)
-                    )
+                    counts = getattr(res, "group_counts", None)
+                    if counts is not None:
+                        # count acks without materializing responses
+                        acked += int(counts().sum())
+                    else:
+                        acked += sum(
+                            len(r)
+                            for r in res
+                            if not isinstance(r, Exception)
+                        )
             except (asyncio.TimeoutError, Exception):
                 await asyncio.sleep(0.02)
 

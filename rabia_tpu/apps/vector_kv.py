@@ -116,6 +116,19 @@ class FrameGroups(Sequence):
         a, b = int(self.bounds[j]), int(self.bounds[j + 1])
         return [self.frames[i] for i in range(a, b)]
 
+    def __iter__(self):
+        # explicit: the Sequence ABC fallback probes __getitem__ through
+        # a generic wrapper per element (measurably slow on the ack path)
+        frames = self.frames
+        bl = self.bounds.tolist()  # one conversion, not 2 numpy reads/group
+        for a, b in zip(bl, bl[1:]):
+            yield [frames[i] for i in range(a, b)]
+
+    def group_counts(self) -> np.ndarray:
+        """i64[k] responses per group WITHOUT materializing any frame —
+        the cheap ack count for clients that only need sizes."""
+        return np.diff(self.bounds)
+
     def __eq__(self, other) -> bool:
         if not isinstance(other, (list, tuple, Sequence)):
             return NotImplemented
