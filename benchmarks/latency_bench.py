@@ -119,7 +119,18 @@ def main() -> None:
     if "--record" in sys.argv:
         path = Path(__file__).parent / "results.json"
         doc = json.loads(path.read_text()) if path.exists() else {}
-        doc["latency_r04"] = out
+        # per-entry merge: other writers (multiproc_latency.py) and
+        # hand-added annotations share this object — whole-object
+        # assignment would silently delete their entries
+        prior = doc.get("latency_r04")
+        if isinstance(prior, dict):
+            for k, v in out.items():
+                if isinstance(v, dict) and isinstance(prior.get(k), dict):
+                    prior[k].update(v)
+                else:
+                    prior[k] = v
+        else:
+            doc["latency_r04"] = out
         path.write_text(json.dumps(doc, indent=1))
         print("recorded -> results.json latency_r04")
 

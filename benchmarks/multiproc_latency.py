@@ -22,11 +22,10 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 REPLICA_CODE = r"""
 import asyncio, json, sys, time
@@ -116,52 +115,16 @@ asyncio.run(main())
 """
 
 
-def _free_ports(n: int) -> list[int]:
-    import socket
-
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
-
 def main() -> int:
+    from rabia_tpu.testing.multiproc import run_replica_cluster
+
     n = int(os.environ.get("MP_LAT_N", "400"))
-    ports = _free_ports(3)
-    env = dict(os.environ)
-    env["PYTHONPATH"] = f"{REPO}{os.pathsep}" + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen(
-            [
-                sys.executable, "-c", REPLICA_CODE,
-                str(i), json.dumps(ports), str(n),
-            ],
-            stdout=subprocess.PIPE,
-            text=True,
-            env=env,
-            cwd=REPO,
-        )
-        for i in range(3)
-    ]
+    outs = run_replica_cluster(REPLICA_CODE, 3, [str(n)])
     result = None
-    try:
-        for i, p in enumerate(procs):
-            out, _ = p.communicate(timeout=240)
-            for line in out.splitlines():
-                if line.startswith("RESULT "):
-                    result = json.loads(line[len("RESULT "):])
-            if p.returncode != 0:
-                print(out)
-                raise SystemExit(f"replica {i} failed rc={p.returncode}")
-    finally:
-        for p in procs:  # a hung/failed replica must not orphan the rest
-            if p.poll() is None:
-                p.kill()
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                result = json.loads(line[len("RESULT "):])
     if result is None:
         raise SystemExit("no RESULT line from replica 0")
     print("multiproc_3rep_tcp:", result)
@@ -171,10 +134,11 @@ def main() -> int:
         doc = json.loads(path.read_text()) if path.exists() else {}
         cores = os.cpu_count() or 1
         interp = (
-            "on this 1-core host the 3 processes time-slice on "
-            "scheduler quanta, so this exceeds the in-process serial "
-            "p50 — it measures the deployment shape's cost under core "
-            "starvation, not the engine"
+            f"on this {cores}-core host the 3 processes contend for "
+            "cores and time-slice on scheduler quanta, so this can "
+            "exceed the in-process serial p50 — it measures the "
+            "deployment shape's cost under core starvation, not the "
+            "engine"
             if cores < 3
             else f"with {cores} cores the replicas' work overlaps; the "
             "~130us transport RTT and per-activation engine work set "

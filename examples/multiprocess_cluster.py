@@ -63,7 +63,17 @@ async def main():
             S, eng.submit_batch, machines, submit_block=eng.submit_block
         )
         pairs = [(f"mp{i}", f"val{i}") for i in range(N_OPS)]
-        res = await asyncio.wait_for(svc.set_many(pairs), 60.0)
+        # retry on transient quorum flaps (a starved host can miss a
+        # heartbeat window right after startup; a real client retries)
+        from rabia_tpu.core.errors import QuorumNotAvailableError
+        for attempt in range(5):
+            try:
+                res = await asyncio.wait_for(svc.set_many(pairs), 60.0)
+                break
+            except QuorumNotAvailableError:
+                await asyncio.sleep(0.5)
+        else:
+            raise SystemExit("no quorum after 5 attempts")
         ok = sum(1 for r in res if r.ok)
         print(f"replica 0: committed {ok}/{N_OPS}", flush=True)
 
@@ -97,47 +107,22 @@ asyncio.run(main())
 """
 
 
-def _free_ports(n: int) -> list[int]:
-    import socket
-
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
-
 def main() -> int:
+    sys.path.insert(0, str(REPO))
+    from rabia_tpu.testing.multiproc import run_replica_cluster
+
     n_ops = 40
-    ports = _free_ports(3)
-    env = dict(os.environ)
-    env["PYTHONPATH"] = f"{REPO}{os.pathsep}" + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", REPLICA_CODE, str(i), json.dumps(ports), str(n_ops)],
-            stdout=subprocess.PIPE,
-            text=True,
-            env=env,
-            cwd=REPO,
-        )
-        for i in range(3)
-    ]
+    outs = run_replica_cluster(
+        REPLICA_CODE, 3, [str(n_ops)], timeout=180.0
+    )
     digests = []
-    for i, p in enumerate(procs):
-        out, _ = p.communicate(timeout=180)
+    for i, out in enumerate(outs):
         print(f"--- replica {i} ---")
         for line in out.splitlines():
             if line.startswith("DIGEST "):
                 digests.append(line[len("DIGEST "):])
             else:
                 print(" ", line)
-        if p.returncode != 0:
-            print(f"replica {i} exited rc={p.returncode}")
-            return 1
     if len(digests) != 3 or len(set(digests)) != 1:
         print("FAIL: replica digests diverge or are missing")
         return 1

@@ -1,7 +1,10 @@
 """Consensus over real TCP sockets via the native C++ transport.
 
-Reference parity: examples/src/tcp_networking.rs:20-43 (3-node real-TCP
-demo). Run: python examples/tcp_networking.py
+Reference parity: examples/tcp_networking.rs:20-43 (3-node real-TCP
+demo) and :329-430 (dynamic topology: a 4th node's transport joins the
+running cluster, exchanges traffic, then leaves — transport-level, like
+the reference's; consensus membership stays the configured cluster).
+Run: python examples/tcp_networking.py
 """
 
 import asyncio
@@ -51,6 +54,40 @@ async def main() -> None:
 
     await asyncio.sleep(0.5)
     print("replica states:", [sm.get_state_summary() for sm in sms])
+
+    # -- dynamic topology (tcp_networking.rs:329-430): a NEW node's
+    # transport joins the running cluster at the data-plane level --------
+    new_id = NodeId.from_int(4)
+    new_net = TcpNetwork(new_id, TcpNetworkConfig(bind_port=0))
+    print(f"new node joining on port {new_net.port}")
+    for i in range(3):
+        new_net.add_peer(ids[i], "127.0.0.1", ports[i])  # new -> existing
+        nets[i].add_peer(new_id, "127.0.0.1", new_net.port)  # existing -> new
+    for _ in range(200):
+        if len(await new_net.get_connected_nodes()) == 3:
+            break
+        await asyncio.sleep(0.02)
+    connected = await new_net.get_connected_nodes()
+    print(f"new node connected to {len(connected)} peers")
+    # traffic flows through the expanded topology: the running replicas'
+    # heartbeat broadcasts now reach the new node's transport (its
+    # receive stream is unowned — the replicas' streams belong to their
+    # engines and must not be read here)
+    from rabia_tpu.core.serialization import Serializer
+
+    sender, data = await new_net.receive(timeout=10.0)
+    msg = Serializer().deserialize(data)
+    print(
+        f"new node heard {type(msg.payload).__name__} from {sender} "
+        "through the expanded topology"
+    )
+    # and leaves again
+    for i in range(3):
+        nets[i].remove_peer(new_id)
+    await new_net.close()
+    print("new node departed; cluster continues")
+    fut = await engines[0].submit_batch(CommandBatch.new(["SET after-leave ok"]))
+    await asyncio.wait_for(fut, 15.0)
 
     for e in engines:
         await e.shutdown()
