@@ -121,14 +121,13 @@ def main() -> None:
         doc = json.loads(path.read_text()) if path.exists() else {}
         # per-entry merge: other writers (multiproc_latency.py) and
         # hand-added annotations share this object — whole-object
-        # assignment would silently delete their entries
+        # assignment would silently delete their entries. Each entry
+        # THIS run measured is replaced wholesale (a shallow update
+        # would mix stale sub-keys from old runs into fresh numbers);
+        # entries this run did not produce are preserved.
         prior = doc.get("latency_r04")
         if isinstance(prior, dict):
-            for k, v in out.items():
-                if isinstance(v, dict) and isinstance(prior.get(k), dict):
-                    prior[k].update(v)
-                else:
-                    prior[k] = v
+            prior.update(out)
         else:
             doc["latency_r04"] = out
         path.write_text(json.dumps(doc, indent=1))

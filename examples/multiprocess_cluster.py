@@ -13,8 +13,6 @@ Run: python examples/multiprocess_cluster.py
 from __future__ import annotations
 
 import json
-import os
-import subprocess
 import sys
 from pathlib import Path
 

@@ -1,43 +1,45 @@
 """Test & benchmark harnesses: fault injection, scenarios, perf loads.
 
 The rebuild of the reference's rabia-testing crate (SURVEY.md §1.5).
+
+Re-exports are lazy (PEP 562): the harness submodules pull the engine
+and kernel (and thus JAX, ~2s) on first attribute access, so
+stdlib-only members like :mod:`rabia_tpu.testing.multiproc` stay
+importable from lightweight parent drivers without loading the runtime.
 """
 
-from rabia_tpu.testing.cluster import TestCluster, default_test_config
-from rabia_tpu.testing.fault_injection import (
-    ConsensusTestHarness,
-    ExpectedOutcome,
-    Fault,
-    FaultType,
-    ScenarioResult,
-    TestScenario,
-    canned_scenarios,
-    run_scenario,
-)
-from rabia_tpu.testing.scenarios import (
-    PerformanceBenchmark,
-    PerformanceReport,
-    PerformanceTest,
-    canned_performance_tests,
-    print_summary,
-    run_performance_test,
-)
+_EXPORTS = {
+    "TestCluster": "rabia_tpu.testing.cluster",
+    "default_test_config": "rabia_tpu.testing.cluster",
+    "ConsensusTestHarness": "rabia_tpu.testing.fault_injection",
+    "ExpectedOutcome": "rabia_tpu.testing.fault_injection",
+    "Fault": "rabia_tpu.testing.fault_injection",
+    "FaultType": "rabia_tpu.testing.fault_injection",
+    "ScenarioResult": "rabia_tpu.testing.fault_injection",
+    "TestScenario": "rabia_tpu.testing.fault_injection",
+    "canned_scenarios": "rabia_tpu.testing.fault_injection",
+    "run_scenario": "rabia_tpu.testing.fault_injection",
+    "PerformanceBenchmark": "rabia_tpu.testing.scenarios",
+    "PerformanceReport": "rabia_tpu.testing.scenarios",
+    "PerformanceTest": "rabia_tpu.testing.scenarios",
+    "canned_performance_tests": "rabia_tpu.testing.scenarios",
+    "print_summary": "rabia_tpu.testing.scenarios",
+    "run_performance_test": "rabia_tpu.testing.scenarios",
+}
 
-__all__ = [
-    "ConsensusTestHarness",
-    "TestCluster",
-    "default_test_config",
-    "ExpectedOutcome",
-    "Fault",
-    "FaultType",
-    "PerformanceBenchmark",
-    "PerformanceReport",
-    "PerformanceTest",
-    "ScenarioResult",
-    "TestScenario",
-    "canned_performance_tests",
-    "canned_scenarios",
-    "print_summary",
-    "run_performance_test",
-    "run_scenario",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(mod), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -69,7 +69,12 @@ def run_replica_cluster(
             out, _ = p.communicate(timeout=timeout)
             outs.append(out)
             if p.returncode != 0:
-                print(out)
+                # print EVERY collected replica's output, not just the
+                # failer's — cross-replica context (who dropped quorum
+                # first) is usually the diagnosis
+                for j, o in enumerate(outs):
+                    print(f"--- replica {j} output ---")
+                    print(o)
                 raise SystemExit(f"replica {i} failed rc={p.returncode}")
     finally:
         for p in procs:  # a hung/failed replica must not orphan the rest

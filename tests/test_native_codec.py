@@ -375,3 +375,91 @@ class TestNativeCodecErrors:
         data[-10] ^= 0xFF  # corrupt block data near the tail
         with pytest.raises(SerializationError):
             native.decode(bytes(data))
+
+
+class TestDecodeRobustness:
+    """The codecs parse NETWORK bytes: any input must either decode or
+    raise a clean error — never crash the process (the C extension) or
+    leak into wrong-typed objects. Mutations of valid frames exercise
+    checksum/bounds/code paths; pure garbage exercises the envelope."""
+
+    def _mk_frames(self) -> list[bytes]:
+        from rabia_tpu.core.messages import NewBatch, Propose
+        from rabia_tpu.core.types import CommandBatch, ShardId, StateValue
+
+        ser = BinarySerializer()
+        batch = CommandBatch.new(["SET a b", "SET c d"], shard=ShardId(1))
+        frames = []
+        for payload in (
+            VoteRound1(
+                shards=np.arange(4, dtype=np.int64),
+                phases=np.arange(4, dtype=np.int64) << 16,
+                vals=np.ones(4, np.int8),
+            ),
+            Decision(
+                shards=np.arange(3, dtype=np.int64),
+                phases=np.arange(3, dtype=np.int64) << 16,
+                vals=np.ones(3, np.int8),
+            ),
+            Propose(
+                shard=1, phase=2, batch_id=BatchId(uuid.UUID(int=7)),
+                value=StateValue.V1, batch=batch,
+            ),
+            NewBatch(shard=2, batch=batch),
+            HeartBeat(current_phase=5, committed_phase=4),
+            SyncRequest(current_phase=9, state_version=3),
+        ):
+            frames.append(
+                ser._serialize_py(
+                    ProtocolMessage.new(NodeId.from_int(1), payload)
+                )
+            )
+        return frames
+
+    def test_mutation_fuzz_never_crashes(self):
+        rng = np.random.default_rng(23)
+        ser = BinarySerializer()
+        frames = self._mk_frames()
+        decoded = bad = 0
+        for trial in range(3000):
+            base = bytearray(frames[trial % len(frames)])
+            k = int(rng.integers(1, 4))
+            for _ in range(k):
+                op = rng.integers(0, 3)
+                if op == 0 and base:  # flip a byte
+                    base[int(rng.integers(0, len(base)))] ^= int(
+                        rng.integers(1, 256)
+                    )
+                elif op == 1 and len(base) > 4:  # truncate
+                    del base[int(rng.integers(1, len(base))):]
+                else:  # append garbage
+                    base.extend(
+                        rng.integers(0, 256, int(rng.integers(1, 16))).astype(
+                            np.uint8
+                        ).tobytes()
+                    )
+            for decode in (native.decode, ser._deserialize_py):
+                try:
+                    out = decode(bytes(base))
+                    if out is not None:
+                        assert isinstance(out, ProtocolMessage)
+                        decoded += 1
+                except Exception:
+                    bad += 1  # clean rejection — any Python exception
+        assert bad > 0  # mutations are actually detected
+        assert decoded > 0  # and the baseline frames actually decode
+
+    def test_pure_garbage_never_crashes(self):
+        rng = np.random.default_rng(5)
+        ser = BinarySerializer()
+        for trial in range(1500):
+            blob = rng.integers(
+                0, 256, int(rng.integers(0, 200))
+            ).astype(np.uint8).tobytes()
+            for decode in (native.decode, ser._deserialize_py):
+                try:
+                    out = decode(blob)
+                    if out is not None:
+                        assert isinstance(out, ProtocolMessage)
+                except Exception:
+                    pass  # clean rejection
