@@ -69,9 +69,16 @@ async def transport_latency(serial: int = 200, pipelined: int = 400) -> dict:
     await asyncio.gather(*[one(i) for i in range(pipelined)])
 
     await _stop(engines, tasks)
+    import os
+
     return {
         "serial_closed_loop": _pct(serial_samples),
         "pipelined_16_in_flight": _pct(piped_samples),
+        "note": (
+            f"all replicas on ONE event loop ({os.cpu_count()}-core "
+            "host: total per-commit engine work bounds serial latency); "
+            "see multiproc_3rep_tcp for the process-per-replica shape"
+        ),
     }
 
 
