@@ -17,17 +17,35 @@ WORKDIR /usr/src/rabia-tpu
 COPY pyproject.toml README.md ./
 COPY rabia_tpu/ ./rabia_tpu/
 
-# Build a wheel and precompile the native transport (librabia_transport.so
-# is cached next to the source keyed by its digest)
+# Build a wheel and precompile EVERY native artifact — the TCP transport,
+# the binary message codec, and the consensus host-kernel step. All three
+# are digest-keyed (_<name>_<digest>.so next to their sources), so the
+# runtime stage can ship the prebuilt files into the same package path
+# and the loaders' exists() checks hit without a toolchain. Missing any
+# of them would make the runtime image silently fall back to the Python
+# codec / numpy step.
 RUN pip install --no-cache-dir build && python -m build --wheel
 RUN pip install --no-cache-dir dist/*.whl \
-    && python -c "from rabia_tpu.native.build import load_library; load_library()" \
     && python - <<'EOF'
-# copy the compiled transport into a stable path for the runtime stage
-import glob, shutil
-so = glob.glob("/usr/local/lib/python3.12/site-packages/rabia_tpu/native/_transport_*.so")
-assert so, "native transport did not build"
-shutil.copy(so[0], "/usr/src/rabia-tpu/librabia_transport.so")
+from rabia_tpu.native.build import load_codec, load_hostkernel, load_library
+
+load_library()
+assert load_codec() is not None, "native codec did not build"
+assert load_hostkernel() is not None, "native hostkernel did not build"
+
+# stage the digest-named artifacts for the runtime image
+import glob, shutil, os
+src = "/usr/local/lib/python3.12/site-packages/rabia_tpu/native"
+dst = "/usr/src/rabia-tpu/native-libs"
+os.makedirs(dst, exist_ok=True)
+# codec + hostkernel ride the digest-keyed exists() path; the transport
+# keeps its dedicated RABIA_NATIVE_LIB mechanism (stale-symbol probe)
+sos = glob.glob(f"{src}/_codec_*.so") + glob.glob(f"{src}/_hostkernel_*.so")
+assert len(sos) == 2, f"expected codec+hostkernel libs, built: {sos}"
+for so in sos:
+    shutil.copy(so, dst)
+shutil.copy(glob.glob(f"{src}/_transport_*.so")[0],
+            "/usr/src/rabia-tpu/librabia_transport.so")
 EOF
 
 # Runtime stage
@@ -45,6 +63,10 @@ RUN pip install --no-cache-dir /tmp/*.whl && rm /tmp/*.whl
 COPY --from=builder /usr/src/rabia-tpu/librabia_transport.so \
      /usr/local/lib/rabia_tpu/librabia_transport.so
 ENV RABIA_NATIVE_LIB=/usr/local/lib/rabia_tpu/librabia_transport.so
+# prebuilt codec + host-kernel at their digest-keyed paths: the lazy
+# loaders find them by exists() and never need a compiler
+COPY --from=builder /usr/src/rabia-tpu/native-libs/ \
+     /usr/local/lib/python3.12/site-packages/rabia_tpu/native/
 
 # Example drivers are the user surface (reference ships 4 binaries)
 COPY examples/ /usr/local/share/rabia-tpu/examples/

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -69,8 +70,6 @@ async def transport_latency(serial: int = 200, pipelined: int = 400) -> dict:
     await asyncio.gather(*[one(i) for i in range(pipelined)])
 
     await _stop(engines, tasks)
-    import os
-
     return {
         "serial_closed_loop": _pct(serial_samples),
         "pipelined_16_in_flight": _pct(piped_samples),

@@ -241,8 +241,6 @@ class TestEngineWireAdversarial:
 
     @pytest.mark.asyncio
     async def test_garbage_frames_do_not_stop_commits(self):
-        import asyncio
-
         from rabia_tpu.core.types import CommandBatch
         from rabia_tpu.net import InMemoryHub
         from tests.test_engine import _mk_config, _spin_cluster, _teardown
@@ -277,8 +275,6 @@ class TestEngineWireAdversarial:
         applied must not reopen it, corrupt the ledger, or change the
         recorded decision — the engine answers with a repair and drops
         the stale entries."""
-        import asyncio
-
         from rabia_tpu.core.messages import ProtocolMessage, VoteRound1
         from rabia_tpu.core.serialization import Serializer
         from rabia_tpu.core.types import CommandBatch
