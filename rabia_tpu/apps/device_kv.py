@@ -352,6 +352,96 @@ class DeviceKVTable:
             "shard_version": sver[: self.n_shards].astype(np.int64),
         }
 
+    def upload_from(self, sm) -> bool:
+        """Rebuild the device table from one host replica store
+        (``dump``'s inverse — the re-promotion path after a demotion).
+
+        Returns False, leaving the device state untouched, when the host
+        content is outside the lane's envelope: an overflow side-store
+        entry, a key over ``K`` bytes, a value over ``VW`` bytes, more
+        than ``P`` live entries in one shard, or a version past i32.
+        Placement is order-free: the fused program's match compares the
+        op key against ALL ``P`` slots of a shard, so any assignment of
+        entries to distinct slots is a valid table.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P_
+
+        from rabia_tpu.apps.vector_kv import _USED
+        from rabia_tpu.parallel.mesh import SHARD_AXIS
+
+        store = sm.store
+        if store._overflow:
+            return False  # long keys live outside the inline table
+        idx = np.nonzero(store.state == _USED)[0]
+        shards = store.shard_col[idx]
+        if idx.size:
+            if int(store.key_len[idx].max()) > self.K:
+                return False
+            if int(store.val_len[idx].max()) > self.VW:
+                return False
+            if int(store.version[idx].max()) >= 2**31 - 2:
+                return False
+            counts = np.bincount(shards, minlength=self.n_shards)
+            if int(counts.max()) > self.P:
+                return False
+        if int(store.shard_version[: self.n_shards].max(initial=0)) >= (
+            2**31 - 2
+        ):
+            return False
+
+        S, Pc = self.S, self.P
+        used = np.zeros((S, Pc), bool)
+        keyb = np.zeros((S, Pc, self.K), np.uint8)
+        klen = np.zeros((S, Pc), np.int32)
+        ver = np.zeros((S, Pc), np.int32)
+        valb = np.zeros((S, Pc, self.VW), np.uint8)
+        vlen = np.zeros((S, Pc), np.int32)
+        # stable per-shard slot assignment: entries sorted by shard, slot
+        # p = running index within the shard — columnar scatters for the
+        # fixed-width planes; only the ragged value buffers loop
+        order = np.argsort(shards, kind="stable")
+        if idx.size:
+            sh_sorted = shards[order]
+            starts = np.searchsorted(sh_sorted, np.arange(self.n_shards))
+            pos = np.arange(idx.size) - starts[sh_sorted]
+            src = idx[order]
+            used[sh_sorted, pos] = True
+            kls = store.key_len[src].astype(np.int64)
+            klen[sh_sorted, pos] = kls
+            ver[sh_sorted, pos] = store.version[src]
+            vlen[sh_sorted, pos] = store.val_len[src]
+            key_bytes_all = store.key_lanes[src].view(np.uint8)  # [n, L*8]
+            kb_w = min(self.K, key_bytes_all.shape[1])
+            # zero-padded lanes guarantee zero tails, so one 2-D copy is
+            # exact (no per-row tail clearing needed)
+            keyb[sh_sorted, pos, :kb_w] = key_bytes_all[:, :kb_w]
+            for j in range(idx.size):
+                i = src[j]
+                buf = store.val_buf[i]
+                a = int(store.val_off[i])
+                b = a + int(store.val_len[i])
+                v = buf[a:b] if buf is not None else b""
+                valb[sh_sorted[j], pos[j], : len(v)] = np.frombuffer(
+                    v, np.uint8
+                )
+        sver = np.zeros(S, np.int32)
+        sver[: self.n_shards] = store.shard_version[: self.n_shards]
+
+        shard_sharding = NamedSharding(self.kernel.mesh, P_(SHARD_AXIS))
+        put = lambda a: jax.device_put(jnp.asarray(a), shard_sharding)
+        self.state = (
+            put(used),
+            put(np.ascontiguousarray(keyb).view(np.uint32)),
+            put(klen),
+            put(ver),
+            put(np.ascontiguousarray(valb).view(np.uint32)),
+            put(vlen),
+            put(sver),
+        )
+        return True
+
     def sync_into(self, sm, dump: Optional[dict] = None) -> None:
         """Rebuild one host replica store (VectorShardedKV) from the
         device table. The host store is reset first — in device mode the

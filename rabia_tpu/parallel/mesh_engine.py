@@ -222,6 +222,7 @@ class MeshEngine:
         max_decision_history: int = 4096,
         device_store: bool = False,
         device_store_kw: Optional[dict] = None,
+        device_store_repromote: int = 64,
         latency_target_ms: Optional[float] = None,
         min_window: int = 1,
         max_window: int = 256,
@@ -330,6 +331,10 @@ class MeshEngine:
             # host mirror of the device per-shard version counters:
             # response versions derive from it (no per-op readback)
             self._dev_sver = np.zeros(self.S, np.int64)
+        # full-width cycles between re-promotion attempts after a
+        # demotion (0 disables climbing back onto the device lane)
+        self._dev_repromote = max(0, int(device_store_repromote))
+        self._dev_cooldown = 0
 
     # -- client surface ------------------------------------------------------
 
@@ -486,6 +491,18 @@ class MeshEngine:
     def _run_cycle_inner(self) -> int:
         if self._full_blocks:
             if self._vector and self._queued_entries == 0:
+                if (
+                    not self._dev_active
+                    and self._dev is not None
+                    and self._dev_repromote > 0
+                ):
+                    # demoted device lane: periodically try to climb back
+                    # (the host stores are quiescent between cycles, so
+                    # the upload captures an exact snapshot)
+                    if self._dev_cooldown > 0:
+                        self._dev_cooldown -= 1
+                    else:
+                        self._try_repromote_device_store()
                 if self._dev_active:
                     return self._run_cycle_fullwidth_device()
                 return self._run_cycle_fullwidth()
@@ -678,6 +695,7 @@ class MeshEngine:
         # no sample in flight to void
         self._lat_invalidate |= self._lat_timing
         self._dev_active = False
+        self._dev_cooldown = self._dev_repromote  # earn the way back
         d = self._dev.dump()  # ONE table materialization for all replicas
         for sm in self.sms:
             self._dev.sync_into(sm, dump=d)
@@ -685,6 +703,32 @@ class MeshEngine:
             "device KV lane demoted to host stores (%d entries)",
             len(d["rows"]),
         )
+
+    def _try_repromote_device_store(self) -> None:
+        """Climb back onto the device lane after a demotion: rebuild the
+        device table from replica 0's store (all replicas are equal — a
+        divergence is already counted/handled by the apply path) and
+        re-arm. Declines (outside the envelope: long keys, wide values,
+        per-shard overflow) re-arm the cool-down and retry later —
+        deletes/GC can bring the content back inside."""
+        # pre-screen the WORKLOAD before paying the table upload: if the
+        # very next window would demote again (e.g. a steady GET-bearing
+        # stream), re-promoting would thrash a full upload+dump round
+        # trip every cool-down period for zero device windows
+        head = [self._full_blocks[0][0]] if self._full_blocks else []
+        if head and self._dev.pack_window(head) is None:
+            self._dev_cooldown = self._dev_repromote
+            return
+        if self._dev.upload_from(self.sms[0]):
+            self._dev_sver[:] = 0
+            sv = self.sms[0].store.shard_version[: self.n_shards]
+            self._dev_sver[: self.n_shards] = sv
+            self._dev_spec = None
+            self._dev_active = True
+            self._lat_invalidate |= self._lat_timing  # upload, not latency
+            logger.info("device KV lane re-promoted from host stores")
+        else:
+            self._dev_cooldown = self._dev_repromote
 
     def _run_cycle_fullwidth(self) -> int:
         """Vectorized happy path: the pending work is a FIFO of
@@ -1098,8 +1142,10 @@ class MeshEngine:
             raise RabiaError("restore requires an idle engine")
         self._spec = None  # speculated on pre-restore slot counters
         # a restored snapshot supersedes any device-lane state: continue
-        # on the host path (no sync — the checkpoint IS the state)
+        # on the host path (no sync — the checkpoint IS the state); the
+        # re-promotion path may climb back after the usual cool-down
         self._dev_active = False
+        self._dev_cooldown = self._dev_repromote
         committed = np.asarray(
             state.per_shard_committed[: self.n_shards], np.int64
         )

@@ -210,3 +210,83 @@ class TestDeviceKVConformance:
         want = _store_content(fresh.sms[0], n)
         dev._demote_device_store()
         assert _store_content(dev.sms[0], n) == want
+
+
+class TestRePromotion:
+    """After a demotion the engine climbs back onto the device lane:
+    upload_from rebuilds the device table from the (authoritative) host
+    stores, and subsequent windows run fused again — with version
+    continuity and content identical to a pure-host engine."""
+
+    def test_demote_then_repromote_conformance(self):
+        import struct
+
+        encode_get_bin = (
+            lambda k: bytes([2]) + struct.pack("<H", len(k)) + k.encode()
+        )
+        n = 4
+        rng = np.random.default_rng(11)
+        dev = _mk(n, device=True, device_store_repromote=2)
+        host = _mk(n, device=False)
+        rng_h = np.random.default_rng(11)
+
+        def both(blocks_fn):
+            for b in blocks_fn(rng):
+                dev.submit_block(b)
+            for b in blocks_fn(rng_h):
+                host.submit_block(b)
+            dev.flush()
+            host.flush()
+
+        both(lambda r: _set_blocks(n, waves=3, rng=r))
+        assert dev._dev_active
+        # demote via a GET block
+        g = lambda r: [
+            build_block(
+                list(range(n)), [[encode_get_bin(f"k{s}_0")] for s in range(n)]
+            )
+        ]
+        both(g)
+        assert not dev._dev_active
+        # host-lane SETs while demoted (content the upload must carry)
+        both(lambda r: _set_blocks(n, waves=2, rng=r))
+        assert not dev._dev_active  # cooldown (2 cycles) not yet served
+        # more full-width cycles serve the cooldown and re-promote
+        both(lambda r: _set_blocks(n, waves=3, rng=r))
+        both(lambda r: _set_blocks(n, waves=3, rng=r))
+        assert dev._dev_active, "device lane did not re-promote"
+        # device-lane windows after re-promotion stay conformant
+        both(lambda r: _set_blocks(n, waves=4, rng=r))
+        assert dev._dev_active
+        dev._demote_device_store()  # final sync-down for comparison
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+
+    def test_upload_declines_outside_envelope(self):
+        n = 2
+        dev = _mk(n, device=True, device_store_repromote=1)
+        # value wider than the device table's VW: host-lane only content
+        wide = "x" * 300
+        dev.submit_block(
+            build_block(
+                list(range(n)),
+                [[encode_set_bin(f"k{s}", wide)] for s in range(n)],
+            )
+        )
+        dev.flush()
+        assert not dev._dev_active  # wide value demoted the lane
+        # re-promotion attempts must DECLINE while the wide value lives
+        for _ in range(4):
+            dev.submit_block(
+                build_block(
+                    list(range(n)),
+                    [[encode_set_bin(f"s{s}", "v")] for s in range(n)],
+                )
+            )
+            dev.flush()
+        assert not dev._dev_active
+        # content still correct on the host path
+        for sm in dev.sms:
+            got = sm.store.get(0, b"k0")
+            assert got is not None and got[0] == wide.encode()
