@@ -290,3 +290,37 @@ class TestRePromotion:
         for sm in dev.sms:
             got = sm.store.get(0, b"k0")
             assert got is not None and got[0] == wide.encode()
+
+
+class TestGovernedDeviceLane:
+    def test_governor_resizes_with_device_store_conformant(self):
+        """latency_target_ms + device_store compose: the governor walks
+        W (each size recompiles the fused program) while the device lane
+        stays active and content matches a fixed-window host engine."""
+        n = 8
+        eng = MeshEngine(
+            lambda: VectorShardedKV(n, capacity=1 << 12),
+            n_shards=n,
+            n_replicas=3,
+            mesh=make_mesh(),
+            window=2,
+            device_store=True,
+            latency_target_ms=60_000.0,
+            max_window=8,
+        )
+        host = _mk(n, device=False)
+        rng = np.random.default_rng(2)
+        rng_h = np.random.default_rng(2)
+        for r in range(25):
+            for b in _set_blocks(n, waves=8, rng=rng):  # deep: saturates W
+                eng.submit_block(b)
+            for b in _set_blocks(n, waves=8, rng=rng_h):
+                host.submit_block(b)
+            eng.flush()
+            host.flush()
+        assert eng.window_resizes > 0, "governor never resized"
+        assert eng._dev_active
+        eng._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in eng.sms:
+            assert _store_content(sm, n) == want
