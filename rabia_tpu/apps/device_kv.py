@@ -49,6 +49,7 @@ the host store but the observable key->(value, version) mapping cannot.
 
 from __future__ import annotations
 
+from collections.abc import Sequence
 from typing import NamedTuple, Optional
 
 import numpy as np
@@ -80,6 +81,64 @@ class DeviceWindowOps(NamedTuple):
     vlen: np.ndarray  # i16[W, S]
     kwin: np.ndarray  # u32[W, S, Ku/4]
     vwin: np.ndarray  # u32[W, S, VWu/4]
+
+
+class GetFrameGroups(Sequence):
+    """Lazy per-shard GET responses over one wave's lookup readback.
+
+    Byte-for-byte the host store's GET framing (`_result_bin`): frames
+    materialize only when a client reads them — the commit path stores
+    this view (one object per block, no per-op Python).
+    """
+
+    __slots__ = ("shards", "found", "ver", "vlen", "valb")
+
+    def __init__(self, shards, found, ver, vlen, val_words) -> None:
+        self.shards = shards  # i64[k] covered shards, group order
+        self.found = found  # bool[S]
+        self.ver = ver  # i32[S]
+        self.vlen = vlen  # i32[S]
+        # contiguous: a fetched device array slice can come back with a
+        # non-contiguous layout, which .view(uint8) rejects
+        self.valb = np.ascontiguousarray(val_words).view(np.uint8)  # u8[S, VW]
+
+    def __len__(self) -> int:
+        return len(self.shards)
+
+    def _frame(self, s: int) -> bytes:
+        from rabia_tpu.apps.kvstore import _result_bin
+
+        if not self.found[s]:
+            return _result_bin(1, 0)
+        ver = int(self.ver[s])
+        val = self.valb[s, : int(self.vlen[s])].tobytes()
+        try:
+            return _result_bin(0, ver, val.decode("utf-8"))
+        except UnicodeDecodeError:
+            return _result_bin(2, ver, "value is not utf-8 text")
+
+    def __getitem__(self, j):
+        if isinstance(j, slice):
+            return [self[i] for i in range(*j.indices(len(self)))]
+        if j < 0:
+            j += len(self)
+        if not (0 <= j < len(self)):
+            raise IndexError(j)
+        return [self._frame(int(self.shards[j]))]
+
+    def __iter__(self):
+        for j in range(len(self)):
+            yield self[j]
+
+    def group_counts(self) -> np.ndarray:
+        return np.ones(len(self), np.int64)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, (list, tuple, Sequence)):
+            return NotImplemented
+        return len(self) == len(other) and all(
+            a == b for a, b in zip(self, other)
+        )
 
 
 class DeviceKVTable:
@@ -122,8 +181,33 @@ class DeviceKVTable:
         )
         self._fused = None  # built per (W, Ku4, VWu4) — see decide_apply
         self._fused_cache: dict = {}
+        # True when the most recent decide_apply/lookup_window built a
+        # new program: the engine's latency governor must not read that
+        # dispatch's wall time as window latency
+        self.compiled_on_last_call = False
 
     # -- host-side packing -------------------------------------------------
+
+    def _parse_block(self, b):
+        """Shared per-block op parse for the window packers: returns
+        ``(dbuf, off, klen, vlen, opcode)`` host arrays (dbuf is the
+        block's bytes padded with K+header slack so fixed-width gathers
+        past the last op stay in bounds), or None when the block is not
+        one-op-per-shard / too short to parse."""
+        if not bool((b.counts == 1).all()):
+            return None
+        raw = np.frombuffer(b.data, np.uint8)
+        if len(raw) < _SET_HDR * len(b):
+            return None
+        off = b.cmd_offsets[:-1]
+        ln = b.cmd_sizes
+        dbuf = np.concatenate([raw, np.zeros(self.K + _SET_HDR, np.uint8)])
+        opcode = dbuf[off]
+        klen = dbuf[off + 1].astype(np.int64) | (
+            dbuf[off + 2].astype(np.int64) << 8
+        )
+        vlen = ln - _SET_HDR - klen
+        return dbuf, off, klen, vlen, opcode
 
     def pack_window(self, blocks) -> Optional[DeviceWindowOps]:
         """Pack ``blocks`` (one per wave, FIFO order) into device inputs.
@@ -137,25 +221,12 @@ class DeviceKVTable:
         parsed = []
         ku = vu = 4
         for b in blocks:
-            if not bool((b.counts == 1).all()):
+            pb = self._parse_block(b)
+            if pb is None:
                 return None
-            raw = np.frombuffer(b.data, np.uint8)
-            if len(raw) < _SET_HDR * len(b):
-                return None
-            # cmd_offsets is a prefix-sum (length total+1); with one op
-            # per covered shard, op i starts at cmd_offsets[i]
-            off = b.cmd_offsets[:-1]
-            ln = b.cmd_sizes
-            pad = np.zeros(self.K + _SET_HDR, np.uint8)
-            dbuf = np.concatenate([raw, pad])
-            opcode = dbuf[off]
-            klen = dbuf[off + 1].astype(np.int64) | (
-                dbuf[off + 2].astype(np.int64) << 8
-            )
-            vlen = ln - _SET_HDR - klen
+            dbuf, off, klen, vlen, opcode = pb
             ok = (
                 (opcode == 1)
-                & (ln >= _SET_HDR)
                 & (klen > 0)
                 & (klen <= self.K)
                 & (vlen >= 0)
@@ -193,7 +264,128 @@ class DeviceKVTable:
             np.ascontiguousarray(vwin_w).view(np.uint32),
         )
 
-    # -- the fused program ---------------------------------------------------
+    def pack_get_window(self, blocks) -> Optional[tuple]:
+        """Pack GET-only full-width blocks into lookup inputs.
+
+        Returns ``(klen i16[W, S], kwin u32[W, S, Ku/4])`` or None when
+        any wave is outside the read lane's envelope (non-GET op, >1 op
+        per shard, malformed, key over the table width) — the caller
+        demotes to the host path."""
+        W = len(blocks)
+        S = self.S
+        parsed = []
+        ku = 4
+        for b in blocks:
+            pb = self._parse_block(b)
+            if pb is None:
+                return None
+            dbuf, off, klen, vlen, opcode = pb
+            ok = (
+                (opcode == 2)
+                & (vlen == 0)  # GET carries exactly the key
+                & (klen > 0)
+                & (klen <= self.K)
+            )
+            if not bool(ok.all()):
+                return None
+            ku = max(ku, _bucket(int(klen.max())))
+            parsed.append((b, dbuf, off, klen))
+        klen_w = np.zeros((W, S), np.int16)
+        kwin_w = np.zeros((W, S, ku), np.uint8)
+        kcols = np.arange(ku)[None, :]
+        for t, (b, dbuf, off, klen) in enumerate(parsed):
+            sh = b.shards
+            klen_w[t, sh] = klen
+            kw = dbuf[(off + _SET_HDR)[:, None] + kcols]
+            kwin_w[t, sh] = np.where(kcols < klen[:, None], kw, 0)
+        return klen_w, np.ascontiguousarray(kwin_w).view(np.uint32)
+
+    # -- the fused programs --------------------------------------------------
+
+    def _build_lookup(self, Ku4: int):
+        """Jitted GET window: consensus slot window + a read-only match
+        over the table (no state mutation, no version advance)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        kernel = self.kernel
+        Pc = self.P
+        K4 = self.K4
+        n = self.n_shards
+        I8, I32 = jnp.int8, jnp.int32
+        col = jnp.arange(self.S) < n
+
+        def lookup(state, alive, base, depth, klen_t, kwin_t, *, W,
+                   max_phases):
+            used, keyw, klen, ver, valw, vlen, _sver = state
+            wave = jnp.arange(W, dtype=I32)[:, None] < depth
+            present = wave & col[None, :]
+            votes = jnp.where(
+                present[:, :, None], I8(V1), I8(V0)
+            ) * jnp.ones((1, 1, kernel.R), I8)
+            decided = kernel.slot_window(
+                votes, alive, base, n_slots=W, max_phases=max_phases
+            )
+            all_v1 = jnp.all(jnp.where(present, decided == V1, True))
+            kwin_full = jnp.pad(kwin_t, ((0, 0), (0, 0), (0, K4 - Ku4)))
+
+            def wave_match(_, inp):
+                klen_w, kwin_w = inp  # [S], [S, K4]
+                klen_w = klen_w.astype(jnp.int32)
+                eq = (
+                    used
+                    & (klen == klen_w[:, None])
+                    & (keyw == kwin_w[:, None, :]).all(-1)
+                )  # [S, P]
+                found = eq.any(1) & (klen_w > 0)
+                oh = eq & found[:, None]  # at most one slot matches
+                rver = (ver * oh).sum(1)
+                rvlen = (vlen * oh).sum(1)
+                rval = (valw * oh[:, :, None]).sum(1)  # [S, VW4] u32
+                return None, (found, rver, rvlen, rval)
+
+            _, (found, rver, rvlen, rval) = lax.scan(
+                wave_match, None, (klen_t, kwin_full)
+            )
+            return all_v1.astype(I32), found, rver, rvlen, rval
+
+        return jax.jit(lookup, static_argnames=("W", "max_phases"))
+
+    def lookup_window(self, alive, base, depth: int, klen, kwin, W: int,
+                      max_phases: int = 4):
+        """Dispatch one consensus+lookup window against the CURRENT
+        table (read-only). Returns host arrays
+        ``(all_v1, found[W,S], ver[W,S], vlen[W,S], val_words[W,S,VW4])``.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if klen.shape[0] < W:
+            pad = W - klen.shape[0]
+            klen = np.concatenate(
+                [klen, np.zeros((pad,) + klen.shape[1:], klen.dtype)]
+            )
+            kwin = np.concatenate(
+                [kwin, np.zeros((pad,) + kwin.shape[1:], kwin.dtype)]
+            )
+        key = ("get", W, kwin.shape[2])
+        fn = self._fused_cache.get(key)
+        self.compiled_on_last_call = fn is None
+        if fn is None:
+            fn = self._build_lookup(kwin.shape[2])
+            self._fused_cache[key] = fn
+        out = fn(
+            self.state,
+            self.kernel.place(jnp.asarray(alive)),
+            jnp.asarray(base),
+            jnp.int32(depth),
+            jnp.asarray(klen),
+            jnp.asarray(kwin),
+            W=W,
+            max_phases=max_phases,
+        )
+        return jax.device_get(out)
 
     def _build_fused(self, Ku4: int, VWu4: int):
         import jax
@@ -308,6 +500,7 @@ class DeviceKVTable:
             )
         key = (W, ops.kwin.shape[2], ops.vwin.shape[2])
         fused = self._fused_cache.get(key)
+        self.compiled_on_last_call = fused is None
         if fused is None:
             fused = self._build_fused(key[1], key[2])
             self._fused_cache[key] = fused

@@ -68,6 +68,21 @@ __all__ = ["MeshBlockFuture", "MeshEngine", "MeshFuture"]
 logger = logging.getLogger(__name__)
 
 
+def _block_op_kind(block) -> Optional[int]:
+    """The uniform opcode of a one-op-per-shard block (1=SET, 2=GET),
+    or None when ops are mixed/absent — the device lanes dispatch by
+    kind; the pack functions re-validate everything else."""
+    if len(block.cmd_sizes) == 0 or not bool((block.counts == 1).all()):
+        return None
+    raw = np.frombuffer(block.data, np.uint8)
+    off = block.cmd_offsets[:-1]
+    if len(raw) == 0 or int(off.max(initial=0)) >= len(raw):
+        return None
+    codes = raw[off]
+    first = int(codes[0])
+    return first if bool((codes == first).all()) else None
+
+
 class MeshFuture:
     """Synchronously settled result holder for one submitted batch.
 
@@ -588,8 +603,23 @@ class MeshEngine:
 
         W = self.window
         n = self.n_shards
-        depth = min(len(self._full_blocks), W)
         self._lat_saturated |= len(self._full_blocks) >= W
+        # the window takes the FIFO head's maximal same-kind run: SET
+        # windows mutate through the fused apply, GET-only windows read
+        # through the lookup program — a kind boundary just splits the
+        # window (FIFO order preserved), it does not demote
+        kinds = [
+            _block_op_kind(self._full_blocks[i][0])
+            for i in range(min(len(self._full_blocks), W))
+        ]
+        head_kind = kinds[0] if kinds else None
+        depth = 0
+        for k in kinds:
+            if k != head_kind:
+                break
+            depth += 1
+        if head_kind == 2:
+            return self._run_cycle_fullwidth_device_get(depth)
         entries = [self._full_blocks[i] for i in range(depth)]  # peek
         base = np.zeros(self.S, np.int32)
         base[:n] = self.next_slot
@@ -608,6 +638,11 @@ class MeshEngine:
                 self.alive, base, depth, ops, W=W,
                 max_phases=self.max_phases,
             )
+            # a new (W, widths) signature compiles inside this dispatch —
+            # seconds of jit, not window latency
+            self._lat_invalidate |= (
+                self._dev.compiled_on_last_call and self._lat_timing
+            )
         self._dev_spec = None
         self.cycles += 1
         # speculate the NEXT window before this one's readback: pack +
@@ -616,14 +651,19 @@ class MeshEngine:
         # cycle's flag round-trip. The program is functional — a fault
         # outcome simply discards the whole chain.
         if len(self._full_blocks) > depth:
-            depth2 = min(len(self._full_blocks) - depth, W)
-            entries2 = [
-                self._full_blocks[depth + i] for i in range(depth2)
-            ]
+            # the lookahead run stops at the first non-SET block — a GET
+            # run splits into its own window and must not kill the SET
+            # chain's speculation (pack_window would decline the mix)
+            entries2 = []
+            for i in range(depth, min(len(self._full_blocks), depth + W)):
+                if _block_op_kind(self._full_blocks[i][0]) != 1:
+                    break
+                entries2.append(self._full_blocks[i])
+            depth2 = len(entries2)
             base2 = base.copy()
             base2[:n] += depth
             ops2 = self._dev.pack_window([e[0] for e in entries2])
-            if ops2 is not None:
+            if entries2 and ops2 is not None:
                 spec = self._dev.decide_apply(
                     self.alive, base2, depth2, ops2, W=W,
                     max_phases=self.max_phases, state=new_state,
@@ -672,6 +712,59 @@ class MeshEngine:
             frames = VectorShardedKV._vers_frames(row)
             bounds = np.arange(len(block) + 1, dtype=np.int64)
             bfut._settle_bulk(FrameGroups(frames, bounds))
+        return depth * n
+
+    def _run_cycle_fullwidth_device_get(self, depth: int) -> int:
+        """GET-only full-width windows through the device table's
+        read-only lookup program: consensus decides the slots and the
+        match gathers (found, version, value) per op in one dispatch —
+        no table mutation, no version advance, responses materialize
+        lazily from the readback. Anything outside the read envelope
+        (long keys, malformed ops) demotes exactly like the write lane.
+        """
+        from rabia_tpu.apps.device_kv import GetFrameGroups
+
+        W = self.window
+        n = self.n_shards
+        entries = [self._full_blocks[i] for i in range(depth)]
+        packed = self._dev.pack_get_window([e[0] for e in entries])
+        if packed is None:
+            self._dev_spec = None
+            self._demote_device_store()
+            return self._run_cycle_inner()
+        base = np.zeros(self.S, np.int32)
+        base[:n] = self.next_slot
+        klen, kwin = packed
+        all_v1, found, ver, vlen, valw = self._dev.lookup_window(
+            self.alive, base, depth, klen, kwin, W=W,
+            max_phases=self.max_phases,
+        )
+        self._lat_invalidate |= (
+            self._dev.compiled_on_last_call and self._lat_timing
+        )
+        self._dev_spec = None  # chained SET state no longer matches base
+        self.cycles += 1
+        if not int(all_v1):
+            self._demote_device_store()
+            return self._run_cycle_inner()
+        for _ in range(depth):
+            self._full_blocks.popleft()
+        start = self.next_slot.copy()
+        self.next_slot[:n] += depth
+        self.decided_v1 += depth * n
+        for t, (block, bfut, inv) in enumerate(entries):
+            self._bulk_log.append((start, t, block, inv))
+        while len(self._bulk_log) > max(
+            1, self.max_decision_history // max(1, self.window)
+        ):
+            self._bulk_log.popleft()
+        for t, (block, bfut, _inv) in enumerate(entries):
+            bfut._settle_bulk(
+                GetFrameGroups(
+                    np.asarray(block.shards, np.int64),
+                    found[t], ver[t], vlen[t], valw[t],
+                )
+            )
         return depth * n
 
     def _dev_window_key(self, entries, base) -> tuple:

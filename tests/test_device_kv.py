@@ -100,7 +100,7 @@ class TestDeviceKVConformance:
 
     def test_mixed_block_demotes_and_stays_correct(self):
         import struct
-        encode_get_bin = lambda k: bytes([2]) + struct.pack("<H", len(k)) + k.encode()
+        encode_del_bin = lambda k: bytes([3]) + struct.pack("<H", len(k)) + k.encode()
 
         n = 4
         rng = np.random.default_rng(7)
@@ -114,13 +114,14 @@ class TestDeviceKVConformance:
         dev.flush()
         host.flush()
         assert dev._dev_active
-        # a GET block is outside the lane's envelope -> demotion, and the
-        # GET must read the device-written values through the host store
+        # a DEL block is outside the lane's envelope (GETs now run
+        # in-lane) -> demotion, and the DEL must act on the
+        # device-written values through the host store
         getb = build_block(
-            list(range(n)), [[encode_get_bin(f"k{s}_0")] for s in range(n)]
+            list(range(n)), [[encode_del_bin(f"k{s}_0")] for s in range(n)]
         )
         getb_h = build_block(
-            list(range(n)), [[encode_get_bin(f"k{s}_0")] for s in range(n)]
+            list(range(n)), [[encode_del_bin(f"k{s}_0")] for s in range(n)]
         )
         df, hf = dev.submit_block(getb), host.submit_block(getb_h)
         dev.flush()
@@ -221,8 +222,8 @@ class TestRePromotion:
     def test_demote_then_repromote_conformance(self):
         import struct
 
-        encode_get_bin = (
-            lambda k: bytes([2]) + struct.pack("<H", len(k)) + k.encode()
+        encode_del_bin = (
+            lambda k: bytes([3]) + struct.pack("<H", len(k)) + k.encode()
         )
         n = 4
         rng = np.random.default_rng(11)
@@ -240,10 +241,10 @@ class TestRePromotion:
 
         both(lambda r: _set_blocks(n, waves=3, rng=r))
         assert dev._dev_active
-        # demote via a GET block
+        # demote via a DEL block (GETs now run in-lane)
         g = lambda r: [
             build_block(
-                list(range(n)), [[encode_get_bin(f"k{s}_0")] for s in range(n)]
+                list(range(n)), [[encode_del_bin(f"k{s}_0")] for s in range(n)]
             )
         ]
         both(g)
@@ -324,3 +325,101 @@ class TestGovernedDeviceLane:
         want = _store_content(host.sms[0], n)
         for sm in eng.sms:
             assert _store_content(sm, n) == want
+
+
+class TestDeviceGetWindows:
+    """GET-only full-width windows run IN the device lane (read-only
+    lookup program): responses are byte-for-byte the host store's GET
+    framing, kind boundaries split the FIFO into windows instead of
+    demoting, and out-of-envelope reads demote exactly like writes."""
+
+    @staticmethod
+    def _enc_get(k: str) -> bytes:
+        import struct
+
+        return bytes([2]) + struct.pack("<H", len(k)) + k.encode()
+
+    def _mixed_fifo(self, n, rng):
+        out = []
+        for w in range(3):
+            out.append(
+                build_block(
+                    list(range(n)),
+                    [
+                        [encode_set_bin(f"k{s}_{int(rng.integers(0, 3))}", f"v{w}")]
+                        for s in range(n)
+                    ],
+                )
+            )
+        for w in range(2):  # GET run, including never-set keys
+            out.append(
+                build_block(
+                    list(range(n)),
+                    [[self._enc_get(f"k{s}_{w}")] for s in range(n)],
+                )
+            )
+        out.append(
+            build_block(
+                list(range(n)),
+                [[encode_set_bin(f"k{s}_0", "after")] for s in range(n)],
+            )
+        )
+        out.append(
+            build_block(
+                list(range(n)), [[self._enc_get(f"k{s}_0")] for s in range(n)]
+            )
+        )
+        out.append(
+            build_block(
+                list(range(n)), [[self._enc_get("missing")] for s in range(n)]
+            )
+        )
+        return out
+
+    def test_mixed_set_get_fifo_byte_identical_no_demotion(self):
+        n = 8
+        dev = _mk(n, device=True)
+        host = _mk(n, device=False)
+        fd = [dev.submit_block(b) for b in self._mixed_fifo(n, np.random.default_rng(5))]
+        fh = [host.submit_block(b) for b in self._mixed_fifo(n, np.random.default_rng(5))]
+        dev.flush()
+        host.flush()
+        assert dev._dev_active, "GET windows demoted the lane"
+        for i, (a, b) in enumerate(zip(fd, fh)):
+            ra = [list(map(bytes, g)) for g in a.result()]
+            rb = [list(map(bytes, g)) for g in b.result()]
+            assert ra == rb, i
+        # reads left versions/content untouched: sync down and compare
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+
+    def test_long_key_get_demotes_byte_identical(self):
+        n = 4
+        dev = _mk(n, device=True)
+        host = _mk(n, device=False)
+        for e in (dev, host):
+            e.submit_block(
+                build_block(
+                    list(range(n)),
+                    [[encode_set_bin(f"k{s}", "v")] for s in range(n)],
+                )
+            )
+            e.flush()
+        gd = dev.submit_block(
+            build_block(
+                list(range(n)), [[self._enc_get("K" * 100)] for s in range(n)]
+            )
+        )
+        gh = host.submit_block(
+            build_block(
+                list(range(n)), [[self._enc_get("K" * 100)] for s in range(n)]
+            )
+        )
+        dev.flush()
+        host.flush()
+        assert not dev._dev_active  # key over the table width: host path
+        assert [list(map(bytes, g)) for g in gd.result()] == [
+            list(map(bytes, g)) for g in gh.result()
+        ]
