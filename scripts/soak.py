@@ -193,15 +193,22 @@ async def soak(seconds: float, shards: int, seed: int, backend: str = "host") ->
     return rc
 
 
-def soak_mesh(seconds: float, shards: int, seed: int) -> int:
+def soak_mesh(
+    seconds: float, shards: int, seed: int, device_store: bool = False
+) -> int:
     """Device-plane chaos: MeshEngine under random crash/heal cycles.
 
     Crashes up to f replicas between flushes (sometimes past quorum — the
     engine must park, not corrupt), heals, and requires every submitted
-    batch to commit and all replicas to agree at the end."""
-    import numpy as np
-
-    from rabia_tpu.apps.kvstore import encode_set_bin
+    batch to commit and all replicas to agree at the end. With
+    ``device_store`` the same chaos drives the device-resident KV lane:
+    quorum-loss windows demote, clean periods re-promote, and GET-only
+    block waves run the read lane — every lane transition under fire."""
+    from rabia_tpu.apps.kvstore import (
+        KVOperation,
+        encode_op_bin,
+        encode_set_bin,
+    )
     from rabia_tpu.apps.vector_kv import VectorShardedKV
     from rabia_tpu.core.blocks import build_block
     from rabia_tpu.core.errors import RabiaError
@@ -209,16 +216,22 @@ def soak_mesh(seconds: float, shards: int, seed: int) -> int:
 
     S, R = shards, 5
     rng = random.Random(seed)
+    enc_get = lambda k: encode_op_bin(KVOperation.get(k))
     eng = MeshEngine(
         lambda: VectorShardedKV(S, capacity=1 << 14),
         n_shards=S,
         n_replicas=R,
         window=4,
+        device_store=device_store,
+        device_store_repromote=3 if device_store else 64,
     )
     stop_at = time.perf_counter() + seconds
     futs = []
+    get_futs = []
     ctr = 0
     down: set[int] = set()
+    repromotions = 0
+    was_active = device_store
     while time.perf_counter() < stop_at:
         # chaos step: crash/heal with occasional quorum loss
         roll = rng.random()
@@ -239,6 +252,18 @@ def soak_mesh(seconds: float, shards: int, seed: int) -> int:
                     )
                 )
             )
+        elif device_store and ctr % 5 == 1:
+            # GET-only full-width wave: the device read lane (or the
+            # host path while demoted — responses must match either way)
+            gf = eng.submit_block(
+                build_block(
+                    list(range(S)),
+                    [[enc_get(f"s{s}")] for s in range(S)],
+                )
+            )
+            futs.append(gf)
+            if ctr > 2:  # every key has been SET by then (FIFO order)
+                get_futs.append(gf)
         else:
             for s in range(S):
                 futs.append(
@@ -249,12 +274,31 @@ def soak_mesh(seconds: float, shards: int, seed: int) -> int:
             eng.flush(max_cycles=8)
         except RabiaError:
             pass  # quorum lost or slow convergence: heal next iteration
+        if device_store:
+            if eng._dev_active and not was_active:
+                repromotions += 1
+            was_active = eng._dev_active
     for i in list(down):
         eng.heal_replica(i)
     eng.flush()
     if not all(f.done() for f in futs):
         print("FAIL: undecided batches after final heal")
         return 1
+    if device_store:
+        # the host stores are stale while the lane is active: sync the
+        # device table down so the convergence check below sees it
+        eng._demote_device_store()
+        # the read lane must have returned FOUND frames (kind 0), not
+        # vacuously settled: decode the last GET wave's responses
+        if get_futs:
+            for g in get_futs[-1].result():
+                frame = bytes(g[0])
+                if frame[0] != 0:
+                    print(f"FAIL: GET wave returned kind {frame[0]}")
+                    return 1
+        if repromotions == 0 and ctr > 20:
+            print("FAIL: device lane never re-promoted under chaos")
+            return 1
     for s in (0, S // 2, S - 1):
         vals = {sm.store.get(s, f"s{s}".encode()) for sm in eng.sms}
         if len(vals) != 1 or None in vals:
@@ -263,9 +307,12 @@ def soak_mesh(seconds: float, shards: int, seed: int) -> int:
     if eng.divergences:
         print(f"FAIL: {eng.divergences} apply divergences")
         return 1
+    lane = ""
+    if device_store:
+        lane = f", {repromotions} device-lane re-promotions under chaos"
     print(
         f"mesh soak OK: {eng.decided_v1} commits over {eng.cycles} "
-        f"dispatches, {ctr} chaos waves, replicas convergent"
+        f"dispatches, {ctr} chaos waves, replicas convergent{lane}"
     )
     return 0
 
@@ -472,15 +519,25 @@ def main() -> int:
         help="transport plane's wire: in-memory hub, or native TCP with "
         "full replica restarts (kill + fresh port + live re-peering)",
     )
+    ap.add_argument(
+        "--device-store", action="store_true",
+        help="mesh plane only: chaos through the device-resident KV lane "
+        "(SET + GET windows, demote/re-promote cycling under crashes)",
+    )
     args = ap.parse_args()
     if args.plane == "mesh" and args.transport == "tcp":
         ap.error("--transport tcp applies to the transport plane only")
+    if args.device_store and args.plane != "mesh":
+        ap.error("--device-store applies to the mesh plane only")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     logging.disable(logging.WARNING)
     if args.plane == "mesh":
-        return soak_mesh(args.seconds, args.shards, args.seed)
+        return soak_mesh(
+            args.seconds, args.shards, args.seed,
+            device_store=args.device_store,
+        )
     if args.transport == "tcp":
         return asyncio.run(soak_tcp(args.seconds, args.shards, args.seed))
     return asyncio.run(soak(args.seconds, args.shards, args.seed, args.backend))
