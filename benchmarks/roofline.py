@@ -16,10 +16,12 @@ parallel/mesh_engine.py). Per-dispatch time = chain time / N, best of
 (~0.4-0.5ms/dispatch through the tunnel) from the marginal byte rate.
 
 Bytes accounting per decision (T*S decisions): votes R bytes in,
-decision 1 byte out, phase 4 bytes out when emitted. Peak HBM for
-TPU v5e is ~819 GB/s.
+decision 1 byte out, phase 4 bytes out when emitted. The packed rows
+(kernel/packed_window.py: 2-bit codes, 16 votes/u32 word) move
+(2R+2)/8 bytes per decision — 1.5 at R=5. Peak HBM for TPU v5e is
+~819 GB/s.
 
-Writes the table into benchmarks/results.json under "roofline_r04"
+Writes the table into benchmarks/results.json under "roofline_r05"
 and prints it. Run on the TPU host: python benchmarks/roofline.py
 """
 
@@ -37,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rabia_tpu.core.types import V1
-from rabia_tpu.kernel import fused_window
+from rabia_tpu.kernel import fused_window, packed_window
 
 PEAK_HBM_GBPS = 819.0  # TPU v5e spec sheet number
 
@@ -124,6 +126,32 @@ def run(T: int = 8192, S: int = 4096, R: int = 5, chain: int = 128) -> dict:
     )
     row("xla_tsr_api", t, votes_b + dec_b + ph_b)
 
+    # nophase variants at the same shape: the apples-to-apples pair for
+    # the Pallas-vs-XLA default decision (the production chain runs
+    # want_phase=False)
+    t = _chain_time(
+        lambda v: fused_window.closed_form_window_rmajor(
+            v, alive_rm, quorum, want_phase=False
+        ),
+        [(v,) for v in votes_rm],
+        chain,
+    )
+    row("xla_rmajor_nophase", t, votes_b + dec_b)
+
+    # the packed-vote window at the same T: 16 votes/u32 word, bitwise
+    # tally — (2R+2)/8 bytes per decision
+    SW = packed_window.packed_width(S)
+    packed = [packed_window.pack_codes(v) for v in votes_rm]
+    for p in packed:
+        p.block_until_ready()
+    alive_p = packed_window.pack_alive(alive_rm)
+    t = _chain_time(
+        lambda p: packed_window.packed_window_rmajor(p, alive_p, quorum),
+        [(p,) for p in packed],
+        chain,
+    )
+    row("packed_xla", t, (R + 1) * T * SW * 4)
+
     return {
         "config": {
             "T": T,
@@ -174,6 +202,54 @@ def t_sweep(S: int = 4096, R: int = 5) -> dict:
     return out
 
 
+def packed_t_sweep(S: int = 4096, R: int = 5) -> dict:
+    """Depth sweep for the packed window. Packed buffers are 4x
+    smaller, so windows go 4x deeper in the same HBM — this is where
+    the fixed ~1-2ms tunnel dispatch overhead amortizes away and the
+    TOTAL rate (not just the marginal slope) approaches peak."""
+    quorum = R // 2 + 1
+    SW = packed_window.packed_width(S)
+    alive_p = packed_window.pack_alive(jnp.ones((R, S), bool))
+    # one full u32 word of V1 codes — windows are built directly at the
+    # packed width (a monolithic i8 plane at T=262144 would not fit)
+    word = packed_window.pack_codes(
+        jnp.full((packed_window.LANES,), V1, jnp.int8)
+    )[0]
+    out = {}
+    prev = None
+    for T in (16384, 65536, 131072, 262144):
+        packed = [
+            jnp.full((R, T, SW), word, jnp.uint32),
+            jnp.full((R, T, SW), word, jnp.uint32),
+        ]
+        for p in packed:
+            p.block_until_ready()
+        t = _chain_time(
+            lambda p: packed_window.packed_window_rmajor(p, alive_p, quorum),
+            [(p,) for p in packed],
+            chain=48,
+        )
+        bm = (R + 1) * T * SW * 4
+        entry = {
+            "ms_per_dispatch": round(t * 1e3, 3),
+            "decisions_per_sec": round(T * S / t, 1),
+            "GBps": round(bm / t / 1e9, 1),
+            "pct_peak_hbm": round(100 * bm / t / 1e9 / PEAK_HBM_GBPS, 1),
+        }
+        if prev is not None:
+            dT, dt = T - prev[0], t - prev[1]
+            if dt > 0:
+                mg = (R + 1) * dT * SW * 4 / dt / 1e9
+                entry["marginal_GBps"] = round(mg, 1)
+                entry["marginal_pct_peak"] = round(
+                    100 * mg / PEAK_HBM_GBPS, 1
+                )
+        prev = (T, t)
+        out[f"T{T}"] = entry
+        del packed
+    return out
+
+
 def main() -> None:
     out = run(
         T=int(os.environ.get("ROOFLINE_T", 8192)),
@@ -184,6 +260,10 @@ def main() -> None:
         S=int(os.environ.get("ROOFLINE_S", 4096)),
         R=int(os.environ.get("ROOFLINE_R", 5)),
     )
+    out["packed_t_sweep"] = packed_t_sweep(
+        S=int(os.environ.get("ROOFLINE_S", 4096)),
+        R=int(os.environ.get("ROOFLINE_R", 5)),
+    )
     print(json.dumps(out, indent=1))
     path = os.path.join(os.path.dirname(__file__), "results.json")
     try:
@@ -191,7 +271,7 @@ def main() -> None:
             results = json.load(f)
     except (OSError, json.JSONDecodeError):
         results = {}
-    results["roofline_r04"] = out
+    results["roofline_r05"] = out
     with open(path, "w") as f:
         json.dump(results, f, indent=1)
 

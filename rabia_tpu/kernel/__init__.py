@@ -10,6 +10,13 @@ from rabia_tpu.kernel.host_driver import (  # noqa: F401
     HostNodeKernel,
     HostNodeState,
 )
+from rabia_tpu.kernel.packed_window import (  # noqa: F401
+    pack_alive,
+    pack_codes,
+    packed_width,
+    packed_window_rmajor,
+    unpack_codes,
+)
 from rabia_tpu.kernel.phase_driver import (  # noqa: F401
     ClusterKernel,
     ClusterState,

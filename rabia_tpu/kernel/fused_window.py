@@ -41,6 +41,12 @@ over random votes (all four codes), random crash masks and odd sizes —
 the general kernel remains the semantics owner; this is its proven
 fast path. No reference analog: the reference decides one instance at a
 time (rabia-core/src/messages.rs:185-211 tallies per phase).
+
+Round 5: the preferred fast path is the PACKED formulation
+(`kernel/packed_window.py` — 16 votes per u32 word, bitwise tally),
+which moves 4x fewer bytes and streams at the HBM marginal rate; the
+i8 entries here remain as the unpacked fallback and the roofline
+comparison rows (docs/PERFORMANCE.md).
 """
 
 from __future__ import annotations

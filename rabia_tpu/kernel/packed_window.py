@@ -1,0 +1,170 @@
+"""Packed-vote fused window: 16 votes per u32 word, bitwise tally.
+
+The fault-free closed form (`fused_window.closed_form_window_rmajor`)
+moves one i8 byte per vote per replica — R+1 bytes per decision — and
+its measured roofline sits at ~30% of peak HBM at the production shape
+because the i8->i32 unpack arithmetic, not the byte stream, is the
+bound (docs/PERFORMANCE.md, roofline_r04). The protocol only has four
+vote codes (V0=0, V1=1, V?=2, ABSENT=3 — core/types.py), i.e. 2 bits,
+so this module packs 16 votes into each u32 word along the shard axis
+and evaluates the SAME closed form with word-wise bit arithmetic:
+
+- per replica word ``w``: the V1 bit-plane is ``lo & ~hi`` and the V0
+  plane ``~(lo|hi)`` where ``lo = w & 0x5555…``, ``hi = (w>>1) & 0x5555…``
+  (one bit per 2-bit lane, at the lane LSB position);
+- replica counts accumulate in BIT-SLICED form with a carry-save ripple
+  (`_csa_inc`): ``ceil(log2(R+1))`` u32 planes hold the per-lane count,
+  so no lane ever widens past its 2-bit field;
+- the quorum test is a static bit-sliced magnitude comparator
+  (`_ge_const`): compile-time constant quorum, pure AND/OR/XOR;
+- decisions come back packed in the same 2-bit layout
+  (V1 / V0 / ABSENT — phase is derivable: 0 iff decided).
+
+Bytes moved per decision drop from R+1 (=6 at R=5) to (2R+2)/8 (=1.5):
+a 4x cut, and every op is u32 vector arithmetic Mosaic/XLA handle at
+full lane width — this sidesteps the i8 limitation entirely instead of
+fighting it. Bit-identical to ``closed_form_window_rmajor`` (pinned in
+tests/test_packed_window.py over random codes, crash masks, quorums
+and ragged widths).
+
+No reference analog: the reference tallies one instance at a time over
+message structs (rabia-core/src/messages.rs:185-211); batching votes
+into bit-planes is the TPU-native formulation of the same tally.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from rabia_tpu.core.types import ABSENT
+
+U32 = jnp.uint32
+I8 = jnp.int8
+LANES = 16  # 2-bit codes per u32 word
+_EVEN = 0x55555555  # lane-LSB positions (bits 0,2,…,30)
+
+
+def packed_width(S: int) -> int:
+    """Words per row for S shards (ceil division)."""
+    return -(-S // LANES)
+
+
+@jax.jit
+def pack_codes(x: jnp.ndarray) -> jnp.ndarray:
+    """Pack 2-bit codes i8[..., S] -> u32[..., ceil(S/16)].
+
+    Ragged widths pad with ABSENT: absent votes never tally, so padding
+    lanes decide ABSENT and `unpack_codes` truncates them away.
+    """
+    S = x.shape[-1]
+    SW = packed_width(S)
+    pad = SW * LANES - S
+    if pad:
+        cfg = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+        x = jnp.pad(x, cfg, constant_values=ABSENT)
+    w = x.reshape(x.shape[:-1] + (SW, LANES)).astype(U32)
+    shifts = jnp.arange(LANES, dtype=U32) * 2
+    # disjoint 2-bit fields: sum == bitwise-or
+    return jnp.sum(w << shifts, axis=-1, dtype=U32)
+
+
+@functools.partial(jax.jit, static_argnames=("S",))
+def unpack_codes(p: jnp.ndarray, S: int) -> jnp.ndarray:
+    """Inverse of :func:`pack_codes`: u32[..., SW] -> i8[..., S]."""
+    shifts = jnp.arange(LANES, dtype=U32) * 2
+    x = (p[..., None] >> shifts) & U32(3)
+    x = x.reshape(p.shape[:-1] + (p.shape[-1] * LANES,)).astype(I8)
+    return x[..., :S]
+
+
+@jax.jit
+def pack_alive(alive: jnp.ndarray) -> jnp.ndarray:
+    """Pack bool[..., S] -> u32[..., SW] with one bit per lane at the
+    lane LSB position (dead/padding lanes are 0)."""
+    S = alive.shape[-1]
+    SW = packed_width(S)
+    pad = SW * LANES - S
+    x = alive.astype(U32)
+    if pad:
+        cfg = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+        x = jnp.pad(x, cfg, constant_values=0)
+    w = x.reshape(x.shape[:-1] + (SW, LANES))
+    shifts = jnp.arange(LANES, dtype=U32) * 2
+    return jnp.sum(w << shifts, axis=-1, dtype=U32)
+
+
+def _csa_inc(planes: list, b, cap: int):
+    """Add bit-plane ``b`` (one bit per lane) into the bit-sliced
+    counter ``planes`` (LSB-first). The count after k increments is at
+    most k <= R < 2**cap, so the carry out of plane cap-1 is provably
+    zero and the counter never grows past ``cap`` planes."""
+    carry = b
+    out = []
+    for p in planes:
+        out.append(p ^ carry)
+        carry = p & carry
+    if len(out) < cap:
+        out.append(carry)
+    return out
+
+
+def _ge_const(planes: list, q: int, m):
+    """Bit-sliced ``count >= q`` for a compile-time constant q.
+
+    ``planes`` is the LSB-first bit-sliced count (every bit sits at a
+    lane LSB position under mask ``m``); returns a mask in the same
+    positions. MSB-first magnitude scan: a lane is >= q once a count
+    bit exceeds the corresponding q bit on an equal prefix.
+    """
+    if q <= 0:
+        return m
+    nbits = len(planes)
+    if q > (1 << nbits) - 1:
+        return jnp.zeros_like(m)
+    ge = jnp.zeros_like(m)
+    eq = m
+    for bit in reversed(range(nbits)):
+        p = planes[bit]
+        if (q >> bit) & 1:
+            eq = eq & p
+        else:
+            ge = ge | (eq & p)
+    return ge | eq
+
+
+@functools.partial(jax.jit, static_argnames=("quorum",))
+def packed_window_rmajor(
+    packed_rm: jnp.ndarray,  # u32[R, T, SW] — replica-major packed planes
+    alive_packed: jnp.ndarray,  # u32[R, SW] — lane-LSB alive bits
+    quorum: int,
+) -> jnp.ndarray:
+    """The fault-free closed form on packed votes; returns packed
+    decisions u32[T, SW] in the same 2-bit layout (V1/V0/ABSENT).
+
+    Bit-identical to ``pack_codes(closed_form_window_rmajor(unpack)…)``;
+    the phase plane is intentionally not produced (derivable: 0 iff
+    decided — same contract as ``want_phase=False``).
+    """
+    R = packed_rm.shape[0]
+    cap = R.bit_length()
+    m = U32(_EVEN)
+    c1: list = []
+    c0: list = []
+    for r in range(R):  # static unroll: R is tiny
+        w = packed_rm[r]
+        a = alive_packed[r][None, :]
+        lo = w & m
+        hi = (w >> 1) & m
+        b1 = lo & ~hi & a
+        b0 = (lo | hi) ^ m  # ~(lo|hi) confined to lane-LSB bits
+        b0 = b0 & a
+        c1 = _csa_inc(c1, b1, cap)
+        c0 = _csa_inc(c0, b0, cap)
+    ge1 = _ge_const(c1, quorum, jnp.broadcast_to(m, packed_rm.shape[1:]))
+    ge0 = _ge_const(c0, quorum, jnp.broadcast_to(m, packed_rm.shape[1:]))
+    # lane codes: V1=01 where ge1; else V0=00 where ge0; else ABSENT=11
+    babs = (ge1 | ge0) ^ jnp.broadcast_to(m, ge1.shape)
+    return (ge1 | babs) | (babs << 1)

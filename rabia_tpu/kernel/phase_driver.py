@@ -608,6 +608,36 @@ class ClusterKernel:
             votes_rm, alive_rm, self.quorum, want_phase=want_phase
         )
 
+    def slot_pipeline_fused_packed(
+        self,
+        packed_rm: jnp.ndarray,  # u32[R, T, SW] — 16 votes/word, 2-bit codes
+        alive_packed: jnp.ndarray,  # u32[R, SW] — lane-LSB alive bits
+        n_slots: int,
+    ) -> jnp.ndarray:
+        """:meth:`slot_pipeline_fused_rmajor` on word-packed votes — the
+        minimum-bytes entry: (2R+2)/8 bytes per decision instead of R+1,
+        tallied with word-wise bit arithmetic (kernel/packed_window.py).
+        Producers pack with ``packed_window.pack_codes`` /
+        ``pack_alive``; returns PACKED decisions u32[T, SW] (decode with
+        ``packed_window.unpack_codes``; phase derivable: 0 iff decided).
+        Bit-identical to the rmajor entry — pinned in
+        tests/test_packed_window.py."""
+        from rabia_tpu.kernel import packed_window
+
+        SW = packed_window.packed_width(self.S)
+        if packed_rm.shape[1] != n_slots:
+            raise ValueError(
+                f"votes carry {packed_rm.shape[1]} slots, n_slots={n_slots}"
+            )
+        if packed_rm.shape[0] != self.R or packed_rm.shape[2] != SW:
+            raise ValueError(
+                f"packed_rm is {packed_rm.shape}, expected packed "
+                f"replica-major [R={self.R}, T={n_slots}, SW={SW}]"
+            )
+        return packed_window.packed_window_rmajor(
+            packed_rm, alive_packed, self.quorum
+        )
+
 
 # ---------------------------------------------------------------------------
 # Per-node kernel (the host engine's device half)

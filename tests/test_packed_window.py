@@ -1,0 +1,203 @@
+"""Conformance tests for the packed-vote fused window.
+
+The packed kernel (kernel/packed_window.py) is a bit-exact
+reformulation of ``fused_window.closed_form_window_rmajor`` on 2-bit
+vote codes packed 16-per-u32 — these tests pin that equivalence over
+random codes (all four), random crash masks, every quorum, ragged
+shard widths, and the pack/unpack round-trip. The scanned
+``slot_pipeline`` remains the semantics owner (test_kernel.py pins the
+closed form to it); transitively the packed kernel is pinned to the
+full round machinery.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from rabia_tpu.core.types import ABSENT, V0, V1, VQUESTION
+from rabia_tpu.kernel import fused_window, packed_window
+
+
+def _rand_votes(rng, R, T, S):
+    return rng.integers(0, 4, size=(R, T, S), dtype=np.int8)
+
+
+class TestPackRoundTrip:
+    @pytest.mark.parametrize("S", [16, 64, 50, 1, 17, 129])
+    def test_codes_round_trip(self, S):
+        rng = np.random.default_rng(7 + S)
+        x = rng.integers(0, 4, size=(3, 5, S), dtype=np.int8)
+        p = packed_window.pack_codes(jnp.asarray(x))
+        assert p.dtype == jnp.uint32
+        assert p.shape == (3, 5, packed_window.packed_width(S))
+        back = packed_window.unpack_codes(p, S)
+        np.testing.assert_array_equal(np.asarray(back), x)
+
+    def test_padding_lanes_are_absent(self):
+        x = jnp.full((1, 5), V1, jnp.int8)  # 5 of 16 lanes used
+        p = packed_window.pack_codes(x)
+        full = packed_window.unpack_codes(p, 16)
+        assert np.all(np.asarray(full)[:, 5:] == ABSENT)
+
+    @pytest.mark.parametrize("S", [16, 50, 128])
+    def test_alive_pack_positions(self, S):
+        rng = np.random.default_rng(S)
+        alive = rng.random((4, S)) < 0.6
+        p = np.asarray(packed_window.pack_alive(jnp.asarray(alive)))
+        for r in range(4):
+            for s in range(S):
+                bit = (p[r, s // 16] >> (2 * (s % 16))) & 1
+                assert bool(bit) == bool(alive[r, s]), (r, s)
+
+
+class TestPackedWindowConformance:
+    @pytest.mark.parametrize("R", [1, 2, 3, 5, 7])
+    def test_matches_closed_form_all_quorums(self, R):
+        rng = np.random.default_rng(40 + R)
+        T, S = 6, 50  # ragged: 50 % 16 != 0
+        votes = _rand_votes(rng, R, T, S)
+        alive = rng.random((R, S)) < 0.7
+        v = jnp.asarray(votes)
+        a = jnp.asarray(alive)
+        for quorum in range(1, R + 1):
+            want = np.asarray(
+                fused_window.closed_form_window_rmajor(
+                    v, a, quorum, want_phase=False
+                )
+            )
+            got_p = packed_window.packed_window_rmajor(
+                packed_window.pack_codes(v),
+                packed_window.pack_alive(a),
+                quorum,
+            )
+            got = np.asarray(packed_window.unpack_codes(got_p, S))
+            np.testing.assert_array_equal(got, want, err_msg=f"Q={quorum}")
+
+    def test_packed_output_codes_are_2bit(self):
+        rng = np.random.default_rng(3)
+        R, T, S = 5, 4, 64
+        v = jnp.asarray(_rand_votes(rng, R, T, S))
+        a = jnp.ones((R, S), bool)
+        dec_p = packed_window.packed_window_rmajor(
+            packed_window.pack_codes(v), packed_window.pack_alive(a), 3
+        )
+        dec = np.asarray(packed_window.unpack_codes(dec_p, S))
+        assert set(np.unique(dec)) <= {V0, V1, ABSENT}
+
+    def test_unanimous_v1_decides_v1(self):
+        R, T, S = 5, 8, 48
+        v = jnp.full((R, T, S), V1, jnp.int8)
+        a = jnp.ones((R, S), bool)
+        dec_p = packed_window.packed_window_rmajor(
+            packed_window.pack_codes(v), packed_window.pack_alive(a), 3
+        )
+        dec = np.asarray(packed_window.unpack_codes(dec_p, S))
+        assert np.all(dec == V1)
+
+    def test_all_question_stays_undecided(self):
+        R, T, S = 5, 3, 32
+        v = jnp.full((R, T, S), VQUESTION, jnp.int8)
+        a = jnp.ones((R, S), bool)
+        dec_p = packed_window.packed_window_rmajor(
+            packed_window.pack_codes(v), packed_window.pack_alive(a), 3
+        )
+        dec = np.asarray(packed_window.unpack_codes(dec_p, S))
+        assert np.all(dec == ABSENT)
+
+    def test_dead_replicas_do_not_count(self):
+        # three alive V1 voters of five with quorum 3 decide; kill one
+        # and the same window goes undecided
+        R, T, S = 5, 2, 16
+        v = jnp.full((R, T, S), V1, jnp.int8)
+        alive3 = jnp.asarray([[True]] * 3 + [[False]] * 2) * jnp.ones(
+            (R, S), bool
+        )
+        dec_p = packed_window.packed_window_rmajor(
+            packed_window.pack_codes(v), packed_window.pack_alive(alive3), 3
+        )
+        assert np.all(
+            np.asarray(packed_window.unpack_codes(dec_p, S)) == V1
+        )
+        alive2 = jnp.asarray([[True]] * 2 + [[False]] * 3) * jnp.ones(
+            (R, S), bool
+        )
+        dec_p = packed_window.packed_window_rmajor(
+            packed_window.pack_codes(v), packed_window.pack_alive(alive2), 3
+        )
+        assert np.all(
+            np.asarray(packed_window.unpack_codes(dec_p, S)) == ABSENT
+        )
+
+    def test_quorum_above_r_never_decides(self):
+        R, T, S = 3, 2, 16
+        v = jnp.full((R, T, S), V1, jnp.int8)
+        a = jnp.ones((R, S), bool)
+        dec_p = packed_window.packed_window_rmajor(
+            packed_window.pack_codes(v), packed_window.pack_alive(a), R + 2
+        )
+        assert np.all(
+            np.asarray(packed_window.unpack_codes(dec_p, S)) == ABSENT
+        )
+
+    def test_v1_precedence_at_quorum_1(self):
+        # quorum 1 can satisfy both counts at once; the closed form
+        # gives V1 precedence and the packed kernel must match
+        R, T, S = 2, 1, 16
+        votes = np.full((R, T, S), V0, np.int8)
+        votes[0] = V1
+        v = jnp.asarray(votes)
+        a = jnp.ones((R, S), bool)
+        want = np.asarray(
+            fused_window.closed_form_window_rmajor(v, a, 1, want_phase=False)
+        )
+        assert np.all(want == V1)
+        dec_p = packed_window.packed_window_rmajor(
+            packed_window.pack_codes(v), packed_window.pack_alive(a), 1
+        )
+        np.testing.assert_array_equal(
+            np.asarray(packed_window.unpack_codes(dec_p, S)), want
+        )
+
+
+class TestClusterKernelPackedEntry:
+    def test_slot_pipeline_fused_packed_matches_rmajor(self):
+        from rabia_tpu.kernel import ClusterKernel
+
+        rng = np.random.default_rng(11)
+        S, R, T = 128, 5, 8
+        k = ClusterKernel(S, R, seed=0)
+        votes = jnp.asarray(_rand_votes(rng, R, T, S))
+        alive = jnp.asarray(rng.random((R, S)) < 0.8)
+        want = np.asarray(
+            k.slot_pipeline_fused_rmajor(
+                votes, alive, T, use_pallas=False, want_phase=False
+            )
+        )
+        got_p = k.slot_pipeline_fused_packed(
+            packed_window.pack_codes(votes),
+            packed_window.pack_alive(alive),
+            T,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(packed_window.unpack_codes(got_p, S)), want
+        )
+
+    def test_shape_validation(self):
+        from rabia_tpu.kernel import ClusterKernel
+
+        k = ClusterKernel(128, 5, seed=0)
+        good = jnp.zeros((5, 4, 8), jnp.uint32)
+        al = jnp.zeros((5, 8), jnp.uint32)
+        with pytest.raises(ValueError):
+            k.slot_pipeline_fused_packed(good, al, 7)  # T mismatch
+        with pytest.raises(ValueError):
+            k.slot_pipeline_fused_packed(
+                jnp.zeros((4, 4, 8), jnp.uint32), al, 4
+            )  # R mismatch
+        with pytest.raises(ValueError):
+            k.slot_pipeline_fused_packed(
+                jnp.zeros((5, 4, 9), jnp.uint32), al, 4
+            )  # SW mismatch
