@@ -55,8 +55,9 @@ from typing import NamedTuple, Optional
 import numpy as np
 
 from rabia_tpu.core.types import V0, V1
+from rabia_tpu.apps.vector_kv import _RESP_DT
 
-__all__ = ["DeviceKVTable", "DeviceWindowOps"]
+__all__ = ["DeviceKVTable", "DeviceWindowOps", "MixedFrameGroups"]
 
 _SET_HDR = 3  # binary SET op: u8 opcode(1) + u16 klen + key + value
 
@@ -116,6 +117,55 @@ class GetFrameGroups(Sequence):
             return _result_bin(0, ver, val.decode("utf-8"))
         except UnicodeDecodeError:
             return _result_bin(2, ver, "value is not utf-8 text")
+
+    def __getitem__(self, j):
+        if isinstance(j, slice):
+            return [self[i] for i in range(*j.indices(len(self)))]
+        if j < 0:
+            j += len(self)
+        if not (0 <= j < len(self)):
+            raise IndexError(j)
+        return [self._frame(int(self.shards[j]))]
+
+    def __iter__(self):
+        for j in range(len(self)):
+            yield self[j]
+
+    def group_counts(self) -> np.ndarray:
+        return np.ones(len(self), np.int64)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, (list, tuple, Sequence)):
+            return NotImplemented
+        return len(self) == len(other) and all(
+            a == b for a, b in zip(self, other)
+        )
+
+
+class MixedFrameGroups(Sequence):
+    """Lazy per-shard responses for one MIXED wave (SET and GET ops in
+    the same wave): SET ops answer with the derived 6-byte version
+    frame (byte-identical to ``VectorShardedKV._vers_frames``), GET ops
+    with the host store's GET framing over the lookup readback. One
+    object per block, frames materialize on client read."""
+
+    __slots__ = ("shards", "kind", "svers", "_get")
+
+    def __init__(self, shards, kind_row, set_vers, get_frames) -> None:
+        self.shards = shards  # i64[k] covered shards, group order
+        self.kind = kind_row  # i8[S]: 1=SET, 2=GET for this wave
+        self.svers = set_vers  # i64[S] derived SET response versions
+        self._get = get_frames  # GetFrameGroups view for this wave
+
+    def __len__(self) -> int:
+        return len(self.shards)
+
+    def _frame(self, s: int) -> bytes:
+        if int(self.kind[s]) == 1:
+            arr = np.zeros(1, _RESP_DT)
+            arr["version"] = np.uint32(self.svers[s])
+            return arr.tobytes()
+        return self._get._frame(s)
 
     def __getitem__(self, j):
         if isinstance(j, slice):
@@ -209,13 +259,15 @@ class DeviceKVTable:
         vlen = ln - _SET_HDR - klen
         return dbuf, off, klen, vlen, opcode
 
-    def pack_window(self, blocks) -> Optional[DeviceWindowOps]:
-        """Pack ``blocks`` (one per wave, FIFO order) into device inputs.
+    def _gather_window(self, blocks, allow: str) -> Optional[tuple]:
+        """Shared validate + bucket + fixed-width gather behind the
+        three window packers (``allow``: "set", "get" or "mixed") —
+        including the end-of-buffer gather clamp, maintained ONCE.
 
-        Returns None when any wave is outside the fast-lane envelope
-        (non-SET op, >1 op per shard, key/value over the table widths) —
-        the caller demotes to the host path. All numpy, no per-op
-        Python loop."""
+        Returns ``(kind i8[W,S], klen i16[W,S], vlen i16[W,S],
+        kwin u8[W,S,Ku], vwin u8[W,S,VWu])`` or None when any op is
+        outside the requested envelope (wrong opcode, >1 op per shard,
+        key/value over the table widths) — the caller demotes."""
         W = len(blocks)
         S = self.S
         parsed = []
@@ -225,26 +277,36 @@ class DeviceKVTable:
             if pb is None:
                 return None
             dbuf, off, klen, vlen, opcode = pb
+            is_set = opcode == 1
+            is_get = opcode == 2
+            kind_ok = {
+                "set": is_set,
+                "get": is_get,
+                "mixed": is_set | is_get,
+            }[allow]
             ok = (
-                (opcode == 1)
+                kind_ok
                 & (klen > 0)
                 & (klen <= self.K)
                 & (vlen >= 0)
                 & (vlen <= self.VW)
+                & (is_set | (vlen == 0))  # GET carries exactly the key
             )
             if not bool(ok.all()):
                 return None
             ku = max(ku, _bucket(int(klen.max())))
             vu = max(vu, _bucket(int(vlen.max(initial=0))))
-            parsed.append((b, dbuf, off, klen, vlen))
+            parsed.append((b, dbuf, off, klen, vlen, opcode))
+        kind_w = np.zeros((W, S), np.int8)
         klen_w = np.zeros((W, S), np.int16)
         vlen_w = np.zeros((W, S), np.int16)
         kwin_w = np.zeros((W, S, ku), np.uint8)
         vwin_w = np.zeros((W, S, vu), np.uint8)
         kcols = np.arange(ku)[None, :]
         vcols = np.arange(vu)[None, :]
-        for t, (b, dbuf, off, klen, vlen) in enumerate(parsed):
+        for t, (b, dbuf, off, klen, vlen, opcode) in enumerate(parsed):
             sh = b.shards
+            kind_w[t, sh] = opcode
             klen_w[t, sh] = klen
             vlen_w[t, sh] = vlen
             kw = dbuf[(off + _SET_HDR)[:, None] + kcols]
@@ -257,6 +319,16 @@ class DeviceKVTable:
             )
             vw = dbuf[vidx]
             vwin_w[t, sh] = np.where(vcols < vlen[:, None], vw, 0)
+        return kind_w, klen_w, vlen_w, kwin_w, vwin_w
+
+    def pack_window(self, blocks) -> Optional[DeviceWindowOps]:
+        """Pack SET-only ``blocks`` (one per wave, FIFO order) into
+        device inputs; None when outside the write lane's envelope —
+        the caller demotes. All numpy, no per-op Python loop."""
+        g = self._gather_window(blocks, "set")
+        if g is None:
+            return None
+        _kind, klen_w, vlen_w, kwin_w, vwin_w = g
         return DeviceWindowOps(
             klen_w,
             vlen_w,
@@ -265,40 +337,35 @@ class DeviceKVTable:
         )
 
     def pack_get_window(self, blocks) -> Optional[tuple]:
-        """Pack GET-only full-width blocks into lookup inputs.
-
-        Returns ``(klen i16[W, S], kwin u32[W, S, Ku/4])`` or None when
-        any wave is outside the read lane's envelope (non-GET op, >1 op
-        per shard, malformed, key over the table width) — the caller
-        demotes to the host path."""
-        W = len(blocks)
-        S = self.S
-        parsed = []
-        ku = 4
-        for b in blocks:
-            pb = self._parse_block(b)
-            if pb is None:
-                return None
-            dbuf, off, klen, vlen, opcode = pb
-            ok = (
-                (opcode == 2)
-                & (vlen == 0)  # GET carries exactly the key
-                & (klen > 0)
-                & (klen <= self.K)
-            )
-            if not bool(ok.all()):
-                return None
-            ku = max(ku, _bucket(int(klen.max())))
-            parsed.append((b, dbuf, off, klen))
-        klen_w = np.zeros((W, S), np.int16)
-        kwin_w = np.zeros((W, S, ku), np.uint8)
-        kcols = np.arange(ku)[None, :]
-        for t, (b, dbuf, off, klen) in enumerate(parsed):
-            sh = b.shards
-            klen_w[t, sh] = klen
-            kw = dbuf[(off + _SET_HDR)[:, None] + kcols]
-            kwin_w[t, sh] = np.where(kcols < klen[:, None], kw, 0)
+        """Pack GET-only blocks into lookup inputs: ``(klen i16[W, S],
+        kwin u32[W, S, Ku/4])``, or None (caller demotes)."""
+        g = self._gather_window(blocks, "get")
+        if g is None:
+            return None
+        _kind, klen_w, _vlen, kwin_w, _vwin = g
         return klen_w, np.ascontiguousarray(kwin_w).view(np.uint32)
+
+    def pack_mixed_window(self, blocks) -> Optional[tuple]:
+        """Pack blocks whose ops are ANY interleaving of binary SET and
+        GET — per op, not per block — into one device window.
+
+        Returns ``(kind i8[W, S], DeviceWindowOps)`` (kind 0 = no op,
+        1 = SET, 2 = GET; GET rows carry the key with vlen 0) or None
+        when any op is outside the union envelope — the caller demotes.
+        This removes the FIFO kind-boundary splits: an interleaved
+        SET/GET workload runs full windows instead of
+        window-per-kind-run (reference applies a mixed batch in one
+        pass too: rabia-kvstore/src/store.rs:313-348)."""
+        g = self._gather_window(blocks, "mixed")
+        if g is None:
+            return None
+        kind_w, klen_w, vlen_w, kwin_w, vwin_w = g
+        return kind_w, DeviceWindowOps(
+            klen_w,
+            vlen_w,
+            np.ascontiguousarray(kwin_w).view(np.uint32),
+            np.ascontiguousarray(vwin_w).view(np.uint32),
+        )
 
     # -- the fused programs --------------------------------------------------
 
@@ -510,6 +577,168 @@ class DeviceKVTable:
             self.kernel.place(jnp.asarray(alive)),
             jnp.asarray(base),
             jnp.int32(depth),
+            dev_ops,
+            W=W,
+            max_phases=max_phases,
+        )
+
+    def _build_mixed(self, Ku4: int, VWu4: int, Gp: int):
+        """Jitted MIXED window: consensus + per-op kind mask over the
+        same table — SET ops mutate (identical update rules to
+        :meth:`_build_fused`), GET ops read the wave-entry state (reads
+        in wave t observe every apply from waves < t — the host store's
+        FIFO semantics), all in ONE scan over the waves.
+
+        ``Gp`` (static) is the padded count of GET-bearing waves; the
+        program gathers those waves' lookup outputs ON DEVICE (the host
+        knows the wave indices at pack time) and packs found/ver/vlen
+        into one two-plane i32 tensor, so the readback is two transfers
+        — not four take-dispatch round-trips over the ~12MB/s tunnel
+        (measured: the four separate fetches cost ~0.5s per window,
+        more than the window's compute)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        kernel = self.kernel
+        S, Pc = self.S, self.P
+        K4, VW4 = self.K4, self.VW4
+        n = self.n_shards
+        I8, I32 = jnp.int8, jnp.int32
+        col = jnp.arange(S) < n
+
+        def mixed(state, alive, base, depth, kind_w, gidx, ops, *, W,
+                  max_phases):
+            wave = jnp.arange(W, dtype=I32)[:, None] < depth
+            present = wave & col[None, :]
+            votes = jnp.where(
+                present[:, :, None], I8(V1), I8(V0)
+            ) * jnp.ones((1, 1, kernel.R), I8)
+            decided = kernel.slot_window(
+                votes, alive, base, n_slots=W, max_phases=max_phases
+            )
+            all_v1 = jnp.all(jnp.where(present, decided == V1, True))
+
+            kwin_full = jnp.pad(ops.kwin, ((0, 0), (0, 0), (0, K4 - Ku4)))
+            vwin_full = jnp.pad(ops.vwin, ((0, 0), (0, 0), (0, VW4 - VWu4)))
+
+            def wave_step(carry, inp):
+                used, keyw, klen, ver, valw, vlen, sver = carry
+                ok_w, kind_t, klen_t, vlen_t, kwin_t, vwin_t = inp
+                klen_t = klen_t.astype(jnp.int32)
+                vlen_t = vlen_t.astype(jnp.int32)
+                kind_t = kind_t.astype(jnp.int32)
+                eq = (
+                    used
+                    & (klen == klen_t[:, None])
+                    & (keyw == kwin_t[:, None, :]).all(-1)
+                )  # [S, P]
+                found = eq.any(1)
+                # GET reads against the wave-entry state, before this
+                # wave's SET applies touch the table
+                gsel = found & (kind_t == 2) & (klen_t > 0)
+                oh_get = eq & gsel[:, None]
+                gver = (ver * oh_get).sum(1)
+                gvlen = (vlen * oh_get).sum(1)
+                gval = (valw * oh_get[:, :, None]).sum(1)
+                # SET applies: same one-hot word-select update as the
+                # pure-SET program, gated on this op BEING a SET
+                is_set = ok_w & (kind_t == 1)
+                slot = jnp.where(
+                    found, jnp.argmax(eq, 1), jnp.argmax(~used, 1)
+                )
+                full = used.all(1)
+                apply = is_set & (found | ~full)
+                overflow = jnp.any(is_set & ~found & full)
+                onehot = (
+                    jnp.arange(Pc)[None, :] == slot[:, None]
+                ) & apply[:, None]
+                oh3 = onehot[:, :, None]
+                used = used | onehot
+                keyw = jnp.where(oh3, kwin_t[:, None, :], keyw)
+                klen = jnp.where(onehot, klen_t[:, None], klen)
+                new_ver = sver + 1
+                ver = jnp.where(onehot, new_ver[:, None], ver)
+                valw = jnp.where(oh3, vwin_t[:, None, :], valw)
+                vlen = jnp.where(onehot, vlen_t[:, None], vlen)
+                sver = jnp.where(apply, new_ver, sver)
+                return (used, keyw, klen, ver, valw, vlen, sver), (
+                    overflow,
+                    gsel,
+                    gver,
+                    gvlen,
+                    gval,
+                )
+
+            new_state, (over_w, gfound, gver, gvlen, gval) = lax.scan(
+                wave_step,
+                state,
+                (present, kind_w, ops.klen, ops.vlen, kwin_full, vwin_full),
+            )
+            flags = jnp.stack(
+                [
+                    all_v1.astype(I32),
+                    jnp.any(over_w).astype(I32),
+                    jnp.any(
+                        new_state[6] >= jnp.int32(2**31 - 2)
+                    ).astype(I32),
+                ]
+            )
+            # device-side gather of the GET-bearing waves + two-plane
+            # meta pack: [0]=version, [1]=(vlen<<1)|found
+            gfound_g = jnp.take(gfound, gidx, axis=0).astype(I32)
+            gver_g = jnp.take(gver, gidx, axis=0)
+            gvlen_g = jnp.take(gvlen, gidx, axis=0)
+            gval_g = jnp.take(gval, gidx, axis=0)
+            meta = jnp.stack([gver_g, (gvlen_g << 1) | gfound_g])
+            return new_state, flags, meta, gval_g
+
+        return jax.jit(mixed, static_argnames=("W", "max_phases"))
+
+    def mixed_apply(self, alive, base, depth: int, kind: np.ndarray,
+                    get_waves: np.ndarray, ops: DeviceWindowOps, W: int,
+                    max_phases: int = 4):
+        """Dispatch one mixed decide+apply+lookup window. Returns device
+        handles ``(new_state, flags, meta, gval)`` where ``meta`` is
+        i32[2, Gp, S] ([0]=version, [1]=(vlen<<1)|found) and ``gval``
+        u32[Gp, S, VW4], both gathered to the ``get_waves`` rows (padded
+        to a power of two; the caller maps real rows). The caller reads
+        the 12-byte flags first and fetches meta/gval only on a clean
+        window."""
+        import jax.numpy as jnp
+
+        if ops.klen.shape[0] < W:
+            pad = W - ops.klen.shape[0]
+            kind = np.concatenate(
+                [kind, np.zeros((pad, kind.shape[1]), kind.dtype)]
+            )
+            ops = DeviceWindowOps(
+                *(
+                    np.concatenate(
+                        [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
+                    )
+                    for a in ops
+                )
+            )
+        Gp = 1
+        while Gp < max(1, len(get_waves)):
+            Gp <<= 1
+        gidx = np.zeros(Gp, np.int32)
+        gidx[: len(get_waves)] = get_waves
+        key = ("mix", W, ops.kwin.shape[2], ops.vwin.shape[2], Gp)
+        fn = self._fused_cache.get(key)
+        self.compiled_on_last_call = fn is None
+        if fn is None:
+            fn = self._build_mixed(key[2], key[3], Gp)
+            self._fused_cache[key] = fn
+        dev_ops = DeviceWindowOps(*(jnp.asarray(a) for a in ops))
+        return fn(
+            self.state,
+            self.kernel.place(jnp.asarray(alive)),
+            jnp.asarray(base),
+            jnp.int32(depth),
+            jnp.asarray(kind),
+            jnp.asarray(gidx),
             dev_ops,
             W=W,
             max_phases=max_phases,

@@ -297,8 +297,16 @@ class MeshEngine:
             # starting size must already be on that ladder
             self.window = min(self.max_window, max(self.min_window, self.window))
         self.window_resizes = 0
-        self._lat_samples: deque[float] = deque(maxlen=32)
+        self._lat_samples: deque[float] = deque(maxlen=64)
         self._lat_saturated = False
+        # set by _govern when the target is below the measured floor at
+        # min_window (no window size can meet it); see governor_stats()
+        self.latency_target_unachievable = False
+        self._lat_floor_ms: Optional[float] = None
+        # anti-oscillation: last window size that overshot the target
+        # (upsizing will not re-enter it until the ceiling ages out)
+        self._lat_ceiling: Optional[int] = None
+        self._lat_ceiling_age = 0
         # windows to leave untimed: the first cycle at any window size
         # pays that size's jit compile (seconds), which must not read as
         # latency or the governor ratchets W down one compile at a time
@@ -470,38 +478,115 @@ class MeshEngine:
                 self._govern(dt_ms)
         return applied
 
+    def _p99(self) -> float:
+        """Interpolated empirical p99 over the current samples.
+
+        Unlike the round-4 max-of-window proxy, a single ambient-load
+        spike does not pin the estimate: with n samples the estimate
+        sits between the two top order statistics, weighted toward the
+        max only as n grows past ~100 (numpy linear interpolation) —
+        so one 2.3x outlier among 30 quiet samples reads as "p99 near
+        the second-worst", which is what a latency SLO actually
+        tracks."""
+        return float(np.percentile(np.asarray(self._lat_samples), 99))
+
     def _govern(self, dt_ms: float) -> None:
         """Latency-target window control (multiplicative ladder).
 
-        Downsize: the conservative p99 proxy (max of the last ≤32 window
-        times) exceeding the target halves W — immediately on a single
-        2× overshoot, else after 4 samples of evidence. Upsize: with the
-        proxy comfortably under target (≤40%) AND demand saturating the
-        current window (a deeper window would actually amortize more), W
-        doubles after 8 samples. Samples clear on every resize so each
-        decision is measured at the current W; each ladder size jit-
-        compiles once per process."""
+        Downsize: the p99 estimate (:meth:`_p99` over the last ≤64
+        window times) exceeding the target halves W — immediately on a
+        single 2× overshoot, else after 6 samples of evidence. Upsize:
+        with p99 ≤ 0.7×target AND demand saturating the current window
+        (a deeper window would actually amortize more), W doubles after
+        8 samples — headroom-based, so an occasional spike below the
+        target no longer vetoes growth the way the old max-proxy did.
+        Samples clear on every resize so each decision is measured at
+        the current W; each ladder size jit-compiles once per process.
+
+        Anti-oscillation: a downsize records the size that failed as a
+        CEILING; upsizing never re-enters a size at or above a live
+        ceiling (the 128↔256 limit cycle would otherwise trade ~25% of
+        throughput for repeated overshoots). The ceiling ages out after
+        256 governed samples so a transient ambient-load spike does not
+        park the engine forever.
+
+        Unachievability: when W is already ``min_window`` and the p99
+        estimate — the statistic this governor is chartered to keep
+        under the target — still exceeds the target, no window size can
+        meet it (the floor is dispatch + tunnel round-trip, not window
+        depth). That state is surfaced instead of silently parking:
+        ``latency_target_unachievable`` flips True, a warning logs once
+        with the measured floor, and :meth:`governor_stats` reports it.
+        It clears when the p99 at min_window comes back under target
+        (e.g. ambient load subsided)."""
         s = self._lat_samples
         t = self.latency_target_ms
-        est = max(s)
+        p99e = self._p99()
+        if self._lat_ceiling is not None:
+            self._lat_ceiling_age += 1
+            if self._lat_ceiling_age > 256:
+                self._lat_ceiling = None
+        if self.window == self.min_window and len(s) >= 8:
+            if p99e > t:
+                self._lat_floor_ms = p99e
+                if not self.latency_target_unachievable:
+                    self.latency_target_unachievable = True
+                    logger.warning(
+                        "latency target %.3gms is unachievable: p99 at "
+                        "min_window=%d is %.3gms (dispatch floor); "
+                        "governor parked",
+                        t,
+                        self.min_window,
+                        p99e,
+                    )
+            elif self.latency_target_unachievable:
+                self.latency_target_unachievable = False
+                self._lat_floor_ms = None
         if (
             (len(s) >= 2 and dt_ms > 2.0 * t)
-            or (len(s) >= 4 and est > t)
+            or (len(s) >= 6 and p99e > t)
         ) and self.window > self.min_window:
+            self._lat_ceiling = self.window  # this size failed
+            self._lat_ceiling_age = 0
             self.window = max(self.min_window, self.window // 2)
             s.clear()
             self._lat_skip = 1
             self.window_resizes += 1
         elif (
             len(s) >= 8
-            and est < 0.4 * t
+            and p99e <= 0.7 * t
             and self._lat_saturated
             and self.window < self.max_window
+            and (
+                self._lat_ceiling is None
+                or self.window * 2 < self._lat_ceiling
+            )
         ):
             self.window = min(self.max_window, self.window * 2)
             s.clear()
             self._lat_skip = 1
             self.window_resizes += 1
+
+    def governor_stats(self) -> dict:
+        """Observable governor state: current window, resize count, the
+        p99 estimate over recent samples, and whether the configured
+        target is below the measured hardware floor."""
+        return {
+            "window": self.window,
+            "resizes": self.window_resizes,
+            "samples": len(self._lat_samples),
+            "p99_ms": (
+                round(self._p99(), 3) if self._lat_samples else None
+            ),
+            "target_ms": self.latency_target_ms,
+            "unachievable": self.latency_target_unachievable,
+            "floor_ms": (
+                round(self._lat_floor_ms, 3)
+                if self._lat_floor_ms is not None
+                else None
+            ),
+            "ceiling_window": self._lat_ceiling,
+        }
 
     def _run_cycle_inner(self) -> int:
         if self._full_blocks:
@@ -604,10 +689,12 @@ class MeshEngine:
         W = self.window
         n = self.n_shards
         self._lat_saturated |= len(self._full_blocks) >= W
-        # the window takes the FIFO head's maximal same-kind run: SET
-        # windows mutate through the fused apply, GET-only windows read
-        # through the lookup program — a kind boundary just splits the
-        # window (FIFO order preserved), it does not demote
+        # uniform-kind runs use the lean programs (SET windows carry no
+        # GET readback planes, GET windows mutate nothing); a kind
+        # boundary INSIDE the window — or a block interleaving SET and
+        # GET ops — runs the MIXED program over the full window instead
+        # of splitting at the boundary (round-4 behavior), so
+        # interleaved workloads no longer pay window quantization
         kinds = [
             _block_op_kind(self._full_blocks[i][0])
             for i in range(min(len(self._full_blocks), W))
@@ -618,6 +705,8 @@ class MeshEngine:
             if k != head_kind:
                 break
             depth += 1
+        if head_kind is None or depth < len(kinds):
+            return self._run_cycle_fullwidth_device_mixed(len(kinds))
         if head_kind == 2:
             return self._run_cycle_fullwidth_device_get(depth)
         entries = [self._full_blocks[i] for i in range(depth)]  # peek
@@ -766,6 +855,92 @@ class MeshEngine:
                 )
             )
         return depth * n
+
+    def _run_cycle_fullwidth_device_mixed(self, count: int) -> int:
+        """Full-width window MIXING SET and GET ops (per op, via the
+        kind-masked fused program): SETs mutate the table, GETs read the
+        wave-entry state, one dispatch for the whole window. SET
+        response versions derive from the host mirror + the per-shard
+        cumulative SET count (clean window ⇒ every SET applied exactly
+        once); GET planes download only for the waves that hold GETs
+        (device-side gather of those waves — a SET-heavy mixed window
+        pays readback proportional to its GET waves, not to W)."""
+        from rabia_tpu.apps.device_kv import GetFrameGroups, MixedFrameGroups
+        from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
+
+        W = self.window
+        n = self.n_shards
+        entries = [self._full_blocks[i] for i in range(count)]
+        packed = self._dev.pack_mixed_window([e[0] for e in entries])
+        if packed is None:
+            self._dev_spec = None
+            self._demote_device_store()
+            return self._run_cycle_inner()
+        kind, ops = packed
+        get_waves = np.nonzero((kind == 2).any(axis=1))[0].astype(np.int32)
+        base = np.zeros(self.S, np.int32)
+        base[:n] = self.next_slot
+        new_state, flags_dev, meta_dev, gval_dev = self._dev.mixed_apply(
+            self.alive, base, count, kind, get_waves, ops, W=W,
+            max_phases=self.max_phases,
+        )
+        self._lat_invalidate |= (
+            self._dev.compiled_on_last_call and self._lat_timing
+        )
+        self._dev_spec = None  # chained SET state no longer matches base
+        self.cycles += 1
+        flags = np.asarray(flags_dev)
+        if not flags[0] or flags[1] or flags[2]:
+            self._demote_device_store()
+            return self._run_cycle_inner()
+        self._dev.adopt(new_state)
+        # derived SET versions: host mirror + inclusive per-shard SET
+        # count (GET waves advance nothing)
+        is_set = kind == 1  # [count, S]
+        set_cum = np.cumsum(is_set, axis=0, dtype=np.int64)
+        svers = self._dev_sver[None, : self.S] + set_cum
+        gpos = {int(t): j for j, t in enumerate(get_waves)}
+        if len(get_waves):
+            # the program already gathered the GET waves on device; two
+            # fetches total (meta planes + value words)
+            meta_h = np.asarray(meta_dev)
+            gval_h = np.asarray(gval_dev)
+            gver_h = meta_h[0]
+            gvlen_h = meta_h[1] >> 1
+            gfound_h = (meta_h[1] & 1).astype(bool)
+        self._dev_sver[: self.S] += set_cum[-1]
+        for _ in range(count):
+            self._full_blocks.popleft()
+        start = self.next_slot.copy()
+        self.next_slot[:n] += count
+        self.decided_v1 += count * n
+        for t, (block, bfut, inv) in enumerate(entries):
+            self._bulk_log.append((start, t, block, inv))
+        while len(self._bulk_log) > max(
+            1, self.max_decision_history // max(1, self.window)
+        ):
+            self._bulk_log.popleft()
+        for t, (block, bfut, _inv) in enumerate(entries):
+            sh = np.asarray(block.shards, np.int64)
+            row_kind = kind[t]
+            gf = None
+            if t in gpos:
+                j = gpos[t]
+                gf = GetFrameGroups(
+                    sh, gfound_h[j], gver_h[j], gvlen_h[j], gval_h[j]
+                )
+            if gf is None:
+                # pure-SET wave inside a mixed window: the lean framing
+                frames = VectorShardedKV._vers_frames(svers[t, sh])
+                bounds = np.arange(len(block) + 1, dtype=np.int64)
+                bfut._settle_bulk(FrameGroups(frames, bounds))
+            elif not bool(is_set[t].any()):
+                bfut._settle_bulk(gf)  # pure-GET wave
+            else:
+                bfut._settle_bulk(
+                    MixedFrameGroups(sh, row_kind, svers[t], gf)
+                )
+        return count * n
 
     def _dev_window_key(self, entries, base) -> tuple:
         """Identity of a device window dispatch: the exact blocks (by

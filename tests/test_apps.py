@@ -16,9 +16,7 @@ from rabia_tpu.apps import (
     KVOperation,
     KVResultKind,
     KVStore,
-    KVStoreSMR,
     NotificationFilter,
-    ShardedStateMachine,
     make_sharded_kv,
     shard_for_key,
 )

@@ -20,7 +20,7 @@ from rabia_tpu.apps.kvstore import (
     encode_op_bin,
     encode_set_bin,
 )
-from rabia_tpu.core.blocks import PayloadBlock, block_batch_id, build_block
+from rabia_tpu.core.blocks import block_batch_id, build_block
 from rabia_tpu.core.config import BatchConfig, RabiaConfig
 from rabia_tpu.core.errors import ValidationError
 from rabia_tpu.core.messages import ProposeBlock, ProtocolMessage

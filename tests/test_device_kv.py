@@ -395,6 +395,84 @@ class TestDeviceGetWindows:
         for sm in dev.sms:
             assert _store_content(sm, n) == want
 
+    def test_intra_block_mixed_ops_run_in_lane(self):
+        # a single block interleaving SET and GET across shards used to
+        # demote (kind=None); the kind-masked mixed program runs it in
+        # the lane, byte-identical to the host path
+        n = 8
+        dev = _mk(n, device=True)
+        host = _mk(n, device=False)
+
+        def fifo():
+            out = []
+            out.append(
+                build_block(
+                    list(range(n)),
+                    [[encode_set_bin(f"k{s}", f"v{s}")] for s in range(n)],
+                )
+            )
+            for w in range(3):
+                cmds = [
+                    [encode_set_bin(f"k{s}", f"w{w}")]
+                    if s % 2 == w % 2
+                    else [self._enc_get(f"k{s}")]
+                    for s in range(n)
+                ]
+                out.append(build_block(list(range(n)), cmds))
+            return out
+
+        fd = [dev.submit_block(b) for b in fifo()]
+        fh = [host.submit_block(b) for b in fifo()]
+        dev.flush()
+        host.flush()
+        assert dev._dev_active, "intra-block mixed ops demoted the lane"
+        for i, (a, b) in enumerate(zip(fd, fh)):
+            ra = [list(map(bytes, g)) for g in a.result()]
+            rb = [list(map(bytes, g)) for g in b.result()]
+            assert ra == rb, i
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    def test_random_kind_fuzz_byte_identical(self, seed):
+        # random SET/GET kind per (wave, shard) over deep FIFOs: reads
+        # must observe exactly the applies of earlier waves (host FIFO
+        # semantics), responses byte-identical, versions conformant
+        n = 8
+        rng = np.random.default_rng(seed)
+
+        def fifo(r):
+            out = []
+            for w in range(9):
+                cmds = []
+                for s in range(n):
+                    k = f"k{s}_{int(r.integers(0, 2))}"
+                    if r.random() < 0.5:
+                        cmds.append([encode_set_bin(k, f"v{w}_{s}")])
+                    else:
+                        cmds.append([self._enc_get(k)])
+                out.append(build_block(list(range(n)), cmds))
+            return out
+
+        dev = _mk(n, device=True)
+        host = _mk(n, device=False)
+        fd = [dev.submit_block(b) for b in fifo(np.random.default_rng(seed))]
+        fh = [host.submit_block(b) for b in fifo(np.random.default_rng(seed))]
+        dev.flush()
+        host.flush()
+        assert dev._dev_active
+        for i, (a, b) in enumerate(zip(fd, fh)):
+            ra = [list(map(bytes, g)) for g in a.result()]
+            rb = [list(map(bytes, g)) for g in b.result()]
+            assert ra == rb, (seed, i)
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+        del rng
+
     def test_long_key_get_demotes_byte_identical(self):
         n = 4
         dev = _mk(n, device=True)

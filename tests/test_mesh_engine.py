@@ -559,6 +559,60 @@ class TestLatencyGovernor:
             eng.flush()
         assert eng.window == 4
 
+    def test_unachievable_target_is_reported(self):
+        # a target below the per-window floor must be SURFACED, not
+        # silently parked at min_window (round-4 governor sat at W=1
+        # with no signal)
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        eng = self._mk(window=4, latency_target_ms=1e-4, min_window=1)
+        op = [encode_set_bin("k", "v")]
+        for _ in range(30):
+            for s in range(eng.n_shards):
+                eng.submit(op, s)
+            eng.flush()
+        assert eng.window == 1
+        assert eng.latency_target_unachievable
+        st = eng.governor_stats()
+        assert st["unachievable"] is True
+        assert st["floor_ms"] is not None and st["floor_ms"] > 1e-4
+        assert st["window"] == 1
+
+    def test_single_spike_does_not_veto_upsize(self):
+        # one ambient-load outlier among 62 quiet samples: the round-4
+        # max-proxy (upsize iff max < 0.4*target -> 200 > 60) would
+        # block growth forever; the interpolated p99 (~82ms <= 0.7*150)
+        # lets the saturated window grow
+        eng = self._mk(window=4, latency_target_ms=150.0, max_window=64)
+        eng._lat_samples.extend([10.0] * 62 + [200.0])
+        eng._lat_saturated = True
+        eng._govern(10.0)
+        assert eng.window == 8
+        assert eng.window_resizes == 1
+
+    def test_downsize_sets_ceiling_that_blocks_reclimb(self):
+        # an overshoot at W=8 must not be re-entered by the next quiet
+        # stretch (the 128<->256 limit cycle): the failed size becomes a
+        # ceiling that upsizing stays strictly below until it ages out
+        eng = self._mk(window=8, latency_target_ms=100.0, max_window=64)
+        eng._lat_samples.extend([50.0, 250.0])
+        eng._govern(250.0)  # 2x overshoot -> halve
+        assert eng.window == 4
+        assert eng._lat_ceiling == 8
+        eng._lat_samples.extend([10.0] * 10)
+        eng._lat_saturated = True
+        eng._govern(10.0)
+        assert eng.window == 4  # 4*2 == ceiling: parked
+        st = eng.governor_stats()
+        assert st["ceiling_window"] == 8
+
+    def test_governor_stats_before_any_sample(self):
+        eng = self._mk(window=4, latency_target_ms=100.0)
+        st = eng.governor_stats()
+        assert st["p99_ms"] is None
+        assert st["unachievable"] is False
+        assert st["window"] == 4
+
     def test_governed_state_matches_ungoverned(self):
         from rabia_tpu.apps.kvstore import encode_set_bin
 
