@@ -61,6 +61,31 @@ __all__ = ["DeviceKVTable", "DeviceWindowOps", "MixedFrameGroups"]
 
 _SET_HDR = 3  # binary SET op: u8 opcode(1) + u16 klen + key + value
 
+# fixed odd multipliers for the dictionary packer's 64-bit row hash
+# (collisions are VERIFIED against, never trusted — see pack_window_dict)
+_HASH_W = (
+    np.random.default_rng(0x5EED).integers(
+        1, 2**62, 4096, dtype=np.uint64
+    )
+    * 2
+    + 1
+)
+
+
+def _fold_words(a: np.ndarray) -> np.ndarray:
+    """Polynomial-fold a u8[..., B] byte plane into u64[...] — viewed
+    as native u32/u64 words (B is a power of two >= 4), mod-2^64."""
+    mul = np.uint64(0x9E3779B97F4A7C15)
+    if a.shape[-1] % 8 == 0:
+        w = a.view(np.uint64)
+    else:
+        w = a.view(np.uint32)
+    h = np.zeros(a.shape[:-1], np.uint64)
+    for j in range(w.shape[-1]):
+        h *= mul
+        h += w[..., j]
+    return h
+
 
 def _bucket(n: int, lo: int = 4) -> int:
     """Round up to a power of two (>= lo, multiple of 4 for u32 views)."""
@@ -84,12 +109,45 @@ class DeviceWindowOps(NamedTuple):
     vwin: np.ndarray  # u32[W, S, VWu/4]
 
 
+class DeviceDictOps(NamedTuple):
+    """One SET window dictionary-compressed for upload (host numpy).
+
+    Zipf-skewed op streams repeat (key, value) rows heavily within a
+    window; the upload then carries each shard's DISTINCT rows once
+    (``dk``/``dv``/lens, D rows per shard) plus a byte-wide rank per
+    (wave, shard) (``idx``) — for the BASELINE config-5 workload this
+    is ~10x fewer bytes over the ~30MB/s tunnel than the row-packed
+    form. Reference idea being extended: the serialization layer's
+    compression threshold (rabia-core/src/serialization.rs:100-114),
+    applied semantically to the device plane.
+    """
+
+    idx: np.ndarray  # u8[W, S] within-shard dictionary rank
+    dkl: np.ndarray  # i16[S, D] key lengths
+    dvl: np.ndarray  # i16[S, D] value lengths
+    dk: np.ndarray  # u32[S, D, Ku/4] key words
+    dv: np.ndarray  # u32[S, D, VWu/4] value words
+
+
+def _get_frame(found: bool, ver: int, val: bytes) -> bytes:
+    """One GET response frame, byte-for-byte the host store's framing
+    (`_result_bin`) — shared by every lazy GET view so the encoding
+    lives in exactly one place."""
+    from rabia_tpu.apps.kvstore import _result_bin
+
+    if not found:
+        return _result_bin(1, 0)
+    try:
+        return _result_bin(0, ver, val.decode("utf-8"))
+    except UnicodeDecodeError:
+        return _result_bin(2, ver, "value is not utf-8 text")
+
+
 class GetFrameGroups(Sequence):
     """Lazy per-shard GET responses over one wave's lookup readback.
 
-    Byte-for-byte the host store's GET framing (`_result_bin`): frames
-    materialize only when a client reads them — the commit path stores
-    this view (one object per block, no per-op Python).
+    Frames materialize only when a client reads them — the commit path
+    stores this view (one object per block, no per-op Python).
     """
 
     __slots__ = ("shards", "found", "ver", "vlen", "valb")
@@ -107,16 +165,69 @@ class GetFrameGroups(Sequence):
         return len(self.shards)
 
     def _frame(self, s: int) -> bytes:
-        from rabia_tpu.apps.kvstore import _result_bin
+        return _get_frame(
+            bool(self.found[s]),
+            int(self.ver[s]),
+            self.valb[s, : int(self.vlen[s])].tobytes(),
+        )
 
+    def __getitem__(self, j):
+        if isinstance(j, slice):
+            return [self[i] for i in range(*j.indices(len(self)))]
+        if j < 0:
+            j += len(self)
+        if not (0 <= j < len(self)):
+            raise IndexError(j)
+        return [self._frame(int(self.shards[j]))]
+
+    def __iter__(self):
+        for j in range(len(self)):
+            yield self[j]
+
+    def group_counts(self) -> np.ndarray:
+        return np.ones(len(self), np.int64)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, (list, tuple, Sequence)):
+            return NotImplemented
+        return len(self) == len(other) and all(
+            a == b for a, b in zip(self, other)
+        )
+
+
+class ResolvedGetFrameGroups(Sequence):
+    """Per-shard GET responses resolved from HOST-side value segments —
+    the zero-value-download read path.
+
+    Only ``found`` bits and version words cross the tunnel (~5
+    bytes/op); the value bytes come from a SNAPSHOT resolver over the
+    engine's retained SET windows / re-promotion seed
+    (``resolver(s, ver) -> bytes``), justified by (shard, version)
+    uniquely identifying content: shard versions are a monotone
+    counter, each value assigned exactly once. The snapshot pins
+    exactly the segments live at settle time — later evictions in the
+    engine cannot invalidate an already-settled response, and the view
+    holds no reference back to the engine. Byte-for-byte the host
+    store's GET framing; frames materialize on client read. The engine
+    only constructs this view after its vectorized resolvability
+    check — the resolver cannot miss."""
+
+    __slots__ = ("shards", "found", "ver", "resolver")
+
+    def __init__(self, shards, found, ver, resolver) -> None:
+        self.shards = shards  # i64[k] covered shards, group order
+        self.found = found  # bool[S]
+        self.ver = ver  # i32[S]
+        self.resolver = resolver
+
+    def __len__(self) -> int:
+        return len(self.shards)
+
+    def _frame(self, s: int) -> bytes:
         if not self.found[s]:
-            return _result_bin(1, 0)
+            return _get_frame(False, 0, b"")
         ver = int(self.ver[s])
-        val = self.valb[s, : int(self.vlen[s])].tobytes()
-        try:
-            return _result_bin(0, ver, val.decode("utf-8"))
-        except UnicodeDecodeError:
-            return _result_bin(2, ver, "value is not utf-8 text")
+        return _get_frame(True, ver, self.resolver(s, ver))
 
     def __getitem__(self, j):
         if isinstance(j, slice):
@@ -304,21 +415,53 @@ class DeviceKVTable:
         vwin_w = np.zeros((W, S, vu), np.uint8)
         kcols = np.arange(ku)[None, :]
         vcols = np.arange(vu)[None, :]
-        for t, (b, dbuf, off, klen, vlen, opcode) in enumerate(parsed):
-            sh = b.shards
-            kind_w[t, sh] = opcode
-            klen_w[t, sh] = klen
-            vlen_w[t, sh] = vlen
-            kw = dbuf[(off + _SET_HDR)[:, None] + kcols]
-            kwin_w[t, sh] = np.where(kcols < klen[:, None], kw, 0)
-            # value window may reach past the buffer end for the last op
-            # (vlen masks it); dbuf's pad already covers K+3 of slack,
-            # extend the gather clamp instead of growing the pad
-            vidx = np.minimum(
-                (off + _SET_HDR + klen)[:, None] + vcols, len(dbuf) - 1
-            )
-            vw = dbuf[vidx]
-            vwin_w[t, sh] = np.where(vcols < vlen[:, None], vw, 0)
+        # batch the W per-block gathers into ONE: concatenate the block
+        # buffers and rebase the offsets — per-window numpy call count
+        # drops from ~4W to ~8 (the W-loop was ~40% of the pack cost).
+        # A value gather may run past its block's end into the next
+        # block's bytes; the vlen mask zeroes those lanes, same as the
+        # old per-block end-of-buffer clamp.
+        sizes = [len(p[1]) for p in parsed]
+        bases = np.zeros(W, np.int64)
+        bases[1:] = np.cumsum(sizes[:-1])
+        dbuf_all = np.concatenate([p[1] for p in parsed])
+        off_all = np.concatenate(
+            [p[2] + bases[t] for t, p in enumerate(parsed)]
+        )
+        klen_all = np.concatenate([p[3] for p in parsed])
+        vlen_all = np.concatenate([p[4] for p in parsed])
+        op_all = np.concatenate([p[5] for p in parsed])
+        sh_all = np.concatenate([p[0].shards for p in parsed])
+        t_all = np.repeat(
+            np.arange(W), [len(p[2]) for p in parsed]
+        )
+        kw = dbuf_all[(off_all + _SET_HDR)[:, None] + kcols]
+        kw = np.where(kcols < klen_all[:, None], kw, 0)
+        vidx = np.minimum(
+            (off_all + _SET_HDR + klen_all)[:, None] + vcols,
+            len(dbuf_all) - 1,
+        )
+        vw = dbuf_all[vidx]
+        vw = np.where(vcols < vlen_all[:, None], vw, 0)
+        n = self.n_shards
+        grid = len(sh_all) == W * n and bool(
+            (sh_all.reshape(W, n) == np.arange(n)[None, :]).all()
+        )
+        if grid:
+            # full-width sorted blocks (the block lane's shape): the
+            # scatter is a contiguous reshape-assign — advanced-index
+            # scatters on 500k+ rows were ~half the gather cost
+            kind_w[:, :n] = op_all.reshape(W, n)
+            klen_w[:, :n] = klen_all.reshape(W, n)
+            vlen_w[:, :n] = vlen_all.reshape(W, n)
+            kwin_w[:, :n] = kw.reshape(W, n, ku)
+            vwin_w[:, :n] = vw.reshape(W, n, vu)
+        else:
+            kind_w[t_all, sh_all] = op_all
+            klen_w[t_all, sh_all] = klen_all
+            vlen_w[t_all, sh_all] = vlen_all
+            kwin_w[t_all, sh_all] = kw
+            vwin_w[t_all, sh_all] = vw
         return kind_w, klen_w, vlen_w, kwin_w, vwin_w
 
     def pack_window(self, blocks) -> Optional[DeviceWindowOps]:
@@ -328,6 +471,10 @@ class DeviceKVTable:
         g = self._gather_window(blocks, "set")
         if g is None:
             return None
+        return self._rows_from_gathered(g)
+
+    @staticmethod
+    def _rows_from_gathered(g: tuple) -> DeviceWindowOps:
         _kind, klen_w, vlen_w, kwin_w, vwin_w = g
         return DeviceWindowOps(
             klen_w,
@@ -335,6 +482,103 @@ class DeviceKVTable:
             np.ascontiguousarray(kwin_w).view(np.uint32),
             np.ascontiguousarray(vwin_w).view(np.uint32),
         )
+
+    def pack_window_dict(
+        self, blocks, max_dict: int = 32
+    ) -> Optional[DeviceDictOps]:
+        """Dictionary-compress a SET window: per-shard distinct
+        (key, value) rows + a rank per (wave, shard).
+
+        Vectorized per-shard uniqueness via a 64-bit universal hash
+        with FULL verification — every op row is compared bytewise
+        against the dictionary row its rank points to, so a hash
+        collision (or more than ``max_dict`` distinct rows per shard)
+        returns None and the caller falls back to the row-packed
+        upload. Correctness never rides on the hash."""
+        g = self._gather_window(blocks, "set")
+        if g is None:
+            return None
+        return self._dict_from_gathered(g, max_dict)
+
+    def _dict_from_gathered(
+        self, g: tuple, max_dict: int = 32
+    ) -> Optional[DeviceDictOps]:
+        _kind, klen_w, vlen_w, kwin_w, vwin_w = g
+        W, S = klen_w.shape
+        ku = kwin_w.shape[2]
+        vu = vwin_w.shape[2]
+        # 64-bit row hash: fold the byte planes as native u32/u64 WORDS
+        # (widths are powers of two, so the views are exact) — no
+        # [W,S,B]->u64 astype, no matmul; per-SHARD uniqueness via
+        # axis-1 sorts over the W window positions — O(S * W log W) on
+        # short rows instead of a global (S*W)-row lexsort. Both were
+        # the dominant pack costs at W=128.
+        h = klen_w.astype(np.uint64) * _HASH_W[0]
+        h += vlen_w.astype(np.uint64) * _HASH_W[1]
+        h += _fold_words(kwin_w) * _HASH_W[2]
+        h += _fold_words(vwin_w) * _HASH_W[3]
+        h = np.ascontiguousarray(h.T)  # [S, W]
+        o = np.argsort(h, axis=1, kind="stable")
+        hs = np.take_along_axis(h, o, axis=1)
+        new = np.ones((S, W), bool)
+        new[:, 1:] = hs[:, 1:] != hs[:, :-1]
+        rank_sorted = np.cumsum(new, axis=1) - 1  # [S, W]
+        D = int(rank_sorted[:, -1].max()) + 1
+        if D > max_dict:
+            return None
+        rank = np.empty((S, W), np.int64)
+        np.put_along_axis(rank, o, rank_sorted, axis=1)
+        # representative wave per (shard, dict row): first occurrence
+        rep_t = np.zeros((S, D), np.int64)
+        s_new, pos_new = np.nonzero(new)
+        rep_t[s_new, rank_sorted[s_new, pos_new]] = o[s_new, pos_new]
+        s_cols = np.arange(S)[:, None]
+        dkl = klen_w[rep_t, s_cols]  # [S, D]
+        dvl = vlen_w[rep_t, s_cols]
+        dkb = kwin_w[rep_t, s_cols]  # [S, D, ku]
+        dvb = vwin_w[rep_t, s_cols]
+        # hash verification: every op's bytes must equal its dictionary
+        # row's bytes — a collision (2^-64, or adversarial) falls back
+        # to the row-packed upload; correctness never rides on the hash
+        rank_ts = rank.T  # [W, S]
+        if D == 1:
+            # degenerate-but-common window (one row per shard all
+            # window): broadcast compare, no advanced-index gathers
+            ok = (
+                (klen_w == dkl[None, :, 0]).all()
+                and (vlen_w == dvl[None, :, 0]).all()
+                and (kwin_w == dkb[None, :, 0]).all()
+                and (vwin_w == dvb[None, :, 0]).all()
+            )
+        else:
+            sc = np.arange(S)[None, :]
+            ok = (
+                (klen_w == dkl[sc, rank_ts]).all()
+                and (vlen_w == dvl[sc, rank_ts]).all()
+                and (kwin_w == dkb[sc, rank_ts]).all()
+                and (vwin_w == dvb[sc, rank_ts]).all()
+            )
+        if not bool(ok):
+            return None
+        return DeviceDictOps(
+            np.ascontiguousarray(rank_ts.astype(np.uint8)),
+            np.ascontiguousarray(dkl),
+            np.ascontiguousarray(dvl),
+            np.ascontiguousarray(dkb).view(np.uint32),
+            np.ascontiguousarray(dvb).view(np.uint32),
+        )
+
+    def pack_window_auto(self, blocks):
+        """Dictionary-compressed SET window when the stream repeats
+        enough to pay off, else the row-packed form; None demotes.
+        One gather pass serves both attempts."""
+        g = self._gather_window(blocks, "set")
+        if g is None:
+            return None
+        d = self._dict_from_gathered(g)
+        if d is not None:
+            return d
+        return self._rows_from_gathered(g)
 
     def pack_get_window(self, blocks) -> Optional[tuple]:
         """Pack GET-only blocks into lookup inputs: ``(klen i16[W, S],
@@ -422,10 +666,13 @@ class DeviceKVTable:
     def lookup_window(self, alive, base, depth: int, klen, kwin, W: int,
                       max_phases: int = 4):
         """Dispatch one consensus+lookup window against the CURRENT
-        table (read-only). Returns host arrays
-        ``(all_v1, found[W,S], ver[W,S], vlen[W,S], val_words[W,S,VW4])``.
-        """
-        import jax
+        table (read-only). Returns DEVICE handles
+        ``(all_v1, found[W,S], ver[W,S], vlen[W,S], val_words[W,S,VW4])``
+        — the caller fetches selectively: found+ver are ~5 bytes/op;
+        the value planes (~70 bytes/op) only need to cross the tunnel
+        when a version cannot be resolved from the host-side value
+        segments (see mesh_engine._dev_resolve), which is the eviction
+        edge case, not the steady state."""
         import jax.numpy as jnp
 
         if klen.shape[0] < W:
@@ -442,7 +689,7 @@ class DeviceKVTable:
         if fn is None:
             fn = self._build_lookup(kwin.shape[2])
             self._fused_cache[key] = fn
-        out = fn(
+        return fn(
             self.state,
             self.kernel.place(jnp.asarray(alive)),
             jnp.asarray(base),
@@ -452,7 +699,105 @@ class DeviceKVTable:
             W=W,
             max_phases=max_phases,
         )
-        return jax.device_get(out)
+
+    @staticmethod
+    def _apply_set_wave(carry, ok_w, klen_t, vlen_t, kwin_t, vwin_t, Pc):
+        """One SET wave over the table state — shared by the row-packed
+        and dictionary-packed fused programs.
+
+        Match: word compare against all P slots of the shard; stored
+        tails beyond the op key are zero, as are the padded op words,
+        so prefix equality + length equality IS full-key equality.
+        Updates are one-hot word SELECTS, not dynamic-index scatters
+        (which lower poorly on TPU)."""
+        import jax.numpy as jnp
+
+        used, keyw, klen, ver, valw, vlen, sver = carry
+        eq = (
+            used
+            & (klen == klen_t[:, None])
+            & (keyw == kwin_t[:, None, :]).all(-1)
+        )  # [S, P]
+        found = eq.any(1)
+        slot = jnp.where(found, jnp.argmax(eq, 1), jnp.argmax(~used, 1))
+        full = used.all(1)
+        apply = ok_w & (found | ~full)
+        overflow = jnp.any(ok_w & ~found & full)
+        onehot = (
+            jnp.arange(Pc)[None, :] == slot[:, None]
+        ) & apply[:, None]  # [S, P]
+        oh3 = onehot[:, :, None]
+        used = used | onehot
+        keyw = jnp.where(oh3, kwin_t[:, None, :], keyw)
+        klen = jnp.where(onehot, klen_t[:, None], klen)
+        new_ver = sver + 1
+        ver = jnp.where(onehot, new_ver[:, None], ver)
+        valw = jnp.where(oh3, vwin_t[:, None, :], valw)
+        vlen = jnp.where(onehot, vlen_t[:, None], vlen)
+        sver = jnp.where(apply, new_ver, sver)
+        return (used, keyw, klen, ver, valw, vlen, sver), overflow
+
+    def _build_fused_dict(self, Ku4: int, VWu4: int, D: int):
+        """Jitted SET window on DICTIONARY-compressed ops: each wave
+        expands its (wave, shard) rank into the shard's dictionary row
+        with a one-hot select (D is small), then applies the shared
+        SET wave body — upload bytes shrink ~10x on repetitive
+        (Zipf) streams, the table math is unchanged."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        kernel = self.kernel
+        S, Pc = self.S, self.P
+        K4, VW4 = self.K4, self.VW4
+        n = self.n_shards
+        I8, I32 = jnp.int8, jnp.int32
+        col = jnp.arange(S) < n
+
+        def fused(state, alive, base, depth, ops, *, W, max_phases):
+            wave = jnp.arange(W, dtype=I32)[:, None] < depth
+            present = wave & col[None, :]
+            votes = jnp.where(
+                present[:, :, None], I8(V1), I8(V0)
+            ) * jnp.ones((1, 1, kernel.R), I8)
+            decided = kernel.slot_window(
+                votes, alive, base, n_slots=W, max_phases=max_phases
+            )
+            all_v1 = jnp.all(jnp.where(present, decided == V1, True))
+
+            dk_full = jnp.pad(ops.dk, ((0, 0), (0, 0), (0, K4 - Ku4)))
+            dv_full = jnp.pad(ops.dv, ((0, 0), (0, 0), (0, VW4 - VWu4)))
+            dkl = ops.dkl.astype(I32)
+            dvl = ops.dvl.astype(I32)
+            dr = jnp.arange(D, dtype=I32)[None, :]
+
+            def wave_step(carry, inp):
+                ok_w, idx_w = inp
+                oh = idx_w.astype(I32)[:, None] == dr  # [S, D]
+                ohu = oh.astype(jnp.uint32)[:, :, None]
+                klen_t = (dkl * oh).sum(1)
+                vlen_t = (dvl * oh).sum(1)
+                kwin_t = (dk_full * ohu).sum(1)
+                vwin_t = (dv_full * ohu).sum(1)
+                return DeviceKVTable._apply_set_wave(
+                    carry, ok_w, klen_t, vlen_t, kwin_t, vwin_t, Pc
+                )
+
+            new_state, over_w = lax.scan(
+                wave_step, state, (present, ops.idx)
+            )
+            flags = jnp.stack(
+                [
+                    all_v1.astype(I32),
+                    jnp.any(over_w).astype(I32),
+                    jnp.any(
+                        new_state[6] >= jnp.int32(2**31 - 2)
+                    ).astype(I32),
+                ]
+            )
+            return new_state, flags
+
+        return jax.jit(fused, static_argnames=("W", "max_phases"))
 
     def _build_fused(self, Ku4: int, VWu4: int):
         import jax
@@ -485,43 +830,18 @@ class DeviceKVTable:
             vwin_full = jnp.pad(ops.vwin, ((0, 0), (0, 0), (0, VW4 - VWu4)))
 
             def wave_step(carry, inp):
-                used, keyw, klen, ver, valw, vlen, sver = carry
                 ok_w, klen_t, vlen_t, kwin_t, vwin_t = inp
                 # op columns travel as i16 (upload bytes are the tunnel
                 # wall); table arithmetic stays i32
-                klen_t = klen_t.astype(jnp.int32)
-                vlen_t = vlen_t.astype(jnp.int32)
-                # match: word compare against all P slots of the shard;
-                # stored tails beyond the op key are zero, as are the
-                # padded op words, so prefix equality + length equality
-                # IS full-key equality
-                eq = (
-                    used
-                    & (klen == klen_t[:, None])
-                    & (keyw == kwin_t[:, None, :]).all(-1)
-                )  # [S, P]
-                found = eq.any(1)
-                slot = jnp.where(
-                    found, jnp.argmax(eq, 1), jnp.argmax(~used, 1)
+                return DeviceKVTable._apply_set_wave(
+                    carry,
+                    ok_w,
+                    klen_t.astype(jnp.int32),
+                    vlen_t.astype(jnp.int32),
+                    kwin_t,
+                    vwin_t,
+                    Pc,
                 )
-                full = used.all(1)
-                apply = ok_w & (found | ~full)
-                overflow = jnp.any(ok_w & ~found & full)
-                # updates as one-hot word SELECTS, not dynamic-index
-                # scatters (which lower poorly on TPU)
-                onehot = (
-                    jnp.arange(Pc)[None, :] == slot[:, None]
-                ) & apply[:, None]  # [S, P]
-                oh3 = onehot[:, :, None]
-                used = used | onehot
-                keyw = jnp.where(oh3, kwin_t[:, None, :], keyw)
-                klen = jnp.where(onehot, klen_t[:, None], klen)
-                new_ver = sver + 1
-                ver = jnp.where(onehot, new_ver[:, None], ver)
-                valw = jnp.where(oh3, vwin_t[:, None, :], valw)
-                vlen = jnp.where(onehot, vlen_t[:, None], vlen)
-                sver = jnp.where(apply, new_ver, sver)
-                return (used, keyw, klen, ver, valw, vlen, sver), overflow
 
             new_state, over_w = lax.scan(
                 wave_step,
@@ -549,9 +869,15 @@ class DeviceKVTable:
         The caller ADOPTS ``new_state`` only when the flags are clean
         (and then derives version responses from its host-side counter
         mirror); otherwise it keeps the old state object (purely
-        functional program — nothing was donated) and demotes."""
+        functional program — nothing was donated) and demotes.
+        Accepts row-packed (:class:`DeviceWindowOps`) or
+        dictionary-packed (:class:`DeviceDictOps`) windows."""
         import jax.numpy as jnp
 
+        if isinstance(ops, DeviceDictOps):
+            return self._decide_apply_dict(
+                alive, base, depth, ops, W, max_phases, state
+            )
         if ops.klen.shape[0] < W:
             # pack_window covers only the depth in-flight waves; pad to
             # the static window size (filler waves are masked out by the
@@ -744,6 +1070,35 @@ class DeviceKVTable:
             max_phases=max_phases,
         )
 
+    def _decide_apply_dict(self, alive, base, depth, ops: DeviceDictOps,
+                           W: int, max_phases: int, state=None):
+        import jax.numpy as jnp
+
+        if ops.idx.shape[0] < W:
+            pad = W - ops.idx.shape[0]
+            ops = ops._replace(
+                idx=np.concatenate(
+                    [ops.idx, np.zeros((pad, ops.idx.shape[1]), np.uint8)]
+                )
+            )
+        D = ops.dkl.shape[1]
+        key = ("dictset", W, ops.dk.shape[2], ops.dv.shape[2], D)
+        fn = self._fused_cache.get(key)
+        self.compiled_on_last_call = fn is None
+        if fn is None:
+            fn = self._build_fused_dict(key[2], key[3], D)
+            self._fused_cache[key] = fn
+        dev_ops = DeviceDictOps(*(jnp.asarray(a) for a in ops))
+        return fn(
+            self.state if state is None else state,
+            self.kernel.place(jnp.asarray(alive)),
+            jnp.asarray(base),
+            jnp.int32(depth),
+            dev_ops,
+            W=W,
+            max_phases=max_phases,
+        )
+
     def adopt(self, new_state) -> None:
         self.state = new_state
 
@@ -774,9 +1129,14 @@ class DeviceKVTable:
             "shard_version": sver[: self.n_shards].astype(np.int64),
         }
 
-    def upload_from(self, sm) -> bool:
+    def upload_from(self, sm, seed_cache: Optional[dict] = None) -> bool:
         """Rebuild the device table from one host replica store
         (``dump``'s inverse — the re-promotion path after a demotion).
+
+        ``seed_cache`` (optional): a ``(shard, version) -> value bytes``
+        dict populated with every uploaded entry, so the engine's GET
+        meta-only read path can resolve pre-promotion versions without
+        downloading values (mesh_engine._dev_resolve).
 
         Returns False, leaving the device state untouched, when the host
         content is outside the lane's envelope: an overflow side-store
@@ -848,6 +1208,10 @@ class DeviceKVTable:
                 valb[sh_sorted[j], pos[j], : len(v)] = np.frombuffer(
                     v, np.uint8
                 )
+                if seed_cache is not None:
+                    seed_cache[
+                        (int(sh_sorted[j]), int(store.version[i]))
+                    ] = bytes(v)
         sver = np.zeros(S, np.int32)
         sver[: self.n_shards] = store.shard_version[: self.n_shards]
 

@@ -68,6 +68,96 @@ __all__ = ["MeshBlockFuture", "MeshEngine", "MeshFuture"]
 logger = logging.getLogger(__name__)
 
 
+class _RowSeg:
+    """Value segment for a pure-SET window packed as per-op rows:
+    version v at shard s is wave ``t = v - start[s] - 1``."""
+
+    __slots__ = ("start", "end", "vlen", "vwin8", "nbytes")
+
+    def __init__(self, start, end, vlen, vwin) -> None:
+        self.start = start
+        self.end = end
+        self.vlen = vlen
+        self.vwin8 = vwin.view(np.uint8)
+        self.nbytes = vlen.nbytes + self.vwin8.nbytes
+
+    def value(self, s: int, ver: int) -> Optional[bytes]:
+        t = ver - int(self.start[s]) - 1
+        return self.vwin8[t, s, : int(self.vlen[t, s])].tobytes()
+
+
+class _DictSeg:
+    """Value segment for a dict-packed SET window: the op's value is
+    the dictionary row its wave indexed."""
+
+    __slots__ = ("start", "end", "idx", "dvl", "dv8", "nbytes")
+
+    def __init__(self, start, end, idx, dvl, dv) -> None:
+        self.start = start
+        self.end = end
+        self.idx = idx  # [W, S] within-shard dictionary rank
+        self.dvl = dvl  # i16[S, D]
+        self.dv8 = dv.view(np.uint8)  # u8[S, D, vu]
+        self.nbytes = idx.nbytes + dvl.nbytes + self.dv8.nbytes
+
+    def value(self, s: int, ver: int) -> Optional[bytes]:
+        t = ver - int(self.start[s]) - 1
+        j = int(self.idx[t, s])
+        return self.dv8[s, j, : int(self.dvl[s, j])].tobytes()
+
+
+class _MixedSeg:
+    """Value segment for a mixed window: per-(wave, shard) derived
+    versions locate the SET wave by binary search (``svers`` columns
+    are nondecreasing; the first wave reaching v is the SET that
+    assigned it)."""
+
+    __slots__ = ("start", "end", "vlen", "vwin8", "svers", "kind", "nbytes")
+
+    def __init__(self, start, end, vlen, vwin, svers, kind) -> None:
+        self.start = start
+        self.end = end
+        self.vlen = vlen
+        self.vwin8 = vwin.view(np.uint8)
+        self.svers = svers
+        self.kind = kind
+        self.nbytes = vlen.nbytes + self.vwin8.nbytes + svers.nbytes
+
+    def value(self, s: int, ver: int) -> Optional[bytes]:
+        col = self.svers[:, s]
+        t = int(np.searchsorted(col, ver))
+        if t >= len(col) or col[t] != ver or self.kind[t, s] != 1:
+            return None
+        return self.vwin8[t, s, : int(self.vlen[t, s])].tobytes()
+
+
+class _SegResolver:
+    """Snapshot (shard, version) -> value-bytes resolver handed to
+    settled GET views: pins exactly the segments and seed epoch live at
+    settle time, so later engine-side evictions or re-promotions cannot
+    invalidate an already-settled response — and the view holds no
+    reference back to the engine (a client retaining results must not
+    pin the whole engine)."""
+
+    __slots__ = ("segs", "seed")
+
+    def __init__(self, segs: tuple, seed: dict) -> None:
+        self.segs = segs
+        self.seed = seed
+
+    def __call__(self, s: int, ver: int) -> bytes:
+        v = self.seed.get((s, ver))
+        if v is not None:
+            return v
+        for seg in reversed(self.segs):
+            if not (seg.start[s] < ver <= seg.end[s]):
+                continue
+            v = seg.value(s, ver)
+            if v is not None:
+                return v
+        raise KeyError((s, ver))
+
+
 def _block_op_kind(block) -> Optional[int]:
     """The uniform opcode of a one-op-per-shard block (1=SET, 2=GET),
     or None when ops are mixed/absent — the device lanes dispatch by
@@ -326,7 +416,6 @@ class MeshEngine:
         # ONCE and the engine continues on the host path permanently.
         self._dev = None
         self._dev_active = False
-        self._dev_spec = None  # speculative chained device window
         if device_store:
             from rabia_tpu.apps.device_kv import DeviceKVTable
 
@@ -354,6 +443,34 @@ class MeshEngine:
             # host mirror of the device per-shard version counters:
             # response versions derive from it (no per-op readback)
             self._dev_sver = np.zeros(self.S, np.int64)
+            # host-side value segments: every committed device window's
+            # (vlen, value bytes) retained keyed by version range, plus
+            # a (shard, version) -> bytes seed filled at re-promotion —
+            # together they resolve ANY version a device GET can return,
+            # so the read lane downloads found+version only (~5 B/op),
+            # not value planes (~70 B/op over a ~12MB/s tunnel)
+            # pipelined-commit records: dispatched-but-unresolved SET
+            # windows (flags unread); see _run_cycle_fullwidth_device.
+            # The 12-byte flags fetch runs on a single worker thread:
+            # issued from the main thread it would queue BEHIND the
+            # just-dispatched next window on the single-stream device
+            # and eat a full window of latency per cycle (measured
+            # ~156ms/cycle); the worker blocks there instead while the
+            # main thread packs the next window.
+            self._dev_pipe: list = []
+            import concurrent.futures
+
+            self._dev_fetcher = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="devkv-flags"
+            )
+            self._dev_vseg: deque = deque()
+            self._dev_vseg_bytes = 0
+            self._dev_vseg_cap = 64 << 20  # evictions raise _dev_floor
+            self._dev_seed: dict = {}
+            self._dev_seed_keys = np.empty(0, np.int64)
+            # versions <= floor[s] are resolvable only via the seed
+            # (raised by segment eviction and at re-promotion)
+            self._dev_floor = np.zeros(self.S, np.int64)
         # full-width cycles between re-promotion attempts after a
         # demotion (0 disables climbing back onto the device lane)
         self._dev_repromote = max(0, int(device_store_repromote))
@@ -428,12 +545,10 @@ class MeshEngine:
         """Mask replica ``r`` out of every shard's tally (fail-stop)."""
         self.alive[:, r] = False
         self._spec = None  # speculated under the old mask
-        self._dev_spec = None
 
     def heal_replica(self, r: int) -> None:
         self.alive[:, r] = True
         self._spec = None
-        self._dev_spec = None
 
     @property
     def has_quorum(self) -> bool:
@@ -589,6 +704,14 @@ class MeshEngine:
         }
 
     def _run_cycle_inner(self) -> int:
+        if (
+            self._dev_active
+            and self._dev_pipe
+            and not self._full_blocks
+        ):
+            # no new device work: drain one in-flight window so flush
+            # converges (its applied count is this cycle's progress)
+            return self._dev_resolve_one()
         if self._full_blocks:
             if self._vector and self._queued_entries == 0:
                 if (
@@ -684,6 +807,7 @@ class MeshEngine:
         overflow, a fault) demotes to the host path — state is adopted
         only on a clean all-V1 window, so demotion always re-runs from a
         consistent table."""
+        from rabia_tpu.apps.device_kv import DeviceDictOps
         from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
 
         W = self.window
@@ -706,72 +830,48 @@ class MeshEngine:
                 break
             depth += 1
         if head_kind is None or depth < len(kinds):
-            return self._run_cycle_fullwidth_device_mixed(len(kinds))
+            applied = self._dev_drain_pipe()
+            if not self._dev_active:
+                return applied + self._run_cycle_inner()
+            return applied + self._run_cycle_fullwidth_device_mixed(
+                len(kinds)
+            )
         if head_kind == 2:
-            return self._run_cycle_fullwidth_device_get(depth)
+            applied = self._dev_drain_pipe()
+            if not self._dev_active:
+                return applied + self._run_cycle_inner()
+            return applied + self._run_cycle_fullwidth_device_get(depth)
         entries = [self._full_blocks[i] for i in range(depth)]  # peek
+        ops = self._dev.pack_window_auto([e[0] for e in entries])
+        if ops is None:
+            applied = self._dev_drain_pipe()
+            self._demote_device_store()
+            return applied + self._run_cycle_inner()
         base = np.zeros(self.S, np.int32)
         base[:n] = self.next_slot
-        key = self._dev_window_key(entries, base)
-        if self._dev_spec is not None and self._dev_spec[0] == key:
-            # the previous cycle already packed, uploaded and dispatched
-            # this window against its (not-yet-adopted) output state
-            new_state, flags_dev = self._dev_spec[1], self._dev_spec[2]
-        else:
-            ops = self._dev.pack_window([e[0] for e in entries])
-            if ops is None:
-                self._dev_spec = None
-                self._demote_device_store()
-                return self._run_cycle_inner()
-            new_state, flags_dev = self._dev.decide_apply(
-                self.alive, base, depth, ops, W=W,
-                max_phases=self.max_phases,
-            )
-            # a new (W, widths) signature compiles inside this dispatch —
-            # seconds of jit, not window latency
-            self._lat_invalidate |= (
-                self._dev.compiled_on_last_call and self._lat_timing
-            )
-        self._dev_spec = None
+        # PIPELINED COMMIT: dispatch window k chained on the UNRESOLVED
+        # previous window's output state, advance the bookkeeping
+        # optimistically, and only then read the previous window's
+        # 12-byte flags — the flag round-trip overlaps this window's
+        # upload + device compute instead of serializing every cycle.
+        # Futures settle one window late (at resolution); a dirty flag
+        # rolls back every optimistic window (the programs are
+        # functional — nothing was adopted) and demotes.
+        state_base = (
+            self._dev_pipe[-1]["new_state"]
+            if self._dev_pipe
+            else self._dev.state
+        )
+        new_state, flags_dev = self._dev.decide_apply(
+            self.alive, base, depth, ops, W=W,
+            max_phases=self.max_phases, state=state_base,
+        )
+        # a new (W, widths) signature compiles inside this dispatch —
+        # seconds of jit, not window latency
+        self._lat_invalidate |= (
+            self._dev.compiled_on_last_call and self._lat_timing
+        )
         self.cycles += 1
-        # speculate the NEXT window before this one's readback: pack +
-        # upload + dispatch against the chained (unadopted) state, so
-        # device compute and the host->device transfer overlap this
-        # cycle's flag round-trip. The program is functional — a fault
-        # outcome simply discards the whole chain.
-        if len(self._full_blocks) > depth:
-            # the lookahead run stops at the first non-SET block — a GET
-            # run splits into its own window and must not kill the SET
-            # chain's speculation (pack_window would decline the mix)
-            entries2 = []
-            for i in range(depth, min(len(self._full_blocks), depth + W)):
-                if _block_op_kind(self._full_blocks[i][0]) != 1:
-                    break
-                entries2.append(self._full_blocks[i])
-            depth2 = len(entries2)
-            base2 = base.copy()
-            base2[:n] += depth
-            ops2 = self._dev.pack_window([e[0] for e in entries2])
-            if entries2 and ops2 is not None:
-                spec = self._dev.decide_apply(
-                    self.alive, base2, depth2, ops2, W=W,
-                    max_phases=self.max_phases, state=new_state,
-                )
-                self._dev_spec = (
-                    self._dev_window_key(entries2, base2),
-                    spec[0],
-                    spec[1],
-                )
-        flags = np.asarray(flags_dev)  # 12 bytes: the ONLY readback
-        if not flags[0] or flags[1] or flags[2]:
-            # the program is functional: nothing was adopted, the table
-            # still holds the pre-window state — sync it down and let
-            # the host path re-decide (deterministic kernel) and apply.
-            # Any speculative chain built on this window dies with it.
-            self._dev_spec = None
-            self._demote_device_store()
-            return self._run_cycle_inner()
-        self._dev.adopt(new_state)
         # version responses are DERIVED, not transferred: a clean
         # all-V1 full-width window advances every covered shard's
         # version by exactly one per wave, so the host mirror + wave
@@ -781,6 +881,17 @@ class MeshEngine:
             self._dev_sver[None, : self.S]
             + np.arange(1, W + 1, dtype=np.int64)[:, None]
         )
+        # retain this window's value bytes host-side: (shard, version)
+        # uniquely identifies content, so the GET lane can answer reads
+        # without downloading values (see _dev_resolve)
+        seg_start = self._dev_sver.copy()
+        seg_end = seg_start.copy()
+        seg_end[:n] += depth
+        if isinstance(ops, DeviceDictOps):
+            seg = _DictSeg(seg_start, seg_end, ops.idx, ops.dvl, ops.dv)
+        else:
+            seg = _RowSeg(seg_start, seg_end, ops.vlen, ops.vwin)
+        self._dev_push_segment(seg)
         self._dev_sver[:n] += depth
         for _ in range(depth):
             self._full_blocks.popleft()
@@ -793,15 +904,73 @@ class MeshEngine:
             1, self.max_decision_history // max(1, self.window)
         ):
             self._bulk_log.popleft()
-        # settle futures from the device's version responses; counts==1
+        self._dev_pipe.append(
+            {
+                "flags_fut": self._dev_fetcher.submit(np.asarray, flags_dev),
+                "new_state": new_state,
+                "entries": entries,
+                "depth": depth,
+                "n": n,
+                "vers": vers,
+                "seg": seg,
+            }
+        )
+        if len(self._dev_pipe) > 1:
+            return self._dev_resolve_one()
+        return 0
+
+    def _dev_resolve_one(self) -> int:
+        """Resolve the OLDEST in-flight device window: read its flags,
+        then settle (clean) or roll back the whole pipe and demote
+        (dirty). Returns batches applied by the resolved window."""
+        from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
+
+        rec = self._dev_pipe[0]
+        flags = rec["flags_fut"].result()  # 12 bytes: the readback
+        if not flags[0] or flags[1] or flags[2]:
+            # roll back EVERY optimistic window, newest first — the
+            # device state was never adopted, so restoring the host
+            # bookkeeping re-creates the pre-window world exactly; the
+            # host path then re-decides the same blocks
+            while self._dev_pipe:
+                r = self._dev_pipe.pop()
+                d, rn = r["depth"], r["n"]
+                for _ in range(d):
+                    if self._bulk_log:
+                        self._bulk_log.pop()
+                for e in reversed(r["entries"]):
+                    self._full_blocks.appendleft(e)
+                self.next_slot[:rn] -= d
+                self._dev_sver[:rn] -= d
+                self.decided_v1 -= d * rn
+                if self._dev_vseg and self._dev_vseg[-1] is r["seg"]:
+                    self._dev_vseg.pop()
+                    self._dev_vseg_bytes -= r["seg"].nbytes
+                # (an already-evicted segment only over-raised the
+                # floor — safe: the GET path falls back to downloads)
+            self._demote_device_store()
+            return 0
+        self._dev_pipe.pop(0)
+        self._dev.adopt(rec["new_state"])
+        # settle futures from the derived version responses; counts==1
         # per covered shard (pack_window enforced it), so group bounds
         # are the identity
-        for t, (block, bfut, _inv) in enumerate(entries):
+        vers = rec["vers"]
+        for t, (block, bfut, _inv) in enumerate(rec["entries"]):
             row = vers[t, np.asarray(block.shards, np.int64)]
             frames = VectorShardedKV._vers_frames(row)
             bounds = np.arange(len(block) + 1, dtype=np.int64)
             bfut._settle_bulk(FrameGroups(frames, bounds))
-        return depth * n
+        return rec["depth"] * rec["n"]
+
+    def _dev_drain_pipe(self) -> int:
+        """Resolve every in-flight device window (used before any
+        operation that needs the settled table: GET/mixed windows,
+        demotion, checkpointing, idle drain)."""
+        applied = 0
+        while self._dev_pipe and self._dev_active:
+            applied += self._dev_resolve_one()
+        return applied
 
     def _run_cycle_fullwidth_device_get(self, depth: int) -> int:
         """GET-only full-width windows through the device table's
@@ -810,32 +979,46 @@ class MeshEngine:
         no table mutation, no version advance, responses materialize
         lazily from the readback. Anything outside the read envelope
         (long keys, malformed ops) demotes exactly like the write lane.
-        """
-        from rabia_tpu.apps.device_kv import GetFrameGroups
+
+        Readback is META-ONLY in the steady state: found bits + version
+        words (~5 bytes/op). Value bytes resolve from the host-side
+        segments/seed (every version a GET can see was packed by this
+        host at SET time or seeded at re-promotion — (shard, version)
+        is unique content identity). Only when the vectorized
+        resolvability check finds an evicted version does the window
+        download the value planes (~70 bytes/op, the round-4 cost)."""
+        from rabia_tpu.apps.device_kv import (
+            GetFrameGroups,
+            ResolvedGetFrameGroups,
+        )
 
         W = self.window
         n = self.n_shards
         entries = [self._full_blocks[i] for i in range(depth)]
         packed = self._dev.pack_get_window([e[0] for e in entries])
         if packed is None:
-            self._dev_spec = None
             self._demote_device_store()
             return self._run_cycle_inner()
         base = np.zeros(self.S, np.int32)
         base[:n] = self.next_slot
         klen, kwin = packed
-        all_v1, found, ver, vlen, valw = self._dev.lookup_window(
+        all_v1_d, found_d, ver_d, vlen_d, valw_d = self._dev.lookup_window(
             self.alive, base, depth, klen, kwin, W=W,
             max_phases=self.max_phases,
         )
         self._lat_invalidate |= (
             self._dev.compiled_on_last_call and self._lat_timing
         )
-        self._dev_spec = None  # chained SET state no longer matches base
         self.cycles += 1
-        if not int(all_v1):
+        if not int(np.asarray(all_v1_d)):
             self._demote_device_store()
             return self._run_cycle_inner()
+        found = np.asarray(found_d)
+        ver = np.asarray(ver_d)
+        resolved = not self._dev_unresolvable(found[:depth], ver[:depth])
+        if not resolved:
+            vlen = np.asarray(vlen_d)
+            valw = np.asarray(valw_d)
         for _ in range(depth):
             self._full_blocks.popleft()
         start = self.next_slot.copy()
@@ -847,13 +1030,18 @@ class MeshEngine:
             1, self.max_decision_history // max(1, self.window)
         ):
             self._bulk_log.popleft()
+        if resolved:
+            rsv = self._dev_make_resolver()
         for t, (block, bfut, _inv) in enumerate(entries):
-            bfut._settle_bulk(
-                GetFrameGroups(
-                    np.asarray(block.shards, np.int64),
-                    found[t], ver[t], vlen[t], valw[t],
+            sh = np.asarray(block.shards, np.int64)
+            if resolved:
+                bfut._settle_bulk(
+                    ResolvedGetFrameGroups(sh, found[t], ver[t], rsv)
                 )
-            )
+            else:
+                bfut._settle_bulk(
+                    GetFrameGroups(sh, found[t], ver[t], vlen[t], valw[t])
+                )
         return depth * n
 
     def _run_cycle_fullwidth_device_mixed(self, count: int) -> int:
@@ -862,10 +1050,15 @@ class MeshEngine:
         wave-entry state, one dispatch for the whole window. SET
         response versions derive from the host mirror + the per-shard
         cumulative SET count (clean window ⇒ every SET applied exactly
-        once); GET planes download only for the waves that hold GETs
-        (device-side gather of those waves — a SET-heavy mixed window
-        pays readback proportional to its GET waves, not to W)."""
-        from rabia_tpu.apps.device_kv import GetFrameGroups, MixedFrameGroups
+        once); GET responses in the steady state carry META ONLY — value
+        bytes resolve from the host-side segments (this window's SETs
+        included, so reads of same-window writes resolve too), with the
+        value-plane download kept as the eviction fallback."""
+        from rabia_tpu.apps.device_kv import (
+            GetFrameGroups,
+            MixedFrameGroups,
+            ResolvedGetFrameGroups,
+        )
         from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
 
         W = self.window
@@ -873,7 +1066,6 @@ class MeshEngine:
         entries = [self._full_blocks[i] for i in range(count)]
         packed = self._dev.pack_mixed_window([e[0] for e in entries])
         if packed is None:
-            self._dev_spec = None
             self._demote_device_store()
             return self._run_cycle_inner()
         kind, ops = packed
@@ -887,7 +1079,6 @@ class MeshEngine:
         self._lat_invalidate |= (
             self._dev.compiled_on_last_call and self._lat_timing
         )
-        self._dev_spec = None  # chained SET state no longer matches base
         self.cycles += 1
         flags = np.asarray(flags_dev)
         if not flags[0] or flags[1] or flags[2]:
@@ -899,15 +1090,27 @@ class MeshEngine:
         is_set = kind == 1  # [count, S]
         set_cum = np.cumsum(is_set, axis=0, dtype=np.int64)
         svers = self._dev_sver[None, : self.S] + set_cum
+        seg_start = self._dev_sver.copy()
+        self._dev_push_segment(
+            _MixedSeg(
+                seg_start, seg_start + set_cum[-1], ops.vlen, ops.vwin,
+                svers, kind,
+            )
+        )
         gpos = {int(t): j for j, t in enumerate(get_waves)}
+        resolved = True
         if len(get_waves):
-            # the program already gathered the GET waves on device; two
-            # fetches total (meta planes + value words)
+            # one meta fetch (found/ver/vlen planes); value words stay
+            # on device unless an evicted version forces the fallback
             meta_h = np.asarray(meta_dev)
-            gval_h = np.asarray(gval_dev)
             gver_h = meta_h[0]
             gvlen_h = meta_h[1] >> 1
             gfound_h = (meta_h[1] & 1).astype(bool)
+            resolved = not self._dev_unresolvable(gfound_h, gver_h)
+            if resolved:
+                rsv = self._dev_make_resolver()
+            else:
+                gval_h = np.asarray(gval_dev)
         self._dev_sver[: self.S] += set_cum[-1]
         for _ in range(count):
             self._full_blocks.popleft()
@@ -926,9 +1129,14 @@ class MeshEngine:
             gf = None
             if t in gpos:
                 j = gpos[t]
-                gf = GetFrameGroups(
-                    sh, gfound_h[j], gver_h[j], gvlen_h[j], gval_h[j]
-                )
+                if resolved:
+                    gf = ResolvedGetFrameGroups(
+                        sh, gfound_h[j], gver_h[j], rsv
+                    )
+                else:
+                    gf = GetFrameGroups(
+                        sh, gfound_h[j], gver_h[j], gvlen_h[j], gval_h[j]
+                    )
             if gf is None:
                 # pure-SET wave inside a mixed window: the lean framing
                 frames = VectorShardedKV._vers_frames(svers[t, sh])
@@ -942,14 +1150,62 @@ class MeshEngine:
                 )
         return count * n
 
-    def _dev_window_key(self, entries, base) -> tuple:
-        """Identity of a device window dispatch: the exact blocks (by
-        object id — the FIFO holds them alive), slot base and alive
-        mask the speculation assumed."""
-        return (
-            tuple(id(e[0]) for e in entries),
-            base.tobytes(),
-            self.alive.tobytes(),
+    def _dev_push_segment(self, seg) -> None:
+        """Retain one committed device window's value bytes (a
+        :class:`_RowSeg` / :class:`_DictSeg` / :class:`_MixedSeg`).
+
+        ``seg.start``/``seg.end`` bound the shard versions the window
+        assigned (start[s] < v <= end[s]). Eviction (byte cap) raises
+        ``_dev_floor`` — evicted versions become seed-only, and the GET
+        path's resolvability check falls back to a value-plane download
+        for them instead of mis-answering."""
+        self._dev_vseg.append(seg)
+        self._dev_vseg_bytes += seg.nbytes
+        while (
+            self._dev_vseg_bytes > self._dev_vseg_cap
+            and len(self._dev_vseg) > 1
+        ):
+            old = self._dev_vseg.popleft()
+            self._dev_vseg_bytes -= old.nbytes
+            np.maximum(self._dev_floor, old.end, out=self._dev_floor)
+
+    def _dev_make_resolver(self) -> _SegResolver:
+        """Snapshot resolver over the CURRENT segments + seed epoch —
+        one per settled window, shared by its frame views. Only built
+        after the vectorized resolvability check, so a miss inside a
+        settled view is a logic error, not a runtime condition."""
+        return _SegResolver(tuple(self._dev_vseg), self._dev_seed)
+
+    def _dev_resolve(self, s: int, ver: int) -> bytes:
+        """Value bytes for (shard, version) against the live engine
+        state (test/debug convenience; settled views carry snapshots)."""
+        return self._dev_make_resolver()(s, ver)
+
+    def _dev_unresolvable(self, found: np.ndarray, ver: np.ndarray) -> bool:
+        """True when ANY found (wave, shard) op's version cannot be
+        resolved host-side — the caller then downloads the value planes
+        for this window (graceful eviction fallback). Vectorized: only
+        versions at or below the floor consult the seed index."""
+        cand = found & (ver <= self._dev_floor[None, : ver.shape[1]])
+        if not bool(cand.any()):
+            return False
+        if len(self._dev_seed_keys) == 0:
+            return True
+        t_idx, s_idx = np.nonzero(cand)
+        keys = (s_idx.astype(np.int64) << 32) | ver[t_idx, s_idx].astype(
+            np.int64
+        )
+        pos = np.searchsorted(self._dev_seed_keys, keys)
+        pos = np.minimum(pos, len(self._dev_seed_keys) - 1)
+        return not bool(np.all(self._dev_seed_keys[pos] == keys))
+
+    def _dev_reindex_seed(self) -> None:
+        self._dev_seed_keys = np.sort(
+            np.fromiter(
+                ((s << 32) | v for (s, v) in self._dev_seed),
+                np.int64,
+                len(self._dev_seed),
+            )
         )
 
     def _demote_device_store(self) -> None:
@@ -958,6 +1214,13 @@ class MeshEngine:
         the host replicas saw none of the applies)."""
         if not self._dev_active:
             return
+        if self._dev_pipe:
+            # the sync-down below must see the SETTLED table: resolve
+            # every in-flight window first (a dirty one rolls the pipe
+            # back and re-enters this method with an empty pipe)
+            self._dev_drain_pipe()
+            if not self._dev_active:
+                return
         # a lane switch DURING a timed cycle voids that cycle's latency
         # sample; from outside a cycle (submit-path demotions) there is
         # no sample in flight to void
@@ -984,14 +1247,27 @@ class MeshEngine:
         # stream), re-promoting would thrash a full upload+dump round
         # trip every cool-down period for zero device windows
         head = [self._full_blocks[0][0]] if self._full_blocks else []
-        if head and self._dev.pack_window(head) is None:
+        if head and self._dev.pack_mixed_window(head) is None:
+            # mixed packer: SET, GET and interleaved heads all run
+            # in-lane now; only genuinely out-of-envelope work declines
             self._dev_cooldown = self._dev_repromote
             return
-        if self._dev.upload_from(self.sms[0]):
+        seed_epoch: dict = {}
+        if self._dev.upload_from(self.sms[0], seed_cache=seed_epoch):
+            self._dev_seed = seed_epoch
             self._dev_sver[:] = 0
             sv = self.sms[0].store.shard_version[: self.n_shards]
             self._dev_sver[: self.n_shards] = sv
-            self._dev_spec = None
+            # versions at or below the promotion snapshot resolve via
+            # the seed (just refilled with the uploaded content);
+            # versions assigned by the host DURING the demotion that
+            # were overwritten before re-promotion are unreachable
+            np.maximum(
+                self._dev_floor[: self.n_shards],
+                sv.astype(np.int64),
+                out=self._dev_floor[: self.n_shards],
+            )
+            self._dev_reindex_seed()
             self._dev_active = True
             self._lat_invalidate |= self._lat_timing  # upload, not latency
             logger.info("device KV lane re-promoted from host stores")
@@ -1375,7 +1651,11 @@ class MeshEngine:
         return total
 
     def _has_pending(self) -> bool:
-        return bool(self._queued_entries or self._full_blocks)
+        return bool(
+            self._queued_entries
+            or self._full_blocks
+            or (self._dev is not None and self._dev_pipe)
+        )
 
     # -- checkpoint / restore ------------------------------------------------
 
@@ -1384,6 +1664,8 @@ class MeshEngine:
         (the transport engine's PersistedEngineState, same shape)."""
         from rabia_tpu.core.persistence import PersistedEngineState
 
+        if self._dev_active:
+            self._dev_drain_pipe()  # snapshot the SETTLED table
         if self._dev_active:
             # the device table is authoritative in device mode: reflect
             # it into the host replicas so the snapshot below sees it

@@ -25,7 +25,7 @@ def _mk(n_shards, device: bool, **kw):
         n_shards=n_shards,
         n_replicas=3,
         mesh=make_mesh(),
-        window=4,
+        window=kw.pop("window", 4),
         device_store=device,
         **kw,
     )
@@ -472,6 +472,192 @@ class TestDeviceGetWindows:
         for sm in dev.sms:
             assert _store_content(sm, n) == want
         del rng
+
+    def test_get_values_resolve_host_side(self):
+        # steady state: GET frames come from the host-retained SET
+        # segments via a SNAPSHOT resolver (meta-only readback), never
+        # the value planes — and the snapshot survives later evictions
+        from rabia_tpu.apps.device_kv import ResolvedGetFrameGroups
+
+        n = 4
+        dev = _mk(n, device=True)
+        dev.submit_block(
+            build_block(
+                list(range(n)),
+                [[encode_set_bin(f"k{s}", f"val{s}")] for s in range(n)],
+            )
+        )
+        f = dev.submit_block(
+            build_block(
+                list(range(n)), [[self._enc_get(f"k{s}")] for s in range(n)]
+            )
+        )
+        dev.flush()
+        assert isinstance(f._results, ResolvedGetFrameGroups)
+        # evict every retained segment AFTER settlement: the settled
+        # view's snapshot must still resolve (round-5 review finding)
+        dev._dev_vseg.clear()
+        dev._dev_vseg_bytes = 0
+        frames = [list(map(bytes, g)) for g in f.result()]
+        # version 1, found, value text round-trips
+        for s, fr in enumerate(frames):
+            assert f"val{s}".encode() in fr[0]
+
+    def test_evicted_segment_falls_back_to_value_download(self):
+        n = 4
+        dev = _mk(n, device=True)
+        host = _mk(n, device=False)
+        dev._dev_vseg_cap = 1  # evict every segment immediately
+        for e in (dev, host):
+            for w in range(3):
+                e.submit_block(
+                    build_block(
+                        list(range(n)),
+                        [
+                            [encode_set_bin(f"k{s}", f"w{w}")]
+                            for s in range(n)
+                        ],
+                    )
+                )
+                e.flush()  # one window (= one segment) per block
+        fd = dev.submit_block(
+            build_block(
+                list(range(n)), [[self._enc_get(f"k{s}")] for s in range(n)]
+            )
+        )
+        fh = host.submit_block(
+            build_block(
+                list(range(n)), [[self._enc_get(f"k{s}")] for s in range(n)]
+            )
+        )
+        dev.flush()
+        host.flush()
+        assert dev._dev_active
+        assert bool((dev._dev_floor[:n] > 0).any())  # evictions happened
+        assert [list(map(bytes, g)) for g in fd.result()] == [
+            list(map(bytes, g)) for g in fh.result()
+        ]
+
+    def test_repromotion_seed_resolves_old_versions(self):
+        n = 4
+        dev = _mk(n, device=True, device_store_repromote=1)
+        host = _mk(n, device=False)
+        for e in (dev, host):
+            e.submit_block(
+                build_block(
+                    list(range(n)),
+                    [[encode_set_bin(f"k{s}", f"old{s}")] for s in range(n)],
+                )
+            )
+            e.flush()
+        # force a demotion (DEL is outside the lane envelope)
+        import struct
+
+        enc_del = lambda k: bytes([3]) + struct.pack("<H", len(k)) + k.encode()
+        for e in (dev, host):
+            e.submit_block(
+                build_block(
+                    list(range(n)), [[enc_del("nope")] for s in range(n)]
+                )
+            )
+            e.flush()
+        assert not dev._dev_active
+        # re-promote (cooldown 1), then GET the PRE-promotion version:
+        # it must resolve from the seed, byte-identical to the host path
+        for e in (dev, host):
+            e.submit_block(
+                build_block(
+                    list(range(n)),
+                    [[encode_set_bin("other", "x")] for s in range(n)],
+                )
+            )
+            e.flush()
+        assert dev._dev_active  # re-promoted
+        fd = dev.submit_block(
+            build_block(
+                list(range(n)), [[self._enc_get(f"k{s}")] for s in range(n)]
+            )
+        )
+        fh = host.submit_block(
+            build_block(
+                list(range(n)), [[self._enc_get(f"k{s}")] for s in range(n)]
+            )
+        )
+        dev.flush()
+        host.flush()
+        assert dev._dev_active
+        assert [list(map(bytes, g)) for g in fd.result()] == [
+            list(map(bytes, g)) for g in fh.result()
+        ]
+
+    def test_dict_upload_engages_and_conforms(self):
+        # repetitive SET streams take the dictionary-compressed upload
+        # (a _DictSeg lands in the value segments); responses and final
+        # content stay identical to the host path
+        from rabia_tpu.parallel.mesh_engine import _DictSeg
+
+        n = 4
+        dev = _mk(n, device=True)
+        host = _mk(n, device=False)
+        for e in (dev, host):
+            for w in range(3):
+                e.submit_block(
+                    build_block(
+                        list(range(n)),
+                        [
+                            [encode_set_bin(f"k{s % 2}", f"v{w % 2}")]
+                            for s in range(n)
+                        ],
+                    )
+                )
+            e.flush()  # pure-SET window: the dict upload path
+            fd = e.submit_block(
+                build_block(
+                    list(range(n)),
+                    [[self._enc_get("k0")] for s in range(n)],
+                )
+            )
+            e.flush()
+            if e is dev:
+                dev_get = fd
+            else:
+                host_get = fd
+        assert dev._dev_active
+        assert any(isinstance(sg, _DictSeg) for sg in dev._dev_vseg)
+        assert [list(map(bytes, g)) for g in dev_get.result()] == [
+            list(map(bytes, g)) for g in host_get.result()
+        ]
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+
+    def test_high_cardinality_window_falls_back_to_rows(self):
+        # >32 distinct (key, value) rows per shard in one window: the
+        # dictionary declines (max_dict) and the row-packed path runs
+        from rabia_tpu.parallel.mesh_engine import _RowSeg
+
+        n = 2
+        dev = _mk(n, device=True, window=40)
+        host = _mk(n, device=False, window=40)
+        for e in (dev, host):
+            for w in range(40):
+                e.submit_block(
+                    build_block(
+                        list(range(n)),
+                        [
+                            [encode_set_bin(f"k{w}", f"v{w}")]
+                            for s in range(n)
+                        ],
+                    )
+                )
+            e.flush()
+        assert dev._dev_active
+        assert any(isinstance(sg, _RowSeg) for sg in dev._dev_vseg)
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
 
     def test_long_key_get_demotes_byte_identical(self):
         n = 4
