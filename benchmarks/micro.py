@@ -19,6 +19,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+import numpy as np
+
 from rabia_tpu.core.batching import CommandBatcher
 from rabia_tpu.core.config import BatchConfig
 from rabia_tpu.core.messages import (
@@ -103,6 +105,43 @@ def bench_serialization_comparison() -> dict:
         out["native_speedup_small"] = round(
             out["binary_small_roundtrips_per_sec"]
             / out["binary_py_small_roundtrips_per_sec"],
+            2,
+        )
+    # snapshot recovery frame (SyncResponse, VERDICT r04 next-#8): a
+    # multi-MB KV snapshot through the codec, native vs Python, at the
+    # engine's production compression threshold — records whether
+    # recovery could ever be codec-bound
+    from rabia_tpu.core.messages import SyncResponse
+    from rabia_tpu.core.serialization import SerializationConfig
+
+    rng = np.random.default_rng(11)
+    snap = (
+        rng.integers(0, 64, 4 << 20).astype(np.uint8).tobytes()
+    )  # 4MB, ~zipfian-ish entropy: compresses but not trivially
+    sync = ProtocolMessage.new(
+        node,
+        SyncResponse(
+            responder_phase=1000,
+            state_version=5000,
+            snapshot=snap,
+            per_shard_phase=tuple(range(4096)),
+            applied_ids=(),
+            per_shard_version=tuple(range(4096)),
+        ),
+    )
+    comp = BinarySerializer(SerializationConfig(compression_threshold=4096))
+    blob = comp.serialize(sync)
+    out["syncresp_4mb_wire_bytes"] = len(blob)
+    out["syncresp_4mb_roundtrips_per_sec"] = _timeit(
+        lambda: comp.deserialize(comp.serialize(sync)), 10
+    )
+    if comp._native is not None:
+        out["syncresp_py_4mb_roundtrips_per_sec"] = _timeit(
+            lambda: comp._deserialize_py(comp._serialize_py(sync)), 10
+        )
+        out["syncresp_native_speedup"] = round(
+            out["syncresp_4mb_roundtrips_per_sec"]
+            / out["syncresp_py_4mb_roundtrips_per_sec"],
             2,
         )
     # the reference asserts binary strictly smaller (serialization.rs:259-276)

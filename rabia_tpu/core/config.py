@@ -151,6 +151,15 @@ class KernelConfig:
     # paying the host->device round trip per stage transition. 3 covers
     # the open->cast->decide cascade; 1 restores per-round stepping.
     device_substeps: int = 3
+    # "jax" backend only: hand the engine's inbox vote planes to the
+    # device via dlpack adoption instead of jnp.asarray's copy — on a
+    # CPU/directly-attached backend the device consumes the host buffer
+    # with ZERO copies (pointer identity pinned in
+    # tests/test_zero_copy.py); on any other backend it is the source of
+    # the single H2D DMA physically required. Requires the plane reset
+    # to wait for the tick's fetch (the engine handles this); off by
+    # default because the tunneled deployment shape gains nothing.
+    zero_copy_inbox: bool = False
 
     @property
     def padded_shards(self) -> int:

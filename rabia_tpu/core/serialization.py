@@ -492,6 +492,7 @@ def _native_codec():
                 Command=Command,
                 ShardId=ShardId,
                 StateValue=StateValue,
+                SyncResponse=SyncResponse,
             )
             _NATIVE_CODEC = mod
     return _NATIVE_CODEC
@@ -562,9 +563,14 @@ class BinarySerializer:
 
     def deserialize(self, data: bytes) -> ProtocolMessage:
         if self._native is not None:
-            msg = self._native.decode(data)
+            msg = self._native.decode(data)  # any buffer: bytes/memoryview
             if msg is not None:
                 return msg
+        # the Python reader slices, hashes and frombuffers — it needs a
+        # real bytes object (zero-copy borrowed frames arrive as
+        # memoryviews over the transport arena)
+        if not isinstance(data, bytes):
+            data = bytes(data)
         return self._deserialize_py(data)
 
     def _deserialize_py(self, data: bytes) -> ProtocolMessage:
@@ -720,7 +726,10 @@ class Serializer:
         """
         try:
             if data[:1] == b"{":
-                return self._json.deserialize(data)
+                # json.loads rejects memoryviews (zero-copy recv frames)
+                return self._json.deserialize(
+                    data if isinstance(data, bytes) else bytes(data)
+                )
             return self._binary.deserialize(data)
         except SerializationError:
             raise

@@ -106,6 +106,17 @@ _MAX_SUBMIT_ATTEMPTS = 3
 _MVC_MASK = (1 << 16) - 1
 
 
+def _aligned_i8(shape, fill: int, align: int = 64) -> np.ndarray:
+    """An i8 array on a 64-byte-aligned base: XLA's CPU client adopts
+    aligned external buffers zero-copy via dlpack; unaligned ones get a
+    defensive copy (which would silently defeat zero_copy_inbox on the
+    small shard counts whose numpy allocations aren't page-backed)."""
+    n = int(np.prod(shape))
+    raw = np.full(n + align, np.int8(fill), np.int8)
+    off = (-raw.ctypes.data) % align
+    return raw[off : off + n].reshape(shape)
+
+
 class _OutBlock:
     """Proposer-side pending block: aggregates per-shard outcomes into one
     client future (one response list — or Exception — per covered shard)."""
@@ -230,6 +241,7 @@ class RabiaEngine:
         seed = self.config.randomization_seed or 0
         self._host_kernel = kc.backend != "jax"
         self._substeps = max(1, int(kc.device_substeps))
+        self._zc_inbox = bool(kc.zero_copy_inbox) and not self._host_kernel
         if not self._host_kernel:
             # fenced: the device-array engine backend is for DIRECTLY-
             # ATTACHED accelerators; on tunneled hardware the per-tick
@@ -267,10 +279,12 @@ class RabiaEngine:
         self._carry1: list[tuple] = []
         self._carry2: list[tuple] = []
         # adopted-decision plane consumed by the next node_step
-        self._dec_plane = np.full(self.S, ABSENT, np.int8)
+        # (64-byte-aligned so zero_copy_inbox adoption is actually
+        # zero-copy — see _aligned_i8)
+        self._dec_plane = _aligned_i8(self.S, ABSENT)
         if not self._host_kernel:
-            self._inbox1 = np.full((self.S, self.R), ABSENT, np.int8)
-            self._inbox2 = np.full((self.S, self.R), ABSENT, np.int8)
+            self._inbox1 = _aligned_i8((self.S, self.R), ABSENT)
+            self._inbox2 = _aligned_i8((self.S, self.R), ABSENT)
         self._shard_ids = np.arange(self.S, dtype=np.int64)
         self._apply_dirty: set[int] = set()
         # native columnar helpers (hostkernel.cpp); None -> numpy paths
@@ -659,22 +673,42 @@ class RabiaEngine:
     # -- inbound ------------------------------------------------------------
 
     async def _drain_messages(self, cap: int = 256) -> int:
-        """Drain up to `cap` inbound messages (engine.rs:923-947)."""
+        """Drain up to `cap` inbound messages (engine.rs:923-947).
+
+        When the transport offers borrowed (zero-copy) frames, the codec
+        decodes straight out of the native arena — no bytes-object copy
+        per frame (SURVEY §7.4.7); the buffer is released immediately
+        after decode, before the message is handled."""
         n = 0
+        recv_borrow = getattr(
+            self.transport, "receive_borrowed_nowait", None
+        )
         recv_nowait = getattr(self.transport, "receive_nowait", None)
         while n < cap:
-            if recv_nowait is not None:
+            release = None
+            if recv_borrow is not None:
+                item = recv_borrow()
+                if item is None:
+                    break
+                sender, data, release = item
+            elif recv_nowait is not None:
                 item = recv_nowait()
                 if item is None:
                     break
+                sender, data = item
             else:
                 try:
-                    item = await self.transport.receive(timeout=0.0005)
+                    sender, data = await self.transport.receive(
+                        timeout=0.0005
+                    )
                 except RabiaError:
                     break
-            sender, data = item
             try:
-                msg = self.serializer.deserialize(data)
+                try:
+                    msg = self.serializer.deserialize(data)
+                finally:
+                    if release is not None:
+                        release()
                 self.validator.validate_message(msg)
                 self._handle_message(sender, msg)
                 n += 1
@@ -1623,22 +1657,46 @@ class RabiaEngine:
             slots_full = np.zeros(self.S, np.int64)
             init_full = np.full(self.S, V0, np.int8)
         with span("engine.kernel.step"):
+            if self._zc_inbox:
+                # dlpack adoption: the device consumes the host inbox
+                # planes in place — zero copies on a CPU/directly-
+                # attached backend (pointer identity pinned in
+                # tests/test_zero_copy.py), ONE H2D DMA elsewhere. The
+                # planes must stay untouched until the tick's fetch
+                # below forces completion; the resets move after it.
+                ib1 = jax.dlpack.from_dlpack(self._inbox1)
+                ib2 = jax.dlpack.from_dlpack(self._inbox2)
+                dec = jax.dlpack.from_dlpack(self._dec_plane)
+            else:
+                ib1 = jnp.asarray(self._inbox1)
+                ib2 = jnp.asarray(self._inbox2)
+                dec = jnp.asarray(self._dec_plane)
             self.kstate, outboxes = self.kernel.node_cycle(
                 self.kstate,
                 jnp.asarray(mask),
                 jnp.asarray(slots_full.astype(np.int32)),
                 jnp.asarray(init_full),
-                jnp.asarray(self._inbox1),
-                jnp.asarray(self._inbox2),
-                jnp.asarray(self._dec_plane),
+                ib1,
+                ib2,
+                dec,
                 self._substeps,
             )
-            self._inbox1.fill(ABSENT)
-            self._inbox2.fill(ABSENT)
-        adopted = self._dec_plane != ABSENT
-        self._dec_plane.fill(ABSENT)
+            if not self._zc_inbox:
+                self._inbox1.fill(ABSENT)
+                self._inbox2.fill(ABSENT)
+        if not self._zc_inbox:
+            adopted = self._dec_plane != ABSENT
+            self._dec_plane.fill(ABSENT)
         with span("engine.kernel.fetch"):
             st_np, ob_np = jax.device_get((self.kstate, outboxes))
+        if self._zc_inbox:
+            # fetch completed => node_cycle consumed the adopted planes;
+            # only now may the host mutate them for the next tick
+            del ib1, ib2, dec
+            adopted = self._dec_plane != ABSENT
+            self._inbox1.fill(ABSENT)
+            self._inbox2.fill(ABSENT)
+            self._dec_plane.fill(ABSENT)
         self._set_mirrors(st_np)
         with span("engine.kernel.outbox"):
             self._process_outbox_window(ob_np, prev_phase, adopted)

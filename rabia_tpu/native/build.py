@@ -45,7 +45,7 @@ def lib_path() -> Path:
 
 def _compile(
     src: Path, target: Path, extra_args: list[str], stale_glob: str,
-    what: str,
+    what: str, link_args: list[str] | None = None,
 ) -> None:
     # compile to a private temp path, then atomically rename: an
     # interrupted or concurrent build (the lock is per-process only) must
@@ -62,6 +62,9 @@ def _compile(
         str(src),
         "-o",
         str(tmp),
+        # libraries must follow the objects that use them (GNU ld
+        # resolves left to right)
+        *(link_args or []),
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
@@ -102,6 +105,7 @@ def _build_codec(target: Path) -> None:
         ],
         "_codec_*.so",
         "codec",
+        link_args=["-lz"],  # SyncResponse snapshot (de)compression
     )
 
 
@@ -222,11 +226,11 @@ def load_library() -> ctypes.CDLL:
             # the newest exported symbol so a stale .so fails fast with a
             # clear message instead of a cryptic AttributeError later
             try:
-                lib.rt_pool_stats
+                lib.rt_recv_borrow
             except AttributeError:
                 raise InternalError(
                     f"RABIA_NATIVE_LIB library {prebuilt} is stale "
-                    "(missing rt_pool_stats); rebuild it from transport.cpp"
+                    "(missing rt_recv_borrow); rebuild it from transport.cpp"
                 ) from None
 
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -267,6 +271,16 @@ def load_library() -> ctypes.CDLL:
             ctypes.c_uint32,
             ctypes.c_int,
         ]
+        lib.rt_recv_borrow.restype = ctypes.c_int64
+        lib.rt_recv_borrow.argtypes = [
+            ctypes.c_void_p,
+            u8p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int,
+        ]
+        lib.rt_recv_release.restype = None
+        lib.rt_recv_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.rt_connected.restype = ctypes.c_int
         lib.rt_connected.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int]
         lib.rt_port.restype = ctypes.c_uint16

@@ -6,10 +6,11 @@
 // SAME wire format as rabia_tpu/core/serialization.py (version 3,
 // hand-rolled little-endian) for the latency-critical frame types —
 // VoteRound1/VoteRound2 (packed vote vectors), Decision, Propose and
-// NewBatch (command batches), ProposeBlock, HeartBeat, SyncRequest — and
-// returns None for everything else so the Python codec remains the
-// semantics owner and fallback. Byte-for-byte compatibility is pinned by
-// tests/test_native_codec.py.
+// NewBatch (command batches), ProposeBlock, HeartBeat, SyncRequest, and
+// SyncResponse (the recovery/snapshot frame, incl. its zlib-level-1 body
+// compression) — and returns None for everything else so the Python
+// codec remains the semantics owner and fallback. Byte-for-byte
+// compatibility is pinned by tests/test_native_codec.py.
 //
 // Built as a CPython extension (not ctypes): the cost of the Python
 // codec is object construction and bytecode, not byte shuffling, so the
@@ -21,6 +22,8 @@
 #define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
 #define PY_ARRAY_UNIQUE_SYMBOL rabia_codec_ARRAY_API
 #include <numpy/arrayobject.h>
+
+#include <zlib.h>
 
 #include <cstdint>
 #include <cstring>
@@ -46,6 +49,7 @@ constexpr uint8_t MT_VOTE1 = 2;
 constexpr uint8_t MT_VOTE2 = 3;
 constexpr uint8_t MT_DECISION = 4;
 constexpr uint8_t MT_SYNCREQ = 5;
+constexpr uint8_t MT_SYNCRESP = 6;
 constexpr uint8_t MT_NEWBATCH = 7;
 constexpr uint8_t MT_HEARTBEAT = 8;
 constexpr uint8_t MT_PROPOSE_BLOCK = 10;
@@ -57,6 +61,7 @@ PyObject* g_VoteRound2 = nullptr;
 PyObject* g_Decision = nullptr;
 PyObject* g_HeartBeat = nullptr;
 PyObject* g_SyncRequest = nullptr;
+PyObject* g_SyncResponse = nullptr;
 PyObject* g_ProposeBlock = nullptr;
 PyObject* g_PayloadBlock = nullptr;
 PyObject* g_NodeId = nullptr;
@@ -83,6 +88,8 @@ PyObject* s_block; PyObject* s_slots; PyObject* s_counts; PyObject* s_cmd_sizes;
 PyObject* s_data; PyObject* s_total_commands;
 PyObject* s_shard; PyObject* s_phase; PyObject* s_batch_id; PyObject* s_batch;
 PyObject* s_commands;
+PyObject* s_responder_phase; PyObject* s_snapshot; PyObject* s_per_shard_phase;
+PyObject* s_applied_ids; PyObject* s_per_shard_version;
 
 inline void wr_u32(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
 inline void wr_u64(uint8_t* p, uint64_t v) { memcpy(p, &v, 8); }
@@ -399,6 +406,104 @@ bool encode_block(Buf& b, PyObject* payload) {
   Py_XDECREF(bid); Py_XDECREF(sh); Py_XDECREF(sl); Py_XDECREF(ct);
   Py_XDECREF(cs); Py_XDECREF(data); Py_XDECREF(tot); Py_DECREF(blk);
   return ok;
+}
+
+bool u64_attr_val(PyObject* obj, PyObject* name, uint64_t* out);
+
+// SyncResponse body (serialization.py _encode_payload SyncResponse
+// branch): u64 responder_phase, u64 state_version, u8 has_snapshot
+// [+ u32 len + bytes], u32 n + n*u64 per_shard_phase, u32 n + n*(u32
+// shard, 16B batch uuid) applied_ids, u32 n + n*u64 per_shard_version.
+// The recovery frame of rabia-core/src/serialization.rs:22-63 (uniform
+// codec over every message type incl. snapshots). Any shape surprise
+// sets *decline (Python codec owns the frame; its error surfaces
+// unchanged) rather than raising here.
+bool syncresp_u64_seq(Buf& b, PyObject* payload, PyObject* name,
+                      bool* decline) {
+  PyObject* seq = PyObject_GetAttr(payload, name);
+  if (!seq) { PyErr_Clear(); *decline = true; return false; }
+  PyObject* fast = PySequence_Fast(seq, "");
+  Py_DECREF(seq);
+  if (!fast) { PyErr_Clear(); *decline = true; return false; }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  bool ok = b.put_u32((uint32_t)n);
+  for (Py_ssize_t i = 0; ok && i < n; i++) {
+    PyObject* v = PySequence_Fast_GET_ITEM(fast, i);
+    PyObject* ix = PyNumber_Index(v);
+    if (!ix) { PyErr_Clear(); *decline = true; ok = false; break; }
+    uint64_t x = PyLong_AsUnsignedLongLong(ix);
+    Py_DECREF(ix);
+    if (x == (uint64_t)-1 && PyErr_Occurred()) {
+      PyErr_Clear(); *decline = true; ok = false; break;
+    }
+    ok = b.put_u64(x);
+  }
+  Py_DECREF(fast);
+  return ok;
+}
+
+bool encode_syncresp(Buf& b, PyObject* payload, bool* decline) {
+  uint64_t rp, sv;
+  if (!u64_attr_val(payload, s_responder_phase, &rp) ||
+      !u64_attr_val(payload, s_state_version, &sv)) {
+    PyErr_Clear(); *decline = true; return false;
+  }
+  if (!b.put_u64(rp) || !b.put_u64(sv)) return false;
+  PyObject* snap = PyObject_GetAttr(payload, s_snapshot);
+  if (!snap) { PyErr_Clear(); *decline = true; return false; }
+  bool ok;
+  if (snap == Py_None) {
+    ok = b.put_u8(0);
+  } else if (PyBytes_Check(snap)) {
+    Py_ssize_t n = PyBytes_GET_SIZE(snap);
+    if ((uint64_t)n > 0xFFFFFFFFull) {
+      // a >4GiB snapshot overflows the u32 length prefix: the Python
+      // writer raises there — decline so it does, never truncate
+      Py_DECREF(snap);
+      *decline = true;
+      return false;
+    }
+    ok = b.put_u8(1) && b.put_u32((uint32_t)n) &&
+         b.put_raw(PyBytes_AS_STRING(snap), (size_t)n);
+  } else {
+    *decline = true; ok = false;  // bytearray/memoryview: Python path
+  }
+  Py_DECREF(snap);
+  if (!ok) return false;
+  if (!syncresp_u64_seq(b, payload, s_per_shard_phase, decline))
+    return false;
+  PyObject* ids = PyObject_GetAttr(payload, s_applied_ids);
+  if (!ids) { PyErr_Clear(); *decline = true; return false; }
+  PyObject* fast = PySequence_Fast(ids, "");
+  Py_DECREF(ids);
+  if (!fast) { PyErr_Clear(); *decline = true; return false; }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  ok = b.put_u32((uint32_t)n);
+  for (Py_ssize_t i = 0; ok && i < n; i++) {
+    PyObject* pair = PySequence_Fast_GET_ITEM(fast, i);
+    PyObject* pf = PySequence_Fast(pair, "");
+    if (!pf || PySequence_Fast_GET_SIZE(pf) != 2) {
+      Py_XDECREF(pf); PyErr_Clear(); *decline = true; ok = false; break;
+    }
+    PyObject* ix = PyNumber_Index(PySequence_Fast_GET_ITEM(pf, 0));
+    uint64_t shard = ix ? PyLong_AsUnsignedLongLong(ix) : (uint64_t)-1;
+    Py_XDECREF(ix);
+    if (!ix || (shard == (uint64_t)-1 && PyErr_Occurred()) ||
+        shard > 0xFFFFFFFFull) {
+      Py_DECREF(pf); PyErr_Clear(); *decline = true; ok = false; break;
+    }
+    PyObject* bid = PySequence_Fast_GET_ITEM(pf, 1);
+    PyObject* val = PyObject_GetAttr(bid, s_value);
+    uint8_t raw[16];
+    bool got = val && uuid_bytes(val, raw);
+    Py_XDECREF(val);
+    Py_DECREF(pf);
+    if (!got) { PyErr_Clear(); *decline = true; ok = false; break; }
+    ok = b.put_u32((uint32_t)shard) && b.put_raw(raw, 16);
+  }
+  Py_DECREF(fast);
+  if (!ok) return false;
+  return syncresp_u64_seq(b, payload, s_per_shard_version, decline);
 }
 
 // u32/u64 from an int-like attribute (plain int, numpy integer, IntEnum).
@@ -723,6 +828,143 @@ PyObject* decode_decision(Rd& r) {
   return obj;
 }
 
+// SyncResponse payload from a (decompressed) body reader
+PyObject* decode_syncresp(Rd& r) {
+  const uint8_t* q = r.take(17);  // u64 + u64 + u8 has_snapshot
+  if (!q) return nullptr;
+  PyObject* rp = PyLong_FromUnsignedLongLong(rd_u64(q));
+  PyObject* sv = PyLong_FromUnsignedLongLong(rd_u64(q + 8));
+  PyObject* snap = nullptr;
+  PyObject *psp = nullptr, *ids = nullptr, *psv = nullptr;
+  PyObject* obj = nullptr;
+  do {
+    if (!rp || !sv) break;
+    if (q[16]) {
+      const uint8_t* ln = r.take(4);
+      if (!ln) break;
+      uint32_t n = rd_u32(ln);
+      const uint8_t* raw = r.take(n);
+      if (!raw) break;
+      snap = PyBytes_FromStringAndSize((const char*)raw, n);
+    } else {
+      snap = Py_None;
+      Py_INCREF(Py_None);
+    }
+    if (!snap) break;
+    // two u64 tuple sections + the (u32, uuid) applied_ids between them
+    auto u64_tuple = [&r]() -> PyObject* {
+      const uint8_t* ln = r.take(4);
+      if (!ln) return nullptr;
+      uint32_t n = rd_u32(ln);
+      const uint8_t* raw = r.take((size_t)n * 8);
+      if (!raw) return nullptr;
+      PyObject* t = PyTuple_New(n);
+      if (!t) return nullptr;
+      for (uint32_t i = 0; i < n; i++) {
+        PyObject* v = PyLong_FromUnsignedLongLong(rd_u64(raw + (size_t)i * 8));
+        if (!v) { Py_DECREF(t); return nullptr; }
+        PyTuple_SET_ITEM(t, i, v);
+      }
+      return t;
+    };
+    psp = u64_tuple();
+    if (!psp) break;
+    const uint8_t* ln = r.take(4);
+    if (!ln) break;
+    uint32_t n_ids = rd_u32(ln);
+    const uint8_t* raw = r.take((size_t)n_ids * 20);
+    if (!raw) break;
+    ids = PyTuple_New(n_ids);
+    if (!ids) break;
+    bool bad = false;
+    for (uint32_t i = 0; i < n_ids; i++) {
+      const uint8_t* e = raw + (size_t)i * 20;
+      PyObject* shard = PyLong_FromUnsignedLong(rd_u32(e));
+      PyObject* u = shard ? make_uuid(e + 4) : nullptr;
+      PyObject* bid = u ? raw_new(g_BatchId) : nullptr;
+      if (!bid || raw_set(bid, s_value, u) < 0) {
+        Py_XDECREF(bid); Py_XDECREF(u); Py_XDECREF(shard);
+        bad = true; break;
+      }
+      Py_DECREF(u);
+      PyObject* pair = PyTuple_New(2);
+      if (!pair) {
+        Py_DECREF(bid); Py_DECREF(shard);
+        bad = true; break;
+      }
+      PyTuple_SET_ITEM(pair, 0, shard);  // steals
+      PyTuple_SET_ITEM(pair, 1, bid);
+      PyTuple_SET_ITEM(ids, i, pair);
+    }
+    if (bad) break;
+    psv = u64_tuple();
+    if (!psv) break;
+    obj = raw_new(g_SyncResponse);
+    if (!obj || raw_set(obj, s_responder_phase, rp) < 0 ||
+        raw_set(obj, s_state_version, sv) < 0 ||
+        raw_set(obj, s_snapshot, snap) < 0 ||
+        raw_set(obj, s_per_shard_phase, psp) < 0 ||
+        raw_set(obj, s_applied_ids, ids) < 0 ||
+        raw_set(obj, s_per_shard_version, psv) < 0) {
+      Py_XDECREF(obj);
+      obj = nullptr;
+      break;
+    }
+  } while (false);
+  Py_XDECREF(rp); Py_XDECREF(sv); Py_XDECREF(snap);
+  Py_XDECREF(psp); Py_XDECREF(ids); Py_XDECREF(psv);
+  return obj;
+}
+
+// zlib-inflate a compressed body into a PyMem buffer (size unknown up
+// front — snapshots compress 10x+; grow geometrically like Python's
+// zlib.decompress). Returns nullptr with SerializationError set.
+uint8_t* inflate_body(const uint8_t* src, size_t n, size_t* out_len) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (inflateInit(&zs) != Z_OK) {
+    PyErr_SetString(g_SerializationError, "decompression failed: init");
+    return nullptr;
+  }
+  size_t cap = n * 4 + 256;
+  uint8_t* out = (uint8_t*)PyMem_Malloc(cap);
+  if (!out) { inflateEnd(&zs); PyErr_NoMemory(); return nullptr; }
+  zs.next_in = (Bytef*)src;
+  zs.avail_in = (uInt)n;
+  size_t have = 0;
+  int rc;
+  do {
+    if (have == cap) {
+      cap *= 2;
+      uint8_t* np = (uint8_t*)PyMem_Realloc(out, cap);
+      if (!np) { PyMem_Free(out); inflateEnd(&zs); PyErr_NoMemory(); return nullptr; }
+      out = np;
+    }
+    zs.next_out = out + have;
+    zs.avail_out = (uInt)(cap - have);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    have = zs.total_out;
+    if (rc != Z_OK && rc != Z_STREAM_END && rc != Z_BUF_ERROR) {
+      PyMem_Free(out);
+      inflateEnd(&zs);
+      PyErr_Format(g_SerializationError, "decompression failed: %s",
+                   zs.msg ? zs.msg : "corrupt stream");
+      return nullptr;
+    }
+    if (rc == Z_BUF_ERROR && zs.avail_in == 0 && zs.avail_out > 0) {
+      // truncated compressed data
+      PyMem_Free(out);
+      inflateEnd(&zs);
+      PyErr_SetString(g_SerializationError,
+                      "decompression failed: incomplete stream");
+      return nullptr;
+    }
+  } while (rc != Z_STREAM_END);
+  inflateEnd(&zs);
+  *out_len = have;
+  return out;
+}
+
 // frozen-dataclass carrier with two u64 fields (HeartBeat / SyncRequest)
 PyObject* decode_two_u64(Rd& r, PyObject* cls, PyObject* f1, PyObject* f2) {
   const uint8_t* q = r.take(16);
@@ -972,6 +1214,7 @@ PyObject* codec_encode(PyObject*, PyObject* args) {
   else if (pt == (PyTypeObject*)g_Decision) mt = MT_DECISION;
   else if (pt == (PyTypeObject*)g_HeartBeat) mt = MT_HEARTBEAT;
   else if (pt == (PyTypeObject*)g_SyncRequest) mt = MT_SYNCREQ;
+  else if (pt == (PyTypeObject*)g_SyncResponse) mt = MT_SYNCRESP;
   else if (pt == (PyTypeObject*)g_ProposeBlock) mt = MT_PROPOSE_BLOCK;
   else if (pt == (PyTypeObject*)g_Propose) mt = MT_PROPOSE;
   else if (pt == (PyTypeObject*)g_NewBatch) mt = MT_NEWBATCH;
@@ -1014,6 +1257,7 @@ PyObject* codec_encode(PyObject*, PyObject* args) {
     }
   }
 
+  bool decline = false;  // shape surprise: Python codec owns the frame
   PyObject* mid = PyObject_GetAttr(msg, s_id);
   PyObject* sender = mid ? PyObject_GetAttr(msg, s_sender) : nullptr;
   PyObject* recipient = sender ? PyObject_GetAttr(msg, s_recipient) : nullptr;
@@ -1058,20 +1302,49 @@ PyObject* codec_encode(PyObject*, PyObject* args) {
             ok = put_u64_attr(body, payload, s_current_phase) &&
                  put_u64_attr(body, payload, s_state_version);
             break;
+          case MT_SYNCRESP:
+            ok = encode_syncresp(body, payload, &decline);
+            break;
           case MT_PROPOSE_BLOCK: ok = encode_block(body, payload); break;
         }
-        ok = ok && env.put_u32((uint32_t)body.len) &&
-             env.put_raw(body.p, body.len);
-        if (ok)
+        bool body_done = false;
+        if (ok && mt == MT_SYNCRESP && compress_threshold > 0 &&
+            (Py_ssize_t)body.len > compress_threshold) {
+          // same rule as _serialize_py: zlib level 1, keep only if it
+          // actually shrinks (byte parity pinned by test_native_codec)
+          uLongf clen = compressBound((uLong)body.len);
+          uint8_t* cbuf = (uint8_t*)PyMem_Malloc(clen);
+          if (!cbuf) {
+            ok = false;
+            PyErr_NoMemory();
+          } else {
+            if (compress2(cbuf, &clen, body.p, (uLong)body.len, 1) == Z_OK &&
+                (size_t)clen < body.len) {
+              env.p[2] |= FLAG_COMPRESSED;  // flags byte of the envelope
+              ok = env.put_u32((uint32_t)clen) && env.put_raw(cbuf, clen);
+              body_done = true;
+            }
+            PyMem_Free(cbuf);
+          }
+        }
+        if (ok && !body_done)
+          ok = env.put_u32((uint32_t)body.len) &&
+               env.put_raw(body.p, body.len);
+        if (ok && !decline)
           out = PyBytes_FromStringAndSize((const char*)env.p,
                                           (Py_ssize_t)env.len);
       }
-      if (!ok && !PyErr_Occurred())
+      if (!ok && !decline && !PyErr_Occurred())
         PyErr_SetString(g_SerializationError, "native encode failed");
     }
   }
   Py_XDECREF(ts); Py_XDECREF(recipient); Py_XDECREF(sender);
   Py_XDECREF(mid); Py_DECREF(payload);
+  if (decline && !out && !PyErr_Occurred()) {
+    // shape surprise: hand the frame to the Python codec untouched
+    out = Py_None;
+    Py_INCREF(Py_None);
+  }
   return out;
 }
 
@@ -1087,6 +1360,7 @@ PyObject* codec_decode(PyObject*, PyObject* arg) {
   PyObject* payload = nullptr;
   PyObject *mid = nullptr, *sender = nullptr, *recipient = nullptr,
            *tsobj = nullptr;
+  uint8_t* inflated = nullptr;
   do {
     const uint8_t* h = r.take(3);
     if (!h) break;
@@ -1098,9 +1372,9 @@ PyObject* codec_decode(PyObject*, PyObject* arg) {
     }
     bool supported =
         (mt == MT_VOTE1 || mt == MT_VOTE2 || mt == MT_DECISION ||
-         mt == MT_HEARTBEAT || mt == MT_SYNCREQ || mt == MT_PROPOSE_BLOCK ||
-         mt == MT_PROPOSE || mt == MT_NEWBATCH) &&
-        !(flags & FLAG_COMPRESSED);
+         mt == MT_HEARTBEAT || mt == MT_SYNCREQ || mt == MT_SYNCRESP ||
+         mt == MT_PROPOSE_BLOCK || mt == MT_PROPOSE || mt == MT_NEWBATCH) &&
+        (!(flags & FLAG_COMPRESSED) || mt == MT_SYNCRESP);
     if (!supported) {
       // Python codec owns the remaining types / compressed bodies
       result = Py_None;
@@ -1137,6 +1411,12 @@ PyObject* codec_decode(PyObject*, PyObject* arg) {
     const uint8_t* body = r.take(body_len);
     if (!body) break;
     Rd br{body, body_len};
+    if (flags & FLAG_COMPRESSED) {  // only MT_SYNCRESP reaches here
+      size_t ilen = 0;
+      inflated = inflate_body(body, body_len, &ilen);
+      if (!inflated) break;
+      br = Rd{inflated, ilen};
+    }
     switch (mt) {
       case MT_VOTE1: payload = decode_votes(br, g_VoteRound1); break;
       case MT_VOTE2: payload = decode_votes(br, g_VoteRound2); break;
@@ -1149,6 +1429,7 @@ PyObject* codec_decode(PyObject*, PyObject* arg) {
         payload = decode_two_u64(br, g_SyncRequest, s_current_phase,
                                  s_state_version);
         break;
+      case MT_SYNCRESP: payload = decode_syncresp(br); break;
       case MT_PROPOSE_BLOCK: payload = decode_block(br); break;
       case MT_PROPOSE: payload = decode_propose(br); break;
       case MT_NEWBATCH: payload = decode_newbatch(br); break;
@@ -1167,6 +1448,7 @@ PyObject* codec_decode(PyObject*, PyObject* arg) {
   } while (false);
   Py_XDECREF(payload); Py_XDECREF(mid); Py_XDECREF(sender);
   Py_XDECREF(recipient); Py_XDECREF(tsobj);
+  if (inflated) PyMem_Free(inflated);
   PyBuffer_Release(&view);
   return result;
 }
@@ -1177,13 +1459,13 @@ PyObject* codec_bind(PyObject*, PyObject* args, PyObject* kwargs) {
       "HeartBeat", "SyncRequest", "ProposeBlock", "PayloadBlock",
       "NodeId", "BatchId", "UUID", "safe_unknown", "SerializationError",
       "crc32", "Propose", "NewBatch", "CommandBatch", "Command",
-      "ShardId", "StateValue", nullptr};
+      "ShardId", "StateValue", "SyncResponse", nullptr};
   PyObject *pm, *v1, *v2, *dc, *hb, *sr, *pb, *plb, *nid, *bid, *uu, *su,
-      *se, *crc, *pr, *nb, *cb, *cm, *si, *sv;
+      *se, *crc, *pr, *nb, *cb, *cm, *si, *sv, *srp;
   if (!PyArg_ParseTupleAndKeywords(
-          args, kwargs, "OOOOOOOOOOOOOOOOOOOO", (char**)kwlist, &pm, &v1,
+          args, kwargs, "OOOOOOOOOOOOOOOOOOOOO", (char**)kwlist, &pm, &v1,
           &v2, &dc, &hb, &sr, &pb, &plb, &nid, &bid, &uu, &su, &se, &crc,
-          &pr, &nb, &cb, &cm, &si, &sv))
+          &pr, &nb, &cb, &cm, &si, &sv, &srp))
     return nullptr;
 #define BIND(slot, val) Py_XDECREF(slot); Py_INCREF(val); slot = val
   BIND(g_ProtocolMessage, pm); BIND(g_VoteRound1, v1); BIND(g_VoteRound2, v2);
@@ -1193,6 +1475,7 @@ PyObject* codec_bind(PyObject*, PyObject* args, PyObject* kwargs) {
   BIND(g_SerializationError, se); BIND(g_crc32, crc);
   BIND(g_Propose, pr); BIND(g_NewBatch, nb); BIND(g_CommandBatch, cb);
   BIND(g_Command, cm); BIND(g_ShardId, si); BIND(g_StateValue, sv);
+  BIND(g_SyncResponse, srp);
 #undef BIND
   Py_RETURN_NONE;
 }
@@ -1240,6 +1523,10 @@ extern "C" PyMODINIT_FUNC PyInit_rabia_native_codec(void) {
   INTERN(s_shard, "shard"); INTERN(s_phase, "phase");
   INTERN(s_batch_id, "batch_id"); INTERN(s_batch, "batch");
   INTERN(s_commands, "commands");
+  INTERN(s_responder_phase, "responder_phase"); INTERN(s_snapshot, "snapshot");
+  INTERN(s_per_shard_phase, "per_shard_phase");
+  INTERN(s_applied_ids, "applied_ids");
+  INTERN(s_per_shard_version, "per_shard_version");
 #undef INTERN
   return m;
 }

@@ -110,6 +110,20 @@ struct Transport {
   std::deque<InboundMsg> inbox;
   std::condition_variable inbox_cv;
   uint64_t dropped_frames = 0;
+  // Zero-copy recv: frames handed out by rt_recv_borrow are parked here
+  // (keyed by token) so their pooled buffers outlive the C call until
+  // the borrower releases them. std::map: references stay valid across
+  // inserts/erases of other keys.
+  std::map<int64_t, std::vector<uint8_t>> borrowed;
+  int64_t next_borrow_token = 1;
+  // Released tokens are STAGED under this light mutex and reclaimed by
+  // the next rt_recv_borrow (which holds `mu` anyway). rt_recv_release
+  // is called from the engine's event-loop thread once per consumed
+  // frame — taking `mu` there would serialize the consensus tick with
+  // whole io-loop epoll batches (the same reason rt_send stages under
+  // `mu_out` instead of touching `mu`).
+  std::mutex mu_rel;
+  std::vector<int64_t> released;
 
   // Outbound staging: rt_send/rt_broadcast never touch `mu` (the io loop
   // holds it across whole epoll batches, syscalls included — a sending
@@ -624,6 +638,59 @@ int rt_recv(void* h, uint8_t sender_out[16], uint8_t* buf, uint32_t buf_cap,
   memcpy(buf, m.data.data(), n);
   t->pool_put_locked(std::move(m.data));
   return static_cast<int>(n);
+}
+
+// Zero-copy variant of rt_recv: pops one inbound frame and hands out a
+// BORROWED pointer into its pooled buffer — no memcpy (the SURVEY
+// §7.4.7 handoff: the codec and jax.dlpack consume the frame where the
+// io thread landed it). The buffer stays alive, parked in a borrow
+// table, until rt_recv_release(token); releasing returns it to the
+// arena. Returns a token > 0 with *ptr_out/*len_out set, -3 on timeout
+// with no message, -1 if closed.
+int64_t rt_recv_borrow(void* h, uint8_t sender_out[16],
+                       const uint8_t** ptr_out, uint32_t* len_out,
+                       int timeout_ms) {
+  auto* t = static_cast<Transport*>(h);
+  std::vector<int64_t> rel;
+  {
+    std::lock_guard<std::mutex> lr(t->mu_rel);
+    rel.swap(t->released);
+  }
+  std::unique_lock<std::mutex> lk(t->mu);
+  for (int64_t tok : rel) {
+    auto it = t->borrowed.find(tok);
+    if (it != t->borrowed.end()) {
+      t->pool_put_locked(std::move(it->second));
+      t->borrowed.erase(it);
+    }
+  }
+  if (t->inbox.empty() && timeout_ms != 0) {
+    t->inbox_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                         [t] { return !t->inbox.empty() || t->stopping.load(); });
+  }
+  if (t->inbox.empty()) return t->stopping.load() ? -1 : -3;
+  InboundMsg m = std::move(t->inbox.front());
+  t->inbox.pop_front();
+  memcpy(sender_out, m.sender.data(), 16);
+  int64_t tok = t->next_borrow_token++;
+  auto& slot = t->borrowed[tok];
+  slot = std::move(m.data);
+  *ptr_out = slot.data();
+  *len_out = static_cast<uint32_t>(slot.size());
+  return tok;
+}
+
+// Return a borrowed frame's buffer to the arena. Unknown/already-released
+// tokens are ignored (close() may race a late release harmlessly as long
+// as the handle itself is still alive). Deliberately NEVER takes `mu`:
+// the caller is the engine's event-loop thread, and `mu` is held by the
+// io thread across whole epoll batches — the token is staged and the
+// buffer reclaimed by the next rt_recv_borrow. The borrowed frame stays
+// valid until then (reclamation only happens under `mu` in borrow).
+void rt_recv_release(void* h, int64_t token) {
+  auto* t = static_cast<Transport*>(h);
+  std::lock_guard<std::mutex> lr(t->mu_rel);
+  t->released.push_back(token);
 }
 
 // Buffer-arena counters (memory_pool.rs PoolStats analog).

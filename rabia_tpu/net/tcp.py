@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import ctypes
+import os
 import threading
 from typing import Optional
 
@@ -36,6 +37,42 @@ _RECV_BUF_CAP = 16 * 1024 * 1024  # matches the native 16MiB frame cap
 
 def _id_bytes(node: NodeId) -> bytes:
     return node.value.bytes
+
+
+class _BorrowedFrame:
+    """Zero-copy view over an inbound frame still owned by the native
+    transport's buffer arena (SURVEY §7.4.7 handoff, step 0: no memcpy
+    between the io thread's landing buffer and the codec/jax.dlpack
+    consumer). ``view`` aliases C memory — it is only valid until
+    ``release()``, which returns the buffer to the arena (idempotent;
+    also safe after transport close, where it is a no-op)."""
+
+    __slots__ = ("_owner", "_token", "addr", "view")
+
+    def __init__(self, owner: "TcpNetwork", token: int, addr: int, n: int):
+        self._owner = owner
+        self._token = token
+        self.addr = addr  # the arena address the C side reported
+        self.view = memoryview(
+            (ctypes.c_uint8 * n).from_address(addr)
+        ).cast("B") if n else memoryview(b"")
+
+    def release(self) -> None:
+        tok, self._token = self._token, 0
+        if tok:
+            self.view = memoryview(b"")  # drop the alias before the free
+            self._owner._release_token(tok)
+
+    def to_bytes(self) -> bytes:
+        data = bytes(self.view)
+        self.release()
+        return data
+
+    def __del__(self):  # leak guard: a dropped frame must not pin its arena
+        try:
+            self.release()
+        except Exception:
+            pass
 
 
 class TcpNetwork(NetworkTransport):
@@ -79,6 +116,12 @@ class TcpNetwork(NetworkTransport):
         self._closed = False
         self._recv_buf = (ctypes.c_uint8 * _RECV_BUF_CAP)()
         self._sender_buf = (ctypes.c_uint8 * 16)()
+        # zero-copy recv engages when the native library exports the
+        # borrow API (a prebuilt RABIA_NATIVE_LIB may predate it) and is
+        # not explicitly disabled
+        self._zero_copy = bool(
+            getattr(self._lib, "rt_recv_borrow", None)
+        ) and not os.environ.get("RABIA_NO_ZERO_COPY_RECV")
         self._reader = threading.Thread(target=self._reader_loop, daemon=True)
         self._reader.start()
 
@@ -97,18 +140,40 @@ class TcpNetwork(NetworkTransport):
     def _reader_loop(self) -> None:
         import uuid
 
+        ptr = ctypes.c_void_p()
+        ln = ctypes.c_uint32()
         while not self._closed:
-            n = self._lib.rt_recv(
-                self._handle, self._sender_buf, self._recv_buf, _RECV_BUF_CAP, 100
-            )
-            if n == -3:
-                continue  # timeout tick; 0 is a valid empty frame
-            if n < 0:
-                return  # transport closing
-            sender = NodeId(uuid.UUID(bytes=bytes(self._sender_buf)))
-            # one C-level memcpy; slicing the ctypes array instead would
-            # build n Python ints and burn the GIL the sender needs
-            data = ctypes.string_at(self._recv_buf, n)
+            if self._zero_copy:
+                tok = self._lib.rt_recv_borrow(
+                    self._handle,
+                    self._sender_buf,
+                    ctypes.byref(ptr),
+                    ctypes.byref(ln),
+                    100,
+                )
+                if tok == -3:
+                    continue  # timeout tick
+                if tok < 0:
+                    return  # transport closing
+                sender = NodeId(uuid.UUID(bytes=bytes(self._sender_buf)))
+                data = _BorrowedFrame(self, tok, ptr.value or 0, ln.value)
+            else:
+                n = self._lib.rt_recv(
+                    self._handle,
+                    self._sender_buf,
+                    self._recv_buf,
+                    _RECV_BUF_CAP,
+                    100,
+                )
+                if n == -3:
+                    continue  # timeout tick; 0 is a valid empty frame
+                if n < 0:
+                    return  # transport closing
+                sender = NodeId(uuid.UUID(bytes=bytes(self._sender_buf)))
+                # one C-level memcpy; slicing the ctypes array instead
+                # would build n Python ints and burn the GIL the sender
+                # needs
+                data = ctypes.string_at(self._recv_buf, n)
             self._pending.append((sender, data))
             if not self._wake_scheduled:
                 # one loop wakeup per pending BATCH: further appends ride
@@ -150,6 +215,17 @@ class TcpNetwork(NetworkTransport):
         if self._recv_notify is not None:
             self._recv_notify()
 
+    def _release_token(self, token: int) -> None:
+        # close() nulls the handle only after the reader joined and the
+        # pending queue was drained — a late release then no-ops here
+        h = self._handle
+        if h:
+            self._lib.rt_recv_release(h, token)
+
+    @staticmethod
+    def _as_bytes(data) -> bytes:
+        return data.to_bytes() if isinstance(data, _BorrowedFrame) else data
+
     async def receive(self, timeout: Optional[float] = None) -> tuple[NodeId, bytes]:
         deadline = (
             None
@@ -158,7 +234,8 @@ class TcpNetwork(NetworkTransport):
         )
         while True:
             try:
-                return self._pending.popleft()
+                sender, data = self._pending.popleft()
+                return sender, self._as_bytes(data)
             except IndexError:
                 pass
             self._data_ready.clear()
@@ -177,9 +254,24 @@ class TcpNetwork(NetworkTransport):
 
     def receive_nowait(self) -> Optional[tuple[NodeId, bytes]]:
         try:
-            return self._pending.popleft()
+            sender, data = self._pending.popleft()
         except IndexError:
             return None
+        return sender, self._as_bytes(data)
+
+    def receive_borrowed_nowait(self):
+        """Zero-copy drain: ``(sender, buffer, release)`` where ``buffer``
+        aliases the native frame arena (a memoryview) until ``release()``
+        is called — the engine decodes straight out of the io thread's
+        landing buffer (SURVEY §7.4.7). Falls back to a plain bytes
+        frame (with a no-op release) when zero-copy recv is off."""
+        try:
+            sender, data = self._pending.popleft()
+        except IndexError:
+            return None
+        if isinstance(data, _BorrowedFrame):
+            return sender, data.view, data.release
+        return sender, data, lambda: None
 
     def set_receive_notify(self, callback) -> bool:
         # invoked from _on_frames, which already runs on the loop thread
@@ -254,6 +346,12 @@ class TcpNetwork(NetworkTransport):
             )
             self._handle = None
             return
+        # materialize any zero-copy frames still pending: their buffers
+        # live in the native arena rt_close is about to free (to_bytes
+        # releases each token while the handle is still valid)
+        for i, (sender, data) in enumerate(self._pending):
+            if isinstance(data, _BorrowedFrame):
+                self._pending[i] = (sender, data.to_bytes())
         handle, self._handle = self._handle, None
         if handle:
             await loop.run_in_executor(None, self._lib.rt_close, handle)

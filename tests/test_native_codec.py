@@ -21,6 +21,7 @@ from rabia_tpu.core.messages import (
     ProposeBlock,
     ProtocolMessage,
     SyncRequest,
+    SyncResponse,
     VoteRound1,
     VoteRound2,
 )
@@ -157,6 +158,58 @@ class TestNativeCodecParity:
         )
         block.slots[:] = [10, 11]
         _roundtrip_both(ProtocolMessage.new(NodeId.from_int(7), ProposeBlock(block=block)))
+
+    def test_syncresponse(self):
+        for payload in (
+            SyncResponse(0, 0),
+            SyncResponse(
+                responder_phase=7,
+                state_version=42,
+                snapshot=b"\x00\x01snapshot bytes" * 9,
+                per_shard_phase=(3, 1, 4, 1, 5),
+                applied_ids=(
+                    (0, BatchId(uuid.UUID(int=11))),
+                    (4, BatchId(uuid.UUID(int=12))),
+                ),
+                per_shard_version=(2, 7, 1, 8, 2),
+            ),
+            SyncResponse(2**63, 2**64 - 1, None, (), (), tuple(range(64))),
+        ):
+            _roundtrip_both(ProtocolMessage.new(NodeId.from_int(3), payload))
+
+    def test_syncresponse_compressed_parity(self):
+        # above the compression threshold the Python codec zlib-level-1
+        # compresses the body; the native codec must emit the IDENTICAL
+        # bytes (same libz in-process) and decode them back
+        from rabia_tpu.core.serialization import SerializationConfig
+
+        snap = bytes(range(256)) * 300  # ~77KB, compressible
+        payload = SyncResponse(
+            9, 17, snap, tuple(range(32)), (), tuple(range(32))
+        )
+        msg = ProtocolMessage.new(NodeId.from_int(2), payload)
+        ser = BinarySerializer(SerializationConfig(compression_threshold=512))
+        p_bytes = ser._serialize_py(msg)
+        n_bytes = native.encode(msg, 512)
+        assert n_bytes == p_bytes
+        assert len(p_bytes) < len(snap) // 4  # compression engaged
+        for decode in (native.decode, ser._deserialize_py):
+            out = decode(p_bytes)
+            assert out is not None
+            assert out.payload == payload
+
+    def test_syncresponse_odd_shapes_fall_back(self):
+        # non-bytes snapshot and out-of-range ints: the Python codec owns
+        # these frames (and raises its historical errors); the native
+        # codec must decline, never mis-encode
+        for payload in (
+            SyncResponse(1, 2, bytearray(b"xyz")),
+            SyncResponse(1, 2, None, (2**64,)),
+            SyncResponse(1, 2, None, (), ((2**32, BatchId.new()),)),
+            SyncResponse(-1, 2),
+        ):
+            msg = ProtocolMessage.new(NodeId.from_int(1), payload)
+            assert native.encode(msg) is None
 
     def test_unsupported_types_fall_through(self):
         # QuorumNotification is not fast-pathed: the native codec must
@@ -363,6 +416,21 @@ class TestNativeCodecErrors:
         with pytest.raises(SerializationError, match="version"):
             native.decode(bytes(data))
 
+    def test_syncresponse_corrupt_compressed_body(self):
+        from rabia_tpu.core.serialization import SerializationConfig
+
+        ser = BinarySerializer(SerializationConfig(compression_threshold=64))
+        msg = ProtocolMessage.new(
+            NodeId.from_int(1),
+            SyncResponse(1, 2, bytes(range(256)) * 16, (1,) * 32),
+        )
+        data = bytearray(ser.serialize(msg))
+        assert data[2] & 0x01  # FLAG_COMPRESSED set
+        data[-8] ^= 0xFF  # corrupt the deflate stream
+        for decode in (native.decode, ser._deserialize_py):
+            with pytest.raises(SerializationError):
+                decode(bytes(data))
+
     def test_block_checksum_mismatch(self):
         from rabia_tpu.core.blocks import build_block
 
@@ -408,6 +476,10 @@ class TestDecodeRobustness:
             NewBatch(shard=2, batch=batch),
             HeartBeat(current_phase=5, committed_phase=4),
             SyncRequest(current_phase=9, state_version=3),
+            SyncResponse(
+                3, 9, b"snap" * 40, (1, 2), ((0, BatchId(uuid.UUID(int=9))),),
+                (4, 4),
+            ),
         ):
             frames.append(
                 ser._serialize_py(

@@ -1,0 +1,300 @@
+"""Zero-copy vote handoff (SURVEY §7.4.7; docs/PERFORMANCE.md design
+note made real).
+
+Three seams, each pinned:
+
+1. **dlpack plane adoption** — pointer identity between the engine's
+   aligned inbox planes and the jax arrays the kernel consumes (CPU
+   backend adopts external host buffers without copying).
+2. **transport borrow API** — inbound frames decoded straight out of the
+   native arena: the memoryview the engine reads aliases the exact
+   address ``rt_recv_borrow`` reported, with no intermediate bytes
+   object; release returns the buffer to the arena.
+3. **engine wiring** — ``KernelConfig.zero_copy_inbox`` produces
+   bit-identical node_cycle outputs to the copying path, and a full
+   jax-backend cluster runs on it end to end.
+
+Reference seam being bridged: the transport→engine buffer path of
+rabia-engine/src/network/tcp.rs:575-630 (which memcpys frames out of
+the socket buffer before decode).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rabia_tpu.core.types import ABSENT, V0, V1, NodeId
+
+
+class TestDlpackPlaneAdoption:
+    def test_pointer_identity_on_cpu(self):
+        from rabia_tpu.engine.engine import _aligned_i8
+
+        plane = _aligned_i8((8, 3), ABSENT)
+        assert plane.ctypes.data % 64 == 0
+        adopted = jax.dlpack.from_dlpack(plane)
+        # the jax array reads the numpy plane's memory, not a copy
+        assert adopted.unsafe_buffer_pointer() == plane.ctypes.data
+
+    def test_unaligned_plane_would_copy(self):
+        # the control: a deliberately misaligned buffer gets a defensive
+        # copy — this is WHY _aligned_i8 exists
+        raw = np.zeros(24 + 1, np.int8)
+        off = 1 if raw.ctypes.data % 64 == 0 else 0
+        mis = raw[off : off + 24].reshape(8, 3)
+        if mis.ctypes.data % 64 == 0:  # pragma: no cover - allocator luck
+            pytest.skip("allocator returned aligned memory for the control")
+        adopted = jax.dlpack.from_dlpack(mis)
+        assert adopted.unsafe_buffer_pointer() != mis.ctypes.data
+
+    def test_adopted_plane_sees_pre_dispatch_writes(self):
+        from rabia_tpu.engine.engine import _aligned_i8
+
+        plane = _aligned_i8((16, 5), ABSENT)
+        plane[3, 2] = V1
+        plane[7, 0] = V0
+        adopted = jax.dlpack.from_dlpack(plane)
+        got = np.asarray(adopted)
+        assert got[3, 2] == V1 and got[7, 0] == V0
+        assert (got == ABSENT).sum() == 16 * 5 - 2
+
+    def test_node_cycle_identical_with_adopted_inboxes(self):
+        """The flag's actual contract: node_cycle(adopted planes) ==
+        node_cycle(copied planes), state and outbox, bit for bit."""
+        from rabia_tpu.engine.engine import _aligned_i8
+        from rabia_tpu.kernel.phase_driver import NodeKernel
+
+        S, R = 16, 3
+        k = NodeKernel(S, R, me=0, seed=5)
+        rng = np.random.default_rng(9)
+
+        def random_planes():
+            ib1 = _aligned_i8((S, R), ABSENT)
+            ib2 = _aligned_i8((S, R), ABSENT)
+            dec = _aligned_i8(S, ABSENT)
+            m = rng.random((S, R)) < 0.5
+            ib1[m] = rng.choice(np.array([V0, V1], np.int8), size=int(m.sum()))
+            m2 = rng.random((S, R)) < 0.3
+            ib2[m2] = rng.choice(np.array([V0, V1], np.int8), size=int(m2.sum()))
+            return ib1, ib2, dec
+
+        mask = np.ones(S, bool)
+        slots = np.zeros(S, np.int32)
+        init = rng.choice(np.array([V0, V1], np.int8), size=S)
+
+        ib1, ib2, dec = random_planes()
+        st_a = k.init_state()
+        st_a, ob_a = k.node_cycle(
+            st_a,
+            jnp.asarray(mask),
+            jnp.asarray(slots),
+            jnp.asarray(init),
+            jax.dlpack.from_dlpack(ib1),
+            jax.dlpack.from_dlpack(ib2),
+            jax.dlpack.from_dlpack(dec),
+            3,
+        )
+        st_b = k.init_state()
+        st_b, ob_b = k.node_cycle(
+            st_b,
+            jnp.asarray(mask),
+            jnp.asarray(slots),
+            jnp.asarray(init),
+            jnp.asarray(ib1),
+            jnp.asarray(ib2),
+            jnp.asarray(dec),
+            3,
+        )
+        for fa, fb in zip(jax.device_get(st_a), jax.device_get(st_b)):
+            assert np.array_equal(fa, fb)
+        for fa, fb in zip(jax.device_get(ob_a), jax.device_get(ob_b)):
+            assert np.array_equal(fa, fb)
+
+
+class TestTransportBorrow:
+    @pytest.mark.asyncio
+    async def test_borrowed_frame_aliases_native_arena(self):
+        from rabia_tpu.core.config import TcpNetworkConfig
+        from rabia_tpu.net.tcp import TcpNetwork, _BorrowedFrame
+
+        a, b = NodeId.from_int(1), NodeId.from_int(2)
+        ta = TcpNetwork(a, TcpNetworkConfig(bind_port=0))
+        tb = TcpNetwork(b, TcpNetworkConfig(bind_port=0))
+        try:
+            assert tb._zero_copy, "borrow API must engage by default"
+            ta.add_peer(b, "127.0.0.1", tb.port)
+            tb.add_peer(a, "127.0.0.1", ta.port)
+            for _ in range(100):
+                if await ta.is_connected(b):
+                    break
+                await asyncio.sleep(0.05)
+            payload = b"zero-copy vote frame \x00\x01\x02" * 7
+            await ta.send_to(b, payload)
+            for _ in range(200):
+                if tb._pending:
+                    break
+                await asyncio.sleep(0.01)
+            sender, frame = tb._pending[0]
+            assert isinstance(frame, _BorrowedFrame)
+            # no-copy: the view the consumer reads IS the arena buffer
+            # the C side reported — same address, no bytes object between
+            assert (
+                np.frombuffer(frame.view, np.uint8).ctypes.data == frame.addr
+            )
+            got = tb.receive_borrowed_nowait()
+            assert got is not None
+            sender2, view, release = got
+            assert sender2 == a
+            assert bytes(view) == payload
+            release()
+            # released view must not be readable (alias dropped)
+            assert len(view) == 0 or bytes(frame.view) == b""
+        finally:
+            await ta.close()
+            await tb.close()
+
+    @pytest.mark.asyncio
+    async def test_receive_contract_still_bytes(self):
+        # the plain NetworkTransport contract (receive/receive_nowait ->
+        # bytes) must hold unchanged for non-borrowing consumers
+        from rabia_tpu.core.config import TcpNetworkConfig
+        from rabia_tpu.net.tcp import TcpNetwork
+
+        a, b = NodeId.from_int(3), NodeId.from_int(4)
+        ta = TcpNetwork(a, TcpNetworkConfig(bind_port=0))
+        tb = TcpNetwork(b, TcpNetworkConfig(bind_port=0))
+        try:
+            ta.add_peer(b, "127.0.0.1", tb.port)
+            tb.add_peer(a, "127.0.0.1", ta.port)
+            for _ in range(100):
+                if await ta.is_connected(b):
+                    break
+                await asyncio.sleep(0.05)
+            await ta.send_to(b, b"plain bytes path")
+            sender, data = await tb.receive(timeout=5.0)
+            assert isinstance(data, bytes)
+            assert data == b"plain bytes path"
+        finally:
+            await ta.close()
+            await tb.close()
+
+    @pytest.mark.asyncio
+    async def test_close_materializes_pending_borrows(self):
+        # frames still pending at close must survive as bytes — their
+        # arena is freed with the native handle
+        from rabia_tpu.core.config import TcpNetworkConfig
+        from rabia_tpu.net.tcp import TcpNetwork
+
+        a, b = NodeId.from_int(5), NodeId.from_int(6)
+        ta = TcpNetwork(a, TcpNetworkConfig(bind_port=0))
+        tb = TcpNetwork(b, TcpNetworkConfig(bind_port=0))
+        try:
+            ta.add_peer(b, "127.0.0.1", tb.port)
+            tb.add_peer(a, "127.0.0.1", ta.port)
+            for _ in range(100):
+                if await ta.is_connected(b):
+                    break
+                await asyncio.sleep(0.05)
+            for i in range(4):
+                await ta.send_to(b, f"pending-{i}".encode())
+            for _ in range(200):
+                if len(tb._pending) == 4:
+                    break
+                await asyncio.sleep(0.01)
+        finally:
+            await ta.close()
+            await tb.close()
+        # after close, the queued frames are plain bytes and intact
+        got = sorted(data for _, data in tb._pending)
+        assert got == [f"pending-{i}".encode() for i in range(4)]
+        assert all(isinstance(d, bytes) for d in got)
+
+
+class TestEngineZeroCopyCluster:
+    @pytest.mark.asyncio
+    @pytest.mark.jax_backend
+    async def test_jax_cluster_commits_with_zero_copy_inbox(self):
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.core.types import CommandBatch
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.net import InMemoryHub
+
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        hub = InMemoryHub()
+        config = RabiaConfig(
+            phase_timeout=0.4,
+            heartbeat_interval=0.05,
+            round_interval=0.002,
+        ).with_kernel(
+            num_shards=2,
+            shard_pad_multiple=2,
+            backend="jax",
+            zero_copy_inbox=True,
+        )
+        engines, sms, tasks = [], [], []
+        for node in nodes:
+            sm = InMemoryStateMachine()
+            eng = RabiaEngine(
+                ClusterConfig.new(node, nodes),
+                sm,
+                hub.register(node),
+                config=config,
+            )
+            assert eng._zc_inbox
+            engines.append(eng)
+            sms.append(sm)
+            tasks.append(asyncio.ensure_future(eng.run()))
+        try:
+            for _ in range(200):
+                await asyncio.sleep(0.01)
+                stats = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in stats):
+                    break
+            futs = [
+                await e.submit_batch(
+                    CommandBatch.new([f"SET zc{i} v{i}"]), shard=i % 2
+                )
+                for i, e in enumerate(engines)
+            ]
+            for f in futs:
+                await asyncio.wait_for(f, 20.0)
+
+            async def converged():
+                while not all(
+                    all(sm.get(f"zc{i}") == f"v{i}" for i in range(3))
+                    for sm in sms
+                ):
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(converged(), 20.0)
+        finally:
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    def test_flag_requires_jax_backend(self):
+        # host backend ignores the flag (there is no device boundary)
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.net import InMemoryHub
+
+        nodes = [NodeId.from_int(1)]
+        hub = InMemoryHub()
+        eng = RabiaEngine(
+            ClusterConfig.new(nodes[0], nodes),
+            InMemoryStateMachine(),
+            hub.register(nodes[0]),
+            config=RabiaConfig().with_kernel(zero_copy_inbox=True),
+        )
+        assert not eng._zc_inbox
