@@ -64,9 +64,7 @@ _SET_HDR = 3  # binary SET op: u8 opcode(1) + u16 klen + key + value
 # fixed odd multipliers for the dictionary packer's 64-bit row hash
 # (collisions are VERIFIED against, never trusted — see pack_window_dict)
 _HASH_W = (
-    np.random.default_rng(0x5EED).integers(
-        1, 2**62, 4096, dtype=np.uint64
-    )
+    np.random.default_rng(0x5EED).integers(1, 2**62, 4, dtype=np.uint64)
     * 2
     + 1
 )
@@ -143,33 +141,15 @@ def _get_frame(found: bool, ver: int, val: bytes) -> bytes:
         return _result_bin(2, ver, "value is not utf-8 text")
 
 
-class GetFrameGroups(Sequence):
-    """Lazy per-shard GET responses over one wave's lookup readback.
+class _ShardFrameGroups(Sequence):
+    """Shared per-shard lazy response machinery for the window views
+    below: group ``j`` covers ``shards[j]`` with exactly one frame,
+    materialized by the subclass's ``_frame(shard)`` on client read."""
 
-    Frames materialize only when a client reads them — the commit path
-    stores this view (one object per block, no per-op Python).
-    """
-
-    __slots__ = ("shards", "found", "ver", "vlen", "valb")
-
-    def __init__(self, shards, found, ver, vlen, val_words) -> None:
-        self.shards = shards  # i64[k] covered shards, group order
-        self.found = found  # bool[S]
-        self.ver = ver  # i32[S]
-        self.vlen = vlen  # i32[S]
-        # contiguous: a fetched device array slice can come back with a
-        # non-contiguous layout, which .view(uint8) rejects
-        self.valb = np.ascontiguousarray(val_words).view(np.uint8)  # u8[S, VW]
+    __slots__ = ()
 
     def __len__(self) -> int:
         return len(self.shards)
-
-    def _frame(self, s: int) -> bytes:
-        return _get_frame(
-            bool(self.found[s]),
-            int(self.ver[s]),
-            self.valb[s, : int(self.vlen[s])].tobytes(),
-        )
 
     def __getitem__(self, j):
         if isinstance(j, slice):
@@ -195,7 +175,33 @@ class GetFrameGroups(Sequence):
         )
 
 
-class ResolvedGetFrameGroups(Sequence):
+class GetFrameGroups(_ShardFrameGroups):
+    """Lazy per-shard GET responses over one wave's lookup readback.
+
+    Frames materialize only when a client reads them — the commit path
+    stores this view (one object per block, no per-op Python).
+    """
+
+    __slots__ = ("shards", "found", "ver", "vlen", "valb")
+
+    def __init__(self, shards, found, ver, vlen, val_words) -> None:
+        self.shards = shards  # i64[k] covered shards, group order
+        self.found = found  # bool[S]
+        self.ver = ver  # i32[S]
+        self.vlen = vlen  # i32[S]
+        # contiguous: a fetched device array slice can come back with a
+        # non-contiguous layout, which .view(uint8) rejects
+        self.valb = np.ascontiguousarray(val_words).view(np.uint8)  # u8[S, VW]
+
+    def _frame(self, s: int) -> bytes:
+        return _get_frame(
+            bool(self.found[s]),
+            int(self.ver[s]),
+            self.valb[s, : int(self.vlen[s])].tobytes(),
+        )
+
+
+class ResolvedGetFrameGroups(_ShardFrameGroups):
     """Per-shard GET responses resolved from HOST-side value segments —
     the zero-value-download read path.
 
@@ -220,40 +226,14 @@ class ResolvedGetFrameGroups(Sequence):
         self.ver = ver  # i32[S]
         self.resolver = resolver
 
-    def __len__(self) -> int:
-        return len(self.shards)
-
     def _frame(self, s: int) -> bytes:
         if not self.found[s]:
             return _get_frame(False, 0, b"")
         ver = int(self.ver[s])
         return _get_frame(True, ver, self.resolver(s, ver))
 
-    def __getitem__(self, j):
-        if isinstance(j, slice):
-            return [self[i] for i in range(*j.indices(len(self)))]
-        if j < 0:
-            j += len(self)
-        if not (0 <= j < len(self)):
-            raise IndexError(j)
-        return [self._frame(int(self.shards[j]))]
 
-    def __iter__(self):
-        for j in range(len(self)):
-            yield self[j]
-
-    def group_counts(self) -> np.ndarray:
-        return np.ones(len(self), np.int64)
-
-    def __eq__(self, other) -> bool:
-        if not isinstance(other, (list, tuple, Sequence)):
-            return NotImplemented
-        return len(self) == len(other) and all(
-            a == b for a, b in zip(self, other)
-        )
-
-
-class MixedFrameGroups(Sequence):
+class MixedFrameGroups(_ShardFrameGroups):
     """Lazy per-shard responses for one MIXED wave (SET and GET ops in
     the same wave): SET ops answer with the derived 6-byte version
     frame (byte-identical to ``VectorShardedKV._vers_frames``), GET ops
@@ -268,38 +248,12 @@ class MixedFrameGroups(Sequence):
         self.svers = set_vers  # i64[S] derived SET response versions
         self._get = get_frames  # GetFrameGroups view for this wave
 
-    def __len__(self) -> int:
-        return len(self.shards)
-
     def _frame(self, s: int) -> bytes:
         if int(self.kind[s]) == 1:
             arr = np.zeros(1, _RESP_DT)
             arr["version"] = np.uint32(self.svers[s])
             return arr.tobytes()
         return self._get._frame(s)
-
-    def __getitem__(self, j):
-        if isinstance(j, slice):
-            return [self[i] for i in range(*j.indices(len(self)))]
-        if j < 0:
-            j += len(self)
-        if not (0 <= j < len(self)):
-            raise IndexError(j)
-        return [self._frame(int(self.shards[j]))]
-
-    def __iter__(self):
-        for j in range(len(self)):
-            yield self[j]
-
-    def group_counts(self) -> np.ndarray:
-        return np.ones(len(self), np.int64)
-
-    def __eq__(self, other) -> bool:
-        if not isinstance(other, (list, tuple, Sequence)):
-            return NotImplemented
-        return len(self) == len(other) and all(
-            a == b for a, b in zip(self, other)
-        )
 
 
 class DeviceKVTable:

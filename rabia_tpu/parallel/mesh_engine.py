@@ -458,11 +458,7 @@ class MeshEngine:
             # ~156ms/cycle); the worker blocks there instead while the
             # main thread packs the next window.
             self._dev_pipe: list = []
-            import concurrent.futures
-
-            self._dev_fetcher = concurrent.futures.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="devkv-flags"
-            )
+            self._dev_fetcher_pool = None  # lazy: first pipelined window
             self._dev_vseg: deque = deque()
             self._dev_vseg_bytes = 0
             self._dev_vseg_cap = 64 << 20  # evictions raise _dev_floor
@@ -906,7 +902,7 @@ class MeshEngine:
             self._bulk_log.popleft()
         self._dev_pipe.append(
             {
-                "flags_fut": self._dev_fetcher.submit(np.asarray, flags_dev),
+                "flags_fut": self._dev_fetcher().submit(np.asarray, flags_dev),
                 "new_state": new_state,
                 "entries": entries,
                 "depth": depth,
@@ -918,6 +914,29 @@ class MeshEngine:
         if len(self._dev_pipe) > 1:
             return self._dev_resolve_one()
         return 0
+
+    def _dev_fetcher(self):
+        """The single-worker executor that fetches window flags off the
+        main thread (see _run_cycle_fullwidth_device). Lazy and
+        recreatable: demotion shuts it down (host mode needs no worker),
+        re-promotion's first pipelined window brings it back."""
+        import concurrent.futures
+
+        if self._dev_fetcher_pool is None:
+            self._dev_fetcher_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="devkv-flags"
+            )
+        return self._dev_fetcher_pool
+
+    def close(self) -> None:
+        """Release engine-held resources: settle in-flight device
+        windows and stop the flags-fetch worker. Idempotent; the engine
+        remains usable afterward (workers are lazily recreated)."""
+        if self._dev is not None and self._dev_active:
+            self._dev_drain_pipe()
+        if getattr(self, "_dev_fetcher_pool", None) is not None:
+            self._dev_fetcher_pool.shutdown(wait=False)
+            self._dev_fetcher_pool = None
 
     def _dev_resolve_one(self) -> int:
         """Resolve the OLDEST in-flight device window: read its flags,
@@ -948,6 +967,27 @@ class MeshEngine:
                     self._dev_vseg_bytes -= r["seg"].nbytes
                 # (an already-evicted segment only over-raised the
                 # floor — safe: the GET path falls back to downloads)
+            if self._queued_entries:
+                # per-batch submissions arrived while the windows were in
+                # flight (submit() found _full_blocks empty, so its
+                # order-preserving demote had nothing to demote). The
+                # rolled-back blocks predate everything in the queues —
+                # push them to the FRONT now, or the later
+                # _demote_full_blocks would append them BEHIND the newer
+                # work and the host path would apply out of submission
+                # order (divergence vs the host-only reference).
+                # Every remaining _full_blocks entry was staged while
+                # _queued_entries == 0, so it also predates the queues.
+                self._lat_invalidate |= self._lat_timing
+                self._spec = None
+                while self._full_blocks:
+                    block, bfut, _inv = self._full_blocks.pop()
+                    for i in reversed(range(len(block))):
+                        s = int(block.shards[i])
+                        self.queues[s].appendleft(
+                            _Pending(None, None, block=block, bidx=i, bfut=bfut)
+                        )
+                        self._queued_entries += 1
             self._demote_device_store()
             return 0
         self._dev_pipe.pop(0)
@@ -1227,6 +1267,10 @@ class MeshEngine:
         self._lat_invalidate |= self._lat_timing
         self._dev_active = False
         self._dev_cooldown = self._dev_repromote  # earn the way back
+        if self._dev_fetcher_pool is not None:
+            # host mode needs no flags worker; re-promotion recreates it
+            self._dev_fetcher_pool.shutdown(wait=False)
+            self._dev_fetcher_pool = None
         d = self._dev.dump()  # ONE table materialization for all replicas
         for sm in self.sms:
             self._dev.sync_into(sm, dump=d)

@@ -187,6 +187,63 @@ class TestDeviceKVConformance:
         ref.flush()
         assert _store_content(dev.sms[0], n) == _store_content(ref.sms[0], n)
 
+    def test_rollback_respects_submission_order_vs_queued_batches(self):
+        # regression (round-5 review): per-batch submissions that arrive
+        # while a pipelined device window is IN FLIGHT land directly on
+        # the per-shard queues (submit() finds _full_blocks empty). If
+        # that window then reads back dirty, the rollback must put its
+        # blocks IN FRONT of the queued batches — appending them behind
+        # (the old behavior) made the host path apply a newer write
+        # before an older one on the same key.
+        n = 2
+        dev = _mk(
+            n,
+            device=True,
+            device_store_kw={"per_shard_capacity": 4},
+            window=8,
+        )
+        host = _mk(n, device=False, window=8)
+
+        def blocks():
+            # 6 distinct keys per shard overflow the 4-slot device
+            # table (dirty flags); the last block writes k := A
+            out = [
+                build_block(
+                    list(range(n)),
+                    [[encode_set_bin(f"key{w}", "x")] for _ in range(n)],
+                )
+                for w in range(6)
+            ]
+            out.append(
+                build_block(
+                    list(range(n)),
+                    [[encode_set_bin("k", "A")] for _ in range(n)],
+                )
+            )
+            return out
+
+        for b in blocks():
+            dev.submit_block(b)
+        dev.run_cycle()  # dispatches the window; flags resolve later
+        assert dev._dev_pipe, "window must be in flight (pipelined)"
+        # newer per-batch submission for the same key while in flight
+        dev.submit([encode_set_bin("k", "B")], 0)
+        dev.flush()
+        assert not dev._dev_active  # dirty window -> demoted
+
+        for b in blocks():
+            host.submit_block(b)
+        host.flush()
+        host.submit([encode_set_bin("k", "B")], 0)
+        host.flush()
+
+        # submission order holds: k ended as B everywhere, and the full
+        # content (incl. versions) matches the host-only reference
+        want = _store_content(host.sms[0], n)
+        assert want[(0, b"k")][0] == b"B"
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+
     def test_idle_run_cycle_does_not_demote(self):
         n = 4
         dev = _mk(n, device=True)
