@@ -1,0 +1,132 @@
+"""Load soak: run the full test suite repeatedly under synthetic CPU load.
+
+Round-4 field notes recorded ~1-in-4 full-suite runs dropping one
+timing-sensitive test under ambient tenant load on the 1-core host — a
+different test each time. This harness makes that failure mode
+reproducible on demand: it spawns duty-cycled CPU hog processes (spin
+``duty`` of every 100ms slice, sleep the rest — emulating a noisy
+co-tenant rather than total starvation) and runs ``pytest tests/``
+``--runs`` times underneath them.
+
+The reference pins its timing behavior on dedicated CI runners; this
+repo's tests must instead hold on a shared 1-core box, so load
+tolerance is a first-class gate (VERDICT r4 item 5). CI runs this as
+its own tier; locally:
+
+    python scripts/load_soak.py [--runs 5] [--duty 0.6] [--hogs 1]
+
+Exits nonzero if any run fails; prints one JSON line per run and a
+summary line at the end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _hog(duty: float, stop_flag) -> None:
+    """Busy-spin ``duty`` of every 100ms slice until the flag is set."""
+    slice_s = 0.1
+    while not stop_flag.is_set():
+        start = time.monotonic()
+        budget = start + slice_s * duty
+        while time.monotonic() < budget:
+            pass  # burn
+        rest = start + slice_s - time.monotonic()
+        if rest > 0:
+            time.sleep(rest)
+
+
+_FAIL_RE = re.compile(r"^(FAILED|ERROR) (\S+)", re.MULTILINE)
+
+
+def run_suite(run_idx: int, pytest_args: list[str]) -> dict:
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", *pytest_args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        env={**os.environ, "RABIA_LOAD_SOAK": "1"},
+    )
+    elapsed = time.monotonic() - t0
+    failures = [m.group(2) for m in _FAIL_RE.finditer(proc.stdout)]
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    return {
+        "run": run_idx,
+        "ok": proc.returncode == 0,
+        "elapsed_s": round(elapsed, 1),
+        "failures": failures,
+        "tail": tail,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--runs", type=int, default=5)
+    ap.add_argument(
+        "--duty",
+        type=float,
+        default=0.6,
+        help="fraction of each 100ms slice the hog burns (0..0.95)",
+    )
+    ap.add_argument(
+        "--hogs",
+        type=int,
+        default=multiprocessing.cpu_count(),
+        help="number of hog processes (default: one per CPU)",
+    )
+    ap.add_argument(
+        "pytest_args",
+        nargs="*",
+        help="extra args forwarded to pytest (after --)",
+    )
+    args = ap.parse_args()
+    duty = min(max(args.duty, 0.0), 0.95)
+
+    stop = multiprocessing.Event()
+    hogs = [
+        multiprocessing.Process(target=_hog, args=(duty, stop), daemon=True)
+        for _ in range(args.hogs)
+    ]
+    for h in hogs:
+        h.start()
+
+    results = []
+    try:
+        for i in range(args.runs):
+            rec = run_suite(i, args.pytest_args)
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+    finally:
+        stop.set()
+        for h in hogs:
+            h.join(timeout=2)
+            if h.is_alive():
+                h.terminate()
+
+    ok_runs = sum(1 for r in results if r["ok"])
+    summary = {
+        "summary": True,
+        "runs": len(results),
+        "green": ok_runs,
+        "duty": duty,
+        "hogs": args.hogs,
+        "all_failures": sorted({f for r in results for f in r["failures"]}),
+    }
+    print(json.dumps(summary), flush=True)
+    return 0 if ok_runs == len(results) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

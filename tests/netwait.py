@@ -1,0 +1,73 @@
+"""Deadline-based liveness waits for transport tests.
+
+The round-4 field notes recorded ~1-in-4 full-suite runs dropping a
+timing-sensitive test under ambient load on the shared 1-core host. The
+common shape was a count-based connect wait (``for _ in range(100):
+sleep(0.05)``) sized for a quiet box: the native dialer makes 5 backoff
+attempts over ~3s and then falls back to a 10s redial period
+(native/transport.cpp kRedialPeriodS), so one loaded startup window
+pushes the handshake past a 5s budget and the test fails later, at the
+receive, with a misleading timeout.
+
+These helpers replace those loops with explicit wall-clock deadlines
+that are generous (liveness budgets cost nothing when things are
+healthy) and assert AT the wait with diagnostics, so a genuinely broken
+transport fails fast and attributably instead of as a downstream
+timeout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+# One redial period past the dialer's 5-attempt burst, with margin for a
+# loaded host: generous on purpose. A healthy localhost handshake takes
+# ~1ms; the budget only matters when the host is starved, where failing
+# the suite over slowness is exactly the flake being removed.
+CONNECT_BUDGET_S = 25.0
+
+
+async def wait_connected(*pairs, budget: float = CONNECT_BUDGET_S) -> None:
+    """Wait until every ``(net, peer_id)`` pair reports connected.
+
+    Asserts with a per-pair connectivity dump on timeout.
+    """
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        states = [await net.is_connected(peer) for net, peer in pairs]
+        if all(states):
+            return
+        await asyncio.sleep(0.05)
+    states = [
+        (str(peer), await net.is_connected(peer)) for net, peer in pairs
+    ]
+    raise AssertionError(
+        f"transport handshake incomplete after {budget}s: {states}"
+    )
+
+
+async def wait_until(pred, budget: float = 15.0, interval: float = 0.01,
+                     desc: str = "condition") -> None:
+    """Wait until a synchronous predicate holds; assert on deadline."""
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"{desc} not reached within {budget}s")
+
+
+async def wait_full_mesh(nets, n_peers: int, budget: float = CONNECT_BUDGET_S):
+    """Wait until every net in ``nets`` sees ``n_peers`` connected nodes."""
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        conn = [await n.get_connected_nodes() for n in nets]
+        if all(len(c) == n_peers for c in conn):
+            return
+        await asyncio.sleep(0.05)
+    conn = [len(await n.get_connected_nodes()) for n in nets]
+    raise AssertionError(
+        f"mesh incomplete after {budget}s: per-net connected counts {conn}"
+        f" (want {n_peers})"
+    )

@@ -4,10 +4,13 @@ service API, adaptive batching in the client path, binary kv op codec."""
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 
 import numpy as np
 import pytest
+
+from netwait import wait_until
 
 from rabia_tpu.apps import ShardedKVService, make_sharded_kv
 from rabia_tpu.apps.kvstore import (
@@ -691,18 +694,42 @@ class TestBlockLanePersistence:
             assert (await e0.get_statistics()).committed_slots > 0, (
                 "restored replica lost its applied counters"
             )
-            # the cluster keeps committing with the restored member
-            for i in range(3):
+            # wait for the restored replica's per-shard heads to catch up
+            # with the cluster: until sync repair lands, every live
+            # proposer defers to a peer (proposer is computed from each
+            # engine's OWN head), so a wave issued in that window no-ops
+            # — the pre-round-5 version assumed exactly 3 waves would
+            # commit and flaked under ambient load on exactly this
+            def heads(e):
+                return _np.maximum(e.rt.next_slot[:S], e.rt.applied_upto[:S])
+
+            await wait_until(
+                lambda: _np.all(heads(e0) >= heads(engines[1])),
+                budget=20.0,
+                desc="restored replica head catch-up",
+            )
+            # the cluster keeps committing with the restored member:
+            # retry waves under a deadline (a wave still no-ops per-shard
+            # while that shard's previous slot is settling)
+            deadline = time.monotonic() + 30.0
+            i = 0
+            after = committed_before
+            got = None
+            while time.monotonic() < deadline:
                 await wave(engines, f"r{i}")
-            after = (await engines[1].get_statistics()).committed_slots
+                i += 1
+                await asyncio.sleep(0.05)
+                after = (await engines[1].get_statistics()).committed_slots
+                got = restored_stores[0][2].store.get("p2")
+                if (
+                    after > committed_before
+                    and got is not None
+                    and got.value.startswith("r")
+                ):
+                    break
             assert after > committed_before
             # restored replica converges on post-restart writes
-            for _ in range(1000):
-                await asyncio.sleep(0.01)
-                got = restored_stores[0][2].store.get("p2")
-                if got is not None and got.value == "r2":
-                    break
-            assert got is not None and got.value == "r2"
+            assert got is not None and got.value.startswith("r")
         finally:
             for e in engines:
                 try:

@@ -594,17 +594,91 @@ class TestLatencyGovernor:
         # an overshoot at W=8 must not be re-entered by the next quiet
         # stretch (the 128<->256 limit cycle): the failed size becomes a
         # ceiling that upsizing stays strictly below until it ages out
-        eng = self._mk(window=8, latency_target_ms=100.0, max_window=64)
-        eng._lat_samples.extend([50.0, 250.0])
-        eng._govern(250.0)  # 2x overshoot -> halve
+        # or a sustained-headroom probe clears it (min_window=4 so the
+        # deep-overshoot fast descent lands one rung down)
+        eng = self._mk(
+            window=8, latency_target_ms=100.0, max_window=64, min_window=4
+        )
+        eng._lat_samples.extend([50.0, 250.0, 250.0])
+        eng._govern(250.0)  # two corroborating 2x overshoots -> down
         assert eng.window == 4
         assert eng._lat_ceiling == 8
-        eng._lat_samples.extend([10.0] * 10)
+        eng._lat_samples.extend([60.0] * 10)
         eng._lat_saturated = True
-        eng._govern(10.0)
-        assert eng.window == 4  # 4*2 == ceiling: parked
+        eng._govern(60.0)
+        assert eng.window == 4  # 4*2 == ceiling: parked (p99 > 0.5*t)
         st = eng.governor_stats()
         assert st["ceiling_window"] == 8
+
+    def test_single_spike_does_not_downsize(self):
+        # one ambient tunnel glitch (5-10x overshoots are routine on the
+        # tunneled chip) must not evict a healthy window size: downsizing
+        # needs a second corroborating overshoot, or the TRIMMED p99
+        # over the target. Round 4 halved on a lone 2x sample, and the
+        # resulting ceiling parked the governor at half its sustainable
+        # window for the rest of the bench run.
+        eng = self._mk(window=8, latency_target_ms=100.0, max_window=64)
+        eng._lat_samples.extend([50.0] * 10 + [850.0])  # lone glitch
+        eng._govern(850.0)
+        assert eng.window == 8  # held
+        assert eng._lat_ceiling is None
+        # a second overshoot while the first is still in the sample
+        # window IS real overload — and at >2x the target on the trimmed
+        # estimate it is a deep one: fast-descend to the floor
+        eng._lat_samples.append(850.0)
+        eng._govern(850.0)
+        assert eng.window == eng.min_window
+        assert eng._lat_ceiling == 8
+
+    def test_post_resize_glitch_does_not_downsize(self):
+        # samples clear on every resize, so the first windows at a new
+        # size run with n<8 where the one-outlier trim is off — the p99
+        # downsize path must therefore stay off too (it engages at n>=8
+        # together with the trim), or a single glitch right after a
+        # resize would evict the brand-new size untrimmed and ceiling it
+        eng = self._mk(window=8, latency_target_ms=250.0, max_window=64)
+        eng._lat_samples.extend([90.0] * 5 + [850.0])  # glitch, n=6
+        eng._govern(850.0)
+        assert eng.window == 8  # held: 1 spike, p99 path needs n>=8
+        assert eng._lat_ceiling is None
+
+    def test_deep_overshoot_jumps_to_floor(self):
+        # p99 over 2x target on the trimmed estimate: the target sits at
+        # or below the dispatch floor, so the governor jumps straight to
+        # min_window rather than paying one jit compile per intermediate
+        # ladder rung on the way down (target_60ms in the r5 sweep burned
+        # its whole budget walking 16->8->4 and never reached the floor
+        # where the unachievable detector lives)
+        eng = self._mk(
+            window=32, latency_target_ms=50.0, max_window=64, min_window=1
+        )
+        eng._lat_samples.extend([120.0] * 6)
+        eng._govern(120.0)
+        assert eng.window == 1  # jumped, not halved
+        assert eng._lat_ceiling == 32
+
+    def test_headroom_probe_clears_ceiling(self):
+        # a ceiling set by a transient must stop costing throughput once
+        # the current size shows sustained deep headroom (trimmed p99
+        # <= 0.5*target over >=16 samples): the governor probes the
+        # evicted size instead of waiting out the 256-sample age-out
+        eng = self._mk(
+            window=8, latency_target_ms=100.0, max_window=64, min_window=4
+        )
+        eng._lat_samples.extend([50.0, 250.0, 250.0])
+        eng._govern(250.0)
+        assert eng.window == 4 and eng._lat_ceiling == 8
+        eng._lat_samples.extend([20.0] * 16)  # deep headroom at W=4
+        eng._lat_saturated = True
+        eng._govern(20.0)
+        assert eng.window == 8  # probed back into the evicted size
+        assert eng._lat_ceiling is None
+        # the probe is accountable: overload at the re-entered size
+        # re-establishes the ceiling within two samples
+        eng._lat_samples.extend([250.0, 250.0])
+        eng._govern(250.0)
+        assert eng.window == 4
+        assert eng._lat_ceiling == 8
 
     def test_governor_stats_before_any_sample(self):
         eng = self._mk(window=4, latency_target_ms=100.0)

@@ -16,6 +16,8 @@ from rabia_tpu.core.types import CommandBatch, NodeId
 from rabia_tpu.engine import RabiaEngine
 from rabia_tpu.net.tcp import TcpNetwork
 
+from netwait import wait_connected, wait_full_mesh
+
 
 def _cfg(n: int = 1) -> RabiaConfig:
     return RabiaConfig(
@@ -40,12 +42,7 @@ class TestTransportBasics:
         try:
             ta.add_peer(b, "127.0.0.1", tb.port)
             tb.add_peer(a, "127.0.0.1", ta.port)
-            # wait for handshake
-            for _ in range(100):
-                if await ta.is_connected(b) and await tb.is_connected(a):
-                    break
-                await asyncio.sleep(0.05)
-            assert await ta.is_connected(b)
+            await wait_connected((ta, b), (tb, a))
             await ta.send_to(b, b"hello over tcp")
             sender, data = await tb.receive(timeout=5.0)
             assert sender == a
@@ -61,13 +58,10 @@ class TestTransportBasics:
         tb = TcpNetwork(b, TcpNetworkConfig(bind_port=0))
         try:
             ta.add_peer(b, "127.0.0.1", tb.port)
-            for _ in range(100):
-                if await ta.is_connected(b):
-                    break
-                await asyncio.sleep(0.05)
+            await wait_connected((ta, b))
             payload = bytes(range(256)) * 4096  # 1 MiB
             await ta.send_to(b, payload)
-            _, data = await tb.receive(timeout=10.0)
+            _, data = await tb.receive(timeout=15.0)
             assert data == payload
         finally:
             await ta.close()
@@ -82,14 +76,10 @@ class TestTransportBasics:
                 for j, b in enumerate(ids):
                     if i != j:
                         nets[i].add_peer(b, "127.0.0.1", nets[j].port)
-            for _ in range(200):
-                conn = [await n.get_connected_nodes() for n in nets]
-                if all(len(c) == 2 for c in conn):
-                    break
-                await asyncio.sleep(0.05)
+            await wait_full_mesh(nets, 2)
             await nets[0].broadcast(b"to everyone")
             for k in (1, 2):
-                sender, data = await nets[k].receive(timeout=5.0)
+                sender, data = await nets[k].receive(timeout=15.0)
                 assert sender == ids[0]
                 assert data == b"to everyone"
         finally:

@@ -26,6 +26,8 @@ import asyncio
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from netwait import wait_connected, wait_until
 import pytest
 
 from rabia_tpu.core.types import ABSENT, V0, V1, NodeId
@@ -129,16 +131,10 @@ class TestTransportBorrow:
             assert tb._zero_copy, "borrow API must engage by default"
             ta.add_peer(b, "127.0.0.1", tb.port)
             tb.add_peer(a, "127.0.0.1", ta.port)
-            for _ in range(100):
-                if await ta.is_connected(b):
-                    break
-                await asyncio.sleep(0.05)
+            await wait_connected((ta, b))
             payload = b"zero-copy vote frame \x00\x01\x02" * 7
             await ta.send_to(b, payload)
-            for _ in range(200):
-                if tb._pending:
-                    break
-                await asyncio.sleep(0.01)
+            await wait_until(lambda: tb._pending, desc="frame pending")
             sender, frame = tb._pending[0]
             assert isinstance(frame, _BorrowedFrame)
             # no-copy: the view the consumer reads IS the arena buffer
@@ -171,12 +167,9 @@ class TestTransportBorrow:
         try:
             ta.add_peer(b, "127.0.0.1", tb.port)
             tb.add_peer(a, "127.0.0.1", ta.port)
-            for _ in range(100):
-                if await ta.is_connected(b):
-                    break
-                await asyncio.sleep(0.05)
+            await wait_connected((ta, b))
             await ta.send_to(b, b"plain bytes path")
-            sender, data = await tb.receive(timeout=5.0)
+            sender, data = await tb.receive(timeout=15.0)
             assert isinstance(data, bytes)
             assert data == b"plain bytes path"
         finally:
@@ -196,16 +189,12 @@ class TestTransportBorrow:
         try:
             ta.add_peer(b, "127.0.0.1", tb.port)
             tb.add_peer(a, "127.0.0.1", ta.port)
-            for _ in range(100):
-                if await ta.is_connected(b):
-                    break
-                await asyncio.sleep(0.05)
+            await wait_connected((ta, b))
             for i in range(4):
                 await ta.send_to(b, f"pending-{i}".encode())
-            for _ in range(200):
-                if len(tb._pending) == 4:
-                    break
-                await asyncio.sleep(0.01)
+            await wait_until(
+                lambda: len(tb._pending) == 4, desc="4 frames pending"
+            )
         finally:
             await ta.close()
             await tb.close()
