@@ -200,10 +200,13 @@ def bench_mixed_set_get(
         "device_lane_decisions_per_sec": round(applied / dt, 1),
         "elapsed_s": round(dt, 3),
         "cycles": eng.cycles,
+        "vs_r04_same_workload": round(applied / dt / 92_000, 2),
         "note": (
             "kind-masked mixed windows: boundary-crossing FIFOs run "
             "full W-deep windows (one dispatch), GET planes download "
-            "only for the waves that hold GETs"
+            "only for the waves that hold GETs; mixed windows PIPELINE "
+            "(chained dispatch, worker-thread flags+meta fetch) like "
+            "the pure-SET lane"
         ),
     }
 
@@ -263,11 +266,13 @@ def bench_get_windows(
         "elapsed_s": round(dt, 3),
         "meta_bytes_per_op": 5,
         "r04_bytes_per_op": 73,
+        "vs_r04": round(waves * n_shards / dt / 153_000, 2),
         "note": (
             "meta-only GET readback (found bits + version words); value "
             "bytes resolve from host-retained SET segments keyed by "
             "(shard, version) — the value planes never cross the tunnel "
-            "in the steady state"
+            "in the steady state; GET windows PIPELINE (chained "
+            "lookup dispatch, worker-thread meta fetch)"
         ),
     }
 
@@ -615,6 +620,23 @@ def main() -> None:
             doc["mesh_engine_weak_scaling_r05"] = out
             path.write_text(json.dumps(doc, indent=1))
             print("recorded -> results.json mesh_engine_weak_scaling_r05")
+        return
+
+    if "--mixed-only" in sys.argv:
+        # re-measure the interleaved + GET-window lanes (a device-lane
+        # pipelining change doesn't require re-running the full bench)
+        mixed = bench_mixed_set_get()
+        print("mixed ->", mixed["device_lane_decisions_per_sec"], "dec/s")
+        getw = bench_get_windows()
+        print("get ->", getw["reads_per_sec"], "reads/s")
+        if "--record" in sys.argv:
+            path = Path(__file__).parent / "results.json"
+            doc = json.loads(path.read_text()) if path.exists() else {}
+            rec = doc.setdefault("mesh_engine_r05", {})
+            rec["mixed_set_get_device_lane"] = mixed
+            rec["get_windows_device_lane"] = getw
+            path.write_text(json.dumps(doc, indent=1))
+            print("recorded -> results.json mesh_engine_r05")
         return
 
     if "--governor-only" in sys.argv:

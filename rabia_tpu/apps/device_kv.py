@@ -618,9 +618,10 @@ class DeviceKVTable:
         return jax.jit(lookup, static_argnames=("W", "max_phases"))
 
     def lookup_window(self, alive, base, depth: int, klen, kwin, W: int,
-                      max_phases: int = 4):
+                      max_phases: int = 4, state=None):
         """Dispatch one consensus+lookup window against the CURRENT
-        table (read-only). Returns DEVICE handles
+        table (read-only; ``state`` overrides it so the pipelined lane
+        can chain on an in-flight window's output). Returns DEVICE handles
         ``(all_v1, found[W,S], ver[W,S], vlen[W,S], val_words[W,S,VW4])``
         — the caller fetches selectively: found+ver are ~5 bytes/op;
         the value planes (~70 bytes/op) only need to cross the tunnel
@@ -644,7 +645,7 @@ class DeviceKVTable:
             fn = self._build_lookup(kwin.shape[2])
             self._fused_cache[key] = fn
         return fn(
-            self.state,
+            self.state if state is None else state,
             self.kernel.place(jnp.asarray(alive)),
             jnp.asarray(base),
             jnp.int32(depth),
@@ -977,14 +978,16 @@ class DeviceKVTable:
 
     def mixed_apply(self, alive, base, depth: int, kind: np.ndarray,
                     get_waves: np.ndarray, ops: DeviceWindowOps, W: int,
-                    max_phases: int = 4):
+                    max_phases: int = 4, state=None):
         """Dispatch one mixed decide+apply+lookup window. Returns device
         handles ``(new_state, flags, meta, gval)`` where ``meta`` is
         i32[2, Gp, S] ([0]=version, [1]=(vlen<<1)|found) and ``gval``
         u32[Gp, S, VW4], both gathered to the ``get_waves`` rows (padded
         to a power of two; the caller maps real rows). The caller reads
         the 12-byte flags first and fetches meta/gval only on a clean
-        window."""
+        window. ``state`` overrides the table state to run against (the
+        pipelined lane chains on the previous in-flight window's
+        unresolved output, same as :meth:`decide_apply`)."""
         import jax.numpy as jnp
 
         if ops.klen.shape[0] < W:
@@ -1013,7 +1016,7 @@ class DeviceKVTable:
             self._fused_cache[key] = fn
         dev_ops = DeviceWindowOps(*(jnp.asarray(a) for a in ops))
         return fn(
-            self.state,
+            self.state if state is None else state,
             self.kernel.place(jnp.asarray(alive)),
             jnp.asarray(base),
             jnp.int32(depth),

@@ -91,6 +91,18 @@ class PartialWriteError(RabiaError):
 class TimeoutError_(RabiaError):  # trailing underscore: don't shadow builtin
     retryable = True
 
+    def __init__(self, op: str = "operation", timeout=None) -> None:
+        # every transport raises TimeoutError_("receive", timeout); a
+        # bare RabiaError.__init__ made that raise itself TypeError
+        msg = (
+            f"{op} timed out"
+            if timeout is None
+            else f"{op} timed out after {timeout}s"
+        )
+        super().__init__(msg)
+        self.op = op
+        self.timeout = timeout
+
 
 class SerializationError(RabiaError):
     pass

@@ -56,6 +56,7 @@ constexpr int kMaxDialAttempts = 5;                  // tcp.rs:57
 constexpr double kDialBaseDelayS = 0.1;              // tcp.rs:58
 constexpr double kDialMaxDelayS = 30.0;              // tcp.rs:60
 constexpr double kRedialPeriodS = 10.0;              // keepalive scan period
+constexpr double kStashTtlS = 10.0;  // stranded-frame redelivery window
 
 using Clock = std::chrono::steady_clock;
 
@@ -76,6 +77,23 @@ struct Conn {
   bool handshaken_in = false;  // peer id received
   bool handshake_sent = false;
   bool outbound = false;       // we dialed (vs accepted)
+  // simultaneous-dial duplicate that lost the deterministic tiebreak:
+  // no longer in `established` (new sends use the winner) but kept
+  // open to DRAIN — queued writes flush, then the write side
+  // half-closes; inbound frames keep delivering until the peer's
+  // symmetric shutdown EOFs the socket. An immediate ::close() here
+  // used to drop any frame in flight on the loser during the
+  // handshake race window (both sides briefly hold only the doomed
+  // connection), surfacing as a rare receive timeout under CPU load.
+  bool draining = false;
+  bool shut_wr = false;        // SHUT_WR already issued
+  double drain_deadline = 0.0;  // hard close if the peer never EOFs
+  // the raw 16-byte handshake id is ALWAYS the first wqueue element
+  // and is NOT length-prefixed: it must never be re-routed/stashed as
+  // a frame (the receiver would parse its first 4 bytes as a length
+  // and poison the winner connection). True until that first element
+  // fully flushes.
+  bool hs_in_queue = false;
   NodeIdBytes dial_target{};   // peer we dialed (valid when outbound)
   std::vector<uint8_t> rbuf;
   // framed bytes pending write. Shared: one broadcast frame is queued on
@@ -91,6 +109,14 @@ struct Peer {
   int attempts = 0;
   double next_dial = 0.0;
   bool connected = false;
+  // frames stranded on a connection that died before flushing, kept
+  // briefly for the next established connection to this peer (the
+  // simultaneous-dial duplicate teardown can EOF the loser while our
+  // frame is still in its wqueue and before the winner has finished
+  // its handshake — dropping there breaks "send after is_connected
+  // delivers" even though the peer is up). Expired by kStashTtlS.
+  std::deque<std::shared_ptr<std::vector<uint8_t>>> stash;
+  double stash_t = 0.0;
 };
 
 struct Transport {
@@ -217,6 +243,8 @@ struct Transport {
   void handle_readable(int fd);
   void handle_writable(int fd);
   void try_dials();
+  void drain_shutdown(int fd, Conn& c);
+  void sweep_draining();
   void dial(const NodeIdBytes& id, Peer& p);
   void close_conn(int fd);
   bool establish(int fd, Conn& c);  // false: conn was dropped (dup loser)
@@ -241,11 +269,44 @@ void Transport::arm_write(int fd, bool on) {
 void Transport::close_conn(int fd) {
   auto it = conns.find(fd);
   if (it == conns.end()) return;
-  if (it->second.handshaken_in) {
-    auto est = established.find(it->second.peer);
+  Conn& c = it->second;
+  if (c.hs_in_queue && !c.wqueue.empty()) {
+    // the raw handshake id is not a frame — never re-route it
+    c.wqueue.pop_front();
+    c.woff = 0;
+    c.hs_in_queue = false;
+  }
+  if (c.handshaken_in && !c.wqueue.empty()) {
+    // undelivered frames must not die with the socket when the peer is
+    // still reachable: re-route whole frames to the established winner
+    // (a partially written front frame arrives truncated and is
+    // discarded by the peer's length-prefix parser, so re-sending the
+    // whole frame cannot duplicate), or stash them briefly for the
+    // next connection when the winner's handshake hasn't finished yet.
+    auto est = established.find(c.peer);
+    if (est != established.end() && est->second != fd) {
+      auto wit = conns.find(est->second);
+      if (wit != conns.end()) {
+        for (auto& f : c.wqueue)
+          wit->second.wqueue.push_back(std::move(f));
+        arm_write(est->second, true);
+        c.wqueue.clear();
+      }
+    }
+    if (!c.wqueue.empty()) {
+      auto p = peers.find(c.peer);
+      if (p != peers.end()) {
+        for (auto& f : c.wqueue) p->second.stash.push_back(std::move(f));
+        p->second.stash_t = now_s();
+        c.wqueue.clear();
+      }
+    }
+  }
+  if (c.handshaken_in) {
+    auto est = established.find(c.peer);
     if (est != established.end() && est->second == fd) {
       established.erase(est);
-      auto p = peers.find(it->second.peer);
+      auto p = peers.find(c.peer);
       if (p != peers.end()) {
         p->second.connected = false;
         p->second.attempts = 0;
@@ -264,31 +325,63 @@ bool Transport::establish(int fd, Conn& c) {
     // simultaneous-dial duplicate: BOTH sides must deterministically keep
     // the same connection or they flap (each closing the one the other
     // kept). Rule: the connection dialed by the smaller node id wins.
+    // The loser is DRAINED, not closed (see Conn::draining): frames a
+    // peer sent on it during the race window must still deliver, and
+    // our queued writes on it must still flush.
     auto initiator = [&](const Conn& conn) -> const NodeIdBytes& {
       return conn.outbound ? self_id : conn.peer;
     };
     int old_fd = old->second;
     Conn& oldc = conns[old_fd];
     bool new_wins = initiator(c) < initiator(oldc);
-    if (!new_wins) {
-      // keep the old one; quietly drop the newcomer
-      epoll_ctl(epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
-      ::close(fd);
-      conns.erase(fd);
-      return false;
-    }
+    Conn& loser = new_wins ? oldc : c;
+    int loser_fd = new_wins ? old_fd : fd;
+    loser.draining = true;
+    loser.drain_deadline = now_s() + kRedialPeriodS;
+    drain_shutdown(loser_fd, loser);
+    if (!new_wins) return false;  // c lives on, draining
     established.erase(old);
-    epoll_ctl(epoll_fd, EPOLL_CTL_DEL, old_fd, nullptr);
-    ::close(old_fd);
-    conns.erase(old_fd);
   }
   established[c.peer] = fd;
   auto p = peers.find(c.peer);
   if (p != peers.end()) {
     p->second.connected = true;
     p->second.attempts = 0;
+    if (!p->second.stash.empty()) {
+      // frames stranded by a dying duplicate connection: deliver on
+      // this one unless the redelivery window lapsed (a long-dead peer
+      // should not receive stale protocol frames on reconnect —
+      // consensus retransmission owns that timescale)
+      bool fresh = now_s() - p->second.stash_t <= kStashTtlS;
+      for (auto& f : p->second.stash) {
+        if (fresh) c.wqueue.push_back(std::move(f));
+      }
+      p->second.stash.clear();
+      if (fresh) arm_write(fd, true);
+    }
   }
   return true;
+}
+
+void Transport::drain_shutdown(int fd, Conn& c) {
+  // half-close a draining loser once its queued writes flushed; the
+  // peer (running the same rule) does the same, and each side closes
+  // on the other's EOF — no frame in either direction is dropped
+  if (c.draining && !c.shut_wr && c.wqueue.empty()) {
+    ::shutdown(fd, SHUT_WR);
+    c.shut_wr = true;
+  }
+}
+
+void Transport::sweep_draining() {
+  // a draining peer that crashed mid-drain never EOFs us; reap on the
+  // deadline (same period as the redial scan)
+  double t = now_s();
+  std::vector<int> overdue;
+  for (auto& [fd, c] : conns) {
+    if (c.draining && t >= c.drain_deadline) overdue.push_back(fd);
+  }
+  for (int fd : overdue) close_conn(fd);
 }
 
 void Transport::handle_readable(int fd) {
@@ -317,7 +410,9 @@ void Transport::handle_readable(int fd) {
     memcpy(c.peer.data(), c.rbuf.data(), 16);
     c.handshaken_in = true;
     off = 16;
-    if (!establish(fd, c)) return;  // dup loser: conn object is gone
+    // a dup loser keeps draining: frames already on this socket still
+    // parse and deliver below (sender id is known now either way)
+    establish(fd, c);
   }
   while (c.rbuf.size() - off >= 4) {
     uint32_t len = static_cast<uint32_t>(c.rbuf[off]) |
@@ -359,6 +454,7 @@ void Transport::handle_writable(int fd) {
         auto sp = std::move(c.wqueue.front());
         c.wqueue.pop_front();
         c.woff = 0;
+        c.hs_in_queue = false;  // handshake is strictly first
         recycle_frame(std::move(sp));
       }
     } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
@@ -369,6 +465,7 @@ void Transport::handle_writable(int fd) {
     }
   }
   arm_write(fd, false);
+  drain_shutdown(fd, c);  // draining loser: flushed — half-close now
 }
 
 void Transport::enqueue_shared_locked(
@@ -423,6 +520,7 @@ void Transport::dial(const NodeIdBytes& id, Peer& p) {
   c.wqueue.push_back(
       std::make_shared<std::vector<uint8_t>>(self_id.begin(), self_id.end()));
   c.handshake_sent = true;
+  c.hs_in_queue = true;
   conns[fd] = std::move(c);
   epoll_event ev{};
   ev.events = EPOLLIN | EPOLLOUT;
@@ -489,6 +587,7 @@ void Transport::io_loop() {
           c.wqueue.push_back(std::make_shared<std::vector<uint8_t>>(
               self_id.begin(), self_id.end()));
           c.handshake_sent = true;
+          c.hs_in_queue = true;
           conns[cfd] = std::move(c);
           epoll_event ev{};
           ev.events = EPOLLIN | EPOLLOUT;
@@ -505,6 +604,7 @@ void Transport::io_loop() {
       if (e & EPOLLOUT) handle_writable(fd);
     }
     try_dials();
+    sweep_draining();
   }
 }
 

@@ -900,18 +900,16 @@ class MeshEngine:
             if k != head_kind:
                 break
             depth += 1
+        # mixed and GET windows PIPELINE like SET windows: they dispatch
+        # chained on the newest in-flight window's output state and join
+        # _dev_pipe. (They used to drain the pipe and read their
+        # flags/meta synchronously here, serializing a full tunnel
+        # round-trip per window — pipelining was worth ~2x on the
+        # pure-SET lane and applies unchanged to the other kinds.)
         if head_kind is None or depth < len(kinds):
-            applied = self._dev_drain_pipe()
-            if not self._dev_active:
-                return applied + self._run_cycle_inner()
-            return applied + self._run_cycle_fullwidth_device_mixed(
-                len(kinds)
-            )
+            return self._run_cycle_fullwidth_device_mixed(len(kinds))
         if head_kind == 2:
-            applied = self._dev_drain_pipe()
-            if not self._dev_active:
-                return applied + self._run_cycle_inner()
-            return applied + self._run_cycle_fullwidth_device_get(depth)
+            return self._run_cycle_fullwidth_device_get(depth)
         entries = [self._full_blocks[i] for i in range(depth)]  # peek
         ops = self._dev.pack_window_auto([e[0] for e in entries])
         if ops is None:
@@ -928,11 +926,7 @@ class MeshEngine:
         # Futures settle one window late (at resolution); a dirty flag
         # rolls back every optimistic window (the programs are
         # functional — nothing was adopted) and demotes.
-        state_base = (
-            self._dev_pipe[-1]["new_state"]
-            if self._dev_pipe
-            else self._dev.state
-        )
+        state_base = self._dev_chain_base()
         new_state, flags_dev = self._dev.decide_apply(
             self.alive, base, depth, ops, W=W,
             max_phases=self.max_phases, state=state_base,
@@ -975,8 +969,11 @@ class MeshEngine:
             1, self.max_decision_history // max(1, self.window)
         ):
             self._bulk_log.popleft()
-        self._dev_pipe.append(
+        sver_delta = np.zeros_like(self._dev_sver)
+        sver_delta[:n] = depth
+        return self._dev_push_window(
             {
+                "kind": "set",
                 "flags_fut": self._dev_fetcher().submit(np.asarray, flags_dev),
                 "new_state": new_state,
                 "entries": entries,
@@ -984,8 +981,26 @@ class MeshEngine:
                 "n": n,
                 "vers": vers,
                 "seg": seg,
+                "sver_delta": sver_delta,
             }
         )
+
+    def _dev_chain_base(self):
+        """Table state a new device window dispatches against: the
+        newest in-flight window's (unresolved) output, else the settled
+        table — shared by all three window kinds."""
+        return (
+            self._dev_pipe[-1]["new_state"]
+            if self._dev_pipe
+            else self._dev.state
+        )
+
+    def _dev_push_window(self, rec) -> int:
+        """Append an in-flight window record and enforce the pipe depth:
+        beyond one in-flight window, resolve the oldest (its flags have
+        had a full window's time to cross the tunnel). Owns the pipe
+        policy so the three dispatch paths cannot diverge."""
+        self._dev_pipe.append(rec)
         if len(self._dev_pipe) > 1:
             return self._dev_resolve_one()
         return 0
@@ -1016,12 +1031,16 @@ class MeshEngine:
     def _dev_resolve_one(self) -> int:
         """Resolve the OLDEST in-flight device window: read its flags,
         then settle (clean) or roll back the whole pipe and demote
-        (dirty). Returns batches applied by the resolved window."""
-        from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
-
+        (dirty). Handles all three window kinds ("set", "mixed", "get"
+        — see their dispatch methods). Returns batches applied by the
+        resolved window."""
         rec = self._dev_pipe[0]
-        flags = rec["flags_fut"].result()  # 12 bytes: the readback
-        if not flags[0] or flags[1] or flags[2]:
+        flags = rec["flags_fut"].result()  # <=12 bytes: the readback
+        if rec["kind"] == "get":
+            dirty = not int(flags)  # lookup returns the all_v1 scalar
+        else:
+            dirty = not flags[0] or flags[1] or flags[2]
+        if dirty:
             # roll back EVERY optimistic window, newest first — the
             # device state was never adopted, so restoring the host
             # bookkeeping re-creates the pre-window world exactly; the
@@ -1035,9 +1054,14 @@ class MeshEngine:
                 for e in reversed(r["entries"]):
                     self._full_blocks.appendleft(e)
                 self.next_slot[:rn] -= d
-                self._dev_sver[:rn] -= d
+                if r["sver_delta"] is not None:
+                    self._dev_sver -= r["sver_delta"]
                 self.decided_v1 -= d * rn
-                if self._dev_vseg and self._dev_vseg[-1] is r["seg"]:
+                if (
+                    r["seg"] is not None
+                    and self._dev_vseg
+                    and self._dev_vseg[-1] is r["seg"]
+                ):
                     self._dev_vseg.pop()
                     self._dev_vseg_bytes -= r["seg"].nbytes
                 # (an already-evicted segment only over-raised the
@@ -1066,88 +1090,52 @@ class MeshEngine:
             self._demote_device_store()
             return 0
         self._dev_pipe.pop(0)
+        # "get" windows are read-only: new_state is the (unchanged)
+        # state they chained on, so adopting is a no-op by value and
+        # keeps the pipe invariant uniform
         self._dev.adopt(rec["new_state"])
-        # settle futures from the derived version responses; counts==1
-        # per covered shard (pack_window enforced it), so group bounds
-        # are the identity
+        if rec["kind"] == "set":
+            self._dev_settle_set(rec)
+        elif rec["kind"] == "mixed":
+            self._dev_settle_mixed(rec)
+        else:
+            self._dev_settle_get(rec)
+        return rec["depth"] * rec["n"]
+
+    def _dev_settle_set(self, rec) -> None:
+        """Settle a clean pure-SET window's futures from the derived
+        version responses; counts==1 per covered shard (pack_window
+        enforced it), so group bounds are the identity."""
+        from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
+
         vers = rec["vers"]
         for t, (block, bfut, _inv) in enumerate(rec["entries"]):
             row = vers[t, np.asarray(block.shards, np.int64)]
             frames = VectorShardedKV._vers_frames(row)
             bounds = np.arange(len(block) + 1, dtype=np.int64)
             bfut._settle_bulk(FrameGroups(frames, bounds))
-        return rec["depth"] * rec["n"]
 
-    def _dev_drain_pipe(self) -> int:
-        """Resolve every in-flight device window (used before any
-        operation that needs the settled table: GET/mixed windows,
-        demotion, checkpointing, idle drain)."""
-        applied = 0
-        while self._dev_pipe and self._dev_active:
-            applied += self._dev_resolve_one()
-        return applied
-
-    def _run_cycle_fullwidth_device_get(self, depth: int) -> int:
-        """GET-only full-width windows through the device table's
-        read-only lookup program: consensus decides the slots and the
-        match gathers (found, version, value) per op in one dispatch —
-        no table mutation, no version advance, responses materialize
-        lazily from the readback. Anything outside the read envelope
-        (long keys, malformed ops) demotes exactly like the write lane.
-
-        Readback is META-ONLY in the steady state: found bits + version
-        words (~5 bytes/op). Value bytes resolve from the host-side
-        segments/seed (every version a GET can see was packed by this
-        host at SET time or seeded at re-promotion — (shard, version)
-        is unique content identity). Only when the vectorized
-        resolvability check finds an evicted version does the window
-        download the value planes (~70 bytes/op, the round-4 cost)."""
+    def _dev_settle_get(self, rec) -> None:
+        """Settle a clean GET window: meta (found/version) was fetched
+        on the worker alongside the flags; value bytes resolve from the
+        host-side segments unless an eviction between dispatch and
+        resolution forces the value-plane download (the device handles
+        were retained in the record for exactly that edge)."""
         from rabia_tpu.apps.device_kv import (
             GetFrameGroups,
             ResolvedGetFrameGroups,
         )
 
-        W = self.window
-        n = self.n_shards
-        entries = [self._full_blocks[i] for i in range(depth)]
-        packed = self._dev.pack_get_window([e[0] for e in entries])
-        if packed is None:
-            self._demote_device_store()
-            return self._run_cycle_inner()
-        base = np.zeros(self.S, np.int32)
-        base[:n] = self.next_slot
-        klen, kwin = packed
-        all_v1_d, found_d, ver_d, vlen_d, valw_d = self._dev.lookup_window(
-            self.alive, base, depth, klen, kwin, W=W,
-            max_phases=self.max_phases,
-        )
-        self._lat_invalidate |= (
-            self._dev.compiled_on_last_call and self._lat_timing
-        )
-        self.cycles += 1
-        if not int(np.asarray(all_v1_d)):
-            self._demote_device_store()
-            return self._run_cycle_inner()
-        found = np.asarray(found_d)
-        ver = np.asarray(ver_d)
+        depth = rec["depth"]
+        found, ver = rec["meta_fut"].result()
         resolved = not self._dev_unresolvable(found[:depth], ver[:depth])
-        if not resolved:
-            vlen = np.asarray(vlen_d)
-            valw = np.asarray(valw_d)
-        for _ in range(depth):
-            self._full_blocks.popleft()
-        start = self.next_slot.copy()
-        self.next_slot[:n] += depth
-        self.decided_v1 += depth * n
-        for t, (block, bfut, inv) in enumerate(entries):
-            self._bulk_log.append((start, t, block, inv))
-        while len(self._bulk_log) > max(
-            1, self.max_decision_history // max(1, self.window)
-        ):
-            self._bulk_log.popleft()
         if resolved:
             rsv = self._dev_make_resolver()
-        for t, (block, bfut, _inv) in enumerate(entries):
+        else:
+            vlen_d, valw_d = rec["val_dev"]
+            vlen = np.asarray(vlen_d)
+            valw = np.asarray(valw_d)
+        for t, (block, bfut, _inv) in enumerate(rec["entries"]):
             sh = np.asarray(block.shards, np.int64)
             if resolved:
                 bfut._settle_bulk(
@@ -1157,18 +1145,12 @@ class MeshEngine:
                 bfut._settle_bulk(
                     GetFrameGroups(sh, found[t], ver[t], vlen[t], valw[t])
                 )
-        return depth * n
 
-    def _run_cycle_fullwidth_device_mixed(self, count: int) -> int:
-        """Full-width window MIXING SET and GET ops (per op, via the
-        kind-masked fused program): SETs mutate the table, GETs read the
-        wave-entry state, one dispatch for the whole window. SET
-        response versions derive from the host mirror + the per-shard
-        cumulative SET count (clean window ⇒ every SET applied exactly
-        once); GET responses in the steady state carry META ONLY — value
-        bytes resolve from the host-side segments (this window's SETs
-        included, so reads of same-window writes resolve too), with the
-        value-plane download kept as the eviction fallback."""
+    def _dev_settle_mixed(self, rec) -> None:
+        """Settle a clean mixed window: SET versions derive from the
+        recorded per-wave cumulative counters; GET meta was fetched on
+        the worker; GET values resolve host-side with the downloaded
+        value planes as the eviction fallback."""
         from rabia_tpu.apps.device_kv import (
             GetFrameGroups,
             MixedFrameGroups,
@@ -1176,48 +1158,14 @@ class MeshEngine:
         )
         from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
 
-        W = self.window
-        n = self.n_shards
-        entries = [self._full_blocks[i] for i in range(count)]
-        packed = self._dev.pack_mixed_window([e[0] for e in entries])
-        if packed is None:
-            self._demote_device_store()
-            return self._run_cycle_inner()
-        kind, ops = packed
-        get_waves = np.nonzero((kind == 2).any(axis=1))[0].astype(np.int32)
-        base = np.zeros(self.S, np.int32)
-        base[:n] = self.next_slot
-        new_state, flags_dev, meta_dev, gval_dev = self._dev.mixed_apply(
-            self.alive, base, count, kind, get_waves, ops, W=W,
-            max_phases=self.max_phases,
-        )
-        self._lat_invalidate |= (
-            self._dev.compiled_on_last_call and self._lat_timing
-        )
-        self.cycles += 1
-        flags = np.asarray(flags_dev)
-        if not flags[0] or flags[1] or flags[2]:
-            self._demote_device_store()
-            return self._run_cycle_inner()
-        self._dev.adopt(new_state)
-        # derived SET versions: host mirror + inclusive per-shard SET
-        # count (GET waves advance nothing)
-        is_set = kind == 1  # [count, S]
-        set_cum = np.cumsum(is_set, axis=0, dtype=np.int64)
-        svers = self._dev_sver[None, : self.S] + set_cum
-        seg_start = self._dev_sver.copy()
-        self._dev_push_segment(
-            _MixedSeg(
-                seg_start, seg_start + set_cum[-1], ops.vlen, ops.vwin,
-                svers, kind,
-            )
-        )
+        kind = rec["kind_rows"]
+        svers = rec["svers"]
+        get_waves = rec["get_waves"]
+        is_set = kind == 1
         gpos = {int(t): j for j, t in enumerate(get_waves)}
         resolved = True
         if len(get_waves):
-            # one meta fetch (found/ver/vlen planes); value words stay
-            # on device unless an evicted version forces the fallback
-            meta_h = np.asarray(meta_dev)
+            meta_h = rec["meta_fut"].result()
             gver_h = meta_h[0]
             gvlen_h = meta_h[1] >> 1
             gfound_h = (meta_h[1] & 1).astype(bool)
@@ -1225,20 +1173,8 @@ class MeshEngine:
             if resolved:
                 rsv = self._dev_make_resolver()
             else:
-                gval_h = np.asarray(gval_dev)
-        self._dev_sver[: self.S] += set_cum[-1]
-        for _ in range(count):
-            self._full_blocks.popleft()
-        start = self.next_slot.copy()
-        self.next_slot[:n] += count
-        self.decided_v1 += count * n
-        for t, (block, bfut, inv) in enumerate(entries):
-            self._bulk_log.append((start, t, block, inv))
-        while len(self._bulk_log) > max(
-            1, self.max_decision_history // max(1, self.window)
-        ):
-            self._bulk_log.popleft()
-        for t, (block, bfut, _inv) in enumerate(entries):
+                gval_h = np.asarray(rec["gval_dev"])
+        for t, (block, bfut, _inv) in enumerate(rec["entries"]):
             sh = np.asarray(block.shards, np.int64)
             row_kind = kind[t]
             gf = None
@@ -1263,7 +1199,180 @@ class MeshEngine:
                 bfut._settle_bulk(
                     MixedFrameGroups(sh, row_kind, svers[t], gf)
                 )
-        return count * n
+
+    def _dev_drain_pipe(self) -> int:
+        """Resolve every in-flight device window (used before any
+        operation that needs the settled table: GET/mixed windows,
+        demotion, checkpointing, idle drain)."""
+        applied = 0
+        while self._dev_pipe and self._dev_active:
+            applied += self._dev_resolve_one()
+        return applied
+
+    def _run_cycle_fullwidth_device_get(self, depth: int) -> int:
+        """GET-only full-width windows through the device table's
+        read-only lookup program: consensus decides the slots and the
+        match gathers (found, version, value) per op in one dispatch —
+        no table mutation, no version advance, responses materialize
+        lazily from the readback. Anything outside the read envelope
+        (long keys, malformed ops) demotes exactly like the write lane.
+
+        Readback is META-ONLY in the steady state: found bits + version
+        words (~5 bytes/op). Value bytes resolve from the host-side
+        segments/seed (every version a GET can see was packed by this
+        host at SET time or seeded at re-promotion — (shard, version)
+        is unique content identity). Only when the vectorized
+        resolvability check finds an evicted version does the window
+        download the value planes (~70 bytes/op, the round-4 cost).
+
+        PIPELINED: the lookup chains on the newest in-flight window's
+        output state (reads observe every earlier window's SETs —
+        FIFO order), slot bookkeeping advances optimistically, and the
+        all_v1 scalar + meta planes cross the tunnel on the worker
+        thread; settlement/rollback live in :meth:`_dev_resolve_one`."""
+        W = self.window
+        n = self.n_shards
+        entries = [self._full_blocks[i] for i in range(depth)]
+        packed = self._dev.pack_get_window([e[0] for e in entries])
+        if packed is None:
+            # drain BEFORE demoting so in-flight windows' applied counts
+            # reach the caller (demote's internal drain discards them)
+            applied = self._dev_drain_pipe()
+            self._demote_device_store()
+            return applied + self._run_cycle_inner()
+        base = np.zeros(self.S, np.int32)
+        base[:n] = self.next_slot
+        klen, kwin = packed
+        state_base = self._dev_chain_base()
+        all_v1_d, found_d, ver_d, vlen_d, valw_d = self._dev.lookup_window(
+            self.alive, base, depth, klen, kwin, W=W,
+            max_phases=self.max_phases, state=state_base,
+        )
+        self._lat_invalidate |= (
+            self._dev.compiled_on_last_call and self._lat_timing
+        )
+        self.cycles += 1
+        for _ in range(depth):
+            self._full_blocks.popleft()
+        start = self.next_slot.copy()
+        self.next_slot[:n] += depth
+        self.decided_v1 += depth * n
+        for t, (block, bfut, inv) in enumerate(entries):
+            self._bulk_log.append((start, t, block, inv))
+        while len(self._bulk_log) > max(
+            1, self.max_decision_history // max(1, self.window)
+        ):
+            self._bulk_log.popleft()
+        pool = self._dev_fetcher()
+        return self._dev_push_window(
+            {
+                "kind": "get",
+                "flags_fut": pool.submit(np.asarray, all_v1_d),
+                "meta_fut": pool.submit(
+                    lambda f=found_d, v=ver_d: (np.asarray(f), np.asarray(v))
+                ),
+                "val_dev": (vlen_d, valw_d),
+                # read-only window: the chained state passes through
+                "new_state": state_base,
+                "entries": entries,
+                "depth": depth,
+                "n": n,
+                "seg": None,
+                "sver_delta": None,
+            }
+        )
+
+    def _run_cycle_fullwidth_device_mixed(self, count: int) -> int:
+        """Full-width window MIXING SET and GET ops (per op, via the
+        kind-masked fused program): SETs mutate the table, GETs read the
+        wave-entry state, one dispatch for the whole window. SET
+        response versions derive from the host mirror + the per-shard
+        cumulative SET count (clean window ⇒ every SET applied exactly
+        once); GET responses in the steady state carry META ONLY — value
+        bytes resolve from the host-side segments (this window's SETs
+        included, so reads of same-window writes resolve too), with the
+        value-plane download kept as the eviction fallback.
+
+        PIPELINED like the pure-SET lane: the dispatch chains on the
+        newest in-flight window's output state, bookkeeping advances
+        optimistically, and the flags + GET meta cross the tunnel on
+        the worker thread while the next window packs — settlement and
+        the dirty-rollback both live in :meth:`_dev_resolve_one` /
+        :meth:`_dev_settle_mixed`."""
+        W = self.window
+        n = self.n_shards
+        entries = [self._full_blocks[i] for i in range(count)]
+        packed = self._dev.pack_mixed_window([e[0] for e in entries])
+        if packed is None:
+            # drain BEFORE demoting so in-flight windows' applied counts
+            # reach the caller (demote's internal drain discards them)
+            applied = self._dev_drain_pipe()
+            self._demote_device_store()
+            return applied + self._run_cycle_inner()
+        kind, ops = packed
+        get_waves = np.nonzero((kind == 2).any(axis=1))[0].astype(np.int32)
+        base = np.zeros(self.S, np.int32)
+        base[:n] = self.next_slot
+        state_base = self._dev_chain_base()
+        new_state, flags_dev, meta_dev, gval_dev = self._dev.mixed_apply(
+            self.alive, base, count, kind, get_waves, ops, W=W,
+            max_phases=self.max_phases, state=state_base,
+        )
+        self._lat_invalidate |= (
+            self._dev.compiled_on_last_call and self._lat_timing
+        )
+        self.cycles += 1
+        # derived SET versions: host mirror + inclusive per-shard SET
+        # count (GET waves advance nothing)
+        is_set = kind == 1  # [count, S]
+        set_cum = np.cumsum(is_set, axis=0, dtype=np.int64)
+        svers = self._dev_sver[None, : self.S] + set_cum
+        seg_start = self._dev_sver.copy()
+        seg = _MixedSeg(
+            seg_start, seg_start + set_cum[-1], ops.vlen, ops.vwin,
+            svers, kind,
+        )
+        self._dev_push_segment(seg)
+        sver_delta = np.zeros_like(self._dev_sver)
+        sver_delta[: self.S] = set_cum[-1]
+        self._dev_sver += sver_delta
+        for _ in range(count):
+            self._full_blocks.popleft()
+        start = self.next_slot.copy()
+        self.next_slot[:n] += count
+        self.decided_v1 += count * n
+        for t, (block, bfut, inv) in enumerate(entries):
+            self._bulk_log.append((start, t, block, inv))
+        while len(self._bulk_log) > max(
+            1, self.max_decision_history // max(1, self.window)
+        ):
+            self._bulk_log.popleft()
+        pool = self._dev_fetcher()
+        return self._dev_push_window(
+            {
+                "kind": "mixed",
+                "flags_fut": pool.submit(np.asarray, flags_dev),
+                # meta fetched optimistically alongside the flags (a
+                # dirty window wastes one small transfer — the rollback
+                # edge); value planes stay on device unless eviction
+                # forces the fallback at settle time
+                "meta_fut": (
+                    pool.submit(np.asarray, meta_dev)
+                    if len(get_waves)
+                    else None
+                ),
+                "gval_dev": gval_dev if len(get_waves) else None,
+                "new_state": new_state,
+                "entries": entries,
+                "depth": count,
+                "n": n,
+                "kind_rows": kind,
+                "svers": svers,
+                "get_waves": get_waves,
+                "seg": seg,
+                "sver_delta": sver_delta,
+            }
+        )
 
     def _dev_push_segment(self, seg) -> None:
         """Retain one committed device window's value bytes (a

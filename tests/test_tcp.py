@@ -87,6 +87,36 @@ class TestTransportBasics:
                 await n.close()
 
 
+class TestSimultaneousDialDrain:
+    @pytest.mark.asyncio
+    async def test_send_in_dup_race_window_not_lost(self):
+        """Both sides dial at once, and the sender fires the moment ITS
+        side reports connected — possibly on the duplicate connection
+        that the deterministic smaller-id-wins tiebreak is about to
+        cull. Pre-round-5 the loser was ::close()d immediately, so a
+        frame in flight on it was silently dropped (a rare receive
+        timeout under CPU load, a different test each run); the drain
+        path (native/transport.cpp Conn::draining) must deliver it.
+        Probabilistic pin: each iteration reopens the race window."""
+        for i in range(25):
+            a = NodeId.from_int(1000 + 2 * i)
+            b = NodeId.from_int(1001 + 2 * i)
+            ta = TcpNetwork(a, TcpNetworkConfig(bind_port=0))
+            tb = TcpNetwork(b, TcpNetworkConfig(bind_port=0))
+            try:
+                # both add_peer -> both dial -> duplicate resolution
+                ta.add_peer(b, "127.0.0.1", tb.port)
+                tb.add_peer(a, "127.0.0.1", ta.port)
+                await wait_connected((ta, b))  # ONE side only, on purpose
+                await ta.send_to(b, b"race window frame")
+                sender, data = await tb.receive(timeout=15.0)
+                assert sender == a, i
+                assert data == b"race window frame", i
+            finally:
+                await ta.close()
+                await tb.close()
+
+
 class TestConsensusOverTcp:
     @pytest.mark.asyncio
     async def test_three_node_cluster_commits(self):
