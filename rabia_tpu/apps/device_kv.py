@@ -50,7 +50,7 @@ the host store but the observable key->(value, version) mapping cannot.
 from __future__ import annotations
 
 from collections.abc import Sequence
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Union
 
 import numpy as np
 
@@ -565,6 +565,38 @@ class DeviceKVTable:
             np.ascontiguousarray(vwin_w).view(np.uint32),
         )
 
+    def pack_mixed_window_auto(self, blocks) -> Optional[tuple]:
+        """Mixed window with the dictionary-compressed upload when the
+        stream repeats enough to pay off, else row-packed; None demotes.
+
+        Returns ``(kind, ops, vlen_plane, vwin_plane)`` where ``ops``
+        is :class:`DeviceDictOps` or :class:`DeviceWindowOps` and the
+        two planes are the FULL per-wave value planes — the engine's
+        host-side value segments need them regardless of how the ops
+        crossed the tunnel (a GET answers from (shard, version) →
+        bytes, which only the uncompressed planes provide). One gather
+        pass serves the dict attempt, the row fallback, and the
+        segment planes."""
+        g = self._gather_window(blocks, "mixed")
+        if g is None:
+            return None
+        kind_w, klen_w, vlen_w, kwin_w, vwin_w = g
+        vwin_u32 = np.ascontiguousarray(vwin_w).view(np.uint32)
+        d = self._dict_from_gathered(g)
+        if d is not None:
+            return kind_w, d, vlen_w, vwin_u32
+        return (
+            kind_w,
+            DeviceWindowOps(
+                klen_w,
+                vlen_w,
+                np.ascontiguousarray(kwin_w).view(np.uint32),
+                vwin_u32,
+            ),
+            vlen_w,
+            vwin_u32,
+        )
+
     # -- the fused programs --------------------------------------------------
 
     def _build_lookup(self, Ku4: int):
@@ -863,7 +895,8 @@ class DeviceKVTable:
             max_phases=max_phases,
         )
 
-    def _build_mixed(self, Ku4: int, VWu4: int, Gp: int):
+    def _build_mixed(self, Ku4: int, VWu4: int, Gp: int,
+                     D: Optional[int] = None):
         """Jitted MIXED window: consensus + per-op kind mask over the
         same table — SET ops mutate (identical update rules to
         :meth:`_build_fused`), GET ops read the wave-entry state (reads
@@ -876,7 +909,13 @@ class DeviceKVTable:
         into one two-plane i32 tensor, so the readback is two transfers
         — not four take-dispatch round-trips over the ~12MB/s tunnel
         (measured: the four separate fetches cost ~0.5s per window,
-        more than the window's compute)."""
+        more than the window's compute).
+
+        ``D`` selects the DICTIONARY-compressed upload variant: ops
+        arrive as per-shard distinct rows + a rank per (wave, shard)
+        (:class:`DeviceDictOps` — GET ops are (key, empty value) rows),
+        expanded on device exactly like the pure-SET dict program. Same
+        table math either way; only the upload shape differs."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -900,12 +939,9 @@ class DeviceKVTable:
             )
             all_v1 = jnp.all(jnp.where(present, decided == V1, True))
 
-            kwin_full = jnp.pad(ops.kwin, ((0, 0), (0, 0), (0, K4 - Ku4)))
-            vwin_full = jnp.pad(ops.vwin, ((0, 0), (0, 0), (0, VW4 - VWu4)))
-
-            def wave_step(carry, inp):
+            def step_body(carry, ok_w, kind_t, klen_t, vlen_t, kwin_t,
+                          vwin_t):
                 used, keyw, klen, ver, valw, vlen, sver = carry
-                ok_w, kind_t, klen_t, vlen_t, kwin_t, vwin_t = inp
                 klen_t = klen_t.astype(jnp.int32)
                 vlen_t = vlen_t.astype(jnp.int32)
                 kind_t = kind_t.astype(jnp.int32)
@@ -951,10 +987,54 @@ class DeviceKVTable:
                     gval,
                 )
 
+            if D is None:
+                # row-packed: per-wave planes uploaded directly
+                kwin_full = jnp.pad(
+                    ops.kwin, ((0, 0), (0, 0), (0, K4 - Ku4))
+                )
+                vwin_full = jnp.pad(
+                    ops.vwin, ((0, 0), (0, 0), (0, VW4 - VWu4))
+                )
+                xs = (
+                    present, kind_w, ops.klen, ops.vlen, kwin_full,
+                    vwin_full,
+                )
+
+                def wave_step(carry, inp):
+                    ok_w, kind_t, klen_t, vlen_t, kwin_t, vwin_t = inp
+                    return step_body(
+                        carry, ok_w, kind_t, klen_t, vlen_t, kwin_t, vwin_t
+                    )
+            else:
+                # dictionary-packed: expand each wave's per-shard rank
+                # into the shard's dictionary row (same one-hot select
+                # as the pure-SET dict program — GET rows are just
+                # (key, empty value) dictionary entries)
+                dk_full = jnp.pad(ops.dk, ((0, 0), (0, 0), (0, K4 - Ku4)))
+                dv_full = jnp.pad(
+                    ops.dv, ((0, 0), (0, 0), (0, VW4 - VWu4))
+                )
+                dkl = ops.dkl.astype(I32)
+                dvl = ops.dvl.astype(I32)
+                dr = jnp.arange(D, dtype=I32)[None, :]
+                xs = (present, kind_w, ops.idx)
+
+                def wave_step(carry, inp):
+                    ok_w, kind_t, idx_w = inp
+                    oh = idx_w.astype(I32)[:, None] == dr  # [S, D]
+                    ohu = oh.astype(jnp.uint32)[:, :, None]
+                    return step_body(
+                        carry,
+                        ok_w,
+                        kind_t,
+                        (dkl * oh).sum(1),
+                        (dvl * oh).sum(1),
+                        (dk_full * ohu).sum(1),
+                        (dv_full * ohu).sum(1),
+                    )
+
             new_state, (over_w, gfound, gver, gvlen, gval) = lax.scan(
-                wave_step,
-                state,
-                (present, kind_w, ops.klen, ops.vlen, kwin_full, vwin_full),
+                wave_step, state, xs
             )
             flags = jnp.stack(
                 [
@@ -977,7 +1057,8 @@ class DeviceKVTable:
         return jax.jit(mixed, static_argnames=("W", "max_phases"))
 
     def mixed_apply(self, alive, base, depth: int, kind: np.ndarray,
-                    get_waves: np.ndarray, ops: DeviceWindowOps, W: int,
+                    get_waves: np.ndarray,
+                    ops: Union[DeviceWindowOps, DeviceDictOps], W: int,
                     max_phases: int = 4, state=None):
         """Dispatch one mixed decide+apply+lookup window. Returns device
         handles ``(new_state, flags, meta, gval)`` where ``meta`` is
@@ -990,11 +1071,17 @@ class DeviceKVTable:
         unresolved output, same as :meth:`decide_apply`)."""
         import jax.numpy as jnp
 
-        if ops.klen.shape[0] < W:
+        is_dict = isinstance(ops, DeviceDictOps)
+        if is_dict:
+            if ops.idx.shape[0] < W:
+                pad = W - ops.idx.shape[0]
+                ops = ops._replace(
+                    idx=np.concatenate(
+                        [ops.idx, np.zeros((pad, ops.idx.shape[1]), np.uint8)]
+                    )
+                )
+        elif ops.klen.shape[0] < W:
             pad = W - ops.klen.shape[0]
-            kind = np.concatenate(
-                [kind, np.zeros((pad, kind.shape[1]), kind.dtype)]
-            )
             ops = DeviceWindowOps(
                 *(
                     np.concatenate(
@@ -1003,18 +1090,28 @@ class DeviceKVTable:
                     for a in ops
                 )
             )
+        if kind.shape[0] < W:
+            kind = np.concatenate(
+                [kind, np.zeros((W - kind.shape[0], kind.shape[1]), kind.dtype)]
+            )
         Gp = 1
         while Gp < max(1, len(get_waves)):
             Gp <<= 1
         gidx = np.zeros(Gp, np.int32)
         gidx[: len(get_waves)] = get_waves
-        key = ("mix", W, ops.kwin.shape[2], ops.vwin.shape[2], Gp)
+        if is_dict:
+            D = ops.dkl.shape[1]
+            key = ("mixdict", W, ops.dk.shape[2], ops.dv.shape[2], Gp, D)
+            build = lambda: self._build_mixed(key[2], key[3], Gp, D)
+        else:
+            key = ("mix", W, ops.kwin.shape[2], ops.vwin.shape[2], Gp)
+            build = lambda: self._build_mixed(key[2], key[3], Gp)
         fn = self._fused_cache.get(key)
         self.compiled_on_last_call = fn is None
         if fn is None:
-            fn = self._build_mixed(key[2], key[3], Gp)
+            fn = build()
             self._fused_cache[key] = fn
-        dev_ops = DeviceWindowOps(*(jnp.asarray(a) for a in ops))
+        dev_ops = type(ops)(*(jnp.asarray(a) for a in ops))
         return fn(
             self.state if state is None else state,
             self.kernel.place(jnp.asarray(alive)),

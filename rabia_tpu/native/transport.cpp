@@ -596,11 +596,17 @@ void Transport::io_loop() {
         }
         continue;
       }
+      // read BEFORE acting on HUP: a peer's final frames can land in
+      // the same epoll event as its FIN (EPOLLIN|EPOLLHUP — routine on
+      // a draining duplicate connection whose both halves are shut).
+      // Closing first would discard them unread from the kernel
+      // buffer; handle_readable drains to EOF and closes the conn
+      // itself, making the HUP branch a no-op for that fd.
+      if (e & EPOLLIN) handle_readable(fd);
       if (e & (EPOLLHUP | EPOLLERR)) {
         close_conn(fd);
         continue;
       }
-      if (e & EPOLLIN) handle_readable(fd);
       if (e & EPOLLOUT) handle_writable(fd);
     }
     try_dials();

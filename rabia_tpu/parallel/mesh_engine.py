@@ -1302,14 +1302,14 @@ class MeshEngine:
         W = self.window
         n = self.n_shards
         entries = [self._full_blocks[i] for i in range(count)]
-        packed = self._dev.pack_mixed_window([e[0] for e in entries])
+        packed = self._dev.pack_mixed_window_auto([e[0] for e in entries])
         if packed is None:
             # drain BEFORE demoting so in-flight windows' applied counts
             # reach the caller (demote's internal drain discards them)
             applied = self._dev_drain_pipe()
             self._demote_device_store()
             return applied + self._run_cycle_inner()
-        kind, ops = packed
+        kind, ops, vlen_plane, vwin_plane = packed
         get_waves = np.nonzero((kind == 2).any(axis=1))[0].astype(np.int32)
         base = np.zeros(self.S, np.int32)
         base[:n] = self.next_slot
@@ -1329,7 +1329,7 @@ class MeshEngine:
         svers = self._dev_sver[None, : self.S] + set_cum
         seg_start = self._dev_sver.copy()
         seg = _MixedSeg(
-            seg_start, seg_start + set_cum[-1], ops.vlen, ops.vwin,
+            seg_start, seg_start + set_cum[-1], vlen_plane, vwin_plane,
             svers, kind,
         )
         self._dev_push_segment(seg)

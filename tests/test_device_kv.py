@@ -716,6 +716,58 @@ class TestDeviceGetWindows:
         for sm in dev.sms:
             assert _store_content(sm, n) == want
 
+    def test_mixed_window_dict_upload_engages_and_conforms(self):
+        # a repetitive INTERLEAVED stream takes the dictionary upload
+        # through the MIXED program (GET ops become (key, empty value)
+        # dictionary rows); responses stay byte-identical to the host
+        # path. Pins that pack_mixed_window_auto actually chooses the
+        # dict form — a silent permanent row fallback would pass every
+        # conformance test while giving up the 10x upload compression.
+        from rabia_tpu.apps.device_kv import DeviceDictOps
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        n = 4
+        dev = _mk(n, device=True, window=6)
+        host = _mk(n, device=False, window=6)
+
+        def stream():
+            out = []
+            for w in range(4):
+                out.append(
+                    build_block(
+                        list(range(n)),
+                        [
+                            [encode_set_bin(f"k{s % 2}", "v")]
+                            for s in range(n)
+                        ],
+                    )
+                )
+                out.append(
+                    build_block(
+                        list(range(n)),
+                        [[self._enc_get(f"k{s % 2}")] for s in range(n)],
+                    )
+                )
+            return out
+
+        # the packer must choose the dictionary form for this window
+        blocks = stream()[:6]
+        packed = dev._dev.pack_mixed_window_auto(blocks)
+        assert packed is not None
+        assert isinstance(packed[1], DeviceDictOps)
+
+        fd = [dev.submit_block(b) for b in stream()]
+        fh = [host.submit_block(b) for b in stream()]
+        dev.flush()
+        host.flush()
+        assert dev._dev_active, "dict-mixed window demoted the lane"
+        for a, b in zip(fd, fh):
+            assert _frames(a) == _frames(b)
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+
     def test_long_key_get_demotes_byte_identical(self):
         n = 4
         dev = _mk(n, device=True)
