@@ -127,6 +127,24 @@ class DeviceDictOps(NamedTuple):
     dv: np.ndarray  # u32[S, D, VWu/4] value words
 
 
+def _pad_dict_idx(ops: DeviceDictOps, W: int) -> DeviceDictOps:
+    """Pad the per-(wave, shard) rank plane to the static window size.
+
+    Pad waves carry rank 0; that aliases a real dictionary row, but
+    every consumer gates on the in-program depth mask (pad waves are
+    not ``present``), so the expanded row is never applied or matched.
+    Shared by all three dict dispatch paths so the pad semantics cannot
+    diverge."""
+    if ops.idx.shape[0] < W:
+        pad = W - ops.idx.shape[0]
+        ops = ops._replace(
+            idx=np.concatenate(
+                [ops.idx, np.zeros((pad, ops.idx.shape[1]), np.uint8)]
+            )
+        )
+    return ops
+
+
 def _get_frame(found: bool, ver: int, val: bytes) -> bytes:
     """One GET response frame, byte-for-byte the host store's framing
     (`_result_bin`) — shared by every lazy GET view so the encoding
@@ -534,15 +552,6 @@ class DeviceKVTable:
             return d
         return self._rows_from_gathered(g)
 
-    def pack_get_window(self, blocks) -> Optional[tuple]:
-        """Pack GET-only blocks into lookup inputs: ``(klen i16[W, S],
-        kwin u32[W, S, Ku/4])``, or None (caller demotes)."""
-        g = self._gather_window(blocks, "get")
-        if g is None:
-            return None
-        _kind, klen_w, _vlen, kwin_w, _vwin = g
-        return klen_w, np.ascontiguousarray(kwin_w).view(np.uint32)
-
     def pack_mixed_window(self, blocks) -> Optional[tuple]:
         """Pack blocks whose ops are ANY interleaving of binary SET and
         GET — per op, not per block — into one device window.
@@ -599,9 +608,11 @@ class DeviceKVTable:
 
     # -- the fused programs --------------------------------------------------
 
-    def _build_lookup(self, Ku4: int):
+    def _build_lookup(self, Ku4: int, D: Optional[int] = None):
         """Jitted GET window: consensus slot window + a read-only match
-        over the table (no state mutation, no version advance)."""
+        over the table (no state mutation, no version advance). ``D``
+        selects the dictionary-upload variant (per-shard distinct keys
+        + a rank per (wave, shard), expanded on device)."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -625,10 +636,8 @@ class DeviceKVTable:
                 votes, alive, base, n_slots=W, max_phases=max_phases
             )
             all_v1 = jnp.all(jnp.where(present, decided == V1, True))
-            kwin_full = jnp.pad(kwin_t, ((0, 0), (0, 0), (0, K4 - Ku4)))
 
-            def wave_match(_, inp):
-                klen_w, kwin_w = inp  # [S], [S, K4]
+            def match_body(klen_w, kwin_w):
                 klen_w = klen_w.astype(jnp.int32)
                 eq = (
                     used
@@ -640,20 +649,62 @@ class DeviceKVTable:
                 rver = (ver * oh).sum(1)
                 rvlen = (vlen * oh).sum(1)
                 rval = (valw * oh[:, :, None]).sum(1)  # [S, VW4] u32
-                return None, (found, rver, rvlen, rval)
+                return found, rver, rvlen, rval
 
-            _, (found, rver, rvlen, rval) = lax.scan(
-                wave_match, None, (klen_t, kwin_full)
-            )
+            if D is None:
+                kwin_full = jnp.pad(
+                    kwin_t, ((0, 0), (0, 0), (0, K4 - Ku4))
+                )
+                xs = (klen_t, kwin_full)
+
+                def wave_match(_, inp):
+                    return None, match_body(*inp)
+            else:
+                # dictionary upload: klen_t is (idx, dkl, dk) — the key
+                # dictionary only, value planes never cross the tunnel
+                # here; expand each wave's per-shard rank into the
+                # shard's distinct key row (GET streams repeat keys
+                # like SET streams repeat rows)
+                idx, dkl_raw, dk_raw = klen_t
+                dk_full = jnp.pad(dk_raw, ((0, 0), (0, 0), (0, K4 - Ku4)))
+                dkl = dkl_raw.astype(I32)
+                dr = jnp.arange(D, dtype=I32)[None, :]
+                xs = (idx,)
+
+                def wave_match(_, inp):
+                    (idx_w,) = inp
+                    oh = idx_w.astype(I32)[:, None] == dr  # [S, D]
+                    ohu = oh.astype(jnp.uint32)[:, :, None]
+                    return None, match_body(
+                        (dkl * oh).sum(1), (dk_full * ohu).sum(1)
+                    )
+
+            _, (found, rver, rvlen, rval) = lax.scan(wave_match, None, xs)
             return all_v1.astype(I32), found, rver, rvlen, rval
 
         return jax.jit(lookup, static_argnames=("W", "max_phases"))
 
-    def lookup_window(self, alive, base, depth: int, klen, kwin, W: int,
+    def pack_get_window_auto(self, blocks):
+        """GET window with the dictionary-compressed upload when the key
+        stream repeats enough, else the row-packed ``(klen, kwin)``
+        pair; None demotes. One gather pass serves both attempts."""
+        g = self._gather_window(blocks, "get")
+        if g is None:
+            return None
+        d = self._dict_from_gathered(g)
+        if d is not None:
+            return d
+        _kind, klen_w, _vlen, kwin_w, _vwin = g
+        return klen_w, np.ascontiguousarray(kwin_w).view(np.uint32)
+
+    def lookup_window(self, alive, base, depth: int, ops, W: int,
                       max_phases: int = 4, state=None):
         """Dispatch one consensus+lookup window against the CURRENT
         table (read-only; ``state`` overrides it so the pipelined lane
-        can chain on an in-flight window's output). Returns DEVICE handles
+        can chain on an in-flight window's output). ``ops`` is either a
+        row-packed ``(klen i16[W,S], kwin u32[W,S,Ku4])`` pair or a
+        :class:`DeviceDictOps` (key dictionary; value planes unused).
+        Returns DEVICE handles
         ``(all_v1, found[W,S], ver[W,S], vlen[W,S], val_words[W,S,VW4])``
         — the caller fetches selectively: found+ver are ~5 bytes/op;
         the value planes (~70 bytes/op) only need to cross the tunnel
@@ -662,6 +713,34 @@ class DeviceKVTable:
         edge case, not the steady state."""
         import jax.numpy as jnp
 
+        if isinstance(ops, DeviceDictOps):
+            ops = _pad_dict_idx(ops, W)
+            D = ops.dkl.shape[1]
+            key = ("getdict", W, ops.dk.shape[2], D)
+            fn = self._fused_cache.get(key)
+            self.compiled_on_last_call = fn is None
+            if fn is None:
+                fn = self._build_lookup(key[2], D)
+                self._fused_cache[key] = fn
+            # only the key dictionary crosses the tunnel: the lookup
+            # never reads values, and uploading the dead dv plane would
+            # cost as much as the keys themselves at D=32
+            kdict = (
+                jnp.asarray(ops.idx),
+                jnp.asarray(ops.dkl),
+                jnp.asarray(ops.dk),
+            )
+            return fn(
+                self.state if state is None else state,
+                self.kernel.place(jnp.asarray(alive)),
+                jnp.asarray(base),
+                jnp.int32(depth),
+                kdict,
+                None,
+                W=W,
+                max_phases=max_phases,
+            )
+        klen, kwin = ops
         if klen.shape[0] < W:
             pad = W - klen.shape[0]
             klen = np.concatenate(
@@ -1073,13 +1152,7 @@ class DeviceKVTable:
 
         is_dict = isinstance(ops, DeviceDictOps)
         if is_dict:
-            if ops.idx.shape[0] < W:
-                pad = W - ops.idx.shape[0]
-                ops = ops._replace(
-                    idx=np.concatenate(
-                        [ops.idx, np.zeros((pad, ops.idx.shape[1]), np.uint8)]
-                    )
-                )
+            ops = _pad_dict_idx(ops, W)
         elif ops.klen.shape[0] < W:
             pad = W - ops.klen.shape[0]
             ops = DeviceWindowOps(
@@ -1128,13 +1201,7 @@ class DeviceKVTable:
                            W: int, max_phases: int, state=None):
         import jax.numpy as jnp
 
-        if ops.idx.shape[0] < W:
-            pad = W - ops.idx.shape[0]
-            ops = ops._replace(
-                idx=np.concatenate(
-                    [ops.idx, np.zeros((pad, ops.idx.shape[1]), np.uint8)]
-                )
-            )
+        ops = _pad_dict_idx(ops, W)
         D = ops.dkl.shape[1]
         key = ("dictset", W, ops.dk.shape[2], ops.dv.shape[2], D)
         fn = self._fused_cache.get(key)

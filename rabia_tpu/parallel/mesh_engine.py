@@ -1233,7 +1233,7 @@ class MeshEngine:
         W = self.window
         n = self.n_shards
         entries = [self._full_blocks[i] for i in range(depth)]
-        packed = self._dev.pack_get_window([e[0] for e in entries])
+        packed = self._dev.pack_get_window_auto([e[0] for e in entries])
         if packed is None:
             # drain BEFORE demoting so in-flight windows' applied counts
             # reach the caller (demote's internal drain discards them)
@@ -1242,10 +1242,9 @@ class MeshEngine:
             return applied + self._run_cycle_inner()
         base = np.zeros(self.S, np.int32)
         base[:n] = self.next_slot
-        klen, kwin = packed
         state_base = self._dev_chain_base()
         all_v1_d, found_d, ver_d, vlen_d, valw_d = self._dev.lookup_window(
-            self.alive, base, depth, klen, kwin, W=W,
+            self.alive, base, depth, packed, W=W,
             max_phases=self.max_phases, state=state_base,
         )
         self._lat_invalidate |= (

@@ -716,6 +716,45 @@ class TestDeviceGetWindows:
         for sm in dev.sms:
             assert _store_content(sm, n) == want
 
+    def test_get_window_dict_upload_engages_and_conforms(self):
+        # a repetitive GET stream takes the dictionary-compressed key
+        # upload (keys repeat like SET rows repeat); responses stay
+        # byte-identical to the host path. Pins that
+        # pack_get_window_auto actually chooses the dict form.
+        from rabia_tpu.apps.device_kv import DeviceDictOps
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        n = 4
+        dev = _mk(n, device=True, window=4)
+        host = _mk(n, device=False, window=4)
+        for e in (dev, host):
+            e.submit_block(
+                build_block(
+                    list(range(n)),
+                    [[encode_set_bin(f"k{s % 2}", "v")] for s in range(n)],
+                )
+            )
+            e.flush()
+
+        def gets():
+            return [
+                build_block(
+                    list(range(n)),
+                    [[self._enc_get(f"k{s % 2}")] for s in range(n)],
+                )
+                for _ in range(8)
+            ]
+
+        packed = dev._dev.pack_get_window_auto(gets()[:4])
+        assert isinstance(packed, DeviceDictOps)
+        fd = [dev.submit_block(b) for b in gets()]
+        fh = [host.submit_block(b) for b in gets()]
+        dev.flush()
+        host.flush()
+        assert dev._dev_active, "dict-GET window demoted the lane"
+        for a, b in zip(fd, fh):
+            assert _frames(a) == _frames(b)
+
     def test_mixed_window_dict_upload_engages_and_conforms(self):
         # a repetitive INTERLEAVED stream takes the dictionary upload
         # through the MIXED program (GET ops become (key, empty value)
