@@ -252,25 +252,38 @@ class ResolvedGetFrameGroups(_ShardFrameGroups):
 
 
 class MixedFrameGroups(_ShardFrameGroups):
-    """Lazy per-shard responses for one MIXED wave (SET and GET ops in
-    the same wave): SET ops answer with the derived 6-byte version
-    frame (byte-identical to ``VectorShardedKV._vers_frames``), GET ops
-    with the host store's GET framing over the lookup readback. One
-    object per block, frames materialize on client read."""
+    """Lazy per-shard responses for one MIXED wave (SET/GET/DEL/EXISTS
+    ops in the same wave): SET ops answer with the derived 6-byte
+    version frame (byte-identical to ``VectorShardedKV._vers_frames``),
+    GET ops with the host store's GET framing over the lookup readback,
+    DEL/EXISTS with their found-bit framing (byte-identical to the
+    vector store's ``apply_op_bin``). One object per block, frames
+    materialize on client read."""
 
     __slots__ = ("shards", "kind", "svers", "_get")
 
     def __init__(self, shards, kind_row, set_vers, get_frames) -> None:
         self.shards = shards  # i64[k] covered shards, group order
-        self.kind = kind_row  # i8[S]: 1=SET, 2=GET for this wave
+        self.kind = kind_row  # i8[S]: 1=SET 2=GET 3=DEL 4=EXISTS
         self.svers = set_vers  # i64[S] derived SET response versions
-        self._get = get_frames  # GetFrameGroups view for this wave
+        # GetFrameGroups/ResolvedGetFrameGroups view for this wave —
+        # also the carrier of the found bits DEL/EXISTS frames need
+        self._get = get_frames
 
     def _frame(self, s: int) -> bytes:
-        if int(self.kind[s]) == 1:
+        from rabia_tpu.apps.kvstore import _result_bin
+
+        k = int(self.kind[s])
+        if k == 1:
             arr = np.zeros(1, _RESP_DT)
             arr["version"] = np.uint32(self.svers[s])
             return arr.tobytes()
+        if k == 3:  # DEL: found bit, no version/value (vector_kv framing)
+            return _result_bin(0 if self._get.found[s] else 1, 0)
+        if k == 4:  # EXISTS: boolean text
+            return _result_bin(
+                0, 0, "true" if self._get.found[s] else "false"
+            )
         return self._get._frame(s)
 
 
@@ -362,10 +375,15 @@ class DeviceKVTable:
             dbuf, off, klen, vlen, opcode = pb
             is_set = opcode == 1
             is_get = opcode == 2
+            is_del = opcode == 3
+            is_exists = opcode == 4
             kind_ok = {
                 "set": is_set,
                 "get": is_get,
-                "mixed": is_set | is_get,
+                # DEL and EXISTS join the mixed envelope: both carry
+                # exactly a key (vlen==0 enforced below); DEL clears the
+                # matched slot on device, EXISTS is a found-bit read
+                "mixed": is_set | is_get | is_del | is_exists,
             }[allow]
             ok = (
                 kind_ok
@@ -1030,13 +1048,24 @@ class DeviceKVTable:
                     & (keyw == kwin_t[:, None, :]).all(-1)
                 )  # [S, P]
                 found = eq.any(1)
-                # GET reads against the wave-entry state, before this
-                # wave's SET applies touch the table
-                gsel = found & (kind_t == 2) & (klen_t > 0)
-                oh_get = eq & gsel[:, None]
+                # reads (GET/DEL/EXISTS found bits) are against the
+                # wave-entry state, before this wave's applies touch the
+                # table; gver/gval carry data for GET ops only (a DEL's
+                # response is its found bit, an EXISTS's is a boolean)
+                rsel = (kind_t >= 2) & (klen_t > 0)
+                gsel = found & rsel
+                oh_get = eq & (found & (kind_t == 2))[:, None]
                 gver = (ver * oh_get).sum(1)
                 gvlen = (vlen * oh_get).sum(1)
                 gval = (valw * oh_get[:, :, None]).sum(1)
+                # DEL applies: clear the matched slot (the table is
+                # compare-all associative — no probe chains to repair,
+                # unlike the host twin's open addressing) and bump the
+                # shard version exactly like the host store's delete()
+                # does on a successful delete
+                del_hit = ok_w & (kind_t == 3) & found
+                used = used & ~(eq & del_hit[:, None])
+                sver = sver + del_hit
                 # SET applies: same one-hot word-select update as the
                 # pure-SET program, gated on this op BEING a SET
                 is_set = ok_w & (kind_t == 1)

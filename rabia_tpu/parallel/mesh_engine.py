@@ -906,7 +906,11 @@ class MeshEngine:
         # flags/meta synchronously here, serializing a full tunnel
         # round-trip per window — pipelining was worth ~2x on the
         # pure-SET lane and applies unchanged to the other kinds.)
-        if head_kind is None or depth < len(kinds):
+        if (
+            head_kind is None
+            or depth < len(kinds)
+            or head_kind in (3, 4)  # DEL/EXISTS runs ride the mixed program
+        ):
             return self._run_cycle_fullwidth_device_mixed(len(kinds))
         if head_kind == 2:
             return self._run_cycle_fullwidth_device_get(depth)
@@ -958,17 +962,7 @@ class MeshEngine:
             seg = _RowSeg(seg_start, seg_end, ops.vlen, ops.vwin)
         self._dev_push_segment(seg)
         self._dev_sver[:n] += depth
-        for _ in range(depth):
-            self._full_blocks.popleft()
-        start = self.next_slot.copy()
-        self.next_slot[:n] += depth
-        self.decided_v1 += depth * n
-        for t, (block, bfut, inv) in enumerate(entries):
-            self._bulk_log.append((start, t, block, inv))
-        while len(self._bulk_log) > max(
-            1, self.max_decision_history // max(1, self.window)
-        ):
-            self._bulk_log.popleft()
+        self._dev_commit_window(entries, depth)
         sver_delta = np.zeros_like(self._dev_sver)
         sver_delta[:n] = depth
         return self._dev_push_window(
@@ -984,6 +978,25 @@ class MeshEngine:
                 "sver_delta": sver_delta,
             }
         )
+
+    def _dev_commit_window(self, entries, depth: int):
+        """Shared commit bookkeeping for every device window kind: pop
+        the consumed blocks, advance the slot counters, append to the
+        bulk decision log (trimmed to the retention budget). Returns
+        the per-shard start slots (for the log records)."""
+        n = self.n_shards
+        for _ in range(depth):
+            self._full_blocks.popleft()
+        start = self.next_slot.copy()
+        self.next_slot[:n] += depth
+        self.decided_v1 += depth * n
+        for t, (block, bfut, inv) in enumerate(entries):
+            self._bulk_log.append((start, t, block, inv))
+        while len(self._bulk_log) > max(
+            1, self.max_decision_history // max(1, self.window)
+        ):
+            self._bulk_log.popleft()
+        return start
 
     def _dev_chain_base(self):
         """Table state a new device window dispatches against: the
@@ -1161,7 +1174,6 @@ class MeshEngine:
         kind = rec["kind_rows"]
         svers = rec["svers"]
         get_waves = rec["get_waves"]
-        is_set = kind == 1
         gpos = {int(t): j for j, t in enumerate(get_waves)}
         resolved = True
         if len(get_waves):
@@ -1169,7 +1181,15 @@ class MeshEngine:
             gver_h = meta_h[0]
             gvlen_h = meta_h[1] >> 1
             gfound_h = (meta_h[1] & 1).astype(bool)
-            resolved = not self._dev_unresolvable(gfound_h, gver_h)
+            # resolvability is about GET values only: EXISTS rows carry
+            # found bits with version 0 and must not read as
+            # unresolvable versions (meta planes are padded — compare
+            # the real rows)
+            g = len(get_waves)
+            is_get_rows = kind[get_waves] == 2
+            resolved = not self._dev_unresolvable(
+                gfound_h[:g] & is_get_rows, gver_h[:g]
+            )
             if resolved:
                 rsv = self._dev_make_resolver()
             else:
@@ -1193,8 +1213,8 @@ class MeshEngine:
                 frames = VectorShardedKV._vers_frames(svers[t, sh])
                 bounds = np.arange(len(block) + 1, dtype=np.int64)
                 bfut._settle_bulk(FrameGroups(frames, bounds))
-            elif not bool(is_set[t].any()):
-                bfut._settle_bulk(gf)  # pure-GET wave
+            elif not bool(((row_kind == 1) | (row_kind == 4)).any()):
+                bfut._settle_bulk(gf)  # pure-GET wave (GET framing only)
             else:
                 bfut._settle_bulk(
                     MixedFrameGroups(sh, row_kind, svers[t], gf)
@@ -1251,17 +1271,7 @@ class MeshEngine:
             self._dev.compiled_on_last_call and self._lat_timing
         )
         self.cycles += 1
-        for _ in range(depth):
-            self._full_blocks.popleft()
-        start = self.next_slot.copy()
-        self.next_slot[:n] += depth
-        self.decided_v1 += depth * n
-        for t, (block, bfut, inv) in enumerate(entries):
-            self._bulk_log.append((start, t, block, inv))
-        while len(self._bulk_log) > max(
-            1, self.max_decision_history // max(1, self.window)
-        ):
-            self._bulk_log.popleft()
+        self._dev_commit_window(entries, depth)
         pool = self._dev_fetcher()
         return self._dev_push_window(
             {
@@ -1309,7 +1319,22 @@ class MeshEngine:
             self._demote_device_store()
             return applied + self._run_cycle_inner()
         kind, ops, vlen_plane, vwin_plane = packed
-        get_waves = np.nonzero((kind == 2).any(axis=1))[0].astype(np.int32)
+        if bool((kind == 3).any()):
+            # DEL bumps the shard version only when the key is FOUND —
+            # a data-dependent bump the host mirror can't derive until
+            # the meta readback. Such windows run SYNCHRONOUSLY against
+            # the settled table (drain first — the counts reach the
+            # caller) so every later window's derived versions stay
+            # exact. SET/GET/EXISTS windows keep the pipelined chain
+            # (EXISTS is read-only: its found bit rides the meta plane,
+            # it bumps nothing).
+            applied = self._dev_drain_pipe()
+            if not self._dev_active:
+                return applied + self._run_cycle_inner()
+            return applied + self._run_cycle_device_mixed_sync(
+                count, kind, ops, vlen_plane, vwin_plane
+            )
+        get_waves = np.nonzero((kind >= 2).any(axis=1))[0].astype(np.int32)
         base = np.zeros(self.S, np.int32)
         base[:n] = self.next_slot
         state_base = self._dev_chain_base()
@@ -1335,17 +1360,7 @@ class MeshEngine:
         sver_delta = np.zeros_like(self._dev_sver)
         sver_delta[: self.S] = set_cum[-1]
         self._dev_sver += sver_delta
-        for _ in range(count):
-            self._full_blocks.popleft()
-        start = self.next_slot.copy()
-        self.next_slot[:n] += count
-        self.decided_v1 += count * n
-        for t, (block, bfut, inv) in enumerate(entries):
-            self._bulk_log.append((start, t, block, inv))
-        while len(self._bulk_log) > max(
-            1, self.max_decision_history // max(1, self.window)
-        ):
-            self._bulk_log.popleft()
+        self._dev_commit_window(entries, count)
         pool = self._dev_fetcher()
         return self._dev_push_window(
             {
@@ -1372,6 +1387,110 @@ class MeshEngine:
                 "sver_delta": sver_delta,
             }
         )
+
+    def _run_cycle_device_mixed_sync(
+        self, count: int, kind, ops, vlen_plane, vwin_plane
+    ) -> int:
+        """Synchronous mixed window for DEL/EXISTS-bearing FIFOs.
+
+        Same device program as the pipelined mixed lane (the kind mask
+        covers 1=SET 2=GET 3=DEL 4=EXISTS), but dispatched against the
+        SETTLED table with flags+meta read inline: a DEL's shard-version
+        bump depends on its found bit, so the authoritative per-shard
+        bump vector (SET always, DEL on found — exactly the host
+        store's semantics) is computed from the readback before any
+        later window derives response versions from the mirror."""
+        from rabia_tpu.apps.device_kv import (
+            GetFrameGroups,
+            MixedFrameGroups,
+            ResolvedGetFrameGroups,
+        )
+        from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
+
+        W = self.window
+        n = self.n_shards
+        entries = [self._full_blocks[i] for i in range(count)]
+        meta_waves = np.nonzero((kind >= 2).any(axis=1))[0].astype(np.int32)
+        base = np.zeros(self.S, np.int32)
+        base[:n] = self.next_slot
+        new_state, flags_dev, meta_dev, gval_dev = self._dev.mixed_apply(
+            self.alive, base, count, kind, meta_waves, ops, W=W,
+            max_phases=self.max_phases,
+        )
+        self._lat_invalidate |= (
+            self._dev.compiled_on_last_call and self._lat_timing
+        )
+        self.cycles += 1
+        flags = np.asarray(flags_dev)
+        if not flags[0] or flags[1] or flags[2]:
+            self._demote_device_store()
+            return self._run_cycle_inner()
+        self._dev.adopt(new_state)
+        gfound_h = gver_h = gvlen_h = None
+        if len(meta_waves):
+            meta_h = np.asarray(meta_dev)
+            gver_h = meta_h[0]
+            gvlen_h = meta_h[1] >> 1
+            gfound_h = (meta_h[1] & 1).astype(bool)
+        # authoritative version bumps: SET always, DEL on found
+        bump = (kind == 1).astype(np.int64)
+        for j, t in enumerate(meta_waves):
+            t = int(t)
+            bump[t] += ((kind[t] == 3) & gfound_h[j]).astype(np.int64)
+        cum = np.cumsum(bump, axis=0)
+        svers = self._dev_sver[None, : self.S] + cum
+        seg_start = self._dev_sver.copy()
+        self._dev_push_segment(
+            _MixedSeg(
+                seg_start, seg_start + cum[-1], vlen_plane, vwin_plane,
+                svers, kind,
+            )
+        )
+        self._dev_sver[: self.S] += cum[-1]
+        self._dev_commit_window(entries, count)
+        gpos = {int(t): j for j, t in enumerate(meta_waves)}
+        resolved = True
+        if len(meta_waves):
+            # the resolvability check is about GET VALUES only: DEL and
+            # EXISTS rows carry found bits with version 0 and must not
+            # read as unresolvable versions. The meta planes are padded
+            # to a power of two rows; compare the real rows only.
+            g = len(meta_waves)
+            is_get_rows = kind[meta_waves] == 2
+            resolved = not self._dev_unresolvable(
+                gfound_h[:g] & is_get_rows, gver_h[:g]
+            )
+            if resolved:
+                rsv = self._dev_make_resolver()
+            else:
+                gval_h = np.asarray(gval_dev)
+        for t, (block, bfut, _inv) in enumerate(entries):
+            sh = np.asarray(block.shards, np.int64)
+            row_kind = kind[t]
+            if t in gpos:
+                j = gpos[t]
+                if resolved:
+                    gf = ResolvedGetFrameGroups(
+                        sh, gfound_h[j], gver_h[j], rsv
+                    )
+                else:
+                    gf = GetFrameGroups(
+                        sh, gfound_h[j], gver_h[j], gvlen_h[j], gval_h[j]
+                    )
+                pure_get = not bool(
+                    ((row_kind == 1) | (row_kind >= 3)).any()
+                )
+                if pure_get:
+                    bfut._settle_bulk(gf)
+                else:
+                    bfut._settle_bulk(
+                        MixedFrameGroups(sh, row_kind, svers[t], gf)
+                    )
+            else:
+                frames = VectorShardedKV._vers_frames(svers[t, sh])
+                bounds = np.arange(len(block) + 1, dtype=np.int64)
+                bfut._settle_bulk(FrameGroups(frames, bounds))
+        return count * n
 
     def _dev_push_segment(self, seg) -> None:
         """Retain one committed device window's value bytes (a

@@ -99,9 +99,6 @@ class TestDeviceKVConformance:
         assert dev.decided_v1 == host.decided_v1
 
     def test_mixed_block_demotes_and_stays_correct(self):
-        import struct
-        encode_del_bin = lambda k: bytes([3]) + struct.pack("<H", len(k)) + k.encode()
-
         n = 4
         rng = np.random.default_rng(7)
         dev = _mk(n, device=True)
@@ -114,14 +111,18 @@ class TestDeviceKVConformance:
         dev.flush()
         host.flush()
         assert dev._dev_active
-        # a DEL block is outside the lane's envelope (GETs now run
-        # in-lane) -> demotion, and the DEL must act on the
-        # device-written values through the host store
+        # a value wider than the device table's value lanes is outside
+        # the envelope (DEL/EXISTS now run in-lane) -> demotion, and
+        # the write must act on the device-written state through the
+        # host store
+        wide = "y" * 100
         getb = build_block(
-            list(range(n)), [[encode_del_bin(f"k{s}_0")] for s in range(n)]
+            list(range(n)),
+            [[encode_set_bin(f"k{s}_0", wide)] for s in range(n)],
         )
         getb_h = build_block(
-            list(range(n)), [[encode_del_bin(f"k{s}_0")] for s in range(n)]
+            list(range(n)),
+            [[encode_set_bin(f"k{s}_0", wide)] for s in range(n)],
         )
         df, hf = dev.submit_block(getb), host.submit_block(getb_h)
         dev.flush()
@@ -277,14 +278,9 @@ class TestRePromotion:
     continuity and content identical to a pure-host engine."""
 
     def test_demote_then_repromote_conformance(self):
-        import struct
-
-        encode_del_bin = (
-            lambda k: bytes([3]) + struct.pack("<H", len(k)) + k.encode()
-        )
         n = 4
         rng = np.random.default_rng(11)
-        dev = _mk(n, device=True, device_store_repromote=2)
+        dev = _mk(n, device=True, device_store_repromote=4)
         host = _mk(n, device=False)
         rng_h = np.random.default_rng(11)
 
@@ -298,17 +294,26 @@ class TestRePromotion:
 
         both(lambda r: _set_blocks(n, waves=3, rng=r))
         assert dev._dev_active
-        # demote via a DEL block (GETs now run in-lane)
+        # demote via an over-width value (DEL/EXISTS now run in-lane)
         g = lambda r: [
             build_block(
-                list(range(n)), [[encode_del_bin(f"k{s}_0")] for s in range(n)]
+                list(range(n)),
+                [[encode_set_bin(f"k{s}_0", "y" * 100)] for s in range(n)],
             )
         ]
         both(g)
         assert not dev._dev_active
+        # overwrite the wide value with an in-envelope one, or the
+        # re-promotion upload keeps declining
+        both(lambda r: [
+            build_block(
+                list(range(n)),
+                [[encode_set_bin(f"k{s}_0", "ok")] for s in range(n)],
+            )
+        ])
         # host-lane SETs while demoted (content the upload must carry)
         both(lambda r: _set_blocks(n, waves=2, rng=r))
-        assert not dev._dev_active  # cooldown (2 cycles) not yet served
+        assert not dev._dev_active  # cooldown (4 cycles) not yet served
         # more full-width cycles serve the cooldown and re-promote
         both(lambda r: _set_blocks(n, waves=3, rng=r))
         both(lambda r: _set_blocks(n, waves=3, rng=r))
@@ -494,9 +499,17 @@ class TestDeviceGetWindows:
 
     @pytest.mark.parametrize("seed", [11, 12, 13])
     def test_random_kind_fuzz_byte_identical(self, seed):
-        # random SET/GET kind per (wave, shard) over deep FIFOs: reads
-        # must observe exactly the applies of earlier waves (host FIFO
-        # semantics), responses byte-identical, versions conformant
+        # random SET/GET/DEL/EXISTS kind per (wave, shard) over deep
+        # FIFOs: reads must observe exactly the applies of earlier waves
+        # (host FIFO semantics), DEL's data-dependent version bumps must
+        # track the host store's counters, responses byte-identical,
+        # versions conformant
+        from rabia_tpu.apps.kvstore import (
+            KVOperation,
+            KVOpType,
+            encode_op_bin,
+        )
+
         n = 8
         rng = np.random.default_rng(seed)
 
@@ -506,10 +519,19 @@ class TestDeviceGetWindows:
                 cmds = []
                 for s in range(n):
                     k = f"k{s}_{int(r.integers(0, 2))}"
-                    if r.random() < 0.5:
+                    x = r.random()
+                    if x < 0.45:
                         cmds.append([encode_set_bin(k, f"v{w}_{s}")])
-                    else:
+                    elif x < 0.75:
                         cmds.append([self._enc_get(k)])
+                    elif x < 0.9:
+                        cmds.append(
+                            [encode_op_bin(KVOperation(KVOpType.Delete, k))]
+                        )
+                    else:
+                        cmds.append(
+                            [encode_op_bin(KVOperation(KVOpType.Exists, k))]
+                        )
                 out.append(build_block(list(range(n)), cmds))
             return out
 
@@ -607,29 +629,35 @@ class TestDeviceGetWindows:
                 )
             )
             e.flush()
-        # force a demotion (DEL is outside the lane envelope)
-        import struct
-
-        enc_del = lambda k: bytes([3]) + struct.pack("<H", len(k)) + k.encode()
-        for e in (dev, host):
-            e.submit_block(
-                build_block(
-                    list(range(n)), [[enc_del("nope")] for s in range(n)]
-                )
-            )
-            e.flush()
-        assert not dev._dev_active
-        # re-promote (cooldown 1), then GET the PRE-promotion version:
-        # it must resolve from the seed, byte-identical to the host path
+        # force a demotion (an over-width value is outside the lane
+        # envelope; DEL/EXISTS now run in-lane)
         for e in (dev, host):
             e.submit_block(
                 build_block(
                     list(range(n)),
-                    [[encode_set_bin("other", "x")] for s in range(n)],
+                    [[encode_set_bin("other", "x" * 100)] for s in range(n)],
                 )
             )
             e.flush()
-        assert dev._dev_active  # re-promoted
+        assert not dev._dev_active
+        # overwrite the wide value so the upload accepts (the attempt
+        # at this cycle's START still sees the wide value and declines,
+        # re-arming the cooldown), then one more full-width cycle whose
+        # start-of-cycle attempt succeeds; then GET the PRE-promotion
+        # version: it must resolve from the seed, byte-identical to the
+        # host path
+        for tag in ("x", "warm"):
+            for e in (dev, host):
+                e.submit_block(
+                    build_block(
+                        list(range(n)),
+                        [[encode_set_bin("other", tag)] for s in range(n)],
+                    )
+                )
+                e.flush()
+        # the re-promotion attempt fires at the start of the NEXT
+        # full-width cycle with a served cooldown — that's the GET
+        # cycle below, which then runs in-lane (asserted after it)
         fd = dev.submit_block(
             build_block(
                 list(range(n)), [[self._enc_get(f"k{s}")] for s in range(n)]
@@ -711,6 +739,52 @@ class TestDeviceGetWindows:
             e.flush()
         assert dev._dev_active
         assert any(isinstance(sg, _RowSeg) for sg in dev._dev_vseg)
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+
+    def test_del_exists_run_in_lane_byte_identical(self):
+        # DEL and EXISTS join the device lane's mixed envelope instead
+        # of demoting: deterministic sequence covering found DEL,
+        # not-found DEL, SET-after-DEL (fresh version continues from
+        # the bumped counter), GET-after-DEL (not-found), and EXISTS
+        # both ways — responses and final content byte-identical to the
+        # host path, no demotion
+        from rabia_tpu.apps.kvstore import (
+            KVOperation,
+            KVOpType,
+            encode_op_bin,
+        )
+
+        enc = lambda t, k: encode_op_bin(KVOperation(t, k))
+        n = 4
+        dev = _mk(n, device=True, window=4)
+        host = _mk(n, device=False, window=4)
+
+        def stream():
+            shards = list(range(n))
+            blk = lambda op: build_block(shards, [[op] for _ in shards])
+            return [
+                blk(encode_set_bin("a", "v1")),
+                blk(enc(KVOpType.Delete, "a")),       # found DEL
+                blk(enc(KVOpType.Delete, "a")),       # not-found DEL
+                blk(enc(KVOpType.Get, "a")),          # not-found GET
+                blk(encode_set_bin("a", "v2")),       # SET after DEL
+                blk(enc(KVOpType.Exists, "a")),       # true
+                blk(enc(KVOpType.Exists, "missing")),  # false
+                blk(enc(KVOpType.Get, "a")),          # found GET
+                blk(encode_set_bin("b", "v3")),
+                blk(enc(KVOpType.Delete, "missing")),  # not-found DEL
+            ]
+
+        fd = [dev.submit_block(b) for b in stream()]
+        fh = [host.submit_block(b) for b in stream()]
+        dev.flush()
+        host.flush()
+        assert dev._dev_active, "DEL/EXISTS demoted the lane"
+        for i, (a, b) in enumerate(zip(fd, fh)):
+            assert _frames(a) == _frames(b), i
         dev._demote_device_store()
         want = _store_content(host.sms[0], n)
         for sm in dev.sms:
