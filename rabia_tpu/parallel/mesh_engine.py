@@ -1213,7 +1213,7 @@ class MeshEngine:
                 frames = VectorShardedKV._vers_frames(svers[t, sh])
                 bounds = np.arange(len(block) + 1, dtype=np.int64)
                 bfut._settle_bulk(FrameGroups(frames, bounds))
-            elif not bool(((row_kind == 1) | (row_kind == 4)).any()):
+            elif not bool(((row_kind == 1) | (row_kind >= 3)).any()):
                 bfut._settle_bulk(gf)  # pure-GET wave (GET framing only)
             else:
                 bfut._settle_bulk(
@@ -1400,13 +1400,6 @@ class MeshEngine:
         bump vector (SET always, DEL on found — exactly the host
         store's semantics) is computed from the readback before any
         later window derives response versions from the mirror."""
-        from rabia_tpu.apps.device_kv import (
-            GetFrameGroups,
-            MixedFrameGroups,
-            ResolvedGetFrameGroups,
-        )
-        from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
-
         W = self.window
         n = self.n_shards
         entries = [self._full_blocks[i] for i in range(count)]
@@ -1426,11 +1419,9 @@ class MeshEngine:
             self._demote_device_store()
             return self._run_cycle_inner()
         self._dev.adopt(new_state)
-        gfound_h = gver_h = gvlen_h = None
+        gfound_h = meta_h = None
         if len(meta_waves):
             meta_h = np.asarray(meta_dev)
-            gver_h = meta_h[0]
-            gvlen_h = meta_h[1] >> 1
             gfound_h = (meta_h[1] & 1).astype(bool)
         # authoritative version bumps: SET always, DEL on found
         bump = (kind == 1).astype(np.int64)
@@ -1448,48 +1439,26 @@ class MeshEngine:
         )
         self._dev_sver[: self.S] += cum[-1]
         self._dev_commit_window(entries, count)
-        gpos = {int(t): j for j, t in enumerate(meta_waves)}
-        resolved = True
+        # settlement is the SAME code as the pipelined lane: hand
+        # _dev_settle_mixed a record whose meta future is already
+        # resolved (the sync path fetched it inline to derive the
+        # bumps) — one settle implementation, zero drift between lanes
+        meta_done = None
         if len(meta_waves):
-            # the resolvability check is about GET VALUES only: DEL and
-            # EXISTS rows carry found bits with version 0 and must not
-            # read as unresolvable versions. The meta planes are padded
-            # to a power of two rows; compare the real rows only.
-            g = len(meta_waves)
-            is_get_rows = kind[meta_waves] == 2
-            resolved = not self._dev_unresolvable(
-                gfound_h[:g] & is_get_rows, gver_h[:g]
-            )
-            if resolved:
-                rsv = self._dev_make_resolver()
-            else:
-                gval_h = np.asarray(gval_dev)
-        for t, (block, bfut, _inv) in enumerate(entries):
-            sh = np.asarray(block.shards, np.int64)
-            row_kind = kind[t]
-            if t in gpos:
-                j = gpos[t]
-                if resolved:
-                    gf = ResolvedGetFrameGroups(
-                        sh, gfound_h[j], gver_h[j], rsv
-                    )
-                else:
-                    gf = GetFrameGroups(
-                        sh, gfound_h[j], gver_h[j], gvlen_h[j], gval_h[j]
-                    )
-                pure_get = not bool(
-                    ((row_kind == 1) | (row_kind >= 3)).any()
-                )
-                if pure_get:
-                    bfut._settle_bulk(gf)
-                else:
-                    bfut._settle_bulk(
-                        MixedFrameGroups(sh, row_kind, svers[t], gf)
-                    )
-            else:
-                frames = VectorShardedKV._vers_frames(svers[t, sh])
-                bounds = np.arange(len(block) + 1, dtype=np.int64)
-                bfut._settle_bulk(FrameGroups(frames, bounds))
+            import concurrent.futures as _cf
+
+            meta_done = _cf.Future()
+            meta_done.set_result(meta_h)
+        self._dev_settle_mixed(
+            {
+                "kind_rows": kind,
+                "svers": svers,
+                "get_waves": meta_waves,
+                "meta_fut": meta_done,
+                "gval_dev": gval_dev if len(meta_waves) else None,
+                "entries": entries,
+            }
+        )
         return count * n
 
     def _dev_push_segment(self, seg) -> None:
