@@ -148,7 +148,14 @@ def main() -> int:
     # the fixed per-dispatch tunnel overhead. Conformance-gated in
     # tests/test_packed_window.py; the producer packs once outside the
     # timed chain (pack_codes), same policy as the prebuilt i8 planes.
-    packed_slots = int(os.environ.get("BENCH_SLOTS_PACKED", 262144))
+    # depth/chain sweet spot from the round-5 on-chip sweep
+    # (headline_depth_probe_r05: 262144/48 gives ~252B; at T=393216
+    # chains 64/96/128 measured 392/371/395B — spread is ambient
+    # tunnel load, so the default rides BENCH_CHAIN at 2x to keep
+    # operator runtime bounds (e.g. BENCH_CHAIN=4 smoke runs)
+    # governing this path too)
+    packed_slots = int(os.environ.get("BENCH_SLOTS_PACKED", 393216))
+    packed_chain = int(os.environ.get("BENCH_CHAIN_PACKED", 2 * chain))
     packed_ok = False
     try:
         from rabia_tpu.kernel import packed_window
@@ -194,14 +201,14 @@ def main() -> int:
         try:
             for _ in range(reps):
                 t0 = time.perf_counter()
-                for i in range(chain):
+                for i in range(packed_chain):
                     d = kernel.slot_pipeline_fused_packed(
                         packed[i % 2], alive_p, packed_slots
                     )
                 np.asarray(d[0, :8])
                 dt = time.perf_counter() - t0
                 packed_rate = max(
-                    packed_rate, chain * shards * packed_slots / dt
+                    packed_rate, packed_chain * shards * packed_slots / dt
                 )
             if not bool(jnp.all(d == expected_row[None, :])):
                 print(
@@ -256,7 +263,14 @@ def main() -> int:
                 else scan_slots
             ),
             **(
-                {"chained_windows": chain, "want_phase": False}
+                {
+                    "chained_windows": (
+                        packed_chain
+                        if kernel_name.startswith("packed")
+                        else chain
+                    ),
+                    "want_phase": False,
+                }
                 if kernel_name.startswith(("pallas", "packed"))
                 else {}
             ),
