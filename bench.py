@@ -161,7 +161,7 @@ def main() -> int:
         from rabia_tpu.kernel import packed_window
 
         # pack in T-chunks: packing the full window in one shot would
-        # materialize a u32 convert of the 4x-larger i8 plane (21GB at
+        # materialize a u32 convert of the 4x-larger i8 plane (~32GB at
         # the default depth — over HBM); chunking bounds the transient
         step = min(packed_slots, 16384)
         parts = []
@@ -302,9 +302,12 @@ def _mesh_engine_rate(S: int, replicas: int) -> float:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from benchmarks.mesh_engine_bench import bench_block_lane
 
+    # W=96 x 8 waves from the round-5 A/B sweep: consistently ~1.4x the
+    # old W=64 x 4 geometry (2.3-2.5M vs 1.5-1.8M dec/s on the tunnel;
+    # headline_depth_probe_r05.engine_pairing in benchmarks/results.json)
     return float(
         bench_block_lane(
-            S, replicas, window=64, waves=4, strict=False,
+            S, replicas, window=96, waves=8, strict=False,
             device_store=True,
         )["decisions_per_sec"]
     )
