@@ -211,6 +211,83 @@ def bench_mixed_set_get(
     }
 
 
+def bench_del_heavy(
+    n_shards: int = 4096,
+    n_replicas: int = 5,
+    window: int = 32,
+    waves: int = 96,
+) -> dict:
+    """DEL-heavy device-lane workload: alternating full-width SET / DEL
+    waves (every DEL finds its key, the worst case for the
+    found-dependent version bump). Round-5 pre-pipelining this ran 82k
+    dec/s — every DEL-bearing window drained the pipe and dispatched
+    synchronously against the settled table. DEL windows now PIPELINE
+    with settlement-time version derivation (the found bits already
+    ride the meta plane), so the tunnel round-trip overlaps the next
+    window's pack like every other window kind."""
+    from rabia_tpu.apps.kvstore import (
+        KVOperation,
+        KVOpType,
+        encode_op_bin,
+        encode_set_bin,
+    )
+    from rabia_tpu.apps.vector_kv import VectorShardedKV
+    from rabia_tpu.core.blocks import build_block
+
+    shards = list(range(n_shards))
+    set_cmds = [[encode_set_bin(f"k{s}", "v0")] for s in range(n_shards)]
+    del_cmds = [
+        [encode_op_bin(KVOperation(KVOpType.Delete, f"k{s}"))]
+        for s in range(n_shards)
+    ]
+
+    def stream(n_waves):
+        return [
+            build_block(shards, set_cmds if w % 2 == 0 else del_cmds)
+            for w in range(n_waves)
+        ]
+
+    eng = MeshEngine(
+        lambda: VectorShardedKV(n_shards, capacity=1 << 18),
+        n_shards=n_shards,
+        n_replicas=n_replicas,
+        mesh=make_mesh(),
+        window=window,
+        device_store=True,
+    )
+    for b in stream(2 * window):  # warmup: compiles the mixed program
+        eng.submit_block(b)
+    eng.flush(max_cycles=400)
+    assert eng._dev_active, "warmup demoted the device lane"
+    futs = [eng.submit_block(b) for b in stream(waves)]
+    t0 = time.perf_counter()
+    before = eng.decided_v1
+    eng.flush(max_cycles=waves * 4)
+    dt = time.perf_counter() - t0
+    applied = eng.decided_v1 - before
+    assert eng._dev_active, "DEL windows demoted the device lane"
+    assert all(f.done() for f in futs)
+    return {
+        "shards": n_shards,
+        "replicas": n_replicas,
+        "window": window,
+        "workload": f"{waves} alternating full-width SET / DEL waves",
+        "decisions_per_sec": round(applied / dt, 1),
+        "elapsed_s": round(dt, 3),
+        "cycles": eng.cycles,
+        "vs_r05_sync_del": round(applied / dt / 82_048, 2),
+        "note": (
+            "DEL-bearing windows pipeline with DEFERRED version "
+            "derivation: the found-dependent shard-version bump is "
+            "computed at settlement from the meta readback (which DEL "
+            "waves already ride), so the dispatch chains like any "
+            "other window instead of draining the pipe — conformance "
+            "pinned in tests/test_device_kv.py "
+            "(test_del_windows_pipeline_with_deferred_versions)"
+        ),
+    }
+
+
 def bench_get_windows(
     n_shards: int = 4096,
     n_replicas: int = 5,
@@ -635,6 +712,24 @@ def main() -> None:
             rec = doc.setdefault("mesh_engine_r05", {})
             rec["mixed_set_get_device_lane"] = mixed
             rec["get_windows_device_lane"] = getw
+            path.write_text(json.dumps(doc, indent=1))
+            print("recorded -> results.json mesh_engine_r05")
+        return
+
+    if "--del-only" in sys.argv:
+        # re-measure the DEL-heavy lane (pipelined DEL windows)
+        rec = bench_del_heavy()
+        print("del-heavy ->", rec["decisions_per_sec"], "dec/s")
+        if "--record" in sys.argv:
+            path = Path(__file__).parent / "results.json"
+            doc = json.loads(path.read_text()) if path.exists() else {}
+            sect = doc.setdefault("mesh_engine_r05", {})
+            prev = sect.get("del_heavy_device_lane", {})
+            # keep the run history across re-records (medians live there)
+            rec["runs_decisions_per_sec"] = prev.get(
+                "runs_decisions_per_sec", []
+            ) + [rec["decisions_per_sec"]]
+            sect["del_heavy_device_lane"] = rec
             path.write_text(json.dumps(doc, indent=1))
             print("recorded -> results.json mesh_engine_r05")
         return

@@ -70,9 +70,17 @@ logger = logging.getLogger(__name__)
 
 class _RowSeg:
     """Value segment for a pure-SET window packed as per-op rows:
-    version v at shard s is wave ``t = v - start[s] - 1``."""
+    version v at shard s is wave ``t = v - start[s] - 1``.
 
-    __slots__ = ("start", "end", "vlen", "vwin8", "nbytes")
+    ``provisional`` marks a segment whose window is still in flight
+    behind a data-dependent version bump (a DEL-bearing window earlier
+    in the pipe): its ``start``/``end`` (and, for mixed segments,
+    ``svers``) are placeholders until settlement patches them — such
+    segments are never evicted (their exact version range is unknown)
+    and never match a resolver range check (placeholder range is
+    empty)."""
+
+    __slots__ = ("start", "end", "vlen", "vwin8", "nbytes", "provisional")
 
     def __init__(self, start, end, vlen, vwin) -> None:
         self.start = start
@@ -80,6 +88,7 @@ class _RowSeg:
         self.vlen = vlen
         self.vwin8 = vwin.view(np.uint8)
         self.nbytes = vlen.nbytes + self.vwin8.nbytes
+        self.provisional = False
 
     def value(self, s: int, ver: int) -> Optional[bytes]:
         t = ver - int(self.start[s]) - 1
@@ -90,7 +99,7 @@ class _DictSeg:
     """Value segment for a dict-packed SET window: the op's value is
     the dictionary row its wave indexed."""
 
-    __slots__ = ("start", "end", "idx", "dvl", "dv8", "nbytes")
+    __slots__ = ("start", "end", "idx", "dvl", "dv8", "nbytes", "provisional")
 
     def __init__(self, start, end, idx, dvl, dv) -> None:
         self.start = start
@@ -99,6 +108,7 @@ class _DictSeg:
         self.dvl = dvl  # i16[S, D]
         self.dv8 = dv.view(np.uint8)  # u8[S, D, vu]
         self.nbytes = idx.nbytes + dvl.nbytes + self.dv8.nbytes
+        self.provisional = False
 
     def value(self, s: int, ver: int) -> Optional[bytes]:
         t = ver - int(self.start[s]) - 1
@@ -112,7 +122,10 @@ class _MixedSeg:
     are nondecreasing; the first wave reaching v is the SET that
     assigned it)."""
 
-    __slots__ = ("start", "end", "vlen", "vwin8", "svers", "kind", "nbytes")
+    __slots__ = (
+        "start", "end", "vlen", "vwin8", "svers", "kind", "nbytes",
+        "provisional",
+    )
 
     def __init__(self, start, end, vlen, vwin, svers, kind) -> None:
         self.start = start
@@ -122,6 +135,7 @@ class _MixedSeg:
         self.svers = svers
         self.kind = kind
         self.nbytes = vlen.nbytes + self.vwin8.nbytes + svers.nbytes
+        self.provisional = False
 
     def value(self, s: int, ver: int) -> Optional[bytes]:
         col = self.svers[:, s]
@@ -458,6 +472,12 @@ class MeshEngine:
             # ~156ms/cycle); the worker blocks there instead while the
             # main thread packs the next window.
             self._dev_pipe: list = []
+            # in-flight windows whose version derivation is DEFERRED to
+            # settlement (DEL bumps the shard version only when found —
+            # a data-dependent bump the mirror can't derive until the
+            # meta readback; any window dispatched behind one inherits
+            # the stale mirror and defers too)
+            self._dev_defer = 0
             self._dev_fetcher_pool = None  # lazy: first pipelined window
             self._dev_vseg: deque = deque()
             self._dev_vseg_bytes = 0
@@ -945,26 +965,40 @@ class MeshEngine:
         # all-V1 full-width window advances every covered shard's
         # version by exactly one per wave, so the host mirror + wave
         # index reproduces the device counters bit-for-bit (pinned by
-        # tests/test_device_kv.py against the host store)
-        vers = (
-            self._dev_sver[None, : self.S]
-            + np.arange(1, W + 1, dtype=np.int64)[:, None]
-        )
-        # retain this window's value bytes host-side: (shard, version)
-        # uniquely identifies content, so the GET lane can answer reads
-        # without downloading values (see _dev_resolve)
-        seg_start = self._dev_sver.copy()
-        seg_end = seg_start.copy()
-        seg_end[:n] += depth
+        # tests/test_device_kv.py against the host store). While a
+        # DEL-bearing window is in flight the mirror base is unknown —
+        # derivation then defers to settlement like the mixed lane's
+        # (_dev_settle_set patches the provisional segment).
+        deferred = self._dev_defer > 0
+        if deferred:
+            vers = None
+            sver_delta = None
+            seg_start = np.zeros_like(self._dev_sver)
+            seg_end = np.zeros_like(self._dev_sver)
+        else:
+            vers = (
+                self._dev_sver[None, : self.S]
+                + np.arange(1, W + 1, dtype=np.int64)[:, None]
+            )
+            # retain this window's value bytes host-side: (shard,
+            # version) uniquely identifies content, so the GET lane can
+            # answer reads without downloading values (see _dev_resolve)
+            seg_start = self._dev_sver.copy()
+            seg_end = seg_start.copy()
+            seg_end[:n] += depth
         if isinstance(ops, DeviceDictOps):
             seg = _DictSeg(seg_start, seg_end, ops.idx, ops.dvl, ops.dv)
         else:
             seg = _RowSeg(seg_start, seg_end, ops.vlen, ops.vwin)
+        if deferred:
+            seg.provisional = True
+            self._dev_defer += 1
         self._dev_push_segment(seg)
-        self._dev_sver[:n] += depth
+        if not deferred:
+            self._dev_sver[:n] += depth
+            sver_delta = np.zeros_like(self._dev_sver)
+            sver_delta[:n] = depth
         self._dev_commit_window(entries, depth)
-        sver_delta = np.zeros_like(self._dev_sver)
-        sver_delta[:n] = depth
         return self._dev_push_window(
             {
                 "kind": "set",
@@ -976,6 +1010,7 @@ class MeshEngine:
                 "vers": vers,
                 "seg": seg,
                 "sver_delta": sver_delta,
+                "deferred": deferred,
             }
         )
 
@@ -1069,6 +1104,10 @@ class MeshEngine:
                 self.next_slot[:rn] -= d
                 if r["sver_delta"] is not None:
                     self._dev_sver -= r["sver_delta"]
+                if r.get("deferred"):
+                    # deferred windows never advanced the mirror — the
+                    # pending count is the only bookkeeping to unwind
+                    self._dev_defer -= 1
                 self.decided_v1 -= d * rn
                 if (
                     r["seg"] is not None
@@ -1118,10 +1157,27 @@ class MeshEngine:
     def _dev_settle_set(self, rec) -> None:
         """Settle a clean pure-SET window's futures from the derived
         version responses; counts==1 per covered shard (pack_window
-        enforced it), so group bounds are the identity."""
+        enforced it), so group bounds are the identity. A deferred
+        window (dispatched behind a DEL-bearing one) derives here —
+        the mirror is exact again — and patches its provisional
+        segment."""
         from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
 
         vers = rec["vers"]
+        if rec.get("deferred"):
+            depth, n = rec["depth"], rec["n"]
+            vers = (
+                self._dev_sver[None, : self.S]
+                + np.arange(1, depth + 1, dtype=np.int64)[:, None]
+            )
+            seg = rec["seg"]
+            seg.start = self._dev_sver.copy()
+            seg.end = seg.start.copy()
+            seg.end[:n] += depth
+            seg.provisional = False
+            self._dev_evict_segments()
+            self._dev_sver[:n] += depth
+            self._dev_defer -= 1
         for t, (block, bfut, _inv) in enumerate(rec["entries"]):
             row = vers[t, np.asarray(block.shards, np.int64)]
             frames = VectorShardedKV._vers_frames(row)
@@ -1163,7 +1219,15 @@ class MeshEngine:
         """Settle a clean mixed window: SET versions derive from the
         recorded per-wave cumulative counters; GET meta was fetched on
         the worker; GET values resolve host-side with the downloaded
-        value planes as the eviction fallback."""
+        value planes as the eviction fallback.
+
+        A DEFERRED window (DEL-bearing, or dispatched behind one)
+        derives its versions HERE instead of at dispatch: FIFO
+        settlement makes the mirror exact again, and the DEL found
+        bits arrived with the meta plane — the authoritative per-shard
+        bump vector (SET always, DEL on found — exactly the host
+        store's semantics) patches the provisional segment and advances
+        the mirror before any frame derives from it."""
         from rabia_tpu.apps.device_kv import (
             GetFrameGroups,
             MixedFrameGroups,
@@ -1172,15 +1236,33 @@ class MeshEngine:
         from rabia_tpu.apps.vector_kv import FrameGroups, VectorShardedKV
 
         kind = rec["kind_rows"]
-        svers = rec["svers"]
         get_waves = rec["get_waves"]
         gpos = {int(t): j for j, t in enumerate(get_waves)}
-        resolved = True
+        gfound_h = gver_h = gvlen_h = None
         if len(get_waves):
             meta_h = rec["meta_fut"].result()
             gver_h = meta_h[0]
             gvlen_h = meta_h[1] >> 1
             gfound_h = (meta_h[1] & 1).astype(bool)
+        if rec.get("deferred"):
+            bump = (kind == 1).astype(np.int64)
+            for j, t in enumerate(get_waves):
+                t = int(t)
+                bump[t] += ((kind[t] == 3) & gfound_h[j]).astype(np.int64)
+            cum = np.cumsum(bump, axis=0)
+            svers = self._dev_sver[None, : self.S] + cum
+            seg = rec["seg"]
+            seg.start = self._dev_sver.copy()
+            seg.end = seg.start + cum[-1]
+            seg.svers = svers
+            seg.provisional = False
+            self._dev_evict_segments()
+            self._dev_sver[: self.S] += cum[-1]
+            self._dev_defer -= 1
+        else:
+            svers = rec["svers"]
+        resolved = True
+        if len(get_waves):
             # resolvability is about GET values only: EXISTS rows carry
             # found bits with version 0 and must not read as
             # unresolvable versions (meta planes are padded — compare
@@ -1319,21 +1401,19 @@ class MeshEngine:
             self._demote_device_store()
             return applied + self._run_cycle_inner()
         kind, ops, vlen_plane, vwin_plane = packed
-        if bool((kind == 3).any()):
-            # DEL bumps the shard version only when the key is FOUND —
-            # a data-dependent bump the host mirror can't derive until
-            # the meta readback. Such windows run SYNCHRONOUSLY against
-            # the settled table (drain first — the counts reach the
-            # caller) so every later window's derived versions stay
-            # exact. SET/GET/EXISTS windows keep the pipelined chain
-            # (EXISTS is read-only: its found bit rides the meta plane,
-            # it bumps nothing).
-            applied = self._dev_drain_pipe()
-            if not self._dev_active:
-                return applied + self._run_cycle_inner()
-            return applied + self._run_cycle_device_mixed_sync(
-                count, kind, ops, vlen_plane, vwin_plane
-            )
+        # DEL bumps the shard version only when the key is FOUND — a
+        # data-dependent bump the host mirror can't derive until the
+        # meta readback (which DEL waves already ride: kind >= 2). Such
+        # windows — and every window dispatched while one is in flight,
+        # whose mirror base is equally unknown — DEFER version
+        # derivation to settlement (_dev_settle_mixed), where FIFO
+        # order guarantees the mirror is exact again. The dispatch
+        # itself pipelines like any other window; the old design
+        # drained the pipe and ran DEL windows synchronously, paying a
+        # full tunnel round-trip per window (measured 82k dec/s on the
+        # DEL-heavy workload). EXISTS is read-only: its found bit rides
+        # the meta plane, it bumps nothing and forces no deferral.
+        deferred = bool((kind == 3).any()) or self._dev_defer > 0
         get_waves = np.nonzero((kind >= 2).any(axis=1))[0].astype(np.int32)
         base = np.zeros(self.S, np.int32)
         base[:n] = self.next_slot
@@ -1347,19 +1427,36 @@ class MeshEngine:
         )
         self.cycles += 1
         # derived SET versions: host mirror + inclusive per-shard SET
-        # count (GET waves advance nothing)
+        # count (GET waves advance nothing). Deferred windows push a
+        # PROVISIONAL segment (empty placeholder range — matches no
+        # resolver lookup, exempt from eviction) and leave the mirror
+        # untouched; settlement patches range + svers from the exact
+        # bump vector (SET always, DEL on found) and advances the
+        # mirror then.
         is_set = kind == 1  # [count, S]
         set_cum = np.cumsum(is_set, axis=0, dtype=np.int64)
-        svers = self._dev_sver[None, : self.S] + set_cum
-        seg_start = self._dev_sver.copy()
-        seg = _MixedSeg(
-            seg_start, seg_start + set_cum[-1], vlen_plane, vwin_plane,
-            svers, kind,
-        )
-        self._dev_push_segment(seg)
-        sver_delta = np.zeros_like(self._dev_sver)
-        sver_delta[: self.S] = set_cum[-1]
-        self._dev_sver += sver_delta
+        if deferred:
+            svers = None
+            sver_delta = None
+            seg = _MixedSeg(
+                np.zeros_like(self._dev_sver),
+                np.zeros_like(self._dev_sver),
+                vlen_plane, vwin_plane, set_cum, kind,
+            )
+            seg.provisional = True
+            self._dev_push_segment(seg)
+            self._dev_defer += 1
+        else:
+            svers = self._dev_sver[None, : self.S] + set_cum
+            seg_start = self._dev_sver.copy()
+            seg = _MixedSeg(
+                seg_start, seg_start + set_cum[-1], vlen_plane, vwin_plane,
+                svers, kind,
+            )
+            self._dev_push_segment(seg)
+            sver_delta = np.zeros_like(self._dev_sver)
+            sver_delta[: self.S] = set_cum[-1]
+            self._dev_sver += sver_delta
         self._dev_commit_window(entries, count)
         pool = self._dev_fetcher()
         return self._dev_push_window(
@@ -1385,81 +1482,9 @@ class MeshEngine:
                 "get_waves": get_waves,
                 "seg": seg,
                 "sver_delta": sver_delta,
+                "deferred": deferred,
             }
         )
-
-    def _run_cycle_device_mixed_sync(
-        self, count: int, kind, ops, vlen_plane, vwin_plane
-    ) -> int:
-        """Synchronous mixed window for DEL/EXISTS-bearing FIFOs.
-
-        Same device program as the pipelined mixed lane (the kind mask
-        covers 1=SET 2=GET 3=DEL 4=EXISTS), but dispatched against the
-        SETTLED table with flags+meta read inline: a DEL's shard-version
-        bump depends on its found bit, so the authoritative per-shard
-        bump vector (SET always, DEL on found — exactly the host
-        store's semantics) is computed from the readback before any
-        later window derives response versions from the mirror."""
-        W = self.window
-        n = self.n_shards
-        entries = [self._full_blocks[i] for i in range(count)]
-        meta_waves = np.nonzero((kind >= 2).any(axis=1))[0].astype(np.int32)
-        base = np.zeros(self.S, np.int32)
-        base[:n] = self.next_slot
-        new_state, flags_dev, meta_dev, gval_dev = self._dev.mixed_apply(
-            self.alive, base, count, kind, meta_waves, ops, W=W,
-            max_phases=self.max_phases,
-        )
-        self._lat_invalidate |= (
-            self._dev.compiled_on_last_call and self._lat_timing
-        )
-        self.cycles += 1
-        flags = np.asarray(flags_dev)
-        if not flags[0] or flags[1] or flags[2]:
-            self._demote_device_store()
-            return self._run_cycle_inner()
-        self._dev.adopt(new_state)
-        gfound_h = meta_h = None
-        if len(meta_waves):
-            meta_h = np.asarray(meta_dev)
-            gfound_h = (meta_h[1] & 1).astype(bool)
-        # authoritative version bumps: SET always, DEL on found
-        bump = (kind == 1).astype(np.int64)
-        for j, t in enumerate(meta_waves):
-            t = int(t)
-            bump[t] += ((kind[t] == 3) & gfound_h[j]).astype(np.int64)
-        cum = np.cumsum(bump, axis=0)
-        svers = self._dev_sver[None, : self.S] + cum
-        seg_start = self._dev_sver.copy()
-        self._dev_push_segment(
-            _MixedSeg(
-                seg_start, seg_start + cum[-1], vlen_plane, vwin_plane,
-                svers, kind,
-            )
-        )
-        self._dev_sver[: self.S] += cum[-1]
-        self._dev_commit_window(entries, count)
-        # settlement is the SAME code as the pipelined lane: hand
-        # _dev_settle_mixed a record whose meta future is already
-        # resolved (the sync path fetched it inline to derive the
-        # bumps) — one settle implementation, zero drift between lanes
-        meta_done = None
-        if len(meta_waves):
-            import concurrent.futures as _cf
-
-            meta_done = _cf.Future()
-            meta_done.set_result(meta_h)
-        self._dev_settle_mixed(
-            {
-                "kind_rows": kind,
-                "svers": svers,
-                "get_waves": meta_waves,
-                "meta_fut": meta_done,
-                "gval_dev": gval_dev if len(meta_waves) else None,
-                "entries": entries,
-            }
-        )
-        return count * n
 
     def _dev_push_segment(self, seg) -> None:
         """Retain one committed device window's value bytes (a
@@ -1472,9 +1497,18 @@ class MeshEngine:
         for them instead of mis-answering."""
         self._dev_vseg.append(seg)
         self._dev_vseg_bytes += seg.nbytes
+        self._dev_evict_segments()
+
+    def _dev_evict_segments(self) -> None:
+        """Enforce the segment byte cap, oldest first. Provisional
+        segments (in-flight deferred windows — contiguous at the newest
+        end) are exempt: their exact version range is unknown until
+        settlement patches them, and a wrong ``end`` would corrupt the
+        floor; settlement re-runs this loop once they are exact."""
         while (
             self._dev_vseg_bytes > self._dev_vseg_cap
             and len(self._dev_vseg) > 1
+            and not self._dev_vseg[0].provisional
         ):
             old = self._dev_vseg.popleft()
             self._dev_vseg_bytes -= old.nbytes

@@ -790,6 +790,60 @@ class TestDeviceGetWindows:
         for sm in dev.sms:
             assert _store_content(sm, n) == want
 
+    def test_del_windows_pipeline_with_deferred_versions(self):
+        # DEL-bearing windows PIPELINE (no synchronous drain): version
+        # derivation defers to settlement, and every window dispatched
+        # while one is in flight inherits the deferral — a later SET's
+        # response version must count the earlier DEL's found-dependent
+        # bump even though that bump is unknown at its dispatch. This
+        # stream is sized so window k+1 (pure SET) dispatches while the
+        # DEL window k is still unsettled: wrong-base derivation would
+        # shift every subsequent version by the found-DEL count.
+        from rabia_tpu.apps.kvstore import (
+            KVOperation,
+            KVOpType,
+            encode_op_bin,
+        )
+
+        enc = lambda t, k: encode_op_bin(KVOperation(t, k))
+        n = 4
+        dev = _mk(n, device=True, window=2)
+        host = _mk(n, device=False, window=2)
+
+        def stream():
+            shards = list(range(n))
+            blk = lambda op: build_block(shards, [[op] for _ in shards])
+            out = []
+            # wave pairs = windows of 2: [SET, SET] [DEL, DEL] [SET, SET]
+            # [GET, EXISTS] [SET, DEL] [GET, GET]
+            out.append(blk(encode_set_bin("a", "v0")))
+            out.append(blk(encode_set_bin("b", "v1")))
+            out.append(blk(enc(KVOpType.Delete, "a")))      # found
+            out.append(blk(enc(KVOpType.Delete, "missing")))  # not found
+            out.append(blk(encode_set_bin("a", "v2")))  # ver counts the bump
+            out.append(blk(encode_set_bin("c", "v3")))
+            out.append(blk(enc(KVOpType.Get, "a")))
+            out.append(blk(enc(KVOpType.Exists, "b")))
+            out.append(blk(encode_set_bin("b", "v4")))
+            out.append(blk(enc(KVOpType.Delete, "c")))      # found
+            out.append(blk(enc(KVOpType.Get, "b")))
+            out.append(blk(enc(KVOpType.Get, "c")))         # not found
+            return out
+
+        fd = [dev.submit_block(b) for b in stream()]
+        fh = [host.submit_block(b) for b in stream()]
+        dev.flush()
+        host.flush()
+        assert dev._dev_active, "DEL windows demoted the lane"
+        assert dev._dev_defer == 0, "deferral bookkeeping leaked"
+        assert not dev._dev_pipe
+        for i, (a, b) in enumerate(zip(fd, fh)):
+            assert _frames(a) == _frames(b), i
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+
     def test_get_window_dict_upload_engages_and_conforms(self):
         # a repetitive GET stream takes the dictionary-compressed key
         # upload (keys repeat like SET rows repeat); responses stay
