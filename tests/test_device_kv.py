@@ -844,6 +844,64 @@ class TestDeviceGetWindows:
         for sm in dev.sms:
             assert _store_content(sm, n) == want
 
+    def test_deferred_del_window_dirty_rollback(self):
+        # a DEL-bearing (deferred) window that reads back DIRTY: the
+        # rollback must unwind the deferral bookkeeping (_dev_defer
+        # back to 0, provisional segments popped) for BOTH the dirty
+        # window and the deferred window pipelined behind it, then the
+        # host path must replay everything in submission order —
+        # exercises the rollback branch the clean-path test can't
+        from rabia_tpu.apps.kvstore import (
+            KVOperation,
+            KVOpType,
+            encode_op_bin,
+        )
+
+        n = 2
+        mk = lambda device: _mk(
+            n,
+            device=device,
+            device_store_kw={"per_shard_capacity": 4},
+            window=4,
+        )
+        dev, host = mk(True), mk(False)
+        shards = list(range(n))
+        blk = lambda op: build_block(shards, [[op] for _ in shards])
+
+        warm = [blk(encode_set_bin(f"k{w}", "x")) for w in range(3)]
+        # window 1 (DEL-bearing -> deferred): the DEL frees one slot
+        # but three new keys need 5 total -> table overflow -> dirty
+        w1 = [blk(enc) for enc in (
+            encode_op_bin(KVOperation(KVOpType.Delete, "k0")),
+            encode_set_bin("k3", "x"),
+            encode_set_bin("k4", "x"),
+            encode_set_bin("k5", "x"),
+        )]
+        # window 2 dispatched while window 1 is in flight: inherits
+        # the deferral (pure SET behind a DEL window)
+        w2 = [blk(encode_set_bin(f"m{w}", "y")) for w in range(4)]
+
+        for b in warm:
+            dev.submit_block(b)
+        dev.flush()
+        assert dev._dev_active
+        for b in w1 + w2:
+            dev.submit_block(b)
+        dev.run_cycle()  # dispatches window 1, flags resolve later
+        assert dev._dev_pipe and dev._dev_defer == 1
+        dev.flush()
+        assert not dev._dev_active, "dirty DEL window must demote"
+        assert dev._dev_defer == 0, "rollback leaked deferral count"
+        assert not dev._dev_pipe
+
+        for b in warm + w1 + w2:
+            host.submit_block(b)
+        host.flush()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+        assert np.array_equal(dev.next_slot, host.next_slot)
+
     def test_get_window_dict_upload_engages_and_conforms(self):
         # a repetitive GET stream takes the dictionary-compressed key
         # upload (keys repeat like SET rows repeat); responses stay
