@@ -264,6 +264,26 @@ def soak_mesh(
             futs.append(gf)
             if ctr > 2:  # every key has been SET by then (FIFO order)
                 get_futs.append(gf)
+        elif device_store and ctr % 7 in (3, 5):
+            # DEL-bearing full-width waves on a separate key family
+            # (the convergence probes read s-keys): SET d-keys at
+            # %7==3, DEL them at %7==5 — deferred-version windows
+            # (found AND not-found DELs, depending on where the
+            # crash/demote cycle interleaved) pipeline under fire
+            if ctr % 7 == 3:
+                mk_op = lambda s: encode_set_bin(f"d{s}", f"w{ctr}")
+            else:
+                mk_op = lambda s: encode_op_bin(
+                    KVOperation.delete(f"d{s}")
+                )
+            futs.append(
+                eng.submit_block(
+                    build_block(
+                        list(range(S)),
+                        [[mk_op(s)] for s in range(S)],
+                    )
+                )
+            )
         else:
             for s in range(S):
                 futs.append(
