@@ -11,10 +11,13 @@ real hardware) as a single multi-controller SPMD program.
 
 Run directly (spawns its own workers):
 
-    python scripts/dcn_dryrun.py
+    python scripts/dcn_dryrun.py [--procs N]    # default 2
 
 Each worker asserts its addressable shards decided V1 and prints a line;
-the parent checks both exit codes.
+the parent checks every exit code. ``--procs 4`` stretches the same
+recipe across a 4-process global mesh (shard axis = processes, replica
+axis = per-process devices) — the shape of a 4-slice pod ingesting
+consensus shards over DCN.
 """
 
 from __future__ import annotations
@@ -26,17 +29,17 @@ import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-N_PROC = 2
+DEFAULT_PROCS = 2
 DEVS_PER_PROC = 4
 
 
-def worker(process_id: int, coordinator: str) -> None:
+def worker(process_id: int, n_proc: int, coordinator: str) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(
         coordinator_address=coordinator,
-        num_processes=N_PROC,
+        num_processes=n_proc,
         process_id=process_id,
     )
     import numpy as np
@@ -48,13 +51,17 @@ def worker(process_id: int, coordinator: str) -> None:
     from rabia_tpu.parallel import MeshPhaseKernel, make_mesh
     from rabia_tpu.parallel.mesh import MeshPhaseState
 
-    devs = jax.devices()  # global: both processes' cpu devices
-    assert len(devs) == N_PROC * DEVS_PER_PROC, devs
-    # replica axis spans 4 devices; shard axis spans the 2 processes —
-    # on a pod this is "replicas within a slice (ICI), shards across
-    # slices (DCN)"; the kernel code is identical either way
-    mesh = make_mesh(devs, shard_axis_size=2, replica_axis_size=4)
-    S, R = 4, 4
+    devs = jax.devices()  # global: every process's cpu devices
+    assert len(devs) == n_proc * DEVS_PER_PROC, devs
+    # replica axis spans each process's 4 devices; shard axis spans the
+    # processes — on a pod this is "replicas within a slice (ICI),
+    # shards across slices (DCN)"; the kernel code is identical either
+    # way, at any process count
+    mesh = make_mesh(devs, shard_axis_size=n_proc, replica_axis_size=4)
+    # shard axis must divide S: round the base width up to a
+    # multiple of the process count
+    S = ((max(4, n_proc) + n_proc - 1) // n_proc) * n_proc
+    R = 4
     k = MeshPhaseKernel(S, R, mesh, seed=3)
     sr = NamedSharding(mesh, P("shard", "replica"))
 
@@ -130,14 +137,14 @@ def worker(process_id: int, coordinator: str) -> None:
     )
     print(
         f"proc {process_id}: MeshEngine committed {applied} batches "
-        f"(scalar + block lanes) across the 2-process mesh; "
+        f"(scalar + block lanes) across the {n_proc}-process mesh; "
         f"state digests agree",
         flush=True,
     )
     jax.distributed.shutdown()
 
 
-def main() -> int:
+def main(n_proc: int) -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
@@ -150,25 +157,52 @@ def main() -> int:
     )
     procs = [
         subprocess.Popen(
-            [sys.executable, __file__, "--worker", str(i), coordinator],
+            [
+                sys.executable, __file__, "--worker", str(i),
+                str(n_proc), coordinator,
+            ],
             env=env,
             cwd=str(REPO),
         )
-        for i in range(N_PROC)
+        for i in range(n_proc)
     ]
-    rcs = [p.wait(timeout=300) for p in procs]
+    rcs = []
+    try:
+        for p in procs:
+            rcs.append(p.wait(timeout=600))
+    except subprocess.TimeoutExpired:
+        # a hung worker (e.g. a peer died before initialize and the
+        # rest block in the collective) must not orphan the others
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        print("dcn dryrun FAILED: worker timeout (rest killed)",
+              file=sys.stderr)
+        return 1
     if any(rcs):
         print(f"dcn dryrun FAILED: worker rcs {rcs}", file=sys.stderr)
         return 1
     print(
-        "dcn dryrun ok: 2 processes, one global mesh — collective phase "
-        "step + full MeshEngine SMR with cross-process state agreement"
+        f"dcn dryrun ok: {n_proc} processes, one global mesh — "
+        "collective phase step + full MeshEngine SMR with "
+        "cross-process state agreement"
     )
     return 0
 
 
 if __name__ == "__main__":
-    if len(sys.argv) == 4 and sys.argv[1] == "--worker":
-        worker(int(sys.argv[2]), sys.argv[3])
+    if len(sys.argv) == 5 and sys.argv[1] == "--worker":
+        worker(int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
     else:
-        sys.exit(main())
+        import argparse
+
+        ap = argparse.ArgumentParser(description=__doc__)
+        ap.add_argument(
+            "--procs", type=int, default=DEFAULT_PROCS,
+            help="processes in the global mesh (shard axis width)",
+        )
+        args = ap.parse_args()
+        if args.procs < 1:
+            ap.error("--procs must be >= 1")
+        sys.exit(main(args.procs))
