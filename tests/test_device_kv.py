@@ -844,6 +844,34 @@ class TestDeviceGetWindows:
         for sm in dev.sms:
             assert _store_content(sm, n) == want
 
+    def test_deeper_inflight_pipe_byte_identical(self):
+        # device_store_inflight=3 keeps three dispatched-but-unresolved
+        # windows in the pipe (the throughput-mode default: with one
+        # fetch worker per window it measured 1.05-2.4x depth 1 —
+        # inflight_depth_ab in benchmarks/results.json); responses and
+        # final content must be byte-identical to the host path
+        n = 8
+        dev = _mk(n, device=True, device_store_inflight=3, window=2)
+        host = _mk(n, device=False, window=2)
+        rng = np.random.default_rng(21)
+        fd = [dev.submit_block(b) for b in self._mixed_fifo(n, rng)]
+        fh = [
+            host.submit_block(b)
+            for b in self._mixed_fifo(n, np.random.default_rng(21))
+        ]
+        dev.flush()
+        host.flush()
+        assert dev._dev_active
+        assert dev._dev_defer == 0 and not dev._dev_pipe
+        for i, (a, b) in enumerate(zip(fd, fh)):
+            ra = [list(map(bytes, g)) for g in a.result()]
+            rb = [list(map(bytes, g)) for g in b.result()]
+            assert ra == rb, i
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+
     def test_deferred_del_window_dirty_rollback(self):
         # a DEL-bearing (deferred) window that reads back DIRTY: the
         # rollback must unwind the deferral bookkeeping (_dev_defer
