@@ -305,12 +305,14 @@ def _mesh_engine_rate(S: int, replicas: int) -> float:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from benchmarks.mesh_engine_bench import bench_block_lane
 
-    # W=96 x 8 waves from the round-5 A/B sweep: consistently ~1.4x the
-    # old W=64 x 4 geometry (2.3-2.5M vs 1.5-1.8M dec/s on the tunnel;
-    # headline_depth_probe_r05.engine_pairing in benchmarks/results.json)
+    # W=64 x 12 waves retuned for the three-deep pipelined commit:
+    # paired repeats put it ~6% over the depth-1-era W=96 x 8 pick
+    # (3.2-3.5M vs 3.0-3.4M dec/s on the tunnel) with lower per-window
+    # latency (inflight_depth_ab.engine_geometry_retune in
+    # benchmarks/results.json)
     return float(
         bench_block_lane(
-            S, replicas, window=96, waves=8, strict=False,
+            S, replicas, window=64, waves=12, strict=False,
             device_store=True,
         )["decisions_per_sec"]
     )

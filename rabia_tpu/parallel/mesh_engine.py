@@ -464,14 +464,15 @@ class MeshEngine:
             # together they resolve ANY version a device GET can return,
             # so the read lane downloads found+version only (~5 B/op),
             # not value planes (~70 B/op over a ~12MB/s tunnel)
-            # pipelined-commit records: dispatched-but-unresolved SET
+            # pipelined-commit records: dispatched-but-unresolved
             # windows (flags unread); see _run_cycle_fullwidth_device.
-            # The 12-byte flags fetch runs on a single worker thread:
-            # issued from the main thread it would queue BEHIND the
-            # just-dispatched next window on the single-stream device
-            # and eat a full window of latency per cycle (measured
-            # ~156ms/cycle); the worker blocks there instead while the
-            # main thread packs the next window.
+            # Flag/meta fetches run on a worker pool (2 per allowed
+            # in-flight window — see _dev_fetcher): issued from the
+            # main thread they would queue BEHIND the just-dispatched
+            # next window on the single-stream device and eat a full
+            # window of latency per cycle (measured ~156ms/cycle), and
+            # on a single worker the fetches serialize one RTT apart,
+            # erasing the deeper pipe's win (inflight_depth_ab).
             self._dev_pipe: list = []
             # in-flight windows whose version derivation is DEFERRED to
             # settlement (DEL bumps the shard version only when found —
