@@ -403,6 +403,12 @@ class MeshEngine:
             self.window = min(self.max_window, max(self.min_window, self.window))
         self.window_resizes = 0
         self._lat_samples: deque[float] = deque(maxlen=64)
+        # dispatch->settle wall time of resolved device windows (ms):
+        # the latency a CLIENT observes through the pipelined commit —
+        # at pipe depth d a window settles ~d cycles after dispatch,
+        # which per-cycle samples cannot see. Collected in device mode
+        # regardless of governing; reported via governor_stats
+        self._lat_settle: deque[float] = deque(maxlen=64)
         self._lat_saturated = False
         # set by _govern when the target is below the measured floor at
         # min_window (no window size can meet it); see governor_stats()
@@ -805,6 +811,27 @@ class MeshEngine:
                 else None
             ),
             "ceiling_window": self._lat_ceiling,
+            # client-observed dispatch->settle latency through the
+            # pipelined commit (~inflight x window time when
+            # saturated — the p99 a settle-latency SLO would see).
+            # Both report None while the device lane is inactive: no
+            # pipelined commit exists then, and frozen device-era
+            # samples must not read as live latency
+            "inflight": (
+                self._dev_inflight
+                if self._dev is not None and self._dev_active
+                else None
+            ),
+            "settle_p99_ms": (
+                round(
+                    float(
+                        np.percentile(np.asarray(self._lat_settle), 99)
+                    ),
+                    3,
+                )
+                if self._lat_settle
+                else None
+            ),
         }
 
     def _run_cycle_inner(self) -> int:
@@ -1063,6 +1090,7 @@ class MeshEngine:
         tunnel — depth 1 overlaps the readback with one pack, deeper
         pipes hide a round-trip longer than a single pack). Owns the
         pipe policy so the three dispatch paths cannot diverge."""
+        rec["t0"] = time.perf_counter()
         self._dev_pipe.append(rec)
         applied = 0
         while len(self._dev_pipe) > self._dev_inflight:
@@ -1166,6 +1194,10 @@ class MeshEngine:
             self._demote_device_store()
             return 0
         self._dev_pipe.pop(0)
+        # dispatch->settle latency: what a client actually waits at the
+        # current pipe depth (depth multiplies it — the reason governed
+        # mode defaults to depth 1); surfaced via governor_stats
+        self._lat_settle.append((time.perf_counter() - rec["t0"]) * 1e3)
         # "get" windows are read-only: new_state is the (unchanged)
         # state they chained on, so adopting is a no-op by value and
         # keeps the pipe invariant uniform
@@ -1595,6 +1627,9 @@ class MeshEngine:
         # no sample in flight to void
         self._lat_invalidate |= self._lat_timing
         self._dev_active = False
+        # device-era settle samples must not read as live latency from
+        # the host path (re-promotion starts a fresh window population)
+        self._lat_settle.clear()
         self._dev_cooldown = self._dev_repromote  # earn the way back
         if self._dev_fetcher_pool is not None:
             # host mode needs no flags worker; re-promotion recreates it

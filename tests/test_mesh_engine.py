@@ -686,6 +686,46 @@ class TestLatencyGovernor:
         assert st["p99_ms"] is None
         assert st["unachievable"] is False
         assert st["window"] == 4
+        assert st["settle_p99_ms"] is None
+
+    def test_settle_latency_reported_for_device_lane(self):
+        # dispatch->settle samples (the latency a client observes
+        # through the pipelined commit — per-cycle samples cannot see
+        # the pipe residency) populate in device mode and surface via
+        # governor_stats alongside the pipe depth
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.apps.vector_kv import VectorShardedKV
+        from rabia_tpu.core.blocks import build_block
+        from rabia_tpu.parallel import MeshEngine, make_mesh
+
+        n = 4
+        eng = MeshEngine(
+            lambda: VectorShardedKV(n, capacity=1 << 10),
+            n_shards=n,
+            n_replicas=3,
+            mesh=make_mesh(),
+            window=2,
+            device_store=True,
+        )
+        shards = list(range(n))
+        for w in range(8):
+            eng.submit_block(
+                build_block(
+                    shards,
+                    [[encode_set_bin(f"k{s}", f"v{w}")] for s in shards],
+                )
+            )
+        eng.flush()
+        st = eng.governor_stats()
+        assert st["inflight"] == 3  # throughput-mode default
+        assert st["settle_p99_ms"] is not None and st["settle_p99_ms"] > 0
+        assert len(eng._lat_settle) >= 3
+        # after demotion there is no pipelined commit: both report None
+        # (frozen device-era samples must not read as live latency)
+        eng._demote_device_store()
+        st = eng.governor_stats()
+        assert st["inflight"] is None
+        assert st["settle_p99_ms"] is None
 
     def test_governed_state_matches_ungoverned(self):
         from rabia_tpu.apps.kvstore import encode_set_bin
