@@ -830,6 +830,8 @@ class MeshEngine:
                     3,
                 )
                 if self._lat_settle
+                and self._dev is not None
+                and self._dev_active
                 else None
             ),
         }
@@ -2101,8 +2103,12 @@ class MeshEngine:
         self._spec = None  # speculated on pre-restore slot counters
         # a restored snapshot supersedes any device-lane state: continue
         # on the host path (no sync — the checkpoint IS the state); the
-        # re-promotion path may climb back after the usual cool-down
+        # re-promotion path may climb back after the usual cool-down.
+        # Pre-restore settle samples die with the lane (stats are also
+        # gated on _dev_active, but a re-promotion must not mix them
+        # into its fresh window population)
         self._dev_active = False
+        self._lat_settle.clear()
         self._dev_cooldown = self._dev_repromote
         committed = np.asarray(
             state.per_shard_committed[: self.n_shards], np.int64
