@@ -359,6 +359,7 @@ def bench_latency_governor(
     n_replicas: int,
     targets_ms: list,
     seconds_per: float = 6.0,
+    device_store: bool = False,
 ) -> dict:
     """Throughput-vs-p99 under the window governor.
 
@@ -384,6 +385,7 @@ def bench_latency_governor(
             n_replicas=n_replicas,
             mesh=make_mesh(),
             window=16,
+            device_store=device_store,
             latency_target_ms=t_ms,
             max_window=256,
         )
@@ -453,6 +455,11 @@ def bench_latency_governor(
             "governor_p99_decision_ms": gstats["p99_decision_ms"],
             "unachievable": gstats["unachievable"],
             "floor_ms": gstats["floor_ms"],
+            # client-observed dispatch->settle p99 (governed mode runs
+            # the pipe at depth 1, so this tracks ~window time + the
+            # next cycle's pack; None when the lane is demoted/absent)
+            "inflight": gstats["inflight"],
+            "settle_p99_ms": gstats["settle_p99_ms"],
         }
         print(
             f"  governor target {t_ms}ms -> W={eng.window} "
@@ -740,12 +747,16 @@ def main() -> None:
         # require re-running the full mesh bench
         print("latency governor sweep (block lane, 1024 shards x 3):")
         sweep = bench_latency_governor(1024, 3, [20.0, 60.0, 250.0, 1000.0])
+        print("governed DEVICE lane point (settle-latency stats live):")
+        dev_point = bench_latency_governor(
+            1024, 3, [250.0], device_store=True
+        )
         if "--record" in sys.argv:
             path = Path(__file__).parent / "results.json"
             doc = json.loads(path.read_text()) if path.exists() else {}
-            doc.setdefault("mesh_engine_r05", {})[
-                "latency_governor_sweep"
-            ] = sweep
+            sect = doc.setdefault("mesh_engine_r05", {})
+            sect["latency_governor_sweep"] = sweep
+            sect["latency_governor_device_point"] = dev_point
             path.write_text(json.dumps(doc, indent=1))
             print("recorded -> results.json mesh_engine_r05")
         return

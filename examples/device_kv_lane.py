@@ -56,10 +56,13 @@ def main() -> int:
     ]
     futs = [eng.submit_block(b) for b in futs]
     eng.flush()
-    vers = [bytes(g[0]) for g in futs[-1].result()]
+    # SET frames carry host-DERIVED versions (never transferred): the
+    # 8th write of every key reports version 8 (frame layout:
+    # u8 kind, u32-LE version, u8 has-value — vector_kv._RESP_DT)
+    ver8 = int.from_bytes(bytes(futs[-1].result()[0][0])[1:5], "little")
     print(
         f"8 SET waves x {S} shards committed in {eng.cycles} dispatches; "
-        f"device lane active: {eng._dev_active}"
+        f"device lane active: {eng._dev_active}; k0 at version {ver8}"
     )
 
     # 2. GET waves: meta-only readback, values resolve host-side
@@ -125,7 +128,6 @@ def main() -> int:
     want = eng.sms[0].store.get(5, b"k5")
     assert all(sm.store.get(5, b"k5") == want for sm in eng.sms)
     print(f"k5 on every replica: {want[0].decode()} (version {want[1]})")
-    del vers
     print("OK")
     return 0
 

@@ -1094,6 +1094,15 @@ class MeshEngine:
         pipe policy so the three dispatch paths cannot diverge."""
         rec["t0"] = time.perf_counter()
         self._dev_pipe.append(rec)
+        if self._dev.compiled_on_last_call:
+            # a jit compile (new window size / widths signature) ran
+            # inside this dispatch: seconds of one-off machinery sat
+            # between every in-flight window's dispatch and its
+            # resolve. Their settle samples would read as latency —
+            # taint them (same policy as _lat_invalidate for the
+            # governor's per-cycle samples)
+            for r in self._dev_pipe:
+                r["lat_taint"] = True
         applied = 0
         while len(self._dev_pipe) > self._dev_inflight:
             applied += self._dev_resolve_one()
@@ -1198,8 +1207,13 @@ class MeshEngine:
         self._dev_pipe.pop(0)
         # dispatch->settle latency: what a client actually waits at the
         # current pipe depth (depth multiplies it — the reason governed
-        # mode defaults to depth 1); surfaced via governor_stats
-        self._lat_settle.append((time.perf_counter() - rec["t0"]) * 1e3)
+        # mode defaults to depth 1); surfaced via governor_stats.
+        # Compile-tainted windows are excluded (one-off jit machinery,
+        # not steady-state latency)
+        if not rec.get("lat_taint"):
+            self._lat_settle.append(
+                (time.perf_counter() - rec["t0"]) * 1e3
+            )
         # "get" windows are read-only: new_state is the (unchanged)
         # state they chained on, so adopting is a no-op by value and
         # keeps the pipe invariant uniform
