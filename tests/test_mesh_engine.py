@@ -694,19 +694,10 @@ class TestLatencyGovernor:
         # the pipe residency) populate in device mode and surface via
         # governor_stats alongside the pipe depth
         from rabia_tpu.apps.kvstore import encode_set_bin
-        from rabia_tpu.apps.vector_kv import VectorShardedKV
         from rabia_tpu.core.blocks import build_block
-        from rabia_tpu.parallel import MeshEngine, make_mesh
 
         n = 4
-        eng = MeshEngine(
-            lambda: VectorShardedKV(n, capacity=1 << 10),
-            n_shards=n,
-            n_replicas=3,
-            mesh=make_mesh(),
-            window=2,
-            device_store=True,
-        )
+        eng = self._mk(S=n, window=2, device_store=True)
         shards = list(range(n))
         for w in range(8):
             eng.submit_block(
@@ -726,6 +717,31 @@ class TestLatencyGovernor:
         st = eng.governor_stats()
         assert st["inflight"] is None
         assert st["settle_p99_ms"] is None
+
+    def test_settle_samples_exclude_compile_tainted_windows(self):
+        # a window resolved across a jit compile would count seconds of
+        # one-off machinery as client latency: dispatches that compile
+        # taint every in-flight window and tainted windows contribute
+        # no settle sample. The FIRST window of a fresh engine always
+        # compiles — deterministically pinning the exclusion
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.core.blocks import build_block
+
+        n = 4
+        eng = self._mk(S=n, window=2, device_store=True)
+        shards = list(range(n))
+        wave = lambda w: build_block(
+            shards, [[encode_set_bin(f"k{s}", f"v{w}")] for s in shards]
+        )
+        eng.submit_block(wave(0))
+        eng.submit_block(wave(1))
+        eng.flush()  # one window; its dispatch compiled -> tainted
+        assert eng._dev_active
+        assert len(eng._lat_settle) == 0, "compile-tainted sample leaked"
+        for w in range(2, 8):  # same signature: no compile, samples flow
+            eng.submit_block(wave(w))
+        eng.flush()
+        assert len(eng._lat_settle) >= 2
 
     def test_governed_state_matches_ungoverned(self):
         from rabia_tpu.apps.kvstore import encode_set_bin
