@@ -482,3 +482,15 @@ class TestFastIds:
         if verdict == "SKIP":
             pytest.skip("no fork on this platform")
         assert verdict == "DIFFER"
+
+
+def test_main_module_environment_report(capsys):
+    # `python -m rabia_tpu` doctor: the report path runs on any backend
+    # and exits 0 with the version + native-component lines present
+    from rabia_tpu.__main__ import main
+
+    assert main([]) == 0
+    out = capsys.readouterr().out
+    assert "rabia-tpu" in out
+    assert "native codec" in out
+    assert "native TCP transport" in out

@@ -617,6 +617,54 @@ class TestDeviceGetWindows:
             list(map(bytes, g)) for g in fh.result()
         ]
 
+    def test_eviction_pressure_during_deferred_del_windows(self):
+        # segment-cap pressure while DEL-bearing (deferred) windows are
+        # in flight: eviction stops at PROVISIONAL segments (their
+        # exact version range is unknown until settlement patches
+        # them), settlement re-runs the eviction loop, and GETs of
+        # evicted versions fall back to the value-plane download —
+        # all byte-identical to the host path under a 1-byte cap
+        from rabia_tpu.apps.kvstore import (
+            KVOperation,
+            KVOpType,
+            encode_op_bin,
+        )
+
+        enc = lambda t, k: encode_op_bin(KVOperation(t, k))
+        n = 4
+        dev = _mk(n, device=True, window=2)
+        host = _mk(n, device=False, window=2)
+        dev._dev_vseg_cap = 1  # evict every settled segment immediately
+
+        def stream():
+            shards = list(range(n))
+            blk = lambda op: build_block(shards, [[op] for _ in shards])
+            out = []
+            for w in range(3):
+                out.append(blk(encode_set_bin(f"k{w}", f"v{w}" * 5)))
+            out.append(blk(enc(KVOpType.Delete, "k0")))      # deferred
+            out.append(blk(encode_set_bin("k0", "back")))    # deferred
+            out.append(blk(enc(KVOpType.Get, "k0")))         # same-pipe read
+            out.append(blk(enc(KVOpType.Get, "k1")))         # evicted read
+            out.append(blk(enc(KVOpType.Delete, "k2")))      # deferred
+            out.append(blk(enc(KVOpType.Get, "k2")))         # deleted read
+            out.append(blk(encode_set_bin("k3", "tail")))
+            return out
+
+        fd = [dev.submit_block(b) for b in stream()]
+        fh = [host.submit_block(b) for b in stream()]
+        dev.flush()
+        host.flush()
+        assert dev._dev_active
+        assert dev._dev_defer == 0 and not dev._dev_pipe
+        assert bool((dev._dev_floor[:n] > 0).any())  # evictions happened
+        for i, (a, b) in enumerate(zip(fd, fh)):
+            assert _frames(a) == _frames(b), i
+        dev._demote_device_store()
+        want = _store_content(host.sms[0], n)
+        for sm in dev.sms:
+            assert _store_content(sm, n) == want
+
     def test_repromotion_seed_resolves_old_versions(self):
         n = 4
         dev = _mk(n, device=True, device_store_repromote=1)
