@@ -492,6 +492,8 @@ def _conformance_point(n_devices: int, n_shards: int) -> bool:
     shards = list(range(n_shards))
     enc_get = lambda k: encode_op_bin(KVOperation(KVOpType.Get, k))
 
+    enc_del = lambda k: encode_op_bin(KVOperation.delete(k))
+
     def blocks():
         out = []
         for wave in range(6):
@@ -500,6 +502,22 @@ def _conformance_point(n_devices: int, n_shards: int) -> bool:
                 for s in range(n_shards)
             ]
             out.append(build_block(shards, cmds))
+        # DEL waves exercise the deferred-version pipeline at this mesh
+        # width (found AND not-found), then a re-SET and the read wave
+        out.append(
+            build_block(shards, [[enc_del(f"k{s % 5}")] for s in range(n_shards)])
+        )
+        out.append(
+            build_block(
+                shards, [[enc_del("absent")] for _ in range(n_shards)]
+            )
+        )
+        out.append(
+            build_block(
+                shards,
+                [[encode_set_bin(f"k{s % 5}", "post-del")] for s in range(n_shards)],
+            )
+        )
         out.append(
             build_block(shards, [[enc_get(f"k{s % 5}")] for s in range(n_shards)])
         )
