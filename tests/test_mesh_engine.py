@@ -718,6 +718,32 @@ class TestLatencyGovernor:
         assert st["inflight"] is None
         assert st["settle_p99_ms"] is None
 
+    def test_restore_clears_settle_samples(self):
+        # restore() is a second device-lane deactivation path besides
+        # demotion: pre-restore settle samples must die with the lane
+        # (and the stats must read None) so a later re-promotion starts
+        # a fresh window population instead of mixing eras
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.core.blocks import build_block
+
+        n = 4
+        eng = self._mk(S=n, window=2, device_store=True)
+        shards = list(range(n))
+        for w in range(8):
+            eng.submit_block(
+                build_block(
+                    shards,
+                    [[encode_set_bin(f"k{s}", f"v{w}")] for s in shards],
+                )
+            )
+        eng.flush()
+        assert len(eng._lat_settle) > 0
+        ckpt = eng.checkpoint()
+        eng.restore(ckpt)
+        assert len(eng._lat_settle) == 0
+        st = eng.governor_stats()
+        assert st["inflight"] is None and st["settle_p99_ms"] is None
+
     def test_settle_samples_exclude_compile_tainted_windows(self):
         # a window resolved across a jit compile would count seconds of
         # one-off machinery as client latency: dispatches that compile
