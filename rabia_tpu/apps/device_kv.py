@@ -422,17 +422,6 @@ class DeviceKVTable:
         vlen_all = np.concatenate([p[4] for p in parsed])
         op_all = np.concatenate([p[5] for p in parsed])
         sh_all = np.concatenate([p[0].shards for p in parsed])
-        t_all = np.repeat(
-            np.arange(W), [len(p[2]) for p in parsed]
-        )
-        kw = dbuf_all[(off_all + _SET_HDR)[:, None] + kcols]
-        kw = np.where(kcols < klen_all[:, None], kw, 0)
-        vidx = np.minimum(
-            (off_all + _SET_HDR + klen_all)[:, None] + vcols,
-            len(dbuf_all) - 1,
-        )
-        vw = dbuf_all[vidx]
-        vw = np.where(vcols < vlen_all[:, None], vw, 0)
         n = self.n_shards
         grid = len(sh_all) == W * n and bool(
             (sh_all.reshape(W, n) == np.arange(n)[None, :]).all()
@@ -444,15 +433,69 @@ class DeviceKVTable:
             kind_w[:, :n] = op_all.reshape(W, n)
             klen_w[:, :n] = klen_all.reshape(W, n)
             vlen_w[:, :n] = vlen_all.reshape(W, n)
+            if self._native_pack_gather(
+                dbuf_all, off_all, klen_all, vlen_all, n, kwin_w, vwin_w
+            ):
+                return kind_w, klen_w, vlen_w, kwin_w, vwin_w
+        kw = dbuf_all[(off_all + _SET_HDR)[:, None] + kcols]
+        kw = np.where(kcols < klen_all[:, None], kw, 0)
+        vidx = np.minimum(
+            (off_all + _SET_HDR + klen_all)[:, None] + vcols,
+            len(dbuf_all) - 1,
+        )
+        vw = dbuf_all[vidx]
+        vw = np.where(vcols < vlen_all[:, None], vw, 0)
+        if grid:
             kwin_w[:, :n] = kw.reshape(W, n, ku)
             vwin_w[:, :n] = vw.reshape(W, n, vu)
         else:
+            t_all = np.repeat(
+                np.arange(W), [len(p[2]) for p in parsed]
+            )
             kind_w[t_all, sh_all] = op_all
             klen_w[t_all, sh_all] = klen_all
             vlen_w[t_all, sh_all] = vlen_all
             kwin_w[t_all, sh_all] = kw
             vwin_w[t_all, sh_all] = vw
         return kind_w, klen_w, vlen_w, kwin_w, vwin_w
+
+    def _native_pack_gather(
+        self, dbuf_all, off_all, klen_all, vlen_all, n, kwin_w, vwin_w
+    ) -> bool:
+        """One-pass C gather of key/value bytes into the zeroed padded
+        planes (GRID fast path only; op i = wave i//n, shard i%n). The
+        numpy gather stays the semantics owner — False (library
+        unavailable, ``RABIA_PY_DEVPACK=1``, or the C bounds check
+        tripping) routes the caller to it. Byte-equivalence with the
+        numpy path is pinned in tests/test_device_kv.py."""
+        import os
+
+        if os.environ.get("RABIA_PY_DEVPACK"):
+            return False
+        from rabia_tpu.native.build import load_hostkernel
+
+        lib = load_hostkernel()
+        if lib is None:
+            return False
+        W_, S_, ku = kwin_w.shape
+        vu = vwin_w.shape[2]
+        dbuf_all = np.ascontiguousarray(dbuf_all)
+        off64 = np.ascontiguousarray(off_all, np.int64)
+        klen64 = np.ascontiguousarray(klen_all, np.int64)
+        vlen64 = np.ascontiguousarray(vlen_all, np.int64)
+        rc = lib.rk_pack_gather(
+            dbuf_all.ctypes.data, len(dbuf_all),
+            off64.ctypes.data, klen64.ctypes.data, vlen64.ctypes.data,
+            len(off64), n, S_, _SET_HDR, ku, vu,
+            kwin_w.ctypes.data, vwin_w.ctypes.data,
+        )
+        if rc != 0:
+            # defensive bounds trip: rezero the partially-written
+            # planes before the numpy path repopulates them
+            kwin_w[...] = 0
+            vwin_w[...] = 0
+            return False
+        return True
 
     def pack_window(self, blocks) -> Optional[DeviceWindowOps]:
         """Pack SET-only ``blocks`` (one per wave, FIFO order) into

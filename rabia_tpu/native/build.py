@@ -193,6 +193,14 @@ def load_hostkernel() -> ctypes.CDLL | None:
         lib.rk_open_scan.argtypes = [
             ctypes.c_int32, p, p, p, p, p, p, p, p, p, p,
         ]
+        lib.rk_pack_gather.restype = ctypes.c_int32
+        lib.rk_pack_gather.argtypes = [
+            p, ctypes.c_int64,
+            p, p, p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            p, p,
+        ]
         _HK_CACHED = lib
         return lib
 

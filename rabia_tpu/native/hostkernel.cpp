@@ -26,6 +26,7 @@
 // contract) and writes the outbox extras that do not alias new state.
 
 #include <cstdint>
+#include <cstring>
 
 extern "C" {
 
@@ -184,6 +185,35 @@ void rk_start_slots(
 // instead of ~9 numpy dispatches per tick. Fills head[s] =
 // max(next_slot, applied) and cand[s]; returns the candidate count so an
 // idle tick exits on a single int.
+// Device-KV window pack gather (the GRID fast path: full-width sorted
+// blocks, op i covers wave i/n, shard i%n). One pass copies each op's
+// key/value bytes into the zeroed padded planes — replacing numpy's
+// materialize-gather + where-mask + reshape-scatter chain (~4 full
+// passes over the op bytes) with a single read+write. Validation
+// stays in Python (the numpy path remains the semantics owner and
+// fallback); this function only trusts its own bounds check and
+// returns nonzero on any out-of-range op so the caller can fall back.
+int32_t rk_pack_gather(
+    const uint8_t* dbuf, int64_t dbuf_len,
+    const int64_t* off, const int64_t* klen, const int64_t* vlen,
+    int64_t n_ops, int64_t n, int64_t S, int64_t hdr,
+    int64_t ku, int64_t vu,
+    uint8_t* kwin, uint8_t* vwin) {
+  for (int64_t i = 0; i < n_ops; i++) {
+    const int64_t kl = klen[i];
+    const int64_t vl = vlen[i];
+    const int64_t o = off[i] + hdr;
+    if (kl < 0 || vl < 0 || kl > ku || vl > vu || o < 0 ||
+        o + kl + vl > dbuf_len) {
+      return 1;  // out of envelope/bounds: caller uses the numpy path
+    }
+    const int64_t row = (i / n) * S + (i % n);
+    std::memcpy(kwin + row * ku, dbuf + o, (size_t)kl);
+    std::memcpy(vwin + row * vu, dbuf + o + kl, (size_t)vl);
+  }
+  return 0;
+}
+
 int32_t rk_open_scan(
     int32_t S,
     const int64_t* next_slot, const int64_t* applied,

@@ -617,6 +617,71 @@ class TestDeviceGetWindows:
             list(map(bytes, g)) for g in fh.result()
         ]
 
+    def test_native_pack_gather_matches_numpy(self, monkeypatch):
+        # the C one-pass gather (native/hostkernel.cpp rk_pack_gather)
+        # must produce byte-identical planes to the numpy gather — the
+        # semantics owner — across SET and mixed windows with varied
+        # value widths; RABIA_PY_DEVPACK=1 forces the numpy path. The
+        # native run is ASSERTED to have engaged (a silent fallback
+        # would compare numpy against numpy, passing vacuously).
+        from rabia_tpu.apps.kvstore import (
+            KVOperation,
+            KVOpType,
+            encode_op_bin,
+        )
+        from rabia_tpu.native.build import load_hostkernel
+
+        if load_hostkernel() is None:
+            pytest.skip("native host kernel unavailable")
+        monkeypatch.delenv("RABIA_PY_DEVPACK", raising=False)
+        n, W = 8, 6
+        dev = _mk(n, device=True, window=W)
+        engaged = []
+        orig = type(dev._dev)._native_pack_gather
+
+        def spy(self_, *a, **kw):
+            r = orig(self_, *a, **kw)
+            engaged.append(r)
+            return r
+
+        monkeypatch.setattr(type(dev._dev), "_native_pack_gather", spy)
+        rng = np.random.default_rng(9)
+
+        def window(mixed):
+            out = []
+            for w in range(W):
+                cmds = []
+                for s in range(n):
+                    if mixed and s % 3 == 1:
+                        cmds.append(
+                            [encode_op_bin(
+                                KVOperation(KVOpType.Get, f"k{s % 3}")
+                            )]
+                        )
+                    elif mixed and s % 5 == 2:
+                        cmds.append(
+                            [encode_op_bin(
+                                KVOperation(KVOpType.Delete, f"k{s % 3}")
+                            )]
+                        )
+                    else:
+                        v = "v" * int(rng.integers(0, 9)) + str(w)
+                        cmds.append([encode_set_bin(f"k{s % 3}", v)])
+                out.append(build_block(list(range(n)), cmds))
+            return out
+
+        for mixed in (False, True):
+            bs = window(mixed)
+            allow = "mixed" if mixed else "set"
+            engaged.clear()
+            g_native = dev._dev._gather_window(bs, allow)
+            assert engaged == [True], "native gather did not engage"
+            monkeypatch.setenv("RABIA_PY_DEVPACK", "1")
+            g_numpy = dev._dev._gather_window(bs, allow)
+            monkeypatch.delenv("RABIA_PY_DEVPACK")
+            for a, b in zip(g_native, g_numpy):
+                assert np.array_equal(a, b), f"divergence (mixed={mixed})"
+
     def test_eviction_pressure_during_deferred_del_windows(self):
         # segment-cap pressure while DEL-bearing (deferred) windows are
         # in flight: eviction stops at PROVISIONAL segments (their
