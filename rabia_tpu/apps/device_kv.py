@@ -550,6 +550,31 @@ class DeviceKVTable:
         h += vlen_w.astype(np.uint64) * _HASH_W[1]
         h += _fold_words(kwin_w) * _HASH_W[2]
         h += _fold_words(vwin_w) * _HASH_W[3]
+        if bool((h == h[:1]).all()):
+            # every wave repeats its shard's single row (the steady
+            # state of uniform workloads): D=1 with wave 0 as the
+            # representative, no per-shard sort — the argsort was the
+            # dominant dict-build cost once the gather went native.
+            # Verification below is the same full byte compare the
+            # sorted path runs; the hash is still never trusted.
+            ok = (
+                (klen_w == klen_w[:1]).all()
+                and (vlen_w == vlen_w[:1]).all()
+                and (kwin_w == kwin_w[:1]).all()
+                and (vwin_w == vwin_w[:1]).all()
+            )
+            if not bool(ok):
+                return None
+            # explicit copies: contiguous row views would alias (and
+            # pin) the full [W, S, *] gather planes for as long as the
+            # window is in flight — W times the bytes actually needed
+            return DeviceDictOps(
+                np.zeros((W, S), np.uint8),
+                klen_w[:1].T.copy(),
+                vlen_w[:1].T.copy(),
+                kwin_w[0][:, None].copy().view(np.uint32),
+                vwin_w[0][:, None].copy().view(np.uint32),
+            )
         h = np.ascontiguousarray(h.T)  # [S, W]
         o = np.argsort(h, axis=1, kind="stable")
         hs = np.take_along_axis(h, o, axis=1)
@@ -574,23 +599,15 @@ class DeviceKVTable:
         # row's bytes — a collision (2^-64, or adversarial) falls back
         # to the row-packed upload; correctness never rides on the hash
         rank_ts = rank.T  # [W, S]
-        if D == 1:
-            # degenerate-but-common window (one row per shard all
-            # window): broadcast compare, no advanced-index gathers
-            ok = (
-                (klen_w == dkl[None, :, 0]).all()
-                and (vlen_w == dvl[None, :, 0]).all()
-                and (kwin_w == dkb[None, :, 0]).all()
-                and (vwin_w == dvb[None, :, 0]).all()
-            )
-        else:
-            sc = np.arange(S)[None, :]
-            ok = (
-                (klen_w == dkl[sc, rank_ts]).all()
-                and (vlen_w == dvl[sc, rank_ts]).all()
-                and (kwin_w == dkb[sc, rank_ts]).all()
-                and (vwin_w == dvb[sc, rank_ts]).all()
-            )
+        # (D == 1 can't reach here: the all-equal pre-check above
+        # returned before the argsort in that case)
+        sc = np.arange(S)[None, :]
+        ok = (
+            (klen_w == dkl[sc, rank_ts]).all()
+            and (vlen_w == dvl[sc, rank_ts]).all()
+            and (kwin_w == dkb[sc, rank_ts]).all()
+            and (vwin_w == dvb[sc, rank_ts]).all()
+        )
         if not bool(ok):
             return None
         return DeviceDictOps(
