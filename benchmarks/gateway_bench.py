@@ -1,0 +1,180 @@
+"""Gateway throughput benchmark: client-observed submit and read rates.
+
+Measures the full client-facing stack — RabiaClient over real TCP
+sockets -> GatewayServer -> 3-replica consensus cluster — in two
+phases:
+
+- **submit**: N clients pipeline exactly-once SET batches (each client
+  keeps its session window full);
+- **read-index**: the same clients issue linearizable GETs served via
+  quorum-probed read index. The decided-slot counters are pinned across
+  the phase: reads must consume ZERO consensus slots (the bench fails
+  otherwise).
+
+Prints one JSON line:
+  {"gateway_submit_ops_per_sec": ..., "gateway_read_ops_per_sec": ...,
+   "read_slots_consumed": 0, ...}
+
+Env knobs: GW_CLIENTS (8), GW_SHARDS (8), GW_SECONDS (3.0),
+GW_BATCH (8 commands per submit).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from rabia_tpu.apps.kvstore import encode_set_bin  # noqa: E402
+from rabia_tpu.gateway import GatewayConfig, RabiaClient  # noqa: E402
+from rabia_tpu.testing.gateway_cluster import GatewayCluster  # noqa: E402
+
+
+def _decided_total(cluster: GatewayCluster) -> int:
+    return sum(e.rt.decided_v0 + e.rt.decided_v1 for e in cluster.engines)
+
+
+async def bench() -> dict:
+    n_clients = int(os.environ.get("GW_CLIENTS", 8))
+    n_shards = int(os.environ.get("GW_SHARDS", 8))
+    seconds = float(os.environ.get("GW_SECONDS", 3.0))
+    batch = int(os.environ.get("GW_BATCH", 8))
+
+    cluster = GatewayCluster(
+        n_replicas=3,
+        n_shards=n_shards,
+        gateway_config=GatewayConfig(max_inflight_per_session=64),
+    )
+    await cluster.start()
+    clients = [
+        RabiaClient([cluster.endpoint(i % 3)], call_timeout=60.0)
+        for i in range(n_clients)
+    ]
+    try:
+        for c in clients:
+            await c.connect()
+
+        # -- submit phase --------------------------------------------------
+        stop_at = time.perf_counter() + seconds
+        counts = [0] * n_clients
+
+        async def submitter(ci: int, c: RabiaClient) -> None:
+            # keep a window of concurrent submits in flight per client
+            window = 8
+            pending: set = set()
+            k = 0
+            while time.perf_counter() < stop_at:
+                while len(pending) < window:
+                    key = f"c{ci}-k{k % 512}"
+                    pending.add(
+                        asyncio.ensure_future(
+                            c.submit(
+                                (ci + k) % n_shards,
+                                [
+                                    encode_set_bin(f"{key}-{j}", "v")
+                                    for j in range(batch)
+                                ],
+                            )
+                        )
+                    )
+                    k += 1
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for d in done:
+                    d.result()  # surface failures
+                    counts[ci] += 1
+            if pending:
+                await asyncio.gather(*pending)
+                counts[ci] += len(pending)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(
+            *(submitter(i, c) for i, c in enumerate(clients))
+        )
+        submit_dt = time.perf_counter() - t0
+        submits = sum(counts)
+        submit_cmds = submits * batch
+
+        # -- read-index phase (must consume zero consensus slots) ----------
+        await asyncio.sleep(0.3)  # let in-flight slots settle
+        decided_before = _decided_total(cluster)
+        read_stop = time.perf_counter() + seconds
+        reads = [0] * n_clients
+
+        async def reader(ci: int, c: RabiaClient) -> None:
+            # pipelined reads: every GET issued while a probe round is in
+            # flight shares the next round — read throughput decouples
+            # from the probe RTT
+            window = 8
+            pending: set = set()
+            while time.perf_counter() < read_stop:
+                while len(pending) < window:
+                    pending.add(
+                        asyncio.ensure_future(
+                            c.get(
+                                (ci + reads[ci]) % n_shards, f"c{ci}-k0-0"
+                            )
+                        )
+                    )
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for d in done:
+                    d.result()
+                    reads[ci] += 1
+            if pending:
+                await asyncio.gather(*pending)
+                reads[ci] += len(pending)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(reader(i, c) for i, c in enumerate(clients)))
+        read_dt = time.perf_counter() - t0
+        read_total = sum(reads)
+        slots_consumed = _decided_total(cluster) - decided_before
+
+        probe_rounds = sum(g.stats.probe_rounds for g in cluster.gateways)
+        return {
+            "benchmark": "client_gateway",
+            "gateway_submit_batches_per_sec": round(submits / submit_dt, 1),
+            "gateway_submit_ops_per_sec": round(submit_cmds / submit_dt, 1),
+            "gateway_read_ops_per_sec": round(read_total / read_dt, 1),
+            "read_slots_consumed": int(slots_consumed),
+            "reads_per_probe_round": round(
+                read_total / max(1, probe_rounds), 2
+            ),
+            "config": {
+                "clients": n_clients,
+                "replicas": 3,
+                "shards": n_shards,
+                "commands_per_submit": batch,
+                "seconds_per_phase": seconds,
+                "transport": "native-tcp",
+            },
+        }
+    finally:
+        for c in clients:
+            await c.close()
+        await cluster.stop()
+
+
+def main() -> int:
+    out = asyncio.run(bench())
+    print(json.dumps(out))
+    if out["read_slots_consumed"] != 0:
+        print(
+            "gateway bench: READS CONSUMED CONSENSUS SLOTS",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

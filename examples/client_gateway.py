@@ -1,0 +1,135 @@
+"""Client gateway walkthrough: remote clients over real TCP sockets.
+
+The gateway subsystem (rabia_tpu/gateway) turns the cluster into
+something a remote user can talk to: a binary client protocol over the
+native transport, exactly-once sessions keyed by (client_id, seq),
+linearizable read-index GETs that consume NO consensus slots, and
+admission control that sheds load with a retryable error.
+
+This driver runs a 3-replica cluster (real TCP via the C++ data plane),
+one gateway per replica, and N concurrent clients spread across the
+gateways:
+
+  1. concurrent exactly-once writes from every client;
+  2. a duplicate submission answered from the session cache (observed
+     via the CACHED status — no second proposal, no second apply);
+  3. linearizable reads with the consensus slot counters pinned;
+  4. admission-control shedding under a tiny session window.
+
+Run: python examples/client_gateway.py
+"""
+
+import asyncio
+
+import _common  # noqa: F401  (sys.path + backend setup)
+
+from rabia_tpu.apps.kvstore import (
+    decode_kv_response,
+    encode_set_bin,
+    shard_for_key,
+)
+from rabia_tpu.core.messages import ResultStatus, Submit
+from rabia_tpu.gateway import GatewayConfig, RabiaClient
+from rabia_tpu.testing.gateway_cluster import GatewayCluster
+
+N_CLIENTS = 8
+SHARDS = 4
+
+
+def shard(key: str) -> int:
+    return shard_for_key(key, SHARDS)
+
+
+async def main() -> int:
+    cluster = GatewayCluster(
+        n_replicas=3,
+        n_shards=SHARDS,
+        gateway_config=GatewayConfig(max_inflight_per_session=16),
+    )
+    await cluster.start()
+    print(
+        "3 replicas + gateways up on ports",
+        [g.port for g in cluster.gateways],
+    )
+    clients = [
+        RabiaClient([cluster.endpoint(i % 3)]) for i in range(N_CLIENTS)
+    ]
+    try:
+        for c in clients:
+            await c.connect()
+        print(
+            f"{N_CLIENTS} clients connected "
+            f"(session window {clients[0].server_window})"
+        )
+
+        # 1. concurrent exactly-once writes
+        async def writer(ci: int, c: RabiaClient) -> None:
+            for k in range(5):
+                key = f"user{ci}:item{k}"
+                resp = await c.submit(
+                    shard(key), [encode_set_bin(key, f"value-{ci}-{k}")]
+                )
+                assert decode_kv_response(resp[0]).ok
+
+        await asyncio.gather(*(writer(i, c) for i, c in enumerate(clients)))
+        print(f"{N_CLIENTS * 5} writes committed exactly-once")
+
+        # 2. duplicate submission: same (client_id, seq) resent — the
+        # session cache answers, nothing is re-proposed
+        cli = clients[0]
+        dup = Submit(
+            client_id=cli.client_id,
+            seq=cli._seq,  # the seq of the last completed write
+            shard=shard("user0:item4"),
+            commands=(encode_set_bin("user0:item4", "value-0-4"),),
+        )
+        res = await cli._call(cli._seq, dup)
+        assert res.status == ResultStatus.CACHED
+        print(
+            "duplicate submit answered from session cache "
+            f"(status CACHED; gateway dedup count "
+            f"{cluster.gateways[0].stats.submits_deduped})"
+        )
+
+        # 3. linearizable reads: zero consensus slots consumed
+        decided_before = sum(
+            e.rt.decided_v0 + e.rt.decided_v1 for e in cluster.engines
+        )
+        for ci, c in enumerate(clients):
+            key = f"user{ci}:item0"
+            r = decode_kv_response(await c.get(shard(key), key))
+            assert r.ok and r.value == f"value-{ci}-0"
+        decided_after = sum(
+            e.rt.decided_v0 + e.rt.decided_v1 for e in cluster.engines
+        )
+        assert decided_after == decided_before
+        print(
+            f"{N_CLIENTS} linearizable reads served via read-index; "
+            f"decided-slot count unchanged ({decided_before})"
+        )
+
+        # 4. admission control: a burst over the session window sheds
+        # with retryable RETRY results; the client's backoff absorbs it
+        burst = [f"burst:{i}" for i in range(40)]
+        await asyncio.gather(
+            *(
+                cli.submit(shard(k), [encode_set_bin(k, "x")])
+                for k in burst
+            )
+        )
+        print(
+            "burst of 40 over a 16-window session: "
+            f"{cluster.gateways[0].stats.submits_shed} shed retryable, "
+            "all eventually committed"
+        )
+        await cluster.wait_converged()
+        print("replica stores converged; OK")
+        return 0
+    finally:
+        for c in clients:
+            await c.close()
+        await cluster.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))

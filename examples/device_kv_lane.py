@@ -62,7 +62,7 @@ def main() -> int:
     ver8 = int.from_bytes(bytes(futs[-1].result()[0][0])[1:5], "little")
     print(
         f"8 SET waves x {S} shards committed in {eng.cycles} dispatches; "
-        f"device lane active: {eng._dev_active}; k0 at version {ver8}"
+        f"device lane active: {eng.device_lane_active}; k0 at version {ver8}"
     )
 
     # 2. GET waves: meta-only readback, values resolve host-side
@@ -105,7 +105,10 @@ def main() -> int:
     f1 = eng.submit_block(blk(lambda s: encode_set_bin(f"k{s}", "minority")))
     eng.flush()
     assert f1.done()
-    print(f"2/{R} replicas crashed: lane still active: {eng._dev_active}")
+    print(
+        f"2/{R} replicas crashed: lane still active: "
+        f"{eng.device_lane_active}"
+    )
     eng.crash_replica(2)  # no quorum: the next window reads back dirty
     f2 = eng.submit_block(blk(lambda s: encode_set_bin(f"k{s}", "parked")))
     try:
@@ -121,10 +124,10 @@ def main() -> int:
     for w in range(6):
         eng.submit_block(blk(lambda s, w=w: encode_set_bin(f"k{s}", f"z{w}")))
     eng.flush()
-    print(f"healed; device lane re-promoted: {eng._dev_active}")
+    print(f"healed; device lane re-promoted: {eng.device_lane_active}")
 
     # state is identical on every replica, across every lane transition
-    eng._demote_device_store()  # sync device table down for inspection
+    eng.sync_to_host()  # sync device table down for inspection
     want = eng.sms[0].store.get(5, b"k5")
     assert all(sm.store.get(5, b"k5") == want for sm in eng.sms)
     print(f"k5 on every replica: {want[0].decode()} (version {want[1]})")

@@ -470,7 +470,10 @@ class DeviceKVTable:
         numpy path is pinned in tests/test_device_kv.py."""
         import os
 
-        if os.environ.get("RABIA_PY_DEVPACK"):
+        # =1 opts out, matching the docstring/tests convention — a plain
+        # truthiness test made RABIA_PY_DEVPACK=0 ALSO disable the
+        # native gather
+        if os.environ.get("RABIA_PY_DEVPACK") == "1":
             return False
         from rabia_tpu.native.build import load_hostkernel
 

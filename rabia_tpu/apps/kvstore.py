@@ -722,6 +722,22 @@ def decode_op_bin(data: bytes) -> KVOperation:
         raise StateMachineError(f"bad binary kv command: {e}") from None
 
 
+def decode_kv_response(data: bytes) -> KVResult:
+    """Decode a committed response frame in EITHER framing: the scalar
+    lane's JSON (``KVStoreSMR.encode_response``) or the compact binary
+    result (block lane / gateway read path). The client-side twin of
+    ``KVStoreSMR.decode_response`` as a free function."""
+    if data[:1] == b"{":
+        doc = json.loads(data)
+        return KVResult(
+            KVResultKind(doc["kind"]),
+            value=doc.get("value"),
+            version=doc.get("version"),
+            error=doc.get("error"),
+        )
+    return decode_result_bin(data)
+
+
 def decode_result_bin(data: bytes) -> KVResult:
     kind = data[0]
     version = int.from_bytes(data[1:5], "little")
@@ -886,15 +902,7 @@ class KVStoreSMR(TypedStateMachine[KVOperation, KVResult, dict]):
         ).encode()
 
     def decode_response(self, data: bytes) -> KVResult:
-        if data[:1] != b"{":
-            return decode_result_bin(data)
-        doc = json.loads(data)
-        return KVResult(
-            KVResultKind(doc["kind"]),
-            value=doc.get("value"),
-            version=doc.get("version"),
-            error=doc.get("error"),
-        )
+        return decode_kv_response(data)
 
     def apply_raw(self, data: bytes) -> bytes:
         """Apply one encoded command without the JSON round-trip when it is

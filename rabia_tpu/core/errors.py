@@ -104,6 +104,13 @@ class TimeoutError_(RabiaError):  # trailing underscore: don't shadow builtin
         self.timeout = timeout
 
 
+class ResponsesUnavailableError(RabiaError):
+    """The batch COMMITTED, but per-command responses never materialized
+    on this replica (it adopted the slots via snapshot sync). The command
+    must not be re-proposed — peers that applied normally still hold the
+    responses (the gateway's result-repair path fetches them)."""
+
+
 class SerializationError(RabiaError):
     pass
 

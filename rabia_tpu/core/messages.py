@@ -48,6 +48,14 @@ class MessageType(enum.IntEnum):
     HeartBeat = 8
     QuorumNotification = 9
     ProposeBlock = 10
+    # client gateway protocol (rabia_tpu/gateway): the client-facing
+    # frame kinds ride the same envelope + transport framing as the
+    # replica-to-replica traffic but never enter the consensus engine —
+    # the gateway runs its own transport instance
+    ClientHello = 11
+    Submit = 12
+    Result = 13
+    ReadIndex = 14
 
 
 # ---------------------------------------------------------------------------
@@ -305,6 +313,100 @@ class QuorumNotification:
     active_nodes: tuple[NodeId, ...]
 
 
+# ---------------------------------------------------------------------------
+# Client gateway protocol (rabia_tpu/gateway)
+# ---------------------------------------------------------------------------
+#
+# Clients talk to a per-replica gateway over the native transport with
+# these four frame kinds. Every command carries a (client_id, seq) pair:
+# the session table dedups retries so a command applies exactly once no
+# matter how many times the client (re)submits it.
+
+
+class ResultStatus(enum.IntEnum):
+    """Outcome discriminant of a :class:`Result` frame."""
+
+    OK = 0  # committed; payload = per-command responses
+    ERROR = 1  # terminal failure; payload = (message,)
+    RETRY = 2  # admission control shed the request; safe to resubmit
+    CACHED = 3  # duplicate (client_id, seq): answered from session cache
+
+
+class ReadIndexMode(enum.IntEnum):
+    """Role discriminant of a :class:`ReadIndex` frame."""
+
+    READ = 0  # client -> gateway: linearizable GET
+    PROBE = 1  # gateway -> gateway: decided-frontier probe
+    REPLY = 2  # gateway -> gateway: probe reply with frontier vector
+    # gateway -> gateway: fetch a committed batch's applied responses
+    # (result repair after a snapshot sync skipped the local apply;
+    # ``key`` carries the 16-byte batch id, ``shard`` the shard)
+    FETCH_RESULT = 3
+
+
+@dataclass(frozen=True)
+class ClientHello:
+    """Session open/resume (client -> gateway) and its ack (``ack=True``,
+    gateway -> client).
+
+    ``last_seq``: from the client, the highest seq it already holds a
+    result for; from the gateway, the session's highest completed seq
+    (the client replays everything above it). ``max_inflight``: the
+    client's requested window, and the gateway's granted one in the ack.
+    """
+
+    client_id: uuid.UUID
+    ack: bool = False
+    last_seq: int = 0
+    max_inflight: int = 0
+
+
+@dataclass(frozen=True)
+class Submit:
+    """One client command batch, exactly-once keyed by (client_id, seq).
+
+    ``ack_upto``: the client has durably received results for every seq
+    <= this value — the gateway's session GC hint (results at or below
+    it become evictable once the decided frontier moves past them).
+    """
+
+    client_id: uuid.UUID
+    seq: int
+    shard: int
+    commands: tuple[bytes, ...]
+    ack_upto: int = 0
+
+
+@dataclass(frozen=True)
+class Result:
+    """Gateway -> client outcome for a Submit or ReadIndex seq."""
+
+    client_id: uuid.UUID
+    seq: int
+    status: int
+    payload: tuple[bytes, ...] = ()
+
+
+@dataclass(frozen=True)
+class ReadIndex:
+    """Linearizable read traffic (see :class:`ReadIndexMode`).
+
+    READ: ``(shard, key)`` names the lookup; ``seq`` routes the Result.
+    PROBE: ``seq`` is the probe nonce (client_id = the asking gateway).
+    REPLY: ``frontier`` is the responder's per-shard potential decided
+    frontier — for every slot that could have committed anywhere at
+    probe time, at least one member of any probed quorum reports a
+    frontier above it (it voted round-2 in that slot or decided it).
+    """
+
+    mode: int
+    client_id: uuid.UUID
+    seq: int
+    shard: int = 0
+    key: bytes = b""
+    frontier: tuple[int, ...] = ()
+
+
 Payload = (
     Propose
     | VoteRound1
@@ -316,6 +418,10 @@ Payload = (
     | HeartBeat
     | QuorumNotification
     | ProposeBlock
+    | ClientHello
+    | Submit
+    | Result
+    | ReadIndex
 )
 
 _PAYLOAD_TYPE = {
@@ -329,6 +435,10 @@ _PAYLOAD_TYPE = {
     HeartBeat: MessageType.HeartBeat,
     QuorumNotification: MessageType.QuorumNotification,
     ProposeBlock: MessageType.ProposeBlock,
+    ClientHello: MessageType.ClientHello,
+    Submit: MessageType.Submit,
+    Result: MessageType.Result,
+    ReadIndex: MessageType.ReadIndex,
 }
 
 

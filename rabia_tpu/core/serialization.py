@@ -36,6 +36,7 @@ from rabia_tpu.core.blocks import PayloadBlock
 from rabia_tpu.core.config import SerializationConfig
 from rabia_tpu.core.errors import SerializationError
 from rabia_tpu.core.messages import (
+    ClientHello,
     Decision,
     HeartBeat,
     MessageType,
@@ -44,7 +45,10 @@ from rabia_tpu.core.messages import (
     ProtocolMessage,
     Propose,
     QuorumNotification,
+    ReadIndex,
+    Result,
     SyncRequest,
+    Submit,
     SyncResponse,
     VoteRound1,
     VoteRound2,
@@ -374,6 +378,35 @@ def _encode_payload(w: _Writer, payload) -> None:
         w.u32(len(payload.active_nodes))
         for n in payload.active_nodes:
             w.uuid(n.value)
+    elif isinstance(payload, ClientHello):
+        w.u8(1 if payload.ack else 0)
+        w.uuid(payload.client_id)
+        w.u64(payload.last_seq)
+        w.u32(payload.max_inflight)
+    elif isinstance(payload, Submit):
+        w.uuid(payload.client_id)
+        w.u64(payload.seq)
+        w.u32(payload.shard)
+        w.u64(payload.ack_upto)
+        w.u32(len(payload.commands))
+        for c in payload.commands:
+            w.blob(c)
+    elif isinstance(payload, Result):
+        w.uuid(payload.client_id)
+        w.u64(payload.seq)
+        w.u8(int(payload.status))
+        w.u32(len(payload.payload))
+        for c in payload.payload:
+            w.blob(c)
+    elif isinstance(payload, ReadIndex):
+        w.u8(int(payload.mode))
+        w.uuid(payload.client_id)
+        w.u64(payload.seq)
+        w.u32(payload.shard)
+        w.blob(payload.key)
+        w.u32(len(payload.frontier))
+        for f in payload.frontier:
+            w.u64(f)
     else:  # pragma: no cover - exhaustive over Payload union
         raise SerializationError(f"unknown payload type {type(payload).__name__}")
 
@@ -451,6 +484,53 @@ def _decode_payload(msg_type: MessageType, r: _Reader):
             has_quorum=has_q,
             active_nodes=tuple(NodeId(r.uuid()) for _ in range(n)),
         )
+    if msg_type == MessageType.ClientHello:
+        ack = bool(r.u8())
+        return ClientHello(
+            client_id=r.uuid(),
+            ack=ack,
+            last_seq=r.u64(),
+            max_inflight=r.u32(),
+        )
+    if msg_type == MessageType.Submit:
+        cid = r.uuid()
+        seq = r.u64()
+        shard = r.u32()
+        ack_upto = r.u64()
+        n = r.u32()
+        return Submit(
+            client_id=cid,
+            seq=seq,
+            shard=shard,
+            commands=tuple(r.blob() for _ in range(n)),
+            ack_upto=ack_upto,
+        )
+    if msg_type == MessageType.Result:
+        cid = r.uuid()
+        seq = r.u64()
+        status = r.u8()
+        n = r.u32()
+        return Result(
+            client_id=cid,
+            seq=seq,
+            status=status,
+            payload=tuple(r.blob() for _ in range(n)),
+        )
+    if msg_type == MessageType.ReadIndex:
+        mode = r.u8()
+        cid = r.uuid()
+        seq = r.u64()
+        shard = r.u32()
+        key = r.blob()
+        n = r.u32()
+        return ReadIndex(
+            mode=mode,
+            client_id=cid,
+            seq=seq,
+            shard=shard,
+            key=key,
+            frontier=tuple(r.u64() for _ in range(n)),
+        )
     raise SerializationError(f"unknown message type {msg_type}")
 
 
@@ -493,6 +573,10 @@ def _native_codec():
                 ShardId=ShardId,
                 StateValue=StateValue,
                 SyncResponse=SyncResponse,
+                ClientHello=ClientHello,
+                Submit=Submit,
+                Result=Result,
+                ReadIndex=ReadIndex,
             )
             _NATIVE_CODEC = mod
     return _NATIVE_CODEC
@@ -756,4 +840,10 @@ def estimate_serialized_size(msg: ProtocolMessage) -> int:
         return base + 4 + p.batch.total_size() + 40 * len(p.batch)
     if isinstance(p, SyncResponse):
         return base + 21 + (len(p.snapshot) if p.snapshot else 0)
+    if isinstance(p, Submit):
+        return base + 40 + sum(4 + len(c) for c in p.commands)
+    if isinstance(p, Result):
+        return base + 29 + sum(4 + len(c) for c in p.payload)
+    if isinstance(p, ReadIndex):
+        return base + 37 + len(p.key) + 8 * len(p.frontier)
     return base + 64

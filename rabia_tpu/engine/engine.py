@@ -313,6 +313,11 @@ class RabiaEngine:
         # replica's first vote in any slot >= the previous barrier, so a
         # restart knows exactly which slots may hold its pre-crash votes
         self._barrier = np.zeros(self.S, np.int64)
+        # read-index floor: the RESTORED barrier. decided_frontier() must
+        # never under-report a slot this replica voted round 2 in, and a
+        # pre-crash vote can sit above the restored next_slot (cast after
+        # the last checkpoint) — the barrier bounds all of them
+        self._frontier_floor = np.zeros(self.S, np.int64)
         self._restored_at = 0.0
         self._pending_proposes: list[Propose] = []
 
@@ -320,6 +325,12 @@ class RabiaEngine:
         self._node_to_row = {n: i for i, n in enumerate(cluster.all_nodes)}
         self._seen_batches: set = set()  # dedup of forwarded batch ids
         self._seen_order: list = []  # insertion order for bounded eviction
+        # decided-frontier hook (rabia_tpu/gateway): callbacks fired once
+        # per tick when the applied frontier advanced (scalar or block
+        # lane) — the gateway's read-index waiters ride this instead of
+        # polling the runtime arrays
+        self._frontier_listeners: list = []
+        self._frontier_dirty = False
         self._bg_tasks: set = set()  # strong refs: loop holds tasks weakly
         self._running = False
         self._stopped = asyncio.Event()
@@ -483,6 +494,52 @@ class RabiaEngine:
     async def get_statistics(self) -> EngineStatistics:
         return self.rt.stats(self.node_id)
 
+    # -- decided-frontier surface (client gateway subsystem) ----------------
+
+    def decided_frontier(self) -> np.ndarray:
+        """Per-shard POTENTIAL decided frontier: slot index past every
+        slot this replica has decided, plus the slot it is currently
+        voting in (in flight counts as potentially decided elsewhere).
+
+        The gateway's linearizable read-index rests on the quorum
+        intersection this bound gives: a write committed at slot k
+        required round-2 votes from a quorum, and each of those voters
+        reports a frontier > k here (it was in flight at k when it
+        voted, and the value only grows). Probing any quorum and taking
+        the per-shard max therefore covers every write committed before
+        the probe. Over-reporting merely delays a read; never report a
+        frontier below a slot this replica has voted round 2 in — which
+        is why the restored vote barrier floors the result: a pre-crash
+        vote can sit above the restored ``next_slot`` (cast after the
+        last checkpoint), but never at-or-above the persisted barrier."""
+        n = self.n_shards
+        rt = self.rt
+        return np.maximum(
+            np.maximum(rt.next_slot[:n], rt.applied_upto[:n])
+            + rt.in_flight[:n].astype(np.int64),
+            self._frontier_floor[:n],
+        )
+
+    def applied_frontier(self) -> np.ndarray:
+        """Per-shard count of contiguously applied slots (a copy)."""
+        return self.rt.applied_upto[: self.n_shards].copy()
+
+    def pending_queue_depth(self) -> int:
+        """Total locally queued submissions across shards — the gateway's
+        admission-control signal (shed before the engine inbox saturates)."""
+        return int(self.rt.queue_len[: self.n_shards].sum())
+
+    def add_frontier_listener(self, cb) -> None:
+        """Register a zero-arg callback fired (on the engine's loop, at
+        most once per tick) whenever the applied frontier advances."""
+        self._frontier_listeners.append(cb)
+
+    def remove_frontier_listener(self, cb) -> None:
+        try:
+            self._frontier_listeners.remove(cb)
+        except ValueError:
+            pass
+
     async def trigger_sync(self) -> None:
         await self._initiate_sync()
 
@@ -548,6 +605,7 @@ class RabiaEngine:
             return
         barrier = np.frombuffer(raw, np.int64)[: self.n_shards]
         self._barrier[: len(barrier)] = barrier
+        self._frontier_floor[: len(barrier)] = barrier
         n = len(barrier)
         taint = barrier > self.rt.applied_upto[:n]
         self.rt.tainted_upto[:n][taint] = barrier[taint]
@@ -665,6 +723,15 @@ class RabiaEngine:
             self._check_timeouts()
         if applied and self.persistence is not None:
             self._dirty = True
+        if applied:
+            self._frontier_dirty = True
+        if self._frontier_dirty:
+            self._frontier_dirty = False
+            for cb in self._frontier_listeners:
+                try:
+                    cb()
+                except Exception:  # a listener must never kill the loop
+                    logger.exception("frontier listener failed")
         return bool(got_msgs or opened or bulk is not None or applied) and stepped
 
     def _anything_in_flight(self) -> bool:
@@ -1015,6 +1082,7 @@ class RabiaEngine:
         # columnar bookkeeping for the whole wave
         rt.applied_upto[idx] = slots + 1
         rt.next_slot[idx] = slots + 1
+        self._frontier_dirty = True
         rt.in_flight[idx] = False
         rt.opened_at[idx] = 0.0
         rt.head_fwd_at[idx] = 0.0
@@ -2019,6 +2087,10 @@ class RabiaEngine:
                     if rec.batch_id is not None and rec.batch_id in sh.applied_ids:
                         # duplicate commit (same batch decided in an earlier
                         # slot): never apply twice; just settle the future
+                        logger.debug(
+                            "row %d shard %d slot %d: dedup-skip batch %s",
+                            self.me, s, slot, rec.batch_id,
+                        )
                         for i, sub in enumerate(list(sh.queue)):
                             if sub.batch.id == rec.batch_id:
                                 del sh.queue[i]
@@ -2075,8 +2147,10 @@ class RabiaEngine:
             return
         responses = sh.applied_results.get(sub.batch.id)
         if responses is None:
+            from rabia_tpu.core.errors import ResponsesUnavailableError
+
             sub.future.set_exception(
-                RabiaError(
+                ResponsesUnavailableError(
                     "batch committed but responses unavailable (applied "
                     "via snapshot sync, or the state machine rejected it)"
                 )
@@ -2328,6 +2402,11 @@ class RabiaEngine:
             )
         else:  # responder on an incompatible shard layout: slot-count bound
             self.rt.state_version += int((resp_applied[ahead] - ours[ahead]).sum())
+        logger.debug(
+            "row %d sync adopt: shards %s ours %s -> resp %s",
+            self.me, ahead.tolist(),
+            ours[ahead].tolist(), resp_applied[ahead].tolist(),
+        )
         for s in ahead.tolist():
             s = int(s)
             applied = int(resp_applied[s])
@@ -2362,6 +2441,7 @@ class RabiaEngine:
             if 0 <= s < self.n_shards:
                 self.rt.shards[s].applied_ids.setdefault(bid, None)
         self.rt.sync_responses.clear()
+        self._frontier_dirty = True
         logger.info("%s sync: jumped to %d applied", self.node_id.short(), best[0])
 
     # -- periodic chores -----------------------------------------------------

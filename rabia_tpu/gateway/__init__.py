@@ -1,0 +1,39 @@
+"""Client gateway subsystem: the cluster's front door.
+
+Exactly-once client sessions, linearizable read-index reads, and
+admission control over the native transport — see
+:mod:`rabia_tpu.gateway.server` for the service and
+:mod:`rabia_tpu.gateway.client` for the client library.
+"""
+
+from rabia_tpu.gateway.client import (
+    BackpressureError,
+    GatewayError,
+    RabiaClient,
+)
+from rabia_tpu.gateway.server import (
+    GatewayConfig,
+    GatewayEndpoint,
+    GatewayServer,
+    GatewayStats,
+    kv_read_handler,
+)
+from rabia_tpu.gateway.session import (
+    CachedResult,
+    GatewaySession,
+    SessionTable,
+)
+
+__all__ = [
+    "BackpressureError",
+    "CachedResult",
+    "GatewayConfig",
+    "GatewayEndpoint",
+    "GatewayError",
+    "GatewayServer",
+    "GatewaySession",
+    "GatewayStats",
+    "RabiaClient",
+    "SessionTable",
+    "kv_read_handler",
+]

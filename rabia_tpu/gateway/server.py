@@ -1,0 +1,748 @@
+"""GatewayServer: the replica's client-facing front door.
+
+One gateway runs next to each :class:`~rabia_tpu.engine.RabiaEngine`
+replica, on its OWN native transport instance (own node id, own port) —
+client traffic never rides the consensus plane's broadcast fan-out and
+the engine's message loop never sees a client frame. The gateway talks
+to its engine in-process and to peer gateways over the wire (read-index
+frontier probes).
+
+Three request paths:
+
+- **Submit** — exactly-once writes. The session table answers duplicate
+  ``(client_id, seq)`` submissions from cache (or attaches them to the
+  in-flight proposal); fresh seqs go through admission control and then
+  ``engine.submit_batch``.
+- **ReadIndex (READ)** — linearizable GETs with no consensus slot
+  consumed. The gateway probes a quorum of gateways for their potential
+  decided frontiers (:meth:`RabiaEngine.decided_frontier`), takes the
+  per-shard max as the read index, waits until the local applied
+  frontier covers it, and serves the value from the local state machine.
+  Quorum intersection makes this linearizable: every write committed
+  before the probe has a round-2 quorum, and any probed quorum shares a
+  member with it that reports a frontier above the write's slot.
+  Probe rounds are shared by every read that arrived before the round
+  started — read throughput is decoupled from the probe RTT.
+- **Admission control** — a bounded per-session inflight window plus an
+  engine queue-depth ceiling; both shed load with a retryable
+  ``ResultStatus.RETRY`` before the engine inbox saturates.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from rabia_tpu.core.config import TcpNetworkConfig
+from rabia_tpu.core.errors import (
+    RabiaError,
+    ResponsesUnavailableError,
+    TimeoutError_,
+)
+from rabia_tpu.core.messages import (
+    ClientHello,
+    ProtocolMessage,
+    ReadIndex,
+    ReadIndexMode,
+    Result,
+    ResultStatus,
+    Submit,
+)
+from rabia_tpu.core.serialization import Serializer
+from rabia_tpu.core.types import (
+    BatchId,
+    Command,
+    CommandBatch,
+    NodeId,
+    ShardId,
+)
+from rabia_tpu.gateway.session import CachedResult, SessionTable
+
+logger = logging.getLogger("rabia_tpu.gateway")
+
+# reader: (shard, key bytes) -> encoded result bytes (the host store's
+# binary result framing — byte-identical to a committed GET's response)
+ReadHandler = Callable[[int, bytes], bytes]
+
+
+@dataclass
+class GatewayConfig:
+    bind_host: str = "127.0.0.1"
+    bind_port: int = 0  # ephemeral
+    max_inflight_per_session: int = 64
+    # shed Submits once the engine's local submission queues hold this
+    # many batches (well under the native transport's 64Ki-frame inbox)
+    max_queue_depth: int = 1024
+    session_ttl: float = 600.0
+    result_cache_cap: int = 4096
+    # one probe round answers every read that arrived before it started;
+    # a round that cannot assemble a quorum of frontiers by this deadline
+    # fails those reads with a retryable RETRY
+    probe_timeout: float = 2.0
+    # how long a read may wait for the local applied frontier to cover
+    # its read index before failing retryable
+    read_timeout: float = 5.0
+    gc_interval: float = 1.0
+
+
+@dataclass
+class GatewayStats:
+    submits: int = 0
+    submits_deduped: int = 0
+    submits_shed: int = 0
+    reads: int = 0
+    reads_failed: int = 0
+    probe_rounds: int = 0
+    results_sent: int = 0
+    results_repaired: int = 0  # fetched from a peer after a sync overtake
+
+
+@dataclass
+class GatewayEndpoint:
+    """Address card for one gateway (what a client/peer needs to dial)."""
+
+    node_id: NodeId
+    host: str
+    port: int
+
+
+def kv_read_handler(sm) -> ReadHandler:
+    """Default read handler over a sharded KV state machine
+    (:class:`~rabia_tpu.apps.sharded.ShardedStateMachine` of
+    ``KVStoreSMR`` shards): serve GETs straight from the shard's host
+    store, framed byte-identically to a committed GET response. A
+    device-lane deployment (apps/device_kv) plugs in a handler backed by
+    the device table's GET lane here instead — the gateway only needs
+    the ``(shard, key) -> result bytes`` seam."""
+    from rabia_tpu.apps.kvstore import KVResultKind, _result_bin
+
+    machines = getattr(sm, "machines", None)
+    if machines is None:
+        raise TypeError(
+            "kv_read_handler needs a sharded state machine with .machines"
+        )
+
+    def read(shard: int, key: bytes) -> bytes:
+        store = machines[shard % len(machines)].store
+        try:
+            k = key.decode()
+        except UnicodeDecodeError:
+            return _result_bin(2, 0, "malformed key")
+        res = store.get(k)
+        if res.kind == KVResultKind.NotFound:
+            return _result_bin(1, 0)
+        return _result_bin(0, res.version or 0, res.value)
+
+    return read
+
+
+class _ProbeRound:
+    """One in-flight frontier probe: nonce, collected reply vectors, and
+    the waiters served by this round."""
+
+    __slots__ = ("nonce", "replies", "done", "waiters", "started_at")
+
+    def __init__(self, nonce: int, waiters: list) -> None:
+        self.nonce = nonce
+        self.replies: dict[NodeId, np.ndarray] = {}
+        self.done = asyncio.Event()
+        self.waiters = waiters
+        self.started_at = time.time()
+
+
+class GatewayServer:
+    """Client-facing service over one engine replica (see module doc)."""
+
+    def __init__(
+        self,
+        engine,
+        reader: Optional[ReadHandler] = None,
+        config: Optional[GatewayConfig] = None,
+        node_id: Optional[NodeId] = None,
+    ) -> None:
+        self.engine = engine
+        self.config = config or GatewayConfig()
+        self.node_id = node_id or NodeId.new()
+        self.reader = reader if reader is not None else kv_read_handler(
+            engine.sm
+        )
+        self.serializer = Serializer(engine.config.serialization)
+        self.sessions = SessionTable(
+            default_window=self.config.max_inflight_per_session,
+            session_ttl=self.config.session_ttl,
+            result_cache_cap=self.config.result_cache_cap,
+        )
+        self.stats = GatewayStats()
+        self._net = None
+        self._peer_gateways: dict[NodeId, tuple[str, int]] = {}
+        self._frontier_event = asyncio.Event()
+        self._round: Optional[_ProbeRound] = None
+        self._round_waiters: list[asyncio.Future] = []
+        self._probe_kick = asyncio.Event()
+        self._nonce = 0
+        self._fetches: dict[int, asyncio.Future] = {}
+        self._fetch_nonce = 0
+        # reads in flight by (client_id, seq): client retransmits of a
+        # slow read must attach to the original, not spawn parallel
+        # probe rounds + reader calls (the read twin of sess.inflight)
+        self._reads_inflight: set[tuple[uuid.UUID, int]] = set()
+        self._tasks: set = set()
+        self._running = False
+        self._run_task = None
+        self._probe_task = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        from rabia_tpu.net.tcp import TcpNetwork
+
+        self._net = TcpNetwork(
+            self.node_id,
+            TcpNetworkConfig(
+                bind_host=self.config.bind_host,
+                bind_port=self.config.bind_port,
+            ),
+        )
+        self.engine.add_frontier_listener(self._frontier_event.set)
+        self._running = True
+        self._run_task = asyncio.ensure_future(self._run())
+        self._probe_task = asyncio.ensure_future(self._probe_loop())
+
+    @property
+    def port(self) -> int:
+        return self._net.port if self._net is not None else 0
+
+    @property
+    def endpoint(self) -> GatewayEndpoint:
+        return GatewayEndpoint(
+            self.node_id, self.config.bind_host, self.port
+        )
+
+    def add_peer_gateway(
+        self, node_id: NodeId, host: str, port: int
+    ) -> None:
+        """Register a peer replica's gateway (read-index probe quorum)."""
+        self._peer_gateways[node_id] = (host, port)
+        self._net.add_peer(node_id, host, port)
+
+    async def close(self) -> None:
+        self._running = False
+        self.engine.remove_frontier_listener(self._frontier_event.set)
+        for t in (self._run_task, self._probe_task, *self._tasks):
+            if t is not None:
+                t.cancel()
+        await asyncio.gather(
+            *(t for t in (self._run_task, self._probe_task, *self._tasks) if t),
+            return_exceptions=True,
+        )
+        self._tasks.clear()
+        if self._net is not None:
+            await self._net.close()
+            self._net = None
+
+    def _spawn(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    # -- receive loop -------------------------------------------------------
+
+    async def _run(self) -> None:
+        last_gc = time.time()
+        while self._running:
+            try:
+                sender, data = await self._net.receive(
+                    timeout=self.config.gc_interval
+                )
+            except TimeoutError_:
+                sender = None
+            except asyncio.CancelledError:
+                return
+            if sender is not None:
+                try:
+                    msg = self.serializer.deserialize(data)
+                    self._handle(sender, msg)
+                except RabiaError as e:
+                    logger.warning(
+                        "gateway %s: dropping bad frame from %s: %s",
+                        self.node_id.short(),
+                        sender,
+                        e,
+                    )
+            now = time.time()
+            if now - last_gc >= self.config.gc_interval:
+                last_gc = now
+                self.sessions.gc(self.engine.rt.state_version, now)
+
+    def _handle(self, sender: NodeId, msg: ProtocolMessage) -> None:
+        p = msg.payload
+        if isinstance(p, (ClientHello, Submit)) or (
+            isinstance(p, ReadIndex) and p.mode == ReadIndexMode.READ
+        ):
+            # a client's transport identity IS its session id (the client
+            # library dials with NodeId(client_id)); mismatches would let
+            # one client replay into another's session
+            if sender.value != p.client_id:
+                logger.warning(
+                    "gateway %s: client frame session/transport mismatch "
+                    "(%s via %s)",
+                    self.node_id.short(),
+                    p.client_id,
+                    sender,
+                )
+                return
+        if isinstance(p, ClientHello):
+            self._on_hello(sender, p)
+        elif isinstance(p, Submit):
+            self._on_submit(sender, p)
+        elif isinstance(p, ReadIndex):
+            if p.mode == ReadIndexMode.READ:
+                self._on_read(sender, p)
+            elif p.mode == ReadIndexMode.PROBE:
+                self._on_probe(sender, p)
+            elif p.mode == ReadIndexMode.REPLY:
+                self._on_probe_reply(sender, p)
+            elif p.mode == ReadIndexMode.FETCH_RESULT:
+                self._on_fetch_result(sender, p)
+        elif isinstance(p, Result):
+            # a peer gateway answering one of our result-repair fetches
+            self._on_peer_result(sender, p)
+        # anything else on the gateway port is noise; ignore
+
+    def _send(self, payload, recipient: NodeId) -> None:
+        msg = ProtocolMessage.new(self.node_id, payload, recipient)
+        try:
+            self._net.send_to_nowait(recipient, self.serializer.serialize(msg))
+        except RabiaError:
+            logger.warning(
+                "gateway %s: send of %s to %s failed",
+                self.node_id.short(),
+                type(payload).__name__,
+                recipient.short(),
+            )
+
+    def _send_result(
+        self,
+        recipient: NodeId,
+        client_id: uuid.UUID,
+        seq: int,
+        status: int,
+        payload: tuple[bytes, ...],
+    ) -> None:
+        self.stats.results_sent += 1
+        self._send(
+            Result(
+                client_id=client_id, seq=seq, status=int(status),
+                payload=payload,
+            ),
+            recipient,
+        )
+
+    # -- session / submit path ---------------------------------------------
+
+    def _on_hello(self, sender: NodeId, p: ClientHello) -> None:
+        sess = self.sessions.ensure(p.client_id, p.max_inflight)
+        self._send(
+            ClientHello(
+                client_id=p.client_id,
+                ack=True,
+                last_seq=sess.highest_completed,
+                max_inflight=sess.window,
+            ),
+            sender,
+        )
+
+    def _on_submit(self, sender: NodeId, p: Submit) -> None:
+        self.stats.submits += 1
+        sess = self.sessions.ensure(p.client_id)
+        if p.ack_upto > sess.ack_upto:
+            sess.ack_upto = p.ack_upto
+        cached = sess.results.get(p.seq)
+        if cached is not None:
+            # exactly-once: a completed seq is answered from cache, never
+            # re-proposed. OK results resend as CACHED so tests/clients
+            # can observe the dedup; terminal errors resend as-is.
+            self.stats.submits_deduped += 1
+            self.sessions.stats.duplicate_submits += 1
+            status = (
+                ResultStatus.CACHED
+                if cached.status == ResultStatus.OK
+                else cached.status
+            )
+            self._send_result(sender, p.client_id, p.seq, status, cached.payload)
+            return
+        if p.seq in sess.inflight:
+            # concurrent duplicate: the original proposal's completion
+            # answers it (same commit, one apply)
+            self.stats.submits_deduped += 1
+            self.sessions.stats.duplicate_submits += 1
+            return
+        # -- admission control (shed BEFORE the engine sees the batch) --
+        if len(sess.inflight) >= sess.window:
+            self.stats.submits_shed += 1
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.RETRY,
+                (b"backpressure: session window full",),
+            )
+            return
+        if self.engine.pending_queue_depth() >= self.config.max_queue_depth:
+            self.stats.submits_shed += 1
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.RETRY,
+                (b"backpressure: engine queue saturated",),
+            )
+            return
+        if not self.engine.rt.has_quorum:
+            self.stats.submits_shed += 1
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.RETRY,
+                (b"no quorum",),
+            )
+            return
+        if not p.commands:
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.ERROR,
+                (b"empty submit",),
+            )
+            return
+        if not (0 <= p.shard < self.engine.n_shards):
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.ERROR,
+                (b"shard out of range",),
+            )
+            return
+        sess.inflight[p.seq] = None  # reserved synchronously (dedup window)
+        self._spawn(self._drive_submit(sender, sess, p))
+
+    @staticmethod
+    def _deterministic_batch(p: Submit) -> CommandBatch:
+        """Build the consensus batch with ids derived from
+        ``(client_id, seq)`` instead of fresh uuid4s. A replay of the
+        same Submit — even after the gateway lost its session state
+        (restart, cache eviction, session expiry) — therefore produces
+        a byte-identical batch with the SAME batch id, and the engine's
+        ``applied_ids`` dedup ledger blocks the double apply that a
+        random id would slip past."""
+        import hashlib
+
+        seed = p.client_id.bytes + p.seq.to_bytes(8, "little")
+        bid = uuid.UUID(
+            bytes=hashlib.blake2s(seed, digest_size=16).digest()
+        )
+        cmds = [
+            Command(
+                id=uuid.UUID(
+                    bytes=hashlib.blake2s(
+                        seed + i.to_bytes(4, "little"), digest_size=16
+                    ).digest()
+                ),
+                data=c,
+            )
+            for i, c in enumerate(p.commands)
+        ]
+        return CommandBatch(
+            id=BatchId(bid), commands=tuple(cmds), shard=ShardId(p.shard)
+        )
+
+    async def _drive_submit(self, sender: NodeId, sess, p: Submit) -> None:
+        batch = self._deterministic_batch(p)
+        proposed = False
+        try:
+            fut = await self.engine.submit_batch(batch, p.shard)
+            proposed = True
+            sess.inflight[p.seq] = fut
+            responses = await fut
+            status: int = ResultStatus.OK
+            payload = tuple(responses)
+        except asyncio.CancelledError:
+            sess.inflight.pop(p.seq, None)
+            raise
+        except ResponsesUnavailableError:
+            # the batch COMMITTED but this replica adopted its slots via
+            # snapshot sync — the responses exist on peers that applied
+            # normally. Repair from a peer gateway; never re-propose.
+            status, payload = await self._repair_result(batch.id, p.shard)
+        except RabiaError as e:
+            if not proposed and e.is_retryable():
+                # rejected before any proposal reached consensus: shed
+                # retryable, nothing to dedup against
+                sess.inflight.pop(p.seq, None)
+                self.stats.submits_shed += 1
+                self._send_result(
+                    sender, p.client_id, p.seq, ResultStatus.RETRY,
+                    (str(e).encode(),),
+                )
+                return
+            # post-proposal failures are terminal for this seq: the batch
+            # MAY have committed (e.g. applied via snapshot sync with
+            # responses unavailable) — a silent retry under the same seq
+            # could double-apply under a fresh batch id, so the error is
+            # cached and the client must use a new seq to retry
+            status = ResultStatus.ERROR
+            payload = (str(e).encode(),)
+        sess.inflight.pop(p.seq, None)
+        sess.complete(
+            p.seq,
+            CachedResult(
+                status=int(status),
+                payload=payload,
+                frontier_mark=self.engine.rt.state_version,
+            ),
+        )
+        self.sessions.stats.results_cached += 1
+        sess.touch()
+        self._send_result(sender, p.client_id, p.seq, status, payload)
+
+    # -- linearizable read path ---------------------------------------------
+
+    def _on_read(self, sender: NodeId, p: ReadIndex) -> None:
+        self.stats.reads += 1
+        if not self.engine.rt.has_quorum:
+            self.stats.reads_failed += 1
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.RETRY,
+                (b"no quorum",),
+            )
+            return
+        if not (0 <= p.shard < self.engine.n_shards):
+            self.stats.reads_failed += 1
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.ERROR,
+                (b"shard out of range",),
+            )
+            return
+        key = (p.client_id, p.seq)
+        if key in self._reads_inflight:
+            return  # retransmit of a slow read: the original answers
+        self._reads_inflight.add(key)
+        self._spawn(self._drive_read(sender, p))
+
+    async def _drive_read(self, sender: NodeId, p: ReadIndex) -> None:
+        try:
+            try:
+                frontier = await self._acquire_read_index()
+                target = int(frontier[p.shard])
+                await self._await_applied(p.shard, target)
+            except RabiaError as e:
+                self.stats.reads_failed += 1
+                self._send_result(
+                    sender, p.client_id, p.seq, ResultStatus.RETRY,
+                    (str(e).encode(),),
+                )
+                return
+            try:
+                data = self.reader(p.shard, p.key)
+            except Exception as e:
+                # the reader is a pluggable seam (device-KV handlers can
+                # fail transiently): the client must get a frame, never
+                # silence — a dead task would make it retransmit forever
+                logger.warning(
+                    "gateway %s: read handler failed for shard %d: %s",
+                    self.node_id.short(), p.shard, e,
+                )
+                self.stats.reads_failed += 1
+                self._send_result(
+                    sender, p.client_id, p.seq, ResultStatus.ERROR,
+                    (f"read handler failed: {e}".encode(),),
+                )
+                return
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.OK, (data,)
+            )
+        finally:
+            self._reads_inflight.discard((p.client_id, p.seq))
+
+    async def _acquire_read_index(self) -> np.ndarray:
+        """Join the NEXT probe round (a round already in flight started
+        before this read arrived, so its frontiers may predate writes the
+        read must observe)."""
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._round_waiters.append(fut)
+        self._probe_kick.set()
+        return await fut
+
+    async def _probe_loop(self) -> None:
+        while self._running:
+            try:
+                await self._probe_kick.wait()
+            except asyncio.CancelledError:
+                return
+            self._probe_kick.clear()
+            if not self._round_waiters:
+                continue
+            waiters, self._round_waiters = self._round_waiters, []
+            try:
+                frontier = await self._run_probe_round(waiters)
+            except asyncio.CancelledError:
+                for w in waiters:
+                    if not w.done():
+                        w.set_exception(
+                            TimeoutError_("read-index probe cancelled")
+                        )
+                return
+            except RabiaError as e:
+                for w in waiters:
+                    if not w.done():
+                        w.set_exception(e)
+                continue
+            for w in waiters:
+                if not w.done():
+                    w.set_result(frontier)
+
+    async def _run_probe_round(self, waiters: list) -> np.ndarray:
+        self.stats.probe_rounds += 1
+        frontier = self.engine.decided_frontier().astype(np.int64)
+        need = self.engine.cluster.quorum_size - 1
+        if need <= 0:
+            return frontier  # single-replica cluster: self IS a quorum
+        if len(self._peer_gateways) < need:
+            raise TimeoutError_("read-index: not enough peer gateways")
+        self._nonce += 1
+        round_ = _ProbeRound(self._nonce, waiters)
+        self._round = round_
+        probe = ReadIndex(
+            mode=int(ReadIndexMode.PROBE),
+            client_id=self.node_id.value,
+            seq=round_.nonce,
+        )
+        for peer in self._peer_gateways:
+            self._send(probe, peer)
+        try:
+            await asyncio.wait_for(
+                round_.done.wait(), self.config.probe_timeout
+            )
+        except asyncio.TimeoutError:
+            raise TimeoutError_(
+                "read-index probe", self.config.probe_timeout
+            ) from None
+        finally:
+            self._round = None
+        for vec in round_.replies.values():
+            m = min(len(vec), len(frontier))
+            np.maximum(frontier[:m], vec[:m], out=frontier[:m])
+        return frontier
+
+    def _on_probe(self, sender: NodeId, p: ReadIndex) -> None:
+        # answer only known peer gateways: the frontier is engine state
+        if sender not in self._peer_gateways:
+            return
+        self._send(
+            ReadIndex(
+                mode=int(ReadIndexMode.REPLY),
+                client_id=self.node_id.value,
+                seq=p.seq,
+                frontier=tuple(
+                    int(x) for x in self.engine.decided_frontier()
+                ),
+            ),
+            sender,
+        )
+
+    def _on_probe_reply(self, sender: NodeId, p: ReadIndex) -> None:
+        if sender not in self._peer_gateways:
+            return
+        round_ = self._round
+        if round_ is None or p.seq != round_.nonce:
+            return  # stale reply from an expired round
+        round_.replies[sender] = np.asarray(p.frontier, np.int64)
+        if len(round_.replies) >= self.engine.cluster.quorum_size - 1:
+            round_.done.set()
+
+    # -- result repair (committed, responses lost to a sync overtake) -------
+
+    def _on_fetch_result(self, sender: NodeId, p: ReadIndex) -> None:
+        """A peer gateway asks for a committed batch's applied responses
+        (its replica adopted the slots via snapshot sync and never ran
+        the apply). ``key`` is the 16-byte batch id."""
+        if sender not in self._peer_gateways:
+            return
+        status, payload = ResultStatus.RETRY, ()  # unknown here
+        if len(p.key) == 16 and 0 <= p.shard < self.engine.n_shards:
+            sh = self.engine.rt.shards[p.shard]
+            bid = BatchId(uuid.UUID(bytes=p.key))
+            if bid in sh.applied_results:
+                responses = sh.applied_results[bid]
+                if responses is None:
+                    # applied here too, but the state machine rejected it
+                    # deterministically: the failure is the true outcome
+                    status, payload = ResultStatus.ERROR, (b"apply failed",)
+                else:
+                    status, payload = ResultStatus.OK, tuple(responses)
+        self._send_result(sender, self.node_id.value, p.seq, status, payload)
+
+    def _on_peer_result(self, sender: NodeId, p: Result) -> None:
+        if sender not in self._peer_gateways:
+            return
+        fut = self._fetches.get(p.seq)
+        if fut is not None and not fut.done():
+            fut.set_result(p)
+
+    async def _repair_result(
+        self, batch_id, shard: int
+    ) -> tuple[int, tuple[bytes, ...]]:
+        """Fetch a committed batch's responses from peer gateways — never
+        re-proposes, so exactly-once is preserved. Returns (status,
+        payload); ERROR with a diagnostic when no peer holds them."""
+        for peer in list(self._peer_gateways):
+            self._fetch_nonce += 1
+            nonce = self._fetch_nonce
+            fut: asyncio.Future = asyncio.get_event_loop().create_future()
+            self._fetches[nonce] = fut
+            try:
+                self._send(
+                    ReadIndex(
+                        mode=int(ReadIndexMode.FETCH_RESULT),
+                        client_id=self.node_id.value,
+                        seq=nonce,
+                        shard=shard,
+                        key=batch_id.value.bytes,
+                    ),
+                    peer,
+                )
+                res = await asyncio.wait_for(
+                    fut, self.config.probe_timeout
+                )
+            except asyncio.TimeoutError:
+                continue
+            finally:
+                self._fetches.pop(nonce, None)
+            if res.status == ResultStatus.OK:
+                self.stats.results_repaired += 1
+                return ResultStatus.OK, tuple(res.payload)
+            if res.status == ResultStatus.ERROR:
+                return ResultStatus.ERROR, tuple(res.payload)
+            # RETRY: this peer doesn't hold it either; try the next
+        return ResultStatus.ERROR, (
+            b"committed but responses unavailable cluster-wide",
+        )
+
+    async def _await_applied(self, shard: int, target: int) -> None:
+        """Block until the local applied frontier covers ``target`` on
+        ``shard`` (event-driven via the engine's frontier hook, with a
+        coarse poll guard)."""
+        rt = self.engine.rt
+        if rt.applied_upto[shard] >= target:
+            return
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + self.config.read_timeout
+        while rt.applied_upto[shard] < target:
+            left = deadline - loop.time()
+            if left <= 0:
+                raise TimeoutError_(
+                    "read-index apply wait", self.config.read_timeout
+                )
+            self._frontier_event.clear()
+            if rt.applied_upto[shard] >= target:
+                return
+            try:
+                await asyncio.wait_for(
+                    self._frontier_event.wait(), min(left, 0.05)
+                )
+            except asyncio.TimeoutError:
+                pass

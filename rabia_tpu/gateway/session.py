@@ -1,0 +1,145 @@
+"""Per-replica client session table: exactly-once command semantics.
+
+Velos (arXiv:2106.08676) carves the client-facing path — session state,
+retry dedup, read leases — out of the consensus core as its own
+subsystem; this module is that state. Every client command carries a
+``(client_id, seq)`` pair; a session keeps the results of completed
+seqs so a duplicate submission (client retry, reconnect replay) is
+answered from cache instead of re-proposed, and tracks in-flight seqs
+so concurrent duplicates attach to the original proposal.
+
+GC is tied to the engine's decided frontier: a cached result becomes
+evictable only once (a) the client acknowledged receiving it
+(``ack_upto``) AND (b) the engine's state version moved past the
+version recorded at completion — the decided frontier has provably
+advanced beyond the command's slot, so no in-flight consensus path can
+re-surface it. Idle sessions age out whole after ``session_ttl``.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class CachedResult:
+    """One completed seq's outcome, replayable to duplicate submits."""
+
+    status: int
+    payload: tuple[bytes, ...]
+    frontier_mark: int  # engine state_version when the result completed
+
+
+@dataclass
+class SessionStats:
+    sessions_opened: int = 0
+    duplicate_submits: int = 0
+    results_cached: int = 0
+    results_evicted: int = 0
+    sessions_expired: int = 0
+
+
+@dataclass
+class GatewaySession:
+    """One client's gateway-side state."""
+
+    client_id: uuid.UUID
+    window: int
+    inflight: dict = field(default_factory=dict)  # seq -> asyncio.Future
+    results: dict = field(default_factory=dict)  # seq -> CachedResult
+    ack_upto: int = 0
+    highest_completed: int = 0
+    last_active: float = field(default_factory=time.time)
+
+    def touch(self) -> None:
+        self.last_active = time.time()
+
+    def complete(self, seq: int, result: CachedResult) -> None:
+        self.results[seq] = result
+        if seq > self.highest_completed:
+            self.highest_completed = seq
+
+
+class SessionTable:
+    """client_id -> :class:`GatewaySession`, with frontier-tied GC."""
+
+    def __init__(
+        self,
+        default_window: int = 64,
+        session_ttl: float = 600.0,
+        result_cache_cap: int = 4096,
+    ) -> None:
+        self.default_window = max(1, default_window)
+        self.session_ttl = session_ttl
+        self.result_cache_cap = max(1, result_cache_cap)
+        self.sessions: dict[uuid.UUID, GatewaySession] = {}
+        self.stats = SessionStats()
+
+    def ensure(
+        self, client_id: uuid.UUID, requested_window: int = 0
+    ) -> GatewaySession:
+        """Open or resume the client's session. The granted window is the
+        gateway's default capped further by the client's request (a
+        client may shrink its window, never grow past the gateway's)."""
+        sess = self.sessions.get(client_id)
+        if sess is None:
+            sess = GatewaySession(
+                client_id=client_id, window=self.default_window
+            )
+            self.sessions[client_id] = sess
+            self.stats.sessions_opened += 1
+        if requested_window > 0:
+            # renegotiable on resume too — a reconnecting client may ask
+            # for a stricter window than its previous session had
+            sess.window = min(self.default_window, requested_window)
+        sess.touch()
+        return sess
+
+    def get(self, client_id: uuid.UUID) -> Optional[GatewaySession]:
+        return self.sessions.get(client_id)
+
+    def gc(self, state_version: int, now: Optional[float] = None) -> int:
+        """Evict acknowledged results the decided frontier has moved past,
+        cap runaway per-session caches, and expire idle sessions.
+        Returns the number of evicted results."""
+        now = time.time() if now is None else now
+        evicted = 0
+        dead: list[uuid.UUID] = []
+        for cid, sess in self.sessions.items():
+            if sess.results:
+                gone = [
+                    seq
+                    for seq, r in sess.results.items()
+                    if seq <= sess.ack_upto and r.frontier_mark < state_version
+                ]
+                for seq in gone:
+                    del sess.results[seq]
+                evicted += len(gone)
+                # hard cap against a client that never acks: evict oldest
+                # seqs first. A replay of an evicted seq re-proposes, but
+                # under the SAME deterministic batch id (server.
+                # _deterministic_batch), so the engine's applied_ids
+                # ledger still blocks a double apply — this cache only
+                # saves the round trip and the burned slot
+                if len(sess.results) > self.result_cache_cap:
+                    for seq in sorted(sess.results)[
+                        : len(sess.results) - self.result_cache_cap
+                    ]:
+                        del sess.results[seq]
+                        evicted += 1
+            if (
+                not sess.inflight
+                and now - sess.last_active > self.session_ttl
+            ):
+                dead.append(cid)
+        for cid in dead:
+            del self.sessions[cid]
+            self.stats.sessions_expired += 1
+        self.stats.results_evicted += evicted
+        return evicted
+
+    def __len__(self) -> int:
+        return len(self.sessions)

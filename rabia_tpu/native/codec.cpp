@@ -6,11 +6,13 @@
 // SAME wire format as rabia_tpu/core/serialization.py (version 3,
 // hand-rolled little-endian) for the latency-critical frame types —
 // VoteRound1/VoteRound2 (packed vote vectors), Decision, Propose and
-// NewBatch (command batches), ProposeBlock, HeartBeat, SyncRequest, and
+// NewBatch (command batches), ProposeBlock, HeartBeat, SyncRequest,
 // SyncResponse (the recovery/snapshot frame, incl. its zlib-level-1 body
-// compression) — and returns None for everything else so the Python
-// codec remains the semantics owner and fallback. Byte-for-byte
-// compatibility is pinned by tests/test_native_codec.py.
+// compression), and the client gateway frames (ClientHello, Submit,
+// Result, ReadIndex — rabia_tpu/gateway) — and returns None for
+// everything else so the Python codec remains the semantics owner and
+// fallback. Byte-for-byte compatibility is pinned by
+// tests/test_native_codec.py.
 //
 // Built as a CPython extension (not ctypes): the cost of the Python
 // codec is object construction and bytecode, not byte shuffling, so the
@@ -53,6 +55,10 @@ constexpr uint8_t MT_SYNCRESP = 6;
 constexpr uint8_t MT_NEWBATCH = 7;
 constexpr uint8_t MT_HEARTBEAT = 8;
 constexpr uint8_t MT_PROPOSE_BLOCK = 10;
+constexpr uint8_t MT_CLIENT_HELLO = 11;
+constexpr uint8_t MT_SUBMIT = 12;
+constexpr uint8_t MT_RESULT = 13;
+constexpr uint8_t MT_READ_INDEX = 14;
 
 // Python classes / helpers bound once via bind()
 PyObject* g_ProtocolMessage = nullptr;
@@ -62,6 +68,10 @@ PyObject* g_Decision = nullptr;
 PyObject* g_HeartBeat = nullptr;
 PyObject* g_SyncRequest = nullptr;
 PyObject* g_SyncResponse = nullptr;
+PyObject* g_ClientHello = nullptr;
+PyObject* g_Submit = nullptr;
+PyObject* g_Result = nullptr;
+PyObject* g_ReadIndex = nullptr;
 PyObject* g_ProposeBlock = nullptr;
 PyObject* g_PayloadBlock = nullptr;
 PyObject* g_NodeId = nullptr;
@@ -90,6 +100,9 @@ PyObject* s_shard; PyObject* s_phase; PyObject* s_batch_id; PyObject* s_batch;
 PyObject* s_commands;
 PyObject* s_responder_phase; PyObject* s_snapshot; PyObject* s_per_shard_phase;
 PyObject* s_applied_ids; PyObject* s_per_shard_version;
+PyObject* s_client_id; PyObject* s_seq; PyObject* s_ack; PyObject* s_last_seq;
+PyObject* s_max_inflight; PyObject* s_ack_upto; PyObject* s_status;
+PyObject* s_mode; PyObject* s_key; PyObject* s_frontier;
 
 inline void wr_u32(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
 inline void wr_u64(uint8_t* p, uint64_t v) { memcpy(p, &v, 8); }
@@ -504,6 +517,119 @@ bool encode_syncresp(Buf& b, PyObject* payload, bool* decline) {
   Py_DECREF(fast);
   if (!ok) return false;
   return syncresp_u64_seq(b, payload, s_per_shard_version, decline);
+}
+
+// --- client gateway frame encoders (rabia_tpu/gateway) --------------------
+// Same decline discipline as encode_syncresp: any shape surprise (non-
+// bytes blob, out-of-range u32 field) routes the frame to the Python
+// codec so its historical error surfaces unchanged.
+
+// 16 wire bytes of a PLAIN uuid.UUID attribute (gateway client ids are
+// bare UUIDs, not NodeId/BatchId wrappers)
+bool put_uuid_attr(Buf& b, PyObject* payload, PyObject* name, bool* decline) {
+  PyObject* u = PyObject_GetAttr(payload, name);
+  if (!u) { PyErr_Clear(); *decline = true; return false; }
+  uint8_t raw[16];
+  bool got = uuid_bytes(u, raw);
+  Py_DECREF(u);
+  if (!got) { PyErr_Clear(); *decline = true; return false; }
+  return b.put_raw(raw, 16);
+}
+
+// u32 count + count * (u32 len + bytes) from a tuple-of-bytes attribute
+bool encode_blob_tuple(Buf& b, PyObject* payload, PyObject* name,
+                       bool* decline) {
+  PyObject* seq = PyObject_GetAttr(payload, name);
+  if (!seq) { PyErr_Clear(); *decline = true; return false; }
+  PyObject* fast = PySequence_Fast(seq, "");
+  Py_DECREF(seq);
+  if (!fast) { PyErr_Clear(); *decline = true; return false; }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  bool ok = b.put_u32((uint32_t)n);
+  for (Py_ssize_t i = 0; ok && i < n; i++) {
+    PyObject* v = PySequence_Fast_GET_ITEM(fast, i);
+    if (!PyBytes_Check(v)) { *decline = true; ok = false; break; }
+    ok = b.put_u32((uint32_t)PyBytes_GET_SIZE(v)) &&
+         b.put_raw(PyBytes_AS_STRING(v), (size_t)PyBytes_GET_SIZE(v));
+  }
+  Py_DECREF(fast);
+  return ok;
+}
+
+// an int attribute bounded to a wire width; out-of-range declines
+bool u64_attr_max(PyObject* obj, PyObject* name, uint64_t max, uint64_t* out,
+                  bool* decline) {
+  if (!u64_attr_val(obj, name, out) || *out > max) {
+    PyErr_Clear(); *decline = true; return false;
+  }
+  return true;
+}
+
+// ClientHello body: u8 ack | 16B client uuid | u64 last_seq |
+// u32 max_inflight
+bool encode_client_hello(Buf& b, PyObject* payload, bool* decline) {
+  PyObject* ack = PyObject_GetAttr(payload, s_ack);
+  if (!ack) { PyErr_Clear(); *decline = true; return false; }
+  int truth = PyObject_IsTrue(ack);
+  Py_DECREF(ack);
+  if (truth < 0) { PyErr_Clear(); *decline = true; return false; }
+  if (!b.put_u8((uint8_t)truth)) return false;
+  if (!put_uuid_attr(b, payload, s_client_id, decline)) return false;
+  uint64_t ls, mi;
+  if (!u64_attr_max(payload, s_last_seq, ~0ull, &ls, decline) ||
+      !u64_attr_max(payload, s_max_inflight, 0xFFFFFFFFull, &mi, decline))
+    return false;
+  return b.put_u64(ls) && b.put_u32((uint32_t)mi);
+}
+
+// Submit body: 16B client uuid | u64 seq | u32 shard | u64 ack_upto |
+// u32 n | n * blob
+bool encode_submit(Buf& b, PyObject* payload, bool* decline) {
+  if (!put_uuid_attr(b, payload, s_client_id, decline)) return false;
+  uint64_t seq, shard, au;
+  if (!u64_attr_max(payload, s_seq, ~0ull, &seq, decline) ||
+      !u64_attr_max(payload, s_shard, 0xFFFFFFFFull, &shard, decline) ||
+      !u64_attr_max(payload, s_ack_upto, ~0ull, &au, decline))
+    return false;
+  if (!b.put_u64(seq) || !b.put_u32((uint32_t)shard) || !b.put_u64(au))
+    return false;
+  return encode_blob_tuple(b, payload, s_commands, decline);
+}
+
+// Result body: 16B client uuid | u64 seq | u8 status | u32 n | n * blob
+bool encode_result(Buf& b, PyObject* payload, bool* decline) {
+  if (!put_uuid_attr(b, payload, s_client_id, decline)) return false;
+  uint64_t seq, status;
+  if (!u64_attr_max(payload, s_seq, ~0ull, &seq, decline) ||
+      !u64_attr_max(payload, s_status, 0xFFull, &status, decline))
+    return false;
+  if (!b.put_u64(seq) || !b.put_u8((uint8_t)status)) return false;
+  return encode_blob_tuple(b, payload, s_payload, decline);
+}
+
+// ReadIndex body: u8 mode | 16B client uuid | u64 seq | u32 shard |
+// u32 klen + key | u32 k | k * u64 frontier
+bool encode_read_index(Buf& b, PyObject* payload, bool* decline) {
+  uint64_t mode, seq, shard;
+  if (!u64_attr_max(payload, s_mode, 0xFFull, &mode, decline)) return false;
+  if (!b.put_u8((uint8_t)mode)) return false;
+  if (!put_uuid_attr(b, payload, s_client_id, decline)) return false;
+  if (!u64_attr_max(payload, s_seq, ~0ull, &seq, decline) ||
+      !u64_attr_max(payload, s_shard, 0xFFFFFFFFull, &shard, decline))
+    return false;
+  if (!b.put_u64(seq) || !b.put_u32((uint32_t)shard)) return false;
+  PyObject* key = PyObject_GetAttr(payload, s_key);
+  if (!key) { PyErr_Clear(); *decline = true; return false; }
+  bool ok = PyBytes_Check(key);
+  if (ok) {
+    ok = b.put_u32((uint32_t)PyBytes_GET_SIZE(key)) &&
+         b.put_raw(PyBytes_AS_STRING(key), (size_t)PyBytes_GET_SIZE(key));
+  } else {
+    *decline = true;  // bytearray/memoryview key: Python path
+  }
+  Py_DECREF(key);
+  if (!ok) return false;
+  return syncresp_u64_seq(b, payload, s_frontier, decline);
 }
 
 // u32/u64 from an int-like attribute (plain int, numpy integer, IntEnum).
@@ -1194,6 +1320,144 @@ PyObject* decode_newbatch(Rd& r) {
   return obj;
 }
 
+// --- client gateway frame decoders ----------------------------------------
+
+// u32 count + count * (u32 len + bytes) -> tuple of bytes
+PyObject* decode_blob_tuple(Rd& r) {
+  const uint8_t* q = r.take(4);
+  if (!q) return nullptr;
+  uint32_t n = rd_u32(q);
+  // bound the wire-controlled count by the remaining bytes BEFORE
+  // allocating (every blob needs >= 4 length bytes)
+  if ((uint64_t)n * 4 > (uint64_t)(r.len - r.pos)) {
+    PyErr_Format(g_SerializationError,
+                 "truncated blob tuple: %u entries in %zu bytes", n,
+                 r.len - r.pos);
+    return nullptr;
+  }
+  PyObject* t = PyTuple_New((Py_ssize_t)n);
+  if (!t) return nullptr;
+  for (uint32_t i = 0; i < n; i++) {
+    const uint8_t* ln = r.take(4);
+    if (!ln) { Py_DECREF(t); return nullptr; }
+    uint32_t dlen = rd_u32(ln);
+    const uint8_t* raw = r.take(dlen);
+    if (!raw) { Py_DECREF(t); return nullptr; }
+    PyObject* blob = PyBytes_FromStringAndSize((const char*)raw, dlen);
+    if (!blob) { Py_DECREF(t); return nullptr; }
+    PyTuple_SET_ITEM(t, i, blob);  // steals
+  }
+  return t;
+}
+
+// u32 count + count * u64 -> tuple of ints
+PyObject* decode_u64_tuple(Rd& r) {
+  const uint8_t* ln = r.take(4);
+  if (!ln) return nullptr;
+  uint32_t n = rd_u32(ln);
+  const uint8_t* raw = r.take((size_t)n * 8);
+  if (!raw) return nullptr;
+  PyObject* t = PyTuple_New((Py_ssize_t)n);
+  if (!t) return nullptr;
+  for (uint32_t i = 0; i < n; i++) {
+    PyObject* v = PyLong_FromUnsignedLongLong(rd_u64(raw + (size_t)i * 8));
+    if (!v) { Py_DECREF(t); return nullptr; }
+    PyTuple_SET_ITEM(t, i, v);
+  }
+  return t;
+}
+
+PyObject* decode_client_hello(Rd& r) {
+  const uint8_t* q = r.take(1 + 16 + 8 + 4);
+  if (!q) return nullptr;
+  PyObject* ack = PyBool_FromLong(q[0]);
+  PyObject* cid = make_uuid(q + 1);
+  PyObject* ls = PyLong_FromUnsignedLongLong(rd_u64(q + 17));
+  PyObject* mi = PyLong_FromUnsignedLong(rd_u32(q + 25));
+  PyObject* obj = (ack && cid && ls && mi) ? raw_new(g_ClientHello) : nullptr;
+  if (!obj || raw_set(obj, s_client_id, cid) < 0 ||
+      raw_set(obj, s_ack, ack) < 0 || raw_set(obj, s_last_seq, ls) < 0 ||
+      raw_set(obj, s_max_inflight, mi) < 0) {
+    Py_XDECREF(obj); Py_XDECREF(ack); Py_XDECREF(cid);
+    Py_XDECREF(ls); Py_XDECREF(mi);
+    return nullptr;
+  }
+  Py_DECREF(ack); Py_DECREF(cid); Py_DECREF(ls); Py_DECREF(mi);
+  return obj;
+}
+
+PyObject* decode_submit(Rd& r) {
+  const uint8_t* q = r.take(16 + 8 + 4 + 8);
+  if (!q) return nullptr;
+  PyObject* cid = make_uuid(q);
+  PyObject* seq = PyLong_FromUnsignedLongLong(rd_u64(q + 16));
+  PyObject* shard = PyLong_FromUnsignedLong(rd_u32(q + 24));
+  PyObject* au = PyLong_FromUnsignedLongLong(rd_u64(q + 28));
+  PyObject* cmds =
+      (cid && seq && shard && au) ? decode_blob_tuple(r) : nullptr;
+  PyObject* obj = cmds ? raw_new(g_Submit) : nullptr;
+  if (!obj || raw_set(obj, s_client_id, cid) < 0 ||
+      raw_set(obj, s_seq, seq) < 0 || raw_set(obj, s_shard, shard) < 0 ||
+      raw_set(obj, s_commands, cmds) < 0 ||
+      raw_set(obj, s_ack_upto, au) < 0) {
+    Py_XDECREF(obj); Py_XDECREF(cid); Py_XDECREF(seq);
+    Py_XDECREF(shard); Py_XDECREF(au); Py_XDECREF(cmds);
+    return nullptr;
+  }
+  Py_DECREF(cid); Py_DECREF(seq); Py_DECREF(shard);
+  Py_DECREF(au); Py_DECREF(cmds);
+  return obj;
+}
+
+PyObject* decode_result(Rd& r) {
+  const uint8_t* q = r.take(16 + 8 + 1);
+  if (!q) return nullptr;
+  PyObject* cid = make_uuid(q);
+  PyObject* seq = PyLong_FromUnsignedLongLong(rd_u64(q + 16));
+  PyObject* status = PyLong_FromLong(q[24]);
+  PyObject* pl = (cid && seq && status) ? decode_blob_tuple(r) : nullptr;
+  PyObject* obj = pl ? raw_new(g_Result) : nullptr;
+  if (!obj || raw_set(obj, s_client_id, cid) < 0 ||
+      raw_set(obj, s_seq, seq) < 0 || raw_set(obj, s_status, status) < 0 ||
+      raw_set(obj, s_payload, pl) < 0) {
+    Py_XDECREF(obj); Py_XDECREF(cid); Py_XDECREF(seq);
+    Py_XDECREF(status); Py_XDECREF(pl);
+    return nullptr;
+  }
+  Py_DECREF(cid); Py_DECREF(seq); Py_DECREF(status); Py_DECREF(pl);
+  return obj;
+}
+
+PyObject* decode_read_index(Rd& r) {
+  const uint8_t* q = r.take(1 + 16 + 8 + 4);
+  if (!q) return nullptr;
+  PyObject* mode = PyLong_FromLong(q[0]);
+  PyObject* cid = make_uuid(q + 1);
+  PyObject* seq = PyLong_FromUnsignedLongLong(rd_u64(q + 17));
+  PyObject* shard = PyLong_FromUnsignedLong(rd_u32(q + 25));
+  PyObject* key = nullptr;
+  if (mode && cid && seq && shard) {
+    const uint8_t* ln = r.take(4);
+    const uint8_t* raw = ln ? r.take(rd_u32(ln)) : nullptr;
+    if (raw)
+      key = PyBytes_FromStringAndSize((const char*)raw,
+                                      (Py_ssize_t)rd_u32(ln));
+  }
+  PyObject* fr = key ? decode_u64_tuple(r) : nullptr;
+  PyObject* obj = fr ? raw_new(g_ReadIndex) : nullptr;
+  if (!obj || raw_set(obj, s_mode, mode) < 0 ||
+      raw_set(obj, s_client_id, cid) < 0 || raw_set(obj, s_seq, seq) < 0 ||
+      raw_set(obj, s_shard, shard) < 0 || raw_set(obj, s_key, key) < 0 ||
+      raw_set(obj, s_frontier, fr) < 0) {
+    Py_XDECREF(obj); Py_XDECREF(mode); Py_XDECREF(cid); Py_XDECREF(seq);
+    Py_XDECREF(shard); Py_XDECREF(key); Py_XDECREF(fr);
+    return nullptr;
+  }
+  Py_DECREF(mode); Py_DECREF(cid); Py_DECREF(seq);
+  Py_DECREF(shard); Py_DECREF(key); Py_DECREF(fr);
+  return obj;
+}
+
 // --- entry points ---------------------------------------------------------
 
 PyObject* codec_encode(PyObject*, PyObject* args) {
@@ -1218,6 +1482,10 @@ PyObject* codec_encode(PyObject*, PyObject* args) {
   else if (pt == (PyTypeObject*)g_ProposeBlock) mt = MT_PROPOSE_BLOCK;
   else if (pt == (PyTypeObject*)g_Propose) mt = MT_PROPOSE;
   else if (pt == (PyTypeObject*)g_NewBatch) mt = MT_NEWBATCH;
+  else if (pt == (PyTypeObject*)g_ClientHello) mt = MT_CLIENT_HELLO;
+  else if (pt == (PyTypeObject*)g_Submit) mt = MT_SUBMIT;
+  else if (pt == (PyTypeObject*)g_Result) mt = MT_RESULT;
+  else if (pt == (PyTypeObject*)g_ReadIndex) mt = MT_READ_INDEX;
   else {
     Py_DECREF(payload);
     Py_RETURN_NONE;  // unsupported: Python codec handles it
@@ -1306,6 +1574,14 @@ PyObject* codec_encode(PyObject*, PyObject* args) {
             ok = encode_syncresp(body, payload, &decline);
             break;
           case MT_PROPOSE_BLOCK: ok = encode_block(body, payload); break;
+          case MT_CLIENT_HELLO:
+            ok = encode_client_hello(body, payload, &decline);
+            break;
+          case MT_SUBMIT: ok = encode_submit(body, payload, &decline); break;
+          case MT_RESULT: ok = encode_result(body, payload, &decline); break;
+          case MT_READ_INDEX:
+            ok = encode_read_index(body, payload, &decline);
+            break;
         }
         bool body_done = false;
         if (ok && mt == MT_SYNCRESP && compress_threshold > 0 &&
@@ -1373,7 +1649,9 @@ PyObject* codec_decode(PyObject*, PyObject* arg) {
     bool supported =
         (mt == MT_VOTE1 || mt == MT_VOTE2 || mt == MT_DECISION ||
          mt == MT_HEARTBEAT || mt == MT_SYNCREQ || mt == MT_SYNCRESP ||
-         mt == MT_PROPOSE_BLOCK || mt == MT_PROPOSE || mt == MT_NEWBATCH) &&
+         mt == MT_PROPOSE_BLOCK || mt == MT_PROPOSE || mt == MT_NEWBATCH ||
+         mt == MT_CLIENT_HELLO || mt == MT_SUBMIT || mt == MT_RESULT ||
+         mt == MT_READ_INDEX) &&
         (!(flags & FLAG_COMPRESSED) || mt == MT_SYNCRESP);
     if (!supported) {
       // Python codec owns the remaining types / compressed bodies
@@ -1433,6 +1711,10 @@ PyObject* codec_decode(PyObject*, PyObject* arg) {
       case MT_PROPOSE_BLOCK: payload = decode_block(br); break;
       case MT_PROPOSE: payload = decode_propose(br); break;
       case MT_NEWBATCH: payload = decode_newbatch(br); break;
+      case MT_CLIENT_HELLO: payload = decode_client_hello(br); break;
+      case MT_SUBMIT: payload = decode_submit(br); break;
+      case MT_RESULT: payload = decode_result(br); break;
+      case MT_READ_INDEX: payload = decode_read_index(br); break;
     }
     if (!payload) break;
     PyObject* msg = raw_new(g_ProtocolMessage);
@@ -1459,13 +1741,14 @@ PyObject* codec_bind(PyObject*, PyObject* args, PyObject* kwargs) {
       "HeartBeat", "SyncRequest", "ProposeBlock", "PayloadBlock",
       "NodeId", "BatchId", "UUID", "safe_unknown", "SerializationError",
       "crc32", "Propose", "NewBatch", "CommandBatch", "Command",
-      "ShardId", "StateValue", "SyncResponse", nullptr};
+      "ShardId", "StateValue", "SyncResponse", "ClientHello", "Submit",
+      "Result", "ReadIndex", nullptr};
   PyObject *pm, *v1, *v2, *dc, *hb, *sr, *pb, *plb, *nid, *bid, *uu, *su,
-      *se, *crc, *pr, *nb, *cb, *cm, *si, *sv, *srp;
+      *se, *crc, *pr, *nb, *cb, *cm, *si, *sv, *srp, *ch, *sb, *rs, *ri;
   if (!PyArg_ParseTupleAndKeywords(
-          args, kwargs, "OOOOOOOOOOOOOOOOOOOOO", (char**)kwlist, &pm, &v1,
-          &v2, &dc, &hb, &sr, &pb, &plb, &nid, &bid, &uu, &su, &se, &crc,
-          &pr, &nb, &cb, &cm, &si, &sv, &srp))
+          args, kwargs, "OOOOOOOOOOOOOOOOOOOOOOOOO", (char**)kwlist, &pm,
+          &v1, &v2, &dc, &hb, &sr, &pb, &plb, &nid, &bid, &uu, &su, &se,
+          &crc, &pr, &nb, &cb, &cm, &si, &sv, &srp, &ch, &sb, &rs, &ri))
     return nullptr;
 #define BIND(slot, val) Py_XDECREF(slot); Py_INCREF(val); slot = val
   BIND(g_ProtocolMessage, pm); BIND(g_VoteRound1, v1); BIND(g_VoteRound2, v2);
@@ -1475,7 +1758,8 @@ PyObject* codec_bind(PyObject*, PyObject* args, PyObject* kwargs) {
   BIND(g_SerializationError, se); BIND(g_crc32, crc);
   BIND(g_Propose, pr); BIND(g_NewBatch, nb); BIND(g_CommandBatch, cb);
   BIND(g_Command, cm); BIND(g_ShardId, si); BIND(g_StateValue, sv);
-  BIND(g_SyncResponse, srp);
+  BIND(g_SyncResponse, srp); BIND(g_ClientHello, ch); BIND(g_Submit, sb);
+  BIND(g_Result, rs); BIND(g_ReadIndex, ri);
 #undef BIND
   Py_RETURN_NONE;
 }
@@ -1527,6 +1811,11 @@ extern "C" PyMODINIT_FUNC PyInit_rabia_native_codec(void) {
   INTERN(s_per_shard_phase, "per_shard_phase");
   INTERN(s_applied_ids, "applied_ids");
   INTERN(s_per_shard_version, "per_shard_version");
+  INTERN(s_client_id, "client_id"); INTERN(s_seq, "seq");
+  INTERN(s_ack, "ack"); INTERN(s_last_seq, "last_seq");
+  INTERN(s_max_inflight, "max_inflight"); INTERN(s_ack_upto, "ack_upto");
+  INTERN(s_status, "status"); INTERN(s_mode, "mode");
+  INTERN(s_key, "key"); INTERN(s_frontier, "frontier");
 #undef INTERN
   return m;
 }

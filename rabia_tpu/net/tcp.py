@@ -282,6 +282,8 @@ class TcpNetwork(NetworkTransport):
     async def get_connected_nodes(self) -> set[NodeId]:
         import uuid
 
+        if not self._handle:  # closed (or close in progress): no peers
+            return set()
         cap = 1024
         buf = (ctypes.c_uint8 * (16 * cap))()
         n = self._lib.rt_connected(self._handle, buf, cap)

@@ -1625,6 +1625,25 @@ class MeshEngine:
             )
         )
 
+    @property
+    def device_lane_active(self) -> bool:
+        """True while the device-resident KV lane is serving windows
+        (``device_store=True`` and the content is inside the lane's
+        envelope). The public twin of the internal ``_dev_active`` flag
+        for drivers/ops tooling."""
+        return self._dev_active
+
+    def sync_to_host(self) -> None:
+        """Materialize the device KV table into every replica's host
+        store for inspection (drains the in-flight window pipe first).
+
+        Implemented as a lane demotion: the device table is downloaded
+        once and fanned into the host stores, and the engine re-promotes
+        automatically after ``device_store_repromote`` clean full-width
+        cycles. Host-lane (or non-device) engines are already in sync —
+        a no-op."""
+        self._demote_device_store()
+
     def _demote_device_store(self) -> None:
         """Leave device-store mode: the device table becomes the host
         replica stores' content (rebuilt from scratch — in device mode

@@ -1,0 +1,217 @@
+"""Gateway cluster harness: N replicas over real TCP, each fronted by a
+:class:`~rabia_tpu.gateway.server.GatewayServer`, with replica
+restart support for chaos runs.
+
+Shared by tests/test_gateway.py, examples/client_gateway.py and
+benchmarks/gateway_bench.py — one place owning the build/start/restart/
+stop cycle of the full client-facing stack (the gateway analog of
+:class:`~rabia_tpu.testing.cluster.TestCluster`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from rabia_tpu.apps.sharded import make_sharded_kv
+from rabia_tpu.core.config import RabiaConfig, TcpNetworkConfig
+from rabia_tpu.core.errors import QuorumNotAvailableError
+from rabia_tpu.core.network import ClusterConfig
+from rabia_tpu.core.types import NodeId
+from rabia_tpu.engine import RabiaEngine
+from rabia_tpu.gateway import GatewayConfig, GatewayEndpoint, GatewayServer
+from rabia_tpu.net.tcp import TcpNetwork
+from rabia_tpu.persistence.backends import InMemoryPersistence
+
+
+def default_gateway_test_config(num_shards: int = 4) -> RabiaConfig:
+    return RabiaConfig(
+        phase_timeout=0.4, heartbeat_interval=0.05, round_interval=0.002
+    ).with_kernel(
+        num_shards=num_shards, shard_pad_multiple=max(1, num_shards)
+    )
+
+
+class GatewayCluster:
+    """N real-TCP replicas + per-replica gateways, lifecycle-managed."""
+
+    def __init__(
+        self,
+        n_replicas: int = 3,
+        n_shards: int = 4,
+        config: Optional[RabiaConfig] = None,
+        gateway_config: Optional[GatewayConfig] = None,
+    ) -> None:
+        self.n = n_replicas
+        self.n_shards = n_shards
+        self.config = config or default_gateway_test_config(n_shards)
+        self.gateway_config = gateway_config or GatewayConfig()
+        self.ids = [NodeId.from_int(i + 1) for i in range(n_replicas)]
+        self.nets: list[TcpNetwork] = []
+        self.engines: list[RabiaEngine] = []
+        self.machines: list[list] = []  # per replica: per-shard KVStoreSMR
+        self.gateways: list[GatewayServer] = []
+        self.tasks: list[asyncio.Task] = []
+        # durable per-replica state surviving restart_replica: a replica
+        # restarting with NO persistence is outside the engine's supported
+        # crash-recovery model (the vote-barrier taint that prevents a
+        # restarted proposer from rebinding fresh batches into anciently
+        # decided slots lives in the persistence layer)
+        self.persists = [InMemoryPersistence() for _ in range(n_replicas)]
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _build_replica(self, i: int, bind_port: int = 0) -> None:
+        net = TcpNetwork(self.ids[i], TcpNetworkConfig(bind_port=bind_port))
+        sm, machines = make_sharded_kv(self.n_shards)
+        eng = RabiaEngine(
+            ClusterConfig.new(self.ids[i], self.ids),
+            sm,
+            net,
+            persistence=self.persists[i],
+            config=self.config,
+        )
+        self.nets[i] = net
+        self.engines[i] = eng
+        self.machines[i] = machines
+
+    async def start(self, quorum_wait: float = 10.0) -> None:
+        self.nets = [None] * self.n  # type: ignore[list-item]
+        self.engines = [None] * self.n  # type: ignore[list-item]
+        self.machines = [None] * self.n  # type: ignore[list-item]
+        for i in range(self.n):
+            self._build_replica(i)
+        for i in range(self.n):
+            for j in range(self.n):
+                if i != j:
+                    self.nets[i].add_peer(
+                        self.ids[j], "127.0.0.1", self.nets[j].port
+                    )
+        self.tasks = [
+            asyncio.ensure_future(e.run()) for e in self.engines
+        ]
+        deadline = time.time() + quorum_wait
+        while time.time() < deadline:
+            stats = [await e.get_statistics() for e in self.engines]
+            if all(s.has_quorum for s in stats):
+                break
+            await asyncio.sleep(0.01)
+        else:
+            await self.stop()
+            raise QuorumNotAvailableError(
+                f"gateway cluster: no quorum within {quorum_wait}s"
+            )
+        self.gateways = [
+            GatewayServer(self.engines[i], config=self.gateway_config)
+            for i in range(self.n)
+        ]
+        for g in self.gateways:
+            await g.start()
+        self._mesh_gateways()
+
+    def _mesh_gateways(self) -> None:
+        for i in range(self.n):
+            for j in range(self.n):
+                if i != j and self.gateways[i] is not None:
+                    self.gateways[i].add_peer_gateway(
+                        self.gateways[j].node_id,
+                        "127.0.0.1",
+                        self.gateways[j].port,
+                    )
+
+    def endpoint(self, i: int) -> GatewayEndpoint:
+        return self.gateways[i].endpoint
+
+    def endpoints(self) -> list[GatewayEndpoint]:
+        return [g.endpoint for g in self.gateways]
+
+    def store(self, replica: int, shard: int):
+        """Direct host-store access (the linearizability oracle)."""
+        return self.machines[replica][shard].store
+
+    # -- chaos --------------------------------------------------------------
+
+    async def restart_replica(self, i: int, settle: float = 0.2) -> None:
+        """Restart replica ``i`` (engine, transport and gateway). The new
+        engine restores from the replica's persistence layer (vote
+        barrier + snapshot — the supported crash-recovery model) and
+        catches up the tail via peer Decisions/snapshot sync. The replica
+        and gateway rebind their previous ports so peers and clients
+        redial transparently."""
+        net_port = self.nets[i].port
+        gw = self.gateways[i]
+        gw_port, gw_node = gw.port, gw.node_id
+        gw_cfg = gw.config
+        await gw.close()
+        await self.engines[i].shutdown()
+        self.tasks[i].cancel()
+        try:
+            await self.tasks[i]
+        except (asyncio.CancelledError, Exception):
+            pass
+        await self.nets[i].close()
+        await asyncio.sleep(settle)
+
+        self._build_replica(i, bind_port=net_port)
+        for j in range(self.n):
+            if i != j:
+                self.nets[i].add_peer(
+                    self.ids[j], "127.0.0.1", self.nets[j].port
+                )
+        self.tasks[i] = asyncio.ensure_future(self.engines[i].run())
+        cfg = GatewayConfig(**{**gw_cfg.__dict__, "bind_port": gw_port})
+        self.gateways[i] = GatewayServer(
+            self.engines[i], config=cfg, node_id=gw_node
+        )
+        await self.gateways[i].start()
+        self._mesh_gateways()
+
+    async def wait_converged(self, timeout: float = 15.0) -> None:
+        """Block until every replica's per-shard store checksums agree."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            sums = [
+                tuple(
+                    self.machines[r][s].store.checksum()
+                    for s in range(self.n_shards)
+                )
+                for r in range(self.n)
+            ]
+            if all(s == sums[0] for s in sums[1:]):
+                return
+            await asyncio.sleep(0.05)
+        detail = "; ".join(
+            f"r{r}=" + ",".join(
+                f"s{s}:{self.machines[r][s].store.checksum() & 0xFFFF:04x}"
+                f"/v{self.machines[r][s].store.version}"
+                f"/n{len(self.machines[r][s].store)}"
+                for s in range(self.n_shards)
+            )
+            for r in range(self.n)
+        )
+        applied = "; ".join(
+            f"r{r}={self.engines[r].applied_frontier().tolist()}"
+            for r in range(self.n)
+        )
+        raise TimeoutError(
+            f"replica stores did not converge within {timeout}s "
+            f"({detail}) applied: {applied}"
+        )
+
+    async def stop(self) -> None:
+        for g in self.gateways:
+            if g is not None:
+                await g.close()
+        self.gateways = []
+        for e in self.engines:
+            if e is not None:
+                await e.shutdown()
+        for t in self.tasks:
+            t.cancel()
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+        self.tasks = []
+        for n in self.nets:
+            if n is not None:
+                await n.close()
+        self.nets = []
