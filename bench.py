@@ -317,7 +317,25 @@ def main(argv: list[str] | None = None) -> int:
         "instead of a single sample, so round-over-round comparisons "
         "stop riding run-to-run variance",
     )
+    ap.add_argument(
+        "--sweep",
+        nargs="*",
+        type=int,
+        metavar="CONFIG",
+        default=None,
+        help="instead of the headline kernel scenario, run the BASELINE "
+        "5-config engine sweep (optionally a subset, e.g. --sweep 3 4); "
+        "--repeats applies per config, reporting median ± IQR and "
+        "settle p50/p99 — one JSON line per config",
+    )
     args = ap.parse_args(argv)
+
+    if args.sweep is not None:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from benchmarks.baseline_sweep import run_sweep
+
+        run_sweep(args.sweep or None, repeats=args.repeats)
+        return 0
 
     if args.repeats <= 1:
         rc, out = _measure_once()

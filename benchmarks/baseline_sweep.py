@@ -45,12 +45,47 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import numpy as np
 
 
-def _emit(config: str, value: float, unit: str, baselines: dict, extra: dict) -> None:
+_LAST_TICK_PATH: str | None = None  # actual path of the last-built cluster
+
+
+def _note_tick_path(engines) -> None:
+    """Record what the cluster's engines ACTUALLY run (engine._rk is the
+    ground truth — a hostkernel build failure or a NativeTick
+    construction error falls back to the Python path silently)."""
+    global _LAST_TICK_PATH
+    _LAST_TICK_PATH = (
+        "native" if all(e._rk is not None for e in engines) else "python"
+    )
+
+
+def _tick_path() -> str:
+    """Best-effort label when no cluster was probed: library
+    availability + the env toggle (the same preconditions RabiaEngine
+    checks before attempting NativeTick construction)."""
+    if _LAST_TICK_PATH is not None:
+        return _LAST_TICK_PATH
+    import os
+
+    if os.environ.get("RABIA_PY_TICK") == "1":
+        return "python"
+    try:
+        from rabia_tpu.native.build import load_hostkernel
+
+        lib = load_hostkernel()
+        if lib is not None and hasattr(lib, "rk_ctx_create"):
+            return "native"
+    except Exception:
+        pass
+    return "python"
+
+
+def _emit(config: str, value: float, unit: str, baselines: dict, extra: dict) -> dict:
     doc = {
         "metric": "decisions_per_sec" if unit == "decisions/s" else unit,
         "config": config,
         "value": round(value, 1),
         "unit": unit,
+        "tick_path": _tick_path(),
         **extra,
     }
     if baselines.get("cpu_engine"):
@@ -61,6 +96,21 @@ def _emit(config: str, value: float, unit: str, baselines: dict, extra: dict) ->
         doc["vs_oracle"] = round(value / baselines["oracle"], 2)
         doc["baseline_oracle_per_sec"] = round(baselines["oracle"], 1)
     print(json.dumps(doc))
+    return doc
+
+
+def _lat_stats(lat_s: list) -> dict:
+    """{settle_p50_ms, settle_p99_ms, settle_samples} from wave-settle
+    latencies (seconds). Every config reports these now, not just #1
+    (VERDICT r05 directive 3)."""
+    if not lat_s:
+        return {"settle_p50_ms": None, "settle_p99_ms": None, "settle_samples": 0}
+    xs = sorted(lat_s)
+    return {
+        "settle_p50_ms": round(xs[len(xs) // 2] * 1000, 2),
+        "settle_p99_ms": round(xs[min(len(xs) - 1, int(len(xs) * 0.99))] * 1000, 2),
+        "settle_samples": len(xs),
+    }
 
 
 def cpu_oracle_baseline(replicas: int = 5, sample: int = 120) -> float:
@@ -113,6 +163,7 @@ async def _mk_mem_cluster(S, R, sm_factory, **cfg_kw):
         engines.append(
             RabiaEngine(ClusterConfig.new(n, nodes), sm, hub.register(n), config=_cfg(S, **cfg_kw))
         )
+    _note_tick_path(engines)
     tasks = [asyncio.ensure_future(e.run()) for e in engines]
     for _ in range(500):
         await asyncio.sleep(0.01)
@@ -140,10 +191,12 @@ async def _committed(engines):
     return sum(s.committed_slots for s in sts) / len(engines), sts
 
 
-async def _block_pump(engines, S, R, dur, shard_cmds, live=None):
+async def _block_pump(engines, S, R, dur, shard_cmds, live=None, lat=None):
     """Drive the block lane: per cycle, each live engine proposes blocks
     for the shards it owns at their head slots. ``shard_cmds(s) -> list of
-    command bytes`` for one slot of shard s. Returns commands acked."""
+    command bytes`` for one slot of shard s. Returns commands acked.
+    When ``lat`` (a list) is given, per-wave submit→settle latencies in
+    seconds are appended to it."""
     from rabia_tpu.core.blocks import build_block
     from rabia_tpu.engine.leader import slot_proposer_vec
 
@@ -157,6 +210,7 @@ async def _block_pump(engines, S, R, dur, shard_cmds, live=None):
         while time.perf_counter() < stop_at:
             futs = []
             sizes = []
+            t_sub = time.perf_counter()
             for e in live:
                 head = np.maximum(e.rt.next_slot[:S], e.rt.applied_upto[:S])
                 mine = shard_ids[
@@ -176,6 +230,8 @@ async def _block_pump(engines, S, R, dur, shard_cmds, live=None):
                 results = await asyncio.wait_for(
                     asyncio.gather(*futs), max(10.0, dur)
                 )
+                if lat is not None:
+                    lat.append(time.perf_counter() - t_sub)
                 for res in results:
                     counts = getattr(res, "group_counts", None)
                     if counts is not None:
@@ -272,23 +328,33 @@ async def config1_counter(baselines) -> None:
     )
     codec = counters[0]
     n_ops = 100
+    lat: list[float] = []
     t0 = time.perf_counter()
     for _ in range(n_ops):
+        t_sub = time.perf_counter()
         fut = await engines[0].submit_batch(
             CommandBatch.new(
                 [Command.new(codec.encode_command(CounterCommand.increment(1)))]
             )
         )
         await asyncio.wait_for(fut, 20.0)
+        lat.append(time.perf_counter() - t_sub)
     dt = time.perf_counter() - t0
     assert counters[0].value == n_ops
     await _stop(engines, tasks)
-    _emit(
+    stats = _lat_stats(lat)
+    return _emit(
         "1:counter_3rep_1shard_inmem",
         n_ops / dt,
         "decisions/s",
         baselines,
-        {"p50_latency_ms": round(dt / n_ops * 1000, 2), "mode": "engine", "store": "counter_smr"},
+        {
+            # real per-op percentiles now (was mean-as-p50)
+            "p50_latency_ms": stats["settle_p50_ms"],
+            "mode": "engine",
+            "store": "counter_smr",
+            **stats,
+        },
     )
 
 
@@ -301,18 +367,24 @@ async def config2_kvstore_64(baselines) -> None:
         S, R, lambda: make_sharded_kv(S)[0]
     )
     op = encode_set_bin("key", "value")
+    lat: list[float] = []
     t0 = time.perf_counter()
     base, _ = await _committed(engines)
-    await _block_pump(engines, S, R, 6.0, lambda s: [op])
+    await _block_pump(engines, S, R, 6.0, lambda s: [op], lat=lat)
     top, _ = await _committed(engines)
     dt = time.perf_counter() - t0
     await _stop(engines, tasks)
-    _emit(
+    return _emit(
         "2:kvstore_3rep_64shards_inmem",
         (top - base) / dt,
         "decisions/s",
         baselines,
-        {"mode": "engine", "store": "kvstore_smr", "lane": "block"},
+        {
+            "mode": "engine",
+            "store": "kvstore_smr",
+            "lane": "block",
+            **_lat_stats(lat),
+        },
     )
 
 
@@ -362,9 +434,10 @@ async def config3_kvstore_4096_batched(baselines) -> None:
     # (b) block lane, full width, one command per shard-slot (the
     # decisions/s headline), then a multi-command phase for commands/s
     one_op = [[encode_set_bin(f"k{s}", "v")] for s in range(S)]
+    lat: list[float] = []
     t0 = time.perf_counter()
     base, _ = await _committed(engines)
-    await _block_pump(engines, S, R, 8.0, lambda s: one_op[s])
+    await _block_pump(engines, S, R, 8.0, lambda s: one_op[s], lat=lat)
     top, _ = await _committed(engines)
     dt = time.perf_counter() - t0
     rate = (top - base) / dt
@@ -399,7 +472,7 @@ async def config3_kvstore_4096_batched(baselines) -> None:
         await _stop(engines_v, tasks_v)
     except Exception as e:
         print(f"config3 vector phase failed: {e!r}", file=sys.stderr)
-    _emit(
+    return _emit(
         "3:kvstore_5rep_4096shards_adaptive",
         rate,
         "decisions/s",
@@ -409,6 +482,7 @@ async def config3_kvstore_4096_batched(baselines) -> None:
             "store": "kvstore_smr",
             "lane": "block",
             "commands_per_slot": 1,
+            **_lat_stats(lat),
             "batched_phase": {
                 "commands_per_slot": 8,
                 "decisions_per_sec": round((top8 - base8) / dt8, 1),
@@ -494,14 +568,15 @@ async def config4_banking_crash(baselines) -> None:
             await asyncio.sleep(0.05)
 
     feeder = asyncio.ensure_future(dead_shard_feeder())
-    await _block_pump(live, S, R, post_dur, lambda s: [dep])
+    lat: list[float] = []
+    await _block_pump(live, S, R, post_dur, lambda s: [dep], lat=lat)
     feeder.cancel()
     await asyncio.gather(feeder, return_exceptions=True)
     post, _ = await _committed(live)
     dt = time.perf_counter() - t0
     post_rate = (post - crash_at) / post_dur
     await _stop(engines[3:], tasks)
-    _emit(
+    return _emit(
         "4:banking_7rep_1024shards_minority_crash",
         post_rate,
         "decisions/s",
@@ -513,6 +588,7 @@ async def config4_banking_crash(baselines) -> None:
             "crashed_replicas": 3,
             "crash_kind": "engine task cancelled + transport disconnected mid-run",
             "survivor_committed_slots": int(post),
+            **_lat_stats(lat),
         },
     )
 
@@ -546,6 +622,7 @@ async def config5_kvstore_tcp_zipf(baselines) -> None:
             )
         )
         tasks.append(asyncio.ensure_future(engines[-1].run()))
+    _note_tick_path(engines)
     for _ in range(500):
         await asyncio.sleep(0.01)
         sts = [await e.get_statistics() for e in engines]
@@ -566,14 +643,15 @@ async def config5_kvstore_tcp_zipf(baselines) -> None:
     def cmds(s: int) -> list[bytes]:
         return per_shard.get(s, default_op)[:32]
 
+    lat: list[float] = []
     t0 = time.perf_counter()
     base, _ = await _committed(engines)
-    acked = await _block_pump(engines, S, R, 8.0, cmds)
+    acked = await _block_pump(engines, S, R, 8.0, cmds, lat=lat)
     top, _ = await _committed(engines)
     dt = time.perf_counter() - t0
     rate = (top - base) / dt
     await _stop(engines, tasks, nets)
-    _emit(
+    return _emit(
         "5:kvstore_5rep_16384shards_tcp_zipf",
         rate,
         "decisions/s",
@@ -586,12 +664,52 @@ async def config5_kvstore_tcp_zipf(baselines) -> None:
             "zipf_s": 1.2,
             "commands_acked": int(acked),
             "commands_per_sec": round(acked / dt, 1),
+            **_lat_stats(lat),
         },
     )
 
 
-def main() -> int:
-    which = {int(a) for a in sys.argv[1:]} or {1, 2, 3, 4, 5}
+_CONFIG_FNS = {
+    1: lambda b: config1_counter(b),
+    2: lambda b: config2_kvstore_64(b),
+    3: lambda b: config3_kvstore_4096_batched(b),
+    4: lambda b: config4_banking_crash(b),
+    5: lambda b: config5_kvstore_tcp_zipf(b),
+}
+
+
+def _aggregate(samples: list[dict]) -> dict:
+    """Median ± IQR over repeated runs of ONE config (VERDICT r05
+    directive 5: no headline backed by a single sample)."""
+    import statistics
+
+    vals = sorted(s["value"] for s in samples)
+    agg = dict(samples[-1])
+    agg["repeats"] = len(samples)
+    agg["samples"] = [round(v, 1) for v in vals]
+    med = vals[len(vals) // 2]
+    if len(vals) >= 2:
+        q1, med, q3 = statistics.quantiles(vals, n=4, method="inclusive")
+        agg["iqr"] = [round(q1, 1), round(q3, 1)]
+    agg["value"] = round(med, 1)
+    if samples[-1].get("baseline_oracle_per_sec"):
+        agg["vs_oracle"] = round(med / samples[-1]["baseline_oracle_per_sec"], 2)
+    if samples[-1].get("baseline_cpu_engine_per_sec"):
+        agg["vs_baseline"] = round(
+            med / samples[-1]["baseline_cpu_engine_per_sec"], 2
+        )
+    for key in ("settle_p50_ms", "settle_p99_ms", "p50_latency_ms"):
+        xs = sorted(
+            s[key] for s in samples if s.get(key) is not None
+        )
+        if xs:
+            agg[key] = xs[len(xs) // 2]
+    return agg
+
+
+def run_sweep(which=None, repeats: int = 1) -> list[dict]:
+    """Run the 5-config sweep ``repeats`` times; returns one (aggregated)
+    doc per config. Shared by the CLI below and ``bench.py --sweep``."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -599,6 +717,7 @@ def main() -> int:
 
     logging.disable(logging.WARNING)
 
+    which = set(which or (1, 2, 3, 4, 5))
     baselines = {"oracle": cpu_oracle_baseline()}
     baselines["cpu_engine"] = asyncio.run(_cpu_engine_rate())
     print(
@@ -611,16 +730,36 @@ def main() -> int:
             }
         )
     )
-    if 1 in which:
-        asyncio.run(config1_counter(baselines))
-    if 2 in which:
-        asyncio.run(config2_kvstore_64(baselines))
-    if 3 in which:
-        asyncio.run(config3_kvstore_4096_batched(baselines))
-    if 4 in which:
-        asyncio.run(config4_banking_crash(baselines))
-    if 5 in which:
-        asyncio.run(config5_kvstore_tcp_zipf(baselines))
+    per_config: dict[int, list[dict]] = {c: [] for c in sorted(which)}
+    for r in range(max(1, repeats)):
+        if repeats > 1:
+            print(f"sweep: repeat {r + 1}/{repeats}", file=sys.stderr)
+        for c in sorted(which):
+            per_config[c].append(asyncio.run(_CONFIG_FNS[c](baselines)))
+    out = []
+    for c in sorted(which):
+        doc = (
+            _aggregate(per_config[c])
+            if len(per_config[c]) > 1
+            else per_config[c][0]
+        )
+        if len(per_config[c]) > 1:
+            print(json.dumps(doc))  # the aggregated line (repeats mode)
+        out.append(doc)
+    return out
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="BASELINE 5-config engine sweep")
+    ap.add_argument("configs", nargs="*", type=int, help="subset (1-5)")
+    ap.add_argument(
+        "--repeats", type=int, default=1, metavar="N",
+        help="run the sweep N times and report median ± IQR per config",
+    )
+    args = ap.parse_args()
+    run_sweep(args.configs or None, repeats=args.repeats)
     return 0
 
 

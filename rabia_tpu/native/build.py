@@ -201,6 +201,32 @@ def load_hostkernel() -> ctypes.CDLL | None:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             p, p,
         ]
+        lib.rk_stall_scan.restype = ctypes.c_int32
+        lib.rk_stall_scan.argtypes = [
+            ctypes.c_int32, p, p, ctypes.c_double, ctypes.c_double,
+        ]
+        # native per-tick fast path (the rk tick context)
+        lib.rk_ctx_create.restype = ctypes.c_void_p
+        lib.rk_ctx_create.argtypes = [p, p, p, p]
+        lib.rk_ctx_destroy.restype = None
+        lib.rk_ctx_destroy.argtypes = [p]
+        lib.rk_rows_seen.restype = ctypes.c_uint64
+        lib.rk_rows_seen.argtypes = [p]
+        lib.rk_dropped.restype = ctypes.c_uint64
+        lib.rk_dropped.argtypes = [p]
+        lib.rk_carry_count.restype = ctypes.c_int64
+        lib.rk_carry_count.argtypes = [p]
+        lib.rk_drain_stale.restype = ctypes.c_int64
+        lib.rk_drain_stale.argtypes = [p, p, p, p, ctypes.c_int64]
+        lib.rk_ingest.restype = ctypes.c_int32
+        lib.rk_ingest.argtypes = [
+            p, p, ctypes.c_int64, ctypes.c_int32, ctypes.c_double,
+        ]
+        lib.rk_tick.restype = None
+        lib.rk_tick.argtypes = [
+            p, ctypes.c_double, p, ctypes.c_int64, ctypes.c_int32,
+            p, p, p, p,
+        ]
         _HK_CACHED = lib
         return lib
 
@@ -234,11 +260,12 @@ def load_library() -> ctypes.CDLL:
             # the newest exported symbol so a stale .so fails fast with a
             # clear message instead of a cryptic AttributeError later
             try:
-                lib.rt_recv_borrow
+                lib.rt_broadcast_frames
             except AttributeError:
                 raise InternalError(
                     f"RABIA_NATIVE_LIB library {prebuilt} is stale "
-                    "(missing rt_recv_borrow); rebuild it from transport.cpp"
+                    "(missing rt_broadcast_frames); rebuild it from "
+                    "transport.cpp"
                 ) from None
 
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -270,6 +297,14 @@ def load_library() -> ctypes.CDLL:
             ctypes.c_void_p,
             ctypes.c_char_p,
             ctypes.c_uint32,
+        ]
+        # batch-staged broadcast of the native tick's outbound buffer
+        # ([u32 record_len][frame]... records, one lock + one kick)
+        lib.rt_broadcast_frames.restype = ctypes.c_int
+        lib.rt_broadcast_frames.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
         ]
         lib.rt_recv.restype = ctypes.c_int
         lib.rt_recv.argtypes = [

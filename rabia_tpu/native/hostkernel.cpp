@@ -27,6 +27,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 extern "C" {
 
@@ -234,6 +235,557 @@ int32_t rk_open_scan(
     n += c;
   }
   return n;
+}
+
+// Timeout pre-scan: "is any in-flight shard stalled past `timeout`?" in
+// one C call — the engine's per-tick retransmit check early-outs on this
+// instead of ~5 numpy dispatches (which dominate the serial shape).
+int32_t rk_stall_scan(int32_t S, const uint8_t* in_flight,
+                      const double* last_progress, double now,
+                      double timeout) {
+  for (int32_t s = 0; s < S; s++) {
+    if (in_flight[s] && now - last_progress[s] >= timeout) return 1;
+  }
+  return 0;
+}
+
+// ===========================================================================
+// Native per-tick fast path (the "rk tick context").
+//
+// The engine's per-round ingest→route→tally→outbox path, with Python
+// touched only for EVENTS (decisions ready to record/apply, sync,
+// membership, timeouts). Semantics owner: the Python paths in
+// engine/engine.py (`_ingest_vote_arrays`/`_route_votes`/`_kernel_round`/
+// `_process_outbox`) — every transition here mirrors them element-for-
+// element; conformance is pinned by tests/test_native_tick.py and the
+// seeded fuzz schedules run under RABIA_PY_TICK=1 vs the default.
+//
+// What runs here:
+//  - rk_ingest: decode VoteRound1/VoteRound2/Decision wire frames
+//    (byte layout of core/serialization.py v3) straight out of the
+//    transport arena (or any bytes buffer) — no Python objects; perform
+//    the stale-drop / taint-mark / votes-seen side effects; scatter
+//    (slot, phase)-matched votes into the kernel ledger; carry future
+//    votes; buffer stale ones for the Python repair path.
+//  - rk_tick: chained route→node_step→outbox rounds (R1→R2→decide with
+//    no Python in between when input allows), framing outbound vote /
+//    decision messages directly into a caller-provided buffer in the
+//    exact wire format peers decode.
+//
+// Everything the context touches is borrowed, caller-owned numpy memory
+// registered once at creation — the engine guarantees those arrays stay
+// alive and in place for the context's lifetime.
+// ===========================================================================
+
+enum : int32_t {
+  RK_HANDLED = 1,       // consumed natively, with ledger/plane effects
+  RK_NOOP = 2,          // consumed natively, NO effects (all entries
+                        // stale/dropped) — the engine may skip the kernel
+                        // round it would otherwise run for this traffic
+  RK_PY = 0,            // not a fast-path frame: Python must handle it
+  RK_DROP = -1,         // malformed / spoofed / validation-failed: drop
+};
+
+struct RkCarry {
+  int32_t row;
+  int32_t shard;
+  int64_t slot;
+  int32_t mvc;
+  int8_t val;
+};
+
+struct RkStale {
+  int32_t row;
+  int32_t shard;
+  int64_t slot;
+};
+
+struct RkCtx {
+  // geometry / protocol constants
+  int32_t S, n, R, me, quorum, f1;
+  uint32_t seed, coin_threshold;
+  int32_t dec_ring;           // ring depth (power of two)
+  int32_t decision_broadcast; // emit Decision frames for newly decided
+  double max_future_skew, max_age;
+
+  // engine runtime columns (borrowed)
+  int64_t* next_slot;
+  int64_t* applied;
+  uint8_t* in_flight;
+  int64_t* votes_seen;
+  int64_t* tainted;
+  double* taint_traffic;
+  double* last_progress;
+  int64_t* ring_slot;  // [S, dec_ring]
+  int8_t* ring_val;    // [S, dec_ring]
+
+  // kernel state (borrowed, persistent — mutated in place)
+  int32_t* slot;
+  int32_t* phase;
+  int8_t* stage;
+  int8_t* my_r1;
+  int8_t* my_r2;
+  int8_t* led1;  // [R, S]
+  int8_t* led2;  // [R, S]
+  int8_t* decided;
+  uint8_t* done;
+  uint8_t* active;
+  int8_t* dec_plane;   // adopted-decision inbox [S]
+  uint8_t* newly_acc;  // newly-decided accumulator [S] (engine reads+clears)
+
+  // identity: row -> 16B node uuid (spoof check + outbound sender field)
+  std::vector<uint8_t> uuids;  // R * 16
+  uint64_t rows_seen;
+
+  // carried future-(slot, phase) votes, bounded like the Python carry
+  std::vector<RkCarry> carry1, carry2;
+  // stale-vote reports for the Python repair path (rate-limited there)
+  std::vector<RkStale> stale;
+  uint64_t dropped;  // frames rejected with RK_DROP (engine stats)
+
+  // node_step outbox scratch
+  std::vector<uint8_t> cast_r2, advanced, newly_step;
+  std::vector<int8_t> r2_vals;
+  std::vector<int32_t> idx_scratch;
+
+  uint64_t msg_counter;
+};
+
+static const size_t RK_STALE_CAP = 1024;
+
+static inline uint32_t rd_u32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+static inline uint64_t rd_u64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+static inline double rd_f64(const uint8_t* p) {
+  double v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+// --- context lifecycle ------------------------------------------------------
+
+// dims: [S, n, R, me, quorum, f1, seed, coin_threshold, dec_ring,
+//        decision_broadcast]
+// ptrs: [next_slot, applied, in_flight, votes_seen, tainted, taint_traffic,
+//        last_progress, ring_slot, ring_val,
+//        slot, phase, stage, my_r1, my_r2, led1, led2, decided, done,
+//        active, dec_plane, newly_acc]
+// uuids: R * 16 bytes (row-major node ids)
+// fparams: [max_future_skew, max_age]
+void* rk_ctx_create(const int64_t* dims, const int64_t* ptrs,
+                    const uint8_t* uuids, const double* fparams) {
+  RkCtx* c = new RkCtx();
+  c->S = (int32_t)dims[0];
+  c->n = (int32_t)dims[1];
+  c->R = (int32_t)dims[2];
+  c->me = (int32_t)dims[3];
+  c->quorum = (int32_t)dims[4];
+  c->f1 = (int32_t)dims[5];
+  c->seed = (uint32_t)dims[6];
+  c->coin_threshold = (uint32_t)dims[7];
+  c->dec_ring = (int32_t)dims[8];
+  c->decision_broadcast = (int32_t)dims[9];
+  int i = 0;
+  c->next_slot = (int64_t*)ptrs[i++];
+  c->applied = (int64_t*)ptrs[i++];
+  c->in_flight = (uint8_t*)ptrs[i++];
+  c->votes_seen = (int64_t*)ptrs[i++];
+  c->tainted = (int64_t*)ptrs[i++];
+  c->taint_traffic = (double*)ptrs[i++];
+  c->last_progress = (double*)ptrs[i++];
+  c->ring_slot = (int64_t*)ptrs[i++];
+  c->ring_val = (int8_t*)ptrs[i++];
+  c->slot = (int32_t*)ptrs[i++];
+  c->phase = (int32_t*)ptrs[i++];
+  c->stage = (int8_t*)ptrs[i++];
+  c->my_r1 = (int8_t*)ptrs[i++];
+  c->my_r2 = (int8_t*)ptrs[i++];
+  c->led1 = (int8_t*)ptrs[i++];
+  c->led2 = (int8_t*)ptrs[i++];
+  c->decided = (int8_t*)ptrs[i++];
+  c->done = (uint8_t*)ptrs[i++];
+  c->active = (uint8_t*)ptrs[i++];
+  c->dec_plane = (int8_t*)ptrs[i++];
+  c->newly_acc = (uint8_t*)ptrs[i++];
+  c->uuids.assign(uuids, uuids + (size_t)c->R * 16);
+  c->rows_seen = 0;
+  c->dropped = 0;
+  c->msg_counter = 0;
+  c->max_future_skew = fparams[0];
+  c->max_age = fparams[1];
+  c->cast_r2.resize(c->S);
+  c->advanced.resize(c->S);
+  c->newly_step.resize(c->S);
+  c->r2_vals.resize(c->S);
+  c->idx_scratch.resize(c->S);
+  return c;
+}
+
+void rk_ctx_destroy(void* ctx) { delete (RkCtx*)ctx; }
+
+uint64_t rk_rows_seen(void* ctx) {
+  RkCtx* c = (RkCtx*)ctx;
+  uint64_t m = c->rows_seen;
+  c->rows_seen = 0;
+  return m;
+}
+
+uint64_t rk_dropped(void* ctx) { return ((RkCtx*)ctx)->dropped; }
+
+int64_t rk_carry_count(void* ctx) {
+  RkCtx* c = (RkCtx*)ctx;
+  return (int64_t)(c->carry1.size() + c->carry2.size());
+}
+
+// Pop up to `cap` buffered stale-vote reports (row, shard, slot) for the
+// Python repair path. Returns the count written.
+int64_t rk_drain_stale(void* ctx, int64_t* rows, int64_t* shards,
+                       int64_t* slots, int64_t cap) {
+  RkCtx* c = (RkCtx*)ctx;
+  int64_t k = 0;
+  for (const RkStale& st : c->stale) {
+    if (k >= cap) break;
+    rows[k] = st.row;
+    shards[k] = st.shard;
+    slots[k] = st.slot;
+    k++;
+  }
+  c->stale.clear();
+  return k;
+}
+
+// --- frame ingest -----------------------------------------------------------
+
+// Wire layout (core/serialization.py, version 3):
+//   u8 version | u8 msg_type | u8 flags | 16B id | 16B sender |
+//   [16B recipient] | f64 timestamp | u32 body_len | body
+// Vote body:     u32 count + count * 13B (u32 shard | u64 phase | u8 vote)
+// Decision body: u32 count + count * 14B (u32 shard | u64 phase | u8 val |
+//                u8 has_bid) + 16B per has_bid entry
+enum : uint8_t {
+  MT_VOTE1 = 2,
+  MT_VOTE2 = 3,
+  MT_DECISION = 4,
+  FLAG_COMPRESSED = 0x01,
+  FLAG_RECIPIENT = 0x02,
+};
+
+static inline bool rk_route_one(RkCtx* c, int32_t round_no, int32_t row,
+                                int32_t s, int64_t slot, int32_t mvc,
+                                int8_t val, std::vector<RkCarry>& carry) {
+  if (c->in_flight[s] && slot == (int64_t)c->slot[s] &&
+      mvc == c->phase[s]) {
+    int8_t* led = (round_no == 1 ? c->led1 : c->led2);
+    int8_t& cell = led[(int64_t)row * c->S + s];
+    if (cell == ABS) {
+      cell = val;
+      return true;
+    }
+    return false;  // first-write-wins duplicate: nothing changed
+  }
+  carry.push_back(RkCarry{row, s, slot, mvc, val});
+  return true;
+}
+
+int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
+                  double now) {
+  RkCtx* c = (RkCtx*)ctx;
+  if (len < 47) return RK_PY;  // not even a recipient-less header
+  const uint8_t version = data[0];
+  const uint8_t msg_type = data[1];
+  const uint8_t flags = data[2];
+  if (version != 3) return RK_PY;
+  if (msg_type != MT_VOTE1 && msg_type != MT_VOTE2 &&
+      msg_type != MT_DECISION)
+    return RK_PY;
+  if (flags & FLAG_COMPRESSED) return RK_PY;  // votes are never compressed
+  if (row < 0 || row >= c->R) return RK_PY;
+  // envelope sender must match the transport-authenticated peer row
+  // (engine._handle_message spoof guard)
+  if (std::memcmp(data + 19, c->uuids.data() + (size_t)row * 16, 16) != 0) {
+    c->dropped++;
+    return RK_DROP;
+  }
+  int64_t base = 35 + ((flags & FLAG_RECIPIENT) ? 16 : 0);
+  if (len < base + 12) return RK_PY;
+  const double ts = rd_f64(data + base);
+  if (ts > now + c->max_future_skew || ts < now - c->max_age) {
+    c->dropped++;  // clock-skew rejection (MessageValidator parity)
+    return RK_DROP;
+  }
+  const uint32_t body_len = rd_u32(data + base + 8);
+  const uint8_t* body = data + base + 12;
+  if ((int64_t)body_len > len - (base + 12) || body_len < 4) return RK_PY;
+  const uint32_t count = rd_u32(body);
+  const uint8_t* ent = body + 4;
+
+  if (msg_type == MT_DECISION) {
+    if (body_len < 4 + (uint64_t)count * 14) return RK_PY;
+    // pass 1: classify without side effects — any entry the Python path
+    // must see (bid-bearing, out-of-range, live-but-not-current and not
+    // in the decided ring) bails the WHOLE frame out untouched
+    for (uint32_t k = 0; k < count; k++) {
+      const uint8_t* e = ent + (size_t)k * 14;
+      const uint32_t s = rd_u32(e);
+      const uint64_t ph = rd_u64(e + 4);
+      const uint8_t val = e[12];
+      if (e[13]) return RK_PY;       // has_bid: recovery path
+      if (val == VQ || val > 3) {
+        // "decision cannot be V?" (validator) / code out of range
+        // (codec parity) — adopting a garbage code would later blow up
+        // StateValue() on the Python event path
+        c->dropped++;
+        return RK_DROP;
+      }
+      if (s >= (uint32_t)c->n) return RK_PY;
+      const int64_t slot = (int64_t)(ph >> 16);
+      if (slot < c->applied[s]) continue;  // stale: dropped in pass 2
+      if (c->in_flight[s] && slot == (int64_t)c->slot[s]) continue;
+      const int64_t ring = slot & (c->dec_ring - 1);
+      if (c->ring_slot[(int64_t)s * c->dec_ring + ring] == slot)
+        continue;  // already decided locally: recording again is a no-op
+      return RK_PY;  // gap/future decision: Python ledger logic owns it
+    }
+    bool dec_effect = false;
+    for (uint32_t k = 0; k < count; k++) {
+      const uint8_t* e = ent + (size_t)k * 14;
+      const uint32_t s = rd_u32(e);
+      const uint64_t ph = rd_u64(e + 4);
+      const int64_t slot = (int64_t)(ph >> 16);
+      if (s >= (uint32_t)c->n || slot < c->applied[s]) continue;
+      if (c->in_flight[s] && slot == (int64_t)c->slot[s]) {
+        c->dec_plane[s] = (int8_t)e[12];
+        dec_effect = true;
+      }
+    }
+    c->rows_seen |= 1ull << (row & 63);
+    return dec_effect ? RK_HANDLED : RK_NOOP;
+  }
+
+  // vote vector (R1/R2)
+  if (count == 0) {
+    c->dropped++;  // "vote vector must be non-empty" (validator)
+    return RK_DROP;
+  }
+  if (body_len < 4 + (uint64_t)count * 13) return RK_PY;
+  // codec parity: reject out-of-range vote codes before any side effect
+  for (uint32_t k = 0; k < count; k++) {
+    if (ent[(size_t)k * 13 + 12] > 3) {
+      c->dropped++;
+      return RK_DROP;
+    }
+  }
+  const int32_t round_no = (msg_type == MT_VOTE1) ? 1 : 2;
+  std::vector<RkCarry>& carry = (round_no == 1) ? c->carry1 : c->carry2;
+  bool effect = false;
+  for (uint32_t k = 0; k < count; k++) {
+    const uint8_t* e = ent + (size_t)k * 13;
+    const uint32_t s = rd_u32(e);
+    if (s >= (uint32_t)c->n) continue;  // bounds filter (ingest parity)
+    const uint64_t ph = rd_u64(e + 4);
+    const int64_t slot = (int64_t)(ph >> 16);
+    const int32_t mvc = (int32_t)(ph & 0xFFFF);
+    const int8_t val = (int8_t)e[12];
+    if (slot < c->applied[s]) {
+      if (c->stale.size() < RK_STALE_CAP)
+        c->stale.push_back(RkStale{row, (int32_t)s, slot});
+      continue;
+    }
+    if (slot < c->tainted[s]) {
+      c->taint_traffic[s] = now;
+      effect = true;
+    }
+    if (slot > c->votes_seen[s]) {
+      c->votes_seen[s] = slot;
+      effect = true;
+    }
+    effect |= rk_route_one(c, round_no, row, (int32_t)s, slot, mvc, val,
+                           carry);
+  }
+  // bound the carry exactly like _route_votes: genuinely unreachable
+  // future votes must not accumulate without limit
+  const size_t cap = (size_t)8 * c->S * c->R;
+  if (carry.size() > cap)
+    carry.erase(carry.begin(), carry.begin() + (carry.size() - cap));
+  c->rows_seen |= 1ull << (row & 63);
+  return effect ? RK_HANDLED : RK_NOOP;
+}
+
+// --- outbound framing -------------------------------------------------------
+
+static void rk_msg_id(RkCtx* c, uint8_t* out) {
+  // deterministic-unique 16 bytes: lowbias32 stream over (seed, me,
+  // counter). Receivers treat message ids as opaque.
+  const uint64_t ctr = ++c->msg_counter;
+  uint32_t h = mix32(c->seed ^ GOLD ^ (uint32_t)(c->me * 0x85EBCA6Bu));
+  for (int w = 0; w < 4; w++) {
+    h = mix32(h ^ (uint32_t)(ctr >> (16 * (w & 1))) ^ GOLD * (w + 1));
+    std::memcpy(out + 4 * w, &h, 4);
+  }
+  out[6] = (out[6] & 0x0F) | 0x40;  // uuid4 version/variant cosmetics
+  out[8] = (out[8] & 0x3F) | 0x80;
+}
+
+struct RkFrameWriter {
+  uint8_t* out;
+  int64_t cap;
+  int64_t pos;
+  int32_t frames;
+  int32_t overflow;
+};
+
+// One broadcast frame: [u32 record_len][frame bytes] with the frame in the
+// exact v3 wire layout. entry_sz is 13 (votes) or 14 (decisions).
+static void rk_emit_frame(RkCtx* c, RkFrameWriter* w, uint8_t msg_type,
+                          double now, const int32_t* idx, int32_t count,
+                          int32_t entry_sz, const int8_t* vals,
+                          int32_t phase_mode) {
+  const int64_t frame_len = 47 + 4 + (int64_t)count * entry_sz;
+  if (w->pos + 4 + frame_len > w->cap) {
+    w->overflow = 1;
+    return;
+  }
+  uint8_t* p = w->out + w->pos;
+  const uint32_t rec = (uint32_t)frame_len;
+  std::memcpy(p, &rec, 4);
+  p += 4;
+  p[0] = 3;  // version
+  p[1] = msg_type;
+  p[2] = 0;  // flags: uncompressed broadcast
+  rk_msg_id(c, p + 3);
+  std::memcpy(p + 19, c->uuids.data() + (size_t)c->me * 16, 16);
+  std::memcpy(p + 35, &now, 8);
+  const uint32_t body_len = 4 + (uint32_t)count * entry_sz;
+  std::memcpy(p + 43, &body_len, 4);
+  uint8_t* body = p + 47;
+  const uint32_t cnt = (uint32_t)count;
+  std::memcpy(body, &cnt, 4);
+  uint8_t* e = body + 4;
+  for (int32_t k = 0; k < count; k++) {
+    const int32_t s = idx[k];
+    const uint32_t su = (uint32_t)s;
+    // phase_mode 0: (slot<<16) | phase[s]  (vote frames)
+    //            1: (slot<<16)             (decision frames)
+    uint64_t ph = ((uint64_t)(int64_t)c->slot[s]) << 16;
+    if (phase_mode == 0) ph |= (uint64_t)(uint32_t)c->phase[s] & 0xFFFF;
+    std::memcpy(e, &su, 4);
+    std::memcpy(e + 4, &ph, 8);
+    e[12] = (uint8_t)vals[s];
+    if (entry_sz == 14) e[13] = 0;  // has_bid=0 (steady-state decisions)
+    e += entry_sz;
+  }
+  w->pos += 4 + frame_len;
+  w->frames++;
+}
+
+// --- the chained tick -------------------------------------------------------
+
+static void rk_route_carry(RkCtx* c, int32_t round_no) {
+  std::vector<RkCarry>& carry = (round_no == 1) ? c->carry1 : c->carry2;
+  if (carry.empty()) return;
+  size_t w = 0;
+  for (size_t i = 0; i < carry.size(); i++) {
+    const RkCarry& e = carry[i];
+    if (e.slot < c->applied[e.shard]) continue;  // stale: decided+applied
+    if (c->in_flight[e.shard] && e.slot == (int64_t)c->slot[e.shard] &&
+        e.mvc == c->phase[e.shard]) {
+      int8_t* led = (round_no == 1 ? c->led1 : c->led2);
+      int8_t& cell = led[(int64_t)e.row * c->S + e.shard];
+      if (cell == ABS) cell = e.val;
+    } else {
+      carry[w++] = e;  // keep for a later tick
+    }
+  }
+  carry.resize(w);
+}
+
+// res: [out_bytes, done_any, restep, frames, overflow]
+// open_mask/open_slots/open_init (nullable): shards opening a new decision
+// slot this tick — armed in place (rk_start_slots) and announced with one
+// VoteRound1 frame BEFORE the chained rounds, exactly like the Python
+// path's start_slots + open broadcast.
+void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
+             int32_t max_iters, const uint8_t* open_mask,
+             const int32_t* open_slots, const int8_t* open_init,
+             int64_t* res) {
+  RkCtx* c = (RkCtx*)ctx;
+  RkFrameWriter w{out, out_cap, 0, 0, 0};
+  int32_t restep = 0;
+  if (open_mask) {
+    rk_start_slots(c->S, c->R, c->me, open_mask, open_slots, open_init,
+                   c->slot, c->phase, c->stage, c->my_r1, c->my_r2, c->led1,
+                   c->led2, c->decided, c->done, c->active);
+    int32_t n_open = 0;
+    int32_t* idx = c->idx_scratch.data();
+    for (int32_t s = 0; s < c->n; s++) {
+      if (open_mask[s]) idx[n_open++] = s;
+    }
+    if (n_open)
+      rk_emit_frame(c, &w, MT_VOTE1, now, idx, n_open, 13, c->my_r1, 0);
+  }
+  for (int32_t it = 0; it < max_iters; it++) {
+    rk_route_carry(c, 1);
+    rk_route_carry(c, 2);
+    rk_node_step(c->S, c->R, c->me, c->quorum, c->f1, c->seed,
+                 c->coin_threshold, c->slot, c->phase, c->stage, c->my_r1,
+                 c->my_r2, c->led1, c->led2, c->decided, c->done, c->active,
+                 c->dec_plane, c->cast_r2.data(), c->r2_vals.data(),
+                 c->advanced.data(), c->newly_step.data());
+    std::memset(c->dec_plane, ABS, c->S);
+    // outbox: per-iteration frames, masked by the engine's in-flight set
+    // (engine._process_outbox parity)
+    int32_t n_cast = 0, n_adv = 0, n_new = 0;
+    int32_t* idx = c->idx_scratch.data();
+    for (int32_t s = 0; s < c->n; s++) {
+      if (!c->in_flight[s]) continue;
+      if (c->cast_r2[s]) idx[n_cast++] = s;
+    }
+    if (n_cast) {
+      rk_emit_frame(c, &w, MT_VOTE2, now, idx, n_cast, 13,
+                    c->r2_vals.data(), 0);
+      for (int32_t k = 0; k < n_cast; k++) c->last_progress[idx[k]] = now;
+    }
+    for (int32_t s = 0; s < c->n; s++) {
+      if (!c->in_flight[s]) continue;
+      if (c->advanced[s] && !c->done[s]) idx[n_adv++] = s;
+    }
+    if (n_adv) {
+      rk_emit_frame(c, &w, MT_VOTE1, now, idx, n_adv, 13, c->my_r1, 0);
+      for (int32_t k = 0; k < n_adv; k++) c->last_progress[idx[k]] = now;
+    }
+    int32_t any_adv = 0;
+    for (int32_t s = 0; s < c->n; s++) {
+      if (!c->in_flight[s]) continue;
+      if (c->advanced[s]) any_adv = 1;
+      if (c->newly_step[s]) {
+        c->newly_acc[s] = 1;
+        idx[n_new++] = s;
+      }
+    }
+    if (n_new && c->decision_broadcast)
+      rk_emit_frame(c, &w, MT_DECISION, now, idx, n_new, 14, c->decided, 1);
+    restep = (n_cast || any_adv) ? 1 : 0;
+    if (!restep) break;
+  }
+  int64_t done_any = 0;
+  for (int32_t s = 0; s < c->n; s++) {
+    if (c->done[s] && c->in_flight[s]) {
+      done_any = 1;
+      break;
+    }
+  }
+  res[0] = w.pos;
+  res[1] = done_any;
+  res[2] = restep;
+  res[3] = w.frames;
+  res[4] = w.overflow;
 }
 
 }  // extern "C"

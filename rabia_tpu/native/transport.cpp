@@ -724,6 +724,36 @@ int rt_broadcast(void* h, const uint8_t* data, uint32_t len) {
   return 0;
 }
 
+// Broadcast a batch of frames packed as [u32 record_len][frame bytes]...
+// (the native tick's outbound buffer, hostkernel.cpp rk_tick). All frames
+// are staged under ONE outbound lock acquisition and one io-loop kick, so
+// a chained tick's R1+R2+Decision wave costs a single Python->C call and
+// a single wakeup. Returns the number of frames staged, or -2 if any
+// record is malformed / exceeds the frame cap (staging stops there).
+int rt_broadcast_frames(void* h, const uint8_t* buf, int64_t len) {
+  auto* t = static_cast<Transport*>(h);
+  // frame first (make_frame takes mu_out itself for the pool), then stage
+  // the whole batch under one lock acquisition
+  std::vector<Transport::OutMsg> staged;
+  int64_t pos = 0;
+  while (pos + 4 <= len) {
+    uint32_t rec;
+    memcpy(&rec, buf + pos, 4);
+    if (rec > kMaxFrame || pos + 4 + (int64_t)rec > len) return -2;
+    staged.push_back({t->make_frame(buf + pos + 4, rec), true,
+                      NodeIdBytes{}});
+    pos += 4 + (int64_t)rec;
+  }
+  if (staged.empty()) return 0;
+  const int n = (int)staged.size();
+  {
+    std::lock_guard<std::mutex> lo(t->mu_out);
+    for (auto& m : staged) t->outq.push_back(std::move(m));
+  }
+  t->kick();
+  return n;
+}
+
 // Blocks up to timeout_ms for one inbound frame. Returns the frame length
 // >= 0 (copied into buf, truncated to buf_cap; 0 is a valid empty frame),
 // -3 on timeout with no message, -1 if closed.

@@ -19,10 +19,21 @@ int rt_remove_peer(void* h, const uint8_t id[16]);
 int rt_send(void* h, const uint8_t id[16], const uint8_t* data, uint32_t len);
 // Returns the number of peers reached.
 int rt_broadcast(void* h, const uint8_t* data, uint32_t len);
+// Broadcast a batch of [u32 record_len][frame] records (the native tick's
+// outbound buffer) under one staging lock + one io kick. Returns the
+// number of frames staged, -2 on a malformed/oversized record.
+int rt_broadcast_frames(void* h, const uint8_t* buf, int64_t len);
 // Blocks up to timeout_ms; >=0 frame length (truncated to buf_cap),
 // -3 timeout, -1 closed.
 int rt_recv(void* h, uint8_t sender_out[16], uint8_t* buf, uint32_t buf_cap,
             int timeout_ms);
+// Zero-copy receive: borrow the next inbound frame straight from the
+// arena. Returns a token >= 0 (frame at *data_out/*len_out until
+// rt_recv_release), -3 timeout, -1 closed.
+int64_t rt_recv_borrow(void* h, uint8_t sender_out[16],
+                       const uint8_t** data_out, uint32_t* len_out,
+                       int timeout_ms);
+void rt_recv_release(void* h, int64_t token);
 // Writes up to cap established peer ids (16B each); returns the count.
 int rt_connected(void* h, uint8_t* ids_out, int cap);
 uint16_t rt_port(void* h);

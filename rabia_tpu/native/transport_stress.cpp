@@ -45,9 +45,19 @@ int main() {
   std::thread sender_a([&] {
     uint8_t msg[512];
     std::memset(msg, 0x5A, sizeof(msg));
+    // a batch of 3 length-prefixed frames, as the native tick's rk_tick
+    // emits them (rt_broadcast_frames staging path)
+    uint8_t batch[3 * (4 + 96)];
+    for (int f = 0; f < 3; f++) {
+      uint8_t* rec = batch + f * (4 + 96);
+      uint32_t len = 96;
+      std::memcpy(rec, &len, 4);
+      std::memset(rec + 4, 0x30 + f, 96);
+    }
     while (!stop.load()) {
       rt_send(a, id_b, msg, sizeof(msg));
       rt_broadcast(a, msg, 64);
+      rt_broadcast_frames(a, batch, sizeof(batch));
     }
   });
   std::thread sender_b([&] {
@@ -56,12 +66,23 @@ int main() {
     while (!stop.load()) rt_broadcast(b, msg, sizeof(msg));
   });
   std::thread receiver_a([&] {
+    // zero-copy drain: borrow straight from the frame arena, touch the
+    // bytes (TSan-visible read of io-thread-written memory), release
     uint8_t sender[16];
-    std::vector<uint8_t> buf(1 << 16);
+    const uint8_t* ptr = nullptr;
+    uint32_t len = 0;
+    volatile uint8_t sink = 0;
     while (!stop.load()) {
-      int n = rt_recv(a, sender, buf.data(), buf.size(), 20);
-      if (n >= 0) received.fetch_add(1);
+      int64_t tok = rt_recv_borrow(a, sender, &ptr, &len, 20);
+      if (tok >= 0) {
+        if (len > 0) sink ^= ptr[len - 1];
+        rt_recv_release(a, tok);
+        received.fetch_add(1);
+      } else if (tok == -1) {
+        break;  // closing
+      }
     }
+    (void)sink;
   });
   std::thread receiver_b([&] {
     uint8_t sender[16];
@@ -73,11 +94,21 @@ int main() {
   });
   std::thread meddler([&] {
     uint8_t ids[16 * 8];
+    int cycles = 0;
     while (!stop.load()) {
       rt_connected(a, ids, 8);
       uint64_t h = 0, m = 0;
       rt_pool_stats(b, &h, &m);
       rt_dropped(a);
+      if (++cycles % 40 == 0) {
+        // concurrent redial churn under load: drop and re-add the peer
+        // while senders stage into the out pool and the borrow drain
+        // holds arena frames (the arena-decode/out_pool interplay the
+        // native tick leans on)
+        rt_remove_peer(a, id_b);
+        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+        rt_add_peer(a, id_b, "127.0.0.1", pb);
+      }
       std::this_thread::sleep_for(std::chrono::milliseconds(5));
     }
   });

@@ -43,12 +43,16 @@ class InMemoryHub:
         self._queues: dict[NodeId, asyncio.Queue[tuple[NodeId, bytes]]] = {}
         self._disconnected: set[NodeId] = set()
         self._notify: dict[NodeId, object] = {}  # node -> zero-arg callable
+        # membership epoch: bumped on register/connect/notify changes so
+        # per-sender broadcast fan-out caches can invalidate
+        self._epoch = 0
         self.stats = HubStats()
 
     def register(self, node: NodeId) -> "InMemoryNetwork":
         if node in self._queues:
             raise NetworkError(f"node {node} already registered")
         self._queues[node] = asyncio.Queue()
+        self._epoch += 1
         return InMemoryNetwork(node, self)
 
     def nodes(self) -> set[NodeId]:
@@ -59,6 +63,7 @@ class InMemoryHub:
             self._disconnected.discard(node)
         else:
             self._disconnected.add(node)
+        self._epoch += 1
 
     def is_connected(self, node: NodeId) -> bool:
         return node in self._queues and node not in self._disconnected
@@ -83,6 +88,7 @@ class InMemoryHub:
         """Wake-on-inbox hook: `callback` runs (on the loop thread, from
         route()) whenever a message lands in `node`'s queue."""
         self._notify[node] = callback
+        self._epoch += 1
 
     def queue_of(self, node: NodeId) -> asyncio.Queue:
         return self._queues[node]
@@ -94,6 +100,8 @@ class InMemoryNetwork(NetworkTransport):
     def __init__(self, node_id: NodeId, hub: InMemoryHub) -> None:
         self.node_id = node_id
         self.hub = hub
+        self._bcast_epoch = -1
+        self._bcast_targets: list = []  # [(queue, notify-or-None)]
 
     async def send_to(self, target: NodeId, data: bytes) -> None:
         self.send_to_nowait(target, data)
@@ -106,9 +114,44 @@ class InMemoryNetwork(NetworkTransport):
         return True
 
     def broadcast_nowait(self, data: bytes) -> bool:
-        for n in self.hub.nodes():
-            if n != self.node_id:
-                self.hub.route(self.node_id, n, data)
+        hub = self.hub
+        if hub._epoch != self._bcast_epoch:
+            # rebuild the fan-out on membership/notify change: rebuilding
+            # the recipient set per broadcast (NodeId set algebra + dict
+            # walks) measurably taxed the serial engine shape
+            self._bcast_epoch = hub._epoch
+            self._bcast_targets = (
+                []
+                if self.node_id in hub._disconnected
+                else [
+                    (hub._queues[n], hub._notify.get(n))
+                    for n in hub._queues
+                    if n != self.node_id and n not in hub._disconnected
+                ]
+            )
+        if not self._bcast_targets:
+            if self.node_id in hub._disconnected:
+                # stat parity with the uncached path: route() counted one
+                # attempted+dropped send per LIVE peer for a disconnected
+                # sender
+                n_live = sum(
+                    1
+                    for n in hub._queues
+                    if n != self.node_id and n not in hub._disconnected
+                )
+                hub.stats.sent += n_live
+                hub.stats.dropped += n_live
+            return True
+        me = self.node_id
+        stats = hub.stats
+        nbytes = len(data)
+        for q, cb in self._bcast_targets:
+            stats.sent += 1
+            q.put_nowait((me, data))
+            stats.delivered += 1
+            stats.total_bytes += nbytes
+            if cb is not None:
+                cb()
         return True
 
     async def receive(self, timeout: Optional[float] = None) -> tuple[NodeId, bytes]:

@@ -273,6 +273,21 @@ class TcpNetwork(NetworkTransport):
             return sender, data.view, data.release
         return sender, data, lambda: None
 
+    def receive_raw_nowait(self):
+        """Address-level drain for the engine's native tick ingest:
+        ``(sender, data, addr, length, release)``. For frames still owned
+        by the native arena, ``addr`` is the raw frame address (``data``
+        is None) — the C ingest reads it with zero Python buffer
+        wrapping; otherwise ``data`` is a bytes object and ``addr`` is 0.
+        ``release`` is None for bytes frames."""
+        try:
+            sender, data = self._pending.popleft()
+        except IndexError:
+            return None
+        if isinstance(data, _BorrowedFrame):
+            return sender, None, data.addr, len(data.view), data.release
+        return sender, data, 0, len(data), None
+
     def set_receive_notify(self, callback) -> bool:
         # invoked from _on_frames, which already runs on the loop thread
         # (the reader thread posts it via call_soon_threadsafe)

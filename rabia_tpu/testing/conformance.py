@@ -129,3 +129,129 @@ async def run_schedule_on_both_planes(
     assert all(
         sm.create_snapshot().data == snap for sm in mesh_eng.sms
     ), f"{tag}: replica state diverges across planes"
+
+
+async def _run_transport_schedule(
+    schedule: Sequence[dict[int, list[str]]],
+    n_shards: int,
+    n_replicas: int,
+    *,
+    tag: str,
+):
+    """One transport-plane cluster through `schedule`; returns
+    (decisions{shard: {slot: value}}, state digest bytes, native_active)."""
+    from rabia_tpu.core.config import RabiaConfig
+    from rabia_tpu.core.network import ClusterConfig
+    from rabia_tpu.core.state_machine import InMemoryStateMachine
+    from rabia_tpu.core.types import CommandBatch, NodeId
+    from rabia_tpu.engine import RabiaEngine
+    from rabia_tpu.net import InMemoryHub
+
+    config = RabiaConfig(
+        phase_timeout=3.0,
+        heartbeat_interval=0.05,
+        round_interval=0.002,
+    ).with_kernel(num_shards=n_shards, shard_pad_multiple=2)
+    hub = InMemoryHub()
+    nodes = [NodeId.from_int(i + 1) for i in range(n_replicas)]
+    engines, sms, tasks = [], [], []
+    for node in nodes:
+        sm = InMemoryStateMachine()
+        eng = RabiaEngine(
+            ClusterConfig.new(node, nodes), sm, hub.register(node),
+            config=config,
+        )
+        engines.append(eng)
+        sms.append(sm)
+        tasks.append(asyncio.ensure_future(eng.run()))
+    try:
+        quorum = False
+        for _ in range(300):
+            await asyncio.sleep(0.01)
+            if all(
+                [(await e.get_statistics()).has_quorum for e in engines]
+            ):
+                quorum = True
+                break
+        assert quorum, f"{tag}: cluster never formed quorum"
+        for w, wave in enumerate(schedule):
+            futs = {
+                s: await engines[w % n_replicas].submit_batch(
+                    CommandBatch.new(list(cmds)), shard=s
+                )
+                for s, cmds in wave.items()
+            }
+            for s, f in futs.items():
+                got = await asyncio.wait_for(f, 15.0)
+                want = [b"OK"] * len(wave[s])
+                assert got == want, f"{tag}: wave {w} shard {s}: {got!r}"
+        decisions = {
+            s: {
+                slot: int(rec.value)
+                for slot, rec in engines[0].rt.shards[s].decisions.items()
+            }
+            for s in range(n_shards)
+        }
+        snap = sms[0].create_snapshot().data
+        for _ in range(500):
+            if all(sm.create_snapshot().data == snap for sm in sms):
+                break
+            await asyncio.sleep(0.01)
+        assert all(
+            sm.create_snapshot().data == snap for sm in sms
+        ), f"{tag}: replicas diverged"
+        native = all(e._rk is not None for e in engines)
+        return decisions, snap, native
+    finally:
+        for e in engines:
+            await e.shutdown()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
+async def run_schedule_on_both_tick_paths(
+    schedule: Sequence[dict[int, list[str]]],
+    n_shards: int,
+    n_replicas: int = 3,
+    *,
+    tag: str = "",
+    require_native: bool = True,
+) -> None:
+    """Native-vs-Python tick-path conformance (the fast-path gate).
+
+    The same submission schedule runs through two transport clusters —
+    the native per-tick fast path (hostkernel.cpp rk_tick) and the Python
+    semantics owner (``RABIA_PY_TICK=1``) — and must produce identical
+    per-shard decision ledgers and byte-identical replica state. Shared
+    by the fixed gate (tests/test_native_tick.py) and the randomized
+    fuzz (scripts/fuzz_conformance.py --tick), so they cannot drift.
+    """
+    import os
+
+    prev = os.environ.pop("RABIA_PY_TICK", None)
+    try:
+        dec_native, snap_native, native = await _run_transport_schedule(
+            schedule, n_shards, n_replicas, tag=f"{tag}[native]"
+        )
+        if require_native:
+            assert native, (
+                f"{tag}: native tick path inactive (hostkernel build "
+                "failure?) — conformance gate would be vacuous"
+            )
+        os.environ["RABIA_PY_TICK"] = "1"
+        dec_py, snap_py, _ = await _run_transport_schedule(
+            schedule, n_shards, n_replicas, tag=f"{tag}[python]"
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("RABIA_PY_TICK", None)
+        else:
+            os.environ["RABIA_PY_TICK"] = prev
+    assert dec_native == dec_py, (
+        f"{tag}: decision ledgers diverge across tick paths "
+        f"(native={dec_native}, python={dec_py})"
+    )
+    assert snap_native == snap_py, (
+        f"{tag}: replica state diverges across tick paths"
+    )

@@ -311,6 +311,36 @@ async def _trial_planes(seed: int) -> None:
     )
 
 
+async def _trial_tick_paths(seed: int) -> None:
+    """Engine-level differential: one RANDOM submission schedule through
+    the native per-tick fast path AND the Python tick path (the
+    semantics owner), via the shared gate — identical decision ledgers
+    and byte-identical replica state required."""
+    from rabia_tpu.testing.conformance import run_schedule_on_both_tick_paths
+
+    rng = np.random.default_rng(seed + 191)
+    S = int(rng.choice([1, 2, 3]))
+    R = int(rng.choice([3, 5]))
+    waves = int(rng.integers(2, 5))
+    schedule = []
+    for w in range(waves):
+        covered = sorted(
+            rng.choice(S, size=int(rng.integers(1, S + 1)), replace=False)
+        )
+        schedule.append(
+            {
+                int(s): [
+                    f"SET w{w}s{s}k{j} v{int(rng.integers(0, 9))}"
+                    for j in range(int(rng.integers(1, 3)))
+                ]
+                for s in covered
+            }
+        )
+    await run_schedule_on_both_tick_paths(
+        schedule, n_shards=S, n_replicas=R, tag=f"tick seed={seed}"
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=30.0)
@@ -320,6 +350,13 @@ def main() -> int:
         help="additionally run N engine-level plane-differential trials "
         "(random schedules through the transport engine AND MeshEngine; "
         "~4s each)",
+    )
+    ap.add_argument(
+        "--tick", type=int, default=0,
+        help="additionally run N native-vs-Python tick-path differential "
+        "trials (random schedules through the transport engine with the "
+        "hostkernel rk_tick fast path on, then with RABIA_PY_TICK=1; "
+        "identical decisions/state required; ~4s each)",
     )
     ap.add_argument(
         "--mesh", type=int, default=0,
@@ -392,11 +429,20 @@ def main() -> int:
         for i in range(args.planes):
             asyncio.run(_trial_planes(args.base_seed + i))
             plane_trials += 1
+    tick_trials = 0
+    if args.tick > 0:
+        import asyncio
+
+        for i in range(args.tick):
+            asyncio.run(_trial_tick_paths(args.base_seed + i))
+            tick_trials += 1
     extra = (
         f"; {plane_trials} plane-differential schedules identical"
         if plane_trials
         else ""
     )
+    if tick_trials:
+        extra += f"; {tick_trials} tick-path differential schedules identical"
     if mesh_trials:
         extra += (
             f"; {mesh_trials} mesh-plane fault schedules conformant "
