@@ -88,6 +88,10 @@ def _emit(config: str, value: float, unit: str, baselines: dict, extra: dict) ->
         "tick_path": _tick_path(),
         **extra,
     }
+    if _LAST_OBS is not None:
+        # counter context captured at the last cluster teardown — the
+        # metrics-registry snapshot riding along with the throughput
+        doc["obs"] = _LAST_OBS
     if baselines.get("cpu_engine"):
         doc["vs_baseline"] = round(value / baselines["cpu_engine"], 2)
         doc["baseline"] = "cpu_scalar_engine_4096shards_5rep"
@@ -173,7 +177,57 @@ async def _mk_mem_cluster(S, R, sm_factory, **cfg_kw):
     return nodes, hub, engines, sms, tasks
 
 
+_LAST_OBS: dict | None = None  # metrics snapshot of the last-stopped cluster
+
+
+def _obs_snapshot(engines, nets=None) -> dict:
+    """Counter context for one sweep config: decisions, drops, out-pool
+    hit rate — pulled from replica 0's metrics registry and the native
+    transport counter block, so BENCH rounds carry the WHY next to the
+    throughput number (docs/OBSERVABILITY.md)."""
+    e0 = engines[0]
+    obs: dict = {}
+    try:
+        snap = e0.metrics.snapshot()
+        obs = {
+            "decided_v1": int(snap.get('rabia_engine_decided_total{value="v1"}', 0)),
+            "decided_v0": int(snap.get('rabia_engine_decided_total{value="v0"}', 0)),
+            "stale_votes": int(snap.get("rabia_tick_stale_votes_total", 0)),
+            "slow_ticks": int(snap.get("rabia_engine_slow_ticks_total", 0)),
+            "syncs": int(snap.get("rabia_engine_syncs_total", 0)),
+            "ticks": int(snap.get("rabia_engine_ticks_total", 0)),
+            "tick_frames": int(
+                sum(
+                    snap.get(f'rabia_tick_frames_total{{kind="{k}"}}', 0)
+                    for k in ("vote1", "vote2", "decision")
+                )
+            ),
+            "anomalies": e0.journal.counts(),
+        }
+    except Exception as e:  # the bench must never die on its own metrics
+        obs["error"] = repr(e)
+    if nets:
+        try:
+            hits, misses = nets[0].out_pool_stats
+            total = hits + misses
+            obs["out_pool_hits"] = int(hits)
+            obs["out_pool_misses"] = int(misses)
+            obs["out_pool_hit_rate"] = (
+                round(hits / total, 4) if total else None
+            )
+            obs["inbox_dropped"] = int(
+                nets[0].transport_counters().get("inbox_dropped", 0)
+            )
+        except Exception as e:
+            obs["transport_error"] = repr(e)
+    return obs
+
+
 async def _stop(engines, tasks, nets=None):
+    global _LAST_OBS
+    # capture BEFORE teardown: the transport counter block dies with the
+    # native handle
+    _LAST_OBS = _obs_snapshot(engines, nets)
     for e in engines:
         try:
             await asyncio.wait_for(e.shutdown(), 5.0)
@@ -746,7 +800,33 @@ def run_sweep(which=None, repeats: int = 1) -> list[dict]:
         if len(per_config[c]) > 1:
             print(json.dumps(doc))  # the aggregated line (repeats mode)
         out.append(doc)
+    _persist_sweep_obs(out)
     return out
+
+
+def _persist_sweep_obs(docs: list[dict]) -> None:
+    """Snapshot each config's metrics-registry context into
+    benchmarks/results.json (key ``sweep_metrics``, latest run per
+    config name), so BENCH rounds carry counter context — decisions,
+    stale drops, out-pool hit rate — not just throughput."""
+    path = Path(__file__).resolve().parent / "results.json"
+    try:
+        existing = json.loads(path.read_text()) if path.exists() else {}
+    except (OSError, json.JSONDecodeError):
+        existing = {}
+    entry = existing.setdefault("sweep_metrics", {})
+    for doc in docs:
+        if doc.get("obs"):
+            entry[doc["config"]] = {
+                "value": doc.get("value"),
+                "unit": doc.get("unit"),
+                "tick_path": doc.get("tick_path"),
+                **doc["obs"],
+            }
+    try:
+        path.write_text(json.dumps(existing, indent=1))
+    except OSError as e:  # read-only checkout: report, don't fail the run
+        print(f"sweep: could not persist obs snapshot: {e}", file=sys.stderr)
 
 
 def main() -> int:

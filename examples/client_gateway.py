@@ -14,12 +14,18 @@ gateways:
   2. a duplicate submission answered from the session cache (observed
      via the CACHED status — no second proposal, no second apply);
   3. linearizable reads with the consensus slot counters pinned;
-  4. admission-control shedding under a tiny session window.
+  4. admission-control shedding under a tiny session window;
+  5. an observability scrape: /metrics over the gateway's HTTP shim,
+     validated as non-empty well-formed Prometheus exposition with live
+     consensus counters (this is the CI example-smoke gate for the
+     observability plane — a garbled or empty exposition FAILS).
 
 Run: python examples/client_gateway.py
 """
 
 import asyncio
+import json
+import urllib.request
 
 import _common  # noqa: F401  (sys.path + backend setup)
 
@@ -44,7 +50,9 @@ async def main() -> int:
     cluster = GatewayCluster(
         n_replicas=3,
         n_shards=SHARDS,
-        gateway_config=GatewayConfig(max_inflight_per_session=16),
+        gateway_config=GatewayConfig(
+            max_inflight_per_session=16, http_port=0
+        ),
     )
     await cluster.start()
     print(
@@ -123,7 +131,35 @@ async def main() -> int:
             "all eventually committed"
         )
         await cluster.wait_converged()
-        print("replica stores converged; OK")
+        print("replica stores converged")
+
+        # 5. observability scrape: well-formed, non-empty exposition
+        # carrying live consensus counters — the CI smoke gate
+        port = cluster.gateways[0].http_port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+        samples = {}
+        for ln in text.splitlines():
+            if not ln or ln.startswith("#"):
+                continue
+            name, _, value = ln.rpartition(" ")
+            assert name, f"garbled exposition line: {ln!r}"
+            samples[name] = float(value)  # raises on garbage values
+        assert samples, "empty /metrics exposition"
+        decided = samples.get('rabia_engine_decided_total{value="v1"}', 0)
+        assert decided > 0, "exposition carries no decided slots"
+        assert samples.get("rabia_gateway_submits_total", 0) > 0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok", health
+        print(
+            f"/metrics scrape: {len(samples)} samples, "
+            f"decided_v1={int(decided)}; /healthz {health['status']}; OK"
+        )
         return 0
     finally:
         for c in clients:

@@ -13,8 +13,10 @@ environment work" additionally means "does XLA compile for my
 backend".
 
 Usage:
-    python -m rabia_tpu             # environment report
-    python -m rabia_tpu --selftest  # + compile and run the mini stack
+    python -m rabia_tpu                    # environment report
+    python -m rabia_tpu --selftest         # + compile and run the mini stack
+    python -m rabia_tpu stats <host:port>  # scrape a gateway's /metrics
+    python -m rabia_tpu stats <host:port> --kind health|journal
 """
 
 from __future__ import annotations
@@ -103,6 +105,38 @@ def _selftest() -> int:
     return 0
 
 
+def _stats(addr: str, kind: str, timeout: float) -> int:
+    """Fetch one admin document from a live gateway over its native
+    transport (the framed AdminRequest path — no HTTP shim required)."""
+    import asyncio
+    import json
+
+    from rabia_tpu.core.messages import AdminKind
+    from rabia_tpu.gateway import admin_fetch
+
+    host, _, port_s = addr.rpartition(":")
+    if not host or not port_s.isdigit():
+        print(f"stats: bad address {addr!r} (want host:port)", file=sys.stderr)
+        return 2
+    kind_code = {
+        "metrics": AdminKind.METRICS,
+        "health": AdminKind.HEALTH,
+        "journal": AdminKind.JOURNAL,
+    }[kind]
+    try:
+        body = asyncio.run(
+            admin_fetch(host, int(port_s), int(kind_code), timeout=timeout)
+        )
+    except Exception as e:
+        print(f"stats: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if kind == "metrics":
+        sys.stdout.write(body.decode(errors="replace"))
+    else:
+        print(json.dumps(json.loads(body.decode()), indent=2))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m rabia_tpu",
@@ -110,7 +144,20 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--selftest", action="store_true",
                     help="compile and run the mini end-to-end stack")
+    sub = ap.add_subparsers(dest="cmd")
+    sp = sub.add_parser(
+        "stats",
+        help="scrape a gateway's admin surface over the native transport",
+    )
+    sp.add_argument("addr", help="gateway host:port")
+    sp.add_argument(
+        "--kind", choices=("metrics", "health", "journal"),
+        default="metrics",
+    )
+    sp.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
+    if args.cmd == "stats":
+        return _stats(args.addr, args.kind, args.timeout)
     rc = _report()
     if rc == 0 and args.selftest:
         rc = _selftest()

@@ -56,6 +56,13 @@ class MessageType(enum.IntEnum):
     Submit = 12
     Result = 13
     ReadIndex = 14
+    # observability admin frames (rabia_tpu/obs): served by the gateway
+    # on its native transport — /metrics, /healthz and the anomaly
+    # journal as framed request/response, for ops tooling that already
+    # speaks the transport (`python -m rabia_tpu stats <addr>`). HTTP
+    # scrapers use the stdlib shim instead (obs/http.py).
+    AdminRequest = 15
+    AdminResponse = 16
 
 
 # ---------------------------------------------------------------------------
@@ -407,6 +414,35 @@ class ReadIndex:
     frontier: tuple[int, ...] = ()
 
 
+class AdminKind(enum.IntEnum):
+    """What an :class:`AdminRequest` asks for."""
+
+    METRICS = 0  # Prometheus text exposition
+    HEALTH = 1  # JSON health document
+    JOURNAL = 2  # JSON anomaly journal
+
+
+@dataclass(frozen=True)
+class AdminRequest:
+    """Ops tooling -> gateway: fetch one admin document (read-only)."""
+
+    kind: int
+    nonce: int = 0
+
+
+@dataclass(frozen=True)
+class AdminResponse:
+    """Gateway -> ops tooling: the requested document.
+
+    ``status`` 0 = ok, nonzero = error (``body`` carries a diagnostic).
+    ``body`` is Prometheus text for METRICS, JSON bytes otherwise.
+    """
+
+    nonce: int
+    status: int
+    body: bytes = b""
+
+
 Payload = (
     Propose
     | VoteRound1
@@ -422,6 +458,8 @@ Payload = (
     | Submit
     | Result
     | ReadIndex
+    | AdminRequest
+    | AdminResponse
 )
 
 _PAYLOAD_TYPE = {
@@ -439,6 +477,8 @@ _PAYLOAD_TYPE = {
     Submit: MessageType.Submit,
     Result: MessageType.Result,
     ReadIndex: MessageType.ReadIndex,
+    AdminRequest: MessageType.AdminRequest,
+    AdminResponse: MessageType.AdminResponse,
 }
 
 

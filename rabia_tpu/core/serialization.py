@@ -36,6 +36,8 @@ from rabia_tpu.core.blocks import PayloadBlock
 from rabia_tpu.core.config import SerializationConfig
 from rabia_tpu.core.errors import SerializationError
 from rabia_tpu.core.messages import (
+    AdminRequest,
+    AdminResponse,
     ClientHello,
     Decision,
     HeartBeat,
@@ -407,6 +409,13 @@ def _encode_payload(w: _Writer, payload) -> None:
         w.u32(len(payload.frontier))
         for f in payload.frontier:
             w.u64(f)
+    elif isinstance(payload, AdminRequest):
+        w.u8(int(payload.kind))
+        w.u64(payload.nonce)
+    elif isinstance(payload, AdminResponse):
+        w.u64(payload.nonce)
+        w.u8(int(payload.status))
+        w.blob(payload.body)
     else:  # pragma: no cover - exhaustive over Payload union
         raise SerializationError(f"unknown payload type {type(payload).__name__}")
 
@@ -531,6 +540,10 @@ def _decode_payload(msg_type: MessageType, r: _Reader):
             key=key,
             frontier=tuple(r.u64() for _ in range(n)),
         )
+    if msg_type == MessageType.AdminRequest:
+        return AdminRequest(kind=r.u8(), nonce=r.u64())
+    if msg_type == MessageType.AdminResponse:
+        return AdminResponse(nonce=r.u64(), status=r.u8(), body=r.blob())
     raise SerializationError(f"unknown message type {msg_type}")
 
 
@@ -846,4 +859,6 @@ def estimate_serialized_size(msg: ProtocolMessage) -> int:
         return base + 29 + sum(4 + len(c) for c in p.payload)
     if isinstance(p, ReadIndex):
         return base + 37 + len(p.key) + 8 * len(p.frontier)
+    if isinstance(p, AdminResponse):
+        return base + 13 + len(p.body)
     return base + 64

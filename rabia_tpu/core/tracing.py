@@ -7,7 +7,13 @@ store.rs:15) and leaves profiling to external tools. Here:
 - :class:`Tracer` — a process-local span aggregator with the same
   pull-based-stats shape as the rest of the framework (§5.5): per-span
   count / total / max wall time, read via :meth:`Tracer.report`. Disabled
-  by default; when disabled a span costs one attribute check.
+  by default; when disabled a span costs one attribute check. Set
+  ``RABIA_TRACE=1`` in the environment to enable it process-wide (or
+  flip ``tracer.enabled`` at runtime). The span aggregates fold into the
+  observability registry's exposition — the engine attaches this tracer
+  to its :class:`~rabia_tpu.obs.MetricsRegistry`, so ``/metrics``
+  carries ``rabia_span_seconds{span=...}`` summaries and there is ONE
+  ``report()`` shape, not two (docs/OBSERVABILITY.md).
 - :func:`span` — ``with span("engine.tick.drain"): ...`` context manager
   against the module singleton.
 - :func:`device_annotation` — wraps ``jax.profiler.TraceAnnotation`` so
@@ -27,6 +33,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Iterator
@@ -91,6 +98,10 @@ class Tracer:
 
 
 tracer = Tracer()
+# the documented enable path: RABIA_TRACE=1 turns span aggregation on for
+# the whole process (tests/benches may still flip tracer.enabled directly)
+if os.environ.get("RABIA_TRACE") == "1":
+    tracer.enabled = True
 
 
 class _NoopSpan:

@@ -232,6 +232,9 @@ class _EngineNetHandler(NetworkEventHandler):
         e = self.engine
         e._paused = True
         e.rt.is_active = False
+        e.journal.record(
+            e.journal.QUORUM_LOST, active=len(e.rt.active_nodes)
+        )
         logger.warning("%s: quorum LOST — consensus paused", e.node_id.short())
         e._send(
             QuorumNotification(
@@ -244,6 +247,9 @@ class _EngineNetHandler(NetworkEventHandler):
         e = self.engine
         e._paused = False
         e.rt.is_active = True
+        e.journal.record(
+            e.journal.QUORUM_RESTORED, active=len(e.rt.active_nodes)
+        )
         logger.info(
             "%s: quorum RESTORED — consensus resumed", e.node_id.short()
         )
@@ -454,6 +460,197 @@ class RabiaEngine:
 
         if self.n_shards > self.S:
             raise ValidationError("num_shards exceeds padded kernel width")
+
+        self._init_obs()
+
+    # ------------------------------------------------------------------
+    # Observability (rabia_tpu/obs — docs/OBSERVABILITY.md taxonomy)
+    # ------------------------------------------------------------------
+
+    def _init_obs(self) -> None:
+        """Register this replica's metrics + anomaly journal.
+
+        Pull-based: gauges/source-backed counters read runtime state (and
+        the native C counter blocks, zero-copy) at scrape time; the only
+        hot-path costs are plain int increments on EVENT paths. The
+        native tick and ``RABIA_PY_TICK=1`` feed the SAME metric names —
+        native counts ride the rk counter block, Python-path counts ride
+        the ``_py_*`` event tallies, and the exported value is their sum
+        (each path leaves the other's source at zero), so the
+        conformance gate can assert counter parity across tick paths."""
+        from rabia_tpu.core.tracing import tracer
+        from rabia_tpu.obs import AnomalyJournal, MetricsRegistry
+
+        m = self.metrics = MetricsRegistry()
+        m.attach_tracer(tracer)
+        self.journal = AnomalyJournal()
+        self._tick_count = 0
+        self._slow_ticks = 0
+        # Python-path event tallies (the RABIA_PY_TICK twin of the rk
+        # counter block; also counts frames the native ingest declined)
+        self._py_frames = {"vote1": 0, "vote2": 0, "decision": 0}
+        self._py_drops = {"spoof": 0, "skew": 0, "malformed": 0}
+        self._py_stale = 0
+        self._last_dials = 0
+        # a tick slower than half the phase timeout (floored for test
+        # configs with tiny timeouts) is an anomaly worth journaling
+        self._slow_tick_s = max(0.25, self.config.phase_timeout / 2)
+
+        rt = self.rt
+        n = self.n_shards
+
+        def rk_ctr(name):
+            rk = self._rk
+            return rk.counter(name) if rk is not None else 0
+
+        # -- engine progress (deterministic across tick paths: the
+        #    conformance parity set) ------------------------------------
+        m.counter(
+            "engine_decided_total",
+            "Slots decided by this replica, by decided value",
+            {"value": "v1"},
+            fn=lambda: rt.decided_v1,
+        )
+        m.counter(
+            "engine_decided_total", "", {"value": "v0"},
+            fn=lambda: rt.decided_v0,
+        )
+        m.counter(
+            "engine_applied_slots_total",
+            "Contiguously applied slots across shards",
+            fn=lambda: int(rt.applied_upto[:n].sum()),
+        )
+        m.counter(
+            "engine_state_version",
+            "V1 batches applied (the replicated-state version)",
+            fn=lambda: rt.state_version,
+        )
+        # -- liveness / load --------------------------------------------
+        m.gauge(
+            "engine_has_quorum", "1 while this replica sees a quorum",
+            fn=lambda: 1 if rt.has_quorum else 0,
+        )
+        m.gauge(
+            "engine_active_nodes", "Peers considered active",
+            fn=lambda: len(rt.active_nodes),
+        )
+        m.gauge(
+            "engine_pending_batches", "Locally queued submissions",
+            fn=lambda: int(rt.queue_len[:n].sum()),
+        )
+        m.gauge(
+            "engine_in_flight_shards", "Shards with an open consensus slot",
+            fn=lambda: int(rt.in_flight[:n].sum()),
+        )
+        m.gauge(
+            "engine_native_tick",
+            "1 when the native rk tick context is active",
+            fn=lambda: 1 if self._rk is not None else 0,
+        )
+        m.counter(
+            "engine_ticks_total", "Engine loop ticks",
+            fn=lambda: self._tick_count,
+        )
+        m.counter(
+            "engine_slow_ticks_total",
+            "Ticks exceeding the slow-tick threshold (journaled)",
+            fn=lambda: self._slow_ticks,
+        )
+        self._syncs = 0
+        m.counter(
+            "engine_syncs_total", "Snapshot syncs initiated",
+            fn=lambda: self._syncs,
+        )
+        # -- the per-tick pipeline (native rk counter block + Python
+        #    event tallies feeding the same names) ----------------------
+        for kind, rk_name in (
+            ("vote1", "frames_vote1"),
+            ("vote2", "frames_vote2"),
+            ("decision", "frames_decision"),
+        ):
+            m.counter(
+                "tick_frames_total",
+                "Consensus frames ingested, by kind (native + Python paths)",
+                {"kind": kind},
+                fn=lambda k=kind, r=rk_name: rk_ctr(r) + self._py_frames[k],
+            )
+        for reason in ("spoof", "skew", "malformed"):
+            m.counter(
+                "tick_drops_total",
+                "Frames dropped at ingest, by reason",
+                {"reason": reason},
+                fn=lambda r=reason: rk_ctr("drop_" + r) + self._py_drops[r],
+            )
+        m.counter(
+            "tick_stale_votes_total",
+            "Below-applied vote entries (answered by the targeted repair)",
+            fn=lambda: rk_ctr("stale_votes") + self._py_stale,
+        )
+        m.gauge(
+            "tick_carry_pending",
+            "Future-(slot,phase) votes currently carried",
+            fn=lambda: (
+                self._rk.carry_count
+                if self._rk is not None
+                else sum(
+                    1 if type(t[1]) is int else len(t[1])
+                    for t in (self._carry1 + self._carry2)
+                )
+            ),
+        )
+        for name in (
+            "carries", "ledger_scatters", "stages", "out_frames",
+            "taint_hits", "opened", "frames_noop",
+        ):
+            m.counter(
+                f"tick_native_{name}_total",
+                "rk tick context counter (native path only)",
+                fn=lambda r=name: rk_ctr(r),
+            )
+        # -- commit pipeline latency breakdown (event-path observes; all
+        #    stages survive the native tick because record/apply stay
+        #    Python events on both paths) -------------------------------
+        self._h_stage = {
+            stage: m.histogram(
+                "commit_stage_seconds",
+                "Commit pipeline latency by stage "
+                "(submit→propose→decide→apply)",
+                {"stage": stage},
+            )
+            for stage in (
+                "submit_propose",
+                "propose_decide",
+                "decide_apply",
+                "submit_apply",
+            )
+        }
+        # -- transport (native counter block, when the transport has one)
+        tc = getattr(self.transport, "transport_counters", None)
+        if callable(tc):
+            from rabia_tpu.net.tcp import RT_COUNTER_NAMES
+
+            for name in RT_COUNTER_NAMES:
+                m.counter(
+                    f"transport_{name}_total",
+                    "Native transport counter (transport.cpp RTC block)",
+                    fn=lambda r=name: tc().get(r, 0),
+                )
+
+    def health(self) -> dict:
+        """The /healthz document (served by the gateway admin surface and
+        the HTTP shim): frontier positions, quorum view, anomaly tallies."""
+        return {
+            "status": "ok" if self.rt.has_quorum else "degraded",
+            "node": str(self.node_id.value),
+            "has_quorum": bool(self.rt.has_quorum),
+            "active_nodes": len(self.rt.active_nodes),
+            "native_tick": self._rk is not None,
+            "decided_frontier": self.decided_frontier().tolist(),
+            "applied_frontier": self.applied_frontier().tolist(),
+            "pending_batches": self.pending_queue_depth(),
+            "state_version": int(self.rt.state_version),
+            "anomalies": self.journal.counts(),
+        }
 
     # ------------------------------------------------------------------
     # Public API (the reference's EngineCommand surface, state.rs:300-307)
@@ -756,7 +953,14 @@ class RabiaEngine:
                 # spurious wake later) or sets the event and cuts the
                 # idle wait short — a wake can never be lost
                 self._wake.clear()
+                t_tick = time.perf_counter()
                 progressed = await self._tick()
+                dt_tick = time.perf_counter() - t_tick
+                if dt_tick > self._slow_tick_s:
+                    self._slow_ticks += 1
+                    self.journal.record(
+                        self.journal.SLOW_TICK, dt_ms=round(dt_tick * 1e3, 2)
+                    )
                 await self._periodic()
                 if progressed or self._restep:
                     # busy: yield to peers/transport, then loop again
@@ -796,6 +1000,7 @@ class RabiaEngine:
     # ------------------------------------------------------------------
 
     async def _tick(self) -> bool:
+        self._tick_count += 1
         with span("engine.tick.drain"):
             got_msgs = await self._drain_messages()
         if self._paused:
@@ -903,6 +1108,7 @@ class RabiaEngine:
                     self._handle_message(sender, msg)
                     n += 1
                 except RabiaError as e:
+                    self._py_drops["malformed"] += 1
                     logger.warning(
                         "dropping bad message from %s: %s", sender, e
                     )
@@ -959,6 +1165,7 @@ class RabiaEngine:
                 self._handle_message(sender, msg)
                 n += 1
             except RabiaError as e:
+                self._py_drops["malformed"] += 1
                 logger.warning("dropping bad message from %s: %s", sender, e)
         if rk_handled:
             rk.finish_drain(self)
@@ -970,6 +1177,7 @@ class RabiaEngine:
             # envelope sender must match the transport-authenticated peer:
             # otherwise one faulty peer could forge votes as every other
             # replica row and fabricate a quorum single-handedly
+            self._py_drops["spoof"] += 1
             logger.warning(
                 "dropping spoofed message: envelope %s via transport %s",
                 msg.sender,
@@ -983,10 +1191,13 @@ class RabiaEngine:
         self.rt.active_nodes.add(msg.sender)
         p = msg.payload
         if isinstance(p, VoteRound1):
+            self._py_frames["vote1"] += 1
             self._ingest_vote_arrays(row, p.shards, p.phases, p.vals, 1)
         elif isinstance(p, VoteRound2):
+            self._py_frames["vote2"] += 1
             self._ingest_vote_arrays(row, p.shards, p.phases, p.vals, 2)
         elif isinstance(p, Decision):
+            self._py_frames["decision"] += 1
             self._on_decision(p)
         elif isinstance(p, ProposeBlock):
             self._on_propose_block(row, p)
@@ -1306,6 +1517,7 @@ class RabiaEngine:
             ph = phases[0].item()
             slot = ph >> 16
             if slot < rt.applied_upto[s]:
+                self._py_stale += 1
                 self._repair_stale_sender(
                     row, shards, np.asarray([slot], np.int64)
                 )
@@ -1334,6 +1546,7 @@ class RabiaEngine:
             # the sender is voting in slots we already decided: it missed
             # the Decision (loss / heal) — answer with a targeted repair
             # instead of letting it stall into the sync path
+            self._py_stale += int((~live).sum())
             self._repair_stale_sender(row, shards[~live], slots[~live])
             shards, phases, vals, slots = (
                 shards[live],
@@ -1367,6 +1580,13 @@ class RabiaEngine:
         sender is still voting in. Rate-limited per sender; slots already
         GC'd from the ledger fall back to the sync path on the sender."""
         now = time.time()
+        if len(shards) > 64:
+            # a storm of stale votes from one sender: a peer is far
+            # behind (or replaying) — journaled for triage alongside the
+            # rate-limited repair below
+            self.journal.record(
+                self.journal.STALE_STORM, row=row, entries=int(len(shards))
+            )
         last = self._last_repair.get(row, 0.0)
         if now - last < max(0.05, self.config.phase_timeout / 4):
             return
@@ -1721,6 +1941,9 @@ class RabiaEngine:
                 opened.append((s, slot, V1))
             elif proposer_row == self.me and sh.queue:
                 sub = sh.queue[0]
+                self._h_stage["submit_propose"].observe(
+                    now - sub.submitted_at
+                )
                 sh.payloads[sub.batch.id] = sub.batch
                 sh.buf_propose[slot] = (sub.batch.id, sub.batch)
                 propose_entries.append(
@@ -2314,6 +2537,15 @@ class RabiaEngine:
             else:
                 self.rt.decided_v0 += 1
         if sh.in_flight and int(self._cur_slot[s]) == slot:
+            opened = float(self.rt.opened_at[s])
+            if opened > 0.0:
+                # open→decide for the slot this replica ran consensus on
+                # (adopted decisions for never-opened slots carry no
+                # local open time) — works on both tick paths: recording
+                # is a Python event even under the native tick
+                self._h_stage["propose_decide"].observe(
+                    time.time() - opened
+                )
             sh.in_flight = False
         sh.next_slot = max(sh.next_slot, slot + 1)
         sh.opened_at = 0.0
@@ -2398,6 +2630,9 @@ class RabiaEngine:
                 else:
                     self._requeue_null_slot(sh, slot, rec)
                 rec.applied = True
+                self._h_stage["decide_apply"].observe(
+                    time.time() - rec.decided_at
+                )
                 sh.applied_upto += 1
                 sh.gc_upto(sh.applied_upto)
                 applied += 1
@@ -2419,6 +2654,11 @@ class RabiaEngine:
         if responses is None:
             from rabia_tpu.core.errors import ResponsesUnavailableError
 
+            self.journal.record(
+                self.journal.SYNC_OVERTAKE,
+                shard=int(sh.shard),
+                batch=str(sub.batch.id.value),
+            )
             sub.future.set_exception(
                 ResponsesUnavailableError(
                     "batch committed but responses unavailable (applied "
@@ -2432,6 +2672,9 @@ class RabiaEngine:
         """Resolve the submitter future if this batch was queued locally."""
         for i, sub in enumerate(list(sh.queue)):
             if sub.batch.id == batch.id:
+                self._h_stage["submit_apply"].observe(
+                    time.time() - sub.submitted_at
+                )
                 if sub.future is not None and not sub.future.done():
                     sub.future.set_result(responses)
                 del sh.queue[i]
@@ -2558,6 +2801,7 @@ class RabiaEngine:
             return
         self.rt.sync_started_at = time.time()
         self.rt.sync_responses.clear()
+        self._syncs += 1
         total_applied = int(self.rt.applied_upto.sum())
         self._send(
             SyncRequest(
@@ -2781,6 +3025,17 @@ class RabiaEngine:
                 await self._initiate_sync()
         if now - self._last_monitor >= max(self.config.heartbeat_interval, 0.2):
             self._last_monitor = now
+            tc = getattr(self.transport, "transport_counters", None)
+            if callable(tc):
+                # redial churn: steady-state has ~zero dials; a burst
+                # inside one monitor window means peers are flapping
+                dials = tc().get("dials", 0)
+                delta = dials - self._last_dials
+                self._last_dials = dials
+                if delta >= 8:
+                    self.journal.record(
+                        self.journal.REDIAL_CHURN, dials=int(delta)
+                    )
             connected = await self.transport.get_connected_nodes()
             # refresh membership BEFORE the monitor fires its handlers:
             # QuorumNotification broadcasts read rt.active_nodes and must

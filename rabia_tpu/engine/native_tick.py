@@ -19,6 +19,7 @@ decision sequences, ledgers and wire behavior between the two.
 
 from __future__ import annotations
 
+import ctypes
 import logging
 import time
 
@@ -27,6 +28,30 @@ import numpy as np
 logger = logging.getLogger("rabia_tpu.engine.native_tick")
 
 _STALE_CAP = 1024
+
+# Names of the rk tick context's counter block, in RKC_* index order
+# (hostkernel.cpp). The block is versioned append-only: a newer library
+# may expose MORE counters than this list names (ignored), an older one
+# fewer (read as 0). These feed the same metric names the Python tick
+# path feeds from its event counters — docs/OBSERVABILITY.md taxonomy.
+RK_COUNTER_NAMES = (
+    "ticks",
+    "stages",
+    "frames_vote1",
+    "frames_vote2",
+    "frames_decision",
+    "frames_noop",
+    "drop_spoof",
+    "drop_skew",
+    "drop_malformed",
+    "stale_votes",
+    "taint_hits",
+    "carries",
+    "ledger_scatters",
+    "out_frames",
+    "decided",
+    "opened",
+)
 
 
 class NativeTick:
@@ -120,10 +145,41 @@ class NativeTick:
         )
         self._kst_ptrs = tuple(a.ctypes.data for a in kst)
         self._geom = (e.S, e.R, e.me)
+        # observability: zero-copy ndarray view over the context's C
+        # counter block — the registry reads cells at scrape time, the
+        # hot path never crosses into Python for them
+        if hasattr(lib, "rk_counters"):
+            n_ctr = int(lib.rk_counters_count())
+            self.counters_version = int(lib.rk_counters_version())
+            cbuf = (ctypes.c_uint64 * n_ctr).from_address(
+                lib.rk_counters(self.ctx)
+            )
+            self.counters = np.frombuffer(cbuf, np.uint64)
+        else:  # stale prebuilt hostkernel: metrics read as zeros
+            self.counters_version = 0
+            self.counters = np.zeros(len(RK_COUNTER_NAMES), np.uint64)
+
+    def counter(self, name: str) -> int:
+        """One named counter from the block (0 for unknown/short blocks)."""
+        try:
+            i = RK_COUNTER_NAMES.index(name)
+        except ValueError:
+            return 0
+        return int(self.counters[i]) if i < len(self.counters) else 0
+
+    def counters_dict(self) -> dict[str, int]:
+        return {
+            n: int(self.counters[i]) if i < len(self.counters) else 0
+            for i, n in enumerate(RK_COUNTER_NAMES)
+        }
 
     def close(self) -> None:
         ctx, self.ctx = self.ctx, None
         if ctx:
+            # freeze the last counter values: the block's memory dies with
+            # the context, but late scrapes (post-shutdown stats) must
+            # read the final state, not freed memory
+            self.counters = self.counters.copy()
             self.lib.rk_ctx_destroy(ctx)
 
     def __del__(self):

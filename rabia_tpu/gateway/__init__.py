@@ -10,6 +10,7 @@ from rabia_tpu.gateway.client import (
     BackpressureError,
     GatewayError,
     RabiaClient,
+    admin_fetch,
 )
 from rabia_tpu.gateway.server import (
     GatewayConfig,
@@ -35,5 +36,6 @@ __all__ = [
     "GatewayStats",
     "RabiaClient",
     "SessionTable",
+    "admin_fetch",
     "kv_read_handler",
 ]

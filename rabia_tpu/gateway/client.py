@@ -376,3 +376,78 @@ class RabiaClient:
             raise GatewayError(
                 res.payload[0].decode() if res.payload else "gateway error"
             )
+
+
+# ---------------------------------------------------------------------------
+# Ops tooling: framed admin fetch (the `python -m rabia_tpu stats` path)
+# ---------------------------------------------------------------------------
+
+
+async def admin_fetch(
+    host: str, port: int, kind: int = 0, timeout: float = 10.0
+) -> bytes:
+    """Fetch one admin document (metrics / health / journal — see
+    :class:`~rabia_tpu.core.messages.AdminKind`) from a gateway's native
+    transport, knowing only ``host:port``.
+
+    The framed transport normally needs the peer's node id up front; ops
+    tooling has only an address. The trick: dial under a PLACEHOLDER peer
+    id — the handshake exchanges real 16-byte ids regardless, so the
+    established connection comes up keyed by the gateway's actual id,
+    which ``get_connected_nodes`` then reveals. The placeholder peer
+    entry is removed right after (stopping its redial scan) and the
+    request rides the discovered identity.
+    """
+    from rabia_tpu.core.messages import AdminRequest, AdminResponse
+    from rabia_tpu.net.tcp import TcpNetwork
+
+    net = TcpNetwork(NodeId(fast_uuid4()), TcpNetworkConfig(bind_port=0))
+    try:
+        placeholder = NodeId(fast_uuid4())
+        net.add_peer(placeholder, host, port)
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        gw: Optional[NodeId] = None
+        while loop.time() < deadline:
+            conn = await net.get_connected_nodes()
+            if conn:
+                gw = next(iter(conn))
+                break
+            await asyncio.sleep(0.02)
+        if gw is None:
+            raise TimeoutError_("admin fetch: gateway handshake", timeout)
+        net.remove_peer(placeholder)  # our live conn is keyed by gw's id
+        ser = Serializer()
+        nonce = 1
+        req = ser.serialize(
+            ProtocolMessage.new(
+                net.node_id, AdminRequest(kind=int(kind), nonce=nonce), gw
+            )
+        )
+        last_send = 0.0
+        while True:
+            now = loop.time()
+            if now >= deadline:
+                raise TimeoutError_("admin fetch: response", timeout)
+            if now - last_send >= 1.0:  # re-send over a racing establish
+                net.send_to_nowait(gw, req)
+                last_send = now
+            try:
+                sender, data = await net.receive(
+                    timeout=min(0.25, deadline - now)
+                )
+            except (TimeoutError_, NetworkError):
+                continue
+            try:
+                msg = ser.deserialize(data)
+            except RabiaError:
+                continue
+            p = msg.payload
+            if isinstance(p, AdminResponse) and p.nonce == nonce:
+                if p.status != 0:
+                    raise GatewayError(
+                        p.body.decode(errors="replace") or "admin error"
+                    )
+                return p.body
+    finally:
+        await net.close()

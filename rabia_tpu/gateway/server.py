@@ -46,6 +46,9 @@ from rabia_tpu.core.errors import (
     TimeoutError_,
 )
 from rabia_tpu.core.messages import (
+    AdminKind,
+    AdminRequest,
+    AdminResponse,
     ClientHello,
     ProtocolMessage,
     ReadIndex,
@@ -89,6 +92,11 @@ class GatewayConfig:
     # its read index before failing retryable
     read_timeout: float = 5.0
     gc_interval: float = 1.0
+    # observability HTTP shim (obs/http.py): None = no HTTP listener
+    # (the admin FRAMES on the native transport are always served);
+    # 0 = bind an ephemeral port, exposed as GatewayServer.http_port
+    http_port: Optional[int] = None
+    http_host: str = "127.0.0.1"
 
 
 @dataclass
@@ -196,6 +204,81 @@ class GatewayServer:
         self._running = False
         self._run_task = None
         self._probe_task = None
+        self._http = None
+        # observability: the gateway registers into ITS ENGINE's registry
+        # so one scrape covers the whole replica (engine + transport
+        # counter block + gateway). Registration is idempotent by metric
+        # identity, so a gateway restart on the same engine re-binds.
+        self.metrics = engine.metrics
+        self._register_metrics()
+
+    def _register_metrics(self) -> None:
+        m = self.metrics
+        st = self.stats
+        for name, help_ in (
+            ("submits", "Submit frames received"),
+            ("submits_deduped", "Duplicate (client_id, seq) submits"),
+            ("submits_shed", "Submits shed by admission control"),
+            ("reads", "Linearizable READ requests"),
+            ("reads_failed", "READs failed (retryable or terminal)"),
+            ("probe_rounds", "Read-index frontier probe rounds"),
+            ("results_sent", "Result frames sent to clients"),
+            ("results_repaired", "Results repaired from peer gateways"),
+        ):
+            m.counter(
+                f"gateway_{name}_total", help_,
+                fn=lambda n=name: getattr(st, n),
+            )
+        m.gauge(
+            "gateway_sessions", "Live client sessions",
+            fn=lambda: len(self.sessions),
+        )
+        m.gauge(
+            "gateway_reads_inflight", "READs currently being driven",
+            fn=lambda: len(self._reads_inflight),
+        )
+
+    # -- observability surface ----------------------------------------------
+
+    def health(self) -> dict:
+        """The /healthz document: the engine's health plus the gateway's
+        client-facing view."""
+        doc = self.engine.health()
+        doc["gateway"] = {
+            "node": str(self.node_id.value),
+            "port": self.port,
+            "sessions": len(self.sessions),
+            "peer_gateways": len(self._peer_gateways),
+            "submits": self.stats.submits,
+            "reads": self.stats.reads,
+        }
+        return doc
+
+    def _admin_body(self, kind: int) -> tuple[int, bytes]:
+        import json
+
+        if kind == AdminKind.METRICS:
+            return 0, self.metrics.render_prometheus().encode()
+        if kind == AdminKind.HEALTH:
+            return 0, json.dumps(self.health()).encode()
+        if kind == AdminKind.JOURNAL:
+            return 0, json.dumps(
+                {"anomalies": self.engine.journal.snapshot()}
+            ).encode()
+        return 1, f"unknown admin kind {kind}".encode()
+
+    def _on_admin(self, sender: NodeId, p: AdminRequest) -> None:
+        """Serve one admin document as a framed response. Read-only and
+        unauthenticated by design (same trust domain as the scrape shim);
+        anything beyond the known kinds answers status=1."""
+        try:
+            status, body = self._admin_body(p.kind)
+        except Exception as e:  # a broken provider must still answer
+            logger.exception("admin request failed")
+            status, body = 1, f"admin handler failed: {e}".encode()
+        self._send(
+            AdminResponse(nonce=p.nonce, status=status, body=body), sender
+        )
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -210,9 +293,24 @@ class GatewayServer:
             ),
         )
         self.engine.add_frontier_listener(self._frontier_event.set)
+        if self.config.http_port is not None and self._http is None:
+            from rabia_tpu.obs import AdminHTTPServer
+
+            self._http = AdminHTTPServer(
+                self.metrics,
+                health_fn=self.health,
+                journal=self.engine.journal,
+                host=self.config.http_host,
+                port=self.config.http_port,
+            )
         self._running = True
         self._run_task = asyncio.ensure_future(self._run())
         self._probe_task = asyncio.ensure_future(self._probe_loop())
+
+    @property
+    def http_port(self) -> int:
+        """Bound port of the observability HTTP shim (0 when disabled)."""
+        return self._http.port if self._http is not None else 0
 
     @property
     def port(self) -> int:
@@ -233,6 +331,9 @@ class GatewayServer:
 
     async def close(self) -> None:
         self._running = False
+        if self._http is not None:
+            self._http.close()
+            self._http = None
         self.engine.remove_frontier_listener(self._frontier_event.set)
         for t in (self._run_task, self._probe_task, *self._tasks):
             if t is not None:
@@ -313,6 +414,8 @@ class GatewayServer:
         elif isinstance(p, Result):
             # a peer gateway answering one of our result-repair fetches
             self._on_peer_result(sender, p)
+        elif isinstance(p, AdminRequest):
+            self._on_admin(sender, p)
         # anything else on the gateway port is noise; ignore
 
     def _send(self, payload, recipient: NodeId) -> None:

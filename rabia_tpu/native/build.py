@@ -227,6 +227,13 @@ def load_hostkernel() -> ctypes.CDLL | None:
             p, ctypes.c_double, p, ctypes.c_int64, ctypes.c_int32,
             p, p, p, p,
         ]
+        # observability counter block (versioned, append-only)
+        lib.rk_counters_version.restype = ctypes.c_int32
+        lib.rk_counters_version.argtypes = []
+        lib.rk_counters_count.restype = ctypes.c_int32
+        lib.rk_counters_count.argtypes = []
+        lib.rk_counters.restype = ctypes.c_void_p
+        lib.rk_counters.argtypes = [p]
         _HK_CACHED = lib
         return lib
 
@@ -260,11 +267,11 @@ def load_library() -> ctypes.CDLL:
             # the newest exported symbol so a stale .so fails fast with a
             # clear message instead of a cryptic AttributeError later
             try:
-                lib.rt_broadcast_frames
+                lib.rt_counters
             except AttributeError:
                 raise InternalError(
                     f"RABIA_NATIVE_LIB library {prebuilt} is stale "
-                    "(missing rt_broadcast_frames); rebuild it from "
+                    "(missing rt_counters); rebuild it from "
                     "transport.cpp"
                 ) from None
 
@@ -336,6 +343,19 @@ def load_library() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64),
         ]
+        lib.rt_out_pool_stats.restype = None
+        lib.rt_out_pool_stats.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        # observability counter block (versioned, append-only)
+        lib.rt_counters_version.restype = ctypes.c_int32
+        lib.rt_counters_version.argtypes = []
+        lib.rt_counters_count.restype = ctypes.c_int32
+        lib.rt_counters_count.argtypes = []
+        lib.rt_counters.restype = ctypes.c_void_p
+        lib.rt_counters.argtypes = [ctypes.c_void_p]
         lib.rt_stop.restype = None
         lib.rt_stop.argtypes = [ctypes.c_void_p]
         lib.rt_close.restype = None

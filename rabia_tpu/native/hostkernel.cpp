@@ -286,6 +286,33 @@ enum : int32_t {
   RK_DROP = -1,         // malformed / spoofed / validation-failed: drop
 };
 
+// Versioned, append-only counter block (the observability plane's
+// zero-copy window into the rk tick context). Indices are ABI: new
+// counters append before RKC_COUNT and bump RK_COUNTERS_VERSION; nothing
+// is ever renumbered or removed, so a newer Python reader degrades to
+// reading the prefix it knows. Read via rk_counters() as a uint64[]
+// ndarray — single-writer (the engine's event loop), so plain u64 cells.
+enum : int32_t {
+  RKC_TICKS = 0,        // rk_tick calls
+  RKC_STAGES,           // chained route->step->outbox activations
+  RKC_FRAMES_V1,        // VoteRound1 frames consumed natively
+  RKC_FRAMES_V2,        // VoteRound2 frames consumed natively
+  RKC_FRAMES_DEC,       // Decision frames consumed natively
+  RKC_FRAMES_NOOP,      // frames consumed with no effects (RK_NOOP)
+  RKC_DROP_SPOOF,       // envelope/transport sender mismatch
+  RKC_DROP_SKEW,        // clock-skew rejections
+  RKC_DROP_MALFORMED,   // bad vote/decision codes, empty vote vectors
+  RKC_STALE,            // stale (below-applied) vote entries observed
+  RKC_TAINT_HITS,       // votes landing under a taint horizon
+  RKC_CARRY,            // future-(slot,phase) votes carried
+  RKC_SCATTER,          // ledger cell writes (ingest + carry replay)
+  RKC_OUT_FRAMES,       // outbound frames emitted by rk_tick
+  RKC_DECIDED,          // shards newly decided inside rk_tick
+  RKC_OPENED,           // shards armed (opened) by rk_tick
+  RKC_COUNT
+};
+static const int32_t RK_COUNTERS_VERSION = 1;
+
 struct RkCarry {
   int32_t row;
   int32_t shard;
@@ -349,6 +376,9 @@ struct RkCtx {
   std::vector<int32_t> idx_scratch;
 
   uint64_t msg_counter;
+
+  // observability counter block (see RKC_* above); zero-initialized
+  uint64_t ctrs[RKC_COUNT];
 };
 
 static const size_t RK_STALE_CAP = 1024;
@@ -425,6 +455,7 @@ void* rk_ctx_create(const int64_t* dims, const int64_t* ptrs,
   c->newly_step.resize(c->S);
   c->r2_vals.resize(c->S);
   c->idx_scratch.resize(c->S);
+  std::memset(c->ctrs, 0, sizeof(c->ctrs));
   return c;
 }
 
@@ -438,6 +469,14 @@ uint64_t rk_rows_seen(void* ctx) {
 }
 
 uint64_t rk_dropped(void* ctx) { return ((RkCtx*)ctx)->dropped; }
+
+// --- counter block (observability plane) ------------------------------------
+
+int32_t rk_counters_version(void) { return RK_COUNTERS_VERSION; }
+int32_t rk_counters_count(void) { return RKC_COUNT; }
+// Borrowed pointer to the context's uint64 counter block; valid for the
+// context's lifetime. The Python side wraps it as a read-only ndarray.
+void* rk_counters(void* ctx) { return ((RkCtx*)ctx)->ctrs; }
 
 int64_t rk_carry_count(void* ctx) {
   RkCtx* c = (RkCtx*)ctx;
@@ -486,11 +525,13 @@ static inline bool rk_route_one(RkCtx* c, int32_t round_no, int32_t row,
     int8_t& cell = led[(int64_t)row * c->S + s];
     if (cell == ABS) {
       cell = val;
+      c->ctrs[RKC_SCATTER]++;
       return true;
     }
     return false;  // first-write-wins duplicate: nothing changed
   }
   carry.push_back(RkCarry{row, s, slot, mvc, val});
+  c->ctrs[RKC_CARRY]++;
   return true;
 }
 
@@ -511,6 +552,7 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
   // (engine._handle_message spoof guard)
   if (std::memcmp(data + 19, c->uuids.data() + (size_t)row * 16, 16) != 0) {
     c->dropped++;
+    c->ctrs[RKC_DROP_SPOOF]++;
     return RK_DROP;
   }
   int64_t base = 35 + ((flags & FLAG_RECIPIENT) ? 16 : 0);
@@ -518,6 +560,7 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
   const double ts = rd_f64(data + base);
   if (ts > now + c->max_future_skew || ts < now - c->max_age) {
     c->dropped++;  // clock-skew rejection (MessageValidator parity)
+    c->ctrs[RKC_DROP_SKEW]++;
     return RK_DROP;
   }
   const uint32_t body_len = rd_u32(data + base + 8);
@@ -542,6 +585,7 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
         // (codec parity) — adopting a garbage code would later blow up
         // StateValue() on the Python event path
         c->dropped++;
+        c->ctrs[RKC_DROP_MALFORMED]++;
         return RK_DROP;
       }
       if (s >= (uint32_t)c->n) return RK_PY;
@@ -566,12 +610,15 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
       }
     }
     c->rows_seen |= 1ull << (row & 63);
+    c->ctrs[RKC_FRAMES_DEC]++;
+    if (!dec_effect) c->ctrs[RKC_FRAMES_NOOP]++;
     return dec_effect ? RK_HANDLED : RK_NOOP;
   }
 
   // vote vector (R1/R2)
   if (count == 0) {
     c->dropped++;  // "vote vector must be non-empty" (validator)
+    c->ctrs[RKC_DROP_MALFORMED]++;
     return RK_DROP;
   }
   if (body_len < 4 + (uint64_t)count * 13) return RK_PY;
@@ -579,6 +626,7 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
   for (uint32_t k = 0; k < count; k++) {
     if (ent[(size_t)k * 13 + 12] > 3) {
       c->dropped++;
+      c->ctrs[RKC_DROP_MALFORMED]++;
       return RK_DROP;
     }
   }
@@ -594,12 +642,14 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
     const int32_t mvc = (int32_t)(ph & 0xFFFF);
     const int8_t val = (int8_t)e[12];
     if (slot < c->applied[s]) {
+      c->ctrs[RKC_STALE]++;
       if (c->stale.size() < RK_STALE_CAP)
         c->stale.push_back(RkStale{row, (int32_t)s, slot});
       continue;
     }
     if (slot < c->tainted[s]) {
       c->taint_traffic[s] = now;
+      c->ctrs[RKC_TAINT_HITS]++;
       effect = true;
     }
     if (slot > c->votes_seen[s]) {
@@ -615,6 +665,8 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
   if (carry.size() > cap)
     carry.erase(carry.begin(), carry.begin() + (carry.size() - cap));
   c->rows_seen |= 1ull << (row & 63);
+  c->ctrs[round_no == 1 ? RKC_FRAMES_V1 : RKC_FRAMES_V2]++;
+  if (!effect) c->ctrs[RKC_FRAMES_NOOP]++;
   return effect ? RK_HANDLED : RK_NOOP;
 }
 
@@ -698,7 +750,10 @@ static void rk_route_carry(RkCtx* c, int32_t round_no) {
         e.mvc == c->phase[e.shard]) {
       int8_t* led = (round_no == 1 ? c->led1 : c->led2);
       int8_t& cell = led[(int64_t)e.row * c->S + e.shard];
-      if (cell == ABS) cell = e.val;
+      if (cell == ABS) {
+        cell = e.val;
+        c->ctrs[RKC_SCATTER]++;
+      }
     } else {
       carry[w++] = e;  // keep for a later tick
     }
@@ -718,6 +773,7 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
   RkCtx* c = (RkCtx*)ctx;
   RkFrameWriter w{out, out_cap, 0, 0, 0};
   int32_t restep = 0;
+  c->ctrs[RKC_TICKS]++;
   if (open_mask) {
     rk_start_slots(c->S, c->R, c->me, open_mask, open_slots, open_init,
                    c->slot, c->phase, c->stage, c->my_r1, c->my_r2, c->led1,
@@ -729,8 +785,10 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
     }
     if (n_open)
       rk_emit_frame(c, &w, MT_VOTE1, now, idx, n_open, 13, c->my_r1, 0);
+    c->ctrs[RKC_OPENED] += (uint64_t)n_open;
   }
   for (int32_t it = 0; it < max_iters; it++) {
+    c->ctrs[RKC_STAGES]++;
     rk_route_carry(c, 1);
     rk_route_carry(c, 2);
     rk_node_step(c->S, c->R, c->me, c->quorum, c->f1, c->seed,
@@ -771,9 +829,11 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
     }
     if (n_new && c->decision_broadcast)
       rk_emit_frame(c, &w, MT_DECISION, now, idx, n_new, 14, c->decided, 1);
+    c->ctrs[RKC_DECIDED] += (uint64_t)n_new;
     restep = (n_cast || any_adv) ? 1 : 0;
     if (!restep) break;
   }
+  c->ctrs[RKC_OUT_FRAMES] += (uint64_t)w.frames;
   int64_t done_any = 0;
   for (int32_t s = 0; s < c->n; s++) {
     if (c->done[s] && c->in_flight[s]) {

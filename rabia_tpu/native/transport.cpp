@@ -60,6 +60,26 @@ constexpr double kStashTtlS = 10.0;  // stranded-frame redelivery window
 
 using Clock = std::chrono::steady_clock;
 
+// Observability counter block (rt_counters). Indices are ABI: append new
+// counters before RTC_COUNT and bump kCountersVersion; never renumber.
+enum : int32_t {
+  RTC_FRAMES_IN = 0,     // inbound frames parsed off sockets
+  RTC_BYTES_IN,          // inbound payload bytes
+  RTC_FRAMES_OUT,        // frames enqueued to peer connections
+  RTC_BYTES_OUT,         // framed bytes enqueued (incl. 4B prefix)
+  RTC_INBOX_DROPPED,     // frames dropped by the bounded inbox
+  RTC_OUT_POOL_HITS,     // outbound frame arena reuse hits
+  RTC_OUT_POOL_MISSES,   // outbound frame arena allocations
+  RTC_IN_POOL_HITS,      // inbound buffer arena reuse hits
+  RTC_IN_POOL_MISSES,    // inbound buffer arena allocations
+  RTC_BORROWS,           // zero-copy frames handed out (rt_recv_borrow)
+  RTC_DIALS,             // outbound connection attempts (incl. redials)
+  RTC_CONNS_ESTABLISHED, // handshakes completed into `established`
+  RTC_CONNS_CLOSED,      // established connections torn down
+  RTC_COUNT
+};
+constexpr int32_t kCountersVersion = 1;
+
 double now_s() {
   return std::chrono::duration<double>(Clock::now().time_since_epoch()).count();
 }
@@ -168,6 +188,18 @@ struct Transport {
   std::vector<std::vector<uint8_t>> out_pool;  // outbound frame arena
   uint64_t out_hits = 0, out_misses = 0;
 
+  // observability counter block (RTC_*), exposed raw via rt_counters.
+  // Relaxed atomics: multi-writer (io thread + caller threads), read
+  // lock-free by the Python scrape path; std::atomic<uint64_t> is
+  // layout-compatible with uint64_t for that zero-copy read.
+  std::atomic<uint64_t> ctrs[RTC_COUNT];
+  static_assert(sizeof(std::atomic<uint64_t>) == sizeof(uint64_t),
+                "counter block must read as a plain uint64 array");
+
+  void bump(int32_t i, uint64_t n = 1) {
+    ctrs[i].fetch_add(n, std::memory_order_relaxed);
+  }
+
   std::shared_ptr<std::vector<uint8_t>> make_frame(const uint8_t* data,
                                                    uint32_t len) {
     std::vector<uint8_t> v;
@@ -178,8 +210,10 @@ struct Transport {
         out_pool.pop_back();
         v.clear();
         out_hits++;
+        bump(RTC_OUT_POOL_HITS);
       } else {
         out_misses++;
+        bump(RTC_OUT_POOL_MISSES);
       }
     }
     v.reserve(4 + len);
@@ -220,9 +254,11 @@ struct Transport {
       v.clear();
       v.reserve(need);
       pool_hits++;
+      bump(RTC_IN_POOL_HITS);
       return v;
     }
     pool_misses++;
+    bump(RTC_IN_POOL_MISSES);
     std::vector<uint8_t> v;
     v.reserve(need);
     return v;
@@ -306,6 +342,7 @@ void Transport::close_conn(int fd) {
     auto est = established.find(c.peer);
     if (est != established.end() && est->second == fd) {
       established.erase(est);
+      bump(RTC_CONNS_CLOSED);
       auto p = peers.find(c.peer);
       if (p != peers.end()) {
         p->second.connected = false;
@@ -343,6 +380,7 @@ bool Transport::establish(int fd, Conn& c) {
     established.erase(old);
   }
   established[c.peer] = fd;
+  bump(RTC_CONNS_ESTABLISHED);
   auto p = peers.find(c.peer);
   if (p != peers.end()) {
     p->second.connected = true;
@@ -428,10 +466,13 @@ void Transport::handle_readable(int fd) {
     m.sender = c.peer;
     m.data = pool_get_locked(len);
     m.data.assign(c.rbuf.begin() + off + 4, c.rbuf.begin() + off + 4 + len);
+    bump(RTC_FRAMES_IN);
+    bump(RTC_BYTES_IN, len);
     if (inbox.size() >= kMaxInbox) {
       pool_put_locked(std::move(inbox.front().data));
       inbox.pop_front();
       dropped_frames++;
+      bump(RTC_INBOX_DROPPED);
     }
     inbox.push_back(std::move(m));
     off += 4 + len;
@@ -473,6 +514,8 @@ void Transport::enqueue_shared_locked(
   auto it = conns.find(fd);
   if (it == conns.end()) return;
   it->second.wqueue.push_back(f);
+  bump(RTC_FRAMES_OUT);
+  bump(RTC_BYTES_OUT, f->size());
   arm_write(fd, true);
 }
 
@@ -493,6 +536,7 @@ void Transport::drain_out_locked() {
 }
 
 void Transport::dial(const NodeIdBytes& id, Peer& p) {
+  bump(RTC_DIALS);
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return;
   set_nonblock(fd);
@@ -624,6 +668,7 @@ void* rt_create(const uint8_t node_id[16], const char* bind_host,
                 uint16_t port, uint16_t* actual_port) {
   auto* t = new Transport();
   memcpy(t->self_id.data(), node_id, 16);
+  for (auto& c : t->ctrs) c.store(0, std::memory_order_relaxed);
 
   t->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (t->listen_fd < 0) {
@@ -813,6 +858,7 @@ int64_t rt_recv_borrow(void* h, uint8_t sender_out[16],
   slot = std::move(m.data);
   *ptr_out = slot.data();
   *len_out = static_cast<uint32_t>(slot.size());
+  t->bump(RTC_BORROWS);
   return tok;
 }
 
@@ -836,6 +882,26 @@ void rt_pool_stats(void* h, uint64_t* hits, uint64_t* misses) {
   std::lock_guard<std::mutex> lo(t->mu_out);
   *hits = t->pool_hits + t->out_hits;
   *misses = t->pool_misses + t->out_misses;
+}
+
+// Outbound frame-arena counters alone (the out-pool: rt_send/rt_broadcast
+// staging buffers), previously folded invisibly into rt_pool_stats.
+void rt_out_pool_stats(void* h, uint64_t* hits, uint64_t* misses) {
+  auto* t = static_cast<Transport*>(h);
+  std::lock_guard<std::mutex> lo(t->mu_out);
+  *hits = t->out_hits;
+  *misses = t->out_misses;
+}
+
+// --- observability counter block -------------------------------------------
+
+int32_t rt_counters_version(void) { return kCountersVersion; }
+int32_t rt_counters_count(void) { return RTC_COUNT; }
+// Borrowed pointer to the transport's counter block (RTC_* order), valid
+// until rt_close. Relaxed-atomic cells readable as plain uint64s.
+const uint64_t* rt_counters(void* h) {
+  auto* t = static_cast<Transport*>(h);
+  return reinterpret_cast<const uint64_t*>(t->ctrs);
 }
 
 // Writes up to cap peer ids (16 bytes each) of established peers; returns

@@ -39,6 +39,17 @@ int rt_connected(void* h, uint8_t* ids_out, int cap);
 uint16_t rt_port(void* h);
 uint64_t rt_dropped(void* h);
 void rt_pool_stats(void* h, uint64_t* hits, uint64_t* misses);
+// Outbound-frame arena counters alone (the out-pool), separate from the
+// merged rt_pool_stats view.
+void rt_out_pool_stats(void* h, uint64_t* hits, uint64_t* misses);
+// Versioned, append-only observability counter block: a borrowed pointer
+// to rt_counters_count() uint64 cells, valid until rt_close. Indices are
+// ABI (RTC_* in transport.cpp); new counters append and bump the
+// version. Cells are relaxed atomics — reads are monotonic, not a
+// consistent snapshot.
+int32_t rt_counters_version(void);
+int32_t rt_counters_count(void);
+const uint64_t* rt_counters(void* h);
 // Stop the io loop and unblock rt_recv callers WITHOUT freeing the
 // handle; call before rt_close when a reader thread may be inside
 // rt_recv.

@@ -34,6 +34,25 @@ from rabia_tpu.native import load_library
 
 _RECV_BUF_CAP = 16 * 1024 * 1024  # matches the native 16MiB frame cap
 
+# Names of the native transport's observability counter block, in RTC_*
+# index order (transport.cpp). Versioned append-only: a newer library may
+# expose more (ignored here), an older one fewer (read as 0).
+RT_COUNTER_NAMES = (
+    "frames_in",
+    "bytes_in",
+    "frames_out",
+    "bytes_out",
+    "inbox_dropped",
+    "out_pool_hits",
+    "out_pool_misses",
+    "in_pool_hits",
+    "in_pool_misses",
+    "arena_borrows",
+    "dials",
+    "conns_established",
+    "conns_closed",
+)
+
 
 def _id_bytes(node: NodeId) -> bytes:
     return node.value.bytes
@@ -114,6 +133,10 @@ class TcpNetwork(NetworkTransport):
         # error (RuntimeError), not a silent hang.
         self._loop = asyncio.get_running_loop()
         self._closed = False
+        # counter state frozen at close (late scrapes read these instead
+        # of the freed native Transport)
+        self._final_ctrs: dict[str, int] = {}
+        self._final_out_pool: tuple[int, int] = (0, 0)
         self._recv_buf = (ctypes.c_uint8 * _RECV_BUF_CAP)()
         self._sender_buf = (ctypes.c_uint8 * 16)()
         # zero-copy recv engages when the native library exports the
@@ -317,7 +340,10 @@ class TcpNetwork(NetworkTransport):
 
     @property
     def pool_stats(self) -> tuple[int, int]:
-        """(hits, misses) of the native buffer arena (C10 PoolStats)."""
+        """(hits, misses) of the native buffer arena (C10 PoolStats).
+
+        Merged view: inbound landing buffers + the outbound frame arena.
+        Use :attr:`out_pool_stats` for the outbound arena alone."""
         if not self._handle:
             return (0, 0)
         hits = ctypes.c_uint64()
@@ -326,6 +352,45 @@ class TcpNetwork(NetworkTransport):
             self._handle, ctypes.byref(hits), ctypes.byref(misses)
         )
         return int(hits.value), int(misses.value)
+
+    @property
+    def out_pool_stats(self) -> tuple[int, int]:
+        """(hits, misses) of the OUTBOUND frame arena alone — the
+        rt_send/rt_broadcast staging buffers transport.cpp recycles
+        (previously collected natively but unreadable from Python).
+        After close, reports the values frozen at teardown."""
+        h = self._handle  # read ONCE: close() swaps it to None
+        if not h or not hasattr(self._lib, "rt_out_pool_stats"):
+            return self._final_out_pool
+        hits = ctypes.c_uint64()
+        misses = ctypes.c_uint64()
+        self._lib.rt_out_pool_stats(
+            h, ctypes.byref(hits), ctypes.byref(misses)
+        )
+        return int(hits.value), int(misses.value)
+
+    def transport_counters(self) -> dict[str, int]:
+        """The native observability counter block as ``{name: value}``
+        (RT_COUNTER_NAMES order; see docs/OBSERVABILITY.md). Values are
+        relaxed-atomic reads — monotonic, not a consistent snapshot.
+        After close, reports the block frozen at teardown. A scrape
+        thread must not race ``close()`` itself (the gateway closes its
+        HTTP shim before its transport for exactly that reason)."""
+        h = self._handle  # read ONCE: close() swaps it to None
+        if not h:
+            return dict(self._final_ctrs)
+        if not hasattr(self._lib, "rt_counters"):
+            return {}
+        n = int(self._lib.rt_counters_count())
+        addr = self._lib.rt_counters(h)
+        if not addr:
+            return {}
+        cells = (ctypes.c_uint64 * n).from_address(addr)
+        return {
+            name: int(cells[i])
+            for i, name in enumerate(RT_COUNTER_NAMES)
+            if i < n
+        }
 
     async def disconnect(self, node: NodeId) -> None:
         self.remove_peer(node)
@@ -342,6 +407,10 @@ class TcpNetwork(NetworkTransport):
         # rt_close deletes the Transport, so a reader still inside rt_recv
         # would be a use-after-free
         self._closed = True
+        # freeze the final counter state while the native handle is still
+        # valid — post-close scrapes read these copies
+        self._final_ctrs = self.transport_counters()
+        self._final_out_pool = self.out_pool_stats
         loop = asyncio.get_running_loop()
         # stop the native io loop first: this makes any in-flight rt_recv
         # return immediately (-1), so the reader exits promptly

@@ -1,0 +1,40 @@
+"""Unified observability plane: metrics registry, anomaly journal, admin
+HTTP shim.
+
+One pull-based surface per replica process component:
+
+- :class:`MetricsRegistry` — counters, gauges and fixed-bucket histograms
+  with a Prometheus-text exporter. Counters/gauges may be *source-backed*
+  (a zero-arg callable read at collect time), which is how the native C
+  counter blocks (hostkernel rk tick context, transport.cpp) surface
+  without per-event Python cost: the block is read zero-copy via ctypes
+  when a scrape happens, never on the hot path.
+- :class:`AnomalyJournal` — bounded structured journal of operational
+  anomalies (sync overtakes, slow ticks, stale storms, redial churn),
+  queryable from the gateway admin endpoint.
+- :class:`AdminHTTPServer` — a tiny stdlib HTTP shim serving
+  ``/metrics`` (Prometheus text), ``/healthz`` (JSON) and ``/journal``
+  (JSON) for scrapers that do not speak the native framed transport.
+
+The metric name taxonomy is documented in docs/OBSERVABILITY.md.
+"""
+
+from rabia_tpu.obs.journal import AnomalyJournal
+from rabia_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS,
+    MetricsRegistry,
+)
+from rabia_tpu.obs.http import AdminHTTPServer
+
+__all__ = [
+    "AdminHTTPServer",
+    "AnomalyJournal",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "LATENCY_BUCKETS",
+    "MetricsRegistry",
+]

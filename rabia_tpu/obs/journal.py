@@ -1,0 +1,52 @@
+"""Bounded structured anomaly journal.
+
+Operational anomalies — events an operator wants the last N of, with
+context, not just a counter: sync overtakes, slow ticks, stale-vote
+storms, redial churn, quorum transitions. Appended by the engine's event
+paths (never the per-tick hot loop), queried through the gateway admin
+endpoint (``/journal``) and folded into ``/healthz`` as per-kind counts.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter as _TallyCounter
+from collections import deque
+from typing import Optional
+
+
+class AnomalyJournal:
+    """Ring of the last ``cap`` anomalies + total per-kind tallies."""
+
+    # canonical kinds (free-form kinds are allowed; these are the ones the
+    # engine emits — see docs/OBSERVABILITY.md for the schema)
+    SYNC_OVERTAKE = "sync_overtake"
+    SLOW_TICK = "slow_tick"
+    STALE_STORM = "stale_storm"
+    REDIAL_CHURN = "redial_churn"
+    QUORUM_LOST = "quorum_lost"
+    QUORUM_RESTORED = "quorum_restored"
+
+    def __init__(self, cap: int = 256) -> None:
+        self.cap = cap
+        self._ring: deque[dict] = deque(maxlen=cap)
+        self.tallies: _TallyCounter = _TallyCounter()
+
+    def record(self, kind: str, **detail) -> None:
+        self.tallies[kind] += 1
+        self._ring.append({"ts": time.time(), "kind": kind, **detail})
+
+    def snapshot(
+        self, limit: int = 64, kind: Optional[str] = None
+    ) -> list[dict]:
+        """Most-recent-last list of journal entries (filtered by kind)."""
+        items = [
+            e for e in self._ring if kind is None or e["kind"] == kind
+        ]
+        return items[-limit:]
+
+    def counts(self) -> dict[str, int]:
+        return dict(self.tallies)
+
+    def __len__(self) -> int:
+        return len(self._ring)

@@ -53,6 +53,7 @@ import numpy as np
 
 from rabia_tpu.core.errors import RabiaError, ValidationError
 from rabia_tpu.core.state_machine import StateMachine
+from rabia_tpu.core.tracing import device_annotation
 from rabia_tpu.core.types import (
     ABSENT,
     V0,
@@ -409,6 +410,32 @@ class MeshEngine:
         # which per-cycle samples cannot see. Collected in device mode
         # regardless of governing; reported via governor_stats
         self._lat_settle: deque[float] = deque(maxlen=64)
+        # observability (rabia_tpu/obs): the mesh plane's slice of the
+        # commit-pipeline breakdown — window dispatch→settle histogram
+        # plus pull gauges; same registry shape as RabiaEngine.metrics
+        from rabia_tpu.obs import MetricsRegistry
+
+        m = self.metrics = MetricsRegistry()
+        self._h_window_settle = m.histogram(
+            "commit_stage_seconds",
+            "Device window dispatch→settle latency (the mesh plane's "
+            "propose→apply span)",
+            {"stage": "window_settle"},
+        )
+        m.gauge("mesh_window", "Current window size", fn=lambda: self.window)
+        m.counter(
+            "mesh_window_resizes_total", "Governor window resizes",
+            fn=lambda: self.window_resizes,
+        )
+        m.counter(
+            "engine_decided_total", "Slots decided (bulk device lane)",
+            {"value": "v1"}, fn=lambda: self.decided_v1,
+        )
+        m.gauge(
+            "mesh_device_lane_active",
+            "1 while the device-resident KV lane is serving windows",
+            fn=lambda: 1 if self._dev_active else 0,
+        )
         self._lat_saturated = False
         # set by _govern when the target is below the measured floor at
         # min_window (no window size can meet it); see governor_stats()
@@ -993,10 +1020,11 @@ class MeshEngine:
         # rolls back every optimistic window (the programs are
         # functional — nothing was adopted) and demotes.
         state_base = self._dev_chain_base()
-        new_state, flags_dev = self._dev.decide_apply(
-            self.alive, base, depth, ops, W=W,
-            max_phases=self.max_phases, state=state_base,
-        )
+        with device_annotation("rabia.devkv.decide_apply"):
+            new_state, flags_dev = self._dev.decide_apply(
+                self.alive, base, depth, ops, W=W,
+                max_phases=self.max_phases, state=state_base,
+            )
         # a new (W, widths) signature compiles inside this dispatch —
         # seconds of jit, not window latency
         self._lat_invalidate |= (
@@ -1211,9 +1239,9 @@ class MeshEngine:
         # Compile-tainted windows are excluded (one-off jit machinery,
         # not steady-state latency)
         if not rec.get("lat_taint"):
-            self._lat_settle.append(
-                (time.perf_counter() - rec["t0"]) * 1e3
-            )
+            dt = time.perf_counter() - rec["t0"]
+            self._lat_settle.append(dt * 1e3)
+            self._h_window_settle.observe(dt)
         # "get" windows are read-only: new_state is the (unchanged)
         # state they chained on, so adopting is a no-op by value and
         # keeps the pipe invariant uniform
@@ -1417,10 +1445,13 @@ class MeshEngine:
         base = np.zeros(self.S, np.int32)
         base[:n] = self.next_slot
         state_base = self._dev_chain_base()
-        all_v1_d, found_d, ver_d, vlen_d, valw_d = self._dev.lookup_window(
-            self.alive, base, depth, packed, W=W,
-            max_phases=self.max_phases, state=state_base,
-        )
+        with device_annotation("rabia.devkv.lookup_window"):
+            all_v1_d, found_d, ver_d, vlen_d, valw_d = (
+                self._dev.lookup_window(
+                    self.alive, base, depth, packed, W=W,
+                    max_phases=self.max_phases, state=state_base,
+                )
+            )
         self._lat_invalidate |= (
             self._dev.compiled_on_last_call and self._lat_timing
         )
@@ -1490,10 +1521,11 @@ class MeshEngine:
         base = np.zeros(self.S, np.int32)
         base[:n] = self.next_slot
         state_base = self._dev_chain_base()
-        new_state, flags_dev, meta_dev, gval_dev = self._dev.mixed_apply(
-            self.alive, base, count, kind, get_waves, ops, W=W,
-            max_phases=self.max_phases, state=state_base,
-        )
+        with device_annotation("rabia.devkv.mixed_apply"):
+            new_state, flags_dev, meta_dev, gval_dev = self._dev.mixed_apply(
+                self.alive, base, count, kind, get_waves, ops, W=W,
+                max_phases=self.max_phases, state=state_base,
+            )
         self._lat_invalidate |= (
             self._dev.compiled_on_last_call and self._lat_timing
         )
@@ -1833,13 +1865,14 @@ class MeshEngine:
         discarded speculative dispatch is not a cycle."""
         import jax.numpy as jnp
 
-        return self.kernel.slot_window(
-            jnp.asarray(votes),
-            self.kernel.place(jnp.asarray(self.alive)),
-            jnp.asarray(base),
-            n_slots=W,
-            max_phases=self.max_phases,
-        )
+        with device_annotation("rabia.mesh.slot_window"):
+            return self.kernel.slot_window(
+                jnp.asarray(votes),
+                self.kernel.place(jnp.asarray(self.alive)),
+                jnp.asarray(base),
+                n_slots=W,
+                max_phases=self.max_phases,
+            )
 
     def _run_window_multihost(
         self, votes: np.ndarray, base: np.ndarray, W: int

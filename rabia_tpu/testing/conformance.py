@@ -139,7 +139,11 @@ async def _run_transport_schedule(
     tag: str,
 ):
     """One transport-plane cluster through `schedule`; returns
-    (decisions{shard: {slot: value}}, state digest bytes, native_active)."""
+    (decisions{shard: {slot: value}}, state digest bytes, native_active,
+    obs) where ``obs`` is {"parity": deterministic counter subset,
+    "context": cheap non-deterministic tick counters} — parity is what
+    the tick-path gate asserts on; both land in the divergence message
+    (which the fuzz prints beside the repro seed)."""
     from rabia_tpu.core.config import RabiaConfig
     from rabia_tpu.core.network import ClusterConfig
     from rabia_tpu.core.state_machine import InMemoryStateMachine
@@ -201,7 +205,31 @@ async def _run_transport_schedule(
             sm.create_snapshot().data == snap for sm in sms
         ), f"{tag}: replicas diverged"
         native = all(e._rk is not None for e in engines)
-        return decisions, snap, native
+        # Counter context: BOTH tick paths feed the same metric names
+        # (rk counter block on native, _py_* event tallies on Python) —
+        # the deterministic subset below must agree across paths on a
+        # fixed schedule; the rest (frame/tick counts ride retransmit
+        # timing) is carried for triage only.
+        e0 = engines[0]
+        rk = e0._rk
+        obs = {
+            "parity": {
+                "decided_v1": int(e0.rt.decided_v1),
+                "decided_v0": int(e0.rt.decided_v0),
+                "state_version": int(e0.rt.state_version),
+            },
+            "context": {
+                "ticks": int(e0._tick_count),
+                "stale": e0._py_stale
+                + (rk.counter("stale_votes") if rk else 0),
+                "frames": {
+                    k: e0._py_frames[k]
+                    + (rk.counter(f"frames_{k}") if rk else 0)
+                    for k in ("vote1", "vote2", "decision")
+                },
+            },
+        }
+        return decisions, snap, native, obs
     finally:
         for e in engines:
             await e.shutdown()
@@ -231,8 +259,10 @@ async def run_schedule_on_both_tick_paths(
 
     prev = os.environ.pop("RABIA_PY_TICK", None)
     try:
-        dec_native, snap_native, native = await _run_transport_schedule(
-            schedule, n_shards, n_replicas, tag=f"{tag}[native]"
+        dec_native, snap_native, native, obs_native = (
+            await _run_transport_schedule(
+                schedule, n_shards, n_replicas, tag=f"{tag}[native]"
+            )
         )
         if require_native:
             assert native, (
@@ -240,7 +270,7 @@ async def run_schedule_on_both_tick_paths(
                 "failure?) — conformance gate would be vacuous"
             )
         os.environ["RABIA_PY_TICK"] = "1"
-        dec_py, snap_py, _ = await _run_transport_schedule(
+        dec_py, snap_py, _, obs_py = await _run_transport_schedule(
             schedule, n_shards, n_replicas, tag=f"{tag}[python]"
         )
     finally:
@@ -248,10 +278,24 @@ async def run_schedule_on_both_tick_paths(
             os.environ.pop("RABIA_PY_TICK", None)
         else:
             os.environ["RABIA_PY_TICK"] = prev
+    ctx = (
+        f"counters[native]={obs_native['parity']} "
+        f"counters[python]={obs_py['parity']} "
+        f"context[native]={obs_native['context']} "
+        f"context[python]={obs_py['context']}"
+    )
     assert dec_native == dec_py, (
         f"{tag}: decision ledgers diverge across tick paths "
-        f"(native={dec_native}, python={dec_py})"
+        f"(native={dec_native}, python={dec_py}); {ctx}"
     )
     assert snap_native == snap_py, (
-        f"{tag}: replica state diverges across tick paths"
+        f"{tag}: replica state diverges across tick paths; {ctx}"
+    )
+    # counter parity: the deterministic subset of the shared metric
+    # namespace must agree across tick paths on an identical schedule
+    assert obs_native["parity"] == obs_py["parity"], (
+        f"{tag}: counter parity broken across tick paths; {ctx}"
+    )
+    assert obs_native["parity"]["decided_v1"] > 0, (
+        f"{tag}: no decisions recorded — vacuous schedule"
     )

@@ -336,9 +336,19 @@ async def _trial_tick_paths(seed: int) -> None:
                 for s in covered
             }
         )
-    await run_schedule_on_both_tick_paths(
-        schedule, n_shards=S, n_replicas=R, tag=f"tick seed={seed}"
-    )
+    try:
+        await run_schedule_on_both_tick_paths(
+            schedule, n_shards=S, n_replicas=R, tag=f"tick seed={seed}"
+        )
+    except AssertionError as e:
+        # triage context: the gate embeds the deterministic counter
+        # subset for both paths in its message — surface it loudly next
+        # to the repro seed so a CI failure carries the counter deltas
+        print(
+            f"tick-path divergence (seed={seed}, S={S}, R={R}): {e}",
+            file=sys.stderr,
+        )
+        raise
 
 
 def main() -> int:

@@ -467,6 +467,99 @@ class TestGatewayChaos:
             await cluster.stop()
 
 
+class TestGatewayObservability:
+    @pytest.mark.asyncio
+    async def test_metrics_and_healthz_from_live_tcp_cluster(self):
+        """Acceptance gate of the observability plane: a 3-replica TCP
+        cluster serves Prometheus-text /metrics (with nonzero native-tick
+        counters when the native path is live) and /healthz reflecting
+        decided/applied frontiers — over BOTH surfaces: framed admin
+        requests on the gateway's native transport and the stdlib HTTP
+        shim."""
+        import json
+        import urllib.request
+
+        from rabia_tpu.core.messages import AdminKind
+        from rabia_tpu.gateway import admin_fetch
+
+        cluster = await _spin_up(
+            gateway_config=GatewayConfig(http_port=0)
+        )
+        try:
+            client = RabiaClient(cluster.endpoints())
+            await client.connect()
+            writes = 6
+            for i in range(writes):
+                key = f"obs{i}"
+                await client.submit(
+                    _shard(key), [encode_set_bin(key, f"v{i}")]
+                )
+            # read once so the read-index counters move too
+            await client.get(_shard("obs0"), "obs0")
+            await client.close()
+
+            # -- framed admin surface (native transport) ----------------
+            ep = cluster.endpoint(0)
+            text = (
+                await admin_fetch(ep.host, ep.port, int(AdminKind.METRICS))
+            ).decode()
+            assert text.endswith("\n")
+            lines = [
+                ln for ln in text.splitlines()
+                if ln and not ln.startswith("#")
+            ]
+            # well-formed exposition: every sample line is "name value"
+            for ln in lines:
+                name, _, value = ln.rpartition(" ")
+                assert name and float(value) is not None, ln
+            sample = {
+                ln.rpartition(" ")[0]: float(ln.rpartition(" ")[2])
+                for ln in lines
+            }
+            assert sample['rabia_engine_decided_total{value="v1"}'] >= writes
+            assert sample["rabia_gateway_submits_total"] >= writes
+            assert sample["rabia_gateway_reads_total"] >= 1
+            assert sample["rabia_engine_has_quorum"] == 1
+            if cluster.engines[0]._rk is not None:
+                # native tick live: the rk counter block must be nonzero
+                # through the shared tick metric names
+                frames = sum(
+                    sample[f'rabia_tick_frames_total{{kind="{k}"}}']
+                    for k in ("vote1", "vote2", "decision")
+                )
+                assert frames > 0
+                assert sample["rabia_tick_native_out_frames_total"] > 0
+            health = json.loads(
+                await admin_fetch(ep.host, ep.port, int(AdminKind.HEALTH))
+            )
+            assert health["status"] == "ok" and health["has_quorum"]
+            assert sum(health["applied_frontier"]) >= writes
+            assert (
+                sum(health["decided_frontier"])
+                >= sum(health["applied_frontier"])
+            )
+            journal = json.loads(
+                await admin_fetch(ep.host, ep.port, int(AdminKind.JOURNAL))
+            )
+            assert isinstance(journal["anomalies"], list)
+
+            # -- HTTP shim ----------------------------------------------
+            port = cluster.gateways[0].http_port
+            assert port > 0
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ) as r:
+                http_text = r.read().decode()
+            assert r.headers["Content-Type"].startswith("text/plain")
+            assert 'rabia_engine_decided_total{value="v1"}' in http_text
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ) as r:
+                assert json.loads(r.read())["status"] == "ok"
+        finally:
+            await cluster.stop()
+
+
 class TestGatewayProtocolFrames:
     def test_frame_roundtrips(self):
         """Envelope round-trip of all four client frame kinds through the
@@ -474,6 +567,8 @@ class TestGatewayProtocolFrames:
         from rabia_tpu.core.serialization import BinarySerializer
         from rabia_tpu.core.messages import ProtocolMessage
         from rabia_tpu.core.types import NodeId
+
+        from rabia_tpu.core.messages import AdminRequest, AdminResponse
 
         cid = uuid.uuid4()
         frames = [
@@ -485,6 +580,8 @@ class TestGatewayProtocolFrames:
                    payload=(b"resp",)),
             ReadIndex(mode=int(ReadIndexMode.REPLY), client_id=cid,
                       seq=3, frontier=(5, 0, 12)),
+            AdminRequest(kind=1, nonce=42),
+            AdminResponse(nonce=42, status=0, body=b"# TYPE x counter\n"),
         ]
         s = BinarySerializer()
         for p in frames:

@@ -1,0 +1,298 @@
+"""Observability plane gates (rabia_tpu/obs + the native counter blocks).
+
+- histogram bucket math: cumulative ``le`` semantics, quantile estimator,
+  exposition rendering;
+- registry registration identity (idempotent) and source-backed reads;
+- anomaly journal bounds + tallies;
+- tracer fold-in (one report shape);
+- the stdlib HTTP shim end-to-end;
+- the hostkernel rk counter block: versioned, nonzero after native-tick
+  traffic, zero-copy view tracks the C side;
+- the transport counter block surfaced through TcpNetwork.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from rabia_tpu.obs import (
+    AdminHTTPServer,
+    AnomalyJournal,
+    MetricsRegistry,
+)
+
+
+class TestHistogram:
+    def test_bucket_math_cumulative(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat_seconds", buckets=(0.001, 0.01, 0.1, 1.0))
+        for v in (0.0005, 0.0009, 0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+        assert h.count == 6
+        assert h.counts == [2, 1, 1, 1]  # per-bucket, non-cumulative
+        assert abs(h.sum - 5.5564) < 1e-9
+        text = m.render_prometheus()
+        # cumulative le semantics in the exposition
+        assert 'rabia_lat_seconds_bucket{le="0.001"} 2' in text
+        assert 'rabia_lat_seconds_bucket{le="0.01"} 3' in text
+        assert 'rabia_lat_seconds_bucket{le="0.1"} 4' in text
+        assert 'rabia_lat_seconds_bucket{le="1"} 5' in text
+        assert 'rabia_lat_seconds_bucket{le="+Inf"} 6' in text
+        assert "rabia_lat_seconds_count 6" in text
+
+    def test_quantile_interpolates(self):
+        m = MetricsRegistry()
+        h = m.histogram("q_seconds", buckets=(1.0, 2.0, 4.0))
+        for _ in range(100):
+            h.observe(1.5)  # all in the (1, 2] bucket
+        q = h.quantile(0.5)
+        assert 1.0 <= q <= 2.0
+        # values above the top bound never extrapolate past it
+        h2 = m.histogram("q2_seconds", buckets=(1.0,))
+        h2.observe(100.0)
+        assert h2.quantile(0.99) == 1.0
+
+    def test_empty_quantile_is_zero(self):
+        h = MetricsRegistry().histogram("e_seconds", buckets=(1.0,))
+        assert h.quantile(0.5) == 0.0
+        assert h.snapshot()["count"] == 0
+
+
+class TestRegistry:
+    def test_registration_identity_idempotent(self):
+        m = MetricsRegistry()
+        a = m.counter("c_total", labels={"k": "x"})
+        b = m.counter("c_total", labels={"k": "x"})
+        c = m.counter("c_total", labels={"k": "y"})
+        assert a is b and a is not c
+        a.inc(3)
+        assert b.value() == 3
+
+    def test_reregistration_rebinds_source(self):
+        """A component restarted over the same registry (gateway over a
+        surviving engine) must re-bind the exported source — not leave
+        the metric reading (and pinning) its dead predecessor."""
+        m = MetricsRegistry()
+        old = m.gauge("comp_state", fn=lambda: 1)
+        new = m.gauge("comp_state", fn=lambda: 2)
+        assert new is old  # identity-deduped ...
+        assert old.value() == 2  # ... but reading the NEW source
+
+    def test_source_backed_counter_sums_fn_and_local(self):
+        m = MetricsRegistry()
+        cell = {"v": 10}
+        c = m.counter("src_total", fn=lambda: cell["v"])
+        c.inc(5)
+        assert c.value() == 15
+        cell["v"] = 20
+        assert c.value() == 25
+
+    def test_gauge_fn_failure_falls_back(self):
+        m = MetricsRegistry()
+
+        def boom():
+            raise RuntimeError("dead source")
+
+        g = m.gauge("g", fn=boom)
+        g.set(7)  # last explicit value survives a dead source
+        assert g.value() == 7
+
+    def test_snapshot_flat_shape(self):
+        m = MetricsRegistry()
+        m.counter("a_total").inc(2)
+        h = m.histogram("h_seconds", buckets=(1.0,))
+        h.observe(0.5)
+        snap = m.snapshot()
+        assert snap["rabia_a_total"] == 2
+        assert snap["rabia_h_seconds_count"] == 1
+
+    def test_tracer_folds_into_exposition(self):
+        from rabia_tpu.core.tracing import Tracer
+
+        t = Tracer(enabled=True)
+        t.record("engine.tick.drain", 0.002)
+        m = MetricsRegistry()
+        m.attach_tracer(t)
+        text = m.render_prometheus()
+        assert 'rabia_span_seconds_count{span="engine.tick.drain"} 1' in text
+        snap = m.snapshot()
+        assert (
+            snap['rabia_span_seconds{span="engine.tick.drain"}_count'] == 1
+        )
+
+    def test_label_escaping(self):
+        m = MetricsRegistry()
+        m.counter("esc_total", labels={"k": 'a"b\\c'}).inc()
+        text = m.render_prometheus()
+        assert 'k="a\\"b\\\\c"' in text
+
+
+class TestJournal:
+    def test_bounded_ring_and_tallies(self):
+        j = AnomalyJournal(cap=4)
+        for i in range(10):
+            j.record(j.SLOW_TICK, i=i)
+        assert len(j) == 4
+        assert j.counts()[j.SLOW_TICK] == 10  # tallies survive eviction
+        snap = j.snapshot()
+        assert [e["i"] for e in snap] == [6, 7, 8, 9]
+        j.record(j.SYNC_OVERTAKE, shard=1)
+        assert [e["kind"] for e in j.snapshot(kind=j.SYNC_OVERTAKE)] == [
+            j.SYNC_OVERTAKE
+        ]
+
+
+class TestHTTPShim:
+    def test_serves_metrics_health_journal(self):
+        m = MetricsRegistry()
+        m.counter("up_total").inc()
+        j = AnomalyJournal()
+        j.record(j.REDIAL_CHURN, dials=9)
+        srv = AdminHTTPServer(
+            m, health_fn=lambda: {"status": "ok", "x": 1}, journal=j
+        )
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+                assert r.status == 200
+                assert "rabia_up_total 1" in r.read().decode()
+            with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+                assert json.loads(r.read())["x"] == 1
+            with urllib.request.urlopen(base + "/journal", timeout=5) as r:
+                doc = json.loads(r.read())
+                assert doc["anomalies"][0]["dials"] == 9
+            try:
+                urllib.request.urlopen(base + "/nope", timeout=5)
+                raise AssertionError("404 expected")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.close()
+
+    def test_degraded_health_is_503(self):
+        m = MetricsRegistry()
+        srv = AdminHTTPServer(m, health_fn=lambda: {"status": "degraded"})
+        try:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+                )
+                raise AssertionError("503 expected")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert json.loads(e.read())["status"] == "degraded"
+        finally:
+            srv.close()
+
+
+class TestNativeCounterBlocks:
+    @pytest.mark.asyncio
+    async def test_rk_counter_block_nonzero_after_traffic(self):
+        """A native-tick cluster run leaves nonzero rk_* counters, read
+        zero-copy from the C block, and the engine registry exports them
+        under the shared tick metric names."""
+        from rabia_tpu.native.build import load_hostkernel
+
+        lib = load_hostkernel()
+        if lib is None or not hasattr(lib, "rk_counters"):
+            pytest.skip("native hostkernel unavailable")
+        assert int(lib.rk_counters_version()) >= 1
+        from rabia_tpu.engine.native_tick import RK_COUNTER_NAMES
+
+        assert int(lib.rk_counters_count()) >= len(RK_COUNTER_NAMES)
+
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.core.types import Command, CommandBatch, NodeId
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.net import InMemoryHub
+
+        cfg = RabiaConfig(
+            phase_timeout=2.0, heartbeat_interval=0.05, round_interval=0.001
+        ).with_kernel(num_shards=1, shard_pad_multiple=1)
+        hub = InMemoryHub()
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        engines = [
+            RabiaEngine(
+                ClusterConfig.new(n, nodes),
+                InMemoryStateMachine(),
+                hub.register(n),
+                config=cfg,
+            )
+            for n in nodes
+        ]
+        if any(e._rk is None for e in engines):
+            pytest.skip("native tick inactive")
+        tasks = [asyncio.ensure_future(e.run()) for e in engines]
+        try:
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                sts = [await e.get_statistics() for e in engines]
+                if all(s.has_quorum for s in sts):
+                    break
+            for i in range(4):
+                fut = await engines[0].submit_batch(
+                    CommandBatch.new([Command.new(f"SET k{i} v".encode())])
+                )
+                assert await asyncio.wait_for(fut, 15.0) == [b"OK"]
+            e0 = engines[0]
+            ctrs = e0._rk.counters_dict()
+            assert ctrs["ticks"] > 0
+            assert ctrs["stages"] > 0
+            assert ctrs["out_frames"] > 0
+            assert ctrs["ledger_scatters"] > 0
+            assert (
+                ctrs["frames_vote1"] + ctrs["frames_vote2"]
+                + ctrs["frames_decision"]
+            ) > 0
+            snap = e0.metrics.snapshot()
+            frames = sum(
+                snap[f'rabia_tick_frames_total{{kind="{k}"}}']
+                for k in ("vote1", "vote2", "decision")
+            )
+            assert frames > 0
+            assert snap['rabia_engine_decided_total{value="v1"}'] >= 4
+        finally:
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    @pytest.mark.asyncio
+    async def test_transport_counter_block(self):
+        from rabia_tpu.core.config import TcpNetworkConfig
+        from rabia_tpu.core.types import NodeId
+        from rabia_tpu.net.tcp import RT_COUNTER_NAMES, TcpNetwork
+
+        from netwait import wait_connected
+
+        a, b = NodeId.from_int(1), NodeId.from_int(2)
+        ta = TcpNetwork(a, TcpNetworkConfig(bind_port=0))
+        tb = TcpNetwork(b, TcpNetworkConfig(bind_port=0))
+        try:
+            assert int(ta._lib.rt_counters_version()) >= 1
+            assert int(ta._lib.rt_counters_count()) >= len(RT_COUNTER_NAMES)
+            ta.add_peer(b, "127.0.0.1", tb.port)
+            tb.add_peer(a, "127.0.0.1", ta.port)
+            await wait_connected((ta, b), (tb, a))
+            for i in range(8):
+                await ta.send_to(b, b"frame %d" % i)
+            for _ in range(8):
+                await tb.receive(timeout=5.0)
+            ca, cb = ta.transport_counters(), tb.transport_counters()
+            assert ca["dials"] >= 1
+            assert ca["conns_established"] >= 1
+            assert ca["frames_out"] >= 8
+            assert cb["frames_in"] >= 8
+            assert cb["bytes_in"] >= 8 * len(b"frame 0")
+        finally:
+            await ta.close()
+            await tb.close()
+        # post-close reads serve the teardown-frozen block, never crash
+        assert ta.transport_counters()["frames_out"] >= 8
