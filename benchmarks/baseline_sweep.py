@@ -505,6 +505,10 @@ async def config3_kvstore_4096_batched(baselines) -> None:
     top8, _ = await _committed(engines)
     dt8 = time.perf_counter() - t1
     await _stop(engines, tasks)
+    # this config's OWN obs snapshot: the optional vector side-phase
+    # below stops another cluster, which would overwrite the module
+    # global and misattribute its counters to this config's doc
+    kv_obs = _LAST_OBS
 
     # (c) same geometry on the columnar store (VectorShardedKV) — the
     # S-axis-native apply plane; the classic per-op store above is the
@@ -526,6 +530,7 @@ async def config3_kvstore_4096_batched(baselines) -> None:
         await _stop(engines_v, tasks_v)
     except Exception as e:
         print(f"config3 vector phase failed: {e!r}", file=sys.stderr)
+    globals()["_LAST_OBS"] = kv_obs
     return _emit(
         "3:kvstore_5rep_4096shards_adaptive",
         rate,

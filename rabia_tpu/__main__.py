@@ -17,6 +17,10 @@ Usage:
     python -m rabia_tpu --selftest         # + compile and run the mini stack
     python -m rabia_tpu stats <host:port>  # scrape a gateway's /metrics
     python -m rabia_tpu stats <host:port> --kind health|journal
+    python -m rabia_tpu stats <host:port> --kind journal \\
+        --journal-kind slow_tick --last 10
+    python -m rabia_tpu trace <host:port> [host:port ...] \\
+        --client <uuid> --seq <n>          # cross-replica commit timeline
 """
 
 from __future__ import annotations
@@ -105,7 +109,20 @@ def _selftest() -> int:
     return 0
 
 
-def _stats(addr: str, kind: str, timeout: float) -> int:
+def _parse_addr(addr: str) -> tuple[str, int] | None:
+    host, _, port_s = addr.rpartition(":")
+    if not host or not port_s.isdigit():
+        return None
+    return host, int(port_s)
+
+
+def _stats(
+    addr: str,
+    kind: str,
+    timeout: float,
+    journal_kind: str | None = None,
+    last: int | None = None,
+) -> int:
     """Fetch one admin document from a live gateway over its native
     transport (the framed AdminRequest path — no HTTP shim required)."""
     import asyncio
@@ -114,18 +131,29 @@ def _stats(addr: str, kind: str, timeout: float) -> int:
     from rabia_tpu.core.messages import AdminKind
     from rabia_tpu.gateway import admin_fetch
 
-    host, _, port_s = addr.rpartition(":")
-    if not host or not port_s.isdigit():
+    parsed = _parse_addr(addr)
+    if parsed is None:
         print(f"stats: bad address {addr!r} (want host:port)", file=sys.stderr)
         return 2
+    host, port = parsed
     kind_code = {
         "metrics": AdminKind.METRICS,
         "health": AdminKind.HEALTH,
         "journal": AdminKind.JOURNAL,
     }[kind]
+    query = b""
+    if kind == "journal" and (journal_kind is not None or last is not None):
+        q: dict = {}
+        if journal_kind is not None:
+            q["kind"] = journal_kind
+        if last is not None:
+            q["last"] = last
+        query = json.dumps(q).encode()
     try:
         body = asyncio.run(
-            admin_fetch(host, int(port_s), int(kind_code), timeout=timeout)
+            admin_fetch(
+                host, port, int(kind_code), timeout=timeout, query=query
+            )
         )
     except Exception as e:
         print(f"stats: {type(e).__name__}: {e}", file=sys.stderr)
@@ -134,6 +162,49 @@ def _stats(addr: str, kind: str, timeout: float) -> int:
         sys.stdout.write(body.decode(errors="replace"))
     else:
         print(json.dumps(json.loads(body.decode()), indent=2))
+    return 0
+
+
+def _trace(addrs: list[str], client: str, seq: int, timeout: float) -> int:
+    """Follow one batch through the whole cluster: fetch each replica's
+    flight-ring TraceSlice (AdminKind.TRACE), align the per-replica
+    monotonic clocks off the fetch RTTs, and print one merged commit
+    timeline (submit → propose → per-peer R1/R2 votes → decide → apply →
+    result). See docs/OBSERVABILITY.md, "Cross-replica commit traces"."""
+    import asyncio
+    import uuid
+
+    from rabia_tpu.obs.flight import collect_trace, render_timeline
+
+    parsed = []
+    for a in addrs:
+        p = _parse_addr(a)
+        if p is None:
+            print(f"trace: bad address {a!r} (want host:port)",
+                  file=sys.stderr)
+            return 2
+        parsed.append(p)
+    try:
+        cid = uuid.UUID(client)
+    except ValueError:
+        print(f"trace: bad client id {client!r} (want a UUID)",
+              file=sys.stderr)
+        return 2
+    try:
+        merged = asyncio.run(
+            collect_trace(parsed, cid, seq, timeout=timeout)
+        )
+    except Exception as e:
+        print(f"trace: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if not merged:
+        print(
+            f"trace: no flight events for client={cid} seq={seq} "
+            "(command too old for the rings, or never submitted here?)",
+            file=sys.stderr,
+        )
+        return 1
+    print(render_timeline(merged))
     return 0
 
 
@@ -154,10 +225,39 @@ def main(argv=None) -> int:
         "--kind", choices=("metrics", "health", "journal"),
         default="metrics",
     )
+    sp.add_argument(
+        "--journal-kind", default=None,
+        help="journal only: filter entries by anomaly kind",
+    )
+    sp.add_argument(
+        "--last", type=int, default=None,
+        help="journal only: return the last N entries (default 64)",
+    )
     sp.add_argument("--timeout", type=float, default=10.0)
+    tp = sub.add_parser(
+        "trace",
+        help="reconstruct one command's cross-replica commit timeline "
+        "from the flight recorders",
+    )
+    tp.add_argument(
+        "addrs", nargs="+",
+        help="gateway host:port (one per replica to include)",
+    )
+    tp.add_argument(
+        "--client", required=True, help="client session id (UUID)"
+    )
+    tp.add_argument(
+        "--seq", type=int, required=True, help="client command seq"
+    )
+    tp.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
     if args.cmd == "stats":
-        return _stats(args.addr, args.kind, args.timeout)
+        return _stats(
+            args.addr, args.kind, args.timeout,
+            journal_kind=args.journal_kind, last=args.last,
+        )
+    if args.cmd == "trace":
+        return _trace(args.addrs, args.client, args.seq, args.timeout)
     rc = _report()
     if rc == 0 and args.selftest:
         rc = _selftest()

@@ -419,15 +419,28 @@ class AdminKind(enum.IntEnum):
 
     METRICS = 0  # Prometheus text exposition
     HEALTH = 1  # JSON health document
-    JOURNAL = 2  # JSON anomaly journal
+    JOURNAL = 2  # JSON anomaly journal; query filters {"kind","last"}
+    # flight-recorder TraceQuery -> TraceSlice: query names a batch via
+    # its session coordinates ({"client": hex, "seq": N} — batch ids
+    # derive deterministically from them, so no new wire fields) or
+    # directly ({"batch": hex}); the response body is the replica's
+    # flight-ring slice for that batch (obs/flight.build_trace_slice)
+    TRACE = 3
 
 
 @dataclass(frozen=True)
 class AdminRequest:
-    """Ops tooling -> gateway: fetch one admin document (read-only)."""
+    """Ops tooling -> gateway: fetch one admin document (read-only).
+
+    ``query`` is a kind-specific parameter blob (JSON by convention;
+    empty = no filters). Added for JOURNAL filters and the TRACE
+    exchange; decoders accept its absence for wire compatibility with
+    pre-trace frames.
+    """
 
     kind: int
     nonce: int = 0
+    query: bytes = b""
 
 
 @dataclass(frozen=True)

@@ -412,6 +412,7 @@ def _encode_payload(w: _Writer, payload) -> None:
     elif isinstance(payload, AdminRequest):
         w.u8(int(payload.kind))
         w.u64(payload.nonce)
+        w.blob(payload.query)
     elif isinstance(payload, AdminResponse):
         w.u64(payload.nonce)
         w.u8(int(payload.status))
@@ -541,7 +542,12 @@ def _decode_payload(msg_type: MessageType, r: _Reader):
             frontier=tuple(r.u64() for _ in range(n)),
         )
     if msg_type == MessageType.AdminRequest:
-        return AdminRequest(kind=r.u8(), nonce=r.u64())
+        kind = r.u8()
+        nonce = r.u64()
+        # trailing query blob appended for JOURNAL filters/TRACE; absent
+        # on pre-trace frames (decode stays wire-compatible both ways)
+        query = r.blob() if not r.done() else b""
+        return AdminRequest(kind=kind, nonce=nonce, query=query)
     if msg_type == MessageType.AdminResponse:
         return AdminResponse(nonce=r.u64(), status=r.u8(), body=r.blob())
     raise SerializationError(f"unknown message type {msg_type}")

@@ -55,6 +55,7 @@ from rabia_tpu.core.messages import (
     Decision,
     DecisionEntry,
     HeartBeat,
+    MessageType,
     NewBatch,
     ProposeBlock,
     ProtocolMessage,
@@ -76,6 +77,24 @@ from rabia_tpu.core.persistence import PersistedEngineState, PersistenceLayer
 from rabia_tpu.core.serialization import Serializer
 from rabia_tpu.core.state_machine import StateMachine, VectorStateMachine
 from rabia_tpu.core.tracing import span
+from rabia_tpu.obs.flight import (
+    FRE_ADVANCE,
+    FRE_APPLY,
+    FRE_CARRY,
+    FRE_CAST_R2,
+    FRE_DECIDE,
+    FRE_DROP,
+    FRE_FRAME_IN,
+    FRE_FRAME_OUT,
+    FRE_OPEN,
+    FRE_PROPOSE,
+    FRE_ROUTE1,
+    FRE_ROUTE2,
+    FRE_STALE,
+    FRE_STEP_DECIDE,
+    FRE_SUBMIT,
+    fr_hash,
+)
 from rabia_tpu.core.types import (
     ABSENT,
     V0,
@@ -480,10 +499,21 @@ class RabiaEngine:
         conformance gate can assert counter parity across tick paths."""
         from rabia_tpu.core.tracing import tracer
         from rabia_tpu.obs import AnomalyJournal, MetricsRegistry
+        from rabia_tpu.obs.flight import FlightRecorder
 
         m = self.metrics = MetricsRegistry()
         m.attach_tracer(tracer)
         self.journal = AnomalyJournal()
+        # flight recorder (docs/OBSERVABILITY.md "Flight recorder"): the
+        # Python event ring. On the native tick path the per-frame kinds
+        # live in the C ring (rk_flight); RABIA_PY_TICK=1 feeds the same
+        # kinds here; engine lifecycle events (submit/propose/decide/
+        # apply) land here on BOTH paths. flight_events() merges.
+        self.flight = FlightRecorder()
+        self._last_flight_dump = 0.0
+        # severe anomalies auto-dump the merged rings to RABIA_FLIGHT_DIR
+        # (a no-op when the env var is unset)
+        self.journal.on_severe = self._flight_autodump
         self._tick_count = 0
         self._slow_ticks = 0
         # Python-path event tallies (the RABIA_PY_TICK twin of the rk
@@ -560,6 +590,12 @@ class RabiaEngine:
         m.counter(
             "engine_syncs_total", "Snapshot syncs initiated",
             fn=lambda: self._syncs,
+        )
+        m.counter(
+            "engine_flight_records_total",
+            "Flight-recorder records written (native ring + Python ring)",
+            fn=lambda: self.flight.head
+            + (self._rk.flight_head() if self._rk is not None else 0),
         )
         # -- the per-tick pipeline (native rk counter block + Python
         #    event tallies feeding the same names) ----------------------
@@ -652,6 +688,77 @@ class RabiaEngine:
             "anomalies": self.journal.counts(),
         }
 
+    # -- flight recorder (obs/flight.py; docs/OBSERVABILITY.md) ------------
+
+    def flight_events(self) -> list[dict]:
+        """Merged flight timeline: the native tick ring (C fast path),
+        the Python event ring, and the transport's frame in/out ring,
+        sorted by monotonic ns (all three share CLOCK_MONOTONIC). Plain
+        dicts with plain ints — JSON-serializable as-is."""
+        from rabia_tpu.obs.flight import (
+            native_ring_events,
+            transport_ring_events,
+        )
+
+        evs = self.flight.snapshot()
+        if self._rk is not None:
+            evs.extend(native_ring_events(self._rk.flight_snapshot()))
+        tf = getattr(self.transport, "flight_snapshot", None)
+        if callable(tf):
+            try:
+                evs.extend(transport_ring_events(tf()))
+            except Exception:  # a closed transport must not kill a dump
+                pass
+        evs.sort(key=lambda e: e["t_ns"])
+        return evs
+
+    def dump_flight(
+        self, path: Optional[str] = None, reason: str = "manual"
+    ) -> Optional[str]:
+        """Write the merged flight timeline to disk; returns the path.
+
+        With no explicit ``path``, dumps into ``$RABIA_FLIGHT_DIR``
+        (created if missing) or returns None when the env var is unset —
+        the auto-dump hooks (severe anomalies, unclean shutdown) are
+        opt-in so test runs don't litter."""
+        from rabia_tpu.obs.flight import dump_events
+
+        if path is None:
+            d = os.environ.get("RABIA_FLIGHT_DIR")
+            if not d:
+                return None
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d,
+                f"flight_{self.node_id.short()}_"
+                f"{int(time.time() * 1000)}_{reason}.json",
+            )
+        return dump_events(
+            path,
+            self.flight_events(),
+            meta={
+                "node": str(self.node_id.value),
+                "row": int(self.me),
+                "reason": reason,
+                "native_tick": self._rk is not None,
+                "anomalies": self.journal.counts(),
+            },
+        )
+
+    def _flight_autodump(self, kind: str) -> None:
+        """Journal severe-kind hook: dump the rings while the evidence is
+        still in the window (rate-limited; no-op without the env var)."""
+        now = time.time()
+        if now - self._last_flight_dump < 5.0:
+            return
+        self._last_flight_dump = now
+        try:
+            p = self.dump_flight(reason=kind)
+            if p:
+                logger.warning("flight recorder dumped to %s (%s)", p, kind)
+        except Exception:
+            logger.exception("flight auto-dump failed")
+
     # ------------------------------------------------------------------
     # Public API (the reference's EngineCommand surface, state.rs:300-307)
     # ------------------------------------------------------------------
@@ -674,6 +781,7 @@ class RabiaEngine:
         s = int(shard) if shard is not None else int(batch.shard)
         if not (0 <= s < self.n_shards):
             raise ValidationError(f"shard {s} out of range")
+        self.flight.record(FRE_SUBMIT, shard=s, batch=fr_hash(batch.id))
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self.rt.shards[s].queue.append(PendingSubmission(batch=batch, future=fut))
         self._wake.set()  # wake the run loop: new work to propose
@@ -969,6 +1077,17 @@ class RabiaEngine:
                 # returns on wake OR timeout (timer check: heartbeats,
                 # phase timeouts) — no exception either way
                 await self._wake.wait(self._idle_wait())
+        except Exception:
+            # unclean shutdown: the run loop died on an exception — dump
+            # the flight rings while the evidence is still in the window
+            # (no-op unless RABIA_FLIGHT_DIR is set), then re-raise
+            try:
+                p = self.dump_flight(reason="unclean-shutdown")
+                if p:
+                    logger.error("flight recorder dumped to %s", p)
+            except Exception:
+                logger.exception("flight dump on unclean shutdown failed")
+            raise
         finally:
             if self._dirty:
                 await self._save_state()
@@ -1109,6 +1228,12 @@ class RabiaEngine:
                     n += 1
                 except RabiaError as e:
                     self._py_drops["malformed"] += 1
+                    srow = node_to_row.get(sender)
+                    self.flight.record(
+                        FRE_DROP,
+                        peer=srow if srow is not None else 0xFFFF,
+                        arg=3,
+                    )
                     logger.warning(
                         "dropping bad message from %s: %s", sender, e
                     )
@@ -1166,6 +1291,12 @@ class RabiaEngine:
                 n += 1
             except RabiaError as e:
                 self._py_drops["malformed"] += 1
+                srow = node_to_row.get(sender)
+                self.flight.record(
+                    FRE_DROP,
+                    peer=srow if srow is not None else 0xFFFF,
+                    arg=3,
+                )
                 logger.warning("dropping bad message from %s: %s", sender, e)
         if rk_handled:
             rk.finish_drain(self)
@@ -1178,6 +1309,10 @@ class RabiaEngine:
             # otherwise one faulty peer could forge votes as every other
             # replica row and fabricate a quorum single-handedly
             self._py_drops["spoof"] += 1
+            srow = self._node_to_row.get(sender)
+            self.flight.record(
+                FRE_DROP, peer=srow if srow is not None else 0xFFFF, arg=1
+            )
             logger.warning(
                 "dropping spoofed message: envelope %s via transport %s",
                 msg.sender,
@@ -1190,14 +1325,44 @@ class RabiaEngine:
             return
         self.rt.active_nodes.add(msg.sender)
         p = msg.payload
+        # flight: per-frame ingest records. Never double-recorded on the
+        # native path — frames the C ingest consumed (RK_HANDLED/RK_NOOP,
+        # where it wrote its own FrEvent) never reach this handler; the
+        # ones that DO arrive here are exactly those rk_ingest declined
+        # (RK_PY) before any ring write, so they must be recorded here or
+        # the trace shows votes materializing with no frame_in
         if isinstance(p, VoteRound1):
             self._py_frames["vote1"] += 1
+            if len(p):
+                self.flight.record(
+                    FRE_FRAME_IN,
+                    shard=int(p.shards[0]),
+                    slot=int(p.phases[0]) >> 16,
+                    peer=row,
+                    arg=int(MessageType.VoteRound1),
+                )
             self._ingest_vote_arrays(row, p.shards, p.phases, p.vals, 1)
         elif isinstance(p, VoteRound2):
             self._py_frames["vote2"] += 1
+            if len(p):
+                self.flight.record(
+                    FRE_FRAME_IN,
+                    shard=int(p.shards[0]),
+                    slot=int(p.phases[0]) >> 16,
+                    peer=row,
+                    arg=int(MessageType.VoteRound2),
+                )
             self._ingest_vote_arrays(row, p.shards, p.phases, p.vals, 2)
         elif isinstance(p, Decision):
             self._py_frames["decision"] += 1
+            if len(p):
+                self.flight.record(
+                    FRE_FRAME_IN,
+                    shard=int(p.shards[0]),
+                    slot=int(p.phases[0]) >> 16,
+                    peer=row,
+                    arg=int(MessageType.Decision),
+                )
             self._on_decision(p)
         elif isinstance(p, ProposeBlock):
             self._on_propose_block(row, p)
@@ -1471,7 +1636,22 @@ class RabiaEngine:
             if len(idx) == 0:
                 return
 
-        # columnar bookkeeping for the whole wave
+        # columnar bookkeeping for the whole wave. Flight records are
+        # BOUNDED per wave: this is the vectorized bulk lane (tens of
+        # thousands of decisions/s), where per-slot Python records would
+        # tax exactly the path the lane exists to keep columnar — and a
+        # full wave would churn straight through the 4096-cap ring
+        # anyway. (No batch hash either: block entries are traced by
+        # (shard, slot), not session coordinates.)
+        for j in range(min(len(idx), 64)):
+            self.flight.record(
+                FRE_DECIDE, shard=int(idx[j]), slot=int(slots[j]),
+                arg=int(vals[j]),
+            )
+            self.flight.record(
+                FRE_APPLY, shard=int(idx[j]), slot=int(slots[j]),
+                arg=int(vals[j]),
+            )
         rt.applied_upto[idx] = slots + 1
         rt.next_slot[idx] = slots + 1
         self._frontier_dirty = True
@@ -1518,6 +1698,9 @@ class RabiaEngine:
             slot = ph >> 16
             if slot < rt.applied_upto[s]:
                 self._py_stale += 1
+                self.flight.record(
+                    FRE_STALE, shard=s, slot=slot, peer=row, arg=round_no
+                )
                 self._repair_stale_sender(
                     row, shards, np.asarray([slot], np.int64)
                 )
@@ -1547,6 +1730,11 @@ class RabiaEngine:
             # the Decision (loss / heal) — answer with a targeted repair
             # instead of letting it stall into the sync path
             self._py_stale += int((~live).sum())
+            for s_st, sl_st in zip(shards[~live][:64], slots[~live][:64]):
+                self.flight.record(
+                    FRE_STALE, shard=int(s_st), slot=int(sl_st), peer=row,
+                    arg=round_no,
+                )
             self._repair_stale_sender(row, shards[~live], slots[~live])
             shards, phases, vals, slots = (
                 shards[live],
@@ -1656,6 +1844,12 @@ class RabiaEngine:
                             )
                             if led[row, s] == ABSENT:
                                 led[row, s] = vals
+                                self.flight.record(
+                                    FRE_ROUTE1 if round_no == 1
+                                    else FRE_ROUTE2,
+                                    shard=s, slot=slots, peer=row,
+                                    arg=int(vals),
+                                )
                         else:
                             plane = (
                                 self._inbox1
@@ -1664,7 +1858,17 @@ class RabiaEngine:
                             )
                             if plane[s, row] == ABSENT:
                                 plane[s, row] = vals
+                                self.flight.record(
+                                    FRE_ROUTE1 if round_no == 1
+                                    else FRE_ROUTE2,
+                                    shard=s, slot=slots, peer=row,
+                                    arg=int(vals),
+                                )
                     else:
+                        self.flight.record(
+                            FRE_CARRY, shard=s, slot=slots, peer=row,
+                            arg=round_no,
+                        )
                         carry.append((row, s, slots, mvcs, vals))
                     continue
                 live = slots >= self.rt.applied_upto[shards]
@@ -1685,6 +1889,13 @@ class RabiaEngine:
                 if cur.any():
                     sh_c = shards[cur]
                     v_c = vals[cur]
+                    sl_c = slots[cur]
+                    for j in range(len(sh_c)):
+                        self.flight.record(
+                            FRE_ROUTE1 if round_no == 1 else FRE_ROUTE2,
+                            shard=int(sh_c[j]), slot=int(sl_c[j]),
+                            peer=row, arg=int(v_c[j]),
+                        )
                     if self._host_kernel:
                         self.kernel.offer_votes(
                             self.kstate, round_no, row, sh_c, v_c
@@ -1944,6 +2155,10 @@ class RabiaEngine:
                 self._h_stage["submit_propose"].observe(
                     now - sub.submitted_at
                 )
+                self.flight.record(
+                    FRE_PROPOSE, shard=s, slot=slot,
+                    batch=fr_hash(sub.batch.id),
+                )
                 sh.payloads[sub.batch.id] = sub.batch
                 sh.buf_propose[slot] = (sub.batch.id, sub.batch)
                 propose_entries.append(
@@ -1996,6 +2211,22 @@ class RabiaEngine:
         return opened
 
     # -- the kernel round ----------------------------------------------------
+
+    def _flight_open(self, idx, slots_arr, init_arr) -> None:
+        """Flight OPEN records for slots armed outside the native tick's
+        own open path (host-kernel/jax rounds, and the native round's
+        Python-vote pre-arm, where rk_start_slots runs standalone and the
+        C ring therefore records nothing)."""
+        for j in range(len(idx)):
+            self.flight.record(
+                FRE_OPEN, shard=int(idx[j]), slot=int(slots_arr[j]),
+                arg=int(init_arr[j]),
+            )
+        if len(idx):
+            self.flight.record(
+                FRE_FRAME_OUT, shard=int(idx[0]), slot=int(slots_arr[0]),
+                arg=int(MessageType.VoteRound1),
+            )
 
     async def _kernel_round(
         self,
@@ -2064,6 +2295,7 @@ class RabiaEngine:
                     self.kstate, mask, slots_full.astype(np.int32), init_full
                 )
             self._refresh_mirrors()
+            self._flight_open(idx, slots_arr, init_arr)
             self._send(
                 VoteRound1(
                     shards=idx,
@@ -2121,6 +2353,7 @@ class RabiaEngine:
             # arm separately, then route, then chain without opens
             with span("engine.kernel.start"):
                 rk.start_slots(mask, slots_full, init_full)
+            self._flight_open(idx, slots_arr, init_arr)
             self._send(
                 VoteRound1(
                     shards=idx, phases=(slots_arr << 16), vals=init_arr
@@ -2191,6 +2424,7 @@ class RabiaEngine:
             self._decided[idx] = ABSENT
             self._done[idx] = False
             self._active[idx] = True
+            self._flight_open(idx, slots_arr, init_arr)
             self._send(
                 VoteRound1(
                     shards=idx,
@@ -2339,11 +2573,21 @@ class RabiaEngine:
             idx = cast_idx
             slots = np.asarray(self._cur_slot)[idx].astype(np.int64)
             phases = (slots << 16) | np.asarray(prev_phase)[idx].astype(np.int64)
+            r2v = np.asarray(outbox.r2_vals)[idx]
+            for j in range(len(idx)):
+                self.flight.record(
+                    FRE_CAST_R2, shard=int(idx[j]), slot=int(slots[j]),
+                    arg=int(r2v[j]),
+                )
+            self.flight.record(
+                FRE_FRAME_OUT, shard=int(idx[0]), slot=int(slots[0]),
+                arg=int(MessageType.VoteRound2),
+            )
             self._send(
                 VoteRound2(
                     shards=idx,
                     phases=phases,
-                    vals=np.asarray(outbox.r2_vals)[idx],
+                    vals=r2v,
                 )
             )
             rt.last_progress[idx] = now
@@ -2351,8 +2595,16 @@ class RabiaEngine:
         if adv_idx.size:
             idx = adv_idx
             slots = np.asarray(self._cur_slot)[idx].astype(np.int64)
-            phases = (slots << 16) | np.asarray(outbox.new_phase)[idx].astype(
-                np.int64
+            new_ph = np.asarray(outbox.new_phase)[idx].astype(np.int64)
+            phases = (slots << 16) | new_ph
+            for j in range(len(idx)):
+                self.flight.record(
+                    FRE_ADVANCE, shard=int(idx[j]), slot=int(slots[j]),
+                    arg=int(new_ph[j]) & 0xFF,
+                )
+            self.flight.record(
+                FRE_FRAME_OUT, shard=int(idx[0]), slot=int(slots[0]),
+                arg=int(MessageType.VoteRound1),
             )
             self._send(
                 VoteRound1(
@@ -2365,6 +2617,13 @@ class RabiaEngine:
 
         if done_idx.size:
             newly = np.asarray(outbox.newly_decided)[:n] & act
+            dec_vals = np.asarray(self._decided)
+            cur = np.asarray(self._cur_slot)
+            for s_new in np.nonzero(newly)[0]:
+                self.flight.record(
+                    FRE_STEP_DECIDE, shard=int(s_new),
+                    slot=int(cur[s_new]), arg=int(dec_vals[s_new]),
+                )
             self._process_decided(done, newly)
 
     def _process_outbox_window(
@@ -2400,6 +2659,11 @@ class RabiaEngine:
             if cast.any():
                 i = np.nonzero(cast)[0]
                 slots = cur_slot[i].astype(np.int64)
+                for j in range(len(i)):
+                    self.flight.record(
+                        FRE_CAST_R2, shard=int(i[j]), slot=int(slots[j]),
+                        arg=int(ob.r2_vals[k][i[j]]),
+                    )
                 self._send(
                     VoteRound2(
                         shards=i,
@@ -2409,12 +2673,23 @@ class RabiaEngine:
                 )
                 rt.last_progress[i] = now
             newly_k = ob.newly_decided[k][:n] & act
+            for s_new in np.nonzero(newly_k)[0]:
+                self.flight.record(
+                    FRE_STEP_DECIDE, shard=int(s_new),
+                    slot=int(cur_slot[s_new]),
+                    arg=int(np.asarray(self._decided)[s_new]),
+                )
             newly_any |= newly_k
             cum_done |= newly_k
             adv = ob.advanced[k][:n] & act & ~cum_done
             if adv.any():
                 i = np.nonzero(adv)[0]
                 slots = cur_slot[i].astype(np.int64)
+                for j in range(len(i)):
+                    self.flight.record(
+                        FRE_ADVANCE, shard=int(i[j]), slot=int(slots[j]),
+                        arg=int(ob.new_phase[k][i[j]]) & 0xFF,
+                    )
                 self._send(
                     VoteRound1(
                         shards=i,
@@ -2489,6 +2764,10 @@ class RabiaEngine:
             # binding from the late/retransmitted Propose or via sync
             idx = np.nonzero(newly)[0]
             slots = cur_slot[idx].astype(np.int64)
+            self.flight.record(
+                FRE_FRAME_OUT, shard=int(idx[0]), slot=int(slots[0]),
+                arg=int(MessageType.Decision),
+            )
             self._send(
                 Decision(
                     shards=idx,
@@ -2532,6 +2811,12 @@ class RabiaEngine:
         else:
             rec = SlotRecord(value=StateValue(value), batch_id=batch_id)
             sh.decisions[slot] = rec
+            # one DECIDE record per slot, on BOTH tick paths (recording
+            # stays a Python event even under the native tick)
+            self.flight.record(
+                FRE_DECIDE, shard=s, slot=slot, arg=value,
+                batch=fr_hash(batch_id) if batch_id is not None else 0,
+            )
             if value == V1:
                 self.rt.decided_v1 += 1
             else:
@@ -2630,6 +2915,14 @@ class RabiaEngine:
                 else:
                     self._requeue_null_slot(sh, slot, rec)
                 rec.applied = True
+                self.flight.record(
+                    FRE_APPLY, shard=s, slot=slot, arg=int(rec.value),
+                    batch=(
+                        fr_hash(rec.batch_id)
+                        if rec.batch_id is not None
+                        else 0
+                    ),
+                )
                 self._h_stage["decide_apply"].observe(
                     time.time() - rec.decided_at
                 )
@@ -2733,6 +3026,14 @@ class RabiaEngine:
         slots = np.asarray(self._cur_slot)[idxs].astype(np.int64)
         phases = (slots << 16) | np.asarray(self._cur_phase)[idxs].astype(np.int64)
         if r1_mask.any():
+            # retransmits go through the Python send path on BOTH tick
+            # paths — record them unconditionally (the C ring only sees
+            # frames rk_tick itself emits)
+            self.flight.record(
+                FRE_FRAME_OUT, shard=int(idxs[r1_mask][0]),
+                slot=int(slots[r1_mask][0]),
+                arg=int(MessageType.VoteRound1),
+            )
             self._send(
                 VoteRound1(
                     shards=idxs[r1_mask],
@@ -2741,6 +3042,11 @@ class RabiaEngine:
                 )
             )
         if r2_mask.any():
+            self.flight.record(
+                FRE_FRAME_OUT, shard=int(idxs[r2_mask][0]),
+                slot=int(slots[r2_mask][0]),
+                arg=int(MessageType.VoteRound2),
+            )
             self._send(
                 VoteRound2(
                     shards=idxs[r2_mask],

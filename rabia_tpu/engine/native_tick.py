@@ -158,6 +158,27 @@ class NativeTick:
         else:  # stale prebuilt hostkernel: metrics read as zeros
             self.counters_version = 0
             self.counters = np.zeros(len(RK_COUNTER_NAMES), np.uint64)
+        # flight recorder: zero-copy structured view over the context's C
+        # event ring (hostkernel.cpp FrEvent ABI — obs/flight.FR_DTYPE)
+        from rabia_tpu.obs.flight import FR_DTYPE
+
+        self._fr_frozen = None
+        if hasattr(lib, "rk_flight"):
+            if int(lib.rk_flight_record_size()) != FR_DTYPE.itemsize:
+                raise RuntimeError(
+                    "flight record ABI mismatch: C "
+                    f"{int(lib.rk_flight_record_size())}B vs Python "
+                    f"{FR_DTYPE.itemsize}B"
+                )
+            cap = int(lib.rk_flight_cap())
+            self.flight_version = int(lib.rk_flight_version())
+            fbuf = (ctypes.c_uint8 * (cap * FR_DTYPE.itemsize)).from_address(
+                lib.rk_flight(self.ctx)
+            )
+            self._fr_view = np.frombuffer(fbuf, FR_DTYPE)
+        else:  # stale prebuilt hostkernel: an empty ring
+            self.flight_version = 0
+            self._fr_view = np.zeros(0, FR_DTYPE)
 
     def counter(self, name: str) -> int:
         """One named counter from the block (0 for unknown/short blocks)."""
@@ -173,13 +194,38 @@ class NativeTick:
             for i, n in enumerate(RK_COUNTER_NAMES)
         }
 
+    def flight_head(self) -> int:
+        """Total flight records ever written by the C ring."""
+        if self.ctx is None or not hasattr(self.lib, "rk_flight_head"):
+            return 0
+        return int(self.lib.rk_flight_head(self.ctx))
+
+    def flight_snapshot(self) -> np.ndarray:
+        """Chronological copy of the live ring window (FR_DTYPE records,
+        oldest first). Single-writer (the engine loop); a foreign-thread
+        scrape may see one torn in-flight record — metrics-grade."""
+        if self._fr_frozen is not None:
+            return self._fr_frozen
+        if self.ctx is None or len(self._fr_view) == 0:
+            from rabia_tpu.obs.flight import FR_DTYPE
+
+            return np.zeros(0, FR_DTYPE)
+        head = self.flight_head()
+        cap = len(self._fr_view)
+        if head <= cap:
+            return self._fr_view[:head].copy()
+        i = head % cap
+        return np.concatenate([self._fr_view[i:], self._fr_view[:i]])
+
     def close(self) -> None:
-        ctx, self.ctx = self.ctx, None
-        if ctx:
-            # freeze the last counter values: the block's memory dies with
-            # the context, but late scrapes (post-shutdown stats) must
-            # read the final state, not freed memory
+        if self.ctx:
+            # freeze the last counter values and the flight ring BEFORE
+            # destroying the context: both live in its memory, but late
+            # scrapes/dumps (post-shutdown stats, crash dumps) must read
+            # the final state, not freed memory
             self.counters = self.counters.copy()
+            self._fr_frozen = self.flight_snapshot()
+            ctx, self.ctx = self.ctx, None
             self.lib.rk_ctx_destroy(ctx)
 
     def __del__(self):

@@ -383,12 +383,20 @@ class RabiaClient:
 # ---------------------------------------------------------------------------
 
 
-async def admin_fetch(
-    host: str, port: int, kind: int = 0, timeout: float = 10.0
-) -> bytes:
-    """Fetch one admin document (metrics / health / journal — see
-    :class:`~rabia_tpu.core.messages.AdminKind`) from a gateway's native
-    transport, knowing only ``host:port``.
+async def admin_fetch_timed(
+    host: str,
+    port: int,
+    kind: int = 0,
+    timeout: float = 10.0,
+    query: bytes = b"",
+) -> tuple[bytes, float, float]:
+    """Fetch one admin document (metrics / health / journal / trace —
+    see :class:`~rabia_tpu.core.messages.AdminKind`) from a gateway's
+    native transport, knowing only ``host:port``. Returns
+    ``(body, send_wall, recv_wall)`` where the wall times bracket the
+    answered request round trip on THIS process's clock — the trace
+    collector's clock-alignment input (offset = RTT midpoint, error
+    bound ±RTT/2; see obs/flight.align_slice).
 
     The framed transport normally needs the peer's node id up front; ops
     tooling has only an address. The trick: dial under a PLACEHOLDER peer
@@ -398,6 +406,8 @@ async def admin_fetch(
     entry is removed right after (stopping its redial scan) and the
     request rides the discovered identity.
     """
+    import time as _time
+
     from rabia_tpu.core.messages import AdminRequest, AdminResponse
     from rabia_tpu.net.tcp import TcpNetwork
 
@@ -421,15 +431,23 @@ async def admin_fetch(
         nonce = 1
         req = ser.serialize(
             ProtocolMessage.new(
-                net.node_id, AdminRequest(kind=int(kind), nonce=nonce), gw
+                net.node_id,
+                AdminRequest(kind=int(kind), nonce=nonce, query=query),
+                gw,
             )
         )
         last_send = 0.0
+        send_wall = 0.0
         while True:
             now = loop.time()
             if now >= deadline:
                 raise TimeoutError_("admin fetch: response", timeout)
             if now - last_send >= 1.0:  # re-send over a racing establish
+                if not send_wall:
+                    # bracket from the FIRST send: a late response to an
+                    # earlier send must widen err_s (conservative), never
+                    # tighten it around the wrong serve time
+                    send_wall = _time.time()
                 net.send_to_nowait(gw, req)
                 last_send = now
             try:
@@ -448,6 +466,21 @@ async def admin_fetch(
                     raise GatewayError(
                         p.body.decode(errors="replace") or "admin error"
                     )
-                return p.body
+                return p.body, send_wall, _time.time()
     finally:
         await net.close()
+
+
+async def admin_fetch(
+    host: str,
+    port: int,
+    kind: int = 0,
+    timeout: float = 10.0,
+    query: bytes = b"",
+) -> bytes:
+    """:func:`admin_fetch_timed` without the RTT bracket (the
+    `python -m rabia_tpu stats` path)."""
+    body, _, _ = await admin_fetch_timed(
+        host, port, kind, timeout=timeout, query=query
+    )
+    return body

@@ -66,6 +66,7 @@ from rabia_tpu.core.types import (
     ShardId,
 )
 from rabia_tpu.gateway.session import CachedResult, SessionTable
+from rabia_tpu.obs.flight import FRE_RESULT, fr_hash
 
 logger = logging.getLogger("rabia_tpu.gateway")
 
@@ -254,7 +255,7 @@ class GatewayServer:
         }
         return doc
 
-    def _admin_body(self, kind: int) -> tuple[int, bytes]:
+    def _admin_body(self, kind: int, query: bytes = b"") -> tuple[int, bytes]:
         import json
 
         if kind == AdminKind.METRICS:
@@ -262,9 +263,44 @@ class GatewayServer:
         if kind == AdminKind.HEALTH:
             return 0, json.dumps(self.health()).encode()
         if kind == AdminKind.JOURNAL:
+            jkind, last = None, 64
+            if query:
+                try:
+                    q = json.loads(query)
+                    jkind = q.get("kind")
+                    last = max(0, int(q.get("last", 64)))
+                except (ValueError, TypeError, AttributeError):
+                    return 1, b"malformed journal query"
             return 0, json.dumps(
-                {"anomalies": self.engine.journal.snapshot()}
+                {
+                    "anomalies": self.engine.journal.snapshot(
+                        limit=last, kind=jkind
+                    )
+                }
             ).encode()
+        if kind == AdminKind.TRACE:
+            # TraceQuery -> TraceSlice (obs/flight): the query names a
+            # batch by session coordinates (ids derive deterministically,
+            # so any replica can compute the hash) or by batch id hex
+            from rabia_tpu.obs.flight import (
+                batch_id_for,
+                build_trace_slice,
+            )
+
+            try:
+                q = json.loads(query) if query else {}
+                if "batch" in q:
+                    bid = uuid.UUID(hex=q["batch"])
+                else:
+                    bid = batch_id_for(
+                        uuid.UUID(hex=q["client"]), int(q["seq"])
+                    )
+            except (ValueError, TypeError, KeyError):
+                return 1, b"malformed trace query"
+            doc = build_trace_slice(self.engine, fr_hash(bid))
+            doc["gateway"] = str(self.node_id.value)
+            doc["batch_id"] = bid.hex
+            return 0, json.dumps(doc).encode()
         return 1, f"unknown admin kind {kind}".encode()
 
     def _on_admin(self, sender: NodeId, p: AdminRequest) -> None:
@@ -272,7 +308,7 @@ class GatewayServer:
         unauthenticated by design (same trust domain as the scrape shim);
         anything beyond the known kinds answers status=1."""
         try:
-            status, body = self._admin_body(p.kind)
+            status, body = self._admin_body(p.kind, p.query)
         except Exception as e:  # a broken provider must still answer
             logger.exception("admin request failed")
             status, body = 1, f"admin handler failed: {e}".encode()
@@ -531,13 +567,15 @@ class GatewayServer:
         (restart, cache eviction, session expiry) — therefore produces
         a byte-identical batch with the SAME batch id, and the engine's
         ``applied_ids`` dedup ledger blocks the double apply that a
-        random id would slip past."""
+        random id would slip past. The derivation lives in
+        :func:`rabia_tpu.obs.flight.batch_id_for` (the trace collector
+        names batches from session coordinates the same way)."""
         import hashlib
 
+        from rabia_tpu.obs.flight import batch_id_for
+
         seed = p.client_id.bytes + p.seq.to_bytes(8, "little")
-        bid = uuid.UUID(
-            bytes=hashlib.blake2s(seed, digest_size=16).digest()
-        )
+        bid = batch_id_for(p.client_id, p.seq)
         cmds = [
             Command(
                 id=uuid.UUID(
@@ -600,6 +638,12 @@ class GatewayServer:
         )
         self.sessions.stats.results_cached += 1
         sess.touch()
+        # flight: the commit timeline's terminal stage (the batch hash
+        # ties it back to submit/propose/decide/apply)
+        self.engine.flight.record(
+            FRE_RESULT, shard=p.shard, arg=int(status),
+            batch=fr_hash(batch.id),
+        )
         self._send_result(sender, p.client_id, p.seq, status, payload)
 
     # -- linearizable read path ---------------------------------------------

@@ -234,6 +234,17 @@ def load_hostkernel() -> ctypes.CDLL | None:
         lib.rk_counters_count.argtypes = []
         lib.rk_counters.restype = ctypes.c_void_p
         lib.rk_counters.argtypes = [p]
+        # flight recorder (fixed-size binary event ring, versioned ABI)
+        lib.rk_flight_version.restype = ctypes.c_int32
+        lib.rk_flight_version.argtypes = []
+        lib.rk_flight_cap.restype = ctypes.c_int32
+        lib.rk_flight_cap.argtypes = []
+        lib.rk_flight_record_size.restype = ctypes.c_int32
+        lib.rk_flight_record_size.argtypes = []
+        lib.rk_flight.restype = ctypes.c_void_p
+        lib.rk_flight.argtypes = [p]
+        lib.rk_flight_head.restype = ctypes.c_uint64
+        lib.rk_flight_head.argtypes = [p]
         _HK_CACHED = lib
         return lib
 
@@ -268,11 +279,12 @@ def load_library() -> ctypes.CDLL:
             # clear message instead of a cryptic AttributeError later
             try:
                 lib.rt_counters
+                lib.rt_flight_copy
             except AttributeError:
                 raise InternalError(
                     f"RABIA_NATIVE_LIB library {prebuilt} is stale "
-                    "(missing rt_counters); rebuild it from "
-                    "transport.cpp"
+                    "(missing rt_counters/rt_flight_copy); rebuild it "
+                    "from transport.cpp"
                 ) from None
 
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -356,6 +368,17 @@ def load_library() -> ctypes.CDLL:
         lib.rt_counters_count.argtypes = []
         lib.rt_counters.restype = ctypes.c_void_p
         lib.rt_counters.argtypes = [ctypes.c_void_p]
+        # flight recorder (frame in/out ring, consistent copy under mu)
+        lib.rt_flight_version.restype = ctypes.c_int32
+        lib.rt_flight_version.argtypes = []
+        lib.rt_flight_record_size.restype = ctypes.c_int32
+        lib.rt_flight_record_size.argtypes = []
+        lib.rt_flight_copy.restype = ctypes.c_int64
+        lib.rt_flight_copy.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
         lib.rt_stop.restype = None
         lib.rt_stop.argtypes = [ctypes.c_void_p]
         lib.rt_close.restype = None

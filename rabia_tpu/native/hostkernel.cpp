@@ -27,6 +27,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 #include <vector>
 
 extern "C" {
@@ -313,6 +314,63 @@ enum : int32_t {
 };
 static const int32_t RK_COUNTERS_VERSION = 1;
 
+// ---------------------------------------------------------------------------
+// Flight recorder: a fixed-size binary event ring written on the fast path.
+//
+// One 32-byte record per ingest / route / node_step / outbox decision, so a
+// misrouted vote or stale storm inside a native run is reconstructable after
+// the fact (the engine auto-dumps the ring on severe anomalies; the trace
+// collector slices it per batch). The record layout and kind codes are a
+// versioned ABI like the RKC_* counter block: fields/kinds append, nothing is
+// renumbered. The Python twin (rabia_tpu/obs/flight.py FR_DTYPE /
+// FlightRecorder) mirrors this layout exactly; RABIA_PY_TICK=1 feeds the
+// same kinds from the Python tick paths.
+//
+// batch_hash is always 0 here: vote/decision wire frames carry no batch ids
+// (ids derive from (client_id, seq) — PR 1), so batch association happens at
+// the Python event layer (propose/decide/apply records) and the trace merger
+// joins on (shard, slot).
+// ---------------------------------------------------------------------------
+
+enum : uint8_t {
+  FRE_FRAME_IN = 1,     // consensus frame consumed (arg = wire msg_type,
+                        // peer = sender row, shard/slot of first entry)
+  FRE_ROUTE1 = 2,       // R1 vote scattered into the ledger (arg = vote)
+  FRE_ROUTE2 = 3,       // R2 vote scattered into the ledger (arg = vote)
+  FRE_CARRY = 4,        // future-(slot,phase) vote carried (arg = round)
+  FRE_STALE = 5,        // below-applied vote entry (repair path)
+  FRE_DROP = 6,         // frame dropped (arg: 1 spoof, 2 skew, 3 malformed)
+  FRE_OPEN = 7,         // slot armed (arg = initial vote)
+  FRE_CAST_R2 = 8,      // R1 quorum -> R2 cast (arg = cast vote)
+  FRE_ADVANCE = 9,      // weak-MVC phase advance (arg = new phase & 0xFF)
+  FRE_STEP_DECIDE = 10, // node_step decided (arg = decided value)
+  FRE_FRAME_OUT = 11,   // outbound frame emitted (arg = wire msg_type,
+                        // shard/slot of first entry)
+  // 12..16 are Python-event kinds (submit/propose/decide/apply/result) and
+  // 17/18 the transport frame in/out kinds — never written by this ring but
+  // reserved here so the numbering space stays single-sourced.
+};
+
+struct FrEvent {
+  uint64_t t_ns;        // CLOCK_MONOTONIC
+  uint64_t slot;        // decision slot (0 when not slot-scoped)
+  uint64_t batch_hash;  // always 0 on the native ring (see above)
+  uint32_t shard;
+  uint16_t peer;        // sender row, or 0xFFFF when not peer-scoped
+  uint8_t kind;         // FRE_*
+  uint8_t arg;
+};
+static_assert(sizeof(FrEvent) == 32, "flight record layout is ABI");
+
+static const int32_t RK_FLIGHT_VERSION = 1;
+static const uint32_t RK_FLIGHT_CAP = 4096;  // power of two
+
+static inline uint64_t fr_now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
 struct RkCarry {
   int32_t row;
   int32_t shard;
@@ -379,7 +437,25 @@ struct RkCtx {
 
   // observability counter block (see RKC_* above); zero-initialized
   uint64_t ctrs[RKC_COUNT];
+
+  // flight-recorder event ring (see FrEvent above); fr_head counts every
+  // record ever written, the live window is the last RK_FLIGHT_CAP
+  std::vector<FrEvent> fr;
+  uint64_t fr_head;
 };
+
+static inline void fr_rec(RkCtx* c, uint8_t kind, uint8_t arg, uint16_t peer,
+                          uint32_t shard, int64_t slot) {
+  FrEvent& e = c->fr[c->fr_head & (RK_FLIGHT_CAP - 1)];
+  e.t_ns = fr_now_ns();
+  e.slot = (uint64_t)slot;
+  e.batch_hash = 0;
+  e.shard = shard;
+  e.peer = peer;
+  e.kind = kind;
+  e.arg = arg;
+  c->fr_head++;
+}
 
 static const size_t RK_STALE_CAP = 1024;
 
@@ -456,6 +532,8 @@ void* rk_ctx_create(const int64_t* dims, const int64_t* ptrs,
   c->r2_vals.resize(c->S);
   c->idx_scratch.resize(c->S);
   std::memset(c->ctrs, 0, sizeof(c->ctrs));
+  c->fr.resize(RK_FLIGHT_CAP);
+  c->fr_head = 0;
   return c;
 }
 
@@ -477,6 +555,20 @@ int32_t rk_counters_count(void) { return RKC_COUNT; }
 // Borrowed pointer to the context's uint64 counter block; valid for the
 // context's lifetime. The Python side wraps it as a read-only ndarray.
 void* rk_counters(void* ctx) { return ((RkCtx*)ctx)->ctrs; }
+
+// --- flight recorder (binary event ring) ------------------------------------
+
+int32_t rk_flight_version(void) { return RK_FLIGHT_VERSION; }
+int32_t rk_flight_cap(void) { return (int32_t)RK_FLIGHT_CAP; }
+int32_t rk_flight_record_size(void) { return (int32_t)sizeof(FrEvent); }
+// Borrowed pointer to the ring base (RK_FLIGHT_CAP records of
+// rk_flight_record_size() bytes); valid for the context's lifetime.
+// Single-writer (the engine's event loop); foreign-thread snapshot reads
+// may see one torn in-flight record — metrics-grade, not ledger-grade.
+void* rk_flight(void* ctx) { return ((RkCtx*)ctx)->fr.data(); }
+// Total records ever written; the live window is the last
+// min(head, RK_FLIGHT_CAP) records ending at head % RK_FLIGHT_CAP.
+uint64_t rk_flight_head(void* ctx) { return ((RkCtx*)ctx)->fr_head; }
 
 int64_t rk_carry_count(void* ctx) {
   RkCtx* c = (RkCtx*)ctx;
@@ -526,12 +618,15 @@ static inline bool rk_route_one(RkCtx* c, int32_t round_no, int32_t row,
     if (cell == ABS) {
       cell = val;
       c->ctrs[RKC_SCATTER]++;
+      fr_rec(c, round_no == 1 ? FRE_ROUTE1 : FRE_ROUTE2, (uint8_t)val,
+             (uint16_t)row, (uint32_t)s, slot);
       return true;
     }
     return false;  // first-write-wins duplicate: nothing changed
   }
   carry.push_back(RkCarry{row, s, slot, mvc, val});
   c->ctrs[RKC_CARRY]++;
+  fr_rec(c, FRE_CARRY, (uint8_t)round_no, (uint16_t)row, (uint32_t)s, slot);
   return true;
 }
 
@@ -553,6 +648,7 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
   if (std::memcmp(data + 19, c->uuids.data() + (size_t)row * 16, 16) != 0) {
     c->dropped++;
     c->ctrs[RKC_DROP_SPOOF]++;
+    fr_rec(c, FRE_DROP, 1, (uint16_t)row, 0, 0);
     return RK_DROP;
   }
   int64_t base = 35 + ((flags & FLAG_RECIPIENT) ? 16 : 0);
@@ -561,6 +657,7 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
   if (ts > now + c->max_future_skew || ts < now - c->max_age) {
     c->dropped++;  // clock-skew rejection (MessageValidator parity)
     c->ctrs[RKC_DROP_SKEW]++;
+    fr_rec(c, FRE_DROP, 2, (uint16_t)row, 0, 0);
     return RK_DROP;
   }
   const uint32_t body_len = rd_u32(data + base + 8);
@@ -586,6 +683,7 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
         // StateValue() on the Python event path
         c->dropped++;
         c->ctrs[RKC_DROP_MALFORMED]++;
+        fr_rec(c, FRE_DROP, 3, (uint16_t)row, s, (int64_t)(ph >> 16));
         return RK_DROP;
       }
       if (s >= (uint32_t)c->n) return RK_PY;
@@ -612,6 +710,9 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
     c->rows_seen |= 1ull << (row & 63);
     c->ctrs[RKC_FRAMES_DEC]++;
     if (!dec_effect) c->ctrs[RKC_FRAMES_NOOP]++;
+    fr_rec(c, FRE_FRAME_IN, MT_DECISION, (uint16_t)row,
+           count ? rd_u32(ent) : 0,
+           count ? (int64_t)(rd_u64(ent + 4) >> 16) : 0);
     return dec_effect ? RK_HANDLED : RK_NOOP;
   }
 
@@ -619,6 +720,7 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
   if (count == 0) {
     c->dropped++;  // "vote vector must be non-empty" (validator)
     c->ctrs[RKC_DROP_MALFORMED]++;
+    fr_rec(c, FRE_DROP, 3, (uint16_t)row, 0, 0);
     return RK_DROP;
   }
   if (body_len < 4 + (uint64_t)count * 13) return RK_PY;
@@ -627,6 +729,7 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
     if (ent[(size_t)k * 13 + 12] > 3) {
       c->dropped++;
       c->ctrs[RKC_DROP_MALFORMED]++;
+      fr_rec(c, FRE_DROP, 3, (uint16_t)row, 0, 0);
       return RK_DROP;
     }
   }
@@ -643,6 +746,7 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
     const int8_t val = (int8_t)e[12];
     if (slot < c->applied[s]) {
       c->ctrs[RKC_STALE]++;
+      fr_rec(c, FRE_STALE, (uint8_t)round_no, (uint16_t)row, s, slot);
       if (c->stale.size() < RK_STALE_CAP)
         c->stale.push_back(RkStale{row, (int32_t)s, slot});
       continue;
@@ -667,6 +771,8 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
   c->rows_seen |= 1ull << (row & 63);
   c->ctrs[round_no == 1 ? RKC_FRAMES_V1 : RKC_FRAMES_V2]++;
   if (!effect) c->ctrs[RKC_FRAMES_NOOP]++;
+  fr_rec(c, FRE_FRAME_IN, msg_type, (uint16_t)row, rd_u32(ent),
+         (int64_t)(rd_u64(ent + 4) >> 16));
   return effect ? RK_HANDLED : RK_NOOP;
 }
 
@@ -735,6 +841,8 @@ static void rk_emit_frame(RkCtx* c, RkFrameWriter* w, uint8_t msg_type,
   }
   w->pos += 4 + frame_len;
   w->frames++;
+  fr_rec(c, FRE_FRAME_OUT, msg_type, 0xFFFF, (uint32_t)idx[0],
+         (int64_t)c->slot[idx[0]]);
 }
 
 // --- the chained tick -------------------------------------------------------
@@ -753,6 +861,8 @@ static void rk_route_carry(RkCtx* c, int32_t round_no) {
       if (cell == ABS) {
         cell = e.val;
         c->ctrs[RKC_SCATTER]++;
+        fr_rec(c, round_no == 1 ? FRE_ROUTE1 : FRE_ROUTE2, (uint8_t)e.val,
+               (uint16_t)e.row, (uint32_t)e.shard, e.slot);
       }
     } else {
       carry[w++] = e;  // keep for a later tick
@@ -781,7 +891,11 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
     int32_t n_open = 0;
     int32_t* idx = c->idx_scratch.data();
     for (int32_t s = 0; s < c->n; s++) {
-      if (open_mask[s]) idx[n_open++] = s;
+      if (open_mask[s]) {
+        idx[n_open++] = s;
+        fr_rec(c, FRE_OPEN, (uint8_t)open_init[s], 0xFFFF, (uint32_t)s,
+               (int64_t)open_slots[s]);
+      }
     }
     if (n_open)
       rk_emit_frame(c, &w, MT_VOTE1, now, idx, n_open, 13, c->my_r1, 0);
@@ -803,7 +917,11 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
     int32_t* idx = c->idx_scratch.data();
     for (int32_t s = 0; s < c->n; s++) {
       if (!c->in_flight[s]) continue;
-      if (c->cast_r2[s]) idx[n_cast++] = s;
+      if (c->cast_r2[s]) {
+        idx[n_cast++] = s;
+        fr_rec(c, FRE_CAST_R2, (uint8_t)c->r2_vals[s], 0xFFFF, (uint32_t)s,
+               (int64_t)c->slot[s]);
+      }
     }
     if (n_cast) {
       rk_emit_frame(c, &w, MT_VOTE2, now, idx, n_cast, 13,
@@ -812,7 +930,11 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
     }
     for (int32_t s = 0; s < c->n; s++) {
       if (!c->in_flight[s]) continue;
-      if (c->advanced[s] && !c->done[s]) idx[n_adv++] = s;
+      if (c->advanced[s] && !c->done[s]) {
+        idx[n_adv++] = s;
+        fr_rec(c, FRE_ADVANCE, (uint8_t)(c->phase[s] & 0xFF), 0xFFFF,
+               (uint32_t)s, (int64_t)c->slot[s]);
+      }
     }
     if (n_adv) {
       rk_emit_frame(c, &w, MT_VOTE1, now, idx, n_adv, 13, c->my_r1, 0);
@@ -825,6 +947,8 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
       if (c->newly_step[s]) {
         c->newly_acc[s] = 1;
         idx[n_new++] = s;
+        fr_rec(c, FRE_STEP_DECIDE, (uint8_t)c->decided[s], 0xFFFF,
+               (uint32_t)s, (int64_t)c->slot[s]);
       }
     }
     if (n_new && c->decision_broadcast)

@@ -26,6 +26,7 @@
 #include <netinet/tcp.h>
 #include <string.h>
 #include <sys/epoll.h>
+#include <time.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -79,6 +80,28 @@ enum : int32_t {
   RTC_COUNT
 };
 constexpr int32_t kCountersVersion = 1;
+
+// Flight recorder: one compact record per frame in/out, so a transport
+// stall is attributable after the fact (the engine's flight merger folds
+// these between the consensus-event records). Layout is a versioned ABI
+// like the RTC_* block; the Python twin is rabia_tpu/net/tcp.TF_DTYPE.
+struct TfEvent {
+  uint64_t t_ns;      // CLOCK_MONOTONIC (same domain as the rk flight ring)
+  uint64_t peer;      // last 8 bytes of the peer's 16-byte node id
+  uint32_t len;       // payload length (sans the 4-byte prefix)
+  uint8_t dir;        // 0 = in (parsed off a socket), 1 = out (enqueued)
+  uint8_t msg_type;   // wire byte 1 of the payload (the v3 msg_type)
+  uint16_t pad;
+};
+static_assert(sizeof(TfEvent) == 24, "transport flight record is ABI");
+constexpr int32_t kFlightVersion = 1;
+constexpr uint32_t kFlightCap = 4096;  // power of two
+
+uint64_t tf_now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
 
 double now_s() {
   return std::chrono::duration<double>(Clock::now().time_since_epoch()).count();
@@ -198,6 +221,23 @@ struct Transport {
 
   void bump(int32_t i, uint64_t n = 1) {
     ctrs[i].fetch_add(n, std::memory_order_relaxed);
+  }
+
+  // flight-recorder frame ring; all writers hold `mu` (handle_readable /
+  // enqueue_shared_locked), rt_flight_copy reads under `mu` too
+  std::vector<TfEvent> tf = std::vector<TfEvent>(kFlightCap);
+  uint64_t tf_head = 0;
+
+  void tf_rec(uint8_t dir, const NodeIdBytes& peer_id, uint32_t len,
+              uint8_t msg_type) {
+    TfEvent& e = tf[tf_head & (kFlightCap - 1)];
+    e.t_ns = tf_now_ns();
+    memcpy(&e.peer, peer_id.data() + 8, 8);
+    e.len = len;
+    e.dir = dir;
+    e.msg_type = msg_type;
+    e.pad = 0;
+    tf_head++;
   }
 
   std::shared_ptr<std::vector<uint8_t>> make_frame(const uint8_t* data,
@@ -468,6 +508,7 @@ void Transport::handle_readable(int fd) {
     m.data.assign(c.rbuf.begin() + off + 4, c.rbuf.begin() + off + 4 + len);
     bump(RTC_FRAMES_IN);
     bump(RTC_BYTES_IN, len);
+    tf_rec(0, c.peer, len, len >= 2 ? c.rbuf[off + 5] : 0);
     if (inbox.size() >= kMaxInbox) {
       pool_put_locked(std::move(inbox.front().data));
       inbox.pop_front();
@@ -516,6 +557,8 @@ void Transport::enqueue_shared_locked(
   it->second.wqueue.push_back(f);
   bump(RTC_FRAMES_OUT);
   bump(RTC_BYTES_OUT, f->size());
+  tf_rec(1, it->second.peer, (uint32_t)(f->size() - 4),
+         f->size() >= 6 ? (*f)[5] : 0);
   arm_write(fd, true);
 }
 
@@ -897,6 +940,27 @@ void rt_out_pool_stats(void* h, uint64_t* hits, uint64_t* misses) {
 
 int32_t rt_counters_version(void) { return kCountersVersion; }
 int32_t rt_counters_count(void) { return RTC_COUNT; }
+
+// --- flight recorder (frame in/out ring) ------------------------------------
+
+int32_t rt_flight_version(void) { return kFlightVersion; }
+int32_t rt_flight_record_size(void) { return (int32_t)sizeof(TfEvent); }
+// Copy the most recent min(written, kFlightCap, max_records) records into
+// `out` (max_records * rt_flight_record_size() bytes) in chronological
+// order; returns the count. Taken under the io mutex — a consistent
+// snapshot, unlike the relaxed counter block.
+int64_t rt_flight_copy(void* h, uint8_t* out, int64_t max_records) {
+  auto* t = static_cast<Transport*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  uint64_t n = t->tf_head < kFlightCap ? t->tf_head : kFlightCap;
+  if ((int64_t)n > max_records) n = (uint64_t)max_records;
+  uint64_t start = t->tf_head - n;
+  auto* dst = reinterpret_cast<TfEvent*>(out);
+  for (uint64_t i = 0; i < n; i++) {
+    dst[i] = t->tf[(start + i) & (kFlightCap - 1)];
+  }
+  return (int64_t)n;
+}
 // Borrowed pointer to the transport's counter block (RTC_* order), valid
 // until rt_close. Relaxed-atomic cells readable as plain uint64s.
 const uint64_t* rt_counters(void* h) {

@@ -50,6 +50,15 @@ void rt_out_pool_stats(void* h, uint64_t* hits, uint64_t* misses);
 int32_t rt_counters_version(void);
 int32_t rt_counters_count(void);
 const uint64_t* rt_counters(void* h);
+// Flight recorder: one fixed-size record per frame in/out (layout is the
+// versioned TfEvent ABI in transport.cpp; the Python twin is
+// rabia_tpu/net/tcp.TF_DTYPE). rt_flight_copy writes the most recent
+// records into `out` (max_records * rt_flight_record_size() bytes) in
+// chronological order and returns the count — a consistent snapshot
+// taken under the io mutex.
+int32_t rt_flight_version(void);
+int32_t rt_flight_record_size(void);
+int64_t rt_flight_copy(void* h, uint8_t* out, int64_t max_records);
 // Stop the io loop and unblock rt_recv callers WITHOUT freeing the
 // handle; call before rt_close when a reader thread may be inside
 // rt_recv.

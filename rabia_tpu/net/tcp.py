@@ -26,6 +26,8 @@ import os
 import threading
 from typing import Optional
 
+import numpy as np
+
 from rabia_tpu.core.config import TcpNetworkConfig
 from rabia_tpu.core.errors import NetworkError, TimeoutError_
 from rabia_tpu.core.network import NetworkTransport
@@ -137,6 +139,9 @@ class TcpNetwork(NetworkTransport):
         # of the freed native Transport)
         self._final_ctrs: dict[str, int] = {}
         self._final_out_pool: tuple[int, int] = (0, 0)
+        from rabia_tpu.obs.flight import TF_DTYPE
+
+        self._final_flight = np.zeros(0, TF_DTYPE)
         self._recv_buf = (ctypes.c_uint8 * _RECV_BUF_CAP)()
         self._sender_buf = (ctypes.c_uint8 * 16)()
         # zero-copy recv engages when the native library exports the
@@ -369,6 +374,20 @@ class TcpNetwork(NetworkTransport):
         )
         return int(hits.value), int(misses.value)
 
+    def flight_snapshot(self, max_records: int = 4096) -> np.ndarray:
+        """Chronological copy of the native frame in/out flight ring
+        (transport.cpp TfEvent records — :data:`rabia_tpu.obs.flight.
+        TF_DTYPE`), taken consistently under the io mutex. After close,
+        reports the ring frozen at teardown."""
+        from rabia_tpu.obs.flight import TF_DTYPE
+
+        h = self._handle  # read ONCE: close() swaps it to None
+        if not h or not hasattr(self._lib, "rt_flight_copy"):
+            return self._final_flight
+        buf = np.zeros(max_records, TF_DTYPE)
+        n = int(self._lib.rt_flight_copy(h, buf.ctypes.data, max_records))
+        return buf[:n]
+
     def transport_counters(self) -> dict[str, int]:
         """The native observability counter block as ``{name: value}``
         (RT_COUNTER_NAMES order; see docs/OBSERVABILITY.md). Values are
@@ -411,6 +430,7 @@ class TcpNetwork(NetworkTransport):
         # valid — post-close scrapes read these copies
         self._final_ctrs = self.transport_counters()
         self._final_out_pool = self.out_pool_stats
+        self._final_flight = self.flight_snapshot()
         loop = asyncio.get_running_loop()
         # stop the native io loop first: this makes any in-flight rt_recv
         # return immediately (-1), so the reader exits promptly

@@ -28,13 +28,35 @@ from rabia_tpu.obs.registry import (
     MetricsRegistry,
 )
 from rabia_tpu.obs.http import AdminHTTPServer
+from rabia_tpu.obs.flight import (
+    FR_DTYPE,
+    FR_KIND_NAMES,
+    TF_DTYPE,
+    FlightRecorder,
+    batch_id_for,
+    build_trace_slice,
+    collect_trace,
+    fr_hash,
+    merge_slices,
+    render_timeline,
+)
 
 __all__ = [
     "AdminHTTPServer",
     "AnomalyJournal",
     "Counter",
+    "FR_DTYPE",
+    "FR_KIND_NAMES",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "LATENCY_BUCKETS",
     "MetricsRegistry",
+    "TF_DTYPE",
+    "batch_id_for",
+    "build_trace_slice",
+    "collect_trace",
+    "fr_hash",
+    "merge_slices",
+    "render_timeline",
 ]

@@ -7,7 +7,8 @@ three documents over plain HTTP/1.1 from a daemon thread:
 
     GET /metrics   text/plain; Prometheus exposition 0.0.4
     GET /healthz   application/json (200 ok / 503 degraded)
-    GET /journal   application/json (bounded anomaly journal)
+    GET /journal   application/json (bounded anomaly journal);
+                   filters: ?kind=<anomaly kind>&last=<N>  (default 64)
 
 Zero dependencies beyond ``http.server``; binds an ephemeral port by
 default. Request handling calls back into registry/health providers —
@@ -20,6 +21,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
@@ -50,7 +52,7 @@ class AdminHTTPServer:
                 logger.debug("admin http: " + fmt, *args)
 
             def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
-                path = self.path.split("?", 1)[0]
+                path, _, qs = self.path.partition("?")
                 try:
                     if path == "/metrics":
                         body = outer.registry.render_prometheus().encode()
@@ -66,8 +68,16 @@ class AdminHTTPServer:
                         body = json.dumps(doc).encode()
                         ctype = "application/json"
                     elif path == "/journal":
+                        q = urllib.parse.parse_qs(qs)
+                        kind = q.get("kind", [None])[0]
+                        try:
+                            last = int(q.get("last", ["64"])[0])
+                        except ValueError:
+                            last = 64
                         entries = (
-                            outer.journal.snapshot()
+                            outer.journal.snapshot(
+                                limit=max(0, last), kind=kind
+                            )
                             if outer.journal is not None
                             else []
                         )

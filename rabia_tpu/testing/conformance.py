@@ -13,6 +13,9 @@ checks can never drift apart.
 from __future__ import annotations
 
 import asyncio
+import json
+import os
+import re
 from typing import Sequence
 
 
@@ -141,9 +144,12 @@ async def _run_transport_schedule(
     """One transport-plane cluster through `schedule`; returns
     (decisions{shard: {slot: value}}, state digest bytes, native_active,
     obs) where ``obs`` is {"parity": deterministic counter subset,
-    "context": cheap non-deterministic tick counters} — parity is what
-    the tick-path gate asserts on; both land in the divergence message
-    (which the fuzz prints beside the repro seed)."""
+    "flight_lifecycle": per-shard propose/decide/apply flight sequences,
+    "flight": the full merged flight capture, "context": cheap
+    non-deterministic tick counters} — parity and flight_lifecycle are
+    what the tick-path gate asserts on; everything lands in the
+    divergence message/dumps (which the fuzz prints beside the repro
+    seed)."""
     from rabia_tpu.core.config import RabiaConfig
     from rabia_tpu.core.network import ClusterConfig
     from rabia_tpu.core.state_machine import InMemoryStateMachine
@@ -212,12 +218,30 @@ async def _run_transport_schedule(
         # timing) is carried for triage only.
         e0 = engines[0]
         rk = e0._rk
+        # Flight recorder capture (before shutdown frees the native
+        # ring). The LIFECYCLE subset — per-shard (kind, slot, value)
+        # sequences of propose/decide/apply — is deterministic on a
+        # fixed fault-free schedule (it is the decision ledger's event
+        # shadow) and is what the tick-path gate asserts; the full event
+        # list rides along for the divergence dumps (timing-dependent
+        # kinds like ingest/route/carry are excluded from parity exactly
+        # like the frame counters are).
+        flight = e0.flight_events()
+        lifecycle: dict[int, list] = {}
+        for ev in flight:
+            if ev["kind"] in ("propose", "decide", "apply"):
+                lifecycle.setdefault(int(ev["shard"]), []).append(
+                    (ev["kind"], int(ev["slot"]), int(ev["arg"]))
+                )
         obs = {
             "parity": {
                 "decided_v1": int(e0.rt.decided_v1),
                 "decided_v0": int(e0.rt.decided_v0),
                 "state_version": int(e0.rt.state_version),
             },
+            "flight_lifecycle": lifecycle,
+            "flight": flight,
+            "flight_native_records": (rk.flight_head() if rk else 0),
             "context": {
                 "ticks": int(e0._tick_count),
                 "stale": e0._py_stale
@@ -284,18 +308,76 @@ async def run_schedule_on_both_tick_paths(
         f"context[native]={obs_native['context']} "
         f"context[python]={obs_py['context']}"
     )
-    assert dec_native == dec_py, (
-        f"{tag}: decision ledgers diverge across tick paths "
-        f"(native={dec_native}, python={dec_py}); {ctx}"
-    )
-    assert snap_native == snap_py, (
-        f"{tag}: replica state diverges across tick paths; {ctx}"
-    )
-    # counter parity: the deterministic subset of the shared metric
-    # namespace must agree across tick paths on an identical schedule
-    assert obs_native["parity"] == obs_py["parity"], (
-        f"{tag}: counter parity broken across tick paths; {ctx}"
-    )
-    assert obs_native["parity"]["decided_v1"] > 0, (
-        f"{tag}: no decisions recorded — vacuous schedule"
-    )
+    try:
+        assert dec_native == dec_py, (
+            f"{tag}: decision ledgers diverge across tick paths "
+            f"(native={dec_native}, python={dec_py}); {ctx}"
+        )
+        assert snap_native == snap_py, (
+            f"{tag}: replica state diverges across tick paths; {ctx}"
+        )
+        # counter parity: the deterministic subset of the shared metric
+        # namespace must agree across tick paths on an identical schedule
+        assert obs_native["parity"] == obs_py["parity"], (
+            f"{tag}: counter parity broken across tick paths; {ctx}"
+        )
+        assert obs_native["parity"]["decided_v1"] > 0, (
+            f"{tag}: no decisions recorded — vacuous schedule"
+        )
+        # flight-recorder parity: both tick paths must emit the same
+        # ordered per-shard sequence of lifecycle flight event kinds
+        # (propose/decide/apply with slot + decided value; timestamps and
+        # timing-dependent kinds — ingest/route/carry ride retransmit
+        # timing — excluded, like the frame counters above)
+        assert (
+            obs_native["flight_lifecycle"] == obs_py["flight_lifecycle"]
+        ), (
+            f"{tag}: flight lifecycle sequences diverge across tick "
+            f"paths (native={obs_native['flight_lifecycle']}, "
+            f"python={obs_py['flight_lifecycle']}); {ctx}"
+        )
+        if require_native:
+            # the native ring must actually have recorded the fast path
+            # (a silently-empty recorder would make trace collection and
+            # the auto-dumps vacuous on the path that matters most)
+            assert obs_native["flight_native_records"] > 0, (
+                f"{tag}: native flight ring empty after a native-tick run"
+            )
+    except AssertionError as e:
+        paths = _dump_divergence_flight(tag, obs_native, obs_py)
+        raise AssertionError(
+            f"{e}; flight dumps: {paths}"
+        ) from None
+
+
+def _dump_divergence_flight(tag: str, obs_native: dict, obs_py: dict) -> list:
+    """Write BOTH tick paths' flight-recorder captures next to the repro
+    seed on divergence (the flight extension of the PR-3 counter-snapshot
+    embedding). Directory: $RABIA_FLIGHT_DIR, default ``flight-dumps/``
+    (CI uploads it as a failure artifact)."""
+    d = os.environ.get("RABIA_FLIGHT_DIR") or "flight-dumps"
+    safe = re.sub(r"[^\w.=-]+", "_", tag) or "divergence"
+    paths = []
+    try:
+        os.makedirs(d, exist_ok=True)
+        for name, obs in (("native", obs_native), ("python", obs_py)):
+            p = os.path.join(d, f"flight_{safe}_{name}.json")
+            with open(p, "w") as f:
+                json.dump(
+                    {
+                        "tag": tag,
+                        "tick_path": name,
+                        "parity": obs["parity"],
+                        "context": obs["context"],
+                        "flight_lifecycle": {
+                            str(k): v
+                            for k, v in obs["flight_lifecycle"].items()
+                        },
+                        "events": obs["flight"],
+                    },
+                    f,
+                )
+            paths.append(p)
+    except OSError as e:  # a read-only CWD must not mask the divergence
+        paths.append(f"<dump failed: {e}>")
+    return paths

@@ -342,8 +342,10 @@ async def _trial_tick_paths(seed: int) -> None:
         )
     except AssertionError as e:
         # triage context: the gate embeds the deterministic counter
-        # subset for both paths in its message — surface it loudly next
-        # to the repro seed so a CI failure carries the counter deltas
+        # subset for both paths in its message AND writes both paths'
+        # flight-recorder dumps (to $RABIA_FLIGHT_DIR, default
+        # flight-dumps/ — a CI failure artifact) — surface all of it
+        # loudly next to the repro seed
         print(
             f"tick-path divergence (seed={seed}, S={S}, R={R}): {e}",
             file=sys.stderr,

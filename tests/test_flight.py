@@ -1,0 +1,343 @@
+"""Flight recorder + cross-replica commit trace gates (rabia_tpu/obs/flight).
+
+- ring mechanics: Python ring bounds, deterministic batch-id/hash
+  derivation, native-ring ABI agreement (record size, version);
+- trace slicing: batch-hash + (shard, slot) join, transport-window
+  inclusion;
+- clock alignment: RTT-midpoint offset estimation and its error bound,
+  per-replica order preservation through the merge;
+- the acceptance end-to-end: `python -m rabia_tpu trace` against a
+  3-replica TCP gateway cluster reconstructs one submitted command's
+  timeline with every stage (submit, propose, per-peer R1/R2 votes,
+  decide, apply, result) present and monotonically ordered after
+  alignment — on the native tick path AND under RABIA_PY_TICK=1.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import uuid
+
+import pytest
+
+from rabia_tpu.obs.flight import (
+    FR_DTYPE,
+    FRE_DECIDE,
+    FlightRecorder,
+    align_slice,
+    batch_id_for,
+    build_trace_slice,
+    fr_hash,
+    merge_slices,
+    render_timeline,
+    timeline_stages,
+)
+
+
+class TestRingMechanics:
+    def test_python_ring_bounded_and_ordered(self):
+        fr = FlightRecorder(cap=8)
+        for i in range(20):
+            fr.record(FRE_DECIDE, shard=0, slot=i, arg=1)
+        assert len(fr) == 8
+        assert fr.head == 20
+        snap = fr.snapshot()
+        assert [e["slot"] for e in snap] == list(range(12, 20))
+        ts = [e["t_ns"] for e in snap]
+        assert ts == sorted(ts)
+
+    def test_batch_id_derivation_matches_gateway(self):
+        """The trace collector names batches from (client_id, seq) with
+        the same derivation the gateway uses — byte-identical ids."""
+        from rabia_tpu.core.messages import Submit
+        from rabia_tpu.gateway.server import GatewayServer
+
+        cid = uuid.UUID(int=0x1234)
+        p = Submit(client_id=cid, seq=7, shard=0, commands=(b"x",))
+        batch = GatewayServer._deterministic_batch(p)
+        assert batch.id.value == batch_id_for(cid, 7)
+        # and the hash is stable (the ring join key)
+        assert fr_hash(batch.id) == fr_hash(batch_id_for(cid, 7))
+
+    def test_native_ring_abi(self):
+        from rabia_tpu.native.build import load_hostkernel
+
+        lib = load_hostkernel()
+        if lib is None or not hasattr(lib, "rk_flight_record_size"):
+            pytest.skip("native hostkernel unavailable")
+        assert int(lib.rk_flight_record_size()) == FR_DTYPE.itemsize
+        assert int(lib.rk_flight_version()) >= 1
+        cap = int(lib.rk_flight_cap())
+        assert cap > 0 and (cap & (cap - 1)) == 0  # power of two ring
+
+    def test_transport_ring_abi(self):
+        from rabia_tpu.native import load_library
+        from rabia_tpu.obs.flight import TF_DTYPE
+
+        lib = load_library()
+        if not hasattr(lib, "rt_flight_record_size"):
+            pytest.skip("native transport predates the flight ring")
+        assert int(lib.rt_flight_record_size()) == TF_DTYPE.itemsize
+        assert int(lib.rt_flight_version()) >= 1
+
+
+class _FakeEngine:
+    """flight_events()-only stand-in for build_trace_slice tests."""
+
+    def __init__(self, events):
+        self._events = events
+        from rabia_tpu.core.types import NodeId
+
+        self.node_id = NodeId.from_int(1)
+        self.me = 0
+        self._row_to_node = {0: NodeId.from_int(1)}
+
+    def flight_events(self):
+        return self._events
+
+
+class TestTraceSlice:
+    def _ev(self, t, kind, shard=0, slot=0, peer=0xFFFF, arg=0, batch=0):
+        return {
+            "t_ns": t, "kind": kind, "shard": shard, "slot": slot,
+            "peer": peer, "arg": arg, "batch": batch,
+        }
+
+    def test_slice_joins_batch_and_slot(self):
+        h = fr_hash(batch_id_for(uuid.UUID(int=5), 1))
+        other = fr_hash(batch_id_for(uuid.UUID(int=5), 2))
+        events = [
+            self._ev(100, "submit", shard=0, batch=h),
+            self._ev(150, "propose", shard=0, slot=3, batch=h),
+            self._ev(160, "frame_in", shard=0, slot=3, peer=1, arg=2),
+            self._ev(165, "route1", shard=0, slot=3, peer=1, arg=1),
+            self._ev(170, "frame_in", shard=0, slot=4, peer=1, arg=2),
+            self._ev(180, "decide", shard=0, slot=3, arg=1, batch=h),
+            self._ev(185, "apply", shard=0, slot=3, arg=1, batch=h),
+            self._ev(190, "submit", shard=0, batch=other),
+            self._ev(200, "tf_in", arg=2),
+            self._ev(999_999_999, "tf_out", arg=2),  # far outside window
+        ]
+        doc = build_trace_slice(_FakeEngine(events), h)
+        kinds = [(e["kind"], e["slot"]) for e in doc["events"]]
+        assert ("submit", 0) in kinds
+        assert ("propose", 3) in kinds
+        assert ("frame_in", 3) in kinds  # slot join pulled the vote in
+        assert ("route1", 3) in kinds
+        assert ("decide", 3) in kinds and ("apply", 3) in kinds
+        assert ("tf_in", 0) in kinds  # in-window transport frame
+        # excluded: the other batch's submit, the off-slot vote, the
+        # out-of-window transport frame
+        assert ("frame_in", 4) not in kinds
+        assert ("tf_out", 0) not in kinds
+        batches = {e["batch"] for e in doc["events"] if e["kind"] == "submit"}
+        assert batches == {h}
+
+    def test_align_and_merge_preserve_per_replica_order(self):
+        sl_a = {
+            "node": "a", "row": 0, "mono_ns": 1_000_000_000,
+            "events": [
+                self._ev(900_000_000, "submit"),
+                self._ev(950_000_000, "decide"),
+            ],
+        }
+        sl_b = {
+            "node": "b", "row": 1, "mono_ns": 77_000_000_000,
+            "events": [self._ev(76_940_000_000, "frame_in", peer=0)],
+        }
+        # replica a answered at collector wall 100.0 (rtt 2ms), replica b
+        # at 100.5 (rtt 10ms): offsets differ wildly, order must survive
+        align_slice(sl_a, 99.999, 100.001)
+        align_slice(sl_b, 100.495, 100.505)
+        assert abs(sl_a["err_s"] - 0.001) < 1e-9
+        assert abs(sl_b["err_s"] - 0.005) < 1e-9
+        merged = merge_slices([sl_a, sl_b])
+        a_ts = [e["t"] for e in merged if e["node"] == "a"]
+        assert a_ts == sorted(a_ts)
+        # a's decide was 50ms before its serve time => ~99.95 aligned
+        dec = next(e for e in merged if e["kind"] == "decide")
+        assert abs(dec["t"] - 99.95) < 0.002
+        assert "decide" in render_timeline(merged)
+
+    def test_merge_requires_alignment(self):
+        with pytest.raises(ValueError):
+            merge_slices([{"node": "a", "row": 0, "events": []}])
+
+
+@pytest.mark.asyncio
+class TestEngineFlight:
+    async def _commit_cluster(self, n=3):
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.core.types import Command, CommandBatch, NodeId
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.net import InMemoryHub
+
+        cfg = RabiaConfig(
+            phase_timeout=2.0, heartbeat_interval=0.05, round_interval=0.001
+        ).with_kernel(num_shards=1, shard_pad_multiple=1)
+        hub = InMemoryHub()
+        nodes = [NodeId.from_int(i + 1) for i in range(n)]
+        engines = [
+            RabiaEngine(
+                ClusterConfig.new(nd, nodes), InMemoryStateMachine(),
+                hub.register(nd), config=cfg,
+            )
+            for nd in nodes
+        ]
+        tasks = [asyncio.ensure_future(e.run()) for e in engines]
+        for _ in range(300):
+            await asyncio.sleep(0.01)
+            if all(
+                [(await e.get_statistics()).has_quorum for e in engines]
+            ):
+                break
+        bids = []
+        for i in range(3):
+            batch = CommandBatch.new([Command.new(f"SET k{i} v".encode())])
+            bids.append(batch.id)
+            fut = await engines[0].submit_batch(batch)
+            assert await asyncio.wait_for(fut, 15.0) == [b"OK"]
+        return engines, tasks, bids
+
+    async def _stop(self, engines, tasks):
+        for e in engines:
+            await e.shutdown()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def test_flight_events_merged_and_ordered(self):
+        engines, tasks, bids = await self._commit_cluster()
+        try:
+            e0 = engines[0]
+            evs = e0.flight_events()
+            assert evs, "no flight events after commits"
+            ts = [e["t_ns"] for e in evs]
+            assert ts == sorted(ts)
+            kinds = {e["kind"] for e in evs}
+            assert {"submit", "decide", "apply"} <= kinds
+            if e0._rk is not None:
+                # the native ring carried the fast-path kinds
+                assert e0._rk.flight_head() > 0
+                assert {"frame_in", "open", "frame_out"} <= kinds
+            # the submitted batches are joinable by hash
+            h0 = fr_hash(bids[0])
+            assert any(e["batch"] == h0 for e in evs)
+            json.dumps(evs)  # dump-ready: plain types only
+        finally:
+            await self._stop(engines, tasks)
+
+    async def test_dump_flight_env_gated(self, tmp_path, monkeypatch):
+        engines, tasks, _ = await self._commit_cluster()
+        try:
+            e0 = engines[0]
+            monkeypatch.delenv("RABIA_FLIGHT_DIR", raising=False)
+            assert e0.dump_flight(reason="test") is None  # env unset: no-op
+            monkeypatch.setenv("RABIA_FLIGHT_DIR", str(tmp_path))
+            p = e0.dump_flight(reason="test")
+            assert p is not None and os.path.exists(p)
+            doc = json.loads(open(p).read())
+            assert doc["reason"] == "test"
+            assert doc["events"]
+            # severe journal kinds trigger the auto-dump hook
+            before = len(list(tmp_path.iterdir()))
+            e0._last_flight_dump = 0.0
+            e0.journal.record(e0.journal.STALE_STORM, row=1, entries=99)
+            assert len(list(tmp_path.iterdir())) == before + 1
+        finally:
+            await self._stop(engines, tasks)
+
+
+async def _run_gateway_trace(via_cli: bool) -> None:
+    """The acceptance path: one client command through a 3-replica TCP
+    gateway cluster, then a full cross-replica trace."""
+    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.gateway.client import RabiaClient
+    from rabia_tpu.obs.flight import collect_trace
+    from rabia_tpu.testing.gateway_cluster import GatewayCluster
+
+    cluster = GatewayCluster(n_replicas=3, n_shards=2)
+    await cluster.start()
+    client = None
+    try:
+        client = RabiaClient(cluster.endpoints())
+        await client.connect()
+        resp = await client.submit(0, [encode_set_bin("tracer", "42")])
+        assert resp
+        addrs = [("127.0.0.1", g.port) for g in cluster.gateways]
+        if via_cli:
+            # the real console entry point (`python -m rabia_tpu trace`),
+            # run on a worker thread so its asyncio.run gets its own loop
+            from rabia_tpu.__main__ import main as cli_main
+
+            rc = await asyncio.to_thread(
+                cli_main,
+                ["trace", *[f"{h}:{p}" for h, p in addrs],
+                 "--client", str(client.client_id), "--seq", "1"],
+            )
+            assert rc == 0
+            return
+        merged = await collect_trace(addrs, client.client_id, 1)
+        stages = timeline_stages(merged)
+
+        # -- every stage present ----------------------------------------
+        for stage in ("submit", "propose", "decide", "apply", "result"):
+            assert stage in stages, f"stage {stage!r} missing: {sorted(stages)}"
+        # per-peer R1/R2 votes: every vote frame consumed anywhere in the
+        # cluster leaves a frame_in record tagged with its sender row —
+        # both quorum voters must therefore appear for each round
+        r1_rows = {
+            e["peer"] for e in stages.get("frame_in", []) if e["arg"] == 2
+        }
+        r2_rows = {
+            e["peer"] for e in stages.get("frame_in", []) if e["arg"] == 3
+        }
+        assert len(r1_rows) >= 2, f"R1 votes from {r1_rows} only"
+        assert len(r2_rows) >= 2, f"R2 votes from {r2_rows} only"
+
+        # -- monotonically ordered after clock alignment ----------------
+        # the submitter replica (row 0) carries all five milestones on
+        # ONE clock, so their aligned order must be exact
+        def first(stage, row=0):
+            return min(
+                e["t"] for e in stages[stage] if e["row"] == row
+            )
+
+        t_submit = first("submit")
+        t_propose = first("propose")
+        t_decide = first("decide")
+        t_apply = first("apply")
+        t_result = first("result")
+        assert t_submit <= t_propose <= t_decide <= t_apply <= t_result
+        # peer vote events land between propose and decide within the
+        # alignment error bound
+        tol = max(e["err_s"] for e in merged) + 0.001
+        for e in stages.get("frame_in", []):
+            if e["arg"] in (2, 3) and e["slot"] == stages["decide"][0]["slot"]:
+                assert t_submit - tol <= e["t"]
+        # the merged list itself is time-sorted
+        ts = [e["t"] for e in merged]
+        assert ts == sorted(ts)
+    finally:
+        if client is not None:
+            await client.close()
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+class TestGatewayTrace:
+    async def test_trace_reconstructs_commit_timeline(self):
+        await _run_gateway_trace(via_cli=False)
+
+    async def test_trace_cli_end_to_end(self):
+        await _run_gateway_trace(via_cli=True)
+
+    async def test_trace_python_tick_path(self, monkeypatch):
+        """The equivalent Python-side ring: the same timeline must
+        reconstruct with the native tick forced off."""
+        monkeypatch.setenv("RABIA_PY_TICK", "1")
+        await _run_gateway_trace(via_cli=False)

@@ -581,6 +581,8 @@ class TestGatewayProtocolFrames:
             ReadIndex(mode=int(ReadIndexMode.REPLY), client_id=cid,
                       seq=3, frontier=(5, 0, 12)),
             AdminRequest(kind=1, nonce=42),
+            AdminRequest(kind=3, nonce=43,
+                         query=b'{"client": "00ff", "seq": 2}'),
             AdminResponse(nonce=42, status=0, body=b"# TYPE x counter\n"),
         ]
         s = BinarySerializer()
@@ -591,3 +593,14 @@ class TestGatewayProtocolFrames:
             assert wire == s._serialize_py(msg)
             assert s._deserialize_py(wire).payload == p
             assert s.deserialize(wire).payload == p
+
+        # pre-trace AdminRequest bodies (no trailing query blob) still
+        # decode — the query field is a wire-compatible append
+        import struct
+
+        from rabia_tpu.core.messages import MessageType
+        from rabia_tpu.core.serialization import _decode_payload, _Reader
+
+        legacy_body = bytes([1]) + struct.pack("<Q", 42)
+        decoded = _decode_payload(MessageType.AdminRequest, _Reader(legacy_body))
+        assert decoded == AdminRequest(kind=1, nonce=42, query=b"")

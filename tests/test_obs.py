@@ -61,6 +61,25 @@ class TestHistogram:
         assert h.quantile(0.5) == 0.0
         assert h.snapshot()["count"] == 0
 
+    def test_overflow_bucket_clamps_not_extrapolates(self):
+        """Observations above the top bucket boundary land only in +Inf
+        and every quantile clamps to the top bound — the estimator must
+        never invent values past what it measured."""
+        m = MetricsRegistry()
+        h = m.histogram("ovf_seconds", buckets=(0.1, 1.0))
+        h.observe(50.0)
+        h.observe(100.0)
+        h.observe(0.05)
+        assert h.count == 3
+        assert h.counts == [1, 0]  # only the in-range observation bucketed
+        for q in (0.5, 0.9, 0.99, 1.0):
+            assert h.quantile(q) <= 1.0
+        assert h.quantile(0.99) == 1.0
+        text = m.render_prometheus()
+        assert 'rabia_ovf_seconds_bucket{le="1"} 1' in text
+        assert 'rabia_ovf_seconds_bucket{le="+Inf"} 3' in text
+        assert "rabia_ovf_seconds_count 3" in text
+
 
 class TestRegistry:
     def test_registration_identity_idempotent(self):
@@ -130,6 +149,31 @@ class TestRegistry:
         text = m.render_prometheus()
         assert 'k="a\\"b\\\\c"' in text
 
+    def test_label_escaping_round_trip(self):
+        """Label values containing ``"`` and newlines must render escaped
+        per the exposition format and un-escape back to the original —
+        a scraper parsing the line recovers the exact value."""
+        import re
+
+        raw = 'quote " back\\slash and\nnewline'
+        m = MetricsRegistry()
+        m.counter("rt_total", labels={"k": raw}).inc(2)
+        text = m.render_prometheus()
+        assert "\n" not in raw.replace("\n", "") and raw.count("\n") == 1
+        line = next(
+            ln for ln in text.split("\n") if ln.startswith("rabia_rt_total{")
+        )  # the raw newline never split the sample line
+        mlab = re.search(r'k="((?:[^"\\]|\\.)*)"', line)
+        assert mlab is not None
+        unescaped = (
+            mlab.group(1)
+            .replace("\\n", "\n")
+            .replace('\\"', '"')
+            .replace("\\\\", "\\")
+        )
+        assert unescaped == raw
+        assert line.endswith(" 2")
+
 
 class TestJournal:
     def test_bounded_ring_and_tallies(self):
@@ -144,6 +188,38 @@ class TestJournal:
         assert [e["kind"] for e in j.snapshot(kind=j.SYNC_OVERTAKE)] == [
             j.SYNC_OVERTAKE
         ]
+
+    def test_entries_carry_wall_and_monotonic_pair(self):
+        """Entries stamp (ts, mono_ns) so journal anomalies correlate
+        with flight-recorder monotonic timestamps across NTP steps."""
+        import time
+
+        j = AnomalyJournal()
+        lo = time.monotonic_ns()
+        j.record(j.SLOW_TICK, dt_ms=3.0)
+        hi = time.monotonic_ns()
+        (e,) = j.snapshot()
+        assert isinstance(e["ts"], float)
+        assert isinstance(e["mono_ns"], int)
+        assert lo <= e["mono_ns"] <= hi
+
+    def test_severe_kinds_fire_hook(self):
+        j = AnomalyJournal()
+        fired = []
+        j.on_severe = fired.append
+        j.record(j.SLOW_TICK, dt_ms=1.0)  # not severe
+        assert fired == []
+        j.record(j.STALE_STORM, row=2, entries=80)
+        j.record(j.QUORUM_LOST, active=1)
+        assert fired == [j.STALE_STORM, j.QUORUM_LOST]
+
+        # a raising hook never breaks recording
+        def boom(kind):
+            raise RuntimeError("dump failed")
+
+        j.on_severe = boom
+        j.record(j.SYNC_OVERTAKE, shard=0, batch="x")
+        assert j.counts()[j.SYNC_OVERTAKE] == 1
 
 
 class TestHTTPShim:
@@ -165,11 +241,48 @@ class TestHTTPShim:
             with urllib.request.urlopen(base + "/journal", timeout=5) as r:
                 doc = json.loads(r.read())
                 assert doc["anomalies"][0]["dials"] == 9
+                assert "mono_ns" in doc["anomalies"][0]
             try:
                 urllib.request.urlopen(base + "/nope", timeout=5)
                 raise AssertionError("404 expected")
             except urllib.error.HTTPError as e:
                 assert e.code == 404
+        finally:
+            srv.close()
+
+    def test_journal_query_filters(self):
+        """/journal?kind=&last=N filters the ring server-side."""
+        m = MetricsRegistry()
+        j = AnomalyJournal()
+        for i in range(8):
+            j.record(j.SLOW_TICK, i=i)
+        j.record(j.REDIAL_CHURN, dials=12)
+        srv = AdminHTTPServer(m, journal=j)
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(
+                base + "/journal?kind=slow_tick&last=3", timeout=5
+            ) as r:
+                doc = json.loads(r.read())
+            assert [e["i"] for e in doc["anomalies"]] == [5, 6, 7]
+            assert all(
+                e["kind"] == "slow_tick" for e in doc["anomalies"]
+            )
+            with urllib.request.urlopen(
+                base + "/journal?kind=redial_churn", timeout=5
+            ) as r:
+                doc = json.loads(r.read())
+            assert [e["dials"] for e in doc["anomalies"]] == [12]
+            # malformed last falls back to the default rather than 500
+            with urllib.request.urlopen(
+                base + "/journal?last=bogus", timeout=5
+            ) as r:
+                assert len(json.loads(r.read())["anomalies"]) == 9
+            # last=0 means zero entries, not the whole ring
+            with urllib.request.urlopen(
+                base + "/journal?last=0", timeout=5
+            ) as r:
+                assert json.loads(r.read())["anomalies"] == []
         finally:
             srv.close()
 
