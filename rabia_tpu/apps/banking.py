@@ -103,6 +103,7 @@ class BankingSMR(TypedStateMachine[BankCommand, BankResponse, dict]):
         self._accounts: dict[str, Account] = {}
         self._history: list[TransactionRecord] = []
         self._seq = 0
+        self._cmd_cache: dict[bytes, BankCommand] = {}
 
     # -- invariant ----------------------------------------------------------
 
@@ -230,18 +231,42 @@ class BankingSMR(TypedStateMachine[BankCommand, BankResponse, dict]):
         ).encode()
 
     def decode_command(self, data: bytes) -> BankCommand:
+        # bounded decode cache: commands are immutable and command bytes
+        # repeat heavily under hot accounts (a deposit storm decodes ONE
+        # JSON doc, not one per committed slot) — the config-4 profile
+        # showed per-op json.loads as the largest apply-path cost
+        if not isinstance(data, bytes):  # bytearray/memoryview callers
+            data = bytes(data)
+        cached = self._cmd_cache.get(data)
+        if cached is not None:
+            return cached
         try:
-            doc = json.loads(data)
-            return BankCommand(
+            doc = json.loads(data.decode())
+            cmd = BankCommand(
                 BankOp(doc["op"]),
                 doc.get("account", ""),
                 doc.get("to", ""),
                 int(doc.get("cents", 0)),
             )
-        except (ValueError, KeyError) as e:
+        except (ValueError, KeyError, UnicodeDecodeError) as e:
             raise StateMachineError(f"bad bank command: {e}") from None
+        if len(self._cmd_cache) > 4096:  # bound against command spraying
+            self._cmd_cache.clear()
+        self._cmd_cache[data] = cmd
+        return cmd
 
     def encode_response(self, response: BankResponse) -> bytes:
+        if response.accounts is None and response.error is None:
+            # the steady-state shape (ok + balance): hand-framed,
+            # byte-identical to the json.dumps output below
+            bal = response.balance_cents
+            return (
+                '{"ok":%s,"balance":%s,"accounts":null,"error":null}'
+                % (
+                    "true" if response.ok else "false",
+                    "null" if bal is None else bal,
+                )
+            ).encode()
         return json.dumps(
             {
                 "ok": response.ok,

@@ -47,18 +47,24 @@ class KVOpType(enum.Enum):
     Delete = "delete"
     Exists = "exists"
     Clear = "clear"
+    Cas = "cas"
 
 
-_WRITE_OPS = {KVOpType.Set, KVOpType.Delete, KVOpType.Clear}
+_WRITE_OPS = {KVOpType.Set, KVOpType.Delete, KVOpType.Clear, KVOpType.Cas}
 
 
 @dataclass(frozen=True)
 class KVOperation:
-    """One typed store operation (operations.rs:9-51)."""
+    """One typed store operation (operations.rs:9-51).
+
+    ``expected_version`` is meaningful for :attr:`KVOpType.Cas` only: the
+    entry version the write is conditioned on (0 = create-if-absent).
+    """
 
     op: KVOpType
     key: str = ""
     value: Optional[str] = None
+    expected_version: int = 0
 
     @property
     def is_write(self) -> bool:
@@ -83,6 +89,10 @@ class KVOperation:
     @staticmethod
     def exists(key: str) -> "KVOperation":
         return KVOperation(KVOpType.Exists, key)
+
+    @staticmethod
+    def cas(key: str, value: str, expected_version: int) -> "KVOperation":
+        return KVOperation(KVOpType.Cas, key, value, expected_version)
 
 
 class KVResultKind(enum.Enum):
@@ -535,6 +545,42 @@ class KVStore:
             self._notify(key, ChangeType.Updated, old, value)
         return KVResult.success(version=self._version)
 
+    def cas(self, key: str, value: str, expected_version: int) -> KVResult:
+        """Compare-and-set: write only when the entry's version equals
+        ``expected_version`` (0 = create-if-absent). Deterministic outcomes
+        (the replicated-write contract): NotFound for a conditioned write
+        on an absent key, ``version_conflict`` (with the CURRENT version in
+        the result) on a mismatch — so optimistic-concurrency clients can
+        retry off the committed result alone."""
+        self._validate_key(key)
+        self._validate_value(value)
+        now = time.time()
+        self.stats.total_operations += 1
+        self.stats.writes += 1
+        entry = self._data.get(key)
+        if entry is None:
+            if expected_version != 0:
+                return KVResult.not_found()
+            if len(self._data) >= self.config.max_keys:
+                raise StoreError(StoreErrorKind.StoreFull)
+            self._version += 1
+            self._data[key] = ValueEntry(value, self._version, now, now)
+            self._notify(key, ChangeType.Created, None, value)
+            return KVResult.success(version=self._version)
+        if entry.version != expected_version:
+            return KVResult(
+                KVResultKind.Error,
+                version=entry.version,
+                error="version_conflict",
+            )
+        old = entry.value
+        self._version += 1
+        entry.value = value
+        entry.version = self._version
+        entry.updated_at = now
+        self._notify(key, ChangeType.Updated, old, value)
+        return KVResult.success(version=self._version)
+
     def get(self, key: str) -> KVResult:
         self.stats.total_operations += 1
         self.stats.reads += 1
@@ -611,6 +657,10 @@ class KVStore:
                     out.append(self.delete(op.key))
                 elif op.op == KVOpType.Exists:
                     out.append(self.exists(op.key))
+                elif op.op == KVOpType.Cas:
+                    out.append(
+                        self.cas(op.key, op.value or "", op.expected_version)
+                    )
                 elif op.op == KVOpType.Clear:
                     self.clear()
                     out.append(KVResult.success())
@@ -675,12 +725,21 @@ class KVStore:
 # Compact binary op codec (the block lane's command format)
 # ---------------------------------------------------------------------------
 #
-# op:     u8 opcode (1=SET 2=GET 3=DEL 4=EXISTS) | u16 LE keylen | key utf8
-#         | value utf8 (SET only, rest of buffer)
+# op:     u8 opcode (1=SET 2=GET 3=DEL 4=EXISTS 5=CLEAR 6=CAS)
+#         | u16 LE keylen | key utf8
+#         | value utf8 (SET: rest of buffer)
+#         | u64 LE expected_version | value utf8 (CAS only)
 # result: u8 kind (0=success 1=not_found 2=error) | u32 LE version
-#         | value utf8 (rest; error text for kind=2)
+#         | u8 has_value | value utf8 (rest; error text for kind=2 —
+#         the presence byte keeps "" distinct from "no value")
+#
+# The same records ride the wire (gateway Submit commands), the ledger
+# (CommandBatch/PayloadBlock payloads) and the apply plane — the native
+# statekernel (native/statekernel.cpp) consumes exactly these bytes and
+# must produce byte-identical result frames to apply_op_bin below, which
+# stays the semantics owner (RABIA_PY_APPLY=1 forces it).
 
-_OP_SET, _OP_GET, _OP_DEL, _OP_EXISTS, _OP_CLEAR = 1, 2, 3, 4, 5
+_OP_SET, _OP_GET, _OP_DEL, _OP_EXISTS, _OP_CLEAR, _OP_CAS = 1, 2, 3, 4, 5, 6
 
 
 def encode_op_bin(op: KVOperation) -> bytes:
@@ -688,6 +747,12 @@ def encode_op_bin(op: KVOperation) -> bytes:
     head = bytes((_OP_CODE[op.op],)) + len(kb).to_bytes(2, "little") + kb
     if op.op == KVOpType.Set:
         return head + (op.value or "").encode()
+    if op.op == KVOpType.Cas:
+        return (
+            head
+            + int(op.expected_version).to_bytes(8, "little")
+            + (op.value or "").encode()
+        )
     return head
 
 
@@ -697,7 +762,19 @@ _OP_CODE = {
     KVOpType.Delete: _OP_DEL,
     KVOpType.Exists: _OP_EXISTS,
     KVOpType.Clear: _OP_CLEAR,
+    KVOpType.Cas: _OP_CAS,
 }
+
+
+def encode_cas_bin(key: str, value: str, expected_version: int) -> bytes:
+    kb = key.encode()
+    return (
+        b"\x06"
+        + len(kb).to_bytes(2, "little")
+        + kb
+        + int(expected_version).to_bytes(8, "little")
+        + value.encode()
+    )
 
 
 def encode_set_bin(key: str, value: str) -> bytes:
@@ -724,8 +801,18 @@ def decode_op_bin(data: bytes) -> KVOperation:
         if 3 + klen > len(data):
             raise KeyError(f"key length {klen} exceeds payload")
         key = data[3 : 3 + klen].decode()
-        value = data[3 + klen :].decode() if op == KVOpType.Set else None
-        return KVOperation(op, key, value)
+        if op == KVOpType.Set:
+            return KVOperation(op, key, data[3 + klen :].decode())
+        if op == KVOpType.Cas:
+            if 3 + klen + 8 > len(data):
+                raise KeyError("cas payload shorter than its version field")
+            expected = int.from_bytes(
+                data[3 + klen : 3 + klen + 8], "little"
+            )
+            return KVOperation(
+                op, key, data[3 + klen + 8 :].decode(), expected
+            )
+        return KVOperation(op, key, None)
     except (KeyError, IndexError, UnicodeDecodeError) as e:
         from rabia_tpu.core.errors import StateMachineError
 
@@ -756,7 +843,11 @@ def decode_result_bin(data: bytes) -> KVResult:
         return KVResult.success(value=value, version=version or None)
     if kind == 1:
         return KVResult.not_found()
-    return KVResult.err(value or "error")
+    # error results carry the entry's CURRENT version when known (CAS
+    # conflicts report it so optimistic clients can retry without a read)
+    return KVResult(
+        KVResultKind.Error, error=value or "error", version=version or None
+    )
 
 
 def apply_ops_bin(store: "KVStore", ops, now: Optional[float] = None) -> list[bytes]:
@@ -765,7 +856,13 @@ def apply_ops_bin(store: "KVStore", ops, now: Optional[float] = None) -> list[by
     per-op overhead amortized — one clock read per wave, notification
     publish skipped when nobody subscribes, no intermediate KVResult
     objects on the SET fast path. Non-SET / limit-violating ops fall back
-    to :func:`apply_op_bin` per op."""
+    to :func:`apply_op_bin` per op.
+
+    Native stores (apps/native_store.NativeKVStore) take the statekernel
+    wave path — same records in, byte-identical result frames out (the
+    apply-path conformance gate pins this)."""
+    if getattr(store, "is_native", False):
+        return store.apply_bin_many(ops, now)
     if now is None:
         now = time.time()
     data = store._data
@@ -826,6 +923,8 @@ def apply_ops_bin(store: "KVStore", ops, now: Optional[float] = None) -> list[by
 
 def apply_op_bin(store: "KVStore", data: bytes) -> bytes:
     """Apply one binary-encoded op against a store; binary result."""
+    if getattr(store, "is_native", False):
+        return store.apply_bin(data)
     try:
         opcode = data[0]
         klen = int.from_bytes(data[1:3], "little")
@@ -850,10 +949,29 @@ def apply_op_bin(store: "KVStore", data: bytes) -> bytes:
             return _result_bin(0, 0, res.value or "false")
         if opcode == _OP_CLEAR:
             return _result_bin(0, 0, str(store.clear()))
+        if opcode == _OP_CAS:
+            if 3 + klen + 8 > len(data):
+                return _result_bin(
+                    2, 0, "malformed op: cas payload shorter than its "
+                    "version field"
+                )
+            expected = int.from_bytes(
+                data[3 + klen : 3 + klen + 8], "little"
+            )
+            res = store.cas(key, data[3 + klen + 8 :].decode(), expected)
+            if res.kind == KVResultKind.NotFound:
+                return _result_bin(1, 0)
+            if res.kind == KVResultKind.Error:
+                return _result_bin(2, res.version or 0, res.error)
+            return _result_bin(0, res.version or 0)
         return _result_bin(2, 0, f"unknown opcode {opcode}")
     except StoreError as e:
         return _result_bin(2, 0, str(e))
-    except (IndexError, UnicodeDecodeError) as e:
+    except UnicodeDecodeError:
+        # canonical text (no codec positions): the native statekernel's
+        # validator must produce byte-identical error frames
+        return _result_bin(2, 0, "malformed op: invalid utf-8")
+    except IndexError as e:
         return _result_bin(2, 0, f"malformed op: {e}")
 
 
@@ -867,10 +985,17 @@ class KVStoreSMR(TypedStateMachine[KVOperation, KVResult, dict]):
 
     One instance serves ONE shard's consensus log; a sharded deployment runs
     `num_shards` of these behind :class:`ShardedKVService`.
+
+    ``store`` may be a :class:`KVStore` (default) or a
+    :class:`~rabia_tpu.apps.native_store.NativeKVStore` view — the typed
+    surface and the binary apply path work identically over either (the
+    conformance gate pins the equivalence).
     """
 
-    def __init__(self, config: Optional[KVStoreConfig] = None) -> None:
-        self.store = KVStore(config)
+    def __init__(
+        self, config: Optional[KVStoreConfig] = None, store=None
+    ) -> None:
+        self.store = store if store is not None else KVStore(config)
 
     def apply_command(self, command: KVOperation) -> KVResult:
         self._bump_version()
@@ -878,25 +1003,39 @@ class KVStoreSMR(TypedStateMachine[KVOperation, KVResult, dict]):
         return self.store.apply_operations([command])[0]
 
     def get_state(self) -> dict:
+        if getattr(self.store, "is_native", False):
+            return self.store.get_state_dict()
         return {k: e.value for k, e in self.store._data.items()}
 
     def set_state(self, state: dict) -> None:
+        if getattr(self.store, "is_native", False):
+            self.store.set_state_dict(state)
+            return
         self.store._data = {
             k: ValueEntry(v, 0, time.time(), time.time()) for k, v in state.items()
         }
 
     def encode_command(self, command: KVOperation) -> bytes:
-        return json.dumps(
-            {"op": command.op.value, "key": command.key, "value": command.value},
-            separators=(",", ":"),
-        ).encode()
+        doc = {
+            "op": command.op.value,
+            "key": command.key,
+            "value": command.value,
+        }
+        if command.op == KVOpType.Cas:
+            doc["expected_version"] = command.expected_version
+        return json.dumps(doc, separators=(",", ":")).encode()
 
     def decode_command(self, data: bytes) -> KVOperation:
         if data[:1] != b"{":
             return decode_op_bin(data)
         try:
             doc = json.loads(data)
-            return KVOperation(KVOpType(doc["op"]), doc.get("key", ""), doc.get("value"))
+            return KVOperation(
+                KVOpType(doc["op"]),
+                doc.get("key", ""),
+                doc.get("value"),
+                int(doc.get("expected_version", 0)),
+            )
         except (ValueError, KeyError) as e:
             raise StateMachineError(f"bad kv command: {e}") from None
 

@@ -39,6 +39,11 @@ from rabia_tpu.apps.kvstore import (
 )
 
 
+_BIN_OPCODES = frozenset(
+    bytes((c,)) for c in (1, 2, 3, 4, 5, 6)
+)  # SET GET DEL EXISTS CLEAR CAS (apps/kvstore.py binary op codec)
+
+
 class ShardedStateMachine(StateMachine, VectorStateMachine):
     """Routes committed batches to per-shard typed machines by batch.shard.
 
@@ -57,6 +62,24 @@ class ShardedStateMachine(StateMachine, VectorStateMachine):
         self.bridges = [SMRBridge(m) for m in machines]
         self.machines = list(machines)
         self._version = 0
+        # native apply plane (apps/native_store): when every shard store
+        # is a NativeKVStore view over ONE shared plane, a decided wave
+        # applies in a single statekernel call (apply_block below)
+        self._native_plane = None
+        self._native_stores = [
+            getattr(m, "store", None) for m in self.machines
+        ]
+        stores = self._native_stores
+        if stores and all(
+            getattr(s, "is_native", False) for s in stores
+        ):
+            planes = {id(s.plane) for s in stores}
+            # exact width match: the native wave routes by
+            # shard % n_stores while the Python paths route by
+            # shard % len(machines) — any mismatch would silently
+            # diverge the two conformance-pinned paths
+            if len(planes) == 1 and stores[0].plane.n_stores == len(stores):
+                self._native_plane = stores[0].plane
 
     @property
     def num_shards(self) -> int:
@@ -70,8 +93,21 @@ class ShardedStateMachine(StateMachine, VectorStateMachine):
         return self.bridges[0].apply_command(command)
 
     def apply_batch(self, batch: CommandBatch) -> list[bytes]:
-        bridge = self._bridge_for(int(batch.shard))
-        return [bridge.apply_command(c) for c in batch.commands]
+        shard = int(batch.shard)
+        cmds = batch.commands
+        m = self.machines[shard % len(self.machines)]
+        raw_many = getattr(m, "apply_raw_many", None)
+        if (
+            raw_many is not None
+            and cmds
+            and all(c.data[:1] in _BIN_OPCODES for c in cmds)
+        ):
+            # binary commands skip per-op Command/typed materialization
+            # (scalar-lane analog of the block lane's apply_raw path;
+            # native stores take the statekernel from here)
+            return list(raw_many([c.data for c in cmds]))
+        bridge = self._bridge_for(shard)
+        return [bridge.apply_command(c) for c in cmds]
 
     def apply_block(self, block, idxs, want_responses: bool = True):
         """Bulk apply for the engine's block lane (VectorStateMachine).
@@ -83,6 +119,22 @@ class ShardedStateMachine(StateMachine, VectorStateMachine):
 
         now = _time.time()
         n = len(self.machines)
+        plane = self._native_plane
+        if plane is not None:
+            # subscribed stores demote (old-value capture for the
+            # notification stream happens per op in the bridge)
+            covered = np.asarray(idxs).tolist()
+            stores = self._native_stores
+            shards_l = block.shards
+            if not any(
+                stores[int(shards_l[i]) % n]._subscribed() for i in covered
+            ):
+                res = plane.apply_block_wave(
+                    block, covered, now, want_responses
+                )
+                if res is not NotImplemented:
+                    self._version += len(covered)
+                    return res
         machines = self.machines
         shards = block.shards.tolist()
         starts = block.shard_starts.tolist()
@@ -156,10 +208,34 @@ class ShardedStateMachine(StateMachine, VectorStateMachine):
 
 
 def make_sharded_kv(
-    num_shards: int, config: Optional[KVStoreConfig] = None
+    num_shards: int,
+    config: Optional[KVStoreConfig] = None,
+    native: Optional[bool] = None,
 ) -> tuple[ShardedStateMachine, list[KVStoreSMR]]:
-    """Build one `KVStoreSMR` per shard behind a routing SM."""
-    machines = [KVStoreSMR(config) for _ in range(num_shards)]
+    """Build one `KVStoreSMR` per shard behind a routing SM.
+
+    ``native`` selects the apply plane: True = the statekernel-backed
+    :class:`~rabia_tpu.apps.native_store.NativeKVStore` per shard (one
+    shared plane; decided waves apply in one C call), False = the Python
+    :class:`KVStore` (the semantics owner), None (default) = native when
+    the library is available and ``RABIA_PY_APPLY`` != 1."""
+    if native is None:
+        from rabia_tpu.apps.native_store import native_apply_available
+
+        native = native_apply_available()
+    if native:
+        from rabia_tpu.apps.native_store import (
+            NativeKVStore,
+            NativeStorePlane,
+        )
+
+        plane = NativeStorePlane(num_shards, config)
+        machines = [
+            KVStoreSMR(config, store=NativeKVStore(config, plane, s))
+            for s in range(num_shards)
+        ]
+    else:
+        machines = [KVStoreSMR(config) for _ in range(num_shards)]
     return ShardedStateMachine(machines), machines
 
 

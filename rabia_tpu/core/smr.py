@@ -73,6 +73,22 @@ class TypedStateMachine(abc.ABC, Generic[C, R, S]):
     def deserialize_state(self, data: bytes) -> None:
         ...
 
+    # -- raw fast path ------------------------------------------------------
+
+    def apply_raw(self, data: bytes) -> bytes:
+        """Apply one ENCODED command; encoded response — the block/apply
+        lane's per-op path. The default is decode→apply→encode without
+        the bridge round trip (no :class:`Command` object, no uuid per
+        op); apps with a binary format override it (KVStoreSMR)."""
+        self._bump_version()
+        return self.encode_response(
+            self.apply_command(self.decode_command(data))
+        )
+
+    def apply_raw_many(self, ops: Sequence[bytes], now=None) -> list[bytes]:
+        """Bulk :meth:`apply_raw` (one decided wave of a shard)."""
+        return [self.apply_raw(b) for b in ops]
+
     # -- markers -----------------------------------------------------------
 
     def is_deterministic(self) -> bool:

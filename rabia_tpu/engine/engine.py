@@ -368,6 +368,11 @@ class RabiaEngine:
             np.full(self.S, V0, np.int8),
         )
         self._apply_dirty: set[int] = set()
+        # pipelined apply stage (engine/apply_plane.py): inline up to a
+        # budget, backlog drains off-tick so consensus keeps rounding
+        from rabia_tpu.engine.apply_plane import ApplyPlane
+
+        self._apply_plane = ApplyPlane(self)
         # native columnar helpers (hostkernel.cpp); None -> numpy paths
         from rabia_tpu.native.build import load_hostkernel
 
@@ -591,6 +596,37 @@ class RabiaEngine:
             "engine_syncs_total", "Snapshot syncs initiated",
             fn=lambda: self._syncs,
         )
+        # -- pipelined apply stage (engine/apply_plane.py) ---------------
+        m.gauge(
+            "apply_backlog_shards",
+            "Shards with decided slots queued to the apply-plane drain",
+            fn=lambda: self._apply_plane.backlog,
+        )
+        m.counter(
+            "apply_deferred_slots_total",
+            "Slots applied by the apply-plane drain task (off-tick)",
+            fn=lambda: self._apply_plane.deferred_slots,
+        )
+        m.counter(
+            "apply_drains_total",
+            "Apply-plane drain task activations",
+            fn=lambda: self._apply_plane.drains,
+        )
+        # -- native apply plane (statekernel SKC counter block), when the
+        #    state machine exposes one ---------------------------------
+        sk_plane = getattr(self.sm, "_native_plane", None)
+        if sk_plane is not None:
+            for name in ("waves", "ops", "errors", "cas_misses"):
+                m.counter(
+                    f"apply_native_{name}_total",
+                    "Native apply plane counter (statekernel SKC block)",
+                    fn=lambda r=name, pl=sk_plane: pl.counter(r),
+                )
+            m.gauge(
+                "apply_native_plane",
+                "1 when the statekernel apply plane is active",
+                fn=lambda: 1,
+            )
         m.counter(
             "engine_flight_records_total",
             "Flight-recorder records written (native ring + Python ring)",
@@ -703,6 +739,16 @@ class RabiaEngine:
         evs = self.flight.snapshot()
         if self._rk is not None:
             evs.extend(native_ring_events(self._rk.flight_snapshot()))
+        # native apply plane (statekernel): one apply record per wave on
+        # the C path, merged alongside the per-slot Python APPLY events
+        sk_plane = getattr(self.sm, "_native_plane", None)
+        if sk_plane is not None:
+            try:
+                evs.extend(
+                    native_ring_events(sk_plane.flight_snapshot())
+                )
+            except Exception:  # a closed plane must not kill a dump
+                pass
         tf = getattr(self.transport, "flight_snapshot", None)
         if callable(tf):
             try:
@@ -1089,6 +1135,12 @@ class RabiaEngine:
                 logger.exception("flight dump on unclean shutdown failed")
             raise
         finally:
+            # settle any deferred apply backlog before externalizing
+            # state (persistence checkpoint, late stats readers)
+            try:
+                self._apply_plane.flush_sync()
+            except Exception:
+                logger.exception("apply-plane flush on shutdown failed")
             if self._dirty:
                 await self._save_state()
             self.rt.is_active = False
@@ -2849,89 +2901,101 @@ class RabiaEngine:
     # -- decision application ------------------------------------------------
 
     def _apply_ready(self) -> int:
-        """Apply decided slots in order per shard (engine.rs:684-746)."""
+        """Apply decided slots in order per shard, through the pipelined
+        apply stage (engine/apply_plane.py): up to the inline budget
+        applies synchronously (the serial commit path never waits for a
+        scheduler hop); a deeper backlog queues to the drain task so the
+        NEXT consensus round progresses while the state machine catches
+        up. Returns slots applied inline."""
         if not self._apply_dirty:
             return 0
-        applied = 0
         dirty = self._apply_dirty
         self._apply_dirty = set()
-        for s in dirty:
-            sh = self.rt.shards[s]
-            while True:
-                slot = sh.applied_upto
-                rec = sh.decisions.get(slot)
-                if rec is None or rec.applied:
-                    if rec is None:
-                        break
-                    sh.applied_upto += 1
-                    continue
-                if rec.value == StateValue.V1:
-                    batch = (
-                        sh.payloads.get(rec.batch_id)
-                        if rec.batch_id is not None
-                        else None
-                    )
-                    if rec.batch_id is not None and rec.batch_id in sh.applied_ids:
-                        # duplicate commit (same batch decided in an earlier
-                        # slot): never apply twice; just settle the future
-                        logger.debug(
-                            "row %d shard %d slot %d: dedup-skip batch %s",
-                            self.me, s, slot, rec.batch_id,
-                        )
-                        for i, sub in enumerate(list(sh.queue)):
-                            if sub.batch.id == rec.batch_id:
-                                del sh.queue[i]
-                                self._settle_from_ledger(sh, sub)
-                                break
-                    elif batch is None:
-                        # decided V1 but never saw the payload: snapshot sync
-                        # is the recovery path (engine.rs:748-844, §3.3)
-                        self._spawn(self._initiate_sync())
-                        break
-                    else:
-                        try:
-                            responses = self.sm.apply_batch(batch)
-                        except Exception as e:
-                            # a committed batch the state machine rejects
-                            # (undecodable command, app-level panic) fails
-                            # DETERMINISTICALLY on every replica: consume
-                            # the slot, fail the submitter — never let one
-                            # bad command kill the consensus loop
-                            logger.warning(
-                                "apply failed for batch %s on shard %d: %s",
-                                rec.batch_id,
-                                s,
-                                e,
-                            )
-                            responses = None
-                        sh.applied_ids[rec.batch_id] = None
-                        sh.applied_results[rec.batch_id] = responses
-                        self.rt.state_version += 1
-                        self.rt.v1_applied[s] += 1
-                        if responses is not None:
-                            self._resolve_local(sh, batch, responses)
-                        else:
-                            self._fail_local(sh, batch.id, RabiaError("apply failed"))
-                else:
-                    self._requeue_null_slot(sh, slot, rec)
-                rec.applied = True
-                self.flight.record(
-                    FRE_APPLY, shard=s, slot=slot, arg=int(rec.value),
-                    batch=(
-                        fr_hash(rec.batch_id)
-                        if rec.batch_id is not None
-                        else 0
-                    ),
-                )
-                self._h_stage["decide_apply"].observe(
-                    time.time() - rec.decided_at
-                )
+        return self._apply_plane.apply_ready(dirty)
+
+    def _apply_shard_ready(self, s: int, budget: int) -> tuple[int, bool]:
+        """Apply up to ``budget`` ready slots of shard ``s`` in slot
+        order (engine.rs:684-746). Returns (applied, more_ready) —
+        ``more_ready`` means the next slot is decided and applicable
+        right now (the apply plane keeps draining it)."""
+        applied = 0
+        sh = self.rt.shards[s]
+        while True:
+            if applied >= budget:
+                return applied, True
+            slot = sh.applied_upto
+            rec = sh.decisions.get(slot)
+            if rec is None or rec.applied:
+                if rec is None:
+                    break
                 sh.applied_upto += 1
-                sh.gc_upto(sh.applied_upto)
-                applied += 1
-        if applied:
-            self.rt.last_apply_time = time.time()
-        return applied
+                continue
+            if rec.value == StateValue.V1:
+                batch = (
+                    sh.payloads.get(rec.batch_id)
+                    if rec.batch_id is not None
+                    else None
+                )
+                if rec.batch_id is not None and rec.batch_id in sh.applied_ids:
+                    # duplicate commit (same batch decided in an earlier
+                    # slot): never apply twice; just settle the future
+                    logger.debug(
+                        "row %d shard %d slot %d: dedup-skip batch %s",
+                        self.me, s, slot, rec.batch_id,
+                    )
+                    for i, sub in enumerate(list(sh.queue)):
+                        if sub.batch.id == rec.batch_id:
+                            del sh.queue[i]
+                            self._settle_from_ledger(sh, sub)
+                            break
+                elif batch is None:
+                    # decided V1 but never saw the payload: snapshot sync
+                    # is the recovery path (engine.rs:748-844, §3.3)
+                    self._spawn(self._initiate_sync())
+                    break
+                else:
+                    try:
+                        with span("sm.apply"):
+                            responses = self.sm.apply_batch(batch)
+                    except Exception as e:
+                        # a committed batch the state machine rejects
+                        # (undecodable command, app-level panic) fails
+                        # DETERMINISTICALLY on every replica: consume
+                        # the slot, fail the submitter — never let one
+                        # bad command kill the consensus loop
+                        logger.warning(
+                            "apply failed for batch %s on shard %d: %s",
+                            rec.batch_id,
+                            s,
+                            e,
+                        )
+                        responses = None
+                    sh.applied_ids[rec.batch_id] = None
+                    sh.applied_results[rec.batch_id] = responses
+                    self.rt.state_version += 1
+                    self.rt.v1_applied[s] += 1
+                    if responses is not None:
+                        self._resolve_local(sh, batch, responses)
+                    else:
+                        self._fail_local(sh, batch.id, RabiaError("apply failed"))
+            else:
+                self._requeue_null_slot(sh, slot, rec)
+            rec.applied = True
+            self.flight.record(
+                FRE_APPLY, shard=s, slot=slot, arg=int(rec.value),
+                batch=(
+                    fr_hash(rec.batch_id)
+                    if rec.batch_id is not None
+                    else 0
+                ),
+            )
+            self._h_stage["decide_apply"].observe(
+                time.time() - rec.decided_at
+            )
+            sh.applied_upto += 1
+            sh.gc_upto(sh.applied_upto)
+            applied += 1
+        return applied, False
 
     def _settle_from_ledger(self, sh, sub) -> None:
         """Settle a submitter future for a batch the ledger says is applied.
@@ -3116,6 +3180,11 @@ class RabiaEngine:
         )
 
     def _on_sync_request(self, sender: NodeId, p: SyncRequest) -> None:
+        # settle any deferred apply backlog first: the snapshot (and the
+        # ahead/behind comparison below) must reflect the decided
+        # ledger, not the drain task's progress — a lagging peer's
+        # recovery must not wait on our apply pipelining
+        self._apply_plane.flush_sync()
         total_applied = int(self.rt.applied_upto.sum())
         if total_applied <= p.current_phase:
             return  # not ahead; stay silent (engine.rs:763-779)

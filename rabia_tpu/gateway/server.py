@@ -143,6 +143,23 @@ def kv_read_handler(sm) -> ReadHandler:
             k = key.decode()
         except UnicodeDecodeError:
             return _result_bin(2, 0, "malformed key")
+        if getattr(store, "is_native", False):
+            # native apply plane: one borrowed C lookup, result framed
+            # directly — no op encode/apply/decode round trip. Stats
+            # are counted like KVStore.get so the two store paths stay
+            # parity-comparable.
+            plane, idx = store.plane, store.idx
+            got = plane.get(idx, key)
+            plane.add_stats(idx, 1, 1, 0)
+            if got is None:
+                return _result_bin(1, 0)
+            val, ver = got
+            return (
+                b"\x00"
+                + (ver & 0xFFFFFFFF).to_bytes(4, "little")
+                + b"\x01"
+                + val
+            )
         res = store.get(k)
         if res.kind == KVResultKind.NotFound:
             return _result_bin(1, 0)

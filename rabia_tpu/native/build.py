@@ -1,12 +1,16 @@
 """Lazy g++ build + loaders for the native libraries.
 
-Three artifacts, all digest-keyed and built on first use:
-- ``transport.cpp``  -> ctypes CDLL (the TCP data plane)
-- ``codec.cpp``      -> CPython extension module (the binary message
+Four artifacts, all digest-keyed and built on first use:
+- ``transport.cpp``   -> ctypes CDLL (the TCP data plane)
+- ``codec.cpp``       -> CPython extension module (the binary message
   codec, SURVEY §2 C9's native component)
-- ``hostkernel.cpp`` -> ctypes CDLL (the engine's per-activation
+- ``hostkernel.cpp``  -> ctypes CDLL (the engine's per-activation
   consensus step; numpy twin in kernel/host_driver.py stays the
   semantics owner)
+- ``statekernel.cpp`` -> ctypes CDLL (the native apply plane: the
+  binary-op KV state machine; the Python apply path in
+  apps/kvstore.py stays the semantics owner, RABIA_PY_APPLY=1
+  forces it)
 """
 
 from __future__ import annotations
@@ -26,12 +30,15 @@ _HERE = Path(__file__).parent
 _SRC = _HERE / "transport.cpp"
 _CODEC_SRC = _HERE / "codec.cpp"
 _HK_SRC = _HERE / "hostkernel.cpp"
+_SK_SRC = _HERE / "statekernel.cpp"
 _LOCK = threading.Lock()
 _CACHED: ctypes.CDLL | None = None
 _CODEC_CACHED = None
 _CODEC_FAILED: str | None = None
 _HK_CACHED: ctypes.CDLL | None = None
 _HK_FAILED: str | None = None
+_SK_CACHED: ctypes.CDLL | None = None
+_SK_FAILED: str | None = None
 
 
 def _src_digest() -> str:
@@ -246,6 +253,109 @@ def load_hostkernel() -> ctypes.CDLL | None:
         lib.rk_flight_head.restype = ctypes.c_uint64
         lib.rk_flight_head.argtypes = [p]
         _HK_CACHED = lib
+        return lib
+
+
+def _sk_path() -> Path:
+    digest = hashlib.blake2s(_SK_SRC.read_bytes(), digest_size=8).hexdigest()
+    return _HERE / f"_statekernel_{digest}.so"
+
+
+def load_statekernel() -> ctypes.CDLL | None:
+    """Build (if needed) and dlopen the native apply-plane library.
+
+    Returns the CDLL with prototypes set, or None when unavailable —
+    callers fall back to the Python binary-op apply in apps/kvstore.py,
+    which stays the semantics owner. ``RABIA_PY_APPLY=1`` forces the
+    Python path (debug/differential testing, the conformance gate's
+    second leg)."""
+    global _SK_CACHED, _SK_FAILED
+    if os.environ.get("RABIA_PY_APPLY") == "1":
+        return None
+    with _LOCK:
+        if _SK_CACHED is not None:
+            return _SK_CACHED
+        if _SK_FAILED is not None:
+            return None
+        try:
+            target = _sk_path()
+            if not target.exists():
+                _compile(
+                    _SK_SRC, target, ["-O3"], "_statekernel_*.so",
+                    "statekernel",
+                )
+            lib = ctypes.CDLL(os.fspath(target))
+        except Exception as e:  # noqa: BLE001 - any failure means fallback
+            _SK_FAILED = str(e)
+            return None
+        p = ctypes.c_void_p
+        i64 = ctypes.c_int64
+        lib.sk_plane_create.restype = ctypes.c_void_p
+        lib.sk_plane_create.argtypes = [i64, i64, i64, i64]
+        lib.sk_plane_destroy.restype = None
+        lib.sk_plane_destroy.argtypes = [p]
+        lib.sk_counters_version.restype = ctypes.c_int32
+        lib.sk_counters_version.argtypes = []
+        lib.sk_counters_count.restype = ctypes.c_int32
+        lib.sk_counters_count.argtypes = []
+        lib.sk_counters.restype = ctypes.c_void_p
+        lib.sk_counters.argtypes = [p]
+        lib.sk_flight_version.restype = ctypes.c_int32
+        lib.sk_flight_version.argtypes = []
+        lib.sk_flight_cap.restype = ctypes.c_int32
+        lib.sk_flight_cap.argtypes = []
+        lib.sk_flight_record_size.restype = ctypes.c_int32
+        lib.sk_flight_record_size.argtypes = []
+        lib.sk_flight.restype = ctypes.c_void_p
+        lib.sk_flight.argtypes = [p]
+        lib.sk_flight_head.restype = ctypes.c_uint64
+        lib.sk_flight_head.argtypes = [p]
+        lib.sk_store_count.restype = i64
+        lib.sk_store_count.argtypes = [p]
+        lib.sk_store_size.restype = i64
+        lib.sk_store_size.argtypes = [p, i64]
+        lib.sk_store_version.restype = ctypes.c_uint64
+        lib.sk_store_version.argtypes = [p, i64]
+        lib.sk_set_version.restype = None
+        lib.sk_set_version.argtypes = [p, i64, ctypes.c_uint64]
+        lib.sk_store_stats.restype = None
+        lib.sk_store_stats.argtypes = [p, i64, p]
+        lib.sk_add_stats.restype = None
+        lib.sk_add_stats.argtypes = [
+            p, i64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.sk_get.restype = i64
+        lib.sk_get.argtypes = [
+            p, i64, p, i64,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.sk_export_size.restype = i64
+        lib.sk_export_size.argtypes = [p, i64]
+        lib.sk_export.restype = i64
+        lib.sk_export.argtypes = [p, i64, p, i64]
+        lib.sk_clear_store.restype = None
+        lib.sk_clear_store.argtypes = [p, i64]
+        lib.sk_insert_raw.restype = ctypes.c_int32
+        lib.sk_insert_raw.argtypes = [
+            p, i64, p, i64, p, i64,
+            ctypes.c_uint64, ctypes.c_double, ctypes.c_double,
+        ]
+        lib.sk_apply_wave.restype = i64
+        lib.sk_apply_wave.argtypes = [
+            p, p, p, p, p, p, i64, ctypes.c_double, ctypes.c_int32,
+        ]
+        lib.sk_apply_ops.restype = i64
+        lib.sk_apply_ops.argtypes = [
+            p, i64, p, p, i64, ctypes.c_double, ctypes.c_int32,
+        ]
+        lib.sk_out_buf.restype = ctypes.c_void_p
+        lib.sk_out_buf.argtypes = [p]
+        lib.sk_out_offs.restype = ctypes.c_void_p
+        lib.sk_out_offs.argtypes = [p]
+        lib.sk_out_count.restype = i64
+        lib.sk_out_count.argtypes = [p]
+        _SK_CACHED = lib
         return lib
 
 

@@ -350,6 +350,160 @@ async def run_schedule_on_both_tick_paths(
         ) from None
 
 
+def run_ops_on_both_apply_paths(
+    schedule: Sequence[dict[int, list[bytes]]],
+    n_shards: int,
+    *,
+    tag: str = "",
+    require_native: bool = True,
+) -> None:
+    """Native-vs-Python APPLY-path conformance (the apply-plane gate).
+
+    The same schedule of binary KV op waves drives two
+    :class:`~rabia_tpu.apps.sharded.ShardedStateMachine` instances — one
+    on the statekernel-backed native stores, one on the Python
+    :class:`KVStore` (the semantics owner, what ``RABIA_PY_APPLY=1``
+    forces) — through the engine-visible apply surfaces: whole waves ride
+    ``apply_block`` (the decided-wave path), with every third wave routed
+    per shard through ``apply_batch`` (the scalar lane). Required:
+    byte-identical per-op result frames on every wave, and — at the end —
+    bit-identical per-shard state hashes, store versions and op-stats,
+    plus a native-snapshot → Python-restore round trip landing on the
+    same hash. Shared by the fixed gate (tests/test_native_apply.py) and
+    the randomized fuzz (``fuzz_conformance.py --apply``), so the two
+    checks cannot drift. On divergence, both paths' context dumps land in
+    ``$RABIA_FLIGHT_DIR`` (default ``flight-dumps/`` — a CI failure
+    artifact), like the tick-path gate's flight dumps.
+    """
+    import numpy as np
+
+    from rabia_tpu.apps.kvstore import KVStore
+    from rabia_tpu.apps.native_store import native_apply_available
+    from rabia_tpu.apps.sharded import make_sharded_kv
+    from rabia_tpu.core.blocks import build_block
+    from rabia_tpu.core.config import KVStoreConfig
+    from rabia_tpu.core.types import Command, CommandBatch, ShardId
+
+    if not native_apply_available():
+        assert not require_native, (
+            f"{tag}: native apply plane unavailable (statekernel build "
+            "failure?) — conformance gate would be vacuous"
+        )
+        return
+    # small limits so fuzz schedules actually HIT the validation edges
+    # (oversized value, key too long, store full — max_keys must sit
+    # BELOW the fuzz generator's ~10-key pool or the store_full branch
+    # is never differentially exercised)
+    cfg = KVStoreConfig(
+        max_keys=8, max_key_length=24, max_value_size=128
+    )
+    sm_nat, m_nat = make_sharded_kv(n_shards, cfg, native=True)
+    sm_py, m_py = make_sharded_kv(n_shards, cfg, native=False)
+    assert sm_nat._native_plane is not None, (
+        f"{tag}: native plane not wired — gate would be vacuous"
+    )
+
+    def _ctx(wave_i: int) -> dict:
+        return {
+            "tag": tag,
+            "wave": wave_i,
+            "native_counters": sm_nat._native_plane.counters_dict(),
+            "checksums_native": [m.store.checksum() for m in m_nat],
+            "checksums_python": [m.store.checksum() for m in m_py],
+        }
+
+    for w, wave in enumerate(schedule):
+        shards = sorted(wave)
+        ops_per_shard = [list(wave[s]) for s in shards]
+        try:
+            if w % 3 == 2:
+                # scalar lane: one CommandBatch per covered shard. A
+                # batch the state machine REJECTS (e.g. an unknown
+                # opcode routed through the typed path) must reject
+                # identically on both paths — the engine turns that
+                # into a deterministic per-replica apply failure.
+                for s, ops in zip(shards, ops_per_shard):
+                    batch = CommandBatch.new(
+                        [Command.new(b) for b in ops], shard=ShardId(s)
+                    )
+                    outcomes = []
+                    for sm in (sm_nat, sm_py):
+                        try:
+                            outcomes.append(list(sm.apply_batch(batch)))
+                        except Exception as e:  # noqa: BLE001
+                            outcomes.append(
+                                (type(e).__name__, str(e))
+                            )
+                    r_nat, r_py = outcomes
+                    assert r_nat == r_py, (
+                        f"{tag}: wave {w} shard {s} scalar-lane outcomes "
+                        f"diverge (native={r_nat!r}, python={r_py!r})"
+                    )
+            else:
+                # block lane. A wave the SM rejects wholesale (e.g. a
+                # "{"-prefixed undecodable command in the Python
+                # fallback) must reject identically on both paths — the
+                # engine turns that into a deterministic apply failure.
+                block = build_block(np.asarray(shards), ops_per_shard)
+                idxs = np.arange(len(shards))
+                outcomes = []
+                for sm in (sm_nat, sm_py):
+                    try:
+                        rs = sm.apply_block(block, idxs, want_responses=True)
+                        outcomes.append([list(r) for r in rs])
+                    except Exception as e:  # noqa: BLE001
+                        outcomes.append((type(e).__name__, str(e)))
+                r_nat, r_py = outcomes
+                assert r_nat == r_py, (
+                    f"{tag}: wave {w} block-lane outcomes diverge "
+                    f"(native={r_nat!r}, python={r_py!r})"
+                )
+        except AssertionError:
+            _dump_apply_divergence(tag, _ctx(w))
+            raise
+    try:
+        for s in range(n_shards):
+            st_n, st_p = m_nat[s].store, m_py[s].store
+            assert st_n.checksum() == st_p.checksum(), (
+                f"{tag}: shard {s} state hash diverges across apply paths"
+            )
+            assert st_n.version == st_p.version, (
+                f"{tag}: shard {s} store version diverges "
+                f"(native={st_n.version}, python={st_p.version})"
+            )
+            sn, sp = st_n.stats, st_p.stats
+            assert (
+                sn.total_operations, sn.reads, sn.writes
+            ) == (sp.total_operations, sp.reads, sp.writes), (
+                f"{tag}: shard {s} op stats diverge across apply paths"
+            )
+            # cross-path snapshot adoption (mixed-cluster sync): a Python
+            # store restored from the NATIVE snapshot lands on the hash
+            restored = KVStore(cfg)
+            restored.restore_bytes(st_n.snapshot_bytes())
+            assert restored.checksum() == st_p.checksum(), (
+                f"{tag}: shard {s} native snapshot does not restore to "
+                "the Python state"
+            )
+    except AssertionError:
+        _dump_apply_divergence(tag, _ctx(len(schedule)))
+        raise
+
+
+def _dump_apply_divergence(tag: str, ctx: dict) -> None:
+    """Write the apply-path divergence context next to the repro seed
+    (``$RABIA_FLIGHT_DIR``, default ``flight-dumps/`` — uploaded as a CI
+    failure artifact like the flight dumps)."""
+    d = os.environ.get("RABIA_FLIGHT_DIR") or "flight-dumps"
+    safe = re.sub(r"[^\w.=-]+", "_", tag) or "apply-divergence"
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, f"apply_{safe}.json"), "w") as f:
+            json.dump(ctx, f)
+    except OSError:
+        pass  # a read-only CWD must not mask the divergence
+
+
 def _dump_divergence_flight(tag: str, obs_native: dict, obs_py: dict) -> list:
     """Write BOTH tick paths' flight-recorder captures next to the repro
     seed on divergence (the flight extension of the PR-3 counter-snapshot

@@ -353,6 +353,75 @@ async def _trial_tick_paths(seed: int) -> None:
         raise
 
 
+def _trial_apply_paths(seed: int) -> None:
+    """Apply-plane differential: one RANDOM binary-op schedule through
+    the native statekernel stores AND the Python KVStore stores (the
+    semantics owner), via the shared gate — byte-identical per-op result
+    frames and state hashes required. Ops are drawn to hit the edges:
+    CAS misses, DELs of absent keys, oversized values, over-long and
+    multi-byte keys, invalid UTF-8, unknown opcodes, replayed waves."""
+    from rabia_tpu.apps.kvstore import (
+        encode_cas_bin,
+        encode_op_bin,
+        encode_set_bin,
+        KVOperation,
+        KVOpType,
+    )
+    from rabia_tpu.testing.conformance import run_ops_on_both_apply_paths
+
+    rng = np.random.default_rng(seed + 313)
+    S = int(rng.choice([1, 2, 4]))
+    keys = (
+        ["k%d" % i for i in range(6)]
+        + ["κλειδί", "ключ", "k" * 24, "k" * 25]  # unicode + length edge
+    )
+
+    def one_op() -> bytes:
+        k = keys[int(rng.integers(0, len(keys)))]
+        r = float(rng.random())
+        if r < 0.35:
+            return encode_set_bin(k, "v" * int(rng.integers(0, 140)))
+        if r < 0.50:
+            return encode_cas_bin(
+                k, "c%d" % int(rng.integers(0, 9)),
+                int(rng.integers(0, 6)),
+            )
+        if r < 0.62:
+            return encode_op_bin(KVOperation.get(k))
+        if r < 0.74:
+            return encode_op_bin(KVOperation.delete(k))
+        if r < 0.80:
+            return encode_op_bin(KVOperation.exists(k))
+        if r < 0.83:
+            return encode_op_bin(KVOperation(KVOpType.Clear))
+        if r < 0.85:
+            return b""  # zero-length command (trailing-offset edge)
+        if r < 0.88:
+            return b"\x01\x03\x00\xff\xfe\xfdxy"  # invalid utf-8 key
+        if r < 0.91:
+            return b"\x01\xff\x7f"  # klen exceeds payload
+        if r < 0.95:
+            return bytes([int(rng.integers(7, 250))]) + b"\x01\x00k"
+        return b"\x06\x02\x00kk\x01"  # short CAS version field
+    waves = int(rng.integers(3, 8))
+    schedule = []
+    for _ in range(waves):
+        covered = sorted(
+            rng.choice(S, size=int(rng.integers(1, S + 1)), replace=False)
+        )
+        schedule.append(
+            {
+                int(s): [one_op() for _ in range(int(rng.integers(1, 6)))]
+                for s in covered
+            }
+        )
+    # replay a random earlier wave verbatim (duplicate-delivery shape)
+    schedule.append(dict(schedule[int(rng.integers(0, len(schedule)))]))
+    run_ops_on_both_apply_paths(
+        schedule, n_shards=S, tag=f"apply seed={seed}"
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=30.0)
@@ -369,6 +438,13 @@ def main() -> int:
         "trials (random schedules through the transport engine with the "
         "hostkernel rk_tick fast path on, then with RABIA_PY_TICK=1; "
         "identical decisions/state required; ~4s each)",
+    )
+    ap.add_argument(
+        "--apply", type=int, default=0,
+        help="additionally run N native-vs-Python APPLY-path differential "
+        "trials (random binary-op schedules through the statekernel "
+        "stores and the Python KVStore; byte-identical result frames + "
+        "state hashes required; sub-second each)",
     )
     ap.add_argument(
         "--mesh", type=int, default=0,
@@ -448,6 +524,11 @@ def main() -> int:
         for i in range(args.tick):
             asyncio.run(_trial_tick_paths(args.base_seed + i))
             tick_trials += 1
+    apply_trials = 0
+    if args.apply > 0:
+        for i in range(args.apply):
+            _trial_apply_paths(args.base_seed + i)
+            apply_trials += 1
     extra = (
         f"; {plane_trials} plane-differential schedules identical"
         if plane_trials
@@ -455,6 +536,10 @@ def main() -> int:
     )
     if tick_trials:
         extra += f"; {tick_trials} tick-path differential schedules identical"
+    if apply_trials:
+        extra += (
+            f"; {apply_trials} apply-path differential schedules identical"
+        )
     if mesh_trials:
         extra += (
             f"; {mesh_trials} mesh-plane fault schedules conformant "

@@ -39,9 +39,11 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def _mk_cluster(n_shards=1, n_replicas=3, py_rows=(), **cfg_kw):
+def _mk_cluster(n_shards=1, n_replicas=3, py_rows=(), sm_factory=None, **cfg_kw):
     """In-memory cluster; replicas whose row is in `py_rows` are forced
-    onto the Python tick path (mixed-cluster wire conformance)."""
+    onto the Python tick path (mixed-cluster wire conformance).
+    ``sm_factory`` overrides the per-replica state machine (default
+    InMemoryStateMachine)."""
     from rabia_tpu.core.config import RabiaConfig
     from rabia_tpu.core.network import ClusterConfig
     from rabia_tpu.core.state_machine import InMemoryStateMachine
@@ -66,7 +68,10 @@ def _mk_cluster(n_shards=1, n_replicas=3, py_rows=(), **cfg_kw):
                 os.environ["RABIA_PY_TICK"] = "1"
             else:
                 os.environ.pop("RABIA_PY_TICK", None)
-            sm = InMemoryStateMachine()
+            sm = (
+                sm_factory() if sm_factory is not None
+                else InMemoryStateMachine()
+            )
             sms.append(sm)
             engines.append(
                 RabiaEngine(
@@ -272,7 +277,7 @@ class TestNativeWire:
 class TestSerialLatencyBudget:
     @pytest.mark.asyncio
     @pytest.mark.parametrize(
-        "mode", ["plain", "traced", "flight"]
+        "mode", ["plain", "traced", "flight", "apply"]
     )
     async def test_config1_serial_latency_budget(self, mode):
         """Pin the config-1 regression (VERDICT r05 weak #1, p50 1.6 →
@@ -294,7 +299,14 @@ class TestSerialLatencyBudget:
         clock_gettime + one 32-byte store per record), and the same
         budget must hold with it verifiably populated — the variant
         additionally asserts the ring carried the run's lifecycle, so a
-        silently-disabled recorder can't make the guard vacuous."""
+        silently-disabled recorder can't make the guard vacuous.
+
+        The ``apply`` variant runs the same budget through the NATIVE
+        APPLY PLANE (kvstore shard stores on the statekernel, binary
+        SET commands): serial commits must not regress when the apply
+        side of the commit path is the C plane, and the variant asserts
+        the plane actually applied (SKC op counter + its flight ring),
+        so a silent fallback to the Python store can't make it vacuous."""
         trace = mode == "traced"
         from rabia_tpu.core.tracing import tracer
         from rabia_tpu.core.types import Command, CommandBatch
@@ -304,15 +316,37 @@ class TestSerialLatencyBudget:
         # (engine_sweep_r06: native p50 median 2.15 ms with slow repeats
         # near 3.6 ms under scheduler noise; the Python path measures
         # 4.2-4.7 ms) — best-of-3 rounds under 4.5 ms separates the two
-        # cost classes without going red on one noisy window
+        # cost classes without going red on one noisy window. The budget
+        # is additionally LOAD-AWARE (the documented ~1-in-4 ambient-load
+        # flake class): a saturating co-tenant scales it, capped at 2x —
+        # a regression to the Python-path cost class still trips it.
         budget_ms = 4.5
+        try:
+            load = os.getloadavg()[0] / max(1, os.cpu_count() or 1)
+        except OSError:  # pragma: no cover - platform without loadavg
+            load = 0.0
+        budget_ms *= max(1.0, min(2.0, load))
+        sm_factory = None
+        if mode == "apply":
+            from rabia_tpu.apps.native_store import native_apply_available
+            from rabia_tpu.apps.sharded import make_sharded_kv
+
+            if not native_apply_available():
+                pytest.skip("statekernel library unavailable")
+            sm_factory = lambda: make_sharded_kv(1, native=True)[0]  # noqa: E731
         hub, nodes, engines, sms = _mk_cluster(
-            phase_timeout=0.4,
+            phase_timeout=0.4, sm_factory=sm_factory,
         )
         assert all(e._rk is not None for e in engines)
         prev_enabled = tracer.enabled
         if trace:
             tracer.enabled = True
+        if mode == "apply":
+            from rabia_tpu.apps.kvstore import encode_set_bin
+
+            cmd_bytes = encode_set_bin("k", "v")  # the binary wire op
+        else:
+            cmd_bytes = b"SET k v"
         tasks = await _start(engines)
         try:
             best = float("inf")
@@ -326,7 +360,7 @@ class TestSerialLatencyBudget:
                     p = slot_proposer(0, slot, 3)
                     t0 = time.perf_counter()
                     fut = await engines[p].submit_batch(
-                        CommandBatch.new([Command.new(b"SET k v")])
+                        CommandBatch.new([Command.new(cmd_bytes)])
                     )
                     await asyncio.wait_for(fut, 10.0)
                     lat.append(time.perf_counter() - t0)
@@ -354,6 +388,14 @@ class TestSerialLatencyBudget:
                 assert e0._rk.flight_head() > 0
                 kinds = {e["kind"] for e in e0.flight_events()}
                 assert {"frame_in", "open", "decide", "apply"} <= kinds
+            if mode == "apply":
+                # the native apply plane must actually have applied the
+                # run (otherwise this variant guards nothing)
+                e0 = engines[0]
+                plane = e0.sm._native_plane
+                assert plane is not None
+                assert plane.counter("ops") >= 60
+                assert plane.flight_head() > 0
             # the commit pipeline histograms observed every commit
             h = engines[0].metrics.histogram(
                 "commit_stage_seconds", labels={"stage": "propose_decide"}
