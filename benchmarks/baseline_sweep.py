@@ -46,16 +46,35 @@ import numpy as np
 
 
 _LAST_TICK_PATH: str | None = None  # actual path of the last-built cluster
+_LAST_PLANES: dict | None = None  # runtime|tick|apply planes, ground truth
 
 
 def _note_tick_path(engines) -> None:
-    """Record what the cluster's engines ACTUALLY run (engine._rk is the
-    ground truth — a hostkernel build failure or a NativeTick
-    construction error falls back to the Python path silently)."""
-    global _LAST_TICK_PATH
+    """Record what the cluster's engines ACTUALLY run (engine._rk /
+    engine._rtm / sm._native_plane are the ground truth — a native build
+    failure or a bridge construction error falls back to the Python
+    paths silently, and perf numbers must be attributable without
+    reading env vars out of CI logs)."""
+    global _LAST_TICK_PATH, _LAST_PLANES
     _LAST_TICK_PATH = (
         "native" if all(e._rk is not None for e in engines) else "python"
     )
+    _LAST_PLANES = {
+        "runtime": (
+            "native"
+            if all(e._rtm is not None for e in engines)
+            else "python"
+        ),
+        "tick": _LAST_TICK_PATH,
+        "apply": (
+            "native"
+            if all(
+                getattr(e.sm, "_native_plane", None) is not None
+                for e in engines
+            )
+            else "python"
+        ),
+    }
 
 
 def _tick_path() -> str:
@@ -86,6 +105,11 @@ def _emit(config: str, value: float, unit: str, baselines: dict, extra: dict) ->
         "value": round(value, 1),
         "unit": unit,
         "tick_path": _tick_path(),
+        # active planes of the measured cluster (runtime|tick|apply:
+        # native|python) — perf numbers stay attributable without
+        # reading env vars out of CI logs
+        "planes": _LAST_PLANES
+        or {"runtime": "python", "tick": _tick_path(), "apply": "python"},
         **extra,
     }
     if _LAST_OBS is not None:
@@ -728,12 +752,99 @@ async def config5_kvstore_tcp_zipf(baselines) -> None:
     )
 
 
+async def config6_kvstore_tcp_runtime(baselines) -> None:
+    """Config-3 geometry over the NATIVE TCP transport: kvstore, 5
+    replicas, 4096 shards, block lane. This is the native engine
+    runtime's home configuration — the GIL-free io/tick thread
+    (native/runtime.cpp) engages automatically on C-transport clusters
+    (RABIA_PY_RUNTIME=1 forces the asyncio orchestration for the
+    before/after pair), so the r08 before/after comparison runs the
+    SAME transport on both legs. The in-memory config 3 stays the
+    r07-comparable line."""
+    from rabia_tpu.apps import make_sharded_kv
+    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.core.network import ClusterConfig
+    from rabia_tpu.core.types import NodeId
+    from rabia_tpu.core.config import TcpNetworkConfig
+    from rabia_tpu.engine import RabiaEngine
+    from rabia_tpu.net.tcp import TcpNetwork
+
+    S, R = 4096, 5
+    ids = [NodeId.from_int(i + 1) for i in range(R)]
+    nets = [TcpNetwork(i, TcpNetworkConfig(bind_port=0)) for i in ids]
+    for i in range(R):
+        for j in range(R):
+            if i != j:
+                nets[i].add_peer(ids[j], "127.0.0.1", nets[j].port)
+    engines, tasks = [], []
+    for i, n in enumerate(ids):
+        engines.append(
+            RabiaEngine(
+                ClusterConfig.new(n, ids),
+                make_sharded_kv(S)[0],
+                nets[i],
+                config=_cfg(S),
+            )
+        )
+        tasks.append(asyncio.ensure_future(engines[-1].run()))
+    _note_tick_path(engines)
+    for _ in range(500):
+        await asyncio.sleep(0.01)
+        sts = [await e.get_statistics() for e in engines]
+        if all(s.has_quorum for s in sts):
+            break
+    one_op = [[encode_set_bin(f"k{s}", "v")] for s in range(S)]
+    lat: list[float] = []
+    t0 = time.perf_counter()
+    base, _ = await _committed(engines)
+    await _block_pump(engines, S, R, 8.0, lambda s: one_op[s], lat=lat)
+    top, _ = await _committed(engines)
+    dt = time.perf_counter() - t0
+    e0 = engines[0]
+    rtm = (
+        {
+            k: v
+            for k, v in e0._rtm.counters_dict().items()
+            if k
+            in (
+                "waves_native",
+                "waves_py",
+                "slots_applied",
+                "gil_handoffs",
+                "frames_native",
+                "frames_escalated",
+                "ev_stalls",
+            )
+        }
+        if e0._rtm is not None
+        else None
+    )
+    await _stop(engines, tasks, nets)
+    return _emit(
+        "6:kvstore_5rep_4096shards_tcp_runtime",
+        (top - base) / dt,
+        "decisions/s",
+        baselines,
+        {
+            "mode": "engine",
+            "store": "kvstore_smr",
+            "lane": "block",
+            "transport": "native_tcp_loopback",
+            "commands_per_slot": 1,
+            **({"runtime_counters": rtm} if rtm else {}),
+            **_lat_stats(lat),
+        },
+    )
+
+
 _CONFIG_FNS = {
     1: lambda b: config1_counter(b),
     2: lambda b: config2_kvstore_64(b),
     3: lambda b: config3_kvstore_4096_batched(b),
     4: lambda b: config4_banking_crash(b),
     5: lambda b: config5_kvstore_tcp_zipf(b),
+    # 6: the r08 native-runtime line (config-3 geometry over native TCP)
+    6: lambda b: config6_kvstore_tcp_runtime(b),
 }
 
 

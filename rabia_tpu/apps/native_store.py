@@ -115,6 +115,36 @@ class NativeResultGroup(Sequence):  # type: ignore[type-arg]
         return f"NativeResultGroup(n={self.n})"
 
 
+def binary_wave_eligible(
+    data, cmd_offsets, shard_starts, n_entries: int, idxs
+) -> bool:
+    """First-byte binary-op eligibility (opcodes 1..6) over the COVERED
+    commands of a wave — the ONE source of the routing rule shared by
+    ``NativeStorePlane.apply_block_wave`` and the runtime bridge's wave
+    pump (the C runtime mirrors it natively for announces it binds).
+    Consensus-critical: proposer and followers must route the same wave
+    the same way, so any change here changes the wire-visible behavior.
+
+    A JSON command on a NON-covered index must not demote the wave, and
+    zero-length commands are native-eligible (the C kernel emits the
+    same "malformed op" frame the Python owner does) — a trailing empty
+    command's offset equals ``len(data)``, so they are excluded from the
+    first-byte gather."""
+    if not len(data):
+        return True
+    offs = cmd_offsets
+    if len(idxs) == n_entries:
+        cov = np.arange(len(offs) - 1)
+    else:
+        cov = np.concatenate(
+            [np.arange(shard_starts[i], shard_starts[i + 1]) for i in idxs]
+        )
+    lens = offs[cov + 1] - offs[cov]
+    nonempty = cov[lens > 0]
+    first = np.frombuffer(data, np.uint8)[offs[nonempty]]
+    return bool(((first >= 1) & (first <= 6)).all())
+
+
 def native_apply_available() -> bool:
     """True when the statekernel library is loadable and not disabled
     (``RABIA_PY_APPLY=1`` forces the Python apply path)."""
@@ -255,7 +285,9 @@ class NativeStorePlane:
         """(buffer address, byte length) of the last wave's staged result
         records — ``[u32 LE len][payload]`` framing, directly consumable
         by ``rt_broadcast_frames``-style staging. Valid until the next
-        apply call."""
+        apply call — the borrowed pointer is only sound when no native
+        runtime thread shares this plane (it applies concurrently);
+        bracket with ``sk_plane_lock``/``sk_plane_unlock`` otherwise."""
         lib = self.lib
         total = int(lib.sk_out_count(self.handle))
         if total == 0:
@@ -275,51 +307,45 @@ class NativeStorePlane:
         idxs = np.ascontiguousarray(np.asarray(idxs, np.int64))
         shards = np.ascontiguousarray(block.shards, np.int64)
         starts = np.ascontiguousarray(block.shard_starts, np.int64)
-        # binary-op eligibility over the COVERED commands only (a JSON
-        # command on a non-covered index must not demote this wave) —
-        # zero-length commands are native-eligible (the C kernel emits
-        # the same "malformed op" frame the Python owner does) and must
-        # be excluded from the first-byte gather: a trailing empty
-        # command's offset equals len(data)
         if len(idxs) == 0:
             return [] if want_responses else None
-        if len(data):
-            if len(idxs) == len(shards):
-                cov = np.arange(len(offs) - 1)
-            else:
-                cov = np.concatenate(
-                    [np.arange(starts[i], starts[i + 1]) for i in idxs]
-                )
-            lens = offs[cov + 1] - offs[cov]
-            nonempty = cov[lens > 0]
-            first = np.frombuffer(data, np.uint8)[offs[nonempty]]
-            if not ((first >= 1) & (first <= 6)).all():
-                return NotImplemented
-        rc = self.lib.sk_apply_wave(
-            self.handle,
-            data,
-            offs.ctypes.data,
-            shards.ctypes.data,
-            starts.ctypes.data,
-            idxs.ctypes.data,
-            len(idxs),
-            now,
-            1 if want_responses else 0,
-        )
-        if rc < 0:
-            raise StoreError(
-                StoreErrorKind.Internal, f"sk_apply_wave rc={rc}"
+        if not binary_wave_eligible(data, offs, starts, len(shards), idxs):
+            return NotImplemented
+        # hold the plane lock across the apply AND the result read-out:
+        # with the native runtime active, its io/tick thread applies
+        # decided waves on this same plane and clears/regrows out_buf —
+        # an unlocked window between our apply returning and the slice
+        # copy-out would hand back another wave's (or freed) bytes. The
+        # plane mutex is recursive, so bracketing the sk call is safe.
+        self.lib.sk_plane_lock(self.handle)
+        try:
+            rc = self.lib.sk_apply_wave(
+                self.handle,
+                data,
+                offs.ctypes.data,
+                shards.ctypes.data,
+                starts.ctypes.data,
+                idxs.ctypes.data,
+                len(idxs),
+                now,
+                1 if want_responses else 0,
             )
-        if not want_responses:
-            return None
-        bounds = []
-        pos = 0
-        st = starts
-        for i in idxs:
-            n = int(st[i + 1] - st[i])
-            bounds.append((pos, pos + n))
-            pos += n
-        return self._slice_results(bounds)
+            if rc < 0:
+                raise StoreError(
+                    StoreErrorKind.Internal, f"sk_apply_wave rc={rc}"
+                )
+            if not want_responses:
+                return None
+            bounds = []
+            pos = 0
+            st = starts
+            for i in idxs:
+                n = int(st[i + 1] - st[i])
+                bounds.append((pos, pos + n))
+                pos += n
+            return self._slice_results(bounds)
+        finally:
+            self.lib.sk_plane_unlock(self.handle)
 
     def apply_ops(
         self, store_idx: int, ops: Sequence[bytes], now: float,
@@ -337,22 +363,28 @@ class NativeStorePlane:
             data = b"".join(ops)
             offs = np.zeros(n + 1, np.int64)
             np.cumsum([len(o) for o in ops], out=offs[1:])
-        rc = self.lib.sk_apply_ops(
-            self.handle,
-            store_idx,
-            data,
-            offs.ctypes.data,
-            n,
-            now,
-            1 if want_responses else 0,
-        )
-        if rc < 0:
-            raise StoreError(
-                StoreErrorKind.Internal, f"sk_apply_ops rc={rc}"
+        # apply + read-out under one plane-lock bracket (see
+        # apply_block_wave: the runtime thread shares out_buf)
+        self.lib.sk_plane_lock(self.handle)
+        try:
+            rc = self.lib.sk_apply_ops(
+                self.handle,
+                store_idx,
+                data,
+                offs.ctypes.data,
+                n,
+                now,
+                1 if want_responses else 0,
             )
-        if not want_responses:
-            return None
-        return self._slice_results([(0, n)])[0]
+            if rc < 0:
+                raise StoreError(
+                    StoreErrorKind.Internal, f"sk_apply_ops rc={rc}"
+                )
+            if not want_responses:
+                return None
+            return self._slice_results([(0, n)])[0]
+        finally:
+            self.lib.sk_plane_unlock(self.handle)
 
     # -- per-store accessors -------------------------------------------------
 
@@ -371,30 +403,44 @@ class NativeStorePlane:
         return int(b[0]), int(b[1]), int(b[2])
 
     def get(self, idx: int, key: bytes):
-        """(value bytes, version) or None."""
+        """(value bytes, version) or None.
+
+        Bracketed by the plane lock: ``sk_get`` hands out a BORROWED
+        value pointer, and under the native engine runtime a GIL-free
+        thread may be applying a wave concurrently — the lock keeps the
+        bytes alive across the copy-out (uncontended cost is
+        nanoseconds)."""
         val = ctypes.c_void_p()
         ver = ctypes.c_uint64()
-        vlen = self.lib.sk_get(
-            self.handle, idx, key, len(key),
-            ctypes.byref(val), ctypes.byref(ver),
-        )
-        if vlen < 0:
-            return None
-        return (
-            ctypes.string_at(val.value, vlen) if vlen else b"",
-            int(ver.value),
-        )
+        self.lib.sk_plane_lock(self.handle)
+        try:
+            vlen = self.lib.sk_get(
+                self.handle, idx, key, len(key),
+                ctypes.byref(val), ctypes.byref(ver),
+            )
+            if vlen < 0:
+                return None
+            return (
+                ctypes.string_at(val.value, vlen) if vlen else b"",
+                int(ver.value),
+            )
+        finally:
+            self.lib.sk_plane_unlock(self.handle)
 
     def export_entries(self, idx: int) -> list[tuple[bytes, bytes, int, float, float]]:
         """All (key, value, version, created, updated) entries of one
         store (arbitrary order; callers sort)."""
-        need = int(self.lib.sk_export_size(self.handle, idx))
-        if need <= 0:
-            return []
-        buf = np.empty(need, np.uint8)
-        got = int(
-            self.lib.sk_export(self.handle, idx, buf.ctypes.data, need)
-        )
+        self.lib.sk_plane_lock(self.handle)
+        try:
+            need = int(self.lib.sk_export_size(self.handle, idx))
+            if need <= 0:
+                return []
+            buf = np.empty(need, np.uint8)
+            got = int(
+                self.lib.sk_export(self.handle, idx, buf.ctypes.data, need)
+            )
+        finally:
+            self.lib.sk_plane_unlock(self.handle)
         if got < 0:
             raise StoreError(StoreErrorKind.Internal, "sk_export failed")
         raw = buf.tobytes()

@@ -158,6 +158,25 @@ class _OutBlock:
             if self.remaining == 0 and self.future is not None and not self.future.done():
                 self.future.set_result(self.responses)
 
+    def settle_many(self, idxs, outcomes) -> None:
+        """Bulk settle (the native runtime's wave events): one pass, one
+        future check — per-entry settle() calls measurably tax the
+        proposer side at thousands of entries per wave."""
+        responses = self.responses
+        hit = 0
+        for i, o in zip(idxs, outcomes):
+            if responses[i] is None:
+                responses[i] = o
+                hit += 1
+        if hit:
+            self.remaining -= hit
+            if (
+                self.remaining == 0
+                and self.future is not None
+                and not self.future.done()
+            ):
+                self.future.set_result(responses)
+
 
 class _BlockRef:
     """Registry record for a live block (incoming or our own)."""
@@ -452,6 +471,31 @@ class RabiaEngine:
                     "native tick unavailable; using the Python tick path"
                 )
                 self._rk = None
+        # native engine runtime (native/runtime.cpp): a GIL-free io/tick
+        # thread runs ingest→route→tally→decide→apply→result end-to-end
+        # for C-transport clusters; Python is demoted to control plane
+        # (engine/runtime_bridge.py). RABIA_PY_RUNTIME=1 forces today's
+        # asyncio orchestration, which stays the semantics owner behind
+        # the run_schedule_on_runtime_paths conformance gate.
+        self._rtm = None
+        if self._rk is not None and persistence is None:
+            try:
+                from rabia_tpu.engine.runtime_bridge import (
+                    RuntimeBridge,
+                    runtime_available,
+                )
+                from rabia_tpu.native.build import load_runtime
+
+                if runtime_available(self):
+                    rtm_lib = load_runtime()
+                    if rtm_lib is not None:
+                        self._rtm = RuntimeBridge(self, rtm_lib)
+            except Exception:
+                logger.exception(
+                    "native runtime unavailable; using the asyncio "
+                    "orchestration"
+                )
+                self._rtm = None
         self._seen_batches: set = set()  # dedup of forwarded batch ids
         self._seen_order: list = []  # insertion order for bounded eviction
         # decided-frontier hook (rabia_tpu/gateway): callbacks fired once
@@ -581,6 +625,37 @@ class RabiaEngine:
             "engine_native_tick",
             "1 when the native rk tick context is active",
             fn=lambda: 1 if self._rk is not None else 0,
+        )
+        # -- native engine runtime (runtime.cpp RTM counter block) -------
+        m.gauge(
+            "engine_native_runtime",
+            "1 when the GIL-free runtime thread owns the commit path",
+            fn=lambda: 1 if self._rtm is not None else 0,
+        )
+
+        def rtm_ctr(name):
+            rtm = self._rtm
+            return rtm.counter(name) if rtm is not None else 0
+
+        for name in (
+            "loops", "wakes_frame", "wakes_idle", "frames_native",
+            "frames_block", "frames_escalated", "cmds", "opens_scalar",
+            "opens_block", "ticks", "decided_scalar", "waves_native",
+            "waves_py", "slots_applied", "ev_records", "ev_stalls",
+            "retransmits", "stale_repairs", "pauses",
+        ):
+            m.counter(
+                f"runtime_{name}_total",
+                "Native runtime counter (runtime.cpp RTM block)",
+                fn=lambda r=name: rtm_ctr(r),
+            )
+        # the acceptance counter: commit-path transitions that required
+        # the GIL. Zero growth while waves_native grows = the steady-state
+        # commit path never re-enters Python.
+        m.counter(
+            "runtime_gil_handoffs_total",
+            "Decided waves whose decide->apply->result needed Python",
+            fn=lambda: rtm_ctr("gil_handoffs"),
         )
         m.counter(
             "engine_ticks_total", "Engine loop ticks",
@@ -717,6 +792,7 @@ class RabiaEngine:
             "has_quorum": bool(self.rt.has_quorum),
             "active_nodes": len(self.rt.active_nodes),
             "native_tick": self._rk is not None,
+            "native_runtime": self._rtm is not None,
             "decided_frontier": self.decided_frontier().tolist(),
             "applied_frontier": self.applied_frontier().tolist(),
             "pending_batches": self.pending_queue_depth(),
@@ -739,6 +815,11 @@ class RabiaEngine:
         evs = self.flight.snapshot()
         if self._rk is not None:
             evs.extend(native_ring_events(self._rk.flight_snapshot()))
+        # native runtime ring: thread wakeups + mailbox handoffs
+        # (FRE_RT_WAKE / FRE_RT_HANDOFF), so timelines stay complete when
+        # the asyncio loop is off the commit path
+        if self._rtm is not None:
+            evs.extend(native_ring_events(self._rtm.flight_snapshot()))
         # native apply plane (statekernel): one apply record per wave on
         # the C path, merged alongside the per-slot Python APPLY events
         sk_plane = getattr(self.sm, "_native_plane", None)
@@ -1100,6 +1181,15 @@ class RabiaEngine:
         self._notify_wired = bool(
             self.transport.set_receive_notify(self._wake.set)
         )
+        if self._rtm is not None:
+            try:
+                self._rtm.start()
+            except Exception:
+                # the reader thread may already be detached: the asyncio
+                # fallback would silently drop inbound frames, so a
+                # runtime start failure is fatal for this replica
+                logger.exception("native runtime start failed")
+                raise
         try:
             while self._running:
                 # clear BEFORE draining: anything that lands after this
@@ -1108,7 +1198,10 @@ class RabiaEngine:
                 # idle wait short — a wake can never be lost
                 self._wake.clear()
                 t_tick = time.perf_counter()
-                progressed = await self._tick()
+                if self._rtm is not None:
+                    progressed = self._runtime_tick()
+                else:
+                    progressed = await self._tick()
                 dt_tick = time.perf_counter() - t_tick
                 if dt_tick > self._slow_tick_s:
                     self._slow_ticks += 1
@@ -1135,6 +1228,19 @@ class RabiaEngine:
                 logger.exception("flight dump on unclean shutdown failed")
             raise
         finally:
+            # shutdown ordering: runtime thread drain (mid-wave applies
+            # complete, the event mailbox empties into Python) → apply
+            # plane flush → persistence checkpoint; the caller closes the
+            # transport only after shutdown() returns
+            if self._rtm is not None:
+                try:
+                    await self._rtm.stop()
+                except Exception:
+                    logger.exception("native runtime stop failed")
+                finally:
+                    # freeze counters + flight ring for late scrapes and
+                    # dumps, then free the native context
+                    self._rtm.close()
             # settle any deferred apply backlog before externalizing
             # state (persistence checkpoint, late stats readers)
             try:
@@ -1145,6 +1251,24 @@ class RabiaEngine:
                 await self._save_state()
             self.rt.is_active = False
             self._stopped.set()
+
+    def _runtime_tick(self) -> bool:
+        """One control-plane pass while the native runtime owns the
+        commit path: drain the event mailbox (decisions, applied waves,
+        escalated frames), then pump staged work (scalar opens, block
+        waves, forwards) back down as commands."""
+        self._tick_count += 1
+        rtm = self._rtm
+        n_ev = rtm.drain_events()
+        rtm.pump()
+        if self._frontier_dirty:
+            self._frontier_dirty = False
+            for cb in self._frontier_listeners:
+                try:
+                    cb()
+                except Exception:  # a listener must never kill the loop
+                    logger.exception("frontier listener failed")
+        return bool(n_ev)
 
     def _idle_wait(self) -> float:
         """How long an idle loop may sleep before re-checking timers.
@@ -1983,6 +2107,13 @@ class RabiaEngine:
         """Vectorized decision ingest: current-slot decisions go straight to
         the adoption plane; gap/future/bid-bearing entries fall back to the
         per-entry path (rare outside crash recovery)."""
+        if self._rtm is not None:
+            # runtime mode: escalated Decision frames (gaps, bid-bearing
+            # recovery) must not touch the adopted-decision plane or the
+            # consensus columns — the runtime thread owns both. The
+            # bridge records/buffers them dict-side and adopts at the
+            # head through CMD_DECIDE.
+            return self._rtm.on_peer_decisions(p)
         n = self.n_shards
         shards, phases, vals = p.shards, p.phases, p.vals
         ok = shards < n
@@ -3180,6 +3311,18 @@ class RabiaEngine:
         )
 
     def _on_sync_request(self, sender: NodeId, p: SyncRequest) -> None:
+        if self._rtm is not None:
+            # quiesce the runtime thread: the snapshot and the per-shard
+            # frontiers must be a consistent cut of the native plane. If
+            # the pause times out, serving a torn cut is worse than
+            # staying silent — the requester simply retries.
+            with self._rtm.paused() as pz:
+                if pz.ok:
+                    return self._serve_sync(sender, p)
+            return None
+        return self._serve_sync(sender, p)
+
+    def _serve_sync(self, sender: NodeId, p: SyncRequest) -> None:
         # settle any deferred apply backlog first: the snapshot (and the
         # ahead/behind comparison below) must reflect the decided
         # ledger, not the drain task's progress — a lagging peer's
@@ -3243,6 +3386,26 @@ class RabiaEngine:
             self._resolve_sync()
 
     def _resolve_sync(self) -> None:
+        if self._rtm is not None:
+            # adoption mutates the consensus columns and the store plane:
+            # the runtime thread must be parked for the duration, and the
+            # bridge's apply mirror re-anchors afterwards. A timed-out
+            # pause means the thread is still the single writer — adopt
+            # nothing (the sync retry window re-requests) rather than
+            # race it.
+            with self._rtm.paused() as pz:
+                if not pz.ok:
+                    return
+                self._adopt_sync()
+                self._rtm._applied = np.maximum(
+                    self._rtm._applied,
+                    self.rt.applied_upto[: self.n_shards],
+                )
+                self._rtm._cmd_slot[:] = -1
+            return
+        return self._adopt_sync()
+
+    def _adopt_sync(self) -> None:
         """Adopt the most advanced responder's snapshot (engine.rs:806-844).
 
         Adoption is PER SHARD: state and counters are taken only for

@@ -11,6 +11,10 @@ Four artifacts, all digest-keyed and built on first use:
   binary-op KV state machine; the Python apply path in
   apps/kvstore.py stays the semantics owner, RABIA_PY_APPLY=1
   forces it)
+- ``runtime.cpp``     -> ctypes CDLL (the native engine runtime: a
+  GIL-free io/tick thread gluing transport -> hostkernel ->
+  statekernel; the asyncio orchestration stays the semantics owner,
+  RABIA_PY_RUNTIME=1 forces it)
 """
 
 from __future__ import annotations
@@ -234,6 +238,10 @@ def load_hostkernel() -> ctypes.CDLL | None:
             p, ctypes.c_double, p, ctypes.c_int64, ctypes.c_int32,
             p, p, p, p,
         ]
+        lib.rk_retransmit.restype = None
+        lib.rk_retransmit.argtypes = [
+            p, ctypes.c_double, ctypes.c_double, p, ctypes.c_int64, p,
+        ]
         # observability counter block (versioned, append-only)
         lib.rk_counters_version.restype = ctypes.c_int32
         lib.rk_counters_version.argtypes = []
@@ -355,6 +363,11 @@ def load_statekernel() -> ctypes.CDLL | None:
         lib.sk_out_offs.argtypes = [p]
         lib.sk_out_count.restype = i64
         lib.sk_out_count.argtypes = [p]
+        # read-side critical-section brackets (native-runtime hook)
+        lib.sk_plane_lock.restype = None
+        lib.sk_plane_lock.argtypes = [p]
+        lib.sk_plane_unlock.restype = None
+        lib.sk_plane_unlock.argtypes = [p]
         _SK_CACHED = lib
         return lib
 
@@ -489,10 +502,90 @@ def load_library() -> ctypes.CDLL:
             ctypes.c_void_p,
             ctypes.c_int64,
         ]
+        lib.rt_inbox_kick.restype = None
+        lib.rt_inbox_kick.argtypes = [ctypes.c_void_p]
         lib.rt_stop.restype = None
         lib.rt_stop.argtypes = [ctypes.c_void_p]
         lib.rt_close.restype = None
         lib.rt_close.argtypes = [ctypes.c_void_p]
 
         _CACHED = lib
+        return lib
+
+
+_RTM_CACHED: ctypes.CDLL | None = None
+_RTM_FAILED: str | None = None
+
+
+def _rtm_path() -> Path:
+    digest = hashlib.blake2s(
+        (_HERE / "runtime.cpp").read_bytes(), digest_size=8
+    ).hexdigest()
+    return _HERE / f"_runtime_{digest}.so"
+
+
+def load_runtime() -> ctypes.CDLL | None:
+    """Build (if needed) and dlopen the native engine runtime library
+    (runtime.cpp: the GIL-free io/tick thread). Returns the CDLL with
+    prototypes set, or None when unavailable — the engine falls back to
+    the asyncio orchestration, which stays the semantics owner
+    (``RABIA_PY_RUNTIME=1`` forces it)."""
+    global _RTM_CACHED, _RTM_FAILED
+    if os.environ.get("RABIA_PY_RUNTIME") == "1":
+        return None
+    with _LOCK:
+        if _RTM_CACHED is not None:
+            return _RTM_CACHED
+        if _RTM_FAILED is not None:
+            return None
+        try:
+            target = _rtm_path()
+            if not target.exists():
+                _compile(
+                    (_HERE / "runtime.cpp"), target, ["-O2", "-pthread"],
+                    "_runtime_*.so", "runtime", link_args=["-lz"],
+                )
+            lib = ctypes.CDLL(os.fspath(target))
+        except Exception as e:  # noqa: BLE001 - any failure means fallback
+            _RTM_FAILED = str(e)
+            return None
+        p = ctypes.c_void_p
+        i64 = ctypes.c_int64
+        lib.rtm_create.restype = ctypes.c_void_p
+        lib.rtm_create.argtypes = [p, p, p, p, p]
+        lib.rtm_start.restype = ctypes.c_int32
+        lib.rtm_start.argtypes = [p]
+        lib.rtm_stop.restype = None
+        lib.rtm_stop.argtypes = [p]
+        lib.rtm_destroy.restype = None
+        lib.rtm_destroy.argtypes = [p]
+        lib.rtm_state.restype = ctypes.c_int32
+        lib.rtm_state.argtypes = [p]
+        lib.rtm_pause.restype = None
+        lib.rtm_pause.argtypes = [p]
+        lib.rtm_resume.restype = None
+        lib.rtm_resume.argtypes = [p]
+        lib.rtm_event_fd.restype = ctypes.c_int
+        lib.rtm_event_fd.argtypes = [p]
+        lib.rtm_cmd_push.restype = ctypes.c_int32
+        lib.rtm_cmd_push.argtypes = [p, p, i64]
+        lib.rtm_ev_drain.restype = i64
+        lib.rtm_ev_drain.argtypes = [p, p, i64]
+        lib.rtm_counters_version.restype = ctypes.c_int32
+        lib.rtm_counters_version.argtypes = []
+        lib.rtm_counters_count.restype = ctypes.c_int32
+        lib.rtm_counters_count.argtypes = []
+        lib.rtm_counters.restype = ctypes.c_void_p
+        lib.rtm_counters.argtypes = [p]
+        lib.rtm_flight_version.restype = ctypes.c_int32
+        lib.rtm_flight_version.argtypes = []
+        lib.rtm_flight_cap.restype = ctypes.c_int32
+        lib.rtm_flight_cap.argtypes = []
+        lib.rtm_flight_record_size.restype = ctypes.c_int32
+        lib.rtm_flight_record_size.argtypes = []
+        lib.rtm_flight.restype = ctypes.c_void_p
+        lib.rtm_flight.argtypes = [p]
+        lib.rtm_flight_head.restype = ctypes.c_uint64
+        lib.rtm_flight_head.argtypes = [p]
+        _RTM_CACHED = lib
         return lib

@@ -972,4 +972,46 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
   res[4] = w.overflow;
 }
 
+// Retransmit current votes for stalled in-flight shards (the native
+// runtime's twin of engine._check_timeouts' vote half): frames a
+// VoteRound1 for every stalled shard holding an R1 vote and a
+// VoteRound2 for every stalled shard waiting in R2, then refreshes
+// last_progress — all without the GIL. Propose/block retransmission
+// stays an escalation (the payload bytes live on the control plane).
+// res: [out_bytes, stalled, frames, overflow]
+void rk_retransmit(void* ctx, double now, double timeout, uint8_t* out,
+                   int64_t out_cap, int64_t* res) {
+  RkCtx* c = (RkCtx*)ctx;
+  RkFrameWriter w{out, out_cap, 0, 0, 0};
+  int32_t* idx = c->idx_scratch.data();
+  int32_t n_stall = 0, n_r1 = 0;
+  for (int32_t s = 0; s < c->n; s++) {
+    if (c->in_flight[s] && now - c->last_progress[s] >= timeout) {
+      n_stall++;
+      if (c->my_r1[s] != ABS) idx[n_r1++] = s;
+    }
+  }
+  if (n_stall == 0) {
+    res[0] = res[1] = res[2] = res[3] = 0;
+    return;
+  }
+  if (n_r1) rk_emit_frame(c, &w, MT_VOTE1, now, idx, n_r1, 13, c->my_r1, 0);
+  int32_t n_r2 = 0;
+  for (int32_t s = 0; s < c->n; s++) {
+    if (c->in_flight[s] && now - c->last_progress[s] >= timeout &&
+        c->stage[s] == R2_WAIT && c->my_r2[s] != ABS)
+      idx[n_r2++] = s;
+  }
+  if (n_r2) rk_emit_frame(c, &w, MT_VOTE2, now, idx, n_r2, 13, c->my_r2, 0);
+  for (int32_t s = 0; s < c->n; s++) {
+    if (c->in_flight[s] && now - c->last_progress[s] >= timeout)
+      c->last_progress[s] = now;
+  }
+  c->ctrs[RKC_OUT_FRAMES] += (uint64_t)w.frames;
+  res[0] = w.pos;
+  res[1] = n_stall;
+  res[2] = w.frames;
+  res[3] = w.overflow;
+}
+
 }  // extern "C"

@@ -1,0 +1,1478 @@
+// Native engine runtime: a GIL-free io/tick thread running the commit
+// path end-to-end — transport readable events feed rk_ingest directly,
+// chained rk_tick stages decide, decided waves flow into sk_apply_wave,
+// and staged vote/decision frames go out via rt_broadcast_frames — all
+// without acquiring the GIL or waking the Python asyncio loop.
+//
+// Python is demoted to control plane (engine/runtime_bridge.py):
+// membership, sync/recovery, config, gateway session logic and obs
+// scrapes, talking to this thread through two bounded byte rings (the
+// command ring Python->C, the event mailbox C->Python) plus an eventfd
+// the Python loop selects on. RABIA_PY_RUNTIME=1 forces today's asyncio
+// orchestration, which stays the semantics owner behind the
+// run_schedule_on_runtime_paths conformance gate
+// (rabia_tpu/testing/conformance.py).
+//
+// Ownership contract (the whole point of the design): while the runtime
+// thread is RUNNING, it is the single writer of the engine's consensus
+// columns (next_slot, applied_upto, in_flight, votes_seen, taint
+// traffic, last_progress, opened_at, the decided-value rings) and of
+// the kernel state arrays behind the rk tick context. Python reads
+// them advisorily (aligned 8-byte loads; metrics-grade) and mutates
+// them ONLY while the runtime is paused (rtm_pause -> state PAUSED).
+// Everything Python must act on — decisions for listeners/futures,
+// escalated frames, stalls — arrives through the event mailbox, in
+// per-shard slot order.
+//
+// This file links against nothing: every foreign entry point (transport,
+// hostkernel, statekernel) arrives as a raw function pointer registered
+// at rtm_create, so the four native libraries stay independently built
+// and digest-keyed (native/build.py).
+
+#include <errno.h>
+#include <string.h>
+#include <sys/eventfd.h>
+#include <time.h>
+#include <unistd.h>
+#include <zlib.h>
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// --- foreign entry points (function-pointer table indices) ------------------
+
+typedef int64_t (*fn_recv_borrow_t)(void*, uint8_t*, const uint8_t**,
+                                    uint32_t*, int);
+typedef void (*fn_recv_release_t)(void*, int64_t);
+typedef int (*fn_bcast_frames_t)(void*, const uint8_t*, int64_t);
+typedef int (*fn_send_t)(void*, const uint8_t*, const uint8_t*, uint32_t);
+typedef int32_t (*fn_rk_ingest_t)(void*, const uint8_t*, int64_t, int32_t,
+                                  double);
+typedef void (*fn_rk_tick_t)(void*, double, uint8_t*, int64_t, int32_t,
+                             const uint8_t*, const int32_t*, const int8_t*,
+                             int64_t*);
+typedef void (*fn_rk_retransmit_t)(void*, double, double, uint8_t*, int64_t,
+                                   int64_t*);
+typedef int64_t (*fn_rk_drain_stale_t)(void*, int64_t*, int64_t*, int64_t*,
+                                       int64_t);
+typedef int64_t (*fn_sk_apply_wave_t)(void*, const uint8_t*, const int64_t*,
+                                      const int64_t*, const int64_t*,
+                                      const int64_t*, int64_t, double,
+                                      int32_t);
+typedef void* (*fn_sk_ptr_t)(void*);
+typedef void (*fn_sk_plane_lk_t)(void*);
+
+enum : int32_t {
+  FN_RECV_BORROW = 0,
+  FN_RECV_RELEASE,
+  FN_BCAST_FRAMES,
+  FN_SEND,
+  FN_RK_INGEST,
+  FN_RK_TICK,
+  FN_RK_RETRANSMIT,
+  FN_RK_DRAIN_STALE,
+  FN_SK_APPLY_WAVE,
+  FN_SK_OUT_BUF,
+  FN_SK_OUT_OFFS,
+  FN_SK_PLANE_LOCK,
+  FN_SK_PLANE_UNLOCK,
+  FN_COUNT
+};
+
+// --- observability counter block (versioned, append-only like RKC_*) --------
+
+enum : int32_t {
+  RTM_LOOPS = 0,        // runtime loop iterations
+  RTM_WAKES_FRAME,      // blocking waits that returned a frame
+  RTM_WAKES_IDLE,       // blocking waits that timed out / were kicked
+  RTM_FRAMES_NATIVE,    // frames consumed by rk_ingest (handled + noop)
+  RTM_FRAMES_BLOCK,     // ProposeBlock frames bound natively
+  RTM_FRAMES_ESCALATED, // frames handed to the Python control plane
+  RTM_FRAMES_DROPPED,   // frames dropped (spoof/skew/malformed)
+  RTM_CMDS,             // command records consumed
+  RTM_OPENS_SCALAR,     // scalar slots armed
+  RTM_OPENS_BLOCK,      // block-bound slots armed
+  RTM_TICKS,            // rk_tick activations
+  RTM_DECIDED_SCALAR,   // scalar decides handed to Python
+  RTM_WAVES_NATIVE,     // decided block waves applied natively (no GIL)
+  RTM_WAVES_PY,         // decided waves that needed a Python handoff
+  RTM_SLOTS_APPLIED,    // slots applied through sk_apply_wave
+  RTM_RESULT_BYTES,     // staged result bytes copied into the mailbox
+  RTM_EV_RECORDS,       // event records appended
+  RTM_EV_STALLS,        // times the event mailbox was full (backpressure)
+  RTM_RETRANSMITS,      // stalled-shard vote retransmission rounds
+  RTM_STALE_REPAIRS,    // native stale-vote repair Decisions sent
+  RTM_PAUSES,           // pause/resume round trips
+  RTM_GIL_HANDOFFS,     // commit-path transitions that required Python
+                        // (scalar decides + py waves): the acceptance
+                        // counter — zero growth per steady-state native
+                        // wave
+  RTM_EV_DROPPED,       // event records larger than the whole mailbox
+                        // (dropped instead of livelocking the thread)
+  RTM_COUNT
+};
+static const int32_t RTM_COUNTERS_VERSION = 2;
+
+// --- flight recorder (FrEvent ABI of hostkernel.cpp / obs/flight.py) --------
+
+enum : uint8_t {
+  FRE_RT_WAKE = 19,     // runtime thread wakeup (arg: 1 frames, 2 idle)
+  FRE_RT_HANDOFF = 20,  // event record handed to Python (arg = ev type)
+};
+
+struct FrEvent {
+  uint64_t t_ns;
+  uint64_t slot;
+  uint64_t batch;
+  uint32_t shard;
+  uint16_t peer;
+  uint8_t kind;
+  uint8_t arg;
+};
+static_assert(sizeof(FrEvent) == 32, "FrEvent ABI is 32 bytes");
+static const int32_t RTM_FLIGHT_VERSION = 1;
+static const uint32_t RTM_FLIGHT_CAP = 2048;  // power of two
+
+// --- mailbox record types ---------------------------------------------------
+
+// events (C -> Python); each record is u32 len | u8 type | payload
+enum : uint8_t {
+  EV_FRAME = 1,    // u16 row | frame bytes (escalated wire frame)
+  EV_DECIDE = 2,   // u32 shard | u64 slot | u8 value | f64 opened_at
+  EV_WAVE = 3,     // u64 token | u8 applied | u8 has_results | u32 count |
+                   // count * (u32 shard | u64 slot | u32 bidx | u8 value)
+                   // | if has_results: count * (u32 rlen | bytes)
+  EV_REJECT = 4,   // u64 token | u32 bidx | u32 shard | u64 slot | u8 why
+  EV_STALL = 5,    // u8 kind | u32 shard | u64 slot_or_token
+                   // kind 0: scalar propose retransmit wanted
+                   // kind 1: block announce retransmit wanted (token)
+                   // kind 2: peer votes waiting, no binding (V0 candidate)
+};
+
+// commands (Python -> C); u32 len | u8 type | payload
+enum : uint8_t {
+  CMD_OPEN_SCALAR = 1,  // u32 shard | u64 slot | u8 init | u32 flen | frame
+  CMD_OPEN_WAVE = 2,    // u64 token | u8 want | u32 k | u32 announce_len |
+                        // u32 blob_len | u32 total_ops |
+                        // k * (u32 shard | u64 slot | u32 bidx | u32 nops) |
+                        // total_ops * u32 op_len | announce | blob
+  CMD_ADVANCE = 3,      // u32 count | count * (u32 shard | u64 new_applied)
+  CMD_DECIDE = 4,       // u32 shard | u64 slot | u8 value (adopt at head)
+  CMD_STOP = 5,
+};
+
+enum : int32_t {
+  RTM_RUNNING = 0,
+  RTM_PAUSE_REQ = 1,
+  RTM_PAUSED = 2,
+  RTM_STOPPED = 3,
+};
+
+// --- wire constants (core/serialization.py v3) ------------------------------
+
+enum : uint8_t {
+  MT_VOTE1 = 2,
+  MT_VOTE2 = 3,
+  MT_DECISION = 4,
+  MT_PROPOSE_BLOCK = 10,
+  FLAG_COMPRESSED = 0x01,
+  FLAG_RECIPIENT = 0x02,
+};
+
+enum : int32_t { RK_HANDLED = 1, RK_NOOP = 2, RK_PY = 0, RK_DROP = -1 };
+enum : int8_t { V0c = 0, V1c = 1 };
+
+// --- small helpers ----------------------------------------------------------
+
+static inline uint64_t mono_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+static inline double wall_s() {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+static inline uint32_t rd_u32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+static inline uint64_t rd_u64(const uint8_t* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+static inline double rd_f64(const uint8_t* p) {
+  double v;
+  memcpy(&v, p, 8);
+  return v;
+}
+static inline void wr_u32(std::vector<uint8_t>& b, uint32_t v) {
+  size_t w = b.size();
+  b.resize(w + 4);
+  memcpy(b.data() + w, &v, 4);
+}
+static inline void wr_u64(std::vector<uint8_t>& b, uint64_t v) {
+  size_t w = b.size();
+  b.resize(w + 8);
+  memcpy(b.data() + w, &v, 8);
+}
+static inline void wr_f64(std::vector<uint8_t>& b, double v) {
+  size_t w = b.size();
+  b.resize(w + 8);
+  memcpy(b.data() + w, &v, 8);
+}
+
+// --- the SPSC byte rings ----------------------------------------------------
+
+// Records are u32 len | payload at (pos % cap); a record never wraps —
+// when the tail of the buffer is too short, a u32 0xFFFFFFFF pad marker
+// (when >= 4 bytes remain) skips to offset 0. head/tail are absolute
+// monotonic byte counters; both sides run the producer/consumer halves
+// in C (rtm_cmd_push / rtm_ev_drain are called from the Python thread),
+// so the acquire/release pairing is real on every architecture.
+struct ByteRing {
+  std::vector<uint8_t> buf;
+  std::atomic<uint64_t> head{0};  // producer cursor (bytes ever written)
+  std::atomic<uint64_t> tail{0};  // consumer cursor (bytes ever consumed)
+
+  int64_t cap() const { return (int64_t)buf.size(); }
+  int64_t free_space() const {
+    return cap() - (int64_t)(head.load(std::memory_order_relaxed) -
+                             tail.load(std::memory_order_acquire));
+  }
+  // space a record of `len` payload bytes needs, worst case (pad + hdr)
+  static int64_t need(int64_t len) { return len + 8; }
+
+  bool push(const uint8_t* a, int64_t alen, const uint8_t* b, int64_t blen) {
+    const int64_t len = alen + blen;
+    if (free_space() < need(len)) return false;
+    uint64_t h = head.load(std::memory_order_relaxed);
+    int64_t at = (int64_t)(h % (uint64_t)cap());
+    if (at + 4 + len > cap()) {
+      // pad to the wrap point, restart at 0 (space already checked via
+      // the conservative need(); re-check against the real layout)
+      int64_t pad = cap() - at;
+      if ((int64_t)(h + pad + 4 + len -
+                    tail.load(std::memory_order_acquire)) > cap())
+        return false;
+      if (pad >= 4) {
+        uint32_t marker = 0xFFFFFFFFu;
+        memcpy(buf.data() + at, &marker, 4);
+      }
+      h += pad;
+      at = 0;
+    }
+    uint32_t l32 = (uint32_t)len;
+    memcpy(buf.data() + at, &l32, 4);
+    memcpy(buf.data() + at + 4, a, (size_t)alen);
+    if (blen) memcpy(buf.data() + at + 4 + alen, b, (size_t)blen);
+    head.store(h + 4 + len, std::memory_order_release);
+    return true;
+  }
+
+  // Pop records into `out` back to back as u32 len | payload; returns
+  // bytes written. Stops before a record that would not fit.
+  int64_t drain(uint8_t* out, int64_t out_cap) {
+    uint64_t t = tail.load(std::memory_order_relaxed);
+    const uint64_t h = head.load(std::memory_order_acquire);
+    int64_t w = 0;
+    while (t < h) {
+      int64_t at = (int64_t)(t % (uint64_t)cap());
+      if (at + 4 > cap()) {
+        t += cap() - at;  // unmarked short tail: skip to 0
+        continue;
+      }
+      uint32_t len = rd_u32(buf.data() + at);
+      if (len == 0xFFFFFFFFu) {
+        t += cap() - at;  // pad marker
+        continue;
+      }
+      if (w + 4 + (int64_t)len > out_cap) break;
+      memcpy(out + w, buf.data() + at, 4 + (size_t)len);
+      w += 4 + len;
+      t += 4 + len;
+    }
+    tail.store(t, std::memory_order_release);
+    return w;
+  }
+};
+
+// --- C-side block registry --------------------------------------------------
+
+struct CBlk {
+  std::vector<uint8_t> data;         // op blob (empty when !has_data)
+  std::vector<int64_t> cmd_offsets;  // total+1 byte offsets into data
+  std::vector<int64_t> starts;       // k+1 command-index prefix
+  std::vector<int64_t> shards;       // k actual shard ids
+  std::vector<int64_t> slots;        // k bound slots
+  std::vector<uint32_t> bidx;        // k Python-side block indices
+  uint64_t token = 0;                // 0 = peer block (no Python owner)
+  int want = 0;                      // stage result frames on apply
+  int has_data = 0;
+  int64_t remaining = 0;             // live bindings (pending + open)
+  double bound_at = 0.0;
+};
+
+struct RtmCtx {
+  // geometry
+  int32_t S, n, R, me, dec_ring;
+  int32_t native_apply;  // sk plane present: decided waves apply in C
+  int64_t max_cmds, max_cmd_size;
+  double max_future_skew, max_age, phase_timeout, grace;
+
+  // handles + foreign entry points
+  void* rk;
+  void* tr;
+  void* sk;
+  void* fns[FN_COUNT];
+
+  // engine columns (borrowed; single-writer = this thread while RUNNING)
+  int64_t* next_slot;
+  int64_t* applied;
+  uint8_t* in_flight;
+  int64_t* votes_seen;
+  int64_t* tainted;
+  double* last_progress;
+  double* opened_at;
+  int64_t* ring_slot;  // [S, dec_ring]
+  int8_t* ring_val;
+  // kernel views (borrowed)
+  int32_t* kslot;
+  int8_t* kdecided;
+  uint8_t* kdone;
+  uint8_t* knewly;
+
+  std::vector<uint8_t> uuids;  // R * 16
+
+  // per-shard runtime state
+  std::vector<int64_t> blk_pend_ref, blk_pend_pos, blk_pend_slot;
+  std::vector<int64_t> blk_cur_ref, blk_cur_pos;
+  std::vector<int64_t> sp_slot;          // pending scalar open slot (-1)
+  std::vector<int8_t> sp_init;
+  std::vector<std::vector<uint8_t>> sp_frame;  // propose frame to emit
+  std::vector<double> stall_ev_at;       // EV_STALL rate limit per shard
+  std::vector<double> votes_wait_at;     // kind-2 escalation rate limit
+
+  std::map<int64_t, CBlk> blocks;
+  int64_t next_blk = 1;
+
+  // open scratch (S-wide planes handed to rk_tick)
+  std::vector<uint8_t> open_mask;
+  std::vector<int32_t> open_slots;
+  std::vector<int8_t> open_init;
+
+  // outbound tick buffer
+  std::vector<uint8_t> out;
+
+  // mailboxes + wakeups
+  ByteRing cmd, ev;
+  int event_fd = -1;
+  std::vector<uint8_t> cmd_scratch;
+
+  // stale-vote repair
+  std::vector<int64_t> st_rows, st_shards, st_slots;
+  std::vector<double> last_repair;  // per row
+  uint64_t msg_counter = 0;
+
+  std::atomic<int32_t> state{RTM_RUNNING};
+  std::atomic<int32_t> stop_req{0};
+  std::atomic<int32_t> pause_req{0};
+  std::thread th;
+  // start at 1: anything the control plane pre-ingested into the rk
+  // ledger before rtm_start (frames the detached Python reader had
+  // already pulled) gets its tick on the first iteration
+  int restep = 1;
+  double last_timers = 0.0;
+
+  uint64_t ctrs[RTM_COUNT];
+  std::vector<FrEvent> fr;
+  uint64_t fr_head = 0;
+};
+
+static inline void fr_rec(RtmCtx* c, uint8_t kind, uint8_t arg, uint32_t shard,
+                          int64_t slot) {
+  FrEvent& e = c->fr[c->fr_head & (RTM_FLIGHT_CAP - 1)];
+  e.t_ns = mono_ns();
+  e.slot = (uint64_t)slot;
+  e.batch = 0;
+  e.shard = shard;
+  e.peer = 0xFFFF;
+  e.kind = kind;
+  e.arg = arg;
+  c->fr_head++;
+}
+
+// Append one event record; spins (bounded sleeps) when the mailbox is
+// full — backpressure on the commit path, exactly like the transport's
+// bounded inbox, except nothing is dropped (Python's drain is
+// eventfd-driven, so the stall resolves in microseconds).
+static void ev_push(RtmCtx* c, const std::vector<uint8_t>& rec) {
+  if (ByteRing::need((int64_t)rec.size()) > c->ev.cap()) {
+    // a record larger than the whole mailbox can never be delivered:
+    // drop it (counted) instead of spinning the commit path forever.
+    // The ring default is sized above the transport's 16 MiB frame cap,
+    // so only pathological wave-result sections can land here; the
+    // protocol's retransmit/sync machinery owns recovery.
+    c->ctrs[RTM_EV_DROPPED]++;
+    return;
+  }
+  while (!c->ev.push(rec.data(), (int64_t)rec.size(), nullptr, 0)) {
+    c->ctrs[RTM_EV_STALLS]++;
+    uint64_t one = 1;
+    (void)!write(c->event_fd, &one, 8);
+    usleep(500);
+    if (c->stop_req.load(std::memory_order_relaxed)) {
+      // shutdown with the mailbox STILL full after the stall loop:
+      // nothing will drain it before the thread joins, so this record
+      // is lost — count it so the drop is visible in /metrics instead
+      // of silently violating the drain-on-shutdown contract (only
+      // reachable when shutdown races a full 20 MB mailbox)
+      c->ctrs[RTM_EV_DROPPED]++;
+      return;
+    }
+  }
+  c->ctrs[RTM_EV_RECORDS]++;
+  fr_rec(c, FRE_RT_HANDOFF, rec.empty() ? 0 : rec[0], 0, 0);
+  uint64_t one = 1;
+  (void)!write(c->event_fd, &one, 8);
+}
+
+static int32_t row_of(RtmCtx* c, const uint8_t sender[16]) {
+  for (int32_t r = 0; r < c->R; r++) {
+    if (memcmp(c->uuids.data() + (size_t)r * 16, sender, 16) == 0) return r;
+  }
+  return -1;
+}
+
+// --- outbound framing (v3 wire header, mirrors hostkernel rk_msg_id) --------
+
+static inline uint32_t mix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x21F0AAADu;
+  h ^= h >> 15;
+  h *= 0x735A2D97u;
+  h ^= h >> 15;
+  return h;
+}
+
+static void rtm_msg_id(RtmCtx* c, uint8_t* out) {
+  const uint64_t ctr = ++c->msg_counter;
+  uint32_t h = mix32(0x52544D00u ^ (uint32_t)(c->me * 0x85EBCA6Bu));
+  for (int w = 0; w < 4; w++) {
+    h = mix32(h ^ (uint32_t)(ctr >> (16 * (w & 1))) ^ 0x9E3779B9u * (w + 1));
+    memcpy(out + 4 * w, &h, 4);
+  }
+  out[6] = (out[6] & 0x0F) | 0x40;
+  out[8] = (out[8] & 0x3F) | 0x80;
+}
+
+// Build a bid-free Decision frame for explicit (shard, slot, value)
+// entries (the native stale-vote repair; rk_emit_frame only frames the
+// kernel's CURRENT slots). Returns frame length.
+static int64_t build_decision_frame(RtmCtx* c, std::vector<uint8_t>& f,
+                                    double now, const int64_t* shards,
+                                    const int64_t* slots, const int8_t* vals,
+                                    int32_t count) {
+  f.clear();
+  const uint32_t body_len = 4 + (uint32_t)count * 14;
+  f.resize(47 + body_len);
+  uint8_t* p = f.data();
+  p[0] = 3;
+  p[1] = MT_DECISION;
+  p[2] = 0;
+  rtm_msg_id(c, p + 3);
+  memcpy(p + 19, c->uuids.data() + (size_t)c->me * 16, 16);
+  memcpy(p + 35, &now, 8);
+  memcpy(p + 43, &body_len, 4);
+  uint8_t* body = p + 47;
+  const uint32_t cnt = (uint32_t)count;
+  memcpy(body, &cnt, 4);
+  uint8_t* e = body + 4;
+  for (int32_t k = 0; k < count; k++) {
+    const uint32_t su = (uint32_t)shards[k];
+    const uint64_t ph = ((uint64_t)slots[k]) << 16;
+    memcpy(e, &su, 4);
+    memcpy(e + 4, &ph, 8);
+    e[12] = (uint8_t)vals[k];
+    e[13] = 0;
+    e += 14;
+  }
+  return (int64_t)f.size();
+}
+
+// --- ProposeBlock native parse ----------------------------------------------
+
+// Wire: v3 header | body: 16B block id | u32 k | k*u32 shards | k*u64
+// slots | k*u32 counts | u32 total | total*u32 cmd_sizes | u32 blob_len
+// | blob | u32 crc32(blob). Binding acceptance mirrors
+// engine._on_propose_block element-for-element: proposer row must own
+// each (shard, slot), slot >= applied, binding slot free, slot >= head.
+// Returns 1 bound-something, 0 nothing-bound (still consumed), -1 not a
+// parseable block (caller escalates), -2 drop (bad checksum/limits).
+static int parse_propose_block(RtmCtx* c, const uint8_t* data, int64_t len,
+                               int32_t row, double now) {
+  if (len < 47) return -1;
+  if (data[0] != 3 || data[1] != MT_PROPOSE_BLOCK) return -1;
+  const uint8_t flags = data[2];
+  if (flags & FLAG_COMPRESSED) return -1;
+  if (memcmp(data + 19, c->uuids.data() + (size_t)row * 16, 16) != 0) {
+    c->ctrs[RTM_FRAMES_DROPPED]++;
+    return -2;  // spoofed envelope
+  }
+  int64_t base = 35 + ((flags & FLAG_RECIPIENT) ? 16 : 0);
+  if (len < base + 12) return -1;
+  const double ts = rd_f64(data + base);
+  if (ts > now + c->max_future_skew || ts < now - c->max_age) {
+    c->ctrs[RTM_FRAMES_DROPPED]++;
+    return -2;
+  }
+  const uint32_t body_len = rd_u32(data + base + 8);
+  const uint8_t* body = data + base + 12;
+  if ((int64_t)body_len > len - (base + 12)) return -1;
+  if (body_len < 16 + 4) return -1;
+  const uint32_t k = rd_u32(body + 16);
+  if (k == 0 || k > (uint32_t)c->n) return -1;
+  // fixed-section bounds before any pointer arithmetic (wire fields are
+  // attacker-controlled; everything is 64-bit so the sums cannot wrap)
+  uint64_t off = 16 + 4 + (uint64_t)k * 16;
+  if (off + 4 > body_len) return -1;
+  const uint8_t* sh_arr = body + 20;
+  const uint8_t* sl_arr = sh_arr + (size_t)k * 4;
+  const uint8_t* cnt_arr = sl_arr + (size_t)k * 8;
+  const uint32_t total = rd_u32(body + off);
+  off += 4;
+  if (off + (uint64_t)total * 4 + 4 > body_len) return -1;
+  const uint8_t* sz_arr = body + off;
+  off += (uint64_t)total * 4;
+  const uint32_t blob_len = rd_u32(body + off);
+  off += 4;
+  if (off + (uint64_t)blob_len + 4 > body_len) return -1;
+  const uint8_t* blob = body + off;
+  const uint32_t crc_wire = rd_u32(body + off + blob_len);
+  if ((uint32_t)crc32(0, blob, blob_len) != crc_wire) {
+    c->ctrs[RTM_FRAMES_DROPPED]++;
+    return -2;
+  }
+  // validator-parity limits + structural sums
+  uint64_t cnt_sum = 0;
+  for (uint32_t i = 0; i < k; i++) {
+    const uint32_t cc = rd_u32(cnt_arr + (size_t)i * 4);
+    if ((int64_t)cc > c->max_cmds) return -2;
+    cnt_sum += cc;
+  }
+  if (cnt_sum != total) return -1;
+  uint64_t sz_sum = 0;
+  for (uint32_t i = 0; i < total; i++) {
+    const uint32_t sz = rd_u32(sz_arr + (size_t)i * 4);
+    if ((int64_t)sz > c->max_cmd_size) return -2;
+    sz_sum += sz;
+  }
+  if (sz_sum != blob_len) return -1;
+
+  // binding pass (first binding wins; in-bounds shards only)
+  std::vector<uint32_t> acc;
+  acc.reserve(k);
+  for (uint32_t i = 0; i < k; i++) {
+    const int64_t s = (int64_t)rd_u32(sh_arr + (size_t)i * 4);
+    const int64_t slot = (int64_t)rd_u64(sl_arr + (size_t)i * 8);
+    if (s < 0 || s >= c->n) continue;
+    if ((s + slot) % c->R != row) continue;  // slot_proposer parity
+    if (slot < c->applied[s]) continue;
+    if (c->blk_pend_ref[s] != -1 || c->blk_cur_ref[s] != -1) continue;
+    const int64_t head =
+        c->next_slot[s] > c->applied[s] ? c->next_slot[s] : c->applied[s];
+    if (slot < head) continue;
+    acc.push_back(i);
+  }
+  if (acc.empty()) return 0;
+  const int64_t ref = c->next_blk++;
+  CBlk& b = c->blocks[ref];
+  b.token = 0;
+  b.want = 0;
+  b.has_data = 1;
+  b.bound_at = now;
+  b.data.assign(blob, blob + blob_len);
+  b.cmd_offsets.resize((size_t)total + 1);
+  b.cmd_offsets[0] = 0;
+  for (uint32_t i = 0; i < total; i++)
+    b.cmd_offsets[i + 1] =
+        b.cmd_offsets[i] + (int64_t)rd_u32(sz_arr + (size_t)i * 4);
+  b.starts.resize((size_t)k + 1);
+  b.starts[0] = 0;
+  for (uint32_t i = 0; i < k; i++)
+    b.starts[i + 1] = b.starts[i] + (int64_t)rd_u32(cnt_arr + (size_t)i * 4);
+  b.shards.resize(k);
+  b.slots.resize(k);
+  b.bidx.resize(k);
+  for (uint32_t i = 0; i < k; i++) {
+    b.shards[i] = (int64_t)rd_u32(sh_arr + (size_t)i * 4);
+    b.slots[i] = (int64_t)rd_u64(sl_arr + (size_t)i * 8);
+    b.bidx[i] = i;
+  }
+  b.remaining = (int64_t)acc.size();
+  for (uint32_t i : acc) {
+    const int64_t s = b.shards[i];
+    c->blk_pend_ref[s] = ref;
+    c->blk_pend_pos[s] = i;
+    c->blk_pend_slot[s] = b.slots[i];
+  }
+  c->ctrs[RTM_FRAMES_BLOCK]++;
+  return 1;
+}
+
+static void blk_unref(RtmCtx* c, int64_t ref, int64_t n) {
+  auto it = c->blocks.find(ref);
+  if (it == c->blocks.end()) return;
+  it->second.remaining -= n;
+  if (it->second.remaining <= 0) c->blocks.erase(it);
+}
+
+// A decided slot voids any pending binding it overtook (asyncio parity:
+// _record_decision -> _void_pending_block); Python demotes/settles the
+// owner through the reject event.
+static void void_stale_pend(RtmCtx* c, int64_t s, int64_t slot) {
+  if (c->blk_pend_ref[s] != -1 && c->blk_pend_slot[s] <= slot) {
+    auto it = c->blocks.find(c->blk_pend_ref[s]);
+    if (it != c->blocks.end()) {
+      std::vector<uint8_t> rec;
+      rec.push_back(EV_REJECT);
+      wr_u64(rec, it->second.token);
+      wr_u32(rec, it->second.bidx[c->blk_pend_pos[s]]);
+      wr_u32(rec, (uint32_t)s);
+      wr_u64(rec, (uint64_t)c->blk_pend_slot[s]);
+      rec.push_back(2);
+      ev_push(c, rec);
+    }
+    blk_unref(c, c->blk_pend_ref[s], 1);
+    c->blk_pend_ref[s] = -1;
+    c->blk_pend_slot[s] = -1;
+  }
+  if (c->sp_slot[s] != -1 && c->sp_slot[s] <= slot) {
+    c->sp_slot[s] = -1;
+    c->sp_frame[s].clear();
+  }
+}
+
+}  // extern "C" (reopened below; internal linkage helpers end here)
+
+extern "C" {
+
+// --- command processing -----------------------------------------------------
+
+static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now) {
+  if (len < 1) return;
+  const uint8_t type = p[0];
+  const uint8_t* q = p + 1;
+  c->ctrs[RTM_CMDS]++;
+  if (type == CMD_OPEN_SCALAR) {
+    if (len < 1 + 4 + 8 + 1 + 4) return;
+    const int64_t s = (int64_t)rd_u32(q);
+    const int64_t slot = (int64_t)rd_u64(q + 4);
+    const int8_t init = (int8_t)q[12];
+    const uint32_t flen = rd_u32(q + 13);
+    if (s < 0 || s >= c->n) return;
+    if (slot < c->applied[s] || c->in_flight[s] ||
+        (c->blk_pend_ref[s] != -1 && c->blk_pend_slot[s] <= slot)) {
+      std::vector<uint8_t> rec;
+      rec.push_back(EV_REJECT);
+      wr_u64(rec, 0);
+      wr_u32(rec, 0);
+      wr_u32(rec, (uint32_t)s);
+      wr_u64(rec, (uint64_t)slot);
+      rec.push_back(1);
+      ev_push(c, rec);
+      return;
+    }
+    c->sp_slot[s] = slot;
+    c->sp_init[s] = init;
+    c->sp_frame[s].assign(q + 17, q + 17 + flen);
+  } else if (type == CMD_OPEN_WAVE) {
+    if (len < 1 + 8 + 1 + 4 + 4 + 4) return;
+    const uint64_t token = rd_u64(q);
+    const uint8_t want = q[8];
+    const uint32_t k = rd_u32(q + 9);
+    const uint32_t announce_len = rd_u32(q + 13);
+    const uint32_t blob_len = rd_u32(q + 17);
+    const uint32_t total = rd_u32(q + 21);
+    const uint8_t* ent = q + 25;
+    const uint8_t* ops = ent + (size_t)k * 20;
+    const uint8_t* announce = ops + (size_t)total * 4;
+    const uint8_t* blob = announce + announce_len;
+    const int64_t ref = c->next_blk++;
+    CBlk& b = c->blocks[ref];
+    b.token = token;
+    b.want = want;
+    b.has_data = blob_len > 0;
+    b.bound_at = now;
+    if (blob_len) b.data.assign(blob, blob + blob_len);
+    b.shards.resize(k);
+    b.slots.resize(k);
+    b.bidx.resize(k);
+    b.starts.resize((size_t)k + 1);
+    b.starts[0] = 0;
+    uint64_t op_at = 0;
+    for (uint32_t i = 0; i < k; i++) {
+      const uint8_t* e = ent + (size_t)i * 20;
+      b.shards[i] = (int64_t)rd_u32(e);
+      b.slots[i] = (int64_t)rd_u64(e + 4);
+      b.bidx[i] = rd_u32(e + 12);
+      const uint32_t nops = rd_u32(e + 16);
+      op_at += nops;
+      b.starts[i + 1] = (int64_t)op_at;
+    }
+    b.cmd_offsets.resize((size_t)total + 1);
+    b.cmd_offsets[0] = 0;
+    for (uint32_t i = 0; i < total; i++)
+      b.cmd_offsets[i + 1] =
+          b.cmd_offsets[i] + (int64_t)rd_u32(ops + (size_t)i * 4);
+    b.remaining = 0;
+    for (uint32_t i = 0; i < k; i++) {
+      const int64_t s = b.shards[i];
+      const int64_t slot = b.slots[i];
+      bool ok = s >= 0 && s < c->n && slot >= c->applied[s] &&
+                c->blk_pend_ref[s] == -1 && c->blk_cur_ref[s] == -1;
+      if (ok) {
+        const int64_t head =
+            c->next_slot[s] > c->applied[s] ? c->next_slot[s] : c->applied[s];
+        ok = slot >= head && c->tainted[s] <= slot;
+      }
+      if (!ok) {
+        std::vector<uint8_t> rec;
+        rec.push_back(EV_REJECT);
+        wr_u64(rec, token);
+        wr_u32(rec, b.bidx[i]);
+        wr_u32(rec, (uint32_t)s);
+        wr_u64(rec, (uint64_t)slot);
+        rec.push_back(1);
+        ev_push(c, rec);
+        continue;
+      }
+      c->blk_pend_ref[s] = ref;
+      c->blk_pend_pos[s] = i;
+      c->blk_pend_slot[s] = slot;
+      b.remaining++;
+    }
+    if (b.remaining == 0) {
+      c->blocks.erase(ref);
+      return;
+    }
+    if (announce_len) {
+      // broadcast the ProposeBlock announce BEFORE any open/vote frame
+      // (asyncio parity: announces flush ahead of the kernel round)
+      std::vector<uint8_t> one(4 + announce_len);
+      memcpy(one.data(), &announce_len, 4);
+      memcpy(one.data() + 4, announce, announce_len);
+      ((fn_bcast_frames_t)c->fns[FN_BCAST_FRAMES])(c->tr, one.data(),
+                                                   (int64_t)one.size());
+    }
+  } else if (type == CMD_ADVANCE) {
+    if (len < 1 + 4) return;
+    const uint32_t count = rd_u32(q);
+    const uint8_t* e = q + 4;
+    for (uint32_t i = 0; i < count && 1 + 4 + (int64_t)(i + 1) * 12 <= len;
+         i++) {
+      const int64_t s = (int64_t)rd_u32(e + (size_t)i * 12);
+      const int64_t upto = (int64_t)rd_u64(e + (size_t)i * 12 + 4);
+      if (s >= 0 && s < c->n && upto > c->applied[s]) c->applied[s] = upto;
+    }
+  } else if (type == CMD_DECIDE) {
+    if (len < 1 + 4 + 8 + 1) return;
+    const int64_t s = (int64_t)rd_u32(q);
+    const int64_t slot = (int64_t)rd_u64(q + 4);
+    const int8_t val = (int8_t)q[12];
+    if (s < 0 || s >= c->n || c->in_flight[s]) return;
+    const int64_t head =
+        c->next_slot[s] > c->applied[s] ? c->next_slot[s] : c->applied[s];
+    if (slot != head) return;
+    if (c->blk_pend_ref[s] != -1 && c->blk_pend_slot[s] == slot) {
+      // a block binding holds this slot's payload: let it open and
+      // decide through consensus/adoption instead — adopting here
+      // would strand a payload-less V1 record on the control plane
+      return;
+    }
+    // adopt: bookkeeping here, record/apply in Python — but ONLY off
+    // the confirming event below (a silently-rejected adopt must not
+    // leave Python with a record C never made)
+    if (slot + 1 > c->next_slot[s]) c->next_slot[s] = slot + 1;
+    const int64_t ring = slot & (c->dec_ring - 1);
+    c->ring_slot[s * c->dec_ring + ring] = slot;
+    c->ring_val[s * c->dec_ring + ring] = val;
+    c->sp_slot[s] = -1;
+    c->sp_frame[s].clear();
+    std::vector<uint8_t> rec;
+    rec.push_back(EV_DECIDE);
+    wr_u32(rec, (uint32_t)s);
+    wr_u64(rec, (uint64_t)slot);
+    rec.push_back((uint8_t)val);
+    wr_f64(rec, 0.0);
+    ev_push(c, rec);
+  } else if (type == CMD_STOP) {
+    c->stop_req.store(1, std::memory_order_relaxed);
+  }
+}
+
+static void drain_cmds(RtmCtx* c, double now) {
+  for (;;) {
+    int64_t got = c->cmd.drain(c->cmd_scratch.data(),
+                               (int64_t)c->cmd_scratch.size());
+    if (got <= 0) break;
+    int64_t at = 0;
+    while (at + 4 <= got) {
+      const uint32_t len = rd_u32(c->cmd_scratch.data() + at);
+      handle_cmd(c, c->cmd_scratch.data() + at + 4, (int64_t)len, now);
+      at += 4 + len;
+    }
+  }
+}
+
+// --- decided-slot processing ------------------------------------------------
+
+static void process_decided(RtmCtx* c, double now) {
+  // group decided block-bound shards by ref; scalars stream directly
+  std::map<int64_t, std::vector<int64_t>> waves;  // ref -> shard list
+  for (int64_t s = 0; s < c->n; s++) {
+    if (!(c->kdone[s] && c->in_flight[s])) continue;
+    const int64_t slot = (int64_t)c->kslot[s];
+    const int8_t val = c->kdecided[s];
+    c->knewly[s] = 0;
+    if (c->blk_cur_ref[s] != -1) {
+      // validate the binding still describes THIS slot: a sync adoption
+      // (Python, under pause) can overtake an in-flight shard and leave
+      // a stale cur binding — routing a later decide through it would
+      // apply the wrong entry's ops
+      auto bit = c->blocks.find(c->blk_cur_ref[s]);
+      if (bit != c->blocks.end() &&
+          bit->second.slots[c->blk_cur_pos[s]] == slot) {
+        waves[c->blk_cur_ref[s]].push_back(s);
+        continue;
+      }
+      blk_unref(c, c->blk_cur_ref[s], 1);
+      c->blk_cur_ref[s] = -1;
+    }
+    if (c->blk_pend_ref[s] != -1 && c->blk_pend_slot[s] == slot &&
+        val == V1c) {
+      // a V1 decide adopted into a slot whose block binding never
+      // OPENED here (we grace-opened V0, peers decided V1): the bound
+      // payload still applies — promote the pending binding and route
+      // through the wave path (asyncio parity: _process_decided's
+      // blk_pending branch)
+      c->blk_cur_ref[s] = c->blk_pend_ref[s];
+      c->blk_cur_pos[s] = c->blk_pend_pos[s];
+      c->blk_pend_ref[s] = -1;
+      c->blk_pend_slot[s] = -1;
+      waves[c->blk_cur_ref[s]].push_back(s);
+      continue;
+    }
+    // scalar decide: consensus bookkeeping here, record/apply in Python
+    c->in_flight[s] = 0;
+    if (slot + 1 > c->next_slot[s]) c->next_slot[s] = slot + 1;
+    const int64_t ring = slot & (c->dec_ring - 1);
+    c->ring_slot[s * c->dec_ring + ring] = slot;
+    c->ring_val[s * c->dec_ring + ring] = val;
+    const double opened = c->opened_at[s];
+    c->opened_at[s] = 0.0;
+    void_stale_pend(c, s, slot);
+    std::vector<uint8_t> rec;
+    rec.push_back(EV_DECIDE);
+    wr_u32(rec, (uint32_t)s);
+    wr_u64(rec, (uint64_t)slot);
+    rec.push_back((uint8_t)val);
+    wr_f64(rec, opened);
+    ev_push(c, rec);
+    c->ctrs[RTM_DECIDED_SCALAR]++;
+    c->ctrs[RTM_GIL_HANDOFFS]++;
+  }
+
+  for (auto& [ref, shards] : waves) {
+    auto bit = c->blocks.find(ref);
+    if (bit == c->blocks.end()) {
+      // registry raced empty (should not happen: refs release at decide)
+      for (int64_t s : shards) {
+        c->in_flight[s] = 0;
+        c->blk_cur_ref[s] = -1;
+      }
+      continue;
+    }
+    CBlk& b = bit->second;
+    // classify entries; only in-order V1 entries of a data-bearing block
+    // apply natively (asyncio parity: _finish_block_slots)
+    std::vector<int64_t> idxs;  // block positions to apply (V1, in order)
+    std::vector<int64_t> ent_shard, ent_slot, ent_pos;
+    std::vector<uint32_t> ent_bidx;
+    std::vector<int8_t> ent_val;
+    std::vector<uint8_t> ent_in_order;
+    const bool native = b.has_data && c->native_apply;
+    for (int64_t s : shards) {
+      const int64_t pos = c->blk_cur_pos[s];
+      const int64_t slot = (int64_t)c->kslot[s];
+      const int8_t val = c->kdecided[s];
+      const bool in_order = c->applied[s] == slot;
+      ent_shard.push_back(s);
+      ent_slot.push_back(slot);
+      ent_pos.push_back(pos);
+      ent_bidx.push_back(b.bidx[pos]);
+      ent_val.push_back(val);
+      ent_in_order.push_back(in_order ? 1 : 0);
+      if (val == V1c && in_order && native) idxs.push_back(pos);
+    }
+    int64_t staged = -1;
+    const int32_t want = (b.token != 0 && b.want) ? 1 : 0;
+    // per-entry staged-result slices, captured below while the plane
+    // lock is still held (slice i of res_bytes has length res_len[i],
+    // concatenated in entry order)
+    std::vector<int64_t> res_len(ent_shard.size(), 0);
+    std::vector<uint8_t> res_bytes;
+    if (native && !idxs.empty()) {
+      // Hold the store-plane lock across the apply AND the result
+      // read-out: the asyncio thread's scalar applies (sk_apply_ops)
+      // clear and regrow the SAME out_buf, so reading it after
+      // sk_apply_wave's internal lock is released races a concurrent
+      // clear/realloc. The plane mutex is recursive, so bracketing the
+      // call is safe — but the bracket must end before any ev_push
+      // (a full mailbox blocks until Python drains, and Python's drain
+      // paths take this lock: holding it there would deadlock).
+      const bool plane_held = c->fns[FN_SK_PLANE_LOCK] != nullptr;
+      if (plane_held)
+        ((fn_sk_plane_lk_t)c->fns[FN_SK_PLANE_LOCK])(c->sk);
+      staged = ((fn_sk_apply_wave_t)c->fns[FN_SK_APPLY_WAVE])(
+          c->sk, b.data.data(), b.cmd_offsets.data(), b.shards.data(),
+          b.starts.data(), idxs.data(), (int64_t)idxs.size(), now, want);
+      if (want && staged >= 0) {
+        const uint8_t* ob =
+            (const uint8_t*)((fn_sk_ptr_t)c->fns[FN_SK_OUT_BUF])(c->sk);
+        const int64_t* offs =
+            (const int64_t*)((fn_sk_ptr_t)c->fns[FN_SK_OUT_OFFS])(c->sk);
+        std::map<int64_t, std::pair<int64_t, int64_t>> ranges;  // pos->ops
+        int64_t op_at = 0;
+        for (int64_t pos : idxs) {
+          const int64_t nops = b.starts[pos + 1] - b.starts[pos];
+          ranges.emplace(pos, std::make_pair(op_at, op_at + nops));
+          op_at += nops;
+        }
+        for (size_t i = 0; i < ent_shard.size(); i++) {
+          auto rit = ranges.find(ent_pos[i]);
+          if (rit == ranges.end()) continue;
+          const int64_t lo = offs[rit->second.first];
+          const int64_t hi = offs[rit->second.second];
+          res_len[i] = hi - lo;
+          if (hi > lo) {
+            size_t w = res_bytes.size();
+            res_bytes.resize(w + (size_t)(hi - lo));
+            memcpy(res_bytes.data() + w, ob + lo, (size_t)(hi - lo));
+          }
+        }
+      }
+      if (plane_held)
+        ((fn_sk_plane_lk_t)c->fns[FN_SK_PLANE_UNLOCK])(c->sk);
+      c->ctrs[RTM_SLOTS_APPLIED] += (uint64_t)idxs.size();
+    }
+    // bookkeeping for every decided entry
+    for (size_t i = 0; i < ent_shard.size(); i++) {
+      const int64_t s = ent_shard[i];
+      const int64_t slot = ent_slot[i];
+      c->in_flight[s] = 0;
+      c->opened_at[s] = 0.0;
+      if (slot + 1 > c->next_slot[s]) c->next_slot[s] = slot + 1;
+      const int64_t ring = slot & (c->dec_ring - 1);
+      c->ring_slot[s * c->dec_ring + ring] = slot;
+      c->ring_val[s * c->dec_ring + ring] = ent_val[i];
+      if (native && ent_in_order[i]) c->applied[s] = slot + 1;
+      c->blk_cur_ref[s] = -1;
+      void_stale_pend(c, s, slot);
+    }
+
+    // one EV_WAVE per (ref, tick-batch)
+    std::vector<uint8_t> rec;
+    const uint8_t applied_flag = native ? 1 : 0;
+    const uint8_t has_results = (native && want && staged >= 0) ? 1 : 0;
+    rec.push_back(EV_WAVE);
+    wr_u64(rec, b.token);
+    rec.push_back(applied_flag);
+    rec.push_back(has_results);
+    wr_u32(rec, (uint32_t)ent_shard.size());
+    for (size_t i = 0; i < ent_shard.size(); i++) {
+      wr_u32(rec, (uint32_t)ent_shard[i]);
+      wr_u64(rec, (uint64_t)ent_slot[i]);
+      wr_u32(rec, ent_bidx[i]);
+      // value bits 0-1; bit 2 flags out-of-order (sync-overtaken)
+      // entries Python must route through its scalar ledger
+      rec.push_back((uint8_t)ent_val[i] | (ent_in_order[i] ? 0 : 4));
+    }
+    if (has_results) {
+      // results section: count * u32 rlen, then ONE concatenated payload
+      // blob (entry order) — the Python side slices lazily with numpy
+      // instead of a per-entry parse loop. Per-entry [u32 len][payload]
+      // result records stay inside each entry's slice (the
+      // rt_broadcast_frames staging format the plane emits). The slices
+      // themselves were copied out of the plane's out_buf above, under
+      // the plane lock.
+      for (size_t i = 0; i < ent_shard.size(); i++)
+        wr_u32(rec, (uint32_t)res_len[i]);
+      if (!res_bytes.empty()) {
+        size_t w = rec.size();
+        rec.resize(w + res_bytes.size());
+        memcpy(rec.data() + w, res_bytes.data(), res_bytes.size());
+        c->ctrs[RTM_RESULT_BYTES] += (uint64_t)res_bytes.size();
+      }
+    }
+    blk_unref(c, ref, (int64_t)ent_shard.size());
+    ev_push(c, rec);
+    if (native) {
+      // proposer-side future settle is Python bookkeeping but OFF the
+      // commit path (peers already progressed) — not a GIL handoff
+      c->ctrs[RTM_WAVES_NATIVE]++;
+    } else {
+      c->ctrs[RTM_WAVES_PY]++;
+      c->ctrs[RTM_GIL_HANDOFFS]++;
+    }
+  }
+}
+
+// --- open collection --------------------------------------------------------
+
+static int32_t collect_opens(RtmCtx* c) {
+  int32_t n_open = 0;
+  memset(c->open_mask.data(), 0, (size_t)c->S);
+  for (int64_t s = 0; s < c->n; s++) {
+    if (c->in_flight[s]) continue;
+    if (c->blk_cur_ref[s] != -1) {
+      // idle shard with a cur binding = a sync adoption overtook the
+      // open (Python cleared in_flight under pause): release it before
+      // anything re-opens the shard
+      blk_unref(c, c->blk_cur_ref[s], 1);
+      c->blk_cur_ref[s] = -1;
+    }
+    if (c->blk_pend_ref[s] == -1 && c->sp_slot[s] == -1) continue;
+    const int64_t head =
+        c->next_slot[s] > c->applied[s] ? c->next_slot[s] : c->applied[s];
+    void_stale_pend(c, s, head - 1);  // drop bindings the head overtook
+    // block binding at head wins (asyncio parity: bulk open runs first)
+    if (c->blk_pend_ref[s] != -1 && c->blk_pend_slot[s] == head &&
+        c->tainted[s] <= head) {
+      c->blk_cur_ref[s] = c->blk_pend_ref[s];
+      c->blk_cur_pos[s] = c->blk_pend_pos[s];
+      c->blk_pend_ref[s] = -1;
+      c->blk_pend_slot[s] = -1;
+      c->open_mask[s] = 1;
+      c->open_slots[s] = (int32_t)head;
+      c->open_init[s] = V1c;
+      n_open++;
+      c->ctrs[RTM_OPENS_BLOCK]++;
+      continue;
+    }
+    if (c->sp_slot[s] == head && c->tainted[s] <= head) {
+      c->open_mask[s] = 1;
+      c->open_slots[s] = (int32_t)head;
+      c->open_init[s] = c->sp_init[s];
+      n_open++;
+      c->ctrs[RTM_OPENS_SCALAR]++;
+      if (!c->sp_frame[s].empty()) {
+        // Propose rides ahead of the open's R1 frame (asyncio parity)
+        std::vector<uint8_t> one;
+        const uint32_t flen = (uint32_t)c->sp_frame[s].size();
+        wr_u32(one, flen);
+        size_t w = one.size();
+        one.resize(w + flen);
+        memcpy(one.data() + w, c->sp_frame[s].data(), flen);
+        ((fn_bcast_frames_t)c->fns[FN_BCAST_FRAMES])(c->tr, one.data(),
+                                                     (int64_t)one.size());
+        c->sp_frame[s].clear();
+      }
+      c->sp_slot[s] = -1;
+    }
+  }
+  if (n_open) {
+    const double now = wall_s();
+    for (int64_t s = 0; s < c->n; s++) {
+      if (!c->open_mask[s]) continue;
+      c->in_flight[s] = 1;
+      // next_slot = max(next_slot, slot) — np.maximum.at parity; the
+      // +1 advance happens at decide
+      if ((int64_t)c->open_slots[s] > c->next_slot[s])
+        c->next_slot[s] = (int64_t)c->open_slots[s];
+      c->opened_at[s] = now;
+      c->last_progress[s] = now;
+    }
+  }
+  return n_open;
+}
+
+// --- timers: retransmit, stale repair, stall escalation ---------------------
+
+static void run_timers(RtmCtx* c, double now) {
+  // vote retransmits for stalled shards (pure C)
+  int64_t res[4] = {0, 0, 0, 0};
+  ((fn_rk_retransmit_t)c->fns[FN_RK_RETRANSMIT])(
+      c->rk, now, c->phase_timeout, c->out.data(), (int64_t)c->out.size(),
+      res);
+  if (res[0] > 0) {
+    ((fn_bcast_frames_t)c->fns[FN_BCAST_FRAMES])(c->tr, c->out.data(), res[0]);
+    c->ctrs[RTM_RETRANSMITS]++;
+  }
+  if (res[1] > 0) {
+    // payload retransmission is Python's (it owns the propose bytes):
+    // escalate stalled shards' bindings, rate-limited per shard
+    for (int64_t s = 0; s < c->n; s++) {
+      if (!c->in_flight[s]) continue;
+      if (now - c->opened_at[s] < c->phase_timeout) continue;
+      if (now - c->stall_ev_at[s] < c->phase_timeout) continue;
+      c->stall_ev_at[s] = now;
+      std::vector<uint8_t> rec;
+      if (c->blk_cur_ref[s] != -1) {
+        auto it = c->blocks.find(c->blk_cur_ref[s]);
+        const uint64_t token = it != c->blocks.end() ? it->second.token : 0;
+        rec.push_back(EV_STALL);
+        rec.push_back(1);
+        wr_u32(rec, (uint32_t)s);
+        wr_u64(rec, token);
+      } else {
+        rec.push_back(EV_STALL);
+        rec.push_back(0);
+        wr_u32(rec, (uint32_t)s);
+        wr_u64(rec, (uint64_t)c->kslot[s]);
+      }
+      ev_push(c, rec);
+    }
+  }
+  // peer-votes-waiting escalation (the V0 grace path stays in Python).
+  // Bounded per pass: at wide shard counts an unthrottled scan would
+  // flood the mailbox with stall events faster than the control plane
+  // can bind payloads, turning a transient binding lag into a V0-open
+  // cascade (measured: ~1M stall events in one config-5 run).
+  int32_t stall_budget = 128;
+  for (int64_t s = 0; s < c->n && stall_budget > 0; s++) {
+    if (c->in_flight[s]) continue;
+    const int64_t head =
+        c->next_slot[s] > c->applied[s] ? c->next_slot[s] : c->applied[s];
+    if (c->votes_seen[s] < head) continue;
+    if (c->blk_pend_ref[s] != -1 || c->sp_slot[s] != -1) continue;
+    if (now - c->votes_wait_at[s] < c->grace) continue;
+    c->votes_wait_at[s] = now;
+    stall_budget--;
+    std::vector<uint8_t> rec;
+    rec.push_back(EV_STALL);
+    rec.push_back(2);
+    wr_u32(rec, (uint32_t)s);
+    wr_u64(rec, (uint64_t)head);
+    ev_push(c, rec);
+  }
+  // native stale-vote repair from the decided-value ring (bid-free
+  // Decisions, unicast, per-row rate limit — _repair_stale_sender parity)
+  const int64_t k = ((fn_rk_drain_stale_t)c->fns[FN_RK_DRAIN_STALE])(
+      c->rk, c->st_rows.data(), c->st_shards.data(), c->st_slots.data(),
+      (int64_t)c->st_rows.size());
+  if (k > 0) {
+    const double limit =
+        c->phase_timeout / 4 > 0.05 ? c->phase_timeout / 4 : 0.05;
+    std::vector<int64_t> shards, slots;
+    std::vector<int8_t> vals;
+    for (int32_t row = 0; row < c->R; row++) {
+      if (row == c->me) continue;
+      shards.clear();
+      slots.clear();
+      vals.clear();
+      for (int64_t i = 0; i < k && (int64_t)shards.size() < 256; i++) {
+        if (c->st_rows[i] != row) continue;
+        const int64_t s = c->st_shards[i];
+        const int64_t slot = c->st_slots[i];
+        const int64_t ring = slot & (c->dec_ring - 1);
+        if (c->ring_slot[s * c->dec_ring + ring] != slot) continue;
+        shards.push_back(s);
+        slots.push_back(slot);
+        vals.push_back(c->ring_val[s * c->dec_ring + ring]);
+      }
+      if (shards.empty()) continue;
+      if (now - c->last_repair[row] < limit) continue;
+      c->last_repair[row] = now;
+      std::vector<uint8_t> f;
+      build_decision_frame(c, f, now, shards.data(), slots.data(),
+                           vals.data(), (int32_t)shards.size());
+      ((fn_send_t)c->fns[FN_SEND])(c->tr,
+                                   c->uuids.data() + (size_t)row * 16,
+                                   f.data(), (uint32_t)f.size());
+      c->ctrs[RTM_STALE_REPAIRS]++;
+    }
+  }
+}
+
+// --- the io/tick loop -------------------------------------------------------
+
+// One inbound frame through the native path: rk_ingest (votes/decisions),
+// the native ProposeBlock binder, or escalation to the Python mailbox.
+// Returns 1 when the frame had ledger/binding effects (a tick is due).
+static int32_t handle_frame(RtmCtx* c, int32_t row, const uint8_t* fp,
+                            uint32_t flen, double now) {
+  const int32_t rc =
+      ((fn_rk_ingest_t)c->fns[FN_RK_INGEST])(c->rk, fp, (int64_t)flen, row,
+                                             now);
+  if (rc == RK_HANDLED) {
+    c->ctrs[RTM_FRAMES_NATIVE]++;
+    return 1;
+  }
+  if (rc == RK_NOOP) {
+    c->ctrs[RTM_FRAMES_NATIVE]++;
+    return 0;
+  }
+  if (rc == RK_DROP) {
+    c->ctrs[RTM_FRAMES_DROPPED]++;
+    return 0;
+  }
+  // RK_PY: bind blocks natively when the apply plane is native —
+  // otherwise the frame goes up (Python owns binding AND apply there)
+  if (flen >= 2 && fp[1] == MT_PROPOSE_BLOCK && c->native_apply) {
+    const int brc = parse_propose_block(c, fp, (int64_t)flen, row, now);
+    if (brc >= 0) return brc;
+    if (brc == -2) return 0;  // dropped (spoof/skew/checksum/limits)
+  }
+  std::vector<uint8_t> rec;
+  rec.push_back(EV_FRAME);
+  rec.push_back((uint8_t)(row & 0xFF));
+  rec.push_back((uint8_t)((row >> 8) & 0xFF));
+  size_t w = rec.size();
+  rec.resize(w + flen);
+  memcpy(rec.data() + w, fp, flen);
+  ev_push(c, rec);
+  c->ctrs[RTM_FRAMES_ESCALATED]++;
+  return 0;
+}
+
+static void rtm_loop(RtmCtx* c) {
+  fn_recv_borrow_t recv_borrow = (fn_recv_borrow_t)c->fns[FN_RECV_BORROW];
+  fn_recv_release_t recv_release = (fn_recv_release_t)c->fns[FN_RECV_RELEASE];
+  fn_rk_tick_t rk_tick = (fn_rk_tick_t)c->fns[FN_RK_TICK];
+  fn_bcast_frames_t bcast = (fn_bcast_frames_t)c->fns[FN_BCAST_FRAMES];
+  uint8_t sender[16];
+  const uint8_t* fp = nullptr;
+  uint32_t flen = 0;
+  int64_t res[8];
+  const double timer_every =
+      c->phase_timeout / 4 < 0.05 ? c->phase_timeout / 4 : 0.05;
+
+  while (!c->stop_req.load(std::memory_order_relaxed)) {
+    c->ctrs[RTM_LOOPS]++;
+    double now = wall_s();
+    drain_cmds(c, now);
+    if (c->pause_req.load(std::memory_order_relaxed)) {
+      c->state.store(RTM_PAUSED, std::memory_order_release);
+      c->ctrs[RTM_PAUSES]++;
+      while (c->pause_req.load(std::memory_order_relaxed) &&
+             !c->stop_req.load(std::memory_order_relaxed))
+        usleep(200);
+      c->state.store(RTM_RUNNING, std::memory_order_release);
+      continue;
+    }
+
+    // nonblocking frame pump: rk_ingest consumes vote/decision frames in
+    // place; ProposeBlock binds natively; everything else escalates
+    int32_t got = 0, consumed = 0;
+    while (consumed < 512) {
+      const int64_t tok = recv_borrow(c->tr, sender, &fp, &flen, 0);
+      if (tok < 0) break;
+      consumed++;
+      const int32_t row = row_of(c, sender);
+      if (row >= 0) got += handle_frame(c, row, fp, flen, now);
+      recv_release(c->tr, tok);
+    }
+
+    const int32_t n_open = collect_opens(c);
+    if (got || n_open || c->restep) {
+      c->restep = 0;
+      now = wall_s();
+      rk_tick(c->rk, now, c->out.data(), (int64_t)c->out.size(), 4,
+              n_open ? c->open_mask.data() : nullptr,
+              n_open ? c->open_slots.data() : nullptr,
+              n_open ? c->open_init.data() : nullptr, res);
+      c->ctrs[RTM_TICKS]++;
+      if (res[0] > 0) bcast(c->tr, c->out.data(), res[0]);
+      if (res[2]) c->restep = 1;
+      if (res[1]) process_decided(c, now);
+    }
+
+    if (now - c->last_timers >= timer_every) {
+      c->last_timers = now;
+      run_timers(c, now);
+    }
+
+    if (c->restep) continue;
+    if (consumed) {
+      fr_rec(c, FRE_RT_WAKE, 1, 0, 0);
+      c->ctrs[RTM_WAKES_FRAME]++;
+      continue;  // stay hot while traffic flows
+    }
+    // idle: block on the transport inbox (frames and rt_inbox_kick both
+    // wake it). Capped at 5ms — rt_inbox_kick is lock-free, so a kick
+    // can (rarely) lose its wakeup; the cap bounds that race AND keeps
+    // timer latency tight without burning idle CPU.
+    int timeout_ms = (int)(timer_every * 1000.0);
+    if (timeout_ms > 5) timeout_ms = 5;
+    if (timeout_ms < 1) timeout_ms = 1;
+    const int64_t tok = recv_borrow(c->tr, sender, &fp, &flen, timeout_ms);
+    if (tok >= 0) {
+      const int32_t row = row_of(c, sender);
+      if (row >= 0 && handle_frame(c, row, fp, flen, wall_s()))
+        c->restep = 1;  // force a tick next iteration
+      recv_release(c->tr, tok);
+      fr_rec(c, FRE_RT_WAKE, 1, 0, 0);
+      c->ctrs[RTM_WAKES_FRAME]++;
+    } else {
+      fr_rec(c, FRE_RT_WAKE, 2, 0, 0);
+      c->ctrs[RTM_WAKES_IDLE]++;
+    }
+  }
+  c->state.store(RTM_STOPPED, std::memory_order_release);
+  uint64_t one = 1;
+  (void)!write(c->event_fd, &one, 8);
+}
+
+// --- lifecycle / ABI --------------------------------------------------------
+
+// dims: [S, n, R, me, dec_ring, native_apply, cmd_ring_cap, ev_ring_cap,
+//        max_cmds_per_batch, max_cmd_size]
+// ptrs: [rk_ctx, transport, sk_plane, next_slot, applied, in_flight,
+//        votes_seen, tainted, last_progress, opened_at, ring_slot,
+//        ring_val, kslot, kdecided, kdone, knewly]
+// fns:  FN_* order above
+// fparams: [max_future_skew, max_age, phase_timeout, grace]
+void* rtm_create(const int64_t* dims, const int64_t* ptrs, const int64_t* fns,
+                 const uint8_t* uuids, const double* fparams) {
+  RtmCtx* c = new RtmCtx();
+  c->S = (int32_t)dims[0];
+  c->n = (int32_t)dims[1];
+  c->R = (int32_t)dims[2];
+  c->me = (int32_t)dims[3];
+  c->dec_ring = (int32_t)dims[4];
+  c->native_apply = (int32_t)dims[5];
+  const int64_t cmd_cap = dims[6] > 0 ? dims[6] : (8 << 20);
+  const int64_t ev_cap = dims[7] > 0 ? dims[7] : (20 << 20);
+  c->max_cmds = dims[8];
+  c->max_cmd_size = dims[9];
+  int i = 0;
+  c->rk = (void*)ptrs[i++];
+  c->tr = (void*)ptrs[i++];
+  c->sk = (void*)ptrs[i++];
+  c->next_slot = (int64_t*)ptrs[i++];
+  c->applied = (int64_t*)ptrs[i++];
+  c->in_flight = (uint8_t*)ptrs[i++];
+  c->votes_seen = (int64_t*)ptrs[i++];
+  c->tainted = (int64_t*)ptrs[i++];
+  c->last_progress = (double*)ptrs[i++];
+  c->opened_at = (double*)ptrs[i++];
+  c->ring_slot = (int64_t*)ptrs[i++];
+  c->ring_val = (int8_t*)ptrs[i++];
+  c->kslot = (int32_t*)ptrs[i++];
+  c->kdecided = (int8_t*)ptrs[i++];
+  c->kdone = (uint8_t*)ptrs[i++];
+  c->knewly = (uint8_t*)ptrs[i++];
+  for (int j = 0; j < FN_COUNT; j++) c->fns[j] = (void*)fns[j];
+  c->uuids.assign(uuids, uuids + (size_t)c->R * 16);
+  c->max_future_skew = fparams[0];
+  c->max_age = fparams[1];
+  c->phase_timeout = fparams[2];
+  c->grace = fparams[3];
+  if (!c->native_apply) c->sk = nullptr;
+
+  c->blk_pend_ref.assign(c->S, -1);
+  c->blk_pend_pos.assign(c->S, 0);
+  c->blk_pend_slot.assign(c->S, -1);
+  c->blk_cur_ref.assign(c->S, -1);
+  c->blk_cur_pos.assign(c->S, 0);
+  c->sp_slot.assign(c->S, -1);
+  c->sp_init.assign(c->S, 0);
+  c->sp_frame.resize(c->S);
+  c->stall_ev_at.assign(c->S, 0.0);
+  c->votes_wait_at.assign(c->S, 0.0);
+  c->open_mask.assign(c->S, 0);
+  c->open_slots.assign(c->S, 0);
+  c->open_init.assign(c->S, 0);
+  // outbound buffer: same sizing rule as NativeTick, with headroom
+  c->out.resize((size_t)(4096 + 72 + 13 * (int64_t)c->n +
+                         4 * (3 * 72 + 40 * (int64_t)c->n)));
+  c->cmd.buf.resize((size_t)cmd_cap);
+  c->ev.buf.resize((size_t)ev_cap);
+  // scratch covers the whole ring: a record the push accepted must
+  // always drain (a smaller scratch would wedge the command plane
+  // behind the first oversized record)
+  c->cmd_scratch.resize((size_t)cmd_cap);
+  c->st_rows.assign(1024, 0);
+  c->st_shards.assign(1024, 0);
+  c->st_slots.assign(1024, 0);
+  c->last_repair.assign(c->R, 0.0);
+  memset(c->ctrs, 0, sizeof(c->ctrs));
+  c->fr.resize(RTM_FLIGHT_CAP);
+  c->event_fd = eventfd(0, EFD_NONBLOCK);
+  return c;
+}
+
+int32_t rtm_start(void* ctx) {
+  RtmCtx* c = (RtmCtx*)ctx;
+  c->th = std::thread([c] { rtm_loop(c); });
+  return 0;
+}
+
+// Request a stop and join. The loop finishes its current iteration —
+// decided waves already ingested complete their apply + event staging
+// before the thread exits (mid-wave shutdown never loses staged result
+// frames; the bridge drains the mailbox after this returns).
+void rtm_stop(void* ctx) {
+  RtmCtx* c = (RtmCtx*)ctx;
+  c->stop_req.store(1, std::memory_order_relaxed);
+  if (c->th.joinable()) c->th.join();
+}
+
+void rtm_destroy(void* ctx) {
+  RtmCtx* c = (RtmCtx*)ctx;
+  rtm_stop(c);
+  if (c->event_fd >= 0) close(c->event_fd);
+  delete c;
+}
+
+int32_t rtm_state(void* ctx) {
+  return ((RtmCtx*)ctx)->state.load(std::memory_order_acquire);
+}
+
+void rtm_pause(void* ctx) {
+  ((RtmCtx*)ctx)->pause_req.store(1, std::memory_order_relaxed);
+}
+
+void rtm_resume(void* ctx) {
+  ((RtmCtx*)ctx)->pause_req.store(0, std::memory_order_relaxed);
+}
+
+int rtm_event_fd(void* ctx) { return ((RtmCtx*)ctx)->event_fd; }
+
+// Producer half of the command ring, called from the Python control
+// plane thread (the only producer). Returns 0 staged, -1 full.
+int32_t rtm_cmd_push(void* ctx, const uint8_t* rec, int64_t len) {
+  RtmCtx* c = (RtmCtx*)ctx;
+  return c->cmd.push(rec, len, nullptr, 0) ? 0 : -1;
+}
+
+// Consumer half of the event mailbox, called from the Python control
+// plane thread (the only consumer). Copies whole records
+// ([u32 len][payload]...) into `out`; returns bytes written.
+int64_t rtm_ev_drain(void* ctx, uint8_t* out, int64_t cap) {
+  RtmCtx* c = (RtmCtx*)ctx;
+  return c->ev.drain(out, cap);
+}
+
+int32_t rtm_counters_version(void) { return RTM_COUNTERS_VERSION; }
+int32_t rtm_counters_count(void) { return RTM_COUNT; }
+void* rtm_counters(void* ctx) { return ((RtmCtx*)ctx)->ctrs; }
+
+int32_t rtm_flight_version(void) { return RTM_FLIGHT_VERSION; }
+int32_t rtm_flight_cap(void) { return (int32_t)RTM_FLIGHT_CAP; }
+int32_t rtm_flight_record_size(void) { return (int32_t)sizeof(FrEvent); }
+void* rtm_flight(void* ctx) { return ((RtmCtx*)ctx)->fr.data(); }
+uint64_t rtm_flight_head(void* ctx) { return ((RtmCtx*)ctx)->fr_head; }
+
+}  // extern "C"

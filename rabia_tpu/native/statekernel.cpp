@@ -40,6 +40,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
+#include <mutex>
 #include <new>
 #include <vector>
 
@@ -142,6 +143,16 @@ struct SkPlane {
   FrEvent flight[SK_FLIGHT_CAP];
   uint64_t flight_head = 0;
   uint64_t waves = 0;
+  // Plane lock (native-runtime hook): the GIL-free runtime thread owns
+  // the apply path while the Python control plane still serves reads
+  // (gateway read-index GETs, snapshot export). Mutating entry points
+  // take it internally; read-side critical sections bracket themselves
+  // with sk_plane_lock/sk_plane_unlock so borrowed pointers (sk_get's
+  // value view) stay valid across the copy-out. Recursive, so a locked
+  // reader can call helpers that lock internally (snapshot restore's
+  // insert_raw loop). Uncontended cost is nanoseconds — invisible next
+  // to a wave apply.
+  std::recursive_mutex mu;
   // wave result staging (plane-owned, reused and grown across waves so
   // a large wave can never overflow mid-apply): [u32 LE len][payload]
   // records in PROCESS order, with out_offs[i] = record i's start and a
@@ -278,6 +289,14 @@ int32_t sk_flight_record_size() { return (int32_t)sizeof(FrEvent); }
 void* sk_flight(void* h) { return ((SkPlane*)h)->flight; }
 uint64_t sk_flight_head(void* h) { return ((SkPlane*)h)->flight_head; }
 
+// Read-side critical-section brackets (native-runtime hook): hold the
+// plane lock across sk_get + the value copy-out (or an export walk) so
+// the GIL-free runtime thread's concurrent wave applies cannot free or
+// rehash the borrowed bytes mid-read. Recursive with the internal
+// mutator locks above.
+void sk_plane_lock(void* h) { ((SkPlane*)h)->mu.lock(); }
+void sk_plane_unlock(void* h) { ((SkPlane*)h)->mu.unlock(); }
+
 int64_t sk_store_count(void* h) {
   return (int64_t)((SkPlane*)h)->stores.size();
 }
@@ -296,6 +315,7 @@ uint64_t sk_store_version(void* h, int64_t idx) {
 
 void sk_set_version(void* h, int64_t idx, uint64_t v) {
   SkPlane* p = (SkPlane*)h;
+  std::lock_guard<std::recursive_mutex> lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   p->stores[(size_t)idx].version = v;
 }
@@ -313,6 +333,7 @@ void sk_store_stats(void* h, int64_t idx, uint64_t* out) {
 void sk_add_stats(void* h, int64_t idx, uint64_t ops, uint64_t reads,
                   uint64_t writes) {
   SkPlane* p = (SkPlane*)h;
+  std::lock_guard<std::recursive_mutex> lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   Store& st = p->stores[(size_t)idx];
   st.total_operations += ops;
@@ -377,6 +398,7 @@ int64_t sk_export(void* h, int64_t idx, uint8_t* out, int64_t cap) {
 
 void sk_clear_store(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
+  std::lock_guard<std::recursive_mutex> lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   Store& st = p->stores[(size_t)idx];
   store_free_entries(st);
@@ -389,6 +411,7 @@ int32_t sk_insert_raw(void* h, int64_t idx, const uint8_t* key,
                       int64_t klen, const uint8_t* val, int64_t vlen,
                       uint64_t version, double created, double updated) {
   SkPlane* p = (SkPlane*)h;
+  std::lock_guard<std::recursive_mutex> lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   if (st.used * 4 >= (int64_t)st.table.size() * 3)
@@ -790,6 +813,7 @@ int64_t sk_apply_wave(void* h, const uint8_t* data,
                       int64_t n_idx, double now, int32_t want) {
   SkPlane* p = (SkPlane*)h;
   if (!p || n_idx < 0) return -2;
+  std::lock_guard<std::recursive_mutex> lk(p->mu);
   p->staging = want != 0;
   p->out_buf.clear();
   p->out_offs.clear();
@@ -821,6 +845,7 @@ int64_t sk_apply_ops(void* h, int64_t store_idx, const uint8_t* data,
   SkPlane* p = (SkPlane*)h;
   if (!p || store_idx < 0 || (size_t)store_idx >= p->stores.size())
     return -2;
+  std::lock_guard<std::recursive_mutex> lk(p->mu);
   p->staging = want != 0;
   p->out_buf.clear();
   p->out_offs.clear();

@@ -178,6 +178,10 @@ struct Transport {
   std::map<NodeIdBytes, Peer> peers;         // configured dial targets
   std::deque<InboundMsg> inbox;
   std::condition_variable inbox_cv;
+  // rt_inbox_kick: spurious-wake generation counter. A waiter samples it
+  // before waiting and also wakes when it changes (see rt_recv_borrow),
+  // so a kick staged between the sample and the wait is never lost.
+  std::atomic<uint64_t> kick_gen{0};
   uint64_t dropped_frames = 0;
   // Zero-copy recv: frames handed out by rt_recv_borrow are parked here
   // (keyed by token) so their pooled buffers outlive the C call until
@@ -850,8 +854,11 @@ int rt_recv(void* h, uint8_t sender_out[16], uint8_t* buf, uint32_t buf_cap,
   auto* t = static_cast<Transport*>(h);
   std::unique_lock<std::mutex> lk(t->mu);
   if (t->inbox.empty() && timeout_ms != 0) {
-    t->inbox_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                         [t] { return !t->inbox.empty() || t->stopping.load(); });
+    const uint64_t k0 = t->kick_gen.load(std::memory_order_relaxed);
+    t->inbox_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [t, k0] {
+      return !t->inbox.empty() || t->stopping.load() ||
+             t->kick_gen.load(std::memory_order_relaxed) != k0;
+    });
   }
   if (t->inbox.empty()) return t->stopping.load() ? -1 : -3;
   InboundMsg m = std::move(t->inbox.front());
@@ -889,8 +896,11 @@ int64_t rt_recv_borrow(void* h, uint8_t sender_out[16],
     }
   }
   if (t->inbox.empty() && timeout_ms != 0) {
-    t->inbox_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                         [t] { return !t->inbox.empty() || t->stopping.load(); });
+    const uint64_t k0 = t->kick_gen.load(std::memory_order_relaxed);
+    t->inbox_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [t, k0] {
+      return !t->inbox.empty() || t->stopping.load() ||
+             t->kick_gen.load(std::memory_order_relaxed) != k0;
+    });
   }
   if (t->inbox.empty()) return t->stopping.load() ? -1 : -3;
   InboundMsg m = std::move(t->inbox.front());
@@ -983,6 +993,21 @@ int rt_connected(void* h, uint8_t* ids_out, int cap) {
 }
 
 uint16_t rt_port(void* h) { return static_cast<Transport*>(h)->port; }
+
+// Spurious-wake a thread blocked in rt_recv / rt_recv_borrow (returns -3
+// there as on timeout). Used by the Python control plane to nudge the
+// native runtime thread after staging a command. Deliberately LOCK-FREE:
+// taking `mu` here would stall the caller behind whole io-loop epoll
+// batches (milliseconds under load — measured on the engine's submit
+// path). The cost is a nanoseconds-wide lost-wakeup window (generation
+// bumped after the waiter's predicate check but notified before its
+// futex wait); the runtime thread bounds that race with a short recv
+// timeout, so a lost kick only delays a command by one idle tick.
+void rt_inbox_kick(void* h) {
+  auto* t = static_cast<Transport*>(h);
+  t->kick_gen.fetch_add(1, std::memory_order_relaxed);
+  t->inbox_cv.notify_all();
+}
 
 // Stop the io loop and unblock any rt_recv caller WITHOUT deleting the
 // transport. Used when the Python reader thread might still be inside

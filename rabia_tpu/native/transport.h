@@ -59,6 +59,11 @@ const uint64_t* rt_counters(void* h);
 int32_t rt_flight_version(void);
 int32_t rt_flight_record_size(void);
 int64_t rt_flight_copy(void* h, uint8_t* out, int64_t max_records);
+// Wake any thread blocked in rt_recv / rt_recv_borrow WITHOUT a frame
+// (the wait returns -3 as on timeout). The native runtime thread sleeps
+// on the transport inbox; the Python control plane kicks it here after
+// staging a command so a submission never waits out the recv timeout.
+void rt_inbox_kick(void* h);
 // Stop the io loop and unblock rt_recv callers WITHOUT freeing the
 // handle; call before rt_close when a reader thread may be inside
 // rt_recv.

@@ -150,6 +150,7 @@ class TcpNetwork(NetworkTransport):
         self._zero_copy = bool(
             getattr(self._lib, "rt_recv_borrow", None)
         ) and not os.environ.get("RABIA_NO_ZERO_COPY_RECV")
+        self._reader_detached = False
         self._reader = threading.Thread(target=self._reader_loop, daemon=True)
         self._reader.start()
 
@@ -165,12 +166,36 @@ class TcpNetwork(NetworkTransport):
 
     # -- reader bridge ------------------------------------------------------
 
+    def detach_reader(self) -> None:
+        """Hand exclusive inbox ownership to a native consumer (the
+        engine's GIL-free runtime thread, engine/runtime_bridge.py):
+        stop the Python reader thread so the two never steal each
+        other's frames. Frames it already pulled into the pending queue
+        stay drainable through the receive_* surface; the caller drains
+        them before the native consumer starts."""
+        self._reader_detached = True
+        if self._handle and hasattr(self._lib, "rt_inbox_kick"):
+            self._lib.rt_inbox_kick(self._handle)
+        if self._reader.is_alive():
+            self._reader.join(timeout=2.0)
+            if self._reader.is_alive():
+                # a reader that outlives the join would keep pulling
+                # frames into the pending queue nothing drains once the
+                # native consumer starts — silently losing votes. The
+                # caller treats runtime start failure as fatal; failing
+                # here is strictly better than racing the inbox.
+                self._reader_detached = False
+                raise RuntimeError(
+                    "transport reader thread did not stop within 2s; "
+                    "refusing to hand the inbox to a native consumer"
+                )
+
     def _reader_loop(self) -> None:
         import uuid
 
         ptr = ctypes.c_void_p()
         ln = ctypes.c_uint32()
-        while not self._closed:
+        while not self._closed and not self._reader_detached:
             if self._zero_copy:
                 tok = self._lib.rt_recv_borrow(
                     self._handle,

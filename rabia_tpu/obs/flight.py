@@ -64,6 +64,8 @@ FRE_APPLY = 15  # slot applied (arg = value)
 FRE_RESULT = 16  # gateway result sent (arg = ResultStatus)
 FRE_TF_IN = 17  # transport frame in (arg = wire msg_type)
 FRE_TF_OUT = 18  # transport frame out (arg = wire msg_type)
+FRE_RT_WAKE = 19  # native runtime thread wakeup (arg: 1 frames, 2 idle)
+FRE_RT_HANDOFF = 20  # runtime -> Python mailbox handoff (arg = ev type)
 
 FR_KIND_NAMES = {
     FRE_FRAME_IN: "frame_in",
@@ -84,6 +86,8 @@ FR_KIND_NAMES = {
     FRE_RESULT: "result",
     FRE_TF_IN: "tf_in",
     FRE_TF_OUT: "tf_out",
+    FRE_RT_WAKE: "rt_wake",
+    FRE_RT_HANDOFF: "rt_handoff",
 }
 
 NO_PEER = 0xFFFF

@@ -350,6 +350,233 @@ async def run_schedule_on_both_tick_paths(
         ) from None
 
 
+async def _run_runtime_schedule(
+    schedule: Sequence[dict[int, list[tuple[str, str]]]],
+    n_shards: int,
+    n_replicas: int,
+    *,
+    tag: str,
+    block_every: int = 2,
+):
+    """One native-TCP cluster (sharded native-KV stores) through a
+    schedule of SET waves: even waves ride the scalar lane, every
+    ``block_every``-th the block lane (submit_block), so BOTH the
+    runtime's scalar decide escalation and its native wave apply are
+    exercised. Returns (decisions, checksums, responses, runtime_active,
+    obs)."""
+    import numpy as np
+
+    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.apps.sharded import make_sharded_kv
+    from rabia_tpu.core.blocks import build_block
+    from rabia_tpu.core.config import RabiaConfig, TcpNetworkConfig
+    from rabia_tpu.core.network import ClusterConfig
+    from rabia_tpu.core.types import Command, CommandBatch, NodeId
+    from rabia_tpu.engine import RabiaEngine
+    from rabia_tpu.net.tcp import TcpNetwork
+
+    config = RabiaConfig(
+        phase_timeout=3.0,
+        heartbeat_interval=0.05,
+        round_interval=0.002,
+    ).with_kernel(num_shards=n_shards, shard_pad_multiple=2)
+    ids = [NodeId.from_int(i + 1) for i in range(n_replicas)]
+    nets = [TcpNetwork(i, TcpNetworkConfig(bind_port=0)) for i in ids]
+    for i in range(n_replicas):
+        for j in range(n_replicas):
+            if i != j:
+                nets[i].add_peer(ids[j], "127.0.0.1", nets[j].port)
+    engines, machines, tasks = [], [], []
+    for i, node in enumerate(ids):
+        sm, ms = make_sharded_kv(n_shards)
+        machines.append(ms)
+        eng = RabiaEngine(
+            ClusterConfig.new(node, ids), sm, nets[i], config=config
+        )
+        engines.append(eng)
+        tasks.append(asyncio.ensure_future(eng.run()))
+    try:
+        quorum = False
+        for _ in range(500):
+            await asyncio.sleep(0.01)
+            if all(
+                [(await e.get_statistics()).has_quorum for e in engines]
+            ):
+                quorum = True
+                break
+        assert quorum, f"{tag}: TCP cluster never formed quorum"
+        responses: list = []
+        for w, wave in enumerate(schedule):
+            shards = sorted(wave)
+            if block_every and w % block_every == 1:
+                # block lane: the covered shards' upcoming proposer rows
+                # differ per shard — submit on each proposer's engine so
+                # eligibility holds (ineligible entries demote to the
+                # scalar lane, which is also a valid, conformant path)
+                e = engines[w % n_replicas]
+                cmds = [
+                    [encode_set_bin(k, v) for k, v in wave[s]]
+                    for s in shards
+                ]
+                fut = await e.submit_block(
+                    build_block(np.asarray(shards, np.int64), cmds)
+                )
+                res = await asyncio.wait_for(fut, 20.0)
+                got = []
+                for r in res:
+                    if isinstance(r, Exception):
+                        got.append(("error", type(r).__name__))
+                    else:
+                        got.append([bytes(x) for x in r])
+                responses.append(got)
+            else:
+                e = engines[w % n_replicas]
+                futs = {}
+                for s in shards:
+                    batch = CommandBatch.new(
+                        [
+                            Command.new(encode_set_bin(k, v))
+                            for k, v in wave[s]
+                        ],
+                        shard=s,
+                    )
+                    futs[s] = await e.submit_batch(batch, shard=s)
+                got = []
+                for s in shards:
+                    r = await asyncio.wait_for(futs[s], 20.0)
+                    got.append([bytes(x) for x in r])
+                responses.append(got)
+        decisions = {
+            s: {
+                slot: int(rec.value)
+                for slot, rec in engines[0].rt.shards[s].decisions.items()
+            }
+            for s in range(n_shards)
+        }
+        # replica convergence on state checksums
+        def sums(ms):
+            return [m.store.checksum() for m in ms]
+
+        want = sums(machines[0])
+        for _ in range(500):
+            if all(sums(ms) == want for ms in machines):
+                break
+            await asyncio.sleep(0.01)
+        assert all(
+            sums(ms) == want for ms in machines
+        ), f"{tag}: replicas diverged"
+        e0 = engines[0]
+        runtime_active = all(e._rtm is not None for e in engines)
+        lifecycle: dict[int, list] = {}
+        for ev in e0.flight_events():
+            if ev["kind"] in ("propose", "decide", "apply"):
+                lifecycle.setdefault(int(ev["shard"]), []).append(
+                    (ev["kind"], int(ev["slot"]), int(ev["arg"]))
+                )
+        obs = {
+            "parity": {
+                "decided_v1": int(e0.rt.decided_v1),
+                "decided_v0": int(e0.rt.decided_v0),
+                "state_version": int(e0.rt.state_version),
+            },
+            "flight_lifecycle": lifecycle,
+            "flight": e0.flight_events(),
+            "runtime": (
+                e0._rtm.counters_dict() if e0._rtm is not None else {}
+            ),
+            "context": {"ticks": int(e0._tick_count)},
+        }
+        return decisions, want, responses, runtime_active, obs
+    finally:
+        for e in engines:
+            await e.shutdown()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        for n in nets:
+            await n.close()
+
+
+async def run_schedule_on_runtime_paths(
+    schedule: Sequence[dict[int, list[tuple[str, str]]]],
+    n_shards: int,
+    n_replicas: int = 3,
+    *,
+    tag: str = "",
+    require_native: bool = True,
+) -> None:
+    """Native-runtime vs asyncio-orchestration conformance (the engine
+    runtime gate, extending the tick-path gate family).
+
+    The same schedule of SET waves (scalar + block lanes) runs through
+    two native-TCP clusters — the GIL-free runtime thread
+    (native/runtime.cpp) and the asyncio semantics owner
+    (``RABIA_PY_RUNTIME=1``) — and must produce identical per-shard
+    decision ledgers, byte-identical client responses, identical replica
+    state checksums and counter parity. Shared by tests/test_runtime.py
+    and ``fuzz_conformance.py --runtime``. Divergence dumps both legs'
+    flight captures to ``$RABIA_FLIGHT_DIR``.
+    """
+    import os
+
+    prev = os.environ.pop("RABIA_PY_RUNTIME", None)
+    try:
+        dec_rt, sums_rt, resp_rt, active, obs_rt = (
+            await _run_runtime_schedule(
+                schedule, n_shards, n_replicas, tag=f"{tag}[runtime]"
+            )
+        )
+        if require_native:
+            assert active, (
+                f"{tag}: native runtime inactive (runtime.cpp build "
+                "failure?) — conformance gate would be vacuous"
+            )
+        os.environ["RABIA_PY_RUNTIME"] = "1"
+        dec_py, sums_py, resp_py, _, obs_py = await _run_runtime_schedule(
+            schedule, n_shards, n_replicas, tag=f"{tag}[asyncio]"
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("RABIA_PY_RUNTIME", None)
+        else:
+            os.environ["RABIA_PY_RUNTIME"] = prev
+    ctx = (
+        f"counters[runtime]={obs_rt['parity']} "
+        f"counters[asyncio]={obs_py['parity']} "
+        f"rtm={obs_rt['runtime']}"
+    )
+    try:
+        assert dec_rt == dec_py, (
+            f"{tag}: decision ledgers diverge across runtime paths "
+            f"(runtime={dec_rt}, asyncio={dec_py}); {ctx}"
+        )
+        assert resp_rt == resp_py, (
+            f"{tag}: client responses diverge across runtime paths; {ctx}"
+        )
+        assert sums_rt == sums_py, (
+            f"{tag}: replica state diverges across runtime paths; {ctx}"
+        )
+        assert obs_rt["parity"] == obs_py["parity"], (
+            f"{tag}: counter parity broken across runtime paths; {ctx}"
+        )
+        assert obs_rt["parity"]["decided_v1"] > 0, (
+            f"{tag}: no decisions recorded — vacuous schedule"
+        )
+        if require_native:
+            rtm = obs_rt["runtime"]
+            assert rtm.get("waves_native", 0) > 0, (
+                f"{tag}: no native waves — block lane never hit the "
+                f"runtime apply path; {ctx}"
+            )
+    except AssertionError as e:
+        paths = _dump_divergence_flight(
+            tag,
+            {**obs_rt, "context": obs_rt.get("context", {})},
+            {**obs_py, "context": obs_py.get("context", {})},
+        )
+        raise AssertionError(f"{e}; flight dumps: {paths}") from None
+
+
 def run_ops_on_both_apply_paths(
     schedule: Sequence[dict[int, list[bytes]]],
     n_shards: int,

@@ -353,6 +353,45 @@ async def _trial_tick_paths(seed: int) -> None:
         raise
 
 
+async def _trial_runtime_paths(seed: int) -> None:
+    """Engine-level differential: one RANDOM schedule of SET waves
+    (scalar + block lanes) through the native engine runtime
+    (runtime.cpp io/tick thread) AND the asyncio orchestration
+    (``RABIA_PY_RUNTIME=1``, the semantics owner) over native TCP —
+    identical decision ledgers, client responses, replica state and
+    counters required (~8s each: two real TCP clusters)."""
+    from rabia_tpu.testing.conformance import run_schedule_on_runtime_paths
+
+    rng = np.random.default_rng(seed + 733)
+    S = int(rng.choice([2, 3, 4]))
+    R = int(rng.choice([3, 5]))
+    waves = int(rng.integers(3, 6))
+    schedule = []
+    for w in range(waves):
+        covered = sorted(
+            rng.choice(S, size=int(rng.integers(1, S + 1)), replace=False)
+        )
+        schedule.append(
+            {
+                int(s): [
+                    (f"w{w}s{s}k{j}", f"v{int(rng.integers(0, 9))}")
+                    for j in range(int(rng.integers(1, 3)))
+                ]
+                for s in covered
+            }
+        )
+    try:
+        await run_schedule_on_runtime_paths(
+            schedule, n_shards=S, n_replicas=R, tag=f"runtime seed={seed}"
+        )
+    except AssertionError as e:
+        print(
+            f"runtime-path divergence (seed={seed}, S={S}, R={R}): {e}",
+            file=sys.stderr,
+        )
+        raise
+
+
 def _trial_apply_paths(seed: int) -> None:
     """Apply-plane differential: one RANDOM binary-op schedule through
     the native statekernel stores AND the Python KVStore stores (the
@@ -447,6 +486,13 @@ def main() -> int:
         "state hashes required; sub-second each)",
     )
     ap.add_argument(
+        "--runtime", type=int, default=0,
+        help="additionally run N native-runtime differential trials "
+        "(random scalar+block schedules through the GIL-free runtime "
+        "thread over TCP, then with RABIA_PY_RUNTIME=1; identical "
+        "decisions/responses/state required; ~8s each)",
+    )
+    ap.add_argument(
         "--mesh", type=int, default=0,
         help="additionally run N mesh-plane fault trials (crash schedules "
         "through MeshPhaseKernel's shard_map collectives + loss/crash "
@@ -529,6 +575,13 @@ def main() -> int:
         for i in range(args.apply):
             _trial_apply_paths(args.base_seed + i)
             apply_trials += 1
+    runtime_trials = 0
+    if args.runtime > 0:
+        import asyncio
+
+        for i in range(args.runtime):
+            asyncio.run(_trial_runtime_paths(args.base_seed + i))
+            runtime_trials += 1
     extra = (
         f"; {plane_trials} plane-differential schedules identical"
         if plane_trials
@@ -539,6 +592,11 @@ def main() -> int:
     if apply_trials:
         extra += (
             f"; {apply_trials} apply-path differential schedules identical"
+        )
+    if runtime_trials:
+        extra += (
+            f"; {runtime_trials} runtime-path differential schedules "
+            "identical"
         )
     if mesh_trials:
         extra += (

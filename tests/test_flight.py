@@ -341,3 +341,78 @@ class TestGatewayTrace:
         reconstruct with the native tick forced off."""
         monkeypatch.setenv("RABIA_PY_TICK", "1")
         await _run_gateway_trace(via_cli=False)
+
+
+@pytest.mark.asyncio
+class TestRuntimeFlight:
+    async def test_runtime_kinds_complete_the_timeline(self, tmp_path,
+                                                       monkeypatch):
+        """With the GIL-free engine runtime owning the commit path (a
+        persistence-free TCP cluster), the merged flight timeline must
+        still carry the full lifecycle PLUS the runtime's own kinds —
+        rt_wake (thread wakeups) and rt_handoff (mailbox events) — and
+        they must survive into a dump file."""
+        import json as _json
+
+        from rabia_tpu.apps import make_sharded_kv
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.core.config import RabiaConfig, TcpNetworkConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.types import Command, CommandBatch, NodeId
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.native.build import load_runtime
+        from rabia_tpu.net.tcp import TcpNetwork
+
+        if load_runtime() is None:
+            pytest.skip("native runtime library unavailable")
+        ids = [NodeId.from_int(i + 1) for i in range(3)]
+        nets = [TcpNetwork(i, TcpNetworkConfig(bind_port=0)) for i in ids]
+        for i in range(3):
+            for j in range(3):
+                if i != j:
+                    nets[i].add_peer(ids[j], "127.0.0.1", nets[j].port)
+        cfg = RabiaConfig(
+            phase_timeout=2.0, heartbeat_interval=0.05
+        ).with_kernel(num_shards=2, shard_pad_multiple=2)
+        engines, tasks = [], []
+        for i, n in enumerate(ids):
+            e = RabiaEngine(
+                ClusterConfig.new(n, ids), make_sharded_kv(2)[0], nets[i],
+                config=cfg,
+            )
+            engines.append(e)
+            tasks.append(asyncio.ensure_future(e.run()))
+        try:
+            for _ in range(500):
+                await asyncio.sleep(0.01)
+                if all(
+                    [(await e.get_statistics()).has_quorum for e in engines]
+                ):
+                    break
+            e0 = engines[0]
+            assert e0._rtm is not None, "runtime inactive on a TCP cluster"
+            fut = await e0.submit_batch(
+                CommandBatch.new(
+                    [Command.new(encode_set_bin("fk", "fv"))], shard=0
+                ),
+                shard=0,
+            )
+            await asyncio.wait_for(fut, 10.0)
+            kinds = {ev["kind"] for ev in e0.flight_events()}
+            assert "rt_wake" in kinds, sorted(kinds)
+            assert "rt_handoff" in kinds, sorted(kinds)
+            # the full commit lifecycle is still present alongside
+            assert {"submit", "propose", "decide", "apply"} <= kinds
+            monkeypatch.setenv("RABIA_FLIGHT_DIR", str(tmp_path))
+            p = e0.dump_flight(reason="runtime-test")
+            doc = _json.loads(open(p).read())
+            dumped = {ev["kind"] for ev in doc["events"]}
+            assert "rt_wake" in dumped and "rt_handoff" in dumped
+        finally:
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            for n in nets:
+                await n.close()

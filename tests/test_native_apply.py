@@ -433,3 +433,69 @@ class TestEngineNativeApply:
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def test_shutdown_flushes_deferred_backlog(self, monkeypatch):
+        """Shutdown ordering, apply-plane half: a deferred backlog still
+        pending when shutdown() is called must flush synchronously
+        (apply_plane.flush_sync in the run loop's finally) BEFORE state
+        is externalized — the applied frontier reaches the decided
+        frontier on the stopped engine, with every decided V1 slot
+        applied to the state machine."""
+        monkeypatch.setenv("RABIA_APPLY_INLINE", "0")
+        from rabia_tpu.core.config import RabiaConfig
+        from rabia_tpu.core.network import ClusterConfig
+        from rabia_tpu.core.state_machine import InMemoryStateMachine
+        from rabia_tpu.core.types import Command, CommandBatch, NodeId
+        from rabia_tpu.engine import RabiaEngine
+        from rabia_tpu.net import InMemoryHub
+
+        cfg = RabiaConfig(
+            phase_timeout=2.0, heartbeat_interval=0.05,
+            round_interval=0.001,
+        ).with_kernel(num_shards=1, shard_pad_multiple=1)
+        hub = InMemoryHub()
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        sms = [InMemoryStateMachine() for _ in nodes]
+        engines = [
+            RabiaEngine(
+                ClusterConfig.new(n, nodes), sms[i], hub.register(n),
+                config=cfg,
+            )
+            for i, n in enumerate(nodes)
+        ]
+        tasks = [asyncio.ensure_future(e.run()) for e in engines]
+        try:
+            for _ in range(300):
+                await asyncio.sleep(0.01)
+                if all(
+                    [(await e.get_statistics()).has_quorum for e in engines]
+                ):
+                    break
+            futs = [
+                await engines[0].submit_batch(
+                    CommandBatch.new([Command.new(f"SET fk{i} {i}")])
+                )
+                for i in range(24)
+            ]
+            await asyncio.wait_for(asyncio.gather(*futs), 30.0)
+            e0 = engines[0]
+            # force a fresh backlog entry, then shut down IMMEDIATELY so
+            # the drain task cannot win the race: flush_sync must cover it
+            e0._apply_plane._pending.add(0)
+            await e0.shutdown()
+            assert e0._apply_plane.backlog == 0, (
+                "shutdown returned with an unflushed apply backlog"
+            )
+            decided = max(
+                (s for s in e0.rt.shards[0].decisions), default=-1
+            )
+            assert int(e0.applied_frontier()[0]) >= decided + 1 or all(
+                rec.applied
+                for rec in e0.rt.shards[0].decisions.values()
+            ), "decided slots left unapplied after shutdown flush"
+        finally:
+            for e in engines:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)

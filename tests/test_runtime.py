@@ -1,0 +1,325 @@
+"""Native engine runtime (native/runtime.cpp + engine/runtime_bridge.py).
+
+Covers: activation preconditions, scalar + block commits through the
+GIL-free io/tick thread, the zero-GIL-per-wave acceptance counter (and
+its /metrics exposure), runtime-vs-asyncio conformance on fixed
+schedules, shutdown ordering (runtime drain -> apply flush -> transport
+close) including a mid-wave shutdown that must not lose staged result
+frames, and the runtime flight-recorder kinds.
+
+The asyncio orchestration stays the semantics owner: RABIA_PY_RUNTIME=1
+forces it; scripts/fuzz_conformance.py --runtime draws fresh schedules.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from rabia_tpu.apps import make_sharded_kv
+from rabia_tpu.apps.kvstore import encode_set_bin
+from rabia_tpu.core.blocks import build_block
+from rabia_tpu.core.config import RabiaConfig, TcpNetworkConfig
+from rabia_tpu.core.network import ClusterConfig
+from rabia_tpu.core.types import Command, CommandBatch, NodeId
+from rabia_tpu.engine import RabiaEngine
+from rabia_tpu.engine.leader import slot_proposer_vec
+from rabia_tpu.net.tcp import TcpNetwork
+
+
+def _runtime_lib():
+    from rabia_tpu.native.build import load_runtime
+
+    return load_runtime()
+
+
+pytestmark = pytest.mark.skipif(
+    _runtime_lib() is None, reason="native runtime library unavailable"
+)
+
+
+async def _mk_cluster(S: int, R: int, **cfg_kw):
+    ids = [NodeId.from_int(i + 1) for i in range(R)]
+    nets = [TcpNetwork(i, TcpNetworkConfig(bind_port=0)) for i in ids]
+    for i in range(R):
+        for j in range(R):
+            if i != j:
+                nets[i].add_peer(ids[j], "127.0.0.1", nets[j].port)
+    cfg = RabiaConfig(
+        phase_timeout=cfg_kw.pop("phase_timeout", 2.0),
+        heartbeat_interval=0.05,
+        round_interval=0.002,
+    ).with_kernel(num_shards=S, shard_pad_multiple=max(1, S))
+    engines, machines, tasks = [], [], []
+    for i, n in enumerate(ids):
+        sm, ms = make_sharded_kv(S)
+        machines.append(ms)
+        e = RabiaEngine(ClusterConfig.new(n, ids), sm, nets[i], config=cfg)
+        engines.append(e)
+        tasks.append(asyncio.ensure_future(e.run()))
+    for _ in range(600):
+        await asyncio.sleep(0.01)
+        if all([(await e.get_statistics()).has_quorum for e in engines]):
+            break
+    else:
+        raise AssertionError("cluster never formed quorum")
+    return ids, nets, engines, machines, tasks
+
+
+async def _teardown(engines, tasks, nets):
+    for e in engines:
+        await e.shutdown()
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    for n in nets:
+        await n.close()
+
+
+def _own_shards(e, S: int) -> np.ndarray:
+    shard_ids = np.arange(S)
+    head = np.maximum(e.rt.next_slot[:S], e.rt.applied_upto[:S])
+    return shard_ids[
+        (slot_proposer_vec(shard_ids, head, e.R) == e.me)
+        & (e.rt.queue_len[:S] == 0)
+        & ~e.rt.in_flight[:S]
+    ]
+
+
+class TestRuntimeActivation:
+    def test_active_on_tcp_inactive_on_env(self, monkeypatch):
+        async def run():
+            _, nets, engines, _, tasks = await _mk_cluster(4, 3)
+            try:
+                assert all(e._rtm is not None for e in engines)
+                assert all(e.health()["native_runtime"] for e in engines)
+                # the transport's Python reader is detached: the runtime
+                # thread owns the inbox
+                assert all(n._reader_detached for n in nets)
+            finally:
+                await _teardown(engines, tasks, nets)
+
+        asyncio.run(run())
+
+        async def run_forced():
+            _, nets, engines, _, tasks = await _mk_cluster(4, 3)
+            try:
+                assert all(e._rtm is None for e in engines)
+            finally:
+                await _teardown(engines, tasks, nets)
+
+        monkeypatch.setenv("RABIA_PY_RUNTIME", "1")
+        asyncio.run(run_forced())
+
+    def test_inactive_on_inmemory_hub(self):
+        async def run():
+            from rabia_tpu.net import InMemoryHub
+
+            hub = InMemoryHub()
+            ids = [NodeId.from_int(i + 1) for i in range(3)]
+            engines = [
+                RabiaEngine(
+                    ClusterConfig.new(n, ids),
+                    make_sharded_kv(2)[0],
+                    hub.register(n),
+                    config=RabiaConfig().with_kernel(
+                        num_shards=2, shard_pad_multiple=2
+                    ),
+                )
+                for n in ids
+            ]
+            assert all(e._rtm is None for e in engines)
+
+        asyncio.run(run())
+
+
+class TestRuntimeCommit:
+    def test_scalar_and_block_commit_and_gil_counter(self):
+        async def run():
+            S, R = 8, 3
+            _, nets, engines, machines, tasks = await _mk_cluster(S, R)
+            try:
+                e0 = engines[0]
+                # scalar commit
+                fut = await e0.submit_batch(
+                    CommandBatch.new(
+                        [Command.new(encode_set_bin("k", "v"))], shard=1
+                    ),
+                    shard=1,
+                )
+                res = await asyncio.wait_for(fut, 10.0)
+                assert len(res) == 1 and res[0][0] == 0  # ok result frame
+                gil_before = e0._rtm.counter("gil_handoffs")
+                waves_before = e0._rtm.counter("waves_native")
+                # block-only waves on each engine's own shards: the
+                # decide->apply->result path must never take the GIL
+                for _ in range(5):
+                    futs = []
+                    for e in engines:
+                        mine = _own_shards(e, S)
+                        if len(mine) == 0:
+                            continue
+                        futs.append(
+                            await e.submit_block(
+                                build_block(
+                                    mine,
+                                    [
+                                        [encode_set_bin(f"k{int(s)}", "v")]
+                                        for s in mine
+                                    ],
+                                )
+                            )
+                        )
+                    results = await asyncio.wait_for(
+                        asyncio.gather(*futs), 20.0
+                    )
+                    for r in results:
+                        for entry in r:
+                            assert not isinstance(entry, Exception)
+                assert e0._rtm.counter("waves_native") > waves_before
+                assert e0._rtm.counter("gil_handoffs") == gil_before, (
+                    "steady-state native waves took a GIL handoff"
+                )
+                # /metrics exposure of the acceptance counter
+                snap = e0.metrics.snapshot()
+                assert snap.get("rabia_engine_native_runtime") == 1
+                assert snap.get("rabia_runtime_waves_native_total", 0) > 0
+                assert "rabia_runtime_gil_handoffs_total" in snap
+                # replica state converges
+                await asyncio.sleep(0.3)
+                want = [m.store.checksum() for m in machines[0]]
+                for _ in range(200):
+                    if all(
+                        [m.store.checksum() for m in ms] == want
+                        for ms in machines
+                    ):
+                        break
+                    await asyncio.sleep(0.01)
+                assert all(
+                    [m.store.checksum() for m in ms] == want
+                    for ms in machines
+                )
+            finally:
+                await _teardown(engines, tasks, nets)
+
+        asyncio.run(run())
+
+    def test_flight_runtime_kinds_present(self):
+        async def run():
+            S, R = 4, 3
+            _, nets, engines, _, tasks = await _mk_cluster(S, R)
+            try:
+                e0 = engines[0]
+                fut = await e0.submit_batch(
+                    CommandBatch.new(
+                        [Command.new(encode_set_bin("fk", "fv"))], shard=0
+                    ),
+                    shard=0,
+                )
+                await asyncio.wait_for(fut, 10.0)
+                kinds = {ev["kind"] for ev in e0.flight_events()}
+                assert "rt_wake" in kinds, kinds
+                assert "rt_handoff" in kinds, kinds
+                # lifecycle records still present alongside
+                assert {"submit", "propose", "decide", "apply"} <= kinds
+            finally:
+                await _teardown(engines, tasks, nets)
+
+        asyncio.run(run())
+
+
+class TestRuntimeConformance:
+    def test_fixed_schedules_match_asyncio_owner(self):
+        from rabia_tpu.testing.conformance import (
+            run_schedule_on_runtime_paths,
+        )
+
+        schedule = [
+            {0: [("a", "1")], 1: [("b", "2"), ("c", "3")]},
+            {0: [("a", "4")], 2: [("d", "5")]},
+            {1: [("b", "6")], 2: [("e", "7")], 0: [("f", "8")]},
+            {0: [("a", "9")], 1: [("g", "10")]},
+        ]
+        asyncio.run(
+            run_schedule_on_runtime_paths(
+                schedule, n_shards=3, n_replicas=3, tag="fixed-runtime"
+            )
+        )
+
+
+class TestRuntimeShutdown:
+    def test_shutdown_ordering_clean(self):
+        """Runtime drain -> apply flush -> transport close: state and
+        counters survive shutdown; the transport closes last."""
+
+        async def run():
+            S, R = 4, 3
+            _, nets, engines, machines, tasks = await _mk_cluster(S, R)
+            e0 = engines[0]
+            fut = await e0.submit_batch(
+                CommandBatch.new(
+                    [Command.new(encode_set_bin("sk", "sv"))], shard=0
+                ),
+                shard=0,
+            )
+            await asyncio.wait_for(fut, 10.0)
+            await _teardown(engines, tasks, nets)
+            # post-shutdown: frozen counters and flight stay readable
+            assert e0._rtm.counter("frames_native") > 0
+            assert len(e0.flight_events()) > 0
+            assert machines[0][0].store.get("sk").value == "sv"
+
+        asyncio.run(run())
+
+    def test_mid_wave_shutdown_keeps_staged_results(self):
+        """A decided wave whose result frames are staged in the event
+        mailbox when shutdown starts must still settle the submitter's
+        future: stop() finishes the runtime iteration and drains the
+        mailbox BEFORE the transport closes."""
+
+        async def run():
+            S, R = 8, 3
+            _, nets, engines, machines, tasks = await _mk_cluster(S, R)
+            e0 = engines[0]
+            mine = _own_shards(e0, S)
+            assert len(mine) > 0
+            fut = await e0.submit_block(
+                build_block(
+                    mine,
+                    [[encode_set_bin(f"m{int(s)}", "w")] for s in mine],
+                )
+            )
+            # push the wave command down WITHOUT letting the event loop
+            # drain the mailbox, then block the loop synchronously while
+            # the C threads decide and apply the wave — the staged
+            # results sit in the event ring when shutdown begins
+            e0._rtm.pump()
+            deadline = time.time() + 5.0
+            while (
+                e0._rtm.counter("slots_applied") < len(mine)
+                and time.time() < deadline
+            ):
+                time.sleep(0.01)  # deliberately sync: no drain can run
+            assert e0._rtm.counter("slots_applied") >= len(mine), (
+                "wave never applied natively"
+            )
+            assert not fut.done(), "future settled without a drain?"
+            await e0.shutdown()  # runtime drain happens in here
+            assert fut.done(), "mid-wave shutdown lost staged results"
+            res = fut.result()
+            assert len(res) == len(mine)
+            for entry in res:
+                assert not isinstance(entry, Exception)
+                assert len(entry) == 1 and bytes(entry[0])[0] == 0
+            for e in engines[1:]:
+                await e.shutdown()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            for n in nets:
+                await n.close()
+
+        asyncio.run(run())
