@@ -1,0 +1,648 @@
+"""Open-loop SLO load generator: offered-rate curves, not best-effort loops.
+
+The closed-loop benches (gateway_bench.py) measure what the system can
+absorb when clients politely wait; an SLO story needs the opposite — a
+Poisson arrival process that keeps offering load at a FIXED rate whether
+or not the system keeps up (the coordinated-omission-free methodology of
+Rabia's own evaluation, SOSP 2021). This driver:
+
+- runs hundreds to 10k **simulated RabiaClient sessions** over real TCP.
+  Each session is protocol-faithful (native framed transport handshake,
+  ClientHello, seq-numbered Submits, Result dispatch) but implemented on
+  plain ``asyncio.open_connection`` so one process can hold thousands of
+  concurrent sessions without a native transport instance (and its io
+  thread) per client;
+- draws arrivals from a global Poisson process at each offered rate,
+  round-robins them over the sessions, and NEVER waits for a previous
+  request before firing the next (open loop — a saturated system shows
+  up as shed/timeout rates and fat tails, not as a silently reduced
+  offered rate);
+- separates a warmup window from the measure window; only requests
+  ARRIVING inside the measure window are scored;
+- scores every request with one of: ``ok``, ``cached`` (session-dedup
+  answer), ``shed`` (admission-control RETRY), ``error`` (terminal),
+  ``timeout`` (no Result inside --call-timeout), ``overflow``
+  (client-side in-flight cap, i.e. the generator itself was saturated);
+- emits an SLO report per offered-rate point — goodput, offered vs
+  achieved rate, p50/p95/p99/p999, shed/timeout/error rates — as a
+  human table, one JSON line, a record under ``loadgen_slo`` in
+  benchmarks/results.json, and (optionally) a clock-aligned multi-replica
+  telemetry timeline dump (obs/telemetry) for the same run.
+
+Usage (defaults spin an in-process 3-replica real-TCP cluster):
+
+    python benchmarks/loadgen.py --rates 100,200,400 --sessions 200,500,1000
+    python benchmarks/loadgen.py --external h1:p1,h2:p2,h3:p3 --rates 500
+
+CI runs a short smoke cell (see .github/workflows/ci.yml, load-soak) and
+fails on an empty or schema-violating report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import random
+import struct
+import sys
+import time
+import uuid
+from pathlib import Path
+from typing import Optional, Sequence
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import os  # noqa: E402
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from rabia_tpu.core.messages import (  # noqa: E402
+    ClientHello,
+    ProtocolMessage,
+    Result,
+    ResultStatus,
+    Submit,
+)
+from rabia_tpu.core.serialization import Serializer  # noqa: E402
+from rabia_tpu.core.types import NodeId  # noqa: E402
+
+REPORT_VERSION = 1
+
+OUTCOMES = ("ok", "cached", "shed", "error", "timeout", "overflow")
+
+
+class LoadSession:
+    """One protocol-faithful simulated RabiaClient session.
+
+    Speaks the native transport wire protocol directly: 16-byte node-id
+    handshake (the session's client_id IS its transport identity — the
+    gateway authenticates every frame against it), then
+    ``[u32 LE length][payload]`` frames. No retransmit machinery: the
+    link is TCP and the gateway answers every Submit (sheds answer
+    immediately), so a missing Result inside the call timeout is scored
+    as ``timeout`` — exactly the client-observed SLO violation an
+    open-loop run is supposed to surface."""
+
+    __slots__ = (
+        "client_id", "node_id", "ser", "reader", "writer", "gateway",
+        "_seq", "pending", "_read_task", "_hello",
+    )
+
+    def __init__(self, ser: Serializer) -> None:
+        self.client_id = uuid.uuid4()
+        self.node_id = NodeId(self.client_id)
+        self.ser = ser
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.gateway: Optional[NodeId] = None
+        self._seq = 0
+        self.pending: dict[int, asyncio.Future] = {}
+        self._read_task: Optional[asyncio.Task] = None
+        self._hello: Optional[asyncio.Future] = None
+
+    async def connect(self, host: str, port: int, timeout: float = 10.0):
+        self.reader, self.writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout
+        )
+        self.writer.write(self.client_id.bytes)
+        peer = await asyncio.wait_for(self.reader.readexactly(16), timeout)
+        self.gateway = NodeId(uuid.UUID(bytes=peer))
+        self._read_task = asyncio.ensure_future(self._read_loop())
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while True:
+            self._hello = loop.create_future()
+            self._send(ClientHello(client_id=self.client_id))
+            try:
+                await asyncio.wait_for(
+                    self._hello, min(0.5, max(0.05, deadline - loop.time()))
+                )
+                return self
+            except asyncio.TimeoutError:
+                if loop.time() >= deadline:
+                    raise TimeoutError(
+                        f"session hello to {host}:{port} timed out"
+                    ) from None
+
+    def _send(self, payload) -> None:
+        data = self.ser.serialize(
+            ProtocolMessage.new(self.node_id, payload, self.gateway)
+        )
+        self.writer.write(struct.pack("<I", len(data)) + data)
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                hdr = await self.reader.readexactly(4)
+                (ln,) = struct.unpack("<I", hdr)
+                data = await self.reader.readexactly(ln)
+                try:
+                    msg = self.ser.deserialize(data)
+                except Exception:
+                    continue
+                p = msg.payload
+                if isinstance(p, ClientHello) and p.ack:
+                    if self._hello is not None and not self._hello.done():
+                        self._hello.set_result(p)
+                elif isinstance(p, Result):
+                    fut = self.pending.get(p.seq)
+                    if fut is not None and not fut.done():
+                        fut.set_result(p)
+        except (asyncio.IncompleteReadError, asyncio.CancelledError,
+                ConnectionError, OSError):
+            return
+
+    async def submit(
+        self, shard: int, commands: Sequence[bytes], timeout: float
+    ) -> Result:
+        self._seq += 1
+        seq = self._seq
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self.pending[seq] = fut
+        try:
+            self._send(
+                Submit(
+                    client_id=self.client_id, seq=seq, shard=shard,
+                    commands=tuple(commands), ack_upto=max(0, seq - 64),
+                )
+            )
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self.pending.pop(seq, None)
+
+    async def close(self) -> None:
+        if self._read_task is not None:
+            self._read_task.cancel()
+            try:
+                await self._read_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self.writer is not None:
+            try:
+                self.writer.close()
+                await self.writer.wait_closed()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# One offered-rate point
+# ---------------------------------------------------------------------------
+
+
+def _percentile(sorted_ms: list[float], q: float) -> Optional[float]:
+    if not sorted_ms:
+        return None
+    i = min(len(sorted_ms) - 1, max(0, math.ceil(q * len(sorted_ms)) - 1))
+    return round(sorted_ms[i], 3)
+
+
+async def run_point(
+    endpoints: Sequence[tuple[str, int]],
+    rate: float,
+    n_sessions: int,
+    warmup: float,
+    measure: float,
+    batch: int,
+    n_shards: int,
+    call_timeout: float,
+    inflight_cap: int,
+    seed: int,
+    connect_parallel: int = 64,
+) -> dict:
+    """Drive one open-loop point and return its SLO report entry."""
+    from rabia_tpu.apps.kvstore import encode_set_bin
+
+    ser = Serializer()
+    rng = random.Random(seed)
+    sessions: list[LoadSession] = []
+    sem = asyncio.Semaphore(connect_parallel)
+
+    async def dial(i: int) -> LoadSession:
+        # retry-or-skip per session: at the tool's stated scale a
+        # handshake burst is expected to overflow listen backlogs now
+        # and then, and one refused SYN must cost one session, not the
+        # whole curve (and must not leak the sessions already connected)
+        async with sem:
+            last_exc: Exception = RuntimeError("no dial attempt ran")
+            for attempt in range(3):
+                s = LoadSession(ser)
+                ep = endpoints[i % len(endpoints)]
+                try:
+                    await s.connect(*ep)
+                    return s
+                except Exception as e:
+                    last_exc = e
+                    await s.close()
+                    await asyncio.sleep(0.05 * (attempt + 1))
+            raise last_exc
+
+    t_dial = time.perf_counter()
+    dialed = await asyncio.gather(
+        *(dial(i) for i in range(n_sessions)), return_exceptions=True
+    )
+    sessions = [s for s in dialed if isinstance(s, LoadSession)]
+    n_failed = len(dialed) - len(sessions)
+    if n_failed:
+        print(
+            f"# {n_failed}/{n_sessions} session dials failed after "
+            f"retries; driving the surviving {len(sessions)}",
+            file=sys.stderr,
+        )
+    if not sessions:
+        raise RuntimeError(
+            f"all {n_sessions} session dials failed: {dialed[0]!r}"
+        )
+    n_sessions = len(sessions)
+    dial_s = time.perf_counter() - t_dial
+
+    counts = {k: 0 for k in OUTCOMES}
+    lat_ok_ms: list[float] = []
+    arrivals_measured = 0
+    inflight = 0
+    fires: set[asyncio.Task] = set()
+    loop = asyncio.get_event_loop()
+    t0 = loop.time()
+    t_measure = t0 + warmup
+    t_end = t_measure + measure
+
+    async def fire(
+        sess: LoadSession, i: int, in_window: bool, arrived: float
+    ) -> None:
+        nonlocal inflight
+        key = f"s{i % 4096}"
+        cmds = [
+            encode_set_bin(f"{key}-{j}", "v" * 8) for j in range(batch)
+        ]
+        # latency is scored from the Poisson ARRIVAL time, not from when
+        # this task first ran: under saturation the event loop itself
+        # queues work, and excluding that delay would reintroduce the
+        # coordinated omission this driver exists to eliminate. (The
+        # call timeout still arms at send — it is the wire-call SLA.)
+        start = arrived
+        outcome = "error"
+        try:
+            res = await sess.submit(i % n_shards, cmds, call_timeout)
+            if res.status == ResultStatus.OK:
+                outcome = "ok"
+            elif res.status == ResultStatus.CACHED:
+                outcome = "cached"
+            elif res.status == ResultStatus.RETRY:
+                outcome = "shed"
+            else:
+                outcome = "error"
+        except asyncio.TimeoutError:
+            outcome = "timeout"
+        except asyncio.CancelledError:
+            # cancelled at the drain cutoff: by construction this call
+            # already exceeded call_timeout, i.e. a client-observed SLO
+            # violation — dropping it from every bucket would be a
+            # coordinated-omission leak at exactly the overload points
+            # the tool exists to measure
+            outcome = "timeout"
+        except Exception:
+            outcome = "error"
+        finally:
+            inflight -= 1
+        if in_window:
+            counts[outcome] += 1
+            if outcome in ("ok", "cached"):
+                lat_ok_ms.append((loop.time() - start) * 1e3)
+
+    i = 0
+    next_at = t0
+    # the loop is keyed on the arrival SCHEDULE, not the clock: every
+    # arrival scheduled before t_end is dispatched (or counted as
+    # overflow) even when the generator wakes up past t_end — dropping
+    # the backlog would shrink the offered-rate denominator exactly when
+    # the host is saturated, the coordinated-omission class this driver
+    # exists to eliminate. Late dispatches still score from their
+    # scheduled arrival time (`arrived`), so the lateness shows up in
+    # the tail instead of vanishing.
+    while next_at < t_end:
+        now = loop.time()
+        if next_at > now:
+            await asyncio.sleep(min(next_at - now, 0.05))
+            continue
+        # one Poisson arrival (possibly several per wakeup when behind)
+        arrived = next_at
+        in_window = next_at >= t_measure
+        next_at += rng.expovariate(rate)
+        sess = sessions[i % n_sessions]
+        if inflight >= inflight_cap:
+            # the GENERATOR is saturated: record the arrival as overflow
+            # instead of silently closing the loop (open-loop honesty)
+            if in_window:
+                counts["overflow"] += 1
+                arrivals_measured += 1
+            i += 1
+            continue
+        inflight += 1
+        if in_window:
+            arrivals_measured += 1
+        t = asyncio.ensure_future(fire(sess, i, in_window, arrived))
+        fires.add(t)
+        t.add_done_callback(fires.discard)
+        i += 1
+
+    # drain stragglers fired inside the window (bounded by call_timeout)
+    if fires:
+        await asyncio.wait(fires, timeout=call_timeout + 1.0)
+    leftovers = list(fires)
+    for t in leftovers:
+        t.cancel()
+    if leftovers:
+        # let the cancelled fires run their accounting (they score as
+        # timeouts) before the counts below are read
+        await asyncio.gather(*leftovers, return_exceptions=True)
+
+    await asyncio.gather(
+        *(s.close() for s in sessions), return_exceptions=True
+    )
+
+    completed = sum(counts[k] for k in ("ok", "cached", "shed", "error"))
+    good = counts["ok"] + counts["cached"]
+    lat_ok_ms.sort()
+    denom = max(1, arrivals_measured)
+    return {
+        "offered_rps": rate,
+        "sessions": n_sessions,
+        "arrivals": arrivals_measured,
+        "completed": completed,
+        "achieved_rps": round(completed / measure, 1),
+        "goodput_rps": round(good / measure, 1),
+        "ok": counts["ok"],
+        "cached": counts["cached"],
+        "shed": counts["shed"],
+        "error": counts["error"],
+        "timeout": counts["timeout"],
+        "overflow": counts["overflow"],
+        "shed_rate": round(counts["shed"] / denom, 4),
+        "timeout_rate": round(counts["timeout"] / denom, 4),
+        "error_rate": round(counts["error"] / denom, 4),
+        "p50_ms": _percentile(lat_ok_ms, 0.50),
+        "p95_ms": _percentile(lat_ok_ms, 0.95),
+        "p99_ms": _percentile(lat_ok_ms, 0.99),
+        "p999_ms": _percentile(lat_ok_ms, 0.999),
+        "max_ms": round(lat_ok_ms[-1], 3) if lat_ok_ms else None,
+        "warmup_s": warmup,
+        "measure_s": measure,
+        "session_dial_s": round(dial_s, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Report schema + rendering (tests and the CI smoke gate validate this)
+# ---------------------------------------------------------------------------
+
+_POINT_REQUIRED = (
+    "offered_rps", "sessions", "arrivals", "completed", "achieved_rps",
+    "goodput_rps", "shed_rate", "timeout_rate", "error_rate",
+    "p50_ms", "p99_ms", "p999_ms",
+)
+
+
+def validate_report(report: dict) -> list[str]:
+    """Schema + sanity check of a loadgen report; returns a list of
+    problems (empty = valid). The CI smoke cell fails the build on any
+    problem — an empty or garbled SLO report must never look green."""
+    problems = []
+    if report.get("version") != REPORT_VERSION:
+        problems.append(f"bad version: {report.get('version')!r}")
+    if report.get("benchmark") != "loadgen_slo":
+        problems.append(f"bad benchmark tag: {report.get('benchmark')!r}")
+    points = report.get("points")
+    if not isinstance(points, list) or not points:
+        return problems + ["no offered-rate points"]
+    for i, pt in enumerate(points):
+        for k in _POINT_REQUIRED:
+            if k not in pt:
+                problems.append(f"point {i}: missing {k}")
+        if pt.get("arrivals", 0) <= 0:
+            problems.append(f"point {i}: no measured arrivals")
+        if pt.get("completed", 0) <= 0:
+            problems.append(f"point {i}: nothing completed")
+        if (pt.get("goodput_rps") or 0) <= 0:
+            problems.append(f"point {i}: zero goodput")
+        if pt.get("p50_ms") is None:
+            problems.append(f"point {i}: no latency samples")
+    return problems
+
+
+def render_table(report: dict) -> str:
+    head = (
+        f"{'offered/s':>10} {'sessions':>8} {'goodput/s':>10} "
+        f"{'achieved/s':>10} {'p50 ms':>8} {'p99 ms':>8} {'p999 ms':>8} "
+        f"{'shed%':>6} {'tmo%':>6} {'err%':>6}"
+    )
+    lines = [head, "-" * len(head)]
+    for pt in report["points"]:
+        lines.append(
+            f"{pt['offered_rps']:>10.0f} {pt['sessions']:>8d} "
+            f"{pt['goodput_rps']:>10.1f} {pt['achieved_rps']:>10.1f} "
+            f"{pt['p50_ms'] if pt['p50_ms'] is not None else float('nan'):>8.1f} "
+            f"{pt['p99_ms'] if pt['p99_ms'] is not None else float('nan'):>8.1f} "
+            f"{pt['p999_ms'] if pt['p999_ms'] is not None else float('nan'):>8.1f} "
+            f"{pt['shed_rate'] * 100:>6.2f} {pt['timeout_rate'] * 100:>6.2f} "
+            f"{pt['error_rate'] * 100:>6.2f}"
+        )
+    return "\n".join(lines)
+
+
+def record_results(report: dict, key: str = "loadgen_slo") -> None:
+    """Merge the report into benchmarks/results.json under ``key``
+    (latest run per key, the sweep_metrics convention)."""
+    path = Path(__file__).resolve().parent / "results.json"
+    doc = {}
+    if path.exists():
+        try:
+            doc = json.loads(path.read_text())
+        except ValueError:
+            doc = {}
+    doc[key] = report
+    path.write_text(json.dumps(doc, indent=1))
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+async def _in_process_timeline(cluster) -> list[dict]:
+    """Merge the in-process cluster's telemetry rings (same clock
+    domain: exact alignment, zero error bound)."""
+    from rabia_tpu.obs.telemetry import merge_timelines
+
+    docs = []
+    for g in cluster.gateways:
+        if g._telemetry is None:
+            continue
+        g._telemetry.sample()  # cover the run's last instant
+        doc = g._telemetry.document()
+        doc["offset_s"] = doc["wall"] - doc["mono_ns"] * 1e-9
+        doc["err_s"] = 0.0
+        docs.append(doc)
+    return merge_timelines(docs) if docs else []
+
+
+async def run(args) -> dict:
+    rates = [float(r) for r in args.rates.split(",") if r]
+    sess_list = [int(s) for s in args.sessions.split(",") if s]
+    if len(sess_list) == 1:
+        sess_list = sess_list * len(rates)
+    if len(sess_list) != len(rates):
+        raise SystemExit("--sessions must be one value or match --rates")
+
+    cluster = None
+    if args.external:
+        endpoints = []
+        for a in args.external.split(","):
+            host, _, port = a.rpartition(":")
+            endpoints.append((host, int(port)))
+    else:
+        from rabia_tpu.gateway import GatewayConfig
+        from rabia_tpu.testing.gateway_cluster import GatewayCluster
+
+        cluster = GatewayCluster(
+            n_replicas=args.replicas,
+            n_shards=args.shards,
+            gateway_config=GatewayConfig(
+                max_inflight_per_session=args.session_window,
+                max_queue_depth=args.queue_depth,
+            ),
+        )
+        await cluster.start()
+        endpoints = [
+            ("127.0.0.1", g.port) for g in cluster.gateways
+        ]
+
+    points = []
+    try:
+        for rate, n_sess in zip(rates, sess_list):
+            print(
+                f"# point: offered {rate:.0f}/s, {n_sess} sessions "
+                f"(warmup {args.warmup}s, measure {args.measure}s)",
+                file=sys.stderr,
+            )
+            pt = await run_point(
+                endpoints,
+                rate=rate,
+                n_sessions=n_sess,
+                warmup=args.warmup,
+                measure=args.measure,
+                batch=args.batch,
+                n_shards=args.shards,
+                call_timeout=args.call_timeout,
+                inflight_cap=args.inflight_cap or n_sess * 8,
+                seed=args.seed,
+            )
+            points.append(pt)
+            print(json.dumps(pt), file=sys.stderr)
+        timeline_rows = None
+        if cluster is not None and args.timeline_out:
+            timeline_rows = await _in_process_timeline(cluster)
+            Path(args.timeline_out).write_text(
+                json.dumps({"version": 1, "rows": timeline_rows})
+            )
+            print(
+                f"# timeline: {len(timeline_rows)} samples -> "
+                f"{args.timeline_out}",
+                file=sys.stderr,
+            )
+    finally:
+        if cluster is not None:
+            await cluster.stop()
+
+    report = {
+        "version": REPORT_VERSION,
+        "benchmark": "loadgen_slo",
+        "ts": time.time(),
+        "config": {
+            "replicas": args.replicas if not args.external else None,
+            "shards": args.shards,
+            "batch": args.batch,
+            "warmup_s": args.warmup,
+            "measure_s": args.measure,
+            "call_timeout_s": args.call_timeout,
+            "transport": "native-tcp"
+            if not args.external
+            else "external",
+            "open_loop": "poisson",
+            "seed": args.seed,
+        },
+        "points": points,
+    }
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=(__doc__ or "").split("\n")[0])
+    ap.add_argument(
+        "--rates", default="100,200,400",
+        help="comma list of offered request rates (req/s), one point each",
+    )
+    ap.add_argument(
+        "--sessions", default="256",
+        help="comma list of concurrent session counts (one value "
+        "broadcasts to every point)",
+    )
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=1,
+                    help="commands per submit")
+    ap.add_argument("--warmup", type=float, default=3.0)
+    ap.add_argument("--measure", type=float, default=10.0)
+    ap.add_argument("--call-timeout", type=float, default=10.0)
+    ap.add_argument(
+        "--inflight-cap", type=int, default=0,
+        help="client-side total in-flight cap (0 = sessions*8); beyond "
+        "it arrivals score as overflow",
+    )
+    ap.add_argument("--session-window", type=int, default=64)
+    ap.add_argument("--queue-depth", type=int, default=1024)
+    ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument(
+        "--external", default=None,
+        help="comma list of gateway host:port to drive instead of an "
+        "in-process cluster",
+    )
+    ap.add_argument(
+        "--out", default=None,
+        help="write the report JSON to this file as well",
+    )
+    ap.add_argument(
+        "--timeline-out", default=None,
+        help="dump the cluster's merged telemetry timeline here "
+        "(in-process cluster only)",
+    )
+    ap.add_argument(
+        "--results-key", default=None,
+        help="also record under this key in benchmarks/results.json",
+    )
+    args = ap.parse_args(argv)
+
+    report = asyncio.run(run(args))
+    print(render_table(report))
+    print(json.dumps(report))
+    if args.out:
+        # --out is written even for invalid reports: it is the CI
+        # failure artifact, the evidence of WHY the run was rejected
+        Path(args.out).write_text(json.dumps(report, indent=1))
+    problems = validate_report(report)
+    if problems:
+        # validate BEFORE record_results: an invalid run must not
+        # clobber a previously recorded acceptance curve in
+        # benchmarks/results.json on its way to a red exit code
+        print("loadgen: INVALID SLO REPORT:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    if args.results_key:
+        record_results(report, key=args.results_key)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,10 @@ Usage:
         --journal-kind slow_tick --last 10
     python -m rabia_tpu trace <host:port> [host:port ...] \\
         --client <uuid> --seq <n>          # cross-replica commit timeline
+    python -m rabia_tpu profile <host:port> [--seconds 2]
+                                           # runtime stage breakdown
+    python -m rabia_tpu timeline <host:port> [host:port ...] \\
+        [--last N] [--metric SUBSTR ...]   # per-second telemetry curves
 """
 
 from __future__ import annotations
@@ -208,6 +212,116 @@ def _trace(addrs: list[str], client: str, seq: int, timeout: float) -> int:
     return 0
 
 
+def _profile(addr: str, seconds: float, timeout: float) -> int:
+    """Two /metrics scrapes ``seconds`` apart -> the commit-path owner's
+    per-stage time breakdown (rabia_runtime_stage_seconds deltas), with
+    a coverage figure against the elapsed wall time between scrapes —
+    "where did the wall move" as a scrape, not a guess. Works identically
+    on the native runtime thread (RTS block) and the asyncio
+    orchestration (loop accounting): same metric family either way."""
+    import asyncio
+    import time as _time
+
+    from rabia_tpu.core.messages import AdminKind
+    from rabia_tpu.gateway import admin_fetch
+    from rabia_tpu.obs.registry import RUNTIME_STAGES, parse_prometheus_text
+
+    parsed = _parse_addr(addr)
+    if parsed is None:
+        print(f"profile: bad address {addr!r} (want host:port)",
+              file=sys.stderr)
+        return 2
+    host, port = parsed
+
+    def scrape() -> tuple[dict, float]:
+        body = asyncio.run(
+            admin_fetch(host, port, int(AdminKind.METRICS), timeout=timeout)
+        )
+        return parse_prometheus_text(body.decode(errors="replace")), \
+            _time.monotonic()
+
+    def stage_of(m: dict, stage: str) -> float:
+        return m.get(
+            f'rabia_runtime_stage_seconds{{stage="{stage}"}}', 0.0
+        )
+
+    try:
+        m0, t0 = scrape()
+        _time.sleep(max(0.2, seconds))
+        m1, t1 = scrape()
+    except Exception as e:
+        print(f"profile: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if not any(
+        k.startswith("rabia_runtime_stage_seconds") for k in m1
+    ):
+        print("profile: replica exports no rabia_runtime_stage_seconds "
+              "(pre-SLO-plane build?)", file=sys.stderr)
+        return 1
+    elapsed = t1 - t0
+    deltas = {s: stage_of(m1, s) - stage_of(m0, s) for s in RUNTIME_STAGES}
+    total = sum(deltas.values())
+    planes = 1.0 if m1.get("rabia_engine_native_runtime", 0.0) else 0.0
+    print(
+        f"runtime stage profile over {elapsed:.2f}s "
+        f"(commit-path owner: "
+        f"{'native runtime thread' if planes else 'asyncio loop'})"
+    )
+    print(f"{'stage':<16}{'time (s)':>12}{'share':>9}{'cumulative (s)':>17}")
+    for s in sorted(RUNTIME_STAGES, key=lambda x: -deltas[x]):
+        share = deltas[s] / elapsed * 100 if elapsed > 0 else 0.0
+        print(f"{s:<16}{deltas[s]:>12.4f}{share:>8.1f}%"
+              f"{stage_of(m1, s):>17.3f}")
+    cov = total / elapsed * 100 if elapsed > 0 else 0.0
+    print(f"{'-- sum':<16}{total:>12.4f}{cov:>8.1f}%  of wall between scrapes")
+    return 0
+
+
+def _timeline(
+    addrs: list[str],
+    last: int | None,
+    metrics: list[str] | None,
+    as_json: bool,
+    out: str | None,
+    timeout: float,
+) -> int:
+    """Fetch every replica's per-second telemetry ring, clock-align them
+    (RTT-midpoint offsets, the flight-recorder model) and print one
+    merged multi-replica time series."""
+    import asyncio
+    import json
+
+    from rabia_tpu.obs.telemetry import (
+        collect_timeline,
+        render_timeline_table,
+    )
+
+    parsed = []
+    for a in addrs:
+        p = _parse_addr(a)
+        if p is None:
+            print(f"timeline: bad address {a!r} (want host:port)",
+                  file=sys.stderr)
+            return 2
+        parsed.append(p)
+    try:
+        rows = asyncio.run(
+            collect_timeline(parsed, last=last, timeout=timeout)
+        )
+    except Exception as e:
+        print(f"timeline: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if out:
+        with open(out, "w") as f:
+            json.dump({"version": 1, "rows": rows}, f)
+        print(f"timeline: {len(rows)} samples -> {out}", file=sys.stderr)
+    if as_json:
+        print(json.dumps(rows))
+    else:
+        print(render_timeline_table(rows, metrics=metrics or None))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m rabia_tpu",
@@ -250,6 +364,42 @@ def main(argv=None) -> int:
         "--seq", type=int, required=True, help="client command seq"
     )
     tp.add_argument("--timeout", type=float, default=10.0)
+    pp = sub.add_parser(
+        "profile",
+        help="two-scrape runtime stage breakdown (where a commit-path "
+        "second actually goes)",
+    )
+    pp.add_argument("addr", help="gateway host:port")
+    pp.add_argument(
+        "--seconds", type=float, default=2.0,
+        help="window between the two /metrics scrapes",
+    )
+    pp.add_argument("--timeout", type=float, default=10.0)
+    tl = sub.add_parser(
+        "timeline",
+        help="merge per-second telemetry rings from every replica into "
+        "one clock-aligned time series",
+    )
+    tl.add_argument(
+        "addrs", nargs="+",
+        help="gateway host:port (one per replica to include)",
+    )
+    tl.add_argument(
+        "--last", type=int, default=None,
+        help="only the last N samples per replica",
+    )
+    tl.add_argument(
+        "--metric", action="append", default=None,
+        help="metric column (substring-matched against snapshot keys, "
+        "matches summed; repeatable)",
+    )
+    tl.add_argument(
+        "--json", action="store_true", help="print merged rows as JSON"
+    )
+    tl.add_argument(
+        "--out", default=None, help="also write merged rows to this file"
+    )
+    tl.add_argument("--timeout", type=float, default=10.0)
     args = ap.parse_args(argv)
     if args.cmd == "stats":
         return _stats(
@@ -258,6 +408,13 @@ def main(argv=None) -> int:
         )
     if args.cmd == "trace":
         return _trace(args.addrs, args.client, args.seq, args.timeout)
+    if args.cmd == "profile":
+        return _profile(args.addr, args.seconds, args.timeout)
+    if args.cmd == "timeline":
+        return _timeline(
+            args.addrs, args.last, args.metric, args.json, args.out,
+            args.timeout,
+        )
     rc = _report()
     if rc == 0 and args.selftest:
         rc = _selftest()

@@ -426,6 +426,11 @@ class AdminKind(enum.IntEnum):
     # directly ({"batch": hex}); the response body is the replica's
     # flight-ring slice for that batch (obs/flight.build_trace_slice)
     TRACE = 3
+    # per-second telemetry ring (obs/telemetry.TelemetrySampler): query
+    # {"last": N} bounds the reply; the body carries timestamped registry
+    # snapshots plus the serve-time (wall, mono_ns) pair the collector
+    # clock-aligns with (`python -m rabia_tpu timeline`)
+    TIMELINE = 4
 
 
 @dataclass(frozen=True)

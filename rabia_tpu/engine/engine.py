@@ -771,6 +771,57 @@ class RabiaEngine:
                 "submit_apply",
             )
         }
+        # -- SLO evidence plane (docs/OBSERVABILITY.md, "SLO histograms"
+        #    + "Runtime stage profiler"). Both families are registered on
+        #    EVERY runtime path with the same names and label sets —
+        #    native contributions ride the runtime's RTH_*/RTS_* blocks
+        #    (zero-copy at scrape time), Python-path contributions ride
+        #    local observes/tallies, and each path leaves the other's
+        #    source at zero, so the conformance story stays counter-parity
+        #    shaped. rabia_slo_seconds{stage=submit_result} is fed by the
+        #    gateway (Python on both paths). -------------------------------
+        from rabia_tpu.obs.registry import (
+            RUNTIME_STAGES,
+            SLO_BUCKETS,
+            SLO_STAGES,
+        )
+
+        def rtm_hist(stage):
+            rtm = self._rtm
+            return rtm.hist_stage(stage) if rtm is not None else None
+
+        self._h_slo = {
+            stage: m.histogram(
+                "slo_seconds",
+                "SLO latency histograms by pipeline stage "
+                "(log-bucketed; native RTH block + Python observes)",
+                {"stage": stage},
+                buckets=SLO_BUCKETS,
+                fn=(
+                    (lambda s=stage: rtm_hist(s))
+                    if stage in ("decide_apply", "broadcast")
+                    else None
+                ),
+            )
+            for stage in SLO_STAGES
+        }
+        # runtime stage profiler: cumulative seconds per commit-path-owner
+        # loop stage. While the native runtime owns the commit path its
+        # RTS block is the source; on the asyncio orchestration the run
+        # loop accounts the same stage names (self._stage_ns) — summed per
+        # scrape, the breakdown covers the owner thread's wall time.
+        self._stage_ns = {s: 0 for s in RUNTIME_STAGES}
+        self._stage_acc = 0
+        self._loop_mark = 0
+        self._bcast_carve = 0
+        for sname in RUNTIME_STAGES:
+            m.counter(
+                "runtime_stage_seconds",
+                "Commit-path owner loop time by stage (native RTS block "
+                "or asyncio-loop accounting; `rabia_tpu profile` renders)",
+                {"stage": sname},
+                fn=lambda s=sname: self.stage_second(s),
+            )
         # -- transport (native counter block, when the transport has one)
         tc = getattr(self.transport, "transport_counters", None)
         if callable(tc):
@@ -793,6 +844,20 @@ class RabiaEngine:
             "active_nodes": len(self.rt.active_nodes),
             "native_tick": self._rk is not None,
             "native_runtime": self._rtm is not None,
+            # active planes (runtime|tick|apply: native|python) — the
+            # same ground truth the bench sweep lines record, so a
+            # scrape can tell which path a replica is ACTUALLY on
+            # (an env toggle or a silent native-build failure both
+            # read as "python" here)
+            "planes": {
+                "runtime": "native" if self._rtm is not None else "python",
+                "tick": "native" if self._rk is not None else "python",
+                "apply": (
+                    "native"
+                    if getattr(self.sm, "_native_plane", None) is not None
+                    else "python"
+                ),
+            },
             "decided_frontier": self.decided_frontier().tolist(),
             "applied_frontier": self.applied_frontier().tolist(),
             "pending_batches": self.pending_queue_depth(),
@@ -1197,6 +1262,31 @@ class RabiaEngine:
                 # spurious wake later) or sets the event and cuts the
                 # idle wait short — a wake can never be lost
                 self._wake.clear()
+                # stage profiler (asyncio-owner half): while the native
+                # runtime owns the commit path its RTS block is the
+                # source and this loop is control plane — account only
+                # when the asyncio orchestration IS the owner, so the
+                # exported breakdown never double-counts two threads.
+                # The remainder between consecutive loop tops (yields,
+                # journal writes, listener dispatch) lands in "other",
+                # so the stage sum tracks the loop's wall time — the
+                # same contract as the native RTS block.
+                py_owner = self._rtm is None
+                now0 = time.perf_counter_ns()
+                if py_owner:
+                    if self._loop_mark:
+                        rem = now0 - self._loop_mark - self._stage_acc
+                        if rem > 0:
+                            self._stage_ns["other"] += rem
+                    self._loop_mark = now0
+                    self._stage_acc = 0
+                    # a broadcast issued from a spawned task BETWEEN
+                    # brackets (e.g. a sync request) credits "broadcast"
+                    # and excludes itself from "other" via _stage_acc,
+                    # but has no enclosing bracket to carve from — drop
+                    # the pending carve so it can't dock the next
+                    # iteration's first bracketed stage
+                    self._bcast_carve = 0
                 t_tick = time.perf_counter()
                 if self._rtm is not None:
                     progressed = self._runtime_tick()
@@ -1208,14 +1298,20 @@ class RabiaEngine:
                     self.journal.record(
                         self.journal.SLOW_TICK, dt_ms=round(dt_tick * 1e3, 2)
                     )
+                t_per = time.perf_counter_ns()
                 await self._periodic()
+                if py_owner:
+                    self._stg("timers", time.perf_counter_ns() - t_per)
                 if progressed or self._restep:
                     # busy: yield to peers/transport, then loop again
                     await asyncio.sleep(0)
                     continue
                 # returns on wake OR timeout (timer check: heartbeats,
                 # phase timeouts) — no exception either way
+                t_idle = time.perf_counter_ns()
                 await self._wake.wait(self._idle_wait())
+                if py_owner:
+                    self._stg("idle", time.perf_counter_ns() - t_idle)
         except Exception:
             # unclean shutdown: the run loop died on an exception — dump
             # the flight rings while the evidence is still in the window
@@ -1251,6 +1347,49 @@ class RabiaEngine:
                 await self._save_state()
             self.rt.is_active = False
             self._stopped.set()
+
+    def stage_second(self, name: str) -> float:
+        """Cumulative seconds the commit-path owner spent in one loop
+        stage (native RTS block + asyncio-loop accounting — each path
+        leaves the other's source at zero)."""
+        ns = self._stage_ns.get(name, 0)
+        rtm = self._rtm
+        if rtm is not None:
+            ns += rtm.stage_ns(name)
+        return ns * 1e-9
+
+    def stage_seconds(self) -> dict[str, float]:
+        """The full ``rabia_runtime_stage_seconds`` breakdown as a dict
+        (the serial-latency budget gate prints this on failure so an
+        ambient-load flake carries its own diagnosis)."""
+        from rabia_tpu.obs.registry import RUNTIME_STAGES
+
+        return {s: self.stage_second(s) for s in RUNTIME_STAGES}
+
+    def _stg(self, name: str, ns: int) -> None:
+        """Asyncio-owner stage accounting: one named section's duration
+        (kept with a per-iteration accumulator so the run loop can
+        attribute the remainder to ``other`` — the stage sum tracks the
+        owner loop's wall time, same contract as the native RTS block)."""
+        if self._bcast_carve:
+            # wire-staging time already credited to "broadcast" by
+            # _stg_bcast happened inside this bracket — carve it out so
+            # the enclosing stage doesn't count it twice
+            ns = max(0, ns - self._bcast_carve)
+            self._bcast_carve = 0
+        self._stage_ns[name] += ns
+        self._stage_acc += ns
+
+    def _stg_bcast(self, ns: int) -> None:
+        """Broadcast staging observed inside another stage's bracket
+        (kernel outbox under "tick", heartbeats under "timers"): credit
+        the broadcast stage directly and leave the same ns pending for
+        _stg to subtract from the enclosing bracket — without this the
+        asyncio profile prints broadcast=0 while "tick" silently absorbs
+        the wire-staging time the native RTS block reports separately."""
+        self._stage_ns["broadcast"] += ns
+        self._stage_acc += ns
+        self._bcast_carve += ns
 
     def _runtime_tick(self) -> bool:
         """One control-plane pass while the native runtime owns the
@@ -1296,13 +1435,17 @@ class RabiaEngine:
 
     async def _tick(self) -> bool:
         self._tick_count += 1
+        pcns = time.perf_counter_ns
+        t0 = pcns()
         with span("engine.tick.drain"):
             got_msgs = await self._drain_messages()
+        self._stg("ingest", pcns() - t0)
         if self._paused:
             # quorum lost: consensus paused (engine.rs:983-997). Inbound
             # traffic above still adopts Decisions / answers sync, so a
             # healed minority catches up passively before resuming.
             return False
+        t0 = pcns()
         with span("engine.tick.open"):
             self._forward_submissions()
             bulk = self._open_block_slots()
@@ -1321,10 +1464,15 @@ class RabiaEngine:
             with span("engine.tick.kernel"):
                 await self._kernel_round(opened, bulk)
             stepped = True
+        self._stg("tick", pcns() - t0)  # open collection + kernel round
+        t0 = pcns()
         with span("engine.tick.apply"):
             applied = self._apply_ready()
+        self._stg("apply", pcns() - t0)
+        t0 = pcns()
         with span("engine.tick.timeouts"):
             self._check_timeouts()
+        self._stg("timers", pcns() - t0)
         if applied and self.persistence is not None:
             self._dirty = True
         if applied:
@@ -2560,7 +2708,12 @@ class RabiaEngine:
                 res = rk.tick()
         nbytes = int(res[0])
         if nbytes:
+            t_bc = time.perf_counter_ns()
             rk.broadcast_out(self, nbytes)
+            dt_bc = time.perf_counter_ns() - t_bc
+            self._h_slo["broadcast"].observe(dt_bc * 1e-9)
+            if self._rtm is None:
+                self._stg_bcast(dt_bc)
         if res[4]:
             logger.warning(
                 "native tick outbound buffer overflow; dropped frames "
@@ -3120,9 +3273,9 @@ class RabiaEngine:
                     else 0
                 ),
             )
-            self._h_stage["decide_apply"].observe(
-                time.time() - rec.decided_at
-            )
+            dt_da = time.time() - rec.decided_at
+            self._h_stage["decide_apply"].observe(dt_da)
+            self._h_slo["decide_apply"].observe(dt_da)
             sh.applied_upto += 1
             sh.gc_upto(sh.applied_upto)
             applied += 1
@@ -3685,7 +3838,21 @@ class RabiaEngine:
             return
         try:
             if recipient is None:
-                if self.transport.broadcast_nowait(data):
+                if self._rtm is None:
+                    # the asyncio loop owns the commit path: its
+                    # broadcast staging IS the SLO broadcast stage.
+                    # While the native runtime owns it, the RTH block
+                    # is the sole source — counting control-plane
+                    # broadcasts (heartbeats, sync) here would
+                    # mis-attribute them to the consensus stage.
+                    t_bc = time.perf_counter_ns()
+                    staged = self.transport.broadcast_nowait(data)
+                    dt_bc = time.perf_counter_ns() - t_bc
+                    self._h_slo["broadcast"].observe(dt_bc * 1e-9)
+                    self._stg_bcast(dt_bc)
+                else:
+                    staged = self.transport.broadcast_nowait(data)
+                if staged:
                     return
                 self._spawn(self.transport.broadcast(data))
             else:

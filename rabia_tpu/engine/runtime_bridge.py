@@ -86,6 +86,25 @@ RTM_COUNTER_NAMES = (
     "ev_dropped",
 )
 
+# RTS_* stage names in index order (runtime.cpp stage profiler block);
+# must match obs.registry.RUNTIME_STAGES — the shared
+# rabia_runtime_stage_seconds{stage=...} label set
+RTM_STAGE_NAMES = (
+    "recv_wait",
+    "ingest",
+    "tick",
+    "apply",
+    "result_staging",
+    "broadcast",
+    "cmd",
+    "timers",
+    "idle",
+    "other",
+)
+
+# RTH_* histogram stage names in index order (runtime.cpp SLO block)
+RTM_HIST_STAGES = ("decide_apply", "broadcast")
+
 _FN_ORDER = (
     "rt_recv_borrow",
     "rt_recv_release",
@@ -279,6 +298,26 @@ class RuntimeBridge:
         self._fr_view = np.frombuffer(fbuf, FR_DTYPE)
         self._fr_frozen: Optional[np.ndarray] = None
 
+        # stage profiler block (cumulative ns per loop stage, RTS_* order)
+        n_stg = int(lib.rtm_stages_count())
+        self.stages_version = int(lib.rtm_stages_version())
+        sbuf = (ctypes.c_uint64 * n_stg).from_address(
+            lib.rtm_stages(self.ctx)
+        )
+        self.stages = np.frombuffer(sbuf, np.uint64)
+        # SLO histogram block: rows of [buckets..., count, sum_ns]
+        self.hist_version = int(lib.rtm_hist_version())
+        self._hist_buckets = int(lib.rtm_hist_buckets())
+        self._hist_sub_bits = int(lib.rtm_hist_sub_bits())
+        self._hist_min_exp = int(lib.rtm_hist_min_exp())
+        n_hs = int(lib.rtm_hist_stages())
+        hbuf = (
+            ctypes.c_uint64 * (n_hs * (self._hist_buckets + 2))
+        ).from_address(lib.rtm_hist(self.ctx))
+        self.hist = np.frombuffer(hbuf, np.uint64).reshape(
+            n_hs, self._hist_buckets + 2
+        )
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
@@ -355,6 +394,8 @@ class RuntimeBridge:
     def close(self) -> None:
         if self.ctx:
             self.counters = self.counters.copy()
+            self.stages = self.stages.copy()
+            self.hist = self.hist.copy()
             self._fr_frozen = self.flight_snapshot()
             ctx, self.ctx = self.ctx, None
             self.lib.rtm_destroy(ctx)
@@ -1377,6 +1418,50 @@ class RuntimeBridge:
             n: int(self.counters[i]) if i < len(self.counters) else 0
             for i, n in enumerate(RTM_COUNTER_NAMES)
         }
+
+    def stage_ns(self, name: str) -> int:
+        """Cumulative ns the runtime thread spent in one loop stage
+        (RTS_* block; advisory read — torn values are metrics noise)."""
+        try:
+            i = RTM_STAGE_NAMES.index(name)
+        except ValueError:
+            return 0
+        return int(self.stages[i]) if i < len(self.stages) else 0
+
+    def stages_dict(self) -> dict[str, int]:
+        return {
+            n: int(self.stages[i]) if i < len(self.stages) else 0
+            for i, n in enumerate(RTM_STAGE_NAMES)
+        }
+
+    def hist_stage(self, name: str):
+        """One SLO histogram row as ``(bucket_counts, count, sum_s)`` —
+        the :class:`~rabia_tpu.obs.registry.Histogram` source shape —
+        or None when the stage is unknown or the block's bucket geometry
+        does not match this build's Python twin (ABI version guard)."""
+        from rabia_tpu.obs.registry import (
+            SLO_BUCKETS,
+            SLO_MIN_EXP,
+            SLO_SUB_BITS,
+        )
+
+        try:
+            i = RTM_HIST_STAGES.index(name)
+        except ValueError:
+            return None
+        if (
+            self._hist_buckets != len(SLO_BUCKETS)
+            or self._hist_sub_bits != SLO_SUB_BITS
+            or self._hist_min_exp != SLO_MIN_EXP
+            or i >= len(self.hist)
+        ):
+            return None
+        row = self.hist[i]
+        return (
+            row[: self._hist_buckets],
+            int(row[self._hist_buckets]),
+            float(row[self._hist_buckets + 1]) * 1e-9,
+        )
 
     def flight_head(self) -> int:
         if not self.ctx:

@@ -98,6 +98,11 @@ class GatewayConfig:
     # 0 = bind an ephemeral port, exposed as GatewayServer.http_port
     http_port: Optional[int] = None
     http_host: str = "127.0.0.1"
+    # per-second telemetry ring (obs/telemetry.TelemetrySampler): one
+    # registry snapshot per interval into a bounded ring, served as
+    # AdminKind.TIMELINE and /timeline. 0 disables the sampler.
+    telemetry_interval: float = 1.0
+    telemetry_cap: int = 900
 
 
 @dataclass
@@ -223,6 +228,16 @@ class GatewayServer:
         self._run_task = None
         self._probe_task = None
         self._http = None
+        self._telemetry = None
+        # admission-control outcomes by reason (exported as
+        # rabia_gateway_shed_total{reason=...} — today's sheds were only
+        # visible to the shedding client as RETRY)
+        self.shed_reasons: dict[str, int] = {
+            "session_window": 0,
+            "queue_depth": 0,
+            "no_quorum": 0,
+            "engine_reject": 0,
+        }
         # observability: the gateway registers into ITS ENGINE's registry
         # so one scrape covers the whole replica (engine + transport
         # counter block + gateway). Registration is idempotent by metric
@@ -254,6 +269,29 @@ class GatewayServer:
         m.gauge(
             "gateway_reads_inflight", "READs currently being driven",
             fn=lambda: len(self._reads_inflight),
+        )
+        # admission-control outcomes, by reason (stats.submits_shed stays
+        # the total; the labeled family makes shed behavior scrapeable)
+        for reason in self.shed_reasons:
+            m.counter(
+                "gateway_shed_total",
+                "Submits shed by admission control, by reason",
+                {"reason": reason},
+                fn=lambda r=reason: self.shed_reasons[r],
+            )
+        # client-observed submit→result latency: the SLO evidence
+        # plane's top stage (rabia_slo_seconds{stage="submit_result"}),
+        # observed for every freshly driven submit — dedup cache hits
+        # and sheds answer in microseconds and are counted by their own
+        # families instead of skewing the commit-latency curve
+        from rabia_tpu.obs.registry import SLO_BUCKETS
+
+        self._h_submit_result = m.histogram(
+            "slo_seconds",
+            "SLO latency histograms by pipeline stage "
+            "(log-bucketed; native RTH block + Python observes)",
+            {"stage": "submit_result"},
+            buckets=SLO_BUCKETS,
         )
 
     # -- observability surface ----------------------------------------------
@@ -318,14 +356,52 @@ class GatewayServer:
             doc["gateway"] = str(self.node_id.value)
             doc["batch_id"] = bid.hex
             return 0, json.dumps(doc).encode()
+        if kind == AdminKind.TIMELINE:
+            if self._telemetry is None:
+                return 1, b"telemetry sampler disabled"
+            last = None
+            if query:
+                try:
+                    last = json.loads(query).get("last")
+                    # "last" is optional: {} serves the full ring, same
+                    # as an empty query
+                    if last is not None:
+                        last = int(last)
+                except (ValueError, TypeError, AttributeError):
+                    return 1, b"malformed timeline query"
+            return 0, json.dumps(self._telemetry.document(last)).encode()
         return 1, f"unknown admin kind {kind}".encode()
 
     def _on_admin(self, sender: NodeId, p: AdminRequest) -> None:
         """Serve one admin document as a framed response. Read-only and
         unauthenticated by design (same trust domain as the scrape shim);
         anything beyond the known kinds answers status=1."""
+        if p.kind == AdminKind.TIMELINE and self._telemetry is not None:
+            # an unbounded ring is up to cap (900) registry snapshots —
+            # multi-MB of dict building + json.dumps; done inline it
+            # stalls the loop driving submits/results and perturbs the
+            # very curves the timeline measures. The document build only
+            # touches the sampler's deque (already read from a foreign
+            # thread by the sampler contract), so serve it off-loop.
+            self._spawn(self._serve_admin_offloop(sender, p))
+            return
         try:
             status, body = self._admin_body(p.kind, p.query)
+        except Exception as e:  # a broken provider must still answer
+            logger.exception("admin request failed")
+            status, body = 1, f"admin handler failed: {e}".encode()
+        self._send(
+            AdminResponse(nonce=p.nonce, status=status, body=body), sender
+        )
+
+    async def _serve_admin_offloop(
+        self, sender: NodeId, p: AdminRequest
+    ) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            status, body = await loop.run_in_executor(
+                None, self._admin_body, p.kind, p.query
+            )
         except Exception as e:  # a broken provider must still answer
             logger.exception("admin request failed")
             status, body = 1, f"admin handler failed: {e}".encode()
@@ -346,6 +422,15 @@ class GatewayServer:
             ),
         )
         self.engine.add_frontier_listener(self._frontier_event.set)
+        if self.config.telemetry_interval > 0 and self._telemetry is None:
+            from rabia_tpu.obs import TelemetrySampler
+
+            self._telemetry = TelemetrySampler(
+                self.metrics,
+                node=str(self.engine.node_id.value),
+                interval=self.config.telemetry_interval,
+                cap=self.config.telemetry_cap,
+            ).start()
         if self.config.http_port is not None and self._http is None:
             from rabia_tpu.obs import AdminHTTPServer
 
@@ -355,6 +440,11 @@ class GatewayServer:
                 journal=self.engine.journal,
                 host=self.config.http_host,
                 port=self.config.http_port,
+                timeline_fn=(
+                    (lambda last: self._telemetry.document(last))
+                    if self._telemetry is not None
+                    else None
+                ),
             )
         self._running = True
         self._run_task = asyncio.ensure_future(self._run())
@@ -387,6 +477,12 @@ class GatewayServer:
         if self._http is not None:
             self._http.close()
             self._http = None
+        if self._telemetry is not None:
+            # final flush so the ring covers the run's last instant even
+            # when the gateway closes between 1 Hz samples
+            self._telemetry.sample()
+            self._telemetry.close()
+            self._telemetry = None
         self.engine.remove_frontier_listener(self._frontier_event.set)
         for t in (self._run_task, self._probe_task, *self._tasks):
             if t is not None:
@@ -542,6 +638,7 @@ class GatewayServer:
         # -- admission control (shed BEFORE the engine sees the batch) --
         if len(sess.inflight) >= sess.window:
             self.stats.submits_shed += 1
+            self.shed_reasons["session_window"] += 1
             self._send_result(
                 sender, p.client_id, p.seq, ResultStatus.RETRY,
                 (b"backpressure: session window full",),
@@ -549,6 +646,7 @@ class GatewayServer:
             return
         if self.engine.pending_queue_depth() >= self.config.max_queue_depth:
             self.stats.submits_shed += 1
+            self.shed_reasons["queue_depth"] += 1
             self._send_result(
                 sender, p.client_id, p.seq, ResultStatus.RETRY,
                 (b"backpressure: engine queue saturated",),
@@ -556,6 +654,7 @@ class GatewayServer:
             return
         if not self.engine.rt.has_quorum:
             self.stats.submits_shed += 1
+            self.shed_reasons["no_quorum"] += 1
             self._send_result(
                 sender, p.client_id, p.seq, ResultStatus.RETRY,
                 (b"no quorum",),
@@ -574,7 +673,9 @@ class GatewayServer:
             )
             return
         sess.inflight[p.seq] = None  # reserved synchronously (dedup window)
-        self._spawn(self._drive_submit(sender, sess, p))
+        self._spawn(
+            self._drive_submit(sender, sess, p, time.perf_counter())
+        )
 
     @staticmethod
     def _deterministic_batch(p: Submit) -> CommandBatch:
@@ -608,7 +709,9 @@ class GatewayServer:
             id=BatchId(bid), commands=tuple(cmds), shard=ShardId(p.shard)
         )
 
-    async def _drive_submit(self, sender: NodeId, sess, p: Submit) -> None:
+    async def _drive_submit(
+        self, sender: NodeId, sess, p: Submit, t0: float = 0.0
+    ) -> None:
         batch = self._deterministic_batch(p)
         proposed = False
         try:
@@ -632,6 +735,7 @@ class GatewayServer:
                 # retryable, nothing to dedup against
                 sess.inflight.pop(p.seq, None)
                 self.stats.submits_shed += 1
+                self.shed_reasons["engine_reject"] += 1
                 self._send_result(
                     sender, p.client_id, p.seq, ResultStatus.RETRY,
                     (str(e).encode(),),
@@ -661,6 +765,8 @@ class GatewayServer:
             FRE_RESULT, shard=p.shard, arg=int(status),
             batch=fr_hash(batch.id),
         )
+        if t0:
+            self._h_submit_result.observe(time.perf_counter() - t0)
         self._send_result(sender, p.client_id, p.seq, status, payload)
 
     # -- linearizable read path ---------------------------------------------

@@ -577,6 +577,26 @@ def load_runtime() -> ctypes.CDLL | None:
         lib.rtm_counters_count.argtypes = []
         lib.rtm_counters.restype = ctypes.c_void_p
         lib.rtm_counters.argtypes = [p]
+        # stage profiler block (RTS_*: cumulative ns per loop stage)
+        lib.rtm_stages_version.restype = ctypes.c_int32
+        lib.rtm_stages_version.argtypes = []
+        lib.rtm_stages_count.restype = ctypes.c_int32
+        lib.rtm_stages_count.argtypes = []
+        lib.rtm_stages.restype = ctypes.c_void_p
+        lib.rtm_stages.argtypes = [p]
+        # SLO latency histogram block (RTH_*: log-bucketed, fixed size)
+        lib.rtm_hist_version.restype = ctypes.c_int32
+        lib.rtm_hist_version.argtypes = []
+        lib.rtm_hist_stages.restype = ctypes.c_int32
+        lib.rtm_hist_stages.argtypes = []
+        lib.rtm_hist_buckets.restype = ctypes.c_int32
+        lib.rtm_hist_buckets.argtypes = []
+        lib.rtm_hist_sub_bits.restype = ctypes.c_int32
+        lib.rtm_hist_sub_bits.argtypes = []
+        lib.rtm_hist_min_exp.restype = ctypes.c_int32
+        lib.rtm_hist_min_exp.argtypes = []
+        lib.rtm_hist.restype = ctypes.c_void_p
+        lib.rtm_hist.argtypes = [p]
         lib.rtm_flight_version.restype = ctypes.c_int32
         lib.rtm_flight_version.argtypes = []
         lib.rtm_flight_cap.restype = ctypes.c_int32

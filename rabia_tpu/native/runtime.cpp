@@ -118,6 +118,57 @@ enum : int32_t {
 };
 static const int32_t RTM_COUNTERS_VERSION = 2;
 
+// --- runtime stage profiler (versioned, append-only like RTM_*) --------------
+//
+// Cumulative CLOCK_MONOTONIC nanoseconds per loop stage. Every loop
+// iteration is fully attributed: each instrumented section adds its
+// duration to one stage AND to a per-iteration accumulator, and the
+// iteration remainder lands in RTS_OTHER — so the stage sum equals the
+// thread's wall time by construction ("where did the wall move" is a
+// scrape, not a guess). Exported as rabia_runtime_stage_seconds{stage=…}
+// via the engine registry; rendered by `python -m rabia_tpu profile`.
+
+enum : int32_t {
+  RTS_RECV_WAIT = 0,   // blocking inbox wait that returned a frame
+  RTS_INGEST,          // frame pump: rk_ingest / native bind / escalate
+  RTS_TICK,            // open collection + chained rk_tick stages
+  RTS_APPLY,           // sk_apply_wave (decided waves applying in C)
+  RTS_RESULT_STAGING,  // result copy-out + event record build/push
+  RTS_BROADCAST,       // rt_broadcast_frames staging of tick out-frames
+  RTS_CMD,             // command-ring drain (control-plane commands)
+  RTS_TIMERS,          // retransmit / stale repair / stall escalation
+  RTS_IDLE,            // blocking inbox wait that timed out; pause park
+  RTS_OTHER,           // loop remainder (bookkeeping between sections)
+  RTS_COUNT
+};
+static const int32_t RTS_VERSION = 1;
+
+// --- SLO latency histogram block (versioned like RKC_*/SKC_*) ----------------
+//
+// HDR-style log-bucketed fixed-size histograms: per stage, RTH_BUCKETS
+// u64 bucket counts + [RTH_BUCKETS] total count + [RTH_BUCKETS+1] sum of
+// observed nanoseconds. Bucketing: 2^RTH_SUB_BITS sub-buckets per
+// power-of-two octave starting at 2^RTH_MIN_EXP ns — bucket upper bound
+// for octave o, sub s is 2^(RTH_MIN_EXP+o) * (2^SUB + s + 1) / 2^SUB
+// (worst-case relative error 1/2^SUB per bucket). Values below the
+// floor clamp into bucket 0, values past the top into the last bucket.
+// observe() is branch-light bit math + three u64 increments: zero
+// allocation on the hot path. The Python twin of the bucket bounds is
+// rabia_tpu.obs.registry.SLO_BUCKETS; both paths export the merged
+// result as rabia_slo_seconds{stage=…}.
+
+enum : int32_t {
+  RTH_DECIDE_APPLY = 0,  // kernel decide -> native wave apply complete
+  RTH_BROADCAST,         // tick vote/decision frames staged to the wire
+  RTH_STAGE_COUNT
+};
+static const int32_t RTH_VERSION = 1;
+static const int32_t RTH_SUB_BITS = 2;  // 4 sub-buckets per octave
+static const int32_t RTH_MIN_EXP = 10;  // floor 1.024us
+static const int32_t RTH_OCTAVES = 25;  // top bound 2^35 ns ~ 34.4s
+static const int32_t RTH_BUCKETS = RTH_OCTAVES << RTH_SUB_BITS;
+static const int32_t RTH_STRIDE = RTH_BUCKETS + 2;  // + count + sum_ns
+
 // --- flight recorder (FrEvent ABI of hostkernel.cpp / obs/flight.py) --------
 
 enum : uint8_t {
@@ -395,9 +446,26 @@ struct RtmCtx {
   double last_timers = 0.0;
 
   uint64_t ctrs[RTM_COUNT];
+  uint64_t stg[RTS_COUNT];                   // stage profiler (ns)
+  uint64_t hist[RTH_STAGE_COUNT * RTH_STRIDE];  // SLO histogram block
   std::vector<FrEvent> fr;
   uint64_t fr_head = 0;
 };
+
+static inline void rth_observe(RtmCtx* c, int32_t stage, uint64_t ns) {
+  uint64_t* h = c->hist + (size_t)stage * RTH_STRIDE;
+  int32_t idx = 0;
+  if (ns >= (1ull << RTH_MIN_EXP)) {
+    const int32_t exp = 63 - __builtin_clzll(ns);
+    const int32_t sub =
+        (int32_t)((ns >> (exp - RTH_SUB_BITS)) & ((1 << RTH_SUB_BITS) - 1));
+    idx = ((exp - RTH_MIN_EXP) << RTH_SUB_BITS) + sub;
+    if (idx >= RTH_BUCKETS) idx = RTH_BUCKETS - 1;
+  }
+  h[idx]++;
+  h[RTH_BUCKETS]++;
+  h[RTH_BUCKETS + 1] += ns;
+}
 
 static inline void fr_rec(RtmCtx* c, uint8_t kind, uint8_t arg, uint32_t shard,
                           int64_t slot) {
@@ -944,9 +1012,13 @@ static void process_decided(RtmCtx* c, double now) {
       const bool plane_held = c->fns[FN_SK_PLANE_LOCK] != nullptr;
       if (plane_held)
         ((fn_sk_plane_lk_t)c->fns[FN_SK_PLANE_LOCK])(c->sk);
+      const uint64_t ap0 = mono_ns();
       staged = ((fn_sk_apply_wave_t)c->fns[FN_SK_APPLY_WAVE])(
           c->sk, b.data.data(), b.cmd_offsets.data(), b.shards.data(),
           b.starts.data(), idxs.data(), (int64_t)idxs.size(), now, want);
+      const uint64_t ap_ns = mono_ns() - ap0;
+      c->stg[RTS_APPLY] += ap_ns;
+      rth_observe(c, RTH_DECIDE_APPLY, ap_ns);
       if (want && staged >= 0) {
         const uint8_t* ob =
             (const uint8_t*)((fn_sk_ptr_t)c->fns[FN_SK_OUT_BUF])(c->sk);
@@ -1246,6 +1318,16 @@ static int32_t handle_frame(RtmCtx* c, int32_t row, const uint8_t* fp,
   return 0;
 }
 
+// Stage bracket: add a measured duration to one RTS_* stage and to the
+// iteration accumulator (the RTS_OTHER remainder computation needs every
+// attributed nanosecond counted exactly once).
+#define RTS_ADD(stage, dur)   \
+  do {                        \
+    const uint64_t _d = (dur); \
+    c->stg[stage] += _d;      \
+    acc += _d;                \
+  } while (0)
+
 static void rtm_loop(RtmCtx* c) {
   fn_recv_borrow_t recv_borrow = (fn_recv_borrow_t)c->fns[FN_RECV_BORROW];
   fn_recv_release_t recv_release = (fn_recv_release_t)c->fns[FN_RECV_RELEASE];
@@ -1260,21 +1342,29 @@ static void rtm_loop(RtmCtx* c) {
 
   while (!c->stop_req.load(std::memory_order_relaxed)) {
     c->ctrs[RTM_LOOPS]++;
+    const uint64_t it0 = mono_ns();
+    uint64_t acc = 0, t0 = 0;
     double now = wall_s();
+    t0 = mono_ns();
     drain_cmds(c, now);
+    RTS_ADD(RTS_CMD, mono_ns() - t0);
     if (c->pause_req.load(std::memory_order_relaxed)) {
       c->state.store(RTM_PAUSED, std::memory_order_release);
       c->ctrs[RTM_PAUSES]++;
+      t0 = mono_ns();
       while (c->pause_req.load(std::memory_order_relaxed) &&
              !c->stop_req.load(std::memory_order_relaxed))
         usleep(200);
+      RTS_ADD(RTS_IDLE, mono_ns() - t0);
       c->state.store(RTM_RUNNING, std::memory_order_release);
+      c->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
       continue;
     }
 
     // nonblocking frame pump: rk_ingest consumes vote/decision frames in
     // place; ProposeBlock binds natively; everything else escalates
     int32_t got = 0, consumed = 0;
+    t0 = mono_ns();
     while (consumed < 512) {
       const int64_t tok = recv_borrow(c->tr, sender, &fp, &flen, 0);
       if (tok < 0) break;
@@ -1283,30 +1373,58 @@ static void rtm_loop(RtmCtx* c) {
       if (row >= 0) got += handle_frame(c, row, fp, flen, now);
       recv_release(c->tr, tok);
     }
+    RTS_ADD(RTS_INGEST, mono_ns() - t0);
 
+    t0 = mono_ns();
     const int32_t n_open = collect_opens(c);
+    RTS_ADD(RTS_TICK, mono_ns() - t0);
     if (got || n_open || c->restep) {
       c->restep = 0;
       now = wall_s();
+      t0 = mono_ns();
       rk_tick(c->rk, now, c->out.data(), (int64_t)c->out.size(), 4,
               n_open ? c->open_mask.data() : nullptr,
               n_open ? c->open_slots.data() : nullptr,
               n_open ? c->open_init.data() : nullptr, res);
+      RTS_ADD(RTS_TICK, mono_ns() - t0);
       c->ctrs[RTM_TICKS]++;
-      if (res[0] > 0) bcast(c->tr, c->out.data(), res[0]);
+      if (res[0] > 0) {
+        t0 = mono_ns();
+        bcast(c->tr, c->out.data(), res[0]);
+        const uint64_t bc_ns = mono_ns() - t0;
+        RTS_ADD(RTS_BROADCAST, bc_ns);
+        rth_observe(c, RTH_BROADCAST, bc_ns);
+      }
       if (res[2]) c->restep = 1;
-      if (res[1]) process_decided(c, now);
+      if (res[1]) {
+        // process_decided brackets its own sk_apply_wave sections into
+        // RTS_APPLY; everything else it does (decision bookkeeping,
+        // result copy-out, event-record staging) is result staging
+        const uint64_t a0 = c->stg[RTS_APPLY];
+        t0 = mono_ns();
+        process_decided(c, now);
+        const uint64_t pd = mono_ns() - t0;
+        const uint64_t ap = c->stg[RTS_APPLY] - a0;
+        c->stg[RTS_RESULT_STAGING] += pd > ap ? pd - ap : 0;
+        acc += pd;
+      }
     }
 
     if (now - c->last_timers >= timer_every) {
       c->last_timers = now;
+      t0 = mono_ns();
       run_timers(c, now);
+      RTS_ADD(RTS_TIMERS, mono_ns() - t0);
     }
 
-    if (c->restep) continue;
+    if (c->restep) {
+      c->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
+      continue;
+    }
     if (consumed) {
       fr_rec(c, FRE_RT_WAKE, 1, 0, 0);
       c->ctrs[RTM_WAKES_FRAME]++;
+      c->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
       continue;  // stay hot while traffic flows
     }
     // idle: block on the transport inbox (frames and rt_inbox_kick both
@@ -1316,18 +1434,24 @@ static void rtm_loop(RtmCtx* c) {
     int timeout_ms = (int)(timer_every * 1000.0);
     if (timeout_ms > 5) timeout_ms = 5;
     if (timeout_ms < 1) timeout_ms = 1;
+    t0 = mono_ns();
     const int64_t tok = recv_borrow(c->tr, sender, &fp, &flen, timeout_ms);
     if (tok >= 0) {
+      RTS_ADD(RTS_RECV_WAIT, mono_ns() - t0);
+      t0 = mono_ns();
       const int32_t row = row_of(c, sender);
       if (row >= 0 && handle_frame(c, row, fp, flen, wall_s()))
         c->restep = 1;  // force a tick next iteration
       recv_release(c->tr, tok);
+      RTS_ADD(RTS_INGEST, mono_ns() - t0);
       fr_rec(c, FRE_RT_WAKE, 1, 0, 0);
       c->ctrs[RTM_WAKES_FRAME]++;
     } else {
+      RTS_ADD(RTS_IDLE, mono_ns() - t0);
       fr_rec(c, FRE_RT_WAKE, 2, 0, 0);
       c->ctrs[RTM_WAKES_IDLE]++;
     }
+    c->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
   }
   c->state.store(RTM_STOPPED, std::memory_order_release);
   uint64_t one = 1;
@@ -1408,6 +1532,8 @@ void* rtm_create(const int64_t* dims, const int64_t* ptrs, const int64_t* fns,
   c->st_slots.assign(1024, 0);
   c->last_repair.assign(c->R, 0.0);
   memset(c->ctrs, 0, sizeof(c->ctrs));
+  memset(c->stg, 0, sizeof(c->stg));
+  memset(c->hist, 0, sizeof(c->hist));
   c->fr.resize(RTM_FLIGHT_CAP);
   c->event_fd = eventfd(0, EFD_NONBLOCK);
   return c;
@@ -1468,6 +1594,22 @@ int64_t rtm_ev_drain(void* ctx, uint8_t* out, int64_t cap) {
 int32_t rtm_counters_version(void) { return RTM_COUNTERS_VERSION; }
 int32_t rtm_counters_count(void) { return RTM_COUNT; }
 void* rtm_counters(void* ctx) { return ((RtmCtx*)ctx)->ctrs; }
+
+// stage profiler block: RTS_COUNT u64 cumulative ns, index order RTS_*
+int32_t rtm_stages_version(void) { return RTS_VERSION; }
+int32_t rtm_stages_count(void) { return RTS_COUNT; }
+void* rtm_stages(void* ctx) { return ((RtmCtx*)ctx)->stg; }
+
+// SLO histogram block: RTH_STAGE_COUNT rows of RTH_BUCKETS bucket
+// counts + total count + sum_ns (stride RTH_BUCKETS + 2), index order
+// RTH_*. Bucket-geometry params are exported so the Python twin
+// (obs.registry.SLO_BUCKETS) can be verified against the ABI.
+int32_t rtm_hist_version(void) { return RTH_VERSION; }
+int32_t rtm_hist_stages(void) { return RTH_STAGE_COUNT; }
+int32_t rtm_hist_buckets(void) { return RTH_BUCKETS; }
+int32_t rtm_hist_sub_bits(void) { return RTH_SUB_BITS; }
+int32_t rtm_hist_min_exp(void) { return RTH_MIN_EXP; }
+void* rtm_hist(void* ctx) { return ((RtmCtx*)ctx)->hist; }
 
 int32_t rtm_flight_version(void) { return RTM_FLIGHT_VERSION; }
 int32_t rtm_flight_cap(void) { return (int32_t)RTM_FLIGHT_CAP; }

@@ -13,8 +13,12 @@ One pull-based surface per replica process component:
   anomalies (sync overtakes, slow ticks, stale storms, redial churn),
   queryable from the gateway admin endpoint.
 - :class:`AdminHTTPServer` — a tiny stdlib HTTP shim serving
-  ``/metrics`` (Prometheus text), ``/healthz`` (JSON) and ``/journal``
-  (JSON) for scrapers that do not speak the native framed transport.
+  ``/metrics`` (Prometheus text), ``/healthz`` (JSON), ``/journal``
+  (JSON) and ``/timeline`` (JSON telemetry ring) for scrapers that do
+  not speak the native framed transport.
+- :class:`TelemetrySampler` — per-replica bounded ring of 1 Hz registry
+  snapshots, served over the admin surface and joined across replicas
+  into one clock-aligned time series (``python -m rabia_tpu timeline``).
 
 The metric name taxonomy is documented in docs/OBSERVABILITY.md.
 """
@@ -26,6 +30,10 @@ from rabia_tpu.obs.registry import (
     Histogram,
     LATENCY_BUCKETS,
     MetricsRegistry,
+    RUNTIME_STAGES,
+    SLO_BUCKETS,
+    SLO_STAGES,
+    parse_prometheus_text,
 )
 from rabia_tpu.obs.http import AdminHTTPServer
 from rabia_tpu.obs.flight import (
@@ -40,6 +48,12 @@ from rabia_tpu.obs.flight import (
     merge_slices,
     render_timeline,
 )
+from rabia_tpu.obs.telemetry import (
+    TelemetrySampler,
+    collect_timeline,
+    merge_timelines,
+    render_timeline_table,
+)
 
 __all__ = [
     "AdminHTTPServer",
@@ -52,11 +66,19 @@ __all__ = [
     "Histogram",
     "LATENCY_BUCKETS",
     "MetricsRegistry",
+    "RUNTIME_STAGES",
+    "SLO_BUCKETS",
+    "SLO_STAGES",
     "TF_DTYPE",
+    "TelemetrySampler",
     "batch_id_for",
     "build_trace_slice",
+    "collect_timeline",
     "collect_trace",
     "fr_hash",
     "merge_slices",
+    "merge_timelines",
+    "parse_prometheus_text",
     "render_timeline",
+    "render_timeline_table",
 ]

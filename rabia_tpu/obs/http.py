@@ -9,6 +9,8 @@ three documents over plain HTTP/1.1 from a daemon thread:
     GET /healthz   application/json (200 ok / 503 degraded)
     GET /journal   application/json (bounded anomaly journal);
                    filters: ?kind=<anomaly kind>&last=<N>  (default 64)
+    GET /timeline  application/json (per-second telemetry ring,
+                   obs/telemetry); filter: ?last=<N> samples
 
 Zero dependencies beyond ``http.server``; binds an ephemeral port by
 default. Request handling calls back into registry/health providers —
@@ -41,10 +43,12 @@ class AdminHTTPServer:
         journal: Optional[AnomalyJournal] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        timeline_fn: Optional[Callable[[Optional[int]], dict]] = None,
     ) -> None:
         self.registry = registry
         self.health_fn = health_fn
         self.journal = journal
+        self.timeline_fn = timeline_fn
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -82,6 +86,20 @@ class AdminHTTPServer:
                             else []
                         )
                         body = json.dumps({"anomalies": entries}).encode()
+                        ctype = "application/json"
+                        code = 200
+                    elif path == "/timeline":
+                        q = urllib.parse.parse_qs(qs)
+                        try:
+                            last = int(q.get("last", [None])[0])  # type: ignore[arg-type]
+                        except (TypeError, ValueError):
+                            last = None
+                        doc = (
+                            outer.timeline_fn(last)
+                            if outer.timeline_fn is not None
+                            else {"version": 1, "samples": []}
+                        )
+                        body = json.dumps(doc).encode()
                         ctype = "application/json"
                         code = 200
                     else:

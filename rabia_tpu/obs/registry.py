@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import math
 import threading
+from bisect import bisect_left
 from typing import Callable, Iterable, Optional
 
 # Default latency buckets (seconds): 100us .. 10s, the commit-pipeline
@@ -37,6 +38,66 @@ LATENCY_BUCKETS: tuple[float, ...] = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
+
+# ---------------------------------------------------------------------------
+# SLO evidence plane conventions (docs/OBSERVABILITY.md, "SLO histograms"
+# and "Runtime stage profiler"). The bucket geometry is the Python twin
+# of the native histogram-block ABI (runtime.cpp RTH_*): 2^SLO_SUB_BITS
+# log sub-buckets per power-of-two octave of nanoseconds, floor
+# 2^SLO_MIN_EXP ns — so a native histogram row merges 1:1 into a
+# :class:`Histogram` built over :data:`SLO_BUCKETS`. Values past the top
+# octave clamp into the last bucket on the native side (the quantile
+# estimator never extrapolates past the top bound anyway).
+# ---------------------------------------------------------------------------
+
+SLO_SUB_BITS = 2
+SLO_MIN_EXP = 10
+SLO_OCTAVES = 25
+
+# the rabia_slo_seconds{stage=...} label set (both runtime paths)
+SLO_STAGES: tuple[str, ...] = ("submit_result", "decide_apply", "broadcast")
+
+# the rabia_runtime_stage_seconds{stage=...} label set, in the native
+# RTS_* index order (runtime.cpp); the Python commit-path owner feeds
+# the same names so the family is path-independent
+RUNTIME_STAGES: tuple[str, ...] = (
+    "recv_wait", "ingest", "tick", "apply", "result_staging",
+    "broadcast", "cmd", "timers", "idle", "other",
+)
+
+
+def _slo_buckets() -> tuple[float, ...]:
+    sub = 1 << SLO_SUB_BITS
+    out = []
+    for octave in range(SLO_OCTAVES):
+        base = 1 << (SLO_MIN_EXP + octave)
+        for s in range(sub):
+            out.append(base * (sub + s + 1) / sub * 1e-9)
+    return tuple(out)
+
+
+SLO_BUCKETS: tuple[float, ...] = _slo_buckets()
+
+
+def parse_prometheus_text(text: str) -> dict[str, float]:
+    """Parse a Prometheus 0.0.4 text exposition back into the
+    :meth:`MetricsRegistry.snapshot` key shape (``name{labels} ->
+    value``). Scrape-side inverse of :meth:`render_prometheus` for the
+    profile/timeline CLIs and tests; ignores comments and anything that
+    does not look like a sample line."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        if not key:
+            continue
+        try:
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
 
 
 def _fmt_labels(labels: tuple[tuple[str, str], ...]) -> str:
@@ -123,13 +184,22 @@ class Gauge:
 class Histogram:
     """Fixed-bucket histogram: cumulative ``le`` buckets + sum + count.
 
-    ``observe`` is the hot call: one linear scan over ~16 bucket bounds
-    and three attribute writes — no allocation. (A bisect would win only
-    past ~30 buckets; the scan keeps observe dependency-free and cheap to
-    reason about for the latency budget gate.)
+    ``observe`` is the hot call: linear scan over small bucket sets
+    (~16 bounds), bisect past ~32 (the 100-bound :data:`SLO_BUCKETS`
+    histograms sit on every broadcast/submit path) — no allocation
+    either way.
+
+    Like :class:`Counter`, a histogram may be *source-backed*: ``fn``
+    returns ``(bucket_counts, count, sum_seconds)`` read from a native
+    histogram block (runtime.cpp RTH_*, bucket-for-bucket the same
+    bounds — :data:`SLO_BUCKETS`), or ``None`` when the source is not
+    active. The exported buckets/count/sum are ``fn() + local``, so the
+    native fast path and Python event paths feed ONE metric identity.
     """
 
-    __slots__ = ("name", "help", "labels", "bounds", "counts", "sum", "count")
+    __slots__ = (
+        "name", "help", "labels", "bounds", "counts", "sum", "count", "fn",
+    )
     kind = "histogram"
 
     def __init__(
@@ -138,6 +208,7 @@ class Histogram:
         help_: str,
         labels: tuple[tuple[str, str], ...],
         buckets: Iterable[float] = LATENCY_BUCKETS,
+        fn: Optional[Callable[[], Optional[tuple]]] = None,
     ) -> None:
         self.name = name
         self.help = help_
@@ -149,26 +220,58 @@ class Histogram:
         self.counts = [0] * len(bounds)  # per-bucket (NON-cumulative) counts
         self.sum = 0.0
         self.count = 0
+        self.fn = fn
 
     def observe(self, v: float) -> None:
         self.sum += v
         self.count += 1
-        for i, b in enumerate(self.bounds):
+        bounds = self.bounds
+        if len(bounds) > 32:
+            i = bisect_left(bounds, v)
+            if i < len(bounds):
+                self.counts[i] += 1
+            # else above the top bound: only in +Inf (count - sum(buckets))
+            return
+        for i, b in enumerate(bounds):
             if v <= b:
                 self.counts[i] += 1
                 return
         # above the top bound: counted only in +Inf (count - sum(buckets))
 
+    def merged(self) -> tuple[list, int, float]:
+        """``(bucket_counts, count, sum_s)`` with the native source (if
+        any) folded in. A dead or shape-mismatched source reads as the
+        local part alone — metrics, not ledgers."""
+        if self.fn is None:
+            return self.counts, self.count, self.sum
+        try:
+            extra = self.fn()
+        except Exception:
+            extra = None
+        if extra is None:
+            return self.counts, self.count, self.sum
+        ec, en, es = extra
+        if len(ec) != len(self.counts):
+            return self.counts, self.count, self.sum
+        counts = [a + int(b) for a, b in zip(self.counts, ec)]
+        return counts, self.count + int(en), self.sum + float(es)
+
     def quantile(self, q: float) -> float:
         """Estimate the q-quantile (0..1) by linear interpolation inside
         the containing bucket; values above the top bound report the top
         bound (the estimator never extrapolates past what it measured)."""
-        if self.count == 0:
+        counts, count, _ = self.merged()
+        return self._quantile_from(counts, count, q)
+
+    def _quantile_from(
+        self, counts: list, count: int, q: float
+    ) -> float:
+        if count == 0:
             return 0.0
-        target = q * self.count
+        target = q * count
         cum = 0
         lo = 0.0
-        for b, c in zip(self.bounds, self.counts):
+        for b, c in zip(self.bounds, counts):
             if cum + c >= target and c > 0:
                 frac = (target - cum) / c
                 return lo + (b - lo) * min(max(frac, 0.0), 1.0)
@@ -177,11 +280,15 @@ class Histogram:
         return self.bounds[-1]
 
     def snapshot(self) -> dict:
+        # one merged() pass feeds count/sum and both quantiles: the
+        # native fn() read is a ctypes copy-out per call, and separate
+        # reads could also see different torn states of the live row
+        counts, count, sum_s = self.merged()
         return {
-            "count": self.count,
-            "sum_s": round(self.sum, 6),
-            "p50_s": round(self.quantile(0.5), 6),
-            "p99_s": round(self.quantile(0.99), 6),
+            "count": count,
+            "sum_s": round(sum_s, 6),
+            "p50_s": round(self._quantile_from(counts, count, 0.5), 6),
+            "p99_s": round(self._quantile_from(counts, count, 0.99), 6),
         }
 
 
@@ -247,8 +354,11 @@ class MetricsRegistry:
         help_: str = "",
         labels: Optional[dict] = None,
         buckets: Iterable[float] = LATENCY_BUCKETS,
+        fn: Optional[Callable[[], Optional[tuple]]] = None,
     ) -> Histogram:
-        return self._register(Histogram, name, help_, labels, buckets=buckets)
+        return self._register(
+            Histogram, name, help_, labels, buckets=buckets, fn=fn
+        )
 
     def attach_tracer(self, tracer) -> None:
         """Fold a :class:`~rabia_tpu.core.tracing.Tracer`'s span
@@ -301,21 +411,22 @@ class MetricsRegistry:
             lines.append(f"# TYPE {name} {first.kind}")
             for m in sorted(group, key=lambda m: m.labels):
                 if m.kind == "histogram":
+                    counts, count, sum_s = m.merged()
                     cum = 0
-                    for b, c in zip(m.bounds, m.counts):
+                    for b, c in zip(m.bounds, counts):
                         cum += c
                         lab = m.labels + (("le", _fmt_value(b)),)
                         lines.append(
                             f"{name}_bucket{_fmt_labels(lab)} {cum}"
                         )
                     lab = m.labels + (("le", "+Inf"),)
-                    lines.append(f"{name}_bucket{_fmt_labels(lab)} {m.count}")
+                    lines.append(f"{name}_bucket{_fmt_labels(lab)} {count}")
                     lines.append(
                         f"{name}_sum{_fmt_labels(m.labels)} "
-                        f"{_fmt_value(m.sum)}"
+                        f"{_fmt_value(sum_s)}"
                     )
                     lines.append(
-                        f"{name}_count{_fmt_labels(m.labels)} {m.count}"
+                        f"{name}_count{_fmt_labels(m.labels)} {count}"
                     )
                 else:
                     lines.append(

@@ -1,0 +1,545 @@
+"""SLO evidence plane gates (ISSUE 7).
+
+Four surfaces, one PR:
+
+- **Histogram-block ABI/versioning** — the native RTH_* log-bucket
+  geometry (runtime.cpp) and its Python twin
+  (:data:`rabia_tpu.obs.registry.SLO_BUCKETS`) must agree exactly, and
+  the RTS_* stage block must match :data:`RUNTIME_STAGES`.
+- **Prometheus exposition** — ``rabia_slo_seconds{stage=…}`` and
+  ``rabia_runtime_stage_seconds{stage=…}`` render with full bucket
+  chains, and the METRIC NAME SET is identical on the native and
+  ``RABIA_PY_RUNTIME=1``/``RABIA_PY_TICK=1`` paths (the counter-parity
+  conformance story extended to the new families).
+- **Per-second telemetry rings** — sampler bounds, TIMELINE admin
+  frames, clock-aligned multi-replica merge, shed-reason counters.
+- **Loadgen report schema** — the open-loop SLO report the CI smoke
+  cell gates on, plus a miniature end-to-end run over real TCP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from rabia_tpu.obs.registry import (
+    Histogram,
+    MetricsRegistry,
+    RUNTIME_STAGES,
+    SLO_BUCKETS,
+    SLO_MIN_EXP,
+    SLO_OCTAVES,
+    SLO_STAGES,
+    SLO_SUB_BITS,
+    parse_prometheus_text,
+)
+
+
+# ---------------------------------------------------------------------------
+# bucket geometry + native ABI
+# ---------------------------------------------------------------------------
+
+
+class TestSloBuckets:
+    def test_geometry(self):
+        assert len(SLO_BUCKETS) == SLO_OCTAVES * (1 << SLO_SUB_BITS)
+        assert all(  # strictly increasing bounds
+            a < b for a, b in zip(SLO_BUCKETS, SLO_BUCKETS[1:])
+        )
+        # first bound: 2^MIN_EXP * (sub+1)/sub ns
+        sub = 1 << SLO_SUB_BITS
+        assert SLO_BUCKETS[0] == pytest.approx(
+            (1 << SLO_MIN_EXP) * (sub + 1) / sub * 1e-9
+        )
+        # last bound: the next full octave boundary
+        assert SLO_BUCKETS[-1] == pytest.approx(
+            float(1 << (SLO_MIN_EXP + SLO_OCTAVES)) * 1e-9
+        )
+
+    def test_native_abi_twin(self):
+        from rabia_tpu.native.build import load_runtime
+
+        lib = load_runtime()
+        if lib is None:
+            pytest.skip("native runtime library unavailable")
+        assert int(lib.rtm_hist_version()) == 1
+        assert int(lib.rtm_hist_buckets()) == len(SLO_BUCKETS)
+        assert int(lib.rtm_hist_sub_bits()) == SLO_SUB_BITS
+        assert int(lib.rtm_hist_min_exp()) == SLO_MIN_EXP
+        from rabia_tpu.engine.runtime_bridge import (
+            RTM_HIST_STAGES,
+            RTM_STAGE_NAMES,
+        )
+
+        assert int(lib.rtm_hist_stages()) == len(RTM_HIST_STAGES)
+        # native hist stages are the non-gateway SLO stages
+        assert set(RTM_HIST_STAGES) == set(SLO_STAGES) - {"submit_result"}
+        assert int(lib.rtm_stages_version()) == 1
+        assert int(lib.rtm_stages_count()) == len(RTM_STAGE_NAMES)
+        assert RTM_STAGE_NAMES == RUNTIME_STAGES
+
+
+class TestHistogramSourceMerge:
+    def test_fn_merges_counts_sum_and_quantiles(self):
+        reg = MetricsRegistry()
+        native = [0] * len(SLO_BUCKETS)
+        native[10] = 5
+        h = reg.histogram(
+            "slo_seconds", "", {"stage": "x"}, buckets=SLO_BUCKETS,
+            fn=lambda: (native, 5, 1.25),
+        )
+        h.observe(SLO_BUCKETS[10] * 0.99)  # lands in local bucket 10
+        counts, count, sum_s = h.merged()
+        assert counts[10] == 6
+        assert count == 6
+        assert sum_s == pytest.approx(1.25 + SLO_BUCKETS[10] * 0.99)
+        # quantile over the merged distribution
+        assert SLO_BUCKETS[9] <= h.quantile(0.5) <= SLO_BUCKETS[10]
+        text = reg.render_prometheus()
+        m = parse_prometheus_text(text)
+        assert m['rabia_slo_seconds_count{stage="x"}'] == 6
+
+    def test_dead_or_mismatched_source_reads_local(self):
+        reg = MetricsRegistry()
+
+        def dead():
+            raise RuntimeError("closed")
+
+        h = reg.histogram(
+            "slo_seconds", "", {"stage": "dead"}, buckets=SLO_BUCKETS,
+            fn=dead,
+        )
+        h.observe(0.001)
+        assert h.merged()[1] == 1
+        h2 = reg.histogram(
+            "slo_seconds", "", {"stage": "short"}, buckets=SLO_BUCKETS,
+            fn=lambda: ([1, 2, 3], 6, 1.0),  # wrong bucket count
+        )
+        h2.observe(0.001)
+        assert h2.merged()[1] == 1
+
+    def test_native_bucket_math_matches_python_bounds(self):
+        """Cross-check the C bucket-index formula against the Python
+        bounds: for a value just under each bound, the C index formula
+        must select that bucket."""
+        sub_bits = SLO_SUB_BITS
+
+        def c_index(ns: int) -> int:
+            if ns < (1 << SLO_MIN_EXP):
+                return 0
+            exp = ns.bit_length() - 1
+            s = (ns >> (exp - sub_bits)) & ((1 << sub_bits) - 1)
+            idx = ((exp - SLO_MIN_EXP) << sub_bits) + s
+            return min(idx, len(SLO_BUCKETS) - 1)
+
+        for i, bound in enumerate(SLO_BUCKETS):
+            ns = int(round(bound * 1e9)) - 1
+            assert c_index(ns) == i, (i, bound, ns)
+
+
+# ---------------------------------------------------------------------------
+# exposition + metric-name parity across runtime paths
+# ---------------------------------------------------------------------------
+
+
+def _mk_engine(env: dict):
+    from rabia_tpu.core.config import RabiaConfig
+    from rabia_tpu.core.network import ClusterConfig
+    from rabia_tpu.core.state_machine import InMemoryStateMachine
+    from rabia_tpu.core.types import NodeId
+    from rabia_tpu.engine import RabiaEngine
+    from rabia_tpu.net import InMemoryHub
+
+    saved = {}
+    for k, v in env.items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        cfg = RabiaConfig(phase_timeout=2.0).with_kernel(
+            num_shards=2, shard_pad_multiple=2
+        )
+        hub = InMemoryHub()
+        nodes = [NodeId.from_int(i + 1) for i in range(3)]
+        return RabiaEngine(
+            ClusterConfig.new(nodes[0], nodes),
+            InMemoryStateMachine(),
+            hub.register(nodes[0]),
+            config=cfg,
+        )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _family_keys(engine, prefixes) -> set:
+    return {
+        k
+        for k in engine.metrics.snapshot()
+        if any(k.startswith(p) for p in prefixes)
+    }
+
+
+class TestMetricNameParity:
+    PREFIXES = ("rabia_slo_seconds", "rabia_runtime_stage_seconds")
+
+    def test_same_families_on_forced_python_paths(self):
+        """The new families must exist with IDENTICAL metric identities
+        whether the commit path is native or forced onto the Python
+        owners — a dashboard built against one path works on the other."""
+        native = _mk_engine({})
+        forced = _mk_engine(
+            {"RABIA_PY_RUNTIME": "1", "RABIA_PY_TICK": "1"}
+        )
+        a = _family_keys(native, self.PREFIXES)
+        b = _family_keys(forced, self.PREFIXES)
+        assert a == b
+        # every declared stage label is present
+        for stage in SLO_STAGES:
+            assert any(f'stage="{stage}"' in k for k in a), stage
+        for stage in RUNTIME_STAGES:
+            assert (
+                f'rabia_runtime_stage_seconds{{stage="{stage}"}}' in a
+            ), stage
+
+    def test_full_bucket_chain_renders(self):
+        e = _mk_engine({})
+        text = e.metrics.render_prometheus()
+        for stage in SLO_STAGES:
+            assert (
+                text.count(f'rabia_slo_seconds_bucket{{stage="{stage}"')
+                == len(SLO_BUCKETS) + 1  # all bounds + +Inf
+            ), stage
+        m = parse_prometheus_text(text)
+        for stage in RUNTIME_STAGES:
+            assert (
+                f'rabia_runtime_stage_seconds{{stage="{stage}"}}' in m
+            ), stage
+
+
+# ---------------------------------------------------------------------------
+# stage profiler: asyncio-owner accounting covers the loop's wall time
+# ---------------------------------------------------------------------------
+
+
+class TestStageProfiler:
+    @pytest.mark.asyncio
+    async def test_asyncio_owner_stage_sum_tracks_wall(self):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_native_tick import _mk_cluster, _start  # noqa: E402
+
+        hub, nodes, engines, sms = _mk_cluster(n_shards=1)
+        tasks = await _start(engines)
+        try:
+            from rabia_tpu.core.types import Command, CommandBatch
+
+            e0 = engines[0]
+            before = e0.stage_seconds()
+            t0 = time.perf_counter()
+            # some commits + idle time inside the window
+            for i in range(5):
+                fut = await engines[i % 3].submit_batch(
+                    CommandBatch.new([Command.new(b"SET k v")])
+                )
+                await asyncio.wait_for(fut, 10.0)
+            await asyncio.sleep(0.5)
+            elapsed = time.perf_counter() - t0
+            after = e0.stage_seconds()
+            delta = {k: after[k] - before[k] for k in after}
+            total = sum(delta.values())
+            # the stage sum must track the loop's wall time: every stage
+            # (idle included) measures wall durations, so even a starved
+            # loop accounts its window. Generous floor for CI noise.
+            assert total >= 0.7 * elapsed, (delta, elapsed)
+            assert total <= 1.3 * elapsed + 0.2, (delta, elapsed)
+            assert delta["idle"] > 0
+            assert delta["tick"] > 0 or delta["ingest"] > 0
+        finally:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    @pytest.mark.asyncio
+    async def test_native_runtime_stage_and_hist_blocks_populate(self):
+        import sys
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from rabia_tpu.native.build import load_runtime
+
+        if load_runtime() is None:
+            pytest.skip("native runtime library unavailable")
+        from test_runtime import _mk_cluster, _own_shards, _teardown
+
+        from rabia_tpu.apps.kvstore import encode_set_bin
+        from rabia_tpu.core.blocks import build_block
+
+        S = 8
+        ids, nets, engines, machines, tasks = await _mk_cluster(S, 3)
+        try:
+            assert all(e._rtm is not None for e in engines)
+            e0 = engines[0]
+            for r in range(4):
+                futs = []
+                for e in engines:
+                    mine = _own_shards(e, S)
+                    if len(mine) == 0:
+                        continue
+                    futs.append(
+                        await e.submit_block(
+                            build_block(
+                                mine,
+                                [
+                                    [encode_set_bin(f"k{r}-{int(s)}", "v")]
+                                    for s in mine
+                                ],
+                            )
+                        )
+                    )
+                await asyncio.wait_for(asyncio.gather(*futs), 20.0)
+            await asyncio.sleep(0.2)
+            # the RTS block populated and exposed through the registry
+            st = e0._rtm.stages_dict()
+            assert sum(st.values()) > 0
+            assert st["idle"] > 0
+            # decided block waves applied natively -> RTH decide_apply
+            da = e0._rtm.hist_stage("decide_apply")
+            bc = e0._rtm.hist_stage("broadcast")
+            assert da is not None and da[1] > 0
+            assert bc is not None and bc[1] > 0
+            m = parse_prometheus_text(e0.metrics.render_prometheus())
+            assert (
+                m['rabia_slo_seconds_count{stage="decide_apply"}'] == da[1]
+            )
+            assert (
+                m['rabia_runtime_stage_seconds{stage="idle"}'] > 0
+            )
+            # profile-CLI shape: stage deltas over a busy window cover
+            # >=95% of the window's wall time (the acceptance criterion,
+            # measured exactly the way `rabia_tpu profile` measures it)
+            t0 = time.monotonic()
+            s0 = {s: e0.stage_second(s) for s in RUNTIME_STAGES}
+            await asyncio.sleep(1.0)
+            elapsed = time.monotonic() - t0
+            s1 = {s: e0.stage_second(s) for s in RUNTIME_STAGES}
+            cov = sum(s1[s] - s0[s] for s in RUNTIME_STAGES) / elapsed
+            assert cov >= 0.95, (cov, s0, s1)
+        finally:
+            await _teardown(engines, tasks, nets)
+
+
+# ---------------------------------------------------------------------------
+# telemetry rings + timeline + shed reasons (real-TCP gateway cluster)
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetryRing:
+    def test_sampler_bounds_and_document(self):
+        from rabia_tpu.obs.telemetry import TelemetrySampler
+
+        reg = MetricsRegistry()
+        c = reg.counter("things_total")
+        s = TelemetrySampler(reg, node="n1", interval=1.0, cap=4)
+        for i in range(7):
+            c.inc()
+            s.sample()
+        assert len(s) == 4  # bounded ring
+        doc = s.document()
+        assert doc["version"] == 1
+        assert doc["node"] == "n1"
+        assert len(doc["samples"]) == 4
+        assert doc["samples"][-1]["metrics"]["rabia_things_total"] == 7
+        assert len(s.document(last=2)["samples"]) == 2
+        mono = [x["mono_ns"] for x in doc["samples"]]
+        assert mono == sorted(mono)
+
+    def test_merge_timelines_aligns_and_sorts(self):
+        from rabia_tpu.obs.telemetry import (
+            align_timeline,
+            merge_timelines,
+            render_timeline_table,
+        )
+
+        def doc(node, base_ns, wall):
+            return {
+                "version": 1,
+                "node": node,
+                "mono_ns": base_ns + 2_000_000_000,
+                "wall": wall,
+                "samples": [
+                    {
+                        "wall": wall - 2 + i,
+                        "mono_ns": base_ns + i * 1_000_000_000,
+                        "metrics": {"rabia_x_total": float(i)},
+                    }
+                    for i in range(3)
+                ],
+            }
+
+        # replica B's monotonic domain is wildly offset; alignment must
+        # land both on the collector's wall timeline
+        a = align_timeline(doc("A", 0, 100.0), 99.9, 100.1)
+        b = align_timeline(doc("B", 5_000_000_000_000, 100.0), 99.8, 100.2)
+        rows = merge_timelines([a, b])
+        assert len(rows) == 6
+        ts = [r["t"] for r in rows]
+        assert ts == sorted(ts)
+        # same sample index of both replicas lands within the err bound
+        t_a0 = [r for r in rows if r["node"] == "A"][0]["t"]
+        t_b0 = [r for r in rows if r["node"] == "B"][0]["t"]
+        assert abs(t_a0 - t_b0) <= 0.4
+        table = render_timeline_table(rows, metrics=["rabia_x_total"])
+        assert "2 replicas" in table
+
+    @pytest.mark.asyncio
+    async def test_gateway_timeline_and_shed_reasons_e2e(self):
+        from rabia_tpu.core.messages import AdminKind
+        from rabia_tpu.gateway import (
+            GatewayConfig,
+            RabiaClient,
+            admin_fetch,
+        )
+        from rabia_tpu.gateway.client import BackpressureError
+        from rabia_tpu.obs.telemetry import collect_timeline
+        from rabia_tpu.testing.gateway_cluster import GatewayCluster
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        cluster = GatewayCluster(
+            n_replicas=3,
+            gateway_config=GatewayConfig(telemetry_interval=0.1),
+        )
+        await cluster.start()
+        try:
+            c = RabiaClient([cluster.endpoint(0)])
+            await c.connect()
+            for i in range(10):
+                await c.submit(i % 4, [encode_set_bin(f"k{i}", "v")])
+            await c.close()
+            await asyncio.sleep(0.35)
+            g0 = cluster.gateways[0]
+            # submit->result SLO histogram observed fresh submits
+            assert g0._h_submit_result.count >= 10
+            # health reports active planes
+            planes = g0.health()["planes"]
+            assert set(planes) == {"runtime", "tick", "apply"}
+            assert all(v in ("native", "python") for v in planes.values())
+            # TIMELINE admin frames serve the ring (query honored)
+            body = await admin_fetch(
+                "127.0.0.1", g0.port, int(AdminKind.TIMELINE),
+                query=json.dumps({"last": 3}).encode(),
+            )
+            doc = json.loads(body)
+            assert doc["version"] == 1 and len(doc["samples"]) == 3
+            assert doc["samples"][-1]["metrics"][
+                "rabia_gateway_submits_total"
+            ] >= 10
+            # the cross-replica collector merges every replica's ring
+            rows = await collect_timeline(
+                [("127.0.0.1", g.port) for g in cluster.gateways],
+                last=5,
+            )
+            assert len({r["node"] for r in rows}) == 3
+            assert all(r["err_s"] >= 0 for r in rows)
+            # shed reasons: zero-depth queue sheds every submit, and the
+            # per-reason counter + labeled family record why
+            cluster.gateways[0].config.max_queue_depth = 0
+            c2 = RabiaClient(
+                [cluster.endpoint(0)], retry_backpressure=False
+            )
+            await c2.connect()
+            with pytest.raises(BackpressureError):
+                await c2.submit(0, [encode_set_bin("kq", "v")])
+            await c2.close()
+            assert g0.shed_reasons["queue_depth"] >= 1
+            m = parse_prometheus_text(
+                cluster.engines[0].metrics.render_prometheus()
+            )
+            assert (
+                m['rabia_gateway_shed_total{reason="queue_depth"}'] >= 1
+            )
+            assert 'rabia_gateway_shed_total{reason="no_quorum"}' in m
+        finally:
+            await cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# loadgen report schema + miniature open-loop run
+# ---------------------------------------------------------------------------
+
+
+def _loadgen():
+    import importlib
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parent.parent / "benchmarks")
+    )
+    return importlib.import_module("loadgen")
+
+
+class TestLoadgenReport:
+    def test_validate_report_schema(self):
+        lg = _loadgen()
+        good = {
+            "version": 1,
+            "benchmark": "loadgen_slo",
+            "ts": time.time(),
+            "config": {},
+            "points": [
+                {
+                    "offered_rps": 100.0,
+                    "sessions": 10,
+                    "arrivals": 300,
+                    "completed": 290,
+                    "achieved_rps": 96.0,
+                    "goodput_rps": 95.0,
+                    "ok": 285,
+                    "cached": 0,
+                    "shed": 5,
+                    "error": 0,
+                    "timeout": 10,
+                    "overflow": 0,
+                    "shed_rate": 0.016,
+                    "timeout_rate": 0.033,
+                    "error_rate": 0.0,
+                    "p50_ms": 5.0,
+                    "p95_ms": 9.0,
+                    "p99_ms": 12.0,
+                    "p999_ms": 20.0,
+                }
+            ],
+        }
+        assert lg.validate_report(good) == []
+        assert lg.render_table(good)
+        bad = dict(good, points=[])
+        assert lg.validate_report(bad)
+        garbled = dict(good, points=[{"offered_rps": 1}])
+        assert lg.validate_report(garbled)
+        empty_point = json.loads(json.dumps(good))
+        empty_point["points"][0]["completed"] = 0
+        empty_point["points"][0]["goodput_rps"] = 0.0
+        assert lg.validate_report(empty_point)
+
+    def test_open_loop_miniature_run(self):
+        """A tiny real run through the whole stack: 12 protocol-faithful
+        sessions over real TCP, Poisson arrivals, report validates and
+        the exit code is green."""
+        lg = _loadgen()
+        rc = lg.main(
+            [
+                "--rates", "40",
+                "--sessions", "12",
+                "--warmup", "0.5",
+                "--measure", "1.5",
+                "--call-timeout", "8",
+            ]
+        )
+        assert rc == 0
