@@ -83,11 +83,17 @@ class LoadSession:
     link is TCP and the gateway answers every Submit (sheds answer
     immediately), so a missing Result inside the call timeout is scored
     as ``timeout`` — exactly the client-observed SLO violation an
-    open-loop run is supposed to surface."""
+    open-loop run is supposed to surface.
+
+    Two transports: a DIRECT connection per session (the pre-mux shape:
+    one socket + one reader task each), or a shared :class:`MuxConn`
+    (the C transport's session-multiplex lane: thousands of sessions
+    over a handful of sockets — the 10^4+ scale lane, since one process
+    cannot hold 10^4 sockets + reader tasks honestly)."""
 
     __slots__ = (
         "client_id", "node_id", "ser", "reader", "writer", "gateway",
-        "_seq", "pending", "_read_task", "_hello",
+        "_seq", "pending", "_read_task", "_hello", "_mux",
     )
 
     def __init__(self, ser: Serializer) -> None:
@@ -101,6 +107,7 @@ class LoadSession:
         self.pending: dict[int, asyncio.Future] = {}
         self._read_task: Optional[asyncio.Task] = None
         self._hello: Optional[asyncio.Future] = None
+        self._mux: Optional["MuxConn"] = None
 
     async def connect(self, host: str, port: int, timeout: float = 10.0):
         self.reader, self.writer = await asyncio.wait_for(
@@ -110,6 +117,19 @@ class LoadSession:
         peer = await asyncio.wait_for(self.reader.readexactly(16), timeout)
         self.gateway = NodeId(uuid.UUID(bytes=peer))
         self._read_task = asyncio.ensure_future(self._read_loop())
+        await self._hello_handshake(timeout, f"{host}:{port}")
+        return self
+
+    async def connect_mux(self, mux: "MuxConn", timeout: float = 10.0):
+        """Attach to an already-connected mux conn and run the session
+        hello handshake over it."""
+        self._mux = mux
+        self.gateway = mux.gateway
+        mux.sessions[self.client_id.bytes] = self
+        await self._hello_handshake(timeout, mux.where)
+        return self
+
+    async def _hello_handshake(self, timeout: float, where: str) -> None:
         loop = asyncio.get_event_loop()
         deadline = loop.time() + timeout
         while True:
@@ -119,18 +139,30 @@ class LoadSession:
                 await asyncio.wait_for(
                     self._hello, min(0.5, max(0.05, deadline - loop.time()))
                 )
-                return self
+                return
             except asyncio.TimeoutError:
                 if loop.time() >= deadline:
                     raise TimeoutError(
-                        f"session hello to {host}:{port} timed out"
+                        f"session hello to {where} timed out"
                     ) from None
 
     def _send(self, payload) -> None:
         data = self.ser.serialize(
             ProtocolMessage.new(self.node_id, payload, self.gateway)
         )
-        self.writer.write(struct.pack("<I", len(data)) + data)
+        if self._mux is not None:
+            self._mux.send(self.client_id.bytes, data)
+        else:
+            self.writer.write(struct.pack("<I", len(data)) + data)
+
+    def _on_payload(self, p) -> None:
+        if isinstance(p, ClientHello) and p.ack:
+            if self._hello is not None and not self._hello.done():
+                self._hello.set_result(p)
+        elif isinstance(p, Result):
+            fut = self.pending.get(p.seq)
+            if fut is not None and not fut.done():
+                fut.set_result(p)
 
     async def _read_loop(self) -> None:
         try:
@@ -142,14 +174,7 @@ class LoadSession:
                     msg = self.ser.deserialize(data)
                 except Exception:
                     continue
-                p = msg.payload
-                if isinstance(p, ClientHello) and p.ack:
-                    if self._hello is not None and not self._hello.done():
-                        self._hello.set_result(p)
-                elif isinstance(p, Result):
-                    fut = self.pending.get(p.seq)
-                    if fut is not None and not fut.done():
-                        fut.set_result(p)
+                self._on_payload(msg.payload)
         except (asyncio.IncompleteReadError, asyncio.CancelledError,
                 ConnectionError, OSError):
             return
@@ -171,6 +196,80 @@ class LoadSession:
             return await asyncio.wait_for(fut, timeout)
         finally:
             self.pending.pop(seq, None)
+
+    async def close(self) -> None:
+        if self._mux is not None:
+            self._mux.sessions.pop(self.client_id.bytes, None)
+            self._mux = None
+            return  # the pool closes the shared conn
+        if self._read_task is not None:
+            self._read_task.cancel()
+            try:
+                await self._read_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self.writer is not None:
+            try:
+                self.writer.close()
+                await self.writer.wait_closed()
+            except Exception:
+                pass
+
+
+class MuxConn:
+    """One session-multiplexed connection to a gateway (the C
+    transport's mux lane, net/tcp.MUX_MAGIC): handshakes with the mux
+    magic id, then every frame is ``[u32 LE 16+len][16B session id]
+    [payload]`` in both directions. One reader task serves every session
+    bound here — the loadgen cost of a session drops from (socket +
+    reader task) to a dict entry."""
+
+    def __init__(self, ser: Serializer) -> None:
+        self.ser = ser
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.gateway: Optional[NodeId] = None
+        self.sessions: dict[bytes, LoadSession] = {}
+        self.where = "?"
+        self._read_task: Optional[asyncio.Task] = None
+
+    async def connect(self, host: str, port: int, timeout: float = 10.0):
+        from rabia_tpu.net.tcp import MUX_MAGIC
+
+        self.where = f"{host}:{port}(mux)"
+        self.reader, self.writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout
+        )
+        self.writer.write(MUX_MAGIC)
+        peer = await asyncio.wait_for(self.reader.readexactly(16), timeout)
+        self.gateway = NodeId(uuid.UUID(bytes=peer))
+        self._read_task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    def send(self, session_id: bytes, data: bytes) -> None:
+        self.writer.write(
+            struct.pack("<I", 16 + len(data)) + session_id + data
+        )
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                hdr = await self.reader.readexactly(4)
+                (ln,) = struct.unpack("<I", hdr)
+                data = await self.reader.readexactly(ln)
+                if ln < 16:
+                    continue
+                sess = self.sessions.get(data[:16])
+                if sess is None:
+                    continue
+                try:
+                    msg = self.ser.deserialize(data[16:])
+                except Exception:
+                    continue
+                sess._on_payload(msg.payload)
+        except (asyncio.IncompleteReadError, asyncio.CancelledError,
+                ConnectionError, OSError):
+            return
 
     async def close(self) -> None:
         if self._read_task is not None:
@@ -211,40 +310,92 @@ async def run_point(
     inflight_cap: int,
     seed: int,
     connect_parallel: int = 64,
+    mux: int = 0,
+    shed_fn=None,
 ) -> dict:
-    """Drive one open-loop point and return its SLO report entry."""
+    """Drive one open-loop point and return its SLO report entry.
+
+    ``mux``: sessions per multiplexed connection (0 = one direct socket
+    per session, the pre-mux shape). ``shed_fn``: optional zero-arg
+    callable returning the cluster's per-reason shed counter dict —
+    sampled before/after the point so a shed-dominated point reports
+    WHY it shed."""
     from rabia_tpu.apps.kvstore import encode_set_bin
 
     ser = Serializer()
     rng = random.Random(seed)
     sessions: list[LoadSession] = []
+    muxconns: list[MuxConn] = []
     sem = asyncio.Semaphore(connect_parallel)
 
-    async def dial(i: int) -> LoadSession:
-        # retry-or-skip per session: at the tool's stated scale a
-        # handshake burst is expected to overflow listen backlogs now
-        # and then, and one refused SYN must cost one session, not the
-        # whole curve (and must not leak the sessions already connected)
-        async with sem:
-            last_exc: Exception = RuntimeError("no dial attempt ran")
-            for attempt in range(3):
-                s = LoadSession(ser)
-                ep = endpoints[i % len(endpoints)]
-                try:
-                    await s.connect(*ep)
-                    return s
-                except Exception as e:
-                    last_exc = e
-                    await s.close()
-                    await asyncio.sleep(0.05 * (attempt + 1))
-            raise last_exc
-
     t_dial = time.perf_counter()
-    dialed = await asyncio.gather(
-        *(dial(i) for i in range(n_sessions)), return_exceptions=True
-    )
-    sessions = [s for s in dialed if isinstance(s, LoadSession)]
-    n_failed = len(dialed) - len(sessions)
+    if mux > 0:
+        # session-multiplex lane: ceil(n/mux) connections round-robined
+        # over the gateways, n sessions attached across them
+        n_conns = (n_sessions + mux - 1) // mux
+
+        async def dial_conn(i: int) -> Optional[MuxConn]:
+            async with sem:
+                for attempt in range(3):
+                    c = MuxConn(ser)
+                    ep = endpoints[i % len(endpoints)]
+                    try:
+                        await c.connect(*ep)
+                        return c
+                    except Exception:
+                        await c.close()
+                        await asyncio.sleep(0.05 * (attempt + 1))
+                return None
+
+        dialed_conns = await asyncio.gather(
+            *(dial_conn(i) for i in range(n_conns))
+        )
+        muxconns = [c for c in dialed_conns if c is not None]
+        if not muxconns:
+            raise RuntimeError(
+                f"all {n_conns} mux connection dials failed"
+            )
+
+        async def attach(i: int) -> Optional[LoadSession]:
+            async with sem:
+                s = LoadSession(ser)
+                try:
+                    return await s.connect_mux(muxconns[i % len(muxconns)])
+                except Exception:
+                    await s.close()
+                    return None
+
+        attached = await asyncio.gather(
+            *(attach(i) for i in range(n_sessions))
+        )
+        sessions = [s for s in attached if s is not None]
+    else:
+
+        async def dial(i: int) -> LoadSession:
+            # retry-or-skip per session: at the tool's stated scale a
+            # handshake burst is expected to overflow listen backlogs
+            # now and then, and one refused SYN must cost one session,
+            # not the whole curve (and must not leak the sessions
+            # already connected)
+            async with sem:
+                last_exc: Exception = RuntimeError("no dial attempt ran")
+                for attempt in range(3):
+                    s = LoadSession(ser)
+                    ep = endpoints[i % len(endpoints)]
+                    try:
+                        await s.connect(*ep)
+                        return s
+                    except Exception as e:
+                        last_exc = e
+                        await s.close()
+                        await asyncio.sleep(0.05 * (attempt + 1))
+                raise last_exc
+
+        dialed = await asyncio.gather(
+            *(dial(i) for i in range(n_sessions)), return_exceptions=True
+        )
+        sessions = [s for s in dialed if isinstance(s, LoadSession)]
+    n_failed = n_sessions - len(sessions)
     if n_failed:
         print(
             f"# {n_failed}/{n_sessions} session dials failed after "
@@ -252,11 +403,10 @@ async def run_point(
             file=sys.stderr,
         )
     if not sessions:
-        raise RuntimeError(
-            f"all {n_sessions} session dials failed: {dialed[0]!r}"
-        )
+        raise RuntimeError(f"all {n_sessions} session dials failed")
     n_sessions = len(sessions)
     dial_s = time.perf_counter() - t_dial
+    shed_before = dict(shed_fn()) if shed_fn is not None else None
 
     counts = {k: 0 for k in OUTCOMES}
     lat_ok_ms: list[float] = []
@@ -361,6 +511,20 @@ async def run_point(
     await asyncio.gather(
         *(s.close() for s in sessions), return_exceptions=True
     )
+    await asyncio.gather(
+        *(c.close() for c in muxconns), return_exceptions=True
+    )
+
+    # per-reason shed join: a shed-dominated point must say WHY it shed
+    # (rabia_gateway_shed_total{reason=...} deltas over the point)
+    shed_reasons = None
+    if shed_before is not None:
+        after = shed_fn()
+        shed_reasons = {
+            k: int(after.get(k, 0)) - int(shed_before.get(k, 0))
+            for k in after
+            if int(after.get(k, 0)) - int(shed_before.get(k, 0))
+        }
 
     completed = sum(counts[k] for k in ("ok", "cached", "shed", "error"))
     good = counts["ok"] + counts["cached"]
@@ -369,6 +533,9 @@ async def run_point(
     return {
         "offered_rps": rate,
         "sessions": n_sessions,
+        "mux": mux,
+        "connections": len(muxconns) if mux > 0 else n_sessions,
+        "shed_reasons": shed_reasons,
         "arrivals": arrivals_measured,
         "completed": completed,
         "achieved_rps": round(completed / measure, 1),
@@ -512,18 +679,37 @@ async def run(args) -> dict:
                 max_inflight_per_session=args.session_window,
                 max_queue_depth=args.queue_depth,
             ),
+            # persistence-free replicas let the GIL-free native engine
+            # runtime engage (it declines persistence), so the curve
+            # scores the commit path production deploys run
+            persistence=not args.no_persistence,
         )
         await cluster.start()
         endpoints = [
             ("127.0.0.1", g.port) for g in cluster.gateways
         ]
 
+    shed_fn = None
+    planes = None
+    if cluster is not None:
+
+        def shed_fn() -> dict:
+            out: dict[str, int] = {}
+            for g in cluster.gateways:
+                for k, v in g.shed_reasons.items():
+                    out[k] = out.get(k, 0) + v
+            return out
+
+        planes = cluster.gateways[0].health().get("planes")
+
     points = []
     try:
         for rate, n_sess in zip(rates, sess_list):
             print(
                 f"# point: offered {rate:.0f}/s, {n_sess} sessions "
-                f"(warmup {args.warmup}s, measure {args.measure}s)",
+                f"(warmup {args.warmup}s, measure {args.measure}s"
+                + (f", mux {args.mux}/conn" if args.mux else "")
+                + ")",
                 file=sys.stderr,
             )
             pt = await run_point(
@@ -537,6 +723,8 @@ async def run(args) -> dict:
                 call_timeout=args.call_timeout,
                 inflight_cap=args.inflight_cap or n_sess * 8,
                 seed=args.seed,
+                mux=args.mux,
+                shed_fn=shed_fn,
             )
             points.append(pt)
             print(json.dumps(pt), file=sys.stderr)
@@ -571,6 +759,12 @@ async def run(args) -> dict:
             else "external",
             "open_loop": "poisson",
             "seed": args.seed,
+            "mux": args.mux,
+            # active planes of the driven cluster (in-process runs): the
+            # CI gate pins gateway=native on the native-gateway smoke
+            # cell, so a silent sessionkernel build failure cannot pass
+            # for the curve it did not produce
+            "planes": planes,
         },
         "points": points,
     }
@@ -604,6 +798,24 @@ def main(argv=None) -> int:
     ap.add_argument("--queue-depth", type=int, default=1024)
     ap.add_argument("--seed", type=int, default=20260803)
     ap.add_argument(
+        "--mux", type=int, default=0,
+        help="sessions per multiplexed connection (the C transport's "
+        "session-mux lane; 0 = one direct socket per session). The "
+        "10k+ lane: one process cannot hold 10^4 sockets honestly",
+    )
+    ap.add_argument(
+        "--no-persistence", action="store_true",
+        help="run the in-process cluster's replicas persistence-free so "
+        "the native engine runtime engages (planes: runtime=native); "
+        "trades away replica-restart support, which loadgen never uses",
+    )
+    ap.add_argument(
+        "--require-plane", action="append", default=[],
+        metavar="NAME=VALUE",
+        help="fail the run unless the driven cluster reports this "
+        "plane (e.g. gateway=native); in-process clusters only",
+    )
+    ap.add_argument(
         "--external", default=None,
         help="comma list of gateway host:port to drive instead of an "
         "in-process cluster",
@@ -631,6 +843,15 @@ def main(argv=None) -> int:
         # failure artifact, the evidence of WHY the run was rejected
         Path(args.out).write_text(json.dumps(report, indent=1))
     problems = validate_report(report)
+    planes = (report.get("config") or {}).get("planes") or {}
+    for req in args.require_plane:
+        name, _, want = req.partition("=")
+        got = planes.get(name)
+        if got != want:
+            problems.append(
+                f"required plane {name}={want} but cluster reports "
+                f"{got!r} (planes: {planes})"
+            )
     if problems:
         # validate BEFORE record_results: an invalid run must not
         # clobber a previously recorded acceptance curve in

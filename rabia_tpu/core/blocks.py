@@ -50,6 +50,20 @@ def block_batch_id(block_id: uuid.UUID, shard: int) -> BatchId:
     return BatchId(uuid.UUID(int=mixed))
 
 
+def block_id_for_batch(batch_id: uuid.UUID, shard: int) -> uuid.UUID:
+    """Inverse of :func:`block_batch_id`: the block id whose covered
+    ``shard`` carries exactly ``batch_id``. The XOR mix is an involution,
+    so applying :func:`block_batch_id` to a batch id yields the block id
+    — ONE copy of the consensus-critical mix expression. A caller that
+    already owns a deterministic batch id (the gateway's ``(client_id,
+    seq)``-derived ids) can thereby route it through the block lane and
+    commit it under the SAME id the scalar lane would use — replays
+    dedup in the engine's ``applied_ids`` ledger regardless of which
+    lane the original rode."""
+    bid = batch_id.value if isinstance(batch_id, BatchId) else batch_id
+    return block_batch_id(bid, shard).value
+
+
 class PayloadBlock:
     """Columnar batch-of-batches covering a set of shards.
 
@@ -152,11 +166,19 @@ class PayloadBlock:
 
     def materialize_batch(self, i: int) -> CommandBatch:
         """Build a scalar-lane CommandBatch for covered-shard index ``i``
-        (demotion/fallback path). Command UUIDs are freshly generated and
-        therefore NOT replicated — consumers must not let responses depend
-        on command ids (none of the built-in SMs do)."""
+        (demotion/fallback path). The batch id is the entry's replicated
+        identity (:func:`block_batch_id`), so a demoted entry commits
+        under the SAME id it would have carried in the block lane and the
+        ``applied_ids`` dedup ledger stays lane-agnostic. Command UUIDs
+        are freshly generated and therefore NOT replicated — consumers
+        must not let responses depend on command ids (none of the
+        built-in SMs do)."""
         cmds = tuple(Command.new(b) for b in self.commands_for(i))
-        return CommandBatch.new(list(cmds), shard=ShardId(int(self.shards[i])))
+        return CommandBatch(
+            id=self.batch_id_for(i),
+            commands=cmds,
+            shard=ShardId(int(self.shards[i])),
+        )
 
     def subset(self, idxs: np.ndarray) -> "PayloadBlock":
         """A new block covering only the given covered-shard indices (used

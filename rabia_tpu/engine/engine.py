@@ -50,7 +50,12 @@ import numpy as np
 
 from rabia_tpu.core.blocks import PayloadBlock
 from rabia_tpu.core.config import RabiaConfig
-from rabia_tpu.core.errors import QuorumNotAvailableError, RabiaError, ValidationError
+from rabia_tpu.core.errors import (
+    QuorumNotAvailableError,
+    RabiaError,
+    ResponsesUnavailableError,
+    ValidationError,
+)
 from rabia_tpu.core.messages import (
     Decision,
     DecisionEntry,
@@ -1029,6 +1034,11 @@ class RabiaEngine:
             int(block.cmd_sizes.max()) > self.config.validation.max_command_size
         ):
             raise ValidationError("block command exceeds max command size")
+        for i in range(len(block)):
+            self.flight.record(
+                FRE_SUBMIT, shard=int(block.shards[i]),
+                batch=fr_hash(block.batch_id_for(i)),
+            )
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         out = _OutBlock(block, fut)
         ref = self._register_block(block, out, self.me)
@@ -1378,6 +1388,15 @@ class RabiaEngine:
             ns = max(0, ns - self._bcast_carve)
             self._bcast_carve = 0
         self._stage_ns[name] += ns
+        self._stage_acc += ns
+
+    def _stg_ext(self, name: str, ns: int) -> None:
+        """Stage accounting for control-plane components sharing this
+        loop (the gateway's "gateway"/"serialization" brackets): credit
+        the named stage and exclude the ns from the run loop's `other`
+        remainder via the per-iteration accumulator. No carve handling —
+        external brackets manage their own nesting."""
+        self._stage_ns[name] = self._stage_ns.get(name, 0) + ns
         self._stage_acc += ns
 
     def _stg_bcast(self, ns: int) -> None:
@@ -1866,7 +1885,7 @@ class RabiaEngine:
                     if rec.out is not None:
                         rec.out.settle(
                             bi,
-                            RabiaError("block shard overtaken by sync"),
+                            ResponsesUnavailableError("block shard overtaken by sync"),
                         )
                     self._unref_block(ref, 1)
                 self._cur_blk_ref[s] = -1
@@ -3637,7 +3656,7 @@ class RabiaEngine:
                     if rec is not None and rec.out is not None:
                         rec.out.settle(
                             int(self._cur_blk_idx[s]),
-                            RabiaError("block shard overtaken by sync"),
+                            ResponsesUnavailableError("block shard overtaken by sync"),
                         )
                     self._cur_blk_ref[s] = -1
                 if self._blk_pending_slot[s] != -1 and self._blk_pending_slot[s] < applied:

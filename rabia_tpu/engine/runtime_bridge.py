@@ -1176,11 +1176,13 @@ class RuntimeBridge:
                     sh.payloads[bid] = block.materialize_batch(int(bidx))
                     sh.buf_propose.setdefault(slot, (bid, None))
                     if breg.out is not None:
-                        from rabia_tpu.core.errors import RabiaError
+                        from rabia_tpu.core.errors import (
+                            ResponsesUnavailableError,
+                        )
 
                         breg.out.settle(
                             int(bidx),
-                            RabiaError("block shard overtaken by sync"),
+                            ResponsesUnavailableError("block shard overtaken by sync"),
                         )
                     e._unref_block(ref, 1)
                     self._record(s, slot, V1, 0.0, count=False)

@@ -65,7 +65,12 @@ from rabia_tpu.core.types import (
     NodeId,
     ShardId,
 )
-from rabia_tpu.gateway.session import CachedResult, SessionTable
+from rabia_tpu.gateway.session import (
+    SUBMIT_DUP_CACHED,
+    SUBMIT_DUP_INFLIGHT,
+    SUBMIT_FRESH,
+    SUBMIT_SHED_WINDOW,
+)
 from rabia_tpu.obs.flight import FRE_RESULT, fr_hash
 
 logger = logging.getLogger("rabia_tpu.gateway")
@@ -85,6 +90,10 @@ class GatewayConfig:
     max_queue_depth: int = 1024
     session_ttl: float = 600.0
     result_cache_cap: int = 4096
+    # hard session lease (seconds): a session silent this long is dropped
+    # by GC even with in-flight seqs, so a stalled frontier / wedged
+    # engine cannot pin dead sessions forever. None = 4 x session_ttl.
+    session_lease: Optional[float] = None
     # one probe round answers every read that arrived before it started;
     # a round that cannot assemble a quorum of frontiers by this deadline
     # fails those reads with a retryable RETRY
@@ -204,10 +213,16 @@ class GatewayServer:
             engine.sm
         )
         self.serializer = Serializer(engine.config.serialization)
-        self.sessions = SessionTable(
+        # the session/dedup table: the native C plane (sessionkernel.cpp)
+        # when it builds, else the Python semantics owner
+        # (RABIA_PY_GATEWAY=1 forces the latter)
+        from rabia_tpu.gateway.native_session import make_session_table
+
+        self.sessions = make_session_table(
             default_window=self.config.max_inflight_per_session,
             session_ttl=self.config.session_ttl,
             result_cache_cap=self.config.result_cache_cap,
+            lease_ttl=self.config.session_lease,
         )
         self.stats = GatewayStats()
         self._net = None
@@ -223,6 +238,14 @@ class GatewayServer:
         # slow read must attach to the original, not spawn parallel
         # probe rounds + reader calls (the read twin of sess.inflight)
         self._reads_inflight: set[tuple[uuid.UUID, int]] = set()
+        # reads waiting for the next shared probe round: every GET that
+        # arrived before the round starts is served by THAT round (one
+        # quorum probe amortized over the whole window, Velos-style one-
+        # sided reads) — no per-read driver task, no per-read future
+        self._pending_reads: list[tuple[NodeId, ReadIndex]] = []
+        # serialization ns credited inside the current gateway stage
+        # bracket (carved out so the two stages never double-count)
+        self._ser_carve = 0
         self._tasks: set = set()
         self._running = False
         self._run_task = None
@@ -279,6 +302,27 @@ class GatewayServer:
                 {"reason": reason},
                 fn=lambda r=reason: self.shed_reasons[r],
             )
+        # native session plane: the GWC_* counter block (sessionkernel.cpp)
+        # read zero-copy at scrape time, one family per counter — absent
+        # entirely when the Python table owns the plane (scrapes tell the
+        # active plane from rabia_gateway_plane_native too)
+        m.gauge(
+            "gateway_plane_native",
+            "1 when the C session/dedup table owns the gateway plane",
+            fn=lambda: 1.0 if self.sessions.is_native else 0.0,
+        )
+        if self.sessions.is_native:
+            from rabia_tpu.gateway.native_session import GWC_COUNTER_NAMES
+
+            for cname in GWC_COUNTER_NAMES:
+                m.counter(
+                    f"gateway_plane_{cname}_total",
+                    "Native gateway session plane counter "
+                    "(sessionkernel.cpp GWC block)",
+                    fn=lambda c=cname: self.sessions.counters_dict().get(
+                        c, 0
+                    ),
+                )
         # client-observed submit→result latency: the SLO evidence
         # plane's top stage (rabia_slo_seconds{stage="submit_result"}),
         # observed for every freshly driven submit — dedup cache hits
@@ -300,6 +344,12 @@ class GatewayServer:
         """The /healthz document: the engine's health plus the gateway's
         client-facing view."""
         doc = self.engine.health()
+        # the gateway plane joins the engine's plane ground truth (an
+        # env toggle or a silent sessionkernel build failure both read
+        # as "python" here — the loadgen CI gate checks this key)
+        doc.setdefault("planes", {})["gateway"] = (
+            "native" if self.sessions.is_native else "python"
+        )
         doc["gateway"] = {
             "node": str(self.node_id.value),
             "port": self.port,
@@ -495,6 +545,11 @@ class GatewayServer:
         if self._net is not None:
             await self._net.close()
             self._net = None
+        closer = getattr(self.sessions, "close", None)
+        if closer is not None:
+            # native plane: freeze the GWC counter block for late scrapes
+            # and free the C table
+            closer()
 
     def _spawn(self, coro) -> None:
         task = asyncio.ensure_future(coro)
@@ -504,6 +559,7 @@ class GatewayServer:
     # -- receive loop -------------------------------------------------------
 
     async def _run(self) -> None:
+        pcns = time.perf_counter_ns
         last_gc = time.time()
         while self._running:
             try:
@@ -515,20 +571,56 @@ class GatewayServer:
             except asyncio.CancelledError:
                 return
             if sender is not None:
+                # stage profiler: the control-plane work the r09 profile
+                # buried in `other` — codec time as "serialization",
+                # dispatch + session/table work as "gateway"
+                t0 = pcns()
                 try:
                     msg = self.serializer.deserialize(data)
-                    self._handle(sender, msg)
                 except RabiaError as e:
+                    self._stg_ser(pcns() - t0)
                     logger.warning(
                         "gateway %s: dropping bad frame from %s: %s",
                         self.node_id.short(),
                         sender,
                         e,
                     )
+                else:
+                    self._stg_ser(pcns() - t0)
+                    t1 = pcns()
+                    self._ser_carve = 0
+                    self._handle(sender, msg)
+                    self._stg_gw(pcns() - t1)
             now = time.time()
             if now - last_gc >= self.config.gc_interval:
                 last_gc = now
+                t0 = pcns()
                 self.sessions.gc(self.engine.rt.state_version, now)
+                self._ser_carve = 0
+                self._stg_gw(pcns() - t0)
+
+    # -- stage accounting (asyncio-owner control plane) ---------------------
+    #
+    # The gateway shares the engine's asyncio loop; its work used to land
+    # in the runtime stage profiler's `other` remainder. These helpers
+    # credit the named "serialization"/"gateway" stages on the ENGINE's
+    # accounting (engine._stg_ext excludes the ns from `other`), with
+    # serialization carved out of enclosing gateway brackets so nested
+    # _send() serializes never double-count.
+
+    def _stg_ser(self, ns: int) -> None:
+        f = getattr(self.engine, "_stg_ext", None)
+        if f is not None:
+            self._ser_carve += ns
+            f("serialization", ns)
+
+    def _stg_gw(self, ns: int) -> None:
+        f = getattr(self.engine, "_stg_ext", None)
+        if f is not None:
+            ns -= self._ser_carve
+            self._ser_carve = 0
+            if ns > 0:
+                f("gateway", ns)
 
     def _handle(self, sender: NodeId, msg: ProtocolMessage) -> None:
         p = msg.payload
@@ -569,8 +661,11 @@ class GatewayServer:
 
     def _send(self, payload, recipient: NodeId) -> None:
         msg = ProtocolMessage.new(self.node_id, payload, recipient)
+        t0 = time.perf_counter_ns()
+        data = self.serializer.serialize(msg)
+        self._stg_ser(time.perf_counter_ns() - t0)
         try:
-            self._net.send_to_nowait(recipient, self.serializer.serialize(msg))
+            self._net.send_to_nowait(recipient, data)
         except RabiaError:
             logger.warning(
                 "gateway %s: send of %s to %s failed",
@@ -599,44 +694,43 @@ class GatewayServer:
     # -- session / submit path ---------------------------------------------
 
     def _on_hello(self, sender: NodeId, p: ClientHello) -> None:
-        sess = self.sessions.ensure(p.client_id, p.max_inflight)
+        window, last_seq = self.sessions.hello(p.client_id, p.max_inflight)
         self._send(
             ClientHello(
                 client_id=p.client_id,
                 ack=True,
-                last_seq=sess.highest_completed,
-                max_inflight=sess.window,
+                last_seq=last_seq,
+                max_inflight=window,
             ),
             sender,
         )
 
     def _on_submit(self, sender: NodeId, p: Submit) -> None:
         self.stats.submits += 1
-        sess = self.sessions.ensure(p.client_id)
-        if p.ack_upto > sess.ack_upto:
-            sess.ack_upto = p.ack_upto
-        cached = sess.results.get(p.seq)
-        if cached is not None:
+        # the submit hot path in ONE table op (native: one C call):
+        # ensure/touch + ack advance + dedup classify + window check +
+        # FRESH reservation
+        decision, cstatus, cpayload = self.sessions.submit_check(
+            p.client_id, p.seq, p.ack_upto
+        )
+        if decision == SUBMIT_DUP_CACHED:
             # exactly-once: a completed seq is answered from cache, never
             # re-proposed. OK results resend as CACHED so tests/clients
             # can observe the dedup; terminal errors resend as-is.
             self.stats.submits_deduped += 1
-            self.sessions.stats.duplicate_submits += 1
             status = (
                 ResultStatus.CACHED
-                if cached.status == ResultStatus.OK
-                else cached.status
+                if cstatus == ResultStatus.OK
+                else cstatus
             )
-            self._send_result(sender, p.client_id, p.seq, status, cached.payload)
+            self._send_result(sender, p.client_id, p.seq, status, cpayload)
             return
-        if p.seq in sess.inflight:
+        if decision == SUBMIT_DUP_INFLIGHT:
             # concurrent duplicate: the original proposal's completion
             # answers it (same commit, one apply)
             self.stats.submits_deduped += 1
-            self.sessions.stats.duplicate_submits += 1
             return
-        # -- admission control (shed BEFORE the engine sees the batch) --
-        if len(sess.inflight) >= sess.window:
+        if decision == SUBMIT_SHED_WINDOW:
             self.stats.submits_shed += 1
             self.shed_reasons["session_window"] += 1
             self._send_result(
@@ -644,7 +738,11 @@ class GatewayServer:
                 (b"backpressure: session window full",),
             )
             return
+        assert decision == SUBMIT_FRESH
+        # -- admission control (shed BEFORE the engine sees the batch;
+        # the FRESH reservation is released on every shed path) --
         if self.engine.pending_queue_depth() >= self.config.max_queue_depth:
+            self.sessions.abort(p.client_id, p.seq)
             self.stats.submits_shed += 1
             self.shed_reasons["queue_depth"] += 1
             self._send_result(
@@ -653,6 +751,7 @@ class GatewayServer:
             )
             return
         if not self.engine.rt.has_quorum:
+            self.sessions.abort(p.client_id, p.seq)
             self.stats.submits_shed += 1
             self.shed_reasons["no_quorum"] += 1
             self._send_result(
@@ -661,21 +760,20 @@ class GatewayServer:
             )
             return
         if not p.commands:
+            self.sessions.abort(p.client_id, p.seq)
             self._send_result(
                 sender, p.client_id, p.seq, ResultStatus.ERROR,
                 (b"empty submit",),
             )
             return
         if not (0 <= p.shard < self.engine.n_shards):
+            self.sessions.abort(p.client_id, p.seq)
             self._send_result(
                 sender, p.client_id, p.seq, ResultStatus.ERROR,
                 (b"shard out of range",),
             )
             return
-        sess.inflight[p.seq] = None  # reserved synchronously (dedup window)
-        self._spawn(
-            self._drive_submit(sender, sess, p, time.perf_counter())
-        )
+        self._spawn(self._drive_submit(sender, p, time.perf_counter()))
 
     @staticmethod
     def _deterministic_batch(p: Submit) -> CommandBatch:
@@ -709,31 +807,87 @@ class GatewayServer:
             id=BatchId(bid), commands=tuple(cmds), shard=ShardId(p.shard)
         )
 
+    def _wave_block(self, p: Submit):
+        """Build the one-shard :class:`PayloadBlock` that routes this
+        submit through the zero-handoff wave lane, or None when it must
+        ride the scalar lane. Eligible when the native runtime owns the
+        commit path, this replica is the rotation proposer at the
+        shard's head RIGHT NOW, and every command is a binary op (the
+        consensus wave-routing rule) — then decide→apply→result runs
+        end-to-end in C (``waves_native`` grows, ``gil_handoffs`` stays
+        flat), where the scalar lane pays one designed GIL handoff per
+        decide. The block id is derived so the entry commits under the
+        SAME deterministic ``(client_id, seq)`` batch id the scalar lane
+        would use: replays dedup in ``applied_ids`` regardless of lane,
+        even if the entry demotes mid-flight."""
+        e = self.engine
+        if getattr(e, "_rtm", None) is None:
+            return None
+        if not bool((e.proposer_eligible_shards() == p.shard).any()):
+            return None
+        from rabia_tpu.apps.native_store import binary_wave_eligible
+        from rabia_tpu.core.blocks import block_id_for_batch, build_block
+        from rabia_tpu.obs.flight import batch_id_for
+
+        blk = build_block(
+            [p.shard], [list(p.commands)],
+            block_id=block_id_for_batch(
+                batch_id_for(p.client_id, p.seq), p.shard
+            ),
+        )
+        if not binary_wave_eligible(
+            blk.data, blk.cmd_offsets, blk.shard_starts, 1,
+            np.arange(1),
+        ):
+            return None
+        return blk
+
     async def _drive_submit(
-        self, sender: NodeId, sess, p: Submit, t0: float = 0.0
+        self, sender: NodeId, p: Submit, t0: float = 0.0
     ) -> None:
-        batch = self._deterministic_batch(p)
+        pcns = time.perf_counter_ns
+        tb = pcns()
+        blk = self._wave_block(p)
+        if blk is None:
+            batch = self._deterministic_batch(p)
+            batch_id = batch.id
+        else:
+            batch = None
+            batch_id = blk.batch_id_for(0)
+        self._ser_carve = 0
+        self._stg_gw(pcns() - tb)
         proposed = False
         try:
-            fut = await self.engine.submit_batch(batch, p.shard)
-            proposed = True
-            sess.inflight[p.seq] = fut
-            responses = await fut
+            if blk is not None:
+                fut = await self.engine.submit_block(blk)
+                proposed = True
+                entry = (await fut)[0]
+                if isinstance(entry, Exception):
+                    # per-entry failures surface as values on the block
+                    # future; re-raise into the scalar lane's handlers
+                    # (sync overtake -> ResponsesUnavailableError ->
+                    # peer repair, like the scalar path)
+                    raise entry
+                responses = entry
+            else:
+                fut = await self.engine.submit_batch(batch, p.shard)
+                proposed = True
+                responses = await fut
             status: int = ResultStatus.OK
             payload = tuple(responses)
         except asyncio.CancelledError:
-            sess.inflight.pop(p.seq, None)
+            self.sessions.abort(p.client_id, p.seq)
             raise
         except ResponsesUnavailableError:
             # the batch COMMITTED but this replica adopted its slots via
             # snapshot sync — the responses exist on peers that applied
             # normally. Repair from a peer gateway; never re-propose.
-            status, payload = await self._repair_result(batch.id, p.shard)
+            status, payload = await self._repair_result(batch_id, p.shard)
         except RabiaError as e:
             if not proposed and e.is_retryable():
                 # rejected before any proposal reached consensus: shed
                 # retryable, nothing to dedup against
-                sess.inflight.pop(p.seq, None)
+                self.sessions.abort(p.client_id, p.seq)
                 self.stats.submits_shed += 1
                 self.shed_reasons["engine_reject"] += 1
                 self._send_result(
@@ -748,26 +902,27 @@ class GatewayServer:
             # cached and the client must use a new seq to retry
             status = ResultStatus.ERROR
             payload = (str(e).encode(),)
-        sess.inflight.pop(p.seq, None)
-        sess.complete(
-            p.seq,
-            CachedResult(
-                status=int(status),
-                payload=payload,
-                frontier_mark=self.engine.rt.state_version,
-            ),
+        # result staging to the session plane: one table op drops the
+        # inflight reservation and caches (status, payload, frontier) —
+        # on the native plane the payload views (the apply plane's lazy
+        # result frames) are packed once into the C-resident blob the
+        # dedup path answers from, with no per-part Python bytes kept
+        tc = pcns()
+        self._ser_carve = 0
+        self.sessions.complete_op(
+            p.client_id, p.seq, int(status), payload,
+            self.engine.rt.state_version,
         )
-        self.sessions.stats.results_cached += 1
-        sess.touch()
         # flight: the commit timeline's terminal stage (the batch hash
         # ties it back to submit/propose/decide/apply)
         self.engine.flight.record(
             FRE_RESULT, shard=p.shard, arg=int(status),
-            batch=fr_hash(batch.id),
+            batch=fr_hash(batch_id),
         )
         if t0:
             self._h_submit_result.observe(time.perf_counter() - t0)
         self._send_result(sender, p.client_id, p.seq, status, payload)
+        self._stg_gw(pcns() - tc)
 
     # -- linearizable read path ---------------------------------------------
 
@@ -791,47 +946,45 @@ class GatewayServer:
         if key in self._reads_inflight:
             return  # retransmit of a slow read: the original answers
         self._reads_inflight.add(key)
-        self._spawn(self._drive_read(sender, p))
+        # queue for the NEXT shared probe round (a round already in
+        # flight started before this read arrived, so its frontiers may
+        # predate writes the read must observe). No per-read task, no
+        # per-read future: the round serves the whole window.
+        self._pending_reads.append((sender, p))
+        self._probe_kick.set()
 
-    async def _drive_read(self, sender: NodeId, p: ReadIndex) -> None:
+    def _fail_read(self, sender: NodeId, p: ReadIndex, status: int,
+                   text: bytes) -> None:
+        self.stats.reads_failed += 1
+        self._reads_inflight.discard((p.client_id, p.seq))
+        self._send_result(sender, p.client_id, p.seq, status, (text,))
+
+    def _serve_read(self, sender: NodeId, p: ReadIndex) -> None:
+        """Serve one read whose read index the applied frontier already
+        covers (synchronous: one reader call, one result frame)."""
         try:
-            try:
-                frontier = await self._acquire_read_index()
-                target = int(frontier[p.shard])
-                await self._await_applied(p.shard, target)
-            except RabiaError as e:
-                self.stats.reads_failed += 1
-                self._send_result(
-                    sender, p.client_id, p.seq, ResultStatus.RETRY,
-                    (str(e).encode(),),
-                )
-                return
-            try:
-                data = self.reader(p.shard, p.key)
-            except Exception as e:
-                # the reader is a pluggable seam (device-KV handlers can
-                # fail transiently): the client must get a frame, never
-                # silence — a dead task would make it retransmit forever
-                logger.warning(
-                    "gateway %s: read handler failed for shard %d: %s",
-                    self.node_id.short(), p.shard, e,
-                )
-                self.stats.reads_failed += 1
-                self._send_result(
-                    sender, p.client_id, p.seq, ResultStatus.ERROR,
-                    (f"read handler failed: {e}".encode(),),
-                )
-                return
-            self._send_result(
-                sender, p.client_id, p.seq, ResultStatus.OK, (data,)
+            data = self.reader(p.shard, p.key)
+        except Exception as e:
+            # the reader is a pluggable seam (device-KV handlers can
+            # fail transiently): the client must get a frame, never
+            # silence — a dropped read would make it retransmit forever
+            logger.warning(
+                "gateway %s: read handler failed for shard %d: %s",
+                self.node_id.short(), p.shard, e,
             )
-        finally:
-            self._reads_inflight.discard((p.client_id, p.seq))
+            self._fail_read(
+                sender, p, ResultStatus.ERROR,
+                f"read handler failed: {e}".encode(),
+            )
+            return
+        self._reads_inflight.discard((p.client_id, p.seq))
+        self._send_result(
+            sender, p.client_id, p.seq, ResultStatus.OK, (data,)
+        )
 
     async def _acquire_read_index(self) -> np.ndarray:
-        """Join the NEXT probe round (a round already in flight started
-        before this read arrived, so its frontiers may predate writes the
-        read must observe)."""
+        """Join the NEXT probe round as a bare frontier waiter (non-read
+        callers, tests)."""
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._round_waiters.append(fut)
         self._probe_kick.set()
@@ -844,9 +997,10 @@ class GatewayServer:
             except asyncio.CancelledError:
                 return
             self._probe_kick.clear()
-            if not self._round_waiters:
+            if not self._round_waiters and not self._pending_reads:
                 continue
             waiters, self._round_waiters = self._round_waiters, []
+            reads, self._pending_reads = self._pending_reads, []
             try:
                 frontier = await self._run_probe_round(waiters)
             except asyncio.CancelledError:
@@ -855,15 +1009,64 @@ class GatewayServer:
                         w.set_exception(
                             TimeoutError_("read-index probe cancelled")
                         )
+                for sender, p in reads:
+                    self._fail_read(
+                        sender, p, ResultStatus.RETRY,
+                        b"read-index probe cancelled",
+                    )
                 return
             except RabiaError as e:
                 for w in waiters:
                     if not w.done():
                         w.set_exception(e)
+                for sender, p in reads:
+                    self._fail_read(
+                        sender, p, ResultStatus.RETRY, str(e).encode()
+                    )
                 continue
             for w in waiters:
                 if not w.done():
                     w.set_result(frontier)
+            if reads:
+                self._finish_reads(reads, frontier)
+
+    def _finish_reads(self, reads: list, frontier: np.ndarray) -> None:
+        """Serve every read of one probe round: reads whose shard's
+        applied frontier already covers its read index answer inline
+        (zero additional tasks — the common case on a healthy replica);
+        the rest group into ONE waiter task per shard."""
+        rt = self.engine.rt
+        deferred: dict[int, list] = {}
+        for sender, p in reads:
+            target = int(frontier[p.shard])
+            if rt.applied_upto[p.shard] >= target:
+                self._serve_read(sender, p)
+            else:
+                deferred.setdefault(p.shard, []).append(
+                    (sender, p, target)
+                )
+        for shard, items in deferred.items():
+            self._spawn(self._serve_deferred_reads(shard, items))
+
+    async def _serve_deferred_reads(self, shard: int, items: list) -> None:
+        """One apply-frontier wait covers every deferred read of the
+        round on this shard (targets share the round's frontier, so the
+        max dominates)."""
+        target = max(t for _, _, t in items)
+        try:
+            await self._await_applied(shard, target)
+        except RabiaError as e:
+            for sender, p, _ in items:
+                self._fail_read(
+                    sender, p, ResultStatus.RETRY, str(e).encode()
+                )
+            return
+        except asyncio.CancelledError:
+            for sender, p, _ in items:
+                self._reads_inflight.discard((p.client_id, p.seq))
+            raise
+        for sender, p, _ in items:
+            self._serve_read(sender, p)
 
     async def _run_probe_round(self, waiters: list) -> np.ndarray:
         self.stats.probe_rounds += 1

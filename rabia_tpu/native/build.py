@@ -15,6 +15,10 @@ Four artifacts, all digest-keyed and built on first use:
   GIL-free io/tick thread gluing transport -> hostkernel ->
   statekernel; the asyncio orchestration stays the semantics owner,
   RABIA_PY_RUNTIME=1 forces it)
+- ``sessionkernel.cpp`` -> ctypes CDLL (the native gateway plane: the
+  client session/dedup table; the Python SessionTable in
+  gateway/session.py stays the semantics owner, RABIA_PY_GATEWAY=1
+  forces it)
 """
 
 from __future__ import annotations
@@ -510,6 +514,103 @@ def load_library() -> ctypes.CDLL:
         lib.rt_close.argtypes = [ctypes.c_void_p]
 
         _CACHED = lib
+        return lib
+
+
+_GWS_CACHED: ctypes.CDLL | None = None
+_GWS_FAILED: str | None = None
+
+
+def _gws_path() -> Path:
+    digest = hashlib.blake2s(
+        (_HERE / "sessionkernel.cpp").read_bytes(), digest_size=8
+    ).hexdigest()
+    return _HERE / f"_sessionkernel_{digest}.so"
+
+
+def load_sessionkernel() -> ctypes.CDLL | None:
+    """Build (if needed) and dlopen the native gateway-plane library
+    (sessionkernel.cpp: the client session/dedup table). Returns the
+    CDLL with prototypes set, or None when unavailable — the gateway
+    falls back to the Python :class:`~rabia_tpu.gateway.session.
+    SessionTable`, which stays the semantics owner
+    (``RABIA_PY_GATEWAY=1`` forces it; the conformance gate's second
+    leg)."""
+    global _GWS_CACHED, _GWS_FAILED
+    if os.environ.get("RABIA_PY_GATEWAY") == "1":
+        return None
+    with _LOCK:
+        if _GWS_CACHED is not None:
+            return _GWS_CACHED
+        if _GWS_FAILED is not None:
+            return None
+        try:
+            target = _gws_path()
+            if not target.exists():
+                _compile(
+                    (_HERE / "sessionkernel.cpp"), target, ["-O3"],
+                    "_sessionkernel_*.so", "sessionkernel",
+                )
+            lib = ctypes.CDLL(os.fspath(target))
+        except Exception as e:  # noqa: BLE001 - any failure means fallback
+            _GWS_FAILED = str(e)
+            return None
+        p = ctypes.c_void_p
+        i64 = ctypes.c_int64
+        u64 = ctypes.c_uint64
+        lib.gws_create.restype = ctypes.c_void_p
+        lib.gws_create.argtypes = [i64, ctypes.c_double, i64,
+                                   ctypes.c_double]
+        lib.gws_destroy.restype = None
+        lib.gws_destroy.argtypes = [p]
+        lib.gws_counters_version.restype = ctypes.c_int32
+        lib.gws_counters_version.argtypes = []
+        lib.gws_counters_count.restype = ctypes.c_int32
+        lib.gws_counters_count.argtypes = []
+        lib.gws_counters.restype = ctypes.c_void_p
+        lib.gws_counters.argtypes = [p]
+        lib.gws_len.restype = i64
+        lib.gws_len.argtypes = [p]
+        lib.gws_clear.restype = None
+        lib.gws_clear.argtypes = [p]
+        lib.gws_stats.restype = None
+        lib.gws_stats.argtypes = [p, p]
+        lib.gws_hello.restype = i64
+        lib.gws_hello.argtypes = [
+            p, p, i64, ctypes.c_double, ctypes.POINTER(u64),
+        ]
+        lib.gws_submit.restype = ctypes.c_int32
+        lib.gws_submit.argtypes = [
+            p, p, u64, u64, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(i64),
+        ]
+        lib.gws_complete.restype = ctypes.c_int32
+        lib.gws_complete.argtypes = [
+            p, p, u64, ctypes.c_int32, u64, p, i64, ctypes.c_double,
+        ]
+        lib.gws_abort.restype = None
+        lib.gws_abort.argtypes = [p, p, u64]
+        lib.gws_gc.restype = i64
+        lib.gws_gc.argtypes = [p, u64, ctypes.c_double]
+        lib.gws_session_info.restype = ctypes.c_int32
+        lib.gws_session_info.argtypes = [
+            p, p, ctypes.POINTER(i64), ctypes.POINTER(u64),
+            ctypes.POINTER(u64), ctypes.POINTER(i64), ctypes.POINTER(i64),
+        ]
+        lib.gws_get_result.restype = ctypes.c_int32
+        lib.gws_get_result.argtypes = [
+            p, p, u64, ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(u64),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(i64),
+        ]
+        lib.gws_session_ids.restype = i64
+        lib.gws_session_ids.argtypes = [p, p, i64]
+        lib.gws_result_seqs.restype = i64
+        lib.gws_result_seqs.argtypes = [p, p, p, i64]
+        lib.gws_inflight_seqs.restype = i64
+        lib.gws_inflight_seqs.argtypes = [p, p, p, i64]
+        _GWS_CACHED = lib
         return lib
 
 

@@ -54,6 +54,18 @@ constexpr uint32_t kMaxFrame = 16u * 1024u * 1024u;  // 16 MiB (tcp.rs:86)
 // retransmits supersede stale votes) and dropped_frames counts it.
 constexpr size_t kMaxInbox = 65536;
 constexpr int kMaxDialAttempts = 5;                  // tcp.rs:57
+// Session multiplexing (the gateway's client-scaling lane): a peer that
+// handshakes with this magic id runs MANY sessions over ONE connection.
+// Every subsequent frame on a muxed connection carries a 16-byte session
+// id prefix inside the payload: inbound, the prefix becomes the sender
+// id (the gateway authenticates the embedded client id against it, same
+// trust model as the self-declared handshake id); outbound, rt_send to a
+// session id bound on a muxed connection wraps the frame with the
+// prefix so the client side can demultiplex. 10^4 client sessions then
+// cost a handful of sockets (and loadgen reader tasks) instead of 10^4.
+constexpr uint8_t kMuxMagic[16] = {0xF5, 'R', 'A', 'B', 'I', 'A', '-',
+                                   'M',  'U', 'X', 0xF5, 0xF5, 0xF5,
+                                   0xF5, 0xF5, 0xF5};
 constexpr double kDialBaseDelayS = 0.1;              // tcp.rs:58
 constexpr double kDialMaxDelayS = 30.0;              // tcp.rs:60
 constexpr double kRedialPeriodS = 10.0;              // keepalive scan period
@@ -130,6 +142,9 @@ struct Conn {
   // connection), surfacing as a rare receive timeout under CPU load.
   bool draining = false;
   bool shut_wr = false;        // SHUT_WR already issued
+  // session-multiplexed connection (handshake id == kMuxMagic): never
+  // enters `established`/peer dedup; frames carry 16-byte session ids
+  bool mux = false;
   double drain_deadline = 0.0;  // hard close if the peer never EOFs
   // the raw 16-byte handshake id is ALWAYS the first wqueue element
   // and is NOT length-prefixed: it must never be re-routed/stashed as
@@ -176,6 +191,10 @@ struct Transport {
   std::map<int, Conn> conns;                 // fd -> connection
   std::map<NodeIdBytes, int> established;    // peer id -> fd
   std::map<NodeIdBytes, Peer> peers;         // configured dial targets
+  // session id -> fd of the muxed connection carrying it (auto-bound on
+  // the first inbound frame bearing the id; latest binding wins, so a
+  // session migrating to a fresh connection reroutes its replies)
+  std::map<NodeIdBytes, int> mux_sessions;
   std::deque<InboundMsg> inbox;
   std::condition_variable inbox_cv;
   // rt_inbox_kick: spurious-wake generation counter. A waiter samples it
@@ -356,7 +375,18 @@ void Transport::close_conn(int fd) {
     c.woff = 0;
     c.hs_in_queue = false;
   }
-  if (c.handshaken_in && !c.wqueue.empty()) {
+  if (c.mux) {
+    // unbind every session riding this connection; a session that
+    // redials (or already rebound to a newer conn) re-binds on its
+    // first inbound frame there
+    for (auto it = mux_sessions.begin(); it != mux_sessions.end();) {
+      if (it->second == fd)
+        it = mux_sessions.erase(it);
+      else
+        ++it;
+    }
+  }
+  if (c.handshaken_in && !c.mux && !c.wqueue.empty()) {
     // undelivered frames must not die with the socket when the peer is
     // still reachable: re-route whole frames to the established winner
     // (a partially written front frame arrives truncated and is
@@ -492,27 +522,47 @@ void Transport::handle_readable(int fd) {
     memcpy(c.peer.data(), c.rbuf.data(), 16);
     c.handshaken_in = true;
     off = 16;
-    // a dup loser keeps draining: frames already on this socket still
-    // parse and deliver below (sender id is known now either way)
-    establish(fd, c);
+    if (memcmp(c.peer.data(), kMuxMagic, 16) == 0) {
+      // session-multiplexed client connection: many sessions, one
+      // socket. Never enters `established` (two mux conns would
+      // collide on the magic id) and skips the dup tiebreak.
+      c.mux = true;
+    } else {
+      // a dup loser keeps draining: frames already on this socket still
+      // parse and deliver below (sender id is known now either way)
+      establish(fd, c);
+    }
   }
   while (c.rbuf.size() - off >= 4) {
     uint32_t len = static_cast<uint32_t>(c.rbuf[off]) |
                    (static_cast<uint32_t>(c.rbuf[off + 1]) << 8) |
                    (static_cast<uint32_t>(c.rbuf[off + 2]) << 16) |
                    (static_cast<uint32_t>(c.rbuf[off + 3]) << 24);
-    if (len > kMaxFrame) {  // poisoned stream: drop the connection
+    if (len > kMaxFrame || (c.mux && len < 16)) {
+      // poisoned stream (mux frames must carry a session id prefix)
       close_conn(fd);
       return;
     }
     if (c.rbuf.size() - off - 4 < len) break;
     InboundMsg m;
-    m.sender = c.peer;
-    m.data = pool_get_locked(len);
-    m.data.assign(c.rbuf.begin() + off + 4, c.rbuf.begin() + off + 4 + len);
+    if (c.mux) {
+      // [16B session id][inner payload]: the embedded id IS the sender
+      memcpy(m.sender.data(), c.rbuf.data() + off + 4, 16);
+      mux_sessions[m.sender] = fd;  // bind/rebind replies to this conn
+      len -= 16;
+      m.data = pool_get_locked(len);
+      m.data.assign(c.rbuf.begin() + off + 20,
+                    c.rbuf.begin() + off + 20 + len);
+      off += 16;  // consumed the prefix; the tail advance below adds len
+    } else {
+      m.sender = c.peer;
+      m.data = pool_get_locked(len);
+      m.data.assign(c.rbuf.begin() + off + 4,
+                    c.rbuf.begin() + off + 4 + len);
+    }
     bump(RTC_FRAMES_IN);
     bump(RTC_BYTES_IN, len);
-    tf_rec(0, c.peer, len, len >= 2 ? c.rbuf[off + 5] : 0);
+    tf_rec(0, m.sender, len, len >= 2 ? m.data[1] : 0);
     if (inbox.size() >= kMaxInbox) {
       pool_put_locked(std::move(inbox.front().data));
       inbox.pop_front();
@@ -577,7 +627,27 @@ void Transport::drain_out_locked() {
       for (auto& [id, fd] : established) enqueue_shared_locked(fd, m.frame);
     } else {
       auto est = established.find(m.target);
-      if (est != established.end()) enqueue_shared_locked(est->second, m.frame);
+      if (est != established.end()) {
+        enqueue_shared_locked(est->second, m.frame);
+        continue;
+      }
+      auto mx = mux_sessions.find(m.target);
+      if (mx != mux_sessions.end()) {
+        // session on a muxed connection: re-frame with the 16-byte
+        // session id prefix so the client side can demultiplex
+        const auto& f = *m.frame;  // [4B len][payload]
+        uint32_t plen = (uint32_t)(f.size() - 4);
+        auto wrapped = std::make_shared<std::vector<uint8_t>>();
+        wrapped->resize(4 + 16 + plen);
+        uint32_t wl = 16 + plen;
+        (*wrapped)[0] = wl & 0xFF;
+        (*wrapped)[1] = (wl >> 8) & 0xFF;
+        (*wrapped)[2] = (wl >> 16) & 0xFF;
+        (*wrapped)[3] = (wl >> 24) & 0xFF;
+        memcpy(wrapped->data() + 4, m.target.data(), 16);
+        memcpy(wrapped->data() + 20, f.data() + 4, plen);
+        enqueue_shared_locked(mx->second, wrapped);
+      }
     }
   }
 }

@@ -36,6 +36,16 @@ from rabia_tpu.native import load_library
 
 _RECV_BUF_CAP = 16 * 1024 * 1024  # matches the native 16MiB frame cap
 
+# Session-multiplex handshake id (transport.cpp kMuxMagic): a connection
+# that handshakes with this 16-byte id carries MANY sessions — every
+# frame is prefixed with a 16-byte session id inside the payload
+# (inbound: the prefix becomes the sender; outbound: rt_send to a bound
+# session id wraps the frame with it). Client-side speakers (the loadgen
+# mux lane) dial plain TCP, send MUX_MAGIC, then frame as
+# [u32 LE 16+len][session id][payload].
+MUX_MAGIC = bytes([0xF5]) + b"RABIA-MUX" + bytes([0xF5] * 6)
+assert len(MUX_MAGIC) == 16
+
 # Names of the native transport's observability counter block, in RTC_*
 # index order (transport.cpp). Versioned append-only: a newer library may
 # expose more (ignored here), an older one fewer (read as 0).

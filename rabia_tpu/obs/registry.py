@@ -57,12 +57,17 @@ SLO_OCTAVES = 25
 # the rabia_slo_seconds{stage=...} label set (both runtime paths)
 SLO_STAGES: tuple[str, ...] = ("submit_result", "decide_apply", "broadcast")
 
-# the rabia_runtime_stage_seconds{stage=...} label set, in the native
-# RTS_* index order (runtime.cpp); the Python commit-path owner feeds
-# the same names so the family is path-independent
+# the rabia_runtime_stage_seconds{stage=...} label set. The first ten
+# names are in the native RTS_* index order (runtime.cpp); the Python
+# commit-path owner feeds the same names so the family is
+# path-independent. "gateway"/"serialization" are asyncio-owner-only
+# stages (gateway/server.py brackets; engine._stg_ext) that split the
+# control-plane work the r09 profile buried in `other` — the native RTS
+# block has no rows for them (stage_ns returns 0 there).
 RUNTIME_STAGES: tuple[str, ...] = (
     "recv_wait", "ingest", "tick", "apply", "result_staging",
     "broadcast", "cmd", "timers", "idle", "other",
+    "gateway", "serialization",
 )
 
 

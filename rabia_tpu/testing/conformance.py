@@ -762,3 +762,188 @@ def _dump_divergence_flight(tag: str, obs_native: dict, obs_py: dict) -> list:
     except OSError as e:  # a read-only CWD must not mask the divergence
         paths.append(f"<dump failed: {e}>")
     return paths
+
+
+def run_gateway_ops_on_both_tables(
+    ops: Sequence[dict],
+    *,
+    default_window: int = 4,
+    session_ttl: float = 30.0,
+    lease_ttl: float = 120.0,
+    result_cache_cap: int = 4,
+    tag: str = "",
+    require_native: bool = True,
+) -> None:
+    """Native-vs-Python GATEWAY session-table conformance (the gateway
+    plane gate).
+
+    The same op schedule drives the C session/dedup table
+    (native/sessionkernel.cpp via
+    :class:`~rabia_tpu.gateway.native_session.NativeSessionTable`) and
+    the Python :class:`~rabia_tpu.gateway.session.SessionTable` (the
+    semantics owner, what ``RABIA_PY_GATEWAY=1`` forces) through the
+    op-level API the gateway server calls — hello / submit_check /
+    complete_op / abort / gc. Required: identical return values for
+    EVERY op (dedup decisions, byte-identical cached reply payloads,
+    hello grants, gc eviction counts), and — at the end — identical
+    surviving-session sets with identical per-session state (window,
+    ack frontier, highest seq, inflight set, cached seqs, and every
+    cached result byte-for-byte) plus SessionStats parity. Shared by
+    the fixed gate (tests/test_gateway.py) and the randomized fuzz
+    (``fuzz_conformance.py --gateway``), so the two checks cannot
+    drift.
+
+    Each op is a dict: ``{"op": "hello"|"submit"|"complete"|"abort"|
+    "gc", "t": <time>, ...}`` with op-specific fields (``cid``,
+    ``seq``, ``window``, ``ack``, ``status``, ``payload``,
+    ``frontier``, ``sv``).
+    """
+    from rabia_tpu.gateway.native_session import NativeSessionTable
+    from rabia_tpu.gateway.session import SessionTable
+    from rabia_tpu.native.build import load_sessionkernel
+
+    lib = load_sessionkernel()
+    if lib is None:
+        if os.environ.get("RABIA_PY_GATEWAY") == "1":
+            # env-forced Python table: the differential is vacuous BY
+            # DESIGN here (the RABIA_PY_GATEWAY matrix cell exercises
+            # the semantics owner; the main gate runs the differential)
+            return
+        assert not require_native, (
+            f"{tag}: sessionkernel unavailable (build failure?) — "
+            "gateway conformance gate would be vacuous"
+        )
+        return
+    kw = dict(
+        default_window=default_window,
+        session_ttl=session_ttl,
+        result_cache_cap=result_cache_cap,
+        lease_ttl=lease_ttl,
+    )
+    nat = NativeSessionTable(lib, **kw)
+    py = SessionTable(**kw)
+    try:
+        for i, op in enumerate(ops):
+            kind, t = op["op"], op["t"]
+            if kind == "hello":
+                a = py.hello(op["cid"], op.get("window", 0), now=t)
+                b = nat.hello(op["cid"], op.get("window", 0), now=t)
+            elif kind == "submit":
+                a = py.submit_check(
+                    op["cid"], op["seq"], op.get("ack", 0), now=t
+                )
+                b = nat.submit_check(
+                    op["cid"], op["seq"], op.get("ack", 0), now=t
+                )
+            elif kind == "complete":
+                a = py.complete_op(
+                    op["cid"], op["seq"], op["status"], op["payload"],
+                    op["frontier"], now=t,
+                )
+                b = nat.complete_op(
+                    op["cid"], op["seq"], op["status"], op["payload"],
+                    op["frontier"], now=t,
+                )
+            elif kind == "abort":
+                a = py.abort(op["cid"], op["seq"])
+                b = nat.abort(op["cid"], op["seq"])
+            elif kind == "gc":
+                a = py.gc(op["sv"], now=t)
+                b = nat.gc(op["sv"], now=t)
+            else:  # pragma: no cover - schedule generator bug
+                raise ValueError(f"unknown gateway op {kind!r}")
+            assert a == b, (
+                f"{tag}: op {i} ({kind}) diverged: python={a!r} "
+                f"native={b!r}"
+            )
+        # -- end state ------------------------------------------------------
+        py_cids = set(py.sessions.keys())
+        nat_cids = set(nat.session_ids())
+        assert py_cids == nat_cids, (
+            f"{tag}: surviving sessions diverge: python-only="
+            f"{py_cids - nat_cids} native-only={nat_cids - py_cids}"
+        )
+        assert len(py) == len(nat)
+        for cid in sorted(py_cids, key=lambda c: c.bytes):
+            sess = py.sessions[cid]
+            info = nat._info(cid)
+            assert info is not None, f"{tag}: {cid} missing natively"
+            window, ack, highest, n_inflight, n_results = info
+            assert (sess.window, sess.ack_upto, sess.highest_completed) == (
+                window, ack, highest,
+            ), f"{tag}: session {cid} header diverged"
+            assert sorted(sess.inflight) == sorted(
+                nat.inflight_seqs(cid)
+            ), f"{tag}: session {cid} inflight set diverged"
+            assert sorted(sess.results) == nat.result_seqs(cid), (
+                f"{tag}: session {cid} cached seqs diverged"
+            )
+            assert len(sess.results) == n_results
+            for seq, rec in sess.results.items():
+                got = nat.cached_result(cid, seq)
+                assert got == rec, (
+                    f"{tag}: cached result ({cid}, {seq}) diverged: "
+                    f"python={rec!r} native={got!r}"
+                )
+        assert py.stats == nat.stats, (
+            f"{tag}: SessionStats diverged: python={py.stats} "
+            f"native={nat.stats}"
+        )
+    finally:
+        nat.close()
+
+
+def random_gateway_ops(seed: int, n_ops: int = 400) -> list[dict]:
+    """Draw one random gateway-table op schedule (the fuzz generator):
+    a small client pool, seqs from a narrow range (so dup/cached/
+    inflight branches are hit constantly), random completes/aborts that
+    need not match reservations (invalid transitions must diverge
+    NOWHERE), time advancing with occasional jumps past the idle ttl
+    and the hard lease, and gc at random frontiers."""
+    import random
+    import uuid as _uuid
+
+    rng = random.Random(seed)
+    cids = [
+        _uuid.UUID(bytes=rng.getrandbits(128).to_bytes(16, "big"))
+        for _ in range(rng.randint(2, 6))
+    ]
+    t = 1000.0
+    sv = 0
+    ops: list[dict] = []
+    for _ in range(n_ops):
+        t += rng.choice([0.0, 0.01, 0.5, 2.0])
+        if rng.random() < 0.02:
+            t += rng.choice([40.0, 150.0])  # past ttl / past lease
+        r = rng.random()
+        cid = rng.choice(cids)
+        seq = rng.randint(1, 12)
+        if r < 0.10:
+            ops.append({
+                "op": "hello", "t": t, "cid": cid,
+                "window": rng.choice([0, 1, 2, 3, 99]),
+            })
+        elif r < 0.55:
+            ops.append({
+                "op": "submit", "t": t, "cid": cid, "seq": seq,
+                "ack": rng.choice([0, 0, seq - 1, seq]),
+            })
+        elif r < 0.80:
+            nparts = rng.randint(0, 3)
+            payload = tuple(
+                bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 40)))
+                for _ in range(nparts)
+            )
+            sv += rng.randint(0, 3)
+            ops.append({
+                "op": "complete", "t": t, "cid": cid, "seq": seq,
+                "status": rng.choice([0, 1, 2, 3]),
+                "payload": payload, "frontier": sv,
+            })
+        elif r < 0.88:
+            ops.append({"op": "abort", "t": t, "cid": cid, "seq": seq})
+        else:
+            sv += rng.randint(0, 5)
+            ops.append({"op": "gc", "t": t, "sv": sv})
+    ops.append({"op": "gc", "t": t + 1.0, "sv": sv + 1})
+    return ops

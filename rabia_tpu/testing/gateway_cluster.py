@@ -42,6 +42,7 @@ class GatewayCluster:
         n_shards: int = 4,
         config: Optional[RabiaConfig] = None,
         gateway_config: Optional[GatewayConfig] = None,
+        persistence: bool = True,
     ) -> None:
         self.n = n_replicas
         self.n_shards = n_shards
@@ -57,8 +58,15 @@ class GatewayCluster:
         # restarting with NO persistence is outside the engine's supported
         # crash-recovery model (the vote-barrier taint that prevents a
         # restarted proposer from rebinding fresh batches into anciently
-        # decided slots lives in the persistence layer)
-        self.persists = [InMemoryPersistence() for _ in range(n_replicas)]
+        # decided slots lives in the persistence layer).
+        # persistence=False trades restart_replica away for the native
+        # engine runtime (which engages only on persistence-free
+        # native-TCP replicas) — the loadgen SLO harness uses this so
+        # the curve scores the commit path production deploys run.
+        self.persists = [
+            InMemoryPersistence() if persistence else None
+            for _ in range(n_replicas)
+        ]
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -139,6 +147,12 @@ class GatewayCluster:
         catches up the tail via peer Decisions/snapshot sync. The replica
         and gateway rebind their previous ports so peers and clients
         redial transparently."""
+        if self.persists[i] is None:
+            raise RuntimeError(
+                "restart_replica requires persistence "
+                "(GatewayCluster(persistence=True)): restarting with no "
+                "persistence is outside the crash-recovery model"
+            )
         net_port = self.nets[i].port
         gw = self.gateways[i]
         gw_port, gw_node = gw.port, gw.node_id

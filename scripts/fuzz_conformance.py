@@ -461,6 +461,23 @@ def _trial_apply_paths(seed: int) -> None:
     )
 
 
+def _trial_gateway_tables(seed: int) -> None:
+    """Gateway-plane differential: one RANDOM session-table op schedule
+    (hello/submit/complete/abort/gc with time jumps past the idle ttl
+    and the hard lease) through the native sessionkernel table AND the
+    Python SessionTable (the semantics owner) — identical decisions,
+    byte-identical cached reply payloads, identical GC survivors and
+    stats required. Sub-second each."""
+    from rabia_tpu.testing.conformance import (
+        random_gateway_ops,
+        run_gateway_ops_on_both_tables,
+    )
+
+    run_gateway_ops_on_both_tables(
+        random_gateway_ops(seed + 517), tag=f"gateway seed={seed}"
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=30.0)
@@ -491,6 +508,14 @@ def main() -> int:
         "(random scalar+block schedules through the GIL-free runtime "
         "thread over TCP, then with RABIA_PY_RUNTIME=1; identical "
         "decisions/responses/state required; ~8s each)",
+    )
+    ap.add_argument(
+        "--gateway", type=int, default=0,
+        help="additionally run N native-vs-Python gateway session-table "
+        "differential trials (random hello/submit/complete/abort/gc "
+        "schedules through the sessionkernel table and the Python "
+        "SessionTable; identical decisions + byte-identical cached "
+        "replies + identical GC survivors required; sub-second each)",
     )
     ap.add_argument(
         "--mesh", type=int, default=0,
@@ -575,6 +600,11 @@ def main() -> int:
         for i in range(args.apply):
             _trial_apply_paths(args.base_seed + i)
             apply_trials += 1
+    gateway_trials = 0
+    if args.gateway > 0:
+        for i in range(args.gateway):
+            _trial_gateway_tables(args.base_seed + i)
+            gateway_trials += 1
     runtime_trials = 0
     if args.runtime > 0:
         import asyncio
@@ -596,6 +626,11 @@ def main() -> int:
     if runtime_trials:
         extra += (
             f"; {runtime_trials} runtime-path differential schedules "
+            "identical"
+        )
+    if gateway_trials:
+        extra += (
+            f"; {gateway_trials} gateway-table differential schedules "
             "identical"
         )
     if mesh_trials:

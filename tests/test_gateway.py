@@ -103,15 +103,22 @@ class TestSessionTable:
         assert b1.checksum() == b2.checksum()  # command ids match too
         assert b3.id != b1.id
 
-    def test_idle_session_expiry_spares_inflight(self):
-        t = SessionTable(session_ttl=0.0)
+    def test_idle_session_expiry_spares_inflight_until_lease(self):
+        t = SessionTable(session_ttl=10.0, lease_ttl=100.0)
         busy = t.ensure(uuid.uuid4())
         busy.inflight[1] = object()
         idle = t.ensure(uuid.uuid4())
         idle.last_active = busy.last_active = 0.0
-        t.gc(state_version=0, now=1e9)
+        # past the idle ttl but inside the lease: inflight spares it
+        t.gc(state_version=0, now=50.0)
         assert busy.client_id in t.sessions
         assert idle.client_id not in t.sessions
+        # past the HARD lease: dropped even with inflight seqs — a
+        # stalled frontier / wedged engine cannot pin dead sessions
+        t.gc(state_version=0, now=200.0)
+        assert busy.client_id not in t.sessions
+        assert t.stats.leases_expired == 1
+        assert t.stats.sessions_expired == 2
 
 
 class TestGatewayEndToEnd:
@@ -604,3 +611,253 @@ class TestGatewayProtocolFrames:
         legacy_body = bytes([1]) + struct.pack("<Q", 42)
         decoded = _decode_payload(MessageType.AdminRequest, _Reader(legacy_body))
         assert decoded == AdminRequest(kind=1, nonce=42, query=b"")
+
+
+# ---------------------------------------------------------------------------
+# native gateway session plane (sessionkernel.cpp)
+# ---------------------------------------------------------------------------
+
+
+def _session_tables():
+    """Both tables under test, same knobs; [] when the kernel is
+    unavailable (the native id then simply doesn't parametrize)."""
+    from rabia_tpu.gateway.native_session import NativeSessionTable
+    from rabia_tpu.gateway.session import SessionTable
+    from rabia_tpu.native.build import load_sessionkernel
+
+    kw = dict(
+        default_window=4, session_ttl=10.0, lease_ttl=60.0,
+        result_cache_cap=3,
+    )
+    out = [("python", lambda: SessionTable(**kw))]
+    lib = load_sessionkernel()
+    if lib is not None:
+        out.append(("native", lambda: NativeSessionTable(lib, **kw)))
+    return out
+
+
+class TestNativeSessionPlane:
+    def test_kernel_available(self):
+        """The container bakes a toolchain: a silent sessionkernel build
+        failure must fail HERE, not demote every gateway to Python."""
+        import os
+
+        from rabia_tpu.native.build import load_sessionkernel
+
+        if os.environ.get("RABIA_PY_GATEWAY") == "1":
+            pytest.skip("RABIA_PY_GATEWAY=1 forces the Python table")
+        lib = load_sessionkernel()
+        assert lib is not None
+        assert lib.gws_counters_version() == 1
+        from rabia_tpu.gateway.native_session import GWC_COUNTER_NAMES
+
+        assert lib.gws_counters_count() == len(GWC_COUNTER_NAMES)
+
+    @pytest.mark.parametrize(
+        "name,mk", _session_tables(), ids=[n for n, _ in _session_tables()]
+    )
+    def test_gc_under_frontier_stall(self, name, mk):
+        """Regression (the lease satellite): a STALLED frontier — no
+        quorum, state_version pinned — must not pin dead sessions. The
+        idle ttl reaps sessions without inflight; the hard lease reaps
+        even sessions wedged with inflight seqs; the cached results go
+        with them."""
+        t = mk()
+        wedged, idle = uuid.uuid4(), uuid.uuid4()
+        assert t.submit_check(wedged, 1, 0, now=0.0)[0] == 0
+        t.complete_op(wedged, 1, 0, (b"r1",), 5, now=0.0)
+        assert t.submit_check(wedged, 2, 0, now=0.0)[0] == 0  # stays wedged
+        t.hello(idle, 0, now=0.0)
+        # frontier NEVER advances (state_version 0 throughout)
+        assert t.gc(0, now=1.0) == 0
+        assert len(t) == 2
+        # past the idle ttl: the inflight-free session goes, the wedged
+        # one survives (its seq may still complete)
+        t.gc(0, now=11.0)
+        assert t.get(idle) is None and t.get(wedged) is not None
+        # past the hard lease: the wedged session goes too, cached
+        # results and all — nothing is pinned by the stalled frontier
+        evicted = t.gc(0, now=61.1)
+        assert evicted >= 1
+        assert len(t) == 0
+        assert t.stats.leases_expired == 1
+        assert t.stats.sessions_expired == 2
+
+    @pytest.mark.parametrize(
+        "name,mk", _session_tables(), ids=[n for n, _ in _session_tables()]
+    )
+    def test_cache_cap_evicts_lowest_seqs(self, name, mk):
+        t = mk()
+        cid = uuid.uuid4()
+        for seq in range(1, 6):
+            assert t.submit_check(cid, seq, 0, now=0.0)[0] == 0
+            t.complete_op(cid, seq, 0, (b"p%d" % seq,), 1, now=0.0)
+        assert t.gc(0, now=0.1) == 2  # cap 3: seqs 1-2 evicted
+        assert t.cached_result(cid, 1) is None
+        assert t.cached_result(cid, 3).payload == (b"p3",)
+        assert t.cached_result(cid, 5).payload == (b"p5",)
+
+    def test_fixed_conformance_schedule(self):
+        """Deterministic branch-cover schedule through the shared gate
+        (same code path as fuzz --gateway, so the checks cannot
+        drift)."""
+        from rabia_tpu.testing.conformance import (
+            run_gateway_ops_on_both_tables,
+        )
+
+        cid1, cid2 = uuid.UUID(int=1), uuid.UUID(int=2)
+        ops = [
+            {"op": "hello", "t": 0.0, "cid": cid1, "window": 99},
+            {"op": "hello", "t": 0.0, "cid": cid2, "window": 2},
+            {"op": "submit", "t": 0.1, "cid": cid1, "seq": 1},
+            {"op": "submit", "t": 0.1, "cid": cid1, "seq": 1},  # inflight dup
+            {"op": "complete", "t": 0.2, "cid": cid1, "seq": 1,
+             "status": 0, "payload": (b"ok", b""), "frontier": 1},
+            {"op": "submit", "t": 0.3, "cid": cid1, "seq": 1},  # cached dup
+            {"op": "submit", "t": 0.3, "cid": cid2, "seq": 1},
+            {"op": "submit", "t": 0.3, "cid": cid2, "seq": 2},
+            {"op": "submit", "t": 0.3, "cid": cid2, "seq": 3},  # window shed
+            {"op": "abort", "t": 0.4, "cid": cid2, "seq": 2},
+            {"op": "complete", "t": 0.5, "cid": cid2, "seq": 9,
+             "status": 2, "payload": (), "frontier": 2},  # error, empty
+            {"op": "submit", "t": 0.6, "cid": cid1, "seq": 2, "ack": 1},
+            {"op": "gc", "t": 0.7, "sv": 5},   # evicts cid1 seq 1
+            {"op": "gc", "t": 20.0, "sv": 5},  # idle expiry (ttl 30 no)
+            {"op": "gc", "t": 200.0, "sv": 5},  # lease: everything goes
+        ]
+        run_gateway_ops_on_both_tables(ops, tag="fixed-schedule")
+
+    def test_random_conformance_schedules(self):
+        from rabia_tpu.testing.conformance import (
+            random_gateway_ops,
+            run_gateway_ops_on_both_tables,
+        )
+
+        for seed in range(6):
+            run_gateway_ops_on_both_tables(
+                random_gateway_ops(seed), tag=f"seed={seed}"
+            )
+
+    def test_payload_blob_roundtrip(self):
+        from rabia_tpu.gateway.native_session import (
+            pack_payload,
+            unpack_payload,
+        )
+
+        for payload in ((), (b"",), (b"a", b"", b"\x00" * 300), (b"x",) * 9):
+            assert unpack_payload(pack_payload(payload)) == payload
+        # memoryviews pack like bytes (the apply plane's lazy views)
+        assert unpack_payload(
+            pack_payload((memoryview(b"abc"), b"d"))
+        ) == (b"abc", b"d")
+
+
+class TestGatewayMux:
+    @pytest.mark.asyncio
+    async def test_sessions_multiplexed_over_one_connection(self):
+        """The C transport's session-mux lane end-to-end: several
+        protocol-faithful sessions over ONE socket against a live
+        gateway — submits commit, replies demultiplex to the right
+        session, and the dedup cache answers a replay with CACHED."""
+        import importlib
+        import sys
+        from pathlib import Path
+
+        sys.path.insert(
+            0, str(Path(__file__).resolve().parent.parent / "benchmarks")
+        )
+        lg = importlib.import_module("loadgen")
+        from rabia_tpu.core.serialization import Serializer
+
+        cluster = await _spin_up()
+        conn = None
+        try:
+            ser = Serializer()
+            conn = await lg.MuxConn(ser).connect(
+                "127.0.0.1", cluster.gateways[0].port
+            )
+            sessions = [
+                await lg.LoadSession(ser).connect_mux(conn)
+                for _ in range(5)
+            ]
+            assert len(conn.sessions) == 5
+            for i, s in enumerate(sessions):
+                key = f"mux-{i}"
+                res = await s.submit(
+                    _shard(key), [encode_set_bin(key, f"v{i}")], 10.0
+                )
+                assert res.status == ResultStatus.OK
+                assert res.client_id == s.client_id
+            # replay the last seq on session 0: answered from the
+            # session cache, routed back over the same muxed socket
+            s0 = sessions[0]
+            s0._seq -= 1
+            res = await s0.submit(
+                _shard("mux-0"), [encode_set_bin("mux-0", "v0")], 10.0
+            )
+            assert res.status == ResultStatus.CACHED
+            for i in range(5):
+                assert (
+                    cluster.store(0, _shard(f"mux-{i}")).get(f"mux-{i}").value
+                    == f"v{i}"
+                )
+            for s in sessions:
+                await s.close()
+        finally:
+            if conn is not None:
+                await conn.close()
+            await cluster.stop()
+
+
+class TestRuntimeGatewayPlane:
+    @pytest.mark.asyncio
+    async def test_gil_handoffs_flat_across_submit_result(self):
+        """Acceptance: on the native runtime + native gateway plane, a
+        client submit -> committed result round trip leaves the
+        runtime's gil_handoffs counter FLAT while waves_native grows —
+        the commit path never re-enters Python, and the gateway's
+        session bookkeeping rides the C table."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from rabia_tpu.native.build import load_runtime, load_sessionkernel
+
+        if load_runtime() is None:
+            pytest.skip("native runtime library unavailable")
+        if load_sessionkernel() is None:
+            pytest.skip("native sessionkernel library unavailable")
+        from test_runtime import _mk_cluster, _teardown  # noqa: E402
+
+        from rabia_tpu.gateway.server import GatewayServer
+
+        ids, nets, engines, machines, tasks = await _mk_cluster(2, 3)
+        gw = None
+        cli = None
+        try:
+            e0 = engines[0]
+            if e0._rtm is None:
+                pytest.skip("native runtime did not engage")
+            gw = GatewayServer(e0, config=GatewayConfig())
+            await gw.start()
+            assert gw.sessions.is_native
+            assert gw.health()["planes"]["gateway"] == "native"
+            cli = RabiaClient([gw.endpoint], call_timeout=30.0)
+            await cli.connect()
+            # settle, then bracket ONE submit->result round trip
+            await asyncio.sleep(0.3)
+            before = e0._rtm.counters_dict()
+            resp = await cli.submit(0, [encode_set_bin("gilk", "v")])
+            assert decode_kv_response(resp[0]).ok
+            after = e0._rtm.counters_dict()
+            assert after["waves_native"] > before["waves_native"]
+            assert after["gil_handoffs"] == before["gil_handoffs"], (
+                "submit->result round trip required a GIL handoff: "
+                f"{before} -> {after}"
+            )
+        finally:
+            if cli is not None:
+                await cli.close()
+            if gw is not None:
+                await gw.close()
+            await _teardown(engines, tasks, nets)
