@@ -1,0 +1,203 @@
+"""Crash-recovery SLO benchmark: the ``recovery_slo_r11`` curve.
+
+Runs :func:`rabia_tpu.testing.recovery.run_crash_recovery_trial` — a
+3-replica durable cluster of REAL processes on the durability plane
+(WAL + incremental snapshots), kill -9 of one replica under sustained
+client traffic, restart, measured recovery — at increasing state sizes
+(~1x / 10x / 100x a baseline working set), recording for each point:
+
+- ``snapshot_restore_s`` — chain restore into the statekernel;
+- ``wal_replay_s`` + ``waves_replayed`` — post-frontier replay through
+  the same apply path as live traffic;
+- ``rejoin_under_load_s`` — wall time from respawn to the restarted
+  gateway answering a committed submit (the SLO headline);
+- ``post_rejoin_goodput_ok`` — survivor-side goodput after rejoin
+  (must be non-zero: recovery never wedges the cluster).
+
+Preload fans out CONCURRENT multi-op submits so the WAL's group commit
+amortizes the fsyncs (serial preload would measure the disk, not the
+system).
+
+Usage: python benchmarks/recovery_bench.py [--record] [--points 1,10]
+Env knobs: RB_BASE_KEYS (200), RB_VALUE_BYTES (64), RB_OPS_PER_SUBMIT
+(20), RB_PARALLEL (24).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import platform
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from rabia_tpu.apps.kvstore import (  # noqa: E402
+    decode_kv_response,
+    encode_set_bin,
+)
+from rabia_tpu.gateway.client import RabiaClient  # noqa: E402
+from rabia_tpu.testing.recovery import RecoveryHarness  # noqa: E402
+
+N_SHARDS = 4
+
+
+async def _preload(
+    cli: RabiaClient, n_keys: int, value_bytes: int,
+    ops_per_submit: int, parallel: int,
+) -> float:
+    """Concurrent multi-op preload; returns seconds taken."""
+    val = "x" * value_bytes
+    t0 = time.perf_counter()
+    keys = list(range(n_keys))
+    at = 0
+
+    async def one(base: int) -> None:
+        ops = [
+            encode_set_bin(f"key-{k}", val)
+            for k in range(base, min(base + ops_per_submit, n_keys))
+        ]
+        resp = await cli.submit(base % N_SHARDS, ops)
+        assert decode_kv_response(resp[0]).ok
+
+    while at < n_keys:
+        batch = []
+        for _ in range(parallel):
+            if at >= n_keys:
+                break
+            batch.append(one(at))
+            at += ops_per_submit
+        await asyncio.gather(*batch)
+    return time.perf_counter() - t0
+
+
+async def _trial(n_keys: int, value_bytes: int) -> dict:
+    """One sized trial (run_crash_recovery_trial with a fast preload)."""
+    ops_per_submit = int(os.environ.get("RB_OPS_PER_SUBMIT", "20"))
+    parallel = int(os.environ.get("RB_PARALLEL", "24"))
+    kill_index = 2
+    h = RecoveryHarness(3, N_SHARDS)
+    try:
+        h.start()
+        eps = h.endpoints()
+        cli = RabiaClient(
+            [eps[j] for j in range(3) if j != kill_index],
+            call_timeout=60.0,
+        )
+        await cli.connect()
+        preload_s = await _preload(
+            cli, n_keys, value_bytes, ops_per_submit, parallel
+        )
+        h.kill9(kill_index)
+        stop = asyncio.Event()
+        load_ok = 0
+
+        async def loadgen() -> None:
+            nonlocal load_ok
+            k = 0
+            val = "y" * value_bytes
+            while not stop.is_set():
+                try:
+                    resp = await cli.submit(
+                        k % N_SHARDS,
+                        [encode_set_bin(f"load-{k % 500}", val)],
+                    )
+                    if decode_kv_response(resp[0]).ok:
+                        load_ok += 1
+                except Exception:
+                    await asyncio.sleep(0.05)
+                k += 1
+                await asyncio.sleep(0.01)
+
+        load_task = asyncio.ensure_future(loadgen())
+        await asyncio.sleep(1.0)
+        t_restart = time.perf_counter()
+        report = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: h.restart(kill_index, 300.0)
+        )
+        rejoin_cli = RabiaClient([h.endpoints()[kill_index]],
+                                 call_timeout=60.0)
+        await rejoin_cli.connect()
+        rejoined = False
+        deadline = time.time() + 300.0
+        while time.time() < deadline:
+            try:
+                resp = await rejoin_cli.submit(
+                    0, [encode_set_bin("rejoin-probe", "1")]
+                )
+                if decode_kv_response(resp[0]).ok:
+                    rejoined = True
+                    break
+            except Exception:
+                await asyncio.sleep(0.1)
+        rejoin_s = time.perf_counter() - t_restart
+        await rejoin_cli.close()
+        before = load_ok
+        await asyncio.sleep(1.0)
+        stop.set()
+        await load_task
+        await cli.close()
+        rec = report.get("recovery") or {}
+        return {
+            "state_keys": n_keys,
+            "value_bytes": value_bytes,
+            "approx_state_bytes": n_keys * (value_bytes + 8),
+            "preload_s": round(preload_s, 3),
+            "chain_files": rec.get("chain_files"),
+            "snapshot_restore_s": rec.get("snapshot_restore_s"),
+            "wal_records": rec.get("wal_records"),
+            "waves_replayed": rec.get("waves_replayed"),
+            "wal_replay_s": rec.get("wal_replay_s"),
+            "rejoin_under_load_s": round(rejoin_s, 3),
+            "rejoined": rejoined,
+            "post_rejoin_goodput_ok": load_ok - before,
+            "planes": report.get("planes"),
+        }
+    finally:
+        h.stop()
+
+
+def main() -> int:
+    base = int(os.environ.get("RB_BASE_KEYS", "200"))
+    value_bytes = int(os.environ.get("RB_VALUE_BYTES", "64"))
+    mults_arg = next(
+        (a.split("=", 1)[1] for a in sys.argv if a.startswith("--points=")),
+        "1,10,100",
+    )
+    mults = [int(x) for x in mults_arg.split(",") if x]
+    points = []
+    for mult in mults:
+        n_keys = base * mult
+        print(f"-- recovery trial: {n_keys} keys ({mult}x) --", flush=True)
+        row = asyncio.run(_trial(n_keys, value_bytes))
+        row["multiplier"] = mult
+        print(json.dumps(row), flush=True)
+        assert row["rejoined"], f"replica failed to rejoin at {mult}x"
+        assert row["post_rejoin_goodput_ok"] > 0, (
+            f"no post-rejoin goodput at {mult}x"
+        )
+        points.append(row)
+    out = {
+        "host": platform.node(),
+        "cpus": os.cpu_count(),
+        "n_replicas": 3,
+        "n_shards": N_SHARDS,
+        "harness": "testing/recovery.py (kill -9 of a real process, "
+        "restart, rejoin under sustained load)",
+        "points": points,
+    }
+    if "--record" in sys.argv:
+        path = Path(__file__).parent / "results.json"
+        doc = json.loads(path.read_text()) if path.exists() else {}
+        doc["recovery_slo_r11"] = out
+        path.write_text(json.dumps(doc, indent=1))
+        print("recorded -> results.json recovery_slo_r11")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -322,6 +322,112 @@ def _timeline(
     return 0
 
 
+def _wal_dump(directory: str, records: bool, last) -> int:
+    """Render a durability-plane directory (persistence/native_wal.py):
+    segments with base LSNs and CRC status, the snapshot chain with its
+    frontier, the latest vote barrier — and FLAG a torn tail (what a
+    crash mid-group-commit looks like) instead of crashing on it."""
+    from pathlib import Path
+
+    from rabia_tpu.persistence.native_wal import (
+        K_BARRIER,
+        K_FRONTIER,
+        K_LEDGER,
+        K_WAVE,
+        KIND_NAMES,
+        decode_record,
+        read_snap_file,
+        scan_wal,
+    )
+
+    d = Path(directory)
+    if not d.is_dir():
+        print(f"not a directory: {directory}", file=sys.stderr)
+        return 2
+    scan = scan_wal(d)
+    print(f"wal directory: {d}")
+    if not scan.segments:
+        print("  (no segments)")
+    for seg in scan.segments:
+        torn_here = scan.torn is not None and scan.torn["segment"] == seg["index"]
+        status = "TORN" if torn_here else "ok"
+        print(
+            f"  {Path(seg['path']).name}: base_lsn={seg.get('base_lsn', '?')} "
+            f"records={seg['records']} bytes={seg['bytes']} crc={status}"
+        )
+    if scan.torn is not None:
+        t = scan.torn
+        print(
+            f"  !! torn tail: segment {t['segment']} offset {t['offset']} "
+            f"({t['reason']}) — recovery truncates here; records before "
+            f"the tear are the durable prefix"
+        )
+    kinds: dict = {}
+    frontier = None
+    barrier = None
+    for _lsn, _seg, _off, payload in scan.records:
+        rec = decode_record(payload)
+        kinds[rec["kind"]] = kinds.get(rec["kind"], 0) + 1
+        if rec["kind"] == K_FRONTIER:
+            frontier = rec
+        elif rec["kind"] == K_BARRIER:
+            barrier = rec
+    summary = ", ".join(
+        f"{KIND_NAMES.get(k, k)}={n}" for k, n in sorted(kinds.items())
+    )
+    print(f"  records: {len(scan.records)} (lsn 1..{scan.last_lsn}) {summary}")
+    chain = [read_snap_file(p) for p in sorted(d.glob("snap-*.dat"))]
+    for info, p in zip(chain, sorted(d.glob("snap-*.dat"))):
+        if info is None:
+            print(f"  {p.name}: CORRUPT (crc/header)")
+            continue
+        meta = info["meta"]
+        print(
+            f"  {p.name}: {'full' if info['is_full'] else 'delta'} "
+            f"kind={'kv' if info['kind'] else 'blob'} "
+            f"frontier_lsn={info['frontier_lsn']} "
+            f"state_version={meta.get('state_version')} "
+            f"applied={sum(meta.get('applied_upto', []))}"
+        )
+    if frontier is not None:
+        print(
+            f"  snapshot frontier: snap_index={frontier['snap_index']} "
+            f"state_version={frontier['state_version']} "
+            f"applied={sum(frontier['applied'])}"
+        )
+    if barrier is not None:
+        bv = barrier["barrier"]
+        print(
+            f"  vote barrier: max={max(bv) if bv else 0} "
+            f"nonzero_shards={sum(1 for x in bv if x)}"
+        )
+    if records:
+        recs = scan.records
+        if last is not None:
+            recs = recs[-last:]
+        for lsn, seg, off, payload in recs:
+            rec = decode_record(payload)
+            kind = KIND_NAMES.get(rec["kind"], str(rec["kind"]))
+            detail = ""
+            if rec["kind"] == K_WAVE:
+                ops = rec["ops"]
+                bid = rec["bid"]
+                detail = (
+                    f" shard={rec['shard']} slot={rec['slot']} "
+                    f"value={rec['value']} ops={len(ops) if ops else 0}"
+                    f" bid={'-' if not bid or not any(bid) else bid.hex()[:16]}"
+                )
+            elif rec["kind"] == K_LEDGER:
+                detail = (
+                    f" shard={rec['shard']} slot={rec['slot']} "
+                    f"bid={rec['bid'].hex()[:16]}"
+                )
+            elif rec["kind"] == K_FRONTIER:
+                detail = f" snap_index={rec['snap_index']}"
+            print(f"  lsn={lsn} seg={seg} off={off} {kind}{detail}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m rabia_tpu",
@@ -400,7 +506,24 @@ def main(argv=None) -> int:
         "--out", default=None, help="also write merged rows to this file"
     )
     tl.add_argument("--timeout", type=float, default=10.0)
+    wd = sub.add_parser(
+        "wal-dump",
+        help="inspect a replica's durability-plane directory: segment "
+        "headers, wave records, CRC status, snapshot frontier "
+        "(docs/DURABILITY.md)",
+    )
+    wd.add_argument("dir", help="WAL directory (one replica's)")
+    wd.add_argument(
+        "--records", action="store_true",
+        help="also print every record (default: per-segment summaries)",
+    )
+    wd.add_argument(
+        "--last", type=int, default=None,
+        help="with --records: only the last N records",
+    )
     args = ap.parse_args(argv)
+    if args.cmd == "wal-dump":
+        return _wal_dump(args.dir, args.records, args.last)
     if args.cmd == "stats":
         return _stats(
             args.addr, args.kind, args.timeout,

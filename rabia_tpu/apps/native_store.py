@@ -74,6 +74,8 @@ SK_COUNTER_NAMES = (
     "bytes_in",
     "bytes_out",
     "rehashes",
+    "delta_snapshots",
+    "delta_entries",
 )
 
 class NativeResultGroup(Sequence):  # type: ignore[type-arg]
@@ -458,8 +460,46 @@ class NativeStorePlane:
             pos += 32 + klen + vlen
         return out
 
+    def snapshot_delta(self, idx: int) -> Optional[bytes]:
+        """The store's incremental-snapshot frame: entries mutated since
+        the last :meth:`snapshot_mark`, plus the deletion log and clear
+        flag (statekernel.cpp delta format). Returns None when only a
+        FULL snapshot is faithful (deletion-log overflow) — the caller
+        falls back to :meth:`export_entries`. Does NOT advance the mark;
+        call :meth:`snapshot_mark` once the frame is durable."""
+        self.lib.sk_plane_lock(self.handle)
+        try:
+            need = int(self.lib.sk_snapshot_delta_size(self.handle, idx))
+            if need == -3:
+                return None
+            if need < 0:
+                raise StoreError(
+                    StoreErrorKind.Internal, "sk_snapshot_delta_size failed"
+                )
+            buf = np.empty(max(need, 1), np.uint8)
+            got = int(
+                self.lib.sk_snapshot_delta(
+                    self.handle, idx, buf.ctypes.data, need
+                )
+            )
+        finally:
+            self.lib.sk_plane_unlock(self.handle)
+        if got == -3:
+            return None
+        if got < 0:
+            raise StoreError(StoreErrorKind.Internal, "sk_snapshot_delta failed")
+        return buf[:got].tobytes()
+
+    def snapshot_mark(self, idx: int) -> None:
+        self.lib.sk_snapshot_mark(self.handle, idx)
+
     def clear_store(self, idx: int) -> None:
         self.lib.sk_clear_store(self.handle, idx)
+
+    def delete_raw(self, idx: int, key: bytes) -> bool:
+        """Restore-path delete: no stats, no version bump, no deletion
+        log (the frame being restored already records it)."""
+        return self.lib.sk_delete_raw(self.handle, idx, key, len(key)) == 1
 
     def insert_raw(
         self, idx: int, key: bytes, val: bytes, version: int,

@@ -51,6 +51,7 @@ import numpy as np
 from rabia_tpu.core.blocks import PayloadBlock
 from rabia_tpu.core.config import RabiaConfig
 from rabia_tpu.core.errors import (
+    PersistenceError,
     QuorumNotAvailableError,
     RabiaError,
     ResponsesUnavailableError,
@@ -98,6 +99,7 @@ from rabia_tpu.obs.flight import (
     FRE_STALE,
     FRE_STEP_DECIDE,
     FRE_SUBMIT,
+    FRE_WAL,
     fr_hash,
 )
 from rabia_tpu.core.types import (
@@ -482,8 +484,21 @@ class RabiaEngine:
         # (engine/runtime_bridge.py). RABIA_PY_RUNTIME=1 forces today's
         # asyncio orchestration, which stays the semantics owner behind
         # the run_schedule_on_runtime_paths conformance gate.
+        # durability plane (persistence/native_wal.py): when the
+        # persistence layer is a WAL, decided waves stage into it from
+        # the apply paths and the vote barrier rides its group-commit
+        # lane — which is what lets the native runtime engage on a
+        # durable cluster (the historical persistence gate below)
+        self._wal = (
+            persistence
+            if getattr(persistence, "supports_wal", False)
+            else None
+        )
         self._rtm = None
-        if self._rk is not None and persistence is None:
+        if self._rk is not None and (
+            persistence is None
+            or (self._wal is not None and getattr(self._wal, "native", False))
+        ):
             try:
                 from rabia_tpu.engine.runtime_bridge import (
                     RuntimeBridge,
@@ -826,6 +841,66 @@ class RabiaEngine:
                 "or asyncio-loop accounting; `rabia_tpu profile` renders)",
                 {"stage": sname},
                 fn=lambda s=sname: self.stage_second(s),
+            )
+        # -- durability plane (walkernel WLC counter block / Python twin
+        #    tallies — persistence/native_wal.py), when the persistence
+        #    layer is a WAL --------------------------------------------
+        wal = self._wal
+        if wal is not None:
+            from rabia_tpu.persistence.native_wal import WAL_COUNTER_NAMES
+
+            m.gauge(
+                "wal_native",
+                "1 when walkernel.cpp owns the WAL writer (0 = the "
+                "RABIA_PY_WAL Python twin)",
+                fn=lambda: 1 if wal.native else 0,
+            )
+            for name in WAL_COUNTER_NAMES:
+                if name == "fsync_ns":
+                    continue  # exported as wal_fsync_seconds_total below
+                m.counter(
+                    f"wal_{name}_total",
+                    "Durability-plane counter (walkernel WLC block)",
+                    fn=lambda r=name: wal.counters_dict().get(r, 0),
+                )
+            m.counter(
+                "wal_fsync_seconds_total",
+                "Cumulative seconds spent in WAL fsync (flush thread)",
+                fn=lambda: wal.counters_dict().get("fsync_ns", 0) / 1e9,
+            )
+            m.gauge(
+                "wal_staged_lsn", "Last staged WAL record LSN",
+                fn=wal.staged_lsn,
+            )
+            m.gauge(
+                "wal_durable_lsn",
+                "Durability watermark: last fsynced WAL record LSN",
+                fn=wal.durable_lsn,
+            )
+            m.counter(
+                "wal_checkpoints_total",
+                "Incremental snapshot checkpoints written",
+                fn=lambda: wal.checkpoints,
+            )
+            m.counter(
+                "wal_gc_segments_total",
+                "WAL segments garbage-collected below the snapshot frontier",
+                fn=lambda: wal.gc_segments,
+            )
+
+            def wal_hist():
+                h = wal.fsync_hist()
+                if h is None:
+                    return None
+                counts, count, sum_ns = h
+                return counts, count, sum_ns / 1e9
+
+            m.histogram(
+                "wal_fsync_seconds",
+                "WAL fsync latency (group-commit flush thread; native "
+                "WLH block, SLO bucket geometry)",
+                buckets=SLO_BUCKETS,
+                fn=wal_hist,
             )
         # -- transport (native counter block, when the transport has one)
         tc = getattr(self.transport, "transport_counters", None)
@@ -1175,7 +1250,19 @@ class RabiaEngine:
 
     async def initialize(self) -> None:
         """Restore persisted state then join the cluster (engine.rs:238-269)."""
-        if self.persistence is not None:
+        if self._wal is not None:
+            # durability plane: snapshot-chain restore + WAL replay
+            # through the same apply path as live traffic
+            # (docs/DURABILITY.md recovery walkthrough)
+            report = self._wal.recover_engine(self)
+            self.flight.record(
+                FRE_WAL, shard=0, slot=report["waves_replayed"], arg=1,
+            )
+            if self._rtm is not None:
+                # mirror the restored frontiers into the bridge before
+                # the runtime thread starts (it owns the columns after)
+                self._rtm.adopt_restored_frontiers()
+        elif self.persistence is not None:
             persisted = await self.persistence.load_engine_state()
             if persisted is not None:
                 if persisted.snapshot is not None:
@@ -1958,6 +2045,25 @@ class RabiaEngine:
                 if want and responses is not None:
                     for bi, resp in zip(bsel, responses):
                         rec.out.settle(int(bi), resp)
+                if self._wal is not None:
+                    # durability plane: stage each applied entry with its
+                    # ops (slices of the block payload) under the SAME
+                    # deterministic batch id the scalar lane would use,
+                    # so recovery repopulates the dedup ledger correctly
+                    blk = rec.block
+                    boffs = blk.cmd_offsets
+                    bstarts = blk.shard_starts
+                    bdata = blk.data
+                    for j, bi in zip(sel, bsel):
+                        lo, hi = int(bstarts[bi]), int(bstarts[bi + 1])
+                        self._wal_stage(
+                            int(idx[j]), int(slots[j]), 1,
+                            bid_bytes=blk.batch_id_for(int(bi)).value.bytes,
+                            ops=[
+                                bytes(bdata[boffs[k] : boffs[k + 1]])
+                                for k in range(lo, hi)
+                            ],
+                        )
                 self._unref_block(int(ref), len(bsel))
             rt.state_version += int(v1.sum()) - len(lost)
             good = (
@@ -1979,6 +2085,11 @@ class RabiaEngine:
             if len(idx) == 0:
                 return
 
+        if self._wal is not None and (~v1).any():
+            # V0 slots stage payload-less frontier records (replay
+            # advances past them without applying anything)
+            for j in np.nonzero(~v1)[0]:
+                self._wal_stage(int(idx[j]), int(slots[j]), 0)
         # columnar bookkeeping for the whole wave. Flight records are
         # BOUNDED per wave: this is the vectorized bulk lane (tens of
         # thousands of decisions/s), where per-slot Python records would
@@ -3203,6 +3314,30 @@ class RabiaEngine:
 
     # -- decision application ------------------------------------------------
 
+    def _wal_stage(
+        self, s: int, slot: int, value: int, batch=None, bid_bytes=None,
+        ops=None,
+    ) -> None:
+        """Stage one decided (shard, slot) into the durability plane's
+        group-commit lane (no fsync here — the WAL's flush thread owns
+        that; the gateway's result barrier waits on the watermark). A
+        wedged log is journaled, never allowed to kill the apply path —
+        results stop leaving (the barrier fails) which is the correct
+        failure mode for lost durability."""
+        p = self._wal
+        if p is None:
+            return
+        if batch is not None:
+            bid_bytes = batch.id.value.bytes
+            ops = [c.data for c in batch.commands]
+        try:
+            p.stage_wave(int(s), int(slot), int(value), bid_bytes, ops)
+        except PersistenceError:
+            logger.exception("wal stage failed (shard %d slot %d)", s, slot)
+            self.journal.record(
+                self.journal.WAL_WEDGED, shard=int(s), slot=int(slot)
+            )
+
     def _apply_ready(self) -> int:
         """Apply decided slots in order per shard, through the pipelined
         apply stage (engine/apply_plane.py): up to the inline budget
@@ -3227,6 +3362,7 @@ class RabiaEngine:
             if applied >= budget:
                 return applied, True
             slot = sh.applied_upto
+            wal_batch = None  # set iff this slot actually applies a batch
             rec = sh.decisions.get(slot)
             if rec is None or rec.applied:
                 if rec is None:
@@ -3275,6 +3411,7 @@ class RabiaEngine:
                         responses = None
                     sh.applied_ids[rec.batch_id] = None
                     sh.applied_results[rec.batch_id] = responses
+                    wal_batch = batch
                     self.rt.state_version += 1
                     self.rt.v1_applied[s] += 1
                     if responses is not None:
@@ -3284,6 +3421,11 @@ class RabiaEngine:
             else:
                 self._requeue_null_slot(sh, slot, rec)
             rec.applied = True
+            if self._wal is not None:
+                # durability plane: stage the decided wave exactly as
+                # applied (ops for a V1 apply; V0 / dedup-skip slots
+                # stage payload-less frontier records)
+                self._wal_stage(s, slot, int(rec.value), batch=wal_batch)
             self.flight.record(
                 FRE_APPLY, shard=s, slot=slot, arg=int(rec.value),
                 batch=(
@@ -3672,6 +3814,14 @@ class RabiaEngine:
                 self.rt.shards[s].applied_ids.setdefault(bid, None)
         self.rt.sync_responses.clear()
         self._frontier_dirty = True
+        if self._wal is not None:
+            # the adopted slots never staged WAL records here: until a
+            # checkpoint captures the adopted state, a crash would
+            # recover a pre-adoption chain with a slot gap (replay stops
+            # at the gap and re-syncs — correct but slow). Pull the next
+            # checkpoint forward.
+            self._dirty = True
+            self._wal.request_checkpoint()
         logger.info("%s sync: jumped to %d applied", self.node_id.short(), best[0])
 
     # -- periodic chores -----------------------------------------------------
@@ -3776,8 +3926,13 @@ class RabiaEngine:
                 self._blk_registry.pop(ref)
                 self._last_blk_retransmit.pop(ref, None)
         if self._dirty:
-            self._dirty = False
-            await self._save_state()
+            # durability plane: decided waves are ALREADY durable in the
+            # log — checkpoints only bound recovery time and enable GC,
+            # so they run on the WalPersistence pacing (bytes appended /
+            # elapsed time), not once per dirty tick like the blob path
+            if self._wal is None or self._wal.checkpoint_due():
+                self._dirty = False
+                await self._save_state()
 
     def _gc(self) -> None:
         """Bound memory: drop old buffers + seen-batch ids (state.rs:191-243)."""
@@ -3819,6 +3974,41 @@ class RabiaEngine:
 
     async def _save_state(self) -> None:
         if self.persistence is None:
+            return
+        if self._wal is not None:
+            # durability plane: incremental checkpoint (statekernel delta
+            # frames when the native plane exists, a full snapshot blob
+            # otherwise) + frontier record + WAL-prefix GC. Decided waves
+            # are already durable in the log — the checkpoint only bounds
+            # recovery time and enables GC, so it runs on the
+            # WalPersistence pacing, not per dirty tick.
+            def _meta() -> dict:
+                n = self.n_shards
+                return {
+                    "next_slot": self.rt.next_slot[:n].tolist(),
+                    "applied_upto": self.rt.applied_upto[:n].tolist(),
+                    "state_version": int(self.rt.state_version),
+                    "v1_applied": self.rt.v1_applied[:n].tolist(),
+                    "sm_version": int(getattr(self.sm, "_version", 0)),
+                }
+
+            # the runtime thread owns the statekernel while running: the
+            # capture (meta read + delta export + mark + frontier read)
+            # happens atomically under pause; file write + GC run unpaused
+            if self._rtm is not None:
+                with self._rtm.paused():
+                    cap = self._wal.capture_checkpoint(_meta(), self.sm)
+            else:
+                cap = self._wal.capture_checkpoint(_meta(), self.sm)
+            try:
+                await self._wal.commit_checkpoint(cap)
+            except PersistenceError:
+                logger.exception("wal checkpoint commit failed")
+                self.journal.record(self.journal.WAL_WEDGED, stage="ckpt")
+                return
+            self.flight.record(
+                FRE_WAL, shard=0, slot=self._wal.checkpoints, arg=2,
+            )
             return
         snap = self.sm.create_snapshot()
         state = PersistedEngineState(

@@ -119,6 +119,9 @@ _FN_ORDER = (
     "sk_out_offs",
     "sk_plane_lock",
     "sk_plane_unlock",
+    "wal_append",
+    "wal_barrier_covered",
+    "wal_durable",
 )
 
 
@@ -131,11 +134,16 @@ def runtime_available(engine) -> bool:
     if engine._rk is None or not engine._host_kernel:
         return False
     if engine.persistence is not None:
-        # the write-ahead vote barrier must be durable BEFORE a slot's
-        # first vote reaches the wire; the runtime thread cannot await
-        # that — durable deployments stay on the asyncio orchestration
-        # until the native WAL lands (ROADMAP item 3)
-        return False
+        # a durable cluster runs the GIL-free commit path only on the
+        # durability plane (persistence/native_wal.py with the C
+        # walkernel writer): decided waves stage from the C apply stage
+        # and the vote-barrier write-ahead gates opens on the
+        # group-commit watermark. Blob persistence — and the
+        # RABIA_PY_WAL Python twin, which the C thread cannot call —
+        # stay on the asyncio orchestration.
+        wal = getattr(engine, "_wal", None)
+        if wal is None or not getattr(wal, "native", False):
+            return False
     t = engine.transport
     if not getattr(t, "_handle", None) or getattr(t, "_lib", None) is None:
         return False
@@ -185,6 +193,19 @@ class RuntimeBridge:
                 sk_plane_lock=sk_plane.lib,
                 sk_plane_unlock=sk_plane.lib,
             )
+        # durability plane: the C writer's append/barrier/watermark entry
+        # points, so the io/tick thread stages decided waves and gates
+        # opens on the vote barrier without ever touching Python
+        self._wal = getattr(e, "_wal", None)
+        wal_handle = 0
+        if self._wal is not None and getattr(self._wal, "native", False):
+            wlib = self._wal._writer.lib
+            wal_handle = int(self._wal._writer.handle)
+            fn_libs.update(
+                wal_append=wlib,
+                wal_barrier_covered=wlib,
+                wal_durable=wlib,
+            )
         fns = np.zeros(len(_FN_ORDER), np.int64)
         for i, name in enumerate(_FN_ORDER):
             flib = fn_libs.get(name)
@@ -227,6 +248,7 @@ class RuntimeBridge:
                 kst.decided.ctypes.data,
                 kst.done.ctypes.data,
                 rk.newly.ctypes.data,
+                wal_handle,
             ],
             np.int64,
         )
@@ -319,6 +341,15 @@ class RuntimeBridge:
         )
 
     # -- lifecycle -----------------------------------------------------------
+
+    def adopt_restored_frontiers(self) -> None:
+        """Re-mirror the event-ordered applied frontier after a WAL
+        recovery rewrote the runtime columns (the bridge snapshotted them
+        at construction, BEFORE ``initialize`` restored state). Must run
+        before :meth:`start` — afterwards the runtime thread is the
+        single writer and the mirror only moves on events."""
+        e = self.engine
+        self._applied[:] = e.rt.applied_upto[: e.n_shards]
 
     def start(self) -> None:
         """Detach the transport's Python reader (the runtime thread owns
@@ -945,6 +976,7 @@ class RuntimeBridge:
         applied = int(self._applied[s])
         advanced = False
         while True:
+            wal_batch = None  # set iff this slot actually applies a batch
             rec = sh.decisions.get(applied)
             if rec is None:
                 break
@@ -987,6 +1019,7 @@ class RuntimeBridge:
                         responses = None
                     sh.applied_ids[rec.batch_id] = None
                     sh.applied_results[rec.batch_id] = responses
+                    wal_batch = batch
                     e.rt.state_version += 1
                     e.rt.v1_applied[s] += 1
                     if responses is not None:
@@ -1000,6 +1033,11 @@ class RuntimeBridge:
             else:
                 e._requeue_null_slot(sh, applied, rec)
             rec.applied = True
+            if e._wal is not None:
+                # durability plane: the scalar lane applies in Python on
+                # the runtime path, so it stages here (the C thread
+                # stages only the waves it applies itself)
+                e._wal_stage(s, applied, int(rec.value), batch=wal_batch)
             e.flight.record(
                 FRE_APPLY, shard=s, slot=applied, arg=int(rec.value),
                 batch=(
@@ -1077,6 +1115,23 @@ class RuntimeBridge:
             n_av1 = int(applied_v1.sum())
             rt.state_version += n_av1
             np.add.at(rt.v1_applied, shards[applied_v1], 1)
+            if e._wal is not None and breg is not None and n_av1:
+                # durability plane: the C thread staged these waves with
+                # a zero batch-id field (it cannot derive deterministic
+                # ids); backfill (shard, slot) -> bid with K_LEDGER
+                # records OFF the commit path so recovery repopulates
+                # the dedup ledger
+                for j in np.nonzero(applied_v1)[0]:
+                    try:
+                        e._wal.stage_ledger(
+                            int(shards[j]), int(slots[j]),
+                            breg.block.batch_id_for(
+                                int(ents["bidx"][j])
+                            ).value.bytes,
+                        )
+                    except Exception:
+                        logger.exception("wal ledger stage failed")
+                        break
             if breg is not None:
                 # own block: settle the V1 futures, demote the V0 entries
                 if out is not None:
@@ -1152,6 +1207,8 @@ class RuntimeBridge:
                 else:
                     e._unref_block(ref, 1)
             if int(self._applied[s]) == slot:
+                if e._wal is not None:
+                    e._wal_stage(s, slot, 0)
                 self._applied[s] = slot + 1
                 adv.append((s, slot + 1))
         if v1:
@@ -1217,6 +1274,23 @@ class RuntimeBridge:
                     if want and responses is not None:
                         for (s_, sl_, bi), resp in zip(in_order, responses):
                             breg.out.settle(int(bi), resp)
+                    if e._wal is not None:
+                        boffs = block.cmd_offsets
+                        bstarts = block.shard_starts
+                        bdata = block.data
+                        for s, slot, bi in in_order:
+                            lo = int(bstarts[bi])
+                            hi = int(bstarts[bi + 1])
+                            e._wal_stage(
+                                s, slot, 1,
+                                bid_bytes=block.batch_id_for(
+                                    int(bi)
+                                ).value.bytes,
+                                ops=[
+                                    bytes(bdata[boffs[k] : boffs[k + 1]])
+                                    for k in range(lo, hi)
+                                ],
+                            )
                     for s, slot, _bi in in_order:
                         e.rt.state_version += 1
                         e.rt.v1_applied[s] += 1

@@ -902,6 +902,20 @@ class GatewayServer:
             # cached and the client must use a new seq to retry
             status = ResultStatus.ERROR
             payload = (str(e).encode(),)
+        # durability barrier (docs/DURABILITY.md): on a WAL cluster the
+        # decided wave's record must survive an fsync BEFORE this seq's
+        # result frame leaves the replica. The wave was staged at apply
+        # (before the submit future settled, on both runtime paths), so
+        # one group-amortized wait on the current watermark covers it.
+        wal = getattr(self.engine, "_wal", None)
+        if wal is not None and status == ResultStatus.OK:
+            try:
+                await wal.durability_barrier()
+            except Exception as e:
+                # lost durability must not ack: terminal for this seq
+                # (cached; the client retries under a new seq)
+                status = ResultStatus.ERROR
+                payload = (f"durability barrier failed: {e}".encode(),)
         # result staging to the session plane: one table op drops the
         # inflight reservation and caches (status, payload, frontier) —
         # on the native plane the payload views (the apply plane's lazy

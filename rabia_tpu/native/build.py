@@ -348,6 +348,8 @@ def load_statekernel() -> ctypes.CDLL | None:
         lib.sk_export.argtypes = [p, i64, p, i64]
         lib.sk_clear_store.restype = None
         lib.sk_clear_store.argtypes = [p, i64]
+        lib.sk_delete_raw.restype = ctypes.c_int32
+        lib.sk_delete_raw.argtypes = [p, i64, p, i64]
         lib.sk_insert_raw.restype = ctypes.c_int32
         lib.sk_insert_raw.argtypes = [
             p, i64, p, i64, p, i64,
@@ -367,6 +369,13 @@ def load_statekernel() -> ctypes.CDLL | None:
         lib.sk_out_offs.argtypes = [p]
         lib.sk_out_count.restype = i64
         lib.sk_out_count.argtypes = [p]
+        # incremental snapshots (durability plane)
+        lib.sk_snapshot_delta_size.restype = i64
+        lib.sk_snapshot_delta_size.argtypes = [p, i64]
+        lib.sk_snapshot_delta.restype = i64
+        lib.sk_snapshot_delta.argtypes = [p, i64, p, i64]
+        lib.sk_snapshot_mark.restype = None
+        lib.sk_snapshot_mark.argtypes = [p, i64]
         # read-side critical-section brackets (native-runtime hook)
         lib.sk_plane_lock.restype = None
         lib.sk_plane_lock.argtypes = [p]
@@ -611,6 +620,98 @@ def load_sessionkernel() -> ctypes.CDLL | None:
         lib.gws_inflight_seqs.restype = i64
         lib.gws_inflight_seqs.argtypes = [p, p, p, i64]
         _GWS_CACHED = lib
+        return lib
+
+
+_WAL_CACHED: ctypes.CDLL | None = None
+_WAL_FAILED: str | None = None
+
+
+def _wal_path() -> Path:
+    digest = hashlib.blake2s(
+        (_HERE / "walkernel.cpp").read_bytes(), digest_size=8
+    ).hexdigest()
+    return _HERE / f"_walkernel_{digest}.so"
+
+
+def load_walkernel() -> ctypes.CDLL | None:
+    """Build (if needed) and dlopen the native durability-plane library
+    (walkernel.cpp: the group-commit write-ahead log). Returns the CDLL
+    with prototypes set, or None when unavailable — WalPersistence falls
+    back to the pure-Python writer, which stays the semantics owner of
+    the byte format (``RABIA_PY_WAL=1`` forces it; the conformance
+    gate's second leg)."""
+    global _WAL_CACHED, _WAL_FAILED
+    if os.environ.get("RABIA_PY_WAL") == "1":
+        return None
+    with _LOCK:
+        if _WAL_CACHED is not None:
+            return _WAL_CACHED
+        if _WAL_FAILED is not None:
+            return None
+        try:
+            target = _wal_path()
+            if not target.exists():
+                _compile(
+                    (_HERE / "walkernel.cpp"), target, ["-O2", "-pthread"],
+                    "_walkernel_*.so", "walkernel", link_args=["-lz"],
+                )
+            lib = ctypes.CDLL(os.fspath(target))
+        except Exception as e:  # noqa: BLE001 - any failure means fallback
+            _WAL_FAILED = str(e)
+            return None
+        p = ctypes.c_void_p
+        i64 = ctypes.c_int64
+        u64 = ctypes.c_uint64
+        lib.wal_create.restype = ctypes.c_void_p
+        lib.wal_create.argtypes = [
+            ctypes.c_char_p, i64, i64, i64, u64, u64,
+        ]
+        lib.wal_start.restype = ctypes.c_int32
+        lib.wal_start.argtypes = [p]
+        lib.wal_stop.restype = None
+        lib.wal_stop.argtypes = [p]
+        lib.wal_destroy.restype = None
+        lib.wal_destroy.argtypes = [p]
+        lib.wal_append.restype = i64
+        lib.wal_append.argtypes = [p, p, i64]
+        lib.wal_durable.restype = u64
+        lib.wal_durable.argtypes = [p]
+        lib.wal_staged.restype = u64
+        lib.wal_staged.argtypes = [p]
+        lib.wal_io_error.restype = ctypes.c_int32
+        lib.wal_io_error.argtypes = [p]
+        lib.wal_event_fd.restype = ctypes.c_int
+        lib.wal_event_fd.argtypes = [p]
+        lib.wal_sync.restype = ctypes.c_int32
+        lib.wal_sync.argtypes = [p, ctypes.c_double]
+        lib.wal_barrier_covered.restype = i64
+        lib.wal_barrier_covered.argtypes = [p, i64, i64]
+        lib.wal_set_barrier.restype = None
+        lib.wal_set_barrier.argtypes = [p, p, i64]
+        lib.wal_get_barrier.restype = None
+        lib.wal_get_barrier.argtypes = [p, p, i64]
+        lib.wal_counters_version.restype = ctypes.c_int32
+        lib.wal_counters_version.argtypes = []
+        lib.wal_counters_count.restype = ctypes.c_int32
+        lib.wal_counters_count.argtypes = []
+        lib.wal_counters.restype = ctypes.c_void_p
+        lib.wal_counters.argtypes = [p]
+        lib.wal_hist_version.restype = ctypes.c_int32
+        lib.wal_hist_version.argtypes = []
+        lib.wal_hist_buckets.restype = ctypes.c_int32
+        lib.wal_hist_buckets.argtypes = []
+        lib.wal_hist_sub_bits.restype = ctypes.c_int32
+        lib.wal_hist_sub_bits.argtypes = []
+        lib.wal_hist_min_exp.restype = ctypes.c_int32
+        lib.wal_hist_min_exp.argtypes = []
+        lib.wal_hist.restype = ctypes.c_void_p
+        lib.wal_hist.argtypes = [p]
+        lib.wal_segment_index.restype = i64
+        lib.wal_segment_index.argtypes = [p]
+        lib.wal_segment_bytes.restype = i64
+        lib.wal_segment_bytes.argtypes = [p]
+        _WAL_CACHED = lib
         return lib
 
 

@@ -66,6 +66,11 @@ typedef int64_t (*fn_sk_apply_wave_t)(void*, const uint8_t*, const int64_t*,
                                       int32_t);
 typedef void* (*fn_sk_ptr_t)(void*);
 typedef void (*fn_sk_plane_lk_t)(void*);
+// durability plane (walkernel.cpp): stage a record / advance the vote
+// barrier / read the durability watermark — all lock-cheap, never disk
+typedef int64_t (*fn_wal_append_t)(void*, const uint8_t*, int64_t);
+typedef int64_t (*fn_wal_barrier_t)(void*, int64_t, int64_t);
+typedef uint64_t (*fn_wal_durable_t)(void*);
 
 enum : int32_t {
   FN_RECV_BORROW = 0,
@@ -81,6 +86,9 @@ enum : int32_t {
   FN_SK_OUT_OFFS,
   FN_SK_PLANE_LOCK,
   FN_SK_PLANE_UNLOCK,
+  FN_WAL_APPEND,
+  FN_WAL_BARRIER,
+  FN_WAL_DURABLE,
   FN_COUNT
 };
 
@@ -385,6 +393,7 @@ struct RtmCtx {
   void* rk;
   void* tr;
   void* sk;
+  void* wal = nullptr;  // durability plane (walkernel.cpp), or null
   void* fns[FN_COUNT];
 
   // engine columns (borrowed; single-writer = this thread while RUNNING)
@@ -413,6 +422,10 @@ struct RtmCtx {
   std::vector<std::vector<uint8_t>> sp_frame;  // propose frame to emit
   std::vector<double> stall_ev_at;       // EV_STALL rate limit per shard
   std::vector<double> votes_wait_at;     // kind-2 escalation rate limit
+  // vote-barrier write-ahead (durability plane): a shard whose next
+  // open outran the durable barrier parks here until the group-commit
+  // fsync covers the barrier record's LSN
+  std::vector<int64_t> bar_wait;
 
   std::map<int64_t, CBlk> blocks;
   int64_t next_blk = 1;
@@ -1048,6 +1061,44 @@ static void process_decided(RtmCtx* c, double now) {
         ((fn_sk_plane_lk_t)c->fns[FN_SK_PLANE_UNLOCK])(c->sk);
       c->ctrs[RTM_SLOTS_APPLIED] += (uint64_t)idxs.size();
     }
+    if (c->wal && native) {
+      // durability plane: stage each in-order entry of the wave into
+      // the WAL's group-commit lane BEFORE its EV_WAVE record reaches
+      // Python — the gateway's result barrier then only has to wait on
+      // the watermark. Payload layout = native_wal.encode_wave (the
+      // Python twin is the semantics owner; keep byte-identical). The
+      // batch id field is zeros here — the control plane backfills it
+      // with a K_LEDGER record off the commit path (C never derives
+      // deterministic batch ids).
+      const uint64_t w0 = mono_ns();
+      std::vector<uint8_t> pay;
+      for (size_t i = 0; i < ent_shard.size(); i++) {
+        if (!ent_in_order[i]) continue;  // py lane stages sync-overtaken
+        const bool with_ops = ent_val[i] == V1c;
+        pay.clear();
+        pay.push_back(1);  // K_WAVE
+        wr_u32(pay, (uint32_t)ent_shard[i]);
+        wr_u64(pay, (uint64_t)ent_slot[i]);
+        pay.push_back((uint8_t)ent_val[i]);
+        pay.push_back(with_ops ? 1 : 0);
+        if (with_ops) {
+          pay.resize(pay.size() + 16, 0);  // bid: K_LEDGER backfills
+          const int64_t pos = ent_pos[i];
+          const int64_t lo = b.starts[pos], hi = b.starts[pos + 1];
+          wr_u32(pay, (uint32_t)(hi - lo));
+          for (int64_t j = lo; j < hi; j++) {
+            const int64_t o0 = b.cmd_offsets[j], o1 = b.cmd_offsets[j + 1];
+            wr_u32(pay, (uint32_t)(o1 - o0));
+            size_t w = pay.size();
+            pay.resize(w + (size_t)(o1 - o0));
+            memcpy(pay.data() + w, b.data.data() + o0, (size_t)(o1 - o0));
+          }
+        }
+        ((fn_wal_append_t)c->fns[FN_WAL_APPEND])(c->wal, pay.data(),
+                                                 (int64_t)pay.size());
+      }
+      c->stg[RTS_APPLY] += mono_ns() - w0;  // staging rides the apply stage
+    }
     // bookkeeping for every decided entry
     for (size_t i = 0; i < ent_shard.size(); i++) {
       const int64_t s = ent_shard[i];
@@ -1114,6 +1165,9 @@ static void process_decided(RtmCtx* c, double now) {
 
 static int32_t collect_opens(RtmCtx* c) {
   int32_t n_open = 0;
+  // durability plane: the watermark read once per pass (an atomic load)
+  const uint64_t wal_durable =
+      c->wal ? ((fn_wal_durable_t)c->fns[FN_WAL_DURABLE])(c->wal) : 0;
   memset(c->open_mask.data(), 0, (size_t)c->S);
   for (int64_t s = 0; s < c->n; s++) {
     if (c->in_flight[s]) continue;
@@ -1127,6 +1181,32 @@ static int32_t collect_opens(RtmCtx* c) {
     if (c->blk_pend_ref[s] == -1 && c->sp_slot[s] == -1) continue;
     const int64_t head =
         c->next_slot[s] > c->applied[s] ? c->next_slot[s] : c->applied[s];
+    if (c->wal) {
+      // vote-barrier write-ahead: this replica's FIRST vote in any slot
+      // >= the persisted barrier must not reach the wire until the
+      // barrier record advancing past it is DURABLE — otherwise a
+      // restart could re-vote differently in the same (slot, phase)
+      // (equivocation). wal_barrier_covered is stride-amortized: the
+      // common case returns 0 (covered) without touching the log, and
+      // a shard that does advance it parks un-armed for the next loop
+      // pass or two while the group-commit fsync lands (other shards
+      // and the frame pump keep running — the io/tick thread NEVER
+      // blocks on disk).
+      if (c->bar_wait[s] > 0) {
+        if (wal_durable < (uint64_t)c->bar_wait[s]) {
+          c->restep = 1;  // stay hot: the fsync is typically ~100us out
+          continue;
+        }
+        c->bar_wait[s] = 0;
+      }
+      const int64_t blsn = ((fn_wal_barrier_t)c->fns[FN_WAL_BARRIER])(
+          c->wal, s, head);
+      if (blsn > 0 && wal_durable < (uint64_t)blsn) {
+        c->bar_wait[s] = blsn;
+        c->restep = 1;
+        continue;
+      }
+    }
     void_stale_pend(c, s, head - 1);  // drop bindings the head overtook
     // block binding at head wins (asyncio parity: bulk open runs first)
     if (c->blk_pend_ref[s] != -1 && c->blk_pend_slot[s] == head &&
@@ -1464,7 +1544,8 @@ static void rtm_loop(RtmCtx* c) {
 //        max_cmds_per_batch, max_cmd_size]
 // ptrs: [rk_ctx, transport, sk_plane, next_slot, applied, in_flight,
 //        votes_seen, tainted, last_progress, opened_at, ring_slot,
-//        ring_val, kslot, kdecided, kdone, knewly]
+//        ring_val, kslot, kdecided, kdone, knewly, wal_ctx]
+//        (wal_ctx: walkernel handle or 0 — the durability plane)
 // fns:  FN_* order above
 // fparams: [max_future_skew, max_age, phase_timeout, grace]
 void* rtm_create(const int64_t* dims, const int64_t* ptrs, const int64_t* fns,
@@ -1497,7 +1578,11 @@ void* rtm_create(const int64_t* dims, const int64_t* ptrs, const int64_t* fns,
   c->kdecided = (int8_t*)ptrs[i++];
   c->kdone = (uint8_t*)ptrs[i++];
   c->knewly = (uint8_t*)ptrs[i++];
+  c->wal = (void*)ptrs[i++];
   for (int j = 0; j < FN_COUNT; j++) c->fns[j] = (void*)fns[j];
+  if (!c->fns[FN_WAL_APPEND] || !c->fns[FN_WAL_BARRIER] ||
+      !c->fns[FN_WAL_DURABLE])
+    c->wal = nullptr;
   c->uuids.assign(uuids, uuids + (size_t)c->R * 16);
   c->max_future_skew = fparams[0];
   c->max_age = fparams[1];
@@ -1515,6 +1600,7 @@ void* rtm_create(const int64_t* dims, const int64_t* ptrs, const int64_t* fns,
   c->sp_frame.resize(c->S);
   c->stall_ev_at.assign(c->S, 0.0);
   c->votes_wait_at.assign(c->S, 0.0);
+  c->bar_wait.assign(c->S, 0);
   c->open_mask.assign(c->S, 0);
   c->open_slots.assign(c->S, 0);
   c->open_init.assign(c->S, 0);

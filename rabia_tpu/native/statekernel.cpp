@@ -64,10 +64,12 @@ enum {
   SKC_BYTES_IN,        // op bytes consumed
   SKC_BYTES_OUT,       // result bytes emitted (framing included)
   SKC_REHASHES,        // table growth events
+  SKC_DELTA_SNAPSHOTS, // sk_snapshot_delta emissions (durability plane)
+  SKC_DELTA_ENTRIES,   // dirty entries exported by delta snapshots
   SKC_COUNT
 };
 
-static const int32_t SK_COUNTERS_VERSION = 1;
+static const int32_t SK_COUNTERS_VERSION = 2;
 
 // flight ring: FrEvent ABI shared with hostkernel.cpp / obs/flight.py
 static const int32_t SK_FLIGHT_VERSION = 1;
@@ -101,6 +103,7 @@ struct Entry {
   uint8_t* kv;        // key bytes then value bytes (one allocation)
   uint64_t hash;
   uint64_t version;   // entry version (KVStore ValueEntry.version)
+  uint64_t epoch;     // store mut_epoch at last mutation (delta tracking)
   double created;
   double updated;
   uint32_t klen;
@@ -127,12 +130,43 @@ struct Store {
   uint64_t total_operations = 0;
   uint64_t reads = 0;
   uint64_t writes = 0;
+  // incremental-snapshot tracking (durability plane): entries stamped
+  // with mut_epoch at mutation; sk_snapshot_mark bumps it, so "dirty" =
+  // epoch == mut_epoch. Deletions since the last mark are logged by key
+  // ([u16 LE klen][key] concatenated, bounded); CLEAR sets `cleared`
+  // (the delta then clears-and-reinserts, which is complete because
+  // every survivor postdates the clear).
+  uint64_t mut_epoch = 1;
+  std::vector<uint8_t> dels;
+  uint32_t n_dels = 0;
+  bool cleared = false;
+  bool dels_overflow = false;  // log bound hit: next delta must be full
 
   void reset_table(int64_t cap) {
     table.assign((size_t)cap, Entry{});
     live = used = 0;
   }
 };
+
+// deletion-log bound: past this the delta degrades to a full snapshot
+// (sk_snapshot_delta returns -3) instead of growing without limit
+static const size_t SK_DELS_CAP = 1 << 20;
+
+static inline void log_del(Store& st, const uint8_t* key, uint32_t klen) {
+  if (st.cleared || st.dels_overflow) return;  // clear supersedes dels
+  if (st.dels.size() + 2 + klen > SK_DELS_CAP) {
+    st.dels_overflow = true;
+    st.dels.clear();
+    st.n_dels = 0;
+    return;
+  }
+  const uint16_t kl = (uint16_t)klen;
+  size_t w = st.dels.size();
+  st.dels.resize(w + 2 + klen);
+  memcpy(st.dels.data() + w, &kl, 2);
+  memcpy(st.dels.data() + w + 2, key, klen);
+  st.n_dels++;
+}
 
 struct SkPlane {
   std::vector<Store> stores;
@@ -403,6 +437,30 @@ void sk_clear_store(void* h, int64_t idx) {
   Store& st = p->stores[(size_t)idx];
   store_free_entries(st);
   st.reset_table(64);
+  st.cleared = true;
+  st.dels.clear();
+  st.n_dels = 0;
+  st.dels_overflow = false;
+}
+
+// restore-path delete (no stats, no version bump, no deletion-log entry:
+// the chain frame being restored already records this deletion, and the
+// restored state simply lacks the key — nothing for the next delta to
+// re-record). Returns 1 removed, 0 absent, -1 bad index.
+int32_t sk_delete_raw(void* h, int64_t idx, const uint8_t* key,
+                      int64_t klen) {
+  SkPlane* p = (SkPlane*)h;
+  std::lock_guard<std::recursive_mutex> lk(p->mu);
+  if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
+  Store& st = p->stores[(size_t)idx];
+  int64_t at = store_find(st, fnv1a(key, klen), key, klen, nullptr);
+  if (at < 0) return 0;
+  Entry& e = st.table[(size_t)at];
+  free(e.kv);
+  e.kv = nullptr;
+  e.state = SLOT_TOMB;
+  st.live--;
+  return 1;
 }
 
 // restore-path insert (no validation, no stats, no version bump — the
@@ -429,6 +487,7 @@ int32_t sk_insert_raw(void* h, int64_t idx, const uint8_t* key,
     e.kv = kv;
     e.vlen = e.vcap = (uint32_t)vlen;
     e.version = version;
+    e.epoch = st.mut_epoch;
     e.created = created;
     e.updated = updated;
     return 0;
@@ -441,6 +500,7 @@ int32_t sk_insert_raw(void* h, int64_t idx, const uint8_t* key,
   e.klen = (uint32_t)klen;
   e.vlen = e.vcap = (uint32_t)vlen;
   e.version = version;
+  e.epoch = st.mut_epoch;
   e.created = created;
   e.updated = updated;
   st.live++;
@@ -584,6 +644,7 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
           e.klen = (uint32_t)klen;
           e.vlen = e.vcap = (uint32_t)vlen;
           e.version = st.version;
+          e.epoch = st.mut_epoch;
           e.created = e.updated = now;
           st.live++;
           if (st.used * 4 >= (int64_t)st.table.size() * 3) {
@@ -606,6 +667,7 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
           e.vlen = (uint32_t)vlen;
           st.version++;
           e.version = st.version;
+          e.epoch = st.mut_epoch;
           e.updated = now;
         }
         p->counters[SKC_SETS]++;
@@ -638,6 +700,7 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
           st.version++;
           // result carries the OLD value and the NEW store version
           res_value(p, 0, st.version, e.kv + e.klen, e.vlen);
+          log_del(st, key, (uint32_t)klen);
           free(e.kv);
           e.kv = nullptr;
           e.state = SLOT_TOMB;
@@ -660,6 +723,10 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
         int64_t count = st.live;
         store_free_entries(st);
         st.reset_table(64);
+        st.cleared = true;
+        st.dels.clear();
+        st.n_dels = 0;
+        st.dels_overflow = false;
         st.version++;
         snprintf(tmp, sizeof(tmp), "%lld", (long long)count);
         res_text(p, 0, 0, tmp);
@@ -732,6 +799,7 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
           e.klen = (uint32_t)klen;
           e.vlen = e.vcap = (uint32_t)vlen;
           e.version = st.version;
+          e.epoch = st.mut_epoch;
           e.created = e.updated = now;
           st.live++;
           if (st.used * 4 >= (int64_t)st.table.size() * 3) {
@@ -763,6 +831,7 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
         e.vlen = (uint32_t)vlen;
         st.version++;
         e.version = st.version;
+        e.epoch = st.mut_epoch;
         e.updated = now;
         p->counters[SKC_CAS_HITS]++;
         res_simple(p, 0, st.version);
@@ -835,6 +904,91 @@ int64_t sk_apply_wave(void* h, const uint8_t* data,
   p->counters[SKC_BYTES_OUT] += (uint64_t)p->out_buf.size();
   flight_wave(p, first_shard, total_ops);
   return (int64_t)p->out_buf.size();
+}
+
+// ---------------------------------------------------------------------------
+// incremental snapshots (durability plane — docs/DURABILITY.md)
+// ---------------------------------------------------------------------------
+//
+// Delta frame for one store (emitted by sk_snapshot_delta, decoded by
+// persistence/native_wal.py, which is the semantics owner of the
+// surrounding file format):
+//   u8 flags (bit0: cleared — restore must clear the store first)
+//   u32 LE n_del  | n_del * (u16 LE klen | key)
+//   u32 LE n_ent  | n_ent * sk_export entry
+//                   ([u32 klen][u32 vlen][u64 version][f64 created]
+//                    [f64 updated][key][val])
+// where n_ent covers exactly the entries mutated since the last
+// sk_snapshot_mark. Restore applies dels BEFORE entries (a deleted-then-
+// reset key appears in both; the insert must win).
+
+// bytes a delta frame needs, or -3 when the deletion log overflowed and
+// only a FULL snapshot is faithful, or -1 on a bad store index.
+int64_t sk_snapshot_delta_size(void* h, int64_t idx) {
+  SkPlane* p = (SkPlane*)h;
+  if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
+  std::lock_guard<std::recursive_mutex> lk(p->mu);
+  Store& st = p->stores[(size_t)idx];
+  if (st.dels_overflow) return -3;
+  int64_t total = 1 + 4 + (int64_t)st.dels.size() + 4;
+  for (auto& e : st.table)
+    if (e.state == SLOT_FULL && e.epoch == st.mut_epoch)
+      total += 32 + e.klen + e.vlen;
+  return total;
+}
+
+// emit the delta frame; returns bytes written, -(bytes needed) when cap
+// is insufficient, -3 on deletion-log overflow (caller does a full
+// snapshot instead), -1 on a bad index. Does NOT advance the mark —
+// call sk_snapshot_mark once the frame is durably on disk, so a failed
+// checkpoint write never loses dirty state.
+int64_t sk_snapshot_delta(void* h, int64_t idx, uint8_t* out, int64_t cap) {
+  SkPlane* p = (SkPlane*)h;
+  if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
+  std::lock_guard<std::recursive_mutex> lk(p->mu);
+  Store& st = p->stores[(size_t)idx];
+  if (st.dels_overflow) return -3;
+  const int64_t need = sk_snapshot_delta_size(h, idx);
+  if (need > cap) return -need;
+  uint8_t* w = out;
+  *w++ = st.cleared ? 1 : 0;
+  memcpy(w, &st.n_dels, 4);
+  w += 4;
+  memcpy(w, st.dels.data(), st.dels.size());
+  w += st.dels.size();
+  uint32_t n_ent = 0;
+  uint8_t* ent_count_at = w;
+  w += 4;
+  for (auto& e : st.table) {
+    if (e.state != SLOT_FULL || e.epoch != st.mut_epoch) continue;
+    memcpy(w, &e.klen, 4);
+    memcpy(w + 4, &e.vlen, 4);
+    memcpy(w + 8, &e.version, 8);
+    memcpy(w + 16, &e.created, 8);
+    memcpy(w + 24, &e.updated, 8);
+    memcpy(w + 32, e.kv, e.klen);
+    memcpy(w + 32 + e.klen, e.kv + e.klen, e.vlen);
+    w += 32 + e.klen + e.vlen;
+    n_ent++;
+  }
+  memcpy(ent_count_at, &n_ent, 4);
+  p->counters[SKC_DELTA_SNAPSHOTS]++;
+  p->counters[SKC_DELTA_ENTRIES] += n_ent;
+  return w - out;
+}
+
+// advance the snapshot mark: everything emitted by the delta just
+// written is now "clean"; future mutations stamp the new epoch.
+void sk_snapshot_mark(void* h, int64_t idx) {
+  SkPlane* p = (SkPlane*)h;
+  if (idx < 0 || (size_t)idx >= p->stores.size()) return;
+  std::lock_guard<std::recursive_mutex> lk(p->mu);
+  Store& st = p->stores[(size_t)idx];
+  st.mut_epoch++;
+  st.dels.clear();
+  st.n_dels = 0;
+  st.cleared = false;
+  st.dels_overflow = false;
 }
 
 // Scalar-lane convenience: apply `n_ops` ops (offsets over `data`)

@@ -66,6 +66,8 @@ FRE_TF_IN = 17  # transport frame in (arg = wire msg_type)
 FRE_TF_OUT = 18  # transport frame out (arg = wire msg_type)
 FRE_RT_WAKE = 19  # native runtime thread wakeup (arg: 1 frames, 2 idle)
 FRE_RT_HANDOFF = 20  # runtime -> Python mailbox handoff (arg = ev type)
+FRE_WAL = 21  # durability-plane lifecycle (arg: 1 recovery, 2 checkpoint,
+#               3 wal GC; slot carries the event's record/segment count)
 
 FR_KIND_NAMES = {
     FRE_FRAME_IN: "frame_in",
@@ -88,6 +90,7 @@ FR_KIND_NAMES = {
     FRE_TF_OUT: "tf_out",
     FRE_RT_WAKE: "rt_wake",
     FRE_RT_HANDOFF: "rt_handoff",
+    FRE_WAL: "wal",
 }
 
 NO_PEER = 0xFFFF

@@ -36,11 +36,12 @@ class AnomalyJournal:
     REDIAL_CHURN = "redial_churn"
     QUORUM_LOST = "quorum_lost"
     QUORUM_RESTORED = "quorum_restored"
+    WAL_WEDGED = "wal_wedged"  # durability-plane append/fsync failure
 
     # kinds severe enough to trigger a flight-recorder dump: each names a
     # condition whose cause is already sliding out of the event rings by
     # the time an operator looks
-    SEVERE = frozenset({SYNC_OVERTAKE, STALE_STORM, QUORUM_LOST})
+    SEVERE = frozenset({SYNC_OVERTAKE, STALE_STORM, QUORUM_LOST, WAL_WEDGED})
 
     def __init__(self, cap: int = 256) -> None:
         self.cap = cap

@@ -2,8 +2,13 @@
 
 Reference parity: rabia-persistence/src/in_memory.rs:11-43 (single-slot
 RwLock store) and file_system.rs:26-94 (one `state.dat`, atomic write via
-`.tmp` + rename). Writes go through the event loop's default executor so
-fsync never blocks the consensus round loop.
+`.tmp` + rename). Writes go through the running event loop's default
+executor (``asyncio.get_running_loop()`` — ``get_event_loop()`` is
+deprecated from coroutines and could bind an orphan loop when called off
+the engine's thread) so fsync never blocks the consensus round loop.
+
+The WAL-based durability plane lives in
+:mod:`rabia_tpu.persistence.native_wal` (docs/DURABILITY.md).
 """
 
 from __future__ import annotations
@@ -70,8 +75,14 @@ class FileSystemPersistence(PersistenceLayer):
         self.path = self.dir / STATE_FILE
         # sweep tmp orphans from crashed saves (tmp names are unique per
         # write, so a crash-looping process would otherwise accumulate
-        # them forever; no live writer of THIS process can exist yet)
+        # them forever). Tmp names embed the writer's pid: skip OUR OWN
+        # pid's files — a second instance constructed on the same dir
+        # (an explicit checkpointer, a test harness) must not unlink a
+        # sibling's in-flight aux write out from under its os.replace.
+        own = f".tmp{os.getpid()}."
         for orphan in self.dir.glob("*.tmp*"):
+            if own in orphan.name:
+                continue
             try:
                 orphan.unlink()
             except OSError:
@@ -116,10 +127,10 @@ class FileSystemPersistence(PersistenceLayer):
             raise PersistenceError(f"load failed: {e}") from None
 
     async def save_state(self, data: bytes) -> None:
-        await asyncio.get_event_loop().run_in_executor(None, self._save_sync, data)
+        await asyncio.get_running_loop().run_in_executor(None, self._save_sync, data)
 
     async def load_state(self) -> Optional[bytes]:
-        return await asyncio.get_event_loop().run_in_executor(None, self._load_sync)
+        return await asyncio.get_running_loop().run_in_executor(None, self._load_sync)
 
     # -- aux blobs (one file per key; same atomic discipline) ---------------
 
@@ -128,7 +139,7 @@ class FileSystemPersistence(PersistenceLayer):
         return self.dir / f"aux_{safe}.dat"
 
     async def save_aux(self, key: str, data: bytes) -> None:
-        await asyncio.get_event_loop().run_in_executor(
+        await asyncio.get_running_loop().run_in_executor(
             None, self._atomic_write, self._aux_path(key), data
         )
 
@@ -141,7 +152,7 @@ class FileSystemPersistence(PersistenceLayer):
             except OSError as e:
                 raise PersistenceError(f"aux load failed: {e}") from None
 
-        return await asyncio.get_event_loop().run_in_executor(None, _load)
+        return await asyncio.get_running_loop().run_in_executor(None, _load)
 
     # sync wrappers (file_system.rs:80-94 "sync constructor" analog)
     def save_state_sync(self, data: bytes) -> None:

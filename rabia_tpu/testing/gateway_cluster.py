@@ -42,7 +42,9 @@ class GatewayCluster:
         n_shards: int = 4,
         config: Optional[RabiaConfig] = None,
         gateway_config: Optional[GatewayConfig] = None,
-        persistence: bool = True,
+        persistence: bool | str = True,
+        wal_dir: Optional[str] = None,
+        wal_kwargs: Optional[dict] = None,
     ) -> None:
         self.n = n_replicas
         self.n_shards = n_shards
@@ -60,13 +62,31 @@ class GatewayCluster:
         # restarted proposer from rebinding fresh batches into anciently
         # decided slots lives in the persistence layer).
         # persistence=False trades restart_replica away for the native
-        # engine runtime (which engages only on persistence-free
-        # native-TCP replicas) — the loadgen SLO harness uses this so
-        # the curve scores the commit path production deploys run.
-        self.persists = [
-            InMemoryPersistence() if persistence else None
-            for _ in range(n_replicas)
-        ]
+        # engine runtime. persistence="wal" builds the durability plane
+        # (persistence/native_wal.py, one directory per replica under
+        # wal_dir) — the native runtime ENGAGES on those replicas AND
+        # restart_replica recovers from snapshot chain + WAL replay.
+        self.wal_kwargs = dict(wal_kwargs or {})
+        if persistence == "wal":
+            import tempfile
+
+            from rabia_tpu.persistence.native_wal import WalPersistence
+
+            self.wal_dir = wal_dir or tempfile.mkdtemp(prefix="rabia-wal-")
+            self.persists = [
+                WalPersistence(
+                    f"{self.wal_dir}/replica-{i}",
+                    n_shards=n_shards,
+                    **self.wal_kwargs,
+                )
+                for i in range(n_replicas)
+            ]
+        else:
+            self.wal_dir = wal_dir
+            self.persists = [
+                InMemoryPersistence() if persistence else None
+                for _ in range(n_replicas)
+            ]
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -167,6 +187,18 @@ class GatewayCluster:
         await self.nets[i].close()
         await asyncio.sleep(settle)
 
+        p = self.persists[i]
+        if getattr(p, "supports_wal", False):
+            # a fresh WalPersistence re-runs the recovery scan (torn-tail
+            # truncation + chain load) exactly like a restarted process
+            p.close()
+            from rabia_tpu.persistence.native_wal import WalPersistence
+
+            self.persists[i] = WalPersistence(
+                f"{self.wal_dir}/replica-{i}",
+                n_shards=self.n_shards,
+                **self.wal_kwargs,
+            )
         self._build_replica(i, bind_port=net_port)
         for j in range(self.n):
             if i != j:
@@ -229,3 +261,6 @@ class GatewayCluster:
             if n is not None:
                 await n.close()
         self.nets = []
+        for p in self.persists:
+            if getattr(p, "supports_wal", False):
+                p.close()

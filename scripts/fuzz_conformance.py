@@ -478,6 +478,23 @@ def _trial_gateway_tables(seed: int) -> None:
     )
 
 
+def _trial_wal_paths(seed: int) -> None:
+    """Durability-plane differential: one RANDOM record sequence (waves
+    with binary ops and V0 gaps, barriers, ledgers, frontier marks)
+    through the C walkernel writer AND the pure-Python twin (the byte
+    format's semantics owner) — byte-identical segment files, identical
+    recovery scans, identical torn-tail truncation at a random cut, and
+    identical replayed state through both apply paths. Sub-second each."""
+    from rabia_tpu.testing.conformance import (
+        random_wal_records,
+        run_waves_on_both_wal_paths,
+    )
+
+    run_waves_on_both_wal_paths(
+        random_wal_records(seed + 911), tag=f"wal seed={seed}"
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=30.0)
@@ -516,6 +533,14 @@ def main() -> int:
         "schedules through the sessionkernel table and the Python "
         "SessionTable; identical decisions + byte-identical cached "
         "replies + identical GC survivors required; sub-second each)",
+    )
+    ap.add_argument(
+        "--wal", type=int, default=0,
+        help="additionally run N durability-plane differential trials "
+        "(random WAL record sequences through the C walkernel writer "
+        "and the Python twin; byte-identical segments + identical "
+        "torn-tail recovery + identical replayed state required; "
+        "sub-second each)",
     )
     ap.add_argument(
         "--mesh", type=int, default=0,
@@ -612,6 +637,11 @@ def main() -> int:
         for i in range(args.runtime):
             asyncio.run(_trial_runtime_paths(args.base_seed + i))
             runtime_trials += 1
+    wal_trials = 0
+    if args.wal > 0:
+        for i in range(args.wal):
+            _trial_wal_paths(args.base_seed + i)
+            wal_trials += 1
     extra = (
         f"; {plane_trials} plane-differential schedules identical"
         if plane_trials
@@ -631,6 +661,11 @@ def main() -> int:
     if gateway_trials:
         extra += (
             f"; {gateway_trials} gateway-table differential schedules "
+            "identical"
+        )
+    if wal_trials:
+        extra += (
+            f"; {wal_trials} durability-plane differential sequences "
             "identical"
         )
     if mesh_trials:
