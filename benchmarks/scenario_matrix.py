@@ -1,0 +1,105 @@
+"""Scenario matrix driver: run the chaos plane's profile matrix and
+record the ``scenario_matrix_r12`` robustness baseline.
+
+Runs named adverse-network / elastic-membership profiles
+(rabia_tpu/chaos/profiles.py) against full clusters — simulator fabric
+and real-TCP clusters shaped inside the C transport — each under
+open-loop load with a continuous commit-availability timeline and the
+phases-to-decide / coin-flip evidence recorded per scenario.
+
+Exits non-zero on ANY profile failing its gates (availability floor,
+final-quarter wedge check, convergence, missing termination evidence) —
+the CI smoke cell rides this exit code.
+
+Usage:
+
+    python benchmarks/scenario_matrix.py                  # full matrix
+    python benchmarks/scenario_matrix.py --smoke          # CI cell (3 short)
+    python benchmarks/scenario_matrix.py --profiles wan_jitter,tcp_shaped_wan
+    python benchmarks/scenario_matrix.py --out matrix.json --no-record
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import os  # noqa: E402
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from rabia_tpu.chaos import (  # noqa: E402
+    MATRIX_KEY,
+    default_profiles,
+    record_matrix,
+    render_matrix,
+    run_matrix,
+    smoke_profiles,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=(__doc__ or "").split("\n")[0])
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="run the CI smoke subset (3 short profiles: one simulator "
+        "adverse-net, one real-TCP shaped, one membership-under-load)",
+    )
+    ap.add_argument(
+        "--profiles", default=None,
+        help="comma list of profile names (default: the full matrix)",
+    )
+    ap.add_argument(
+        "--time-scale", type=float, default=1.0,
+        help="scale every profile's timings by this factor",
+    )
+    ap.add_argument("--out", default=None,
+                    help="write the full report JSON here")
+    ap.add_argument(
+        "--no-record", action="store_true",
+        help=f"skip recording under {MATRIX_KEY} in benchmarks/results.json",
+    )
+    ap.add_argument(
+        "--results-key", default=MATRIX_KEY,
+        help="results.json key to record under",
+    )
+    args = ap.parse_args(argv)
+
+    profiles = smoke_profiles() if args.smoke else default_profiles()
+    if args.profiles:
+        want = [p for p in args.profiles.split(",") if p]
+        allp = default_profiles()
+        missing = [w for w in want if w not in allp]
+        if missing:
+            print(f"unknown profiles: {missing}", file=sys.stderr)
+            print(f"available: {sorted(allp)}", file=sys.stderr)
+            return 2
+        profiles = {w: allp[w] for w in want}
+    if args.time_scale != 1.0:
+        profiles = {
+            n: p.scaled(args.time_scale) for n, p in profiles.items()
+        }
+
+    report = asyncio.run(run_matrix(profiles))
+    print(render_matrix(report))
+    if args.out:
+        # written even for failing runs: it is the CI failure artifact
+        Path(args.out).write_text(json.dumps(report, indent=1))
+    if not report["pass"]:
+        print("scenario matrix: FAILING PROFILES:", file=sys.stderr)
+        for name, probs in report["problems"].items():
+            for p in probs:
+                print(f"  - {name}: {p}", file=sys.stderr)
+        return 1
+    if not args.no_record:
+        record_matrix(report, key=args.results_key)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

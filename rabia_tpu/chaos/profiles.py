@@ -1,0 +1,294 @@
+"""Adverse-network / elastic-membership scenario profiles (the chaos DSL).
+
+A :class:`ChaosProfile` is a declarative description of ONE scenario: a
+load shape (open-loop Poisson arrivals, never waiting on the system), a
+timed list of :class:`ChaosEvent` fault injections, and acceptance floors
+the matrix gate scores against. Profiles are data, not code — the runner
+(:mod:`rabia_tpu.chaos.runner`) interprets the events against whichever
+fabric the profile targets:
+
+- ``fabric="sim"``  — an in-process :class:`~rabia_tpu.net.NetworkSimulator`
+  cluster (deterministic impairments: per-link asymmetric loss, scheduled
+  flapping, timed partitions, slow nodes, crash/recover);
+- ``fabric="tcp"``  — a real-TCP :class:`~rabia_tpu.testing.gateway_cluster.
+  GatewayCluster` (gateway + native engine runtime + WAL durability plane),
+  impaired through the C transport's shaping layer (``rt_set_shaping``)
+  and the elastic-membership surface (stop/start/rolling-restart) — the
+  PRODUCTION commit path carries the shaped traffic, not a stand-in.
+
+Event vocabulary (``ChaosEvent.action``):
+
+====================  =======  ====================================================
+action                fabrics  args
+====================  =======  ====================================================
+``wan``               both     ``latency_ms``, ``jitter_ms`` = TOTAL spread (all links)
+``link_loss``         both     ``src``, ``dst`` (replica indices), ``rate``
+``flap``              sim      ``group`` (indices), ``period``, ``duty``, ``duration``
+``partition``         sim      ``group``, ``duration`` (None = until ``heal``)
+``heal``              sim      — (heals partition AND flapping)
+``slow``              both     ``node``, ``delay_ms`` (0 clears)
+``crash``             sim      ``node``
+``recover``           sim      ``node``
+``stop_replica``      tcp      ``node``
+``start_replica``     tcp      ``node``
+``restart_replica``   tcp      ``node``
+``clear``             both     — (clears link faults / shaping)
+====================  =======  ====================================================
+
+Every profile measures the same consensus-health evidence regardless of
+fabric: the per-decision **phases-to-decide distribution** and
+**coin-flip tallies** (the paper's randomized-termination analysis), and
+a **continuous commit-availability timeline** (per-window goodput over
+offered arrivals — the dip during the partition is the datum, not the
+end-of-run average). docs/SCENARIOS.md documents the schema.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One timed fault injection: run ``action(args)`` at ``at`` seconds
+    after the measure window opens."""
+
+    at: float
+    action: str
+    args: dict = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class ChaosProfile:
+    """One named scenario (see module doc for the event vocabulary)."""
+
+    name: str
+    fabric: str  # "sim" | "tcp"
+    description: str
+    duration: float  # measure window, seconds
+    events: tuple[ChaosEvent, ...] = ()
+    # open-loop load shape
+    rate: float = 120.0  # offered ops/s (Poisson)
+    warmup: float = 1.0
+    batch: int = 4  # commands per submit
+    call_timeout: float = 8.0
+    n_replicas: int = 3
+    n_shards: int = 4
+    # acceptance floors (the matrix gate)
+    min_availability: float = 0.5  # mean over the whole run
+    min_final_availability: float = 0.05  # last-quarter mean: wedge guard
+    require_convergence: bool = True
+    seed: int = 20260803
+
+    def scaled(self, factor: float) -> "ChaosProfile":
+        """Time-scaled copy (the CI smoke cell runs factor < 1)."""
+        if factor == 1.0:
+            return self
+        ev = tuple(
+            ChaosEvent(
+                at=e.at * factor,
+                action=e.action,
+                args={
+                    k: (v * factor if k in ("duration", "period") else v)
+                    for k, v in e.args.items()
+                },
+            )
+            for e in self.events
+        )
+        return ChaosProfile(
+            **{
+                **self.__dict__,
+                "duration": self.duration * factor,
+                "warmup": max(0.5, self.warmup * factor),
+                "events": ev,
+            }
+        )
+
+
+def _p(name, fabric, desc, duration, events, **kw) -> ChaosProfile:
+    return ChaosProfile(
+        name=name,
+        fabric=fabric,
+        description=desc,
+        duration=duration,
+        events=tuple(events),
+        **kw,
+    )
+
+
+def default_profiles() -> dict[str, ChaosProfile]:
+    """The standing scenario matrix (``scenario_matrix_r12``): ≥6 named
+    profiles, at least one real-TCP shaped and at least one elastic
+    membership change under sustained load."""
+    profiles = [
+        # -- simulator fabric -------------------------------------------
+        _p(
+            "wan_jitter",
+            "sim",
+            "WAN latency with heavy jitter on every link (25ms one-way, "
+            "20ms total spread): decisions must keep terminating in few phases "
+            "with round trips two orders slower than LAN",
+            duration=8.0,
+            events=[
+                ChaosEvent(0.0, "wan", {"latency_ms": 25.0, "jitter_ms": 20.0}),
+            ],
+            # WAN round trips serialize slot progress: offer well under
+            # the ~shards/RTT capacity so the curve scores the network,
+            # not a queueing collapse of the generator's own making
+            rate=36.0,
+            min_availability=0.8,
+        ),
+        _p(
+            "asymmetric_loss",
+            "sim",
+            "Sustained asymmetric loss: replica 0's OUTBOUND links drop "
+            "30%, then 60% mid-run, while its inbound stays clean (the "
+            "wireless-BFT lossy-uplink shape); retransmission must keep "
+            "the phase-count tail bounded",
+            duration=10.0,
+            events=[
+                ChaosEvent(0.0, "link_loss", {"src": 0, "dst": 1, "rate": 0.3}),
+                ChaosEvent(0.0, "link_loss", {"src": 0, "dst": 2, "rate": 0.3}),
+                ChaosEvent(4.0, "link_loss", {"src": 0, "dst": 1, "rate": 0.6}),
+                ChaosEvent(4.0, "link_loss", {"src": 0, "dst": 2, "rate": 0.6}),
+                ChaosEvent(8.0, "clear", {}),
+            ],
+            min_availability=0.55,
+        ),
+        _p(
+            "flapping_partition",
+            "sim",
+            "A minority replica flaps in and out of a partition every "
+            "1.2s (40% down duty): the cluster must ride through every "
+            "flap without wedging on stale votes",
+            duration=10.0,
+            events=[
+                ChaosEvent(
+                    1.0,
+                    "flap",
+                    {"group": [2], "period": 1.2, "duty": 0.4,
+                     "duration": 7.0},
+                ),
+                ChaosEvent(8.5, "heal", {}),
+            ],
+            min_availability=0.6,
+        ),
+        _p(
+            "slow_replica",
+            "sim",
+            "One chronically lagging replica (35ms extra on all its "
+            "traffic): the quorum path must route around it, and its "
+            "stale votes must not poison phase counts",
+            duration=8.0,
+            events=[
+                ChaosEvent(0.5, "slow", {"node": 1, "delay_ms": 35.0}),
+                ChaosEvent(6.5, "slow", {"node": 1, "delay_ms": 0.0}),
+            ],
+            rate=90.0,
+            min_availability=0.7,
+        ),
+        _p(
+            "crash_recover_churn",
+            "sim",
+            "Minority crash/recover churn: each replica in turn crashes "
+            "for ~1.5s and recovers; availability must hold through "
+            "every single-replica outage",
+            duration=10.0,
+            events=[
+                ChaosEvent(1.0, "crash", {"node": 2}),
+                ChaosEvent(2.5, "recover", {"node": 2}),
+                ChaosEvent(4.0, "crash", {"node": 1}),
+                ChaosEvent(5.5, "recover", {"node": 1}),
+                ChaosEvent(7.0, "crash", {"node": 0}),
+                ChaosEvent(8.5, "recover", {"node": 0}),
+            ],
+            min_availability=0.5,
+        ),
+        # -- real-TCP fabric (gateway + native runtime + durability) ----
+        _p(
+            "tcp_shaped_wan",
+            "tcp",
+            "Real-TCP cluster under C-transport shaping: every "
+            "replica-to-replica link carries 10ms (6ms jitter spread) injected "
+            "one-way delay inside the native io loop — the production "
+            "epoll path, not a simulator",
+            duration=8.0,
+            events=[
+                ChaosEvent(0.0, "wan", {"latency_ms": 10.0, "jitter_ms": 6.0}),
+            ],
+            rate=80.0,
+            min_availability=0.7,
+        ),
+        _p(
+            "tcp_asymmetric_loss",
+            "tcp",
+            "Real-TCP asymmetric drop: replica 0's outbound consensus "
+            "frames drop 25% in the C transport while everything else "
+            "flows clean; vote retransmission must carry the slack",
+            duration=8.0,
+            events=[
+                ChaosEvent(
+                    0.5, "link_loss", {"src": 0, "dst": 1, "rate": 0.25}
+                ),
+                ChaosEvent(
+                    0.5, "link_loss", {"src": 0, "dst": 2, "rate": 0.25}
+                ),
+                ChaosEvent(6.5, "clear", {}),
+            ],
+            rate=80.0,
+            min_availability=0.6,
+        ),
+        _p(
+            "membership_elastic",
+            "tcp",
+            "Elastic membership under sustained load: a replica is "
+            "DECOMMISSIONED mid-run (gateway, engine and transport down),"
+            " the remaining quorum keeps committing, then it REJOINS "
+            "(WAL recovery + tail catch-up) — commit availability and "
+            "settle latency are scored CONTINUOUSLY through both "
+            "transitions, not just at end-state convergence",
+            duration=12.0,
+            events=[
+                ChaosEvent(3.0, "stop_replica", {"node": 2}),
+                ChaosEvent(7.0, "start_replica", {"node": 2}),
+            ],
+            rate=80.0,
+            min_availability=0.55,
+        ),
+        _p(
+            "rolling_restart",
+            "tcp",
+            "Rolling restart under load: each replica in turn restarts "
+            "(WAL recovery, port rebind, peer redial) while clients keep "
+            "submitting — the zero-downtime-deploy drill",
+            duration=12.0,
+            events=[
+                ChaosEvent(2.0, "restart_replica", {"node": 0}),
+                ChaosEvent(6.0, "restart_replica", {"node": 1}),
+                ChaosEvent(10.0, "restart_replica", {"node": 2}),
+            ],
+            rate=80.0,
+            min_availability=0.5,
+        ),
+    ]
+    return {p.name: p for p in profiles}
+
+
+def smoke_profiles() -> dict[str, ChaosProfile]:
+    """The CI smoke subset: 3 short profiles — one simulator adverse-net,
+    one real-TCP shaped, one membership change under load — time-scaled
+    to keep the cell under a couple of minutes."""
+    all_p = default_profiles()
+    out = {}
+    for name, factor in (
+        ("flapping_partition", 0.6),
+        ("tcp_shaped_wan", 0.6),
+        ("membership_elastic", 0.7),
+    ):
+        out[name] = all_p[name].scaled(factor)
+    return out
+
+
+def get_profile(name: str) -> Optional[ChaosProfile]:
+    return default_profiles().get(name)

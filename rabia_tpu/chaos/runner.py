@@ -1,0 +1,754 @@
+"""Chaos scenario runner: drive a full cluster through adverse-network /
+elastic-membership profiles under open-loop load, continuously recording
+consensus-health telemetry.
+
+One :func:`run_profile` call executes one :class:`~rabia_tpu.chaos.
+profiles.ChaosProfile`:
+
+1. build the profile's fabric — an in-process simulator cluster
+   (``fabric="sim"``) or a real-TCP gateway + native-runtime + WAL
+   cluster (``fabric="tcp"``);
+2. start an **open-loop Poisson load** (arrivals keep firing whether or
+   not the system keeps up — a partition shows up as failed windows, not
+   as a silently reduced offered rate; the r09 loadgen methodology);
+3. fire the profile's timed :class:`ChaosEvent` injections;
+4. sample a **continuous timeline** (~8 Hz): per-window commit
+   availability (ok arrivals / offered arrivals, scored at the arrival's
+   window) and per-replica decided counters — the dip during the fault
+   IS the datum;
+5. score the run: availability floors, a wedge check on the final
+   quarter, end-state convergence, and the consensus-health evidence the
+   paper's claim needs — the **phases-to-decide distribution** and
+   **coin-flip tallies** pulled from the engines' telemetry (C counter
+   blocks + host bins feeding the ``rabia_phases_to_decide`` /
+   ``rabia_coin_flips_total`` families).
+
+:func:`run_matrix` runs a profile dict and merges everything into the
+``scenario_matrix_r12`` report recorded in benchmarks/results.json — the
+standing robustness baseline later PRs report against (schema in
+docs/SCENARIOS.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import random
+import sys
+import time
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from rabia_tpu.chaos.profiles import ChaosProfile
+from rabia_tpu.core.errors import QuorumNotAvailableError, RabiaError
+from rabia_tpu.core.messages import ResultStatus
+from rabia_tpu.core.types import CommandBatch
+from rabia_tpu.testing.loadsession import LoadSession
+
+MATRIX_VERSION = 1
+MATRIX_KEY = "scenario_matrix_r12"
+
+_OUTCOMES = ("ok", "shed", "error", "timeout", "overflow")
+
+
+class _Arrivals:
+    """Per-arrival score sheet -> post-hoc windowed availability curve."""
+
+    def __init__(self) -> None:
+        self.rows: list[tuple[float, str, float]] = []  # (t, outcome, ms)
+
+    def score(self, t: float, outcome: str, ms: float = 0.0) -> None:
+        self.rows.append((t, outcome, ms))
+
+    def timeline(
+        self, t0: float, duration: float, window: float
+    ) -> list[dict]:
+        n_win = max(1, int(math.ceil(duration / window)))
+        wins = [
+            {"t": round(k * window, 3), "attempts": 0, "ok": 0,
+             "failed": 0, "lat_ms": []}
+            for k in range(n_win)
+        ]
+        for t, outcome, ms in self.rows:
+            k = int((t - t0) / window)
+            if k < 0 or k >= n_win:
+                continue
+            w = wins[k]
+            w["attempts"] += 1
+            if outcome == "ok":
+                w["ok"] += 1
+                w["lat_ms"].append(ms)
+            else:
+                w["failed"] += 1
+        out = []
+        for w in wins:
+            lat = sorted(w.pop("lat_ms"))
+            w["availability"] = (
+                round(w["ok"] / w["attempts"], 4) if w["attempts"] else None
+            )
+            w["p99_ms"] = (
+                round(lat[min(len(lat) - 1, int(0.99 * len(lat)))], 2)
+                if lat
+                else None
+            )
+            out.append(w)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Fabrics
+# ---------------------------------------------------------------------------
+
+
+class _SimFabric:
+    """TestCluster over the NetworkSimulator; events map to simulator
+    fault-injection calls; load submits straight to the engines."""
+
+    name = "sim"
+
+    def __init__(self, profile: ChaosProfile) -> None:
+        from rabia_tpu.testing.cluster import TestCluster, default_test_config
+
+        self.profile = profile
+        self.cluster = TestCluster(
+            profile.n_replicas,
+            config=default_test_config(profile.n_shards),
+            seed=profile.seed,
+        )
+        self._crashed: set[int] = set()
+
+    async def start(self) -> None:
+        await self.cluster.start()
+
+    async def stop(self) -> None:
+        await self.cluster.stop()
+
+    def _node(self, i: int):
+        return self.cluster.nodes[i]
+
+    def apply_event(self, action: str, args: dict) -> None:
+        sim = self.cluster.sim
+        if action == "wan":
+            base = args.get("latency_ms", 0.0) / 1000.0
+            jit = args.get("jitter_ms", 0.0) / 2000.0
+            sim.conditions.latency_min = max(0.0, base - jit)
+            sim.conditions.latency_max = base + jit
+        elif action == "link_loss":
+            sim.set_link_loss(
+                self._node(args["src"]), self._node(args["dst"]),
+                args["rate"],
+            )
+        elif action == "flap":
+            sim.set_flap(
+                {self._node(i) for i in args["group"]},
+                period=args["period"],
+                duty=args.get("duty", 0.5),
+                duration=args.get("duration"),
+            )
+        elif action == "partition":
+            sim.partition(
+                {self._node(i) for i in args["group"]},
+                duration=args.get("duration"),
+            )
+        elif action == "heal":
+            sim.heal_partition()
+            sim.clear_flap()
+        elif action == "slow":
+            sim.set_node_delay(
+                self._node(args["node"]), args.get("delay_ms", 0.0) / 1000.0
+            )
+        elif action == "crash":
+            sim.crash(self._node(args["node"]))
+            self._crashed.add(args["node"])
+        elif action == "recover":
+            sim.recover(self._node(args["node"]))
+            self._crashed.discard(args["node"])
+        elif action == "clear":
+            sim.clear_link_faults()
+            sim.conditions.latency_min = 0.0
+            sim.conditions.latency_max = 0.0
+        else:
+            raise ValueError(f"sim fabric: unknown action {action!r}")
+
+    def clear_faults(self) -> None:
+        sim = self.cluster.sim
+        sim.heal_partition()
+        sim.clear_flap()
+        sim.clear_link_faults()
+        for i in list(self._crashed):
+            sim.recover(self._node(i))
+        self._crashed.clear()
+        for node in self.cluster.nodes:
+            sim.set_node_delay(node, 0.0)
+
+    async def submit(self, i: int, pairs: list, timeout: float) -> str:
+        """One open-loop arrival routed like an honest client: round-robin
+        over replicas not currently crashed."""
+        live = [
+            j for j in range(self.profile.n_replicas)
+            if j not in self._crashed
+        ]
+        if not live:
+            return "shed"
+        eng = self.cluster.engines[live[i % len(live)]]
+        shard = i % self.profile.n_shards
+        cmds = [f"SET {k} {v}" for k, v in pairs]
+        try:
+            fut = await eng.submit_batch(CommandBatch.new(cmds), shard=shard)
+            await asyncio.wait_for(fut, timeout)
+            return "ok"
+        except QuorumNotAvailableError:
+            return "shed"
+        except asyncio.TimeoutError:
+            return "timeout"
+        except (RabiaError, Exception):
+            return "error"
+
+    def engines(self) -> list:
+        return [e for e in self.cluster.engines if e is not None]
+
+    def decided_totals(self) -> list[Optional[int]]:
+        return [
+            int(e.rt.decided_v1 + e.rt.decided_v0)
+            for e in self.cluster.engines
+        ]
+
+    async def converged(self, timeout: float) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            datas = [
+                getattr(sm, "_data", None) for sm in self.cluster.sms
+            ]
+            if all(d is not None for d in datas) and all(
+                d == datas[0] for d in datas[1:]
+            ):
+                return True
+            await asyncio.sleep(0.05)
+        return False
+
+
+class _TcpFabric:
+    """GatewayCluster (real TCP, gateway + native runtime + WAL
+    durability) driven through protocol-faithful LoadSessions; events map
+    to the C transport's shaping layer and the elastic-membership
+    surface."""
+
+    name = "tcp"
+
+    SESSIONS_PER_GW = 8
+
+    def __init__(self, profile: ChaosProfile) -> None:
+        from rabia_tpu.testing.gateway_cluster import GatewayCluster
+
+        self.profile = profile
+        self.cluster = GatewayCluster(
+            n_replicas=profile.n_replicas,
+            n_shards=profile.n_shards,
+            persistence="wal",
+        )
+        self._ser = None
+        self._sessions: dict[int, list] = {}  # gw index -> LoadSession pool
+        self._down: set[int] = set()
+        self._redials: set[asyncio.Task] = set()
+
+    async def start(self) -> None:
+        from rabia_tpu.core.serialization import Serializer
+
+        await self.cluster.start()
+        self._ser = Serializer()
+        for i in range(self.profile.n_replicas):
+            self._sessions[i] = await self._dial_pool(i)
+
+    async def _dial_pool(self, i: int) -> list:
+        out = []
+        gw = self.cluster.gateways[i]
+        if gw is None:
+            return out
+        for _ in range(self.SESSIONS_PER_GW):
+            s = LoadSession(self._ser)
+            try:
+                await s.connect("127.0.0.1", gw.port)
+                out.append(s)
+            except Exception:
+                await s.close()
+        return out
+
+    async def stop(self) -> None:
+        for t in list(self._redials):
+            t.cancel()
+        await asyncio.gather(*self._redials, return_exceptions=True)
+        for pool in self._sessions.values():
+            await asyncio.gather(
+                *(s.close() for s in pool), return_exceptions=True
+            )
+        self._sessions.clear()
+        await self.cluster.stop()
+        # the fabric owns the cluster's implicit mkdtemp WAL dir: remove
+        # it, or every matrix/CI run litters /tmp with full WAL chains
+        if self.cluster.wal_dir:
+            import shutil
+
+            shutil.rmtree(self.cluster.wal_dir, ignore_errors=True)
+
+    # -- events -------------------------------------------------------------
+
+    def _shape(self, src: int, dst: int, **kw) -> None:
+        net = self.cluster.nets[src]
+        if net is not None:
+            net.set_peer_shaping(self.cluster.ids[dst], **kw)
+
+    def apply_event(self, action: str, args: dict) -> None:
+        n = self.profile.n_replicas
+        if action == "wan":
+            # symmetric one-way delay on every replica-to-replica link.
+            # jitter_ms is the TOTAL spread on both fabrics (latency
+            # +/- jitter/2 — the NetworkConditions.wan convention);
+            # rt_set_shaping takes the half-amplitude, so halve here to
+            # keep sim and tcp matrix cells comparable
+            delay = args.get("latency_ms", 0.0)
+            jit = args.get("jitter_ms", 0.0) / 2.0
+            for i in range(n):
+                for j in range(n):
+                    if i != j:
+                        self._shape(
+                            i, j, delay_ms=delay, jitter_ms=jit,
+                            seed=self.profile.seed + i * n + j,
+                        )
+        elif action == "link_loss":
+            self._shape(
+                args["src"], args["dst"], drop_rate=args["rate"],
+                seed=self.profile.seed + 7,
+            )
+        elif action == "slow":
+            d = args.get("delay_ms", 0.0)
+            for j in range(n):
+                if j != args["node"]:
+                    self._shape(args["node"], j, delay_ms=d)
+        elif action == "clear":
+            for net in self.cluster.nets:
+                if net is not None:
+                    net.clear_shaping()
+        elif action in ("stop_replica", "start_replica", "restart_replica"):
+            # handled asynchronously by the runner (they await)
+            raise RuntimeError("membership events are async — runner bug")
+        else:
+            raise ValueError(f"tcp fabric: unknown action {action!r}")
+
+    async def apply_event_async(self, action: str, args: dict) -> None:
+        if action == "stop_replica":
+            i = args["node"]
+            self._down.add(i)
+            pool = self._sessions.pop(i, [])
+            await asyncio.gather(
+                *(s.close() for s in pool), return_exceptions=True
+            )
+            await self.cluster.stop_replica(i)
+        elif action == "start_replica":
+            i = args["node"]
+            await self.cluster.start_replica(i)
+            self._down.discard(i)
+            self._spawn_redial(i)
+        elif action == "restart_replica":
+            i = args["node"]
+            self._down.add(i)
+            pool = self._sessions.pop(i, [])
+            await asyncio.gather(
+                *(s.close() for s in pool), return_exceptions=True
+            )
+            await self.cluster.restart_replica(i)
+            self._down.discard(i)
+            self._spawn_redial(i)
+        else:
+            self.apply_event(action, args)
+
+    def _spawn_redial(self, i: int) -> None:
+        async def redial():
+            self._sessions[i] = await self._dial_pool(i)
+
+        t = asyncio.ensure_future(redial())
+        self._redials.add(t)
+        t.add_done_callback(self._redials.discard)
+
+    def clear_faults(self) -> None:
+        for net in self.cluster.nets:
+            if net is not None:
+                net.clear_shaping()
+
+    # -- load ---------------------------------------------------------------
+
+    async def submit(self, i: int, pairs: list, timeout: float) -> str:
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        live = [
+            j for j in range(self.profile.n_replicas)
+            if j not in self._down and self._sessions.get(j)
+        ]
+        if not live:
+            return "shed"
+        pool = self._sessions[live[i % len(live)]]
+        sess = pool[i % len(pool)]
+        shard = i % self.profile.n_shards
+        cmds = [encode_set_bin(k, v) for k, v in pairs]
+        try:
+            res = await sess.submit(shard, cmds, timeout)
+            if res.status in (ResultStatus.OK, ResultStatus.CACHED):
+                return "ok"
+            if res.status == ResultStatus.RETRY:
+                return "shed"
+            return "error"
+        except asyncio.TimeoutError:
+            return "timeout"
+        except Exception:
+            return "error"
+
+    def engines(self) -> list:
+        return [e for e in self.cluster.engines if e is not None]
+
+    def decided_totals(self) -> list[Optional[int]]:
+        return [
+            int(e.rt.decided_v1 + e.rt.decided_v0) if e is not None else None
+            for e in self.cluster.engines
+        ]
+
+    async def converged(self, timeout: float) -> bool:
+        try:
+            await self.cluster.wait_converged(timeout)
+            return True
+        except Exception:
+            return False
+
+
+# ---------------------------------------------------------------------------
+# Consensus-health evidence
+# ---------------------------------------------------------------------------
+
+
+def collect_evidence(engines: list) -> dict:
+    """Aggregate the termination-analysis evidence across replicas: the
+    phases-to-decide distribution (rabia_phases_to_decide sources — C
+    tick-context bins + host kernel bins + device-window bins) and the
+    common-coin outcome tallies."""
+    hist = np.zeros(32, np.int64)
+    total = 0
+    ssum = 0.0
+    coins = {"v0": 0, "v1": 0}
+    for eng in engines:
+        try:
+            h = eng.metrics.histogram("phases_to_decide")
+            counts, count, s = h.merged()
+            for j, c in enumerate(counts):
+                hist[min(j + 1, 31)] += int(c)
+            total += int(count)
+            ssum += float(s)
+            for k in ("v0", "v1"):
+                coins[k] += int(
+                    eng.metrics.counter(
+                        "coin_flips_total", labels={"outcome": k}
+                    ).value()
+                )
+        except Exception:
+            continue
+    nz = np.nonzero(hist)[0]
+    dist = {str(int(p)): int(hist[p]) for p in nz}
+    cum = np.cumsum(hist)
+
+    def pct(q: float) -> Optional[int]:
+        if total == 0:
+            return None
+        tgt = q * total
+        for p in range(len(hist)):
+            if cum[p] >= tgt:
+                return int(p)
+        return int(len(hist) - 1)
+
+    return {
+        "decisions": total,
+        "hist": dist,
+        "mean_phases": round(ssum / total, 4) if total else None,
+        "p50_phases": pct(0.50),
+        "p99_phases": pct(0.99),
+        "max_phases": int(nz[-1]) if len(nz) else None,
+        "coin_flips": coins,
+    }
+
+
+# ---------------------------------------------------------------------------
+# The runner
+# ---------------------------------------------------------------------------
+
+
+async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
+    """Execute one profile end-to-end; returns its scenario report (the
+    matrix entry — schema in docs/SCENARIOS.md)."""
+
+    def log(msg: str) -> None:
+        if verbose:
+            print(f"# [{profile.name}] {msg}", file=sys.stderr)
+
+    fabric = (
+        _SimFabric(profile) if profile.fabric == "sim" else _TcpFabric(profile)
+    )
+    log(f"starting {profile.fabric} cluster "
+        f"({profile.n_replicas} replicas, {profile.n_shards} shards)")
+    await fabric.start()
+    arrivals = _Arrivals()
+    health_rows: list[dict] = []
+    rng = random.Random(profile.seed)
+    loop = asyncio.get_event_loop()
+    fires: set[asyncio.Task] = set()
+    inflight = 0
+    inflight_cap = max(64, int(profile.rate * profile.call_timeout * 2))
+    window = max(0.2, profile.duration / 32.0)
+
+    try:
+        # warmup: light load so the pipeline is hot before t0
+        warm_end = loop.time() + profile.warmup
+        while loop.time() < warm_end:
+            t = asyncio.ensure_future(
+                fabric.submit(
+                    rng.randrange(1 << 20),
+                    [(f"warm{rng.randrange(64)}", "w")] * profile.batch,
+                    profile.call_timeout,
+                )
+            )
+            fires.add(t)
+            t.add_done_callback(fires.discard)
+            await asyncio.sleep(max(0.005, 2.0 / profile.rate))
+
+        t0 = loop.time()
+        t_end = t0 + profile.duration
+        events = sorted(profile.events, key=lambda e: e.at)
+        ev_idx = 0
+        next_arrival = t0
+        next_sample = t0
+        i = 0
+        membership_pending: Optional[asyncio.Task] = None
+
+        async def fire(idx: int, arrived: float) -> None:
+            nonlocal inflight
+            key = f"k{idx % 512}"
+            pairs = [
+                (f"{key}-{j}", f"v{idx}") for j in range(profile.batch)
+            ]
+            try:
+                outcome = await fabric.submit(
+                    idx, pairs, profile.call_timeout
+                )
+            except Exception:
+                outcome = "error"
+            finally:
+                inflight -= 1
+            arrivals.score(
+                arrived, outcome, (loop.time() - arrived) * 1e3
+            )
+
+        while True:
+            now = loop.time()
+            # timed fault injections (membership events run async but
+            # sequentially — one transition at a time, like a real
+            # operator; load keeps firing while they run)
+            while ev_idx < len(events) and now - t0 >= events[ev_idx].at:
+                ev = events[ev_idx]
+                ev_idx += 1
+                log(f"t={now - t0:.1f}s event {ev.action} {ev.args}")
+                if hasattr(fabric, "apply_event_async"):
+                    # one transition at a time, like a real operator;
+                    # load keeps firing while the transition runs
+                    if membership_pending is not None:
+                        await membership_pending
+                    membership_pending = asyncio.ensure_future(
+                        fabric.apply_event_async(ev.action, ev.args)
+                    )
+                else:
+                    fabric.apply_event(ev.action, ev.args)
+            # health sample (~per window)
+            if now >= next_sample:
+                health_rows.append(
+                    {
+                        "t": round(now - t0, 3),
+                        "decided": fabric.decided_totals(),
+                    }
+                )
+                next_sample = now + window
+            if now >= t_end:
+                break
+            # open-loop Poisson arrivals
+            if now >= next_arrival:
+                arrived = next_arrival
+                next_arrival += rng.expovariate(profile.rate)
+                if inflight >= inflight_cap:
+                    arrivals.score(arrived, "overflow")
+                else:
+                    inflight += 1
+                    t = asyncio.ensure_future(fire(i, arrived))
+                    fires.add(t)
+                    t.add_done_callback(fires.discard)
+                i += 1
+                continue
+            await asyncio.sleep(
+                max(0.001, min(next_arrival, next_sample, t_end) - now)
+            )
+
+        if membership_pending is not None:
+            await membership_pending
+        # drain stragglers (bounded), then score
+        log("draining in-flight arrivals")
+        if fires:
+            await asyncio.wait(fires, timeout=profile.call_timeout + 1.0)
+        for t in list(fires):
+            t.cancel()
+        if fires:
+            await asyncio.gather(*fires, return_exceptions=True)
+        fabric.clear_faults()
+
+        # end-state convergence (faults cleared first)
+        converged = True
+        if profile.require_convergence:
+            converged = await fabric.converged(timeout=10.0)
+        evidence = collect_evidence(fabric.engines())
+    finally:
+        await fabric.stop()
+
+    timeline = arrivals.timeline(t0, profile.duration, window)
+    n_ok = sum(w["ok"] for w in timeline)
+    n_att = sum(w["attempts"] for w in timeline)
+    avail = n_ok / n_att if n_att else 0.0
+    q_len = max(1, len(timeline) // 4)
+    tail = timeline[-q_len:]
+    tail_ok = sum(w["ok"] for w in tail)
+    tail_att = sum(w["attempts"] for w in tail)
+    tail_avail = tail_ok / tail_att if tail_att else 0.0
+    lat = sorted(
+        ms for t, o, ms in arrivals.rows if o == "ok"
+    )
+
+    def lpct(q: float) -> Optional[float]:
+        if not lat:
+            return None
+        return round(lat[min(len(lat) - 1, int(q * len(lat)))], 2)
+
+    counts = {k: 0 for k in _OUTCOMES}
+    for _t, o, _ms in arrivals.rows:
+        counts[o] = counts.get(o, 0) + 1
+
+    problems = []
+    if n_att == 0:
+        problems.append("no measured arrivals")
+    if avail < profile.min_availability:
+        problems.append(
+            f"availability {avail:.3f} < floor {profile.min_availability}"
+        )
+    if tail_avail < profile.min_final_availability:
+        problems.append(
+            f"wedged: final-quarter availability {tail_avail:.3f} < "
+            f"{profile.min_final_availability}"
+        )
+    if profile.require_convergence and not converged:
+        problems.append("replicas did not converge after fault clearing")
+    if not evidence["decisions"]:
+        problems.append("no phases-to-decide evidence recorded")
+
+    report = {
+        "profile": profile.name,
+        "fabric": profile.fabric,
+        "description": profile.description,
+        "duration_s": profile.duration,
+        "offered_rps": profile.rate,
+        "replicas": profile.n_replicas,
+        "shards": profile.n_shards,
+        "events": [
+            {"at": e.at, "action": e.action, **e.args} for e in profile.events
+        ],
+        "arrivals": n_att,
+        "outcomes": counts,
+        "availability": round(avail, 4),
+        "final_quarter_availability": round(tail_avail, 4),
+        "min_window_availability": min(
+            (w["availability"] for w in timeline
+             if w["availability"] is not None),
+            default=None,
+        ),
+        "settle_ms": {"p50": lpct(0.5), "p99": lpct(0.99),
+                      "max": lpct(1.0)},
+        "phases_to_decide": evidence,
+        "timeline": timeline,
+        "health": health_rows,
+        "converged": converged,
+        "pass": not problems,
+        "problems": problems,
+    }
+    log(
+        f"done: avail={avail:.3f} tail={tail_avail:.3f} "
+        f"decisions={evidence['decisions']} "
+        f"mean_phases={evidence['mean_phases']} "
+        f"coins={evidence['coin_flips']} "
+        f"{'PASS' if not problems else 'FAIL ' + '; '.join(problems)}"
+    )
+    return report
+
+
+async def run_matrix(
+    profiles: dict[str, ChaosProfile], verbose: bool = True
+) -> dict:
+    """Run every profile sequentially and assemble the matrix report."""
+    entries = {}
+    for name, prof in profiles.items():
+        entries[name] = await run_profile(prof, verbose=verbose)
+    return {
+        "version": MATRIX_VERSION,
+        "benchmark": "scenario_matrix",
+        "ts": time.time(),
+        "profiles": entries,
+        "pass": all(e["pass"] for e in entries.values()),
+        "problems": {
+            n: e["problems"] for n, e in entries.items() if e["problems"]
+        },
+    }
+
+
+def render_matrix(report: dict) -> str:
+    head = (
+        f"{'profile':<22} {'fabric':<6} {'avail':>6} {'tail':>6} "
+        f"{'p50ms':>7} {'p99ms':>8} {'decided':>8} {'phases':>11} "
+        f"{'coins v0/v1':>12} {'ok?':>4}"
+    )
+    lines = [head, "-" * len(head)]
+    for name, e in report["profiles"].items():
+        ph = e["phases_to_decide"]
+        s = e["settle_ms"]
+        lines.append(
+            f"{name:<22} {e['fabric']:<6} {e['availability']:>6.3f} "
+            f"{e['final_quarter_availability']:>6.3f} "
+            f"{s['p50'] if s['p50'] is not None else float('nan'):>7.1f} "
+            f"{s['p99'] if s['p99'] is not None else float('nan'):>8.1f} "
+            f"{ph['decisions']:>8d} "
+            f"{(str(ph['mean_phases']) + '/' + str(ph['max_phases'])):>11} "
+            f"{(str(ph['coin_flips']['v0']) + '/' + str(ph['coin_flips']['v1'])):>12} "
+            f"{'yes' if e['pass'] else 'NO':>4}"
+        )
+    return "\n".join(lines)
+
+
+def record_matrix(report: dict, key: str = MATRIX_KEY) -> None:
+    """Merge the matrix (timelines trimmed) into benchmarks/results.json
+    under ``key`` (latest run per key, the sweep_metrics convention)."""
+    import json
+
+    path = (
+        Path(__file__).resolve().parents[2] / "benchmarks" / "results.json"
+    )
+    doc = {}
+    if path.exists():
+        try:
+            doc = json.loads(path.read_text())
+        except ValueError:
+            doc = {}
+    slim = {**report, "profiles": {}}
+    for name, e in report["profiles"].items():
+        slim["profiles"][name] = {
+            k: v for k, v in e.items() if k not in ("health",)
+        }
+    doc[key] = slim
+    path.write_text(json.dumps(doc, indent=1))

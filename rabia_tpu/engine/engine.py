@@ -677,6 +677,75 @@ class RabiaEngine:
             "Decided waves whose decide->apply->result needed Python",
             fn=lambda: rtm_ctr("gil_handoffs"),
         )
+        # -- consensus-health telemetry (chaos plane: the paper's
+        #    randomized-termination curve, docs/SCENARIOS.md). Three
+        #    sources feed ONE metric identity, mirroring the tick-path
+        #    convention above: the rk tick context's C bins (native tick
+        #    AND the GIL-free runtime share the ctx), HostNodeKernel's
+        #    host bins (RABIA_PY_TICK / host-kernel engines), and the
+        #    engine's device-window bins — each path leaves the others'
+        #    sources at zero.
+        self._dev_phase_hist = np.zeros(32, np.int64)
+        self._dev_phase_sum = 0
+        phase_bounds = tuple(float(b) for b in range(1, 33))
+
+        def phase_curve():
+            hist = np.zeros(32, np.int64)
+            ssum = 0
+            rk = self._rk
+            if rk is not None:
+                h = np.asarray(rk.phase_hist, np.int64)
+                hist[: len(h)] += h
+                ssum += rk.counter("phase_sum")
+            kern = getattr(self, "kernel", None)
+            kh = getattr(kern, "phase_hist", None)
+            if kh is not None:
+                hist[: len(kh)] += np.asarray(kh, np.int64)
+                ssum += int(kern.phase_sum)
+            hist += self._dev_phase_hist
+            ssum += self._dev_phase_sum
+            # bin p (decisions taking p phases) lands in bucket bound p,
+            # i.e. index p-1. The sources' top bin (31) is a CLAMP —
+            # "exactly 31 OR more" — so it rides the TOP bound (32,
+            # claiming <= 32: true for 31, best-effort for the
+            # astronomically rare beyond) instead of mislabeling the
+            # extreme tail as <= 31. Bin 0 (impossible: deciding
+            # requires an advance) joins it defensively.
+            counts = [int(hist[j + 1]) for j in range(30)]
+            counts.append(0)  # bound 31: absorbed into the clamp bucket
+            counts.append(int(hist[31]) + int(hist[0]))
+            return counts, int(hist.sum()), float(ssum)
+
+        m.histogram(
+            "phases_to_decide",
+            "Weak-MVC phases each locally tally-decided slot took "
+            "(1 = decided in its first phase); the randomized-"
+            "termination evidence curve",
+            buckets=phase_bounds,
+            fn=phase_curve,
+        )
+
+        def coin_ctr(i):
+            kern = getattr(self, "kernel", None)
+            cf = getattr(kern, "coin_flips", None)
+            v = int(cf[i]) if cf is not None else 0
+            rk = self._rk
+            if rk is not None:
+                v += rk.counter("coin_v1" if i else "coin_v0")
+            return v
+
+        m.counter(
+            "coin_flips_total",
+            "Common-coin flips by outcome (round-2 all-? tie-breaks). "
+            "Covers the host/native decide paths; the jitted device "
+            "kernel flips inside XLA and is not tallied here",
+            {"outcome": "v0"},
+            fn=lambda: coin_ctr(0),
+        )
+        m.counter(
+            "coin_flips_total", "", {"outcome": "v1"},
+            fn=lambda: coin_ctr(1),
+        )
         m.counter(
             "engine_ticks_total", "Engine loop ticks",
             fn=lambda: self._tick_count,
@@ -3139,6 +3208,18 @@ class RabiaEngine:
                 )
                 rt.last_progress[i] = now
             newly_k = ob.newly_decided[k][:n] & act
+            if newly_k.any():
+                # phases-to-decide telemetry for the device-kernel path
+                # (the host paths account inside HostNodeKernel / the rk
+                # tick context): post-advance phase == phases used
+                i_new = np.nonzero(newly_k)[0]
+                ph_new = np.asarray(ob.new_phase[k])[i_new].astype(np.int64)
+                self._dev_phase_sum += int(ph_new.sum())
+                np.add.at(
+                    self._dev_phase_hist,
+                    np.minimum(ph_new, len(self._dev_phase_hist) - 1),
+                    1,
+                )
             for s_new in np.nonzero(newly_k)[0]:
                 self.flight.record(
                     FRE_STEP_DECIDE, shard=int(s_new),

@@ -51,6 +51,10 @@ RK_COUNTER_NAMES = (
     "out_frames",
     "decided",
     "opened",
+    # consensus-health telemetry (RKC v2, chaos plane)
+    "coin_v0",
+    "coin_v1",
+    "phase_sum",
 )
 
 
@@ -158,6 +162,18 @@ class NativeTick:
         else:  # stale prebuilt hostkernel: metrics read as zeros
             self.counters_version = 0
             self.counters = np.zeros(len(RK_COUNTER_NAMES), np.uint64)
+        # phases-to-decide histogram: zero-copy view over the context's
+        # C bins (bin p = local decisions that took p weak-MVC phases).
+        # Shared with the GIL-free runtime thread (same rk ctx), so a
+        # scrape may see a torn in-flight bin — metrics-grade.
+        if hasattr(lib, "rk_phase_hist"):
+            n_ph = int(lib.rk_phase_hist_len())
+            pbuf = (ctypes.c_uint64 * n_ph).from_address(
+                lib.rk_phase_hist(self.ctx)
+            )
+            self.phase_hist = np.frombuffer(pbuf, np.uint64)
+        else:  # stale prebuilt hostkernel
+            self.phase_hist = np.zeros(32, np.uint64)
         # flight recorder: zero-copy structured view over the context's C
         # event ring (hostkernel.cpp FrEvent ABI — obs/flight.FR_DTYPE)
         from rabia_tpu.obs.flight import FR_DTYPE
@@ -224,6 +240,7 @@ class NativeTick:
             # scrapes/dumps (post-shutdown stats, crash dumps) must read
             # the final state, not freed memory
             self.counters = self.counters.copy()
+            self.phase_hist = self.phase_hist.copy()
             self._fr_frozen = self.flight_snapshot()
             ctx, self.ctx = self.ctx, None
             self.lib.rk_ctx_destroy(ctx)

@@ -48,6 +48,7 @@ EV_DECIDE = 2
 EV_WAVE = 3
 EV_REJECT = 4
 EV_STALL = 5
+EV_LEDGER = 6
 
 CMD_OPEN_SCALAR = 1
 CMD_OPEN_WAVE = 2
@@ -906,6 +907,8 @@ class RuntimeBridge:
             kind = rec[1]
             s, arg = struct.unpack_from("<IQ", rec, 2)
             self._on_stall(int(kind), int(s), int(arg))
+        elif t == EV_LEDGER:
+            self._on_ledger(rec)
 
     # -- decision / apply handlers ------------------------------------------
 
@@ -1192,6 +1195,39 @@ class RuntimeBridge:
         # registry entry is gone — drop the token mapping lazily
         if ref is not None and ref not in e._blk_registry:
             self._tokens.pop(token, None)
+
+    def _on_ledger(self, rec: bytes) -> None:
+        """EV_LEDGER: receiver-side batch-id ledger completeness (ROADMAP
+        3c). A natively parsed PEER block's waves were C-staged with zero
+        batch-id fields (token 0 — no Python block registry entry, so
+        `_on_wave`'s proposer-path backfill never sees them). The record
+        carries the wire block id + the in-order V1 (shard, slot)
+        entries; batch ids derive deterministically from
+        ``block_batch_id(block_id, shard)`` — the SAME ids the proposer
+        and the scalar lane commit under — so a follower's recovery
+        replay repopulates its ``applied_ids`` dedup ledger in parity
+        with the proposer's."""
+        e = self.engine
+        if e._wal is None:
+            return
+        import uuid as _uuid
+
+        from rabia_tpu.core.blocks import block_batch_id
+
+        block_id = _uuid.UUID(bytes=rec[1:17])
+        (count,) = struct.unpack_from("<I", rec, 17)
+        at = 21
+        for _ in range(count):
+            s, slot = struct.unpack_from("<IQ", rec, at)
+            at += 12
+            try:
+                e._wal.stage_ledger(
+                    int(s), int(slot),
+                    block_batch_id(block_id, int(s)).value.bytes,
+                )
+            except Exception:
+                logger.exception("receiver wal ledger stage failed")
+                break
 
     def _apply_wave_py(self, ref, breg, entries) -> None:
         """Decided wave whose apply stays in Python (no native plane,

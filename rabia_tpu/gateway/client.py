@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import struct
 import uuid
 from typing import Iterable, Optional, Sequence
 
@@ -63,6 +64,113 @@ class GatewayError(RabiaError):
     failure; retry semantically with a fresh command if appropriate."""
 
 
+class _MuxLink:
+    """Client side of the C transport's session-multiplex lane
+    (net/tcp.MUX_MAGIC): one plain TCP connection handshaken with the
+    mux magic id, every frame ``[u32 LE 16+len][16B session id]
+    [payload]`` both ways. Duck-types the slice of the TcpNetwork
+    surface RabiaClient uses (add_peer / send_to_nowait / receive /
+    get_connected_nodes / close), so the client's retry/redial machinery
+    is transport-agnostic. The session id IS the client's node id — the
+    gateway authenticates every frame against it, and the transport
+    rebinds the session to the NEWEST connection carrying it (latest
+    binding wins), which is exactly what makes redial rebinding work:
+    a reconnected client's first frame reroutes all replies here.
+
+    The wire contract (MUX_MAGIC handshake + per-frame session-id
+    prefix) is owned by transport.cpp; the OTHER client-side speaker is
+    :class:`rabia_tpu.testing.loadsession.MuxConn` (a shared-connection
+    pool for thousands of loadgen sessions — a different shape from this
+    single-session link, hence two speakers of one 3-line framing)."""
+
+    def __init__(self, node_id: NodeId) -> None:
+        self.node_id = node_id
+        self._sid = node_id.value.bytes
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self._gateway: Optional[NodeId] = None
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._dial_task: Optional[asyncio.Task] = None
+        self._read_task: Optional[asyncio.Task] = None
+        self._target: Optional[tuple[str, int]] = None
+        self._dead = False
+
+    def add_peer(self, peer: NodeId, host: str, port: int) -> None:
+        # `peer` is advisory (the endpoint's configured id): the live
+        # identity comes back in the handshake
+        self._target = (host, port)
+        self._dial_task = asyncio.ensure_future(self._dial())
+
+    async def _dial(self) -> None:
+        from rabia_tpu.net.tcp import MUX_MAGIC
+
+        try:
+            host, port = self._target  # type: ignore[misc]
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(MUX_MAGIC)
+            gw = await reader.readexactly(16)
+            self._gateway = NodeId(uuid.UUID(bytes=gw))
+            self.reader, self.writer = reader, writer
+            self._read_task = asyncio.ensure_future(self._read_loop())
+        except Exception:
+            self._dead = True
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                hdr = await self.reader.readexactly(4)
+                (ln,) = struct.unpack("<I", hdr)
+                data = await self.reader.readexactly(ln)
+                if ln < 16 or data[:16] != self._sid:
+                    continue  # another session's frame (never ours to see)
+                self._q.put_nowait((self._gateway, data[16:]))
+        except (asyncio.IncompleteReadError, asyncio.CancelledError,
+                ConnectionError, OSError):
+            self._dead = True
+
+    def send_to_nowait(self, recipient: NodeId, data: bytes) -> bool:
+        w = self.writer
+        if w is None or self._dead:
+            return False  # the hello/retry loops re-send after connect
+        try:
+            w.write(struct.pack("<I", 16 + len(data)) + self._sid + data)
+        except Exception:
+            self._dead = True
+            return False
+        return True
+
+    async def receive(self, timeout: Optional[float] = None):
+        if timeout is None:
+            return await self._q.get()
+        try:
+            return await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            raise TimeoutError_("mux receive", timeout) from None
+
+    async def get_connected_nodes(self) -> set[NodeId]:
+        if self._dead or self._gateway is None or self.writer is None:
+            return set()
+        if self.writer.is_closing():
+            return set()
+        return {self._gateway}
+
+    async def close(self) -> None:
+        for t in (self._dial_task, self._read_task):
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+        if self.writer is not None:
+            try:
+                self.writer.close()
+                await self.writer.wait_closed()
+            except Exception:
+                pass
+        self.writer = None
+
+
 class RabiaClient:
     """Exactly-once client over the gateway protocol (see module doc)."""
 
@@ -76,10 +184,18 @@ class RabiaClient:
         retry_backpressure: bool = True,
         backpressure_base_delay: float = 0.02,
         max_backpressure_retries: int = 200,
+        mux: bool = False,
     ) -> None:
         if not endpoints:
             raise ValueError("at least one gateway endpoint required")
         self.endpoints = list(endpoints)
+        # opt-in session-mux lane: ride the C transport's multiplexed
+        # connection class (one plain socket + the MUX_MAGIC handshake)
+        # instead of a private native transport instance per client —
+        # the 10^4-clients-per-host deployment shape. Exactly-once
+        # semantics are unchanged: the session id stays the client id,
+        # redials rebind the session to the newest connection.
+        self.mux = bool(mux)
         self.client_id = client_id or fast_uuid4()
         self.node_id = NodeId(self.client_id)
         self.call_timeout = call_timeout
@@ -125,9 +241,12 @@ class RabiaClient:
             self._endpoint_idx += 1
             await self._teardown_net()
             try:
-                self._net = TcpNetwork(
-                    self.node_id, TcpNetworkConfig(bind_port=0)
-                )
+                if self.mux:
+                    self._net = _MuxLink(self.node_id)
+                else:
+                    self._net = TcpNetwork(
+                        self.node_id, TcpNetworkConfig(bind_port=0)
+                    )
                 self._net.add_peer(ep.node_id, ep.host, ep.port)
                 self._recv_task = asyncio.ensure_future(self._recv_loop())
                 self._hello_fut = asyncio.get_event_loop().create_future()

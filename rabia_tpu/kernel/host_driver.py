@@ -116,6 +116,15 @@ class HostNodeKernel:
         self._shard_idx = np.arange(self.S, dtype=I32)
         self._coin_threshold = coin_threshold(coin_p1)
         self._native_lib: object = False  # False = not probed yet
+        # consensus-health telemetry (chaos plane): common-coin flip
+        # outcomes ([V0, V1] counts; the C step accumulates in place via
+        # rk_node_step_ex) and the phases-to-decide distribution of
+        # locally tally-decided slots (bin p = decisions taking p
+        # weak-MVC phases, top bin clamps). Accounting only — no
+        # protocol effect, and bit-identity between paths is untouched.
+        self.coin_flips = np.zeros(2, np.uint64)
+        self.phase_hist = np.zeros(32, np.uint64)
+        self.phase_sum = 0
 
     def init_state(self) -> HostNodeState:
         S, R = self.S, self.R
@@ -268,7 +277,16 @@ class HostNodeKernel:
         else:
             decision_in = np.ascontiguousarray(decision_in, I8)
             dec_ptr = decision_in.ctypes.data
-        lib.rk_node_step(*self._const_args, *ptrs[:10], dec_ptr, *ptrs[10:])
+        if self._step_ex:
+            lib.rk_node_step_ex(
+                *self._const_args, *ptrs[:10], dec_ptr, *ptrs[10:],
+                self._coin_ptr,
+            )
+        else:  # stale prebuilt hostkernel: coin telemetry reads as zeros
+            lib.rk_node_step(
+                *self._const_args, *ptrs[:10], dec_ptr, *ptrs[10:]
+            )
+        self._acct_decided(out_extra[3], st_out.phase)
         outbox = NodeOutbox(
             cast_r2=out_extra[0],
             r2_vals=out_extra[1],
@@ -314,6 +332,21 @@ class HostNodeKernel:
         self._const_args = (
             S, R, self.me, self.quorum, self.f1,
             self.seed & 0xFFFFFFFF, self._coin_threshold,
+        )
+        lib = self._native_lib
+        self._step_ex = bool(getattr(lib, "rk_node_step_ex", None))
+        self._coin_ptr = self.coin_flips.ctypes.data
+
+    def _acct_decided(self, newly, phase) -> None:
+        """Fold this step's tally decisions into the phases-to-decide
+        telemetry (post-advance phase == phases used)."""
+        idx = np.nonzero(newly)[0]
+        if len(idx) == 0:
+            return
+        ph = np.asarray(phase)[idx].astype(np.int64)
+        self.phase_sum += int(ph.sum())
+        np.add.at(
+            self.phase_hist, np.minimum(ph, len(self.phase_hist) - 1), 1
         )
 
     def _node_step_np(
@@ -370,7 +403,7 @@ class HostNodeKernel:
         coin_case = advance & ~decide1 & ~decide0 & (d1 == 0) & (d0 == 0)
         if coin_case.any():
             idx = np.nonzero(coin_case)[0]
-            next_v[idx] = _coin_bits(
+            bits = _coin_bits(
                 self.seed,
                 idx.astype(I32),
                 state.slot[idx],
@@ -378,6 +411,10 @@ class HostNodeKernel:
                 self.coin_p1,
                 xp=np,
             )
+            next_v[idx] = bits
+            n1 = int((bits == V1).sum())
+            self.coin_flips[0] += len(idx) - n1
+            self.coin_flips[1] += n1
         newly_decided = advance & (decide1 | decide0)
         dec_val = np.where(decide1, I8(V1), I8(V0))
 
@@ -404,6 +441,7 @@ class HostNodeKernel:
             np.copyto(led1[self.me], next_v, where=advance)
             np.copyto(led2, _ABS, where=advance[None, :])
 
+        self._acct_decided(newly_decided, phase)
         new_state = HostNodeState(
             slot=state.slot,
             phase=phase,

@@ -198,6 +198,16 @@ def load_hostkernel() -> ctypes.CDLL | None:
             p, p, p, p, p, p, p, p, p, p, p,
             p, p, p, p,
         ]
+        if hasattr(lib, "rk_node_step_ex"):
+            # rk_node_step + coin-flip accounting (chaos-plane telemetry)
+            lib.rk_node_step_ex.restype = None
+            lib.rk_node_step_ex.argtypes = [
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_uint32, ctypes.c_uint32,
+                p, p, p, p, p, p, p, p, p, p, p,
+                p, p, p, p, p,
+            ]
         lib.rk_start_slots.restype = None
         lib.rk_start_slots.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
@@ -253,6 +263,12 @@ def load_hostkernel() -> ctypes.CDLL | None:
         lib.rk_counters_count.argtypes = []
         lib.rk_counters.restype = ctypes.c_void_p
         lib.rk_counters.argtypes = [p]
+        if hasattr(lib, "rk_phase_hist"):
+            # phases-to-decide histogram (chaos-plane telemetry, v2)
+            lib.rk_phase_hist_len.restype = ctypes.c_int32
+            lib.rk_phase_hist_len.argtypes = []
+            lib.rk_phase_hist.restype = ctypes.c_void_p
+            lib.rk_phase_hist.argtypes = [p]
         # flight recorder (fixed-size binary event ring, versioned ABI)
         lib.rk_flight_version.restype = ctypes.c_int32
         lib.rk_flight_version.argtypes = []
@@ -440,6 +456,17 @@ def load_library() -> ctypes.CDLL:
         ]
         lib.rt_remove_peer.restype = ctypes.c_int
         lib.rt_remove_peer.argtypes = [ctypes.c_void_p, u8p]
+        if hasattr(lib, "rt_set_shaping"):
+            # chaos shaping layer (a prebuilt RABIA_NATIVE_LIB may
+            # predate it; TcpNetwork.set_peer_shaping raises then)
+            lib.rt_set_shaping.restype = ctypes.c_int
+            lib.rt_set_shaping.argtypes = [
+                ctypes.c_void_p, u8p,
+                ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_double, ctypes.c_uint64,
+            ]
+            lib.rt_clear_shaping.restype = ctypes.c_int
+            lib.rt_clear_shaping.argtypes = [ctypes.c_void_p]
         lib.rt_send.restype = ctypes.c_int
         lib.rt_send.argtypes = [
             ctypes.c_void_p,

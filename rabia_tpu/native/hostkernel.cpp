@@ -60,7 +60,10 @@ static inline int8_t coin_bit(uint32_t seed, uint32_t shard, uint32_t slot,
 // One node_step over S shards. State arrays are mutated in place; the
 // outbox fields that alias new state (new_r1=my_r1, new_phase=phase,
 // decided_vals=decided) are read by the caller from the state arrays.
-void rk_node_step(
+// coin_out (nullable): 2 uint64 cells accumulating common-coin flip
+// outcomes (index 0 = V0, 1 = V1) — the chaos plane's coin-behavior
+// telemetry; pure accounting, no protocol effect.
+static void rk_node_step_impl(
     int32_t S, int32_t R, int32_t me, int32_t quorum, int32_t f1,
     uint32_t seed, uint32_t coin_threshold,
     const int32_t* slot,       // [S]
@@ -77,7 +80,8 @@ void rk_node_step(
     uint8_t* cast_r2,          // [S] out
     int8_t* r2_vals,           // [S] out
     uint8_t* advanced,         // [S] out
-    uint8_t* newly_decided     // [S] out
+    uint8_t* newly_decided,    // [S] out
+    uint64_t* coin_out         // [2] or nullptr (accounting only)
 ) {
   for (int32_t s = 0; s < S; s++) {
     const int8_t st0 = stage[s];
@@ -117,9 +121,11 @@ void rk_node_step(
         else if (dec0) next_v = V0;
         else if (d1 > 0) next_v = V1;
         else if (d0 > 0) next_v = V0;
-        else
+        else {
           next_v = coin_bit(seed, (uint32_t)s, (uint32_t)slot[s],
                             (uint32_t)phase[s], coin_threshold);
+          if (coin_out) coin_out[next_v == V1 ? 1 : 0]++;
+        }
         if (dec1 || dec0) {
           newdec = 1;
           decided[s] = dec1 ? V1 : V0;
@@ -154,6 +160,35 @@ void rk_node_step(
     advanced[s] = adv;
     newly_decided[s] = newdec;
   }
+}
+
+void rk_node_step(
+    int32_t S, int32_t R, int32_t me, int32_t quorum, int32_t f1,
+    uint32_t seed, uint32_t coin_threshold,
+    const int32_t* slot, int32_t* phase, int8_t* stage, int8_t* my_r1,
+    int8_t* my_r2, int8_t* led1, int8_t* led2, int8_t* decided,
+    uint8_t* done, const uint8_t* active, const int8_t* decision_in,
+    uint8_t* cast_r2, int8_t* r2_vals, uint8_t* advanced,
+    uint8_t* newly_decided) {
+  rk_node_step_impl(S, R, me, quorum, f1, seed, coin_threshold, slot, phase,
+                    stage, my_r1, my_r2, led1, led2, decided, done, active,
+                    decision_in, cast_r2, r2_vals, advanced, newly_decided,
+                    nullptr);
+}
+
+// rk_node_step + coin accounting (coin_out: 2 uint64 cells, V0/V1).
+void rk_node_step_ex(
+    int32_t S, int32_t R, int32_t me, int32_t quorum, int32_t f1,
+    uint32_t seed, uint32_t coin_threshold,
+    const int32_t* slot, int32_t* phase, int8_t* stage, int8_t* my_r1,
+    int8_t* my_r2, int8_t* led1, int8_t* led2, int8_t* decided,
+    uint8_t* done, const uint8_t* active, const int8_t* decision_in,
+    uint8_t* cast_r2, int8_t* r2_vals, uint8_t* advanced,
+    uint8_t* newly_decided, uint64_t* coin_out) {
+  rk_node_step_impl(S, R, me, quorum, f1, seed, coin_threshold, slot, phase,
+                    stage, my_r1, my_r2, led1, led2, decided, done, active,
+                    decision_in, cast_r2, r2_vals, advanced, newly_decided,
+                    coin_out);
 }
 
 // start_slots: (re)arm masked shards for a new decision slot.
@@ -310,9 +345,20 @@ enum : int32_t {
   RKC_OUT_FRAMES,       // outbound frames emitted by rk_tick
   RKC_DECIDED,          // shards newly decided inside rk_tick
   RKC_OPENED,           // shards armed (opened) by rk_tick
+  // -- consensus-health telemetry (chaos plane, v2) --------------------
+  RKC_COIN_V0,          // common-coin flips landing V0 (MUST stay
+  RKC_COIN_V1,          // adjacent to RKC_COIN_V1: rk_tick hands the
+                        // pair to the step as one 2-cell block)
+  RKC_PHASE_SUM,        // sum of phases-to-decide over local decisions
   RKC_COUNT
 };
-static const int32_t RK_COUNTERS_VERSION = 1;
+static const int32_t RK_COUNTERS_VERSION = 2;
+
+// Phases-to-decide histogram: bin p counts local tally decisions whose
+// weak-MVC phase count was p (clamped into the top bin). Sized for the
+// tail the paper's termination analysis cares about (P[phases > p]
+// decays ~2^-p; 32 covers anything a live cluster can produce).
+static const int32_t RK_PHASE_HIST = 32;
 
 // ---------------------------------------------------------------------------
 // Flight recorder: a fixed-size binary event ring written on the fast path.
@@ -438,6 +484,9 @@ struct RkCtx {
   // observability counter block (see RKC_* above); zero-initialized
   uint64_t ctrs[RKC_COUNT];
 
+  // phases-to-decide histogram (see RK_PHASE_HIST above); zero-init
+  uint64_t phase_hist[RK_PHASE_HIST];
+
   // flight-recorder event ring (see FrEvent above); fr_head counts every
   // record ever written, the live window is the last RK_FLIGHT_CAP
   std::vector<FrEvent> fr;
@@ -532,6 +581,7 @@ void* rk_ctx_create(const int64_t* dims, const int64_t* ptrs,
   c->r2_vals.resize(c->S);
   c->idx_scratch.resize(c->S);
   std::memset(c->ctrs, 0, sizeof(c->ctrs));
+  std::memset(c->phase_hist, 0, sizeof(c->phase_hist));
   c->fr.resize(RK_FLIGHT_CAP);
   c->fr_head = 0;
   return c;
@@ -555,6 +605,12 @@ int32_t rk_counters_count(void) { return RKC_COUNT; }
 // Borrowed pointer to the context's uint64 counter block; valid for the
 // context's lifetime. The Python side wraps it as a read-only ndarray.
 void* rk_counters(void* ctx) { return ((RkCtx*)ctx)->ctrs; }
+
+// Phases-to-decide histogram (uint64[rk_phase_hist_len()], bin p =
+// decisions taking p phases, top bin clamps). Borrowed, context-lifetime,
+// single-writer — same contract as rk_counters.
+int32_t rk_phase_hist_len(void) { return RK_PHASE_HIST; }
+void* rk_phase_hist(void* ctx) { return ((RkCtx*)ctx)->phase_hist; }
 
 // --- flight recorder (binary event ring) ------------------------------------
 
@@ -905,11 +961,12 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
     c->ctrs[RKC_STAGES]++;
     rk_route_carry(c, 1);
     rk_route_carry(c, 2);
-    rk_node_step(c->S, c->R, c->me, c->quorum, c->f1, c->seed,
-                 c->coin_threshold, c->slot, c->phase, c->stage, c->my_r1,
-                 c->my_r2, c->led1, c->led2, c->decided, c->done, c->active,
-                 c->dec_plane, c->cast_r2.data(), c->r2_vals.data(),
-                 c->advanced.data(), c->newly_step.data());
+    rk_node_step_impl(c->S, c->R, c->me, c->quorum, c->f1, c->seed,
+                      c->coin_threshold, c->slot, c->phase, c->stage,
+                      c->my_r1, c->my_r2, c->led1, c->led2, c->decided,
+                      c->done, c->active, c->dec_plane, c->cast_r2.data(),
+                      c->r2_vals.data(), c->advanced.data(),
+                      c->newly_step.data(), &c->ctrs[RKC_COIN_V0]);
     std::memset(c->dec_plane, ABS, c->S);
     // outbox: per-iteration frames, masked by the engine's in-flight set
     // (engine._process_outbox parity)
@@ -947,6 +1004,11 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
       if (c->newly_step[s]) {
         c->newly_acc[s] = 1;
         idx[n_new++] = s;
+        // post-advance phase == phases-to-decide for this slot (the
+        // decide step bumps phase): the termination-analysis curve
+        const int32_t p = c->phase[s];
+        c->ctrs[RKC_PHASE_SUM] += (uint64_t)p;
+        c->phase_hist[p < RK_PHASE_HIST ? p : RK_PHASE_HIST - 1]++;
         fr_rec(c, FRE_STEP_DECIDE, (uint8_t)c->decided[s], 0xFFFF,
                (uint32_t)s, (int64_t)c->slot[s]);
       }

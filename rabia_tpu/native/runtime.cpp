@@ -211,6 +211,13 @@ enum : uint8_t {
                    // kind 0: scalar propose retransmit wanted
                    // kind 1: block announce retransmit wanted (token)
                    // kind 2: peer votes waiting, no binding (V0 candidate)
+  EV_LEDGER = 6,   // 16B block id | u32 count | count * (u32 shard |
+                   // u64 slot): natively applied PEER-block wave entries
+                   // (token 0 — no Python owner) whose K_WAVE records
+                   // were staged with zero batch ids; the control plane
+                   // derives bid = block_batch_id(block_id, shard) and
+                   // backfills K_LEDGER so follower recovery repopulates
+                   // the applied_ids dedup ledger (ROADMAP 3c)
 };
 
 // commands (Python -> C); u32 len | u8 type | payload
@@ -380,6 +387,12 @@ struct CBlk {
   int has_data = 0;
   int64_t remaining = 0;             // live bindings (pending + open)
   double bound_at = 0.0;
+  // 16B wire block id of a natively parsed peer block (has_block_id=1):
+  // lets the control plane backfill K_LEDGER batch ids for C-staged
+  // waves on NON-proposer replicas (EV_LEDGER) — batch ids derive
+  // deterministically from (block_id, shard), core/blocks.py
+  uint8_t block_id[16] = {0};
+  int has_block_id = 0;
 };
 
 struct RtmCtx {
@@ -682,6 +695,8 @@ static int parse_propose_block(RtmCtx* c, const uint8_t* data, int64_t len,
   b.want = 0;
   b.has_data = 1;
   b.bound_at = now;
+  memcpy(b.block_id, body, 16);
+  b.has_block_id = 1;
   b.data.assign(blob, blob + blob_len);
   b.cmd_offsets.resize((size_t)total + 1);
   b.cmd_offsets[0] = 0;
@@ -1098,6 +1113,29 @@ static void process_decided(RtmCtx* c, double now) {
                                                  (int64_t)pay.size());
       }
       c->stg[RTS_APPLY] += mono_ns() - w0;  // staging rides the apply stage
+      if (b.token == 0 && b.has_block_id) {
+        // receiver-side ledger completeness: hand the (block id, shard,
+        // slot) tuples of the zero-bid K_WAVE records just staged to
+        // Python, which backfills K_LEDGER off the commit path (the
+        // proposer path backfills from its block registry in _on_wave)
+        std::vector<uint8_t> lrec;
+        uint32_t n_led = 0;
+        for (size_t i = 0; i < ent_shard.size(); i++)
+          if (ent_in_order[i] && ent_val[i] == V1c) n_led++;
+        if (n_led) {
+          lrec.push_back(EV_LEDGER);
+          size_t w = lrec.size();
+          lrec.resize(w + 16);
+          memcpy(lrec.data() + w, b.block_id, 16);
+          wr_u32(lrec, n_led);
+          for (size_t i = 0; i < ent_shard.size(); i++) {
+            if (!ent_in_order[i] || ent_val[i] != V1c) continue;
+            wr_u32(lrec, (uint32_t)ent_shard[i]);
+            wr_u64(lrec, (uint64_t)ent_slot[i]);
+          }
+          ev_push(c, lrec);
+        }
+      }
     }
     // bookkeeping for every decided entry
     for (size_t i = 0; i < ent_shard.size(); i++) {

@@ -40,6 +40,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <queue>
 #include <string>
 #include <thread>
 #include <vector>
@@ -89,9 +90,12 @@ enum : int32_t {
   RTC_DIALS,             // outbound connection attempts (incl. redials)
   RTC_CONNS_ESTABLISHED, // handshakes completed into `established`
   RTC_CONNS_CLOSED,      // established connections torn down
+  // -- chaos shaping layer (rt_set_shaping, v2) ------------------------
+  RTC_SHAPE_DROPPED,     // outbound frames dropped by per-peer shaping
+  RTC_SHAPE_DELAYED,     // outbound frames held in the delay queue
   RTC_COUNT
 };
-constexpr int32_t kCountersVersion = 1;
+constexpr int32_t kCountersVersion = 2;
 
 // Flight recorder: one compact record per frame in/out, so a transport
 // stall is attributable after the fact (the engine's flight merger folds
@@ -233,6 +237,84 @@ struct Transport {
   std::deque<OutMsg> outq;
   std::vector<std::vector<uint8_t>> out_pool;  // outbound frame arena
   uint64_t out_hits = 0, out_misses = 0;
+
+  // Chaos shaping layer (rt_set_shaping): per-peer outbound delay/drop
+  // injection, applied by the io thread at drain time so the REAL
+  // epoll/TCP path carries the shaped traffic (the chaos plane's
+  // adverse-network profiles exercise the production C runtime, not a
+  // simulator stand-in). Guarded by `mu` (drain_out_locked holds it).
+  // Mux client sessions are never shaped — shaping targets replica
+  // peers by node id.
+  struct Shape {
+    uint32_t delay_us = 0;
+    uint32_t jitter_us = 0;
+    double drop = 0.0;
+  };
+  std::map<NodeIdBytes, Shape> shaping;
+  struct Delayed {
+    double due;
+    std::shared_ptr<std::vector<uint8_t>> frame;
+    NodeIdBytes target;
+    bool operator>(const Delayed& o) const { return due > o.due; }
+  };
+  std::priority_queue<Delayed, std::vector<Delayed>, std::greater<Delayed>>
+      delayq;
+  uint64_t shape_rng = 0x9E3779B97F4A7C15ull;
+
+  static inline uint64_t xs64(uint64_t& s) {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    return s;
+  }
+  double shape_rand01() {  // uniform [0,1), 53-bit
+    return (double)(xs64(shape_rng) >> 11) * (1.0 / 9007199254740992.0);
+  }
+
+  // Apply the peer's shape to one frame destined for `id` (established
+  // fd `fd`): returns true when the frame was consumed (dropped or
+  // queued for later delivery), false when the caller should enqueue it
+  // now. Caller holds `mu`.
+  bool shape_outbound(const NodeIdBytes& id, int fd, double now,
+                      const std::shared_ptr<std::vector<uint8_t>>& f) {
+    (void)fd;
+    if (shaping.empty()) return false;
+    auto it = shaping.find(id);
+    if (it == shaping.end()) return false;
+    const Shape& sh = it->second;
+    if (sh.drop > 0.0 && shape_rand01() < sh.drop) {
+      bump(RTC_SHAPE_DROPPED);
+      return true;
+    }
+    if (sh.delay_us == 0 && sh.jitter_us == 0) return false;
+    double d_us = (double)sh.delay_us;
+    if (sh.jitter_us)
+      d_us += (shape_rand01() * 2.0 - 1.0) * (double)sh.jitter_us;
+    if (d_us <= 0.0) return false;  // jitter-only draws clamp at "now"
+    delayq.push(Delayed{now + d_us * 1e-6, f, id});
+    bump(RTC_SHAPE_DELAYED);
+    return true;
+  }
+
+  // Release delayed frames whose due time passed; returns the epoll
+  // timeout (ms) until the next one is due (capped by `base_ms`).
+  // Caller holds `mu`.
+  int release_delayed(double now, int base_ms) {
+    while (!delayq.empty() && delayq.top().due <= now) {
+      Delayed d = delayq.top();
+      delayq.pop();
+      auto est = established.find(d.target);
+      if (est != established.end()) {
+        enqueue_shared_locked(est->second, d.frame);
+      }
+      // peer gone at release time: best-effort drop, exactly like an
+      // unshaped frame staged for a disconnected peer
+    }
+    if (delayq.empty()) return base_ms;
+    int ms = (int)((delayq.top().due - now) * 1e3) + 1;
+    if (ms < 1) ms = 1;
+    return ms < base_ms ? ms : base_ms;
+  }
 
   // observability counter block (RTC_*), exposed raw via rt_counters.
   // Relaxed atomics: multi-writer (io thread + caller threads), read
@@ -622,13 +704,18 @@ void Transport::drain_out_locked() {
     std::lock_guard<std::mutex> lo(mu_out);
     local.swap(outq);
   }
+  const double now = local.empty() ? 0.0 : now_s();
   for (auto& m : local) {
     if (m.broadcast) {
-      for (auto& [id, fd] : established) enqueue_shared_locked(fd, m.frame);
+      for (auto& [id, fd] : established) {
+        if (!shape_outbound(id, fd, now, m.frame))
+          enqueue_shared_locked(fd, m.frame);
+      }
     } else {
       auto est = established.find(m.target);
       if (est != established.end()) {
-        enqueue_shared_locked(est->second, m.frame);
+        if (!shape_outbound(m.target, est->second, now, m.frame))
+          enqueue_shared_locked(est->second, m.frame);
         continue;
       }
       auto mx = mux_sessions.find(m.target);
@@ -723,10 +810,15 @@ void Transport::try_dials() {
 
 void Transport::io_loop() {
   epoll_event evs[64];
+  int wait_ms = 50;
   while (!stopping.load()) {
-    int n = epoll_wait(epoll_fd, evs, 64, 50);
+    int n = epoll_wait(epoll_fd, evs, 64, wait_ms);
     std::unique_lock<std::mutex> lk(mu);
     drain_out_locked();
+    // chaos shaping: deliver due delayed frames and tighten the next
+    // epoll timeout to the next due time (50ms granularity would smear
+    // sub-50ms injected delays)
+    wait_ms = delayq.empty() ? 50 : release_delayed(now_s(), 50);
     for (int i = 0; i < n; i++) {
       int fd = evs[i].data.fd;
       uint32_t e = evs[i].events;
@@ -854,6 +946,44 @@ int rt_remove_peer(void* h, const uint8_t peer_id[16]) {
   t->peers.erase(id);
   auto est = t->established.find(id);
   if (est != t->established.end()) t->close_conn(est->second);
+  return 0;
+}
+
+// Chaos shaping layer: inject per-peer outbound delay (+/- jitter) and
+// drop probability on this transport's link TO peer_id, applied on the
+// io thread at drain time (see Transport::shape_outbound). Asymmetric
+// by construction — shape one side's transport to impair one direction.
+// delay_us=0, drop=0 clears the peer's entry; seed != 0 reseeds the
+// deterministic drop RNG. Returns 0.
+int rt_set_shaping(void* h, const uint8_t peer_id[16], uint32_t delay_us,
+                   uint32_t jitter_us, double drop, uint64_t seed) {
+  auto* t = static_cast<Transport*>(h);
+  NodeIdBytes id;
+  memcpy(id.data(), peer_id, 16);
+  {
+    std::lock_guard<std::mutex> lk(t->mu);
+    if (seed) t->shape_rng = seed;
+    if (delay_us == 0 && jitter_us == 0 && drop <= 0.0) {
+      t->shaping.erase(id);
+    } else {
+      Transport::Shape sh;
+      sh.delay_us = delay_us;
+      sh.jitter_us = jitter_us;
+      sh.drop = drop < 0.0 ? 0.0 : (drop > 1.0 ? 1.0 : drop);
+      t->shaping[id] = sh;
+    }
+  }
+  t->kick();
+  return 0;
+}
+
+// Clear every shaping entry (delayed frames already queued still deliver
+// at their due times — clearing stops future impairment, it does not
+// reorder traffic already in the delay queue).
+int rt_clear_shaping(void* h) {
+  auto* t = static_cast<Transport*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  t->shaping.clear();
   return 0;
 }
 

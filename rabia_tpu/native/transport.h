@@ -15,6 +15,12 @@ void* rt_create(const uint8_t self_id[16], const char* host, uint16_t port,
 int rt_add_peer(void* h, const uint8_t id[16], const char* host,
                 uint16_t port);
 int rt_remove_peer(void* h, const uint8_t id[16]);
+// Chaos shaping layer: per-peer outbound delay/jitter (us) + drop
+// probability, applied by the io thread at drain time. delay=jitter=0
+// and drop<=0 clears the peer; seed != 0 reseeds the drop RNG.
+int rt_set_shaping(void* h, const uint8_t id[16], uint32_t delay_us,
+                   uint32_t jitter_us, double drop, uint64_t seed);
+int rt_clear_shaping(void* h);
 // 0 ok, -1 unknown/unconnected peer, -2 frame too large.
 int rt_send(void* h, const uint8_t id[16], const uint8_t* data, uint32_t len);
 // Returns the number of peers reached.

@@ -119,6 +119,15 @@ class NetworkSimulator:
         self._node_delay: dict[NodeId, float] = {}  # SlowNode fault support
         self._partition: set[NodeId] = set()
         self._partition_until: float = 0.0
+        # chaos plane: per-DIRECTED-link impairments (asymmetric loss /
+        # extra one-way delay) and a scheduled flapping partition
+        self._link_loss: dict[tuple[NodeId, NodeId], float] = {}
+        self._link_delay: dict[tuple[NodeId, NodeId], float] = {}
+        self._flap_group: set[NodeId] = set()
+        self._flap_period: float = 1.0
+        self._flap_duty: float = 0.5
+        self._flap_t0: float = 0.0
+        self._flap_until: float = 0.0
         self._heap: list[_Pending] = []
         self._seq = itertools.count()
         self._wakeup: Optional[asyncio.Event] = None
@@ -158,6 +167,65 @@ class NetworkSimulator:
         else:
             self._node_delay[node] = delay
 
+    def set_link_loss(self, src: NodeId, dst: NodeId, rate: float) -> None:
+        """ASYMMETRIC loss: drop `rate` of messages on the DIRECTED link
+        src->dst only (the reverse direction is untouched — the
+        sustained-asymmetric-loss chaos profile; wireless-BFT's lossy
+        uplink shape). rate <= 0 clears the link."""
+        if rate <= 0:
+            self._link_loss.pop((src, dst), None)
+        else:
+            self._link_loss[(src, dst)] = min(1.0, float(rate))
+
+    def set_link_delay(self, src: NodeId, dst: NodeId, delay: float) -> None:
+        """Extra one-way delay on the DIRECTED link src->dst (seconds);
+        composes with global conditions and node delays. <= 0 clears."""
+        if delay <= 0:
+            self._link_delay.pop((src, dst), None)
+        else:
+            self._link_delay[(src, dst)] = float(delay)
+
+    def clear_link_faults(self) -> None:
+        self._link_loss.clear()
+        self._link_delay.clear()
+
+    def set_flap(
+        self,
+        group: set[NodeId],
+        period: float,
+        duty: float = 0.5,
+        duration: Optional[float] = None,
+    ) -> None:
+        """Scheduled flapping partition: `group` is isolated (one-sided
+        membership semantics, like :meth:`partition`) for the first
+        ``duty`` fraction of every ``period`` seconds, healed for the
+        rest — evaluated lazily at send/delivery time, so the schedule
+        is exact with no timer tasks. ``duration`` bounds the whole
+        flapping episode (None = until :meth:`clear_flap`)."""
+        if period <= 0:
+            raise ValueError("flap period must be positive")
+        self._flap_group = set(group)
+        self._flap_period = float(period)
+        self._flap_duty = min(1.0, max(0.0, float(duty)))
+        self._flap_t0 = time.monotonic()
+        self._flap_until = (
+            self._flap_t0 + duration if duration is not None else float("inf")
+        )
+
+    def clear_flap(self) -> None:
+        self._flap_group = set()
+        self._flap_until = 0.0
+
+    def _flap_active(self) -> bool:
+        if not self._flap_group:
+            return False
+        now = time.monotonic()
+        if now >= self._flap_until:
+            self._flap_group = set()
+            return False
+        phase = ((now - self._flap_t0) % self._flap_period) / self._flap_period
+        return phase < self._flap_duty
+
     def partition(self, group: set[NodeId], duration: Optional[float] = None) -> None:
         """Isolate `group` from the rest for `duration` seconds (None = until
         healed explicitly)."""
@@ -179,9 +247,15 @@ class NetworkSimulator:
         return True
 
     def _blocked_by_partition(self, a: NodeId, b: NodeId) -> bool:
-        if not self._partition_active():
-            return False
-        return (a in self._partition) != (b in self._partition)
+        if self._partition_active() and (
+            (a in self._partition) != (b in self._partition)
+        ):
+            return True
+        if self._flap_active() and (
+            (a in self._flap_group) != (b in self._flap_group)
+        ):
+            return True
+        return False
 
     # -- the send path (network_sim.rs:138-186) -----------------------------
 
@@ -200,6 +274,10 @@ class NetworkSimulator:
         if c.packet_loss_rate > 0 and self._rng.random() < c.packet_loss_rate:
             self.stats.messages_dropped += 1
             return
+        link_loss = self._link_loss.get((sender, target))
+        if link_loss and self._rng.random() < link_loss:
+            self.stats.messages_dropped += 1
+            return
         if c.partition_probability > 0 and self._rng.random() < c.partition_probability:
             self.stats.messages_dropped += 1
             return
@@ -208,6 +286,7 @@ class NetworkSimulator:
         if c.latency_max > 0:
             delay = self._rng.uniform(c.latency_min, c.latency_max)
         delay += self._node_delay.get(sender, 0.0) + self._node_delay.get(target, 0.0)
+        delay += self._link_delay.get((sender, target), 0.0)
         if c.bandwidth_limit:
             delay += self._bandwidth_delay(len(data), c.bandwidth_limit)
 

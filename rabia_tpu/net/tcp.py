@@ -63,6 +63,9 @@ RT_COUNTER_NAMES = (
     "dials",
     "conns_established",
     "conns_closed",
+    # chaos shaping layer (RTC v2)
+    "shape_dropped",
+    "shape_delayed",
 )
 
 
@@ -173,6 +176,46 @@ class TcpNetwork(NetworkTransport):
     def remove_peer(self, peer: NodeId) -> None:
         pid = (ctypes.c_uint8 * 16).from_buffer_copy(_id_bytes(peer))
         self._lib.rt_remove_peer(self._handle, pid)
+
+    # -- chaos shaping (adverse-network scenario engine) --------------------
+
+    def set_peer_shaping(
+        self,
+        peer: NodeId,
+        delay_ms: float = 0.0,
+        jitter_ms: float = 0.0,
+        drop_rate: float = 0.0,
+        seed: int = 0,
+    ) -> None:
+        """Inject outbound delay (+/- jitter) and drop probability on
+        THIS transport's link to ``peer``, applied inside the native io
+        loop — the real epoll/TCP path carries the shaped traffic, so
+        chaos profiles exercise the production C runtime. Asymmetric by
+        construction: shape one endpoint to impair one direction. All
+        zeros clears the peer's shaping."""
+        if not hasattr(self._lib, "rt_set_shaping"):
+            raise NetworkError(
+                "native transport library predates rt_set_shaping; "
+                "rebuild it from transport.cpp"
+            )
+        h = self._handle
+        if not h:
+            return
+        pid = (ctypes.c_uint8 * 16).from_buffer_copy(_id_bytes(peer))
+        self._lib.rt_set_shaping(
+            h, pid,
+            int(max(0.0, delay_ms) * 1000),
+            int(max(0.0, jitter_ms) * 1000),
+            float(drop_rate),
+            seed & 0xFFFFFFFFFFFFFFFF,
+        )
+
+    def clear_shaping(self) -> None:
+        """Remove every per-peer shaping entry (already-delayed frames
+        still deliver at their due times)."""
+        h = self._handle
+        if h and hasattr(self._lib, "rt_clear_shaping"):
+            self._lib.rt_clear_shaping(h)
 
     # -- reader bridge ------------------------------------------------------
 

@@ -1068,6 +1068,20 @@ class WalPersistence(PersistenceLayer):
     def close(self) -> None:
         w, self._writer = self._writer, None
         if w is not None:
+            # unregister the durability eventfd from the watching loop
+            # BEFORE the writer closes the fd: the OS may hand the same
+            # fd NUMBER to a later WAL instance in this process, and a
+            # stale selector registration for the dead fd poisons the
+            # new one (epoll drops a closed fd silently; the selector's
+            # fd->key map does not) — every durability barrier on the
+            # successor then times out. Surfaced by the chaos plane's
+            # sequential-cluster scenario matrix.
+            loop, self._watch_loop = self._watch_loop, None
+            if loop is not None and w.event_fd is not None:
+                try:
+                    loop.remove_reader(w.event_fd)
+                except Exception:
+                    pass
             try:
                 w.sync(5.0)
             except PersistenceError:

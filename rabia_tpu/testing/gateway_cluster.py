@@ -141,7 +141,11 @@ class GatewayCluster:
     def _mesh_gateways(self) -> None:
         for i in range(self.n):
             for j in range(self.n):
-                if i != j and self.gateways[i] is not None:
+                if (
+                    i != j
+                    and self.gateways[i] is not None
+                    and self.gateways[j] is not None
+                ):
                     self.gateways[i].add_peer_gateway(
                         self.gateways[j].node_id,
                         "127.0.0.1",
@@ -158,26 +162,47 @@ class GatewayCluster:
         """Direct host-store access (the linearizability oracle)."""
         return self.machines[replica][shard].store
 
-    # -- chaos --------------------------------------------------------------
+    # -- chaos / elastic membership -----------------------------------------
+    #
+    # The replica ROSTER is fixed (Rabia has no in-protocol
+    # reconfiguration; neither does the reference) — what is elastic is
+    # the LIVE SET: replicas decommission (`stop_replica`), rejoin
+    # (`start_replica`, recovering from their persistence layer and
+    # catching up via peer Decisions/snapshot sync), or roll
+    # (`restart_replica`) while the rest of the cluster keeps serving.
+    # The chaos plane's membership profiles drive exactly these
+    # transitions under sustained open-loop load.
 
-    async def restart_replica(self, i: int, settle: float = 0.2) -> None:
-        """Restart replica ``i`` (engine, transport and gateway). The new
-        engine restores from the replica's persistence layer (vote
-        barrier + snapshot — the supported crash-recovery model) and
-        catches up the tail via peer Decisions/snapshot sync. The replica
-        and gateway rebind their previous ports so peers and clients
-        redial transparently."""
+    def is_down(self, i: int) -> bool:
+        return self.engines[i] is None
+
+    @property
+    def live_replicas(self) -> list[int]:
+        return [i for i in range(self.n) if self.engines[i] is not None]
+
+    async def stop_replica(self, i: int, settle: float = 0.2) -> None:
+        """Decommission replica ``i``: gateway, engine and transport go
+        down and STAY down until :meth:`start_replica`. Its persistence
+        layer (and port reservations, best-effort) survive for the
+        rejoin."""
         if self.persists[i] is None:
             raise RuntimeError(
-                "restart_replica requires persistence "
-                "(GatewayCluster(persistence=True)): restarting with no "
+                "stop_replica requires persistence "
+                "(GatewayCluster(persistence=True)): rejoining with no "
                 "persistence is outside the crash-recovery model"
             )
-        net_port = self.nets[i].port
+        if self.engines[i] is None:
+            return
         gw = self.gateways[i]
-        gw_port, gw_node = gw.port, gw.node_id
-        gw_cfg = gw.config
+        self._down_state = getattr(self, "_down_state", {})
+        self._down_state[i] = {
+            "net_port": self.nets[i].port,
+            "gw_port": gw.port,
+            "gw_node": gw.node_id,
+            "gw_cfg": gw.config,
+        }
         await gw.close()
+        self.gateways[i] = None
         await self.engines[i].shutdown()
         self.tasks[i].cancel()
         try:
@@ -185,8 +210,20 @@ class GatewayCluster:
         except (asyncio.CancelledError, Exception):
             pass
         await self.nets[i].close()
+        self.engines[i] = None
+        self.nets[i] = None  # type: ignore[call-overload]
         await asyncio.sleep(settle)
 
+    async def start_replica(self, i: int) -> None:
+        """Rejoin a decommissioned replica under its original identity
+        and ports: the new engine restores from the replica's
+        persistence layer (vote barrier + snapshot chain + WAL replay
+        where present) and catches up the tail via peer Decisions /
+        snapshot sync; peers and clients redial transparently because
+        the ports are rebound."""
+        if self.engines[i] is not None:
+            return
+        st = self._down_state.pop(i)
         p = self.persists[i]
         if getattr(p, "supports_wal", False):
             # a fresh WalPersistence re-runs the recovery scan (torn-tail
@@ -199,32 +236,47 @@ class GatewayCluster:
                 n_shards=self.n_shards,
                 **self.wal_kwargs,
             )
-        self._build_replica(i, bind_port=net_port)
+        self._build_replica(i, bind_port=st["net_port"])
         for j in range(self.n):
-            if i != j:
+            if i != j and self.nets[j] is not None:
                 self.nets[i].add_peer(
                     self.ids[j], "127.0.0.1", self.nets[j].port
                 )
+                self.nets[j].add_peer(
+                    self.ids[i], "127.0.0.1", self.nets[i].port
+                )
         self.tasks[i] = asyncio.ensure_future(self.engines[i].run())
-        cfg = GatewayConfig(**{**gw_cfg.__dict__, "bind_port": gw_port})
+        cfg = GatewayConfig(
+            **{**st["gw_cfg"].__dict__, "bind_port": st["gw_port"]}
+        )
         self.gateways[i] = GatewayServer(
-            self.engines[i], config=cfg, node_id=gw_node
+            self.engines[i], config=cfg, node_id=st["gw_node"]
         )
         await self.gateways[i].start()
         self._mesh_gateways()
 
+    async def restart_replica(self, i: int, settle: float = 0.2) -> None:
+        """Restart replica ``i`` (engine, transport and gateway) — one
+        rolling-restart step: :meth:`stop_replica` + :meth:`start_replica`."""
+        await self.stop_replica(i, settle=settle)
+        await self.start_replica(i)
+
     async def wait_converged(self, timeout: float = 15.0) -> None:
-        """Block until every replica's per-shard store checksums agree."""
+        """Block until every LIVE replica's per-shard store checksums
+        agree (a decommissioned replica's frozen pre-stop stores can
+        never converge and are excluded; they re-enter the comparison
+        when ``start_replica`` rebuilds them)."""
         deadline = time.time() + timeout
         while time.time() < deadline:
+            live = self.live_replicas
             sums = [
                 tuple(
                     self.machines[r][s].store.checksum()
                     for s in range(self.n_shards)
                 )
-                for r in range(self.n)
+                for r in live
             ]
-            if all(s == sums[0] for s in sums[1:]):
+            if sums and all(s == sums[0] for s in sums[1:]):
                 return
             await asyncio.sleep(0.05)
         detail = "; ".join(
@@ -234,11 +286,11 @@ class GatewayCluster:
                 f"/n{len(self.machines[r][s].store)}"
                 for s in range(self.n_shards)
             )
-            for r in range(self.n)
+            for r in self.live_replicas
         )
         applied = "; ".join(
             f"r{r}={self.engines[r].applied_frontier().tolist()}"
-            for r in range(self.n)
+            for r in self.live_replicas
         )
         raise TimeoutError(
             f"replica stores did not converge within {timeout}s "

@@ -1,0 +1,289 @@
+"""Chaos-plane tests: NetworkSimulator edge semantics (partition expiry
+racing heal, crash with in-flight messages, node delay x partition, the
+new per-link asymmetric loss and scheduled flapping), the profile DSL,
+and a short end-to-end scenario run with consensus-health evidence.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from rabia_tpu.chaos import default_profiles, smoke_profiles
+from rabia_tpu.chaos.profiles import ChaosEvent, ChaosProfile
+from rabia_tpu.core.types import NodeId
+from rabia_tpu.net import NetworkConditions, NetworkSimulator
+
+A = NodeId.from_int(1)
+B = NodeId.from_int(2)
+C = NodeId.from_int(3)
+
+
+def _sim(**kw) -> tuple[NetworkSimulator, dict]:
+    sim = NetworkSimulator(**kw)
+    nets = {n: sim.register(n) for n in (A, B, C)}
+    return sim, nets
+
+
+async def _drain(net, timeout=0.3):
+    out = []
+    while True:
+        try:
+            out.append(await net.receive(timeout=timeout))
+        except Exception:
+            return out
+
+
+class TestSimulatorEdgeSemantics:
+    @pytest.mark.asyncio
+    async def test_partition_duration_expiry_races_heal(self):
+        """A timed partition that already expired must leave heal_partition
+        a no-op (not resurrect anything), and a heal BEFORE expiry must
+        not be undone by the stale expiry deadline when a new untimed
+        partition follows."""
+        sim, nets = _sim()
+        sim.partition({B}, duration=0.05)
+        sim.send(A, B, b"during")
+        assert sim.stats.messages_dropped == 1
+        await asyncio.sleep(0.08)
+        # expired: traffic flows again even with no explicit heal
+        sim.send(A, B, b"after-expiry")
+        assert (await nets[B].receive(timeout=0.5))[1] == b"after-expiry"
+        sim.heal_partition()  # racing the (already-fired) expiry: no-op
+        sim.send(A, B, b"after-heal")
+        assert (await nets[B].receive(timeout=0.5))[1] == b"after-heal"
+
+        # heal BEFORE expiry, then a new UNTIMED partition: the old
+        # deadline must not expire the new partition early
+        sim.partition({B}, duration=5.0)
+        sim.heal_partition()
+        sim.send(A, B, b"healed-early")
+        assert (await nets[B].receive(timeout=0.5))[1] == b"healed-early"
+        sim.partition({B})  # no duration: until healed
+        await asyncio.sleep(0.06)
+        dropped = sim.stats.messages_dropped
+        sim.send(A, B, b"blocked")
+        assert sim.stats.messages_dropped == dropped + 1
+
+    @pytest.mark.asyncio
+    async def test_crash_with_messages_in_flight_drops_at_delivery(self):
+        """Messages already in the delay heap when the target crashes
+        must be dropped at DELIVERY time, not handed to a dead node."""
+        sim, nets = _sim(
+            conditions=NetworkConditions(latency_min=0.05, latency_max=0.06)
+        )
+        sim.send(A, B, b"doomed")
+        sim.crash(B)  # in-flight: due in ~50ms
+        await asyncio.sleep(0.12)
+        assert nets[B].receive_nowait() is None
+        assert sim.stats.messages_dropped == 1
+        # recovery does NOT resurrect the dropped message
+        sim.recover(B)
+        await asyncio.sleep(0.08)
+        assert nets[B].receive_nowait() is None
+        # but fresh traffic flows
+        sim.send(A, B, b"fresh")
+        assert (await nets[B].receive(timeout=0.5))[1] == b"fresh"
+
+    @pytest.mark.asyncio
+    async def test_node_delay_interacts_with_partition_at_delivery(self):
+        """set_node_delay holds a message in flight; a partition that
+        activates before the due time blocks it at delivery (one-sided
+        membership check runs again at delivery time), and a partition
+        that heals before the due time lets it through."""
+        sim, nets = _sim()
+        sim.set_node_delay(B, 0.08)
+        sim.send(A, B, b"blocked-at-delivery")
+        sim.partition({B}, duration=0.5)  # activates while in flight
+        await asyncio.sleep(0.15)
+        assert nets[B].receive_nowait() is None
+        assert sim.stats.messages_dropped == 1
+        sim.heal_partition()
+        # reverse order: partitioned at SEND time drops immediately,
+        # regardless of the pending delay
+        dropped = sim.stats.messages_dropped
+        sim.partition({B}, duration=0.02)
+        sim.send(A, B, b"dropped-at-send")
+        assert sim.stats.messages_dropped == dropped + 1
+        # healed while in flight: delivered
+        await asyncio.sleep(0.05)
+        sim.send(A, B, b"in-flight-heals")
+        assert (
+            await nets[B].receive(timeout=1.0)
+        )[1] == b"in-flight-heals"
+        sim.set_node_delay(B, 0.0)
+
+    @pytest.mark.asyncio
+    async def test_asymmetric_link_loss_is_directional(self):
+        sim, nets = _sim()
+        sim.set_link_loss(A, B, 1.0)
+        for _ in range(5):
+            sim.send(A, B, b"up")   # all dropped
+            sim.send(B, A, b"down")  # all delivered
+        assert len(await _drain(nets[A], timeout=0.1)) == 5
+        assert nets[B].receive_nowait() is None
+        # other links untouched
+        sim.send(A, C, b"side")
+        assert (await nets[C].receive(timeout=0.5))[1] == b"side"
+        sim.clear_link_faults()
+        sim.send(A, B, b"cleared")
+        assert (await nets[B].receive(timeout=0.5))[1] == b"cleared"
+
+    @pytest.mark.asyncio
+    async def test_flap_schedule_blocks_down_windows_then_expires(self):
+        sim, nets = _sim()
+        sim.set_flap({B}, period=0.2, duty=0.5, duration=0.5)
+        t0 = time.monotonic()
+        # first half-period: down (blocked, one-sided)
+        dropped = sim.stats.messages_dropped
+        sim.send(A, B, b"down-window")
+        assert sim.stats.messages_dropped == dropped + 1
+        sim.send(C, A, b"unaffected")  # neither endpoint in the group
+        assert (await nets[A].receive(timeout=0.5))[1] == b"unaffected"
+        # wait into the second half-period: up
+        await asyncio.sleep(max(0.0, t0 + 0.12 - time.monotonic()))
+        sim.send(A, B, b"up-window")
+        assert (await nets[B].receive(timeout=0.5))[1] == b"up-window"
+        # past the episode: flapping is over regardless of phase
+        await asyncio.sleep(max(0.0, t0 + 0.55 - time.monotonic()))
+        sim.send(A, B, b"episode-over")
+        assert (await nets[B].receive(timeout=0.5))[1] == b"episode-over"
+        # get_connected_nodes honors the flap window too
+        sim.set_flap({B}, period=10.0, duty=1.0)
+        assert B not in await nets[A].get_connected_nodes()
+        sim.clear_flap()
+        assert B in await nets[A].get_connected_nodes()
+
+
+class TestProfileDsl:
+    def test_default_matrix_shape(self):
+        profs = default_profiles()
+        assert len(profs) >= 6
+        fabrics = {p.fabric for p in profs.values()}
+        assert fabrics == {"sim", "tcp"}
+        # the acceptance shape: >=1 real-TCP shaped, >=1 membership
+        assert any(
+            p.fabric == "tcp"
+            and any(e.action in ("wan", "link_loss") for e in p.events)
+            for p in profs.values()
+        )
+        assert any(
+            any(
+                e.action in ("stop_replica", "start_replica",
+                             "restart_replica")
+                for e in p.events
+            )
+            for p in profs.values()
+        )
+        smoke = smoke_profiles()
+        assert 2 <= len(smoke) <= 4
+        assert any(p.fabric == "tcp" for p in smoke.values())
+
+    def test_scaling_preserves_structure(self):
+        p = ChaosProfile(
+            name="x", fabric="sim", description="", duration=10.0,
+            events=(
+                ChaosEvent(2.0, "flap",
+                           {"group": [1], "period": 1.0, "duty": 0.4,
+                            "duration": 4.0}),
+                ChaosEvent(8.0, "heal", {}),
+            ),
+        )
+        s = p.scaled(0.5)
+        assert s.duration == 5.0
+        assert s.events[0].at == 1.0
+        assert s.events[0].args["period"] == 0.5
+        assert s.events[0].args["duration"] == 2.0
+        assert s.events[0].args["duty"] == 0.4  # NOT time-scaled
+        assert s.events[1].at == 4.0
+        assert p.scaled(1.0) is p
+
+
+class TestScenarioRunSim:
+    @pytest.mark.asyncio
+    async def test_short_sim_profile_records_evidence_and_timeline(self):
+        """End-to-end mini scenario on the simulator fabric: the report
+        must carry a continuous availability timeline, the
+        phases-to-decide distribution and coin tallies — the evidence
+        schema every matrix entry promises (docs/SCENARIOS.md)."""
+        from rabia_tpu.chaos.runner import run_profile
+
+        prof = ChaosProfile(
+            name="mini",
+            fabric="sim",
+            description="mini flap",
+            duration=2.5,
+            warmup=0.5,
+            rate=60.0,
+            events=(
+                ChaosEvent(0.5, "flap",
+                           {"group": [2], "period": 0.5, "duty": 0.4,
+                            "duration": 1.2}),
+            ),
+            min_availability=0.2,
+        )
+        rep = await run_profile(prof, verbose=False)
+        assert rep["arrivals"] > 0
+        assert rep["outcomes"]["ok"] > 0
+        assert len(rep["timeline"]) >= 8
+        assert any(
+            w["availability"] is not None for w in rep["timeline"]
+        )
+        ev = rep["phases_to_decide"]
+        assert ev["decisions"] > 0
+        assert ev["hist"], "empty phase-count distribution"
+        assert ev["mean_phases"] >= 1.0
+        assert set(ev["coin_flips"]) == {"v0", "v1"}
+        assert rep["converged"] is True
+        assert rep["pass"], rep["problems"]
+
+
+class TestElasticMembership:
+    @pytest.mark.asyncio
+    async def test_stop_start_replica_under_client_load(self):
+        """GatewayCluster's elastic-membership surface directly: a
+        replica decommissions while a client keeps committing against
+        the surviving quorum, then rejoins (WAL recovery) and the
+        cluster reconverges with the writes that happened while it was
+        gone."""
+        from rabia_tpu.apps.kvstore import decode_kv_response, encode_set_bin
+        from rabia_tpu.gateway.client import RabiaClient
+        from rabia_tpu.native.build import load_walkernel
+        from rabia_tpu.testing.gateway_cluster import GatewayCluster
+
+        if load_walkernel() is None:
+            pytest.skip("walkernel unavailable")
+        c = GatewayCluster(3, 2, persistence="wal")
+        cli = None
+        try:
+            await c.start()
+            cli = RabiaClient(
+                [c.endpoint(0), c.endpoint(1)], call_timeout=30.0
+            )
+            await cli.connect()
+            for k in range(6):
+                resp = await cli.submit(
+                    k % 2, [encode_set_bin(f"em{k}", f"v{k}")]
+                )
+                assert decode_kv_response(resp[0]).ok
+            await c.stop_replica(2)
+            assert c.is_down(2) and c.live_replicas == [0, 1]
+            # the surviving quorum keeps serving THROUGH the outage
+            for k in range(6, 12):
+                resp = await cli.submit(
+                    k % 2, [encode_set_bin(f"em{k}", f"v{k}")]
+                )
+                assert decode_kv_response(resp[0]).ok
+            await c.start_replica(2)
+            assert not c.is_down(2)
+            await c.wait_converged(20)
+            # the rejoined replica holds a write it never saw live
+            # (em8 was submitted on shard 8 % 2 == 0)
+            v = c.store(2, 0).get("em8")
+            assert getattr(v, "value", v) == "v8"
+        finally:
+            if cli is not None:
+                await cli.close()
+            await c.stop()

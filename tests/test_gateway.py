@@ -808,6 +808,71 @@ class TestGatewayMux:
                 await conn.close()
             await cluster.stop()
 
+    @pytest.mark.asyncio
+    async def test_rabia_client_mux_lane_with_redial_rebinding(self):
+        """RabiaClient's opt-in mux lane (``mux=True``): the full client
+        library — exactly-once seqs, retry machinery, reconnect replay —
+        over one multiplexed socket instead of a private native
+        transport. A killed connection must redial transparently, the
+        session REBINDS to the new socket (transport latest-binding-wins)
+        and a replayed seq answers from the dedup cache without a second
+        apply."""
+        cluster = await _spin_up()
+        cli = None
+        try:
+            cli = RabiaClient(
+                [cluster.endpoint(0)], mux=True, call_timeout=20.0
+            )
+            await cli.connect()
+            assert isinstance(cli._net.writer, asyncio.StreamWriter)
+            for k in range(6):
+                key = f"cmux-{k}"
+                resp = await cli.submit(
+                    _shard(key), [encode_set_bin(key, f"v{k}")]
+                )
+                assert decode_kv_response(resp[0]).ok
+            v1_before = _decided_v1_total(cluster)
+            # kill the muxed socket under the client: the next call must
+            # redial, rebind the session, and still be exactly-once
+            cli._net.writer.close()
+            resp = await cli.submit(
+                _shard("cmux-re"), [encode_set_bin("cmux-re", "after")]
+            )
+            assert decode_kv_response(resp[0]).ok
+            assert cli.reconnects >= 1
+            # duplicate of an already-committed seq: served CACHED over
+            # the REBOUND connection, no new proposal
+            await asyncio.sleep(0.2)
+            v1_mid = _decided_v1_total(cluster)
+            seq_replay = cli._seq
+            fut = asyncio.get_event_loop().create_future()
+            frame = Submit(
+                client_id=cli.client_id,
+                seq=seq_replay,
+                shard=_shard("cmux-re"),
+                commands=(encode_set_bin("cmux-re", "after"),),
+                ack_upto=0,
+            )
+            cli._pending[seq_replay] = (fut, frame)
+            cli._send_pending(seq_replay)
+            res = await asyncio.wait_for(fut, 10.0)
+            cli._pending.pop(seq_replay, None)
+            assert res.status == ResultStatus.CACHED
+            await asyncio.sleep(0.2)
+            assert _decided_v1_total(cluster) == v1_mid, (
+                "replayed seq over the rebound mux connection proposed "
+                "a second time"
+            )
+            assert v1_mid >= v1_before
+            assert (
+                cluster.store(0, _shard("cmux-re")).get("cmux-re").value
+                == "after"
+            )
+        finally:
+            if cli is not None:
+                await cli.close()
+            await cluster.stop()
+
 
 class TestRuntimeGatewayPlane:
     @pytest.mark.asyncio
