@@ -49,13 +49,40 @@ _SK_CACHED: ctypes.CDLL | None = None
 _SK_FAILED: str | None = None
 
 
+# Every kernel includes the annotations header; its digest keys rebuilds
+# exactly like the kernel's own source (a changed macro or lock wrapper
+# must invalidate every cached .so).
+_ANNOT = _HERE / "annotations.h"
+
+
+def _flavor() -> tuple[str, list[str]]:
+    """(digest-suffix, extra flags) of the current build FLAVOR.
+
+    ``RABIA_NATIVE_DEBUG=1`` selects the debug flavor: the lock-order
+    checker in annotations.h compiles in (acquisition-order inversions
+    and non-recursive double locks abort with both lock names), plus
+    debug symbols. The suffix keeps flavors side by side in the cache —
+    switching the env back and forth never rebuilds."""
+    if os.environ.get("RABIA_NATIVE_DEBUG") == "1":
+        return "-dbg", ["-DRABIA_NATIVE_DEBUG=1", "-g"]
+    return "", []
+
+
+def _digest_of(*srcs: Path) -> str:
+    h = hashlib.blake2s(digest_size=8)
+    for s in srcs:
+        h.update(s.read_bytes())
+    h.update(_flavor()[0].encode())
+    return h.hexdigest()
+
+
 def _src_digest() -> str:
-    return hashlib.blake2s(_SRC.read_bytes(), digest_size=8).hexdigest()
+    return _digest_of(_SRC, _ANNOT)
 
 
 def lib_path() -> Path:
     """Target .so path, keyed by source digest so edits force rebuilds."""
-    return _HERE / f"_transport_{_src_digest()}.so"
+    return _HERE / f"_transport_{_src_digest()}{_flavor()[0]}.so"
 
 
 def _compile(
@@ -73,6 +100,7 @@ def _compile(
         "-std=c++17",
         "-shared",
         "-fPIC",
+        *_flavor()[1],
         *extra_args,
         str(src),
         "-o",
@@ -88,9 +116,11 @@ def _compile(
             f"native {what} build failed:\n{proc.stderr[-2000:]}"
         )
     os.replace(tmp, target)
-    # clean up stale builds of older source versions
+    # clean up stale builds of older source versions (same flavor only:
+    # regular and -dbg artifacts coexist, keyed by their suffix)
+    dbg = _flavor()[0] == "-dbg"
     for old in _HERE.glob(stale_glob):
-        if old != target:
+        if old != target and old.name.endswith("-dbg.so") == dbg:
             try:
                 old.unlink()
             except OSError:
@@ -102,10 +132,7 @@ def _build(target: Path) -> None:
 
 
 def _codec_path() -> Path:
-    digest = hashlib.blake2s(
-        _CODEC_SRC.read_bytes(), digest_size=8
-    ).hexdigest()
-    return _HERE / f"_codec_{digest}.so"
+    return _HERE / f"_codec_{_digest_of(_CODEC_SRC)}{_flavor()[0]}.so"
 
 
 def _build_codec(target: Path) -> None:
@@ -157,8 +184,7 @@ def load_codec():
 
 
 def _hk_path() -> Path:
-    digest = hashlib.blake2s(_HK_SRC.read_bytes(), digest_size=8).hexdigest()
-    return _HERE / f"_hostkernel_{digest}.so"
+    return _HERE / f"_hostkernel_{_digest_of(_HK_SRC)}{_flavor()[0]}.so"
 
 
 def load_hostkernel() -> ctypes.CDLL | None:
@@ -285,8 +311,9 @@ def load_hostkernel() -> ctypes.CDLL | None:
 
 
 def _sk_path() -> Path:
-    digest = hashlib.blake2s(_SK_SRC.read_bytes(), digest_size=8).hexdigest()
-    return _HERE / f"_statekernel_{digest}.so"
+    return (
+        _HERE / f"_statekernel_{_digest_of(_SK_SRC, _ANNOT)}{_flavor()[0]}.so"
+    )
 
 
 def load_statekernel() -> ctypes.CDLL | None:
@@ -558,10 +585,8 @@ _GWS_FAILED: str | None = None
 
 
 def _gws_path() -> Path:
-    digest = hashlib.blake2s(
-        (_HERE / "sessionkernel.cpp").read_bytes(), digest_size=8
-    ).hexdigest()
-    return _HERE / f"_sessionkernel_{digest}.so"
+    digest = _digest_of(_HERE / "sessionkernel.cpp", _ANNOT)
+    return _HERE / f"_sessionkernel_{digest}{_flavor()[0]}.so"
 
 
 def load_sessionkernel() -> ctypes.CDLL | None:
@@ -655,10 +680,8 @@ _WAL_FAILED: str | None = None
 
 
 def _wal_path() -> Path:
-    digest = hashlib.blake2s(
-        (_HERE / "walkernel.cpp").read_bytes(), digest_size=8
-    ).hexdigest()
-    return _HERE / f"_walkernel_{digest}.so"
+    digest = _digest_of(_HERE / "walkernel.cpp", _ANNOT)
+    return _HERE / f"_walkernel_{digest}{_flavor()[0]}.so"
 
 
 def load_walkernel() -> ctypes.CDLL | None:
@@ -747,10 +770,8 @@ _RTM_FAILED: str | None = None
 
 
 def _rtm_path() -> Path:
-    digest = hashlib.blake2s(
-        (_HERE / "runtime.cpp").read_bytes(), digest_size=8
-    ).hexdigest()
-    return _HERE / f"_runtime_{digest}.so"
+    digest = _digest_of(_HERE / "runtime.cpp", _ANNOT)
+    return _HERE / f"_runtime_{digest}{_flavor()[0]}.so"
 
 
 def load_runtime() -> ctypes.CDLL | None:
@@ -838,3 +859,348 @@ def load_runtime() -> ctypes.CDLL | None:
         lib.rtm_flight_head.argtypes = [p]
         _RTM_CACHED = lib
         return lib
+
+
+# ---------------------------------------------------------------------------
+# static-analysis plane: sanitizer toolchains + the native stress suite
+# (docs/STATIC_ANALYSIS.md; scripts/sanitize_gate.py is the driver)
+# ---------------------------------------------------------------------------
+
+STRESS_DIR = _HERE / "stress"
+_STRESS_BUILD = STRESS_DIR / "_build"
+
+# The gcc-10 libtsan on this container does not intercept
+# pthread_cond_clockwait (libstdc++'s timed condvar path on glibc >= 2.30),
+# so the unlock/relock inside a wait is invisible to TSan — the root cause
+# of the retired probe-SKIP's false "double lock of a mutex". The shim
+# routes clockwait to the intercepted pthread_cond_timedwait; linking it
+# into every TSan stress binary makes gcc a VIABLE TSan toolchain (the
+# kernels themselves wait via rabia::CondVar, which never emits
+# clockwait — the shim covers libstdc++ internals and test scaffolding).
+_TSAN_COMPAT = STRESS_DIR / "tsan_compat.cpp"
+
+SAN_FLAGS: dict[str, list[str]] = {
+    "tsan": ["-fsanitize=thread", "-O1", "-g"],
+    "asan": [
+        "-fsanitize=address", "-fno-omit-frame-pointer", "-O1", "-g",
+    ],
+    "ubsan": [
+        "-fsanitize=undefined", "-fno-sanitize-recover=undefined",
+        "-O1", "-g",
+    ],
+}
+
+
+def stress_env(flavor: str) -> dict[str, str]:
+    """Runtime env for a `flavor` stress binary: halt_on_error so any
+    finding is a nonzero exit (an enforced gate, not a log line), plus
+    the vetted suppression file for TSan (each entry justified inline)."""
+    env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin")}
+    if flavor == "tsan":
+        env["TSAN_OPTIONS"] = (
+            f"halt_on_error=1:suppressions={STRESS_DIR / 'tsan.supp'}"
+        )
+    elif flavor == "asan":
+        env["ASAN_OPTIONS"] = "halt_on_error=1:detect_leaks=1"
+        env["LSAN_OPTIONS"] = f"suppressions={STRESS_DIR / 'lsan.supp'}"
+    elif flavor == "ubsan":
+        env["UBSAN_OPTIONS"] = "halt_on_error=1:print_stacktrace=1"
+    return env
+
+
+# name -> kernel sources linked into stress/stress_<name>.cpp. Each
+# program hammers one cross-thread seam the thread-per-shard-group
+# runtime (ROADMAP item 1) will multiply.
+STRESS_PROGRAMS: dict[str, dict] = {
+    "transport": {"srcs": ["transport.cpp"], "libs": []},
+    "wal": {"srcs": ["walkernel.cpp"], "libs": ["-lz"]},
+    "session": {"srcs": ["sessionkernel.cpp"], "libs": []},
+    "statekernel": {"srcs": ["statekernel.cpp"], "libs": []},
+    "runtime": {"srcs": ["runtime.cpp", "transport.cpp"], "libs": ["-lz"]},
+}
+
+# deliberately-broken probes: the test suite builds these and asserts the
+# gate EXITS NONZERO — proof the matrix is red-on-failure, not
+# green-by-silence
+SELFCHECK_PROGRAMS: dict[str, str] = {
+    "tsan": "selfcheck_race",
+    "asan": "selfcheck_uaf",
+}
+
+_PROBE_CLEAN = r"""
+// race-free by construction: mutex churn + TIMED condvar waits (the
+// exact primitives the kernels use; a toolchain that flags this is not
+// viable and the gate skips with this program's own output)
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <mutex>
+#include <thread>
+#include <vector>
+int main() {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  long shared = 0;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 3; t++) {
+    ts.emplace_back([&] {
+      for (int i = 0; i < 20000; i++) {
+        std::lock_guard<std::mutex> lk(mu);
+        shared++;
+        if ((shared & 1023) == 0) cv.notify_all();
+      }
+    });
+  }
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    while (!cv.wait_for(lk, std::chrono::milliseconds(2),
+                        [&] { return shared >= 60000; })) {
+    }
+    done = true;
+  }
+  for (auto& t : ts) t.join();
+  std::printf("probe ok %ld %d\n", shared, (int)done);
+  return shared == 60000 ? 0 : 3;
+}
+"""
+
+_PROBE_BROKEN = {
+    # a real data race: the sanitizer must catch it or it cannot be
+    # trusted to gate anything
+    "tsan": r"""
+#include <cstdio>
+#include <thread>
+long shared = 0;
+int main() {
+  std::thread a([] { for (int i = 0; i < 200000; i++) shared++; });
+  std::thread b([] { for (int i = 0; i < 200000; i++) shared++; });
+  a.join();
+  b.join();
+  std::printf("done %ld\n", shared);
+  return 0;
+}
+""",
+    "asan": r"""
+#include <cstdio>
+#include <cstdlib>
+int main() {
+  volatile int* p = (volatile int*)malloc(32);
+  p[0] = 7;
+  free((void*)p);
+  std::printf("uaf %d\n", p[0]);  // heap-use-after-free
+  return 0;
+}
+""",
+    "ubsan": r"""
+#include <cstdio>
+int main(int argc, char**) {
+  volatile int s = 40 + argc;
+  volatile int v = 1 << s;  // shift exponent out of range
+  std::printf("ub %d\n", v);
+  return 0;
+}
+""",
+}
+
+_TOOLCHAIN_CACHE: dict[str, dict | None] = {}
+
+
+def _compiler_candidates() -> list[str]:
+    import shutil as _sh
+
+    out = []
+    for name in (
+        "clang++", "clang++-20", "clang++-19", "clang++-18", "clang++-17",
+        "clang++-16", "clang++-15", "clang++-14", "g++",
+    ):
+        if _sh.which(name):
+            out.append(name)
+    return out
+
+
+def find_sanitizer_toolchain(flavor: str) -> dict | None:
+    """Find a compiler whose `flavor` sanitizer is VIABLE here.
+
+    Viable means BOTH halves hold, probed with real binaries:
+      - the clean probe (mutex + timed-condvar churn) runs clean three
+        times — a toolchain that false-positives on it (gcc-10 libtsan
+        without the clockwait shim) would make every stress verdict
+        noise;
+      - the broken probe (a planted race / use-after-free / UB shift)
+        exits NONZERO — a sanitizer that cannot catch the planted bug
+        cannot be trusted to gate the real ones.
+
+    clang is preferred; gcc's TSan qualifies via the clockwait shim.
+    Returns {"cxx", "flags", "extra_sources", "reason"} or None (the
+    last probe failure lands in find_sanitizer_toolchain.reason for the
+    one-line SKIP)."""
+    import subprocess as sp
+    import tempfile
+
+    if flavor in _TOOLCHAIN_CACHE:
+        return _TOOLCHAIN_CACHE[flavor]
+    reasons = []
+    result = None
+    for cxx in _compiler_candidates():
+        extra = []
+        if flavor == "tsan":
+            extra = [str(_TSAN_COMPAT)]
+        with tempfile.TemporaryDirectory() as td:
+            probe = Path(td) / "probe.cpp"
+            probe.write_text(_PROBE_CLEAN)
+            exe = Path(td) / "probe"
+            cmd = [
+                cxx, "-std=c++17", *SAN_FLAGS[flavor], "-pthread",
+                str(probe), *extra, "-o", str(exe),
+            ]
+            rc = sp.run(cmd, capture_output=True, text=True, timeout=180)
+            if rc.returncode != 0:
+                reasons.append(f"{cxx}: probe build failed")
+                continue
+            env = stress_env(flavor)
+            ok = True
+            for _ in range(3):
+                run = sp.run(
+                    [str(exe)], capture_output=True, text=True,
+                    timeout=120, env=env,
+                )
+                if run.returncode != 0 or "probe ok" not in run.stdout:
+                    reasons.append(
+                        f"{cxx}: clean probe flagged "
+                        f"(rc={run.returncode}): "
+                        + (run.stderr or run.stdout)[-300:].replace(
+                            "\n", " | "
+                        )
+                    )
+                    ok = False
+                    break
+            if not ok:
+                continue
+            broken = Path(td) / "broken.cpp"
+            broken.write_text(_PROBE_BROKEN[flavor])
+            bexe = Path(td) / "broken"
+            rc = sp.run(
+                [
+                    cxx, "-std=c++17", *SAN_FLAGS[flavor], "-pthread",
+                    str(broken), *extra, "-o", str(bexe),
+                ],
+                capture_output=True, text=True, timeout=180,
+            )
+            if rc.returncode != 0:
+                reasons.append(f"{cxx}: broken probe build failed")
+                continue
+            caught = False
+            for _ in range(5):
+                run = sp.run(
+                    [str(bexe)], capture_output=True, text=True,
+                    timeout=120, env=env,
+                )
+                if run.returncode != 0:
+                    caught = True
+                    break
+            if not caught:
+                reasons.append(f"{cxx}: planted bug not detected")
+                continue
+            result = {
+                "cxx": cxx,
+                "flags": list(SAN_FLAGS[flavor]),
+                "extra_sources": [str(p) for p in extra],
+                "reason": "",
+            }
+            break
+    if result is None:
+        find_sanitizer_toolchain.reason = (  # type: ignore[attr-defined]
+            "; ".join(reasons) or "no C++ compiler found"
+        )
+    _TOOLCHAIN_CACHE[flavor] = result
+    return result
+
+
+def build_stress(name: str, flavor: str) -> Path:
+    """Build stress/stress_<name>.cpp + its kernel sources under
+    `flavor`; returns the binary path (digest-cached like the .so
+    builds). Raises InternalError on build failure — a kernel edit that
+    breaks the sanitizer build must FAIL the gate, never skip it."""
+    import subprocess as sp
+
+    spec = STRESS_PROGRAMS[name]
+    tc = find_sanitizer_toolchain(flavor)
+    if tc is None:
+        raise InternalError(
+            f"no viable {flavor} toolchain: "
+            + getattr(find_sanitizer_toolchain, "reason", "")
+        )
+    main_src = STRESS_DIR / f"stress_{name}.cpp"
+    # every header an included source can pull in participates in the
+    # digest — a header-only ABI edit must never reuse a stale cached
+    # stress binary (the silent-stale-artifact class this gate exists
+    # to kill)
+    srcs = [
+        main_src, STRESS_DIR / "stress_common.h", _ANNOT,
+        _HERE / "transport.h",
+    ]
+    srcs += [_HERE / s for s in spec["srcs"]]
+    h = hashlib.blake2s(digest_size=8)
+    for s in srcs:
+        h.update(s.read_bytes())
+    for p in tc["extra_sources"]:
+        h.update(Path(p).read_bytes())
+    h.update((tc["cxx"] + flavor).encode())
+    _STRESS_BUILD.mkdir(parents=True, exist_ok=True)
+    out = _STRESS_BUILD / f"{name}-{flavor}-{h.hexdigest()}"
+    if out.exists():
+        return out
+    # compile to a private temp path, then atomically rename (the
+    # _compile pattern): a build killed mid-link must never leave a
+    # truncated binary at the digest-keyed path, which the exists()
+    # fast path would trust forever
+    tmp = out.with_suffix(f".tmp{os.getpid()}")
+    cmd = [
+        tc["cxx"], "-std=c++17", *tc["flags"], "-pthread",
+        f"-I{_HERE}",
+        str(main_src),
+        *[str(_HERE / s) for s in spec["srcs"]],
+        *tc["extra_sources"],
+        "-o", str(tmp),
+        *spec["libs"],
+    ]
+    proc = sp.run(cmd, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        tmp.unlink(missing_ok=True)
+        raise InternalError(
+            f"{flavor} build of stress_{name} failed:\n"
+            + proc.stderr[-2000:]
+        )
+    os.replace(tmp, out)
+    for old in _STRESS_BUILD.glob(f"{name}-{flavor}-*"):
+        if old != out:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+    return out
+
+
+def build_selfcheck(flavor: str) -> Path:
+    """Build the deliberately-broken probe for `flavor` (the gate's
+    red-on-failure proof)."""
+    import subprocess as sp
+
+    tc = find_sanitizer_toolchain(flavor)
+    if tc is None:
+        raise InternalError(f"no viable {flavor} toolchain")
+    _STRESS_BUILD.mkdir(parents=True, exist_ok=True)
+    src = _STRESS_BUILD / f"selfcheck_{flavor}.cpp"
+    src.write_text(_PROBE_BROKEN[flavor])
+    out = _STRESS_BUILD / f"selfcheck_{flavor}"
+    cmd = [
+        tc["cxx"], "-std=c++17", *tc["flags"], "-pthread", str(src),
+        *tc["extra_sources"], "-o", str(out),
+    ]
+    proc = sp.run(cmd, capture_output=True, text=True, timeout=180)
+    if proc.returncode != 0:
+        raise InternalError(
+            f"selfcheck build failed:\n{proc.stderr[-1000:]}"
+        )
+    return out

@@ -27,6 +27,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <atomic>
 #include <ctime>
 #include <vector>
 
@@ -490,12 +491,15 @@ struct RkCtx {
   // flight-recorder event ring (see FrEvent above); fr_head counts every
   // record ever written, the live window is the last RK_FLIGHT_CAP
   std::vector<FrEvent> fr;
-  uint64_t fr_head;
+  // relaxed atomic: written on the tick path, read by the Python
+  // scrape thread via rk_flight_head while the engine runs
+  std::atomic<uint64_t> fr_head;
 };
 
 static inline void fr_rec(RkCtx* c, uint8_t kind, uint8_t arg, uint16_t peer,
                           uint32_t shard, int64_t slot) {
-  FrEvent& e = c->fr[c->fr_head & (RK_FLIGHT_CAP - 1)];
+  const uint64_t head = c->fr_head.load(std::memory_order_relaxed);
+  FrEvent& e = c->fr[head & (RK_FLIGHT_CAP - 1)];
   e.t_ns = fr_now_ns();
   e.slot = (uint64_t)slot;
   e.batch_hash = 0;
@@ -503,7 +507,7 @@ static inline void fr_rec(RkCtx* c, uint8_t kind, uint8_t arg, uint16_t peer,
   e.peer = peer;
   e.kind = kind;
   e.arg = arg;
-  c->fr_head++;
+  c->fr_head.store(head + 1, std::memory_order_relaxed);
 }
 
 static const size_t RK_STALE_CAP = 1024;
@@ -624,7 +628,9 @@ int32_t rk_flight_record_size(void) { return (int32_t)sizeof(FrEvent); }
 void* rk_flight(void* ctx) { return ((RkCtx*)ctx)->fr.data(); }
 // Total records ever written; the live window is the last
 // min(head, RK_FLIGHT_CAP) records ending at head % RK_FLIGHT_CAP.
-uint64_t rk_flight_head(void* ctx) { return ((RkCtx*)ctx)->fr_head; }
+uint64_t rk_flight_head(void* ctx) {
+  return ((RkCtx*)ctx)->fr_head.load(std::memory_order_relaxed);
+}
 
 int64_t rk_carry_count(void* ctx) {
   RkCtx* c = (RkCtx*)ctx;

@@ -42,6 +42,8 @@
 #include <thread>
 #include <vector>
 
+#include "annotations.h"
+
 extern "C" {
 
 // --- foreign entry points (function-pointer table indices) ------------------
@@ -254,6 +256,15 @@ enum : int32_t { RK_HANDLED = 1, RK_NOOP = 2, RK_PY = 0, RK_DROP = -1 };
 enum : int8_t { V0c = 0, V1c = 1 };
 
 // --- small helpers ----------------------------------------------------------
+
+// The io/tick thread ROLE (annotations.h ThreadRole): every function
+// below marked RABIA_REQUIRES(rtm_io_role) touches state the runtime's
+// single-writer-while-RUNNING contract reserves for the io thread —
+// calling one from a control-plane entry point is a compile error under
+// clang -Werror=thread-safety. The runtime handshake that actually
+// transfers ownership (rtm_pause -> PAUSED -> mutate -> rtm_resume) is
+// stress-checked under TSan in native/stress/stress_runtime.cpp.
+static rabia::ThreadRole rtm_io_role{"runtime.io"};
 
 static inline uint64_t mono_ns() {
   timespec ts;
@@ -475,10 +486,14 @@ struct RtmCtx {
   uint64_t stg[RTS_COUNT];                   // stage profiler (ns)
   uint64_t hist[RTH_STAGE_COUNT * RTH_STRIDE];  // SLO histogram block
   std::vector<FrEvent> fr;
-  uint64_t fr_head = 0;
+  // relaxed atomic: single-writer (io thread) but read by the Python
+  // scrape path via rtm_flight_head while the loop runs (TSan stress
+  // finding, round 13)
+  std::atomic<uint64_t> fr_head{0};
 };
 
-static inline void rth_observe(RtmCtx* c, int32_t stage, uint64_t ns) {
+static inline void rth_observe(RtmCtx* c, int32_t stage, uint64_t ns)
+    RABIA_REQUIRES(rtm_io_role) {
   uint64_t* h = c->hist + (size_t)stage * RTH_STRIDE;
   int32_t idx = 0;
   if (ns >= (1ull << RTH_MIN_EXP)) {
@@ -494,8 +509,10 @@ static inline void rth_observe(RtmCtx* c, int32_t stage, uint64_t ns) {
 }
 
 static inline void fr_rec(RtmCtx* c, uint8_t kind, uint8_t arg, uint32_t shard,
-                          int64_t slot) {
-  FrEvent& e = c->fr[c->fr_head & (RTM_FLIGHT_CAP - 1)];
+                          int64_t slot)
+    RABIA_REQUIRES(rtm_io_role) {
+  const uint64_t head = c->fr_head.load(std::memory_order_relaxed);
+  FrEvent& e = c->fr[head & (RTM_FLIGHT_CAP - 1)];
   e.t_ns = mono_ns();
   e.slot = (uint64_t)slot;
   e.batch = 0;
@@ -503,14 +520,15 @@ static inline void fr_rec(RtmCtx* c, uint8_t kind, uint8_t arg, uint32_t shard,
   e.peer = 0xFFFF;
   e.kind = kind;
   e.arg = arg;
-  c->fr_head++;
+  c->fr_head.store(head + 1, std::memory_order_relaxed);
 }
 
 // Append one event record; spins (bounded sleeps) when the mailbox is
 // full — backpressure on the commit path, exactly like the transport's
 // bounded inbox, except nothing is dropped (Python's drain is
 // eventfd-driven, so the stall resolves in microseconds).
-static void ev_push(RtmCtx* c, const std::vector<uint8_t>& rec) {
+static void ev_push(RtmCtx* c, const std::vector<uint8_t>& rec)
+    RABIA_REQUIRES(rtm_io_role) {
   if (ByteRing::need((int64_t)rec.size()) > c->ev.cap()) {
     // a record larger than the whole mailbox can never be delivered:
     // drop it (counted) instead of spinning the commit path forever.
@@ -559,7 +577,7 @@ static inline uint32_t mix32(uint32_t h) {
   return h;
 }
 
-static void rtm_msg_id(RtmCtx* c, uint8_t* out) {
+static void rtm_msg_id(RtmCtx* c, uint8_t* out) RABIA_REQUIRES(rtm_io_role) {
   const uint64_t ctr = ++c->msg_counter;
   uint32_t h = mix32(0x52544D00u ^ (uint32_t)(c->me * 0x85EBCA6Bu));
   for (int w = 0; w < 4; w++) {
@@ -576,7 +594,8 @@ static void rtm_msg_id(RtmCtx* c, uint8_t* out) {
 static int64_t build_decision_frame(RtmCtx* c, std::vector<uint8_t>& f,
                                     double now, const int64_t* shards,
                                     const int64_t* slots, const int8_t* vals,
-                                    int32_t count) {
+                                    int32_t count)
+    RABIA_REQUIRES(rtm_io_role) {
   f.clear();
   const uint32_t body_len = 4 + (uint32_t)count * 14;
   f.resize(47 + body_len);
@@ -614,7 +633,8 @@ static int64_t build_decision_frame(RtmCtx* c, std::vector<uint8_t>& f,
 // Returns 1 bound-something, 0 nothing-bound (still consumed), -1 not a
 // parseable block (caller escalates), -2 drop (bad checksum/limits).
 static int parse_propose_block(RtmCtx* c, const uint8_t* data, int64_t len,
-                               int32_t row, double now) {
+                               int32_t row, double now)
+    RABIA_REQUIRES(rtm_io_role) {
   if (len < 47) return -1;
   if (data[0] != 3 || data[1] != MT_PROPOSE_BLOCK) return -1;
   const uint8_t flags = data[2];
@@ -726,7 +746,8 @@ static int parse_propose_block(RtmCtx* c, const uint8_t* data, int64_t len,
   return 1;
 }
 
-static void blk_unref(RtmCtx* c, int64_t ref, int64_t n) {
+static void blk_unref(RtmCtx* c, int64_t ref, int64_t n)
+    RABIA_REQUIRES(rtm_io_role) {
   auto it = c->blocks.find(ref);
   if (it == c->blocks.end()) return;
   it->second.remaining -= n;
@@ -736,7 +757,8 @@ static void blk_unref(RtmCtx* c, int64_t ref, int64_t n) {
 // A decided slot voids any pending binding it overtook (asyncio parity:
 // _record_decision -> _void_pending_block); Python demotes/settles the
 // owner through the reject event.
-static void void_stale_pend(RtmCtx* c, int64_t s, int64_t slot) {
+static void void_stale_pend(RtmCtx* c, int64_t s, int64_t slot)
+    RABIA_REQUIRES(rtm_io_role) {
   if (c->blk_pend_ref[s] != -1 && c->blk_pend_slot[s] <= slot) {
     auto it = c->blocks.find(c->blk_pend_ref[s]);
     if (it != c->blocks.end()) {
@@ -765,7 +787,8 @@ extern "C" {
 
 // --- command processing -----------------------------------------------------
 
-static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now) {
+static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now)
+    RABIA_REQUIRES(rtm_io_role) {
   if (len < 1) return;
   const uint8_t type = p[0];
   const uint8_t* q = p + 1;
@@ -917,7 +940,7 @@ static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now) {
   }
 }
 
-static void drain_cmds(RtmCtx* c, double now) {
+static void drain_cmds(RtmCtx* c, double now) RABIA_REQUIRES(rtm_io_role) {
   for (;;) {
     int64_t got = c->cmd.drain(c->cmd_scratch.data(),
                                (int64_t)c->cmd_scratch.size());
@@ -933,7 +956,8 @@ static void drain_cmds(RtmCtx* c, double now) {
 
 // --- decided-slot processing ------------------------------------------------
 
-static void process_decided(RtmCtx* c, double now) {
+static void process_decided(RtmCtx* c, double now)
+    RABIA_REQUIRES(rtm_io_role) {
   // group decided block-bound shards by ref; scalars stream directly
   std::map<int64_t, std::vector<int64_t>> waves;  // ref -> shard list
   for (int64_t s = 0; s < c->n; s++) {
@@ -1201,7 +1225,7 @@ static void process_decided(RtmCtx* c, double now) {
 
 // --- open collection --------------------------------------------------------
 
-static int32_t collect_opens(RtmCtx* c) {
+static int32_t collect_opens(RtmCtx* c) RABIA_REQUIRES(rtm_io_role) {
   int32_t n_open = 0;
   // durability plane: the watermark read once per pass (an atomic load)
   const uint64_t wal_durable =
@@ -1299,7 +1323,7 @@ static int32_t collect_opens(RtmCtx* c) {
 
 // --- timers: retransmit, stale repair, stall escalation ---------------------
 
-static void run_timers(RtmCtx* c, double now) {
+static void run_timers(RtmCtx* c, double now) RABIA_REQUIRES(rtm_io_role) {
   // vote retransmits for stalled shards (pure C)
   int64_t res[4] = {0, 0, 0, 0};
   ((fn_rk_retransmit_t)c->fns[FN_RK_RETRANSMIT])(
@@ -1401,7 +1425,8 @@ static void run_timers(RtmCtx* c, double now) {
 // the native ProposeBlock binder, or escalation to the Python mailbox.
 // Returns 1 when the frame had ledger/binding effects (a tick is due).
 static int32_t handle_frame(RtmCtx* c, int32_t row, const uint8_t* fp,
-                            uint32_t flen, double now) {
+                            uint32_t flen, double now)
+    RABIA_REQUIRES(rtm_io_role) {
   const int32_t rc =
       ((fn_rk_ingest_t)c->fns[FN_RK_INGEST])(c->rk, fp, (int64_t)flen, row,
                                              now);
@@ -1447,6 +1472,9 @@ static int32_t handle_frame(RtmCtx* c, int32_t row, const uint8_t* fp,
   } while (0)
 
 static void rtm_loop(RtmCtx* c) {
+  // this thread IS the io role: assert_capability informs the analysis
+  // without emitting code (rtm_start spawns exactly one such thread)
+  rtm_io_role.assert_held();
   fn_recv_borrow_t recv_borrow = (fn_recv_borrow_t)c->fns[FN_RECV_BORROW];
   fn_recv_release_t recv_release = (fn_recv_release_t)c->fns[FN_RECV_RELEASE];
   fn_rk_tick_t rk_tick = (fn_rk_tick_t)c->fns[FN_RK_TICK];
@@ -1466,11 +1494,14 @@ static void rtm_loop(RtmCtx* c) {
     t0 = mono_ns();
     drain_cmds(c, now);
     RTS_ADD(RTS_CMD, mono_ns() - t0);
-    if (c->pause_req.load(std::memory_order_relaxed)) {
+    if (c->pause_req.load(std::memory_order_acquire)) {
       c->state.store(RTM_PAUSED, std::memory_order_release);
       c->ctrs[RTM_PAUSES]++;
       t0 = mono_ns();
-      while (c->pause_req.load(std::memory_order_relaxed) &&
+      // acquire pairs with rtm_resume's release store: the control
+      // plane's while-PAUSED mutations of the shared arrays must be
+      // visible before the loop reads them again
+      while (c->pause_req.load(std::memory_order_acquire) &&
              !c->stop_req.load(std::memory_order_relaxed))
         usleep(200);
       RTS_ADD(RTS_IDLE, mono_ns() - t0);
@@ -1691,11 +1722,17 @@ int32_t rtm_state(void* ctx) {
 }
 
 void rtm_pause(void* ctx) {
-  ((RtmCtx*)ctx)->pause_req.store(1, std::memory_order_relaxed);
+  ((RtmCtx*)ctx)->pause_req.store(1, std::memory_order_release);
 }
 
+// release: the control plane mutates the shared consensus arrays
+// (next_slot/applied/tainted/...) while the loop is parked in PAUSED;
+// the io thread's acquire load of pause_req in its park loop is the
+// other half of the edge that makes those writes visible before it
+// resumes ticking. (Was relaxed/relaxed — a real ordering bug the TSan
+// stress cell flags on weakly-ordered machines.)
 void rtm_resume(void* ctx) {
-  ((RtmCtx*)ctx)->pause_req.store(0, std::memory_order_relaxed);
+  ((RtmCtx*)ctx)->pause_req.store(0, std::memory_order_release);
 }
 
 int rtm_event_fd(void* ctx) { return ((RtmCtx*)ctx)->event_fd; }
@@ -1739,6 +1776,8 @@ int32_t rtm_flight_version(void) { return RTM_FLIGHT_VERSION; }
 int32_t rtm_flight_cap(void) { return (int32_t)RTM_FLIGHT_CAP; }
 int32_t rtm_flight_record_size(void) { return (int32_t)sizeof(FrEvent); }
 void* rtm_flight(void* ctx) { return ((RtmCtx*)ctx)->fr.data(); }
-uint64_t rtm_flight_head(void* ctx) { return ((RtmCtx*)ctx)->fr_head; }
+uint64_t rtm_flight_head(void* ctx) {
+  return ((RtmCtx*)ctx)->fr_head.load(std::memory_order_relaxed);
+}
 
 }  // extern "C"

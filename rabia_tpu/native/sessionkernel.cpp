@@ -39,15 +39,27 @@
 //
 // Layout contract: one GwPlane per gateway, one versioned append-only
 // GWC_* counter block (read zero-copy via ctypes like RKC_*/SKC_*).
-// Single-threaded: the gateway's asyncio loop is the only mutator;
-// scrape threads read the counter block advisorily (torn reads are
-// metrics noise, the RKC contract).
+//
+// Threading: every entry point takes the plane mutex internally, so the
+// table is safe under concurrent callers (the thread-per-shard-group
+// runtime multiplies the gateway's callers — ROADMAP item 1; the
+// gws_gc-vs-gws_submit seam is stress-checked under TSan in
+// native/stress/stress_session.cpp). Counter cells are relaxed atomics
+// read zero-copy by scrape threads (the RKC torn-read contract).
+// BORROWED pointers (gws_submit / gws_get_result blob_out) remain valid
+// only until the next mutating call ON ANY THREAD — a caller that reads
+// them must serialize against mutators itself (the gateway's asyncio
+// loop does; the stress harness pins its sessions hot so GC cannot free
+// what a submit thread is reading).
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <new>
 #include <vector>
+
+#include "annotations.h"
 
 extern "C" {
 
@@ -124,17 +136,25 @@ static inline uint64_t cid_hash(const uint8_t* p) {
 }
 
 struct GwPlane {
-  std::vector<Slot> table;  // power-of-two capacity
-  int64_t live = 0;         // SLOT_FULL count
-  int64_t used = 0;         // FULL + TOMB (probe-length bound)
+  rabia::Mutex mu{"sessionkernel.mu"};
+  std::vector<Slot> table RABIA_GUARDED_BY(mu);  // power-of-two capacity
+  int64_t live RABIA_GUARDED_BY(mu) = 0;   // SLOT_FULL count
+  int64_t used RABIA_GUARDED_BY(mu) = 0;   // FULL + TOMB (probe bound)
   int64_t default_window;
   double session_ttl;
   double lease_ttl;
   int64_t result_cache_cap;
-  uint64_t counters[GWC_COUNT];
+  // relaxed atomics, read zero-copy as plain u64s by the scrape path
+  std::atomic<uint64_t> counters[GWC_COUNT];
+  static_assert(sizeof(std::atomic<uint64_t>) == sizeof(uint64_t),
+                "counter block must read as a plain uint64 array");
+  void bump(int i, uint64_t n = 1) {
+    counters[i].fetch_add(n, std::memory_order_relaxed);
+  }
 };
 
-static void plane_rehash(GwPlane* p, int64_t want_cap) {
+static void plane_rehash(GwPlane* p, int64_t want_cap)
+    RABIA_REQUIRES(p->mu) {
   int64_t cap = 256;
   while (cap < want_cap) cap <<= 1;
   std::vector<Slot> old;
@@ -149,13 +169,13 @@ static void plane_rehash(GwPlane* p, int64_t want_cap) {
     p->table[i] = e;
     p->used++;
   }
-  p->counters[GWC_REHASHES]++;
+  p->bump(GWC_REHASHES);
 }
 
 // find the slot for cid; returns index or -1. `free_out` (when non-null)
 // receives the first insertable slot (tombstone or empty).
 static int64_t plane_find(GwPlane* p, uint64_t h, const uint8_t* cid,
-                          int64_t* free_out) {
+                          int64_t* free_out) RABIA_REQUIRES(p->mu) {
   const uint64_t mask = (uint64_t)p->table.size() - 1;
   uint64_t i = h & mask;
   int64_t free_slot = -1;
@@ -175,14 +195,16 @@ static int64_t plane_find(GwPlane* p, uint64_t h, const uint8_t* cid,
   }
 }
 
-static Session* plane_get(GwPlane* p, const uint8_t* cid) {
+static Session* plane_get(GwPlane* p, const uint8_t* cid)
+    RABIA_REQUIRES(p->mu) {
   int64_t at = plane_find(p, cid_hash(cid), cid, nullptr);
   return at < 0 ? nullptr : p->table[(size_t)at].s;
 }
 
 // open-or-resume (session.py SessionTable.ensure)
 static Session* plane_ensure(GwPlane* p, const uint8_t* cid,
-                             int64_t requested_window, double now) {
+                             int64_t requested_window, double now)
+    RABIA_REQUIRES(p->mu) {
   uint64_t h = cid_hash(cid);
   int64_t free_slot = -1;
   int64_t at = plane_find(p, h, cid, &free_slot);
@@ -200,7 +222,7 @@ static Session* plane_ensure(GwPlane* p, const uint8_t* cid,
     e.s = s;
     e.hash = h;
     p->live++;
-    p->counters[GWC_SESSIONS_OPENED]++;
+    p->bump(GWC_SESSIONS_OPENED);
     if (p->used * 4 >= (int64_t)p->table.size() * 3) {
       // size from LIVE sessions, not the current capacity: the rehash
       // drops every tombstone, and under steady session churn (clients
@@ -249,16 +271,19 @@ void* gws_create(int64_t default_window, double session_ttl,
                  int64_t result_cache_cap, double lease_ttl) {
   GwPlane* p = new (std::nothrow) GwPlane();
   if (!p) return nullptr;
-  p->table.assign(256, Slot{});
+  {
+    rabia::MutexLock lk(p->mu);  // no other thread yet; analysis only
+    p->table.assign(256, Slot{});
+  }
   p->default_window = default_window < 1 ? 1 : default_window;
   p->session_ttl = session_ttl;
   p->lease_ttl = lease_ttl;
   p->result_cache_cap = result_cache_cap < 1 ? 1 : result_cache_cap;
-  memset(p->counters, 0, sizeof(p->counters));
+  for (auto& c : p->counters) c.store(0, std::memory_order_relaxed);
   return p;
 }
 
-static void plane_drop_all(GwPlane* p) {
+static void plane_drop_all(GwPlane* p) RABIA_REQUIRES(p->mu) {
   for (auto& e : p->table)
     if (e.state == SLOT_FULL) delete e.s;
   p->table.assign(256, Slot{});
@@ -268,8 +293,11 @@ static void plane_drop_all(GwPlane* p) {
 void gws_destroy(void* h) {
   GwPlane* p = (GwPlane*)h;
   if (!p) return;
-  for (auto& e : p->table)
-    if (e.state == SLOT_FULL) delete e.s;
+  {
+    rabia::MutexLock lk(p->mu);  // last reference; analysis only
+    for (auto& e : p->table)
+      if (e.state == SLOT_FULL) delete e.s;
+  }
   delete p;
 }
 
@@ -277,21 +305,33 @@ int32_t gws_counters_version() { return GWS_COUNTERS_VERSION; }
 int32_t gws_counters_count() { return GWC_COUNT; }
 void* gws_counters(void* h) { return ((GwPlane*)h)->counters; }
 
-int64_t gws_len(void* h) { return ((GwPlane*)h)->live; }
+int64_t gws_len(void* h) {
+  GwPlane* p = (GwPlane*)h;
+  rabia::MutexLock lk(p->mu);
+  return p->live;
+}
 
 // total session-state loss (tests; the restart-wipe chaos shape)
-void gws_clear(void* h) { plane_drop_all((GwPlane*)h); }
+void gws_clear(void* h) {
+  GwPlane* p = (GwPlane*)h;
+  rabia::MutexLock lk(p->mu);
+  plane_drop_all(p);
+}
 
 // SessionStats parity: out[0..5] = sessions_opened, duplicate_submits,
 // results_cached, results_evicted, sessions_expired, leases_expired
 void gws_stats(void* h, uint64_t* out) {
   GwPlane* p = (GwPlane*)h;
-  out[0] = p->counters[GWC_SESSIONS_OPENED];
-  out[1] = p->counters[GWC_DEDUP_CACHED] + p->counters[GWC_DEDUP_INFLIGHT];
-  out[2] = p->counters[GWC_RESULTS_CACHED];
-  out[3] = p->counters[GWC_RESULTS_EVICTED];
-  out[4] = p->counters[GWC_SESSIONS_EXPIRED];
-  out[5] = p->counters[GWC_LEASES_EXPIRED];
+  const auto rd = [](const std::atomic<uint64_t>& c) {
+    return c.load(std::memory_order_relaxed);
+  };
+  out[0] = rd(p->counters[GWC_SESSIONS_OPENED]);
+  out[1] = rd(p->counters[GWC_DEDUP_CACHED]) +
+           rd(p->counters[GWC_DEDUP_INFLIGHT]);
+  out[2] = rd(p->counters[GWC_RESULTS_CACHED]);
+  out[3] = rd(p->counters[GWC_RESULTS_EVICTED]);
+  out[4] = rd(p->counters[GWC_SESSIONS_EXPIRED]);
+  out[5] = rd(p->counters[GWC_LEASES_EXPIRED]);
 }
 
 // ---------------------------------------------------------------------------
@@ -302,7 +342,8 @@ void gws_stats(void* h, uint64_t* out) {
 int64_t gws_hello(void* h, const uint8_t* cid, int64_t req_window,
                   double now, uint64_t* last_seq_out) {
   GwPlane* p = (GwPlane*)h;
-  p->counters[GWC_HELLOS]++;
+  rabia::MutexLock lk(p->mu);
+  p->bump(GWC_HELLOS);
   Session* s = plane_ensure(p, cid, req_window, now);
   if (!s) return -1;
   if (last_seq_out) *last_seq_out = s->highest_completed;
@@ -316,28 +357,29 @@ int32_t gws_submit(void* h, const uint8_t* cid, uint64_t seq,
                    uint64_t ack_upto, double now, int32_t* status_out,
                    const uint8_t** blob_out, int64_t* blob_len_out) {
   GwPlane* p = (GwPlane*)h;
-  p->counters[GWC_SUBMITS]++;
+  rabia::MutexLock lk(p->mu);
+  p->bump(GWC_SUBMITS);
   Session* s = plane_ensure(p, cid, 0, now);
   if (!s) return -1;
   if (ack_upto > s->ack_upto) s->ack_upto = ack_upto;
   CachedRec* r = session_result(s, seq);
   if (r) {
-    p->counters[GWC_DEDUP_CACHED]++;
+    p->bump(GWC_DEDUP_CACHED);
     if (status_out) *status_out = r->status;
     if (blob_out) *blob_out = r->blob.data();
     if (blob_len_out) *blob_len_out = (int64_t)r->blob.size();
     return SUBMIT_DUP_CACHED;
   }
   if (session_inflight_has(s, seq)) {
-    p->counters[GWC_DEDUP_INFLIGHT]++;
+    p->bump(GWC_DEDUP_INFLIGHT);
     return SUBMIT_DUP_INFLIGHT;
   }
   if ((int64_t)s->inflight.size() >= s->window) {
-    p->counters[GWC_SHED_WINDOW]++;
+    p->bump(GWC_SHED_WINDOW);
     return SUBMIT_SHED_WINDOW;
   }
   s->inflight.push_back(seq);
-  p->counters[GWC_FRESH]++;
+  p->bump(GWC_FRESH);
   return SUBMIT_FRESH;
 }
 
@@ -347,6 +389,7 @@ int32_t gws_complete(void* h, const uint8_t* cid, uint64_t seq,
                      int32_t status, uint64_t frontier_mark,
                      const uint8_t* blob, int64_t blob_len, double now) {
   GwPlane* p = (GwPlane*)h;
+  rabia::MutexLock lk(p->mu);
   Session* s = plane_get(p, cid);
   if (!s) return 0;
   session_inflight_drop(s, seq);
@@ -367,18 +410,19 @@ int32_t gws_complete(void* h, const uint8_t* cid, uint64_t seq,
   }
   if (seq > s->highest_completed) s->highest_completed = seq;
   s->last_active = now;
-  p->counters[GWC_COMPLETES]++;
-  p->counters[GWC_RESULTS_CACHED]++;
-  p->counters[GWC_RESULT_BYTES] += (uint64_t)blob_len;
+  p->bump(GWC_COMPLETES);
+  p->bump(GWC_RESULTS_CACHED);
+  p->bump(GWC_RESULT_BYTES, (uint64_t)blob_len);
   return 1;
 }
 
 void gws_abort(void* h, const uint8_t* cid, uint64_t seq) {
   GwPlane* p = (GwPlane*)h;
+  rabia::MutexLock lk(p->mu);
   Session* s = plane_get(p, cid);
   if (!s) return;
   session_inflight_drop(s, seq);
-  p->counters[GWC_ABORTS]++;
+  p->bump(GWC_ABORTS);
 }
 
 // ---------------------------------------------------------------------------
@@ -387,7 +431,8 @@ void gws_abort(void* h, const uint8_t* cid, uint64_t seq) {
 
 int64_t gws_gc(void* h, uint64_t state_version, double now) {
   GwPlane* p = (GwPlane*)h;
-  p->counters[GWC_GC_RUNS]++;
+  rabia::MutexLock lk(p->mu);
+  p->bump(GWC_GC_RUNS);
   int64_t evicted = 0;
   for (auto& e : p->table) {
     if (e.state != SLOT_FULL) continue;
@@ -420,18 +465,18 @@ int64_t gws_gc(void* h, uint64_t state_version, double now) {
       e.s = nullptr;
       e.state = SLOT_TOMB;
       p->live--;
-      p->counters[GWC_LEASES_EXPIRED]++;
-      p->counters[GWC_SESSIONS_EXPIRED]++;
+      p->bump(GWC_LEASES_EXPIRED);
+      p->bump(GWC_SESSIONS_EXPIRED);
     } else if (s->inflight.empty() && idle > p->session_ttl) {
       evicted += (int64_t)s->results.size();
       delete s;
       e.s = nullptr;
       e.state = SLOT_TOMB;
       p->live--;
-      p->counters[GWC_SESSIONS_EXPIRED]++;
+      p->bump(GWC_SESSIONS_EXPIRED);
     }
   }
-  p->counters[GWC_RESULTS_EVICTED] += (uint64_t)evicted;
+  p->bump(GWC_RESULTS_EVICTED, (uint64_t)evicted);
   return evicted;
 }
 
@@ -443,7 +488,9 @@ int64_t gws_gc(void* h, uint64_t state_version, double now) {
 int32_t gws_session_info(void* h, const uint8_t* cid, int64_t* window,
                          uint64_t* ack_upto, uint64_t* highest,
                          int64_t* n_inflight, int64_t* n_results) {
-  Session* s = plane_get((GwPlane*)h, cid);
+  GwPlane* p = (GwPlane*)h;
+  rabia::MutexLock lk(p->mu);
+  Session* s = plane_get(p, cid);
   if (!s) return 0;
   if (window) *window = s->window;
   if (ack_upto) *ack_upto = s->ack_upto;
@@ -458,7 +505,9 @@ int32_t gws_session_info(void* h, const uint8_t* cid, int64_t* window,
 int32_t gws_get_result(void* h, const uint8_t* cid, uint64_t seq,
                        int32_t* status_out, uint64_t* frontier_out,
                        const uint8_t** blob_out, int64_t* blob_len_out) {
-  Session* s = plane_get((GwPlane*)h, cid);
+  GwPlane* p = (GwPlane*)h;
+  rabia::MutexLock lk(p->mu);
+  Session* s = plane_get(p, cid);
   if (!s) return 0;
   CachedRec* r = session_result(s, seq);
   if (!r) return 0;
@@ -473,6 +522,7 @@ int32_t gws_get_result(void* h, const uint8_t* cid, uint64_t seq,
 // callers sort; the conformance gate compares as sets)
 int64_t gws_session_ids(void* h, uint8_t* out, int64_t cap) {
   GwPlane* p = (GwPlane*)h;
+  rabia::MutexLock lk(p->mu);
   int64_t n = 0;
   for (auto& e : p->table) {
     if (e.state != SLOT_FULL) continue;
@@ -487,7 +537,9 @@ int64_t gws_session_ids(void* h, uint8_t* out, int64_t cap) {
 // session does not exist
 int64_t gws_result_seqs(void* h, const uint8_t* cid, uint64_t* out,
                         int64_t cap) {
-  Session* s = plane_get((GwPlane*)h, cid);
+  GwPlane* p = (GwPlane*)h;
+  rabia::MutexLock lk(p->mu);
+  Session* s = plane_get(p, cid);
   if (!s) return -1;
   int64_t n = 0;
   for (auto& r : s->results) {
@@ -499,7 +551,9 @@ int64_t gws_result_seqs(void* h, const uint8_t* cid, uint64_t* out,
 
 int64_t gws_inflight_seqs(void* h, const uint8_t* cid, uint64_t* out,
                           int64_t cap) {
-  Session* s = plane_get((GwPlane*)h, cid);
+  GwPlane* p = (GwPlane*)h;
+  rabia::MutexLock lk(p->mu);
+  Session* s = plane_get(p, cid);
   if (!s) return -1;
   int64_t n = 0;
   for (uint64_t q : s->inflight) {

@@ -35,6 +35,7 @@
 // hostkernel.cpp / obs/flight.FR_DTYPE) written once per apply wave on
 // the C path. Single-threaded: the engine loop is the only caller.
 
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -43,6 +44,8 @@
 #include <mutex>
 #include <new>
 #include <vector>
+
+#include "annotations.h"
 
 extern "C" {
 
@@ -169,13 +172,15 @@ static inline void log_del(Store& st, const uint8_t* key, uint32_t klen) {
 }
 
 struct SkPlane {
-  std::vector<Store> stores;
+  std::vector<Store> stores RABIA_GUARDED_BY(mu);
   int64_t max_keys;
   int64_t max_key_len;    // CODE POINTS (KVStoreConfig.max_key_length)
   int64_t max_value_size; // BYTES (KVStoreConfig.max_value_size)
   uint64_t counters[SKC_COUNT];
   FrEvent flight[SK_FLIGHT_CAP];
-  uint64_t flight_head = 0;
+  // relaxed atomic: written under mu on the apply path, read
+  // lock-free by the scrape path via sk_flight_head
+  std::atomic<uint64_t> flight_head{0};
   uint64_t waves = 0;
   // Plane lock (native-runtime hook): the GIL-free runtime thread owns
   // the apply path while the Python control plane still serves reads
@@ -186,7 +191,7 @@ struct SkPlane {
   // reader can call helpers that lock internally (snapshot restore's
   // insert_raw loop). Uncontended cost is nanoseconds — invisible next
   // to a wave apply.
-  std::recursive_mutex mu;
+  rabia::RecursiveMutex mu{"statekernel.mu"};
   // wave result staging (plane-owned, reused and grown across waves so
   // a large wave can never overflow mid-apply): [u32 LE len][payload]
   // records in PROCESS order, with out_offs[i] = record i's start and a
@@ -296,8 +301,11 @@ void* sk_plane_create(int64_t n_stores, int64_t max_keys,
   if (n_stores <= 0) return nullptr;
   SkPlane* p = new (std::nothrow) SkPlane();
   if (!p) return nullptr;
-  p->stores.resize((size_t)n_stores);
-  for (auto& st : p->stores) st.reset_table(64);
+  {
+    rabia::RecursiveLock lk(p->mu);  // no other thread yet; analysis only
+    p->stores.resize((size_t)n_stores);
+    for (auto& st : p->stores) st.reset_table(64);
+  }
   p->max_keys = max_keys;
   p->max_key_len = max_key_len;
   p->max_value_size = max_value_size;
@@ -309,7 +317,10 @@ void* sk_plane_create(int64_t n_stores, int64_t max_keys,
 void sk_plane_destroy(void* h) {
   SkPlane* p = (SkPlane*)h;
   if (!p) return;
-  for (auto& st : p->stores) store_free_entries(st);
+  {
+    rabia::RecursiveLock lk(p->mu);  // last reference; analysis only
+    for (auto& st : p->stores) store_free_entries(st);
+  }
   delete p;
 }
 
@@ -321,35 +332,44 @@ int32_t sk_flight_version() { return SK_FLIGHT_VERSION; }
 int32_t sk_flight_cap() { return SK_FLIGHT_CAP; }
 int32_t sk_flight_record_size() { return (int32_t)sizeof(FrEvent); }
 void* sk_flight(void* h) { return ((SkPlane*)h)->flight; }
-uint64_t sk_flight_head(void* h) { return ((SkPlane*)h)->flight_head; }
+uint64_t sk_flight_head(void* h) {
+  return ((SkPlane*)h)->flight_head.load(std::memory_order_relaxed);
+}
 
 // Read-side critical-section brackets (native-runtime hook): hold the
 // plane lock across sk_get + the value copy-out (or an export walk) so
 // the GIL-free runtime thread's concurrent wave applies cannot free or
 // rehash the borrowed bytes mid-read. Recursive with the internal
 // mutator locks above.
-void sk_plane_lock(void* h) { ((SkPlane*)h)->mu.lock(); }
-void sk_plane_unlock(void* h) { ((SkPlane*)h)->mu.unlock(); }
+// NO_TSA: a deliberately unbalanced C-API bracket over an opaque handle
+// (the analysis cannot follow the caller's pairing; the debug lock-order
+// checker and the TSan stress cell validate it at runtime instead)
+void sk_plane_lock(void* h) RABIA_NO_TSA { ((SkPlane*)h)->mu.lock(); }
+void sk_plane_unlock(void* h) RABIA_NO_TSA { ((SkPlane*)h)->mu.unlock(); }
 
 int64_t sk_store_count(void* h) {
-  return (int64_t)((SkPlane*)h)->stores.size();
+  SkPlane* p = (SkPlane*)h;
+  rabia::RecursiveLock lk(p->mu);
+  return (int64_t)p->stores.size();
 }
 
 int64_t sk_store_size(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   return p->stores[(size_t)idx].live;
 }
 
 uint64_t sk_store_version(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return 0;
   return p->stores[(size_t)idx].version;
 }
 
 void sk_set_version(void* h, int64_t idx, uint64_t v) {
   SkPlane* p = (SkPlane*)h;
-  std::lock_guard<std::recursive_mutex> lk(p->mu);
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   p->stores[(size_t)idx].version = v;
 }
@@ -357,6 +377,7 @@ void sk_set_version(void* h, int64_t idx, uint64_t v) {
 // out[0..2] = total_operations, reads, writes (StoreStats parity)
 void sk_store_stats(void* h, int64_t idx, uint64_t* out) {
   SkPlane* p = (SkPlane*)h;
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   Store& st = p->stores[(size_t)idx];
   out[0] = st.total_operations;
@@ -367,7 +388,7 @@ void sk_store_stats(void* h, int64_t idx, uint64_t* out) {
 void sk_add_stats(void* h, int64_t idx, uint64_t ops, uint64_t reads,
                   uint64_t writes) {
   SkPlane* p = (SkPlane*)h;
-  std::lock_guard<std::recursive_mutex> lk(p->mu);
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   Store& st = p->stores[(size_t)idx];
   st.total_operations += ops;
@@ -385,6 +406,7 @@ void sk_add_stats(void* h, int64_t idx, uint64_t ops, uint64_t reads,
 int64_t sk_get(void* h, int64_t idx, const uint8_t* key, int64_t klen,
                const uint8_t** val_addr, uint64_t* version_out) {
   SkPlane* p = (SkPlane*)h;
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   int64_t at = store_find(st, fnv1a(key, klen), key, klen, nullptr);
@@ -398,6 +420,7 @@ int64_t sk_get(void* h, int64_t idx, const uint8_t* key, int64_t klen,
 // bytes needed by sk_export for this store
 int64_t sk_export_size(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   int64_t total = 0;
@@ -411,6 +434,7 @@ int64_t sk_export_size(void* h, int64_t idx) {
 // returns bytes written, or -(bytes needed) when cap is insufficient.
 int64_t sk_export(void* h, int64_t idx, uint8_t* out, int64_t cap) {
   SkPlane* p = (SkPlane*)h;
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   int64_t need = sk_export_size(h, idx);
@@ -432,7 +456,7 @@ int64_t sk_export(void* h, int64_t idx, uint8_t* out, int64_t cap) {
 
 void sk_clear_store(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
-  std::lock_guard<std::recursive_mutex> lk(p->mu);
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   Store& st = p->stores[(size_t)idx];
   store_free_entries(st);
@@ -450,7 +474,7 @@ void sk_clear_store(void* h, int64_t idx) {
 int32_t sk_delete_raw(void* h, int64_t idx, const uint8_t* key,
                       int64_t klen) {
   SkPlane* p = (SkPlane*)h;
-  std::lock_guard<std::recursive_mutex> lk(p->mu);
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   int64_t at = store_find(st, fnv1a(key, klen), key, klen, nullptr);
@@ -469,7 +493,7 @@ int32_t sk_insert_raw(void* h, int64_t idx, const uint8_t* key,
                       int64_t klen, const uint8_t* val, int64_t vlen,
                       uint64_t version, double created, double updated) {
   SkPlane* p = (SkPlane*)h;
-  std::lock_guard<std::recursive_mutex> lk(p->mu);
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   if (st.used * 4 >= (int64_t)st.table.size() * 3)
@@ -850,7 +874,8 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
 static void flight_wave(SkPlane* p, int64_t first_shard, int64_t total_ops) {
   // one FRE_APPLY record per wave on the C path (the engine's per-slot
   // Python records stay the lifecycle source on both tick paths)
-  FrEvent& ev = p->flight[p->flight_head % SK_FLIGHT_CAP];
+  const uint64_t head = p->flight_head.load(std::memory_order_relaxed);
+  FrEvent& ev = p->flight[head % SK_FLIGHT_CAP];
   ev.t_ns = mono_ns();
   ev.slot = p->waves++;
   ev.batch = (uint64_t)total_ops;
@@ -858,7 +883,7 @@ static void flight_wave(SkPlane* p, int64_t first_shard, int64_t total_ops) {
   ev.peer = 0xFFFF;
   ev.kind = FRE_APPLY;
   ev.arg = (uint8_t)(total_ops > 255 ? 255 : total_ops);
-  p->flight_head++;
+  p->flight_head.store(head + 1, std::memory_order_relaxed);
 }
 
 // wave result staging accessors (valid until the next apply call)
@@ -882,7 +907,7 @@ int64_t sk_apply_wave(void* h, const uint8_t* data,
                       int64_t n_idx, double now, int32_t want) {
   SkPlane* p = (SkPlane*)h;
   if (!p || n_idx < 0) return -2;
-  std::lock_guard<std::recursive_mutex> lk(p->mu);
+  rabia::RecursiveLock lk(p->mu);
   p->staging = want != 0;
   p->out_buf.clear();
   p->out_offs.clear();
@@ -926,8 +951,8 @@ int64_t sk_apply_wave(void* h, const uint8_t* data,
 // only a FULL snapshot is faithful, or -1 on a bad store index.
 int64_t sk_snapshot_delta_size(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
-  std::lock_guard<std::recursive_mutex> lk(p->mu);
   Store& st = p->stores[(size_t)idx];
   if (st.dels_overflow) return -3;
   int64_t total = 1 + 4 + (int64_t)st.dels.size() + 4;
@@ -944,8 +969,8 @@ int64_t sk_snapshot_delta_size(void* h, int64_t idx) {
 // checkpoint write never loses dirty state.
 int64_t sk_snapshot_delta(void* h, int64_t idx, uint8_t* out, int64_t cap) {
   SkPlane* p = (SkPlane*)h;
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
-  std::lock_guard<std::recursive_mutex> lk(p->mu);
   Store& st = p->stores[(size_t)idx];
   if (st.dels_overflow) return -3;
   const int64_t need = sk_snapshot_delta_size(h, idx);
@@ -954,7 +979,11 @@ int64_t sk_snapshot_delta(void* h, int64_t idx, uint8_t* out, int64_t cap) {
   *w++ = st.cleared ? 1 : 0;
   memcpy(w, &st.n_dels, 4);
   w += 4;
-  memcpy(w, st.dels.data(), st.dels.size());
+  if (!st.dels.empty()) {
+    // empty-log guard: memcpy's src is declared nonnull and an empty
+    // vector's data() may be null (UBSan stress finding, round 13)
+    memcpy(w, st.dels.data(), st.dels.size());
+  }
   w += st.dels.size();
   uint32_t n_ent = 0;
   uint8_t* ent_count_at = w;
@@ -981,8 +1010,8 @@ int64_t sk_snapshot_delta(void* h, int64_t idx, uint8_t* out, int64_t cap) {
 // written is now "clean"; future mutations stamp the new epoch.
 void sk_snapshot_mark(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
+  rabia::RecursiveLock lk(p->mu);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
-  std::lock_guard<std::recursive_mutex> lk(p->mu);
   Store& st = p->stores[(size_t)idx];
   st.mut_epoch++;
   st.dels.clear();
@@ -997,9 +1026,9 @@ int64_t sk_apply_ops(void* h, int64_t store_idx, const uint8_t* data,
                      const int64_t* cmd_offsets, int64_t n_ops, double now,
                      int32_t want) {
   SkPlane* p = (SkPlane*)h;
-  if (!p || store_idx < 0 || (size_t)store_idx >= p->stores.size())
-    return -2;
-  std::lock_guard<std::recursive_mutex> lk(p->mu);
+  if (!p) return -2;
+  rabia::RecursiveLock lk(p->mu);
+  if (store_idx < 0 || (size_t)store_idx >= p->stores.size()) return -2;
   p->staging = want != 0;
   p->out_buf.clear();
   p->out_offs.clear();

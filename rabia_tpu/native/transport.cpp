@@ -45,6 +45,7 @@
 #include <thread>
 #include <vector>
 
+#include "annotations.h"  // RABIA_* thread-safety macros + rabia::Mutex
 #include "transport.h"  // the C ABI — definitions below are checked against it
 
 namespace {
@@ -191,35 +192,35 @@ struct Transport {
   std::thread io_thread;
   std::atomic<bool> stopping{false};
 
-  std::mutex mu;  // guards everything below
-  std::map<int, Conn> conns;                 // fd -> connection
-  std::map<NodeIdBytes, int> established;    // peer id -> fd
-  std::map<NodeIdBytes, Peer> peers;         // configured dial targets
+  rabia::Mutex mu{"transport.mu"};  // guards everything below
+  std::map<int, Conn> conns RABIA_GUARDED_BY(mu);       // fd -> conn
+  std::map<NodeIdBytes, int> established RABIA_GUARDED_BY(mu);
+  std::map<NodeIdBytes, Peer> peers RABIA_GUARDED_BY(mu);  // dial targets
   // session id -> fd of the muxed connection carrying it (auto-bound on
   // the first inbound frame bearing the id; latest binding wins, so a
   // session migrating to a fresh connection reroutes its replies)
-  std::map<NodeIdBytes, int> mux_sessions;
-  std::deque<InboundMsg> inbox;
-  std::condition_variable inbox_cv;
+  std::map<NodeIdBytes, int> mux_sessions RABIA_GUARDED_BY(mu);
+  std::deque<InboundMsg> inbox RABIA_GUARDED_BY(mu);
+  rabia::CondVar inbox_cv;
   // rt_inbox_kick: spurious-wake generation counter. A waiter samples it
   // before waiting and also wakes when it changes (see rt_recv_borrow),
   // so a kick staged between the sample and the wait is never lost.
   std::atomic<uint64_t> kick_gen{0};
-  uint64_t dropped_frames = 0;
+  uint64_t dropped_frames RABIA_GUARDED_BY(mu) = 0;
   // Zero-copy recv: frames handed out by rt_recv_borrow are parked here
   // (keyed by token) so their pooled buffers outlive the C call until
   // the borrower releases them. std::map: references stay valid across
   // inserts/erases of other keys.
-  std::map<int64_t, std::vector<uint8_t>> borrowed;
-  int64_t next_borrow_token = 1;
+  std::map<int64_t, std::vector<uint8_t>> borrowed RABIA_GUARDED_BY(mu);
+  int64_t next_borrow_token RABIA_GUARDED_BY(mu) = 1;
   // Released tokens are STAGED under this light mutex and reclaimed by
   // the next rt_recv_borrow (which holds `mu` anyway). rt_recv_release
   // is called from the engine's event-loop thread once per consumed
   // frame — taking `mu` there would serialize the consensus tick with
   // whole io-loop epoll batches (the same reason rt_send stages under
   // `mu_out` instead of touching `mu`).
-  std::mutex mu_rel;
-  std::vector<int64_t> released;
+  rabia::Mutex mu_rel{"transport.mu_rel"};
+  std::vector<int64_t> released RABIA_GUARDED_BY(mu_rel);
 
   // Outbound staging: rt_send/rt_broadcast never touch `mu` (the io loop
   // holds it across whole epoll batches, syscalls included — a sending
@@ -233,10 +234,12 @@ struct Transport {
     bool broadcast = false;
     NodeIdBytes target{};
   };
-  std::mutex mu_out;
-  std::deque<OutMsg> outq;
-  std::vector<std::vector<uint8_t>> out_pool;  // outbound frame arena
-  uint64_t out_hits = 0, out_misses = 0;
+  rabia::Mutex mu_out{"transport.mu_out"};
+  std::deque<OutMsg> outq RABIA_GUARDED_BY(mu_out);
+  // outbound frame arena
+  std::vector<std::vector<uint8_t>> out_pool RABIA_GUARDED_BY(mu_out);
+  uint64_t out_hits RABIA_GUARDED_BY(mu_out) = 0;
+  uint64_t out_misses RABIA_GUARDED_BY(mu_out) = 0;
 
   // Chaos shaping layer (rt_set_shaping): per-peer outbound delay/drop
   // injection, applied by the io thread at drain time so the REAL
@@ -250,7 +253,7 @@ struct Transport {
     uint32_t jitter_us = 0;
     double drop = 0.0;
   };
-  std::map<NodeIdBytes, Shape> shaping;
+  std::map<NodeIdBytes, Shape> shaping RABIA_GUARDED_BY(mu);
   struct Delayed {
     double due;
     std::shared_ptr<std::vector<uint8_t>> frame;
@@ -258,8 +261,8 @@ struct Transport {
     bool operator>(const Delayed& o) const { return due > o.due; }
   };
   std::priority_queue<Delayed, std::vector<Delayed>, std::greater<Delayed>>
-      delayq;
-  uint64_t shape_rng = 0x9E3779B97F4A7C15ull;
+      delayq RABIA_GUARDED_BY(mu);
+  uint64_t shape_rng RABIA_GUARDED_BY(mu) = 0x9E3779B97F4A7C15ull;
 
   static inline uint64_t xs64(uint64_t& s) {
     s ^= s << 13;
@@ -267,7 +270,7 @@ struct Transport {
     s ^= s << 17;
     return s;
   }
-  double shape_rand01() {  // uniform [0,1), 53-bit
+  double shape_rand01() RABIA_REQUIRES(mu) {  // uniform [0,1), 53-bit
     return (double)(xs64(shape_rng) >> 11) * (1.0 / 9007199254740992.0);
   }
 
@@ -276,7 +279,8 @@ struct Transport {
   // queued for later delivery), false when the caller should enqueue it
   // now. Caller holds `mu`.
   bool shape_outbound(const NodeIdBytes& id, int fd, double now,
-                      const std::shared_ptr<std::vector<uint8_t>>& f) {
+                      const std::shared_ptr<std::vector<uint8_t>>& f)
+      RABIA_REQUIRES(mu) {
     (void)fd;
     if (shaping.empty()) return false;
     auto it = shaping.find(id);
@@ -299,7 +303,7 @@ struct Transport {
   // Release delayed frames whose due time passed; returns the epoll
   // timeout (ms) until the next one is due (capped by `base_ms`).
   // Caller holds `mu`.
-  int release_delayed(double now, int base_ms) {
+  int release_delayed(double now, int base_ms) RABIA_REQUIRES(mu) {
     while (!delayq.empty() && delayq.top().due <= now) {
       Delayed d = delayq.top();
       delayq.pop();
@@ -330,11 +334,12 @@ struct Transport {
 
   // flight-recorder frame ring; all writers hold `mu` (handle_readable /
   // enqueue_shared_locked), rt_flight_copy reads under `mu` too
-  std::vector<TfEvent> tf = std::vector<TfEvent>(kFlightCap);
-  uint64_t tf_head = 0;
+  std::vector<TfEvent> tf RABIA_GUARDED_BY(mu) =
+      std::vector<TfEvent>(kFlightCap);
+  uint64_t tf_head RABIA_GUARDED_BY(mu) = 0;
 
   void tf_rec(uint8_t dir, const NodeIdBytes& peer_id, uint32_t len,
-              uint8_t msg_type) {
+              uint8_t msg_type) RABIA_REQUIRES(mu) {
     TfEvent& e = tf[tf_head & (kFlightCap - 1)];
     e.t_ns = tf_now_ns();
     memcpy(&e.peer, peer_id.data() + 8, 8);
@@ -349,7 +354,7 @@ struct Transport {
                                                    uint32_t len) {
     std::vector<uint8_t> v;
     {
-      std::lock_guard<std::mutex> lo(mu_out);
+      rabia::MutexLock lo(mu_out);
       if (!out_pool.empty()) {
         v = std::move(out_pool.back());
         out_pool.pop_back();
@@ -373,7 +378,7 @@ struct Transport {
 
   void recycle_frame(std::shared_ptr<std::vector<uint8_t>>&& sp) {
     if (sp.use_count() != 1) return;  // other conns still sending it
-    std::lock_guard<std::mutex> lo(mu_out);
+    rabia::MutexLock lo(mu_out);
     if (out_pool.size() < kMaxPooled && sp->capacity() <= kMaxPooledBuf) {
       out_pool.push_back(std::move(*sp));
     }
@@ -387,12 +392,12 @@ struct Transport {
   // buffer arena (rabia-core/src/memory_pool.rs analog): frame/message
   // byte vectors are recycled instead of allocated per frame. Guarded by
   // mu like everything else.
-  std::vector<std::vector<uint8_t>> buf_pool;
-  uint64_t pool_hits = 0;
-  uint64_t pool_misses = 0;
+  std::vector<std::vector<uint8_t>> buf_pool RABIA_GUARDED_BY(mu);
+  uint64_t pool_hits RABIA_GUARDED_BY(mu) = 0;
+  uint64_t pool_misses RABIA_GUARDED_BY(mu) = 0;
   static constexpr size_t kMaxPooled = 256;
 
-  std::vector<uint8_t> pool_get_locked(size_t need) {
+  std::vector<uint8_t> pool_get_locked(size_t need) RABIA_REQUIRES(mu) {
     if (!buf_pool.empty()) {
       std::vector<uint8_t> v = std::move(buf_pool.back());
       buf_pool.pop_back();
@@ -414,25 +419,27 @@ struct Transport {
   // process lifetime
   static constexpr size_t kMaxPooledBuf = 256 * 1024;
 
-  void pool_put_locked(std::vector<uint8_t>&& v) {
+  void pool_put_locked(std::vector<uint8_t>&& v) RABIA_REQUIRES(mu) {
     if (buf_pool.size() < kMaxPooled && v.capacity() <= kMaxPooledBuf) {
       buf_pool.push_back(std::move(v));
     }
   }
 
-  void io_loop();
-  void handle_readable(int fd);
-  void handle_writable(int fd);
-  void try_dials();
-  void drain_shutdown(int fd, Conn& c);
-  void sweep_draining();
-  void dial(const NodeIdBytes& id, Peer& p);
-  void close_conn(int fd);
-  bool establish(int fd, Conn& c);  // false: conn was dropped (dup loser)
-  void enqueue_shared_locked(int fd,
-                             const std::shared_ptr<std::vector<uint8_t>>& f);
-  void drain_out_locked();
-  void arm_write(int fd, bool on);
+  void io_loop() RABIA_EXCLUDES(mu);
+  void handle_readable(int fd) RABIA_REQUIRES(mu);
+  void handle_writable(int fd) RABIA_REQUIRES(mu);
+  void try_dials() RABIA_REQUIRES(mu);
+  void drain_shutdown(int fd, Conn& c) RABIA_REQUIRES(mu);
+  void sweep_draining() RABIA_REQUIRES(mu);
+  void dial(const NodeIdBytes& id, Peer& p) RABIA_REQUIRES(mu);
+  void close_conn(int fd) RABIA_REQUIRES(mu);
+  // false: conn was dropped (dup loser)
+  bool establish(int fd, Conn& c) RABIA_REQUIRES(mu);
+  void enqueue_shared_locked(
+      int fd, const std::shared_ptr<std::vector<uint8_t>>& f)
+      RABIA_REQUIRES(mu);
+  void drain_out_locked() RABIA_REQUIRES(mu) RABIA_EXCLUDES(mu_out);
+  void arm_write(int fd, bool on) RABIA_REQUIRES(mu);
 };
 
 int set_nonblock(int fd) {
@@ -440,14 +447,14 @@ int set_nonblock(int fd) {
   return fcntl(fd, F_SETFL, fl | O_NONBLOCK);
 }
 
-void Transport::arm_write(int fd, bool on) {
+void Transport::arm_write(int fd, bool on) RABIA_REQUIRES(mu) {
   epoll_event ev{};
   ev.events = EPOLLIN | (on ? EPOLLOUT : 0);
   ev.data.fd = fd;
   epoll_ctl(epoll_fd, EPOLL_CTL_MOD, fd, &ev);
 }
 
-void Transport::close_conn(int fd) {
+void Transport::close_conn(int fd) RABIA_REQUIRES(mu) {
   auto it = conns.find(fd);
   if (it == conns.end()) return;
   Conn& c = it->second;
@@ -512,7 +519,7 @@ void Transport::close_conn(int fd) {
   conns.erase(it);
 }
 
-bool Transport::establish(int fd, Conn& c) {
+bool Transport::establish(int fd, Conn& c) RABIA_REQUIRES(mu) {
   auto old = established.find(c.peer);
   if (old != established.end() && old->second != fd) {
     // simultaneous-dial duplicate: BOTH sides must deterministically keep
@@ -557,7 +564,7 @@ bool Transport::establish(int fd, Conn& c) {
   return true;
 }
 
-void Transport::drain_shutdown(int fd, Conn& c) {
+void Transport::drain_shutdown(int fd, Conn& c) RABIA_REQUIRES(mu) {
   // half-close a draining loser once its queued writes flushed; the
   // peer (running the same rule) does the same, and each side closes
   // on the other's EOF — no frame in either direction is dropped
@@ -567,7 +574,7 @@ void Transport::drain_shutdown(int fd, Conn& c) {
   }
 }
 
-void Transport::sweep_draining() {
+void Transport::sweep_draining() RABIA_REQUIRES(mu) {
   // a draining peer that crashed mid-drain never EOFs us; reap on the
   // deadline (same period as the redial scan)
   double t = now_s();
@@ -578,7 +585,7 @@ void Transport::sweep_draining() {
   for (int fd : overdue) close_conn(fd);
 }
 
-void Transport::handle_readable(int fd) {
+void Transport::handle_readable(int fd) RABIA_REQUIRES(mu) {
   auto it = conns.find(fd);
   if (it == conns.end()) return;
   Conn& c = it->second;
@@ -658,7 +665,7 @@ void Transport::handle_readable(int fd) {
   if (!inbox.empty()) inbox_cv.notify_all();
 }
 
-void Transport::handle_writable(int fd) {
+void Transport::handle_writable(int fd) RABIA_REQUIRES(mu) {
   auto it = conns.find(fd);
   if (it == conns.end()) return;
   Conn& c = it->second;
@@ -687,7 +694,8 @@ void Transport::handle_writable(int fd) {
 }
 
 void Transport::enqueue_shared_locked(
-    int fd, const std::shared_ptr<std::vector<uint8_t>>& f) {
+    int fd, const std::shared_ptr<std::vector<uint8_t>>& f)
+    RABIA_REQUIRES(mu) {
   auto it = conns.find(fd);
   if (it == conns.end()) return;
   it->second.wqueue.push_back(f);
@@ -698,10 +706,10 @@ void Transport::enqueue_shared_locked(
   arm_write(fd, true);
 }
 
-void Transport::drain_out_locked() {
+void Transport::drain_out_locked() RABIA_REQUIRES(mu) {
   std::deque<OutMsg> local;
   {
-    std::lock_guard<std::mutex> lo(mu_out);
+    rabia::MutexLock lo(mu_out);
     local.swap(outq);
   }
   const double now = local.empty() ? 0.0 : now_s();
@@ -739,7 +747,7 @@ void Transport::drain_out_locked() {
   }
 }
 
-void Transport::dial(const NodeIdBytes& id, Peer& p) {
+void Transport::dial(const NodeIdBytes& id, Peer& p) RABIA_REQUIRES(mu) {
   bump(RTC_DIALS);
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return;
@@ -776,7 +784,7 @@ void Transport::dial(const NodeIdBytes& id, Peer& p) {
   epoll_ctl(epoll_fd, EPOLL_CTL_ADD, fd, &ev);
 }
 
-void Transport::try_dials() {
+void Transport::try_dials() RABIA_REQUIRES(mu) {
   double t = now_s();
   for (auto& [id, p] : peers) {
     if (p.connected) continue;
@@ -808,12 +816,12 @@ void Transport::try_dials() {
   }
 }
 
-void Transport::io_loop() {
+void Transport::io_loop() RABIA_EXCLUDES(mu) {
   epoll_event evs[64];
   int wait_ms = 50;
   while (!stopping.load()) {
     int n = epoll_wait(epoll_fd, evs, 64, wait_ms);
-    std::unique_lock<std::mutex> lk(mu);
+    rabia::MutexLock lk(mu);
     drain_out_locked();
     // chaos shaping: deliver due delayed frames and tighten the next
     // epoll timeout to the next due time (50ms granularity would smear
@@ -926,7 +934,7 @@ int rt_add_peer(void* h, const uint8_t peer_id[16], const char* host,
   NodeIdBytes id;
   memcpy(id.data(), peer_id, 16);
   {
-    std::lock_guard<std::mutex> lk(t->mu);
+    rabia::MutexLock lk(t->mu);
     Peer p;
     p.host = host;
     p.port = port;
@@ -942,7 +950,7 @@ int rt_remove_peer(void* h, const uint8_t peer_id[16]) {
   auto* t = static_cast<Transport*>(h);
   NodeIdBytes id;
   memcpy(id.data(), peer_id, 16);
-  std::lock_guard<std::mutex> lk(t->mu);
+  rabia::MutexLock lk(t->mu);
   t->peers.erase(id);
   auto est = t->established.find(id);
   if (est != t->established.end()) t->close_conn(est->second);
@@ -961,7 +969,7 @@ int rt_set_shaping(void* h, const uint8_t peer_id[16], uint32_t delay_us,
   NodeIdBytes id;
   memcpy(id.data(), peer_id, 16);
   {
-    std::lock_guard<std::mutex> lk(t->mu);
+    rabia::MutexLock lk(t->mu);
     if (seed) t->shape_rng = seed;
     if (delay_us == 0 && jitter_us == 0 && drop <= 0.0) {
       t->shaping.erase(id);
@@ -982,7 +990,7 @@ int rt_set_shaping(void* h, const uint8_t peer_id[16], uint32_t delay_us,
 // reorder traffic already in the delay queue).
 int rt_clear_shaping(void* h) {
   auto* t = static_cast<Transport*>(h);
-  std::lock_guard<std::mutex> lk(t->mu);
+  rabia::MutexLock lk(t->mu);
   t->shaping.clear();
   return 0;
 }
@@ -996,7 +1004,7 @@ int rt_send(void* h, const uint8_t peer_id[16], const uint8_t* data,
   memcpy(id.data(), peer_id, 16);
   auto frame = t->make_frame(data, len);
   {
-    std::lock_guard<std::mutex> lo(t->mu_out);
+    rabia::MutexLock lo(t->mu_out);
     t->outq.push_back({std::move(frame), false, id});
   }
   t->kick();
@@ -1009,7 +1017,7 @@ int rt_broadcast(void* h, const uint8_t* data, uint32_t len) {
   if (len > kMaxFrame) return -2;
   auto frame = t->make_frame(data, len);
   {
-    std::lock_guard<std::mutex> lo(t->mu_out);
+    rabia::MutexLock lo(t->mu_out);
     t->outq.push_back({std::move(frame), true, NodeIdBytes{}});
   }
   t->kick();
@@ -1039,7 +1047,7 @@ int rt_broadcast_frames(void* h, const uint8_t* buf, int64_t len) {
   if (staged.empty()) return 0;
   const int n = (int)staged.size();
   {
-    std::lock_guard<std::mutex> lo(t->mu_out);
+    rabia::MutexLock lo(t->mu_out);
     for (auto& m : staged) t->outq.push_back(std::move(m));
   }
   t->kick();
@@ -1052,13 +1060,15 @@ int rt_broadcast_frames(void* h, const uint8_t* buf, int64_t len) {
 int rt_recv(void* h, uint8_t sender_out[16], uint8_t* buf, uint32_t buf_cap,
             int timeout_ms) {
   auto* t = static_cast<Transport*>(h);
-  std::unique_lock<std::mutex> lk(t->mu);
+  rabia::MutexLock lk(t->mu);
   if (t->inbox.empty() && timeout_ms != 0) {
     const uint64_t k0 = t->kick_gen.load(std::memory_order_relaxed);
-    t->inbox_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [t, k0] {
-      return !t->inbox.empty() || t->stopping.load() ||
-             t->kick_gen.load(std::memory_order_relaxed) != k0;
-    });
+    const timespec dl =
+        rabia::CondVar::deadline_in((double)timeout_ms * 1e-3);
+    while (t->inbox.empty() && !t->stopping.load() &&
+           t->kick_gen.load(std::memory_order_relaxed) == k0) {
+      if (!t->inbox_cv.wait_until(lk, dl)) break;
+    }
   }
   if (t->inbox.empty()) return t->stopping.load() ? -1 : -3;
   InboundMsg m = std::move(t->inbox.front());
@@ -1084,10 +1094,10 @@ int64_t rt_recv_borrow(void* h, uint8_t sender_out[16],
   auto* t = static_cast<Transport*>(h);
   std::vector<int64_t> rel;
   {
-    std::lock_guard<std::mutex> lr(t->mu_rel);
+    rabia::MutexLock lr(t->mu_rel);
     rel.swap(t->released);
   }
-  std::unique_lock<std::mutex> lk(t->mu);
+  rabia::MutexLock lk(t->mu);
   for (int64_t tok : rel) {
     auto it = t->borrowed.find(tok);
     if (it != t->borrowed.end()) {
@@ -1097,10 +1107,12 @@ int64_t rt_recv_borrow(void* h, uint8_t sender_out[16],
   }
   if (t->inbox.empty() && timeout_ms != 0) {
     const uint64_t k0 = t->kick_gen.load(std::memory_order_relaxed);
-    t->inbox_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [t, k0] {
-      return !t->inbox.empty() || t->stopping.load() ||
-             t->kick_gen.load(std::memory_order_relaxed) != k0;
-    });
+    const timespec dl =
+        rabia::CondVar::deadline_in((double)timeout_ms * 1e-3);
+    while (t->inbox.empty() && !t->stopping.load() &&
+           t->kick_gen.load(std::memory_order_relaxed) == k0) {
+      if (!t->inbox_cv.wait_until(lk, dl)) break;
+    }
   }
   if (t->inbox.empty()) return t->stopping.load() ? -1 : -3;
   InboundMsg m = std::move(t->inbox.front());
@@ -1124,15 +1136,15 @@ int64_t rt_recv_borrow(void* h, uint8_t sender_out[16],
 // valid until then (reclamation only happens under `mu` in borrow).
 void rt_recv_release(void* h, int64_t token) {
   auto* t = static_cast<Transport*>(h);
-  std::lock_guard<std::mutex> lr(t->mu_rel);
+  rabia::MutexLock lr(t->mu_rel);
   t->released.push_back(token);
 }
 
 // Buffer-arena counters (memory_pool.rs PoolStats analog).
 void rt_pool_stats(void* h, uint64_t* hits, uint64_t* misses) {
   auto* t = static_cast<Transport*>(h);
-  std::lock_guard<std::mutex> lk(t->mu);
-  std::lock_guard<std::mutex> lo(t->mu_out);
+  rabia::MutexLock lk(t->mu);
+  rabia::MutexLock lo(t->mu_out);
   *hits = t->pool_hits + t->out_hits;
   *misses = t->pool_misses + t->out_misses;
 }
@@ -1141,7 +1153,7 @@ void rt_pool_stats(void* h, uint64_t* hits, uint64_t* misses) {
 // staging buffers), previously folded invisibly into rt_pool_stats.
 void rt_out_pool_stats(void* h, uint64_t* hits, uint64_t* misses) {
   auto* t = static_cast<Transport*>(h);
-  std::lock_guard<std::mutex> lo(t->mu_out);
+  rabia::MutexLock lo(t->mu_out);
   *hits = t->out_hits;
   *misses = t->out_misses;
 }
@@ -1161,7 +1173,7 @@ int32_t rt_flight_record_size(void) { return (int32_t)sizeof(TfEvent); }
 // snapshot, unlike the relaxed counter block.
 int64_t rt_flight_copy(void* h, uint8_t* out, int64_t max_records) {
   auto* t = static_cast<Transport*>(h);
-  std::lock_guard<std::mutex> lk(t->mu);
+  rabia::MutexLock lk(t->mu);
   uint64_t n = t->tf_head < kFlightCap ? t->tf_head : kFlightCap;
   if ((int64_t)n > max_records) n = (uint64_t)max_records;
   uint64_t start = t->tf_head - n;
@@ -1182,7 +1194,7 @@ const uint64_t* rt_counters(void* h) {
 // the count.
 int rt_connected(void* h, uint8_t* ids_out, int cap) {
   auto* t = static_cast<Transport*>(h);
-  std::lock_guard<std::mutex> lk(t->mu);
+  rabia::MutexLock lk(t->mu);
   int i = 0;
   for (auto& [id, fd] : t->established) {
     if (i >= cap) break;
@@ -1217,7 +1229,7 @@ void rt_stop(void* h) {
   auto* t = static_cast<Transport*>(h);
   t->stopping.store(true);
   {
-    std::lock_guard<std::mutex> lk(t->mu);
+    rabia::MutexLock lk(t->mu);
     t->inbox_cv.notify_all();
   }
   uint64_t one = 1;
@@ -1227,7 +1239,7 @@ void rt_stop(void* h) {
 // Total inbound frames dropped due to the bounded inbox (oldest-first).
 uint64_t rt_dropped(void* h) {
   auto* t = static_cast<Transport*>(h);
-  std::lock_guard<std::mutex> lk(t->mu);
+  rabia::MutexLock lk(t->mu);
   return t->dropped_frames;
 }
 
@@ -1235,7 +1247,7 @@ void rt_close(void* h) {
   auto* t = static_cast<Transport*>(h);
   t->stopping.store(true);
   {
-    std::lock_guard<std::mutex> lk(t->mu);
+    rabia::MutexLock lk(t->mu);
     t->inbox_cv.notify_all();
   }
   uint64_t one = 1;
@@ -1244,7 +1256,7 @@ void rt_close(void* h) {
   {
     // the lock_guard must release BEFORE delete: unlocking a destroyed
     // mutex is use-after-free (found by the TSan stress harness)
-    std::lock_guard<std::mutex> lk(t->mu);
+    rabia::MutexLock lk(t->mu);
     for (auto& [fd, c] : t->conns) ::close(fd);
     t->conns.clear();
     ::close(t->listen_fd);

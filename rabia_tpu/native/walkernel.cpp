@@ -52,14 +52,13 @@
 #include <zlib.h>
 
 #include <atomic>
-#include <chrono>
-#include <condition_variable>
 #include <cstdint>
-#include <mutex>
 #include <new>
 #include <string>
 #include <thread>
 #include <vector>
+
+#include "annotations.h"
 
 extern "C" {
 
@@ -110,32 +109,46 @@ static inline uint64_t mono_ns() {
 struct WalCtx {
   std::string dir;
   int dir_fd = -1;
-  int seg_fd = -1;
-  uint64_t seg_index = 0;    // index of the OPEN segment
-  int64_t seg_bytes = 0;     // bytes written to the open segment
+  int seg_fd = -1;  // flush-thread-owned after wal_start (create before)
+  // open-segment identity/fill, published for wal_segment_index/_bytes
+  // (advisory cross-thread reads; the flush thread is the only writer
+  // after start)
+  std::atomic<uint64_t> seg_index{0};
+  std::atomic<int64_t> seg_bytes{0};
   int64_t seg_limit = 0;     // rotation threshold (record boundaries)
 
-  std::mutex mu;             // guards stage / staged_lsn / barrier
-  std::condition_variable cv;
-  std::condition_variable cv_done;  // wal_sync waiters
-  std::vector<uint8_t> stage;       // framed records awaiting flush
-  uint64_t staged_lsn = 0;          // lsn of the last staged record
-  uint64_t flushed_lsn = 0;         // lsn of the last record written
+  rabia::Mutex mu{"walkernel.mu"};
+  rabia::CondVar cv;        // append lane -> flush thread
+  rabia::CondVar cv_done;   // flush thread -> wal_sync waiters
+  std::vector<uint8_t> stage RABIA_GUARDED_BY(mu);  // records to flush
+  uint64_t staged_lsn RABIA_GUARDED_BY(mu) = 0;  // last staged record
+  uint64_t flushed_lsn RABIA_GUARDED_BY(mu) = 0;  // last record written
   std::atomic<uint64_t> durable_lsn{0};
   std::atomic<int32_t> io_error{0};
-  bool stop_req = false;
+  bool stop_req RABIA_GUARDED_BY(mu) = false;
 
   // vote-barrier state (native-runtime lane): barrier[s] is the first
-  // slot NOT yet covered by a durable barrier record
-  std::vector<int64_t> barrier;
+  // slot NOT yet covered by a durable barrier record. The vector LENGTH
+  // is fixed at create time — bounds checks read the immutable
+  // n_shards; the slots are guarded.
+  std::vector<int64_t> barrier RABIA_GUARDED_BY(mu);
+  int64_t n_shards = 1;
   int64_t stride = 16;
 
   std::thread th;
-  bool started = false;
+  bool started = false;  // control-plane thread only (start/stop/destroy)
   int event_fd = -1;
 
-  uint64_t ctrs[WLC_COUNT];
-  uint64_t hist[WLH_STRIDE];  // one stage: fsync latency
+  // counter block: multi-writer (append lane under mu, flush thread
+  // without) — relaxed atomics, read zero-copy as plain u64s by the
+  // Python scrape path (the RKC torn-read contract)
+  std::atomic<uint64_t> ctrs[WLC_COUNT];
+  static_assert(sizeof(std::atomic<uint64_t>) == sizeof(uint64_t),
+                "counter block must read as a plain uint64 array");
+  void bump(int i, uint64_t n = 1) {
+    ctrs[i].fetch_add(n, std::memory_order_relaxed);
+  }
+  uint64_t hist[WLH_STRIDE];  // fsync latency; flush-thread-owned
 };
 
 // identical bucket math to runtime.cpp rth_observe: the Python exporter
@@ -189,8 +202,8 @@ static bool seg_open(WalCtx* c, uint64_t index, uint64_t base_lsn) {
     close(c->seg_fd);
   }
   c->seg_fd = fd;
-  c->seg_index = index;
-  c->seg_bytes = WAL_HEADER;
+  c->seg_index.store(index, std::memory_order_relaxed);
+  c->seg_bytes.store(WAL_HEADER, std::memory_order_relaxed);
   return true;
 }
 
@@ -204,23 +217,25 @@ static bool flush_batch(WalCtx* c, const uint8_t* buf, int64_t len,
     // find the largest run of whole records that fits the open segment
     int64_t run = 0;
     uint64_t run_recs = 0;
+    const int64_t seg_bytes = c->seg_bytes.load(std::memory_order_relaxed);
     while (at + run < len) {
       uint32_t plen;
       memcpy(&plen, buf + at + run, 4);
       const int64_t frame = 8 + (int64_t)plen;
-      if (run > 0 && c->seg_bytes + run + frame > c->seg_limit) break;
+      if (run > 0 && seg_bytes + run + frame > c->seg_limit) break;
       // a first record never fits? it goes in alone (oversized records
       // own a segment; rotation below handles the boundary)
-      if (run == 0 && c->seg_bytes > WAL_HEADER &&
-          c->seg_bytes + frame > c->seg_limit)
+      if (run == 0 && seg_bytes > WAL_HEADER &&
+          seg_bytes + frame > c->seg_limit)
         break;
       run += frame;
       run_recs++;
     }
     if (run == 0) {
       // rotation required before this record
-      if (!seg_open(c, c->seg_index + 1, lsn)) return false;
-      c->ctrs[WLC_ROTATIONS]++;
+      uint64_t next = c->seg_index.load(std::memory_order_relaxed) + 1;
+      if (!seg_open(c, next, lsn)) return false;
+      c->bump(WLC_ROTATIONS);
       continue;
     }
     int64_t done = 0;
@@ -232,8 +247,8 @@ static bool flush_batch(WalCtx* c, const uint8_t* buf, int64_t len,
       }
       done += w;
     }
-    c->seg_bytes += run;
-    c->ctrs[WLC_FLUSH_BYTES] += (uint64_t)run;
+    c->seg_bytes.fetch_add(run, std::memory_order_relaxed);
+    c->bump(WLC_FLUSH_BYTES, (uint64_t)run);
     at += run;
     lsn += run_recs;
   }
@@ -247,8 +262,8 @@ static void wal_loop(WalCtx* c) {
     uint64_t target;
     uint64_t first;
     {
-      std::unique_lock<std::mutex> lk(c->mu);
-      c->cv.wait(lk, [c] { return !c->stage.empty() || c->stop_req; });
+      rabia::MutexLock lk(c->mu);
+      while (c->stage.empty() && !c->stop_req) c->cv.wait(lk);
       if (c->stage.empty() && c->stop_req) break;
       local.clear();
       local.swap(c->stage);
@@ -256,7 +271,7 @@ static void wal_loop(WalCtx* c) {
       target = c->staged_lsn;
       c->flushed_lsn = target;
     }
-    c->ctrs[WLC_FLUSHES]++;
+    c->bump(WLC_FLUSHES);
     bool ok = c->io_error.load(std::memory_order_relaxed) == 0;
     if (ok)
       ok = flush_batch(c, local.data(), (int64_t)local.size(), first, target);
@@ -264,9 +279,9 @@ static void wal_loop(WalCtx* c) {
       const uint64_t t0 = mono_ns();
       ok = fsync(c->seg_fd) == 0;
       const uint64_t dt = mono_ns() - t0;
-      c->ctrs[WLC_FSYNCS]++;
-      c->ctrs[WLC_FSYNC_NS] += dt;
-      c->ctrs[WLC_GROUP_RECORDS] += target - first + 1;
+      c->bump(WLC_FSYNCS);
+      c->bump(WLC_FSYNC_NS, dt);
+      c->bump(WLC_GROUP_RECORDS, target - first + 1);
       hist_observe(c, dt);
     }
     {
@@ -274,13 +289,13 @@ static void wal_loop(WalCtx* c) {
       // while holding mu, so a store outside the lock could land
       // between the check and the block — a lost wakeup that stalls
       // the waiter until its full timeout
-      std::lock_guard<std::mutex> lk(c->mu);
+      rabia::MutexLock lk(c->mu);
       if (!ok) {
         // a durability failure must never be reported as durable: the
         // watermark freezes, callers waiting on it see the wedge via
         // wal_io_error and fail loudly instead of acking lost writes
         c->io_error.store(1, std::memory_order_release);
-        c->ctrs[WLC_IO_ERRORS]++;
+        c->bump(WLC_IO_ERRORS);
       } else {
         c->durable_lsn.store(target, std::memory_order_release);
       }
@@ -311,15 +326,21 @@ void* wal_create(const char* dir, int64_t seg_limit, int64_t n_shards,
   // rotation threshold is part of the byte-parity contract
   c->seg_limit = seg_limit > WAL_HEADER + 64 ? seg_limit : WAL_HEADER + 64;
   c->stride = stride > 0 ? stride : 16;
-  c->barrier.assign((size_t)(n_shards > 0 ? n_shards : 1), 0);
-  memset(c->ctrs, 0, sizeof(c->ctrs));
+  c->n_shards = n_shards > 0 ? n_shards : 1;
+  for (auto& ctr : c->ctrs) ctr.store(0, std::memory_order_relaxed);
   memset(c->hist, 0, sizeof(c->hist));
   c->dir_fd = open(dir, O_RDONLY | O_DIRECTORY | O_CLOEXEC);
   if (c->dir_fd < 0) {
     delete c;
     return nullptr;
   }
-  c->staged_lsn = c->flushed_lsn = start_lsn;
+  {
+    // no other thread exists yet; the lock is for the analysis (and
+    // free — uncontended)
+    rabia::MutexLock lk(c->mu);
+    c->barrier.assign((size_t)c->n_shards, 0);
+    c->staged_lsn = c->flushed_lsn = start_lsn;
+  }
   c->durable_lsn.store(start_lsn, std::memory_order_release);
   if (!seg_open(c, start_segment, start_lsn + 1)) {
     close(c->dir_fd);
@@ -344,7 +365,7 @@ void wal_stop(void* h) {
   WalCtx* c = (WalCtx*)h;
   if (!c->started) return;
   {
-    std::lock_guard<std::mutex> lk(c->mu);
+    rabia::MutexLock lk(c->mu);
     c->stop_req = true;
   }
   c->cv.notify_all();
@@ -377,20 +398,20 @@ int64_t wal_append(void* h, const uint8_t* payload, int64_t len) {
   const uint32_t crc = (uint32_t)crc32(0, payload, (uInt)len);
   uint64_t lsn;
   {
-    std::lock_guard<std::mutex> lk(c->mu);
+    rabia::MutexLock lk(c->mu);
     size_t w = c->stage.size();
     c->stage.resize(w + 8 + (size_t)len);
     memcpy(c->stage.data() + w, &plen, 4);
     memcpy(c->stage.data() + w + 4, &crc, 4);
     memcpy(c->stage.data() + w + 8, payload, (size_t)len);
     lsn = ++c->staged_lsn;
-    c->ctrs[WLC_APPENDS]++;
-    c->ctrs[WLC_APPEND_BYTES] += (uint64_t)len + 8;
+    c->bump(WLC_APPENDS);
+    c->bump(WLC_APPEND_BYTES, (uint64_t)len + 8);
     switch (payload[0]) {
-      case 1: c->ctrs[WLC_WAVES]++; break;
-      case 2: c->ctrs[WLC_BARRIERS]++; break;
-      case 3: c->ctrs[WLC_FRONTIERS]++; break;
-      case 4: c->ctrs[WLC_LEDGERS]++; break;
+      case 1: c->bump(WLC_WAVES); break;
+      case 2: c->bump(WLC_BARRIERS); break;
+      case 3: c->bump(WLC_FRONTIERS); break;
+      case 4: c->bump(WLC_LEDGERS); break;
       default: break;
     }
   }
@@ -404,7 +425,7 @@ uint64_t wal_durable(void* h) {
 
 uint64_t wal_staged(void* h) {
   WalCtx* c = (WalCtx*)h;
-  std::lock_guard<std::mutex> lk(c->mu);
+  rabia::MutexLock lk(c->mu);
   return c->staged_lsn;
 }
 
@@ -420,18 +441,24 @@ int32_t wal_sync(void* h, double timeout_s) {
   WalCtx* c = (WalCtx*)h;
   uint64_t target;
   {
-    std::lock_guard<std::mutex> lk(c->mu);
+    rabia::MutexLock lk(c->mu);
     target = c->staged_lsn;
   }
   c->cv.notify_one();
-  std::unique_lock<std::mutex> lk(c->mu);
-  bool ok = c->cv_done.wait_for(
-      lk, std::chrono::duration<double>(timeout_s), [c, target] {
-        return c->durable_lsn.load(std::memory_order_acquire) >= target ||
-               c->io_error.load(std::memory_order_acquire);
-      });
-  if (!ok || c->io_error.load(std::memory_order_acquire)) return -1;
-  return 0;
+  const timespec dl = rabia::CondVar::deadline_in(timeout_s);
+  rabia::MutexLock lk(c->mu);
+  for (;;) {
+    if (c->io_error.load(std::memory_order_acquire)) return -1;
+    if (c->durable_lsn.load(std::memory_order_acquire) >= target) return 0;
+    if (!c->cv_done.wait_until(lk, dl)) {
+      // timed out: one last look (the flush may have published while we
+      // were timing out)
+      if (c->io_error.load(std::memory_order_acquire)) return -1;
+      return c->durable_lsn.load(std::memory_order_acquire) >= target
+                 ? 0
+                 : -1;
+    }
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -446,36 +473,36 @@ int32_t wal_sync(void* h, double timeout_s) {
 // let a vote for the slot reach the wire until wal_durable() >= that.
 int64_t wal_barrier_covered(void* h, int64_t shard, int64_t slot) {
   WalCtx* c = (WalCtx*)h;
-  if (!c || shard < 0 || (size_t)shard >= c->barrier.size()) return 0;
+  if (!c || shard < 0 || shard >= c->n_shards) return 0;
   {
-    std::lock_guard<std::mutex> lk(c->mu);
+    rabia::MutexLock lk(c->mu);
     if (slot < c->barrier[(size_t)shard]) return 0;
     c->barrier[(size_t)shard] = slot + c->stride;
   }
   // encode outside the lock; wal_append re-locks (cheap, uncontended)
-  const uint32_t n = (uint32_t)c->barrier.size();
+  const uint32_t n = (uint32_t)c->n_shards;
   std::vector<uint8_t> payload(5 + 8 * (size_t)n);
   payload[0] = 2;  // K_BARRIER
   memcpy(payload.data() + 1, &n, 4);
   {
-    std::lock_guard<std::mutex> lk(c->mu);
+    rabia::MutexLock lk(c->mu);
     memcpy(payload.data() + 5, c->barrier.data(), 8 * (size_t)n);
   }
-  c->ctrs[WLC_BARRIER_WAITS]++;
+  c->bump(WLC_BARRIER_WAITS);
   return wal_append(h, payload.data(), (int64_t)payload.size());
 }
 
 void wal_set_barrier(void* h, const int64_t* vec, int64_t n) {
   WalCtx* c = (WalCtx*)h;
-  std::lock_guard<std::mutex> lk(c->mu);
-  for (int64_t i = 0; i < n && (size_t)i < c->barrier.size(); i++)
+  rabia::MutexLock lk(c->mu);
+  for (int64_t i = 0; i < n && i < c->n_shards; i++)
     c->barrier[(size_t)i] = vec[i];
 }
 
 void wal_get_barrier(void* h, int64_t* out, int64_t n) {
   WalCtx* c = (WalCtx*)h;
-  std::lock_guard<std::mutex> lk(c->mu);
-  for (int64_t i = 0; i < n && (size_t)i < c->barrier.size(); i++)
+  rabia::MutexLock lk(c->mu);
+  for (int64_t i = 0; i < n && i < c->n_shards; i++)
     out[i] = c->barrier[(size_t)i];
 }
 
@@ -485,7 +512,8 @@ void wal_get_barrier(void* h, int64_t* out, int64_t n) {
 
 int32_t wal_counters_version() { return WAL_COUNTERS_VERSION; }
 int32_t wal_counters_count() { return WLC_COUNT; }
-void* wal_counters(void* h) { return ((WalCtx*)h)->ctrs; }
+void* wal_counters(void* h) { return ((WalCtx*)h)->ctrs; }  // atomics read
+                                                            // as plain u64s
 
 int32_t wal_hist_version() { return WLH_VERSION; }
 int32_t wal_hist_buckets() { return WLH_BUCKETS; }
@@ -494,8 +522,10 @@ int32_t wal_hist_min_exp() { return WLH_MIN_EXP; }
 void* wal_hist(void* h) { return ((WalCtx*)h)->hist; }
 
 int64_t wal_segment_index(void* h) {
-  return (int64_t)((WalCtx*)h)->seg_index;
+  return (int64_t)((WalCtx*)h)->seg_index.load(std::memory_order_relaxed);
 }
-int64_t wal_segment_bytes(void* h) { return ((WalCtx*)h)->seg_bytes; }
+int64_t wal_segment_bytes(void* h) {
+  return ((WalCtx*)h)->seg_bytes.load(std::memory_order_relaxed);
+}
 
 }  // extern "C"

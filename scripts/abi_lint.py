@@ -1,0 +1,462 @@
+"""Cross-language ABI linter: C++ kernels vs their Python twins.
+
+Every native kernel exposes versioned, append-only blocks (counters,
+histograms, flight rings) and wire formats that a Python twin mirrors by
+hand — RKC_* vs native_tick.RK_COUNTER_NAMES, FrEvent vs
+obs.flight.FR_DTYPE, the WAL record kinds, the runtime's CMD_*/EV_*
+codes. Until this linter, a drift (counter appended on one side, enum
+reordered, version literal bumped once, struct resized) compiled clean
+and CORRUPTED METRICS SILENTLY: the scrape path reads the block
+zero-copy by index, so a one-slot shift relabels every later counter.
+
+The linter PARSES both sides (regex over comment-stripped C++, `ast`
+over the Python — nothing is imported or executed) and cross-checks:
+
+  count     enumerator count (before *_COUNT) == len(names tuple)
+  order     index-by-index name correspondence (enum name minus prefix,
+            lowercased; irregular spellings live in ALIASES — updating
+            that map is part of adding an irregular counter)
+  version   version literals declared on BOTH sides must be equal
+  size      struct static_asserts vs np.dtype itemsize (computed from
+            the dtype spec, not imported)
+  codes     shared code points (FRE_*, CMD_*/EV_*, SUBMIT_*, RTM run
+            states, WAL record kinds) equal value-for-value
+  geometry  histogram bucket geometry (sub_bits/min_exp/octaves) equal
+            across walkernel WLH_*, runtime RTH_* and obs.registry SLO_*
+
+Run: python scripts/abi_lint.py [--root DIR]   (exit 1 on any drift)
+The unit suite (tests/test_static_analysis.py) seeds each drift class
+into copies of the real tree and asserts the class is caught.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import re
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+
+# --- irregular name correspondences (index-aligned pairs that do not
+# follow the default enum-minus-prefix-lowercased rule). Part of the
+# lint contract: an irregularly-named counter lands here or the gate
+# goes red.
+ALIASES: dict[str, str] = {
+    "RKC_FRAMES_V1": "frames_vote1",
+    "RKC_FRAMES_V2": "frames_vote2",
+    "RKC_FRAMES_DEC": "frames_decision",
+    "RKC_STALE": "stale_votes",
+    "RKC_CARRY": "carries",
+    "RKC_SCATTER": "ledger_scatters",
+    "RTC_BORROWS": "arena_borrows",
+}
+
+
+@dataclass
+class Violation:
+    rule: str       # count|order|version|size|codes|geometry
+    where: str      # "cpp_file <-> py_file :: subject"
+    detail: str
+
+    def __str__(self) -> str:
+        return f"[{self.rule}] {self.where}: {self.detail}"
+
+
+# --- C++ side ---------------------------------------------------------------
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    return re.sub(r"//[^\n]*", " ", text)
+
+
+def cpp_enum(text: str, terminator: str) -> list[tuple[str, int]]:
+    """Enumerators (name, value) of the enum block ending at
+    `terminator` (the *_COUNT sentinel, excluded)."""
+    clean = _strip_comments(text)
+    for m in re.finditer(r"enum[^{;]*\{([^}]*)\}", clean, flags=re.S):
+        body = m.group(1)
+        if not re.search(rf"\b{terminator}\b", body):
+            continue
+        out: list[tuple[str, int]] = []
+        nxt = 0
+        for ent in body.split(","):
+            ent = ent.strip()
+            if not ent:
+                continue
+            em = re.match(r"([A-Za-z_]\w*)\s*(?:=\s*([\w'x]+))?$", ent)
+            if not em:
+                continue
+            name, val = em.group(1), em.group(2)
+            value = int(val, 0) if val else nxt
+            nxt = value + 1
+            if name == terminator:
+                return out
+            out.append((name, value))
+    raise LookupError(f"enum with terminator {terminator} not found")
+
+
+def cpp_enum_prefix(text: str, prefix: str) -> dict[str, int]:
+    """All enumerators named `prefix*` anywhere in the file (for blocks
+    with explicit values and no *_COUNT sentinel, e.g. FRE_*)."""
+    clean = _strip_comments(text)
+    out: dict[str, int] = {}
+    nxt = 0
+    for m in re.finditer(
+        rf"\b({prefix}\w+)\s*(?:=\s*(\w+))?\s*[,}}]", clean
+    ):
+        name, val = m.group(1), m.group(2)
+        value = int(val, 0) if val else nxt
+        nxt = value + 1
+        if name not in out:
+            out[name] = value
+    if not out:
+        raise LookupError(f"no {prefix}* enumerators found")
+    return out
+
+
+def cpp_const(text: str, name: str) -> int:
+    clean = _strip_comments(text)
+    m = re.search(
+        rf"(?:static\s+)?const(?:expr)?\s+[\w:]+\s+{name}\s*=\s*([\w']+)\s*;",
+        clean,
+    )
+    if not m:
+        raise LookupError(f"constant {name} not found")
+    return int(m.group(1), 0)
+
+
+def cpp_sizeof_assert(text: str, struct: str) -> int:
+    m = re.search(
+        rf"static_assert\(\s*sizeof\({struct}\)\s*==\s*(\d+)", text
+    )
+    if not m:
+        raise LookupError(f"static_assert sizeof({struct}) not found")
+    return int(m.group(1))
+
+
+def cpp_wal_kind_cases(text: str) -> dict[int, str]:
+    """The wal_append per-kind counter switch: case byte -> WLC_* name."""
+    clean = _strip_comments(text)
+    out = {}
+    for m in re.finditer(
+        r"case\s+(\d+)\s*:\s*c->(?:bump\(|ctrs\[)(WLC_\w+)", clean
+    ):
+        out[int(m.group(1))] = m.group(2)
+    if not out:
+        raise LookupError("wal_append kind switch not found")
+    return out
+
+
+# --- Python side ------------------------------------------------------------
+
+
+class PyModule:
+    """Top-level assignments of a module, parsed — never imported."""
+
+    def __init__(self, path: Path) -> None:
+        self.path = path
+        self.assigns: dict[str, ast.expr] = {}
+        tree = ast.parse(path.read_text())
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    self.assigns[t.id] = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name):
+                    self.assigns[node.target.id] = node.value
+
+    def str_tuple(self, name: str) -> list[str]:
+        node = self.assigns[name]
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            raise LookupError(f"{name} is not a tuple in {self.path}")
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                raise LookupError(f"{name} holds a non-literal")
+            out.append(el.value)
+        return out
+
+    def int_const(self, name: str) -> int:
+        node = self.assigns[name]
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return node.value
+        raise LookupError(f"{name} is not an int literal in {self.path}")
+
+    def int_consts_prefix(self, prefix: str) -> dict[str, int]:
+        out = {}
+        for k, v in self.assigns.items():
+            if k.startswith(prefix) and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, int):
+                out[k] = v.value
+        return out
+
+    def dtype_itemsize(self, name: str) -> int:
+        """Itemsize of an np.dtype([...]) literal, from the format
+        strings alone (unpadded struct dtype — matches numpy)."""
+        node = self.assigns[name]
+        if not (isinstance(node, ast.Call) and node.args):
+            raise LookupError(f"{name} is not an np.dtype call")
+        spec = node.args[0]
+        if not isinstance(spec, (ast.List, ast.Tuple)):
+            raise LookupError(f"{name} spec is not a list")
+        sizes = {"1": 1, "2": 2, "4": 4, "8": 8}
+        total = 0
+        for field in spec.elts:
+            assert isinstance(field, (ast.Tuple, ast.List))
+            fmt = field.elts[1]
+            assert isinstance(fmt, ast.Constant)
+            total += sizes[str(fmt.value).lstrip("<>=")[-1]]
+        return total
+
+
+# --- rules ------------------------------------------------------------------
+
+
+def norm(enum_name: str, prefix: str) -> str:
+    return ALIASES.get(enum_name, enum_name[len(prefix):].lower())
+
+
+def check_counter_pair(
+    v: list[Violation], cpp_path: Path, terminator: str, prefix: str,
+    py: PyModule, names_var: str,
+) -> None:
+    where = f"{cpp_path.name} <-> {py.path.name} :: {prefix}*"
+    enum = cpp_enum(cpp_path.read_text(), terminator)
+    names = py.str_tuple(names_var)
+    if len(enum) != len(names):
+        v.append(Violation(
+            "count", where,
+            f"{len(enum)} enumerators vs {len(names)} names in "
+            f"{names_var} (append BOTH sides and bump the version)",
+        ))
+        return
+    for i, ((ename, _), pyname) in enumerate(zip(enum, names)):
+        if norm(ename, prefix) != pyname:
+            v.append(Violation(
+                "order", where,
+                f"index {i}: {ename} vs {pyname!r} (reorder/rename "
+                "drift; irregular spellings belong in abi_lint.ALIASES)",
+            ))
+            return
+
+
+def check_versions(
+    v: list[Violation], cpp_path: Path, cpp_name: str, py: PyModule,
+    py_name: str,
+) -> None:
+    where = f"{cpp_path.name} <-> {py.path.name} :: {cpp_name}"
+    cv = cpp_const(cpp_path.read_text(), cpp_name)
+    pv = py.int_const(py_name)
+    if cv != pv:
+        v.append(Violation(
+            "version", where,
+            f"C++ {cpp_name}={cv} vs Python {py_name}={pv}",
+        ))
+
+
+def check_codes(
+    v: list[Violation], cpp_path: Path, cpp_codes: dict[str, int],
+    py: PyModule, prefix: str, py_only_ok: bool = True,
+) -> None:
+    where = f"{cpp_path.name} <-> {py.path.name} :: {prefix}*"
+    py_codes = py.int_consts_prefix(prefix)
+    for name, val in cpp_codes.items():
+        if name not in py_codes:
+            v.append(Violation(
+                "codes", where,
+                f"{name}={val} declared in C++ only",
+            ))
+        elif py_codes[name] != val:
+            v.append(Violation(
+                "codes", where,
+                f"{name}: C++ {val} vs Python {py_codes[name]}",
+            ))
+    if not py_only_ok:
+        for name in sorted(set(py_codes) - set(cpp_codes)):
+            v.append(Violation(
+                "codes", where,
+                f"{name} declared in Python only",
+            ))
+
+
+def run(root: Path) -> list[Violation]:
+    v: list[Violation] = []
+    native = root / "rabia_tpu" / "native"
+    hk = native / "hostkernel.cpp"
+    tp = native / "transport.cpp"
+    sk = native / "statekernel.cpp"
+    gw = native / "sessionkernel.cpp"
+    wl = native / "walkernel.cpp"
+    rt = native / "runtime.cpp"
+
+    tick = PyModule(root / "rabia_tpu" / "engine" / "native_tick.py")
+    bridge = PyModule(root / "rabia_tpu" / "engine" / "runtime_bridge.py")
+    store = PyModule(root / "rabia_tpu" / "apps" / "native_store.py")
+    sess = PyModule(root / "rabia_tpu" / "gateway" / "native_session.py")
+    sesspy = PyModule(root / "rabia_tpu" / "gateway" / "session.py")
+    wal = PyModule(root / "rabia_tpu" / "persistence" / "native_wal.py")
+    tcp = PyModule(root / "rabia_tpu" / "net" / "tcp.py")
+    flight = PyModule(root / "rabia_tpu" / "obs" / "flight.py")
+    registry = PyModule(root / "rabia_tpu" / "obs" / "registry.py")
+
+    # counter blocks (count + order)
+    check_counter_pair(v, hk, "RKC_COUNT", "RKC_", tick,
+                       "RK_COUNTER_NAMES")
+    check_counter_pair(v, tp, "RTC_COUNT", "RTC_", tcp,
+                       "RT_COUNTER_NAMES")
+    check_counter_pair(v, sk, "SKC_COUNT", "SKC_", store,
+                       "SK_COUNTER_NAMES")
+    check_counter_pair(v, gw, "GWC_COUNT", "GWC_", sess,
+                       "GWC_COUNTER_NAMES")
+    check_counter_pair(v, wl, "WLC_COUNT", "WLC_", wal,
+                       "WAL_COUNTER_NAMES")
+    check_counter_pair(v, rt, "RTM_COUNT", "RTM_", bridge,
+                       "RTM_COUNTER_NAMES")
+    check_counter_pair(v, rt, "RTS_COUNT", "RTS_", bridge,
+                       "RTM_STAGE_NAMES")
+
+    # version literals declared on both sides
+    check_versions(v, gw, "GWS_COUNTERS_VERSION", sess,
+                   "GWS_COUNTERS_VERSION")
+    check_versions(v, wl, "WAL_VERSION", wal, "WAL_VERSION")
+
+    # struct sizes: C++ static_asserts vs np.dtype itemsize
+    fr_cpp = cpp_sizeof_assert(hk.read_text(), "FrEvent")
+    fr_sk = cpp_sizeof_assert(sk.read_text(), "FrEvent")
+    fr_rt = cpp_sizeof_assert(rt.read_text(), "FrEvent")
+    fr_py = flight.dtype_itemsize("FR_DTYPE")
+    if len({fr_cpp, fr_sk, fr_rt, fr_py}) != 1:
+        v.append(Violation(
+            "size", "hostkernel/statekernel/runtime <-> flight.py :: "
+            "FrEvent",
+            f"sizes diverge: hostkernel={fr_cpp} statekernel={fr_sk} "
+            f"runtime={fr_rt} FR_DTYPE={fr_py}",
+        ))
+    tf_cpp = cpp_sizeof_assert(tp.read_text(), "TfEvent")
+    tf_py = flight.dtype_itemsize("TF_DTYPE")
+    if tf_cpp != tf_py:
+        v.append(Violation(
+            "size", "transport.cpp <-> flight.py :: TfEvent",
+            f"static_assert {tf_cpp} vs TF_DTYPE itemsize {tf_py}",
+        ))
+
+    # shared code points
+    check_codes(v, hk, cpp_enum_prefix(hk.read_text(), "FRE_"),
+                flight, "FRE_")
+    check_codes(v, rt, cpp_enum_prefix(rt.read_text(), "CMD_"),
+                bridge, "CMD_")
+    check_codes(v, rt, cpp_enum_prefix(rt.read_text(), "EV_"),
+                bridge, "EV_")
+    rtm_states = {
+        k: val
+        for k, val in cpp_enum_prefix(rt.read_text(), "RTM_").items()
+        if k in ("RTM_RUNNING", "RTM_PAUSED", "RTM_STOPPED")
+    }
+    check_codes(v, rt, rtm_states, bridge, "RTM_")
+    check_codes(v, gw, cpp_enum_prefix(gw.read_text(), "SUBMIT_"),
+                sesspy, "SUBMIT_")
+
+    # WAL record kinds: the Python K_* map vs the per-kind counter switch
+    kind_cases = cpp_wal_kind_cases(wl.read_text())
+    k_py = wal.int_consts_prefix("K_")
+    for kname, kval in sorted(k_py.items()):
+        expect_wlc = "WLC_" + kname[2:] + "S"
+        got = kind_cases.get(kval)
+        if got is None:
+            v.append(Violation(
+                "codes", "walkernel.cpp <-> native_wal.py :: record kinds",
+                f"{kname}={kval} has no per-kind counter case in "
+                "wal_append",
+            ))
+        elif got != expect_wlc:
+            v.append(Violation(
+                "codes", "walkernel.cpp <-> native_wal.py :: record kinds",
+                f"{kname}={kval} counts {got}, expected {expect_wlc}",
+            ))
+    # segment header size is part of the byte-parity contract
+    if cpp_const(wl.read_text(), "WAL_HEADER") != wal.int_const(
+        "SEG_HEADER"
+    ):
+        v.append(Violation(
+            "size", "walkernel.cpp <-> native_wal.py :: segment header",
+            "WAL_HEADER vs SEG_HEADER disagree",
+        ))
+
+    # histogram geometry: one bound table serves every native histogram
+    geo = {
+        "walkernel WLH": (
+            cpp_const(wl.read_text(), "WLH_SUB_BITS"),
+            cpp_const(wl.read_text(), "WLH_MIN_EXP"),
+            cpp_const(wl.read_text(), "WLH_OCTAVES"),
+        ),
+        "runtime RTH": (
+            cpp_const(rt.read_text(), "RTH_SUB_BITS"),
+            cpp_const(rt.read_text(), "RTH_MIN_EXP"),
+            cpp_const(rt.read_text(), "RTH_OCTAVES"),
+        ),
+        "registry SLO": (
+            registry.int_const("SLO_SUB_BITS"),
+            registry.int_const("SLO_MIN_EXP"),
+            registry.int_const("SLO_OCTAVES"),
+        ),
+    }
+    if len(set(geo.values())) != 1:
+        v.append(Violation(
+            "geometry",
+            "walkernel.cpp / runtime.cpp <-> obs/registry.py :: "
+            "histogram buckets",
+            "; ".join(f"{k}={val}" for k, val in geo.items()),
+        ))
+
+    # runtime hist stages: Python label tuple vs RTH stage enum
+    rth = cpp_enum(rt.read_text(), "RTH_STAGE_COUNT")
+    hist_names = bridge.str_tuple("RTM_HIST_STAGES")
+    if len(rth) != len(hist_names):
+        v.append(Violation(
+            "count", "runtime.cpp <-> runtime_bridge.py :: RTH_*",
+            f"{len(rth)} stages vs {len(hist_names)} labels",
+        ))
+    else:
+        for i, ((ename, _), label) in enumerate(zip(rth, hist_names)):
+            if norm(ename, "RTH_") != label:
+                v.append(Violation(
+                    "order", "runtime.cpp <-> runtime_bridge.py :: RTH_*",
+                    f"index {i}: {ename} vs {label!r}",
+                ))
+                break
+
+    # runtime stage labels prefix the registry's exported label set (the
+    # registry appends asyncio-owner-only stages after the native rows —
+    # registry.py RUNTIME_STAGES doc)
+    rts_names = bridge.str_tuple("RTM_STAGE_NAMES")
+    reg_stages = registry.str_tuple("RUNTIME_STAGES")
+    if reg_stages[: len(rts_names)] != rts_names:
+        v.append(Violation(
+            "order", "runtime_bridge.py <-> obs/registry.py :: "
+            "RUNTIME_STAGES",
+            "native RTS_* labels must prefix RUNTIME_STAGES, in order",
+        ))
+
+    return v
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=str(Path(__file__).parent.parent))
+    args = ap.parse_args()
+    violations = run(Path(args.root))
+    for violation in violations:
+        print(violation)
+    if violations:
+        print(f"abi_lint: {len(violations)} violation(s)")
+        return 1
+    print("abi_lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,7 +49,10 @@ def run_real_tools() -> int:
 
 
 class _Fallback(ast.NodeVisitor):
-    """Single-file F401/E711/E712/E722/F403 approximation."""
+    """Single-file F401/E711/E712/E722/F403 + B006/RUF006
+    approximation (the round-13 additions mirror the ruff codes
+    enabled in pyproject: mutable defaults and dangling
+    asyncio.create_task results)."""
 
     def __init__(self, path: pathlib.Path, src: str) -> None:
         self.path = path
@@ -80,6 +83,54 @@ class _Fallback(ast.NodeVisitor):
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
         if node.type is None:
             self._flag(node.lineno, "E722", "bare except")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        defaults = list(node.args.defaults)
+        defaults += [d for d in node.args.kw_defaults if d is not None]
+        for d in defaults:
+            mutable = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and isinstance(d.func, ast.Name)
+                and d.func.id in ("list", "dict", "set")
+            )
+            if mutable:
+                self._flag(
+                    d.lineno, "B006",
+                    f"mutable default in {node.name}() is shared "
+                    "across calls",
+                )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        # RUF006: a create_task whose handle is dropped can be GC'd
+        # mid-flight (the task silently disappears). Mirror ruff's
+        # scope: asyncio.create_task / <loop>.create_task / a bare
+        # imported create_task — NOT TaskGroup.create_task (the group
+        # holds the strong reference).
+        if isinstance(node.value, ast.Call):
+            f = node.value.func
+            dangling = False
+            if isinstance(f, ast.Attribute) and f.attr == "create_task":
+                base = f.value
+                dangling = isinstance(base, ast.Name) and (
+                    base.id == "asyncio" or base.id.endswith("loop")
+                )
+            elif isinstance(f, ast.Name) and f.id == "create_task":
+                dangling = "create_task" in self.imports
+            if dangling:
+                self._flag(
+                    node.lineno, "RUF006",
+                    "create_task result must be bound (a dangling "
+                    "task may be garbage-collected mid-flight)",
+                )
         self.generic_visit(node)
 
     def visit_Compare(self, node: ast.Compare) -> None:

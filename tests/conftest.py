@@ -41,6 +41,12 @@ def pytest_configure(config):
         "(KernelConfig.backend='jax' — directly-attached accelerators "
         "only; deselect with -m 'not jax_backend')",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-build/long-run gates (the full sanitizer matrix "
+        "beyond the tier-1 cells); deselect with -m 'not slow' — the "
+        "CI sanitizers job covers them all via scripts/sanitize_gate.py",
+    )
 
 
 @pytest.hookimpl(tryfirst=True)

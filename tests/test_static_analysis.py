@@ -1,0 +1,412 @@
+"""The concurrency & ABI static-analysis plane's gates (round 13).
+
+Four tiers, mirroring docs/STATIC_ANALYSIS.md:
+
+- **Sanitizer matrix** — the native stress programs under TSan/ASan as
+  ENFORCED cells (halt_on_error; exit nonzero = red). The old
+  environmental SKIP is retired: build.find_sanitizer_toolchain proves
+  the toolchain first (clean timed-condvar probe passes AND a planted
+  bug is caught — on gcc via the pthread_cond_clockwait shim), so the
+  only remaining skip is "no viable toolchain on this machine", one
+  line. The selfcheck tests prove red-on-failure with deliberately
+  broken probes.
+- **ABI linter** — scripts/abi_lint.py clean on the real tree, plus a
+  drift-injection suite: each drift class (added counter, reordered
+  names, stale version literal, resized struct, diverged code point)
+  is seeded into a COPY of the real sources and must be caught.
+- **Thread-safety build** — every annotated kernel compiles under
+  clang++ -Werror=thread-safety (skips in one line without clang; the
+  CI thread-safety cell installs it).
+- **Lock-order checker** — the RABIA_NATIVE_DEBUG=1 flavor aborts on a
+  deliberate inversion and passes the real kernels' lock paths.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "scripts"))
+
+from rabia_tpu.native import build as nb  # noqa: E402
+
+import abi_lint  # noqa: E402
+
+
+def _toolchain_or_skip(flavor: str):
+    if shutil.which("g++") is None and shutil.which("clang++") is None:
+        pytest.skip("no C++ compiler")
+    tc = nb.find_sanitizer_toolchain(flavor)
+    if tc is None:
+        reason = getattr(nb.find_sanitizer_toolchain, "reason", "?")
+        pytest.skip(f"no viable {flavor} toolchain: {reason}")
+    return tc
+
+
+def _run_cell(name: str, flavor: str, extra_args: list[str] | None = None):
+    """Build + run one enforced sanitizer cell; any finding is FATAL
+    (no skip past this point — the toolchain is already proven)."""
+    exe = nb.build_stress(name, flavor)
+    proc = subprocess.run(
+        [str(exe), *(extra_args or [])],
+        capture_output=True, text=True, timeout=300,
+        env=nb.stress_env(flavor),
+    )
+    assert proc.returncode == 0, (
+        f"{flavor}/{name} stress FAILED rc={proc.returncode}\n"
+        f"{proc.stdout[-800:]}\n{proc.stderr[-4000:]}"
+    )
+    assert "stress ok" in proc.stdout  # the seam did real work
+
+
+class TestSanitizerMatrix:
+    """Enforced TSan/ASan cells over the cross-thread seams that the
+    thread-per-shard-group runtime (ROADMAP item 1) will multiply."""
+
+    def test_tsan_transport(self):
+        _toolchain_or_skip("tsan")
+        _run_cell("transport", "tsan")
+
+    def test_tsan_wal(self, tmp_path):
+        _toolchain_or_skip("tsan")
+        _run_cell("wal", "tsan", [str(tmp_path)])
+
+    def test_tsan_session(self):
+        _toolchain_or_skip("tsan")
+        _run_cell("session", "tsan")
+
+    def test_tsan_statekernel(self):
+        _toolchain_or_skip("tsan")
+        _run_cell("statekernel", "tsan")
+
+    @pytest.mark.slow
+    def test_tsan_runtime(self):
+        _toolchain_or_skip("tsan")
+        _run_cell("runtime", "tsan")
+
+    def test_asan_wal(self, tmp_path):
+        _toolchain_or_skip("asan")
+        _run_cell("wal", "asan", [str(tmp_path)])
+
+    def test_asan_session(self):
+        _toolchain_or_skip("asan")
+        _run_cell("session", "asan")
+
+    @pytest.mark.slow
+    def test_asan_transport(self):
+        _toolchain_or_skip("asan")
+        _run_cell("transport", "asan")
+
+    @pytest.mark.slow
+    def test_asan_statekernel_and_runtime(self):
+        _toolchain_or_skip("asan")
+        _run_cell("statekernel", "asan")
+        _run_cell("runtime", "asan")
+
+    @pytest.mark.slow
+    def test_ubsan_all(self, tmp_path):
+        _toolchain_or_skip("ubsan")
+        for name in sorted(nb.STRESS_PROGRAMS):
+            args = [str(tmp_path / name)] if name == "wal" else []
+            if name == "wal":
+                (tmp_path / name).mkdir()
+            _run_cell(name, "ubsan", args)
+
+    def test_tsan_gate_is_red_on_a_planted_race(self):
+        """The gate must FAIL on a real race — proof the matrix is
+        enforced, not green-by-silence."""
+        _toolchain_or_skip("tsan")
+        exe = nb.build_selfcheck("tsan")
+        for _ in range(5):
+            proc = subprocess.run(
+                [str(exe)], capture_output=True, text=True, timeout=120,
+                env=nb.stress_env("tsan"),
+            )
+            if proc.returncode != 0:
+                return
+        pytest.fail("TSan did not catch the planted data race")
+
+    def test_asan_gate_is_red_on_a_planted_uaf(self):
+        _toolchain_or_skip("asan")
+        exe = nb.build_selfcheck("asan")
+        proc = subprocess.run(
+            [str(exe)], capture_output=True, text=True, timeout=120,
+            env=nb.stress_env("asan"),
+        )
+        assert proc.returncode != 0, (
+            "ASan did not catch the planted use-after-free"
+        )
+
+
+# --- ABI linter -------------------------------------------------------------
+
+# every file the linter reads, relative to the repo root (the drift
+# suite copies exactly these into a scratch tree)
+_LINT_FILES = [
+    "rabia_tpu/native/hostkernel.cpp",
+    "rabia_tpu/native/transport.cpp",
+    "rabia_tpu/native/statekernel.cpp",
+    "rabia_tpu/native/sessionkernel.cpp",
+    "rabia_tpu/native/walkernel.cpp",
+    "rabia_tpu/native/runtime.cpp",
+    "rabia_tpu/engine/native_tick.py",
+    "rabia_tpu/engine/runtime_bridge.py",
+    "rabia_tpu/apps/native_store.py",
+    "rabia_tpu/gateway/native_session.py",
+    "rabia_tpu/gateway/session.py",
+    "rabia_tpu/persistence/native_wal.py",
+    "rabia_tpu/net/tcp.py",
+    "rabia_tpu/obs/flight.py",
+    "rabia_tpu/obs/registry.py",
+]
+
+
+def _scratch_tree(tmp_path: Path) -> Path:
+    root = tmp_path / "tree"
+    for rel in _LINT_FILES:
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+    return root
+
+
+def _mutate(root: Path, rel: str, old: str, new: str) -> None:
+    p = root / rel
+    text = p.read_text()
+    assert old in text, f"fixture anchor missing in {rel}: {old!r}"
+    p.write_text(text.replace(old, new, 1))
+
+
+def _rules(root: Path) -> set[str]:
+    return {v.rule for v in abi_lint.run(root)}
+
+
+class TestAbiLint:
+    def test_real_tree_is_clean(self):
+        violations = abi_lint.run(REPO)
+        assert violations == [], "\n".join(str(v) for v in violations)
+
+    def test_scratch_copy_is_clean(self, tmp_path):
+        # the drift fixtures prove detection only if the unmutated copy
+        # passes
+        assert _rules(_scratch_tree(tmp_path)) == set()
+
+    def test_catches_added_counter(self, tmp_path):
+        root = _scratch_tree(tmp_path)
+        _mutate(root, "rabia_tpu/native/hostkernel.cpp",
+                "  RKC_COUNT", "  RKC_SYNTHETIC_NEW,\n  RKC_COUNT")
+        assert "count" in _rules(root)
+
+    def test_catches_reordered_names(self, tmp_path):
+        root = _scratch_tree(tmp_path)
+        _mutate(root, "rabia_tpu/engine/native_tick.py",
+                '    "ticks",\n    "stages",',
+                '    "stages",\n    "ticks",')
+        assert "order" in _rules(root)
+
+    def test_catches_stale_version_literal(self, tmp_path):
+        root = _scratch_tree(tmp_path)
+        _mutate(root, "rabia_tpu/native/sessionkernel.cpp",
+                "GWS_COUNTERS_VERSION = 1", "GWS_COUNTERS_VERSION = 2")
+        assert "version" in _rules(root)
+
+    def test_catches_resized_struct(self, tmp_path):
+        root = _scratch_tree(tmp_path)
+        _mutate(root, "rabia_tpu/obs/flight.py",
+                '("shard", "<u4")', '("shard", "<u8")')
+        assert "size" in _rules(root)
+
+    def test_catches_diverged_code_point(self, tmp_path):
+        root = _scratch_tree(tmp_path)
+        _mutate(root, "rabia_tpu/native/sessionkernel.cpp",
+                "SUBMIT_SHED_WINDOW = 3", "SUBMIT_SHED_WINDOW = 4")
+        assert "codes" in _rules(root)
+
+    def test_catches_histogram_geometry_drift(self, tmp_path):
+        root = _scratch_tree(tmp_path)
+        _mutate(root, "rabia_tpu/native/walkernel.cpp",
+                "WLH_SUB_BITS = 2", "WLH_SUB_BITS = 3")
+        assert "geometry" in _rules(root)
+
+
+# --- clang -Werror=thread-safety --------------------------------------------
+
+_ANNOTATED = [
+    "transport.cpp", "statekernel.cpp", "sessionkernel.cpp",
+    "walkernel.cpp", "runtime.cpp",
+]
+
+
+def _find_clang() -> str | None:
+    for name in ("clang++", "clang++-20", "clang++-19", "clang++-18",
+                 "clang++-17", "clang++-16", "clang++-15", "clang++-14"):
+        if shutil.which(name):
+            return name
+    return None
+
+
+class TestThreadSafetyBuild:
+    def test_kernels_clean_under_werror_thread_safety(self):
+        clang = _find_clang()
+        if clang is None:
+            pytest.skip("no clang++ (the CI thread-safety cell has one)")
+        native = REPO / "rabia_tpu" / "native"
+        for src in _ANNOTATED:
+            proc = subprocess.run(
+                [clang, "-std=c++17", "-fsyntax-only",
+                 "-Werror=thread-safety", "-Wthread-safety",
+                 f"-I{native}", str(native / src)],
+                capture_output=True, text=True, timeout=300,
+            )
+            assert proc.returncode == 0, (
+                f"{src} fails -Werror=thread-safety:\n"
+                f"{proc.stderr[-4000:]}"
+            )
+
+    def test_annotation_violation_is_a_compile_error(self, tmp_path):
+        """GUARDED_BY without the lock must fail the build — proof the
+        macros bind (and that the no-op fallback is clang-only)."""
+        clang = _find_clang()
+        if clang is None:
+            pytest.skip("no clang++ (the CI thread-safety cell has one)")
+        src = tmp_path / "violate.cpp"
+        src.write_text(
+            '#include "annotations.h"\n'
+            "struct S {\n"
+            "  rabia::Mutex mu{\"s.mu\"};\n"
+            "  int guarded RABIA_GUARDED_BY(mu) = 0;\n"
+            "};\n"
+            "int touch(S& s) { return s.guarded; }  // no lock held\n"
+        )
+        proc = subprocess.run(
+            [clang, "-std=c++17", "-fsyntax-only",
+             "-Werror=thread-safety", "-Wthread-safety",
+             f"-I{REPO / 'rabia_tpu' / 'native'}", str(src)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode != 0, (
+            "clang accepted an unguarded access to a GUARDED_BY field"
+        )
+
+
+# --- lock-order checker (the RABIA_NATIVE_DEBUG flavor) ---------------------
+
+
+class TestLockOrder:
+    def _gxx(self):
+        if shutil.which("g++") is None:
+            pytest.skip("no g++")
+        return "g++"
+
+    def test_inversion_aborts_with_both_names(self, tmp_path):
+        gxx = self._gxx()
+        src = tmp_path / "invert.cpp"
+        src.write_text(
+            '#include <cstdio>\n#include "annotations.h"\n'
+            "int main() {\n"
+            "  rabia::Mutex a{\"probe.a\"}, b{\"probe.b\"};\n"
+            "  { rabia::MutexLock la(a); rabia::MutexLock lb(b); }\n"
+            "  { rabia::MutexLock lb(b); rabia::MutexLock la(a); }\n"
+            '  std::printf("not reached\\n");\n'
+            "  return 0;\n}\n"
+        )
+        exe = tmp_path / "invert"
+        build = subprocess.run(
+            [gxx, "-std=c++17", "-O1", "-pthread",
+             "-DRABIA_NATIVE_DEBUG=1",
+             f"-I{REPO / 'rabia_tpu' / 'native'}", str(src),
+             "-o", str(exe)],
+            capture_output=True, text=True, timeout=180,
+        )
+        assert build.returncode == 0, build.stderr[-1500:]
+        proc = subprocess.run(
+            [str(exe)], capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode != 0
+        assert "order inversion" in proc.stderr
+        assert "probe.a" in proc.stderr and "probe.b" in proc.stderr
+
+    def test_three_lock_cycle_aborts(self, tmp_path):
+        """A->B, B->C, C->A has no reversed PAIR to match — only the
+        digraph reachability walk catches it (the 3-thread deadlock a
+        pairwise checker misses)."""
+        gxx = self._gxx()
+        src = tmp_path / "cycle3.cpp"
+        src.write_text(
+            '#include <cstdio>\n#include "annotations.h"\n'
+            "int main() {\n"
+            "  rabia::Mutex a{\"probe.a\"}, b{\"probe.b\"}, c{\"probe.c\"};\n"
+            "  { rabia::MutexLock la(a); rabia::MutexLock lb(b); }\n"
+            "  { rabia::MutexLock lb(b); rabia::MutexLock lc(c); }\n"
+            "  { rabia::MutexLock lc(c); rabia::MutexLock la(a); }\n"
+            '  std::printf("not reached\\n");\n'
+            "  return 0;\n}\n"
+        )
+        exe = tmp_path / "cycle3"
+        build = subprocess.run(
+            [gxx, "-std=c++17", "-O1", "-pthread",
+             "-DRABIA_NATIVE_DEBUG=1",
+             f"-I{REPO / 'rabia_tpu' / 'native'}", str(src),
+             "-o", str(exe)],
+            capture_output=True, text=True, timeout=180,
+        )
+        assert build.returncode == 0, build.stderr[-1500:]
+        proc = subprocess.run(
+            [str(exe)], capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode != 0
+        assert "order inversion" in proc.stderr
+
+    def test_double_lock_aborts(self, tmp_path):
+        gxx = self._gxx()
+        src = tmp_path / "dbl.cpp"
+        src.write_text(
+            '#include "annotations.h"\n'
+            "int main() {\n"
+            "  rabia::Mutex a{\"probe.dbl\"};\n"
+            "  a.lock();\n"
+            "  a.lock();  // same thread, non-recursive: must abort,\n"
+            "             // not deadlock inside pthread_mutex_lock\n"
+            "  return 0;\n}\n"
+        )
+        exe = tmp_path / "dbl"
+        build = subprocess.run(
+            [gxx, "-std=c++17", "-O1", "-pthread",
+             "-DRABIA_NATIVE_DEBUG=1",
+             f"-I{REPO / 'rabia_tpu' / 'native'}", str(src),
+             "-o", str(exe)],
+            capture_output=True, text=True, timeout=180,
+        )
+        assert build.returncode == 0, build.stderr[-1500:]
+        proc = subprocess.run(
+            [str(exe)], capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode != 0
+        assert "double lock" in proc.stderr
+
+    def test_kernel_lock_paths_clean_under_debug_flavor(self, tmp_path):
+        """The WAL stress (the deepest lock nest: append lane + flush
+        thread + sync waiters) runs clean under the checker."""
+        gxx = self._gxx()
+        native = REPO / "rabia_tpu" / "native"
+        exe = tmp_path / "dbg_wal"
+        build = subprocess.run(
+            [gxx, "-std=c++17", "-O1", "-pthread",
+             "-DRABIA_NATIVE_DEBUG=1", f"-I{native}",
+             str(native / "stress" / "stress_wal.cpp"),
+             str(native / "walkernel.cpp"), "-o", str(exe), "-lz"],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert build.returncode == 0, build.stderr[-1500:]
+        wal_dir = tmp_path / "wal"
+        wal_dir.mkdir()
+        proc = subprocess.run(
+            [str(exe), str(wal_dir)], capture_output=True, text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
