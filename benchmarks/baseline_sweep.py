@@ -74,6 +74,18 @@ def _note_tick_path(engines) -> None:
             )
             else "python"
         ),
+        # thread-per-shard-group runtime: worker (= shard group) count
+        # actually running (1 on the asyncio path) — every sweep line
+        # records the geometry it measured
+        "runtime_workers": (
+            max(
+                getattr(e._rtm, "workers", 1)
+                for e in engines
+                if e._rtm is not None
+            )
+            if any(e._rtm is not None for e in engines)
+            else 1
+        ),
     }
 
 

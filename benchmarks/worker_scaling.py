@@ -1,0 +1,188 @@
+"""Thread-per-shard-group worker-scaling curve (round 14).
+
+Runs the native runtime's home configuration (config-6 geometry:
+kvstore block lane, 5 replicas, 4096 shards, native TCP loopback) at
+worker counts N ∈ {1, 2, 4, 8} in ONE process session — same-session
+pairs, every sample recorded — and writes the curve to
+benchmarks/results.json as ``engine_sweep_r14``. Each point records
+dec/s, settle p50/p99, the per-worker RTM counter blocks, and the
+stage-profiler breakdown, so the scaling (or its absence on a small
+host) is attributable, not asserted.
+
+Run: python benchmarks/worker_scaling.py [--workers 1,2,4,8]
+     [--dur 8.0] [--repeats 1] [--no-record]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+RESULTS = Path(__file__).resolve().parent / "results.json"
+
+
+def _median(vals):
+    s = sorted(vals)
+    return s[len(s) // 2]
+
+
+async def _measure_point(workers: int, dur: float) -> dict:
+    """One config-6-geometry measurement at `workers` shard groups."""
+    from benchmarks.baseline_sweep import (
+        _block_pump,
+        _cfg,
+        _committed,
+        _lat_stats,
+        _note_tick_path,
+        _stop,
+    )
+    from rabia_tpu.apps import make_sharded_kv
+    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.core.config import TcpNetworkConfig
+    from rabia_tpu.core.network import ClusterConfig
+    from rabia_tpu.core.types import NodeId
+    from rabia_tpu.engine import RabiaEngine
+    from rabia_tpu.net.tcp import TcpNetwork
+    from dataclasses import replace
+
+    S, R = 4096, 5
+    ids = [NodeId.from_int(i + 1) for i in range(R)]
+    nets = [TcpNetwork(i, TcpNetworkConfig(bind_port=0)) for i in ids]
+    for i in range(R):
+        for j in range(R):
+            if i != j:
+                nets[i].add_peer(ids[j], "127.0.0.1", nets[j].port)
+    cfg = replace(_cfg(S), runtime_workers=workers)
+    engines, tasks = [], []
+    for i, n in enumerate(ids):
+        engines.append(
+            RabiaEngine(
+                ClusterConfig.new(n, ids),
+                make_sharded_kv(S)[0],
+                nets[i],
+                config=cfg,
+            )
+        )
+        tasks.append(asyncio.ensure_future(engines[-1].run()))
+    _note_tick_path(engines)
+    for _ in range(500):
+        await asyncio.sleep(0.01)
+        sts = [await e.get_statistics() for e in engines]
+        if all(s.has_quorum for s in sts):
+            break
+    one_op = [[encode_set_bin(f"k{s}", "v")] for s in range(S)]
+    lat: list[float] = []
+    t0 = time.perf_counter()
+    base, _ = await _committed(engines)
+    await _block_pump(engines, S, R, dur, lambda s: one_op[s], lat=lat)
+    top, _ = await _committed(engines)
+    dt = time.perf_counter() - t0
+    e0 = engines[0]
+    rtm = e0._rtm
+    doc = {
+        "workers_requested": workers,
+        "workers_active": getattr(rtm, "workers", 0) if rtm else 0,
+        "runtime_plane": "native" if rtm is not None else "python",
+        "decisions_per_sec": round((top - base) / dt, 1),
+        **_lat_stats(lat),
+    }
+    if rtm is not None:
+        keep = (
+            "loops", "waves_native", "waves_py", "slots_applied",
+            "gil_handoffs", "frames_native", "frames_escalated",
+            "ev_stalls", "wakes_idle",
+        )
+        doc["runtime_counters"] = {
+            k: v for k, v in rtm.counters_dict().items() if k in keep
+        }
+        doc["per_worker"] = [
+            {
+                k: v
+                for k, v in rtm.counters_dict_worker(g).items()
+                if k in ("loops", "waves_native", "slots_applied",
+                         "frames_native")
+            }
+            for g in range(rtm.workers)
+        ]
+        # stage profiler: per-worker wall attribution (the >=95%
+        # acceptance check reads this)
+        doc["stages_s"] = {
+            k: round(v * 1e-9, 3) for k, v in rtm.stages_dict().items()
+        }
+        doc["stages_per_worker_s"] = [
+            {
+                k: round(v * 1e-9, 3)
+                for k, v in rtm.stages_dict_worker(g).items()
+            }
+            for g in range(rtm.workers)
+        ]
+        doc["wall_s"] = round(dt, 3)
+    await _stop(engines, tasks, nets)
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workers", default="1,2,4,8")
+    ap.add_argument("--dur", type=float, default=8.0)
+    ap.add_argument("--repeats", type=int, default=1)
+    ap.add_argument("--no-record", action="store_true")
+    ap.add_argument("--key", default="engine_sweep_r14")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import logging
+
+    logging.disable(logging.WARNING)
+
+    ns = [int(x) for x in args.workers.split(",") if x.strip()]
+    points = []
+    for n in ns:
+        samples = []
+        for r in range(max(1, args.repeats)):
+            os.environ["RABIA_RT_WORKERS"] = str(n)
+            try:
+                doc = asyncio.run(_measure_point(n, args.dur))
+            finally:
+                os.environ.pop("RABIA_RT_WORKERS", None)
+            samples.append(doc)
+            print(json.dumps(doc))
+        best = _median([s["decisions_per_sec"] for s in samples])
+        agg = dict(next(
+            s for s in samples if s["decisions_per_sec"] == best
+        ))
+        if args.repeats > 1:
+            agg["samples_dec_s"] = sorted(
+                s["decisions_per_sec"] for s in samples
+            )
+        points.append(agg)
+
+    curve = {
+        "config": "6:kvstore_5rep_4096shards_tcp_runtime",
+        "host_cores": os.cpu_count(),
+        "note": (
+            "thread-per-shard-group worker scaling; same-session "
+            "points, every sample recorded"
+        ),
+        "points": points,
+    }
+    print(json.dumps({"curve": curve}, indent=1))
+    if not args.no_record:
+        data = json.loads(RESULTS.read_text()) if RESULTS.exists() else {}
+        data[args.key] = curve
+        RESULTS.write_text(json.dumps(data, indent=1) + "\n")
+        print(f"recorded -> {RESULTS}:{args.key}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

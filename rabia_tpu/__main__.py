@@ -274,6 +274,54 @@ def _profile(addr: str, seconds: float, timeout: float) -> int:
               f"{stage_of(m1, s):>17.3f}")
     cov = total / elapsed * 100 if elapsed > 0 else 0.0
     print(f"{'-- sum':<16}{total:>12.4f}{cov:>8.1f}%  of wall between scrapes")
+
+    # thread-per-shard-group runtime: per-worker breakdown next to the
+    # aggregate (the worker-labeled series exist only with workers > 1)
+    import re as _re
+
+    workers = sorted(
+        {
+            m.group(1)
+            for k in m1
+            for m in [
+                _re.match(
+                    r'rabia_runtime_stage_seconds\{stage="[^"]+",'
+                    r'worker="(\d+)"\}', k
+                )
+            ]
+            if m
+        },
+        key=int,
+    )
+    if workers:
+        def wstage(m: dict, g: str, stage: str) -> float:
+            return m.get(
+                f'rabia_runtime_stage_seconds{{stage="{stage}",'
+                f'worker="{g}"}}', 0.0
+            )
+
+        print(f"\nper-worker breakdown ({len(workers)} shard groups):")
+        hdr = f"{'stage':<16}" + "".join(
+            f"{'w' + g + ' (s)':>12}" for g in workers
+        )
+        print(hdr)
+        wtot = {g: 0.0 for g in workers}
+        for s in RUNTIME_STAGES:
+            row = f"{s:<16}"
+            for g in workers:
+                d = wstage(m1, g, s) - wstage(m0, g, s)
+                wtot[g] += d
+                row += f"{d:>12.4f}"
+            print(row)
+        row = f"{'-- sum':<16}"
+        for g in workers:
+            row += f"{wtot[g]:>12.4f}"
+        print(row)
+        row = f"{'-- coverage':<16}"
+        for g in workers:
+            c = wtot[g] / elapsed * 100 if elapsed > 0 else 0.0
+            row += f"{c:>11.1f}%"
+        print(row + "  of wall per worker")
     return 0
 
 

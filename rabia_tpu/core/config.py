@@ -222,6 +222,14 @@ class RabiaConfig:
     # ring) and snapshot sync. Keep True for sparse/lossy deployments where
     # proactive decision propagation shortens catch-up.
     decision_broadcast: bool = True
+    # thread-per-shard-group native runtime: number of C worker threads,
+    # each owning a contiguous shard group end-to-end (ingest → tick →
+    # apply → result staging). None = auto: min(shards, max(1, cores-1))
+    # — one core is left for the Python control plane; on hosts with
+    # <= 2 cores auto resolves to 1 (the single-thread runtime, which is
+    # byte-for-byte the historical behavior). The RABIA_RT_WORKERS env
+    # var overrides this knob; workers cap at min(64, num_shards).
+    runtime_workers: Optional[int] = None
     tcp: TcpNetworkConfig = TcpNetworkConfig()
     batching: BatchConfig = BatchConfig()
     validation: ValidationConfig = ValidationConfig()

@@ -696,7 +696,10 @@ class RabiaEngine:
             if rk is not None:
                 h = np.asarray(rk.phase_hist, np.int64)
                 hist[: len(h)] += h
-                ssum += rk.counter("phase_sum")
+                for sib in getattr(rk, "siblings", ()):
+                    sh = np.asarray(sib.phase_hist, np.int64)
+                    hist[: len(sh)] += sh
+                ssum += rk.counter("phase_sum")  # sums siblings itself
             kern = getattr(self, "kernel", None)
             kh = getattr(kern, "phase_hist", None)
             if kh is not None:
@@ -911,6 +914,25 @@ class RabiaEngine:
                 {"stage": sname},
                 fn=lambda s=sname: self.stage_second(s),
             )
+        # thread-per-shard-group runtime: per-worker stage series with a
+        # `worker` label next to the aggregate above (single-worker and
+        # asyncio runs keep the historical label set untouched)
+        rtm0 = self._rtm
+        if rtm0 is not None and getattr(rtm0, "workers", 1) > 1:
+            for g in range(rtm0.workers):
+                for sname in RUNTIME_STAGES:
+                    m.counter(
+                        "runtime_stage_seconds",
+                        "Per-worker commit-path loop time by stage "
+                        "(thread-per-shard-group runtime)",
+                        {"stage": sname, "worker": str(g)},
+                        fn=lambda s=sname, gg=g: (
+                            self._rtm.stage_ns_worker(gg, s) * 1e-9
+                            if self._rtm is not None
+                            and gg < getattr(self._rtm, "workers", 1)
+                            else 0.0
+                        ),
+                    )
         # -- durability plane (walkernel WLC counter block / Python twin
         #    tallies — persistence/native_wal.py), when the persistence
         #    layer is a WAL --------------------------------------------
@@ -1006,6 +1028,13 @@ class RabiaEngine:
                     if getattr(self.sm, "_native_plane", None) is not None
                     else "python"
                 ),
+                # thread-per-shard-group worker count (1 = the
+                # single-thread runtime or the asyncio orchestration)
+                "runtime_workers": (
+                    getattr(self._rtm, "workers", 1)
+                    if self._rtm is not None
+                    else 1
+                ),
             },
             "decided_frontier": self.decided_frontier().tolist(),
             "applied_frontier": self.applied_frontier().tolist(),
@@ -1029,6 +1058,9 @@ class RabiaEngine:
         evs = self.flight.snapshot()
         if self._rk is not None:
             evs.extend(native_ring_events(self._rk.flight_snapshot()))
+            # sibling worker contexts (thread-per-shard-group runtime)
+            for sib in getattr(self._rk, "siblings", ()):
+                evs.extend(native_ring_events(sib.flight_snapshot()))
         # native runtime ring: thread wakeups + mailbox handoffs
         # (FRE_RT_WAKE / FRE_RT_HANDOFF), so timelines stay complete when
         # the asyncio loop is off the commit path

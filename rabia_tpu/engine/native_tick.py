@@ -195,20 +195,34 @@ class NativeTick:
         else:  # stale prebuilt hostkernel: an empty ring
             self.flight_version = 0
             self._fr_view = np.zeros(0, FR_DTYPE)
+        # sibling worker contexts (thread-per-shard-group runtime): the
+        # bridge creates one extra NativeTick per additional worker and
+        # registers them here so counter()/phase-hist scrapes cover the
+        # whole shard space (each sibling only ever ticks its own range)
+        self.siblings: list["NativeTick"] = []
 
     def counter(self, name: str) -> int:
-        """One named counter from the block (0 for unknown/short blocks)."""
+        """One named counter from the block, summed over this context and
+        any sibling worker contexts (0 for unknown/short blocks)."""
         try:
             i = RK_COUNTER_NAMES.index(name)
         except ValueError:
             return 0
-        return int(self.counters[i]) if i < len(self.counters) else 0
+        total = int(self.counters[i]) if i < len(self.counters) else 0
+        for sib in self.siblings:
+            if i < len(sib.counters):
+                total += int(sib.counters[i])
+        return total
 
     def counters_dict(self) -> dict[str, int]:
-        return {
-            n: int(self.counters[i]) if i < len(self.counters) else 0
-            for i, n in enumerate(RK_COUNTER_NAMES)
-        }
+        return {n: self.counter(n) for n in RK_COUNTER_NAMES}
+
+    def set_range(self, lo: int, hi: int, salt: int = 0) -> None:
+        """Restrict this context to shard-group range [lo, hi) with a
+        message-id salt (thread-per-shard-group runtime). Call only
+        while no thread is inside the context."""
+        if self.ctx is not None and hasattr(self.lib, "rk_set_range"):
+            self.lib.rk_set_range(self.ctx, lo, hi, salt)
 
     def flight_head(self) -> int:
         """Total flight records ever written by the C ring."""

@@ -123,7 +123,34 @@ _FN_ORDER = (
     "wal_append",
     "wal_barrier_covered",
     "wal_durable",
+    # thread-per-shard-group additions (null with a single worker)
+    "rt_recv_borrow_group",
+    "sk_apply_wave_lane",
+    "sk_out_buf_lane",
+    "sk_out_offs_lane",
 )
+
+
+def resolve_runtime_workers(engine) -> int:
+    """Worker (= shard group) count for the thread-per-shard-group
+    runtime. ``RABIA_RT_WORKERS`` overrides
+    ``RabiaConfig.runtime_workers``; auto (unset/None) is
+    ``min(shards, max(1, cores - 1))`` — one core stays with the Python
+    control plane, and hosts with <= 2 cores run the historical
+    single-thread runtime. Capped at 64 groups (the classifier's
+    bitmask width) and at the shard count."""
+    env = os.environ.get("RABIA_RT_WORKERS")
+    w = None
+    if env:
+        try:
+            w = int(env)
+        except ValueError:
+            w = None
+    if w is None:
+        w = getattr(engine.config, "runtime_workers", None)
+    if w is None:
+        w = max(1, (os.cpu_count() or 1) - 1)
+    return max(1, min(int(w), 64, engine.n_shards))
 
 
 def runtime_available(engine) -> bool:
@@ -175,6 +202,46 @@ class RuntimeBridge:
         self.native_apply = sk_plane is not None
         self._sk_plane = sk_plane
 
+        # thread-per-shard-group geometry: W worker threads, each owning
+        # a contiguous chunk of the shard space end-to-end. W=1 is the
+        # historical single-thread runtime, byte for byte. Multi-worker
+        # needs the per-group transport inbox, the per-lane statekernel
+        # apply, and the rk range ABI — stale prebuilt libraries without
+        # them fall back to one worker.
+        self.workers = resolve_runtime_workers(e)
+        if self.workers > 1 and (
+            not hasattr(t._lib, "rt_recv_borrow_group")
+            or not hasattr(e._hk_lib, "rk_set_range")
+            or not hasattr(lib, "rtm_workers")
+            or (
+                self.native_apply
+                and not hasattr(sk_plane.lib, "sk_apply_wave_lane")
+            )
+        ):
+            logger.warning(
+                "runtime_workers=%d requested but the native ABI predates "
+                "shard groups; running single-worker", self.workers,
+            )
+            self.workers = 1
+        self._chunk = (e.n_shards + self.workers - 1) // self.workers
+        self._extra_rks: list = []
+        if self.workers > 1:
+            from rabia_tpu.engine.native_tick import NativeTick
+
+            for _g in range(1, self.workers):
+                self._extra_rks.append(NativeTick(e, e._hk_lib))
+            rk.set_range(0, min(self._chunk, e.n_shards), 0)
+            for g, xrk in enumerate(self._extra_rks, start=1):
+                lo = g * self._chunk
+                hi = (
+                    e.n_shards
+                    if g == self.workers - 1
+                    else min((g + 1) * self._chunk, e.n_shards)
+                )
+                xrk.set_range(lo, hi, g)
+            # scrapes of the primary context sum the whole shard space
+            rk.siblings = self._extra_rks
+
         # function-pointer table: transport + hostkernel (+ statekernel)
         fn_libs = {
             "rt_recv_borrow": t._lib,
@@ -186,6 +253,8 @@ class RuntimeBridge:
             "rk_retransmit": e._hk_lib,
             "rk_drain_stale": e._hk_lib,
         }
+        if self.workers > 1:
+            fn_libs["rt_recv_borrow_group"] = t._lib
         if self.native_apply:
             fn_libs.update(
                 sk_apply_wave=sk_plane.lib,
@@ -194,6 +263,12 @@ class RuntimeBridge:
                 sk_plane_lock=sk_plane.lib,
                 sk_plane_unlock=sk_plane.lib,
             )
+            if self.workers > 1:
+                fn_libs.update(
+                    sk_apply_wave_lane=sk_plane.lib,
+                    sk_out_buf_lane=sk_plane.lib,
+                    sk_out_offs_lane=sk_plane.lib,
+                )
         # durability plane: the C writer's append/barrier/watermark entry
         # points, so the io/tick thread stages decided waves and gates
         # opens on the vote barrier without ever touching Python
@@ -227,6 +302,7 @@ class RuntimeBridge:
                 int(os.environ.get("RABIA_RTM_EV_RING", 20 << 20)),
                 v.max_commands_per_batch,
                 v.max_command_size,
+                self.workers,
             ],
             np.int64,
         )
@@ -250,7 +326,10 @@ class RuntimeBridge:
                 kst.done.ctypes.data,
                 rk.newly.ctypes.data,
                 wal_handle,
-            ],
+            ]
+            # per-worker rk tick contexts (workers 1..W-1; worker 0 is
+            # the engine's primary context at ptrs[0])
+            + [int(xrk.ctx) for xrk in self._extra_rks],
             np.int64,
         )
         uuid_tbl = np.frombuffer(
@@ -275,6 +354,11 @@ class RuntimeBridge:
         )
         if not self.ctx:
             raise RuntimeError("rtm_create failed")
+        if hasattr(lib, "rtm_workers"):
+            self.workers = int(lib.rtm_workers(self.ctx))  # C-side clamp
+        if self.workers > 1 and self.native_apply:
+            # per-worker statekernel apply lanes + group store locking
+            sk_plane.lib.sk_set_groups(sk_plane.handle, self.workers)
         self._started = False
         self._stopped = False
         self._grace = grace
@@ -294,8 +378,11 @@ class RuntimeBridge:
         self._applied = rt.applied_upto[: e.n_shards].copy()
         # scalar command in flight per shard: slot or -1
         self._cmd_slot = np.full(e.n_shards, -1, np.int64)
-        # block-token registry: token -> (ref, block)
+        # block-token registry: token -> ref, with a ref -> tokens
+        # reverse index (a group-split wave holds one token per shard
+        # group; retirement drops them all in O(tokens-per-ref))
         self._tokens: dict[int, int] = {}
+        self._ref_tokens: dict[int, list[int]] = {}
         self._next_token = 1
         # votes-waiting grace clocks (the _open_slots V0 path's shadow)
         self._votes_wait: dict[int, float] = {}
@@ -307,39 +394,59 @@ class RuntimeBridge:
         self._kick_pending = False
         self._event_fd = int(lib.rtm_event_fd(self.ctx))
 
-        # observability: zero-copy counter + flight views
-        n_ctr = int(lib.rtm_counters_count())
-        self.counters_version = int(lib.rtm_counters_version())
-        cbuf = (ctypes.c_uint64 * n_ctr).from_address(lib.rtm_counters(self.ctx))
-        self.counters = np.frombuffer(cbuf, np.uint64)
+        # observability: zero-copy per-worker counter/stage/hist/flight
+        # views (RTM_*/RTS_*/RTH_* geometry per worker; scrapes sum, the
+        # profile CLI renders per worker). Worker 0's blocks stay exposed
+        # under the historical attribute names.
         from rabia_tpu.obs.flight import FR_DTYPE
 
-        cap = int(lib.rtm_flight_cap())
-        fbuf = (ctypes.c_uint8 * (cap * FR_DTYPE.itemsize)).from_address(
-            lib.rtm_flight(self.ctx)
-        )
-        self._fr_view = np.frombuffer(fbuf, FR_DTYPE)
-        self._fr_frozen: Optional[np.ndarray] = None
-
-        # stage profiler block (cumulative ns per loop stage, RTS_* order)
+        n_ctr = int(lib.rtm_counters_count())
+        self.counters_version = int(lib.rtm_counters_version())
         n_stg = int(lib.rtm_stages_count())
         self.stages_version = int(lib.rtm_stages_version())
-        sbuf = (ctypes.c_uint64 * n_stg).from_address(
-            lib.rtm_stages(self.ctx)
-        )
-        self.stages = np.frombuffer(sbuf, np.uint64)
-        # SLO histogram block: rows of [buckets..., count, sum_ns]
         self.hist_version = int(lib.rtm_hist_version())
         self._hist_buckets = int(lib.rtm_hist_buckets())
         self._hist_sub_bits = int(lib.rtm_hist_sub_bits())
         self._hist_min_exp = int(lib.rtm_hist_min_exp())
         n_hs = int(lib.rtm_hist_stages())
-        hbuf = (
-            ctypes.c_uint64 * (n_hs * (self._hist_buckets + 2))
-        ).from_address(lib.rtm_hist(self.ctx))
-        self.hist = np.frombuffer(hbuf, np.uint64).reshape(
-            n_hs, self._hist_buckets + 2
-        )
+        cap = int(lib.rtm_flight_cap())
+        has_w = hasattr(lib, "rtm_counters_w")
+
+        def _u64_view(addr, count):
+            buf = (ctypes.c_uint64 * count).from_address(addr)
+            return np.frombuffer(buf, np.uint64)
+
+        self._w_counters: list[np.ndarray] = []
+        self._w_stages: list[np.ndarray] = []
+        self._w_hists: list[np.ndarray] = []
+        self._w_fr_views: list[np.ndarray] = []
+        for g in range(self.workers):
+            if g == 0 or not has_w:
+                c_addr = lib.rtm_counters(self.ctx)
+                s_addr = lib.rtm_stages(self.ctx)
+                h_addr = lib.rtm_hist(self.ctx)
+                f_addr = lib.rtm_flight(self.ctx)
+            else:
+                c_addr = lib.rtm_counters_w(self.ctx, g)
+                s_addr = lib.rtm_stages_w(self.ctx, g)
+                h_addr = lib.rtm_hist_w(self.ctx, g)
+                f_addr = lib.rtm_flight_w(self.ctx, g)
+            self._w_counters.append(_u64_view(c_addr, n_ctr))
+            self._w_stages.append(_u64_view(s_addr, n_stg))
+            self._w_hists.append(
+                _u64_view(h_addr, n_hs * (self._hist_buckets + 2)).reshape(
+                    n_hs, self._hist_buckets + 2
+                )
+            )
+            fbuf = (
+                ctypes.c_uint8 * (cap * FR_DTYPE.itemsize)
+            ).from_address(f_addr)
+            self._w_fr_views.append(np.frombuffer(fbuf, FR_DTYPE))
+        self.counters = self._w_counters[0]
+        self.stages = self._w_stages[0]
+        self.hist = self._w_hists[0]
+        self._fr_view = self._w_fr_views[0]
+        self._fr_frozen: Optional[np.ndarray] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -358,20 +465,37 @@ class RuntimeBridge:
         thread."""
         e = self.engine
         e.transport.detach_reader()
+        if self.workers > 1:
+            # install per-group frame routing BEFORE draining leftovers:
+            # the legacy inbox stops growing (new frames land in group
+            # inboxes for the workers), so the drain below sees a finite
+            # backlog and nothing arrives worker-invisible in between
+            t = e.transport
+            classify = ctypes.cast(
+                self.lib.rtm_frame_group_mask, ctypes.c_void_p
+            ).value
+            t._lib.rt_set_groups(t._handle, self.workers, classify, self.ctx)
         # leftovers the Python reader pulled before detaching go through
         # the native ingest while the arrays are still Python-owned; the
         # runtime's first iteration ticks unconditionally to pick them up
+        all_rks = [e._rk, *self._extra_rks]
         item = e.transport.receive_raw_nowait()
         while item is not None:
             sender, data, addr, ln, release = item
             row = e._node_to_row.get(sender)
             try:
                 if row is not None:
-                    rc = (
-                        e._rk.ingest_addr(addr, ln, row, time.time())
-                        if addr
-                        else e._rk.ingest(data, row, time.time())
-                    )
+                    # every worker context ingests (each range-filters);
+                    # the frame escalates to Python when ANY declines
+                    rcs = [
+                        (
+                            xrk.ingest_addr(addr, ln, row, time.time())
+                            if addr
+                            else xrk.ingest(data, row, time.time())
+                        )
+                        for xrk in all_rks
+                    ]
+                    rc = 0 if any(r == 0 for r in rcs) else rcs[0]
                     if rc == 0:
                         if data is None:
                             data = ctypes.string_at(addr, ln)
@@ -418,19 +542,46 @@ class RuntimeBridge:
         await asyncio.get_running_loop().run_in_executor(
             None, self.lib.rtm_stop, self.ctx
         )
-        # drain every event the thread staged before exiting (mid-wave
+        # drain every event the workers staged before exiting (mid-wave
         # shutdown must not lose staged result frames)
         while self.drain_events():
             pass
+        if self.workers > 1:
+            # clear per-group routing (undelivered group frames merge
+            # back into the legacy inbox) and restore the primary rk
+            # context to the full shard range for any post-stop use
+            try:
+                t = self.engine.transport
+                if t._handle:
+                    t._lib.rt_set_groups(t._handle, 0, None, None)
+            except Exception:
+                logger.exception("rt_set_groups clear failed")
+            self.engine._rk.set_range(0, self.engine.n_shards, 0)
 
     def close(self) -> None:
         if self.ctx:
-            self.counters = self.counters.copy()
-            self.stages = self.stages.copy()
-            self.hist = self.hist.copy()
+            if self.workers > 1:
+                # the transport's classifier holds self.ctx — clear the
+                # routing before rtm_destroy even when stop() was skipped
+                # (exception teardown), or the io thread reads freed
+                # memory on the next inbound frame
+                try:
+                    t = self.engine.transport
+                    if getattr(t, "_handle", None):
+                        t._lib.rt_set_groups(t._handle, 0, None, None)
+                except Exception:
+                    logger.exception("rt_set_groups clear failed")
+            self._w_counters = [a.copy() for a in self._w_counters]
+            self._w_stages = [a.copy() for a in self._w_stages]
+            self._w_hists = [a.copy() for a in self._w_hists]
+            self.counters = self._w_counters[0]
+            self.stages = self._w_stages[0]
+            self.hist = self._w_hists[0]
             self._fr_frozen = self.flight_snapshot()
             ctx, self.ctx = self.ctx, None
             self.lib.rtm_destroy(ctx)
+        for xrk in self._extra_rks:
+            xrk.close()
 
     # -- pause / resume (ownership hand-off) ---------------------------------
 
@@ -525,13 +676,29 @@ class RuntimeBridge:
         rec = struct.pack("<BIQBI", CMD_OPEN_SCALAR, shard, slot, init, len(frame))
         return self._push(rec + frame)
 
+    def _group_of(self, shard: int) -> int:
+        """Contiguous shard→group map (the runtime.cpp twin)."""
+        if self.workers <= 1:
+            return 0
+        return min(int(shard) // self._chunk, self.workers - 1)
+
     def advance(self, items) -> None:
-        """items: iterable of (shard, new_applied)."""
+        """items: iterable of (shard, new_applied). With multiple
+        workers the entries split into one group-pure CMD_ADVANCE per
+        owning worker (the C router dispatches a record whole)."""
         items = list(items)
-        rec = struct.pack("<BI", CMD_ADVANCE, len(items)) + b"".join(
-            struct.pack("<IQ", s, upto) for s, upto in items
-        )
-        self._push_reliable(rec)
+        if self.workers > 1:
+            by_group: dict[int, list] = {}
+            for s, upto in items:
+                by_group.setdefault(self._group_of(s), []).append((s, upto))
+            parts = list(by_group.values())
+        else:
+            parts = [items]
+        for part in parts:
+            rec = struct.pack("<BI", CMD_ADVANCE, len(part)) + b"".join(
+                struct.pack("<IQ", s, upto) for s, upto in part
+            )
+            self._push_reliable(rec)
 
     def decide(self, shard: int, slot: int, value: int) -> None:
         self._push_reliable(
@@ -748,9 +915,25 @@ class RuntimeBridge:
                 e._blk_pending_ref[sel_all] = -1
                 e._blk_pending_slot[sel_all] = -1
                 continue
-            for chunk in range(0, len(sel_all), max_entries):
-                sel = sel_all[chunk : chunk + max_entries]
-                bidx = bidx_all[chunk : chunk + max_entries]
+            if self.workers > 1:
+                # one CMD_OPEN_WAVE per shard group: each worker owns a
+                # contiguous range, and the C router dispatches a record
+                # whole — a cross-group wave becomes group-pure records
+                # (each with its own token; the registry refcount spans
+                # them, and _on_wave settles per entry as ever)
+                gsel = np.minimum(
+                    sel_all // self._chunk, self.workers - 1
+                )
+                group_parts = [
+                    (sel_all[gsel == g], bidx_all[gsel == g])
+                    for g in np.unique(gsel)
+                ]
+            else:
+                group_parts = [(sel_all, bidx_all)]
+            for sel_part, bidx_part in group_parts:
+              for chunk in range(0, len(sel_part), max_entries):
+                sel = sel_part[chunk : chunk + max_entries]
+                bidx = bidx_part[chunk : chunk + max_entries]
                 # transfer ownership pend -> token BEFORE staging (a
                 # reject event re-routes through the registry)
                 e._blk_pending_ref[sel] = -1
@@ -763,6 +946,7 @@ class RuntimeBridge:
                 token = self._next_token
                 self._next_token += 1
                 self._tokens[token] = int(ref)
+                self._ref_tokens.setdefault(int(ref), []).append(token)
                 counts = block.counts[bidx].astype(np.int64)
                 ent = np.empty(len(sel), self._CMD_ENT_DT)
                 ent["shard"] = sel
@@ -816,6 +1000,11 @@ class RuntimeBridge:
                     # command ring full: put the binding back and retry
                     # on the next pass
                     del self._tokens[token]
+                    toks = self._ref_tokens.get(int(ref))
+                    if toks is not None:
+                        toks.remove(token)
+                        if not toks:
+                            del self._ref_tokens[int(ref)]
                     e._blk_pending_ref[sel] = int(ref)
                     e._blk_pending_idx[sel] = bidx
                     e._blk_pending_slot[sel] = slots
@@ -1066,6 +1255,12 @@ class RuntimeBridge:
         [("shard", "<u4"), ("slot", "<u8"), ("bidx", "<u4"), ("flags", "u1")]
     )
 
+    def _drop_tokens_for(self, ref: int) -> None:
+        """Retire every token of a block ref (all shard groups' records)
+        once its registry entry is gone."""
+        for t in self._ref_tokens.pop(ref, ()):
+            self._tokens.pop(t, None)
+
     def _on_wave(self, rec: bytes) -> None:
         """A decided block wave. The common case — a natively applied
         peer wave — reduces to a handful of vectorized ops: the per-slot
@@ -1192,9 +1387,11 @@ class RuntimeBridge:
         if e.persistence is not None:
             e._dirty = True
         # token bookkeeping: when the block has no live entries left the
-        # registry entry is gone — drop the token mapping lazily
+        # registry entry is gone — drop EVERY token mapping for the ref
+        # (a group-split wave holds one token per shard group; only the
+        # last one's event observes the empty registry)
         if ref is not None and ref not in e._blk_registry:
-            self._tokens.pop(token, None)
+            self._drop_tokens_for(int(ref))
 
     def _on_ledger(self, rec: bytes) -> None:
         """EV_LEDGER: receiver-side batch-id ledger completeness (ROADMAP
@@ -1447,17 +1644,20 @@ class RuntimeBridge:
         ref = self._tokens.get(token)
         breg = e._blk_registry.get(ref) if ref is not None else None
         if breg is None:
-            self._tokens.pop(token, None)
+            if ref is not None:
+                self._drop_tokens_for(int(ref))
+            else:
+                self._tokens.pop(token, None)
             return
         if breg.out is not None:
             e._demote_block_entry(ref, bidx)
         else:
             e._unref_block(ref, 1)
         # mirror _on_wave's lazy token cleanup: a wave whose entries are
-        # ALL rejected never produces an EV_WAVE, so the mapping must
-        # drop here once the registry entry is gone
+        # ALL rejected never produces an EV_WAVE, so the mappings must
+        # drop here once the registry entry is gone (every group's token)
         if ref not in e._blk_registry:
-            self._tokens.pop(token, None)
+            self._drop_tokens_for(int(ref))
 
     def _on_stall(self, kind: int, s: int, arg: int) -> None:
         e = self.engine
@@ -1519,30 +1719,52 @@ class RuntimeBridge:
     # -- observability -------------------------------------------------------
 
     def counter(self, name: str) -> int:
+        """One named RTM counter summed across every worker's block."""
         try:
             i = RTM_COUNTER_NAMES.index(name)
         except ValueError:
             return 0
-        return int(self.counters[i]) if i < len(self.counters) else 0
+        return sum(
+            int(blk[i]) for blk in self._w_counters if i < len(blk)
+        )
 
     def counters_dict(self) -> dict[str, int]:
+        return {n: self.counter(n) for n in RTM_COUNTER_NAMES}
+
+    def counters_dict_worker(self, g: int) -> dict[str, int]:
+        """One worker's RTM counter block as a dict."""
+        blk = self._w_counters[g]
         return {
-            n: int(self.counters[i]) if i < len(self.counters) else 0
+            n: int(blk[i]) if i < len(blk) else 0
             for i, n in enumerate(RTM_COUNTER_NAMES)
         }
 
     def stage_ns(self, name: str) -> int:
-        """Cumulative ns the runtime thread spent in one loop stage
-        (RTS_* block; advisory read — torn values are metrics noise)."""
+        """Cumulative ns the runtime workers spent in one loop stage,
+        summed across workers (RTS_* blocks; advisory read — torn values
+        are metrics noise). With W workers the stage SUM tracks W×wall."""
         try:
             i = RTM_STAGE_NAMES.index(name)
         except ValueError:
             return 0
-        return int(self.stages[i]) if i < len(self.stages) else 0
+        return sum(int(blk[i]) for blk in self._w_stages if i < len(blk))
+
+    def stage_ns_worker(self, g: int, name: str) -> int:
+        """One worker's cumulative ns for one loop stage."""
+        try:
+            i = RTM_STAGE_NAMES.index(name)
+        except ValueError:
+            return 0
+        blk = self._w_stages[g]
+        return int(blk[i]) if i < len(blk) else 0
 
     def stages_dict(self) -> dict[str, int]:
+        return {n: self.stage_ns(n) for n in RTM_STAGE_NAMES}
+
+    def stages_dict_worker(self, g: int) -> dict[str, int]:
+        blk = self._w_stages[g]
         return {
-            n: int(self.stages[i]) if i < len(self.stages) else 0
+            n: int(blk[i]) if i < len(blk) else 0
             for i, n in enumerate(RTM_STAGE_NAMES)
         }
 
@@ -1568,7 +1790,12 @@ class RuntimeBridge:
             or i >= len(self.hist)
         ):
             return None
-        row = self.hist[i]
+        # sum the stage row across every worker's block (identical
+        # geometry: bucket counts, total count, and sum_ns all add)
+        row = self._w_hists[0][i].astype(np.uint64).copy()
+        for blk in self._w_hists[1:]:
+            if i < len(blk):
+                row += blk[i]
         return (
             row[: self._hist_buckets],
             int(row[self._hist_buckets]),
@@ -1580,19 +1807,37 @@ class RuntimeBridge:
             return 0
         return int(self.lib.rtm_flight_head(self.ctx))
 
+    def _one_flight(self, g: int) -> np.ndarray:
+        from rabia_tpu.obs.flight import FR_DTYPE
+
+        view = self._w_fr_views[g]
+        if not self.ctx or len(view) == 0:
+            return np.zeros(0, FR_DTYPE)
+        if g == 0 or not hasattr(self.lib, "rtm_flight_head_w"):
+            head = int(self.lib.rtm_flight_head(self.ctx))
+        else:
+            head = int(self.lib.rtm_flight_head_w(self.ctx, g))
+        cap = len(view)
+        if head <= cap:
+            return view[:head].copy()
+        i = head % cap
+        return np.concatenate([view[i:], view[:i]])
+
     def flight_snapshot(self) -> np.ndarray:
         from rabia_tpu.obs.flight import FR_DTYPE
 
         if self._fr_frozen is not None:
             return self._fr_frozen
-        if not self.ctx or len(self._fr_view) == 0:
+        if not self.ctx:
             return np.zeros(0, FR_DTYPE)
-        head = self.flight_head()
-        cap = len(self._fr_view)
-        if head <= cap:
-            return self._fr_view[:head].copy()
-        i = head % cap
-        return np.concatenate([self._fr_view[i:], self._fr_view[:i]])
+        parts = [self._one_flight(g) for g in range(self.workers)]
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            return np.zeros(0, FR_DTYPE)
+        merged = np.concatenate(parts)
+        # the engine's flight merger sorts globally on t_ns; keep each
+        # worker's window intact and pre-order across workers here
+        return merged[np.argsort(merged["t_ns"], kind="stable")]
 
 
 class _LazyResults:

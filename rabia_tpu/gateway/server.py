@@ -112,6 +112,11 @@ class GatewayConfig:
     # AdminKind.TIMELINE and /timeline. 0 disables the sampler.
     telemetry_interval: float = 1.0
     telemetry_cap: int = 900
+    # thread-per-shard-group native runtime workers for engines built by
+    # GatewayCluster from this config (None = the engine default /
+    # RabiaConfig.runtime_workers / RABIA_RT_WORKERS — see
+    # docs/PERFORMANCE.md "Thread-per-shard-group runtime")
+    runtime_workers: Optional[int] = None
 
 
 @dataclass

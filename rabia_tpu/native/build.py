@@ -261,6 +261,14 @@ def load_hostkernel() -> ctypes.CDLL | None:
         lib.rk_ctx_create.argtypes = [p, p, p, p]
         lib.rk_ctx_destroy.restype = None
         lib.rk_ctx_destroy.argtypes = [p]
+        # shard-group range (thread-per-shard-group runtime)
+        lib.rk_set_range.restype = None
+        lib.rk_set_range.argtypes = [
+            p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_uint32,
+        ]
         lib.rk_rows_seen.restype = ctypes.c_uint64
         lib.rk_rows_seen.argtypes = [p]
         lib.rk_dropped.restype = ctypes.c_uint64
@@ -412,6 +420,18 @@ def load_statekernel() -> ctypes.CDLL | None:
         lib.sk_out_offs.argtypes = [p]
         lib.sk_out_count.restype = i64
         lib.sk_out_count.argtypes = [p]
+        # thread-per-shard-group apply lanes (runtime workers > 1)
+        lib.sk_set_groups.restype = ctypes.c_int32
+        lib.sk_set_groups.argtypes = [p, ctypes.c_int32]
+        lib.sk_apply_wave_lane.restype = i64
+        lib.sk_apply_wave_lane.argtypes = [
+            p, ctypes.c_int32, p, p, p, p, p, i64,
+            ctypes.c_double, ctypes.c_int32,
+        ]
+        lib.sk_out_buf_lane.restype = ctypes.c_void_p
+        lib.sk_out_buf_lane.argtypes = [p, ctypes.c_int32]
+        lib.sk_out_offs_lane.restype = ctypes.c_void_p
+        lib.sk_out_offs_lane.argtypes = [p, ctypes.c_int32]
         # incremental snapshots (durability plane)
         lib.sk_snapshot_delta_size.restype = i64
         lib.sk_snapshot_delta_size.argtypes = [p, i64]
@@ -533,6 +553,23 @@ def load_library() -> ctypes.CDLL:
         ]
         lib.rt_recv_release.restype = None
         lib.rt_recv_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        # thread-per-shard-group routing (runtime workers > 1)
+        lib.rt_set_groups.restype = ctypes.c_int
+        lib.rt_set_groups.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        lib.rt_recv_borrow_group.restype = ctypes.c_int64
+        lib.rt_recv_borrow_group.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            u8p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int,
+        ]
         lib.rt_connected.restype = ctypes.c_int
         lib.rt_connected.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int]
         lib.rt_port.restype = ctypes.c_uint16
@@ -857,6 +894,23 @@ def load_runtime() -> ctypes.CDLL | None:
         lib.rtm_flight.argtypes = [p]
         lib.rtm_flight_head.restype = ctypes.c_uint64
         lib.rtm_flight_head.argtypes = [p]
+        # thread-per-shard-group workers: geometry + per-worker blocks
+        lib.rtm_workers.restype = ctypes.c_int32
+        lib.rtm_workers.argtypes = [p]
+        lib.rtm_group_chunk.restype = ctypes.c_int64
+        lib.rtm_group_chunk.argtypes = [p]
+        lib.rtm_frame_group_mask.restype = ctypes.c_uint64
+        lib.rtm_frame_group_mask.argtypes = [p, p, ctypes.c_uint32]
+        lib.rtm_counters_w.restype = ctypes.c_void_p
+        lib.rtm_counters_w.argtypes = [p, ctypes.c_int32]
+        lib.rtm_stages_w.restype = ctypes.c_void_p
+        lib.rtm_stages_w.argtypes = [p, ctypes.c_int32]
+        lib.rtm_hist_w.restype = ctypes.c_void_p
+        lib.rtm_hist_w.argtypes = [p, ctypes.c_int32]
+        lib.rtm_flight_w.restype = ctypes.c_void_p
+        lib.rtm_flight_w.argtypes = [p, ctypes.c_int32]
+        lib.rtm_flight_head_w.restype = ctypes.c_uint64
+        lib.rtm_flight_head_w.argtypes = [p, ctypes.c_int32]
         _RTM_CACHED = lib
         return lib
 
@@ -917,6 +971,16 @@ STRESS_PROGRAMS: dict[str, dict] = {
     "session": {"srcs": ["sessionkernel.cpp"], "libs": []},
     "statekernel": {"srcs": ["statekernel.cpp"], "libs": []},
     "runtime": {"srcs": ["runtime.cpp", "transport.cpp"], "libs": ["-lz"]},
+    # thread-per-shard-group seams: 2 workers vs per-group inbox
+    # routing, per-lane statekernel applies, shared WAL staging lanes,
+    # cross-worker result staging and the multi-worker pause barrier
+    "runtime_mt": {
+        "srcs": [
+            "runtime.cpp", "transport.cpp", "statekernel.cpp",
+            "walkernel.cpp",
+        ],
+        "libs": ["-lz"],
+    },
 }
 
 # deliberately-broken probes: the test suite builds these and asserts the

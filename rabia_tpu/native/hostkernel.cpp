@@ -64,9 +64,15 @@ static inline int8_t coin_bit(uint32_t seed, uint32_t shard, uint32_t slot,
 // coin_out (nullable): 2 uint64 cells accumulating common-coin flip
 // outcomes (index 0 = V0, 1 = V1) — the chaos plane's coin-behavior
 // telemetry; pure accounting, no protocol effect.
+// s_lo/s_hi bound the shard scan: the thread-per-shard-group runtime
+// gives each worker its own RkCtx over a contiguous shard range, and a
+// worker must never READ another group's state cells (TSan-visible and
+// semantically wrong — foreign ledgers live in foreign contexts). The
+// full-range wrappers pass (0, S); coin values depend only on
+// (seed, shard, slot, phase), so a range split never changes decisions.
 static void rk_node_step_impl(
     int32_t S, int32_t R, int32_t me, int32_t quorum, int32_t f1,
-    uint32_t seed, uint32_t coin_threshold,
+    uint32_t seed, uint32_t coin_threshold, int32_t s_lo, int32_t s_hi,
     const int32_t* slot,       // [S]
     int32_t* phase,            // [S] in/out
     int8_t* stage,             // [S] in/out
@@ -84,7 +90,7 @@ static void rk_node_step_impl(
     uint8_t* newly_decided,    // [S] out
     uint64_t* coin_out         // [2] or nullptr (accounting only)
 ) {
-  for (int32_t s = 0; s < S; s++) {
+  for (int32_t s = s_lo; s < s_hi; s++) {
     const int8_t st0 = stage[s];
     int8_t m2 = my_r2[s];
     uint8_t cast = 0, adv = 0, newdec = 0;
@@ -171,10 +177,10 @@ void rk_node_step(
     uint8_t* done, const uint8_t* active, const int8_t* decision_in,
     uint8_t* cast_r2, int8_t* r2_vals, uint8_t* advanced,
     uint8_t* newly_decided) {
-  rk_node_step_impl(S, R, me, quorum, f1, seed, coin_threshold, slot, phase,
-                    stage, my_r1, my_r2, led1, led2, decided, done, active,
-                    decision_in, cast_r2, r2_vals, advanced, newly_decided,
-                    nullptr);
+  rk_node_step_impl(S, R, me, quorum, f1, seed, coin_threshold, 0, S, slot,
+                    phase, stage, my_r1, my_r2, led1, led2, decided, done,
+                    active, decision_in, cast_r2, r2_vals, advanced,
+                    newly_decided, nullptr);
 }
 
 // rk_node_step + coin accounting (coin_out: 2 uint64 cells, V0/V1).
@@ -186,10 +192,10 @@ void rk_node_step_ex(
     uint8_t* done, const uint8_t* active, const int8_t* decision_in,
     uint8_t* cast_r2, int8_t* r2_vals, uint8_t* advanced,
     uint8_t* newly_decided, uint64_t* coin_out) {
-  rk_node_step_impl(S, R, me, quorum, f1, seed, coin_threshold, slot, phase,
-                    stage, my_r1, my_r2, led1, led2, decided, done, active,
-                    decision_in, cast_r2, r2_vals, advanced, newly_decided,
-                    coin_out);
+  rk_node_step_impl(S, R, me, quorum, f1, seed, coin_threshold, 0, S, slot,
+                    phase, stage, my_r1, my_r2, led1, led2, decided, done,
+                    active, decision_in, cast_r2, r2_vals, advanced,
+                    newly_decided, coin_out);
 }
 
 // start_slots: (re)arm masked shards for a new decision slot.
@@ -465,6 +471,15 @@ struct RkCtx {
   int8_t* dec_plane;   // adopted-decision inbox [S]
   uint8_t* newly_acc;  // newly-decided accumulator [S] (engine reads+clears)
 
+  // shard-group range [g_lo, g_hi): the thread-per-shard-group runtime
+  // partitions the shard space across per-worker contexts — this ctx
+  // ingests/ticks ONLY shards in its range and skips foreign entries
+  // (another worker's ctx owns them). Default [0, n) = today's single
+  // full-range context, byte-for-byte. id_salt keeps message ids unique
+  // across sibling contexts sharing (seed, me); it never feeds the coin.
+  int32_t g_lo, g_hi;
+  uint32_t id_salt;
+
   // identity: row -> 16B node uuid (spoof check + outbound sender field)
   std::vector<uint8_t> uuids;  // R * 16
   uint64_t rows_seen;
@@ -573,6 +588,9 @@ void* rk_ctx_create(const int64_t* dims, const int64_t* ptrs,
   c->active = (uint8_t*)ptrs[i++];
   c->dec_plane = (int8_t*)ptrs[i++];
   c->newly_acc = (uint8_t*)ptrs[i++];
+  c->g_lo = 0;
+  c->g_hi = c->n;
+  c->id_salt = 0;
   c->uuids.assign(uuids, uuids + (size_t)c->R * 16);
   c->rows_seen = 0;
   c->dropped = 0;
@@ -592,6 +610,22 @@ void* rk_ctx_create(const int64_t* dims, const int64_t* ptrs,
 }
 
 void rk_ctx_destroy(void* ctx) { delete (RkCtx*)ctx; }
+
+// Restrict this context to the shard-group range [lo, hi) (the
+// thread-per-shard-group runtime: one ctx per worker, disjoint ranges
+// over shared engine arrays). `salt` differentiates sibling contexts'
+// outbound message ids; it does NOT perturb the common coin, so a
+// range-partitioned cluster decides identically to a full-range one.
+// Call only while no thread is inside this ctx (pre-start or paused).
+void rk_set_range(void* ctx, int32_t lo, int32_t hi, uint32_t salt) {
+  RkCtx* c = (RkCtx*)ctx;
+  if (lo < 0) lo = 0;
+  if (hi > c->n) hi = c->n;
+  if (hi < lo) hi = lo;
+  c->g_lo = lo;
+  c->g_hi = hi;
+  c->id_salt = salt;
+}
 
 uint64_t rk_rows_seen(void* ctx) {
   RkCtx* c = (RkCtx*)ctx;
@@ -749,6 +783,8 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
         return RK_DROP;
       }
       if (s >= (uint32_t)c->n) return RK_PY;
+      if ((int32_t)s < c->g_lo || (int32_t)s >= c->g_hi)
+        continue;  // another shard group's entry: its worker owns it
       const int64_t slot = (int64_t)(ph >> 16);
       if (slot < c->applied[s]) continue;  // stale: dropped in pass 2
       if (c->in_flight[s] && slot == (int64_t)c->slot[s]) continue;
@@ -764,6 +800,7 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
       const uint64_t ph = rd_u64(e + 4);
       const int64_t slot = (int64_t)(ph >> 16);
       if (s >= (uint32_t)c->n || slot < c->applied[s]) continue;
+      if ((int32_t)s < c->g_lo || (int32_t)s >= c->g_hi) continue;
       if (c->in_flight[s] && slot == (int64_t)c->slot[s]) {
         c->dec_plane[s] = (int8_t)e[12];
         dec_effect = true;
@@ -802,6 +839,8 @@ int32_t rk_ingest(void* ctx, const uint8_t* data, int64_t len, int32_t row,
     const uint8_t* e = ent + (size_t)k * 13;
     const uint32_t s = rd_u32(e);
     if (s >= (uint32_t)c->n) continue;  // bounds filter (ingest parity)
+    if ((int32_t)s < c->g_lo || (int32_t)s >= c->g_hi)
+      continue;  // another shard group's vote: its worker's ctx owns it
     const uint64_t ph = rd_u64(e + 4);
     const int64_t slot = (int64_t)(ph >> 16);
     const int32_t mvc = (int32_t)(ph & 0xFFFF);
@@ -844,7 +883,8 @@ static void rk_msg_id(RkCtx* c, uint8_t* out) {
   // deterministic-unique 16 bytes: lowbias32 stream over (seed, me,
   // counter). Receivers treat message ids as opaque.
   const uint64_t ctr = ++c->msg_counter;
-  uint32_t h = mix32(c->seed ^ GOLD ^ (uint32_t)(c->me * 0x85EBCA6Bu));
+  uint32_t h = mix32(c->seed ^ c->id_salt ^ GOLD ^
+                     (uint32_t)(c->me * 0x85EBCA6Bu));
   for (int w = 0; w < 4; w++) {
     h = mix32(h ^ (uint32_t)(ctr >> (16 * (w & 1))) ^ GOLD * (w + 1));
     std::memcpy(out + 4 * w, &h, 4);
@@ -952,7 +992,7 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
                    c->led2, c->decided, c->done, c->active);
     int32_t n_open = 0;
     int32_t* idx = c->idx_scratch.data();
-    for (int32_t s = 0; s < c->n; s++) {
+    for (int32_t s = c->g_lo; s < c->g_hi; s++) {
       if (open_mask[s]) {
         idx[n_open++] = s;
         fr_rec(c, FRE_OPEN, (uint8_t)open_init[s], 0xFFFF, (uint32_t)s,
@@ -968,17 +1008,21 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
     rk_route_carry(c, 1);
     rk_route_carry(c, 2);
     rk_node_step_impl(c->S, c->R, c->me, c->quorum, c->f1, c->seed,
-                      c->coin_threshold, c->slot, c->phase, c->stage,
-                      c->my_r1, c->my_r2, c->led1, c->led2, c->decided,
-                      c->done, c->active, c->dec_plane, c->cast_r2.data(),
-                      c->r2_vals.data(), c->advanced.data(),
-                      c->newly_step.data(), &c->ctrs[RKC_COIN_V0]);
-    std::memset(c->dec_plane, ABS, c->S);
+                      c->coin_threshold, c->g_lo, c->g_hi, c->slot,
+                      c->phase, c->stage, c->my_r1, c->my_r2, c->led1,
+                      c->led2, c->decided, c->done, c->active, c->dec_plane,
+                      c->cast_r2.data(), c->r2_vals.data(),
+                      c->advanced.data(), c->newly_step.data(),
+                      &c->ctrs[RKC_COIN_V0]);
+    // dec_plane is a SHARED [S] column: clear only this group's cells
+    // (a full-plane memset would erase a sibling worker's adopted
+    // decisions mid-tick)
+    std::memset(c->dec_plane + c->g_lo, ABS, (size_t)(c->g_hi - c->g_lo));
     // outbox: per-iteration frames, masked by the engine's in-flight set
     // (engine._process_outbox parity)
     int32_t n_cast = 0, n_adv = 0, n_new = 0;
     int32_t* idx = c->idx_scratch.data();
-    for (int32_t s = 0; s < c->n; s++) {
+    for (int32_t s = c->g_lo; s < c->g_hi; s++) {
       if (!c->in_flight[s]) continue;
       if (c->cast_r2[s]) {
         idx[n_cast++] = s;
@@ -991,7 +1035,7 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
                     c->r2_vals.data(), 0);
       for (int32_t k = 0; k < n_cast; k++) c->last_progress[idx[k]] = now;
     }
-    for (int32_t s = 0; s < c->n; s++) {
+    for (int32_t s = c->g_lo; s < c->g_hi; s++) {
       if (!c->in_flight[s]) continue;
       if (c->advanced[s] && !c->done[s]) {
         idx[n_adv++] = s;
@@ -1004,7 +1048,7 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
       for (int32_t k = 0; k < n_adv; k++) c->last_progress[idx[k]] = now;
     }
     int32_t any_adv = 0;
-    for (int32_t s = 0; s < c->n; s++) {
+    for (int32_t s = c->g_lo; s < c->g_hi; s++) {
       if (!c->in_flight[s]) continue;
       if (c->advanced[s]) any_adv = 1;
       if (c->newly_step[s]) {
@@ -1027,7 +1071,7 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
   }
   c->ctrs[RKC_OUT_FRAMES] += (uint64_t)w.frames;
   int64_t done_any = 0;
-  for (int32_t s = 0; s < c->n; s++) {
+  for (int32_t s = c->g_lo; s < c->g_hi; s++) {
     if (c->done[s] && c->in_flight[s]) {
       done_any = 1;
       break;
@@ -1053,7 +1097,7 @@ void rk_retransmit(void* ctx, double now, double timeout, uint8_t* out,
   RkFrameWriter w{out, out_cap, 0, 0, 0};
   int32_t* idx = c->idx_scratch.data();
   int32_t n_stall = 0, n_r1 = 0;
-  for (int32_t s = 0; s < c->n; s++) {
+  for (int32_t s = c->g_lo; s < c->g_hi; s++) {
     if (c->in_flight[s] && now - c->last_progress[s] >= timeout) {
       n_stall++;
       if (c->my_r1[s] != ABS) idx[n_r1++] = s;
@@ -1065,13 +1109,13 @@ void rk_retransmit(void* ctx, double now, double timeout, uint8_t* out,
   }
   if (n_r1) rk_emit_frame(c, &w, MT_VOTE1, now, idx, n_r1, 13, c->my_r1, 0);
   int32_t n_r2 = 0;
-  for (int32_t s = 0; s < c->n; s++) {
+  for (int32_t s = c->g_lo; s < c->g_hi; s++) {
     if (c->in_flight[s] && now - c->last_progress[s] >= timeout &&
         c->stage[s] == R2_WAIT && c->my_r2[s] != ABS)
       idx[n_r2++] = s;
   }
   if (n_r2) rk_emit_frame(c, &w, MT_VOTE2, now, idx, n_r2, 13, c->my_r2, 0);
-  for (int32_t s = 0; s < c->n; s++) {
+  for (int32_t s = c->g_lo; s < c->g_hi; s++) {
     if (c->in_flight[s] && now - c->last_progress[s] >= timeout)
       c->last_progress[s] = now;
   }

@@ -39,6 +39,7 @@
 #include <atomic>
 #include <cstdint>
 #include <map>
+#include <memory>
 #include <thread>
 #include <vector>
 
@@ -73,6 +74,15 @@ typedef void (*fn_sk_plane_lk_t)(void*);
 typedef int64_t (*fn_wal_append_t)(void*, const uint8_t*, int64_t);
 typedef int64_t (*fn_wal_barrier_t)(void*, int64_t, int64_t);
 typedef uint64_t (*fn_wal_durable_t)(void*);
+// thread-per-shard-group additions: per-group transport inbox + per-lane
+// statekernel apply (worker g stages results into its private lane)
+typedef int64_t (*fn_recv_borrow_grp_t)(void*, int32_t, uint8_t*,
+                                        const uint8_t**, uint32_t*, int);
+typedef int64_t (*fn_sk_apply_lane_t)(void*, int32_t, const uint8_t*,
+                                      const int64_t*, const int64_t*,
+                                      const int64_t*, const int64_t*,
+                                      int64_t, double, int32_t);
+typedef void* (*fn_sk_lane_ptr_t)(void*, int32_t);
 
 enum : int32_t {
   FN_RECV_BORROW = 0,
@@ -91,6 +101,11 @@ enum : int32_t {
   FN_WAL_APPEND,
   FN_WAL_BARRIER,
   FN_WAL_DURABLE,
+  // appended (workers > 1 only; null with a single worker)
+  FN_RECV_BORROW_GROUP,
+  FN_SK_APPLY_WAVE_LANE,
+  FN_SK_OUT_BUF_LANE,
+  FN_SK_OUT_OFFS_LANE,
   FN_COUNT
 };
 
@@ -406,21 +421,75 @@ struct CBlk {
   int has_block_id = 0;
 };
 
+// One shard-group worker: a dedicated io/tick thread owning the commit
+// path for shards [lo, hi) end-to-end — its own rk tick context, frame
+// inbox (per-group transport routing), command/event SPSC rings (the
+// Python-facing rtm_cmd_push/rtm_ev_drain entry points route/merge so
+// the control plane still sees ONE ring pair), result-staging lane into
+// the shared statekernel plane, WAL staging lane into the shared
+// group-commit flush, and its own observability blocks (counters, stage
+// profiler, SLO histograms, flight ring) summed at scrape. With one
+// worker this is exactly the round-8 runtime, byte for byte.
+struct RtmWorker {
+  int32_t gid = 0;
+  int64_t lo = 0, hi = 0;  // owned shard range
+  void* rk = nullptr;      // this worker's rk tick context
+
+  std::map<int64_t, CBlk> blocks;
+  int64_t next_blk = 1;
+
+  // open scratch (S-wide planes handed to rk_tick; only [lo,hi) used)
+  std::vector<uint8_t> open_mask;
+  std::vector<int32_t> open_slots;
+  std::vector<int8_t> open_init;
+
+  // outbound tick buffer
+  std::vector<uint8_t> out;
+
+  // mailboxes (SPSC: Python thread <-> this worker)
+  ByteRing cmd, ev;
+  std::vector<uint8_t> cmd_scratch;
+
+  // stale-vote repair
+  std::vector<int64_t> st_rows, st_shards, st_slots;
+  std::vector<double> last_repair;  // per row
+  uint64_t msg_counter = 0;
+
+  std::atomic<int32_t> state{RTM_RUNNING};
+  std::thread th;
+  // start at 1: anything the control plane pre-ingested into the rk
+  // ledger before rtm_start (frames the detached Python reader had
+  // already pulled) gets its tick on the first iteration
+  int restep = 1;
+  double last_timers = 0.0;
+
+  uint64_t ctrs[RTM_COUNT];
+  uint64_t stg[RTS_COUNT];                   // stage profiler (ns)
+  uint64_t hist[RTH_STAGE_COUNT * RTH_STRIDE];  // SLO histogram block
+  std::vector<FrEvent> fr;
+  // relaxed atomic: single-writer (this worker) but read by the Python
+  // scrape path via rtm_flight_head while the loop runs (TSan stress
+  // finding, round 13)
+  std::atomic<uint64_t> fr_head{0};
+};
+
 struct RtmCtx {
   // geometry
   int32_t S, n, R, me, dec_ring;
   int32_t native_apply;  // sk plane present: decided waves apply in C
+  int32_t W = 1;         // worker (= shard group) count
+  int64_t chunk = 0;     // contiguous group width: group = s / chunk
   int64_t max_cmds, max_cmd_size;
   double max_future_skew, max_age, phase_timeout, grace;
 
   // handles + foreign entry points
-  void* rk;
   void* tr;
   void* sk;
   void* wal = nullptr;  // durability plane (walkernel.cpp), or null
   void* fns[FN_COUNT];
 
-  // engine columns (borrowed; single-writer = this thread while RUNNING)
+  // engine columns (borrowed; single-writer of shard s = the worker
+  // owning s's group, while RUNNING)
   int64_t* next_slot;
   int64_t* applied;
   uint8_t* in_flight;
@@ -438,7 +507,7 @@ struct RtmCtx {
 
   std::vector<uint8_t> uuids;  // R * 16
 
-  // per-shard runtime state
+  // per-shard runtime state (disjoint per-worker access by shard range)
   std::vector<int64_t> blk_pend_ref, blk_pend_pos, blk_pend_slot;
   std::vector<int64_t> blk_cur_ref, blk_cur_pos;
   std::vector<int64_t> sp_slot;          // pending scalar open slot (-1)
@@ -451,50 +520,22 @@ struct RtmCtx {
   // fsync covers the barrier record's LSN
   std::vector<int64_t> bar_wait;
 
-  std::map<int64_t, CBlk> blocks;
-  int64_t next_blk = 1;
-
-  // open scratch (S-wide planes handed to rk_tick)
-  std::vector<uint8_t> open_mask;
-  std::vector<int32_t> open_slots;
-  std::vector<int8_t> open_init;
-
-  // outbound tick buffer
-  std::vector<uint8_t> out;
-
-  // mailboxes + wakeups
-  ByteRing cmd, ev;
   int event_fd = -1;
-  std::vector<uint8_t> cmd_scratch;
-
-  // stale-vote repair
-  std::vector<int64_t> st_rows, st_shards, st_slots;
-  std::vector<double> last_repair;  // per row
-  uint64_t msg_counter = 0;
-
-  std::atomic<int32_t> state{RTM_RUNNING};
   std::atomic<int32_t> stop_req{0};
-  std::atomic<int32_t> pause_req{0};
-  std::thread th;
-  // start at 1: anything the control plane pre-ingested into the rk
-  // ledger before rtm_start (frames the detached Python reader had
-  // already pulled) gets its tick on the first iteration
-  int restep = 1;
-  double last_timers = 0.0;
+  std::atomic<int32_t> pause_req{0};  // pause = a barrier across workers
 
-  uint64_t ctrs[RTM_COUNT];
-  uint64_t stg[RTS_COUNT];                   // stage profiler (ns)
-  uint64_t hist[RTH_STAGE_COUNT * RTH_STRIDE];  // SLO histogram block
-  std::vector<FrEvent> fr;
-  // relaxed atomic: single-writer (io thread) but read by the Python
-  // scrape path via rtm_flight_head while the loop runs (TSan stress
-  // finding, round 13)
-  std::atomic<uint64_t> fr_head{0};
+  std::vector<std::unique_ptr<RtmWorker>> workers;
+
+  int32_t group_of(int64_t s) const {
+    if (W <= 1 || chunk <= 0) return 0;
+    int64_t g = s / chunk;
+    return (int32_t)(g >= W ? W - 1 : g);
+  }
 };
 
-static inline void rth_observe(RtmCtx* c, int32_t stage, uint64_t ns)
+static inline void rth_observe(RtmWorker* w, int32_t stage, uint64_t ns)
     RABIA_REQUIRES(rtm_io_role) {
-  uint64_t* h = c->hist + (size_t)stage * RTH_STRIDE;
+  uint64_t* h = w->hist + (size_t)stage * RTH_STRIDE;
   int32_t idx = 0;
   if (ns >= (1ull << RTH_MIN_EXP)) {
     const int32_t exp = 63 - __builtin_clzll(ns);
@@ -508,11 +549,11 @@ static inline void rth_observe(RtmCtx* c, int32_t stage, uint64_t ns)
   h[RTH_BUCKETS + 1] += ns;
 }
 
-static inline void fr_rec(RtmCtx* c, uint8_t kind, uint8_t arg, uint32_t shard,
-                          int64_t slot)
+static inline void fr_rec(RtmWorker* w, uint8_t kind, uint8_t arg,
+                          uint32_t shard, int64_t slot)
     RABIA_REQUIRES(rtm_io_role) {
-  const uint64_t head = c->fr_head.load(std::memory_order_relaxed);
-  FrEvent& e = c->fr[head & (RTM_FLIGHT_CAP - 1)];
+  const uint64_t head = w->fr_head.load(std::memory_order_relaxed);
+  FrEvent& e = w->fr[head & (RTM_FLIGHT_CAP - 1)];
   e.t_ns = mono_ns();
   e.slot = (uint64_t)slot;
   e.batch = 0;
@@ -520,26 +561,26 @@ static inline void fr_rec(RtmCtx* c, uint8_t kind, uint8_t arg, uint32_t shard,
   e.peer = 0xFFFF;
   e.kind = kind;
   e.arg = arg;
-  c->fr_head.store(head + 1, std::memory_order_relaxed);
+  w->fr_head.store(head + 1, std::memory_order_relaxed);
 }
 
 // Append one event record; spins (bounded sleeps) when the mailbox is
 // full — backpressure on the commit path, exactly like the transport's
 // bounded inbox, except nothing is dropped (Python's drain is
 // eventfd-driven, so the stall resolves in microseconds).
-static void ev_push(RtmCtx* c, const std::vector<uint8_t>& rec)
+static void ev_push(RtmCtx* c, RtmWorker* w, const std::vector<uint8_t>& rec)
     RABIA_REQUIRES(rtm_io_role) {
-  if (ByteRing::need((int64_t)rec.size()) > c->ev.cap()) {
+  if (ByteRing::need((int64_t)rec.size()) > w->ev.cap()) {
     // a record larger than the whole mailbox can never be delivered:
     // drop it (counted) instead of spinning the commit path forever.
     // The ring default is sized above the transport's 16 MiB frame cap,
     // so only pathological wave-result sections can land here; the
     // protocol's retransmit/sync machinery owns recovery.
-    c->ctrs[RTM_EV_DROPPED]++;
+    w->ctrs[RTM_EV_DROPPED]++;
     return;
   }
-  while (!c->ev.push(rec.data(), (int64_t)rec.size(), nullptr, 0)) {
-    c->ctrs[RTM_EV_STALLS]++;
+  while (!w->ev.push(rec.data(), (int64_t)rec.size(), nullptr, 0)) {
+    w->ctrs[RTM_EV_STALLS]++;
     uint64_t one = 1;
     (void)!write(c->event_fd, &one, 8);
     usleep(500);
@@ -549,12 +590,12 @@ static void ev_push(RtmCtx* c, const std::vector<uint8_t>& rec)
       // is lost — count it so the drop is visible in /metrics instead
       // of silently violating the drain-on-shutdown contract (only
       // reachable when shutdown races a full 20 MB mailbox)
-      c->ctrs[RTM_EV_DROPPED]++;
+      w->ctrs[RTM_EV_DROPPED]++;
       return;
     }
   }
-  c->ctrs[RTM_EV_RECORDS]++;
-  fr_rec(c, FRE_RT_HANDOFF, rec.empty() ? 0 : rec[0], 0, 0);
+  w->ctrs[RTM_EV_RECORDS]++;
+  fr_rec(w, FRE_RT_HANDOFF, rec.empty() ? 0 : rec[0], 0, 0);
   uint64_t one = 1;
   (void)!write(c->event_fd, &one, 8);
 }
@@ -577,9 +618,13 @@ static inline uint32_t mix32(uint32_t h) {
   return h;
 }
 
-static void rtm_msg_id(RtmCtx* c, uint8_t* out) RABIA_REQUIRES(rtm_io_role) {
-  const uint64_t ctr = ++c->msg_counter;
-  uint32_t h = mix32(0x52544D00u ^ (uint32_t)(c->me * 0x85EBCA6Bu));
+static void rtm_msg_id(RtmCtx* c, RtmWorker* w, uint8_t* out)
+    RABIA_REQUIRES(rtm_io_role) {
+  const uint64_t ctr = ++w->msg_counter;
+  // gid-salted stream so sibling workers never collide; gid 0 (and the
+  // single-worker path) reproduces the historical ids bit for bit
+  uint32_t h = mix32(0x52544D00u ^ (uint32_t)(c->me * 0x85EBCA6Bu) ^
+                     (uint32_t)(w->gid * 0x9E3779B1u));
   for (int w = 0; w < 4; w++) {
     h = mix32(h ^ (uint32_t)(ctr >> (16 * (w & 1))) ^ 0x9E3779B9u * (w + 1));
     memcpy(out + 4 * w, &h, 4);
@@ -591,8 +636,9 @@ static void rtm_msg_id(RtmCtx* c, uint8_t* out) RABIA_REQUIRES(rtm_io_role) {
 // Build a bid-free Decision frame for explicit (shard, slot, value)
 // entries (the native stale-vote repair; rk_emit_frame only frames the
 // kernel's CURRENT slots). Returns frame length.
-static int64_t build_decision_frame(RtmCtx* c, std::vector<uint8_t>& f,
-                                    double now, const int64_t* shards,
+static int64_t build_decision_frame(RtmCtx* c, RtmWorker* w,
+                                    std::vector<uint8_t>& f, double now,
+                                    const int64_t* shards,
                                     const int64_t* slots, const int8_t* vals,
                                     int32_t count)
     RABIA_REQUIRES(rtm_io_role) {
@@ -603,7 +649,7 @@ static int64_t build_decision_frame(RtmCtx* c, std::vector<uint8_t>& f,
   p[0] = 3;
   p[1] = MT_DECISION;
   p[2] = 0;
-  rtm_msg_id(c, p + 3);
+  rtm_msg_id(c, w, p + 3);
   memcpy(p + 19, c->uuids.data() + (size_t)c->me * 16, 16);
   memcpy(p + 35, &now, 8);
   memcpy(p + 43, &body_len, 4);
@@ -632,22 +678,22 @@ static int64_t build_decision_frame(RtmCtx* c, std::vector<uint8_t>& f,
 // each (shard, slot), slot >= applied, binding slot free, slot >= head.
 // Returns 1 bound-something, 0 nothing-bound (still consumed), -1 not a
 // parseable block (caller escalates), -2 drop (bad checksum/limits).
-static int parse_propose_block(RtmCtx* c, const uint8_t* data, int64_t len,
-                               int32_t row, double now)
+static int parse_propose_block(RtmCtx* c, RtmWorker* w, const uint8_t* data,
+                               int64_t len, int32_t row, double now)
     RABIA_REQUIRES(rtm_io_role) {
   if (len < 47) return -1;
   if (data[0] != 3 || data[1] != MT_PROPOSE_BLOCK) return -1;
   const uint8_t flags = data[2];
   if (flags & FLAG_COMPRESSED) return -1;
   if (memcmp(data + 19, c->uuids.data() + (size_t)row * 16, 16) != 0) {
-    c->ctrs[RTM_FRAMES_DROPPED]++;
+    w->ctrs[RTM_FRAMES_DROPPED]++;
     return -2;  // spoofed envelope
   }
   int64_t base = 35 + ((flags & FLAG_RECIPIENT) ? 16 : 0);
   if (len < base + 12) return -1;
   const double ts = rd_f64(data + base);
   if (ts > now + c->max_future_skew || ts < now - c->max_age) {
-    c->ctrs[RTM_FRAMES_DROPPED]++;
+    w->ctrs[RTM_FRAMES_DROPPED]++;
     return -2;
   }
   const uint32_t body_len = rd_u32(data + base + 8);
@@ -674,7 +720,7 @@ static int parse_propose_block(RtmCtx* c, const uint8_t* data, int64_t len,
   const uint8_t* blob = body + off;
   const uint32_t crc_wire = rd_u32(body + off + blob_len);
   if ((uint32_t)crc32(0, blob, blob_len) != crc_wire) {
-    c->ctrs[RTM_FRAMES_DROPPED]++;
+    w->ctrs[RTM_FRAMES_DROPPED]++;
     return -2;
   }
   // validator-parity limits + structural sums
@@ -693,13 +739,15 @@ static int parse_propose_block(RtmCtx* c, const uint8_t* data, int64_t len,
   }
   if (sz_sum != blob_len) return -1;
 
-  // binding pass (first binding wins; in-bounds shards only)
+  // binding pass (first binding wins; in-bounds shards of THIS worker's
+  // group only — sibling workers bind their own entries from their copy)
   std::vector<uint32_t> acc;
   acc.reserve(k);
   for (uint32_t i = 0; i < k; i++) {
     const int64_t s = (int64_t)rd_u32(sh_arr + (size_t)i * 4);
     const int64_t slot = (int64_t)rd_u64(sl_arr + (size_t)i * 8);
     if (s < 0 || s >= c->n) continue;
+    if (s < w->lo || s >= w->hi) continue;  // another group's entry
     if ((s + slot) % c->R != row) continue;  // slot_proposer parity
     if (slot < c->applied[s]) continue;
     if (c->blk_pend_ref[s] != -1 || c->blk_cur_ref[s] != -1) continue;
@@ -709,8 +757,8 @@ static int parse_propose_block(RtmCtx* c, const uint8_t* data, int64_t len,
     acc.push_back(i);
   }
   if (acc.empty()) return 0;
-  const int64_t ref = c->next_blk++;
-  CBlk& b = c->blocks[ref];
+  const int64_t ref = w->next_blk++;
+  CBlk& b = w->blocks[ref];
   b.token = 0;
   b.want = 0;
   b.has_data = 1;
@@ -742,26 +790,26 @@ static int parse_propose_block(RtmCtx* c, const uint8_t* data, int64_t len,
     c->blk_pend_pos[s] = i;
     c->blk_pend_slot[s] = b.slots[i];
   }
-  c->ctrs[RTM_FRAMES_BLOCK]++;
+  w->ctrs[RTM_FRAMES_BLOCK]++;
   return 1;
 }
 
-static void blk_unref(RtmCtx* c, int64_t ref, int64_t n)
+static void blk_unref(RtmWorker* w, int64_t ref, int64_t n)
     RABIA_REQUIRES(rtm_io_role) {
-  auto it = c->blocks.find(ref);
-  if (it == c->blocks.end()) return;
+  auto it = w->blocks.find(ref);
+  if (it == w->blocks.end()) return;
   it->second.remaining -= n;
-  if (it->second.remaining <= 0) c->blocks.erase(it);
+  if (it->second.remaining <= 0) w->blocks.erase(it);
 }
 
 // A decided slot voids any pending binding it overtook (asyncio parity:
 // _record_decision -> _void_pending_block); Python demotes/settles the
 // owner through the reject event.
-static void void_stale_pend(RtmCtx* c, int64_t s, int64_t slot)
+static void void_stale_pend(RtmCtx* c, RtmWorker* w, int64_t s, int64_t slot)
     RABIA_REQUIRES(rtm_io_role) {
   if (c->blk_pend_ref[s] != -1 && c->blk_pend_slot[s] <= slot) {
-    auto it = c->blocks.find(c->blk_pend_ref[s]);
-    if (it != c->blocks.end()) {
+    auto it = w->blocks.find(c->blk_pend_ref[s]);
+    if (it != w->blocks.end()) {
       std::vector<uint8_t> rec;
       rec.push_back(EV_REJECT);
       wr_u64(rec, it->second.token);
@@ -769,9 +817,9 @@ static void void_stale_pend(RtmCtx* c, int64_t s, int64_t slot)
       wr_u32(rec, (uint32_t)s);
       wr_u64(rec, (uint64_t)c->blk_pend_slot[s]);
       rec.push_back(2);
-      ev_push(c, rec);
+      ev_push(c, w, rec);
     }
-    blk_unref(c, c->blk_pend_ref[s], 1);
+    blk_unref(w, c->blk_pend_ref[s], 1);
     c->blk_pend_ref[s] = -1;
     c->blk_pend_slot[s] = -1;
   }
@@ -787,19 +835,20 @@ extern "C" {
 
 // --- command processing -----------------------------------------------------
 
-static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now)
+static void handle_cmd(RtmCtx* c, RtmWorker* w, const uint8_t* p,
+                       int64_t len, double now)
     RABIA_REQUIRES(rtm_io_role) {
   if (len < 1) return;
   const uint8_t type = p[0];
   const uint8_t* q = p + 1;
-  c->ctrs[RTM_CMDS]++;
+  w->ctrs[RTM_CMDS]++;
   if (type == CMD_OPEN_SCALAR) {
     if (len < 1 + 4 + 8 + 1 + 4) return;
     const int64_t s = (int64_t)rd_u32(q);
     const int64_t slot = (int64_t)rd_u64(q + 4);
     const int8_t init = (int8_t)q[12];
     const uint32_t flen = rd_u32(q + 13);
-    if (s < 0 || s >= c->n) return;
+    if (s < w->lo || s >= w->hi) return;
     if (slot < c->applied[s] || c->in_flight[s] ||
         (c->blk_pend_ref[s] != -1 && c->blk_pend_slot[s] <= slot)) {
       std::vector<uint8_t> rec;
@@ -809,7 +858,7 @@ static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now)
       wr_u32(rec, (uint32_t)s);
       wr_u64(rec, (uint64_t)slot);
       rec.push_back(1);
-      ev_push(c, rec);
+      ev_push(c, w, rec);
       return;
     }
     c->sp_slot[s] = slot;
@@ -827,8 +876,8 @@ static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now)
     const uint8_t* ops = ent + (size_t)k * 20;
     const uint8_t* announce = ops + (size_t)total * 4;
     const uint8_t* blob = announce + announce_len;
-    const int64_t ref = c->next_blk++;
-    CBlk& b = c->blocks[ref];
+    const int64_t ref = w->next_blk++;
+    CBlk& b = w->blocks[ref];
     b.token = token;
     b.want = want;
     b.has_data = blob_len > 0;
@@ -858,7 +907,7 @@ static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now)
     for (uint32_t i = 0; i < k; i++) {
       const int64_t s = b.shards[i];
       const int64_t slot = b.slots[i];
-      bool ok = s >= 0 && s < c->n && slot >= c->applied[s] &&
+      bool ok = s >= w->lo && s < w->hi && slot >= c->applied[s] &&
                 c->blk_pend_ref[s] == -1 && c->blk_cur_ref[s] == -1;
       if (ok) {
         const int64_t head =
@@ -873,7 +922,7 @@ static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now)
         wr_u32(rec, (uint32_t)s);
         wr_u64(rec, (uint64_t)slot);
         rec.push_back(1);
-        ev_push(c, rec);
+        ev_push(c, w, rec);
         continue;
       }
       c->blk_pend_ref[s] = ref;
@@ -882,7 +931,7 @@ static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now)
       b.remaining++;
     }
     if (b.remaining == 0) {
-      c->blocks.erase(ref);
+      w->blocks.erase(ref);
       return;
     }
     if (announce_len) {
@@ -902,14 +951,15 @@ static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now)
          i++) {
       const int64_t s = (int64_t)rd_u32(e + (size_t)i * 12);
       const int64_t upto = (int64_t)rd_u64(e + (size_t)i * 12 + 4);
-      if (s >= 0 && s < c->n && upto > c->applied[s]) c->applied[s] = upto;
+      if (s >= w->lo && s < w->hi && upto > c->applied[s])
+        c->applied[s] = upto;
     }
   } else if (type == CMD_DECIDE) {
     if (len < 1 + 4 + 8 + 1) return;
     const int64_t s = (int64_t)rd_u32(q);
     const int64_t slot = (int64_t)rd_u64(q + 4);
     const int8_t val = (int8_t)q[12];
-    if (s < 0 || s >= c->n || c->in_flight[s]) return;
+    if (s < w->lo || s >= w->hi || c->in_flight[s]) return;
     const int64_t head =
         c->next_slot[s] > c->applied[s] ? c->next_slot[s] : c->applied[s];
     if (slot != head) return;
@@ -934,21 +984,22 @@ static void handle_cmd(RtmCtx* c, const uint8_t* p, int64_t len, double now)
     wr_u64(rec, (uint64_t)slot);
     rec.push_back((uint8_t)val);
     wr_f64(rec, 0.0);
-    ev_push(c, rec);
+    ev_push(c, w, rec);
   } else if (type == CMD_STOP) {
     c->stop_req.store(1, std::memory_order_relaxed);
   }
 }
 
-static void drain_cmds(RtmCtx* c, double now) RABIA_REQUIRES(rtm_io_role) {
+static void drain_cmds(RtmCtx* c, RtmWorker* w, double now)
+    RABIA_REQUIRES(rtm_io_role) {
   for (;;) {
-    int64_t got = c->cmd.drain(c->cmd_scratch.data(),
-                               (int64_t)c->cmd_scratch.size());
+    int64_t got = w->cmd.drain(w->cmd_scratch.data(),
+                               (int64_t)w->cmd_scratch.size());
     if (got <= 0) break;
     int64_t at = 0;
     while (at + 4 <= got) {
-      const uint32_t len = rd_u32(c->cmd_scratch.data() + at);
-      handle_cmd(c, c->cmd_scratch.data() + at + 4, (int64_t)len, now);
+      const uint32_t len = rd_u32(w->cmd_scratch.data() + at);
+      handle_cmd(c, w, w->cmd_scratch.data() + at + 4, (int64_t)len, now);
       at += 4 + len;
     }
   }
@@ -956,11 +1007,11 @@ static void drain_cmds(RtmCtx* c, double now) RABIA_REQUIRES(rtm_io_role) {
 
 // --- decided-slot processing ------------------------------------------------
 
-static void process_decided(RtmCtx* c, double now)
+static void process_decided(RtmCtx* c, RtmWorker* w, double now)
     RABIA_REQUIRES(rtm_io_role) {
   // group decided block-bound shards by ref; scalars stream directly
   std::map<int64_t, std::vector<int64_t>> waves;  // ref -> shard list
-  for (int64_t s = 0; s < c->n; s++) {
+  for (int64_t s = w->lo; s < w->hi; s++) {
     if (!(c->kdone[s] && c->in_flight[s])) continue;
     const int64_t slot = (int64_t)c->kslot[s];
     const int8_t val = c->kdecided[s];
@@ -970,13 +1021,13 @@ static void process_decided(RtmCtx* c, double now)
       // (Python, under pause) can overtake an in-flight shard and leave
       // a stale cur binding — routing a later decide through it would
       // apply the wrong entry's ops
-      auto bit = c->blocks.find(c->blk_cur_ref[s]);
-      if (bit != c->blocks.end() &&
+      auto bit = w->blocks.find(c->blk_cur_ref[s]);
+      if (bit != w->blocks.end() &&
           bit->second.slots[c->blk_cur_pos[s]] == slot) {
         waves[c->blk_cur_ref[s]].push_back(s);
         continue;
       }
-      blk_unref(c, c->blk_cur_ref[s], 1);
+      blk_unref(w, c->blk_cur_ref[s], 1);
       c->blk_cur_ref[s] = -1;
     }
     if (c->blk_pend_ref[s] != -1 && c->blk_pend_slot[s] == slot &&
@@ -1001,21 +1052,21 @@ static void process_decided(RtmCtx* c, double now)
     c->ring_val[s * c->dec_ring + ring] = val;
     const double opened = c->opened_at[s];
     c->opened_at[s] = 0.0;
-    void_stale_pend(c, s, slot);
+    void_stale_pend(c, w, s, slot);
     std::vector<uint8_t> rec;
     rec.push_back(EV_DECIDE);
     wr_u32(rec, (uint32_t)s);
     wr_u64(rec, (uint64_t)slot);
     rec.push_back((uint8_t)val);
     wr_f64(rec, opened);
-    ev_push(c, rec);
-    c->ctrs[RTM_DECIDED_SCALAR]++;
-    c->ctrs[RTM_GIL_HANDOFFS]++;
+    ev_push(c, w, rec);
+    w->ctrs[RTM_DECIDED_SCALAR]++;
+    w->ctrs[RTM_GIL_HANDOFFS]++;
   }
 
   for (auto& [ref, shards] : waves) {
-    auto bit = c->blocks.find(ref);
-    if (bit == c->blocks.end()) {
+    auto bit = w->blocks.find(ref);
+    if (bit == w->blocks.end()) {
       // registry raced empty (should not happen: refs release at decide)
       for (int64_t s : shards) {
         c->in_flight[s] = 0;
@@ -1053,29 +1104,57 @@ static void process_decided(RtmCtx* c, double now)
     std::vector<int64_t> res_len(ent_shard.size(), 0);
     std::vector<uint8_t> res_bytes;
     if (native && !idxs.empty()) {
-      // Hold the store-plane lock across the apply AND the result
-      // read-out: the asyncio thread's scalar applies (sk_apply_ops)
-      // clear and regrow the SAME out_buf, so reading it after
-      // sk_apply_wave's internal lock is released races a concurrent
-      // clear/realloc. The plane mutex is recursive, so bracketing the
-      // call is safe — but the bracket must end before any ev_push
-      // (a full mailbox blocks until Python drains, and Python's drain
-      // paths take this lock: holding it there would deadlock).
-      const bool plane_held = c->fns[FN_SK_PLANE_LOCK] != nullptr;
+      // Single-worker path: hold the store-plane lock across the apply
+      // AND the result read-out — the asyncio thread's scalar applies
+      // (sk_apply_ops) clear and regrow the SAME out_buf, so reading it
+      // after sk_apply_wave's internal lock is released races a
+      // concurrent clear/realloc. The plane mutex is recursive, so
+      // bracketing the call is safe — but the bracket must end before
+      // any ev_push (a full mailbox blocks until Python drains, and
+      // Python's drain paths take this lock: holding it there would
+      // deadlock).
+      //
+      // Multi-worker path: the wave is group-pure, so it applies through
+      // this worker's PRIVATE statekernel lane (sk_apply_wave_lane) —
+      // the group mutex is taken inside the call and the lane's staging
+      // buffers have a single owner thread, so neither the apply nor the
+      // read-out needs the plane-wide bracket. N workers' applies stop
+      // serializing on the recursive plane mutex.
+      const bool lane_apply = c->W > 1 &&
+                              c->fns[FN_SK_APPLY_WAVE_LANE] != nullptr;
+      const bool plane_held =
+          !lane_apply && c->fns[FN_SK_PLANE_LOCK] != nullptr;
       if (plane_held)
         ((fn_sk_plane_lk_t)c->fns[FN_SK_PLANE_LOCK])(c->sk);
       const uint64_t ap0 = mono_ns();
-      staged = ((fn_sk_apply_wave_t)c->fns[FN_SK_APPLY_WAVE])(
-          c->sk, b.data.data(), b.cmd_offsets.data(), b.shards.data(),
-          b.starts.data(), idxs.data(), (int64_t)idxs.size(), now, want);
+      if (lane_apply) {
+        staged = ((fn_sk_apply_lane_t)c->fns[FN_SK_APPLY_WAVE_LANE])(
+            c->sk, w->gid, b.data.data(), b.cmd_offsets.data(),
+            b.shards.data(), b.starts.data(), idxs.data(),
+            (int64_t)idxs.size(), now, want);
+      } else {
+        staged = ((fn_sk_apply_wave_t)c->fns[FN_SK_APPLY_WAVE])(
+            c->sk, b.data.data(), b.cmd_offsets.data(), b.shards.data(),
+            b.starts.data(), idxs.data(), (int64_t)idxs.size(), now, want);
+      }
       const uint64_t ap_ns = mono_ns() - ap0;
-      c->stg[RTS_APPLY] += ap_ns;
-      rth_observe(c, RTH_DECIDE_APPLY, ap_ns);
+      w->stg[RTS_APPLY] += ap_ns;
+      rth_observe(w, RTH_DECIDE_APPLY, ap_ns);
       if (want && staged >= 0) {
-        const uint8_t* ob =
-            (const uint8_t*)((fn_sk_ptr_t)c->fns[FN_SK_OUT_BUF])(c->sk);
-        const int64_t* offs =
-            (const int64_t*)((fn_sk_ptr_t)c->fns[FN_SK_OUT_OFFS])(c->sk);
+        const uint8_t* ob;
+        const int64_t* offs;
+        if (lane_apply) {
+          ob = (const uint8_t*)((fn_sk_lane_ptr_t)
+                                    c->fns[FN_SK_OUT_BUF_LANE])(c->sk,
+                                                                w->gid);
+          offs = (const int64_t*)((fn_sk_lane_ptr_t)
+                                      c->fns[FN_SK_OUT_OFFS_LANE])(c->sk,
+                                                                   w->gid);
+        } else {
+          ob = (const uint8_t*)((fn_sk_ptr_t)c->fns[FN_SK_OUT_BUF])(c->sk);
+          offs =
+              (const int64_t*)((fn_sk_ptr_t)c->fns[FN_SK_OUT_OFFS])(c->sk);
+        }
         std::map<int64_t, std::pair<int64_t, int64_t>> ranges;  // pos->ops
         int64_t op_at = 0;
         for (int64_t pos : idxs) {
@@ -1090,15 +1169,15 @@ static void process_decided(RtmCtx* c, double now)
           const int64_t hi = offs[rit->second.second];
           res_len[i] = hi - lo;
           if (hi > lo) {
-            size_t w = res_bytes.size();
-            res_bytes.resize(w + (size_t)(hi - lo));
-            memcpy(res_bytes.data() + w, ob + lo, (size_t)(hi - lo));
+            size_t wb = res_bytes.size();
+            res_bytes.resize(wb + (size_t)(hi - lo));
+            memcpy(res_bytes.data() + wb, ob + lo, (size_t)(hi - lo));
           }
         }
       }
       if (plane_held)
         ((fn_sk_plane_lk_t)c->fns[FN_SK_PLANE_UNLOCK])(c->sk);
-      c->ctrs[RTM_SLOTS_APPLIED] += (uint64_t)idxs.size();
+      w->ctrs[RTM_SLOTS_APPLIED] += (uint64_t)idxs.size();
     }
     if (c->wal && native) {
       // durability plane: stage each in-order entry of the wave into
@@ -1128,15 +1207,15 @@ static void process_decided(RtmCtx* c, double now)
           for (int64_t j = lo; j < hi; j++) {
             const int64_t o0 = b.cmd_offsets[j], o1 = b.cmd_offsets[j + 1];
             wr_u32(pay, (uint32_t)(o1 - o0));
-            size_t w = pay.size();
-            pay.resize(w + (size_t)(o1 - o0));
-            memcpy(pay.data() + w, b.data.data() + o0, (size_t)(o1 - o0));
+            size_t wb = pay.size();
+            pay.resize(wb + (size_t)(o1 - o0));
+            memcpy(pay.data() + wb, b.data.data() + o0, (size_t)(o1 - o0));
           }
         }
         ((fn_wal_append_t)c->fns[FN_WAL_APPEND])(c->wal, pay.data(),
                                                  (int64_t)pay.size());
       }
-      c->stg[RTS_APPLY] += mono_ns() - w0;  // staging rides the apply stage
+      w->stg[RTS_APPLY] += mono_ns() - w0;  // staging rides the apply stage
       if (b.token == 0 && b.has_block_id) {
         // receiver-side ledger completeness: hand the (block id, shard,
         // slot) tuples of the zero-bid K_WAVE records just staged to
@@ -1148,16 +1227,16 @@ static void process_decided(RtmCtx* c, double now)
           if (ent_in_order[i] && ent_val[i] == V1c) n_led++;
         if (n_led) {
           lrec.push_back(EV_LEDGER);
-          size_t w = lrec.size();
-          lrec.resize(w + 16);
-          memcpy(lrec.data() + w, b.block_id, 16);
+          size_t wb = lrec.size();
+          lrec.resize(wb + 16);
+          memcpy(lrec.data() + wb, b.block_id, 16);
           wr_u32(lrec, n_led);
           for (size_t i = 0; i < ent_shard.size(); i++) {
             if (!ent_in_order[i] || ent_val[i] != V1c) continue;
             wr_u32(lrec, (uint32_t)ent_shard[i]);
             wr_u64(lrec, (uint64_t)ent_slot[i]);
           }
-          ev_push(c, lrec);
+          ev_push(c, w, lrec);
         }
       }
     }
@@ -1173,7 +1252,7 @@ static void process_decided(RtmCtx* c, double now)
       c->ring_val[s * c->dec_ring + ring] = ent_val[i];
       if (native && ent_in_order[i]) c->applied[s] = slot + 1;
       c->blk_cur_ref[s] = -1;
-      void_stale_pend(c, s, slot);
+      void_stale_pend(c, w, s, slot);
     }
 
     // one EV_WAVE per (ref, tick-batch)
@@ -1204,40 +1283,41 @@ static void process_decided(RtmCtx* c, double now)
       for (size_t i = 0; i < ent_shard.size(); i++)
         wr_u32(rec, (uint32_t)res_len[i]);
       if (!res_bytes.empty()) {
-        size_t w = rec.size();
-        rec.resize(w + res_bytes.size());
-        memcpy(rec.data() + w, res_bytes.data(), res_bytes.size());
-        c->ctrs[RTM_RESULT_BYTES] += (uint64_t)res_bytes.size();
+        size_t wb = rec.size();
+        rec.resize(wb + res_bytes.size());
+        memcpy(rec.data() + wb, res_bytes.data(), res_bytes.size());
+        w->ctrs[RTM_RESULT_BYTES] += (uint64_t)res_bytes.size();
       }
     }
-    blk_unref(c, ref, (int64_t)ent_shard.size());
-    ev_push(c, rec);
+    blk_unref(w, ref, (int64_t)ent_shard.size());
+    ev_push(c, w, rec);
     if (native) {
       // proposer-side future settle is Python bookkeeping but OFF the
       // commit path (peers already progressed) — not a GIL handoff
-      c->ctrs[RTM_WAVES_NATIVE]++;
+      w->ctrs[RTM_WAVES_NATIVE]++;
     } else {
-      c->ctrs[RTM_WAVES_PY]++;
-      c->ctrs[RTM_GIL_HANDOFFS]++;
+      w->ctrs[RTM_WAVES_PY]++;
+      w->ctrs[RTM_GIL_HANDOFFS]++;
     }
   }
 }
 
 // --- open collection --------------------------------------------------------
 
-static int32_t collect_opens(RtmCtx* c) RABIA_REQUIRES(rtm_io_role) {
+static int32_t collect_opens(RtmCtx* c, RtmWorker* w)
+    RABIA_REQUIRES(rtm_io_role) {
   int32_t n_open = 0;
   // durability plane: the watermark read once per pass (an atomic load)
   const uint64_t wal_durable =
       c->wal ? ((fn_wal_durable_t)c->fns[FN_WAL_DURABLE])(c->wal) : 0;
-  memset(c->open_mask.data(), 0, (size_t)c->S);
-  for (int64_t s = 0; s < c->n; s++) {
+  memset(w->open_mask.data() + w->lo, 0, (size_t)(w->hi - w->lo));
+  for (int64_t s = w->lo; s < w->hi; s++) {
     if (c->in_flight[s]) continue;
     if (c->blk_cur_ref[s] != -1) {
       // idle shard with a cur binding = a sync adoption overtook the
       // open (Python cleared in_flight under pause): release it before
       // anything re-opens the shard
-      blk_unref(c, c->blk_cur_ref[s], 1);
+      blk_unref(w, c->blk_cur_ref[s], 1);
       c->blk_cur_ref[s] = -1;
     }
     if (c->blk_pend_ref[s] == -1 && c->sp_slot[s] == -1) continue;
@@ -1256,7 +1336,7 @@ static int32_t collect_opens(RtmCtx* c) RABIA_REQUIRES(rtm_io_role) {
       // blocks on disk).
       if (c->bar_wait[s] > 0) {
         if (wal_durable < (uint64_t)c->bar_wait[s]) {
-          c->restep = 1;  // stay hot: the fsync is typically ~100us out
+          w->restep = 1;  // stay hot: the fsync is typically ~100us out
           continue;
         }
         c->bar_wait[s] = 0;
@@ -1265,11 +1345,11 @@ static int32_t collect_opens(RtmCtx* c) RABIA_REQUIRES(rtm_io_role) {
           c->wal, s, head);
       if (blsn > 0 && wal_durable < (uint64_t)blsn) {
         c->bar_wait[s] = blsn;
-        c->restep = 1;
+        w->restep = 1;
         continue;
       }
     }
-    void_stale_pend(c, s, head - 1);  // drop bindings the head overtook
+    void_stale_pend(c, w, s, head - 1);  // drop bindings the head overtook
     // block binding at head wins (asyncio parity: bulk open runs first)
     if (c->blk_pend_ref[s] != -1 && c->blk_pend_slot[s] == head &&
         c->tainted[s] <= head) {
@@ -1277,27 +1357,27 @@ static int32_t collect_opens(RtmCtx* c) RABIA_REQUIRES(rtm_io_role) {
       c->blk_cur_pos[s] = c->blk_pend_pos[s];
       c->blk_pend_ref[s] = -1;
       c->blk_pend_slot[s] = -1;
-      c->open_mask[s] = 1;
-      c->open_slots[s] = (int32_t)head;
-      c->open_init[s] = V1c;
+      w->open_mask[s] = 1;
+      w->open_slots[s] = (int32_t)head;
+      w->open_init[s] = V1c;
       n_open++;
-      c->ctrs[RTM_OPENS_BLOCK]++;
+      w->ctrs[RTM_OPENS_BLOCK]++;
       continue;
     }
     if (c->sp_slot[s] == head && c->tainted[s] <= head) {
-      c->open_mask[s] = 1;
-      c->open_slots[s] = (int32_t)head;
-      c->open_init[s] = c->sp_init[s];
+      w->open_mask[s] = 1;
+      w->open_slots[s] = (int32_t)head;
+      w->open_init[s] = c->sp_init[s];
       n_open++;
-      c->ctrs[RTM_OPENS_SCALAR]++;
+      w->ctrs[RTM_OPENS_SCALAR]++;
       if (!c->sp_frame[s].empty()) {
         // Propose rides ahead of the open's R1 frame (asyncio parity)
         std::vector<uint8_t> one;
         const uint32_t flen = (uint32_t)c->sp_frame[s].size();
         wr_u32(one, flen);
-        size_t w = one.size();
-        one.resize(w + flen);
-        memcpy(one.data() + w, c->sp_frame[s].data(), flen);
+        size_t wb = one.size();
+        one.resize(wb + flen);
+        memcpy(one.data() + wb, c->sp_frame[s].data(), flen);
         ((fn_bcast_frames_t)c->fns[FN_BCAST_FRAMES])(c->tr, one.data(),
                                                      (int64_t)one.size());
         c->sp_frame[s].clear();
@@ -1307,13 +1387,13 @@ static int32_t collect_opens(RtmCtx* c) RABIA_REQUIRES(rtm_io_role) {
   }
   if (n_open) {
     const double now = wall_s();
-    for (int64_t s = 0; s < c->n; s++) {
-      if (!c->open_mask[s]) continue;
+    for (int64_t s = w->lo; s < w->hi; s++) {
+      if (!w->open_mask[s]) continue;
       c->in_flight[s] = 1;
       // next_slot = max(next_slot, slot) — np.maximum.at parity; the
       // +1 advance happens at decide
-      if ((int64_t)c->open_slots[s] > c->next_slot[s])
-        c->next_slot[s] = (int64_t)c->open_slots[s];
+      if ((int64_t)w->open_slots[s] > c->next_slot[s])
+        c->next_slot[s] = (int64_t)w->open_slots[s];
       c->opened_at[s] = now;
       c->last_progress[s] = now;
     }
@@ -1323,28 +1403,29 @@ static int32_t collect_opens(RtmCtx* c) RABIA_REQUIRES(rtm_io_role) {
 
 // --- timers: retransmit, stale repair, stall escalation ---------------------
 
-static void run_timers(RtmCtx* c, double now) RABIA_REQUIRES(rtm_io_role) {
+static void run_timers(RtmCtx* c, RtmWorker* w, double now)
+    RABIA_REQUIRES(rtm_io_role) {
   // vote retransmits for stalled shards (pure C)
   int64_t res[4] = {0, 0, 0, 0};
   ((fn_rk_retransmit_t)c->fns[FN_RK_RETRANSMIT])(
-      c->rk, now, c->phase_timeout, c->out.data(), (int64_t)c->out.size(),
+      w->rk, now, c->phase_timeout, w->out.data(), (int64_t)w->out.size(),
       res);
   if (res[0] > 0) {
-    ((fn_bcast_frames_t)c->fns[FN_BCAST_FRAMES])(c->tr, c->out.data(), res[0]);
-    c->ctrs[RTM_RETRANSMITS]++;
+    ((fn_bcast_frames_t)c->fns[FN_BCAST_FRAMES])(c->tr, w->out.data(), res[0]);
+    w->ctrs[RTM_RETRANSMITS]++;
   }
   if (res[1] > 0) {
     // payload retransmission is Python's (it owns the propose bytes):
     // escalate stalled shards' bindings, rate-limited per shard
-    for (int64_t s = 0; s < c->n; s++) {
+    for (int64_t s = w->lo; s < w->hi; s++) {
       if (!c->in_flight[s]) continue;
       if (now - c->opened_at[s] < c->phase_timeout) continue;
       if (now - c->stall_ev_at[s] < c->phase_timeout) continue;
       c->stall_ev_at[s] = now;
       std::vector<uint8_t> rec;
       if (c->blk_cur_ref[s] != -1) {
-        auto it = c->blocks.find(c->blk_cur_ref[s]);
-        const uint64_t token = it != c->blocks.end() ? it->second.token : 0;
+        auto it = w->blocks.find(c->blk_cur_ref[s]);
+        const uint64_t token = it != w->blocks.end() ? it->second.token : 0;
         rec.push_back(EV_STALL);
         rec.push_back(1);
         wr_u32(rec, (uint32_t)s);
@@ -1355,7 +1436,7 @@ static void run_timers(RtmCtx* c, double now) RABIA_REQUIRES(rtm_io_role) {
         wr_u32(rec, (uint32_t)s);
         wr_u64(rec, (uint64_t)c->kslot[s]);
       }
-      ev_push(c, rec);
+      ev_push(c, w, rec);
     }
   }
   // peer-votes-waiting escalation (the V0 grace path stays in Python).
@@ -1364,7 +1445,7 @@ static void run_timers(RtmCtx* c, double now) RABIA_REQUIRES(rtm_io_role) {
   // can bind payloads, turning a transient binding lag into a V0-open
   // cascade (measured: ~1M stall events in one config-5 run).
   int32_t stall_budget = 128;
-  for (int64_t s = 0; s < c->n && stall_budget > 0; s++) {
+  for (int64_t s = w->lo; s < w->hi && stall_budget > 0; s++) {
     if (c->in_flight[s]) continue;
     const int64_t head =
         c->next_slot[s] > c->applied[s] ? c->next_slot[s] : c->applied[s];
@@ -1378,13 +1459,13 @@ static void run_timers(RtmCtx* c, double now) RABIA_REQUIRES(rtm_io_role) {
     rec.push_back(2);
     wr_u32(rec, (uint32_t)s);
     wr_u64(rec, (uint64_t)head);
-    ev_push(c, rec);
+    ev_push(c, w, rec);
   }
   // native stale-vote repair from the decided-value ring (bid-free
   // Decisions, unicast, per-row rate limit — _repair_stale_sender parity)
   const int64_t k = ((fn_rk_drain_stale_t)c->fns[FN_RK_DRAIN_STALE])(
-      c->rk, c->st_rows.data(), c->st_shards.data(), c->st_slots.data(),
-      (int64_t)c->st_rows.size());
+      w->rk, w->st_rows.data(), w->st_shards.data(), w->st_slots.data(),
+      (int64_t)w->st_rows.size());
   if (k > 0) {
     const double limit =
         c->phase_timeout / 4 > 0.05 ? c->phase_timeout / 4 : 0.05;
@@ -1396,9 +1477,9 @@ static void run_timers(RtmCtx* c, double now) RABIA_REQUIRES(rtm_io_role) {
       slots.clear();
       vals.clear();
       for (int64_t i = 0; i < k && (int64_t)shards.size() < 256; i++) {
-        if (c->st_rows[i] != row) continue;
-        const int64_t s = c->st_shards[i];
-        const int64_t slot = c->st_slots[i];
+        if (w->st_rows[i] != row) continue;
+        const int64_t s = w->st_shards[i];
+        const int64_t slot = w->st_slots[i];
         const int64_t ring = slot & (c->dec_ring - 1);
         if (c->ring_slot[s * c->dec_ring + ring] != slot) continue;
         shards.push_back(s);
@@ -1406,17 +1487,66 @@ static void run_timers(RtmCtx* c, double now) RABIA_REQUIRES(rtm_io_role) {
         vals.push_back(c->ring_val[s * c->dec_ring + ring]);
       }
       if (shards.empty()) continue;
-      if (now - c->last_repair[row] < limit) continue;
-      c->last_repair[row] = now;
+      if (now - w->last_repair[row] < limit) continue;
+      w->last_repair[row] = now;
       std::vector<uint8_t> f;
-      build_decision_frame(c, f, now, shards.data(), slots.data(),
+      build_decision_frame(c, w, f, now, shards.data(), slots.data(),
                            vals.data(), (int32_t)shards.size());
       ((fn_send_t)c->fns[FN_SEND])(c->tr,
                                    c->uuids.data() + (size_t)row * 16,
                                    f.data(), (uint32_t)f.size());
-      c->ctrs[RTM_STALE_REPAIRS]++;
+      w->ctrs[RTM_STALE_REPAIRS]++;
     }
   }
+}
+
+// --- frame classification (per-group transport routing) ---------------------
+
+// Which shard groups must see this frame? Vote/Decision/ProposeBlock
+// frames map their entry shards to groups (a workers=1 peer's mixed
+// batch fans out — each worker's rk ctx ingests only its own range);
+// everything else (Propose, sync, admin, malformed, non-v3) lands in
+// group 0, whose worker owns control-plane escalation. Pure + read-only:
+// the transport's io thread calls this through rt_set_groups, and
+// workers recompute it for escalation dedup — same bytes, same mask.
+static uint64_t group_mask_of(const RtmCtx* c, const uint8_t* data,
+                              uint32_t len) {
+  if (c->W <= 1) return 1;
+  if (len < 47 || data[0] != 3) return 1;
+  const uint8_t mt = data[1];
+  const uint8_t flags = data[2];
+  if (flags & FLAG_COMPRESSED) return 1;
+  const uint32_t base = 35 + ((flags & FLAG_RECIPIENT) ? 16 : 0);
+  if (len < base + 12) return 1;
+  const uint32_t body_len = rd_u32(data + base + 8);
+  if ((uint64_t)body_len > (uint64_t)len - (base + 12)) return 1;
+  const uint8_t* body = data + base + 12;
+  uint64_t mask = 0;
+  if (mt == MT_VOTE1 || mt == MT_VOTE2 || mt == MT_DECISION) {
+    if (body_len < 4) return 1;
+    const uint32_t count = rd_u32(body);
+    const uint32_t esz = (mt == MT_DECISION) ? 14u : 13u;
+    if (4ull + (uint64_t)count * esz > body_len) return 1;
+    const uint8_t* e = body + 4;
+    for (uint32_t k = 0; k < count; k++, e += esz) {
+      const uint32_t s = rd_u32(e);
+      if (s < (uint32_t)c->n) mask |= 1ull << c->group_of((int64_t)s);
+    }
+    return mask ? mask : 1;
+  }
+  if (mt == MT_PROPOSE_BLOCK) {
+    if (body_len < 20) return 1;
+    const uint32_t k = rd_u32(body + 16);
+    if (k == 0 || k > (uint32_t)c->n) return 1;
+    if (20ull + (uint64_t)k * 16 > body_len) return 1;
+    const uint8_t* sh = body + 20;
+    for (uint32_t i = 0; i < k; i++) {
+      const uint32_t s = rd_u32(sh + (size_t)i * 4);
+      if (s < (uint32_t)c->n) mask |= 1ull << c->group_of((int64_t)s);
+    }
+    return mask ? mask : 1;
+  }
+  return 1;
 }
 
 // --- the io/tick loop -------------------------------------------------------
@@ -1424,40 +1554,49 @@ static void run_timers(RtmCtx* c, double now) RABIA_REQUIRES(rtm_io_role) {
 // One inbound frame through the native path: rk_ingest (votes/decisions),
 // the native ProposeBlock binder, or escalation to the Python mailbox.
 // Returns 1 when the frame had ledger/binding effects (a tick is due).
-static int32_t handle_frame(RtmCtx* c, int32_t row, const uint8_t* fp,
-                            uint32_t flen, double now)
+static int32_t handle_frame(RtmCtx* c, RtmWorker* w, int32_t row,
+                            const uint8_t* fp, uint32_t flen, double now)
     RABIA_REQUIRES(rtm_io_role) {
   const int32_t rc =
-      ((fn_rk_ingest_t)c->fns[FN_RK_INGEST])(c->rk, fp, (int64_t)flen, row,
+      ((fn_rk_ingest_t)c->fns[FN_RK_INGEST])(w->rk, fp, (int64_t)flen, row,
                                              now);
   if (rc == RK_HANDLED) {
-    c->ctrs[RTM_FRAMES_NATIVE]++;
+    w->ctrs[RTM_FRAMES_NATIVE]++;
     return 1;
   }
   if (rc == RK_NOOP) {
-    c->ctrs[RTM_FRAMES_NATIVE]++;
+    w->ctrs[RTM_FRAMES_NATIVE]++;
     return 0;
   }
   if (rc == RK_DROP) {
-    c->ctrs[RTM_FRAMES_DROPPED]++;
+    w->ctrs[RTM_FRAMES_DROPPED]++;
     return 0;
   }
   // RK_PY: bind blocks natively when the apply plane is native —
   // otherwise the frame goes up (Python owns binding AND apply there)
   if (flen >= 2 && fp[1] == MT_PROPOSE_BLOCK && c->native_apply) {
-    const int brc = parse_propose_block(c, fp, (int64_t)flen, row, now);
+    const int brc = parse_propose_block(c, w, fp, (int64_t)flen, row, now);
     if (brc >= 0) return brc;
     if (brc == -2) return 0;  // dropped (spoof/skew/checksum/limits)
+  }
+  if (c->W > 1 && flen >= 2 && fp[1] == MT_PROPOSE_BLOCK) {
+    // escalation dedup: a multi-group ProposeBlock was delivered to
+    // every group it binds — exactly ONE worker (the lowest group in
+    // the recomputed mask) hands it to Python, or _on_propose_block
+    // would register duplicate block entries. Vote/Decision escalations
+    // stay per-worker: their Python handlers are idempotent per entry.
+    const uint64_t mask = group_mask_of(c, fp, flen);
+    if (w->gid != __builtin_ctzll(mask ? mask : 1)) return 0;
   }
   std::vector<uint8_t> rec;
   rec.push_back(EV_FRAME);
   rec.push_back((uint8_t)(row & 0xFF));
   rec.push_back((uint8_t)((row >> 8) & 0xFF));
-  size_t w = rec.size();
-  rec.resize(w + flen);
-  memcpy(rec.data() + w, fp, flen);
-  ev_push(c, rec);
-  c->ctrs[RTM_FRAMES_ESCALATED]++;
+  size_t wat = rec.size();
+  rec.resize(wat + flen);
+  memcpy(rec.data() + wat, fp, flen);
+  ev_push(c, w, rec);
+  w->ctrs[RTM_FRAMES_ESCALATED]++;
   return 0;
 }
 
@@ -1467,18 +1606,22 @@ static int32_t handle_frame(RtmCtx* c, int32_t row, const uint8_t* fp,
 #define RTS_ADD(stage, dur)   \
   do {                        \
     const uint64_t _d = (dur); \
-    c->stg[stage] += _d;      \
+    w->stg[stage] += _d;      \
     acc += _d;                \
   } while (0)
 
-static void rtm_loop(RtmCtx* c) {
-  // this thread IS the io role: assert_capability informs the analysis
-  // without emitting code (rtm_start spawns exactly one such thread)
+static void rtm_loop(RtmCtx* c, RtmWorker* w) {
+  // this thread IS the io role for its shard group: assert_capability
+  // informs the analysis without emitting code (rtm_start spawns one
+  // such thread per group; shard ranges are disjoint)
   rtm_io_role.assert_held();
   fn_recv_borrow_t recv_borrow = (fn_recv_borrow_t)c->fns[FN_RECV_BORROW];
+  fn_recv_borrow_grp_t recv_borrow_grp =
+      (fn_recv_borrow_grp_t)c->fns[FN_RECV_BORROW_GROUP];
   fn_recv_release_t recv_release = (fn_recv_release_t)c->fns[FN_RECV_RELEASE];
   fn_rk_tick_t rk_tick = (fn_rk_tick_t)c->fns[FN_RK_TICK];
   fn_bcast_frames_t bcast = (fn_bcast_frames_t)c->fns[FN_BCAST_FRAMES];
+  const bool grouped = c->W > 1;
   uint8_t sender[16];
   const uint8_t* fp = nullptr;
   uint32_t flen = 0;
@@ -1487,16 +1630,19 @@ static void rtm_loop(RtmCtx* c) {
       c->phase_timeout / 4 < 0.05 ? c->phase_timeout / 4 : 0.05;
 
   while (!c->stop_req.load(std::memory_order_relaxed)) {
-    c->ctrs[RTM_LOOPS]++;
+    w->ctrs[RTM_LOOPS]++;
     const uint64_t it0 = mono_ns();
     uint64_t acc = 0, t0 = 0;
     double now = wall_s();
     t0 = mono_ns();
-    drain_cmds(c, now);
+    drain_cmds(c, w, now);
     RTS_ADD(RTS_CMD, mono_ns() - t0);
     if (c->pause_req.load(std::memory_order_acquire)) {
-      c->state.store(RTM_PAUSED, std::memory_order_release);
-      c->ctrs[RTM_PAUSES]++;
+      // the pause is a BARRIER handshake: every worker parks itself and
+      // rtm_state reports PAUSED only once all of them have (the
+      // round-13 release/acquire handshake, multiplied per worker)
+      w->state.store(RTM_PAUSED, std::memory_order_release);
+      w->ctrs[RTM_PAUSES]++;
       t0 = mono_ns();
       // acquire pairs with rtm_resume's release store: the control
       // plane's while-PAUSED mutations of the shared arrays must be
@@ -1505,8 +1651,8 @@ static void rtm_loop(RtmCtx* c) {
              !c->stop_req.load(std::memory_order_relaxed))
         usleep(200);
       RTS_ADD(RTS_IDLE, mono_ns() - t0);
-      c->state.store(RTM_RUNNING, std::memory_order_release);
-      c->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
+      w->state.store(RTM_RUNNING, std::memory_order_release);
+      w->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
       continue;
     }
 
@@ -1515,65 +1661,67 @@ static void rtm_loop(RtmCtx* c) {
     int32_t got = 0, consumed = 0;
     t0 = mono_ns();
     while (consumed < 512) {
-      const int64_t tok = recv_borrow(c->tr, sender, &fp, &flen, 0);
+      const int64_t tok =
+          grouped ? recv_borrow_grp(c->tr, w->gid, sender, &fp, &flen, 0)
+                  : recv_borrow(c->tr, sender, &fp, &flen, 0);
       if (tok < 0) break;
       consumed++;
       const int32_t row = row_of(c, sender);
-      if (row >= 0) got += handle_frame(c, row, fp, flen, now);
+      if (row >= 0) got += handle_frame(c, w, row, fp, flen, now);
       recv_release(c->tr, tok);
     }
     RTS_ADD(RTS_INGEST, mono_ns() - t0);
 
     t0 = mono_ns();
-    const int32_t n_open = collect_opens(c);
+    const int32_t n_open = collect_opens(c, w);
     RTS_ADD(RTS_TICK, mono_ns() - t0);
-    if (got || n_open || c->restep) {
-      c->restep = 0;
+    if (got || n_open || w->restep) {
+      w->restep = 0;
       now = wall_s();
       t0 = mono_ns();
-      rk_tick(c->rk, now, c->out.data(), (int64_t)c->out.size(), 4,
-              n_open ? c->open_mask.data() : nullptr,
-              n_open ? c->open_slots.data() : nullptr,
-              n_open ? c->open_init.data() : nullptr, res);
+      rk_tick(w->rk, now, w->out.data(), (int64_t)w->out.size(), 4,
+              n_open ? w->open_mask.data() : nullptr,
+              n_open ? w->open_slots.data() : nullptr,
+              n_open ? w->open_init.data() : nullptr, res);
       RTS_ADD(RTS_TICK, mono_ns() - t0);
-      c->ctrs[RTM_TICKS]++;
+      w->ctrs[RTM_TICKS]++;
       if (res[0] > 0) {
         t0 = mono_ns();
-        bcast(c->tr, c->out.data(), res[0]);
+        bcast(c->tr, w->out.data(), res[0]);
         const uint64_t bc_ns = mono_ns() - t0;
         RTS_ADD(RTS_BROADCAST, bc_ns);
-        rth_observe(c, RTH_BROADCAST, bc_ns);
+        rth_observe(w, RTH_BROADCAST, bc_ns);
       }
-      if (res[2]) c->restep = 1;
+      if (res[2]) w->restep = 1;
       if (res[1]) {
         // process_decided brackets its own sk_apply_wave sections into
         // RTS_APPLY; everything else it does (decision bookkeeping,
         // result copy-out, event-record staging) is result staging
-        const uint64_t a0 = c->stg[RTS_APPLY];
+        const uint64_t a0 = w->stg[RTS_APPLY];
         t0 = mono_ns();
-        process_decided(c, now);
+        process_decided(c, w, now);
         const uint64_t pd = mono_ns() - t0;
-        const uint64_t ap = c->stg[RTS_APPLY] - a0;
-        c->stg[RTS_RESULT_STAGING] += pd > ap ? pd - ap : 0;
+        const uint64_t ap = w->stg[RTS_APPLY] - a0;
+        w->stg[RTS_RESULT_STAGING] += pd > ap ? pd - ap : 0;
         acc += pd;
       }
     }
 
-    if (now - c->last_timers >= timer_every) {
-      c->last_timers = now;
+    if (now - w->last_timers >= timer_every) {
+      w->last_timers = now;
       t0 = mono_ns();
-      run_timers(c, now);
+      run_timers(c, w, now);
       RTS_ADD(RTS_TIMERS, mono_ns() - t0);
     }
 
-    if (c->restep) {
-      c->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
+    if (w->restep) {
+      w->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
       continue;
     }
     if (consumed) {
-      fr_rec(c, FRE_RT_WAKE, 1, 0, 0);
-      c->ctrs[RTM_WAKES_FRAME]++;
-      c->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
+      fr_rec(w, FRE_RT_WAKE, 1, 0, 0);
+      w->ctrs[RTM_WAKES_FRAME]++;
+      w->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
       continue;  // stay hot while traffic flows
     }
     // idle: block on the transport inbox (frames and rt_inbox_kick both
@@ -1584,25 +1732,28 @@ static void rtm_loop(RtmCtx* c) {
     if (timeout_ms > 5) timeout_ms = 5;
     if (timeout_ms < 1) timeout_ms = 1;
     t0 = mono_ns();
-    const int64_t tok = recv_borrow(c->tr, sender, &fp, &flen, timeout_ms);
+    const int64_t tok =
+        grouped
+            ? recv_borrow_grp(c->tr, w->gid, sender, &fp, &flen, timeout_ms)
+            : recv_borrow(c->tr, sender, &fp, &flen, timeout_ms);
     if (tok >= 0) {
       RTS_ADD(RTS_RECV_WAIT, mono_ns() - t0);
       t0 = mono_ns();
       const int32_t row = row_of(c, sender);
-      if (row >= 0 && handle_frame(c, row, fp, flen, wall_s()))
-        c->restep = 1;  // force a tick next iteration
+      if (row >= 0 && handle_frame(c, w, row, fp, flen, wall_s()))
+        w->restep = 1;  // force a tick next iteration
       recv_release(c->tr, tok);
       RTS_ADD(RTS_INGEST, mono_ns() - t0);
-      fr_rec(c, FRE_RT_WAKE, 1, 0, 0);
-      c->ctrs[RTM_WAKES_FRAME]++;
+      fr_rec(w, FRE_RT_WAKE, 1, 0, 0);
+      w->ctrs[RTM_WAKES_FRAME]++;
     } else {
       RTS_ADD(RTS_IDLE, mono_ns() - t0);
-      fr_rec(c, FRE_RT_WAKE, 2, 0, 0);
-      c->ctrs[RTM_WAKES_IDLE]++;
+      fr_rec(w, FRE_RT_WAKE, 2, 0, 0);
+      w->ctrs[RTM_WAKES_IDLE]++;
     }
-    c->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
+    w->stg[RTS_OTHER] += (mono_ns() - it0) - acc;
   }
-  c->state.store(RTM_STOPPED, std::memory_order_release);
+  w->state.store(RTM_STOPPED, std::memory_order_release);
   uint64_t one = 1;
   (void)!write(c->event_fd, &one, 8);
 }
@@ -1610,11 +1761,16 @@ static void rtm_loop(RtmCtx* c) {
 // --- lifecycle / ABI --------------------------------------------------------
 
 // dims: [S, n, R, me, dec_ring, native_apply, cmd_ring_cap, ev_ring_cap,
-//        max_cmds_per_batch, max_cmd_size]
+//        max_cmds_per_batch, max_cmd_size, workers]
+//        (workers: shard-group worker threads; <= 1 or absent = the
+//         single-thread runtime, byte-for-byte the round-8 behavior)
 // ptrs: [rk_ctx, transport, sk_plane, next_slot, applied, in_flight,
 //        votes_seen, tainted, last_progress, opened_at, ring_slot,
-//        ring_val, kslot, kdecided, kdone, knewly, wal_ctx]
-//        (wal_ctx: walkernel handle or 0 — the durability plane)
+//        ring_val, kslot, kdecided, kdone, knewly, wal_ctx,
+//        rk_ctx_1 .. rk_ctx_{workers-1}]
+//        (wal_ctx: walkernel handle or 0 — the durability plane; the
+//         extra rk handles are the per-worker tick contexts, already
+//         range-restricted via rk_set_range by the bridge)
 // fns:  FN_* order above
 // fparams: [max_future_skew, max_age, phase_timeout, grace]
 void* rtm_create(const int64_t* dims, const int64_t* ptrs, const int64_t* fns,
@@ -1630,8 +1786,14 @@ void* rtm_create(const int64_t* dims, const int64_t* ptrs, const int64_t* fns,
   const int64_t ev_cap = dims[7] > 0 ? dims[7] : (20 << 20);
   c->max_cmds = dims[8];
   c->max_cmd_size = dims[9];
+  int32_t W = (int32_t)dims[10];
+  if (W < 1) W = 1;
+  if (W > 64) W = 64;
+  if (W > c->n) W = c->n > 0 ? c->n : 1;
+  c->W = W;
+  c->chunk = (c->n + W - 1) / W;
   int i = 0;
-  c->rk = (void*)ptrs[i++];
+  void* rk0 = (void*)ptrs[i++];
   c->tr = (void*)ptrs[i++];
   c->sk = (void*)ptrs[i++];
   c->next_slot = (int64_t*)ptrs[i++];
@@ -1670,44 +1832,71 @@ void* rtm_create(const int64_t* dims, const int64_t* ptrs, const int64_t* fns,
   c->stall_ev_at.assign(c->S, 0.0);
   c->votes_wait_at.assign(c->S, 0.0);
   c->bar_wait.assign(c->S, 0);
-  c->open_mask.assign(c->S, 0);
-  c->open_slots.assign(c->S, 0);
-  c->open_init.assign(c->S, 0);
-  // outbound buffer: same sizing rule as NativeTick, with headroom
-  c->out.resize((size_t)(4096 + 72 + 13 * (int64_t)c->n +
-                         4 * (3 * 72 + 40 * (int64_t)c->n)));
-  c->cmd.buf.resize((size_t)cmd_cap);
-  c->ev.buf.resize((size_t)ev_cap);
-  // scratch covers the whole ring: a record the push accepted must
-  // always drain (a smaller scratch would wedge the command plane
-  // behind the first oversized record)
-  c->cmd_scratch.resize((size_t)cmd_cap);
-  c->st_rows.assign(1024, 0);
-  c->st_shards.assign(1024, 0);
-  c->st_slots.assign(1024, 0);
-  c->last_repair.assign(c->R, 0.0);
-  memset(c->ctrs, 0, sizeof(c->ctrs));
-  memset(c->stg, 0, sizeof(c->stg));
-  memset(c->hist, 0, sizeof(c->hist));
-  c->fr.resize(RTM_FLIGHT_CAP);
   c->event_fd = eventfd(0, EFD_NONBLOCK);
+
+  for (int32_t g = 0; g < W; g++) {
+    auto w = std::make_unique<RtmWorker>();
+    w->gid = g;
+    w->lo = (int64_t)g * c->chunk;
+    w->hi = g == W - 1 ? (int64_t)c->n : (int64_t)(g + 1) * c->chunk;
+    if (w->hi > c->n) w->hi = c->n;
+    w->rk = g == 0 ? rk0 : (void*)ptrs[17 + (g - 1)];
+    w->open_mask.assign(c->S, 0);
+    w->open_slots.assign(c->S, 0);
+    w->open_init.assign(c->S, 0);
+    // outbound buffer: same sizing rule as NativeTick, with headroom
+    w->out.resize((size_t)(4096 + 72 + 13 * (int64_t)c->n +
+                           4 * (3 * 72 + 40 * (int64_t)c->n)));
+    w->cmd.buf.resize((size_t)cmd_cap);
+    w->ev.buf.resize((size_t)ev_cap);
+    // scratch covers the whole ring: a record the push accepted must
+    // always drain (a smaller scratch would wedge the command plane
+    // behind the first oversized record)
+    w->cmd_scratch.resize((size_t)cmd_cap);
+    w->st_rows.assign(1024, 0);
+    w->st_shards.assign(1024, 0);
+    w->st_slots.assign(1024, 0);
+    w->last_repair.assign(c->R, 0.0);
+    memset(w->ctrs, 0, sizeof(w->ctrs));
+    memset(w->stg, 0, sizeof(w->stg));
+    memset(w->hist, 0, sizeof(w->hist));
+    w->fr.resize(RTM_FLIGHT_CAP);
+    c->workers.push_back(std::move(w));
+  }
   return c;
 }
 
+// The transport classifier (rt_set_groups): pure, read-only, safe from
+// the io thread while workers run.
+uint64_t rtm_frame_group_mask(void* ctx, const uint8_t* data, uint32_t len) {
+  return group_mask_of((const RtmCtx*)ctx, data, len);
+}
+
+int32_t rtm_workers(void* ctx) { return ((RtmCtx*)ctx)->W; }
+
+// Shard-group geometry for the control plane: contiguous chunks of
+// rtm_group_chunk(ctx) shards; group of shard s = min(s / chunk, W-1).
+int64_t rtm_group_chunk(void* ctx) { return ((RtmCtx*)ctx)->chunk; }
+
 int32_t rtm_start(void* ctx) {
   RtmCtx* c = (RtmCtx*)ctx;
-  c->th = std::thread([c] { rtm_loop(c); });
+  for (auto& w : c->workers) {
+    RtmWorker* wp = w.get();
+    wp->th = std::thread([c, wp] { rtm_loop(c, wp); });
+  }
   return 0;
 }
 
-// Request a stop and join. The loop finishes its current iteration —
-// decided waves already ingested complete their apply + event staging
-// before the thread exits (mid-wave shutdown never loses staged result
-// frames; the bridge drains the mailbox after this returns).
+// Request a stop and join every worker. Each loop finishes its current
+// iteration — decided waves already ingested complete their apply +
+// event staging before the thread exits (mid-wave shutdown never loses
+// staged result frames; the bridge drains the mailbox after this
+// returns).
 void rtm_stop(void* ctx) {
   RtmCtx* c = (RtmCtx*)ctx;
   c->stop_req.store(1, std::memory_order_relaxed);
-  if (c->th.joinable()) c->th.join();
+  for (auto& w : c->workers)
+    if (w->th.joinable()) w->th.join();
 }
 
 void rtm_destroy(void* ctx) {
@@ -1717,8 +1906,25 @@ void rtm_destroy(void* ctx) {
   delete c;
 }
 
+// Aggregate run state: STOPPED once every worker stopped, PAUSED once
+// every worker parked (the pause barrier's completion signal — the
+// bridge's pause() polls this), RUNNING otherwise.
 int32_t rtm_state(void* ctx) {
-  return ((RtmCtx*)ctx)->state.load(std::memory_order_acquire);
+  RtmCtx* c = (RtmCtx*)ctx;
+  int32_t n_stop = 0, n_parked = 0;
+  for (auto& w : c->workers) {
+    const int32_t st = w->state.load(std::memory_order_acquire);
+    if (st == RTM_STOPPED) {
+      n_stop++;
+      n_parked++;
+    } else if (st == RTM_PAUSED) {
+      n_parked++;
+    }
+  }
+  const int32_t W = (int32_t)c->workers.size();
+  if (n_stop == W) return RTM_STOPPED;
+  if (n_parked == W) return RTM_PAUSED;
+  return RTM_RUNNING;
 }
 
 void rtm_pause(void* ctx) {
@@ -1726,9 +1932,9 @@ void rtm_pause(void* ctx) {
 }
 
 // release: the control plane mutates the shared consensus arrays
-// (next_slot/applied/tainted/...) while the loop is parked in PAUSED;
-// the io thread's acquire load of pause_req in its park loop is the
-// other half of the edge that makes those writes visible before it
+// (next_slot/applied/tainted/...) while every worker is parked in
+// PAUSED; each worker's acquire load of pause_req in its park loop is
+// the other half of the edge that makes those writes visible before it
 // resumes ticking. (Was relaxed/relaxed — a real ordering bug the TSan
 // stress cell flags on weakly-ordered machines.)
 void rtm_resume(void* ctx) {
@@ -1737,29 +1943,72 @@ void rtm_resume(void* ctx) {
 
 int rtm_event_fd(void* ctx) { return ((RtmCtx*)ctx)->event_fd; }
 
-// Producer half of the command ring, called from the Python control
-// plane thread (the only producer). Returns 0 staged, -1 full.
+// Producer half of the command rings, called from the Python control
+// plane thread (the only producer). The control plane sees ONE command
+// ring: records route to the owning worker's SPSC ring by the shard
+// they carry (the bridge splits multi-shard records per group first).
+// Returns 0 staged, -1 full.
 int32_t rtm_cmd_push(void* ctx, const uint8_t* rec, int64_t len) {
   RtmCtx* c = (RtmCtx*)ctx;
-  return c->cmd.push(rec, len, nullptr, 0) ? 0 : -1;
+  int32_t g = 0;
+  if (c->W > 1 && len >= 1) {
+    const uint8_t type = rec[0];
+    int64_t s = -1;
+    if (type == CMD_OPEN_SCALAR && len >= 5) {
+      s = (int64_t)rd_u32(rec + 1);
+    } else if (type == CMD_OPEN_WAVE && len >= 30) {
+      s = (int64_t)rd_u32(rec + 26);  // first entry's shard
+    } else if (type == CMD_ADVANCE && len >= 9) {
+      s = (int64_t)rd_u32(rec + 5);  // first entry's shard
+    } else if (type == CMD_DECIDE && len >= 5) {
+      s = (int64_t)rd_u32(rec + 1);
+    } else if (type == CMD_STOP) {
+      // fan the stop out so every parked/blocked worker wakes
+      c->stop_req.store(1, std::memory_order_relaxed);
+      for (auto& w : c->workers)
+        (void)w->cmd.push(rec, len, nullptr, 0);
+      return 0;
+    }
+    if (s >= 0 && s < c->n) g = c->group_of(s);
+  }
+  return c->workers[(size_t)g]->cmd.push(rec, len, nullptr, 0) ? 0 : -1;
 }
 
-// Consumer half of the event mailbox, called from the Python control
-// plane thread (the only consumer). Copies whole records
-// ([u32 len][payload]...) into `out`; returns bytes written.
+// Consumer half of the event mailboxes, called from the Python control
+// plane thread (the only consumer). Drains every worker's ring into
+// `out` ([u32 len][payload]... records back to back) — per-shard event
+// order is per-worker order, which each SPSC ring preserves. Returns
+// bytes written.
 int64_t rtm_ev_drain(void* ctx, uint8_t* out, int64_t cap) {
   RtmCtx* c = (RtmCtx*)ctx;
-  return c->ev.drain(out, cap);
+  int64_t total = 0;
+  for (auto& w : c->workers) {
+    if (total >= cap) break;
+    total += w->ev.drain(out + total, cap - total);
+  }
+  return total;
 }
 
 int32_t rtm_counters_version(void) { return RTM_COUNTERS_VERSION; }
 int32_t rtm_counters_count(void) { return RTM_COUNT; }
-void* rtm_counters(void* ctx) { return ((RtmCtx*)ctx)->ctrs; }
+void* rtm_counters(void* ctx) { return ((RtmCtx*)ctx)->workers[0]->ctrs; }
+// per-worker counter blocks (same RTM_* geometry; the bridge sums at
+// scrape and labels per-worker series)
+void* rtm_counters_w(void* ctx, int32_t g) {
+  RtmCtx* c = (RtmCtx*)ctx;
+  if (g < 0 || (size_t)g >= c->workers.size()) return nullptr;
+  return c->workers[(size_t)g]->ctrs;
+}
 
 // stage profiler block: RTS_COUNT u64 cumulative ns, index order RTS_*
 int32_t rtm_stages_version(void) { return RTS_VERSION; }
 int32_t rtm_stages_count(void) { return RTS_COUNT; }
-void* rtm_stages(void* ctx) { return ((RtmCtx*)ctx)->stg; }
+void* rtm_stages(void* ctx) { return ((RtmCtx*)ctx)->workers[0]->stg; }
+void* rtm_stages_w(void* ctx, int32_t g) {
+  RtmCtx* c = (RtmCtx*)ctx;
+  if (g < 0 || (size_t)g >= c->workers.size()) return nullptr;
+  return c->workers[(size_t)g]->stg;
+}
 
 // SLO histogram block: RTH_STAGE_COUNT rows of RTH_BUCKETS bucket
 // counts + total count + sum_ns (stride RTH_BUCKETS + 2), index order
@@ -1770,14 +2019,31 @@ int32_t rtm_hist_stages(void) { return RTH_STAGE_COUNT; }
 int32_t rtm_hist_buckets(void) { return RTH_BUCKETS; }
 int32_t rtm_hist_sub_bits(void) { return RTH_SUB_BITS; }
 int32_t rtm_hist_min_exp(void) { return RTH_MIN_EXP; }
-void* rtm_hist(void* ctx) { return ((RtmCtx*)ctx)->hist; }
+void* rtm_hist(void* ctx) { return ((RtmCtx*)ctx)->workers[0]->hist; }
+void* rtm_hist_w(void* ctx, int32_t g) {
+  RtmCtx* c = (RtmCtx*)ctx;
+  if (g < 0 || (size_t)g >= c->workers.size()) return nullptr;
+  return c->workers[(size_t)g]->hist;
+}
 
 int32_t rtm_flight_version(void) { return RTM_FLIGHT_VERSION; }
 int32_t rtm_flight_cap(void) { return (int32_t)RTM_FLIGHT_CAP; }
 int32_t rtm_flight_record_size(void) { return (int32_t)sizeof(FrEvent); }
-void* rtm_flight(void* ctx) { return ((RtmCtx*)ctx)->fr.data(); }
+void* rtm_flight(void* ctx) {
+  return ((RtmCtx*)ctx)->workers[0]->fr.data();
+}
 uint64_t rtm_flight_head(void* ctx) {
-  return ((RtmCtx*)ctx)->fr_head.load(std::memory_order_relaxed);
+  return ((RtmCtx*)ctx)->workers[0]->fr_head.load(std::memory_order_relaxed);
+}
+void* rtm_flight_w(void* ctx, int32_t g) {
+  RtmCtx* c = (RtmCtx*)ctx;
+  if (g < 0 || (size_t)g >= c->workers.size()) return nullptr;
+  return c->workers[(size_t)g]->fr.data();
+}
+uint64_t rtm_flight_head_w(void* ctx, int32_t g) {
+  RtmCtx* c = (RtmCtx*)ctx;
+  if (g < 0 || (size_t)g >= c->workers.size()) return 0;
+  return c->workers[(size_t)g]->fr_head.load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

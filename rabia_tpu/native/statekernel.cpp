@@ -41,6 +41,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
+#include <memory>
 #include <mutex>
 #include <new>
 #include <vector>
@@ -171,17 +172,34 @@ static inline void log_del(Store& st, const uint8_t* key, uint32_t klen) {
   st.n_dels++;
 }
 
+// One result-staging lane: a growable [u32 len][payload] record buffer
+// plus its offset index. lane0 is the legacy plane-owned staging (the
+// asyncio runtime + Python scalar applies); the thread-per-shard-group
+// runtime gives each worker its OWN lane (sk_apply_wave_lane), so N
+// workers stage results without sharing a buffer.
+struct SkLane {
+  std::vector<uint8_t> out_buf;
+  std::vector<int64_t> out_offs;
+  bool staging = true;  // false while want=0: followers skip result frames
+};
+
 struct SkPlane {
   std::vector<Store> stores RABIA_GUARDED_BY(mu);
   int64_t max_keys;
   int64_t max_key_len;    // CODE POINTS (KVStoreConfig.max_key_length)
   int64_t max_value_size; // BYTES (KVStoreConfig.max_value_size)
-  uint64_t counters[SKC_COUNT];
+  // relaxed atomics: multi-writer once apply lanes are configured (N
+  // worker threads + the Python plane); layout-identical to uint64 for
+  // the zero-copy sk_counters scrape
+  std::atomic<uint64_t> counters[SKC_COUNT];
+  static_assert(sizeof(std::atomic<uint64_t>) == sizeof(uint64_t),
+                "counter block must read as a plain uint64 array");
   FrEvent flight[SK_FLIGHT_CAP];
-  // relaxed atomic: written under mu on the apply path, read
-  // lock-free by the scrape path via sk_flight_head
+  // relaxed atomic: written on the apply paths (possibly several lanes
+  // at once — each writer claims a slot via fetch_add; a torn record is
+  // metrics-grade noise), read lock-free via sk_flight_head
   std::atomic<uint64_t> flight_head{0};
-  uint64_t waves = 0;
+  std::atomic<uint64_t> waves{0};
   // Plane lock (native-runtime hook): the GIL-free runtime thread owns
   // the apply path while the Python control plane still serves reads
   // (gateway read-index GETs, snapshot export). Mutating entry points
@@ -196,9 +214,38 @@ struct SkPlane {
   // a large wave can never overflow mid-apply): [u32 LE len][payload]
   // records in PROCESS order, with out_offs[i] = record i's start and a
   // final total — read zero-copy by the bridge via sk_out_buf/sk_out_offs
-  std::vector<uint8_t> out_buf;
-  std::vector<int64_t> out_offs;
-  bool staging = true;  // false while want=0: followers skip result frames
+  SkLane lane0;
+  // thread-per-shard-group apply lanes (sk_set_groups): lanes[g] is
+  // worker g's private staging, lane_mus[g] its shard group's store
+  // lock. A lane apply takes ONLY its group mutex; every plane-wide
+  // entry point takes `mu` plus ALL group mutexes in index order (lock
+  // order: mu -> lane_mus[0] -> lane_mus[1] -> …), so readers/snapshots
+  // exclude every concurrently-applying worker while workers never
+  // serialize against EACH OTHER. Vectors are stable while workers run
+  // (sk_set_groups only executes with the runtime quiesced).
+  std::vector<std::unique_ptr<SkLane>> lanes;
+  std::vector<std::unique_ptr<rabia::RecursiveMutex>> lane_mus;
+};
+
+// Plane-wide critical section: `mu` + every configured group mutex.
+// With no groups configured this is exactly the historical RecursiveLock
+// on `mu` — the workers=1 path stays byte-identical.
+struct PlaneGuard {
+  SkPlane* p;
+  size_t n_lanes;  // lanes locked at construction — sk_set_groups can
+                   // GROW lane_mus inside a guard; the destructor must
+                   // unlock exactly what the constructor locked
+  explicit PlaneGuard(SkPlane* pp) RABIA_NO_TSA : p(pp) {
+    p->mu.lock();
+    n_lanes = p->lane_mus.size();
+    for (size_t i = 0; i < n_lanes; i++) p->lane_mus[i]->lock();
+  }
+  ~PlaneGuard() RABIA_NO_TSA {
+    for (size_t i = n_lanes; i-- > 0;) p->lane_mus[i]->unlock();
+    p->mu.unlock();
+  }
+  PlaneGuard(const PlaneGuard&) = delete;
+  PlaneGuard& operator=(const PlaneGuard&) = delete;
 };
 
 static void store_free_entries(Store& st) {
@@ -302,14 +349,14 @@ void* sk_plane_create(int64_t n_stores, int64_t max_keys,
   SkPlane* p = new (std::nothrow) SkPlane();
   if (!p) return nullptr;
   {
-    rabia::RecursiveLock lk(p->mu);  // no other thread yet; analysis only
+    PlaneGuard lk(p);  // no other thread yet; analysis only
     p->stores.resize((size_t)n_stores);
     for (auto& st : p->stores) st.reset_table(64);
   }
   p->max_keys = max_keys;
   p->max_key_len = max_key_len;
   p->max_value_size = max_value_size;
-  memset(p->counters, 0, sizeof(p->counters));
+  for (auto& c : p->counters) c.store(0, std::memory_order_relaxed);
   memset(p->flight, 0, sizeof(p->flight));
   return p;
 }
@@ -318,7 +365,7 @@ void sk_plane_destroy(void* h) {
   SkPlane* p = (SkPlane*)h;
   if (!p) return;
   {
-    rabia::RecursiveLock lk(p->mu);  // last reference; analysis only
+    PlaneGuard lk(p);  // last reference; analysis only
     for (auto& st : p->stores) store_free_entries(st);
   }
   delete p;
@@ -344,32 +391,44 @@ uint64_t sk_flight_head(void* h) {
 // NO_TSA: a deliberately unbalanced C-API bracket over an opaque handle
 // (the analysis cannot follow the caller's pairing; the debug lock-order
 // checker and the TSan stress cell validate it at runtime instead)
-void sk_plane_lock(void* h) RABIA_NO_TSA { ((SkPlane*)h)->mu.lock(); }
-void sk_plane_unlock(void* h) RABIA_NO_TSA { ((SkPlane*)h)->mu.unlock(); }
+void sk_plane_lock(void* h) RABIA_NO_TSA {
+  SkPlane* p = (SkPlane*)h;
+  p->mu.lock();
+  // group lanes configured: the bracket must exclude every lane apply
+  // too (a borrowed sk_get pointer must survive a worker's concurrent
+  // wave into the same store) — same order as PlaneGuard
+  for (auto& m : p->lane_mus) m->lock();
+}
+void sk_plane_unlock(void* h) RABIA_NO_TSA {
+  SkPlane* p = (SkPlane*)h;
+  for (auto it = p->lane_mus.rbegin(); it != p->lane_mus.rend(); ++it)
+    (*it)->unlock();
+  p->mu.unlock();
+}
 
 int64_t sk_store_count(void* h) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   return (int64_t)p->stores.size();
 }
 
 int64_t sk_store_size(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   return p->stores[(size_t)idx].live;
 }
 
 uint64_t sk_store_version(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return 0;
   return p->stores[(size_t)idx].version;
 }
 
 void sk_set_version(void* h, int64_t idx, uint64_t v) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   p->stores[(size_t)idx].version = v;
 }
@@ -377,7 +436,7 @@ void sk_set_version(void* h, int64_t idx, uint64_t v) {
 // out[0..2] = total_operations, reads, writes (StoreStats parity)
 void sk_store_stats(void* h, int64_t idx, uint64_t* out) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   Store& st = p->stores[(size_t)idx];
   out[0] = st.total_operations;
@@ -388,7 +447,7 @@ void sk_store_stats(void* h, int64_t idx, uint64_t* out) {
 void sk_add_stats(void* h, int64_t idx, uint64_t ops, uint64_t reads,
                   uint64_t writes) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   Store& st = p->stores[(size_t)idx];
   st.total_operations += ops;
@@ -406,7 +465,7 @@ void sk_add_stats(void* h, int64_t idx, uint64_t ops, uint64_t reads,
 int64_t sk_get(void* h, int64_t idx, const uint8_t* key, int64_t klen,
                const uint8_t** val_addr, uint64_t* version_out) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   int64_t at = store_find(st, fnv1a(key, klen), key, klen, nullptr);
@@ -420,7 +479,7 @@ int64_t sk_get(void* h, int64_t idx, const uint8_t* key, int64_t klen,
 // bytes needed by sk_export for this store
 int64_t sk_export_size(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   int64_t total = 0;
@@ -434,7 +493,7 @@ int64_t sk_export_size(void* h, int64_t idx) {
 // returns bytes written, or -(bytes needed) when cap is insufficient.
 int64_t sk_export(void* h, int64_t idx, uint8_t* out, int64_t cap) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   int64_t need = sk_export_size(h, idx);
@@ -456,7 +515,7 @@ int64_t sk_export(void* h, int64_t idx, uint8_t* out, int64_t cap) {
 
 void sk_clear_store(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   Store& st = p->stores[(size_t)idx];
   store_free_entries(st);
@@ -474,7 +533,7 @@ void sk_clear_store(void* h, int64_t idx) {
 int32_t sk_delete_raw(void* h, int64_t idx, const uint8_t* key,
                       int64_t klen) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   int64_t at = store_find(st, fnv1a(key, klen), key, klen, nullptr);
@@ -493,7 +552,7 @@ int32_t sk_insert_raw(void* h, int64_t idx, const uint8_t* key,
                       int64_t klen, const uint8_t* val, int64_t vlen,
                       uint64_t version, double created, double updated) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   if (st.used * 4 >= (int64_t)st.table.size() * 3)
@@ -539,13 +598,13 @@ int32_t sk_insert_raw(void* h, int64_t idx, const uint8_t* key,
 // staging format — appended to the plane-owned growable buffer (state
 // mutations can therefore never be lost to an output-capacity error).
 
-static inline void res_head(SkPlane* p, uint8_t kind, uint64_t version,
+static inline void res_head(SkLane& L, uint8_t kind, uint64_t version,
                             int32_t has_value, int64_t value_len) {
-  if (!p->staging) return;
+  if (!L.staging) return;
   int64_t payload = 6 + (has_value ? value_len : 0);
-  size_t w = p->out_buf.size();
-  p->out_buf.resize(w + 4 + (size_t)payload);
-  uint8_t* out = p->out_buf.data() + w;
+  size_t w = L.out_buf.size();
+  L.out_buf.resize(w + 4 + (size_t)payload);
+  uint8_t* out = L.out_buf.data() + w;
   uint32_t plen = (uint32_t)payload;
   memcpy(out, &plen, 4);
   out[4] = kind;
@@ -554,33 +613,36 @@ static inline void res_head(SkPlane* p, uint8_t kind, uint64_t version,
   out[9] = has_value ? 1 : 0;
 }
 
-static inline void res_simple(SkPlane* p, uint8_t kind, uint64_t version) {
-  res_head(p, kind, version, 0, 0);
+static inline void res_simple(SkLane& L, uint8_t kind, uint64_t version) {
+  res_head(L, kind, version, 0, 0);
 }
 
-static inline void res_value(SkPlane* p, uint8_t kind, uint64_t version,
+static inline void res_value(SkLane& L, uint8_t kind, uint64_t version,
                              const uint8_t* val, int64_t vlen) {
-  if (!p->staging) return;
-  res_head(p, kind, version, 1, vlen);
-  memcpy(p->out_buf.data() + p->out_buf.size() - vlen, val, (size_t)vlen);
+  if (!L.staging) return;
+  res_head(L, kind, version, 1, vlen);
+  memcpy(L.out_buf.data() + L.out_buf.size() - vlen, val, (size_t)vlen);
 }
 
-static inline void res_text(SkPlane* p, uint8_t kind, uint64_t version,
+static inline void res_text(SkLane& L, uint8_t kind, uint64_t version,
                             const char* text) {
-  res_value(p, kind, version, (const uint8_t*)text,
+  res_value(L, kind, version, (const uint8_t*)text,
             (int64_t)strlen(text));
 }
 
 // Apply ops data[offs[j]..offs[j+1]] for j in [op_lo, op_hi) against
-// store st; results + record offsets appended to the plane buffers.
-static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
-                            const int64_t* offs, int64_t op_lo,
-                            int64_t op_hi, double now) {
+// store st; results + record offsets appended to lane L's buffers.
+// Caller holds a lock covering `st` (the plane guard, or the store's
+// group mutex on a worker lane).
+static void apply_ops_store(SkPlane* p, SkLane& L, Store& st,
+                            const uint8_t* data, const int64_t* offs,
+                            int64_t op_lo, int64_t op_hi,
+                            double now) RABIA_NO_TSA {
   char tmp[128];
   for (int64_t j = op_lo; j < op_hi; j++) {
     const uint8_t* op = data + offs[j];
     const int64_t n = offs[j + 1] - offs[j];
-    if (p->staging) p->out_offs.push_back((int64_t)p->out_buf.size());
+    if (L.staging) L.out_offs.push_back((int64_t)L.out_buf.size());
     p->counters[SKC_OPS]++;
     p->counters[SKC_BYTES_IN] += (uint64_t)n;
 
@@ -588,7 +650,7 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
       // Python: data[0] raises IndexError -> "malformed op: index out
       // of range"
       p->counters[SKC_ERRORS]++;
-      res_text(p, 2, 0, "malformed op: index out of range");
+      res_text(L, 2, 0, "malformed op: index out of range");
       continue;
     }
     const uint8_t opcode = op[0];
@@ -602,14 +664,14 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
       snprintf(tmp, sizeof(tmp),
                "malformed op: key length %lld exceeds payload",
                (long long)klen);
-      res_text(p, 2, 0, tmp);
+      res_text(L, 2, 0, tmp);
       continue;
     }
     const uint8_t* key = op + 3;
     const int64_t key_points = utf8_points(key, klen);
     if (key_points < 0) {
       p->counters[SKC_ERRORS]++;
-      res_text(p, 2, 0, "malformed op: invalid utf-8");
+      res_text(L, 2, 0, "malformed op: invalid utf-8");
       continue;
     }
 
@@ -619,25 +681,25 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
         const int64_t vlen = n - 3 - klen;
         if (utf8_points(val, vlen) < 0) {
           p->counters[SKC_ERRORS]++;
-          res_text(p, 2, 0, "malformed op: invalid utf-8");
+          res_text(L, 2, 0, "malformed op: invalid utf-8");
           break;
         }
         // _validate_key / _validate_value run BEFORE stats (KVStore.set)
         if (klen == 0) {
           p->counters[SKC_ERRORS]++;
-          res_text(p, 2, 0, "StoreError: key_empty");
+          res_text(L, 2, 0, "StoreError: key_empty");
           break;
         }
         if (key_points > p->max_key_len) {
           p->counters[SKC_ERRORS]++;
           snprintf(tmp, sizeof(tmp), "StoreError: key_too_long: %lld > %lld",
                    (long long)key_points, (long long)p->max_key_len);
-          res_text(p, 2, 0, tmp);
+          res_text(L, 2, 0, tmp);
           break;
         }
         if (vlen > p->max_value_size) {
           p->counters[SKC_ERRORS]++;
-          res_text(p, 2, 0, "StoreError: value_too_large");
+          res_text(L, 2, 0, "StoreError: value_too_large");
           break;
         }
         st.total_operations++;
@@ -648,13 +710,13 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
         if (at < 0) {
           if (st.live >= p->max_keys) {
             p->counters[SKC_ERRORS]++;
-            res_text(p, 2, 0, "StoreError: store_full");
+            res_text(L, 2, 0, "StoreError: store_full");
             break;
           }
           uint8_t* kv = (uint8_t*)malloc((size_t)(klen + vlen) + 1);
           if (!kv) {
             p->counters[SKC_ERRORS]++;
-            res_text(p, 2, 0, "internal: oom");
+            res_text(L, 2, 0, "internal: oom");
             break;
           }
           memcpy(kv, key, (size_t)klen);
@@ -681,7 +743,7 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
             uint8_t* kv = (uint8_t*)realloc(e.kv, (size_t)(klen + vlen) + 1);
             if (!kv) {
               p->counters[SKC_ERRORS]++;
-              res_text(p, 2, 0, "internal: oom");
+              res_text(L, 2, 0, "internal: oom");
               break;
             }
             e.kv = kv;
@@ -695,7 +757,7 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
           e.updated = now;
         }
         p->counters[SKC_SETS]++;
-        res_simple(p, 0, st.version);
+        res_simple(L, 0, st.version);
         break;
       }
       case 2: {  // GET
@@ -704,10 +766,10 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
         p->counters[SKC_GETS]++;
         int64_t at = store_find(st, fnv1a(key, klen), key, klen, nullptr);
         if (at < 0) {
-          res_simple(p, 1, 0);
+          res_simple(L, 1, 0);
         } else {
           Entry& e = st.table[(size_t)at];
-          res_value(p, 0, e.version, e.kv + e.klen, e.vlen);
+          res_value(L, 0, e.version, e.kv + e.klen, e.vlen);
         }
         break;
       }
@@ -718,12 +780,12 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
         uint64_t hsh = fnv1a(key, klen);
         int64_t at = store_find(st, hsh, key, klen, nullptr);
         if (at < 0) {
-          res_simple(p, 1, 0);
+          res_simple(L, 1, 0);
         } else {
           Entry& e = st.table[(size_t)at];
           st.version++;
           // result carries the OLD value and the NEW store version
-          res_value(p, 0, st.version, e.kv + e.klen, e.vlen);
+          res_value(L, 0, st.version, e.kv + e.klen, e.vlen);
           log_del(st, key, (uint32_t)klen);
           free(e.kv);
           e.kv = nullptr;
@@ -737,7 +799,7 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
         st.reads++;
         p->counters[SKC_EXISTS]++;
         int64_t at = store_find(st, fnv1a(key, klen), key, klen, nullptr);
-        res_text(p, 0, 0, at >= 0 ? "true" : "false");
+        res_text(L, 0, 0, at >= 0 ? "true" : "false");
         break;
       }
       case 5: {  // CLEAR
@@ -753,13 +815,13 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
         st.dels_overflow = false;
         st.version++;
         snprintf(tmp, sizeof(tmp), "%lld", (long long)count);
-        res_text(p, 0, 0, tmp);
+        res_text(L, 0, 0, tmp);
         break;
       }
       case 6: {  // CAS
         if (3 + klen + 8 > n) {
           p->counters[SKC_ERRORS]++;
-          res_text(p, 2, 0,
+          res_text(L, 2, 0,
                    "malformed op: cas payload shorter than its "
                    "version field");
           break;
@@ -770,24 +832,24 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
         const int64_t vlen = n - 3 - klen - 8;
         if (utf8_points(val, vlen) < 0) {
           p->counters[SKC_ERRORS]++;
-          res_text(p, 2, 0, "malformed op: invalid utf-8");
+          res_text(L, 2, 0, "malformed op: invalid utf-8");
           break;
         }
         if (klen == 0) {
           p->counters[SKC_ERRORS]++;
-          res_text(p, 2, 0, "StoreError: key_empty");
+          res_text(L, 2, 0, "StoreError: key_empty");
           break;
         }
         if (key_points > p->max_key_len) {
           p->counters[SKC_ERRORS]++;
           snprintf(tmp, sizeof(tmp), "StoreError: key_too_long: %lld > %lld",
                    (long long)key_points, (long long)p->max_key_len);
-          res_text(p, 2, 0, tmp);
+          res_text(L, 2, 0, tmp);
           break;
         }
         if (vlen > p->max_value_size) {
           p->counters[SKC_ERRORS]++;
-          res_text(p, 2, 0, "StoreError: value_too_large");
+          res_text(L, 2, 0, "StoreError: value_too_large");
           break;
         }
         st.total_operations++;
@@ -798,18 +860,18 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
         if (at < 0) {
           if (expected != 0) {
             p->counters[SKC_CAS_MISSES]++;
-            res_simple(p, 1, 0);  // not_found
+            res_simple(L, 1, 0);  // not_found
             break;
           }
           if (st.live >= p->max_keys) {
             p->counters[SKC_ERRORS]++;
-            res_text(p, 2, 0, "StoreError: store_full");
+            res_text(L, 2, 0, "StoreError: store_full");
             break;
           }
           uint8_t* kv = (uint8_t*)malloc((size_t)(klen + vlen) + 1);
           if (!kv) {
             p->counters[SKC_ERRORS]++;
-            res_text(p, 2, 0, "internal: oom");
+            res_text(L, 2, 0, "internal: oom");
             break;
           }
           memcpy(kv, key, (size_t)klen);
@@ -831,21 +893,21 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
             p->counters[SKC_REHASHES]++;
           }
           p->counters[SKC_CAS_HITS]++;
-          res_simple(p, 0, st.version);
+          res_simple(L, 0, st.version);
           break;
         }
         Entry& e = st.table[(size_t)at];
         if (e.version != expected) {
           p->counters[SKC_CAS_MISSES]++;
           p->counters[SKC_ERRORS]++;
-          res_text(p, 2, e.version, "version_conflict");
+          res_text(L, 2, e.version, "version_conflict");
           break;
         }
         if ((uint32_t)vlen > e.vcap) {
           uint8_t* kv = (uint8_t*)realloc(e.kv, (size_t)(klen + vlen) + 1);
           if (!kv) {
             p->counters[SKC_ERRORS]++;
-            res_text(p, 2, 0, "internal: oom");
+            res_text(L, 2, 0, "internal: oom");
             break;
           }
           e.kv = kv;
@@ -858,13 +920,13 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
         e.epoch = st.mut_epoch;
         e.updated = now;
         p->counters[SKC_CAS_HITS]++;
-        res_simple(p, 0, st.version);
+        res_simple(L, 0, st.version);
         break;
       }
       default: {
         p->counters[SKC_ERRORS]++;
         snprintf(tmp, sizeof(tmp), "unknown opcode %d", (int)opcode);
-        res_text(p, 2, 0, tmp);
+        res_text(L, 2, 0, tmp);
         break;
       }
     }
@@ -874,23 +936,99 @@ static void apply_ops_store(SkPlane* p, Store& st, const uint8_t* data,
 static void flight_wave(SkPlane* p, int64_t first_shard, int64_t total_ops) {
   // one FRE_APPLY record per wave on the C path (the engine's per-slot
   // Python records stay the lifecycle source on both tick paths)
-  const uint64_t head = p->flight_head.load(std::memory_order_relaxed);
+  // fetch_add slot claim: several apply lanes may record concurrently;
+  // each writer owns its claimed slot (a reader racing a write sees one
+  // torn record — metrics-grade, documented in OBSERVABILITY.md)
+  const uint64_t head = p->flight_head.fetch_add(1, std::memory_order_relaxed);
   FrEvent& ev = p->flight[head % SK_FLIGHT_CAP];
   ev.t_ns = mono_ns();
-  ev.slot = p->waves++;
+  ev.slot = p->waves.fetch_add(1, std::memory_order_relaxed);
   ev.batch = (uint64_t)total_ops;
   ev.shard = (uint32_t)(first_shard < 0 ? 0 : first_shard);
   ev.peer = 0xFFFF;
   ev.kind = FRE_APPLY;
   ev.arg = (uint8_t)(total_ops > 255 ? 255 : total_ops);
-  p->flight_head.store(head + 1, std::memory_order_relaxed);
 }
 
 // wave result staging accessors (valid until the next apply call)
-void* sk_out_buf(void* h) { return ((SkPlane*)h)->out_buf.data(); }
-void* sk_out_offs(void* h) { return ((SkPlane*)h)->out_offs.data(); }
+void* sk_out_buf(void* h) { return ((SkPlane*)h)->lane0.out_buf.data(); }
+void* sk_out_offs(void* h) { return ((SkPlane*)h)->lane0.out_offs.data(); }
 int64_t sk_out_count(void* h) {
-  return (int64_t)((SkPlane*)h)->out_offs.size();
+  return (int64_t)((SkPlane*)h)->lane0.out_offs.size();
+}
+
+// Per-worker-lane staging accessors (sk_apply_wave_lane results).
+void* sk_out_buf_lane(void* h, int32_t lane) {
+  SkPlane* p = (SkPlane*)h;
+  if (lane < 0 || (size_t)lane >= p->lanes.size()) return nullptr;
+  return p->lanes[(size_t)lane]->out_buf.data();
+}
+void* sk_out_offs_lane(void* h, int32_t lane) {
+  SkPlane* p = (SkPlane*)h;
+  if (lane < 0 || (size_t)lane >= p->lanes.size()) return nullptr;
+  return p->lanes[(size_t)lane]->out_offs.data();
+}
+
+// Configure per-shard-group apply lanes: ngroups worker lanes, each with
+// its own staging buffers and group mutex (the runtime's shard→group
+// partition is contiguous; group membership only matters to the CALLER —
+// the plane just guarantees lane g's applies exclude plane-wide entry
+// points and nothing else). ngroups=0 clears. MUST be called while no
+// worker is inside a lane apply (the runtime bridge configures before
+// rtm_start). Returns 0, or -1 on a bad count.
+int32_t sk_set_groups(void* h, int32_t ngroups) {
+  SkPlane* p = (SkPlane*)h;
+  if (!p || ngroups < 0 || ngroups > 64) return -1;
+  PlaneGuard lk(p);
+  if (ngroups == 0) {
+    // lanes retained (stable addresses for stragglers); mutexes too
+    return 0;
+  }
+  static const struct LaneNames {
+    char n[64][24];
+    LaneNames() {
+      for (int i = 0; i < 64; i++)
+        snprintf(n[i], sizeof(n[i]), "statekernel.lane%02d", i);
+    }
+  } kLaneNames;
+  while ((int32_t)p->lanes.size() < ngroups) {
+    const size_t i = p->lanes.size();
+    p->lanes.push_back(std::make_unique<SkLane>());
+    p->lane_mus.push_back(
+        std::make_unique<rabia::RecursiveMutex>(kLaneNames.n[i & 63]));
+  }
+  return 0;
+}
+
+// The lane-parameterized wave apply core. Caller holds a lock covering
+// every store the wave touches (PlaneGuard, or one group mutex when the
+// wave is group-pure).
+static int64_t apply_wave_into(SkPlane* p, SkLane& L, const uint8_t* data,
+                               const int64_t* cmd_offsets,
+                               const int64_t* shards, const int64_t* starts,
+                               const int64_t* idxs, int64_t n_idx,
+                               double now, int32_t want) RABIA_NO_TSA {
+  L.staging = want != 0;
+  L.out_buf.clear();
+  L.out_offs.clear();
+  int64_t first_shard = -1;
+  int64_t total_ops = 0;
+  const int64_t n_stores = (int64_t)p->stores.size();
+  for (int64_t i = 0; i < n_idx; i++) {
+    const int64_t idx = idxs[i];
+    int64_t s = shards[idx] % n_stores;
+    if (s < 0) s += n_stores;
+    if (first_shard < 0) first_shard = s;
+    Store& st = p->stores[(size_t)s];
+    const int64_t lo = starts[idx], hi = starts[idx + 1];
+    total_ops += hi - lo;
+    apply_ops_store(p, L, st, data, cmd_offsets, lo, hi, now);
+  }
+  if (L.staging) L.out_offs.push_back((int64_t)L.out_buf.size());
+  p->counters[SKC_WAVES]++;
+  p->counters[SKC_BYTES_OUT] += (uint64_t)L.out_buf.size();
+  flight_wave(p, first_shard, total_ops);
+  return (int64_t)L.out_buf.size();
 }
 
 // Apply one decided wave: for each selected covered-index `idxs[i]` the
@@ -907,28 +1045,29 @@ int64_t sk_apply_wave(void* h, const uint8_t* data,
                       int64_t n_idx, double now, int32_t want) {
   SkPlane* p = (SkPlane*)h;
   if (!p || n_idx < 0) return -2;
-  rabia::RecursiveLock lk(p->mu);
-  p->staging = want != 0;
-  p->out_buf.clear();
-  p->out_offs.clear();
-  int64_t first_shard = -1;
-  int64_t total_ops = 0;
-  const int64_t n_stores = (int64_t)p->stores.size();
-  for (int64_t i = 0; i < n_idx; i++) {
-    const int64_t idx = idxs[i];
-    int64_t s = shards[idx] % n_stores;
-    if (s < 0) s += n_stores;
-    if (first_shard < 0) first_shard = s;
-    Store& st = p->stores[(size_t)s];
-    const int64_t lo = starts[idx], hi = starts[idx + 1];
-    total_ops += hi - lo;
-    apply_ops_store(p, st, data, cmd_offsets, lo, hi, now);
-  }
-  if (p->staging) p->out_offs.push_back((int64_t)p->out_buf.size());
-  p->counters[SKC_WAVES]++;
-  p->counters[SKC_BYTES_OUT] += (uint64_t)p->out_buf.size();
-  flight_wave(p, first_shard, total_ops);
-  return (int64_t)p->out_buf.size();
+  PlaneGuard lk(p);
+  return apply_wave_into(p, p->lane0, data, cmd_offsets, shards, starts,
+                         idxs, n_idx, now, want);
+}
+
+// Thread-per-shard-group wave apply: worker `lane`'s GROUP-PURE wave
+// (every shard in the wave belongs to the lane's group) applies under
+// ONLY that group's mutex, staging results into the lane's private
+// buffers (sk_out_buf_lane / sk_out_offs_lane — no further lock needed
+// to read them: the lane has a single owner thread). N workers applying
+// to different groups no longer serialize on the plane mutex; plane-wide
+// readers (sk_get, exports, snapshots) exclude every lane by taking all
+// group mutexes through the PlaneGuard.
+int64_t sk_apply_wave_lane(void* h, int32_t lane, const uint8_t* data,
+                           const int64_t* cmd_offsets, const int64_t* shards,
+                           const int64_t* starts, const int64_t* idxs,
+                           int64_t n_idx, double now, int32_t want) {
+  SkPlane* p = (SkPlane*)h;
+  if (!p || n_idx < 0) return -2;
+  if (lane < 0 || (size_t)lane >= p->lanes.size()) return -2;
+  rabia::RecursiveLock lg(*p->lane_mus[(size_t)lane]);
+  return apply_wave_into(p, *p->lanes[(size_t)lane], data, cmd_offsets,
+                         shards, starts, idxs, n_idx, now, want);
 }
 
 // ---------------------------------------------------------------------------
@@ -951,7 +1090,7 @@ int64_t sk_apply_wave(void* h, const uint8_t* data,
 // only a FULL snapshot is faithful, or -1 on a bad store index.
 int64_t sk_snapshot_delta_size(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   if (st.dels_overflow) return -3;
@@ -969,7 +1108,7 @@ int64_t sk_snapshot_delta_size(void* h, int64_t idx) {
 // checkpoint write never loses dirty state.
 int64_t sk_snapshot_delta(void* h, int64_t idx, uint8_t* out, int64_t cap) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return -1;
   Store& st = p->stores[(size_t)idx];
   if (st.dels_overflow) return -3;
@@ -1010,7 +1149,7 @@ int64_t sk_snapshot_delta(void* h, int64_t idx, uint8_t* out, int64_t cap) {
 // written is now "clean"; future mutations stamp the new epoch.
 void sk_snapshot_mark(void* h, int64_t idx) {
   SkPlane* p = (SkPlane*)h;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (idx < 0 || (size_t)idx >= p->stores.size()) return;
   Store& st = p->stores[(size_t)idx];
   st.mut_epoch++;
@@ -1027,18 +1166,19 @@ int64_t sk_apply_ops(void* h, int64_t store_idx, const uint8_t* data,
                      int32_t want) {
   SkPlane* p = (SkPlane*)h;
   if (!p) return -2;
-  rabia::RecursiveLock lk(p->mu);
+  PlaneGuard lk(p);
   if (store_idx < 0 || (size_t)store_idx >= p->stores.size()) return -2;
-  p->staging = want != 0;
-  p->out_buf.clear();
-  p->out_offs.clear();
+  SkLane& L = p->lane0;
+  L.staging = want != 0;
+  L.out_buf.clear();
+  L.out_offs.clear();
   Store& st = p->stores[(size_t)store_idx];
-  apply_ops_store(p, st, data, cmd_offsets, 0, n_ops, now);
-  if (p->staging) p->out_offs.push_back((int64_t)p->out_buf.size());
+  apply_ops_store(p, L, st, data, cmd_offsets, 0, n_ops, now);
+  if (L.staging) L.out_offs.push_back((int64_t)L.out_buf.size());
   p->counters[SKC_WAVES]++;
-  p->counters[SKC_BYTES_OUT] += (uint64_t)p->out_buf.size();
+  p->counters[SKC_BYTES_OUT] += (uint64_t)L.out_buf.size();
   flight_wave(p, store_idx, n_ops);
-  return (int64_t)p->out_buf.size();
+  return (int64_t)L.out_buf.size();
 }
 
 }  // extern "C"

@@ -113,9 +113,10 @@ int main() {
   memcpy(uuids, id_a, 16);
   memcpy(uuids + 16, id_b, 16);
 
-  const int64_t dims[10] = {kS, kS, /*R=*/2, /*me=*/0, kDecRing,
+  const int64_t dims[11] = {kS, kS, /*R=*/2, /*me=*/0, kDecRing,
                             /*native_apply=*/0, 1 << 20, 1 << 20,
-                            /*max_cmds=*/64, /*max_cmd_size=*/4096};
+                            /*max_cmds=*/64, /*max_cmd_size=*/4096,
+                            /*workers=*/1};
   const int64_t ptrs[17] = {
       /*rk_ctx*/ 1,  // opaque to the stubs
       (int64_t)a,
@@ -127,13 +128,14 @@ int main() {
       (int64_t)ring_val.data(), (int64_t)kslot.data(),
       (int64_t)kdecided.data(), (int64_t)kdone.data(),
       (int64_t)knewly.data(), /*wal*/ 0};
-  const int64_t fns[16] = {
+  const int64_t fns[20] = {
       (int64_t)&rt_recv_borrow, (int64_t)&rt_recv_release,
       (int64_t)&rt_broadcast_frames, (int64_t)&rt_send,
       (int64_t)&stub_rk_ingest, (int64_t)&stub_rk_tick,
       (int64_t)&stub_rk_retransmit, (int64_t)&stub_rk_drain_stale,
       0, 0, 0, 0, 0,  // FN_SK_* (native_apply=0)
-      0, 0, 0};       // FN_WAL_*
+      0, 0, 0,        // FN_WAL_*
+      0, 0, 0, 0};    // FN_RECV_BORROW_GROUP / FN_SK_*_LANE (workers=1)
   const double fparams[4] = {1.0, 30.0, 0.2, 0.05};
 
   void* rtm = rtm_create(dims, ptrs, fns, uuids, fparams);

@@ -94,9 +94,12 @@ enum : int32_t {
   // -- chaos shaping layer (rt_set_shaping, v2) ------------------------
   RTC_SHAPE_DROPPED,     // outbound frames dropped by per-peer shaping
   RTC_SHAPE_DELAYED,     // outbound frames held in the delay queue
+  RTC_GROUP_FRAMES,      // frames delivered through per-group inboxes
+                         // (fan-out counted: one frame to 2 groups = 2)
+  RTC_GROUP_COPIES,      // extra buffer copies for multi-group frames
   RTC_COUNT
 };
-constexpr int32_t kCountersVersion = 2;
+constexpr int32_t kCountersVersion = 3;
 
 // Flight recorder: one compact record per frame in/out, so a transport
 // stall is attributable after the fact (the engine's flight merger folds
@@ -166,6 +169,32 @@ struct Conn {
   size_t woff = 0;  // offset into *wqueue.front()
 };
 
+// Per-shard-group inbox (the thread-per-shard-group runtime): the io
+// loop classifies each inbound frame by the shard groups it carries
+// (rt_set_groups installs the classifier — runtime.cpp's
+// rtm_frame_group_mask) and delivers to each flagged group's own queue,
+// so N runtime workers pull frames without contending one lock per
+// frame. Each GroupInbox has its OWN mutex/condvar: the io thread takes
+// it briefly at delivery (lock order: Transport::mu -> gmu), a worker
+// takes only its group's — workers never touch the transport-wide `mu`
+// on the frame path. Borrowed frames and their recycled buffers are
+// group-local; the io thread sweeps `recycle` back into the shared
+// arena at its next delivery to that group.
+struct GroupInbox {
+  rabia::Mutex gmu{"transport.group"};
+  rabia::CondVar cv;
+  // rt_inbox_kick spurious-wake generation, mirroring the main inbox
+  std::atomic<uint64_t> kick_gen{0};
+  std::deque<InboundMsg> q RABIA_GUARDED_BY(gmu);
+  std::map<int64_t, std::vector<uint8_t>> borrowed RABIA_GUARDED_BY(gmu);
+  int64_t next_token RABIA_GUARDED_BY(gmu) = 1;
+  std::vector<std::vector<uint8_t>> recycle RABIA_GUARDED_BY(gmu);
+};
+
+// classifier: returns a bitmask of groups a frame must reach (bit g =
+// deliver to group g); 0 means "group 0" (control/unparseable frames)
+typedef uint64_t (*rt_classify_t)(void*, const uint8_t*, uint32_t);
+
 struct Peer {
   std::string host;
   uint16_t port = 0;
@@ -202,6 +231,15 @@ struct Transport {
   std::map<NodeIdBytes, int> mux_sessions RABIA_GUARDED_BY(mu);
   std::deque<InboundMsg> inbox RABIA_GUARDED_BY(mu);
   rabia::CondVar inbox_cv;
+  // per-shard-group inboxes (0 = routing off, the single legacy inbox).
+  // `groups` entries are stable once created: rt_set_groups only runs
+  // while no worker thread is inside a _group call (the runtime bridge
+  // installs routing before rtm_start and clears it after rtm_stop), and
+  // the vector never shrinks, so workers index it without `mu`.
+  std::atomic<int32_t> ngroups{0};
+  std::vector<std::unique_ptr<GroupInbox>> groups;
+  rt_classify_t classify RABIA_GUARDED_BY(mu) = nullptr;
+  void* classify_arg RABIA_GUARDED_BY(mu) = nullptr;
   // rt_inbox_kick: spurious-wake generation counter. A waiter samples it
   // before waiting and also wakes when it changes (see rt_recv_borrow),
   // so a kick staged between the sample and the wait is never lost.
@@ -427,6 +465,7 @@ struct Transport {
 
   void io_loop() RABIA_EXCLUDES(mu);
   void handle_readable(int fd) RABIA_REQUIRES(mu);
+  void deliver_groups_locked(InboundMsg&& m, int32_t ng) RABIA_REQUIRES(mu);
   void handle_writable(int fd) RABIA_REQUIRES(mu);
   void try_dials() RABIA_REQUIRES(mu);
   void drain_shutdown(int fd, Conn& c) RABIA_REQUIRES(mu);
@@ -652,17 +691,75 @@ void Transport::handle_readable(int fd) RABIA_REQUIRES(mu) {
     bump(RTC_FRAMES_IN);
     bump(RTC_BYTES_IN, len);
     tf_rec(0, m.sender, len, len >= 2 ? m.data[1] : 0);
-    if (inbox.size() >= kMaxInbox) {
-      pool_put_locked(std::move(inbox.front().data));
-      inbox.pop_front();
-      dropped_frames++;
-      bump(RTC_INBOX_DROPPED);
+    const int32_t ng = ngroups.load(std::memory_order_acquire);
+    if (ng > 0) {
+      // thread-per-shard-group routing: classify by the shards the
+      // frame carries and deliver to each flagged group's own inbox
+      deliver_groups_locked(std::move(m), ng);
+    } else {
+      if (inbox.size() >= kMaxInbox) {
+        pool_put_locked(std::move(inbox.front().data));
+        inbox.pop_front();
+        dropped_frames++;
+        bump(RTC_INBOX_DROPPED);
+      }
+      inbox.push_back(std::move(m));
     }
-    inbox.push_back(std::move(m));
     off += 4 + len;
   }
   if (off) c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + off);
   if (!inbox.empty()) inbox_cv.notify_all();
+}
+
+// Route one inbound frame to its shard groups' inboxes. Multi-group
+// frames (a workers=1 peer's mixed vote batch) are copied per extra
+// group — each worker's rk ctx ingests only its own shard range, so
+// every group must see the whole frame. The classifier is pure and
+// read-only (runtime.cpp rtm_frame_group_mask); mask 0 or unroutable
+// frames (Propose/sync/admin/malformed) land in group 0, whose worker
+// owns control-plane escalation.
+void Transport::deliver_groups_locked(InboundMsg&& m, int32_t ng)
+    RABIA_REQUIRES(mu) {
+  uint64_t mask =
+      classify ? classify(classify_arg, m.data.data(),
+                          (uint32_t)m.data.size())
+               : 1;
+  const uint64_t all = ng >= 64 ? ~0ull : ((1ull << ng) - 1);
+  mask &= all;
+  if (!mask) mask = 1;
+  const int32_t last = 63 - __builtin_clzll(mask);
+  for (int32_t g = 0; g < ng; g++) {
+    if (!(mask & (1ull << g))) continue;
+    GroupInbox& G = *groups[(size_t)g];
+    InboundMsg d;
+    d.sender = m.sender;
+    if (g == last) {
+      d.data = std::move(m.data);
+    } else {
+      d.data = pool_get_locked(m.data.size());
+      d.data.assign(m.data.begin(), m.data.end());
+      bump(RTC_GROUP_COPIES);
+    }
+    bump(RTC_GROUP_FRAMES);
+    {
+      rabia::MutexLock lg(G.gmu);
+      // sweep this group's released borrow buffers back into the arena
+      // (the worker recycles lock-cheap; only the io thread, already
+      // holding `mu`, touches the shared pool)
+      if (!G.recycle.empty()) {
+        for (auto& v : G.recycle) pool_put_locked(std::move(v));
+        G.recycle.clear();
+      }
+      if (G.q.size() >= kMaxInbox) {
+        pool_put_locked(std::move(G.q.front().data));
+        G.q.pop_front();
+        dropped_frames++;
+        bump(RTC_INBOX_DROPPED);
+      }
+      G.q.push_back(std::move(d));
+    }
+    G.cv.notify_all();
+  }
 }
 
 void Transport::handle_writable(int fd) RABIA_REQUIRES(mu) {
@@ -1136,8 +1233,102 @@ int64_t rt_recv_borrow(void* h, uint8_t sender_out[16],
 // valid until then (reclamation only happens under `mu` in borrow).
 void rt_recv_release(void* h, int64_t token) {
   auto* t = static_cast<Transport*>(h);
+  const int64_t g = (token >> 48) - 1;
+  if (g >= 0) {
+    // group-encoded token (rt_recv_borrow_group): recycle group-locally;
+    // the io thread sweeps the buffers back into the shared arena at its
+    // next delivery. Callers release on the borrowing worker's thread.
+    if ((size_t)g < t->groups.size()) {
+      GroupInbox& G = *t->groups[(size_t)g];
+      rabia::MutexLock lg(G.gmu);
+      auto it = G.borrowed.find(token & 0xFFFFFFFFFFFFll);
+      if (it != G.borrowed.end()) {
+        if (G.recycle.size() < 256) G.recycle.push_back(std::move(it->second));
+        G.borrowed.erase(it);
+      }
+    }
+    return;
+  }
   rabia::MutexLock lr(t->mu_rel);
   t->released.push_back(token);
+}
+
+// Zero-copy receive from one shard group's inbox (rt_set_groups routing
+// active). Same contract as rt_recv_borrow; the returned token routes
+// its release back to the group. Returns -3 timeout, -1 closed/invalid.
+int64_t rt_recv_borrow_group(void* h, int32_t group, uint8_t sender_out[16],
+                             const uint8_t** ptr_out, uint32_t* len_out,
+                             int timeout_ms) {
+  auto* t = static_cast<Transport*>(h);
+  const int32_t ng = t->ngroups.load(std::memory_order_acquire);
+  if (group < 0 || group >= ng) return -1;
+  GroupInbox& G = *t->groups[(size_t)group];
+  rabia::MutexLock lk(G.gmu);
+  if (G.q.empty() && timeout_ms != 0) {
+    const uint64_t k0 = G.kick_gen.load(std::memory_order_relaxed);
+    const timespec dl =
+        rabia::CondVar::deadline_in((double)timeout_ms * 1e-3);
+    while (G.q.empty() && !t->stopping.load() &&
+           G.kick_gen.load(std::memory_order_relaxed) == k0) {
+      if (!G.cv.wait_until(lk, dl)) break;
+    }
+  }
+  if (G.q.empty()) return t->stopping.load() ? -1 : -3;
+  InboundMsg m = std::move(G.q.front());
+  G.q.pop_front();
+  memcpy(sender_out, m.sender.data(), 16);
+  int64_t tok = G.next_token++;
+  auto& slot = G.borrowed[tok];
+  slot = std::move(m.data);
+  *ptr_out = slot.data();
+  *len_out = static_cast<uint32_t>(slot.size());
+  t->bump(RTC_BORROWS);
+  return ((int64_t)(group + 1) << 48) | tok;
+}
+
+// Install (ngroups >= 1) or clear (ngroups == 0) per-shard-group frame
+// routing. classify_fn(arg, data, len) -> group bitmask (0 = group 0).
+// MUST be called while no thread is inside a _group entry point — the
+// runtime bridge installs routing before rtm_start and clears it after
+// rtm_stop. On clear, undelivered group frames merge back into the
+// legacy inbox so a re-attached Python reader sees them.
+int rt_set_groups(void* h, int32_t ngroups, void* classify_fn, void* arg) {
+  auto* t = static_cast<Transport*>(h);
+  if (ngroups < 0 || ngroups > 64) return -1;
+  rabia::MutexLock lk(t->mu);
+  if (ngroups == 0) {
+    t->ngroups.store(0, std::memory_order_release);
+    t->classify = nullptr;
+    t->classify_arg = nullptr;
+    for (auto& gp : t->groups) {
+      if (!gp) continue;
+      rabia::MutexLock lg(gp->gmu);
+      while (!gp->q.empty()) {
+        if (t->inbox.size() < kMaxInbox) {
+          t->inbox.push_back(std::move(gp->q.front()));
+        } else {
+          // legacy inbox full: drop like every other overflow path —
+          // counted, buffer recycled (not silently destroyed)
+          t->pool_put_locked(std::move(gp->q.front().data));
+          t->dropped_frames++;
+          t->bump(RTC_INBOX_DROPPED);
+        }
+        gp->q.pop_front();
+      }
+      for (auto& v : gp->recycle) t->pool_put_locked(std::move(v));
+      gp->recycle.clear();
+    }
+    // `groups` entries are retained: a straggling release may still
+    // index them (GroupInbox addresses are stable behind unique_ptr)
+    if (!t->inbox.empty()) t->inbox_cv.notify_all();
+    return 0;
+  }
+  while ((int32_t)t->groups.size() < ngroups)
+    t->groups.push_back(std::make_unique<GroupInbox>());
+  t->classify = (rt_classify_t)classify_fn;
+  t->classify_arg = arg;
+  t->ngroups.store(ngroups, std::memory_order_release);
+  return 0;
 }
 
 // Buffer-arena counters (memory_pool.rs PoolStats analog).
@@ -1219,6 +1410,13 @@ void rt_inbox_kick(void* h) {
   auto* t = static_cast<Transport*>(h);
   t->kick_gen.fetch_add(1, std::memory_order_relaxed);
   t->inbox_cv.notify_all();
+  // wake every shard-group worker too (same lock-free contract)
+  const int32_t ng = t->ngroups.load(std::memory_order_acquire);
+  for (int32_t g = 0; g < ng; g++) {
+    GroupInbox& G = *t->groups[(size_t)g];
+    G.kick_gen.fetch_add(1, std::memory_order_relaxed);
+    G.cv.notify_all();
+  }
 }
 
 // Stop the io loop and unblock any rt_recv caller WITHOUT deleting the
@@ -1232,6 +1430,8 @@ void rt_stop(void* h) {
     rabia::MutexLock lk(t->mu);
     t->inbox_cv.notify_all();
   }
+  const int32_t ng = t->ngroups.load(std::memory_order_acquire);
+  for (int32_t g = 0; g < ng; g++) t->groups[(size_t)g]->cv.notify_all();
   uint64_t one = 1;
   (void)!::write(t->wake_fd, &one, 8);
 }
@@ -1249,6 +1449,10 @@ void rt_close(void* h) {
   {
     rabia::MutexLock lk(t->mu);
     t->inbox_cv.notify_all();
+  }
+  {
+    const int32_t ng = t->ngroups.load(std::memory_order_acquire);
+    for (int32_t g = 0; g < ng; g++) t->groups[(size_t)g]->cv.notify_all();
   }
   uint64_t one = 1;
   (void)!::write(t->wake_fd, &one, 8);

@@ -40,6 +40,17 @@ int64_t rt_recv_borrow(void* h, uint8_t sender_out[16],
                        const uint8_t** data_out, uint32_t* len_out,
                        int timeout_ms);
 void rt_recv_release(void* h, int64_t token);
+// Thread-per-shard-group routing: install (ngroups >= 1) or clear
+// (ngroups == 0) per-group inbound-frame classification. classify_fn is
+// `uint64_t (*)(void* arg, const uint8_t* data, uint32_t len)` returning
+// a group bitmask (0 = group 0). Call only while no thread is inside a
+// _group entry point (install before rtm_start, clear after rtm_stop).
+int rt_set_groups(void* h, int32_t ngroups, void* classify_fn, void* arg);
+// Zero-copy receive from one shard group's inbox; the returned token is
+// group-encoded and releases through rt_recv_release as usual.
+int64_t rt_recv_borrow_group(void* h, int32_t group, uint8_t sender_out[16],
+                             const uint8_t** data_out, uint32_t* len_out,
+                             int timeout_ms);
 // Writes up to cap established peer ids (16B each); returns the count.
 int rt_connected(void* h, uint8_t* ids_out, int cap);
 uint16_t rt_port(void* h);

@@ -66,6 +66,9 @@ RT_COUNTER_NAMES = (
     # chaos shaping layer (RTC v2)
     "shape_dropped",
     "shape_delayed",
+    # thread-per-shard-group inbox routing (RTC v3)
+    "group_frames",
+    "group_copies",
 )
 
 

@@ -16,7 +16,7 @@ import asyncio
 import json
 import os
 import re
-from typing import Sequence
+from typing import Optional, Sequence
 
 
 async def run_schedule_on_both_planes(
@@ -504,6 +504,7 @@ async def run_schedule_on_runtime_paths(
     *,
     tag: str = "",
     require_native: bool = True,
+    workers: Optional[int] = None,
 ) -> None:
     """Native-runtime vs asyncio-orchestration conformance (the engine
     runtime gate, extending the tick-path gate family).
@@ -513,14 +514,21 @@ async def run_schedule_on_runtime_paths(
     (native/runtime.cpp) and the asyncio semantics owner
     (``RABIA_PY_RUNTIME=1``) — and must produce identical per-shard
     decision ledgers, byte-identical client responses, identical replica
-    state checksums and counter parity. Shared by tests/test_runtime.py
-    and ``fuzz_conformance.py --runtime``. Divergence dumps both legs'
+    state checksums and counter parity. ``workers`` pins the runtime
+    leg's thread-per-shard-group worker count (via ``RABIA_RT_WORKERS``;
+    None = inherit the environment), so the same gate pins workers=N vs
+    asyncio, and a caller comparing two ``workers`` values transitively
+    pins workers=N vs workers=1. Shared by tests/test_runtime.py and
+    ``fuzz_conformance.py --runtime``. Divergence dumps both legs'
     flight captures to ``$RABIA_FLIGHT_DIR``.
     """
     import os
 
     prev = os.environ.pop("RABIA_PY_RUNTIME", None)
+    prev_w = os.environ.get("RABIA_RT_WORKERS")
     try:
+        if workers is not None:
+            os.environ["RABIA_RT_WORKERS"] = str(workers)
         dec_rt, sums_rt, resp_rt, active, obs_rt = (
             await _run_runtime_schedule(
                 schedule, n_shards, n_replicas, tag=f"{tag}[runtime]"
@@ -540,6 +548,11 @@ async def run_schedule_on_runtime_paths(
             os.environ.pop("RABIA_PY_RUNTIME", None)
         else:
             os.environ["RABIA_PY_RUNTIME"] = prev
+        if workers is not None:
+            if prev_w is None:
+                os.environ.pop("RABIA_RT_WORKERS", None)
+            else:
+                os.environ["RABIA_RT_WORKERS"] = prev_w
     ctx = (
         f"counters[runtime]={obs_rt['parity']} "
         f"counters[asyncio]={obs_py['parity']} "

@@ -50,6 +50,15 @@ class GatewayCluster:
         self.n_shards = n_shards
         self.config = config or default_gateway_test_config(n_shards)
         self.gateway_config = gateway_config or GatewayConfig()
+        if self.gateway_config.runtime_workers is not None:
+            # GatewayConfig.runtime_workers flows into the engine config
+            # (thread-per-shard-group native runtime worker count)
+            from dataclasses import replace
+
+            self.config = replace(
+                self.config,
+                runtime_workers=self.gateway_config.runtime_workers,
+            )
         self.ids = [NodeId.from_int(i + 1) for i in range(n_replicas)]
         self.nets: list[TcpNetwork] = []
         self.engines: list[RabiaEngine] = []

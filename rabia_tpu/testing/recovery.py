@@ -334,6 +334,10 @@ async def run_crash_recovery_trial(
             "rejoined": rejoined,
             "post_rejoin_goodput_ok": post_rejoin_ok,
             "planes": report.get("planes"),
+            # the harness's WAL root survives h.stop(): callers scan the
+            # killed replica's log post-trial (LSN continuity asserts)
+            "wal_root": h.wal_root,
+            "kill_index": kill_index,
         }
     finally:
         h.stop()

@@ -50,7 +50,42 @@ ALIASES: dict[str, str] = {
     "RKC_CARRY": "carries",
     "RKC_SCATTER": "ledger_scatters",
     "RTC_BORROWS": "arena_borrows",
+    # runtime.cpp FN_* function-pointer table vs runtime_bridge._FN_ORDER:
+    # the Python names ARE the exported symbol names, so every entry is
+    # an "irregular spelling" from the enum's point of view
+    "FN_RECV_BORROW": "rt_recv_borrow",
+    "FN_RECV_RELEASE": "rt_recv_release",
+    "FN_BCAST_FRAMES": "rt_broadcast_frames",
+    "FN_SEND": "rt_send",
+    "FN_RK_INGEST": "rk_ingest",
+    "FN_RK_TICK": "rk_tick",
+    "FN_RK_RETRANSMIT": "rk_retransmit",
+    "FN_RK_DRAIN_STALE": "rk_drain_stale",
+    "FN_SK_APPLY_WAVE": "sk_apply_wave",
+    "FN_SK_OUT_BUF": "sk_out_buf",
+    "FN_SK_OUT_OFFS": "sk_out_offs",
+    "FN_SK_PLANE_LOCK": "sk_plane_lock",
+    "FN_SK_PLANE_UNLOCK": "sk_plane_unlock",
+    "FN_WAL_APPEND": "wal_append",
+    "FN_WAL_BARRIER": "wal_barrier_covered",
+    "FN_WAL_DURABLE": "wal_durable",
+    "FN_RECV_BORROW_GROUP": "rt_recv_borrow_group",
+    "FN_SK_APPLY_WAVE_LANE": "sk_apply_wave_lane",
+    "FN_SK_OUT_BUF_LANE": "sk_out_buf_lane",
+    "FN_SK_OUT_OFFS_LANE": "sk_out_offs_lane",
 }
+
+# the per-worker observability accessor family (thread-per-shard-group
+# runtime): every `rtm_*_w` export in runtime.cpp must have a ctypes
+# prototype in native/build.py and vice versa — a block added on one
+# side only would scrape garbage addresses or read as zeros silently
+PER_WORKER_ACCESSORS = (
+    "rtm_counters_w",
+    "rtm_stages_w",
+    "rtm_hist_w",
+    "rtm_flight_w",
+    "rtm_flight_head_w",
+)
 
 
 @dataclass
@@ -318,6 +353,27 @@ def run(root: Path) -> list[Violation]:
                        "RTM_COUNTER_NAMES")
     check_counter_pair(v, rt, "RTS_COUNT", "RTS_", bridge,
                        "RTM_STAGE_NAMES")
+    # the function-pointer table (rtm_create's fns argument): index
+    # order IS the ABI — a reordered/missing entry calls the wrong
+    # kernel entry point with the wrong signature
+    check_counter_pair(v, rt, "FN_COUNT", "FN_", bridge, "_FN_ORDER")
+
+    # per-worker observability accessors (thread-per-shard-group
+    # runtime): declared in BOTH runtime.cpp and native/build.py
+    rt_text = rt.read_text()
+    build_text = (native / "build.py").read_text()
+    for acc in PER_WORKER_ACCESSORS:
+        in_cpp = bool(
+            re.search(rf"\b{acc}\s*\(\s*void\s*\*\s*ctx", rt_text)
+        )
+        in_py = f"lib.{acc}.restype" in build_text
+        if not (in_cpp and in_py):
+            v.append(Violation(
+                "geometry", "runtime.cpp <-> build.py :: per-worker "
+                "blocks",
+                f"{acc}: declared in "
+                f"{'C++ only' if in_cpp else 'Python only' if in_py else 'neither side'}",
+            ))
 
     # version literals declared on both sides
     check_versions(v, gw, "GWS_COUNTERS_VERSION", sess,

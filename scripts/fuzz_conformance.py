@@ -34,6 +34,7 @@ repro seed on the first divergence.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from pathlib import Path
@@ -365,6 +366,12 @@ async def _trial_runtime_paths(seed: int) -> None:
     rng = np.random.default_rng(seed + 733)
     S = int(rng.choice([2, 3, 4]))
     R = int(rng.choice([3, 5]))
+    # thread-per-shard-group geometry: half the trials run the runtime
+    # leg multi-worker (clamped by the shard count) so worker routing
+    # fuzzes alongside the schedules; an explicit RABIA_RT_WORKERS (the
+    # CI matrix cell) pins the geometry instead
+    env_w = os.environ.get("RABIA_RT_WORKERS")
+    workers = None if env_w else min(int(rng.choice([1, 2])), S)
     waves = int(rng.integers(3, 6))
     schedule = []
     for w in range(waves):
@@ -382,11 +389,14 @@ async def _trial_runtime_paths(seed: int) -> None:
         )
     try:
         await run_schedule_on_runtime_paths(
-            schedule, n_shards=S, n_replicas=R, tag=f"runtime seed={seed}"
+            schedule, n_shards=S, n_replicas=R,
+            tag=f"runtime seed={seed} workers={workers or env_w or 'auto'}",
+            workers=workers,
         )
     except AssertionError as e:
         print(
-            f"runtime-path divergence (seed={seed}, S={S}, R={R}): {e}",
+            f"runtime-path divergence (seed={seed}, S={S}, R={R}, "
+            f"workers={workers or env_w or 'auto'}): {e}",
             file=sys.stderr,
         )
         raise

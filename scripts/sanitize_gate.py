@@ -46,8 +46,8 @@ def run_cell(
     build_s = time.monotonic() - t0
     with tempfile.TemporaryDirectory(prefix="sanitize-wal-") as tmp:
         args = [str(exe)]
-        if name == "wal":
-            args.append(tmp)
+        if name in ("wal", "runtime_mt"):
+            args.append(tmp)  # these stress a real on-disk WAL
         t1 = time.monotonic()
         proc = subprocess.run(
             args, capture_output=True, text=True, timeout=timeout,

@@ -430,10 +430,15 @@ class TestTelemetryRing:
             g0 = cluster.gateways[0]
             # submit->result SLO histogram observed fresh submits
             assert g0._h_submit_result.count >= 10
-            # health reports active planes
+            # health reports active planes (+ the thread-per-shard-group
+            # worker count, round 14)
             planes = g0.health()["planes"]
-            assert set(planes) == {"runtime", "tick", "apply", "gateway"}
+            assert set(planes) == {
+                "runtime", "tick", "apply", "gateway", "runtime_workers",
+            }
             assert planes["gateway"] in ("native", "python")
+            workers = planes.pop("runtime_workers")
+            assert isinstance(workers, int) and workers >= 1
             assert all(v in ("native", "python") for v in planes.values())
             # TIMELINE admin frames serve the ring (query honored)
             body = await admin_fetch(

@@ -89,6 +89,18 @@ class TestSanitizerMatrix:
         _toolchain_or_skip("tsan")
         _run_cell("runtime", "tsan")
 
+    def test_tsan_runtime_mt(self, tmp_path):
+        # the thread-per-shard-group seams: 2 workers vs group inbox
+        # routing, per-lane applies, shared WAL staging, the pause
+        # barrier — the round-14 correctness gate
+        _toolchain_or_skip("tsan")
+        _run_cell("runtime_mt", "tsan", [str(tmp_path)])
+
+    @pytest.mark.slow
+    def test_asan_runtime_mt(self, tmp_path):
+        _toolchain_or_skip("asan")
+        _run_cell("runtime_mt", "asan", [str(tmp_path)])
+
     def test_asan_wal(self, tmp_path):
         _toolchain_or_skip("asan")
         _run_cell("wal", "asan", [str(tmp_path)])
@@ -112,8 +124,9 @@ class TestSanitizerMatrix:
     def test_ubsan_all(self, tmp_path):
         _toolchain_or_skip("ubsan")
         for name in sorted(nb.STRESS_PROGRAMS):
-            args = [str(tmp_path / name)] if name == "wal" else []
-            if name == "wal":
+            needs_dir = name in ("wal", "runtime_mt")
+            args = [str(tmp_path / name)] if needs_dir else []
+            if needs_dir:
                 (tmp_path / name).mkdir()
             _run_cell(name, "ubsan", args)
 
@@ -154,6 +167,7 @@ _LINT_FILES = [
     "rabia_tpu/native/sessionkernel.cpp",
     "rabia_tpu/native/walkernel.cpp",
     "rabia_tpu/native/runtime.cpp",
+    "rabia_tpu/native/build.py",
     "rabia_tpu/engine/native_tick.py",
     "rabia_tpu/engine/runtime_bridge.py",
     "rabia_tpu/apps/native_store.py",
@@ -231,6 +245,31 @@ class TestAbiLint:
         root = _scratch_tree(tmp_path)
         _mutate(root, "rabia_tpu/native/walkernel.cpp",
                 "WLH_SUB_BITS = 2", "WLH_SUB_BITS = 3")
+        assert "geometry" in _rules(root)
+
+    def test_catches_fn_table_drift(self, tmp_path):
+        # the rtm_create function-pointer table: a reordered Python
+        # _FN_ORDER would register kernel entry points at wrong indices
+        root = _scratch_tree(tmp_path)
+        _mutate(root, "rabia_tpu/engine/runtime_bridge.py",
+                '    "rk_ingest",\n    "rk_tick",',
+                '    "rk_tick",\n    "rk_ingest",')
+        assert "order" in _rules(root)
+
+    def test_catches_fn_table_missing_entry(self, tmp_path):
+        root = _scratch_tree(tmp_path)
+        _mutate(root, "rabia_tpu/engine/runtime_bridge.py",
+                '    "sk_out_offs_lane",\n', "")
+        assert "count" in _rules(root)
+
+    def test_catches_per_worker_accessor_drift(self, tmp_path):
+        # a per-worker observability block declared on one side only
+        # (thread-per-shard-group runtime) — here build.py loses its
+        # rtm_stages_w prototype while runtime.cpp keeps the export
+        root = _scratch_tree(tmp_path)
+        _mutate(root, "rabia_tpu/native/build.py",
+                "lib.rtm_stages_w.restype",
+                "lib.rtm_stages_w_RENAMED.restype")
         assert "geometry" in _rules(root)
 
 
@@ -410,3 +449,34 @@ class TestLockOrder:
             timeout=120,
         )
         assert proc.returncode == 0, proc.stderr[-2000:]
+
+    def test_multi_worker_lock_paths_clean_under_debug_flavor(
+        self, tmp_path
+    ):
+        """The multi-worker stress under the lock-order checker: the
+        round-14 nest (transport mu -> group gmu, statekernel mu ->
+        lane mutexes, worker lane locks, walkernel mu) must build a
+        cycle-free acquisition graph with workers > 1."""
+        gxx = self._gxx()
+        native = REPO / "rabia_tpu" / "native"
+        exe = tmp_path / "dbg_rt_mt"
+        build = subprocess.run(
+            [gxx, "-std=c++17", "-O1", "-pthread",
+             "-DRABIA_NATIVE_DEBUG=1", f"-I{native}",
+             str(native / "stress" / "stress_runtime_mt.cpp"),
+             str(native / "runtime.cpp"),
+             str(native / "transport.cpp"),
+             str(native / "statekernel.cpp"),
+             str(native / "walkernel.cpp"),
+             "-o", str(exe), "-lz"],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert build.returncode == 0, build.stderr[-1500:]
+        wal_dir = tmp_path / "wal"
+        wal_dir.mkdir()
+        proc = subprocess.run(
+            [str(exe), str(wal_dir)], capture_output=True, text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "stress ok" in proc.stdout
