@@ -95,6 +95,7 @@ async def run_point(
     connect_parallel: int = 64,
     mux: int = 0,
     shed_fn=None,
+    counters_fn=None,
 ) -> dict:
     """Drive one open-loop point and return its SLO report entry.
 
@@ -102,7 +103,11 @@ async def run_point(
     per session, the pre-mux shape). ``shed_fn``: optional zero-arg
     callable returning the cluster's per-reason shed counter dict —
     sampled before/after the point so a shed-dominated point reports
-    WHY it shed."""
+    WHY it shed. ``counters_fn``: optional zero-arg callable returning a
+    flat dict of cumulative cluster counters (decided slots, coalesce
+    outcomes, WAL fsyncs/barriers) — sampled before/after so each point
+    carries the amortization evidence (slots per committed op, fsyncs
+    per durable Result) the coalescing tier is scored by."""
     from rabia_tpu.apps.kvstore import encode_set_bin
 
     ser = Serializer()
@@ -190,6 +195,7 @@ async def run_point(
     n_sessions = len(sessions)
     dial_s = time.perf_counter() - t_dial
     shed_before = dict(shed_fn()) if shed_fn is not None else None
+    ctr_before = dict(counters_fn()) if counters_fn is not None else None
 
     counts = {k: 0 for k in OUTCOMES}
     lat_ok_ms: list[float] = []
@@ -309,6 +315,34 @@ async def run_point(
             if int(after.get(k, 0)) - int(shed_before.get(k, 0))
         }
 
+    cluster_counters = None
+    derived = {}
+    if ctr_before is not None:
+        after_c = counters_fn()
+        cluster_counters = {
+            k: int(after_c.get(k, 0)) - int(ctr_before.get(k, 0))
+            for k in after_c
+        }
+        ok_results = max(0, counts["ok"])
+        # decided_v1 / wal_fsyncs are summed over replicas (every
+        # replica decides every slot and fsyncs its own log): normalize
+        # to PER-REPLICA rates before dividing by committed results
+        n_rep = max(1, int(ctr_before.get("replicas", 0)) or 1)
+        if ok_results:
+            derived["slots_per_op"] = round(
+                cluster_counters.get("decided_v1", 0) / n_rep / ok_results,
+                3,
+            )
+            derived["fsyncs_per_result"] = round(
+                cluster_counters.get("wal_fsyncs", 0) / n_rep / ok_results,
+                3,
+            )
+        waits = cluster_counters.get("barrier_waits", 0)
+        if waits:
+            derived["results_per_barrier_wait"] = round(
+                cluster_counters.get("barrier_covered", 0) / waits, 2
+            )
+
     completed = sum(counts[k] for k in ("ok", "cached", "shed", "error"))
     good = counts["ok"] + counts["cached"]
     lat_ok_ms.sort()
@@ -319,6 +353,8 @@ async def run_point(
         "mux": mux,
         "connections": len(muxconns) if mux > 0 else n_sessions,
         "shed_reasons": shed_reasons,
+        "cluster_counters": cluster_counters,
+        **derived,
         "arrivals": arrivals_measured,
         "completed": completed,
         "achieved_rps": round(completed / measure, 1),
@@ -446,6 +482,7 @@ async def run(args) -> dict:
         raise SystemExit("--sessions must be one value or match --rates")
 
     cluster = None
+    pmode = None
     if args.external:
         endpoints = []
         for a in args.external.split(","):
@@ -455,17 +492,30 @@ async def run(args) -> dict:
         from rabia_tpu.gateway import GatewayConfig
         from rabia_tpu.testing.gateway_cluster import GatewayCluster
 
+        # persistence plane resolution: --persistence wins, the legacy
+        # --no-persistence spelling maps to "off". Persistence-free
+        # replicas let the GIL-free native engine runtime engage; "wal"
+        # lets it engage too (round 11) AND gates every OK Result on the
+        # durability barrier — the durable-by-default deployment shape.
+        pmode = args.persistence or (
+            "off" if args.no_persistence else "memory"
+        )
+        gw_kwargs: dict = {}
+        if args.coalesce is not None:
+            gw_kwargs["coalesce"] = args.coalesce
+        if args.coalesce_window is not None:
+            gw_kwargs["coalesce_window"] = args.coalesce_window
+            gw_kwargs["coalesce_window_min"] = args.coalesce_window
         cluster = GatewayCluster(
             n_replicas=args.replicas,
             n_shards=args.shards,
             gateway_config=GatewayConfig(
                 max_inflight_per_session=args.session_window,
                 max_queue_depth=args.queue_depth,
+                **gw_kwargs,
             ),
-            # persistence-free replicas let the GIL-free native engine
-            # runtime engage (it declines persistence), so the curve
-            # scores the commit path production deploys run
-            persistence=not args.no_persistence,
+            persistence={"memory": True, "off": False, "wal": "wal"}[pmode],
+            wal_dir=args.wal_dir,
         )
         await cluster.start()
         endpoints = [
@@ -473,6 +523,7 @@ async def run(args) -> dict:
         ]
 
     shed_fn = None
+    counters_fn = None
     planes = None
     if cluster is not None:
 
@@ -481,6 +532,37 @@ async def run(args) -> dict:
             for g in cluster.gateways:
                 for k, v in g.shed_reasons.items():
                     out[k] = out.get(k, 0) + v
+            return out
+
+        def counters_fn() -> dict:
+            # amortization evidence: decided slots, coalesce outcomes,
+            # WAL fsync + barrier counters, summed over the cluster
+            out = {
+                "replicas": 0,
+                "decided_v1": 0, "decided_v0": 0, "wal_fsyncs": 0,
+                "wal_records": 0, "barrier_waits": 0,
+                "barrier_covered": 0, "coalesced": 0, "solo": 0,
+                "sparse": 0, "bypass": 0, "coalesce_waves": 0,
+            }
+            for e in cluster.engines:
+                if e is None:
+                    continue
+                out["replicas"] += 1
+                out["decided_v1"] += int(e.rt.decided_v1)
+                out["decided_v0"] += int(e.rt.decided_v0)
+                wal = getattr(e, "_wal", None)
+                if wal is not None:
+                    ctrs = wal.counters_dict()
+                    out["wal_fsyncs"] += int(ctrs.get("fsyncs", 0))
+                    out["wal_records"] += int(ctrs.get("appends", 0))
+                    out["barrier_waits"] += int(wal.barrier_waits)
+                    out["barrier_covered"] += int(wal.barrier_covered)
+            for g in cluster.gateways:
+                if g is None:
+                    continue
+                for k, v in g.coalesce_outcomes.items():
+                    out[k] = out.get(k, 0) + int(v)
+                out["coalesce_waves"] += int(g.stats.coalesce_waves)
             return out
 
         planes = cluster.gateways[0].health().get("planes")
@@ -508,6 +590,7 @@ async def run(args) -> dict:
                 seed=args.seed,
                 mux=args.mux,
                 shed_fn=shed_fn,
+                counters_fn=counters_fn,
             )
             points.append(pt)
             print(json.dumps(pt), file=sys.stderr)
@@ -543,6 +626,9 @@ async def run(args) -> dict:
             "open_loop": "poisson",
             "seed": args.seed,
             "mux": args.mux,
+            "persistence": pmode,
+            "coalesce": args.coalesce,
+            "coalesce_window": args.coalesce_window,
             # active planes of the driven cluster (in-process runs): the
             # CI gate pins gateway=native on the native-gateway smoke
             # cell, so a silent sessionkernel build failure cannot pass
@@ -591,6 +677,35 @@ def main(argv=None) -> int:
         help="run the in-process cluster's replicas persistence-free so "
         "the native engine runtime engages (planes: runtime=native); "
         "trades away replica-restart support, which loadgen never uses",
+    )
+    ap.add_argument(
+        "--persistence", default=None, choices=("memory", "wal", "off"),
+        help="in-process cluster persistence plane: 'wal' builds the "
+        "native durability plane (group-commit WAL; the native runtime "
+        "engages and every OK Result waits on the durability barrier — "
+        "the durable-by-default deployment shape), 'memory' the "
+        "InMemory layer, 'off' == --no-persistence",
+    )
+    ap.add_argument(
+        "--wal-dir", default=None,
+        help="WAL root for --persistence wal (default: a fresh tempdir; "
+        "point it at the filesystem whose fsync cost you mean to measure)",
+    )
+    ap.add_argument(
+        "--coalesce", dest="coalesce", action="store_true", default=None,
+        help="force the gateway's cross-session submit coalescing lane "
+        "ON (default: the GatewayConfig default)",
+    )
+    ap.add_argument(
+        "--no-coalesce", dest="coalesce", action="store_false",
+        help="force the coalescing lane OFF (the per-submit wave lane "
+        "only — the before-curve shape)",
+    )
+    ap.add_argument(
+        "--coalesce-window", type=float, default=None,
+        help="pin the coalescing window (seconds, min and max both): "
+        "the latency-for-amortization dial. Routed/dense deployments "
+        "run tens of ms; None = the gateway's adaptive default",
     )
     ap.add_argument(
         "--require-plane", action="append", default=[],

@@ -47,7 +47,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=(__doc__ or "").split("\n")[0])
     ap.add_argument(
         "--smoke", action="store_true",
-        help="run the CI smoke subset (3 short profiles: one simulator "
+        help="run the CI smoke subset (4 short profiles: one simulator "
         "adverse-net, one real-TCP shaped, one membership-under-load)",
     )
     ap.add_argument(

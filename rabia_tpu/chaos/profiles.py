@@ -80,6 +80,9 @@ class ChaosProfile:
     min_final_availability: float = 0.05  # last-quarter mean: wedge guard
     require_convergence: bool = True
     seed: int = 20260803
+    # tcp fabric only: GatewayConfig field overrides as a (key, value)
+    # tuple-of-pairs (profiles are frozen/hashable — no dict field)
+    gateway_overrides: tuple = ()
 
     def scaled(self, factor: float) -> "ChaosProfile":
         """Time-scaled copy (the CI smoke cell runs factor < 1)."""
@@ -257,6 +260,46 @@ def default_profiles() -> dict[str, ChaosProfile]:
             min_availability=0.55,
         ),
         _p(
+            "coalesce_flap_restart",
+            "tcp",
+            "Cross-session coalescing lane under compound adversity: a "
+            "flapping partial partition (replica 2's links drop 100% in "
+            "1s bursts) while a proposer restarts mid-run with decided "
+            "coalesced waves whose durability barrier is still pending "
+            "— parked windows must shed retryable (never duplicate-"
+            "apply), multi-client waves must keep packing between "
+            "flaps, and every covered session's Result must stay "
+            "exactly-once through the WAL recovery",
+            duration=12.0,
+            events=[
+                # flapping partial partition: 1s on / 1s off bursts
+                ChaosEvent(1.0, "link_loss", {"src": 2, "dst": 0, "rate": 1.0}),
+                ChaosEvent(1.0, "link_loss", {"src": 2, "dst": 1, "rate": 1.0}),
+                ChaosEvent(2.0, "clear", {}),
+                ChaosEvent(3.0, "link_loss", {"src": 2, "dst": 0, "rate": 1.0}),
+                ChaosEvent(3.0, "link_loss", {"src": 2, "dst": 1, "rate": 1.0}),
+                ChaosEvent(4.0, "clear", {}),
+                # proposer restart mid-load: decided-but-barrier-pending
+                # coalesced waves ride the WAL recovery
+                ChaosEvent(6.0, "restart_replica", {"node": 0}),
+                ChaosEvent(8.0, "link_loss", {"src": 2, "dst": 0, "rate": 1.0}),
+                ChaosEvent(8.0, "link_loss", {"src": 2, "dst": 1, "rate": 1.0}),
+                # cleared well before run end: the flapped replica's
+                # catch-up sync must fit the convergence window even on
+                # a loaded CI host
+                ChaosEvent(9.0, "clear", {}),
+            ],
+            rate=80.0,
+            min_availability=0.45,
+            # pinned coalescing windows so multi-client packing is the
+            # shape under test, not an arrival-rate accident
+            gateway_overrides=(
+                ("coalesce", True),
+                ("coalesce_window", 0.02),
+                ("coalesce_window_min", 0.02),
+            ),
+        ),
+        _p(
             "rolling_restart",
             "tcp",
             "Rolling restart under load: each replica in turn restarts "
@@ -276,7 +319,7 @@ def default_profiles() -> dict[str, ChaosProfile]:
 
 
 def smoke_profiles() -> dict[str, ChaosProfile]:
-    """The CI smoke subset: 3 short profiles — one simulator adverse-net,
+    """The CI smoke subset: 4 short profiles — one simulator adverse-net,
     one real-TCP shaped, one membership change under load — time-scaled
     to keep the cell under a couple of minutes."""
     all_p = default_profiles()
@@ -285,6 +328,7 @@ def smoke_profiles() -> dict[str, ChaosProfile]:
         ("flapping_partition", 0.6),
         ("tcp_shaped_wan", 0.6),
         ("membership_elastic", 0.7),
+        ("coalesce_flap_restart", 0.7),
     ):
         out[name] = all_p[name].scaled(factor)
     return out

@@ -240,12 +240,21 @@ class _TcpFabric:
     SESSIONS_PER_GW = 8
 
     def __init__(self, profile: ChaosProfile) -> None:
+        from rabia_tpu.gateway import GatewayConfig
         from rabia_tpu.testing.gateway_cluster import GatewayCluster
 
         self.profile = profile
+        # profile-pinned gateway knobs (e.g. the coalescing lane's
+        # window for the coalesce_flap_restart scenario)
+        gw_cfg = (
+            GatewayConfig(**dict(profile.gateway_overrides))
+            if profile.gateway_overrides
+            else None
+        )
         self.cluster = GatewayCluster(
             n_replicas=profile.n_replicas,
             n_shards=profile.n_shards,
+            gateway_config=gw_cfg,
             persistence="wal",
         )
         self._ser = None
@@ -416,7 +425,10 @@ class _TcpFabric:
         try:
             await self.cluster.wait_converged(timeout)
             return True
-        except Exception:
+        except Exception as e:
+            # the divergence detail (per-replica checksums/versions/
+            # frontiers) is the evidence a failing matrix row needs
+            print(f"# convergence failure: {e}", file=sys.stderr)
             return False
 
 

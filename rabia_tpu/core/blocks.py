@@ -84,6 +84,7 @@ class PayloadBlock:
         "counts",
         "cmd_sizes",
         "data",
+        "aliases",
         "_cmd_offsets",
         "_shard_starts",
         "_id_cache",
@@ -117,6 +118,17 @@ class PayloadBlock:
         self._cmd_offsets: Optional[np.ndarray] = None
         self._shard_starts: Optional[np.ndarray] = None
         self._id_cache: dict[int, BatchId] = {}
+        # per-entry ALIAS batch ids (proposer-local, NEVER on the wire):
+        # the cross-session coalescing lane packs many clients' commands
+        # into one entry, and each non-lead client's deterministic
+        # (client_id, seq)-derived id rides here as
+        # (bid_bytes16, op_lo, op_hi) — op indices RELATIVE to the
+        # entry's command range. The apply/settle paths register every
+        # alias in the engine's ``applied_ids`` dedup ledger (and stage
+        # K_LEDGER records on durable clusters) so a replayed Submit
+        # dedups exactly like a scalar-lane commit would, even though
+        # the wire only ever carried the entry's lead-derived id.
+        self.aliases: Optional[dict[int, tuple]] = None
 
     # -- derived indices ------------------------------------------------------
 
@@ -164,6 +176,13 @@ class PayloadBlock:
             self._id_cache[i] = bid
         return bid
 
+    def alias_ids_for(self, i: int) -> tuple:
+        """Alias (bid_bytes16, op_lo, op_hi) triples of covered-shard
+        index ``i`` (empty for every lane but the coalescing lane)."""
+        if self.aliases is None:
+            return ()
+        return self.aliases.get(i, ())
+
     def materialize_batch(self, i: int) -> CommandBatch:
         """Build a scalar-lane CommandBatch for covered-shard index ``i``
         (demotion/fallback path). The batch id is the entry's replicated
@@ -178,6 +197,7 @@ class PayloadBlock:
             id=self.batch_id_for(i),
             commands=cmds,
             shard=ShardId(int(self.shards[i])),
+            aliases=self.alias_ids_for(i),
         )
 
     def subset(self, idxs: np.ndarray) -> "PayloadBlock":
@@ -193,7 +213,7 @@ class PayloadBlock:
             lo, hi = int(starts[i]), int(starts[i + 1])
             pieces.append(self.data[int(offs[lo]) : int(offs[hi])])
             sizes.append(self.cmd_sizes[lo:hi])
-        return PayloadBlock(
+        sub = PayloadBlock(
             self.id,
             self.shards[idxs],
             self.slots[idxs],
@@ -201,6 +221,16 @@ class PayloadBlock:
             np.concatenate(sizes) if sizes else np.zeros(0, np.int64),
             b"".join(pieces),
         )
+        if self.aliases:
+            # alias op ranges are entry-relative, so they survive the
+            # subset unchanged — only the covered-shard index remaps
+            remapped = {
+                j: self.aliases[int(i)]
+                for j, i in enumerate(idxs)
+                if int(i) in self.aliases
+            }
+            sub.aliases = remapped or None
+        return sub
 
 
 def build_block(

@@ -225,6 +225,17 @@ class CommandBatch:
     commands: tuple[Command, ...]
     timestamp: float = field(default_factory=time.time)
     shard: ShardId = ShardId(0)
+    # proposer-LOCAL alias batch ids (never serialized): the coalescing
+    # lane's non-lead (client_id, seq)-derived ids as
+    # (bid_bytes16, op_lo, op_hi) triples — the apply path registers
+    # them in the dedup ledger next to ``id`` (core/blocks.py doc).
+    # Equality/hash of a batch stays its ``id``-based dataclass identity;
+    # aliases ride along only so a demoted coalesced entry keeps its
+    # per-client exactly-once bookkeeping on the scalar lane. Excluded
+    # from compare AND repr: the native codec materializes wire-decoded
+    # batches without running __init__, so this attribute may be absent
+    # — consumers read it with getattr(batch, "aliases", ()).
+    aliases: tuple = field(default=(), compare=False, repr=False)
 
     @staticmethod
     def new(

@@ -44,6 +44,7 @@ import ctypes
 import logging
 import os
 import time
+import uuid
 from typing import Optional, Sequence
 
 import numpy as np
@@ -106,6 +107,7 @@ from rabia_tpu.core.types import (
     ABSENT,
     V0,
     V1,
+    BatchId,
     CommandBatch,
     NodeId,
     StateValue,
@@ -974,6 +976,17 @@ class RabiaEngine:
                 fn=lambda: wal.checkpoints,
             )
             m.counter(
+                "wal_barrier_waits_total",
+                "Durability-barrier watermark waits entered",
+                fn=lambda: getattr(wal, "barrier_waits", 0),
+            )
+            m.counter(
+                "wal_barrier_covered_total",
+                "Client Results released by durability-barrier waits "
+                "(covered/waits = the cross-session batching factor)",
+                fn=lambda: getattr(wal, "barrier_covered", 0),
+            )
+            m.counter(
                 "wal_gc_segments_total",
                 "WAL segments garbage-collected below the snapshot frontier",
                 fn=lambda: wal.gc_segments,
@@ -1034,6 +1047,16 @@ class RabiaEngine:
                     getattr(self._rtm, "workers", 1)
                     if self._rtm is not None
                     else 1
+                ),
+                # durability plane: which WAL writer owns the byte
+                # format on this replica ("none" = not a durable
+                # cluster) — the loadgen durable smoke cell pins
+                # wal=native with --require-plane
+                "wal": (
+                    ("native" if getattr(self._wal, "native", False)
+                     else "python")
+                    if self._wal is not None
+                    else "none"
                 ),
             },
             "decided_frontier": self.decided_frontier().tolist(),
@@ -1267,6 +1290,11 @@ class RabiaEngine:
         block = rec.block
         s = int(block.shards[i])
         batch = block.materialize_batch(i)
+        if getattr(batch, "aliases", ()):
+            # coalescing lane: the scalar apply may bind a WIRE copy of
+            # this batch (forwarded proposal) that cannot carry the
+            # aliases — stash them on the shard for _batch_aliases
+            self.rt.shards[s].alias_subs[batch.id] = batch.aliases
         subfut: asyncio.Future = asyncio.get_event_loop().create_future()
         out = rec.out
 
@@ -2075,6 +2103,18 @@ class RabiaEngine:
                             bi,
                             ResponsesUnavailableError("block shard overtaken by sync"),
                         )
+                    if rt.applied_upto[s] > int(slots[j]):
+                        # the snapshot already covered this slot: the
+                        # scalar lane will never apply the demoted batch
+                        # here, so its coalescing-lane aliases would be
+                        # lost — register them ids-only (no responses)
+                        # so a covered client's session-loss replay
+                        # dedups into the repair/unavailable path
+                        # instead of re-proposing a double apply
+                        self.register_applied_aliases(
+                            s, int(slots[j]),
+                            rec.block.alias_ids_for(bi), stage=False,
+                        )
                     self._unref_block(ref, 1)
                 self._cur_blk_ref[s] = -1
                 self._record_decision(s, int(slots[j]), int(vals[j]), None)
@@ -2146,20 +2186,36 @@ class RabiaEngine:
                 if want and responses is not None:
                     for bi, resp in zip(bsel, responses):
                         rec.out.settle(int(bi), resp)
+                if rec.block.aliases:
+                    # coalescing lane: per-client alias ids into the
+                    # dedup ledger (aliases exist only on own blocks)
+                    for k, (j, bi) in enumerate(zip(sel, bsel)):
+                        self.register_applied_aliases(
+                            int(idx[j]), int(slots[j]),
+                            rec.block.alias_ids_for(int(bi)),
+                            None if responses is None else responses[k],
+                            have_responses=want,
+                        )
                 if self._wal is not None:
                     # durability plane: stage each applied entry with its
                     # ops (slices of the block payload) under the SAME
                     # deterministic batch id the scalar lane would use,
                     # so recovery repopulates the dedup ledger correctly
+                    # — and enter it into the LIVE ledger too (round 15:
+                    # a failover replay at THIS replica's gateway must
+                    # dedup; durable clusters only, so the persistence-
+                    # free bulk lanes stay free of per-entry dict work)
                     blk = rec.block
                     boffs = blk.cmd_offsets
                     bstarts = blk.shard_starts
                     bdata = blk.data
                     for j, bi in zip(sel, bsel):
                         lo, hi = int(bstarts[bi]), int(bstarts[bi + 1])
+                        ebid = blk.batch_id_for(int(bi))
+                        rt.shards[int(idx[j])].applied_ids[ebid] = None
                         self._wal_stage(
                             int(idx[j]), int(slots[j]), 1,
-                            bid_bytes=blk.batch_id_for(int(bi)).value.bytes,
+                            bid_bytes=ebid.value.bytes,
                             ops=[
                                 bytes(bdata[boffs[k] : boffs[k + 1]])
                                 for k in range(lo, hi)
@@ -3524,6 +3580,13 @@ class RabiaEngine:
                         responses = None
                     sh.applied_ids[rec.batch_id] = None
                     sh.applied_results[rec.batch_id] = responses
+                    # demoted/forwarded coalesced entry: per-client alias
+                    # ids keep their scalar-lane exactly-once bookkeeping
+                    self.register_applied_aliases(
+                        s, slot,
+                        self._batch_aliases(sh, rec.batch_id, batch),
+                        responses, have_responses=True,
+                    )
                     wal_batch = batch
                     self.rt.state_version += 1
                     self.rt.v1_applied[s] += 1
@@ -3555,6 +3618,86 @@ class RabiaEngine:
             applied += 1
         return applied, False
 
+    @staticmethod
+    def _batch_aliases(sh, bid, batch) -> tuple:
+        """Coalescing-lane aliases of an applied scalar batch: from the
+        applied payload object itself, or — when the binding adopted a
+        WIRE copy (a forwarded/demoted coalesced entry; the codec never
+        carries local-only attrs) — from the shard's ``alias_subs``
+        stash written at demote time. O(1): ordinary batches carry no
+        aliases and the stash is empty outside the coalescing lane."""
+        al = getattr(batch, "aliases", ())
+        if al:
+            if sh.alias_subs and bid is not None:
+                sh.alias_subs.pop(bid, None)  # local copy won the bind
+            return al
+        if bid is None or not sh.alias_subs:
+            return ()
+        return sh.alias_subs.pop(bid, ())
+
+    def register_applied_aliases(
+        self, s: int, slot: int, aliases, responses=None,
+        have_responses: bool = False, stage: bool = True,
+    ) -> None:
+        """Coalescing-lane exactly-once bookkeeping (docs/PERFORMANCE.md
+        "Coalescing tier"): a multi-client entry commits ON THE WIRE
+        under its lead client's deterministic ``(client_id, seq)``-derived
+        id, and EVERY covered client's id (lead included) arrives here as
+        an alias ``(bid_bytes16, op_lo, op_hi)`` with op indices relative
+        to the entry. Each alias enters the PROPOSER-LOCAL
+        ``alias_ledger`` (NOT ``applied_ids``: aliases never ride the
+        wire, so only this replica would hold them — and the apply path
+        dedup-skips on ``applied_ids`` membership, so an asymmetric
+        entry would make THIS replica skip a re-proposed duplicate its
+        peers apply, diverging replica state permanently; see the
+        ``ShardRuntime.alias_ledger`` comment) with the client's slice
+        of the entry's responses in ``applied_results``, and stages a
+        K_LEDGER record on durable clusters — so a replayed Submit after
+        session-state loss dedups at this gateway's pre-drive check
+        (and settles from the ledger, with ONLY that client's responses)
+        exactly like a scalar-lane commit, regardless of which lane the
+        original rode. ``responses`` is the ENTRY's full response list
+        (or None for a deterministic apply failure) when
+        ``have_responses``; absent responses leave ``applied_results``
+        untouched — and so does an id that already HAS a recorded
+        result: the scalar lane writes the FULL entry response list
+        under the entry's (== lead's) id before this runs, and
+        ``_settle_from_ledger``/entry-level peer repair depend on that
+        full list staying intact (the lead's replay path truncates to
+        its own op count instead; its ops are the entry's prefix by
+        construction). A replay whose responses were never recorded
+        gets the honest terminal "committed but responses unavailable"
+        after peer repair — per-client slices are NOT recoverable
+        post-crash (K_LEDGER records carry ids, not op ranges).
+        ``stage=False`` skips the K_LEDGER staging: used by the
+        sync-overtake settle sites, where the covered slot has no local
+        WAVE record to pair with (the live ``alias_ledger`` entry is
+        the point there; crash durability of adopt-overtaken aliases is
+        best-effort by design)."""
+        if not aliases:
+            return
+        sh = self.rt.shards[s]
+        wal = self._wal if stage else None
+        for bid_bytes, lo, hi in aliases:
+            bid_bytes = bytes(bid_bytes)
+            bid = BatchId(uuid.UUID(bytes=bid_bytes))
+            # the value is the client's op COUNT: the ledger-replay
+            # serve path truncates a full-entry response list to the
+            # RECORDED count, never trusting the replayed Submit's
+            # arity (None after crash recovery — K_LEDGER has no ranges)
+            sh.alias_ledger[bid] = int(hi) - int(lo)
+            if have_responses and bid not in sh.applied_results:
+                sh.applied_results[bid] = (
+                    None if responses is None
+                    else list(responses[int(lo):int(hi)])
+                )
+            if wal is not None:
+                try:
+                    wal.stage_ledger(s, slot, bid_bytes)
+                except Exception:
+                    logger.exception("alias ledger stage failed")
+                    wal = None  # one failure wedges the log; stop here
+
     def _settle_from_ledger(self, sh, sub) -> None:
         """Settle a submitter future for a batch the ledger says is applied.
 
@@ -3563,6 +3706,7 @@ class RabiaEngine:
         never existed here, so the future must FAIL with a distinct error
         rather than resolve with an empty list (callers index responses
         per command)."""
+        sh.alias_subs.pop(sub.batch.id, None)  # demote stash: settled
         if sub.future is None or sub.future.done():
             return
         responses = sh.applied_results.get(sub.batch.id)
@@ -3913,6 +4057,18 @@ class RabiaEngine:
                             int(self._cur_blk_idx[s]),
                             ResponsesUnavailableError("block shard overtaken by sync"),
                         )
+                    if rec is not None:
+                        # voided binding: the wave committed inside the
+                        # adopted snapshot — its proposer-local aliases
+                        # would be lost with it; keep the ids so covered
+                        # clients' replays dedup instead of re-applying
+                        self.register_applied_aliases(
+                            s, max(0, applied - 1),
+                            rec.block.alias_ids_for(
+                                int(self._cur_blk_idx[s])
+                            ),
+                            stage=False,
+                        )
                     self._cur_blk_ref[s] = -1
                 if self._blk_pending_slot[s] != -1 and self._blk_pending_slot[s] < applied:
                     self._void_pending_block(s)
@@ -4074,12 +4230,26 @@ class RabiaEngine:
                     : len(sh.applied_results) - self.config.max_pending_batches
                 ]:
                     del sh.applied_results[bid]
+            if len(sh.alias_subs) > self.config.max_pending_batches:
+                # demote-stash safety cap (normally popped at apply or
+                # ledger settle; a wedged demoted entry must not pin it)
+                for bid in list(sh.alias_subs)[
+                    : len(sh.alias_subs) - self.config.max_pending_batches
+                ]:
+                    del sh.alias_subs[bid]
             # the dedup ledger is id-only (16B entries): keep a far deeper
             # horizon, evicted FIFO only to bound truly long runs
             id_cap = 64 * self.config.max_pending_batches
             if len(sh.applied_ids) > id_cap:
                 for bid in list(sh.applied_ids)[: len(sh.applied_ids) - id_cap]:
                     del sh.applied_ids[bid]
+            if len(sh.alias_ledger) > id_cap:
+                # same id-only horizon for the coalescing lane's
+                # proposer-local per-client dedup ids
+                for bid in list(sh.alias_ledger)[
+                    : len(sh.alias_ledger) - id_cap
+                ]:
+                    del sh.alias_ledger[bid]
         # evict oldest seen-batch ids, never the whole dedup set at once
         cap = 10 * self.config.max_pending_batches
         while len(self._seen_order) > cap:

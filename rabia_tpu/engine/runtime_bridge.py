@@ -1211,6 +1211,13 @@ class RuntimeBridge:
                         responses = None
                     sh.applied_ids[rec.batch_id] = None
                     sh.applied_results[rec.batch_id] = responses
+                    # demoted/forwarded coalesced entry: per-client
+                    # alias ids keep their exactly-once bookkeeping
+                    e.register_applied_aliases(
+                        s, applied,
+                        e._batch_aliases(sh, rec.batch_id, batch),
+                        responses, have_responses=True,
+                    )
                     wal_batch = batch
                     e.rt.state_version += 1
                     e.rt.v1_applied[s] += 1
@@ -1319,17 +1326,50 @@ class RuntimeBridge:
                 # ids); backfill (shard, slot) -> bid with K_LEDGER
                 # records OFF the commit path so recovery repopulates
                 # the dedup ledger
+                wal = e._wal
                 for j in np.nonzero(applied_v1)[0]:
+                    ebid = breg.block.batch_id_for(int(ents["bidx"][j]))
+                    # live ledger entry next to the K_LEDGER backfill
+                    # (failover replays dedup at the gateway pre-drive
+                    # check; durable clusters only by this guard) —
+                    # inserted even when staging fails: the live dedup
+                    # must cover every applied entry
+                    rt.shards[int(shards[j])].applied_ids[ebid] = None
+                    if wal is None:
+                        continue
                     try:
-                        e._wal.stage_ledger(
+                        wal.stage_ledger(
                             int(shards[j]), int(slots[j]),
-                            breg.block.batch_id_for(
-                                int(ents["bidx"][j])
-                            ).value.bytes,
+                            ebid.value.bytes,
                         )
                     except Exception:
                         logger.exception("wal ledger stage failed")
-                        break
+                        wal = None  # one failure wedges the log
+            if breg is not None and breg.block.aliases and n_av1:
+                # coalescing lane: every covered client's deterministic
+                # batch id enters the dedup ledger (+ K_LEDGER records
+                # on durable clusters), with its slice of the entry's
+                # responses — the wave blob parses lazily, once per
+                # entry, only on coalesced waves
+                for j in np.nonzero(applied_v1)[0]:
+                    bi = int(ents["bidx"][j])
+                    al = breg.block.alias_ids_for(bi)
+                    if not al:
+                        continue
+                    if res_blob is not None:
+                        base = _LazyResults(
+                            res_blob, int(res_offs[j]),
+                            int(res_offs[j + 1]),
+                            int(breg.block.counts[bi]),
+                        )
+                        e.register_applied_aliases(
+                            int(shards[j]), int(slots[j]), al,
+                            base, have_responses=True,
+                        )
+                    else:
+                        e.register_applied_aliases(
+                            int(shards[j]), int(slots[j]), al,
+                        )
             if breg is not None:
                 # own block: settle the V1 futures, demote the V0 entries
                 if out is not None:
@@ -1414,17 +1454,27 @@ class RuntimeBridge:
         block_id = _uuid.UUID(bytes=rec[1:17])
         (count,) = struct.unpack_from("<I", rec, 17)
         at = 21
+        wal = e._wal
         for _ in range(count):
             s, slot = struct.unpack_from("<IQ", rec, at)
             at += 12
+            bid = block_batch_id(block_id, int(s))
+            # LIVE dedup too (round 15): a client that fails over to
+            # THIS replica's gateway and replays a wave-lane seq must
+            # hit the ledger here, not re-propose — the gateway's
+            # pre-drive applied_ids check is only as good as this set
+            # (durable clusters only; the gate keeps the persistence-
+            # free bulk lanes free of per-entry Python dict work).
+            # Inserted even when staging fails: the live dedup must
+            # cover every applied entry
+            e.rt.shards[int(s)].applied_ids[bid] = None
+            if wal is None:
+                continue
             try:
-                e._wal.stage_ledger(
-                    int(s), int(slot),
-                    block_batch_id(block_id, int(s)).value.bytes,
-                )
+                wal.stage_ledger(int(s), int(slot), bid.value.bytes)
             except Exception:
                 logger.exception("receiver wal ledger stage failed")
-                break
+                wal = None  # one failure wedges the log
 
     def _apply_wave_py(self, ref, breg, entries) -> None:
         """Decided wave whose apply stays in Python (no native plane,
@@ -1474,6 +1524,16 @@ class RuntimeBridge:
                             int(bidx),
                             ResponsesUnavailableError("block shard overtaken by sync"),
                         )
+                    if int(self._applied[s]) > slot:
+                        # snapshot already covered the slot — the scalar
+                        # lane will never apply the demoted batch, so
+                        # register the coalescing-lane aliases ids-only
+                        # (covered clients' replays dedup instead of
+                        # re-proposing a double apply)
+                        e.register_applied_aliases(
+                            s, slot, block.alias_ids_for(int(bidx)),
+                            stage=False,
+                        )
                     e._unref_block(ref, 1)
                     self._record(s, slot, V1, 0.0, count=False)
                     self._try_apply(s)
@@ -1507,6 +1567,16 @@ class RuntimeBridge:
                     if want and responses is not None:
                         for (s_, sl_, bi), resp in zip(in_order, responses):
                             breg.out.settle(int(bi), resp)
+                    if block.aliases:
+                        # coalescing lane: per-client alias ids into the
+                        # dedup ledger (own blocks only carry aliases)
+                        for k, (s_, sl_, bi) in enumerate(in_order):
+                            e.register_applied_aliases(
+                                s_, sl_, block.alias_ids_for(int(bi)),
+                                None if responses is None
+                                else responses[k],
+                                have_responses=want,
+                            )
                     if e._wal is not None:
                         boffs = block.cmd_offsets
                         bstarts = block.shard_starts

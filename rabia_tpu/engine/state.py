@@ -190,6 +190,8 @@ class ShardRuntime:
         "payloads",
         "applied_ids",
         "applied_results",
+        "alias_subs",
+        "alias_ledger",
         "decisions",
         "buf_decision",
         "buf_propose",
@@ -212,6 +214,22 @@ class ShardRuntime:
         # ledger so evicting a cached response can never re-enable a
         # duplicate apply
         self.applied_results: dict[BatchId, Optional[list[bytes]]] = {}
+        # coalescing lane: alias triples of demoted multi-client entries
+        # queued on the scalar lane, keyed by the entry's lead batch id
+        # (the apply path pops them here instead of scanning the queue
+        # when the payload binding adopted a wire copy; bounded by the
+        # applied_results eviction in engine._gc)
+        self.alias_subs: dict[BatchId, tuple] = {}
+        # coalescing lane: PROPOSER-LOCAL dedup ids of covered clients
+        # (alias batch ids), valued with the client's op COUNT when
+        # registered live (None after crash recovery — K_LEDGER records
+        # carry no op ranges). Consulted ONLY by the gateway's pre-drive
+        # replay check — NEVER by the apply-path dedup: applied_ids
+        # must stay symmetric across replicas (every replica inserts
+        # the same ids from the same wire-visible facts), because an
+        # apply-time dedup-skip on one replica that its peers don't
+        # take would diverge replica state permanently.
+        self.alias_ledger: dict[BatchId, Optional[int]] = {}
         self.decisions: dict[int, SlotRecord] = {}
         # decision notices not yet consumed: slot -> (value_code, batch_id)
         self.buf_decision: _FlagDict = _FlagDict(rt.dec_flag, shard)

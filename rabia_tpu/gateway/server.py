@@ -71,7 +71,7 @@ from rabia_tpu.gateway.session import (
     SUBMIT_FRESH,
     SUBMIT_SHED_WINDOW,
 )
-from rabia_tpu.obs.flight import FRE_RESULT, fr_hash
+from rabia_tpu.obs.flight import FRE_RESULT, batch_id_for, fr_hash
 
 logger = logging.getLogger("rabia_tpu.gateway")
 
@@ -117,6 +117,28 @@ class GatewayConfig:
     # RabiaConfig.runtime_workers / RABIA_RT_WORKERS — see
     # docs/PERFORMANCE.md "Thread-per-shard-group runtime")
     runtime_workers: Optional[int] = None
+    # -- cross-session submit coalescing (docs/PERFORMANCE.md
+    # "Coalescing tier"): eligible fresh binary-op Submits arriving
+    # within a short adaptive window pack into ONE multi-client
+    # PayloadBlock entry per shard — one consensus slot, one
+    # sk_apply_wave, one durability-barrier wait for MANY sessions.
+    # False = the per-submit lane only (the round-10 shape).
+    coalesce: bool = True
+    # latency budget: the LONGEST a parked Submit waits for its window
+    # to fill; the adaptive window (sized from the eligible-arrival
+    # rate EWMA) never exceeds it. None = auto: 2ms, raised to 8ms on
+    # durable clusters (results there cannot leave before the fsync
+    # barrier anyway, so a longer window is nearly free and buys
+    # cross-session batching)
+    coalesce_window: Optional[float] = None
+    # adaptive floor: under dense arrivals the window shrinks toward
+    # this instead of zero (a too-small window degenerates to solo)
+    coalesce_window_min: float = 0.0005
+    # ops budget per packed entry (clamped further to the engine's
+    # max-batch validation limits at flush time)
+    coalesce_max_ops: int = 128
+    # bytes budget for a packed entry's command payloads
+    coalesce_max_bytes: int = 256 * 1024
 
 
 @dataclass
@@ -129,6 +151,8 @@ class GatewayStats:
     probe_rounds: int = 0
     results_sent: int = 0
     results_repaired: int = 0  # fetched from a peer after a sync overtake
+    submits_coalesced: int = 0  # submits that rode a multi-client wave
+    coalesce_waves: int = 0  # multi-client waves proposed
 
 
 @dataclass
@@ -185,6 +209,20 @@ def kv_read_handler(sm) -> ReadHandler:
         return _result_bin(0, res.version or 0, res.value)
 
     return read
+
+
+class _CoalesceWindow:
+    """One shard's open coalescing window: parked FRESH submits (their
+    session reservations held), running op/byte totals, and the armed
+    flush timer."""
+
+    __slots__ = ("entries", "ops", "size", "timer")
+
+    def __init__(self) -> None:
+        self.entries: list = []  # (sender NodeId, Submit, t0 perf_counter)
+        self.ops = 0
+        self.size = 0
+        self.timer = None  # asyncio.TimerHandle while armed
 
 
 class _ProbeRound:
@@ -248,6 +286,39 @@ class GatewayServer:
         # quorum probe amortized over the whole window, Velos-style one-
         # sided reads) — no per-read driver task, no per-read future
         self._pending_reads: list[tuple[NodeId, ReadIndex]] = []
+        # cross-session coalescing lane: per-shard open windows of
+        # parked FRESH submits + per-shard eligible-arrival-rate EWMAs
+        # that size the adaptive flush window and gate parking — sparse
+        # lanes skip the window entirely on EVERY cluster flavor (no
+        # batching chance means only the latency tax, and a parked solo
+        # submit can miss its proposer-eligibility instant; see
+        # _coal_add). Durable clusters merely get a LONGER default
+        # window (below), engaged only once traffic is dense.
+        self._coal: dict[int, _CoalesceWindow] = {}
+        self._coal_rate: dict[int, float] = {}
+        self._coal_last_arrival: dict[int, float] = {}
+        self._coal_window_cfg = (
+            self.config.coalesce_window
+            if self.config.coalesce_window is not None
+            else (0.008 if getattr(engine, "_wal", None) is not None
+                  else 0.002)
+        )
+        # ops budget clamped to what submit_block will accept, so a
+        # packed entry can never bounce off the engine's validators
+        self._coal_max_ops = max(1, min(
+            self.config.coalesce_max_ops,
+            engine.config.max_batch_size,
+            engine.config.validation.max_commands_per_batch,
+        ))
+        # an over-limit command must fail ITS OWN submit on the classic
+        # lane, not poison window-mates with a batch-level rejection
+        self._coal_max_cmd = engine.config.validation.max_command_size
+        self.coalesce_outcomes: dict[str, int] = {
+            "coalesced": 0,  # submits that rode a multi-client wave
+            "solo": 0,       # windows that flushed with one submit
+            "bypass": 0,     # eligible lane on, submit not packable
+            "sparse": 0,     # density gate: parking would not batch
+        }
         # serialization ns credited inside the current gateway stage
         # bracket (carved out so the two stages never double-count)
         self._ser_carve = 0
@@ -285,6 +356,8 @@ class GatewayServer:
             ("probe_rounds", "Read-index frontier probe rounds"),
             ("results_sent", "Result frames sent to clients"),
             ("results_repaired", "Results repaired from peer gateways"),
+            ("submits_coalesced", "Submits committed via multi-client waves"),
+            ("coalesce_waves", "Multi-client coalesced waves proposed"),
         ):
             m.counter(
                 f"gateway_{name}_total", help_,
@@ -341,6 +414,25 @@ class GatewayServer:
             "(log-bucketed; native RTH block + Python observes)",
             {"stage": "submit_result"},
             buckets=SLO_BUCKETS,
+        )
+        # cross-session coalescing lane (docs/OBSERVABILITY.md):
+        # per-outcome submit counts and the submits-per-flush size
+        # distribution. Slots-per-committed-op derives from these plus
+        # the runtime's decided counters (rabia_engine_* / RKC block):
+        # slots/op = Δdecided_v1 / Δ(ok results).
+        for oc in self.coalesce_outcomes:
+            m.counter(
+                "coalesce_total",
+                "Coalescing-lane submit outcomes "
+                "(coalesced=rode a multi-client wave, solo=window of "
+                "one, bypass=not packable)",
+                {"outcome": oc},
+                fn=lambda o=oc: self.coalesce_outcomes[o],
+            )
+        self._h_coal = m.histogram(
+            "coalesce_batch_size",
+            "Submits per coalescing-window flush (1 = solo)",
+            buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256],
         )
 
     # -- observability surface ----------------------------------------------
@@ -529,6 +621,9 @@ class GatewayServer:
 
     async def close(self) -> None:
         self._running = False
+        # open coalescing windows: nothing in them was proposed — shed
+        # the parked submits retryable while the transport still sends
+        self._coal_abort_all()
         if self._http is not None:
             self._http.close()
             self._http = None
@@ -744,6 +839,42 @@ class GatewayServer:
             )
             return
         assert decision == SUBMIT_FRESH
+        if not (0 <= p.shard < self.engine.n_shards):
+            # shard validation FIRST: the ledger lookup below indexes
+            # rt.shards, and a malformed frame must answer (and release
+            # its reservation), not raise out of the receive loop
+            self.sessions.abort(p.client_id, p.seq)
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.ERROR,
+                (b"shard out of range",),
+            )
+            return
+        if not p.commands:
+            # validate BEFORE the ledger dedup: an empty replay of an
+            # applied seq must stay an error, not an OK with a
+            # zero-truncated payload cached in the session table
+            self.sessions.abort(p.client_id, p.seq)
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.ERROR,
+                (b"empty submit",),
+            )
+            return
+        # engine-ledger dedup BEFORE any proposal: a seq whose result
+        # was evicted from the session cache (ack + GC, lease expiry,
+        # session loss) re-arrives FRESH, but its deterministic batch id
+        # may already be known applied — scalar commits and wave-lane
+        # entry ids in applied_ids, coalesced-wave per-client ALIASES in
+        # the proposer-local alias_ledger (kept out of applied_ids so
+        # the apply-path dedup stays symmetric across replicas). Answer
+        # from the ledger instead of burning a slot (and instead of
+        # re-applying through the wave lane, which applies decided
+        # waves unconditionally).
+        bid = BatchId(batch_id_for(p.client_id, p.seq))
+        sh = self.engine.rt.shards[p.shard]
+        if bid in sh.applied_ids or bid in sh.alias_ledger:
+            self.stats.submits_deduped += 1
+            self._spawn(self._drive_ledger_replay(sender, p, bid, sh))
+            return
         # -- admission control (shed BEFORE the engine sees the batch;
         # the FRESH reservation is released on every shed path) --
         if self.engine.pending_queue_depth() >= self.config.max_queue_depth:
@@ -764,21 +895,13 @@ class GatewayServer:
                 (b"no quorum",),
             )
             return
-        if not p.commands:
-            self.sessions.abort(p.client_id, p.seq)
-            self._send_result(
-                sender, p.client_id, p.seq, ResultStatus.ERROR,
-                (b"empty submit",),
-            )
+        t0 = time.perf_counter()
+        if self.config.coalesce and self._coal_eligible(p):
+            self._coal_add(sender, p, t0)
             return
-        if not (0 <= p.shard < self.engine.n_shards):
-            self.sessions.abort(p.client_id, p.seq)
-            self._send_result(
-                sender, p.client_id, p.seq, ResultStatus.ERROR,
-                (b"shard out of range",),
-            )
-            return
-        self._spawn(self._drive_submit(sender, p, time.perf_counter()))
+        if self.config.coalesce:
+            self.coalesce_outcomes["bypass"] += 1
+        self._spawn(self._drive_submit(sender, p, t0))
 
     @staticmethod
     def _deterministic_batch(p: Submit) -> CommandBatch:
@@ -793,7 +916,6 @@ class GatewayServer:
         names batches from session coordinates the same way)."""
         import hashlib
 
-        from rabia_tpu.obs.flight import batch_id_for
 
         seed = p.client_id.bytes + p.seq.to_bytes(8, "little")
         bid = batch_id_for(p.client_id, p.seq)
@@ -832,7 +954,6 @@ class GatewayServer:
             return None
         from rabia_tpu.apps.native_store import binary_wave_eligible
         from rabia_tpu.core.blocks import block_id_for_batch, build_block
-        from rabia_tpu.obs.flight import batch_id_for
 
         blk = build_block(
             [p.shard], [list(p.commands)],
@@ -845,7 +966,324 @@ class GatewayServer:
             np.arange(1),
         ):
             return None
+        # self-alias: the per-submit wave registers its own (client_id,
+        # seq)-derived id + responses in the applied ledger exactly like
+        # a coalesced wave's covered clients — a replay after session-
+        # state loss answers from the ledger instead of re-applying
+        # through the wave lane (which never consults applied_ids)
+        blk.aliases = {
+            0: (
+                (
+                    batch_id_for(p.client_id, p.seq).bytes,
+                    0, len(p.commands),
+                ),
+            )
+        }
         return blk
+
+    async def _drive_ledger_replay(
+        self, sender: NodeId, p: Submit, bid, sh
+    ) -> None:
+        """Answer a FRESH submit whose batch id is already in the
+        engine's applied ledger: the commit happened in an earlier life
+        of this session. Serve the recorded responses (or repair them
+        from a peer) — NEVER re-propose."""
+        responses = sh.applied_results.get(bid)
+        if bid in sh.applied_results:
+            if responses is None:
+                # applied but deterministically rejected: that failure
+                # is the true outcome of this seq
+                status, payload = ResultStatus.ERROR, (b"apply failed",)
+            else:
+                status, payload = ResultStatus.OK, tuple(responses)
+        else:
+            # committed, responses not recorded here (a C-applied wave
+            # on a ledger-recovered replica): try the peer repair lane
+            # (which is terminal — it returns OK or ERROR, never RETRY)
+            status, payload = await self._repair_result(bid, p.shard)
+        if status == ResultStatus.OK:
+            # the LEAD client of a coalesced entry replays under the
+            # entry's own id, whose recorded/repaired responses may be
+            # the FULL entry list (the scalar-demoted lane records the
+            # whole entry under that id, and entry-level repair/settle
+            # need it intact) — the lead's ops are the entry's PREFIX
+            # by construction, so its own answers are the first
+            # `count` responses. The count comes from the alias ledger
+            # (recorded at apply time), NEVER from the replayed
+            # Submit's arity: a replay with inflated command count must
+            # not receive other covered clients' response slices. Post-
+            # crash the recorded count is gone (K_LEDGER has no op
+            # ranges) — fall back to the replayed arity, which can only
+            # NARROW an over-long list, never widen the slice.
+            count = sh.alias_ledger.get(bid)
+            if count is None:
+                count = len(p.commands)
+            if len(payload) > count:
+                payload = payload[:count]
+        if status == ResultStatus.OK:
+            wal = getattr(self.engine, "_wal", None)
+            if wal is not None:
+                # the ledger entry was written at APPLY time, possibly
+                # ahead of the wave's fsync — an OK replay answer must
+                # honor the same durability fence as every other OK
+                # Result on a durable cluster
+                try:
+                    await wal.durability_barrier()
+                except Exception as e:
+                    status, payload = ResultStatus.ERROR, (
+                        f"durability barrier failed: {e}".encode(),
+                    )
+        self.sessions.complete_op(
+            p.client_id, p.seq, int(status), payload,
+            self.engine.rt.state_version,
+        )
+        # a replayed commit resends as CACHED (the dedup observable),
+        # matching the session-cache path's wire behavior
+        wire_status = (
+            ResultStatus.CACHED if status == ResultStatus.OK else status
+        )
+        self._send_result(sender, p.client_id, p.seq, wire_status, payload)
+
+    # -- cross-session coalescing lane (docs/PERFORMANCE.md) ----------------
+    #
+    # Many sessions' FRESH binary-op Submits to one shard pack into ONE
+    # PayloadBlock entry under the lead client's deterministic batch id:
+    # one consensus slot, one apply, one result-staging pass, and on
+    # durable clusters ONE durability-barrier wait for every covered
+    # session. Every covered client's (client_id, seq)-derived id rides
+    # the block as an ALIAS (core/blocks.py) so dedup/replay/K_LEDGER
+    # stay exactly-once PER CLIENT with zero new wire semantics.
+
+    def _coal_eligible(self, p: Submit) -> bool:
+        """Packable: every command a binary KV op (opcodes 1..6 — the
+        wave-routing rule) and the submit alone within the ops budget.
+        Everything else rides the classic per-submit lane."""
+        cmds = p.commands
+        if not cmds or len(cmds) > self._coal_max_ops:
+            return False
+        for c in cmds:
+            if not c or not (1 <= c[0] <= 6) or len(c) > self._coal_max_cmd:
+                return False
+        return True
+
+    def _coal_window_s(self, shard: int) -> float:
+        """Adaptive flush window: aim to collect several submits at the
+        shard's eligible-arrival rate, floored and capped by config (the
+        cap IS the per-submit latency budget)."""
+        cfg = self.config
+        rate = self._coal_rate.get(shard, 0.0)
+        if rate <= 1.0:
+            return self._coal_window_cfg
+        return min(
+            self._coal_window_cfg,
+            max(cfg.coalesce_window_min, 8.0 / rate),
+        )
+
+    def _coal_add(self, sender: NodeId, p: Submit, t0: float) -> None:
+        """Park one FRESH eligible submit in its shard's window (the
+        session reservation from submit_check is HELD while parked, so
+        client retransmits attach as DUP_INFLIGHT). Sparse lanes drive
+        straight through instead: with no realistic chance of a window
+        companion, parking buys nothing and can cost a lot more than
+        the window itself — a parked submit can MISS its shard's
+        proposer-eligibility instant and demote to the forwarded scalar
+        path (measured: +50ms p50 at 30/s on a 3-gateway cluster)."""
+        # per-shard arrival-rate EWMA (adaptive window + density gate)
+        s = p.shard
+        last = self._coal_last_arrival.get(s, 0.0)
+        self._coal_last_arrival[s] = t0
+        rate = self._coal_rate.get(s, 0.0)
+        dt = t0 - last
+        if 0.0 < dt < 1.0:
+            rate += 0.2 * ((1.0 / dt) - rate)
+        else:
+            rate *= 0.5
+        self._coal_rate[s] = rate
+        w = self._coal.get(s)
+        if w is None and rate * self._coal_window_cfg < 0.5:
+            self.coalesce_outcomes["sparse"] += 1
+            self._spawn(self._drive_submit(sender, p, t0))
+            return
+        n_ops = len(p.commands)
+        n_bytes = sum(len(c) for c in p.commands)
+        if w is not None and w.entries and (
+            w.ops + n_ops > self._coal_max_ops
+            or w.size + n_bytes > self.config.coalesce_max_bytes
+        ):
+            # budget would overflow: flush what is parked, start fresh
+            self._coal_flush(s)
+            w = None
+        if w is None:
+            w = self._coal[s] = _CoalesceWindow()
+        w.entries.append((sender, p, t0))
+        w.ops += n_ops
+        w.size += n_bytes
+        if (
+            w.ops >= self._coal_max_ops
+            or w.size >= self.config.coalesce_max_bytes
+        ):
+            self._coal_flush(s)
+            return
+        if w.timer is None:
+            w.timer = asyncio.get_event_loop().call_later(
+                self._coal_window_s(s), self._coal_flush_timed, s
+            )
+
+    def _coal_flush_timed(self, shard: int) -> None:
+        """Timer-fired flush: bracket the assembly work for the stage
+        profiler (the _on_submit path is already inside a bracket)."""
+        t0 = time.perf_counter_ns()
+        self._ser_carve = 0
+        self._coal_flush(shard)
+        self._stg_gw(time.perf_counter_ns() - t0)
+
+    def _coal_flush(self, shard: int) -> None:
+        w = self._coal.pop(shard, None)
+        if w is None:
+            return
+        if w.timer is not None:
+            w.timer.cancel()
+            w.timer = None
+        entries = w.entries
+        self._h_coal.observe(len(entries))
+        if len(entries) == 1:
+            # window of one: the classic lane is strictly cheaper (and
+            # keeps the zero-handoff per-submit wave path hot)
+            self.coalesce_outcomes["solo"] += 1
+            sender, p, t0 = entries[0]
+            self._spawn(self._drive_submit(sender, p, t0))
+            return
+        self.coalesce_outcomes["coalesced"] += len(entries)
+        self.stats.submits_coalesced += len(entries)
+        self.stats.coalesce_waves += 1
+        self._spawn(self._drive_coalesced(shard, entries))
+
+    def _coal_abort_all(self, notify: bool = True) -> None:
+        """Tear down every open window (gateway close): release the
+        session reservations and shed the parked submits retryable —
+        nothing was proposed, so a client retry is FRESH everywhere."""
+        for s in list(self._coal):
+            w = self._coal.pop(s)
+            if w.timer is not None:
+                w.timer.cancel()
+            for sender, p, _t0 in w.entries:
+                self.sessions.abort(p.client_id, p.seq)
+                if notify:
+                    self._send_result(
+                        sender, p.client_id, p.seq, ResultStatus.RETRY,
+                        (b"gateway closing",),
+                    )
+
+    async def _drive_coalesced(self, shard: int, entries: list) -> None:
+        """Commit ONE multi-client wave and fan its Result slices out to
+        every covered session (the coalescing twin of _drive_submit)."""
+        pcns = time.perf_counter_ns
+        tb = pcns()
+        from rabia_tpu.core.blocks import block_id_for_batch, build_block
+
+        flat: list[bytes] = []
+        ranges: list[tuple[int, int]] = []
+        for _sender, p, _t0 in entries:
+            lo = len(flat)
+            flat.extend(p.commands)
+            ranges.append((lo, len(flat)))
+        lead = entries[0][1]
+        lead_bid = batch_id_for(lead.client_id, lead.seq)
+        blk = build_block(
+            [shard], [flat],
+            block_id=block_id_for_batch(lead_bid, shard),
+        )
+        # EVERY covered client (lead included) aliases the entry with
+        # its deterministic id + op range: the apply paths register
+        # them in alias_ledger/applied_results/K_LEDGER
+        blk.aliases = {
+            0: tuple(
+                (batch_id_for(p.client_id, p.seq).bytes, lo, hi)
+                for (_s, p, _t), (lo, hi) in zip(entries, ranges)
+            )
+        }
+        batch_id = blk.batch_id_for(0)  # == lead_bid by construction
+        self._ser_carve = 0
+        self._stg_gw(pcns() - tb)
+        proposed = False
+        status: int = ResultStatus.OK
+        responses: Optional[list] = None
+        payload_all: tuple[bytes, ...] = ()
+        try:
+            fut = await self.engine.submit_block(blk)
+            proposed = True
+            entry = (await fut)[0]
+            if isinstance(entry, Exception):
+                raise entry
+            responses = list(entry)
+        except asyncio.CancelledError:
+            for _sender, p, _t0 in entries:
+                self.sessions.abort(p.client_id, p.seq)
+            raise
+        except ResponsesUnavailableError:
+            # committed, responses adopted away by a sync overtake:
+            # repair the ENTRY once by its lead id, slice per client
+            status, payload = await self._repair_result(batch_id, shard)
+            if status == ResultStatus.OK:
+                responses = list(payload)
+                if len(responses) != len(flat):
+                    status = ResultStatus.ERROR
+                    payload_all = (
+                        b"repaired responses misaligned with wave",
+                    )
+            else:
+                payload_all = payload
+        except RabiaError as e:
+            if not proposed and e.is_retryable():
+                # rejected before any proposal reached consensus: shed
+                # every covered submit retryable
+                for sender, p, _t0 in entries:
+                    self.sessions.abort(p.client_id, p.seq)
+                    self.stats.submits_shed += 1
+                    self.shed_reasons["engine_reject"] += 1
+                    self._send_result(
+                        sender, p.client_id, p.seq, ResultStatus.RETRY,
+                        (str(e).encode(),),
+                    )
+                return
+            # post-proposal failures are terminal for every covered seq
+            # (cached; clients retry under new seqs) — same contract as
+            # the scalar lane
+            status = ResultStatus.ERROR
+            payload_all = (str(e).encode(),)
+        # cross-session durability-barrier batching: the wave staged its
+        # WAL record at apply, so ONE watermark wait here releases EVERY
+        # covered session's Result frame
+        wal = getattr(self.engine, "_wal", None)
+        if wal is not None and status == ResultStatus.OK:
+            try:
+                await wal.durability_barrier(covered=len(entries))
+            except Exception as e:
+                status = ResultStatus.ERROR
+                payload_all = (
+                    f"durability barrier failed: {e}".encode(),
+                )
+        tc = pcns()
+        self._ser_carve = 0
+        sv = self.engine.rt.state_version
+        now = time.perf_counter()
+        for (sender, p, t0), (lo, hi) in zip(entries, ranges):
+            pay = (
+                tuple(responses[lo:hi])
+                if status == ResultStatus.OK and responses is not None
+                else payload_all
+            )
+            self.sessions.complete_op(
+                p.client_id, p.seq, int(status), pay, sv
+            )
+            self.engine.flight.record(
+                FRE_RESULT, shard=shard, arg=int(status),
+                batch=fr_hash(batch_id_for(p.client_id, p.seq)),
+            )
+            if t0:
+                self._h_submit_result.observe(now - t0)
+            self._send_result(sender, p.client_id, p.seq, status, pay)
+        self._stg_gw(pcns() - tc)
 
     async def _drive_submit(
         self, sender: NodeId, p: Submit, t0: float = 0.0

@@ -811,7 +811,7 @@ class RecoveredLog:
 
     chain: list[dict] = field(default_factory=list)
     waves: list[tuple[int, dict]] = field(default_factory=list)  # (lsn, rec)
-    ledger: dict = field(default_factory=dict)  # (shard, slot) -> bid bytes
+    ledger: dict = field(default_factory=dict)  # (shard, slot) -> [bid bytes]
     barrier: Optional[bytes] = None
     frontier_lsn: int = 0
     torn: Optional[dict] = None
@@ -924,6 +924,13 @@ class WalPersistence(PersistenceLayer):
         self._force_full = False
         self._checkpoint_asap = False
         self.checkpoints = 0
+        # cross-session durability-barrier batching (the covered-release
+        # lane): one watermark wait may release MANY client Results.
+        # barrier_waits counts actual waits entered, barrier_covered the
+        # Results those waits released — covered/waits is the
+        # amortization factor next to WLC fsyncs/group_records.
+        self.barrier_waits = 0
+        self.barrier_covered = 0
         self.gc_segments = 0
         self.saves = 0  # PersistenceLayer blob-path compatibility counters
         self.loads = 0
@@ -976,7 +983,13 @@ class WalPersistence(PersistenceLayer):
                 self.recovered.waves.append((lsn, decode_record(payload)))
             elif kind == K_LEDGER:
                 rec = decode_record(payload)
-                self.recovered.ledger[(rec["shard"], rec["slot"])] = rec["bid"]
+                # a slot holds a LIST of ids: the wave's own id first,
+                # then the coalescing lane's per-client aliases — every
+                # one of them re-enters applied_ids at replay (dedup
+                # stays exactly-once PER CLIENT, not per wave)
+                self.recovered.ledger.setdefault(
+                    (rec["shard"], rec["slot"]), []
+                ).append(rec["bid"])
 
     def _merge_chain_barrier(self) -> None:
         """The recovered barrier = elementwise max of the last chain
@@ -1151,9 +1164,16 @@ class WalPersistence(PersistenceLayer):
         heapq.heappush(self._waiters, (lsn, next(self._wait_seq), fut))
         await asyncio.wait_for(fut, timeout)
 
-    async def durability_barrier(self, timeout: float = 10.0) -> None:
+    async def durability_barrier(
+        self, timeout: float = 10.0, covered: int = 1
+    ) -> None:
         """Barrier over everything staged so far — the gateway's
-        before-the-result-frame-leaves fence."""
+        before-the-result-frame-leaves fence. ``covered`` is how many
+        client Results this ONE watermark wait releases (the coalescing
+        lane's cross-session barrier batching passes its wave's client
+        count; the scalar lane leaves the default 1)."""
+        self.barrier_waits += 1
+        self.barrier_covered += int(covered)
         await self.wait_durable(self.staged_lsn(), timeout)
 
     # -- PersistenceLayer ABC -------------------------------------------
@@ -1461,9 +1481,10 @@ class WalPersistence(PersistenceLayer):
                     gapped.add(s)
                 continue
             sh = rt.shards[s]
+            ledger_bids = self.recovered.ledger.get((s, slot), ())
             bid_bytes = rec["bid"]
             if bid_bytes is None or bid_bytes == _NULL_BID:
-                bid_bytes = self.recovered.ledger.get((s, slot))
+                bid_bytes = ledger_bids[0] if ledger_bids else None
             if rec["value"] == 1 and rec["ops"] is not None:
                 bid = (
                     BatchId(uuid.UUID(bytes=bytes(bid_bytes)))
@@ -1490,6 +1511,21 @@ class WalPersistence(PersistenceLayer):
                 rt.v1_applied[s] += 1
                 if bid_bytes:
                     sh.applied_ids[bid] = None
+                for ab in ledger_bids:
+                    # coalescing-lane aliases staged against this slot:
+                    # every covered client's id re-enters the PROPOSER-
+                    # LOCAL alias ledger with the wave it rode. NOT
+                    # applied_ids: only this replica's WAL carries its
+                    # aliases, and an asymmetric applied_ids entry would
+                    # let the apply-path dedup-skip diverge replica
+                    # state (ShardRuntime.alias_ledger comment). The
+                    # slot's own (wire-symmetric) id stayed above.
+                    ab = bytes(ab)
+                    if bid_bytes is not None and ab == bytes(bid_bytes):
+                        continue
+                    sh.alias_ledger[
+                        BatchId(uuid.UUID(bytes=ab))
+                    ] = None
             rt.applied_upto[s] = slot + 1  # sh.applied_upto views this
             if slot + 1 > rt.next_slot[s]:
                 rt.next_slot[s] = slot + 1

@@ -42,6 +42,11 @@ def _child_main(argv: list[str]) -> int:
     gw_ports = json.loads(argv[2])
     wal_root = argv[3]
     n_shards = int(argv[4])
+    # optional extras (bench topologies): {"workers": N} pins the
+    # thread-per-shard-group runtime worker count inside THIS process —
+    # the single-process-per-replica shape benchmarks/worker_scaling.py
+    # --procs drives, where workers never compete with sibling replicas
+    extras = json.loads(argv[5]) if len(argv) > 5 else {}
 
     from rabia_tpu.apps.sharded import make_sharded_kv
     from rabia_tpu.core.config import RabiaConfig, TcpNetworkConfig
@@ -65,6 +70,10 @@ def _child_main(argv: list[str]) -> int:
         ).with_kernel(
             num_shards=n_shards, shard_pad_multiple=max(1, n_shards)
         )
+        if extras.get("workers"):
+            from dataclasses import replace
+
+            cfg = replace(cfg, runtime_workers=int(extras["workers"]))
         eng = RabiaEngine(
             ClusterConfig.new(me, node_ids), sm, net,
             persistence=pers, config=cfg,
@@ -154,11 +163,13 @@ class RecoveryHarness:
     def __init__(
         self, n_replicas: int = 3, n_shards: int = 4,
         wal_root: Optional[str] = None,
+        extras: Optional[dict] = None,
     ) -> None:
         import tempfile
 
         self.n = n_replicas
         self.n_shards = n_shards
+        self.extras = dict(extras or {})
         self.wal_root = wal_root or tempfile.mkdtemp(prefix="rabia-recovery-")
         ports = free_ports(2 * n_replicas)
         self.net_ports = ports[:n_replicas]
@@ -175,6 +186,7 @@ class RecoveryHarness:
                 "--child", str(i),
                 json.dumps(self.net_ports), json.dumps(self.gw_ports),
                 self.wal_root, str(self.n_shards),
+                json.dumps(self.extras),
             ],
             stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL,

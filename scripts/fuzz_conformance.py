@@ -505,6 +505,28 @@ def _trial_wal_paths(seed: int) -> None:
     )
 
 
+def _trial_coalesce_paths(seed: int) -> None:
+    """Coalescing-lane differential: one RANDOM multi-client submit
+    schedule through a coalesce-ON gateway cluster and the per-submit
+    round-10 lane — semantically identical per-client responses,
+    identical key/value state + per-shard mutation counts (the double-
+    apply detector), and byte-identical full-replay answers within each
+    leg. The ON leg must actually pack multi-client waves. ~10s each."""
+    import asyncio
+
+    from rabia_tpu.testing.conformance import (
+        random_coalesce_schedule,
+        run_submits_on_coalesce_paths,
+    )
+
+    rounds, n_clients, n_shards = random_coalesce_schedule(seed + 2113)
+    asyncio.run(
+        run_submits_on_coalesce_paths(
+            rounds, n_clients, n_shards, tag=f"coalesce seed={seed}"
+        )
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=30.0)
@@ -551,6 +573,14 @@ def main() -> int:
         "and the Python twin; byte-identical segments + identical "
         "torn-tail recovery + identical replayed state required; "
         "sub-second each)",
+    )
+    ap.add_argument(
+        "--coalesce", type=int, default=0,
+        help="additionally run N coalescing-lane differential trials "
+        "(random multi-client submit schedules through a coalesce-ON "
+        "gateway cluster and the per-submit lane; identical responses/"
+        "state/mutation counts + byte-identical replays required; "
+        "~10s each)",
     )
     ap.add_argument(
         "--mesh", type=int, default=0,
@@ -652,6 +682,11 @@ def main() -> int:
         for i in range(args.wal):
             _trial_wal_paths(args.base_seed + i)
             wal_trials += 1
+    coalesce_trials = 0
+    if args.coalesce > 0:
+        for i in range(args.coalesce):
+            _trial_coalesce_paths(args.base_seed + i)
+            coalesce_trials += 1
     extra = (
         f"; {plane_trials} plane-differential schedules identical"
         if plane_trials
@@ -677,6 +712,11 @@ def main() -> int:
         extra += (
             f"; {wal_trials} durability-plane differential sequences "
             "identical"
+        )
+    if coalesce_trials:
+        extra += (
+            f"; {coalesce_trials} coalescing-lane differential "
+            "schedules identical"
         )
     if mesh_trials:
         extra += (

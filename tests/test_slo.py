@@ -435,10 +435,14 @@ class TestTelemetryRing:
             planes = g0.health()["planes"]
             assert set(planes) == {
                 "runtime", "tick", "apply", "gateway", "runtime_workers",
+                "wal",
             }
             assert planes["gateway"] in ("native", "python")
             workers = planes.pop("runtime_workers")
             assert isinstance(workers, int) and workers >= 1
+            # wal reports the writer flavor, or "none" off durable
+            # clusters (this cluster runs InMemory persistence)
+            assert planes.pop("wal") in ("native", "python", "none")
             assert all(v in ("native", "python") for v in planes.values())
             # TIMELINE admin frames serve the ring (query honored)
             body = await admin_fetch(
