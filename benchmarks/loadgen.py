@@ -96,6 +96,8 @@ async def run_point(
     mux: int = 0,
     shed_fn=None,
     counters_fn=None,
+    fleet_resolver=None,
+    fleet_fn=None,
 ) -> dict:
     """Drive one open-loop point and return its SLO report entry.
 
@@ -107,17 +109,59 @@ async def run_point(
     flat dict of cumulative cluster counters (decided slots, coalesce
     outcomes, WAL fsyncs/barriers) — sampled before/after so each point
     carries the amortization evidence (slots per committed op, fsyncs
-    per durable Result) the coalescing tier is scored by."""
+    per durable Result) the coalescing tier is scored by.
+
+    ``fleet_resolver``: when set (a
+    :class:`rabia_tpu.fleet.harness.FleetResolver`), the point drives
+    :class:`~rabia_tpu.fleet.harness.FleetSession`\\ s through the
+    consistent-hash ring over ONE shared mux connection per fleet
+    gateway instead of dialing ``endpoints`` directly — the
+    10^5-sessions-behind-one-front-door lane. ``fleet_fn``: zero-arg
+    callable returning per-gateway health snapshots; sampled
+    before/after so the point carries per-gateway AND fleet-aggregate
+    counter deltas (moved, cached replays, ledger traffic)."""
     from rabia_tpu.apps.kvstore import encode_set_bin
 
     ser = Serializer()
     rng = random.Random(seed)
-    sessions: list[LoadSession] = []
+    sessions: list = []
     muxconns: list[MuxConn] = []
     sem = asyncio.Semaphore(connect_parallel)
+    fleet_pool = None
 
     t_dial = time.perf_counter()
-    if mux > 0:
+    if fleet_resolver is not None:
+        from rabia_tpu.fleet.harness import FleetConnPool, FleetSession
+
+        fleet_pool = FleetConnPool(ser)
+
+        async def dial_fleet(i: int):
+            # eager home-shard attach: the hello storm (10^5 handshakes
+            # at the headline scale) belongs in the dial phase, not
+            # inside the measured window. Session i always fires shard
+            # i % n_shards when n_shards divides n_sessions, so this
+            # pre-warms exactly the connection submit() will use.
+            async with sem:
+                s = FleetSession(
+                    ser, fleet_resolver, pool=fleet_pool,
+                    call_timeout=call_timeout,
+                )
+                for attempt in range(3):
+                    try:
+                        addr = fleet_resolver.addr_for(i % n_shards)
+                        if addr is not None:
+                            await s._conn(addr, 10.0)
+                        return s
+                    except Exception:
+                        await asyncio.sleep(0.05 * (attempt + 1))
+                await s.close()
+                return None
+
+        attached = await asyncio.gather(
+            *(dial_fleet(i) for i in range(n_sessions))
+        )
+        sessions = [s for s in attached if s is not None]
+    elif mux > 0:
         # session-multiplex lane: ceil(n/mux) connections round-robined
         # over the gateways, n sessions attached across them
         n_conns = (n_sessions + mux - 1) // mux
@@ -196,6 +240,7 @@ async def run_point(
     dial_s = time.perf_counter() - t_dial
     shed_before = dict(shed_fn()) if shed_fn is not None else None
     ctr_before = dict(counters_fn()) if counters_fn is not None else None
+    fleet_before = fleet_fn() if fleet_fn is not None else None
 
     counts = {k: 0 for k in OUTCOMES}
     lat_ok_ms: list[float] = []
@@ -232,7 +277,9 @@ async def run_point(
                 outcome = "shed"
             else:
                 outcome = "error"
-        except asyncio.TimeoutError:
+        except (asyncio.TimeoutError, TimeoutError):
+            # both spellings: pre-3.11 asyncio.TimeoutError is a class
+            # of its own, and FleetSession raises the builtin
             outcome = "timeout"
         except asyncio.CancelledError:
             # cancelled at the drain cutoff: by construction this call
@@ -297,12 +344,57 @@ async def run_point(
         # timeouts) before the counts below are read
         await asyncio.gather(*leftovers, return_exceptions=True)
 
+    # fleet routing evidence must be read BEFORE the sessions close
+    fleet_client = None
+    n_fleet_conns = 0
+    if fleet_resolver is not None:
+        fleet_client = {
+            "redirects": sum(s.redirects for s in sessions),
+            "failovers": sum(s.failovers for s in sessions),
+        }
+        n_fleet_conns = len(fleet_pool.muxes)
+
     await asyncio.gather(
         *(s.close() for s in sessions), return_exceptions=True
     )
     await asyncio.gather(
         *(c.close() for c in muxconns), return_exceptions=True
     )
+    if fleet_pool is not None:
+        await fleet_pool.close()
+
+    # per-gateway + fleet-aggregate record: each fleet gateway's counter
+    # deltas over the point (MOVED answers, dedup cache hits, ledger
+    # replication traffic) plus the client-side routing tallies — the
+    # evidence the routed-fleet SLO is scored by
+    fleet_doc = None
+    if fleet_fn is not None:
+        after_g = fleet_fn()
+        before_by = {g["name"]: g for g in (fleet_before or [])}
+        gws = []
+        agg: dict[str, int] = {}
+        for g in after_g:
+            b = before_by.get(g["name"], {"stats": {}})
+            delta = {
+                k: int(v) - int(b["stats"].get(k, 0))
+                for k, v in g["stats"].items()
+            }
+            gws.append({
+                "name": g["name"],
+                "sessions": g["sessions"],
+                "owned_shards": g["owned_shards"],
+                **delta,
+            })
+            for k, v in delta.items():
+                agg[k] = agg.get(k, 0) + v
+        fleet_doc = {
+            "gateways": gws,
+            "aggregate": {
+                **agg,
+                "sessions": sum(g["sessions"] for g in after_g),
+                **(fleet_client or {}),
+            },
+        }
 
     # per-reason shed join: a shed-dominated point must say WHY it shed
     # (rabia_gateway_shed_total{reason=...} deltas over the point)
@@ -351,7 +443,11 @@ async def run_point(
         "offered_rps": rate,
         "sessions": n_sessions,
         "mux": mux,
-        "connections": len(muxconns) if mux > 0 else n_sessions,
+        "connections": (
+            n_fleet_conns if fleet_pool is not None
+            else len(muxconns) if mux > 0 else n_sessions
+        ),
+        "fleet": fleet_doc,
         "shed_reasons": shed_reasons,
         "cluster_counters": cluster_counters,
         **derived,
@@ -482,6 +578,7 @@ async def run(args) -> dict:
         raise SystemExit("--sessions must be one value or match --rates")
 
     cluster = None
+    fleet_harness = None
     pmode = None
     if args.external:
         endpoints = []
@@ -490,7 +587,6 @@ async def run(args) -> dict:
             endpoints.append((host, int(port)))
     else:
         from rabia_tpu.gateway import GatewayConfig
-        from rabia_tpu.testing.gateway_cluster import GatewayCluster
 
         # persistence plane resolution: --persistence wins, the legacy
         # --no-persistence spelling maps to "off". Persistence-free
@@ -506,21 +602,44 @@ async def run(args) -> dict:
         if args.coalesce_window is not None:
             gw_kwargs["coalesce_window"] = args.coalesce_window
             gw_kwargs["coalesce_window_min"] = args.coalesce_window
-        cluster = GatewayCluster(
-            n_replicas=args.replicas,
-            n_shards=args.shards,
-            gateway_config=GatewayConfig(
-                max_inflight_per_session=args.session_window,
-                max_queue_depth=args.queue_depth,
-                **gw_kwargs,
-            ),
-            persistence={"memory": True, "off": False, "wal": "wal"}[pmode],
-            wal_dir=args.wal_dir,
+        gw_config = GatewayConfig(
+            max_inflight_per_session=args.session_window,
+            max_queue_depth=args.queue_depth,
+            **gw_kwargs,
         )
-        await cluster.start()
-        endpoints = [
-            ("127.0.0.1", g.port) for g in cluster.gateways
-        ]
+        persistence = {"memory": True, "off": False, "wal": "wal"}[pmode]
+        if args.fleet:
+            # routed-fleet lane: the same real-TCP replica cluster, but
+            # fronted by N consistent-hash FleetGateways; sessions route
+            # through the ring resolver over one shared mux per gateway
+            from rabia_tpu.fleet.harness import FleetHarness
+
+            fleet_harness = FleetHarness(
+                n_gateways=args.fleet,
+                n_replicas=args.replicas,
+                n_shards=args.shards,
+                gateway_config=gw_config,
+                persistence=persistence,
+            )
+            await fleet_harness.start()
+            cluster = fleet_harness.cluster
+            endpoints = [
+                ("127.0.0.1", g.port) for g in fleet_harness.gateways
+            ]
+        else:
+            from rabia_tpu.testing.gateway_cluster import GatewayCluster
+
+            cluster = GatewayCluster(
+                n_replicas=args.replicas,
+                n_shards=args.shards,
+                gateway_config=gw_config,
+                persistence=persistence,
+                wal_dir=args.wal_dir,
+            )
+            await cluster.start()
+            endpoints = [
+                ("127.0.0.1", g.port) for g in cluster.gateways
+            ]
 
     shed_fn = None
     counters_fn = None
@@ -567,6 +686,23 @@ async def run(args) -> dict:
 
         planes = cluster.gateways[0].health().get("planes")
 
+    fleet_fn = None
+    if fleet_harness is not None:
+
+        def fleet_fn() -> list[dict]:
+            out = []
+            for gw in fleet_harness.gateways:
+                if gw is None:
+                    continue
+                h = gw.health()
+                out.append({
+                    "name": h["name"],
+                    "sessions": h["sessions"],
+                    "owned_shards": len(h["owned_shards"]),
+                    "stats": dict(h["stats"]),
+                })
+            return out
+
     points = []
     try:
         for rate, n_sess in zip(rates, sess_list):
@@ -574,6 +710,7 @@ async def run(args) -> dict:
                 f"# point: offered {rate:.0f}/s, {n_sess} sessions "
                 f"(warmup {args.warmup}s, measure {args.measure}s"
                 + (f", mux {args.mux}/conn" if args.mux else "")
+                + (f", fleet {args.fleet} gateways" if args.fleet else "")
                 + ")",
                 file=sys.stderr,
             )
@@ -588,9 +725,18 @@ async def run(args) -> dict:
                 call_timeout=args.call_timeout,
                 inflight_cap=args.inflight_cap or n_sess * 8,
                 seed=args.seed,
+                # the fleet dial phase is pure handshake over shared
+                # muxes (no socket per session): a wider dial window
+                # keeps the 10^5-hello storm out of the measure window
+                connect_parallel=512 if fleet_harness is not None else 64,
                 mux=args.mux,
                 shed_fn=shed_fn,
                 counters_fn=counters_fn,
+                fleet_resolver=(
+                    fleet_harness.resolver()
+                    if fleet_harness is not None else None
+                ),
+                fleet_fn=fleet_fn,
             )
             points.append(pt)
             print(json.dumps(pt), file=sys.stderr)
@@ -606,7 +752,9 @@ async def run(args) -> dict:
                 file=sys.stderr,
             )
     finally:
-        if cluster is not None:
+        if fleet_harness is not None:
+            await fleet_harness.stop()  # stops its cluster too
+        elif cluster is not None:
             await cluster.stop()
 
     report = {
@@ -626,6 +774,7 @@ async def run(args) -> dict:
             "open_loop": "poisson",
             "seed": args.seed,
             "mux": args.mux,
+            "fleet_gateways": args.fleet or None,
             "persistence": pmode,
             "coalesce": args.coalesce,
             "coalesce_window": args.coalesce_window,
@@ -671,6 +820,16 @@ def main(argv=None) -> int:
         help="sessions per multiplexed connection (the C transport's "
         "session-mux lane; 0 = one direct socket per session). The "
         "10k+ lane: one process cannot hold 10^4 sockets honestly",
+    )
+    ap.add_argument(
+        "--fleet", type=int, default=0, metavar="N",
+        help="front the in-process cluster with N consistent-hash fleet "
+        "gateways (rabia_tpu.fleet) and drive FleetSessions through the "
+        "ring resolver over ONE shared mux connection per gateway — the "
+        "10^5-sessions-behind-one-front-door lane. Every point then "
+        "carries per-gateway and fleet-aggregate counter deltas "
+        "(MOVED, dedup cache hits, ledger replication) plus client-side "
+        "redirect/failover tallies",
     )
     ap.add_argument(
         "--no-persistence", action="store_true",

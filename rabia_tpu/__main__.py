@@ -476,6 +476,88 @@ def _wal_dump(directory: str, records: bool, last) -> int:
     return 0
 
 
+def _ring(addr: str, timeout: float, as_json: bool) -> int:
+    """Dump a routed fleet's control-plane view from any one member:
+    the consistent-hash ring (version, members), the shard -> gateway
+    ownership table, and each member's live session count + routing
+    counters (HEALTH fetched per member — an unreachable member prints
+    as such instead of failing the whole dump). docs/FLEET.md."""
+    import asyncio
+    import json
+
+    from rabia_tpu.core.messages import AdminKind
+    from rabia_tpu.fleet.ring import HashRing
+    from rabia_tpu.gateway import admin_fetch
+
+    parsed = _parse_addr(addr)
+    if parsed is None:
+        print(f"ring: bad address {addr!r} (want host:port)", file=sys.stderr)
+        return 2
+    host, port = parsed
+
+    async def fetch() -> dict:
+        body = await admin_fetch(
+            host, port, int(AdminKind.RING), timeout=timeout
+        )
+        doc = json.loads(body.decode())
+        healths: dict = {}
+        for m in (doc.get("ring") or {}).get("members", []):
+            try:
+                hb = await admin_fetch(
+                    m["host"], m["port"], int(AdminKind.HEALTH),
+                    timeout=timeout,
+                )
+                healths[m["name"]] = json.loads(hb.decode())
+            except Exception as e:
+                healths[m["name"]] = {"error": str(e)}
+        doc["members_health"] = healths
+        return doc
+
+    try:
+        doc = asyncio.run(fetch())
+    except Exception as e:
+        print(f"ring: fetch from {addr} failed: {e}", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    ring_doc = doc.get("ring") or {}
+    n_shards = int(doc.get("n_shards") or 0)
+    print(
+        f"ring version {ring_doc.get('version')}: "
+        f"{len(ring_doc.get('members', []))} members, {n_shards} shards "
+        f"(answered by {doc.get('self')})"
+    )
+    healths = doc["members_health"]
+    for m in ring_doc.get("members", []):
+        h = healths.get(m["name"], {})
+        if "error" in h:
+            print(
+                f"  {m['name']:<12} {m['host']}:{m['port']}  "
+                f"UNREACHABLE ({h['error']})"
+            )
+            continue
+        st = h.get("stats", {})
+        print(
+            f"  {m['name']:<12} {m['host']}:{m['port']}  "
+            f"sessions={h.get('sessions')} "
+            f"shards={len(h.get('owned_shards', []))} "
+            f"moved={st.get('moved')} cached={st.get('cached_replays')} "
+            f"ledger_in={st.get('ledger_applied')} "
+            f"ledger_out={st.get('ledger_sent')}"
+        )
+    ring = HashRing.from_doc(ring_doc)
+    by_owner: dict = {}
+    for s in range(n_shards):
+        owner = ring.owner(s)
+        name = owner.name if owner is not None else "?"
+        by_owner.setdefault(name, []).append(s)
+    for name in sorted(by_owner):
+        shards = ",".join(str(s) for s in by_owner[name])
+        print(f"  shards[{name}]: {shards}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m rabia_tpu",
@@ -554,6 +636,18 @@ def main(argv=None) -> int:
         "--out", default=None, help="also write merged rows to this file"
     )
     tl.add_argument("--timeout", type=float, default=10.0)
+    rg = sub.add_parser(
+        "ring",
+        help="dump a routed fleet's hash ring from any member: "
+        "membership, shard ownership, per-gateway session counts "
+        "(docs/FLEET.md)",
+    )
+    rg.add_argument("addr", help="any fleet gateway host:port")
+    rg.add_argument(
+        "--json", action="store_true",
+        help="print the raw ring + per-member health as JSON",
+    )
+    rg.add_argument("--timeout", type=float, default=10.0)
     wd = sub.add_parser(
         "wal-dump",
         help="inspect a replica's durability-plane directory: segment "
@@ -572,6 +666,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.cmd == "wal-dump":
         return _wal_dump(args.dir, args.records, args.last)
+    if args.cmd == "ring":
+        return _ring(args.addr, args.timeout, args.json)
     if args.cmd == "stats":
         return _stats(
             args.addr, args.kind, args.timeout,

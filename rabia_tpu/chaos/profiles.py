@@ -32,8 +32,17 @@ action                fabrics  args
 ``stop_replica``      tcp      ``node``
 ``start_replica``     tcp      ``node``
 ``restart_replica``   tcp      ``node``
+``kill_gateway``      fleet    ``gw`` (fleet gateway index; abrupt, no handoff)
+``rebalance``         fleet    ``members`` (surviving gateway indices; handoff runs)
 ``clear``             both     — (clears link faults / shaping)
 ====================  =======  ====================================================
+
+``fabric="fleet"`` (round 16) is the routed tier: the same real-TCP
+replica cluster behind consistent-hash-routed fleet gateways
+(docs/FLEET.md), loaded through MOVED-following sessions; its runs add
+a post-run exactly-once replay sweep (every session's last acked
+Result must replay byte-identical through the post-fault ring with
+zero store mutation).
 
 Every profile measures the same consensus-health evidence regardless of
 fabric: the per-decision **phases-to-decide distribution** and
@@ -64,7 +73,7 @@ class ChaosProfile:
     """One named scenario (see module doc for the event vocabulary)."""
 
     name: str
-    fabric: str  # "sim" | "tcp"
+    fabric: str  # "sim" | "tcp" | "fleet"
     description: str
     duration: float  # measure window, seconds
     events: tuple[ChaosEvent, ...] = ()
@@ -75,6 +84,7 @@ class ChaosProfile:
     call_timeout: float = 8.0
     n_replicas: int = 3
     n_shards: int = 4
+    n_gateways: int = 2  # fleet fabric only: routing-tier size
     # acceptance floors (the matrix gate)
     min_availability: float = 0.5  # mean over the whole run
     min_final_availability: float = 0.05  # last-quarter mean: wedge guard
@@ -299,6 +309,24 @@ def default_profiles() -> dict[str, ChaosProfile]:
                 ("coalesce_window_min", 0.02),
             ),
         ),
+        # -- routed fleet fabric (round 16: gateway tier + hash ring) ---
+        _p(
+            "routed_gateway_failover",
+            "fleet",
+            "Kill a fleet gateway mid-wave: clients follow MOVED / ring "
+            "failover to the successor, whose replicated dedup ledger "
+            "answers every redirected replay byte-identically — zero "
+            "double-applies, zero lost acked Results (the post-run "
+            "replay sweep is the gate), and goodput recovers once the "
+            "survivors adopt the shrunken ring",
+            duration=10.0,
+            events=[
+                ChaosEvent(4.0, "kill_gateway", {"gw": 0}),
+            ],
+            rate=80.0,
+            n_gateways=2,
+            min_availability=0.5,
+        ),
         _p(
             "rolling_restart",
             "tcp",
@@ -319,9 +347,10 @@ def default_profiles() -> dict[str, ChaosProfile]:
 
 
 def smoke_profiles() -> dict[str, ChaosProfile]:
-    """The CI smoke subset: 4 short profiles — one simulator adverse-net,
-    one real-TCP shaped, one membership change under load — time-scaled
-    to keep the cell under a couple of minutes."""
+    """The CI smoke subset: 5 short profiles — one simulator adverse-net,
+    one real-TCP shaped, one membership change under load, one routed
+    gateway failover — time-scaled to keep the cell under a couple of
+    minutes."""
     all_p = default_profiles()
     out = {}
     for name, factor in (
@@ -329,6 +358,7 @@ def smoke_profiles() -> dict[str, ChaosProfile]:
         ("tcp_shaped_wan", 0.6),
         ("membership_elastic", 0.7),
         ("coalesce_flap_restart", 0.7),
+        ("routed_gateway_failover", 0.7),
     ):
         out[name] = all_p[name].scaled(factor)
     return out

@@ -432,6 +432,189 @@ class _TcpFabric:
             return False
 
 
+class _FleetFabric:
+    """Routed fleet (round 16): a real-TCP replica cluster behind
+    consistent-hash-routed fleet gateways
+    (:class:`~rabia_tpu.fleet.harness.FleetHarness`), driven by
+    MOVED-following :class:`~rabia_tpu.fleet.harness.FleetSession`
+    clients over shared mux connections. Events add ``kill_gateway``
+    (abrupt death, no handoff — survivors adopt the shrunken ring) and
+    ``rebalance`` (planned drain with session handoff). The post-run
+    :meth:`verify` hook is the scenario's exactly-once gate: every
+    session's last ACKED result must replay byte-identical wherever
+    the ring routes it now, with zero store mutation."""
+
+    name = "fleet"
+
+    N_SESSIONS = 24
+
+    def __init__(self, profile: ChaosProfile) -> None:
+        from rabia_tpu.fleet.harness import FleetHarness
+        from rabia_tpu.gateway import GatewayConfig
+
+        self.profile = profile
+        gw_cfg = (
+            GatewayConfig(**dict(profile.gateway_overrides))
+            if profile.gateway_overrides
+            else None
+        )
+        self.harness = FleetHarness(
+            n_gateways=profile.n_gateways,
+            n_replicas=profile.n_replicas,
+            n_shards=profile.n_shards,
+            persistence="wal",
+            gateway_config=gw_cfg,
+        )
+        self._sessions: list = []
+        self._pool = None
+        # per session: (seq, shard, payload bytes) of the LAST acked
+        # submit — the verify() replay sample (newest seq per session
+        # is never GC-eligible under its own ack frontier)
+        self._last_acked: dict[int, tuple] = {}
+
+    async def start(self) -> None:
+        from rabia_tpu.fleet.harness import FleetConnPool, FleetSession
+
+        await self.harness.start()
+        self._pool = FleetConnPool(self.harness.ser)
+        resolver = self.harness.resolver()
+        self._sessions = [
+            FleetSession(
+                self.harness.ser, resolver, pool=self._pool,
+                call_timeout=min(5.0, self.profile.call_timeout),
+            )
+            for _ in range(self.N_SESSIONS)
+        ]
+
+    async def stop(self) -> None:
+        for s in self._sessions:
+            await s.close()
+        self._sessions = []
+        if self._pool is not None:
+            await self._pool.close()
+        await self.harness.stop()
+        if self.harness.cluster.wal_dir:
+            import shutil
+
+            shutil.rmtree(self.harness.cluster.wal_dir, ignore_errors=True)
+
+    # -- events -------------------------------------------------------------
+
+    def apply_event(self, action: str, args: dict) -> None:
+        if action == "clear":
+            return
+        if action in ("kill_gateway", "rebalance"):
+            raise RuntimeError("fleet events are async — runner bug")
+        raise ValueError(f"fleet fabric: unknown action {action!r}")
+
+    async def apply_event_async(self, action: str, args: dict) -> None:
+        if action == "kill_gateway":
+            await self.harness.kill_gateway(args["gw"])
+        elif action == "rebalance":
+            await self.harness.rebalance(args["members"])
+        else:
+            self.apply_event(action, args)
+
+    def clear_faults(self) -> None:
+        pass
+
+    # -- load ---------------------------------------------------------------
+
+    async def submit(self, i: int, pairs: list, timeout: float) -> str:
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        si = i % len(self._sessions)
+        sess = self._sessions[si]
+        shard = i % self.profile.n_shards
+        cmds = [encode_set_bin(k, v) for k, v in pairs]
+        try:
+            res = await sess.submit(shard, cmds, timeout=timeout)
+        except (asyncio.TimeoutError, TimeoutError):
+            return "timeout"
+        except Exception:
+            return "error"
+        if res.status in (ResultStatus.OK, ResultStatus.CACHED):
+            self._last_acked[si] = (
+                res.seq, shard, tuple(bytes(p) for p in res.payload)
+            )
+            return "ok"
+        if res.status == ResultStatus.RETRY:
+            return "shed"
+        return "error"
+
+    # -- scoring ------------------------------------------------------------
+
+    async def verify(self) -> list[str]:
+        """The routed-failover acceptance gates: zero lost acked
+        Results (byte-identical replays through the post-fault ring)
+        and zero double-applies (store mutation parity across the
+        replay sweep)."""
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        problems: list[str] = []
+        if not self._last_acked:
+            return ["fleet verify: no acked submits to replay"]
+
+        def versions():
+            return [
+                [
+                    self.harness.cluster.store(r, s).version
+                    for s in range(self.profile.n_shards)
+                ]
+                for r in range(self.profile.n_replicas)
+            ]
+
+        before = versions()
+        lost = 0
+        for si in sorted(self._last_acked):
+            seq, shard, want = self._last_acked[si]
+            try:
+                res = await self._sessions[si].submit_seq(
+                    seq, shard,
+                    [encode_set_bin("verify-replay", "X")],
+                    timeout=15.0,
+                )
+            except Exception as e:
+                problems.append(
+                    f"fleet verify: replay session {si} seq {seq} "
+                    f"failed: {e}"
+                )
+                continue
+            if tuple(bytes(p) for p in res.payload) != want:
+                lost += 1
+        if lost:
+            problems.append(
+                f"fleet verify: {lost} acked result(s) replayed "
+                "non-identical — exactly-once broken"
+            )
+        await asyncio.sleep(0.3)
+        if versions() != before:
+            problems.append(
+                "fleet verify: replays mutated replica state — "
+                "double apply"
+            )
+        return problems
+
+    def engines(self) -> list:
+        return [
+            e for e in self.harness.cluster.engines if e is not None
+        ]
+
+    def decided_totals(self) -> list[Optional[int]]:
+        return [
+            int(e.rt.decided_v1 + e.rt.decided_v0) if e is not None else None
+            for e in self.harness.cluster.engines
+        ]
+
+    async def converged(self, timeout: float) -> bool:
+        try:
+            await self.harness.cluster.wait_converged(timeout)
+            return True
+        except Exception as e:
+            print(f"# convergence failure: {e}", file=sys.stderr)
+            return False
+
+
 # ---------------------------------------------------------------------------
 # Consensus-health evidence
 # ---------------------------------------------------------------------------
@@ -499,9 +682,9 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
         if verbose:
             print(f"# [{profile.name}] {msg}", file=sys.stderr)
 
-    fabric = (
-        _SimFabric(profile) if profile.fabric == "sim" else _TcpFabric(profile)
-    )
+    fabric = {
+        "sim": _SimFabric, "tcp": _TcpFabric, "fleet": _FleetFabric,
+    }[profile.fabric](profile)
     log(f"starting {profile.fabric} cluster "
         f"({profile.n_replicas} replicas, {profile.n_shards} shards)")
     await fabric.start()
@@ -619,6 +802,12 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
         converged = True
         if profile.require_convergence:
             converged = await fabric.converged(timeout=10.0)
+        # fabric-specific end-state gates (the fleet fabric's
+        # exactly-once replay sweep) — run before teardown
+        fabric_problems: list = []
+        if hasattr(fabric, "verify"):
+            log("running fabric verify sweep")
+            fabric_problems = await fabric.verify()
         evidence = collect_evidence(fabric.engines())
     finally:
         await fabric.stop()
@@ -661,6 +850,7 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
         problems.append("replicas did not converge after fault clearing")
     if not evidence["decisions"]:
         problems.append("no phases-to-decide evidence recorded")
+    problems.extend(fabric_problems)
 
     report = {
         "profile": profile.name,

@@ -337,6 +337,11 @@ class ResultStatus(enum.IntEnum):
     ERROR = 1  # terminal failure; payload = (message,)
     RETRY = 2  # admission control shed the request; safe to resubmit
     CACHED = 3  # duplicate (client_id, seq): answered from session cache
+    # routed-fleet redirect (docs/FLEET.md): this gateway does not own
+    # the shard — payload = (b"host:port", 16-byte owner node id). The
+    # client re-sends the SAME seq to the named owner; exactly-once is
+    # preserved because nothing was proposed or reserved here.
+    MOVED = 4
 
 
 class ReadIndexMode(enum.IntEnum):
@@ -431,6 +436,18 @@ class AdminKind(enum.IntEnum):
     # snapshots plus the serve-time (wall, mono_ns) pair the collector
     # clock-aligns with (`python -m rabia_tpu timeline`)
     TIMELINE = 4
+    # routed gateway fleet (docs/FLEET.md). RING: query {"op": "get"}
+    # returns the gateway's live hash-ring view + session counts;
+    # {"op": "set", "ring": doc} installs a new membership view and
+    # triggers session handoff for shards that moved away. HANDOFF:
+    # query = binary session-transfer blob (fleet/handoff.py); the new
+    # owner imports the sessions and acks with the imported count.
+    # LEDGER: query = binary completed-result records (fleet/ledger.py)
+    # replicated to the shard's gateway group so a gateway failover
+    # preserves exactly-once replay without waiting out session leases.
+    RING = 5
+    HANDOFF = 6
+    LEDGER = 7
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,30 @@
+"""Routed gateway fleet: many standalone gateway processes behind a
+consistent-hash front door (docs/FLEET.md).
+
+- :mod:`rabia_tpu.fleet.ring` — the consistent-hash router mapping
+  shard -> owning gateway, with bounded-movement rebalance;
+- :mod:`rabia_tpu.fleet.ledger` — completed-result records replicated
+  to the shard's gateway group (exactly-once across gateway failover);
+- :mod:`rabia_tpu.fleet.handoff` — session transfer on planned
+  rebalance (windows, ack frontiers, inflight reservations);
+- :mod:`rabia_tpu.fleet.gateway_proc` — the standalone gateway itself:
+  holds sessions and forward windows, proxies Submits to the replica
+  cluster over the mux transport lane, answers ``MOVED`` for shards it
+  does not own;
+- :mod:`rabia_tpu.fleet.harness` — in-process fleet harness + the
+  MOVED-following client session used by tests/chaos/bench.
+"""
+
+from rabia_tpu.fleet.ring import HashRing, RingMember, moved_shards
+from rabia_tpu.fleet.ledger import LedgerRecord, apply_record
+from rabia_tpu.fleet.gateway_proc import FleetGateway, FleetGatewayConfig
+
+__all__ = [
+    "HashRing",
+    "RingMember",
+    "moved_shards",
+    "LedgerRecord",
+    "apply_record",
+    "FleetGateway",
+    "FleetGatewayConfig",
+]

@@ -218,6 +218,7 @@ class RabiaClient:
         self.server_last_seq = 0
         self.reconnects = 0
         self.cached_replies = 0  # results answered from the session cache
+        self.moved_redirects = 0  # fleet MOVED redirects followed
         self._conn_lock = asyncio.Lock()
 
     # -- connection management ---------------------------------------------
@@ -403,6 +404,31 @@ class RabiaClient:
         finally:
             self._pending.pop(seq, None)
 
+    async def _redirect(self, res: Result) -> None:
+        """Follow a fleet-tier ``MOVED`` redirect: the payload names the
+        shard's owning gateway (``b"host:port"``, 16-byte node id). The
+        owner moves to the front of the endpoint rotation and the link
+        redials it; the caller then re-sends the SAME seq there —
+        exactly-once holds because the redirecting gateway reserved and
+        proposed nothing (docs/FLEET.md)."""
+        host, _, port = res.payload[0].decode().rpartition(":")
+        node = (
+            NodeId(uuid.UUID(bytes=bytes(res.payload[1])))
+            if len(res.payload) > 1 and len(res.payload[1]) == 16
+            else NodeId(fast_uuid4())  # transport learns the real id
+        )
+        ep = GatewayEndpoint(node_id=node, host=host, port=int(port))
+        self.moved_redirects += 1
+        async with self._conn_lock:
+            self.endpoints = [ep] + [
+                e for e in self.endpoints
+                if (e.host, e.port) != (ep.host, ep.port)
+            ]
+            self._endpoint_idx = 0
+            self._gateway = None
+            await self._teardown_net()
+            await self._connect_locked(5.0)
+
     async def _link_alive(self) -> bool:
         if self._net is None or self._gateway is None:
             return False
@@ -424,6 +450,7 @@ class RabiaClient:
             c if isinstance(c, bytes) else bytes(c) for c in commands
         )
         attempts = 0
+        redirects = 0
         while True:
             frame = Submit(
                 client_id=self.client_id,
@@ -436,6 +463,14 @@ class RabiaClient:
             if res.status in (ResultStatus.OK, ResultStatus.CACHED):
                 self._ack(seq)
                 return list(res.payload)
+            if res.status == ResultStatus.MOVED:
+                redirects += 1
+                if redirects > 8:
+                    raise GatewayError(
+                        f"shard {shard}: MOVED redirect loop"
+                    )
+                await self._redirect(res)
+                continue  # same seq to the named owner
             if res.status == ResultStatus.RETRY:
                 attempts += 1
                 if (
@@ -462,6 +497,7 @@ class RabiaClient:
         seq = self._next_seq()
         kb = key.encode() if isinstance(key, str) else bytes(key)
         attempts = 0
+        redirects = 0
         while True:
             frame = ReadIndex(
                 mode=int(ReadIndexMode.READ),
@@ -478,6 +514,14 @@ class RabiaClient:
                 # read forever
                 self._ack(seq)
                 return res.payload[0] if res.payload else b""
+            if res.status == ResultStatus.MOVED:
+                redirects += 1
+                if redirects > 8:
+                    raise GatewayError(
+                        f"shard {shard}: MOVED redirect loop"
+                    )
+                await self._redirect(res)
+                continue  # same seq to the named owner
             if res.status == ResultStatus.RETRY:
                 attempts += 1
                 if (

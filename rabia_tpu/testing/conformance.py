@@ -859,10 +859,17 @@ def run_gateway_ops_on_both_tables(
     drift.
 
     Each op is a dict: ``{"op": "hello"|"submit"|"complete"|"abort"|
-    "gc", "t": <time>, ...}`` with op-specific fields (``cid``,
-    ``seq``, ``window``, ``ack``, ``status``, ``payload``,
-    ``frontier``, ``sv``).
+    "gc"|"ledger", "t": <time>, ...}`` with op-specific fields
+    (``cid``, ``seq``, ``window``, ``ack``, ``status``, ``payload``,
+    ``frontier``, ``sv``). The ``ledger`` op is the fleet tier's
+    replicated completed-result record
+    (:func:`rabia_tpu.fleet.apply_record` — reserve-if-absent +
+    complete in one step): a gateway-failover replay must find the
+    byte-identical cached result on the successor's table whichever
+    backend that table runs, so the record's landing decision is part
+    of the conformance surface.
     """
+    from rabia_tpu.fleet.ledger import apply_record
     from rabia_tpu.gateway.native_session import NativeSessionTable
     from rabia_tpu.gateway.session import SessionTable
     from rabia_tpu.native.build import load_sessionkernel
@@ -915,6 +922,15 @@ def run_gateway_ops_on_both_tables(
             elif kind == "gc":
                 a = py.gc(op["sv"], now=t)
                 b = nat.gc(op["sv"], now=t)
+            elif kind == "ledger":
+                a = apply_record(
+                    py, op["cid"], op["seq"], op["status"],
+                    op["payload"], op["frontier"], now=t,
+                )
+                b = apply_record(
+                    nat, op["cid"], op["seq"], op["status"],
+                    op["payload"], op["frontier"], now=t,
+                )
             else:  # pragma: no cover - schedule generator bug
                 raise ValueError(f"unknown gateway op {kind!r}")
             assert a == b, (
@@ -964,7 +980,9 @@ def random_gateway_ops(seed: int, n_ops: int = 400) -> list[dict]:
     inflight branches are hit constantly), random completes/aborts that
     need not match reservations (invalid transitions must diverge
     NOWHERE), time advancing with occasional jumps past the idle ttl
-    and the hard lease, and gc at random frontiers."""
+    and the hard lease, gc at random frontiers, and fleet ledger
+    records (reserve+complete in one step) racing the client's own
+    submits over the same narrow seq range."""
     import random
     import uuid as _uuid
 
@@ -1007,6 +1025,18 @@ def random_gateway_ops(seed: int, n_ops: int = 400) -> list[dict]:
             })
         elif r < 0.88:
             ops.append({"op": "abort", "t": t, "cid": cid, "seq": seq})
+        elif r < 0.95:
+            nparts = rng.randint(0, 2)
+            payload = tuple(
+                bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 24)))
+                for _ in range(nparts)
+            )
+            sv += rng.randint(0, 2)
+            ops.append({
+                "op": "ledger", "t": t, "cid": cid, "seq": seq,
+                "status": rng.choice([0, 1]),
+                "payload": payload, "frontier": sv,
+            })
         else:
             sv += rng.randint(0, 5)
             ops.append({"op": "gc", "t": t, "sv": sv})

@@ -52,8 +52,13 @@ class LoadSession:
         "_seq", "pending", "_read_task", "_hello", "_mux",
     )
 
-    def __init__(self, ser: Serializer) -> None:
-        self.client_id = uuid.uuid4()
+    def __init__(
+        self, ser: Serializer, client_id: Optional[uuid.UUID] = None
+    ) -> None:
+        # an explicit client_id re-speaks an EXISTING session identity
+        # over a new connection — the fleet tier's MOVED-following
+        # client redials a different gateway mid-session with it
+        self.client_id = client_id or uuid.uuid4()
         self.node_id = NodeId(self.client_id)
         self.ser = ser
         self.reader: Optional[asyncio.StreamReader] = None
@@ -139,7 +144,17 @@ class LoadSession:
         self, shard: int, commands: Sequence[bytes], timeout: float
     ) -> Result:
         self._seq += 1
-        seq = self._seq
+        return await self.submit_seq(self._seq, shard, commands, timeout)
+
+    async def submit_seq(
+        self, seq: int, shard: int, commands: Sequence[bytes],
+        timeout: float,
+    ) -> Result:
+        """Submit under an EXPLICIT seq — the replay/redirect lane: a
+        MOVED-following or failover-retrying client re-sends the SAME
+        seq on a different connection and the session tables dedup it."""
+        if seq > self._seq:
+            self._seq = seq
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self.pending[seq] = fut
         try:

@@ -162,8 +162,9 @@ class TestProfileDsl:
         profs = default_profiles()
         assert len(profs) >= 6
         fabrics = {p.fabric for p in profs.values()}
-        assert fabrics == {"sim", "tcp"}
-        # the acceptance shape: >=1 real-TCP shaped, >=1 membership
+        assert fabrics == {"sim", "tcp", "fleet"}
+        # the acceptance shape: >=1 real-TCP shaped, >=1 membership,
+        # >=1 routed-fleet gateway failover (round 16)
         assert any(
             p.fabric == "tcp"
             and any(e.action in ("wan", "link_loss") for e in p.events)
@@ -177,9 +178,15 @@ class TestProfileDsl:
             )
             for p in profs.values()
         )
+        assert any(
+            p.fabric == "fleet"
+            and any(e.action == "kill_gateway" for e in p.events)
+            for p in profs.values()
+        )
         smoke = smoke_profiles()
-        assert 2 <= len(smoke) <= 4
+        assert 2 <= len(smoke) <= 6
         assert any(p.fabric == "tcp" for p in smoke.values())
+        assert "routed_gateway_failover" in smoke
 
     def test_scaling_preserves_structure(self):
         p = ChaosProfile(
@@ -236,6 +243,25 @@ class TestScenarioRunSim:
         assert ev["hist"], "empty phase-count distribution"
         assert ev["mean_phases"] >= 1.0
         assert set(ev["coin_flips"]) == {"v0", "v1"}
+        assert rep["converged"] is True
+        assert rep["pass"], rep["problems"]
+
+
+class TestScenarioRunFleet:
+    @pytest.mark.asyncio
+    async def test_routed_gateway_failover_mini(self):
+        """End-to-end mini routed-fleet scenario: kill a fleet gateway
+        mid-wave — clients follow the ring to the survivor, the run
+        scores non-zero goodput through the kill, and the post-run
+        exactly-once replay sweep (fabric.verify) passes with zero
+        problems."""
+        from rabia_tpu.chaos.profiles import default_profiles
+        from rabia_tpu.chaos.runner import run_profile
+
+        prof = default_profiles()["routed_gateway_failover"].scaled(0.4)
+        rep = await run_profile(prof, verbose=False)
+        assert rep["fabric"] == "fleet"
+        assert rep["outcomes"]["ok"] > 0
         assert rep["converged"] is True
         assert rep["pass"], rep["problems"]
 

@@ -1,0 +1,547 @@
+"""Fleet tier tests: hash ring, ledger/handoff codecs and semantics,
+routed MOVED-following clients, cross-gateway exactly-once.
+
+The in-process tests drive a real-TCP replica cluster
+(:class:`~rabia_tpu.testing.gateway_cluster.GatewayCluster`) behind
+in-process :class:`~rabia_tpu.fleet.gateway_proc.FleetGateway`\\ s
+(:class:`~rabia_tpu.fleet.harness.FleetHarness`); the subprocess test
+spawns each fleet gateway as its own OS process via the
+testing/recovery child protocol, so a SIGKILL is a real crash. The
+invariants under test are docs/FLEET.md's failure matrix: MOVED never
+loses a seq, handoff lands dedup state before redirects start, and a
+killed gateway's acked results replay byte-identical from the
+replicated ledger on its ring successor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import uuid
+
+import pytest
+
+from rabia_tpu.apps.kvstore import encode_set_bin
+from rabia_tpu.core.messages import ResultStatus
+from rabia_tpu.core.serialization import Serializer
+from rabia_tpu.core.types import NodeId
+from rabia_tpu.fleet import HashRing, RingMember, moved_shards
+from rabia_tpu.fleet.handoff import (
+    SessionExport,
+    decode_handoff,
+    encode_handoff,
+    export_sessions,
+    import_sessions,
+)
+from rabia_tpu.fleet.harness import (
+    FleetConnPool,
+    FleetHarness,
+    FleetResolver,
+    FleetSession,
+)
+from rabia_tpu.fleet.ledger import (
+    LedgerRecord,
+    apply_record,
+    decode_records,
+    encode_records,
+)
+from rabia_tpu.gateway.session import (
+    SUBMIT_DUP_CACHED,
+    SUBMIT_DUP_INFLIGHT,
+    SUBMIT_FRESH,
+    SessionTable,
+)
+
+N_SHARDS = 64
+
+
+def _members(n: int, port0: int = 9000) -> list[RingMember]:
+    return [
+        RingMember(
+            name=f"gw{i}", host="127.0.0.1", port=port0 + i,
+            node=NodeId.from_int(2000 + i),
+        )
+        for i in range(n)
+    ]
+
+
+class TestHashRing:
+    def test_ownership_total_and_deterministic(self):
+        a, b = HashRing(), HashRing()
+        for m in _members(4):
+            a.add(m)
+        for m in reversed(_members(4)):
+            b.add(m)  # insertion order must not matter
+        owners_a = {s: a.owner(s).name for s in range(N_SHARDS)}
+        owners_b = {s: b.owner(s).name for s in range(N_SHARDS)}
+        assert owners_a == owners_b
+        assert set(owners_a.values()) == {"gw0", "gw1", "gw2", "gw3"}
+
+    def test_bounded_movement_on_removal(self):
+        """Removing one member moves exactly its own shards — every
+        other shard keeps its owner (the consistent-hash contract)."""
+        old = HashRing()
+        for m in _members(4):
+            old.add(m)
+        new = old.copy()
+        new.remove("gw2")
+        moved = moved_shards(old, new, N_SHARDS)
+        assert moved, "gw2 owned no shards at 64 — degenerate layout"
+        for s in range(N_SHARDS):
+            if old.owner(s).name == "gw2":
+                assert s in moved and moved[s] != "gw2"
+            else:
+                assert s not in moved
+                assert new.owner(s).name == old.owner(s).name
+
+    def test_bounded_movement_on_add(self):
+        old = HashRing()
+        for m in _members(3):
+            old.add(m)
+        new = old.copy()
+        new.add(_members(4)[3])
+        moved = moved_shards(old, new, N_SHARDS)
+        # every moved shard moved TO the new member, none between
+        # incumbents
+        assert moved
+        assert set(moved.values()) == {"gw3"}
+
+    def test_successors_distinct_start_with_owner(self):
+        ring = HashRing()
+        for m in _members(4):
+            ring.add(m)
+        for s in range(N_SHARDS):
+            succ = ring.successors(s, 3)
+            assert len(succ) == 3
+            assert len({m.name for m in succ}) == 3
+            assert succ[0].name == ring.owner(s).name
+        # k beyond membership clamps to distinct members
+        assert len(ring.successors(0, 10)) == 4
+
+    def test_doc_round_trip_and_version(self):
+        ring = HashRing(vnodes=8)
+        v0 = ring.version
+        for m in _members(3):
+            ring.add(m)
+        assert ring.version == v0 + 3
+        clone = HashRing.from_doc(ring.to_doc())
+        assert len(clone) == 3
+        assert clone.vnodes == 8
+        for s in range(N_SHARDS):
+            assert clone.owner(s).name == ring.owner(s).name
+        m = clone.members["gw1"]
+        assert (m.host, m.port, m.node) == (
+            "127.0.0.1", 9001, NodeId.from_int(2001),
+        )
+        ring.remove("gw0")
+        assert ring.version == v0 + 4
+
+
+class TestLedgerCodec:
+    def test_round_trip(self):
+        recs = [
+            LedgerRecord(
+                client_id=uuid.UUID(int=7), seq=3, shard=1, status=0,
+                payload=(b"ok", b"", b"\x00" * 300),
+            ),
+            LedgerRecord(
+                client_id=uuid.UUID(int=8), seq=2**40, shard=0,
+                status=1, payload=(),
+            ),
+        ]
+        assert decode_records(encode_records(recs)) == recs
+
+    def test_apply_fresh_then_replay_is_cached(self):
+        t = SessionTable(default_window=4)
+        cid = uuid.UUID(int=9)
+        d = apply_record(t, cid, 1, 0, (b"r1",), 5, now=0.0)
+        assert d == SUBMIT_FRESH
+        dec, st, pl = t.submit_check(cid, 1, 0, now=0.1)
+        assert dec == SUBMIT_DUP_CACHED
+        assert (st, pl) == (0, (b"r1",))
+
+    def test_apply_onto_existing_reservation_completes_it(self):
+        t = SessionTable(default_window=4)
+        cid = uuid.UUID(int=10)
+        assert t.submit_check(cid, 1, 0, now=0.0)[0] == SUBMIT_FRESH
+        d = apply_record(t, cid, 1, 0, (b"done",), 6, now=0.1)
+        assert d == SUBMIT_DUP_INFLIGHT
+        dec, st, pl = t.submit_check(cid, 1, 0, now=0.2)
+        assert dec == SUBMIT_DUP_CACHED and pl == (b"done",)
+
+    def test_apply_never_overwrites_cached(self):
+        """First completion wins: a late ledger record for an
+        already-cached seq is a no-op (the byte-identical-replay
+        invariant would break otherwise)."""
+        t = SessionTable(default_window=4)
+        cid = uuid.UUID(int=11)
+        apply_record(t, cid, 1, 0, (b"first",), 1, now=0.0)
+        d = apply_record(t, cid, 1, 1, (b"second",), 2, now=0.1)
+        assert d == SUBMIT_DUP_CACHED
+        assert t.cached_result(cid, 1).payload == (b"first",)
+
+
+class TestHandoff:
+    def _table_with_state(self):
+        t = SessionTable(default_window=8)
+        c1, c2 = uuid.UUID(int=21), uuid.UUID(int=22)
+        for seq in (1, 2, 3):
+            assert t.submit_check(c1, seq, 0, now=0.0)[0] == SUBMIT_FRESH
+        t.complete_op(c1, 1, 0, (b"a1", b""), 1, now=0.0)
+        t.complete_op(c1, 2, 1, (b"err",), 2, now=0.0)
+        # seq 3 stays inflight
+        assert t.submit_check(c2, 1, 0, now=0.0)[0] == SUBMIT_FRESH
+        t.complete_op(c2, 1, 0, (b"b1",), 3, now=0.0)
+        return t, c1, c2
+
+    def test_codec_round_trip(self):
+        t, c1, c2 = self._table_with_state()
+        exports = export_sessions(t, [c1, c2, uuid.UUID(int=99)])
+        assert len(exports) == 2  # unknown cid skipped
+        assert decode_handoff(encode_handoff(exports)) == exports
+
+    def test_import_lands_replayable_state(self):
+        t, c1, c2 = self._table_with_state()
+        dst = SessionTable(default_window=8)
+        summary = import_sessions(
+            dst, export_sessions(t, [c1, c2]), frontier_mark=10, now=1.0
+        )
+        assert summary.sessions == 2
+        assert summary.results == 3
+        assert summary.inflight == 1
+        assert summary.skipped == 0
+        # replays answer byte-identically on the new owner
+        dec, st, pl = dst.submit_check(c1, 2, 0, now=1.1)
+        assert dec == SUBMIT_DUP_CACHED and (st, pl) == (1, (b"err",))
+        dec, st, pl = dst.submit_check(c1, 1, 0, now=1.1)
+        assert dec == SUBMIT_DUP_CACHED and (st, pl) == (0, (b"a1", b""))
+        # the inflight seq imported as a live reservation, not a result
+        assert dst.submit_check(c1, 3, 0, now=1.1)[0] == SUBMIT_DUP_INFLIGHT
+        # the window grant survived the move
+        assert dst.sessions[c1].window == 8
+
+    def test_import_never_overwrites_resident_state(self):
+        """A replay (or ledger record) racing the handoff means the
+        destination already holds the seq — the import must count it
+        skipped, not clobber it."""
+        t, c1, _c2 = self._table_with_state()
+        dst = SessionTable(default_window=8)
+        apply_record(dst, c1, 1, 0, (b"resident",), 1, now=0.5)
+        summary = import_sessions(
+            dst, export_sessions(t, [c1]), frontier_mark=10, now=1.0
+        )
+        assert summary.skipped == 1
+        assert dst.cached_result(c1, 1).payload == (b"resident",)
+
+
+def _gw_index(harness: FleetHarness, member) -> int:
+    return int(member.name.removeprefix("gw"))
+
+
+async def _owner_and_successor(harness: FleetHarness, shard: int):
+    ring = harness.gateways[harness.live_indices()[0]].ring
+    owner, succ = ring.successors(shard, 2)
+    return _gw_index(harness, owner), _gw_index(harness, succ)
+
+
+class TestFleetRouting:
+    @pytest.mark.asyncio
+    async def test_moved_redirect_reaches_owner(self):
+        """A client whose ring view is wrong gets MOVED to the real
+        owner and the SAME seq commits there — no lost or doubled
+        submits, and the resolver remembers the correction."""
+        h = FleetHarness(n_gateways=2, n_shards=4, persistence=False)
+        await h.start()
+        try:
+            shard = 0
+            owner_i, succ_i = await _owner_and_successor(h, shard)
+            resolver = h.resolver()
+            # poison the view: point the shard at the non-owner
+            wrong = h.gateways[succ_i].member()
+            resolver.note_moved(shard, (wrong.host, wrong.port))
+            sess = FleetSession(h.ser, resolver)
+            res = await sess.submit(shard, [encode_set_bin("mv", "1")])
+            assert res.status == ResultStatus.OK
+            assert sess.redirects >= 1
+            assert resolver.addr_for(shard) == (
+                h.gateways[owner_i].member().host,
+                h.gateways[owner_i].member().port,
+            )
+            # second submit goes straight through (no new redirect)
+            before = sess.redirects
+            res = await sess.submit(shard, [encode_set_bin("mv", "2")])
+            assert res.status == ResultStatus.OK
+            assert sess.redirects == before
+            assert h.gateways[succ_i].stats.moved >= 1
+            await sess.close()
+        finally:
+            await h.stop()
+
+    @pytest.mark.asyncio
+    async def test_ledger_replication_answers_replay_on_successor(self):
+        """A completed result's ledger record lands on the shard's ring
+        successor; a replay of the SAME seq routed there answers CACHED
+        with byte-identical payload — without the successor ever
+        forwarding upstream."""
+        h = FleetHarness(n_gateways=2, n_shards=4, persistence=False)
+        await h.start()
+        try:
+            shard = 1
+            owner_i, succ_i = await _owner_and_successor(h, shard)
+            sess = FleetSession(h.ser, h.resolver())
+            res = await sess.submit(shard, [encode_set_bin("led", "v")])
+            assert res.status == ResultStatus.OK
+            want = tuple(bytes(p) for p in res.payload)
+            # replication is fire-and-forget: wait for the record
+            succ = h.gateways[succ_i]
+            for _ in range(100):
+                if succ.sessions.cached_result(sess.client_id, 1):
+                    break
+                await asyncio.sleep(0.02)
+            rec = succ.sessions.cached_result(sess.client_id, 1)
+            assert rec is not None, "ledger record never replicated"
+            # route the replay AT the successor
+            sess.resolver.note_moved(
+                shard, (succ.member().host, succ.member().port)
+            )
+            replay = await sess.submit_seq(
+                1, shard, [encode_set_bin("led", "v")]
+            )
+            assert replay.status == ResultStatus.CACHED
+            assert tuple(bytes(p) for p in replay.payload) == want
+            assert succ.stats.ledger_applied >= 1
+            assert h.gateways[owner_i].stats.ledger_sent >= 1
+            await sess.close()
+        finally:
+            await h.stop()
+
+    @pytest.mark.asyncio
+    async def test_rebalance_hands_sessions_off_before_moved(self):
+        """A planned drain: the departing gateway exports its sessions
+        to the new owners BEFORE answering MOVED, so a redirected
+        client's replay finds its dedup state resident — CACHED,
+        byte-identical — and fresh traffic keeps flowing."""
+        h = FleetHarness(n_gateways=2, n_shards=4, persistence=False)
+        await h.start()
+        try:
+            stale = h.resolver()  # pre-drain view: will be MOVED
+            sessions = [FleetSession(h.ser, stale) for _ in range(4)]
+            want: dict[int, tuple] = {}
+            for i, s in enumerate(sessions):
+                shard = i % 4
+                res = await s.submit(
+                    shard, [encode_set_bin(f"hk{i}", f"v{i}")]
+                )
+                assert res.status == ResultStatus.OK
+                want[i] = tuple(bytes(p) for p in res.payload)
+            # drain gw0: every shard moves to gw1, sessions ride along
+            await h.rebalance([1])
+            imported = h.gateways[1].stats.handoff_in_sessions
+            assert imported >= 1, "no sessions handed off"
+            for i, s in enumerate(sessions):
+                shard = i % 4
+                replay = await s.submit_seq(
+                    1, shard, [encode_set_bin(f"hk{i}", f"v{i}")]
+                )
+                assert replay.status == ResultStatus.CACHED, (
+                    f"session {i} replay was {replay.status} not CACHED"
+                )
+                assert tuple(bytes(p) for p in replay.payload) == want[i]
+                fresh = await s.submit(
+                    shard, [encode_set_bin(f"hk{i}-b", "w")]
+                )
+                assert fresh.status == ResultStatus.OK
+            for s in sessions:
+                await s.close()
+        finally:
+            await h.stop()
+
+    @pytest.mark.asyncio
+    async def test_gateway_kill_failover_exactly_once(self):
+        """Abrupt gateway death (no handoff): the client fails over to
+        the ring successor, the acked pre-kill result replays CACHED
+        byte-identical from the replicated ledger, replays mutate
+        nothing (store.version parity), and fresh submits keep
+        flowing."""
+        h = FleetHarness(n_gateways=2, n_shards=4, persistence=False)
+        await h.start()
+        try:
+            shard = 2
+            owner_i, succ_i = await _owner_and_successor(h, shard)
+            sess = FleetSession(h.ser, h.resolver())
+            res = await sess.submit(shard, [encode_set_bin("fk", "v")])
+            assert res.status == ResultStatus.OK
+            want = tuple(bytes(p) for p in res.payload)
+            # wait for the replicated record before the kill — the
+            # fire-and-forget window is the cost of async replication;
+            # bounding it is the chaos scenario's job, not this test's
+            succ = h.gateways[succ_i]
+            for _ in range(100):
+                if succ.sessions.cached_result(sess.client_id, 1):
+                    break
+                await asyncio.sleep(0.02)
+            assert succ.sessions.cached_result(sess.client_id, 1)
+            vers = [
+                h.cluster.store(r, shard).version for r in range(3)
+            ]
+            await h.kill_gateway(owner_i)
+            replay = await sess.submit_seq(
+                1, shard, [encode_set_bin("fk", "X")], timeout=20.0
+            )
+            assert sess.failovers >= 1
+            assert replay.status == ResultStatus.CACHED
+            assert tuple(bytes(p) for p in replay.payload) == want
+            await asyncio.sleep(0.3)
+            assert [
+                h.cluster.store(r, shard).version for r in range(3)
+            ] == vers, "failover replay re-applied (double apply)"
+            fresh = await sess.submit(
+                shard, [encode_set_bin("fk2", "w")], timeout=20.0
+            )
+            assert fresh.status == ResultStatus.OK
+            await sess.close()
+        finally:
+            await h.stop()
+
+    @pytest.mark.asyncio
+    async def test_mux_pool_sessions_share_sockets(self):
+        """The 10^5-session lane: many FleetSessions over one
+        FleetConnPool — one mux socket per gateway serves them all."""
+        h = FleetHarness(n_gateways=2, n_shards=4, persistence=False)
+        await h.start()
+        try:
+            pool = FleetConnPool(h.ser)
+            resolver = h.resolver()
+            sessions = [
+                FleetSession(h.ser, resolver, pool=pool)
+                for _ in range(16)
+            ]
+            res = await asyncio.gather(*(
+                s.submit(i % 4, [encode_set_bin(f"mx{i}", "1")])
+                for i, s in enumerate(sessions)
+            ))
+            assert all(r.status == ResultStatus.OK for r in res)
+            assert len(pool.muxes) <= 2
+            for s in sessions:
+                await s.close()
+            await pool.close()
+        finally:
+            await h.stop()
+
+
+class TestRabiaClientMoved:
+    @pytest.mark.asyncio
+    async def test_client_follows_moved_to_owner(self):
+        """The library client (RabiaClient) pointed at the wrong fleet
+        gateway follows MOVED — the redirected seq commits exactly once
+        and later submits reuse the corrected endpoint ordering."""
+        from rabia_tpu.gateway.client import RabiaClient
+        from rabia_tpu.gateway.server import GatewayEndpoint
+
+        h = FleetHarness(n_gateways=2, n_shards=4, persistence=False)
+        await h.start()
+        cli = None
+        try:
+            ring = h.gateways[0].ring
+            target = next(
+                s for s in range(4) if ring.owner(s).name != "gw0"
+            )
+            gw0 = h.gateways[0].member()
+            cli = RabiaClient(
+                [GatewayEndpoint(
+                    node_id=gw0.node, host=gw0.host, port=gw0.port
+                )]
+            )
+            await cli.connect()
+            out = await cli.submit(target, [encode_set_bin("cm", "1")])
+            assert len(out) == 1
+            assert cli.moved_redirects == 1
+            before = cli.moved_redirects
+            await cli.submit(target, [encode_set_bin("cm", "2")])
+            assert cli.moved_redirects == before
+        finally:
+            if cli is not None:
+                await cli.close()
+            await h.stop()
+
+
+class TestFleetAdmin:
+    @pytest.mark.asyncio
+    async def test_ring_admin_frame_reports_ownership(self):
+        from rabia_tpu.core.messages import AdminKind
+        from rabia_tpu.gateway.client import admin_fetch
+
+        h = FleetHarness(n_gateways=2, n_shards=4, persistence=False)
+        await h.start()
+        try:
+            m = h.gateways[0].member()
+            body = await admin_fetch(
+                m.host, m.port, kind=int(AdminKind.RING), timeout=5.0
+            )
+            doc = json.loads(body.decode())
+            assert doc["self"] == "gw0"
+            assert doc["n_shards"] == 4
+            ring = HashRing.from_doc(doc["ring"])
+            assert {m.name for m in ring.members.values()} == {
+                "gw0", "gw1",
+            }
+            assert sorted(doc["owned_shards"]) == sorted(
+                s for s in range(4) if ring.owner(s).name == "gw0"
+            )
+        finally:
+            await h.stop()
+
+
+@pytest.mark.slow
+class TestFleetProc:
+    @pytest.mark.asyncio
+    async def test_child_protocol_and_kill9_failover(self):
+        """Fleet gateways as real OS processes: ready events carry the
+        ring layout, a submit routes end-to-end, and a SIGKILL'd
+        gateway's shards fail over to the survivor."""
+        from rabia_tpu.fleet.harness import FleetProcHarness
+        from rabia_tpu.testing.gateway_cluster import GatewayCluster
+
+        cluster = GatewayCluster(
+            n_replicas=3, n_shards=4, persistence=False
+        )
+        await cluster.start()
+        fleet = None
+        try:
+            fleet = FleetProcHarness(
+                [(ep.host, ep.port) for ep in cluster.endpoints()],
+                n_gateways=2, n_shards=4,
+                extras={"rf": 2},
+            )
+            ready = await asyncio.get_event_loop().run_in_executor(
+                None, fleet.start
+            )
+            assert {r["name"] for r in ready} == {"gw0", "gw1"}
+            owned = sorted(
+                s for r in ready for s in r["owned_shards"]
+            )
+            assert owned == [0, 1, 2, 3]
+            resolver = FleetResolver(fleet.ring())
+            ser = Serializer()
+            sess = FleetSession(ser, resolver, call_timeout=10.0)
+            res = await sess.submit(
+                0, [encode_set_bin("pr", "1")], timeout=30.0
+            )
+            assert res.status == ResultStatus.OK
+            # SIGKILL the owner of shard 0; the survivor owns the world
+            owner_name = fleet.ring().owner(0).name
+            victim = int(owner_name.removeprefix("gw"))
+            fleet.kill9(victim)
+            # the operator move: push the shrunken membership to the
+            # survivor so its MOVED answers stop naming the corpse
+            await fleet.push_ring([1 - victim])
+            res2 = await sess.submit(
+                0, [encode_set_bin("pr", "2")], timeout=30.0
+            )
+            assert res2.status == ResultStatus.OK
+            assert sess.failovers >= 1
+            await sess.close()
+        finally:
+            if fleet is not None:
+                fleet.stop()
+            await cluster.stop()

@@ -721,6 +721,16 @@ class TestNativeSessionPlane:
             {"op": "complete", "t": 0.5, "cid": cid2, "seq": 9,
              "status": 2, "payload": (), "frontier": 2},  # error, empty
             {"op": "submit", "t": 0.6, "cid": cid1, "seq": 2, "ack": 1},
+            # fleet ledger records (reserve+complete in one step): a
+            # fresh landing, a landing onto the existing reservation
+            # (cid1 seq 2 is inflight), and a no-op onto a cached seq
+            {"op": "ledger", "t": 0.65, "cid": cid2, "seq": 4,
+             "status": 0, "payload": (b"led",), "frontier": 3},
+            {"op": "ledger", "t": 0.65, "cid": cid1, "seq": 2,
+             "status": 0, "payload": (b"r2",), "frontier": 3},
+            {"op": "ledger", "t": 0.66, "cid": cid1, "seq": 2,
+             "status": 1, "payload": (b"loser",), "frontier": 4},
+            {"op": "submit", "t": 0.67, "cid": cid1, "seq": 2},  # cached
             {"op": "gc", "t": 0.7, "sv": 5},   # evicts cid1 seq 1
             {"op": "gc", "t": 20.0, "sv": 5},  # idle expiry (ttl 30 no)
             {"op": "gc", "t": 200.0, "sv": 5},  # lease: everything goes
