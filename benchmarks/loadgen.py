@@ -94,6 +94,7 @@ async def run_point(
     seed: int,
     connect_parallel: int = 64,
     mux: int = 0,
+    get_ratio: float = 0.0,
     shed_fn=None,
     counters_fn=None,
     fleet_resolver=None,
@@ -120,7 +121,11 @@ async def run_point(
     callable returning per-gateway health snapshots; sampled
     before/after so the point carries per-gateway AND fleet-aggregate
     counter deltas (moved, cached replays, ledger traffic)."""
-    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.apps.kvstore import (
+        KVOperation,
+        encode_op_bin,
+        encode_set_bin,
+    )
 
     ser = Serializer()
     rng = random.Random(seed)
@@ -244,6 +249,15 @@ async def run_point(
 
     counts = {k: 0 for k in OUTCOMES}
     lat_ok_ms: list[float] = []
+    # read-mix ledger (client side of the device-plane evidence): how
+    # many GETs the read-index lane answered with ZERO consensus slots
+    # vs how many fell back to a consensus-slot GET submit (RETRY /
+    # probe timeout / a transport without the read lane)
+    reads = {"offcons": 0, "onslot": 0, "failed": 0}
+    # separate stream so a read mix never perturbs the Poisson arrival
+    # schedule: the same seed offers the identical arrival process at
+    # every --get-ratio
+    rng_rw = random.Random(seed ^ 0x9E3779B9)
     arrivals_measured = 0
     inflight = 0
     fires: set[asyncio.Task] = set()
@@ -253,13 +267,11 @@ async def run_point(
     t_end = t_measure + measure
 
     async def fire(
-        sess: LoadSession, i: int, in_window: bool, arrived: float
+        sess: LoadSession, i: int, in_window: bool, arrived: float,
+        is_read: bool = False,
     ) -> None:
         nonlocal inflight
         key = f"s{i % 4096}"
-        cmds = [
-            encode_set_bin(f"{key}-{j}", "v" * 8) for j in range(batch)
-        ]
         # latency is scored from the Poisson ARRIVAL time, not from when
         # this task first ran: under saturation the event loop itself
         # queues work, and excluding that delay would reintroduce the
@@ -268,15 +280,52 @@ async def run_point(
         start = arrived
         outcome = "error"
         try:
-            res = await sess.submit(i % n_shards, cmds, call_timeout)
-            if res.status == ResultStatus.OK:
-                outcome = "ok"
-            elif res.status == ResultStatus.CACHED:
-                outcome = "cached"
-            elif res.status == ResultStatus.RETRY:
-                outcome = "shed"
+            if is_read:
+                # GET a key every SET batch writes (j=0 of the cycle),
+                # preferring the off-consensus read-index lane; RETRY
+                # (probe timeout / quorum loss) or a session without
+                # the lane falls back to a consensus-slot GET submit
+                rkey = f"{key}-0"
+                res = None
+                if hasattr(sess, "read"):
+                    res = await sess.read(
+                        i % n_shards, rkey.encode(), call_timeout
+                    )
+                if res is not None and res.status == ResultStatus.OK:
+                    reads["offcons"] += 1
+                    outcome = "ok"
+                else:
+                    res = await sess.submit(
+                        i % n_shards,
+                        [encode_op_bin(KVOperation.get(rkey))],
+                        call_timeout,
+                    )
+                    if res.status in (
+                        ResultStatus.OK, ResultStatus.CACHED
+                    ):
+                        reads["onslot"] += 1
+                        outcome = (
+                            "ok" if res.status == ResultStatus.OK
+                            else "cached"
+                        )
+                    elif res.status == ResultStatus.RETRY:
+                        outcome = "shed"
+                    else:
+                        outcome = "error"
             else:
-                outcome = "error"
+                cmds = [
+                    encode_set_bin(f"{key}-{j}", "v" * 8)
+                    for j in range(batch)
+                ]
+                res = await sess.submit(i % n_shards, cmds, call_timeout)
+                if res.status == ResultStatus.OK:
+                    outcome = "ok"
+                elif res.status == ResultStatus.CACHED:
+                    outcome = "cached"
+                elif res.status == ResultStatus.RETRY:
+                    outcome = "shed"
+                else:
+                    outcome = "error"
         except (asyncio.TimeoutError, TimeoutError):
             # both spellings: pre-3.11 asyncio.TimeoutError is a class
             # of its own, and FleetSession raises the builtin
@@ -292,6 +341,8 @@ async def run_point(
             outcome = "error"
         finally:
             inflight -= 1
+        if is_read and outcome not in ("ok", "cached"):
+            reads["failed"] += 1
         if in_window:
             counts[outcome] += 1
             if outcome in ("ok", "cached"):
@@ -316,6 +367,10 @@ async def run_point(
         arrived = next_at
         in_window = next_at >= t_measure
         next_at += rng.expovariate(rate)
+        # drawn per ARRIVAL (before the cap check) so the read/write
+        # stream stays aligned with the arrival schedule even when the
+        # generator saturates and some arrivals score as overflow
+        is_read = get_ratio > 0.0 and rng_rw.random() < get_ratio
         sess = sessions[i % n_sessions]
         if inflight >= inflight_cap:
             # the GENERATOR is saturated: record the arrival as overflow
@@ -328,7 +383,9 @@ async def run_point(
         inflight += 1
         if in_window:
             arrivals_measured += 1
-        t = asyncio.ensure_future(fire(sess, i, in_window, arrived))
+        t = asyncio.ensure_future(
+            fire(sess, i, in_window, arrived, is_read)
+        )
         fires.add(t)
         t.add_done_callback(fires.discard)
         i += 1
@@ -435,6 +492,24 @@ async def run_point(
                 cluster_counters.get("barrier_covered", 0) / waits, 2
             )
 
+    # read-lane join: the per-point evidence the device-plane read tier
+    # is scored by — what fraction of GETs consumed ZERO consensus
+    # slots. Client tallies here; the server-side twin (gateway reads /
+    # probe_rounds / reads_batched deltas) rides in cluster_counters.
+    read_lane = None
+    if get_ratio > 0.0:
+        n_reads = reads["offcons"] + reads["onslot"] + reads["failed"]
+        read_lane = {
+            "get_ratio": get_ratio,
+            "reads": n_reads,
+            "reads_offcons": reads["offcons"],
+            "reads_onslot": reads["onslot"],
+            "reads_failed": reads["failed"],
+            "offcons_fraction": (
+                round(reads["offcons"] / n_reads, 4) if n_reads else None
+            ),
+        }
+
     completed = sum(counts[k] for k in ("ok", "cached", "shed", "error"))
     good = counts["ok"] + counts["cached"]
     lat_ok_ms.sort()
@@ -450,6 +525,7 @@ async def run_point(
         "fleet": fleet_doc,
         "shed_reasons": shed_reasons,
         "cluster_counters": cluster_counters,
+        "read_lane": read_lane,
         **derived,
         "arrivals": arrivals_measured,
         "completed": completed,
@@ -571,6 +647,11 @@ async def _in_process_timeline(cluster) -> list[dict]:
 
 async def run(args) -> dict:
     rates = [float(r) for r in args.rates.split(",") if r]
+    get_ratio = 0.9 if getattr(args, "get_heavy", False) else float(
+        getattr(args, "get_ratio", 0.0) or 0.0
+    )
+    if not 0.0 <= get_ratio <= 1.0:
+        raise SystemExit("--get-ratio must be in [0, 1]")
     sess_list = [int(s) for s in args.sessions.split(",") if s]
     if len(sess_list) == 1:
         sess_list = sess_list * len(rates)
@@ -682,6 +763,21 @@ async def run(args) -> dict:
                 for k, v in g.coalesce_outcomes.items():
                     out[k] = out.get(k, 0) + int(v)
                 out["coalesce_waves"] += int(g.stats.coalesce_waves)
+                # read-index lane evidence (server-side twin of the
+                # per-point read_lane client tallies)
+                out["reads"] = (
+                    out.get("reads", 0) + int(g.stats.reads)
+                )
+                out["reads_failed"] = (
+                    out.get("reads_failed", 0) + int(g.stats.reads_failed)
+                )
+                out["reads_batched"] = (
+                    out.get("reads_batched", 0)
+                    + int(g.stats.reads_batched)
+                )
+                out["probe_rounds"] = (
+                    out.get("probe_rounds", 0) + int(g.stats.probe_rounds)
+                )
             return out
 
         planes = cluster.gateways[0].health().get("planes")
@@ -730,6 +826,7 @@ async def run(args) -> dict:
                 # keeps the 10^5-hello storm out of the measure window
                 connect_parallel=512 if fleet_harness is not None else 64,
                 mux=args.mux,
+                get_ratio=get_ratio,
                 shed_fn=shed_fn,
                 counters_fn=counters_fn,
                 fleet_resolver=(
@@ -775,6 +872,7 @@ async def run(args) -> dict:
             "seed": args.seed,
             "mux": args.mux,
             "fleet_gateways": args.fleet or None,
+            "get_ratio": get_ratio or None,
             "persistence": pmode,
             "coalesce": args.coalesce,
             "coalesce_window": args.coalesce_window,
@@ -865,6 +963,19 @@ def main(argv=None) -> int:
         help="pin the coalescing window (seconds, min and max both): "
         "the latency-for-amortization dial. Routed/dense deployments "
         "run tens of ms; None = the gateway's adaptive default",
+    )
+    ap.add_argument(
+        "--get-ratio", type=float, default=0.0, metavar="R",
+        help="fraction of arrivals issued as GETs on keys the SET "
+        "stream writes (0..1). GETs go through the gateway read-index "
+        "lane (zero consensus slots) and fall back to a consensus-slot "
+        "GET submit on RETRY; every point then carries a read_lane "
+        "block (off-consensus vs on-slot vs failed tallies) joined "
+        "with the gateway's reads/probe_rounds/reads_batched deltas",
+    )
+    ap.add_argument(
+        "--get-heavy", action="store_true",
+        help="the 90/10 GET-heavy preset: shorthand for --get-ratio 0.9",
     )
     ap.add_argument(
         "--require-plane", action="append", default=[],

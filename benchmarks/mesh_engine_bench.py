@@ -139,6 +139,7 @@ def bench_mixed_set_get(
     reps: int = 12,
     set_waves: int = 64,
     get_waves: int = 8,
+    read_lane: bool = False,
 ) -> dict:
     """Interleaved SET/GET workload through the device lane (the round-4
     weak spot: kind boundaries split the FIFO into window-per-run, and
@@ -173,11 +174,13 @@ def bench_mixed_set_get(
         mesh=make_mesh(),
         window=window,
         device_store=True,
+        device_read_lane=read_lane,
     )
     for b in one_rep():  # warmup: compiles SET + mixed + GET programs
         eng.submit_block(b)
     eng.flush(max_cycles=400)
     assert eng._dev_active, "warmup demoted the device lane"
+    rl0 = eng.read_lane_stats()
     blocks = []
     for _ in range(reps):
         blocks.extend(one_rep())
@@ -189,15 +192,24 @@ def bench_mixed_set_get(
     applied = eng.decided_v1 - before
     assert eng._dev_active, "mixed windows demoted the device lane"
     assert all(f.done() for f in futs)
+    rl1 = eng.read_lane_stats()
+    rl = {k: rl1[k] - rl0[k] for k in rl1}
+    # with the read lane on, GETs never consume slots: decided_v1
+    # counts SET decisions only, and total ops = decisions + probe
+    # reads (same workload either way — the honest comparison axis)
+    ops = applied + rl["probe"]
     return {
         "shards": n_shards,
         "replicas": n_replicas,
         "window": window,
+        "read_lane": read_lane,
         "workload": (
             f"{reps} reps of {set_waves} SET waves + {get_waves} GET "
             "waves, full-width"
         ),
         "device_lane_decisions_per_sec": round(applied / dt, 1),
+        "ops_per_sec": round(ops / dt, 1),
+        "read_lane_deltas": rl,
         "elapsed_s": round(dt, 3),
         "cycles": eng.cycles,
         "vs_r04_same_workload": round(applied / dt / 92_000, 2),
@@ -207,6 +219,13 @@ def bench_mixed_set_get(
             "only for the waves that hold GETs; mixed windows PIPELINE "
             "(chained dispatch, worker-thread flags+meta fetch) like "
             "the pure-SET lane"
+            + (
+                "; read_lane=True skims GETs out pre-consensus into "
+                "zero-slot lookup_only probe windows — the consensus "
+                "stream dispatches SET-only windows"
+                if read_lane
+                else ""
+            )
         ),
     }
 
@@ -293,6 +312,7 @@ def bench_get_windows(
     n_replicas: int = 5,
     window: int = 64,
     waves: int = 192,
+    read_lane: bool = False,
 ) -> dict:
     """GET-only windows through the device lane. Round 4 was
     tunnel-download-bound (~70 bytes/op of found/ver/value planes over
@@ -316,6 +336,7 @@ def bench_get_windows(
         mesh=make_mesh(),
         window=window,
         device_store=True,
+        device_read_lane=read_lane,
     )
     set_cmds = [[encode_set_bin(f"k{s}", f"v{s % 7}")] for s in range(n_shards)]
     get_cmds = [[enc_get(f"k{s}")] for s in range(n_shards)]
@@ -324,6 +345,7 @@ def bench_get_windows(
     eng.flush()
     eng.submit_block(build_block(shards, get_cmds))  # compile GET program
     eng.flush()
+    rl0 = eng.read_lane_stats()
     blocks = [build_block(shards, get_cmds) for _ in range(waves)]
     futs = [eng.submit_block(b) for b in blocks]
     t0 = time.perf_counter()
@@ -334,11 +356,14 @@ def bench_get_windows(
     # materialize a sample of responses so lazy framing is honest work
     sample = [bytes(g[0]) for g in futs[-1].result()[:64]]
     assert all(s for s in sample)
+    rl1 = eng.read_lane_stats()
     return {
         "shards": n_shards,
         "replicas": n_replicas,
         "window": window,
         "waves": waves,
+        "read_lane": read_lane,
+        "read_lane_deltas": {k: rl1[k] - rl0[k] for k in rl1},
         "reads_per_sec": round(waves * n_shards / dt, 1),
         "elapsed_s": round(dt, 3),
         "meta_bytes_per_op": 5,
@@ -722,6 +747,86 @@ def main() -> None:
             doc["mesh_engine_weak_scaling_r05"] = out
             path.write_text(json.dumps(doc, indent=1))
             print("recorded -> results.json mesh_engine_weak_scaling_r05")
+        return
+
+    if "--read-lane-only" in sys.argv:
+        # device read-index lane A/B: the same mixed workload with GETs
+        # riding consensus slots (before) vs skimmed into zero-slot
+        # lookup_only probe windows (after), plus the GET-heavy mix and
+        # the pure-GET stream through the probe path. Records a
+        # same-host pair under mesh_engine_r17.
+        backend = jax.devices()[0].platform
+        off = bench_mixed_set_get(read_lane=False)
+        print("mixed lane-off ->", off["device_lane_decisions_per_sec"],
+              "dec/s,", off["ops_per_sec"], "ops/s")
+        on = bench_mixed_set_get(read_lane=True)
+        print("mixed lane-on  ->", on["device_lane_decisions_per_sec"],
+              "dec/s,", on["ops_per_sec"], "ops/s")
+        heavy = bench_mixed_set_get(
+            reps=12, set_waves=8, get_waves=64, read_lane=True
+        )
+        print("get-heavy lane-on ->", heavy["ops_per_sec"], "ops/s")
+        getw = bench_get_windows(read_lane=True)
+        print("pure-GET probe ->", getw["reads_per_sec"], "reads/s")
+        assert on["read_lane_deltas"]["slot"] == 0, (
+            "read lane on: GETs still consumed consensus slots"
+        )
+        rec = {
+            "backend": backend,
+            "devices": len(jax.devices()),
+            "mixed_read_lane_off": off,
+            "mixed_read_lane_on": on,
+            "mixed_get_heavy_read_lane_on": heavy,
+            "get_windows_probe_path": getw,
+        }
+        if "--record" in sys.argv:
+            path = Path(__file__).parent / "results.json"
+            doc = json.loads(path.read_text()) if path.exists() else {}
+            sect = doc.setdefault("mesh_engine_r17", {})
+            key = (
+                "read_lane_ab_cpu" if backend == "cpu" else "read_lane_ab"
+            )
+            sect[key] = rec
+            path.write_text(json.dumps(doc, indent=1))
+            print(f"recorded -> results.json mesh_engine_r17.{key}")
+        return
+
+    if "--read-smoke" in sys.argv:
+        # CI cell: tiny GET/mixed windows on the CPU backend; asserts
+        # the read lane actually ENGAGES (probe > 0, zero slot-GETs —
+        # the --require-plane analog for the read path) and writes the
+        # record for artifact upload via --out.
+        rec = {
+            "backend": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            "mixed": bench_mixed_set_get(
+                n_shards=64, n_replicas=3, window=8, reps=2,
+                set_waves=8, get_waves=8, read_lane=True,
+            ),
+            "get_windows": bench_get_windows(
+                n_shards=64, n_replicas=3, window=8, waves=16,
+                read_lane=True,
+            ),
+        }
+        for name in ("mixed", "get_windows"):
+            d = rec[name]["read_lane_deltas"]
+            assert d["probe"] > 0, f"{name}: read lane never engaged"
+            assert d["slot"] == 0, (
+                f"{name}: GETs consumed consensus slots with the lane on"
+            )
+        covered = rec["mixed"]["read_lane_deltas"]["probe"]
+        total_gets = covered + rec["mixed"]["read_lane_deltas"]["slot"]
+        rec["off_consensus_fraction"] = covered / max(1, total_gets)
+        print(
+            "read-smoke OK:",
+            rec["mixed"]["ops_per_sec"], "mixed ops/s,",
+            rec["get_windows"]["reads_per_sec"], "reads/s,",
+            f"{rec['off_consensus_fraction']:.0%} of GETs off-consensus",
+        )
+        if "--out" in sys.argv:
+            out_path = Path(sys.argv[sys.argv.index("--out") + 1])
+            out_path.write_text(json.dumps(rec, indent=1))
+            print("wrote ->", out_path)
         return
 
     if "--mixed-only" in sys.argv:

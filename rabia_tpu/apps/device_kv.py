@@ -847,6 +847,122 @@ class DeviceKVTable:
             max_phases=max_phases,
         )
 
+    def _build_lookup_only(self, Ku4: int, D: Optional[int] = None):
+        """Jitted CONSENSUS-FREE read window: the same read-only match
+        scan as :meth:`_build_lookup`, with the slot window removed
+        entirely — no votes, no phases, no collective. The read-index
+        lane dispatches these for probe-covered GETs (the gateway's
+        shared quorum probe round already established linearizability;
+        the device table only has to answer), so reads consume ZERO
+        consensus slots and the program crosses zero ICI bytes on a
+        multi-chip mesh (pinned by benchmarks/ici_model.py via jaxpr
+        inspection)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        K4 = self.K4
+        I32 = jnp.int32
+
+        def lookup_only(state, klen_t, kwin_t, *, W):
+            used, keyw, klen, ver, valw, vlen, _sver = state
+
+            def match_body(klen_w, kwin_w):
+                klen_w = klen_w.astype(jnp.int32)
+                eq = (
+                    used
+                    & (klen == klen_w[:, None])
+                    & (keyw == kwin_w[:, None, :]).all(-1)
+                )  # [S, P]
+                found = eq.any(1) & (klen_w > 0)
+                oh = eq & found[:, None]  # at most one slot matches
+                rver = (ver * oh).sum(1)
+                rvlen = (vlen * oh).sum(1)
+                rval = (valw * oh[:, :, None]).sum(1)  # [S, VW4] u32
+                return found, rver, rvlen, rval
+
+            if D is None:
+                kwin_full = jnp.pad(
+                    kwin_t, ((0, 0), (0, 0), (0, K4 - Ku4))
+                )
+                xs = (klen_t, kwin_full)
+
+                def wave_match(_, inp):
+                    return None, match_body(*inp)
+            else:
+                idx, dkl_raw, dk_raw = klen_t
+                dk_full = jnp.pad(dk_raw, ((0, 0), (0, 0), (0, K4 - Ku4)))
+                dkl = dkl_raw.astype(I32)
+                dr = jnp.arange(D, dtype=I32)[None, :]
+                xs = (idx,)
+
+                def wave_match(_, inp):
+                    (idx_w,) = inp
+                    oh = idx_w.astype(I32)[:, None] == dr  # [S, D]
+                    ohu = oh.astype(jnp.uint32)[:, :, None]
+                    return None, match_body(
+                        (dkl * oh).sum(1), (dk_full * ohu).sum(1)
+                    )
+
+            _, (found, rver, rvlen, rval) = lax.scan(wave_match, None, xs)
+            return found, rver, rvlen, rval
+
+        return jax.jit(lookup_only, static_argnames=("W",))
+
+    def lookup_only(self, ops, W: int, state=None):
+        """Dispatch one consensus-free read window (the read-index
+        lane's probe serve): ``ops`` exactly as :meth:`lookup_window`
+        takes them (row-packed ``(klen, kwin)`` or a
+        :class:`DeviceDictOps`), padded to the static window size ``W``
+        (padding waves carry klen 0 and match nothing). Returns DEVICE
+        handles ``(found[W,S], ver[W,S], vlen[W,S], val_words)`` — no
+        all_v1 scalar, because nothing was decided. The caller fetches
+        meta-only in the steady state, exactly like the slot-consuming
+        GET window."""
+        import jax.numpy as jnp
+
+        if isinstance(ops, DeviceDictOps):
+            ops = _pad_dict_idx(ops, W)
+            D = ops.dkl.shape[1]
+            key = ("rodict", W, ops.dk.shape[2], D)
+            fn = self._fused_cache.get(key)
+            self.compiled_on_last_call = fn is None
+            if fn is None:
+                fn = self._build_lookup_only(key[2], D)
+                self._fused_cache[key] = fn
+            kdict = (
+                jnp.asarray(ops.idx),
+                jnp.asarray(ops.dkl),
+                jnp.asarray(ops.dk),
+            )
+            return fn(
+                self.state if state is None else state,
+                kdict,
+                None,
+                W=W,
+            )
+        klen, kwin = ops
+        if klen.shape[0] < W:
+            pad = W - klen.shape[0]
+            klen = np.concatenate(
+                [klen, np.zeros((pad,) + klen.shape[1:], klen.dtype)]
+            )
+            kwin = np.concatenate(
+                [kwin, np.zeros((pad,) + kwin.shape[1:], kwin.dtype)]
+            )
+        key = ("ro", W, kwin.shape[2])
+        fn = self._fused_cache.get(key)
+        self.compiled_on_last_call = fn is None
+        if fn is None:
+            fn = self._build_lookup_only(key[2])
+            self._fused_cache[key] = fn
+        return fn(
+            self.state if state is None else state,
+            jnp.asarray(klen),
+            jnp.asarray(kwin),
+            W=W,
+        )
+
     @staticmethod
     def _apply_set_wave(carry, ok_w, klen_t, vlen_t, kwin_t, vwin_t, Pc):
         """One SET wave over the table state — shared by the row-packed

@@ -27,8 +27,9 @@ action                fabrics  args
 ``partition``         sim      ``group``, ``duration`` (None = until ``heal``)
 ``heal``              sim      — (heals partition AND flapping)
 ``slow``              both     ``node``, ``delay_ms`` (0 clears)
-``crash``             sim      ``node``
-``recover``           sim      ``node``
+``crash``             sim/mesh ``node``
+``recover``           sim/mesh ``node``
+``demote_device``     mesh     — (force device-lane demotion mid-window)
 ``stop_replica``      tcp      ``node``
 ``start_replica``     tcp      ``node``
 ``restart_replica``   tcp      ``node``
@@ -36,6 +37,15 @@ action                fabrics  args
 ``rebalance``         fleet    ``members`` (surviving gateway indices; handoff runs)
 ``clear``             both     — (clears link faults / shaping)
 ====================  =======  ====================================================
+
+``fabric="mesh"`` (round 17) is the device-plane tier: one colocated
+lockstep :class:`~rabia_tpu.parallel.MeshEngine` with the
+device-resident KV table AND the consensus-free read-index lane on —
+full-width SET waves interleave with GET waves the lane must serve off
+consensus, while replicas drop out of the alive mask and the device
+store is force-demoted mid-window; the post-run verify gates on the
+lane having actually engaged (probe reads > 0) and on zero lockstep
+apply divergences.
 
 ``fabric="fleet"`` (round 16) is the routed tier: the same real-TCP
 replica cluster behind consistent-hash-routed fleet gateways
@@ -73,7 +83,7 @@ class ChaosProfile:
     """One named scenario (see module doc for the event vocabulary)."""
 
     name: str
-    fabric: str  # "sim" | "tcp" | "fleet"
+    fabric: str  # "sim" | "tcp" | "fleet" | "mesh"
     description: str
     duration: float  # measure window, seconds
     events: tuple[ChaosEvent, ...] = ()
@@ -309,6 +319,31 @@ def default_profiles() -> dict[str, ChaosProfile]:
                 ("coalesce_window_min", 0.02),
             ),
         ),
+        # -- device-mesh fabric (round 17: device KV + read-index lane) -
+        _p(
+            "mesh_device_read_lane",
+            "mesh",
+            "Device-plane read lane under replica loss and forced "
+            "demotion: a colocated MeshEngine serves full-width SET "
+            "waves plus GET waves off-consensus (zero slots) while a "
+            "minority replica crashes out of the alive mask and "
+            "recovers, then the device store is force-DEMOTED "
+            "mid-window — parked probe reads must flush to the "
+            "consensus path, the auto-repromote must re-engage the "
+            "lane with reset write barriers, and the verify sweep "
+            "gates on probe reads > 0 and zero lockstep divergences",
+            duration=10.0,
+            events=[
+                ChaosEvent(2.0, "crash", {"node": 2}),
+                ChaosEvent(4.0, "recover", {"node": 2}),
+                ChaosEvent(6.0, "demote_device", {}),
+            ],
+            rate=60.0,
+            batch=1,
+            n_replicas=3,
+            n_shards=4,
+            min_availability=0.6,
+        ),
         # -- routed fleet fabric (round 16: gateway tier + hash ring) ---
         _p(
             "routed_gateway_failover",
@@ -347,10 +382,10 @@ def default_profiles() -> dict[str, ChaosProfile]:
 
 
 def smoke_profiles() -> dict[str, ChaosProfile]:
-    """The CI smoke subset: 5 short profiles — one simulator adverse-net,
+    """The CI smoke subset: 6 short profiles — one simulator adverse-net,
     one real-TCP shaped, one membership change under load, one routed
-    gateway failover — time-scaled to keep the cell under a couple of
-    minutes."""
+    gateway failover, and the device-mesh read-lane drill — time-scaled
+    to keep the cell under a couple of minutes."""
     all_p = default_profiles()
     out = {}
     for name, factor in (
@@ -359,6 +394,7 @@ def smoke_profiles() -> dict[str, ChaosProfile]:
         ("membership_elastic", 0.7),
         ("coalesce_flap_restart", 0.7),
         ("routed_gateway_failover", 0.7),
+        ("mesh_device_read_lane", 0.6),
     ):
         out[name] = all_p[name].scaled(factor)
     return out

@@ -615,6 +615,197 @@ class _FleetFabric:
             return False
 
 
+class _MeshFabric:
+    """MeshEngine colocated-lockstep fabric: the whole cluster is ONE
+    in-process device-mesh engine (``n_replicas`` lockstep replicas over
+    the JAX mesh) with the device-resident KV table AND the read-index
+    lane on. Load is full-width PayloadBlocks — a SET wave or (every
+    third arrival) a GET wave that the read lane must serve from
+    consensus-free ``lookup_only`` probe windows. Events map to the
+    alive-mask (``crash``/``recover``) and to forced device-lane
+    demotion (``demote_device``): parked probe reads must flush to the
+    consensus path, and the auto-repromote must re-engage the lane —
+    with correct write barriers — while arrivals keep firing."""
+
+    name = "mesh"
+
+    def __init__(self, profile: ChaosProfile) -> None:
+        from rabia_tpu.apps.vector_kv import VectorShardedKV
+        from rabia_tpu.parallel import MeshEngine, make_mesh
+
+        self.profile = profile
+        n_shards = profile.n_shards
+        self.eng = MeshEngine(
+            lambda: VectorShardedKV(n_shards, capacity=1 << 14),
+            n_shards=n_shards,
+            n_replicas=profile.n_replicas,
+            mesh=make_mesh(),
+            window=8,
+            device_store=True,
+            device_read_lane=True,
+            # small repromote horizon so a mid-run demote_device event
+            # re-engages the lane INSIDE the measure window (the
+            # barrier-reset path is part of what this fabric scores)
+            device_store_repromote=24,
+        )
+        self._crashed: set[int] = set()
+        self._pump_task: Optional[asyncio.Task] = None
+        self._running = False
+
+    def _blocks(self, idx: int, pairs: list):
+        """One full-width wave per arrival: SET waves carry the runner's
+        key/value pairs fanned across shards; every third arrival is a
+        GET wave on the j=0 keys an earlier same-slot SET wrote."""
+        from rabia_tpu.apps.kvstore import (
+            KVOperation,
+            KVOpType,
+            encode_op_bin,
+            encode_set_bin,
+        )
+        from rabia_tpu.core.blocks import build_block
+
+        shards = list(range(self.profile.n_shards))
+        if idx % 3 == 0:
+            cmds = [
+                [encode_op_bin(
+                    KVOperation(KVOpType.Get, f"k{idx % 512}-0-{s}")
+                )]
+                for s in shards
+            ]
+        else:
+            cmds = [
+                [encode_set_bin(f"{k}-{s}", v) for k, v in pairs]
+                for s in shards
+            ]
+        return build_block(shards, cmds)
+
+    async def start(self) -> None:
+        # pin every program compile (SET wave, GET wave, lookup_only
+        # probe) OUTSIDE the measured window
+        self.eng.submit_block(self._blocks(1, [("warm", "w")]))
+        self.eng.submit_block(self._blocks(0, [("warm", "w")]))
+        self.eng.flush(max_cycles=400)
+        self._running = True
+        self._pump_task = asyncio.ensure_future(self._pump())
+
+    async def _pump(self) -> None:
+        # the engine is synchronous: one background task turns cycles
+        # whenever work is pending, yielding between cycles so the
+        # arrival generator and event injections interleave honestly
+        while self._running:
+            got = self.eng.run_cycle()
+            await asyncio.sleep(0.0 if got else 0.002)
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            try:
+                await self._pump_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self.eng.close()
+
+    def apply_event(self, action: str, args: dict) -> None:
+        eng = self.eng
+        if action == "crash":
+            eng.crash_replica(args["node"])
+            self._crashed.add(args["node"])
+        elif action == "recover":
+            eng.heal_replica(args["node"])
+            self._crashed.discard(args["node"])
+        elif action == "demote_device":
+            # forced mid-window demotion: the device table syncs to the
+            # host replica stores, parked probe reads flush back into
+            # the consensus stream, and (repromote horizon permitting)
+            # the lane re-engages with reset write barriers
+            if eng._dev_active:
+                eng._demote_device_store()
+        elif action == "clear":
+            for r in list(self._crashed):
+                eng.heal_replica(r)
+            self._crashed.clear()
+        else:
+            raise ValueError(f"mesh fabric: unknown action {action!r}")
+
+    def clear_faults(self) -> None:
+        for r in list(self._crashed):
+            self.eng.heal_replica(r)
+        self._crashed.clear()
+
+    async def submit(self, i: int, pairs: list, timeout: float) -> str:
+        eng = self.eng
+        if not eng.has_quorum:
+            return "shed"
+        try:
+            bfut = eng.submit_block(self._blocks(i, pairs))
+        except Exception:
+            return "error"
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while not bfut.done():
+            if loop.time() >= deadline:
+                return "timeout"
+            await asyncio.sleep(0.001)
+        return "ok"
+
+    async def verify(self) -> list[str]:
+        """Mesh acceptance gates: the read lane actually engaged (probe
+        reads > 0 — a run whose GETs all fell back to consensus slots
+        is a silent regression of the tier under test) and the lockstep
+        replicas never diverged on an apply outcome."""
+        problems: list[str] = []
+        rl = self.eng.read_lane_stats()
+        if rl["probe"] <= 0:
+            problems.append(
+                "mesh verify: read lane served zero off-consensus "
+                f"probe reads (stats {rl})"
+            )
+        if int(self.eng.divergences) != 0:
+            problems.append(
+                f"mesh verify: {self.eng.divergences} lockstep apply "
+                "divergences"
+            )
+        return problems
+
+    def engines(self) -> list:
+        return [self.eng]
+
+    def decided_totals(self) -> list[Optional[int]]:
+        return [int(self.eng.decided_v1 + self.eng.decided_v0)]
+
+    async def converged(self, timeout: float) -> bool:
+        eng = self.eng
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if not eng._has_pending():
+                break
+            await asyncio.sleep(0.02)
+        else:
+            return False
+        if eng._dev_active:
+            eng.sync_to_host()  # device table down into every replica
+        from rabia_tpu.apps.vector_kv import VectorKVStore
+
+        def canon(sm):
+            # logical content only: snapshot bytes embed per-store
+            # wall-clock created/updated stamps that legitimately differ
+            sv, rows, over = VectorKVStore._parse_snapshot(
+                sm.store.snapshot_bytes()
+            )
+            shards, keys, vals, vers, _cr, _up = rows
+            return (
+                sv.tolist(), shards, keys, vals, vers,
+                sorted(
+                    (d["shard"], d["key"], d["value"], d["version"])
+                    for d in over
+                ),
+            )
+
+        snaps = [canon(sm) for sm in eng.sms]
+        return all(s == snaps[0] for s in snaps[1:])
+
+
 # ---------------------------------------------------------------------------
 # Consensus-health evidence
 # ---------------------------------------------------------------------------
@@ -684,6 +875,7 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
 
     fabric = {
         "sim": _SimFabric, "tcp": _TcpFabric, "fleet": _FleetFabric,
+        "mesh": _MeshFabric,
     }[profile.fabric](profile)
     log(f"starting {profile.fabric} cluster "
         f"({profile.n_replicas} replicas, {profile.n_shards} shards)")

@@ -17,6 +17,7 @@ from rabia_tpu.gateway.server import (
     GatewayEndpoint,
     GatewayServer,
     GatewayStats,
+    devkv_read_handler,
     kv_read_handler,
 )
 from rabia_tpu.gateway.session import (
@@ -37,5 +38,6 @@ __all__ = [
     "RabiaClient",
     "SessionTable",
     "admin_fetch",
+    "devkv_read_handler",
     "kv_read_handler",
 ]

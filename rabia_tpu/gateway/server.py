@@ -153,6 +153,7 @@ class GatewayStats:
     results_repaired: int = 0  # fetched from a peer after a sync overtake
     submits_coalesced: int = 0  # submits that rode a multi-client wave
     coalesce_waves: int = 0  # multi-client waves proposed
+    reads_batched: int = 0  # reads served via the handler's read_many batch
 
 
 @dataclass
@@ -208,6 +209,113 @@ def kv_read_handler(sm) -> ReadHandler:
             return _result_bin(1, 0)
         return _result_bin(0, res.version or 0, res.value)
 
+    return read
+
+
+def devkv_read_handler(engine) -> ReadHandler:
+    """Read handler over a device-store MeshEngine
+    (:class:`~rabia_tpu.parallel.mesh_engine.MeshEngine` with
+    ``device_store=True``): probe-covered GETs are answered by a
+    consensus-free ``lookup_only`` dispatch against the device-resident
+    table — zero consensus slots, zero collectives in the program —
+    with meta-only readback (~5 bytes/op) and host-segment value
+    resolution (value planes download only on the eviction edge).
+
+    The handler also exposes ``read_many`` so the gateway batches ALL
+    reads covered by one probe round into a SINGLE device dispatch: one
+    plane fetch per probe window instead of one per read. With the
+    device lane demoted, reads fall back to the host replica store (the
+    semantics owner, synced at demotion). Drive the engine and this
+    handler from one thread — the device table is not locked."""
+    from rabia_tpu.apps.device_kv import _bucket, _get_frame
+
+    def _host_one(shard: int, key: bytes) -> bytes:
+        got = engine.sms[0].store.get(shard, key)
+        if got is None:
+            return _get_frame(False, 0, b"")
+        val, ver = got
+        return _get_frame(True, ver, val)
+
+    def read_many(items: list) -> list:
+        if engine._dev is None or not engine._dev_active:
+            return [_host_one(s, k) for s, k in items]
+        dev = engine._dev
+        W = engine.window
+        out: list = [None] * len(items)
+        sel = []
+        for i, (s, k) in enumerate(items):
+            if len(k) > dev.K or not (0 <= s < engine.n_shards):
+                # a key wider than the table's lanes cannot have been
+                # SET while the lane is active (it would have demoted):
+                # not-found by construction, no dispatch needed
+                out[i] = _get_frame(False, 0, b"")
+            else:
+                sel.append(i)
+        if not sel:
+            return out
+        # wave-pack: at most one key per shard per wave (the lookup
+        # program's shape); duplicate-shard reads spill to later waves
+        waves: list[dict] = []
+        for i in sel:
+            s = items[i][0]
+            for used in waves:
+                if s not in used:
+                    used[s] = i
+                    break
+            else:
+                waves.append({s: i})
+        Ku = min(
+            _bucket(max(len(items[i][1]) for i in sel)), dev.K
+        )
+        for c0 in range(0, len(waves), W):
+            chunk = waves[c0 : c0 + W]
+            depth = len(chunk)
+            klen = np.zeros((depth, dev.S), np.int16)
+            kwin = np.zeros((depth, dev.S, Ku), np.uint8)
+            for t, wv in enumerate(chunk):
+                for s, i in wv.items():
+                    k = items[i][1]
+                    klen[t, s] = len(k)
+                    kwin[t, s, : len(k)] = np.frombuffer(k, np.uint8)
+            found_d, ver_d, vlen_d, valw_d = dev.lookup_only(
+                (klen, np.ascontiguousarray(kwin).view(np.uint32)),
+                W=W,
+                state=engine._dev_chain_base(),
+            )
+            found = np.asarray(found_d)
+            ver = np.asarray(ver_d)
+            n_ops = sum(len(wv) for wv in chunk)
+            engine._read_stats["probe"] += n_ops
+            engine._read_stats["probe_windows"] += 1
+            engine._h_read_batch.observe(float(depth))
+            if engine._dev_unresolvable(found[:depth], ver[:depth]):
+                # eviction edge: this window downloads the value planes
+                engine._read_stats["fallback"] += n_ops
+                resolver = None
+                vlen = np.asarray(vlen_d)
+                valb = np.ascontiguousarray(np.asarray(valw_d)).view(
+                    np.uint8
+                )
+            else:
+                resolver = engine._dev_make_resolver()
+            for t, wv in enumerate(chunk):
+                for s, i in wv.items():
+                    f, v = bool(found[t, s]), int(ver[t, s])
+                    if not f:
+                        out[i] = _get_frame(False, 0, b"")
+                    elif resolver is not None:
+                        out[i] = _get_frame(True, v, resolver(s, v))
+                    else:
+                        out[i] = _get_frame(
+                            True, v,
+                            valb[t, s, : int(vlen[t, s])].tobytes(),
+                        )
+        return out
+
+    def read(shard: int, key: bytes) -> bytes:
+        return read_many([(shard, key)])[0]
+
+    read.read_many = read_many  # the gateway's batched probe-round seam
     return read
 
 
@@ -353,6 +461,8 @@ class GatewayServer:
             ("submits_shed", "Submits shed by admission control"),
             ("reads", "Linearizable READ requests"),
             ("reads_failed", "READs failed (retryable or terminal)"),
+            ("reads_batched", "READs served via the reader's read_many "
+             "batch (one device-plane dispatch per probe round)"),
             ("probe_rounds", "Read-index frontier probe rounds"),
             ("results_sent", "Result frames sent to clients"),
             ("results_repaired", "Results repaired from peer gateways"),
@@ -454,6 +564,7 @@ class GatewayServer:
             "peer_gateways": len(self._peer_gateways),
             "submits": self.stats.submits,
             "reads": self.stats.reads,
+            "reads_batched": self.stats.reads_batched,
         }
         return doc
 
@@ -721,6 +832,17 @@ class GatewayServer:
             self._ser_carve = 0
             if ns > 0:
                 f("gateway", ns)
+
+    def _stg_rp(self, ns: int) -> None:
+        # "read_probe": serving probe-covered reads through the read
+        # handler (the device read-index lane's host-side cost); nested
+        # _send_result serializes carve out like the gateway bracket
+        f = getattr(self.engine, "_stg_ext", None)
+        if f is not None:
+            ns -= self._ser_carve
+            self._ser_carve = 0
+            if ns > 0:
+                f("read_probe", ns)
 
     def _handle(self, sender: NodeId, msg: ProtocolMessage) -> None:
         p = msg.payload
@@ -1493,17 +1615,57 @@ class GatewayServer:
         (zero additional tasks — the common case on a healthy replica);
         the rest group into ONE waiter task per shard."""
         rt = self.engine.rt
+        inline: list = []
         deferred: dict[int, list] = {}
         for sender, p in reads:
             target = int(frontier[p.shard])
             if rt.applied_upto[p.shard] >= target:
-                self._serve_read(sender, p)
+                inline.append((sender, p))
             else:
                 deferred.setdefault(p.shard, []).append(
                     (sender, p, target)
                 )
+        if inline:
+            self._serve_reads_batch(inline)
         for shard, items in deferred.items():
             self._spawn(self._serve_deferred_reads(shard, items))
+
+    def _serve_reads_batch(self, pairs: list) -> None:
+        """Serve every probe-covered read of one round in ONE handler
+        call when the reader exposes ``read_many`` (the device-plane
+        batched seam: all GETs of the probe window become a single
+        ``lookup_only`` dispatch — one plane fetch per probe round
+        instead of one per read). Handlers without the batch seam fall
+        back to per-read serving."""
+        rm = getattr(self.reader, "read_many", None)
+        if rm is None or len(pairs) == 1:
+            t0 = time.perf_counter_ns()
+            for sender, p in pairs:
+                self._serve_read(sender, p)
+            self._stg_rp(time.perf_counter_ns() - t0)
+            return
+        t0 = time.perf_counter_ns()
+        try:
+            frames = rm([(p.shard, p.key) for _, p in pairs])
+        except Exception as e:
+            logger.warning(
+                "gateway %s: batched read handler failed: %s",
+                self.node_id.short(), e,
+            )
+            for sender, p in pairs:
+                self._fail_read(
+                    sender, p, ResultStatus.ERROR,
+                    f"read handler failed: {e}".encode(),
+                )
+            self._stg_rp(time.perf_counter_ns() - t0)
+            return
+        self.stats.reads_batched += len(pairs)
+        for (sender, p), data in zip(pairs, frames):
+            self._reads_inflight.discard((p.client_id, p.seq))
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.OK, (data,)
+            )
+        self._stg_rp(time.perf_counter_ns() - t0)
 
     async def _serve_deferred_reads(self, shard: int, items: list) -> None:
         """One apply-frontier wait covers every deferred read of the
@@ -1522,8 +1684,7 @@ class GatewayServer:
             for sender, p, _ in items:
                 self._reads_inflight.discard((p.client_id, p.seq))
             raise
-        for sender, p, _ in items:
-            self._serve_read(sender, p)
+        self._serve_reads_batch([(sender, p) for sender, p, _ in items])
 
     async def _run_probe_round(self, waiters: list) -> np.ndarray:
         self.stats.probe_rounds += 1

@@ -63,11 +63,14 @@ SLO_STAGES: tuple[str, ...] = ("submit_result", "decide_apply", "broadcast")
 # path-independent. "gateway"/"serialization" are asyncio-owner-only
 # stages (gateway/server.py brackets; engine._stg_ext) that split the
 # control-plane work the r09 profile buried in `other` — the native RTS
-# block has no rows for them (stage_ns returns 0 there).
+# block has no rows for them (stage_ns returns 0 there). "read_probe"
+# is likewise asyncio-owner-only: time spent serving probe-covered
+# reads through the gateway's read handler (the device read-index
+# lane's host-side cost — gateway/server._serve_reads_batch).
 RUNTIME_STAGES: tuple[str, ...] = (
     "recv_wait", "ingest", "tick", "apply", "result_staging",
     "broadcast", "cmd", "timers", "idle", "other",
-    "gateway", "serialization",
+    "gateway", "serialization", "read_probe",
 )
 
 

@@ -344,6 +344,7 @@ class MeshEngine:
         device_store_kw: Optional[dict] = None,
         device_store_repromote: int = 64,
         device_store_inflight: Optional[int] = None,
+        device_read_lane: bool = False,
         latency_target_ms: Optional[float] = None,
         min_window: int = 1,
         max_window: int = 256,
@@ -464,6 +465,66 @@ class MeshEngine:
         # ONCE and the engine continues on the host path permanently.
         self._dev = None
         self._dev_active = False
+        # device READ-INDEX lane (opt-in): full-width GET blocks skim
+        # out of the consensus stream at submit time and batch into
+        # consensus-free lookup_only probe windows (zero slots, zero
+        # collectives) — see _dev_serve_reads. Off by default: probe
+        # reads may legally observe writes dispatched AFTER them
+        # (concurrent-invocation freedom), which the byte-identical
+        # device-vs-host conformance gates cannot tolerate.
+        self._dev_read_lane = bool(device_read_lane)
+        # skimmed GETs awaiting service: (block, bfut, barrier) where
+        # barrier is the _dev_wseq stamp at submit — the read becomes
+        # eligible once every write block staged before it has
+        # DISPATCHED (chained state then contains those writes)
+        self._read_pending: deque = deque()
+        self._dev_wseq = 0  # full-width blocks staged (write barrier)
+        self._dev_wdisp = 0  # full-width blocks dispatched
+        # rabia_devkv_read_* sources: ops served off-consensus (probe),
+        # ops that consumed slots (slot), value-plane download events
+        # (fallback), probe windows dispatched
+        self._read_stats = {
+            "probe": 0, "slot": 0, "fallback": 0, "probe_windows": 0,
+        }
+        for _path in ("probe", "slot", "fallback"):
+            m.counter(
+                "devkv_read_total",
+                "Device-lane GET ops by serving path: probe = "
+                "off-consensus lookup_only windows (zero slots), slot = "
+                "consensus-window GETs, fallback = value-plane download "
+                "events (eviction edge; overlaps the other two)",
+                {"path": _path},
+                fn=(lambda p=_path: self._read_stats[p]),
+            )
+        m.counter(
+            "devkv_read_probe_windows_total",
+            "Consensus-free lookup_only probe windows dispatched",
+            fn=lambda: self._read_stats["probe_windows"],
+        )
+        self._h_read_batch = m.histogram(
+            "devkv_read_batch_ops",
+            "GET blocks coalesced per probe window (batching factor of "
+            "the read-index lane)",
+            buckets=tuple(float(1 << i) for i in range(11)),
+        )
+        # randomized-termination evidence (chaos/runner.collect_evidence
+        # reads this family from every engine): the colocated lockstep
+        # mesh decides every counted slot unanimously in its first
+        # phase — a theorem of the model, not a measurement, so the
+        # curve is a spike at 1 sourced from the decision counter
+        _phase_bounds = tuple(float(b) for b in range(1, 33))
+
+        def _mesh_phase_curve():
+            d = int(self.decided_v1)
+            return [d] + [0] * 31, d, float(d)
+
+        m.histogram(
+            "phases_to_decide",
+            "Weak-MVC phases per decided slot (colocated lockstep: "
+            "every decided slot is unanimous, phase 1 by construction)",
+            buckets=_phase_bounds,
+            fn=_mesh_phase_curve,
+        )
         if device_store:
             from rabia_tpu.apps.device_kv import DeviceKVTable
 
@@ -587,10 +648,22 @@ class MeshEngine:
             raise ValidationError("block shards must be unique")
         bfut = MeshBlockFuture(len(shards))
         if len(shards) == self.n_shards and self._queued_entries == 0:
+            if (
+                self._dev_read_lane
+                and self._dev_active
+                and _block_op_kind(block) == 2
+            ):
+                # read-index lane: the GET never enters the consensus
+                # stream — it parks with a write barrier (every block
+                # staged so far) and serves from a consensus-free probe
+                # window once those writes have dispatched
+                self._read_pending.append((block, bfut, self._dev_wseq))
+                return bfut
             # full-width block with nothing queued: the vectorized lane
             inv = np.empty(self.n_shards, np.int64)
             inv[shards] = np.arange(len(shards))
             self._full_blocks.append((block, bfut, inv))
+            self._dev_wseq += 1
             return bfut
         if self._full_blocks:
             self._demote_full_blocks()
@@ -864,6 +937,24 @@ class MeshEngine:
         }
 
     def _run_cycle_inner(self) -> int:
+        # read-index lane first: every eligible skimmed GET (its write
+        # barrier has dispatched) batches into one consensus-free probe
+        # window before the consensus stream runs — mixed workloads
+        # then dispatch SET-mostly windows
+        served = 0
+        if (
+            self._dev is not None
+            and self._dev_active
+            and self._read_pending
+            and self._read_pending[0][2] <= self._dev_wdisp
+        ):
+            # a probe window outside the read envelope demotes inside
+            # this call; the flushed blocks then re-enter through the
+            # host path in the body below — same-cycle continuation
+            served = self._dev_serve_reads()
+        return served + self._run_cycle_body()
+
+    def _run_cycle_body(self) -> int:
         if (
             self._dev_active
             and self._dev_pipe
@@ -1092,6 +1183,7 @@ class MeshEngine:
         n = self.n_shards
         for _ in range(depth):
             self._full_blocks.popleft()
+        self._dev_wdisp += depth  # read-lane write barrier advances
         start = self.next_slot.copy()
         self.next_slot[:n] += depth
         self.decided_v1 += depth * n
@@ -1174,11 +1266,17 @@ class MeshEngine:
         — see their dispatch methods). Returns batches applied by the
         resolved window."""
         rec = self._dev_pipe[0]
-        flags = rec["flags_fut"].result()  # <=12 bytes: the readback
-        if rec["kind"] == "get":
-            dirty = not int(flags)  # lookup returns the all_v1 scalar
+        if rec["kind"] == "read":
+            # consensus-free probe window: nothing was decided, nothing
+            # can be dirty — FIFO resolution means every write it
+            # chained on settled cleanly before it reached the head
+            dirty = False
         else:
-            dirty = not flags[0] or flags[1] or flags[2]
+            flags = rec["flags_fut"].result()  # <=12 bytes: the readback
+            if rec["kind"] == "get":
+                dirty = not int(flags)  # lookup returns the all_v1 scalar
+            else:
+                dirty = not flags[0] or flags[1] or flags[2]
         if dirty:
             # roll back EVERY optimistic window, newest first — the
             # device state was never adopted, so restoring the host
@@ -1187,11 +1285,24 @@ class MeshEngine:
             while self._dev_pipe:
                 r = self._dev_pipe.pop()
                 d, rn = r["depth"], r["n"]
+                if r["kind"] == "read":
+                    # probe windows consumed no slots and no log
+                    # entries: re-front the skimmed blocks so the
+                    # demotion below flushes them to the host path
+                    # (serialized after the rolled-back writes — all
+                    # still-unsettled, so any order is linearizable).
+                    # Un-count them: these ops end up host-served
+                    self._read_stats["probe"] -= d * rn
+                    self._read_stats["probe_windows"] -= 1
+                    for e in reversed(r["entries"]):
+                        self._read_pending.appendleft(e)
+                    continue
                 for _ in range(d):
                     if self._bulk_log:
                         self._bulk_log.pop()
                 for e in reversed(r["entries"]):
                     self._full_blocks.appendleft(e)
+                self._dev_wdisp -= d
                 self.next_slot[:rn] -= d
                 if r["sver_delta"] is not None:
                     self._dev_sver -= r["sver_delta"]
@@ -1301,6 +1412,8 @@ class MeshEngine:
         if resolved:
             rsv = self._dev_make_resolver()
         else:
+            # eviction edge: the window pays the value-plane download
+            self._read_stats["fallback"] += depth * rec["n"]
             vlen_d, valw_d = rec["val_dev"]
             vlen = np.asarray(vlen_d)
             valw = np.asarray(valw_d)
@@ -1411,6 +1524,78 @@ class MeshEngine:
             applied += self._dev_resolve_one()
         return applied
 
+    def _dev_serve_reads(self) -> int:
+        """Serve every ELIGIBLE skimmed GET (write barrier dispatched)
+        in one consensus-free ``lookup_only`` probe window: zero slots
+        consumed, zero collectives in the program (pinned by
+        benchmarks/ici_model.py), meta-only readback with host-segment
+        value resolution — the device read-index lane.
+
+        Linearizability: the window chains on the newest in-flight
+        write window's output state, so a read observes every write
+        dispatched before it (its barrier guarantees all EARLIER
+        submissions are among them — read-your-writes) and possibly
+        writes dispatched after it while it was parked — legal, those
+        writes are still unsettled, i.e. concurrent invocations. The
+        probe record joins the FIFO pipe, so its responses settle only
+        after every write it observed settled cleanly; a dirty write
+        rolls the probe back unserved (see _dev_resolve_one).
+
+        Returns batches applied by windows the pipe resolved while
+        enforcing its depth (the probe itself settles later)."""
+        W = self.window
+        batch = []
+        while (
+            self._read_pending
+            and len(batch) < W
+            and self._read_pending[0][2] <= self._dev_wdisp
+        ):
+            batch.append(self._read_pending.popleft())
+        if not batch:
+            return 0
+        packed = self._dev.pack_get_window_auto([e[0] for e in batch])
+        if packed is None:
+            # outside the read envelope (long key, malformed op): put
+            # the batch back and demote — the flush below hands every
+            # parked read to the host path
+            for e in reversed(batch):
+                self._read_pending.appendleft(e)
+            applied = self._dev_drain_pipe()
+            self._demote_device_store()
+            return applied
+        state_base = self._dev_chain_base()
+        with device_annotation("rabia.devkv.read_probe"):
+            found_d, ver_d, vlen_d, valw_d = self._dev.lookup_only(
+                packed, W=W, state=state_base
+            )
+        self._lat_invalidate |= (
+            self._dev.compiled_on_last_call and self._lat_timing
+        )
+        self.cycles += 1
+        depth = len(batch)
+        n = self.n_shards
+        self._read_stats["probe"] += depth * n
+        self._read_stats["probe_windows"] += 1
+        self._h_read_batch.observe(float(depth))
+        pool = self._dev_fetcher()
+        return self._dev_push_window(
+            {
+                "kind": "read",
+                "flags_fut": None,  # nothing decided, nothing to read
+                "meta_fut": pool.submit(
+                    lambda f=found_d, v=ver_d: (np.asarray(f), np.asarray(v))
+                ),
+                "val_dev": (vlen_d, valw_d),
+                # read-only: the chained state passes through untouched
+                "new_state": state_base,
+                "entries": batch,
+                "depth": depth,
+                "n": n,
+                "seg": None,
+                "sver_delta": None,
+            }
+        )
+
     def _run_cycle_fullwidth_device_get(self, depth: int) -> int:
         """GET-only full-width windows through the device table's
         read-only lookup program: consensus decides the slots and the
@@ -1456,6 +1641,7 @@ class MeshEngine:
             self._dev.compiled_on_last_call and self._lat_timing
         )
         self.cycles += 1
+        self._read_stats["slot"] += depth * n  # GETs that consumed slots
         self._dev_commit_window(entries, depth)
         pool = self._dev_fetcher()
         return self._dev_push_window(
@@ -1530,6 +1716,9 @@ class MeshEngine:
             self._dev.compiled_on_last_call and self._lat_timing
         )
         self.cycles += 1
+        # GET ops that rode consensus slots inside the mixed window
+        # (kind 2; DEL/EXISTS are not reads for the read-lane counters)
+        self._read_stats["slot"] += int((kind == 2).sum())
         # derived SET versions: host mirror + inclusive per-shard SET
         # count (GET waves advance nothing). Deferred windows push a
         # PROVISIONAL segment (empty placeholder range — matches no
@@ -1702,6 +1891,16 @@ class MeshEngine:
             # host mode needs no flags worker; re-promotion recreates it
             self._dev_fetcher_pool.shutdown(wait=False)
             self._dev_fetcher_pool = None
+        # parked reads leave with the lane: re-enter them as ordinary
+        # full-width blocks at the BACK of the staged stream (behind
+        # any rolled-back writes — all still unsettled, so the order
+        # is linearizable); the host GET path serves them
+        while self._read_pending:
+            block, bfut, _barrier = self._read_pending.popleft()
+            shards = np.asarray(block.shards, np.int64)
+            inv = np.empty(self.n_shards, np.int64)
+            inv[shards] = np.arange(len(shards))
+            self._full_blocks.append((block, bfut, inv))
         d = self._dev.dump()  # ONE table materialization for all replicas
         for sm in self.sms:
             self._dev.sync_into(sm, dump=d)
@@ -1744,6 +1943,11 @@ class MeshEngine:
             )
             self._dev_reindex_seed()
             self._dev_active = True
+            # re-arm the read-lane write barrier: the staged (not yet
+            # dispatched) blocks are the only writes a fresh read must
+            # wait behind
+            self._dev_wseq = len(self._full_blocks)
+            self._dev_wdisp = 0
             self._lat_invalidate |= self._lat_timing  # upload, not latency
             logger.info("device KV lane re-promoted from host stores")
         else:
@@ -2130,8 +2334,17 @@ class MeshEngine:
         return bool(
             self._queued_entries
             or self._full_blocks
+            or self._read_pending
             or (self._dev is not None and self._dev_pipe)
         )
+
+    def read_lane_stats(self) -> dict:
+        """Read-index lane counters (the ``rabia_devkv_read_*`` family
+        as a plain dict): ops served off-consensus (``probe``), GETs
+        that consumed consensus slots (``slot``), value-plane download
+        events (``fallback``), probe windows dispatched
+        (``probe_windows``)."""
+        return dict(self._read_stats)
 
     # -- checkpoint / restore ------------------------------------------------
 

@@ -22,7 +22,14 @@ import struct
 import uuid
 from typing import Optional, Sequence
 
-from rabia_tpu.core.messages import ClientHello, ProtocolMessage, Result, Submit
+from rabia_tpu.core.messages import (
+    ClientHello,
+    ProtocolMessage,
+    ReadIndex,
+    ReadIndexMode,
+    Result,
+    Submit,
+)
 from rabia_tpu.core.serialization import Serializer
 from rabia_tpu.core.types import NodeId
 
@@ -162,6 +169,29 @@ class LoadSession:
                 Submit(
                     client_id=self.client_id, seq=seq, shard=shard,
                     commands=tuple(commands), ack_upto=max(0, seq - 64),
+                )
+            )
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self.pending.pop(seq, None)
+
+    async def read(self, shard: int, key: bytes, timeout: float) -> Result:
+        """Linearizable GET through the gateway's read-index lane
+        (``ReadIndexMode.READ``): served from a shared frontier probe
+        round — ZERO consensus slots consumed — with the result framed
+        byte-identically to a committed GET. A RETRY status (probe
+        timeout, quorum loss) is the caller's signal to fall back to a
+        consensus-slot GET submit."""
+        self._seq += 1
+        seq = self._seq
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self.pending[seq] = fut
+        try:
+            self._send(
+                ReadIndex(
+                    mode=int(ReadIndexMode.READ),
+                    client_id=self.client_id, seq=seq,
+                    shard=shard, key=key,
                 )
             )
             return await asyncio.wait_for(fut, timeout)

@@ -83,7 +83,7 @@ class TestSloBuckets:
         # tail stages are asyncio-owner-only (gateway control plane)
         assert RUNTIME_STAGES[: len(RTM_STAGE_NAMES)] == RTM_STAGE_NAMES
         assert set(RUNTIME_STAGES) - set(RTM_STAGE_NAMES) == {
-            "gateway", "serialization",
+            "gateway", "serialization", "read_probe",
         }
 
 
