@@ -81,6 +81,47 @@ def _percentile(sorted_ms: list[float], q: float) -> Optional[float]:
     return round(sorted_ms[i], 3)
 
 
+_COAL_FIELDS = ("waves", "covered", "solo", "scalar", "results_ok")
+
+
+def fleet_coalesce_columns(
+    gateways: list, coal_before: dict, coal_after: dict
+) -> dict:
+    """Per-fleet-gateway coalesce figures from the REPLICA tier's
+    per-shard counter deltas, grouped by ring ownership.
+
+    ``gateways``: ``[{"name", "owned_shards_list"}, ...]`` (the fleet
+    health docs); ``coal_before``/``coal_after``: ``{shard: {field:
+    cumulative}}`` sampled around the point. Returns ``{name: {waves,
+    covered, solo, scalar, results_ok, coalesce_density,
+    slots_per_op}}`` — the SAME recipes the fleet aggregator derives
+    from scraped ``rabia_coalesce_shard_total`` deltas
+    (obs/fleet_obs.derive_gateway_figures), computed here from the
+    in-process counters so a recorded run can cross-check the two
+    independent paths against each other."""
+    out: dict[str, dict] = {}
+    for g in gateways:
+        fig = {f: 0 for f in _COAL_FIELDS}
+        for s in g.get("owned_shards_list", []):
+            a = coal_after.get(s, {})
+            b = coal_before.get(s, {})
+            for f in _COAL_FIELDS:
+                fig[f] += int(a.get(f, 0)) - int(b.get(f, 0))
+        slots = fig["waves"] + fig["scalar"]
+        out[g["name"]] = {
+            **fig,
+            "coalesce_density": (
+                round(fig["covered"] / fig["waves"], 4)
+                if fig["waves"] > 0 else None
+            ),
+            "slots_per_op": (
+                round(slots / fig["results_ok"], 4)
+                if fig["results_ok"] > 0 else None
+            ),
+        }
+    return out
+
+
 async def run_point(
     endpoints: Sequence[tuple[str, int]],
     rate: float,
@@ -99,6 +140,7 @@ async def run_point(
     counters_fn=None,
     fleet_resolver=None,
     fleet_fn=None,
+    coal_shard_fn=None,
 ) -> dict:
     """Drive one open-loop point and return its SLO report entry.
 
@@ -120,7 +162,12 @@ async def run_point(
     10^5-sessions-behind-one-front-door lane. ``fleet_fn``: zero-arg
     callable returning per-gateway health snapshots; sampled
     before/after so the point carries per-gateway AND fleet-aggregate
-    counter deltas (moved, cached replays, ledger traffic)."""
+    counter deltas (moved, cached replays, ledger traffic).
+    ``coal_shard_fn``: zero-arg callable returning the replica tier's
+    per-shard coalesce counters ``{shard: {field: cumulative}}`` —
+    sampled before/after so each fleet point carries per-gateway
+    coalesce-density / slots-per-op columns grouped by ring ownership
+    (:func:`fleet_coalesce_columns`)."""
     from rabia_tpu.apps.kvstore import (
         KVOperation,
         encode_op_bin,
@@ -246,6 +293,7 @@ async def run_point(
     shed_before = dict(shed_fn()) if shed_fn is not None else None
     ctr_before = dict(counters_fn()) if counters_fn is not None else None
     fleet_before = fleet_fn() if fleet_fn is not None else None
+    coal_before = coal_shard_fn() if coal_shard_fn is not None else None
 
     counts = {k: 0 for k in OUTCOMES}
     lat_ok_ms: list[float] = []
@@ -428,6 +476,14 @@ async def run_point(
     if fleet_fn is not None:
         after_g = fleet_fn()
         before_by = {g["name"]: g for g in (fleet_before or [])}
+        # per-gateway coalesce columns: replica-tier per-shard counter
+        # deltas grouped by each fleet gateway's owned shards — the
+        # loadgen side of the aggregator cross-check
+        coal_cols = None
+        if coal_before is not None and coal_shard_fn is not None:
+            coal_cols = fleet_coalesce_columns(
+                after_g, coal_before, coal_shard_fn()
+            )
         gws = []
         agg: dict[str, int] = {}
         for g in after_g:
@@ -441,6 +497,10 @@ async def run_point(
                 "sessions": g["sessions"],
                 "owned_shards": g["owned_shards"],
                 **delta,
+                **(
+                    {"coalesce": coal_cols[g["name"]]}
+                    if coal_cols is not None else {}
+                ),
             })
             for k, v in delta.items():
                 agg[k] = agg.get(k, 0) + v
@@ -783,6 +843,7 @@ async def run(args) -> dict:
         planes = cluster.gateways[0].health().get("planes")
 
     fleet_fn = None
+    coal_shard_fn = None
     if fleet_harness is not None:
 
         def fleet_fn() -> list[dict]:
@@ -795,8 +856,23 @@ async def run(args) -> dict:
                     "name": h["name"],
                     "sessions": h["sessions"],
                     "owned_shards": len(h["owned_shards"]),
+                    "owned_shards_list": list(h["owned_shards"]),
                     "stats": dict(h["stats"]),
                 })
+            return out
+
+        def coal_shard_fn() -> dict:
+            # the replica tier's per-shard coalesce counters, summed
+            # over the cluster gateways: the raw material for the
+            # per-fleet-gateway density/slots-per-op columns
+            out: dict[int, dict] = {}
+            for g in cluster.gateways:
+                if g is None:
+                    continue
+                for shard, cs in g.coal_shard_stats.items():
+                    dst = out.setdefault(shard, {})
+                    for k, v in cs.items():
+                        dst[k] = dst.get(k, 0) + int(v)
             return out
 
     points = []
@@ -834,6 +910,7 @@ async def run(args) -> dict:
                     if fleet_harness is not None else None
                 ),
                 fleet_fn=fleet_fn,
+                coal_shard_fn=coal_shard_fn,
             )
             points.append(pt)
             print(json.dumps(pt), file=sys.stderr)

@@ -25,6 +25,10 @@ Usage:
                                            # runtime stage breakdown
     python -m rabia_tpu timeline <host:port> [host:port ...] \\
         [--last N] [--metric SUBSTR ...]   # per-second telemetry curves
+    python -m rabia_tpu fleet-top <host:port> \\
+        [--samples N] [--interval S]       # ring-discovered fleet pane:
+                                           # per-gateway coalesce density,
+                                           # slots/op, routing rates
 """
 
 from __future__ import annotations
@@ -558,6 +562,60 @@ def _ring(addr: str, timeout: float, as_json: bool) -> int:
     return 0
 
 
+def _fleet_top(
+    addr: str,
+    samples: int,
+    interval: float,
+    as_json: bool,
+    out: str | None,
+    timeout: float,
+) -> int:
+    """Ring-discovered fleet pane: bootstrap the whole two-tier
+    inventory from one fleet gateway (RING members + each member's
+    ``upstreams``), scrape everything, and print the per-gateway derived
+    series — coalesce density, slots/op, routing rates — plus the
+    fleet-level shared-resource figures (fsyncs/Result, off-consensus
+    read fraction). Derived rates are counter DELTAS, so at least two
+    samples are taken. docs/OBSERVABILITY.md, "Fleet plane"."""
+    import asyncio
+    import json
+
+    from rabia_tpu.obs.fleet_obs import FleetAggregator, render_fleet_table
+
+    parsed = _parse_addr(addr)
+    if parsed is None:
+        print(f"fleet-top: bad address {addr!r} (want host:port)",
+              file=sys.stderr)
+        return 2
+
+    async def run() -> list[dict]:
+        agg = FleetAggregator(parsed, timeout=timeout)
+        await agg.refresh()
+        docs = []
+        for k in range(max(2, samples)):
+            if k:
+                await asyncio.sleep(max(0.1, interval))
+            doc = await agg.sample()
+            docs.append(doc)
+            if not as_json:
+                print(render_fleet_table(doc))
+                print()
+        return agg.series()
+
+    try:
+        series = asyncio.run(run())
+    except Exception as e:
+        print(f"fleet-top: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if out:
+        with open(out, "w") as f:
+            json.dump({"version": 1, "series": series}, f)
+        print(f"fleet-top: {len(series)} samples -> {out}", file=sys.stderr)
+    if as_json:
+        print(json.dumps(series[-1]))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m rabia_tpu",
@@ -636,6 +694,30 @@ def main(argv=None) -> int:
         "--out", default=None, help="also write merged rows to this file"
     )
     tl.add_argument("--timeout", type=float, default=10.0)
+    ft = sub.add_parser(
+        "fleet-top",
+        help="ring-discovered fleet pane: per-gateway coalesce density, "
+        "slots/op and routing rates plus fleet-level shared-resource "
+        "figures (docs/OBSERVABILITY.md)",
+    )
+    ft.add_argument("addr", help="any fleet gateway host:port (the seed)")
+    ft.add_argument(
+        "--samples", type=int, default=2,
+        help="scrape rounds (min 2 — derived rates are counter deltas)",
+    )
+    ft.add_argument(
+        "--interval", type=float, default=1.0,
+        help="seconds between scrape rounds",
+    )
+    ft.add_argument(
+        "--json", action="store_true",
+        help="print the final derived sample as JSON instead of tables",
+    )
+    ft.add_argument(
+        "--out", default=None,
+        help="also write the whole derived series to this file",
+    )
+    ft.add_argument("--timeout", type=float, default=10.0)
     rg = sub.add_parser(
         "ring",
         help="dump a routed fleet's hash ring from any member: "
@@ -668,6 +750,11 @@ def main(argv=None) -> int:
         return _wal_dump(args.dir, args.records, args.last)
     if args.cmd == "ring":
         return _ring(args.addr, args.timeout, args.json)
+    if args.cmd == "fleet-top":
+        return _fleet_top(
+            args.addr, args.samples, args.interval, args.json, args.out,
+            args.timeout,
+        )
     if args.cmd == "stats":
         return _stats(
             args.addr, args.kind, args.timeout,

@@ -103,6 +103,11 @@ class ChaosProfile:
     # tcp fabric only: GatewayConfig field overrides as a (key, value)
     # tuple-of-pairs (profiles are frozen/hashable — no dict field)
     gateway_overrides: tuple = ()
+    # SLO burn-rate watchdog (obs/fleet_obs.py, round 18): journal kinds
+    # the watchdog MUST record during the fault window — and before the
+    # first fault event it must stay quiet (the healthy control). Empty
+    # tuple = watchdog runs but is not asserted on.
+    expect_watchdog: tuple = ()
 
     def scaled(self, factor: float) -> "ChaosProfile":
         """Time-scaled copy (the CI smoke cell runs factor < 1)."""
@@ -318,6 +323,10 @@ def default_profiles() -> dict[str, ChaosProfile]:
                 ("coalesce_window", 0.02),
                 ("coalesce_window_min", 0.02),
             ),
+            # the proposer restart takes a member out of the watchdog's
+            # alive set mid-run: ring_stale must fire in the fault
+            # window and nothing may fire before the first event
+            expect_watchdog=("ring_stale",),
         ),
         # -- device-mesh fabric (round 17: device KV + read-index lane) -
         _p(
@@ -361,6 +370,9 @@ def default_profiles() -> dict[str, ChaosProfile]:
             rate=80.0,
             n_gateways=2,
             min_availability=0.5,
+            # the killed fleet gateway leaves the watchdog's alive set
+            # for the rest of the run: ring_stale is the asserted kind
+            expect_watchdog=("ring_stale",),
         ),
         _p(
             "rolling_restart",

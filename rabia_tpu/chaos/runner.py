@@ -215,6 +215,13 @@ class _SimFabric:
             for e in self.cluster.engines
         ]
 
+    def watchdog_sample(self) -> dict:
+        n = self.profile.n_replicas
+        return {
+            "members_alive": n - len(self._crashed),
+            "members_total": n,
+        }
+
     async def converged(self, timeout: float) -> bool:
         deadline = time.time() + timeout
         while time.time() < deadline:
@@ -421,6 +428,26 @@ class _TcpFabric:
             for e in self.cluster.engines
         ]
 
+    def watchdog_sample(self) -> dict:
+        # membership from the fabric's OWN knowledge of what it stopped
+        # (deterministic — no scrape race), coalesce counters from the
+        # live gateways' per-shard stats (cumulative; a restarted
+        # gateway's counters reset, which a delta window just skips)
+        n = self.profile.n_replicas
+        waves = covered = 0
+        for g in self.cluster.gateways:
+            if g is None:
+                continue
+            for cs in getattr(g, "coal_shard_stats", {}).values():
+                waves += cs["waves"]
+                covered += cs["covered"]
+        return {
+            "members_alive": n - len(self._down),
+            "members_total": n,
+            "waves": waves,
+            "covered": covered,
+        }
+
     async def converged(self, timeout: float) -> bool:
         try:
             await self.cluster.wait_converged(timeout)
@@ -606,6 +633,24 @@ class _FleetFabric:
             for e in self.harness.cluster.engines
         ]
 
+    def watchdog_sample(self) -> dict:
+        # members = the ROUTING tier (a killed fleet gateway is what
+        # ring_stale names here); coalesce counters come from the
+        # replica-cluster gateways that actually pack the waves
+        waves = covered = 0
+        for g in self.harness.cluster.gateways:
+            if g is None:
+                continue
+            for cs in getattr(g, "coal_shard_stats", {}).values():
+                waves += cs["waves"]
+                covered += cs["covered"]
+        return {
+            "members_alive": len(self.harness.live_indices()),
+            "members_total": self.profile.n_gateways,
+            "waves": waves,
+            "covered": covered,
+        }
+
     async def converged(self, timeout: float) -> bool:
         try:
             await self.harness.cluster.wait_converged(timeout)
@@ -774,6 +819,13 @@ class _MeshFabric:
     def decided_totals(self) -> list[Optional[int]]:
         return [int(self.eng.decided_v1 + self.eng.decided_v0)]
 
+    def watchdog_sample(self) -> dict:
+        n = self.profile.n_replicas
+        return {
+            "members_alive": n - len(self._crashed),
+            "members_total": n,
+        }
+
     async def converged(self, timeout: float) -> bool:
         eng = self.eng
         deadline = time.time() + timeout
@@ -889,6 +941,33 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
     inflight_cap = max(64, int(profile.rate * profile.call_timeout * 2))
     window = max(0.2, profile.duration / 32.0)
 
+    # SLO burn-rate watchdog (obs/fleet_obs.py): fed cumulative counters
+    # at the health cadence — outcome totals from the arrival score
+    # sheet, membership + coalesce counters from the fabric's own
+    # knowledge (no scrape race). Profiles with ``expect_watchdog`` gate
+    # on the verdict; everyone else carries it as report evidence.
+    from rabia_tpu.obs.fleet_obs import BurnRateWatchdog, SLOPolicy
+
+    watchdog = BurnRateWatchdog(
+        SLOPolicy(
+            fast_window_s=2.0 * window,
+            slow_window_s=8.0 * window,
+        )
+    )
+
+    def wd_observe(rel_t: float) -> None:
+        ok = errors = 0
+        for _t, outcome, _ms in arrivals.rows:
+            if outcome == "ok":
+                ok += 1
+            else:
+                errors += 1
+        sample = {"ok": ok, "errors": errors}
+        if hasattr(fabric, "watchdog_sample"):
+            sample.update(fabric.watchdog_sample())
+        for kind in watchdog.observe(rel_t, sample):
+            log(f"t={rel_t:.1f}s watchdog fired {kind}")
+
     try:
         # warmup: light load so the pipeline is hot before t0
         warm_end = loop.time() + profile.warmup
@@ -948,6 +1027,13 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
                     membership_pending = asyncio.ensure_future(
                         fabric.apply_event_async(ev.action, ev.args)
                     )
+                    # let the transition coroutine reach its first await
+                    # (membership bookkeeping is its first statement),
+                    # then sample the watchdog on the event edge — a
+                    # sub-window outage must not dodge detection by
+                    # falling between cadence samples
+                    await asyncio.sleep(0)
+                    wd_observe(loop.time() - t0)
                 else:
                     fabric.apply_event(ev.action, ev.args)
             # health sample (~per window)
@@ -958,6 +1044,11 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
                         "decided": fabric.decided_totals(),
                     }
                 )
+                # fresh clock read: an event-edge sample earlier in this
+                # iteration may already have stamped a later t than the
+                # loop-top `now`, and the watchdog windows assume
+                # monotone sample times
+                wd_observe(loop.time() - t0)
                 next_sample = now + window
             if now >= t_end:
                 break
@@ -1044,6 +1135,34 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
         problems.append("no phases-to-decide evidence recorded")
     problems.extend(fabric_problems)
 
+    # SLO watchdog verdict: profiles that declare expectations gate on
+    # (a) every expected kind fired inside the fault window and (b) the
+    # healthy control — NOTHING fired before the first fault event
+    verdict = watchdog.verdict()
+    if profile.expect_watchdog:
+        first_event_at = min(
+            (e.at for e in profile.events), default=0.0
+        )
+        for kind in profile.expect_watchdog:
+            hits = [
+                ep for ep in verdict["episodes"]
+                if ep["kind"] == kind and ep["t"] >= first_event_at
+            ]
+            if not hits:
+                problems.append(
+                    f"watchdog: expected {kind!r} to fire during the "
+                    f"fault window (fired: {verdict['fired'] or 'nothing'})"
+                )
+        early = [
+            ep["kind"] for ep in verdict["episodes"]
+            if ep["t"] < first_event_at
+        ]
+        if early:
+            problems.append(
+                "watchdog: fired on the healthy control (before "
+                f"t={first_event_at}s): {sorted(set(early))}"
+            )
+
     report = {
         "profile": profile.name,
         "fabric": profile.fabric,
@@ -1069,6 +1188,7 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
         "phases_to_decide": evidence,
         "timeline": timeline,
         "health": health_rows,
+        "watchdog": verdict,
         "converged": converged,
         "pass": not problems,
         "problems": problems,

@@ -1304,14 +1304,23 @@ class RuntimeBridge:
         # a wave decide supersedes any staged scalar command marker
         self._cmd_slot[shards] = -1
         for j in range(min(count, 8)):
+            # own-block waves know their batch ids; stamping them makes
+            # the (shard, slot) discoverable by TRACE slicing, so a
+            # cross-tier trace shows the wave decide/apply on the
+            # proposer (peer waves have no registry entry — hash 0)
+            bh = (
+                fr_hash(breg.block.batch_id_for(int(ents["bidx"][j])))
+                if breg is not None
+                else 0
+            )
             e.flight.record(
                 FRE_DECIDE, shard=int(shards[j]), slot=int(slots[j]),
-                arg=int(values[j]),
+                arg=int(values[j]), batch=bh,
             )
             if applied_flag:
                 e.flight.record(
                     FRE_APPLY, shard=int(shards[j]), slot=int(slots[j]),
-                    arg=int(values[j]),
+                    arg=int(values[j]), batch=bh,
                 )
         if applied_flag:
             done = in_order

@@ -82,7 +82,21 @@ from rabia_tpu.gateway.session import (
     SUBMIT_SHED_WINDOW,
     SessionTable,
 )
+from rabia_tpu.obs.flight import (
+    FRE_FLEET_FWD,
+    FRE_FLEET_LEDGER_APPLY,
+    FRE_FLEET_LEDGER_SEND,
+    FRE_FLEET_MOVED,
+    FRE_FLEET_RECV,
+    FRE_FLEET_RESULT,
+    FlightRecorder,
+    batch_id_for,
+    build_fleet_trace_slice,
+    fr_hash,
+)
+from rabia_tpu.obs.journal import AnomalyJournal
 from rabia_tpu.obs.registry import MetricsRegistry
+from rabia_tpu.obs.telemetry import TelemetrySampler
 
 logger = logging.getLogger("rabia_tpu.fleet")
 
@@ -112,6 +126,10 @@ class FleetGatewayConfig:
     # this long is aborted + shed RETRY; the retry re-forwards
     waiter_timeout: float = 10.0
     handoff_timeout: float = 10.0
+    # per-second telemetry ring (obs/telemetry.TelemetrySampler), served
+    # as AdminKind.TIMELINE like the replica gateways. 0 disables it.
+    telemetry_interval: float = 1.0
+    telemetry_cap: int = 900
 
 
 @dataclass
@@ -257,6 +275,20 @@ class FleetGateway:
         # for this table (session GC runs against it, not an engine
         # state version — the fleet tier has no engine)
         self._frontier = 0
+        # fleet-side observability plane: a flight ring for the routing
+        # hops (FRE_FLEET_* kinds, batch-hash keyed so a (client_id, seq)
+        # trace joins with the replica tier), an anomaly journal, and a
+        # telemetry ring started in start(). The row index parses from
+        # the gateway name ("gw3" -> 3) — it only disambiguates fleet
+        # slices among themselves, never against replica rows (slices
+        # carry tier="fleet").
+        self.flight = FlightRecorder()
+        self.journal = AnomalyJournal()
+        self._telemetry: Optional[TelemetrySampler] = None
+        digits = "".join(
+            c for c in self.config.name if c.isdigit()
+        )
+        self._row = int(digits) if digits else 0
         self.metrics = MetricsRegistry(namespace="rabia")
         self._register_metrics()
 
@@ -311,6 +343,13 @@ class FleetGateway:
             _UpstreamLink(self, host, port)
             for host, port in self.config.upstreams
         ]
+        if self.config.telemetry_interval > 0 and self._telemetry is None:
+            self._telemetry = TelemetrySampler(
+                self.metrics,
+                node=self.config.name,
+                interval=self.config.telemetry_interval,
+                cap=self.config.telemetry_cap,
+            ).start()
         self._running = True
         self._run_task = asyncio.ensure_future(self._run())
 
@@ -329,6 +368,11 @@ class FleetGateway:
 
     async def close(self) -> None:
         self._running = False
+        if self._telemetry is not None:
+            # final flush so the ring covers the run's last instant
+            self._telemetry.sample()
+            self._telemetry.close()
+            self._telemetry = None
         for t in (self._run_task, *self._tasks):
             if t is not None:
                 t.cancel()
@@ -501,6 +545,11 @@ class FleetGateway:
 
     def _on_submit(self, p: Submit) -> None:
         self.stats.submits += 1
+        # the fleet hop of the cross-tier trace: every Submit records
+        # its arrival under the SAME deterministic batch hash the
+        # replica tier keys its lifecycle events with
+        bhash = fr_hash(batch_id_for(p.client_id, p.seq))
+        self.flight.record(FRE_FLEET_RECV, shard=p.shard, batch=bhash)
         decision, cstatus, cpayload = self.sessions.submit_check(
             p.client_id, p.seq, p.ack_upto
         )
@@ -536,6 +585,9 @@ class FleetGateway:
             owner = self.ring.owner(p.shard)
             self.sessions.abort(p.client_id, p.seq)
             self.stats.moved += 1
+            self.flight.record(
+                FRE_FLEET_MOVED, shard=p.shard, batch=bhash,
+            )
             self._send_result(
                 p.client_id, p.seq, ResultStatus.MOVED,
                 (
@@ -549,6 +601,7 @@ class FleetGateway:
             p.shard, time.time() + self.config.forward_timeout
         )
         self.stats.forwarded += 1
+        self.flight.record(FRE_FLEET_FWD, shard=p.shard, batch=bhash)
         self._forward(p.client_id, p)
 
     def _forward(self, client_id: uuid.UUID, payload) -> None:
@@ -587,6 +640,10 @@ class FleetGateway:
             self._send(p, NodeId(p.client_id))
             return
         shard, _deadline = entry
+        self.flight.record(
+            FRE_FLEET_RESULT, shard=shard, arg=int(p.status),
+            batch=fr_hash(batch_id_for(p.client_id, p.seq)),
+        )
         if p.status == ResultStatus.RETRY:
             # upstream shed it: nothing committed, nothing to cache
             self.sessions.abort(p.client_id, p.seq)
@@ -640,10 +697,16 @@ class FleetGateway:
                 status=status, payload=tuple(payload),
             )
         ])
+        bhash = fr_hash(batch_id_for(client_id, seq))
         for mem in self.ring.successors(shard, rf):
             if mem.name == self.config.name:
                 continue
             self.stats.ledger_sent += 1
+            digits = "".join(c for c in mem.name if c.isdigit())
+            self.flight.record(
+                FRE_FLEET_LEDGER_SEND, shard=shard,
+                peer=int(digits) if digits else 0, batch=bhash,
+            )
             self._admin_nonce += 1
             self._send(
                 AdminRequest(
@@ -665,6 +728,11 @@ class FleetGateway:
             if decision in (SUBMIT_FRESH, SUBMIT_DUP_INFLIGHT):
                 applied += 1
                 self.stats.ledger_applied += 1
+                self.flight.record(
+                    FRE_FLEET_LEDGER_APPLY, shard=rec.shard,
+                    arg=int(rec.status) & 0xFF,
+                    batch=fr_hash(batch_id_for(rec.client_id, rec.seq)),
+                )
                 self._session_shard.setdefault(rec.client_id, rec.shard)
                 self._answer_if_waiting(rec.client_id, rec.seq)
         return applied
@@ -729,6 +797,50 @@ class FleetGateway:
         if kind == AdminKind.LEDGER:
             applied = self._apply_ledger(bytes(p.query))
             return 0, json.dumps({"applied": applied}).encode()
+        if kind == AdminKind.JOURNAL:
+            jkind, last = None, 64
+            if p.query:
+                try:
+                    q = json.loads(p.query)
+                    jkind = q.get("kind")
+                    last = max(0, int(q.get("last", 64)))
+                except (ValueError, TypeError, AttributeError):
+                    return 1, b"malformed journal query"
+            return 0, json.dumps(
+                {"anomalies": self.journal.snapshot(limit=last, kind=jkind)}
+            ).encode()
+        if kind == AdminKind.TRACE:
+            # the fleet hop of a cross-tier trace: same TraceSlice
+            # schema the replica gateways serve, selected by the same
+            # deterministic batch hash, marked tier="fleet" so the
+            # merged timeline renders the hop under the gateway's name
+            try:
+                q = json.loads(p.query) if p.query else {}
+                if "batch" in q:
+                    bid = uuid.UUID(hex=q["batch"])
+                else:
+                    bid = batch_id_for(
+                        uuid.UUID(hex=q["client"]), int(q["seq"])
+                    )
+            except (ValueError, TypeError, KeyError):
+                return 1, b"malformed trace query"
+            doc = build_fleet_trace_slice(
+                self.flight, self.config.name, self._row, fr_hash(bid)
+            )
+            doc["batch_id"] = bid.hex
+            return 0, json.dumps(doc).encode()
+        if kind == AdminKind.TIMELINE:
+            if self._telemetry is None:
+                return 1, b"telemetry sampler disabled"
+            last = None
+            if p.query:
+                try:
+                    last = json.loads(p.query).get("last")
+                    if last is not None:
+                        last = int(last)
+                except (ValueError, TypeError, AttributeError):
+                    return 1, b"malformed timeline query"
+            return 0, json.dumps(self._telemetry.document(last)).encode()
         return 1, b"unsupported admin kind for fleet gateway"
 
     def _ring_doc(self) -> dict:
@@ -753,9 +865,14 @@ class FleetGateway:
             "owned_shards": self.ring.owned_shards(
                 self.config.name, self.config.n_shards
             ),
+            # the replica-cluster endpoints this gateway proxies to —
+            # the fleet aggregator walks these to scrape the replica
+            # tier without out-of-band configuration
+            "upstreams": [[h, p] for h, p in self.config.upstreams],
             "sessions": len(self.sessions),
             "pending_forwards": len(self._pending),
             "waiting": len(self._waiting),
+            "anomalies": self.journal.counts(),
             "stats": {
                 "submits": s.submits,
                 "forwarded": s.forwarded,

@@ -319,6 +319,10 @@ def devkv_read_handler(engine) -> ReadHandler:
     return read
 
 
+_COAL_SHARD_FIELDS = ("waves", "covered", "solo", "scalar", "results_ok")
+_COAL_SHARD_ZERO = {f: 0 for f in _COAL_SHARD_FIELDS}
+
+
 class _CoalesceWindow:
     """One shard's open coalescing window: parked FRESH submits (their
     session reservations held), running op/byte totals, and the armed
@@ -427,6 +431,15 @@ class GatewayServer:
             "bypass": 0,     # eligible lane on, submit not packable
             "sparse": 0,     # density gate: parking would not batch
         }
+        # per-SHARD coalescing/commit counters (fleet observability):
+        # the fleet aggregator groups these by ring shard ownership to
+        # attribute coalesce density and slots/op to the fleet gateway
+        # that concentrated the traffic. "waves"+"scalar" is the
+        # slots-proposed proxy for the shard (each wave and each
+        # per-submit drive proposes exactly one consensus entry);
+        # "covered" counts submits riding waves, "results_ok" the OK
+        # results fanned out.
+        self.coal_shard_stats: dict[int, dict[str, int]] = {}
         # serialization ns credited inside the current gateway stage
         # bracket (carved out so the two stages never double-count)
         self._ser_carve = 0
@@ -544,6 +557,23 @@ class GatewayServer:
             "Submits per coalescing-window flush (1 = solo)",
             buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256],
         )
+        # per-shard coalescing/commit counters, one series per (shard,
+        # field) — the fleet aggregator's per-gateway attribution input
+        # (see coal_shard_stats above). Registered for every shard up
+        # front so scrapes see zeros, not absences, before traffic.
+        for s in range(self.engine.n_shards):
+            for fld in _COAL_SHARD_FIELDS:
+                m.counter(
+                    "coalesce_shard_total",
+                    "Per-shard coalescing-lane counters "
+                    "(waves=multi-client flushes, covered=submits "
+                    "riding waves, solo=windows of one, scalar="
+                    "per-submit proposals, results_ok=OK results)",
+                    {"shard": str(s), "field": fld},
+                    fn=lambda s=s, f=fld: self.coal_shard_stats.get(
+                        s, _COAL_SHARD_ZERO
+                    )[f],
+                )
 
     # -- observability surface ----------------------------------------------
 
@@ -1268,17 +1298,27 @@ class GatewayServer:
             w.timer = None
         entries = w.entries
         self._h_coal.observe(len(entries))
+        cs = self._coal_shard(shard)
         if len(entries) == 1:
             # window of one: the classic lane is strictly cheaper (and
             # keeps the zero-handoff per-submit wave path hot)
             self.coalesce_outcomes["solo"] += 1
+            cs["solo"] += 1
             sender, p, t0 = entries[0]
             self._spawn(self._drive_submit(sender, p, t0))
             return
         self.coalesce_outcomes["coalesced"] += len(entries)
         self.stats.submits_coalesced += len(entries)
         self.stats.coalesce_waves += 1
+        cs["waves"] += 1
+        cs["covered"] += len(entries)
         self._spawn(self._drive_coalesced(shard, entries))
+
+    def _coal_shard(self, shard: int) -> dict:
+        cs = self.coal_shard_stats.get(shard)
+        if cs is None:
+            cs = self.coal_shard_stats[shard] = dict(_COAL_SHARD_ZERO)
+        return cs
 
     def _coal_abort_all(self, notify: bool = True) -> None:
         """Tear down every open window (gateway close): release the
@@ -1387,6 +1427,8 @@ class GatewayServer:
                 )
         tc = pcns()
         self._ser_carve = 0
+        if status == ResultStatus.OK:
+            self._coal_shard(shard)["results_ok"] += len(entries)
         sv = self.engine.rt.state_version
         now = time.perf_counter()
         for (sender, p, t0), (lo, hi) in zip(entries, ranges):
@@ -1412,6 +1454,12 @@ class GatewayServer:
     ) -> None:
         pcns = time.perf_counter_ns
         tb = pcns()
+        # per-shard slots proxy: one per-submit drive = one proposal
+        # attempt (engine-reject sheds inflate this by the shed count —
+        # zero on a healthy run, and the aggregator's tolerance absorbs
+        # fault-window noise)
+        cs = self._coal_shard(p.shard)
+        cs["scalar"] += 1
         blk = self._wave_block(p)
         if blk is None:
             batch = self._deterministic_batch(p)
@@ -1488,6 +1536,8 @@ class GatewayServer:
         # dedup path answers from, with no per-part Python bytes kept
         tc = pcns()
         self._ser_carve = 0
+        if status == ResultStatus.OK:
+            cs["results_ok"] += 1
         self.sessions.complete_op(
             p.client_id, p.seq, int(status), payload,
             self.engine.rt.state_version,

@@ -19,6 +19,11 @@ One pull-based surface per replica process component:
 - :class:`TelemetrySampler` — per-replica bounded ring of 1 Hz registry
   snapshots, served over the admin surface and joined across replicas
   into one clock-aligned time series (``python -m rabia_tpu timeline``).
+- :mod:`rabia_tpu.obs.fleet_obs` — the fleet plane (round 18): a
+  ring-discovered :class:`FleetAggregator` scraping both tiers into one
+  derived per-gateway series, :func:`collect_fleet_trace` for cross-tier
+  ``(client_id, seq)`` timelines, and the :class:`BurnRateWatchdog`
+  fast/slow SLO evaluator (``python -m rabia_tpu fleet-top``).
 
 The metric name taxonomy is documented in docs/OBSERVABILITY.md.
 """
@@ -54,13 +59,24 @@ from rabia_tpu.obs.telemetry import (
     merge_timelines,
     render_timeline_table,
 )
+from rabia_tpu.obs.fleet_obs import (
+    BurnRateWatchdog,
+    FleetAggregator,
+    SLOPolicy,
+    collect_fleet_trace,
+    derive_fleet_sample,
+    derive_gateway_figures,
+    discover_fleet,
+)
 
 __all__ = [
     "AdminHTTPServer",
     "AnomalyJournal",
+    "BurnRateWatchdog",
     "Counter",
     "FR_DTYPE",
     "FR_KIND_NAMES",
+    "FleetAggregator",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -68,13 +84,18 @@ __all__ = [
     "MetricsRegistry",
     "RUNTIME_STAGES",
     "SLO_BUCKETS",
+    "SLOPolicy",
     "SLO_STAGES",
     "TF_DTYPE",
     "TelemetrySampler",
     "batch_id_for",
     "build_trace_slice",
+    "collect_fleet_trace",
     "collect_timeline",
     "collect_trace",
+    "derive_fleet_sample",
+    "derive_gateway_figures",
+    "discover_fleet",
     "fr_hash",
     "merge_slices",
     "merge_timelines",

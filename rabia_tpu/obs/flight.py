@@ -69,6 +69,19 @@ FRE_RT_HANDOFF = 20  # runtime -> Python mailbox handoff (arg = ev type)
 FRE_WAL = 21  # durability-plane lifecycle (arg: 1 recovery, 2 checkpoint,
 #               3 wal GC; slot carries the event's record/segment count)
 
+# Fleet-tier kinds (Python-only — the fleet gateway has no native ring;
+# abi_lint treats FRE_ additions without a C mirror as legal). They carry
+# the same batch hash as the replica-tier lifecycle kinds, so one
+# (client_id, seq) trace joins across tiers with no new wire fields.
+FRE_FLEET_RECV = 22  # fleet gateway accepted a Submit (shard = routed shard)
+FRE_FLEET_MOVED = 23  # ownership miss -> MOVED redirect (arg: 1 shard-map,
+#                       2 draining; peer = owning gateway index if known)
+FRE_FLEET_FWD = 24  # Submit proxied upstream to a replica gateway
+FRE_FLEET_RESULT = 25  # upstream Result relayed to the client (arg = status)
+FRE_FLEET_LEDGER_SEND = 26  # dedup-ledger entry replicated to a ring
+#                             successor (peer = successor gateway index)
+FRE_FLEET_LEDGER_APPLY = 27  # replicated ledger entry applied locally
+
 FR_KIND_NAMES = {
     FRE_FRAME_IN: "frame_in",
     FRE_ROUTE1: "route1",
@@ -91,6 +104,12 @@ FR_KIND_NAMES = {
     FRE_RT_WAKE: "rt_wake",
     FRE_RT_HANDOFF: "rt_handoff",
     FRE_WAL: "wal",
+    FRE_FLEET_RECV: "fleet_recv",
+    FRE_FLEET_MOVED: "fleet_moved",
+    FRE_FLEET_FWD: "fleet_fwd",
+    FRE_FLEET_RESULT: "fleet_result",
+    FRE_FLEET_LEDGER_SEND: "fleet_ledger_send",
+    FRE_FLEET_LEDGER_APPLY: "fleet_ledger_apply",
 }
 
 NO_PEER = 0xFFFF
@@ -295,6 +314,35 @@ def build_trace_slice(
     }
 
 
+def build_fleet_trace_slice(
+    recorder: "FlightRecorder",
+    node: str,
+    row: int,
+    batch_hash: int,
+) -> dict:
+    """A fleet gateway's TraceSlice for a batch — same document schema as
+    :func:`build_trace_slice` (so :func:`align_slice` / :func:`merge_slices`
+    work unchanged) with ``tier: "fleet"`` marking the routing hop. The
+    fleet tier has no consensus slots, so selection is batch-hash only;
+    ``row`` is the fleet gateway's index in its own tier (rendered as the
+    gateway name, never confused with replica rows)."""
+    events = [
+        e for e in recorder.snapshot()
+        if batch_hash and e.get("batch") == batch_hash
+    ]
+    return {
+        "version": 1,
+        "tier": "fleet",
+        "node": node,
+        "row": int(row),
+        "rows": {},
+        "wall": time.time(),
+        "mono_ns": time.monotonic_ns(),
+        "batch_hash": int(batch_hash),
+        "events": events,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Clock alignment + merging (collector side)
 # ---------------------------------------------------------------------------
@@ -329,6 +377,7 @@ def merge_slices(slices: Sequence[dict]) -> list[dict]:
             entry["node"] = sl["node"]
             entry["row"] = sl["row"]
             entry["err_s"] = sl["err_s"]
+            entry["tier"] = sl.get("tier", "replica")
             merged.append(entry)
     merged.sort(key=lambda e: (e["t"], e["row"], e["t_ns"]))
     return merged
@@ -399,7 +448,20 @@ _STAGE_LABELS = {
     "tf_in": "wire in",
     "tf_out": "wire out",
     "drop": "DROP",
+    "fleet_recv": "fleet recv",
+    "fleet_moved": "MOVED redirect",
+    "fleet_fwd": "fleet forward",
+    "fleet_result": "fleet result",
+    "fleet_ledger_send": "ledger send",
+    "fleet_ledger_apply": "ledger apply",
 }
+
+_FLEET_KINDS = frozenset(
+    {
+        "fleet_recv", "fleet_moved", "fleet_fwd", "fleet_result",
+        "fleet_ledger_send", "fleet_ledger_apply",
+    }
+)
 
 _WIRE_KIND = {2: "R1", 3: "R2", 4: "Decision"}
 
@@ -414,8 +476,13 @@ def _describe(e: dict) -> str:
         bits.append(f"v={e['arg']}")
     if kind in _SLOT_SCOPED:
         bits.append(f"shard {e['shard']} slot {e['slot']}")
+    elif kind in _FLEET_KINDS:
+        bits.append(f"shard {e['shard']}")
     if e.get("peer", NO_PEER) != NO_PEER:
-        bits.append(f"from row {e['peer']}")
+        if kind in _FLEET_KINDS:
+            bits.append(f"peer gw {e['peer']}")
+        else:
+            bits.append(f"from row {e['peer']}")
     if e.get("len"):
         bits.append(f"{e['len']}B")
     return " ".join(bits)
@@ -429,13 +496,17 @@ def render_timeline(merged: Sequence[dict]) -> str:
     t0 = merged[0]["t"]
     lines = [
         f"{len(merged)} events across "
-        f"{len({e['node'] for e in merged})} replicas; "
+        f"{len({e['node'] for e in merged})} nodes; "
         f"clock-alignment error bound ±"
         f"{max(e['err_s'] for e in merged) * 1e3:.2f} ms"
     ]
     for e in merged:
+        who = (
+            f"gw {e['node']}" if e.get("tier") == "fleet"
+            else f"row{e['row']}"
+        )
         lines.append(
-            f"  +{(e['t'] - t0) * 1e3:9.3f} ms  row{e['row']}  "
+            f"  +{(e['t'] - t0) * 1e3:9.3f} ms  {who}  "
             f"{_describe(e)}"
         )
     return "\n".join(lines)

@@ -37,6 +37,13 @@ class AnomalyJournal:
     QUORUM_LOST = "quorum_lost"
     QUORUM_RESTORED = "quorum_restored"
     WAL_WEDGED = "wal_wedged"  # durability-plane append/fsync failure
+    # fleet-plane watchdog kinds (obs/fleet_obs.py BurnRateWatchdog) —
+    # deliberately NOT in SEVERE: they describe budget pressure, not a
+    # condition whose cause is sliding out of the flight rings
+    SLO_BURN = "slo_burn"  # fast+slow burn-rate windows both over budget
+    COALESCE_DENSITY_DROP = "coalesce_density_drop"  # results/wave collapsed
+    READ_LANE_DEMOTED = "read_lane_demoted"  # off-consensus read fraction sank
+    RING_STALE = "ring_stale"  # a ring member stopped answering scrapes
 
     # kinds severe enough to trigger a flight-recorder dump: each names a
     # condition whose cause is already sliding out of the event rings by
