@@ -551,6 +551,19 @@ async def run_point(
             derived["results_per_barrier_wait"] = round(
                 cluster_counters.get("barrier_covered", 0) / waits, 2
             )
+        decisions = cluster_counters.get("phase_decisions", 0)
+        if decisions > 0:
+            derived["phases_per_decide"] = round(
+                cluster_counters.get("phase_sum", 0) / decisions, 3
+            )
+            derived["coin_flips_per_decide"] = round(
+                (
+                    cluster_counters.get("coin_v0", 0)
+                    + cluster_counters.get("coin_v1", 0)
+                )
+                / decisions,
+                4,
+            )
 
     # read-lane join: the per-point evidence the device-plane read tier
     # is scored by — what fraction of GETs consumed ZERO consensus
@@ -688,6 +701,87 @@ def record_results(report: dict, key: str = "loadgen_slo") -> None:
 # ---------------------------------------------------------------------------
 
 
+def _critpath_column(cluster, fleet_harness=None):
+    """Decompose the cluster gateways' slowlog exemplars in-process
+    (zero alignment error: same clock domain) into the per-point
+    ``critpath`` segment-breakdown column.
+
+    Sampled right after the point's measure window, so the reservoir
+    (current + previous rotation window) holds the point's tail — the
+    exemplars ARE the p99.9 stragglers the latency columns report."""
+    from rabia_tpu.obs.critpath import (
+        CritpathAggregator,
+        decompose_exemplars,
+        dominant_segment,
+        inprocess_exemplar_timeline,
+    )
+
+    exemplars = []
+    for g in cluster.gateways:
+        if g is None or getattr(g, "slowlog", None) is None:
+            continue
+        exemplars.extend(g.slowlog.document().get("exemplars", []))
+    if not exemplars:
+        return None
+    exemplars.sort(key=lambda e: -float(e.get("wall_s", 0.0)))
+    engines = [e for e in cluster.engines if e is not None]
+    fleet_recorders = []
+    if fleet_harness is not None:
+        for gw in fleet_harness.gateways:
+            if gw is not None:
+                fleet_recorders.append(
+                    (gw.flight, gw.config.name, gw._row)
+                )
+    agg = CritpathAggregator()
+    decomps = decompose_exemplars(
+        exemplars,
+        lambda ex: inprocess_exemplar_timeline(
+            engines, ex, fleet_recorders=fleet_recorders
+        ),
+        aggregator=agg,
+    )
+    s = agg.summary()
+    # "worst" means the worst FRESH exemplar — the same rule the
+    # aggregates follow: a trace the ring wrapped past cannot be
+    # decomposed honestly, so it is counted (truncated) but never
+    # elected as the column's representative straggler
+    fresh = [
+        d for d in decomps if d.get("ok") and not d.get("truncated")
+    ]
+    worst = max(fresh, key=lambda d: d["total_s"]) if fresh else None
+    return {
+        "exemplars": s["exemplars"],
+        "truncated": s["truncated"],
+        "unanchored": s["unanchored"],
+        "segments_ms": {
+            k: round(v * 1e3, 3) for k, v in s["segments"].items()
+        },
+        "dominant": (
+            dominant_segment(worst) if worst is not None else None
+        ),
+        "worst_wall_ms": (
+            round(
+                float(
+                    worst["exemplar"].get("wall_s")
+                    or worst["total_s"]
+                ) * 1e3, 3,
+            )
+            if worst is not None
+            else None
+        ),
+        "worst_unattributed_frac": (
+            round(worst["unattributed_frac"], 4)
+            if worst is not None
+            else None
+        ),
+        "phases_to_decide": [
+            d["phases_to_decide"]
+            for d in decomps
+            if d.get("phases_to_decide")
+        ],
+    }
+
+
 async def _in_process_timeline(cluster) -> list[dict]:
     """Merge the in-process cluster's telemetry rings (same clock
     domain: exact alignment, zero error bound)."""
@@ -810,6 +904,28 @@ async def run(args) -> dict:
                 out["replicas"] += 1
                 out["decided_v1"] += int(e.rt.decided_v1)
                 out["decided_v0"] += int(e.rt.decided_v0)
+                # termination-analysis deltas: phases-to-decide mass +
+                # common-coin outcomes (the per-point twin of the chaos
+                # runner's collect_evidence aggregate)
+                try:
+                    _, cnt, s = e.metrics.histogram(
+                        "phases_to_decide"
+                    ).merged()
+                    out["phase_decisions"] = (
+                        out.get("phase_decisions", 0) + int(cnt)
+                    )
+                    out["phase_sum"] = (
+                        out.get("phase_sum", 0) + int(s)
+                    )
+                    for k in ("v0", "v1"):
+                        out["coin_" + k] = out.get("coin_" + k, 0) + int(
+                            e.metrics.counter(
+                                "coin_flips_total",
+                                labels={"outcome": k},
+                            ).value()
+                        )
+                except Exception:
+                    pass
                 wal = getattr(e, "_wal", None)
                 if wal is not None:
                     ctrs = wal.counters_dict()
@@ -912,6 +1028,16 @@ async def run(args) -> dict:
                 fleet_fn=fleet_fn,
                 coal_shard_fn=coal_shard_fn,
             )
+            if cluster is not None:
+                # slow-exemplar breakdown for THIS point's tail — the
+                # decomposer's in-process lane (docs/OBSERVABILITY.md,
+                # "Critical path")
+                try:
+                    pt["critpath"] = _critpath_column(
+                        cluster, fleet_harness
+                    )
+                except Exception as exc:  # noqa: BLE001 — diagnostic col
+                    pt["critpath"] = {"error": f"{type(exc).__name__}: {exc}"}
             points.append(pt)
             print(json.dumps(pt), file=sys.stderr)
         timeline_rows = None

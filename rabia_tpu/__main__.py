@@ -216,6 +216,101 @@ def _trace(addrs: list[str], client: str, seq: int, timeout: float) -> int:
     return 0
 
 
+def _slowlog(
+    addr: str,
+    replicas: list[str],
+    fleet: list[str],
+    last,
+    as_json: bool,
+    timeout: float,
+) -> int:
+    """Fetch a gateway's slow-Submit exemplar reservoir
+    (AdminKind.SLOWLOG), decompose each exemplar's cross-tier flight
+    trace into named critical-path segments, and print the table plus
+    the worst exemplar's waterfall. See docs/OBSERVABILITY.md,
+    "Critical path"."""
+    import asyncio
+    import json
+
+    from rabia_tpu.obs.critpath import (
+        collect_exemplar_trace,
+        collect_slowlog,
+        decompose,
+        render_slowlog,
+    )
+
+    p0 = _parse_addr(addr)
+    if p0 is None:
+        print(f"slowlog: bad address {addr!r} (want host:port)",
+              file=sys.stderr)
+        return 2
+    rep_addrs = []
+    for a in replicas or [addr]:
+        p = _parse_addr(a)
+        if p is None:
+            print(f"slowlog: bad replica address {a!r}", file=sys.stderr)
+            return 2
+        rep_addrs.append(p)
+    fleet_addrs = []
+    for a in fleet or []:
+        p = _parse_addr(a)
+        if p is None:
+            print(f"slowlog: bad fleet address {a!r}", file=sys.stderr)
+            return 2
+        fleet_addrs.append(p)
+
+    async def run():
+        doc = await collect_slowlog(
+            p0[0], p0[1], last=last, timeout=timeout
+        )
+
+        async def timeline_async(ex):
+            return await collect_exemplar_trace(
+                rep_addrs, ex, fleet_addrs=fleet_addrs, timeout=timeout
+            )
+
+        # decompose_exemplars takes a sync collector; each trace fetch
+        # is itself sequential, so drive them one by one here
+        decomps = []
+        for ex in doc.get("exemplars", []):
+            try:
+                merged = await timeline_async(ex)
+            except Exception as exc:  # noqa: BLE001 — keep the table
+                decomps.append(
+                    {
+                        "ok": False,
+                        "error": f"{type(exc).__name__}: {exc}",
+                        "truncated": False,
+                        "segments": {},
+                        "total_s": 0.0,
+                        "unattributed_s": 0.0,
+                        "unattributed_frac": 0.0,
+                        "exemplar": dict(ex),
+                    }
+                )
+                continue
+            d = decompose(
+                merged,
+                coalesced=ex.get("coalesced"),
+                wall_s=ex.get("wall_s"),
+            )
+            d["exemplar"] = dict(ex)
+            decomps.append(d)
+        return doc, decomps
+
+    try:
+        doc, decomps = asyncio.run(run())
+    except Exception as e:
+        print(f"slowlog: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps({"slowlog": doc, "decompositions": decomps},
+                         indent=2, default=str))
+    else:
+        print(render_slowlog(doc, decomps))
+    return 0
+
+
 def _profile(addr: str, seconds: float, timeout: float) -> int:
     """Two /metrics scrapes ``seconds`` apart -> the commit-path owner's
     per-stage time breakdown (rabia_runtime_stage_seconds deltas), with
@@ -658,6 +753,31 @@ def main(argv=None) -> int:
         "--seq", type=int, required=True, help="client command seq"
     )
     tp.add_argument("--timeout", type=float, default=10.0)
+    sl = sub.add_parser(
+        "slowlog",
+        help="decompose a gateway's slowest Submit exemplars into "
+        "critical-path segments (queue, park, per-phase consensus, "
+        "fsync, fanout)",
+    )
+    sl.add_argument("addr", help="replica gateway host:port (slowlog source)")
+    sl.add_argument(
+        "--replicas", action="append", default=None,
+        help="replica gateway host:port to trace against (repeatable; "
+        "default: the slowlog addr only)",
+    )
+    sl.add_argument(
+        "--fleet", action="append", default=None,
+        help="fleet gateway host:port to include in traces (repeatable)",
+    )
+    sl.add_argument(
+        "--last", type=int, default=None,
+        help="only the N slowest exemplars",
+    )
+    sl.add_argument(
+        "--json", action="store_true",
+        help="print the reservoir + decompositions as JSON",
+    )
+    sl.add_argument("--timeout", type=float, default=10.0)
     pp = sub.add_parser(
         "profile",
         help="two-scrape runtime stage breakdown (where a commit-path "
@@ -762,6 +882,11 @@ def main(argv=None) -> int:
         )
     if args.cmd == "trace":
         return _trace(args.addrs, args.client, args.seq, args.timeout)
+    if args.cmd == "slowlog":
+        return _slowlog(
+            args.addr, args.replicas, args.fleet, args.last, args.json,
+            args.timeout,
+        )
     if args.cmd == "profile":
         return _profile(args.addr, args.seconds, args.timeout)
     if args.cmd == "timeline":

@@ -108,6 +108,15 @@ class ChaosProfile:
     # first fault event it must stay quiet (the healthy control). Empty
     # tuple = watchdog runs but is not asserted on.
     expect_watchdog: tuple = ()
+    # Critical-path attribution gate (obs/critpath.py, round 19):
+    # segment names whose combined slow-exemplar milliseconds must burn
+    # far above their healthy-control band during the fault window and
+    # return inside it after the faults clear (the burn-rate watchdog
+    # pattern applied to attribution — on a durable profile
+    # fsync_barrier dominates the HEALTHY tail too, so the signature is
+    # magnitude, not first appearance). Empty tuple = no exemplar
+    # sampling (the decomposition scan is not free).
+    expect_critpath: tuple = ()
 
     def scaled(self, factor: float) -> "ChaosProfile":
         """Time-scaled copy (the CI smoke cell runs factor < 1)."""
@@ -322,11 +331,30 @@ def default_profiles() -> dict[str, ChaosProfile]:
                 ("coalesce", True),
                 ("coalesce_window", 0.02),
                 ("coalesce_window_min", 0.02),
+                # fast slowlog rotation so each attribution sample sees
+                # only the last ~2s of exemplars (current + previous
+                # window), not the whole run's tail
+                ("slowlog_window", 1.0),
             ),
             # the proposer restart takes a member out of the watchdog's
             # alive set mid-run: ring_stale must fire in the fault
             # window and nothing may fire before the first event
             expect_watchdog=("ring_stale",),
+            # attribution gate: while the faults are live the slow
+            # tail's time must pile into the stall legs — proposals
+            # waiting for a slot to open while the flapped/restarted
+            # proposer recovers (propose_to_open), the WAL barrier
+            # (fsync_barrier), and coalesce parking (coalesce_park).
+            # WHICH of the three absorbs a given straggler depends on
+            # where its wave was when the fault landed, so the gate
+            # sums the set rather than asserting one label; after the
+            # faults clear the sum must drop back inside the control
+            # band
+            expect_critpath=(
+                "propose_to_open",
+                "fsync_barrier",
+                "coalesce_park",
+            ),
         ),
         # -- device-mesh fabric (round 17: device KV + read-index lane) -
         _p(

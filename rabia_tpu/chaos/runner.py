@@ -448,6 +448,96 @@ class _TcpFabric:
             "covered": covered,
         }
 
+    def critpath_sample(
+        self, pool: int = 8, max_age_s: Optional[float] = None
+    ) -> Optional[dict]:
+        """Decompose the live gateways' slow exemplars in-process (zero
+        alignment error) into one attribution sample: which critical-
+        path segment the tail's wall time sits in RIGHT NOW. The runner
+        records these at the health cadence for profiles with
+        ``expect_critpath`` — the watchdog pattern applied to
+        attribution instead of burn rate.
+
+        Decomposes up to ``pool`` exemplars across the gateways (not
+        just the global slowest few: a multi-second straggler whose
+        ring has wrapped is honestly excluded from the aggregate, and
+        taking only the top walls would leave such samples empty).
+
+        ``max_age_s`` keeps only exemplars whose completion is at most
+        that many seconds old — the recovery sample uses it so fault-era
+        stragglers that legitimately finish (and therefore pin the
+        slowest-first reservoir) cannot mask a healthy post-fault
+        tail."""
+        from rabia_tpu.obs.critpath import (
+            decompose,
+            inprocess_exemplar_timeline,
+        )
+
+        exemplars = []
+        for g in self.cluster.gateways:
+            if g is None or getattr(g, "slowlog", None) is None:
+                continue
+            # age-filtered samples read the FULL reservoir before the
+            # filter: a recovering cluster's last fault-era stragglers
+            # are the slowest entries, and cutting to the per-gateway
+            # top few first would evict the young exemplars the filter
+            # is there to isolate
+            doc = g.slowlog.document(None if max_age_s is not None
+                                     else 4)
+            exemplars.extend(doc.get("exemplars", []))
+        if max_age_s is not None:
+            exemplars = [
+                e for e in exemplars
+                if float(e.get("age_s", 0.0)) <= max_age_s
+            ]
+        if not exemplars:
+            return None
+        exemplars.sort(key=lambda e: -float(e.get("wall_s", 0.0)))
+        exemplars = exemplars[:pool]
+        engines = [e for e in self.cluster.engines if e is not None]
+        seg_tot: dict[str, float] = {}
+        n_ok = n_trunc = n_bad = 0
+        for ex in exemplars:
+            try:
+                merged = inprocess_exemplar_timeline(engines, ex)
+                d = decompose(
+                    merged,
+                    coalesced=ex.get("coalesced"),
+                    wall_s=ex.get("wall_s"),
+                )
+            except Exception:
+                n_bad += 1
+                continue
+            if not d["ok"]:
+                n_bad += 1
+                continue
+            if d["truncated"]:
+                n_trunc += 1
+                continue
+            n_ok += 1
+            for k, v in d["segments"].items():
+                seg_tot[k] = seg_tot.get(k, 0.0) + v
+            seg_tot["unattributed"] = (
+                seg_tot.get("unattributed", 0.0) + d["unattributed_s"]
+            )
+        out = {
+            "exemplars": n_ok,
+            "truncated": n_trunc,
+            "unanchored": n_bad,
+            "worst_ms": round(
+                float(exemplars[0].get("wall_s", 0.0)) * 1e3, 3
+            ),
+        }
+        if n_ok:
+            out["dominant"] = max(
+                seg_tot.items(), key=lambda kv: kv[1]
+            )[0]
+            out["segments_ms"] = {
+                k: round(v / n_ok * 1e3, 3)
+                for k, v in sorted(seg_tot.items())
+            }
+        return out
+
     async def converged(self, timeout: float) -> bool:
         try:
             await self.cluster.wait_converged(timeout)
@@ -968,6 +1058,28 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
         for kind in watchdog.observe(rel_t, sample):
             log(f"t={rel_t:.1f}s watchdog fired {kind}")
 
+    # slow-exemplar attribution samples (profiles with expect_critpath
+    # only — the in-process trace scan is not free at the health
+    # cadence, so nobody else pays for it)
+    critpath_rows: list[dict] = []
+
+    def cp_observe(
+        rel_t: float, max_age_s: Optional[float] = None
+    ) -> None:
+        if not profile.expect_critpath or not hasattr(
+            fabric, "critpath_sample"
+        ):
+            return
+        try:
+            sample = fabric.critpath_sample(max_age_s=max_age_s)
+        except Exception:  # noqa: BLE001 — evidence, never the run
+            return
+        if sample is not None:
+            sample["t"] = round(rel_t, 3)
+            if max_age_s is not None:
+                sample["max_age_s"] = max_age_s
+            critpath_rows.append(sample)
+
     try:
         # warmup: light load so the pipeline is hot before t0
         warm_end = loop.time() + profile.warmup
@@ -1049,6 +1161,7 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
                 # loop-top `now`, and the watchdog windows assume
                 # monotone sample times
                 wd_observe(loop.time() - t0)
+                cp_observe(loop.time() - t0)
                 next_sample = now + window
             if now >= t_end:
                 break
@@ -1085,6 +1198,41 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
         converged = True
         if profile.require_convergence:
             converged = await fabric.converged(timeout=10.0)
+        # recovered-state attribution sample. The slowest-first
+        # reservoir is honest but unforgiving here: fault-era stragglers
+        # complete LATE, so they legitimately top the post-fault windows
+        # and can mask the recovered tail. Drive a short healthy probe
+        # load, then sample only exemplars younger than the probe phase —
+        # the recovered tail, not the funeral of the faulted one.
+        if profile.expect_critpath:
+            # quiesce: gateway-side waves from the fault era complete on
+            # their own schedule (client cancellation does not unwind
+            # them) — let them land BEFORE the probe window opens so the
+            # age filter below can tell the two populations apart
+            await asyncio.sleep(2.0)
+            probe_t0 = loop.time()
+            for j in range(0, 40, 8):
+                burst = [
+                    fabric.submit(
+                        1_000_000 + j + k,
+                        [(f"probe-{j + k}", "v")],
+                        profile.call_timeout,
+                    )
+                    for k in range(8)
+                ]
+                await asyncio.gather(*burst, return_exceptions=True)
+                if j == 16:
+                    # the first bursts absorb post-restart cold-start
+                    # latency (session redial, first slot open); the
+                    # verdict should judge the WARM recovered path, so
+                    # age-scope the sample to the trailing bursts
+                    probe_t0 = loop.time()
+            cp_observe(
+                loop.time() - t0,
+                max_age_s=loop.time() - probe_t0 + 0.5,
+            )
+        else:
+            cp_observe(loop.time() - t0)
         # fabric-specific end-state gates (the fleet fabric's
         # exactly-once replay sweep) — run before teardown
         fabric_problems: list = []
@@ -1163,6 +1311,92 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
                 f"t={first_event_at}s): {sorted(set(early))}"
             )
 
+    # critical-path attribution verdict — the watchdog's burn-rate
+    # pattern applied to attribution: the expected segments' tail
+    # milliseconds must BURN far above their healthy-control band
+    # during the fault window, and return inside it after the faults
+    # clear. (A label-argmax gate would be dishonest here: on a durable
+    # profile fsync_barrier legitimately dominates the HEALTHY tail at
+    # tens of ms — the fault signature is its explosion by an order of
+    # magnitude, not its first appearance.)
+    critpath_doc = None
+    if profile.expect_critpath:
+        first_event_at = min((e.at for e in profile.events), default=0.0)
+        expected = set(profile.expect_critpath)
+
+        def expected_ms(r: dict) -> float:
+            segs = r.get("segments_ms", {})
+            return sum(segs.get(s, 0.0) for s in expected)
+
+        # The reservoir observes COMPLETIONS, so attribution lags the
+        # fault: a wave stalled by the restart finishes (and is
+        # decomposed) well after the last clear event. The burn window
+        # is therefore everything from the first event through the
+        # drain — while recovery is proven ONLY by the age-filtered
+        # probe samples (max_age_s set), which see exclusively
+        # post-quiesce traffic.
+        control = [
+            r for r in critpath_rows
+            if r["t"] < first_event_at and r.get("exemplars")
+            and "max_age_s" not in r
+        ]
+        fault = [
+            r for r in critpath_rows
+            if r["t"] >= first_event_at and r.get("exemplars")
+            and "max_age_s" not in r
+        ]
+        post = [
+            r for r in critpath_rows
+            if "max_age_s" in r and r.get("exemplars")
+        ]
+        c_ms = max((expected_ms(r) for r in control), default=0.0)
+        f_ms = max((expected_ms(r) for r in fault), default=0.0)
+        r_ms = min((expected_ms(r) for r in post), default=None)
+        # shift threshold: well clear of the control band (3x) with an
+        # absolute floor so a near-zero control cannot make scheduler
+        # noise look like a fault signature
+        threshold = max(3.0 * c_ms, c_ms + 250.0)
+        critpath_doc = {
+            "expected": sorted(expected),
+            "samples": len(critpath_rows),
+            "control_ms": round(c_ms, 3),
+            "fault_ms": round(f_ms, 3),
+            "recovered_ms": (
+                round(r_ms, 3) if r_ms is not None else None
+            ),
+            "threshold_ms": round(threshold, 3),
+            "control_dominants": sorted(
+                {r["dominant"] for r in control}
+            ),
+            "fault_dominants": sorted({r["dominant"] for r in fault}),
+            "post_dominants": sorted({r["dominant"] for r in post}),
+            "rows": critpath_rows,
+        }
+        if not control:
+            problems.append(
+                "critpath: no decomposable healthy-control sample "
+                f"before t={first_event_at}s"
+            )
+        if f_ms < threshold:
+            problems.append(
+                f"critpath: {sorted(expected)} never burned above the "
+                f"control band between the first event and the drain "
+                f"(fault {f_ms:.0f}ms < threshold {threshold:.0f}ms, "
+                f"control {c_ms:.0f}ms)"
+            )
+        if r_ms is None:
+            problems.append(
+                "critpath: no decomposable recovery-probe sample "
+                "(recovery unproven)"
+            )
+        elif r_ms >= threshold:
+            problems.append(
+                f"critpath: {sorted(expected)} did not return to the "
+                f"control band on the post-quiesce probe load "
+                f"(best probe {r_ms:.0f}ms >= threshold "
+                f"{threshold:.0f}ms)"
+            )
+
     report = {
         "profile": profile.name,
         "fabric": profile.fabric,
@@ -1189,6 +1423,7 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
         "timeline": timeline,
         "health": health_rows,
         "watchdog": verdict,
+        "critpath": critpath_doc,
         "converged": converged,
         "pass": not problems,
         "problems": problems,

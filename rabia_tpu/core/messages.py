@@ -448,6 +448,13 @@ class AdminKind(enum.IntEnum):
     RING = 5
     HANDOFF = 6
     LEDGER = 7
+    # tail-exemplar slowlog (obs/critpath.py): the replica gateway's
+    # reservoir of the slowest fresh-Submit completions per rotation
+    # window (batch id + wall time + outcome), so p99 capture needs no
+    # operator foreknowledge of batch ids. Query {"last": N} bounds the
+    # reply; the body carries the exemplar documents plus serve-time
+    # (wall, mono_ns) for clock alignment (`python -m rabia_tpu slowlog`)
+    SLOWLOG = 8
 
 
 @dataclass(frozen=True)

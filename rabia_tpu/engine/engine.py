@@ -730,6 +730,54 @@ class RabiaEngine:
             fn=phase_curve,
         )
 
+        # -- per-phase consensus dwell (the critical-path decomposer's
+        #    consensus segments, obs/critpath.py): how long each phase
+        #    ordinal took in wall time, not just how many phases ran.
+        #    Native source: the rk ctx's RK_DWELL histogram block
+        #    (hostkernel.cpp — the GIL-free runtime shares the ctx, so
+        #    both native planes land there); Python twin: _py_dwell, fed
+        #    by the engine's open/outbox processing on the RABIA_PY_TICK
+        #    host path and the jax device path. Identical geometry (the
+        #    SLO buckets), same metric name either way — the critpath
+        #    name-parity test pins this.
+        from rabia_tpu.obs.registry import (
+            SLO_BUCKETS as _SLO_B,
+            SLO_MIN_EXP,
+            SLO_SUB_BITS,
+        )
+
+        n_slo = len(_SLO_B)
+        self._py_dwell = np.zeros((8, n_slo + 2), np.uint64)
+        self._dwell_t0 = np.zeros(self.S, np.int64)
+        self._dwell_t0_slot = np.full(self.S, -1, np.int64)
+
+        def dwell_row(row):
+            agg = np.zeros(n_slo + 2, np.int64)
+            rk = self._rk
+            if rk is not None and rk.dwell_geometry == (
+                n_slo, SLO_SUB_BITS, SLO_MIN_EXP
+            ):
+                for src in (rk, *getattr(rk, "siblings", ())):
+                    if row < len(src.dwell):
+                        agg += src.dwell[row].astype(np.int64)
+            agg += self._py_dwell[row].astype(np.int64)
+            return (
+                [int(v) for v in agg[:n_slo]],
+                int(agg[n_slo]),
+                float(agg[n_slo + 1]) * 1e-9,
+            )
+
+        for pi in range(8):
+            m.histogram(
+                "consensus_phase_dwell_seconds",
+                "Wall time each weak-MVC phase ordinal dwelt before its "
+                "advance (top row clamps 8+; native RK_DWELL block + "
+                "Python tick twin, SLO bucket geometry)",
+                {"phase": str(pi + 1) if pi < 7 else "8+"},
+                buckets=_SLO_B,
+                fn=lambda r=pi: dwell_row(r),
+            )
+
         def coin_ctr(i):
             kern = getattr(self, "kernel", None)
             cf = getattr(kern, "coin_flips", None)
@@ -1107,6 +1155,46 @@ class RabiaEngine:
                 pass
         evs.sort(key=lambda e: e["t_ns"])
         return evs
+
+    def flight_ring_state(self) -> list[dict]:
+        """Head/wrap state for the rings :meth:`flight_events` merges
+        (minus the transport frame ring, which keeps no total-written
+        counter): the trace wrap-honesty stamps. A ring whose ``head``
+        exceeds its retained window has evicted records, and any trace
+        sliced from it may be silently partial — build_trace_slice
+        compares ``oldest_t_ns`` against the batch's earliest event
+        (obs/flight.slice_truncated) and marks the slice ``truncated``."""
+        rings = [dict(self.flight.state(), ring="python")]
+
+        def native_state(obj, name: str) -> None:
+            try:
+                head = int(obj.flight_head())
+                snap = obj.flight_snapshot()
+            except Exception:  # a closed plane must not kill a trace
+                return
+            retained = len(snap)
+            rings.append(
+                {
+                    "ring": name,
+                    "head": head,
+                    "cap": retained,  # the retained-window size
+                    "wrapped": head > retained,
+                    "oldest_t_ns": (
+                        int(snap[0]["t_ns"]) if retained else None
+                    ),
+                }
+            )
+
+        if self._rk is not None:
+            native_state(self._rk, "rk")
+            for i, sib in enumerate(getattr(self._rk, "siblings", ())):
+                native_state(sib, f"rk_w{i + 1}")
+        if self._rtm is not None:
+            native_state(self._rtm, "rtm")
+        sk_plane = getattr(self.sm, "_native_plane", None)
+        if sk_plane is not None:
+            native_state(sk_plane, "statekernel")
+        return rings
 
     def dump_flight(
         self, path: Optional[str] = None, reason: str = "manual"
@@ -2830,11 +2918,40 @@ class RabiaEngine:
 
     # -- the kernel round ----------------------------------------------------
 
+    def _dwell_observe(self, idx, new_ph) -> None:
+        """Python-twin per-phase dwell observe (host/device tick paths;
+        the native path's twin lives in rk_tick). ``new_ph`` holds each
+        shard's post-advance phase = the 1-based ordinal of the phase
+        that just completed. The slot guard skips shards whose stamp
+        belongs to an earlier slot (armed outside _flight_open)."""
+        from rabia_tpu.obs.registry import slo_bucket_index
+
+        now = time.monotonic_ns()
+        cur = np.asarray(self._cur_slot)
+        for j in range(len(idx)):
+            s = int(idx[j])
+            if int(self._dwell_t0_slot[s]) != int(cur[s]):
+                continue
+            p = int(new_ph[j])
+            if p >= 1:
+                h = self._py_dwell[min(p, 8) - 1]
+                ns = now - int(self._dwell_t0[s])
+                h[slo_bucket_index(ns)] += 1
+                h[-2] += 1
+                h[-1] += ns
+            self._dwell_t0[s] = now
+
     def _flight_open(self, idx, slots_arr, init_arr) -> None:
         """Flight OPEN records for slots armed outside the native tick's
         own open path (host-kernel/jax rounds, and the native round's
         Python-vote pre-arm, where rk_start_slots runs standalone and the
         C ring therefore records nothing)."""
+        if len(idx):
+            # phase-dwell stamp: the armed slots' phase 1 starts now
+            t = time.monotonic_ns()
+            ii = np.asarray(idx, np.int64)
+            self._dwell_t0[ii] = t
+            self._dwell_t0_slot[ii] = np.asarray(slots_arr, np.int64)
         for j in range(len(idx)):
             self.flight.record(
                 FRE_OPEN, shard=int(idx[j]), slot=int(slots_arr[j]),
@@ -3191,6 +3308,12 @@ class RabiaEngine:
         # votes decisive — schedule one follow-up step (see _tick)
         if cast_idx.size or adv_all_idx.size:
             self._restep = True
+        if adv_all_idx.size:
+            # per-phase dwell closes on EVERY advance — deciding shards
+            # (masked out of adv_idx below) still finish their final phase
+            self._dwell_observe(
+                adv_all_idx, np.asarray(outbox.new_phase)[adv_all_idx]
+            )
 
         if cast_idx.size:
             idx = cast_idx
@@ -3316,6 +3439,12 @@ class RabiaEngine:
                 )
             newly_any |= newly_k
             cum_done |= newly_k
+            adv_all_k = ob.advanced[k][:n] & act
+            if adv_all_k.any():
+                i_adv = np.nonzero(adv_all_k)[0]
+                self._dwell_observe(
+                    i_adv, np.asarray(ob.new_phase[k])[i_adv]
+                )
             adv = ob.advanced[k][:n] & act & ~cum_done
             if adv.any():
                 i = np.nonzero(adv)[0]

@@ -174,6 +174,30 @@ class NativeTick:
             self.phase_hist = np.frombuffer(pbuf, np.uint64)
         else:  # stale prebuilt hostkernel
             self.phase_hist = np.zeros(32, np.uint64)
+        # per-phase consensus dwell histograms: zero-copy (phases, stride)
+        # view over the context's C block (RK_DWELL ABI — RTH-style rows
+        # of buckets + count + sum_ns). Geometry tuple lets the exporter
+        # verify the block matches the registry's SLO buckets before
+        # decoding. Same torn-read caveat as phase_hist: metrics-grade.
+        if hasattr(lib, "rk_dwell"):
+            n_dp = int(lib.rk_dwell_phases())
+            n_db = int(lib.rk_dwell_buckets())
+            self.dwell_version = int(lib.rk_dwell_version())
+            self.dwell_geometry = (
+                n_db,
+                int(lib.rk_dwell_sub_bits()),
+                int(lib.rk_dwell_min_exp()),
+            )
+            dbuf = (ctypes.c_uint64 * (n_dp * (n_db + 2))).from_address(
+                lib.rk_dwell(self.ctx)
+            )
+            self.dwell = np.frombuffer(dbuf, np.uint64).reshape(
+                n_dp, n_db + 2
+            )
+        else:  # stale prebuilt hostkernel: dwell reads as zeros
+            self.dwell_version = 0
+            self.dwell_geometry = (100, 2, 10)
+            self.dwell = np.zeros((8, 102), np.uint64)
         # flight recorder: zero-copy structured view over the context's C
         # event ring (hostkernel.cpp FrEvent ABI — obs/flight.FR_DTYPE)
         from rabia_tpu.obs.flight import FR_DTYPE
@@ -255,6 +279,7 @@ class NativeTick:
             # the final state, not freed memory
             self.counters = self.counters.copy()
             self.phase_hist = self.phase_hist.copy()
+            self.dwell = self.dwell.copy()
             self._fr_frozen = self.flight_snapshot()
             ctx, self.ctx = self.ctx, None
             self.lib.rk_ctx_destroy(ctx)

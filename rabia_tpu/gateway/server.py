@@ -71,7 +71,13 @@ from rabia_tpu.gateway.session import (
     SUBMIT_FRESH,
     SUBMIT_SHED_WINDOW,
 )
-from rabia_tpu.obs.flight import FRE_RESULT, batch_id_for, fr_hash
+from rabia_tpu.obs.flight import (
+    FRE_BARRIER,
+    FRE_GW_RECV,
+    FRE_RESULT,
+    batch_id_for,
+    fr_hash,
+)
 
 logger = logging.getLogger("rabia_tpu.gateway")
 
@@ -139,6 +145,90 @@ class GatewayConfig:
     coalesce_max_ops: int = 128
     # bytes budget for a packed entry's command payloads
     coalesce_max_bytes: int = 256 * 1024
+    # -- tail-exemplar slowlog (obs/critpath.py; AdminKind.SLOWLOG):
+    # the gateway keeps a bounded reservoir of the SLOWEST fresh-Submit
+    # completions per rotation window (batch id + wall time + outcome),
+    # so p99 exemplars are capturable with no operator foreknowledge of
+    # batch ids. Serving merges the live and previous windows — a fresh
+    # rotation never empties the reply. 0 exemplars disables capture.
+    slowlog_cap: int = 8
+    slowlog_window: float = 10.0
+
+
+class _SlowlogReservoir:
+    """Per-window bounded reservoir of the slowest completions.
+
+    ``observe`` is the hot call: one comparison against the window's
+    current floor in the common case (a completion faster than every
+    kept exemplar). The reservoir keeps the ``cap`` slowest entries of
+    the live window and rotates on a wall cadence, retaining exactly one
+    previous window so a scrape right after rotation still sees the
+    recent tail. Exemplar documents are JSON-ready plain dicts."""
+
+    __slots__ = (
+        "cap", "window", "_cur", "_prev", "_floor", "_window_start",
+        "observed", "rotations",
+    )
+
+    def __init__(self, cap: int, window: float) -> None:
+        self.cap = cap
+        self.window = window
+        self._cur: list[tuple[float, dict, float]] = []
+        self._prev: list[tuple[float, dict, float]] = []
+        self._floor = 0.0
+        self._window_start = time.monotonic()
+        self.observed = 0
+        self.rotations = 0
+
+    def _rotate_if_due(self, now: float) -> None:
+        if now - self._window_start < self.window:
+            return
+        self._prev = self._cur
+        self._cur = []
+        self._floor = 0.0
+        self._window_start = now
+        self.rotations += 1
+
+    def observe(self, wall_s: float, exemplar: dict) -> None:
+        if self.cap <= 0:
+            return
+        self.observed += 1
+        now = time.monotonic()
+        self._rotate_if_due(now)
+        cur = self._cur
+        if len(cur) >= self.cap:
+            if wall_s <= self._floor:
+                return
+            # evict the fastest kept exemplar (linear over a tiny cap)
+            cur.pop(min(range(len(cur)), key=lambda i: cur[i][0]))
+        cur.append((wall_s, exemplar, now))
+        self._floor = min(w for w, _, _ in cur) if len(cur) >= self.cap \
+            else 0.0
+
+    def document(self, last: Optional[int] = None) -> dict:
+        """The AdminKind.SLOWLOG reply body: live + previous windows,
+        slowest first, with the serve-time clock pair the collector
+        aligns with (the TraceSlice convention)."""
+        now = time.monotonic()
+        self._rotate_if_due(now)
+        ex = sorted(
+            self._cur + self._prev, key=lambda e: -e[0]
+        )
+        if last is not None:
+            ex = ex[: max(0, last)]
+        return {
+            "version": 1,
+            "cap": self.cap,
+            "window_s": self.window,
+            "observed": self.observed,
+            "rotations": self.rotations,
+            "wall": time.time(),
+            "mono_ns": time.monotonic_ns(),
+            "exemplars": [
+                dict(e, wall_s=w, age_s=round(now - at, 3))
+                for w, e, at in ex
+            ],
+        }
 
 
 @dataclass
@@ -443,6 +533,13 @@ class GatewayServer:
         # serialization ns credited inside the current gateway stage
         # bracket (carved out so the two stages never double-count)
         self._ser_carve = 0
+        # tail-exemplar slowlog: the slowest fresh-Submit completions
+        # per rotation window, served over AdminKind.SLOWLOG so the
+        # critpath decomposer (obs/critpath.py) can pick p99 exemplars
+        # without knowing any batch id in advance
+        self.slowlog = _SlowlogReservoir(
+            self.config.slowlog_cap, self.config.slowlog_window
+        )
         self._tasks: set = set()
         self._running = False
         self._run_task = None
@@ -658,6 +755,18 @@ class GatewayServer:
                 except (ValueError, TypeError, AttributeError):
                     return 1, b"malformed timeline query"
             return 0, json.dumps(self._telemetry.document(last)).encode()
+        if kind == AdminKind.SLOWLOG:
+            last = None
+            if query:
+                try:
+                    last = json.loads(query).get("last")
+                    if last is not None:
+                        last = int(last)
+                except (ValueError, TypeError, AttributeError):
+                    return 1, b"malformed slowlog query"
+            doc = self.slowlog.document(last)
+            doc["node"] = str(self.node_id.value)
+            return 0, json.dumps(doc).encode()
         return 1, f"unknown admin kind {kind}".encode()
 
     def _on_admin(self, sender: NodeId, p: AdminRequest) -> None:
@@ -1048,7 +1157,14 @@ class GatewayServer:
             )
             return
         t0 = time.perf_counter()
-        if self.config.coalesce and self._coal_eligible(p):
+        coal = self.config.coalesce and self._coal_eligible(p)
+        # flight: the gateway-accept stamp (critpath's gateway_queue /
+        # coalesce_park boundary; arg records the park decision)
+        self.engine.flight.record(
+            FRE_GW_RECV, shard=p.shard, arg=1 if coal else 0,
+            batch=fr_hash(bid),
+        )
+        if coal:
             self._coal_add(sender, p, t0)
             return
         if self.config.coalesce:
@@ -1420,6 +1536,12 @@ class GatewayServer:
         if wal is not None and status == ResultStatus.OK:
             try:
                 await wal.durability_barrier(covered=len(entries))
+                # flight: one barrier stamp per wave, keyed by the LEAD
+                # batch hash (covered entries' traces merge the wave's
+                # trace in — obs/critpath fetches both hashes)
+                self.engine.flight.record(
+                    FRE_BARRIER, shard=shard, batch=fr_hash(batch_id),
+                )
             except Exception as e:
                 status = ResultStatus.ERROR
                 payload_all = (
@@ -1445,7 +1567,22 @@ class GatewayServer:
                 batch=fr_hash(batch_id_for(p.client_id, p.seq)),
             )
             if t0:
-                self._h_submit_result.observe(now - t0)
+                wall = now - t0
+                self._h_submit_result.observe(wall)
+                self.slowlog.observe(
+                    wall,
+                    {
+                        "client": p.client_id.hex,
+                        "seq": int(p.seq),
+                        "batch": batch_id_for(p.client_id, p.seq).hex,
+                        "wave": getattr(
+                            batch_id, "value", batch_id
+                        ).hex,
+                        "shard": int(shard),
+                        "status": int(status),
+                        "coalesced": True,
+                    },
+                )
             self._send_result(sender, p.client_id, p.seq, status, pay)
         self._stg_gw(pcns() - tc)
 
@@ -1524,6 +1661,9 @@ class GatewayServer:
         if wal is not None and status == ResultStatus.OK:
             try:
                 await wal.durability_barrier()
+                self.engine.flight.record(
+                    FRE_BARRIER, shard=p.shard, batch=fr_hash(batch_id),
+                )
             except Exception as e:
                 # lost durability must not ack: terminal for this seq
                 # (cached; the client retries under a new seq)
@@ -1549,7 +1689,20 @@ class GatewayServer:
             batch=fr_hash(batch_id),
         )
         if t0:
-            self._h_submit_result.observe(time.perf_counter() - t0)
+            wall = time.perf_counter() - t0
+            self._h_submit_result.observe(wall)
+            self.slowlog.observe(
+                wall,
+                {
+                    "client": p.client_id.hex,
+                    "seq": int(p.seq),
+                    "batch": getattr(batch_id, "value", batch_id).hex,
+                    "wave": getattr(batch_id, "value", batch_id).hex,
+                    "shard": int(p.shard),
+                    "status": int(status),
+                    "coalesced": False,
+                },
+            )
         self._send_result(sender, p.client_id, p.seq, status, payload)
         self._stg_gw(pcns() - tc)
 

@@ -314,6 +314,20 @@ def load_hostkernel() -> ctypes.CDLL | None:
         lib.rk_flight.argtypes = [p]
         lib.rk_flight_head.restype = ctypes.c_uint64
         lib.rk_flight_head.argtypes = [p]
+        if hasattr(lib, "rk_dwell"):
+            # per-phase consensus dwell histograms (RTH-style geometry)
+            lib.rk_dwell_version.restype = ctypes.c_int32
+            lib.rk_dwell_version.argtypes = []
+            lib.rk_dwell_phases.restype = ctypes.c_int32
+            lib.rk_dwell_phases.argtypes = []
+            lib.rk_dwell_buckets.restype = ctypes.c_int32
+            lib.rk_dwell_buckets.argtypes = []
+            lib.rk_dwell_sub_bits.restype = ctypes.c_int32
+            lib.rk_dwell_sub_bits.argtypes = []
+            lib.rk_dwell_min_exp.restype = ctypes.c_int32
+            lib.rk_dwell_min_exp.argtypes = []
+            lib.rk_dwell.restype = ctypes.c_void_p
+            lib.rk_dwell.argtypes = [p]
         _HK_CACHED = lib
         return lib
 

@@ -418,6 +418,24 @@ static_assert(sizeof(FrEvent) == 32, "flight record layout is ABI");
 static const int32_t RK_FLIGHT_VERSION = 1;
 static const uint32_t RK_FLIGHT_CAP = 4096;  // power of two
 
+// ---------------------------------------------------------------------------
+// Per-phase consensus dwell: how long each weak-MVC phase actually took,
+// measured where the phase runs (slot open -> advance -> ... -> decide),
+// not inferred from aggregate phase counts. One histogram row per phase
+// ordinal (1..RK_DWELL_PHASES, top row clamps "8+"), RTH-style log-bucket
+// geometry (runtime.cpp): 2^SUB_BITS sub-buckets per power-of-two octave
+// from 2^MIN_EXP ns; row layout = BUCKETS counts + total count + sum_ns
+// (stride BUCKETS + 2). Versioned ABI like the RKC_* block; the Python
+// tick twin (engine._py_dwell) mirrors this geometry exactly.
+// ---------------------------------------------------------------------------
+static const int32_t RK_DWELL_VERSION = 1;
+static const int32_t RK_DWELL_SUB_BITS = 2;  // 4 sub-buckets per octave
+static const int32_t RK_DWELL_MIN_EXP = 10;  // floor 1.024us
+static const int32_t RK_DWELL_OCTAVES = 25;  // top bound 2^35 ns ~ 34.4s
+static const int32_t RK_DWELL_BUCKETS = RK_DWELL_OCTAVES << RK_DWELL_SUB_BITS;
+static const int32_t RK_DWELL_STRIDE = RK_DWELL_BUCKETS + 2;
+static const int32_t RK_DWELL_PHASES = 8;  // rows: phase 1..7 + "8+"
+
 static inline uint64_t fr_now_ns() {
   timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
@@ -503,6 +521,15 @@ struct RkCtx {
   // phases-to-decide histogram (see RK_PHASE_HIST above); zero-init
   uint64_t phase_hist[RK_PHASE_HIST];
 
+  // per-phase dwell histogram block (see RK_DWELL_* above); zero-init
+  uint64_t dwell[RK_DWELL_PHASES * RK_DWELL_STRIDE];
+  // per-shard stamp of the in-progress phase's start, plus the slot it
+  // was stamped for (-1 = unarmed). Slots armed outside rk_tick's open
+  // path (rk_start_slots called directly) carry no stamp; the slot
+  // guard skips them instead of mis-attributing a stale interval.
+  std::vector<uint64_t> dwell_t0;
+  std::vector<int64_t> dwell_t0_slot;
+
   // flight-recorder event ring (see FrEvent above); fr_head counts every
   // record ever written, the live window is the last RK_FLIGHT_CAP
   std::vector<FrEvent> fr;
@@ -523,6 +550,28 @@ static inline void fr_rec(RkCtx* c, uint8_t kind, uint8_t arg, uint16_t peer,
   e.kind = kind;
   e.arg = arg;
   c->fr_head.store(head + 1, std::memory_order_relaxed);
+}
+
+// One completed phase -> its dwell row (phase is the 1-based ordinal of
+// the phase that just finished: slots open at phase 0 and each advance
+// bumps by one, so the post-advance value counts completed phases).
+// Bucketing is bit-identical to runtime.cpp rth_observe.
+static inline void rk_dwell_obs(RkCtx* c, int32_t phase, uint64_t ns) {
+  if (phase < 1) return;
+  const int32_t row =
+      (phase < RK_DWELL_PHASES ? phase : RK_DWELL_PHASES) - 1;
+  uint64_t* h = c->dwell + (size_t)row * RK_DWELL_STRIDE;
+  int32_t idx = 0;
+  if (ns >= (1ull << RK_DWELL_MIN_EXP)) {
+    const int32_t exp = 63 - __builtin_clzll(ns);
+    const int32_t sub = (int32_t)((ns >> (exp - RK_DWELL_SUB_BITS)) &
+                                  ((1 << RK_DWELL_SUB_BITS) - 1));
+    idx = ((exp - RK_DWELL_MIN_EXP) << RK_DWELL_SUB_BITS) + sub;
+    if (idx >= RK_DWELL_BUCKETS) idx = RK_DWELL_BUCKETS - 1;
+  }
+  h[idx]++;
+  h[RK_DWELL_BUCKETS]++;
+  h[RK_DWELL_BUCKETS + 1] += ns;
 }
 
 static const size_t RK_STALE_CAP = 1024;
@@ -604,6 +653,9 @@ void* rk_ctx_create(const int64_t* dims, const int64_t* ptrs,
   c->idx_scratch.resize(c->S);
   std::memset(c->ctrs, 0, sizeof(c->ctrs));
   std::memset(c->phase_hist, 0, sizeof(c->phase_hist));
+  std::memset(c->dwell, 0, sizeof(c->dwell));
+  c->dwell_t0.assign((size_t)c->S, 0);
+  c->dwell_t0_slot.assign((size_t)c->S, -1);
   c->fr.resize(RK_FLIGHT_CAP);
   c->fr_head = 0;
   return c;
@@ -665,6 +717,20 @@ void* rk_flight(void* ctx) { return ((RkCtx*)ctx)->fr.data(); }
 uint64_t rk_flight_head(void* ctx) {
   return ((RkCtx*)ctx)->fr_head.load(std::memory_order_relaxed);
 }
+
+// --- per-phase dwell histogram block ----------------------------------------
+
+int32_t rk_dwell_version(void) { return RK_DWELL_VERSION; }
+int32_t rk_dwell_phases(void) { return RK_DWELL_PHASES; }
+int32_t rk_dwell_buckets(void) { return RK_DWELL_BUCKETS; }
+int32_t rk_dwell_sub_bits(void) { return RK_DWELL_SUB_BITS; }
+int32_t rk_dwell_min_exp(void) { return RK_DWELL_MIN_EXP; }
+// Borrowed pointer to the context's dwell block (rk_dwell_phases() rows
+// of rk_dwell_buckets() bucket counts + total count + sum_ns, stride
+// buckets + 2); context-lifetime, single-writer — the rk_counters
+// contract. The geometry accessors exist so the Python exporter can
+// refuse to decode a block whose shape it does not recognize.
+void* rk_dwell(void* ctx) { return ((RkCtx*)ctx)->dwell; }
 
 int64_t rk_carry_count(void* ctx) {
   RkCtx* c = (RkCtx*)ctx;
@@ -995,6 +1061,8 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
     for (int32_t s = c->g_lo; s < c->g_hi; s++) {
       if (open_mask[s]) {
         idx[n_open++] = s;
+        c->dwell_t0[s] = fr_now_ns();
+        c->dwell_t0_slot[s] = (int64_t)open_slots[s];
         fr_rec(c, FRE_OPEN, (uint8_t)open_init[s], 0xFFFF, (uint32_t)s,
                (int64_t)open_slots[s]);
       }
@@ -1050,7 +1118,17 @@ void rk_tick(void* ctx, double now, uint8_t* out, int64_t out_cap,
     int32_t any_adv = 0;
     for (int32_t s = c->g_lo; s < c->g_hi; s++) {
       if (!c->in_flight[s]) continue;
-      if (c->advanced[s]) any_adv = 1;
+      if (c->advanced[s]) {
+        any_adv = 1;
+        // close the phase that just completed (deciding advances mask
+        // FRE_ADVANCE via done[] but still finish their final phase,
+        // so dwell is observed on ALL advances); restamp for the next
+        if (c->dwell_t0_slot[s] == (int64_t)c->slot[s]) {
+          const uint64_t t = fr_now_ns();
+          rk_dwell_obs(c, c->phase[s], t - c->dwell_t0[s]);
+          c->dwell_t0[s] = t;
+        }
+      }
       if (c->newly_step[s]) {
         c->newly_acc[s] = 1;
         idx[n_new++] = s;

@@ -82,6 +82,15 @@ FRE_FLEET_LEDGER_SEND = 26  # dedup-ledger entry replicated to a ring
 #                             successor (peer = successor gateway index)
 FRE_FLEET_LEDGER_APPLY = 27  # replicated ledger entry applied locally
 
+# Critical-path kinds (Python-only, like the fleet tier). FRE_GW_RECV
+# stamps the instant a replica gateway accepted a FRESH Submit — before
+# the coalesce-park/drive branch — so the slowlog decomposer can split
+# gateway queueing from coalesce parking. FRE_BARRIER stamps the return
+# from the durability barrier so fsync wait is a measured segment, not
+# the gap left over between apply and result.
+FRE_GW_RECV = 28  # gateway accepted a fresh Submit (arg: 1 coalesced)
+FRE_BARRIER = 29  # durability barrier crossed for the batch's wave
+
 FR_KIND_NAMES = {
     FRE_FRAME_IN: "frame_in",
     FRE_ROUTE1: "route1",
@@ -110,6 +119,8 @@ FR_KIND_NAMES = {
     FRE_FLEET_RESULT: "fleet_result",
     FRE_FLEET_LEDGER_SEND: "fleet_ledger_send",
     FRE_FLEET_LEDGER_APPLY: "fleet_ledger_apply",
+    FRE_GW_RECV: "gw_recv",
+    FRE_BARRIER: "barrier",
 }
 
 NO_PEER = 0xFFFF
@@ -196,6 +207,18 @@ class FlightRecorder:
     def __len__(self) -> int:
         return len(self._ring)
 
+    def state(self) -> dict:
+        """Ring head/wrap document for trace wrap-honesty stamps: once
+        ``head`` exceeds ``cap`` the ring has evicted records, and any
+        trace sliced from it may be silently partial — ``oldest_t_ns``
+        bounds how far back the retained window reaches."""
+        return {
+            "head": self.head,
+            "cap": self.cap,
+            "wrapped": self.head > self.cap,
+            "oldest_t_ns": self._ring[0][0] if self._ring else None,
+        }
+
     def snapshot(self) -> list[dict]:
         """Oldest-first event dicts (the merged-view element shape)."""
         return [
@@ -252,6 +275,30 @@ def transport_ring_events(records: np.ndarray) -> list[dict]:
 # Trace slicing (replica side — served via AdminKind.TRACE)
 # ---------------------------------------------------------------------------
 
+def slice_truncated(
+    ring_state: Sequence[dict], t_hits: Sequence[int]
+) -> bool:
+    """Whether a trace sliced from ``ring_state`` rings may be missing
+    events for a batch first seen at ``min(t_hits)``.
+
+    All rings on one node share CLOCK_MONOTONIC, so the test is direct:
+    a ring that has wrapped AND whose oldest retained record is newer
+    than the batch's earliest observed event may have evicted events
+    from the batch's early life (typically a different ring than the
+    one that produced the earliest hit — e.g. the native tick ring
+    wrapping past a long-parked submit that the Python ring kept)."""
+    if not t_hits:
+        return False
+    tmin = min(t_hits)
+    for r in ring_state:
+        if not r.get("wrapped"):
+            continue
+        oldest = r.get("oldest_t_ns")
+        if oldest is not None and oldest > tmin:
+            return True
+    return False
+
+
 # kinds whose (shard, slot) join identifies a batch's consensus slot
 _SLOT_BEARING = frozenset(
     {"propose", "decide", "apply"}
@@ -300,6 +347,8 @@ def build_trace_slice(
             and tmin <= e["t_ns"] <= tmax
         ):
             sel.append(e)
+    ring_getter = getattr(engine, "flight_ring_state", None)
+    ring_state = list(ring_getter()) if ring_getter is not None else []
     return {
         "version": 1,
         "node": str(engine.node_id.value),
@@ -310,6 +359,8 @@ def build_trace_slice(
         "wall": time.time(),
         "mono_ns": time.monotonic_ns(),
         "batch_hash": int(batch_hash),
+        "ring": ring_state,
+        "truncated": slice_truncated(ring_state, t_hits),
         "events": sel,
     }
 
@@ -330,6 +381,7 @@ def build_fleet_trace_slice(
         e for e in recorder.snapshot()
         if batch_hash and e.get("batch") == batch_hash
     ]
+    ring_state = [recorder.state()]
     return {
         "version": 1,
         "tier": "fleet",
@@ -339,6 +391,10 @@ def build_fleet_trace_slice(
         "wall": time.time(),
         "mono_ns": time.monotonic_ns(),
         "batch_hash": int(batch_hash),
+        "ring": ring_state,
+        "truncated": slice_truncated(
+            ring_state, [e["t_ns"] for e in events]
+        ),
         "events": events,
     }
 
@@ -378,6 +434,7 @@ def merge_slices(slices: Sequence[dict]) -> list[dict]:
             entry["row"] = sl["row"]
             entry["err_s"] = sl["err_s"]
             entry["tier"] = sl.get("tier", "replica")
+            entry["truncated"] = bool(sl.get("truncated", False))
             merged.append(entry)
     merged.sort(key=lambda e: (e["t"], e["row"], e["t_ns"]))
     return merged
@@ -454,6 +511,8 @@ _STAGE_LABELS = {
     "fleet_result": "fleet result",
     "fleet_ledger_send": "ledger send",
     "fleet_ledger_apply": "ledger apply",
+    "gw_recv": "gateway recv",
+    "barrier": "durability barrier",
 }
 
 _FLEET_KINDS = frozenset(
@@ -500,6 +559,13 @@ def render_timeline(merged: Sequence[dict]) -> str:
         f"clock-alignment error bound ±"
         f"{max(e['err_s'] for e in merged) * 1e3:.2f} ms"
     ]
+    cut = {e["node"] for e in merged if e.get("truncated")}
+    if cut:
+        lines.append(
+            f"  WARNING: flight ring wrapped past this batch on "
+            f"{len(cut)} node(s) ({', '.join(sorted(cut))}) — "
+            "timeline may be missing early events"
+        )
     for e in merged:
         who = (
             f"gw {e['node']}" if e.get("tier") == "fleet"

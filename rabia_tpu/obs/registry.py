@@ -87,6 +87,20 @@ def _slo_buckets() -> tuple[float, ...]:
 SLO_BUCKETS: tuple[float, ...] = _slo_buckets()
 
 
+def slo_bucket_index(ns: int) -> int:
+    """Bucket index of a nanosecond duration under the SLO geometry —
+    bit-identical to the native bucketing (runtime.cpp rth_observe /
+    hostkernel.cpp rk_dwell_obs), so a Python-twin histogram row merges
+    1:1 with a native block row."""
+    if ns < (1 << SLO_MIN_EXP):
+        return 0
+    exp = int(ns).bit_length() - 1
+    sub = (ns >> (exp - SLO_SUB_BITS)) & ((1 << SLO_SUB_BITS) - 1)
+    idx = ((exp - SLO_MIN_EXP) << SLO_SUB_BITS) + sub
+    top = (SLO_OCTAVES << SLO_SUB_BITS) - 1
+    return idx if idx < top else top
+
+
 def parse_prometheus_text(text: str) -> dict[str, float]:
     """Parse a Prometheus 0.0.4 text exposition back into the
     :meth:`MetricsRegistry.snapshot` key shape (``name{labels} ->

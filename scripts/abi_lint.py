@@ -454,6 +454,11 @@ def run(root: Path) -> list[Violation]:
             cpp_const(rt.read_text(), "RTH_MIN_EXP"),
             cpp_const(rt.read_text(), "RTH_OCTAVES"),
         ),
+        "hostkernel RK_DWELL": (
+            cpp_const(hk.read_text(), "RK_DWELL_SUB_BITS"),
+            cpp_const(hk.read_text(), "RK_DWELL_MIN_EXP"),
+            cpp_const(hk.read_text(), "RK_DWELL_OCTAVES"),
+        ),
         "registry SLO": (
             registry.int_const("SLO_SUB_BITS"),
             registry.int_const("SLO_MIN_EXP"),
