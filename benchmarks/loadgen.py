@@ -122,6 +122,82 @@ def fleet_coalesce_columns(
     return out
 
 
+def _coal_shard_key(name: str):
+    """``rabia_coalesce_shard_total{field="waves",shard="3"}`` ->
+    ``("waves", 3)``; None for any other exposition key."""
+    if not name.startswith('rabia_coalesce_shard_total{'):
+        return None
+    try:
+        inner = name[name.index("{") + 1:-1]
+        labels = dict(p.split("=", 1) for p in inner.split(","))
+        return (
+            labels["field"].strip('"'),
+            int(labels["shard"].strip('"')),
+        )
+    except (ValueError, KeyError):
+        return None
+
+
+def group_delta_columns(
+    group_shards: dict[int, list[int]],
+    before: dict[int, list[dict]],
+    after: dict[int, list[dict]],
+) -> dict:
+    """Per-consensus-group counter-delta columns from scraped replica
+    metrics (:func:`rabia_tpu.obs.registry.parse_prometheus_text`
+    dicts, one per live replica, sampled around the point).
+
+    Each group is an independent cluster, so the recipes are the
+    per-cluster ones: coalesce fields summed over the group's OWNED
+    shards across its replica gateways (density = covered/waves),
+    decided_v1 and WAL fsyncs summed over replicas then normalized
+    per-replica (every replica decides every slot and fsyncs its own
+    log), and ok-Results from the per-shard ``results_ok`` counter —
+    which is also the live-groups guard's evidence that the point
+    actually spanned every group."""
+    def sums(metrics_list: list[dict], shards: set[int]) -> dict:
+        out = {f: 0 for f in _COAL_FIELDS}
+        out["decided_v1"] = 0
+        out["wal_fsyncs"] = 0
+        for m in metrics_list:
+            out["decided_v1"] += int(
+                m.get('rabia_engine_decided_total{value="v1"}', 0)
+            )
+            out["wal_fsyncs"] += int(m.get("rabia_wal_fsyncs_total", 0))
+            for k, v in m.items():
+                fs = _coal_shard_key(k)
+                if fs is not None and fs[1] in shards:
+                    out[fs[0]] = out.get(fs[0], 0) + int(v)
+        return out
+
+    doc: dict[str, dict] = {}
+    for gid in sorted(group_shards):
+        shards = set(group_shards[gid])
+        b = sums(before.get(gid) or [], shards)
+        a = sums(after.get(gid) or [], shards)
+        n_rep = max(1, len(after.get(gid) or []))
+        d = {k: a[k] - b[k] for k in a}
+        ok = d["results_ok"]
+        doc[str(gid)] = {
+            "shards": sorted(shards),
+            "replicas": len(after.get(gid) or []),
+            **{f: d[f] for f in _COAL_FIELDS},
+            "decided_v1": d["decided_v1"],
+            "wal_fsyncs": d["wal_fsyncs"],
+            "coalesce_density": (
+                round(d["covered"] / d["waves"], 4)
+                if d["waves"] > 0 else None
+            ),
+            "slots_per_op": (
+                round(d["decided_v1"] / n_rep / ok, 3) if ok > 0 else None
+            ),
+            "fsyncs_per_result": (
+                round(d["wal_fsyncs"] / n_rep / ok, 3) if ok > 0 else None
+            ),
+        }
+    return doc
+
+
 async def run_point(
     endpoints: Sequence[tuple[str, int]],
     rate: float,
@@ -141,6 +217,9 @@ async def run_point(
     fleet_resolver=None,
     fleet_fn=None,
     coal_shard_fn=None,
+    endpoint_for=None,
+    groups_fn=None,
+    group_shards=None,
 ) -> dict:
     """Drive one open-loop point and return its SLO report entry.
 
@@ -167,7 +246,18 @@ async def run_point(
     per-shard coalesce counters ``{shard: {field: cumulative}}`` —
     sampled before/after so each fleet point carries per-gateway
     coalesce-density / slots-per-op columns grouped by ring ownership
-    (:func:`fleet_coalesce_columns`)."""
+    (:func:`fleet_coalesce_columns`).
+
+    ``endpoint_for``: optional ``i -> (host, port)`` override for the
+    direct-dial lane — the partitioned-groups lane dials session ``i``
+    to the replica gateway OWNING shard ``i % n_shards`` (the
+    :class:`rabia_tpu.fleet.groups.GroupRouter` spread), so every
+    submit lands in-range and group locality is exercised end to end.
+    ``groups_fn``: optional ASYNC zero-arg callable returning
+    ``{group: [parsed replica metrics, ...]}`` — sampled before/after
+    the point; with ``group_shards`` (``{group: [shard, ...]}``) it
+    yields the per-group counter-delta columns
+    (:func:`group_delta_columns`) every multi-group point carries."""
     from rabia_tpu.apps.kvstore import (
         KVOperation,
         encode_op_bin,
@@ -265,7 +355,10 @@ async def run_point(
                 last_exc: Exception = RuntimeError("no dial attempt ran")
                 for attempt in range(3):
                     s = LoadSession(ser)
-                    ep = endpoints[i % len(endpoints)]
+                    ep = (
+                        endpoint_for(i) if endpoint_for is not None
+                        else endpoints[i % len(endpoints)]
+                    )
                     try:
                         await s.connect(*ep)
                         return s
@@ -294,6 +387,7 @@ async def run_point(
     ctr_before = dict(counters_fn()) if counters_fn is not None else None
     fleet_before = fleet_fn() if fleet_fn is not None else None
     coal_before = coal_shard_fn() if coal_shard_fn is not None else None
+    groups_before = await groups_fn() if groups_fn is not None else None
 
     counts = {k: 0 for k in OUTCOMES}
     lat_ok_ms: list[float] = []
@@ -513,6 +607,16 @@ async def run_point(
             },
         }
 
+    # per-group counter-delta columns (partitioned-groups lane): each
+    # group is an independent consensus cluster, so each gets its own
+    # slots/op, fsyncs/Result and coalesce-density figures — and the
+    # per-group results_ok delta doubles as the live-groups guard
+    groups_doc = None
+    if groups_fn is not None and groups_before is not None:
+        groups_doc = group_delta_columns(
+            group_shards or {}, groups_before, await groups_fn()
+        )
+
     # per-reason shed join: a shed-dominated point must say WHY it shed
     # (rabia_gateway_shed_total{reason=...} deltas over the point)
     shed_reasons = None
@@ -596,6 +700,7 @@ async def run_point(
             else len(muxconns) if mux > 0 else n_sessions
         ),
         "fleet": fleet_doc,
+        "groups": groups_doc,
         "shed_reasons": shed_reasons,
         "cluster_counters": cluster_counters,
         "read_lane": read_lane,
@@ -799,6 +904,180 @@ async def _in_process_timeline(cluster) -> list[dict]:
     return merge_timelines(docs) if docs else []
 
 
+async def _groups_exactly_once(harness, group_map, batch: int) -> dict:
+    """Per-group replay probe run after each point: submit a fresh
+    batch at one replica gateway, drop the connection, then re-speak
+    the SAME (client_id, seq) at that gateway over a FRESH connection —
+    the session dedup (keyed by client_id, surviving the reconnect)
+    must answer byte-identical without a second apply. One probe per
+    group, so the point's record shows the exactly-once story holding
+    in EVERY partition. (Cross-REPLICA replay semantics — identical or
+    the honest responses-unavailable terminal with a frontier
+    no-movement proof — are pinned by tests/test_groups.py and the
+    chaos sweep; under load the probe batch can ride the native block
+    lane, whose responses live only at the proposer, so a cross-replica
+    probe here would be load-dependent rather than a point invariant.)
+    """
+    from rabia_tpu.apps.kvstore import encode_set_bin
+
+    ser = Serializer()
+    doc: dict = {"ok": True, "groups": {}}
+    for g in group_map.groups():
+        rh = harness.harnesses[g]
+        shard = group_map.shards_of(g)[0]
+        cmds = [
+            encode_set_bin(f"eo-g{g}-{j}", "probe") for j in range(batch)
+        ]
+        entry: dict = {"status": None, "identical": None}
+        s1 = LoadSession(ser)
+        s2 = None
+        try:
+            await s1.connect("127.0.0.1", rh.gw_ports[0])
+            r1 = await s1.submit(shard, cmds, 15.0)
+            if r1.status != ResultStatus.OK:
+                entry["status"] = f"probe:{ResultStatus(r1.status).name}"
+            else:
+                want = tuple(bytes(p) for p in r1.payload)
+                seq = s1._seq
+                # close FIRST: the transport keys connections by
+                # client_id, so the replay must be the only live one
+                await s1.close()
+                s2 = LoadSession(ser, client_id=s1.client_id)
+                await s2.connect("127.0.0.1", rh.gw_ports[0])
+                r2 = await s2.submit_seq(seq, shard, cmds, 15.0)
+                got = tuple(bytes(p) for p in r2.payload)
+                entry["status"] = ResultStatus(r2.status).name
+                entry["identical"] = got == want
+        except Exception as e:
+            entry["status"] = f"error:{type(e).__name__}"
+            entry["identical"] = False
+        finally:
+            await s1.close()
+            if s2 is not None:
+                await s2.close()
+        doc["groups"][str(g)] = entry
+        doc["ok"] = doc["ok"] and entry["identical"] is True
+    return doc
+
+
+async def _run_groups(args, rates, sess_list, group_counts) -> dict:
+    """The partitioned-groups curve: for each requested group count G,
+    spawn G independent durable consensus clusters (real OS processes,
+    own WAL subtree each — :class:`rabia_tpu.fleet.groups
+    .GroupProcHarness`), route every session to the replica gateway
+    owning its shard, and drive the same offered-rate points. The
+    multi-group scale-out story is the aggregate ok-ops/s of the
+    groups=G points against groups=1 at EQUAL offered rate."""
+    import os as _os
+
+    from rabia_tpu.core.messages import AdminKind
+    from rabia_tpu.fleet.groups import GroupMap, GroupProcHarness
+    from rabia_tpu.gateway.client import admin_fetch
+    from rabia_tpu.obs.registry import parse_prometheus_text
+
+    loop = asyncio.get_event_loop()
+    points = []
+    for G in group_counts:
+        gm = GroupMap.initial(args.shards, G)
+        harness = GroupProcHarness(
+            gm,
+            n_replicas=args.replicas,
+            wal_root=(
+                _os.path.join(args.wal_dir, f"groups-{G}")
+                if args.wal_dir else None
+            ),
+        )
+        print(
+            f"# groups={G}: spawning {G}x{args.replicas} durable "
+            "replica processes (group-commit WAL each)",
+            file=sys.stderr,
+        )
+        await loop.run_in_executor(None, harness.start)
+        router = harness.router()
+        group_shards = {g: gm.shards_of(g) for g in gm.groups()}
+
+        async def groups_fn(h=harness, g_map=gm):
+            # scrape every LIVE replica's exposition per group; a dead
+            # replica contributes nothing (and drops the group's
+            # replica count in the columns — visible, not papered over)
+            out: dict[int, list[dict]] = {}
+            for g in g_map.groups():
+                rh = h.harnesses[g]
+                docs = []
+                for i, port in enumerate(rh.gw_ports):
+                    rp = rh.procs[i]
+                    if rp is None or rp.proc.poll() is not None:
+                        continue
+                    try:
+                        body = await admin_fetch(
+                            "127.0.0.1", port,
+                            kind=int(AdminKind.METRICS), timeout=10.0,
+                        )
+                        docs.append(parse_prometheus_text(body.decode()))
+                    except Exception:
+                        pass
+                out[g] = docs
+            return out
+
+        def endpoint_for(i: int, r=router, S=args.shards):
+            return r.upstream_for(i % S)
+
+        try:
+            for rate, n_sess in zip(rates, sess_list):
+                print(
+                    f"# point: offered {rate:.0f}/s, {n_sess} sessions, "
+                    f"{G} consensus group(s) (warmup {args.warmup}s, "
+                    f"measure {args.measure}s)",
+                    file=sys.stderr,
+                )
+                pt = await run_point(
+                    [],
+                    rate=rate,
+                    n_sessions=n_sess,
+                    warmup=args.warmup,
+                    measure=args.measure,
+                    batch=args.batch,
+                    n_shards=args.shards,
+                    call_timeout=args.call_timeout,
+                    inflight_cap=args.inflight_cap or n_sess * 8,
+                    seed=args.seed,
+                    get_ratio=0.0,
+                    endpoint_for=endpoint_for,
+                    groups_fn=groups_fn,
+                    group_shards=group_shards,
+                )
+                pt["n_groups"] = G
+                pt["exactly_once"] = await _groups_exactly_once(
+                    harness, gm, args.batch
+                )
+                points.append(pt)
+                print(json.dumps(pt), file=sys.stderr)
+        finally:
+            await loop.run_in_executor(None, harness.stop)
+
+    return {
+        "version": REPORT_VERSION,
+        "benchmark": "loadgen_slo",
+        "ts": time.time(),
+        "config": {
+            "replicas": args.replicas,
+            "shards": args.shards,
+            "batch": args.batch,
+            "warmup_s": args.warmup,
+            "measure_s": args.measure,
+            "call_timeout_s": args.call_timeout,
+            "transport": "proc-groups",
+            "open_loop": "poisson",
+            "seed": args.seed,
+            "groups": group_counts,
+            # recovery children always run the native durability plane
+            "persistence": "wal",
+            "planes": None,
+        },
+        "points": points,
+    }
+
+
 async def run(args) -> dict:
     rates = [float(r) for r in args.rates.split(",") if r]
     get_ratio = 0.9 if getattr(args, "get_heavy", False) else float(
@@ -811,6 +1090,28 @@ async def run(args) -> dict:
         sess_list = sess_list * len(rates)
     if len(sess_list) != len(rates):
         raise SystemExit("--sessions must be one value or match --rates")
+
+    if getattr(args, "groups", None):
+        group_counts = [int(x) for x in str(args.groups).split(",") if x]
+        if args.external or args.mux or args.fleet:
+            raise SystemExit(
+                "--groups drives its own process-group clusters; it "
+                "cannot combine with --mux, --fleet or --external"
+            )
+        for G in group_counts:
+            if not 1 <= G <= args.shards:
+                raise SystemExit(
+                    f"--groups values must be in [1, {args.shards}] "
+                    f"(a group owns >= 1 shard); got {G}"
+                )
+        for n_sess in sess_list:
+            if n_sess % args.shards:
+                raise SystemExit(
+                    "--groups requires session counts divisible by "
+                    "--shards (session i fires shard i %% shards; "
+                    "divisibility keeps per-group offered load even)"
+                )
+        return await _run_groups(args, rates, sess_list, group_counts)
 
     cluster = None
     fleet_harness = None
@@ -1133,6 +1434,20 @@ def main(argv=None) -> int:
         "redirect/failover tallies",
     )
     ap.add_argument(
+        "--groups", default=None, metavar="G[,G...]",
+        help="comma list of consensus-group counts: for each G, spawn "
+        "G INDEPENDENT durable consensus clusters (real OS processes, "
+        "own WAL subtree each) partitioning the shard space "
+        "contiguously (rabia_tpu.fleet.groups), route every session "
+        "to the replica gateway owning its shard, and drive the same "
+        "offered-rate points — the multi-group scale-out curve "
+        "(groups=2 vs groups=1 at equal offered rate). Points carry "
+        "per-group slots/op, fsyncs/Result and coalesce-density "
+        "columns plus a per-group exactly-once replay probe; the run "
+        "fails unless every point shows ok-Results in ALL G groups. "
+        "Incompatible with --mux/--fleet/--external",
+    )
+    ap.add_argument(
         "--no-persistence", action="store_true",
         help="run the in-process cluster's replicas persistence-free so "
         "the native engine runtime engages (planes: runtime=native); "
@@ -1214,6 +1529,30 @@ def main(argv=None) -> int:
         # failure artifact, the evidence of WHY the run was rejected
         Path(args.out).write_text(json.dumps(report, indent=1))
     problems = validate_report(report)
+    if args.groups:
+        # the --require-plane analogue for the groups lane, always on:
+        # a "groups=2" curve whose load all landed in one group (or
+        # whose replay probe broke) must never record as a scale-out
+        # result
+        for i, pt in enumerate(report["points"]):
+            cols = pt.get("groups") or {}
+            G = pt.get("n_groups")
+            dead = [
+                g for g, c in cols.items()
+                if int(c.get("results_ok") or 0) <= 0
+            ]
+            if len(cols) != G or dead:
+                problems.append(
+                    f"point {i}: groups={G} but live-group evidence "
+                    f"covers {len(cols) - len(dead)} "
+                    f"(zero ok-Results in: {sorted(dead)})"
+                )
+            eo = pt.get("exactly_once") or {}
+            if not eo.get("ok"):
+                problems.append(
+                    f"point {i}: per-group exactly-once replay probe "
+                    f"failed: {json.dumps(eo.get('groups'))}"
+                )
     planes = (report.get("config") or {}).get("planes") or {}
     for req in args.require_plane:
         name, _, want = req.partition("=")

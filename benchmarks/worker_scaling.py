@@ -217,6 +217,95 @@ async def _measure_point_procs(
         h.stop()
 
 
+async def _measure_point_groups(
+    n_groups: int, dur: float, replicas: int, shards: int,
+    sessions: int, batch: int,
+) -> dict:
+    """One measurement with the shard space PARTITIONED into
+    independent consensus groups (round 20): each group is its own
+    durable replica process set — own native runtime, own WAL fsync
+    lane — and closed-loop sessions dial through the GroupRouter to
+    the owning group's gateways. The sweep variable is the GROUP
+    count, so the curve shows whether aggregate ok-ops/s scales as
+    whole consensus clusters (not just worker threads) are added."""
+    from rabia_tpu.apps.kvstore import encode_set_bin
+    from rabia_tpu.core.messages import ResultStatus
+    from rabia_tpu.core.serialization import Serializer
+    from rabia_tpu.fleet.groups import GroupMap, GroupProcHarness
+    from rabia_tpu.testing.loadsession import LoadSession
+
+    gm = GroupMap.initial(shards, n_groups)
+    h = GroupProcHarness(gm, n_replicas=replicas)
+    ser = Serializer()
+    lat: list[float] = []
+    ok = 0
+    ok_group = {g: 0 for g in gm.groups()}
+    try:
+        await asyncio.get_running_loop().run_in_executor(None, h.start)
+        router = h.router()
+        conns = []
+        for i in range(sessions):
+            shard = i % shards
+            s = LoadSession(ser)
+            await s.connect(*router.upstream_for(shard))
+            conns.append((s, shard))
+        stop = time.perf_counter() + dur
+
+        async def session(si: int) -> None:
+            nonlocal ok
+            s, shard = conns[si]
+            g = gm.group_of(shard)
+            k = 0
+            while time.perf_counter() < stop:
+                t0 = time.perf_counter()
+                try:
+                    res = await s.submit(
+                        shard,
+                        [
+                            encode_set_bin(f"g{si}-k{k}-{j}", "v")
+                            for j in range(batch)
+                        ],
+                        30.0,
+                    )
+                except Exception:
+                    await asyncio.sleep(0.05)
+                    continue
+                lat.append(time.perf_counter() - t0)
+                if res.status == ResultStatus.OK:
+                    ok += 1
+                    ok_group[g] += 1
+                k += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(session(i) for i in range(sessions)))
+        wall = time.perf_counter() - t0
+        for s, _ in conns:
+            await s.close()
+        lat_ms = sorted(x * 1e3 for x in lat)
+
+        def pct(p):
+            return round(
+                lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))], 2
+            ) if lat_ms else None
+
+        return {
+            "groups_requested": n_groups,
+            "topology": "partitioned-groups",
+            "replicas_per_group": replicas,
+            "shards": shards,
+            "sessions": sessions,
+            "batch": batch,
+            "ok_ops_per_sec": round(ok * batch / wall, 1),
+            "submits_per_sec": round(ok / wall, 1),
+            "ok_by_group": {str(g): n for g, n in ok_group.items()},
+            "settle_p50_ms": pct(0.50),
+            "settle_p99_ms": pct(0.99),
+            "wall_s": round(wall, 3),
+        }
+    finally:
+        h.stop()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--workers", default="1,2,4,8")
@@ -234,7 +323,17 @@ def main(argv=None) -> int:
     ap.add_argument("--procs-shards", type=int, default=64)
     ap.add_argument("--procs-sessions", type=int, default=32)
     ap.add_argument("--procs-batch", type=int, default=4)
+    ap.add_argument(
+        "--groups", default=None, metavar="N,M",
+        help="sweep GROUP counts instead of worker counts: partition "
+        "the shard space into N independent consensus groups (each a "
+        "durable replica process set with its own runtime and WAL "
+        "lane, rabia_tpu.fleet.groups) and score aggregate ok-ops/s — "
+        "the round-20 scale-out axis; mutually exclusive with --procs",
+    )
     args = ap.parse_args(argv)
+    if args.groups and args.procs:
+        ap.error("--groups and --procs are mutually exclusive sweeps")
 
     import jax
 
@@ -243,11 +342,26 @@ def main(argv=None) -> int:
 
     logging.disable(logging.WARNING)
 
-    ns = [int(x) for x in args.workers.split(",") if x.strip()]
+    ns = [
+        int(x)
+        for x in (args.groups or args.workers).split(",")
+        if x.strip()
+    ]
     points = []
     for n in ns:
         samples = []
         for r in range(max(1, args.repeats)):
+            if args.groups:
+                doc = asyncio.run(
+                    _measure_point_groups(
+                        n, args.dur, args.procs_replicas,
+                        args.procs_shards, args.procs_sessions,
+                        args.procs_batch,
+                    )
+                )
+                samples.append(doc)
+                print(json.dumps(doc))
+                continue
             if args.procs:
                 doc = asyncio.run(
                     _measure_point_procs(
@@ -266,34 +380,57 @@ def main(argv=None) -> int:
                 os.environ.pop("RABIA_RT_WORKERS", None)
             samples.append(doc)
             print(json.dumps(doc))
-        metric = "ok_ops_per_sec" if args.procs else "decisions_per_sec"
+        metric = (
+            "ok_ops_per_sec"
+            if (args.procs or args.groups)
+            else "decisions_per_sec"
+        )
         best = _median([s[metric] for s in samples])
         agg = dict(next(s for s in samples if s[metric] == best))
         if args.repeats > 1:
             # key the repeat samples by what they actually measure:
-            # --procs scores client-visible ok-ops/s, not decisions/s
-            key = "samples_ok_ops_s" if args.procs else "samples_dec_s"
+            # --procs/--groups score client-visible ok-ops/s
+            key = (
+                "samples_ok_ops_s"
+                if (args.procs or args.groups)
+                else "samples_dec_s"
+            )
             agg[key] = sorted(s[metric] for s in samples)
         points.append(agg)
 
-    curve = {
-        "config": (
+    if args.groups:
+        config = (
+            f"groups:kvstore_{args.procs_replicas}rep_per_group_"
+            f"{args.procs_shards}shards_wal_gateway"
+        )
+        note = (
+            "partitioned-group scale-out: each point runs N "
+            "independent consensus groups (durable replica process "
+            "sets, own runtime + WAL lane each), closed-loop "
+            "group-routed sessions; same-session points, every "
+            "sample recorded"
+        )
+    elif args.procs:
+        config = (
             f"procs:kvstore_{args.procs_replicas}proc_"
             f"{args.procs_shards}shards_wal_gateway"
-            if args.procs
-            else "6:kvstore_5rep_4096shards_tcp_runtime"
-        ),
-        "host_cores": os.cpu_count(),
-        "note": (
+        )
+        note = (
             "thread-per-shard-group worker scaling; "
-            + (
-                "single-process-per-replica topology (durable gateway "
-                "children), closed-loop client sessions; "
-                if args.procs
-                else ""
-            )
-            + "same-session points, every sample recorded"
-        ),
+            "single-process-per-replica topology (durable gateway "
+            "children), closed-loop client sessions; "
+            "same-session points, every sample recorded"
+        )
+    else:
+        config = "6:kvstore_5rep_4096shards_tcp_runtime"
+        note = (
+            "thread-per-shard-group worker scaling; "
+            "same-session points, every sample recorded"
+        )
+    curve = {
+        "config": config,
+        "host_cores": os.cpu_count(),
+        "note": note,
         "points": points,
     }
     print(json.dumps({"curve": curve}, indent=1))

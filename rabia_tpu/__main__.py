@@ -610,6 +610,27 @@ def _ring(addr: str, timeout: float, as_json: bool) -> int:
             except Exception as e:
                 healths[m["name"]] = {"error": str(e)}
         doc["members_health"] = healths
+        # shard-group liveness (fleet/groups.py): probe each group's
+        # replica gateways so a dead group renders UNREACHABLE + stale
+        # in the ownership table rather than silently absent
+        if doc.get("groups"):
+            self_health = healths.get(doc.get("self")) or {}
+            group_alive: dict = {}
+            for gid, addrs in enumerate(
+                self_health.get("upstream_groups") or []
+            ):
+                alive = 0
+                for gh, gp in addrs:
+                    try:
+                        await admin_fetch(
+                            gh, gp, int(AdminKind.HEALTH),
+                            timeout=min(timeout, 3.0),
+                        )
+                        alive += 1
+                    except Exception:
+                        pass
+                group_alive[gid] = [alive, len(addrs)]
+            doc["group_liveness"] = group_alive
         return doc
 
     try:
@@ -619,6 +640,19 @@ def _ring(addr: str, timeout: float, as_json: bool) -> int:
         return 1
     if as_json:
         print(json.dumps(doc, indent=2))
+        return 0
+    if doc.get("ring") is None and "group" in doc:
+        # a REPLICA gateway answered: its RING document is the group
+        # card (group id + owned shard ranges), not a fleet ring
+        ranges = ", ".join(
+            f"[{lo},{hi})" for lo, hi in (doc.get("shards") or [])
+        )
+        print(
+            f"replica gateway {doc.get('node')}: "
+            f"group={doc.get('group')} "
+            f"owned shard ranges: {ranges or '(all — ungrouped)'} "
+            f"of {doc.get('n_shards')} shards"
+        )
         return 0
     ring_doc = doc.get("ring") or {}
     n_shards = int(doc.get("n_shards") or 0)
@@ -654,6 +688,22 @@ def _ring(addr: str, timeout: float, as_json: bool) -> int:
     for name in sorted(by_owner):
         shards = ",".join(str(s) for s in by_owner[name])
         print(f"  shards[{name}]: {shards}")
+    groups = doc.get("groups")
+    if groups:
+        live = doc.get("group_liveness") or {}
+        print(
+            f"  group map v{groups.get('version')} "
+            "(shard-range -> consensus group):"
+        )
+        for lo, hi, gid in groups.get("ranges", []):
+            a = live.get(gid, live.get(str(gid)))
+            status = ""
+            if a is not None:
+                alive, total = a
+                status = f"  replicas {alive}/{total}"
+                if alive == 0:
+                    status += "  UNREACHABLE (stale)"
+            print(f"    shards [{lo},{hi}) -> group {gid}{status}")
     return 0
 
 

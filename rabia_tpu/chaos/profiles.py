@@ -35,6 +35,8 @@ action                fabrics  args
 ``restart_replica``   tcp      ``node``
 ``kill_gateway``      fleet    ``gw`` (fleet gateway index; abrupt, no handoff)
 ``rebalance``         fleet    ``members`` (surviving gateway indices; handoff runs)
+``kill_group_proposer`` groups ``group`` (SIGKILL that group's proposer replica)
+``restart_group_proposer`` groups ``group`` (restart the killed proposer)
 ``clear``             both     — (clears link faults / shaping)
 ====================  =======  ====================================================
 
@@ -46,6 +48,13 @@ consensus, while replicas drop out of the alive mask and the device
 store is force-demoted mid-window; the post-run verify gates on the
 lane having actually engaged (probe reads > 0) and on zero lockstep
 apply divergences.
+
+``fabric="groups"`` (round 20) is the partitioned tier: N independent
+consensus groups, each its own OS-process replica set with its own WAL
+root (``fleet/groups.py``), loaded through group-routed sessions; the
+scenario SIGKILLs one group's proposer mid-wave and gates on the OTHER
+groups' goodput holding inside the healthy control band (blast-radius
+isolation) plus a post-run per-group exactly-once replay sweep.
 
 ``fabric="fleet"`` (round 16) is the routed tier: the same real-TCP
 replica cluster behind consistent-hash-routed fleet gateways
@@ -83,7 +92,7 @@ class ChaosProfile:
     """One named scenario (see module doc for the event vocabulary)."""
 
     name: str
-    fabric: str  # "sim" | "tcp" | "fleet" | "mesh"
+    fabric: str  # "sim" | "tcp" | "fleet" | "mesh" | "groups"
     description: str
     duration: float  # measure window, seconds
     events: tuple[ChaosEvent, ...] = ()
@@ -95,6 +104,7 @@ class ChaosProfile:
     n_replicas: int = 3
     n_shards: int = 4
     n_gateways: int = 2  # fleet fabric only: routing-tier size
+    n_groups: int = 2  # groups fabric only: partitioned consensus groups
     # acceptance floors (the matrix gate)
     min_availability: float = 0.5  # mean over the whole run
     min_final_availability: float = 0.05  # last-quarter mean: wedge guard
@@ -402,6 +412,35 @@ def default_profiles() -> dict[str, ChaosProfile]:
             # for the rest of the run: ring_stale is the asserted kind
             expect_watchdog=("ring_stale",),
         ),
+        # -- partitioned shard-group fabric (round 20: fleet/groups.py) -
+        _p(
+            "group_proposer_kill",
+            "groups",
+            "SIGKILL one consensus group's proposer replica mid-wave in "
+            "a 2-group partitioned fleet: the victim group rides through "
+            "on its surviving quorum while the OTHER group's goodput "
+            "must hold inside the healthy control band (blast-radius "
+            "isolation is the datum) — then the proposer restarts (WAL "
+            "recovery) and a per-group exactly-once replay sweep "
+            "re-submits every session's last acked seq through a "
+            "DIFFERENT replica gateway of its group, expecting CACHED "
+            "byte-identical answers and zero store mutation",
+            duration=12.0,
+            events=[
+                ChaosEvent(4.0, "kill_group_proposer", {"group": 0}),
+                ChaosEvent(8.0, "restart_group_proposer", {"group": 0}),
+            ],
+            # 2 groups x 3 replicas = 6 OS processes sharing whatever
+            # cores the host has: offer modestly so the curve scores the
+            # kill, not CPU starvation of the generator's own making
+            rate=60.0,
+            n_groups=2,
+            min_availability=0.5,
+            # the SIGKILLed proposer leaves the watchdog's per-process
+            # alive set for the kill window: ring_stale is the asserted
+            # kind, and nothing may fire in the healthy control prefix
+            expect_watchdog=("ring_stale",),
+        ),
         _p(
             "rolling_restart",
             "tcp",
@@ -422,10 +461,11 @@ def default_profiles() -> dict[str, ChaosProfile]:
 
 
 def smoke_profiles() -> dict[str, ChaosProfile]:
-    """The CI smoke subset: 6 short profiles — one simulator adverse-net,
+    """The CI smoke subset: 7 short profiles — one simulator adverse-net,
     one real-TCP shaped, one membership change under load, one routed
-    gateway failover, and the device-mesh read-lane drill — time-scaled
-    to keep the cell under a couple of minutes."""
+    gateway failover, the device-mesh read-lane drill, and the
+    partitioned-group proposer kill — time-scaled to keep the cell
+    under a couple of minutes."""
     all_p = default_profiles()
     out = {}
     for name, factor in (
@@ -435,6 +475,7 @@ def smoke_profiles() -> dict[str, ChaosProfile]:
         ("coalesce_flap_restart", 0.7),
         ("routed_gateway_failover", 0.7),
         ("mesh_device_read_lane", 0.6),
+        ("group_proposer_kill", 0.7),
     ):
         out[name] = all_p[name].scaled(factor)
     return out

@@ -948,9 +948,524 @@ class _MeshFabric:
         return all(s == snaps[0] for s in snaps[1:])
 
 
+class _GroupFabric:
+    """Partitioned shard-group fabric (round 20, fleet/groups.py): N
+    independent consensus groups, each a real OS-process replica set
+    (:class:`~rabia_tpu.fleet.groups.GroupProcHarness` — durable WAL
+    children, SIGKILL-able) under its own WAL subtree. Sessions are
+    group-routed client-side: every arrival's home shard maps through
+    the :class:`~rabia_tpu.fleet.groups.GroupMap` to its owning group,
+    and the session dials that group's preferred ("proposer") replica
+    gateway, failing over INSIDE the group when it dies. Events add
+    ``kill_group_proposer`` (SIGKILL — no graceful anything) and
+    ``restart_group_proposer`` (respawn + WAL recovery). Scoring adds
+    the blast-radius gate: the NON-killed groups' goodput during the
+    kill window must hold against their own healthy control band, and
+    the post-run :meth:`verify` replays every session's last acked seq
+    through a DIFFERENT replica gateway of its group (byte-identical,
+    zero applied-frontier movement = exactly-once held per group).
+
+    Everything observed cross-process comes from the replica gateways'
+    admin plane (METRICS scrape) — there are no in-process engines, so
+    the fabric carries its own scrape-based evidence collector."""
+
+    name = "groups"
+
+    SESSIONS_PER_LANE = 6
+
+    def __init__(self, profile: ChaosProfile) -> None:
+        from rabia_tpu.fleet.groups import GroupMap, GroupProcHarness
+
+        self.profile = profile
+        self.group_map = GroupMap.initial(
+            profile.n_shards, profile.n_groups
+        )
+        self.harness = GroupProcHarness(
+            self.group_map, n_replicas=profile.n_replicas
+        )
+        self._ser = None
+        # (group, replica) -> LoadSession pool; sessions prefer the
+        # lowest live replica index of their group (the "proposer")
+        self._sessions: dict[tuple[int, int], list] = {}
+        self._down: set[tuple[int, int]] = set()
+        self._redials: set[asyncio.Task] = set()
+        # last acked submit per session: client_id -> (group, replica,
+        # seq, shard, payload) — the verify() replay sample
+        self._last_acked: dict = {}
+        # per-group goodput rows (arrival wall time, group, outcome)
+        # and the kill/restart edges, for the blast-radius gate
+        self._group_rows: list[tuple[float, int, str]] = []
+        self._kill_edges: dict[int, list[float]] = {}
+        self._scrape_task: Optional[asyncio.Task] = None
+        self._decided_cache: dict[tuple[int, int], Optional[int]] = {}
+        self._running = False
+
+    async def start(self) -> None:
+        from rabia_tpu.core.serialization import Serializer
+
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(None, self.harness.start)
+        self._ser = Serializer()
+        for g in self.group_map.groups():
+            for r in range(self.profile.n_replicas):
+                self._sessions[(g, r)] = await self._dial_pool(g, r)
+        self._running = True
+        self._scrape_task = asyncio.ensure_future(self._scrape_loop())
+
+    async def _dial_pool(self, g: int, r: int) -> list:
+        port = self.harness.harnesses[g].gw_ports[r]
+        out = []
+        for _ in range(self.SESSIONS_PER_LANE):
+            s = LoadSession(self._ser)
+            try:
+                await s.connect("127.0.0.1", port)
+                out.append(s)
+            except Exception:
+                await s.close()
+        return out
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._scrape_task is not None:
+            self._scrape_task.cancel()
+            try:
+                await self._scrape_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        for t in list(self._redials):
+            t.cancel()
+        await asyncio.gather(*self._redials, return_exceptions=True)
+        for pool in self._sessions.values():
+            await asyncio.gather(
+                *(s.close() for s in pool), return_exceptions=True
+            )
+        self._sessions.clear()
+        self.harness.stop()
+        import shutil
+
+        shutil.rmtree(self.harness.wal_root, ignore_errors=True)
+
+    # -- admin-plane scraping ----------------------------------------------
+
+    def _live(self, g: int, r: int) -> bool:
+        rp = self.harness.harnesses[g].procs[r]
+        return (
+            (g, r) not in self._down
+            and rp is not None
+            and rp.proc.poll() is None
+        )
+
+    async def _scrape_metrics(
+        self, g: int, r: int, timeout: float = 3.0
+    ) -> Optional[dict]:
+        from rabia_tpu.core.messages import AdminKind
+        from rabia_tpu.gateway.client import admin_fetch
+        from rabia_tpu.obs.registry import parse_prometheus_text
+
+        if not self._live(g, r):
+            return None
+        port = self.harness.harnesses[g].gw_ports[r]
+        try:
+            body = await admin_fetch(
+                "127.0.0.1", port, kind=int(AdminKind.METRICS),
+                timeout=timeout,
+            )
+            return parse_prometheus_text(body.decode(errors="replace"))
+        except Exception:
+            return None
+
+    async def _scrape_loop(self) -> None:
+        """Background decided-counter cache: ``decided_totals`` is
+        called synchronously at the health cadence, and a cross-process
+        fabric cannot afford a blocking scrape there."""
+        keys = [
+            (g, r)
+            for g in self.group_map.groups()
+            for r in range(self.profile.n_replicas)
+        ]
+        while self._running:
+            for g, r in keys:
+                mm = await self._scrape_metrics(g, r, timeout=2.0)
+                if mm is None:
+                    self._decided_cache[(g, r)] = None
+                    continue
+                self._decided_cache[(g, r)] = int(
+                    mm.get('rabia_engine_decided_total{value="v0"}', 0)
+                    + mm.get('rabia_engine_decided_total{value="v1"}', 0)
+                )
+            await asyncio.sleep(0.3)
+
+    # -- events -------------------------------------------------------------
+
+    def apply_event(self, action: str, args: dict) -> None:
+        if action == "clear":
+            return
+        if action in ("kill_group_proposer", "restart_group_proposer"):
+            raise RuntimeError("group events are async — runner bug")
+        raise ValueError(f"groups fabric: unknown action {action!r}")
+
+    async def apply_event_async(self, action: str, args: dict) -> None:
+        loop = asyncio.get_event_loop()
+        if action == "kill_group_proposer":
+            g = args["group"]
+            self._down.add((g, 0))
+            self._kill_edges.setdefault(g, []).append(loop.time())
+            pool = self._sessions.pop((g, 0), [])
+            await loop.run_in_executor(
+                None, self.harness.kill9, g, 0
+            )
+            await asyncio.gather(
+                *(s.close() for s in pool), return_exceptions=True
+            )
+        elif action == "restart_group_proposer":
+            g = args["group"]
+            await loop.run_in_executor(
+                None, self.harness.restart, g, 0
+            )
+            self._down.discard((g, 0))
+            self._kill_edges.setdefault(g, []).append(loop.time())
+
+            async def redial(g=g):
+                self._sessions[(g, 0)] = await self._dial_pool(g, 0)
+
+            t = asyncio.ensure_future(redial())
+            self._redials.add(t)
+            t.add_done_callback(self._redials.discard)
+        else:
+            self.apply_event(action, args)
+
+    def clear_faults(self) -> None:
+        pass
+
+    # -- load ---------------------------------------------------------------
+
+    async def submit(self, i: int, pairs: list, timeout: float) -> str:
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        shard = i % self.profile.n_shards
+        g = self.group_map.group_of(shard)
+        arrived = asyncio.get_event_loop().time()
+        live = [
+            r for r in range(self.profile.n_replicas)
+            if self._live(g, r) and self._sessions.get((g, r))
+        ]
+        if not live:
+            self._group_rows.append((arrived, g, "shed"))
+            return "shed"
+        pool = self._sessions[(g, live[0])]
+        sess = pool[i % len(pool)]
+        cmds = [encode_set_bin(k, v) for k, v in pairs]
+        try:
+            res = await sess.submit(shard, cmds, timeout)
+        except asyncio.TimeoutError:
+            self._group_rows.append((arrived, g, "timeout"))
+            return "timeout"
+        except Exception:
+            self._group_rows.append((arrived, g, "error"))
+            return "error"
+        if res.status in (ResultStatus.OK, ResultStatus.CACHED):
+            self._last_acked[sess.client_id] = (
+                g, live[0], res.seq, shard,
+                tuple(bytes(p) for p in res.payload),
+            )
+            self._group_rows.append((arrived, g, "ok"))
+            return "ok"
+        self._group_rows.append((arrived, g, "shed"))
+        if res.status == ResultStatus.RETRY:
+            return "shed"
+        return "error"
+
+    # -- scoring ------------------------------------------------------------
+
+    def _blast_radius_problems(self) -> list[str]:
+        """The isolation gate: for every group that was NOT killed, its
+        goodput during another group's kill window must hold against
+        its OWN healthy control (the equal-length window just before
+        the kill). Allows a 50% dip — a 1-core host legitimately bleeds
+        some CPU into the victim's WAL recovery — but a partitioned
+        tier whose healthy groups halt with the victim is a failed
+        isolation story."""
+        problems: list[str] = []
+        for victim, edges in self._kill_edges.items():
+            kill_t = edges[0]
+            end_t = edges[1] if len(edges) > 1 else max(
+                (t for t, _g, _o in self._group_rows), default=kill_t
+            )
+            span = end_t - kill_t
+            if span <= 0:
+                continue
+            for g in self.group_map.groups():
+                if g == victim:
+                    continue
+
+                def avail(lo: float, hi: float, g=g) -> tuple:
+                    att = ok = 0
+                    for t, gg, o in self._group_rows:
+                        if gg == g and lo <= t < hi:
+                            att += 1
+                            ok += o == "ok"
+                    return (ok / att if att else None), att
+
+                ctrl, ctrl_n = avail(kill_t - span, kill_t)
+                fault, fault_n = avail(kill_t, end_t)
+                if ctrl is None or fault is None:
+                    problems.append(
+                        f"blast radius: group {g} has no arrivals to "
+                        f"score around group {victim}'s kill window"
+                    )
+                    continue
+                if fault < 0.5 * ctrl:
+                    problems.append(
+                        f"blast radius: group {g} goodput fell to "
+                        f"{fault:.3f} (n={fault_n}) during group "
+                        f"{victim}'s kill window vs healthy control "
+                        f"{ctrl:.3f} (n={ctrl_n}) — isolation broken"
+                    )
+        return problems
+
+    async def verify(self) -> list[str]:
+        """Per-group exactly-once sweep: every session's last ACKED seq
+        re-submitted through a DIFFERENT replica gateway of the SAME
+        group must answer byte-identical (the engine ledger's replay
+        lane), and the sweep must move no group's applied frontier
+        (a moved frontier = a replay consumed a real slot = double
+        apply)."""
+        problems = self._blast_radius_problems()
+        if not self._last_acked:
+            return problems + ["groups verify: no acked submits to replay"]
+        from rabia_tpu.apps.kvstore import encode_set_bin
+
+        async def frontier() -> dict:
+            out = {}
+            for g in self.group_map.groups():
+                for r in range(self.profile.n_replicas):
+                    mm = await self._scrape_metrics(g, r)
+                    out[(g, r)] = (
+                        None if mm is None else
+                        int(mm.get("rabia_engine_applied_slots_total", 0))
+                    )
+            return out
+
+        before = await frontier()
+        lost = 0
+        identical = 0
+        aged = 0
+        for cid, (g, r_used, seq, shard, want) in sorted(
+            self._last_acked.items(), key=lambda kv: str(kv[0])
+        ):
+            others = [
+                r for r in range(self.profile.n_replicas)
+                if r != r_used and self._live(g, r)
+            ]
+            if not others:
+                problems.append(
+                    f"groups verify: group {g} has no OTHER live "
+                    "replica to replay against"
+                )
+                continue
+            s = LoadSession(self._ser, client_id=cid)
+            try:
+                await s.connect(
+                    "127.0.0.1",
+                    self.harness.harnesses[g].gw_ports[others[0]],
+                )
+                # replay at the ORIGINAL arity: the ledger-replay lane
+                # narrows an over-long recorded response list to the
+                # replayed command count (it must never widen), so a
+                # 1-command probe of a 4-command batch would read as a
+                # truncated — hence "lost" — payload
+                res = await s.submit_seq(
+                    seq, shard,
+                    [encode_set_bin("verify-replay", "X")] * len(want),
+                    timeout=15.0,
+                )
+                got = tuple(bytes(p) for p in res.payload)
+                if res.status in (
+                    ResultStatus.OK, ResultStatus.CACHED
+                ) and got == want:
+                    identical += 1
+                elif (
+                    res.status == ResultStatus.ERROR
+                    and got
+                    and b"committed but responses unavailable" in got[0]
+                ):
+                    # the HONEST terminal for an aged replay: the engine
+                    # dedups forever on applied_ids/alias_ledger, but
+                    # applied_results is a BOUNDED response cache — an
+                    # old seq's recorded slice can evict cluster-wide,
+                    # and the replay then gets this marker instead of a
+                    # fabricated answer. Exactly-once still holds: the
+                    # frontier check below proves no slot was consumed.
+                    aged += 1
+                else:
+                    lost += 1
+                    if lost <= 4:
+                        problems.append(
+                            f"groups verify detail: group {g} shard "
+                            f"{shard} seq {seq} via r{others[0]} "
+                            f"status={ResultStatus(res.status).name} "
+                            f"want={len(want)}x{[w[:24] for w in want[:2]]}"
+                            f" got={len(got)}x{[b[:24] for b in got[:2]]}"
+                        )
+            except Exception as e:
+                problems.append(
+                    f"groups verify: replay of group {g} session "
+                    f"seq {seq} failed: {e}"
+                )
+            finally:
+                await s.close()
+        if lost:
+            problems.append(
+                f"groups verify: {lost} acked result(s) replayed "
+                "non-identical — exactly-once broken"
+            )
+        if not identical:
+            # all-aged (or all-errored) would make the byte-identity leg
+            # vacuous: demand at least one replay actually round-tripped
+            problems.append(
+                "groups verify: no replay came back byte-identical "
+                f"(identical=0 aged={aged} lost={lost})"
+            )
+        await asyncio.sleep(0.3)
+        after = await frontier()
+        moved = {
+            k: (before[k], after[k])
+            for k in before
+            if before[k] is not None
+            and after[k] is not None
+            and after[k] != before[k]
+        }
+        if moved:
+            problems.append(
+                "groups verify: replay sweep moved applied frontiers "
+                f"{moved} — double apply"
+            )
+        return problems
+
+    def engines(self) -> list:
+        return []  # cross-process: evidence comes from collect_evidence
+
+    async def collect_evidence(self) -> dict:
+        """Scrape-based termination evidence: rebuild the per-replica
+        phases-to-decide bucket counts from the Prometheus exposition
+        (cumulative ``le`` buckets diffed back to per-phase bins) and
+        the coin tallies, then fold into the shared report schema."""
+        hist = np.zeros(32, np.int64)
+        total = 0
+        ssum = 0.0
+        coins = {"v0": 0, "v1": 0}
+        pref = 'rabia_phases_to_decide_bucket{le="'
+        for g in self.group_map.groups():
+            for r in range(self.profile.n_replicas):
+                mm = await self._scrape_metrics(g, r)
+                if mm is None:
+                    continue
+                rows = []
+                for k, v in mm.items():
+                    if k.startswith(pref) and not k.endswith('+Inf"}'):
+                        rows.append((float(k[len(pref):-2]), v))
+                rows.sort()
+                prev = 0.0
+                for le, cum in rows:
+                    c = int(cum - prev)
+                    prev = cum
+                    if c > 0:
+                        hist[min(int(le), 31)] += c
+                total += int(mm.get("rabia_phases_to_decide_count", 0))
+                ssum += float(mm.get("rabia_phases_to_decide_sum", 0.0))
+                for k in ("v0", "v1"):
+                    coins[k] += int(
+                        mm.get(
+                            f'rabia_coin_flips_total{{outcome="{k}"}}', 0
+                        )
+                    )
+        return _evidence_report(hist, total, ssum, coins)
+
+    def decided_totals(self) -> list[Optional[int]]:
+        return [
+            self._decided_cache.get((g, r))
+            for g in self.group_map.groups()
+            for r in range(self.profile.n_replicas)
+        ]
+
+    def watchdog_sample(self) -> dict:
+        alive = self.harness.alive()
+        return {
+            "members_alive": sum(alive.values()),
+            "members_total": (
+                self.profile.n_groups * self.profile.n_replicas
+            ),
+        }
+
+    async def converged(self, timeout: float) -> bool:
+        """Frontier convergence per group: every live replica of a
+        group reports the SAME applied-slot frontier, stable across two
+        scrapes. (Byte-level store parity is out of reach across
+        process boundaries — the verify() replay sweep is what gates
+        payload correctness.)"""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        last = None
+        while loop.time() < deadline:
+            snap = {}
+            flat = True
+            for g in self.group_map.groups():
+                vals = []
+                for r in range(self.profile.n_replicas):
+                    mm = await self._scrape_metrics(g, r)
+                    if mm is not None:
+                        vals.append(
+                            int(mm.get(
+                                "rabia_engine_applied_slots_total", 0
+                            ))
+                        )
+                snap[g] = vals
+                if len(vals) < 2 or len(set(vals)) != 1:
+                    flat = False
+            if flat and snap == last:
+                return True
+            last = snap if flat else None
+            await asyncio.sleep(0.4)
+        print(
+            f"# groups convergence failure: frontiers {last}",
+            file=sys.stderr,
+        )
+        return False
+
+
 # ---------------------------------------------------------------------------
 # Consensus-health evidence
 # ---------------------------------------------------------------------------
+
+
+def _evidence_report(
+    hist: np.ndarray, total: int, ssum: float, coins: dict
+) -> dict:
+    """Fold an aggregated phases-to-decide histogram into the matrix
+    evidence schema (shared by the in-process and scrape-based
+    collectors)."""
+    nz = np.nonzero(hist)[0]
+    dist = {str(int(p)): int(hist[p]) for p in nz}
+    cum = np.cumsum(hist)
+
+    def pct(q: float) -> Optional[int]:
+        if total == 0:
+            return None
+        tgt = q * total
+        for p in range(len(hist)):
+            if cum[p] >= tgt:
+                return int(p)
+        return int(len(hist) - 1)
+
+    return {
+        "decisions": total,
+        "hist": dist,
+        "mean_phases": round(ssum / total, 4) if total else None,
+        "p50_phases": pct(0.50),
+        "p99_phases": pct(0.99),
+        "max_phases": int(nz[-1]) if len(nz) else None,
+        "coin_flips": coins,
+    }
 
 
 def collect_evidence(engines: list) -> dict:
@@ -978,28 +1493,7 @@ def collect_evidence(engines: list) -> dict:
                 )
         except Exception:
             continue
-    nz = np.nonzero(hist)[0]
-    dist = {str(int(p)): int(hist[p]) for p in nz}
-    cum = np.cumsum(hist)
-
-    def pct(q: float) -> Optional[int]:
-        if total == 0:
-            return None
-        tgt = q * total
-        for p in range(len(hist)):
-            if cum[p] >= tgt:
-                return int(p)
-        return int(len(hist) - 1)
-
-    return {
-        "decisions": total,
-        "hist": dist,
-        "mean_phases": round(ssum / total, 4) if total else None,
-        "p50_phases": pct(0.50),
-        "p99_phases": pct(0.99),
-        "max_phases": int(nz[-1]) if len(nz) else None,
-        "coin_flips": coins,
-    }
+    return _evidence_report(hist, total, ssum, coins)
 
 
 # ---------------------------------------------------------------------------
@@ -1017,7 +1511,7 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
 
     fabric = {
         "sim": _SimFabric, "tcp": _TcpFabric, "fleet": _FleetFabric,
-        "mesh": _MeshFabric,
+        "mesh": _MeshFabric, "groups": _GroupFabric,
     }[profile.fabric](profile)
     log(f"starting {profile.fabric} cluster "
         f"({profile.n_replicas} replicas, {profile.n_shards} shards)")
@@ -1239,7 +1733,12 @@ async def run_profile(profile: ChaosProfile, verbose: bool = True) -> dict:
         if hasattr(fabric, "verify"):
             log("running fabric verify sweep")
             fabric_problems = await fabric.verify()
-        evidence = collect_evidence(fabric.engines())
+        # cross-process fabrics have no in-process engines: they carry
+        # their own (scrape-based) evidence collector
+        if hasattr(fabric, "collect_evidence"):
+            evidence = await fabric.collect_evidence()
+        else:
+            evidence = collect_evidence(fabric.engines())
     finally:
         await fabric.stop()
 

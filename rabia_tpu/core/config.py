@@ -230,6 +230,13 @@ class RabiaConfig:
     # byte-for-byte the historical behavior). The RABIA_RT_WORKERS env
     # var overrides this knob; workers cap at min(64, num_shards).
     runtime_workers: Optional[int] = None
+    # shard-group scale-out (fleet/groups.py): the consensus group this
+    # engine's replica set belongs to in a partitioned deployment. The
+    # engine itself is group-agnostic (it still runs the full global
+    # shard space — unowned shards simply stay idle); the id scopes
+    # health documents, per-group metric attribution, and WAL/test
+    # tooling that must tell sibling groups apart. None = ungrouped.
+    group_id: Optional[int] = None
     tcp: TcpNetworkConfig = TcpNetworkConfig()
     batching: BatchConfig = BatchConfig()
     validation: ValidationConfig = ValidationConfig()

@@ -12,12 +12,24 @@ consistent-hash front door (docs/FLEET.md).
   cluster over the mux transport lane, answers ``MOVED`` for shards it
   does not own;
 - :mod:`rabia_tpu.fleet.harness` — in-process fleet harness + the
-  MOVED-following client session used by tests/chaos/bench.
+  MOVED-following client session used by tests/chaos/bench;
+- :mod:`rabia_tpu.fleet.groups` — shard-group scale-out: the versioned
+  GroupMap partitioning the shard space into independent consensus
+  groups (own replica processes, WAL, coalescing windows), the
+  GroupRouter resolving shard -> owning group's upstream, and the
+  process-group harnesses.
 """
 
 from rabia_tpu.fleet.ring import HashRing, RingMember, moved_shards
 from rabia_tpu.fleet.ledger import LedgerRecord, apply_record
 from rabia_tpu.fleet.gateway_proc import FleetGateway, FleetGatewayConfig
+from rabia_tpu.fleet.groups import (
+    GroupMap,
+    GroupRouter,
+    GroupProcHarness,
+    GroupedFleetHarness,
+    moved_group_shards,
+)
 
 __all__ = [
     "HashRing",
@@ -27,4 +39,9 @@ __all__ = [
     "apply_record",
     "FleetGateway",
     "FleetGatewayConfig",
+    "GroupMap",
+    "GroupRouter",
+    "GroupProcHarness",
+    "GroupedFleetHarness",
+    "moved_group_shards",
 ]

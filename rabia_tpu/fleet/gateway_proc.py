@@ -130,6 +130,15 @@ class FleetGatewayConfig:
     # as AdminKind.TIMELINE like the replica gateways. 0 disables it.
     telemetry_interval: float = 1.0
     telemetry_cap: int = 900
+    # shard-group scale-out (fleet/groups.py): when non-empty, each
+    # inner tuple is ONE consensus group's replica-gateway endpoints
+    # (index = group id) and a Submit routes GroupMap.group_of(shard)
+    # -> that lane — within the lane the same shard % len spread as the
+    # flat tier. `groups` is the GroupMap doc; None = the deterministic
+    # even partition over len(upstream_groups) groups. Empty
+    # upstream_groups = the flat (ungrouped) tier, `upstreams` above.
+    upstream_groups: tuple[tuple[tuple[str, int], ...], ...] = ()
+    groups: Optional[dict] = None
 
 
 @dataclass
@@ -269,6 +278,9 @@ class FleetGateway:
         # list: sessions homed to a moved shard transfer with it)
         self._session_shard: dict[uuid.UUID, int] = {}
         self._upstreams: list[_UpstreamLink] = []
+        # shard-group routing state (fleet/groups.py): None = flat tier
+        self.groups = None
+        self._group_links: dict[int, list[_UpstreamLink]] = {}
         self._admin_nonce = 0
         self._admin_futs: dict[int, asyncio.Future] = {}
         # local monotonic completion counter: the frontier_mark domain
@@ -326,6 +338,11 @@ class FleetGateway:
                 tag, fn=lambda: len(self._pending))
         m.gauge("fleet_ring_version", "adopted ring membership version",
                 tag, fn=lambda: self.ring.version)
+        m.gauge("fleet_group_map_version",
+                "adopted shard-group map version (-1 = flat tier)", tag,
+                fn=lambda: (
+                    self.groups.version if self.groups is not None else -1
+                ))
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -339,10 +356,31 @@ class FleetGateway:
                 bind_port=self.config.bind_port,
             ),
         )
-        self._upstreams = [
-            _UpstreamLink(self, host, port)
-            for host, port in self.config.upstreams
-        ]
+        if self.config.upstream_groups:
+            from rabia_tpu.fleet.groups import GroupMap
+
+            self.groups = (
+                GroupMap.from_doc(self.config.groups)
+                if self.config.groups is not None
+                else GroupMap.initial(
+                    self.config.n_shards,
+                    len(self.config.upstream_groups),
+                )
+            )
+            self._group_links = {
+                g: [_UpstreamLink(self, h, p) for h, p in addrs]
+                for g, addrs in enumerate(self.config.upstream_groups)
+            }
+            self._upstreams = [
+                link
+                for links in self._group_links.values()
+                for link in links
+            ]
+        else:
+            self._upstreams = [
+                _UpstreamLink(self, host, port)
+                for host, port in self.config.upstreams
+            ]
         if self.config.telemetry_interval > 0 and self._telemetry is None:
             self._telemetry = TelemetrySampler(
                 self.metrics,
@@ -408,6 +446,32 @@ class FleetGateway:
     def _owns(self, shard: int) -> bool:
         owner = self.ring.owner(shard)
         return owner is None or owner.name == self.config.name
+
+    # -- shard groups -------------------------------------------------------
+
+    def adopt_groups(self, new_map) -> bool:
+        """Install a strictly newer GroupMap (the routing flip of the
+        safe rebalance order — the new owner's replica gateways widened
+        their accepted ranges BEFORE this runs). Sessions stay put: the
+        fleet session cache answers replays that cross the flip, so the
+        re-routed group never sees an already-committed seq."""
+        if self.groups is None:
+            raise RuntimeError(
+                f"fleet {self.config.name}: not configured with "
+                "upstream_groups; cannot adopt a group map"
+            )
+        if new_map.version <= self.groups.version:
+            return False
+        if new_map.n_shards != self.groups.n_shards:
+            raise ValueError("group map covers a different shard space")
+        if any(
+            g not in self._group_links for g in new_map.groups()
+        ):
+            raise ValueError(
+                "group map names a group with no upstream lane"
+            )
+        self.groups = new_map
+        return True
 
     async def _rebalance(self, new_ring: HashRing) -> None:
         """Adopt a new membership view: hand sessions on departing
@@ -618,7 +682,13 @@ class FleetGateway:
                 )
             return
         shard = getattr(payload, "shard", 0)
-        up = self._upstreams[shard % len(self._upstreams)]
+        if self.groups is not None and 0 <= shard < self.groups.n_shards:
+            # group-routed lane: the owning group's upstreams, spread
+            # shard % len within the lane (coalescing concentration)
+            links = self._group_links[self.groups.group_of(shard)]
+            up = links[shard % len(links)]
+        else:
+            up = self._upstreams[shard % len(self._upstreams)]
         data = self.serializer.serialize(
             ProtocolMessage.new(NodeId(client_id), payload, None)
         )
@@ -777,6 +847,16 @@ class FleetGateway:
                 return 0, json.dumps(
                     {"adopting": new_ring.version}
                 ).encode()
+            if query.get("op") == "set_groups":
+                from rabia_tpu.fleet.groups import GroupMap
+
+                adopted = self.adopt_groups(
+                    GroupMap.from_doc(query["groups"])
+                )
+                return 0, json.dumps({
+                    "adopted": adopted,
+                    "version": self.groups.version,
+                }).encode()
             return 0, json.dumps(self._ring_doc()).encode()
         if kind == AdminKind.HANDOFF:
             exports = decode_handoff(bytes(p.query))
@@ -852,6 +932,9 @@ class FleetGateway:
             "n_shards": cfg.n_shards,
             "owned_shards": self.ring.owned_shards(cfg.name, cfg.n_shards),
             "sessions": len(self.sessions),
+            "groups": (
+                self.groups.to_doc() if self.groups is not None else None
+            ),
         }
 
     def health(self) -> dict:
@@ -869,6 +952,13 @@ class FleetGateway:
             # the fleet aggregator walks these to scrape the replica
             # tier without out-of-band configuration
             "upstreams": [[h, p] for h, p in self.config.upstreams],
+            "upstream_groups": [
+                [[h, p] for h, p in grp]
+                for grp in self.config.upstream_groups
+            ],
+            "groups": (
+                self.groups.to_doc() if self.groups is not None else None
+            ),
             "sessions": len(self.sessions),
             "pending_forwards": len(self._pending),
             "waiting": len(self._waiting),
@@ -972,6 +1062,13 @@ def _child_main(argv: list[str]) -> int:
                 n_shards=n_shards,
                 replication_factor=int(extras.get("rf", 2)),
                 forward_timeout=float(extras.get("forward_timeout", 30.0)),
+                # shard-group routing (fleet/groups.py): extras carry
+                # the per-group upstream lanes + the GroupMap doc
+                upstream_groups=tuple(
+                    tuple((str(h), int(p)) for h, p in grp)
+                    for grp in extras.get("upstream_groups", [])
+                ),
+                groups=extras.get("groups"),
             ),
             # deterministic ids so parents build the ring and MOVED
             # targets without a handshake (recovery.py's 1000+i idiom,

@@ -153,6 +153,18 @@ class GatewayConfig:
     # rotation never empties the reply. 0 exemplars disables capture.
     slowlog_cap: int = 8
     slowlog_window: float = 10.0
+    # -- shard-group scale-out (fleet/groups.py): when this replica
+    # belongs to one consensus group of a partitioned deployment,
+    # group_id names the group and group_shards lists the half-open
+    # [lo, hi) global shard ranges the group owns. Submits outside the
+    # owned ranges shed retryable (reason "group_range") — the router's
+    # map flip re-aims the client's retry — and the coalescing lane
+    # asserts every flushed window is group-local. The owned ranges are
+    # RUNTIME-UPDATABLE via the AdminKind.RING {"op": "set_group"}
+    # frame (rebalance widens the new owner before the route flips).
+    # None = ungrouped (the classic whole-shard-space replica).
+    group_id: Optional[int] = None
+    group_shards: Optional[tuple[tuple[int, int], ...]] = None
 
 
 class _SlowlogReservoir:
@@ -554,7 +566,17 @@ class GatewayServer:
             "queue_depth": 0,
             "no_quorum": 0,
             "engine_reject": 0,
+            "group_range": 0,
         }
+        # shard-group locality enforcement (fleet/groups.py): the
+        # half-open global shard ranges this replica's group owns.
+        # None = ungrouped. Mutable at runtime (set_group admin) so a
+        # rebalance can widen the new owner BEFORE the route flips.
+        self._group_ranges: Optional[list[tuple[int, int]]] = (
+            [(int(lo), int(hi)) for lo, hi in self.config.group_shards]
+            if self.config.group_shards is not None
+            else None
+        )
         # observability: the gateway registers into ITS ENGINE's registry
         # so one scrape covers the whole replica (engine + transport
         # counter block + gateway). Registration is idempotent by metric
@@ -609,6 +631,23 @@ class GatewayServer:
             "1 when the C session/dedup table owns the gateway plane",
             fn=lambda: 1.0 if self.sessions.is_native else 0.0,
         )
+        # shard-group membership (fleet/groups.py): exported only on
+        # grouped replicas so every series scraped from this process
+        # attributes to its group (fleet-top / burn-rate labels join on
+        # it); ungrouped deployments keep their metric surface unchanged
+        if self.config.group_id is not None:
+            m.gauge(
+                "gateway_group",
+                "Shard-group id this replica's consensus group serves",
+                fn=lambda: float(self.config.group_id),
+            )
+            m.gauge(
+                "gateway_group_shards",
+                "Global shards currently owned by this replica's group",
+                fn=lambda: float(sum(
+                    hi - lo for lo, hi in (self._group_ranges or [])
+                )),
+            )
         if self.sessions.is_native:
             from rabia_tpu.gateway.native_session import GWC_COUNTER_NAMES
 
@@ -693,7 +732,19 @@ class GatewayServer:
             "reads": self.stats.reads,
             "reads_batched": self.stats.reads_batched,
         }
+        if self.config.group_id is not None:
+            doc["gateway"]["group"] = {
+                "id": self.config.group_id,
+                "shards": [
+                    [lo, hi] for lo, hi in (self._group_ranges or [])
+                ],
+            }
         return doc
+
+    def _group_owns(self, shard: int) -> bool:
+        if self._group_ranges is None:
+            return True
+        return any(lo <= shard < hi for lo, hi in self._group_ranges)
 
     def _admin_body(self, kind: int, query: bytes = b"") -> tuple[int, bytes]:
         import json
@@ -767,6 +818,39 @@ class GatewayServer:
             doc = self.slowlog.document(last)
             doc["node"] = str(self.node_id.value)
             return 0, json.dumps(doc).encode()
+        if kind == AdminKind.RING:
+            # the replica-side slice of the shard-group plane: a plain
+            # get answers the group card; {"op": "set_group"} adopts
+            # new owned ranges — the widen-the-new-owner-first step of
+            # a group rebalance (fleet/groups.py), pushed BEFORE the
+            # routing tier flips its GroupMap
+            try:
+                q = json.loads(query) if query else {}
+            except (ValueError, TypeError):
+                return 1, b"malformed ring query"
+            if q.get("op") == "set_group":
+                if self.config.group_id is None:
+                    return 1, b"replica is not grouped"
+                try:
+                    ranges = [
+                        (int(lo), int(hi)) for lo, hi in q["shards"]
+                    ]
+                except (ValueError, TypeError, KeyError):
+                    return 1, b"malformed set_group ranges"
+                for lo, hi in ranges:
+                    if not (0 <= lo < hi <= self.engine.n_shards):
+                        return 1, b"set_group range out of shard space"
+                self._group_ranges = ranges
+            return 0, json.dumps({
+                "group": self.config.group_id,
+                "shards": (
+                    [[lo, hi] for lo, hi in self._group_ranges]
+                    if self._group_ranges is not None
+                    else None
+                ),
+                "n_shards": self.engine.n_shards,
+                "node": str(self.node_id.value),
+            }).encode()
         return 1, f"unknown admin kind {kind}".encode()
 
     def _on_admin(self, sender: NodeId, p: AdminRequest) -> None:
@@ -1110,6 +1194,20 @@ class GatewayServer:
                 (b"shard out of range",),
             )
             return
+        if not self._group_owns(p.shard):
+            # group-locality fence (fleet/groups.py): RETRYABLE, not an
+            # error — mid-rebalance a router's stale map can land one
+            # in-flight submit here after this group shrank; the retry
+            # re-resolves against the flipped map and reaches the new
+            # owner, where the deterministic batch id dedups any replay
+            self.sessions.abort(p.client_id, p.seq)
+            self.stats.submits_shed += 1
+            self.shed_reasons["group_range"] += 1
+            self._send_result(
+                sender, p.client_id, p.seq, ResultStatus.RETRY,
+                (b"shard not owned by this group",),
+            )
+            return
         if not p.commands:
             # validate BEFORE the ledger dedup: an empty replay of an
             # applied seq must stay an error, not an OK with a
@@ -1409,6 +1507,15 @@ class GatewayServer:
         w = self._coal.pop(shard, None)
         if w is None:
             return
+        # a coalesced PayloadBlock must NEVER span groups: windows key
+        # per shard (structural), and on a grouped replica the flushed
+        # shard must sit inside the owned ranges — asserted, not
+        # assumed (admission fences every parked submit, and set_group
+        # only ever WIDENS before routing flips toward a group)
+        assert self._group_owns(shard), (
+            f"coalesce window for shard {shard} outside group "
+            f"{self.config.group_id} ranges {self._group_ranges}"
+        )
         if w.timer is not None:
             w.timer.cancel()
             w.timer = None

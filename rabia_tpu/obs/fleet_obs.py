@@ -97,6 +97,9 @@ async def discover_fleet(
         "upstreams": [
             (str(h), int(p)) for h, p in health.get("upstreams", [])
         ],
+        # shard-group scale-out (fleet/groups.py): the adopted GroupMap
+        # doc (None on a flat fleet) — per-group attribution joins on it
+        "groups": ring_doc.get("groups"),
     }
 
 
@@ -205,12 +208,22 @@ def _metric_sum(metrics_list: Sequence[dict], needle: str) -> float:
     )
 
 
+def _replica_group(health: Optional[dict]) -> Optional[int]:
+    """The consensus-group id a replica's HEALTH document declares
+    (``gateway.group.id``, set on grouped deployments), else None."""
+    g = (health or {}).get("gateway", {}).get("group")
+    if isinstance(g, dict) and "id" in g and g["id"] is not None:
+        return int(g["id"])
+    return None
+
+
 def derive_fleet_sample(
     ring_doc: dict,
     n_shards: int,
     gateway_scrapes: dict,
     replica_scrapes: Sequence[dict],
     prev: Optional[dict] = None,
+    groups_doc: Optional[dict] = None,
 ) -> dict:
     """One fleet-level sample from a scrape round.
 
@@ -290,6 +303,73 @@ def derive_fleet_sample(
     aggregate["offcons_fraction"] = (
         round(d_probe / d_reads, 6) if d_reads > 0 else None
     )
+    # -- per-group attribution (fleet/groups.py): partition the replica
+    # tier by each replica's group card (HEALTH gateway.group.id; the
+    # stored "group" key on prev-sample scrapes), derive each group's
+    # own coalesce/slots figures over ITS shard ranges, and per-group
+    # fsyncs/Result — each group owns its own WAL lane, so the sharing
+    # argument that keeps fsyncs fleet-level does NOT apply here. A
+    # group expected by the map but answering no scrape renders
+    # stale=True (UNREACHABLE), never absent.
+    group_ranges: dict[int, list[tuple[int, int]]] = {}
+    if groups_doc:
+        for lo, hi, gid in groups_doc.get("ranges", []):
+            group_ranges.setdefault(int(gid), []).append(
+                (int(lo), int(hi))
+            )
+    by_group: dict[int, list[dict]] = {}
+    scrape_groups: list[Optional[int]] = []
+    for sc in replica_scrapes:
+        gid = sc.get("group")
+        if gid is None:
+            gid = _replica_group(sc.get("health"))
+        scrape_groups.append(gid)
+        if gid is not None:
+            by_group.setdefault(int(gid), []).append(sc["metrics"])
+    prev_by_group: dict[int, list[dict]] = {}
+    if prev and prev.get("replica_scrapes"):
+        for sc in prev["replica_scrapes"]:
+            if sc.get("group") is not None:
+                prev_by_group.setdefault(int(sc["group"]), []).append(
+                    sc["metrics"]
+                )
+    groups_out: dict[str, dict] = {}
+    stale_groups: list[int] = []
+    for gid in sorted(set(group_ranges) | set(by_group)):
+        ranges = group_ranges.get(gid)
+        mets = by_group.get(gid)
+        if not mets:
+            stale_groups.append(gid)
+            groups_out[str(gid)] = {
+                "stale": True,
+                "shard_ranges": [
+                    [lo, hi] for lo, hi in (ranges or [])
+                ],
+            }
+            continue
+        shards: Iterable[int] = (
+            [s for lo, hi in ranges for s in range(lo, hi)]
+            if ranges
+            else range(n_shards)
+        )
+        pmets = prev_by_group.get(gid)
+        fig = derive_gateway_figures(shards, mets, pmets)
+        d_fsync_g = _metric_sum(mets, "wal_fsyncs_total")
+        if pmets:
+            d_fsync_g -= _metric_sum(pmets, "wal_fsyncs_total")
+        fig["fsyncs_per_result"] = (
+            round(d_fsync_g / fig["results_ok"], 6)
+            if fig["results_ok"] > 0
+            else None
+        )
+        groups_out[str(gid)] = {
+            "stale": False,
+            "replicas": len(mets),
+            "shard_ranges": (
+                [[lo, hi] for lo, hi in ranges] if ranges else None
+            ),
+            **fig,
+        }
     return {
         "t": now,
         "wall": time.time(),
@@ -299,9 +379,14 @@ def derive_fleet_sample(
         "gateways": gateways,
         "aggregate": aggregate,
         "stale_members": stale,
+        "groups": groups_out or None,
+        "group_map_version": (
+            int(groups_doc.get("version", 0)) if groups_doc else None
+        ),
+        "stale_groups": stale_groups,
         "replica_scrapes": [
-            {"metrics": sc["metrics"], "t": sc["t"]}
-            for sc in replica_scrapes
+            {"metrics": sc["metrics"], "t": sc["t"], "group": gid}
+            for sc, gid in zip(replica_scrapes, scrape_groups)
         ],
     }
 
@@ -377,7 +462,7 @@ class FleetAggregator:
         prev = self.history[-1] if self.history else None
         doc = derive_fleet_sample(
             inv["ring"], inv["n_shards"], gateway_scrapes,
-            replica_scrapes, prev,
+            replica_scrapes, prev, groups_doc=inv.get("groups"),
         )
         self.history.append(doc)
         if self.watchdog is not None:
@@ -450,6 +535,38 @@ def render_fleet_table(doc: dict) -> str:
     )
     if doc["stale_members"]:
         lines.append(f"stale members: {', '.join(doc['stale_members'])}")
+    # shard-group section (fleet/groups.py): one row per consensus
+    # group with ITS derived figures; a dead group renders UNREACHABLE
+    # + stale (it stays in the table — absence would hide the outage)
+    if doc.get("groups"):
+        gv = doc.get("group_map_version")
+        ghead = (
+            f"{'group':<7} {'shards':<16} {'repl':>5} {'density':>8} "
+            f"{'slots/op':>9} {'fsync/res':>10}"
+        )
+        lines.append(
+            "groups"
+            + (f" (map v{gv})" if gv is not None else "")
+            + ":"
+        )
+        lines.append(ghead)
+        lines.append("-" * len(ghead))
+        for gid in sorted(doc["groups"], key=int):
+            g = doc["groups"][gid]
+            rng = ",".join(
+                f"[{lo},{hi})" for lo, hi in (g.get("shard_ranges") or [])
+            ) or "?"
+            if g.get("stale"):
+                lines.append(
+                    f"{'g' + gid:<7} {rng:<16} UNREACHABLE (stale)"
+                )
+                continue
+            lines.append(
+                f"{'g' + gid:<7} {rng:<16} {g['replicas']:>5} "
+                f"{fmt(g['coalesce_density'], 8)} "
+                f"{fmt(g['slots_per_op'], 9)} "
+                f"{fmt(g['fsyncs_per_result'], 10)}"
+            )
     return "\n".join(lines)
 
 

@@ -74,6 +74,15 @@ def _child_main(argv: list[str]) -> int:
             from dataclasses import replace
 
             cfg = replace(cfg, runtime_workers=int(extras["workers"]))
+        # shard-group membership (fleet/groups.py GroupProcHarness):
+        # {"group": g, "group_shards": [[lo, hi], ...]} scopes this
+        # replica set to one consensus group of a partitioned
+        # deployment — the gateway enforces the owned ranges
+        group_id = extras.get("group")
+        if group_id is not None:
+            from dataclasses import replace
+
+            cfg = replace(cfg, group_id=int(group_id))
         eng = RabiaEngine(
             ClusterConfig.new(me, node_ids), sm, net,
             persistence=pers, config=cfg,
@@ -84,9 +93,21 @@ def _child_main(argv: list[str]) -> int:
         task = asyncio.ensure_future(eng.run())
         # gateway under a DETERMINISTIC node id so the parent can build
         # endpoints without a handshake
+        gw_cfg = GatewayConfig(bind_port=gw_ports[idx])
+        if group_id is not None:
+            from dataclasses import replace
+
+            gw_cfg = replace(
+                gw_cfg,
+                group_id=int(group_id),
+                group_shards=tuple(
+                    (int(lo), int(hi))
+                    for lo, hi in extras.get("group_shards", [])
+                ),
+            )
         gw = GatewayServer(
             eng,
-            config=GatewayConfig(bind_port=gw_ports[idx]),
+            config=gw_cfg,
             node_id=NodeId.from_int(1000 + idx),
         )
         # wait for the engine to finish initialize: recover_engine stamps
@@ -105,6 +126,7 @@ def _child_main(argv: list[str]) -> int:
                     "pid": os.getpid(),
                     "recovery": getattr(pers, "last_recovery", None),
                     "planes": eng.health()["planes"],
+                    "group": group_id,
                 }
             ),
             flush=True,

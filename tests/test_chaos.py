@@ -162,10 +162,11 @@ class TestProfileDsl:
         profs = default_profiles()
         assert len(profs) >= 6
         fabrics = {p.fabric for p in profs.values()}
-        assert fabrics == {"sim", "tcp", "fleet", "mesh"}
+        assert fabrics == {"sim", "tcp", "fleet", "mesh", "groups"}
         # the acceptance shape: >=1 real-TCP shaped, >=1 membership,
         # >=1 routed-fleet gateway failover (round 16), >=1 device-plane
-        # mesh with a mid-window demotion (round 17)
+        # mesh with a mid-window demotion (round 17), >=1 partitioned-
+        # group proposer kill (round 20)
         assert any(
             p.fabric == "tcp"
             and any(e.action in ("wan", "link_loss") for e in p.events)
@@ -189,11 +190,17 @@ class TestProfileDsl:
             and any(e.action == "demote_device" for e in p.events)
             for p in profs.values()
         )
+        assert any(
+            p.fabric == "groups"
+            and any(e.action == "kill_group_proposer" for e in p.events)
+            for p in profs.values()
+        )
         smoke = smoke_profiles()
-        assert 2 <= len(smoke) <= 6
+        assert 2 <= len(smoke) <= 7
         assert any(p.fabric == "tcp" for p in smoke.values())
         assert "routed_gateway_failover" in smoke
         assert "mesh_device_read_lane" in smoke
+        assert "group_proposer_kill" in smoke
 
     def test_scaling_preserves_structure(self):
         p = ChaosProfile(
